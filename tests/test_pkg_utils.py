@@ -1,0 +1,238 @@
+"""Tests for pkg utilities: flock, workqueue, featuregates.
+
+Reference analogs: pkg/flock usage discipline, pkg/workqueue/workqueue_test.go,
+pkg/featuregates/featuregates_test.go.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg.flock import Flock, FlockOptions, FlockTimeoutError, locked
+from tpu_dra_driver.pkg.workqueue import (
+    BucketRateLimiter,
+    ItemExponentialFailureRateLimiter,
+    JitteredExponentialRateLimiter,
+    WorkQueue,
+    cd_daemon_rate_limiter,
+    prep_unprep_rate_limiter,
+)
+
+
+# ---------------------------------------------------------------------------
+# flock
+# ---------------------------------------------------------------------------
+
+def test_flock_basic(tmp_path):
+    p = str(tmp_path / "pu.lock")
+    with locked(p):
+        # second acquisition from another object must time out quickly
+        other = Flock(p, FlockOptions(timeout=0.15, poll_interval=0.01))
+        t0 = time.monotonic()
+        with pytest.raises(FlockTimeoutError):
+            other.acquire()
+        assert time.monotonic() - t0 >= 0.15
+    # released: immediate acquisition succeeds
+    with locked(p, timeout=0.1):
+        pass
+
+
+def test_flock_released_on_context_exit_even_on_error(tmp_path):
+    p = str(tmp_path / "cp.lock")
+    with pytest.raises(ValueError):
+        with locked(p):
+            raise ValueError("boom")
+    with locked(p, timeout=0.1):
+        pass
+
+
+def test_flock_contention_across_threads(tmp_path):
+    p = str(tmp_path / "pu.lock")
+    order = []
+
+    def worker(i):
+        with locked(p, timeout=5.0):
+            order.append(("enter", i))
+            time.sleep(0.02)
+            order.append(("exit", i))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # strictly alternating enter/exit — no overlap
+    for j in range(0, len(order), 2):
+        assert order[j][0] == "enter"
+        assert order[j + 1][0] == "exit"
+        assert order[j][1] == order[j + 1][1]
+
+
+# ---------------------------------------------------------------------------
+# rate limiters
+# ---------------------------------------------------------------------------
+
+def test_item_exponential_limiter():
+    lim = ItemExponentialFailureRateLimiter(0.25, 3.0)
+    assert lim.when("a") == 0.25
+    assert lim.when("a") == 0.5
+    assert lim.when("a") == 1.0
+    assert lim.when("a") == 2.0
+    assert lim.when("a") == 3.0  # capped
+    assert lim.when("a") == 3.0
+    assert lim.when("b") == 0.25  # independent key
+    lim.forget("a")
+    assert lim.when("a") == 0.25
+
+
+def test_bucket_limiter_burst_then_throttle():
+    lim = BucketRateLimiter(qps=5.0, burst=3)
+    delays = [lim.when("x") for _ in range(5)]
+    assert delays[0] == 0.0 and delays[1] == 0.0 and delays[2] == 0.0
+    assert delays[3] > 0.0
+    assert delays[4] > delays[3]
+
+
+def test_jittered_limiter_bounds():
+    import random
+    lim = JitteredExponentialRateLimiter(0.005, 6.0, 0.25, rng=random.Random(42))
+    d1 = lim.when("k")
+    assert 0.005 * 0.75 <= d1 <= 0.005 * 1.25
+    for _ in range(20):
+        d = lim.when("k")
+    assert d <= 6.0 * 1.25
+
+
+def test_composite_limiters_construct():
+    prep_unprep_rate_limiter().when("k")
+    cd_daemon_rate_limiter().when("k")
+
+
+# ---------------------------------------------------------------------------
+# workqueue
+# ---------------------------------------------------------------------------
+
+def test_workqueue_runs_and_retries():
+    q = WorkQueue(ItemExponentialFailureRateLimiter(0.01, 0.05))
+    attempts = []
+    done = threading.Event()
+
+    def flaky():
+        attempts.append(time.monotonic())
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        done.set()
+
+    stop = q.start()
+    q.enqueue_with_key("claim-1", flaky)
+    assert done.wait(5.0)
+    assert q.wait_idle(5.0)
+    stop.set()
+    assert len(attempts) == 3
+
+
+def test_workqueue_latest_wins():
+    q = WorkQueue(ItemExponentialFailureRateLimiter(0.01, 0.05))
+    ran = []
+    # enqueue three versions under one key before starting the worker
+    for i in range(3):
+        q.enqueue_with_key("k", (lambda i=i: ran.append(i)))
+    stop = q.start()
+    assert q.wait_idle(5.0)
+    stop.set()
+    assert ran == [2]  # only the newest ran
+
+
+def test_workqueue_auto_keys_all_run():
+    q = WorkQueue()
+    ran = []
+    for i in range(5):
+        q.enqueue(lambda i=i: ran.append(i))
+    stop = q.start(workers=2)
+    assert q.wait_idle(5.0)
+    stop.set()
+    assert sorted(ran) == [0, 1, 2, 3, 4]
+
+
+def test_workqueue_shutdown_drops_pending():
+    q = WorkQueue()
+    q.enqueue_with_key("k", lambda: None, delay=10.0)
+    q.shutdown()
+    stop = q.start()
+    assert q.wait_idle(1.0)
+    stop.set()
+
+
+# ---------------------------------------------------------------------------
+# feature gates
+# ---------------------------------------------------------------------------
+
+def test_featuregate_defaults():
+    gates = fg.FeatureGates()
+    assert gates.enabled(fg.SLICE_DAEMONS_WITH_DNS_NAMES)
+    assert gates.enabled(fg.COMPUTE_DOMAIN_CLIQUES)
+    assert gates.enabled(fg.CRASH_ON_ICI_FABRIC_ERRORS)
+    assert not gates.enabled(fg.DYNAMIC_SUBSLICE)
+    assert not gates.enabled(fg.MULTI_PROCESS_SHARING)
+
+
+def test_featuregate_parse_env_format():
+    gates = fg.from_env_spec("DynamicSubslice=true, ComputeDomainCliques=false")
+    assert gates.enabled(fg.DYNAMIC_SUBSLICE)
+    assert not gates.enabled(fg.COMPUTE_DOMAIN_CLIQUES)
+
+
+@pytest.mark.parametrize("spec", [
+    "NoSuchGate=true",
+    "DynamicSubslice",
+    "DynamicSubslice=yes",
+])
+def test_featuregate_parse_rejects_malformed(spec):
+    with pytest.raises(fg.FeatureGateError):
+        fg.from_env_spec(spec)
+
+
+@pytest.mark.parametrize("other", [
+    fg.PASSTHROUGH_SUPPORT, fg.DEVICE_HEALTH_CHECK, fg.MULTI_PROCESS_SHARING,
+])
+def test_featuregate_mutual_exclusion_with_dynamic_subslice(other):
+    with pytest.raises(fg.FeatureGateError):
+        fg.from_env_spec(f"DynamicSubslice=true,{other}=true")
+
+
+def test_featuregate_unknown_query():
+    gates = fg.FeatureGates()
+    with pytest.raises(fg.FeatureGateError):
+        gates.enabled("Bogus")
+
+
+# ---------------------------------------------------------------------------
+# regressions from review round 1
+# ---------------------------------------------------------------------------
+
+def test_workqueue_stale_delayed_entry_cannot_fire_reenqueued_item():
+    """A stale delayed heap entry from an earlier incarnation of a key must
+    not cause a newly re-enqueued item to run before its own delay."""
+    q = WorkQueue()
+    ran = []
+    barrier = threading.Event()
+
+    q.enqueue_with_key("k", lambda: ran.append("f1"), delay=0.3)
+    q.enqueue_with_key("k", lambda: (barrier.wait(2.0), ran.append("f2")))
+    stop = q.start()
+    time.sleep(0.05)  # worker pops f2 and blocks inside it
+    q.enqueue_with_key("k", lambda: ran.append("f3"), delay=60.0)
+    barrier.set()
+    time.sleep(0.6)  # past the stale 0.3s entry's ready time
+    stop.set()
+    assert ran == ["f2"]  # f3 must NOT have fired via the stale entry
+
+
+def test_featuregates_unchanged_after_rejected_parse():
+    gates = fg.FeatureGates()
+    with pytest.raises(fg.FeatureGateError):
+        gates.parse("DynamicSubslice=true,MultiProcessSharing=true")
+    assert not gates.enabled(fg.DYNAMIC_SUBSLICE)
+    assert not gates.enabled(fg.MULTI_PROCESS_SHARING)

@@ -1,0 +1,242 @@
+"""Tests for the tpulib native boundary: topology, partition naming, fake.
+
+Reference analogs: the MIG canonical-name round-trip contract
+(cmd/gpu-kubelet-plugin/mig.go:184-214) and enumeration behavior
+(nvlib.go:170-310) — tested here against the fake backend the reference
+never had.
+"""
+
+import pytest
+
+from tpu_dra_driver.tpulib import (
+    GENERATIONS,
+    SliceTopology,
+    SubsliceProfile,
+    SubsliceSpec,
+    parse_canonical_name,
+)
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+from tpu_dra_driver.tpulib.interface import (
+    HealthEvent,
+    HealthEventKind,
+    SubsliceAlreadyExistsError,
+    SubsliceNotFoundError,
+    TimesliceInterval,
+    TpuLibError,
+)
+from tpu_dra_driver.tpulib.partition import (
+    ParsedChip,
+    ParsedSubslice,
+    ParsedVfio,
+    SubsliceSpecTuple,
+    canonical_chip_name,
+    canonical_subslice_name,
+    canonical_vfio_name,
+    profiles_for,
+)
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("accel,chips,hosts,cores", [
+    ("v5p-16", 8, 2, 16),     # BASELINE north-star: 2-host v5p-16
+    ("v5p-8", 4, 1, 8),
+    ("v4-8", 4, 1, 8),
+    ("v5e-16", 16, 4, 16),
+    ("v6e-8", 8, 2, 8),
+])
+def test_slice_topology_shapes(accel, chips, hosts, cores):
+    topo = SliceTopology.from_accelerator_type(accel)
+    assert topo.num_chips == chips
+    assert topo.num_hosts == hosts
+    assert topo.num_cores == cores
+    assert topo.accelerator_type == accel
+
+
+def test_slice_topology_rejects_garbage():
+    with pytest.raises(ValueError):
+        SliceTopology.from_accelerator_type("h100-8")
+    with pytest.raises(ValueError):
+        SliceTopology.from_accelerator_type("v5p-3")  # not divisible by 2 cores
+
+
+def test_host_coord_assignment_partitions_the_torus():
+    topo = SliceTopology.from_accelerator_type("v5p-16")
+    all_coords = set(topo.chip_coords())
+    seen = set()
+    for h in range(topo.num_hosts):
+        coords = topo.coords_for_host(h)
+        assert len(coords) == 4  # chips per host
+        assert not (set(coords) & seen)
+        seen |= set(coords)
+    assert seen == all_coords
+    # determinism: same call, same answer
+    assert topo.coords_for_host(1) == topo.coords_for_host(1)
+
+
+def test_worker_env_contract():
+    topo = SliceTopology.from_accelerator_type("v5p-16")
+    env = topo.worker_env(1, ["cd-daemon-0000", "cd-daemon-0001"])
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "cd-daemon-0000,cd-daemon-0001"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+    assert env["TPU_TOPOLOGY"] == "2x2x2"
+
+
+# ---------------------------------------------------------------------------
+# partition canonical names
+# ---------------------------------------------------------------------------
+
+def test_canonical_name_round_trip_all_profiles():
+    for gen in GENERATIONS.values():
+        for prof in profiles_for(gen):
+            for start in prof.placements():
+                name = canonical_subslice_name(3, prof, start)
+                parsed = parse_canonical_name(name)
+                assert isinstance(parsed, ParsedSubslice), name
+                assert parsed.tuple == SubsliceSpecTuple(3, prof.id, start)
+                assert parsed.tuple.canonical_name() == name
+
+
+def test_canonical_chip_and_vfio_names():
+    assert parse_canonical_name(canonical_chip_name(7)) == ParsedChip(7)
+    assert parse_canonical_name(canonical_vfio_name(2)) == ParsedVfio(2)
+    assert parse_canonical_name("gpu-0") is None
+    assert parse_canonical_name("tpu-0-ss-bogus") is None
+
+
+def test_v5p_profiles():
+    gen = GENERATIONS["v5p"]
+    profs = profiles_for(gen)
+    assert [p.cores for p in profs] == [1, 2]
+    one_core = profs[0]
+    assert one_core.id == "1c47g"  # 95 GiB / 2 cores = 47 GiB per core
+    assert one_core.placements() == [0, 1]
+    assert profs[1].placements() == [0]
+
+
+def test_subslice_spec_rejects_bad_placement():
+    gen = GENERATIONS["v5p"]
+    prof = SubsliceProfile(gen, 2)
+    with pytest.raises(ValueError):
+        SubsliceSpec(0, "TPU-x", prof, placement_start=1)
+
+
+# ---------------------------------------------------------------------------
+# fake backend
+# ---------------------------------------------------------------------------
+
+def _mklib(**kw) -> FakeTpuLib:
+    return FakeTpuLib(FakeSystemConfig(**kw))
+
+
+def test_fake_enumeration_deterministic():
+    a = _mklib(accelerator_type="v5p-16", host_index=0)
+    b = _mklib(accelerator_type="v5p-16", host_index=0)
+    ca, cb = a.enumerate_chips(), b.enumerate_chips()
+    assert len(ca) == 4
+    assert [c.uuid for c in ca] == [c.uuid for c in cb]
+    assert all(c.devfs_path == f"/dev/accel{c.index}" for c in ca)
+    # different host → different uuids, same slice id
+    c = _mklib(accelerator_type="v5p-16", host_index=1)
+    assert {x.uuid for x in c.enumerate_chips()}.isdisjoint({x.uuid for x in ca})
+    assert c.slice_id() == a.slice_id()
+
+
+def test_fake_subslice_lifecycle_and_conflicts():
+    lib = _mklib(accelerator_type="v5p-8")
+    chip = lib.enumerate_chips()[0]
+    prof1 = SubsliceProfile(chip.generation, 1)
+    prof2 = SubsliceProfile(chip.generation, 2)
+
+    live0 = lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof1, 0))
+    assert live0.devfs_path.startswith(chip.devfs_path)
+    # same placement again → conflict
+    with pytest.raises(SubsliceAlreadyExistsError):
+        lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof1, 0))
+    # full-chip profile overlaps the live 1-core slice → conflict
+    with pytest.raises(SubsliceAlreadyExistsError):
+        lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof2, 0))
+    # second placement fits
+    live1 = lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof1, 1))
+    assert live1.uuid != live0.uuid
+    assert len(lib.list_subslices()) == 2
+
+    lib.destroy_subslice(SubsliceSpecTuple(chip.index, prof1.id, 0))
+    assert len(lib.list_subslices()) == 1
+    with pytest.raises(SubsliceNotFoundError):
+        lib.destroy_subslice(SubsliceSpecTuple(chip.index, prof1.id, 0))
+
+
+def test_fake_subslices_survive_plugin_restart():
+    lib = _mklib(accelerator_type="v5p-8")
+    chip = lib.enumerate_chips()[0]
+    prof = SubsliceProfile(chip.generation, 1)
+    lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof, 0))
+    # "restart": new lib object sharing host state (like real MIG devices)
+    lib2 = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"),
+                      host_state=lib.host_state)
+    live = lib2.list_subslices()
+    assert len(live) == 1
+    assert live[0].spec_tuple.canonical_name() == "tpu-0-ss-1c47g-0"
+
+
+def test_fake_vfio_bind_unbind():
+    lib = _mklib(accelerator_type="v5p-8")
+    chip = lib.enumerate_chips()[0]
+    assert lib.current_driver(chip.pci_address) == "tpu"
+    group = lib.bind_to_vfio(chip.pci_address)
+    assert group.startswith("/dev/vfio/")
+    assert lib.current_driver(chip.pci_address) == "vfio-pci"
+    # enumeration reflects the binding
+    bound = [c for c in lib.enumerate_chips() if c.pci_address == chip.pci_address][0]
+    assert bound.vfio_group == group
+    # busy device cannot be re-bound after unbind
+    lib.unbind_from_vfio(chip.pci_address)
+    lib.set_device_in_use(chip.pci_address, True)
+    with pytest.raises(TpuLibError):
+        lib.bind_to_vfio(chip.pci_address)
+
+
+def test_fake_sharing_knobs_and_health():
+    lib = _mklib(accelerator_type="v5p-8")
+    chip = lib.enumerate_chips()[0]
+    lib.set_timeslice(chip.uuid, TimesliceInterval.SHORT)
+    lib.set_exclusive_mode(chip.uuid, True)
+    assert lib.get_timeslice(chip.uuid) == TimesliceInterval.SHORT
+    assert lib.get_exclusive_mode(chip.uuid)
+
+    got = []
+    unsub = lib.subscribe_health(got.append)
+    ev = HealthEvent(HealthEventKind.HBM_ECC_ERROR, chip.uuid, 42, "injected")
+    lib.inject_health_event(ev)
+    assert got == [ev]
+    unsub()
+    lib.inject_health_event(ev)
+    assert len(got) == 1
+
+
+def test_fake_fault_injection():
+    lib = _mklib(accelerator_type="v5p-8")
+    lib.fail_next("enumerate_chips")
+    with pytest.raises(TpuLibError):
+        lib.enumerate_chips()
+    assert len(lib.enumerate_chips()) == 4  # only the next op fails
+
+
+def test_fake_vfio_groups_unique_after_unbind_rebind():
+    lib = _mklib(accelerator_type="v5p-16")  # 4 chips on this host
+    chips = lib.enumerate_chips()
+    g0 = lib.bind_to_vfio(chips[0].pci_address)
+    g1 = lib.bind_to_vfio(chips[1].pci_address)
+    lib.unbind_from_vfio(chips[0].pci_address)
+    g2 = lib.bind_to_vfio(chips[2].pci_address)
+    assert len({g0, g1, g2}) == 3
+
+
+def test_bounds_for_host_validates_index():
+    topo = SliceTopology.from_accelerator_type("v5p-16")
+    with pytest.raises(ValueError):
+        topo.bounds_for_host(5)

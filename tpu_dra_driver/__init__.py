@@ -1,0 +1,34 @@
+"""tpu_dra_driver — a TPU-native Kubernetes Dynamic Resource Allocation driver.
+
+A from-scratch rebuild of the capabilities of the NVIDIA DRA GPU driver
+(reference: /root/reference, surveyed in SURVEY.md), designed TPU-first:
+
+- ``tpulib``     — native device boundary: TPU chip enumeration (/dev/accel*,
+                   /dev/vfio, PCI vendor 0x1ae0), generation/topology model,
+                   per-megacore sub-slice partitioning (the MIG analog), with
+                   both a C++ native backend and a faithful in-memory fake.
+- ``plugin``     — the tpu-kubelet-plugin: ResourceSlice publishing (incl.
+                   KEP-4815 partitionable devices), checkpointed two-phase
+                   Prepare/Unprepare, CDI spec generation, sharing managers.
+- ``computedomain`` — the ComputeDomain control plane: cluster controller,
+                   per-node daemon, and the compute-domain kubelet plugin that
+                   orchestrate multi-host ICI slice topology (worker IDs,
+                   hostnames, readiness-gated workload release) in place of
+                   the reference's IMEX daemons/channels.
+- ``kube``       — self-contained Kubernetes client machinery (typed client,
+                   in-memory fake API server with watch, informers/listers,
+                   leader election) replacing client-go.
+- ``pkg``        — substrate-agnostic utilities: feature gates, flock,
+                   rate-limited workqueues.
+- ``cdi``        — TPU-native CDI spec generation (no NVIDIA Container
+                   Toolkit): device nodes, libtpu mounts, TPU_* env.
+- ``workloads``  — JAX validation workloads (the nickelpie/nvbandwidth
+                   analog): sharded training step + ICI allreduce benchmarks.
+"""
+
+from tpu_dra_driver.version import VERSION as __version__  # noqa: F401
+
+DRIVER_NAME = "tpu.google.com"
+COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.google.com"
+API_GROUP = "resource.tpu.google.com"
+API_VERSION = "v1beta1"

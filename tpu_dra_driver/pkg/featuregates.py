@@ -1,0 +1,152 @@
+"""Feature-gate registry with cross-gate dependency validation.
+
+Reference analog: pkg/featuregates/featuregates.go:32-189 — a
+component-base featuregate registry versioned against the project version,
+with gates and *mutual-exclusion* validation (DynamicMIG cannot be combined
+with Passthrough / health check / MPS).
+
+TPU mapping of the reference gates:
+
+=========================  =================================  =======
+reference gate             TPU gate                           default
+=========================  =================================  =======
+TimeSlicingSettings        TimeSlicingSettings                False
+MPSSupport                 MultiProcessSharing                False
+IMEXDaemonsWithDNSNames    SliceDaemonsWithDNSNames           True
+PassthroughSupport         PassthroughSupport                 False
+NVMLDeviceHealthCheck      DeviceHealthCheck                  False
+DynamicMIG                 DynamicSubslice                    False
+ComputeDomainCliques       ComputeDomainCliques               True
+CrashOnNVLinkFabricErrors  CrashOnICIFabricErrors             True
+=========================  =================================  =======
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Iterable, Mapping
+
+
+class Stage(Enum):
+    ALPHA = "Alpha"
+    BETA = "Beta"
+    GA = "GA"
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    name: str
+    default: bool
+    stage: Stage
+    locked: bool = False  # locked-to-default (GA'd) gates cannot be changed
+
+
+TIME_SLICING_SETTINGS = "TimeSlicingSettings"
+MULTI_PROCESS_SHARING = "MultiProcessSharing"
+SLICE_DAEMONS_WITH_DNS_NAMES = "SliceDaemonsWithDNSNames"
+PASSTHROUGH_SUPPORT = "PassthroughSupport"
+DEVICE_HEALTH_CHECK = "DeviceHealthCheck"
+DYNAMIC_SUBSLICE = "DynamicSubslice"
+COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
+CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
+
+_SPECS: tuple[FeatureSpec, ...] = (
+    FeatureSpec(TIME_SLICING_SETTINGS, False, Stage.ALPHA),
+    FeatureSpec(MULTI_PROCESS_SHARING, False, Stage.ALPHA),
+    FeatureSpec(SLICE_DAEMONS_WITH_DNS_NAMES, True, Stage.BETA),
+    FeatureSpec(PASSTHROUGH_SUPPORT, False, Stage.ALPHA),
+    FeatureSpec(DEVICE_HEALTH_CHECK, False, Stage.ALPHA),
+    FeatureSpec(DYNAMIC_SUBSLICE, False, Stage.ALPHA),
+    FeatureSpec(COMPUTE_DOMAIN_CLIQUES, True, Stage.BETA),
+    FeatureSpec(CRASH_ON_ICI_FABRIC_ERRORS, True, Stage.BETA),
+)
+
+# Mutual exclusions (reference featuregates.go:170-189): dynamic
+# repartitioning owns the chip exclusively, so passthrough flips, health
+# monitoring of fixed placements, and multi-process share daemons conflict.
+_MUTUALLY_EXCLUSIVE: tuple[tuple[str, str], ...] = (
+    (DYNAMIC_SUBSLICE, PASSTHROUGH_SUPPORT),
+    (DYNAMIC_SUBSLICE, DEVICE_HEALTH_CHECK),
+    (DYNAMIC_SUBSLICE, MULTI_PROCESS_SHARING),
+)
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+@dataclass
+class FeatureGates:
+    """A resolved set of feature gates."""
+
+    _values: Dict[str, bool] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for spec in _SPECS:
+            self._values.setdefault(spec.name, spec.default)
+
+    @staticmethod
+    def known() -> Mapping[str, FeatureSpec]:
+        return {s.name: s for s in _SPECS}
+
+    def enabled(self, name: str) -> bool:
+        if name not in self._values:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        return self._values[name]
+
+    def set(self, name: str, value: bool) -> None:
+        spec = self.known().get(name)
+        if spec is None:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        if spec.locked and value != spec.default:
+            raise FeatureGateError(f"feature gate {name!r} is locked to {spec.default}")
+        self._values[name] = value
+
+    def apply(self, overrides: Mapping[str, bool]) -> None:
+        # Validate a merged copy before committing, so a rejected override
+        # set cannot leave this object in a mutually-exclusive state.
+        trial = FeatureGates(dict(self._values))
+        for k, v in overrides.items():
+            trial.set(k, v)
+        trial.validate()
+        self._values = trial._values
+
+    def parse(self, spec: str) -> None:
+        """Parse a ``Gate1=true,Gate2=false`` string (the FEATURE_GATES env
+        flag format, reference pkg/flags/featuregates.go)."""
+        overrides: Dict[str, bool] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise FeatureGateError(
+                    f"malformed feature gate {part!r}: expected Name=true|false"
+                )
+            name, _, raw = part.partition("=")
+            raw = raw.strip().lower()
+            if raw not in ("true", "false"):
+                raise FeatureGateError(
+                    f"malformed feature gate value {part!r}: expected true or false"
+                )
+            overrides[name.strip()] = raw == "true"
+        self.apply(overrides)
+
+    def validate(self) -> None:
+        for a, b in _MUTUALLY_EXCLUSIVE:
+            if self._values.get(a) and self._values.get(b):
+                raise FeatureGateError(
+                    f"feature gates {a!r} and {b!r} are mutually exclusive"
+                )
+
+    def as_dict(self) -> Dict[str, bool]:
+        return dict(self._values)
+
+
+def from_env_spec(spec: str | None) -> FeatureGates:
+    fg = FeatureGates()
+    if spec:
+        fg.parse(spec)
+    fg.validate()
+    return fg

@@ -1,0 +1,95 @@
+"""Polling file-lock wrapper with timeout, released on fd close (crash-safe).
+
+Reference analog: pkg/flock/flock.go:31-135 — a polling
+``flock(LOCK_EX|LOCK_NB)`` wrapper used for the node-global
+prepare/unprepare lock (``pu.lock``) and the checkpoint lock (``cp.lock``).
+Because the lock is tied to the open file descriptor, a crashed process
+releases it automatically when the kernel closes its fds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import fcntl
+import os
+import time
+from dataclasses import dataclass
+
+
+class FlockTimeoutError(TimeoutError):
+    """Raised when the lock cannot be acquired within the timeout."""
+
+
+@dataclass
+class FlockOptions:
+    timeout: float = 10.0       # seconds; <=0 means a single non-blocking try
+    poll_interval: float = 0.01  # seconds between LOCK_NB attempts
+
+
+class Flock:
+    """An exclusive advisory lock on a file path.
+
+    The fd is kept open for the lifetime of the lock so that process death
+    releases it. Re-entrant acquisition from the same Flock object is an
+    error (mirrors the reference's usage discipline).
+    """
+
+    def __init__(self, path: str, options: FlockOptions | None = None):
+        self._path = path
+        self._options = options or FlockOptions()
+        self._fd: int | None = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def acquire(self, timeout: float | None = None) -> None:
+        if self._fd is not None:
+            raise RuntimeError(f"flock {self._path}: already held by this object")
+        t = self._options.timeout if timeout is None else timeout
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+        deadline = time.monotonic() + max(t, 0.0)
+        try:
+            while True:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    self._fd = fd
+                    return
+                except OSError as e:
+                    if e.errno not in (errno.EWOULDBLOCK, errno.EAGAIN, errno.EACCES):
+                        raise
+                if time.monotonic() >= deadline:
+                    raise FlockTimeoutError(
+                        f"timed out after {t:.1f}s acquiring lock {self._path}"
+                    )
+                time.sleep(self._options.poll_interval)
+        except BaseException:
+            if self._fd is None:
+                os.close(fd)
+            raise
+
+    def release(self) -> None:
+        if self._fd is None:
+            return
+        fd, self._fd = self._fd, None
+        with contextlib.suppress(OSError):
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+    def __enter__(self) -> "Flock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def locked(path: str, timeout: float = 10.0) -> Flock:
+    """Convenience: ``with locked('/run/.../pu.lock'):``"""
+    return Flock(path, FlockOptions(timeout=timeout))

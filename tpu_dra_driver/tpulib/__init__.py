@@ -1,0 +1,41 @@
+"""tpulib — the native device boundary for TPUs.
+
+Reference analog: the cgo/NVML boundary (github.com/NVIDIA/go-nvml +
+go-nvlib) used by cmd/gpu-kubelet-plugin/nvlib.go. Here the substrate is:
+
+- ``/dev/accel*`` + ``/sys/class/accel`` device nodes (TPU runtime driver),
+- ``/dev/vfio/<group>`` for passthrough-bound chips,
+- PCI discovery via ``/sys/bus/pci/devices`` (Google vendor id 0x1ae0),
+- libtpu-style topology metadata (generation, chips/host, ICI torus coords).
+
+Three implementations of :class:`tpu_dra_driver.tpulib.interface.TpuLib`:
+
+- :mod:`tpu_dra_driver.tpulib.fake`   — faithful in-memory fake (the test
+  seam the reference lacks; SURVEY.md §4/§7).
+- :mod:`tpu_dra_driver.tpulib.native` — ctypes binding to the C++
+  ``libtpudev.so`` (native/tpudevlib) which does the real sysfs/devfs walk
+  and owns the live sub-slice partition registry.
+- a sysfs-walking pure-Python fallback inside ``native.py`` when the shared
+  library is unavailable.
+"""
+
+from tpu_dra_driver.tpulib.interface import (  # noqa: F401
+    TpuLib,
+    TpuLibError,
+    ChipInfo,
+    HealthEvent,
+)
+from tpu_dra_driver.tpulib.topology import (  # noqa: F401
+    Generation,
+    GENERATIONS,
+    SliceTopology,
+)
+from tpu_dra_driver.tpulib.partition import (  # noqa: F401
+    SubsliceProfile,
+    SubsliceSpec,
+    SubsliceSpecTuple,
+    SubsliceLiveTuple,
+    canonical_chip_name,
+    canonical_subslice_name,
+    parse_canonical_name,
+)

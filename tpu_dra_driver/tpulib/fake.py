@@ -1,0 +1,316 @@
+"""In-memory fake TpuLib — the hardware-free test seam.
+
+The reference has **no** fake/mock hardware backend (SURVEY.md §4); every
+meaningful test needs a real GPU cluster. This fake closes that gap: the
+entire plugin stack (enumeration → ResourceSlices → Prepare/Unprepare →
+CDI → crash recovery) runs against it in unit tests and in the in-repo e2e
+harness.
+
+Fidelity points deliberately modeled on real behavior:
+
+- deterministic chip UUIDs/PCI addresses derived from (slice_id, host,
+  index), so restarts "re-enumerate" identical hardware;
+- live sub-slices survive a *plugin* restart but not a *host* restart
+  (mirrors MIG): state lives in a shared registry object (or an optional
+  state file) that outlives the plugin object in tests;
+- occupancy conflicts: overlapping placements and double-creates fail like
+  NVML does;
+- optional fault injection: fail-next-op, health-event publishing, op
+  latency to exercise timeout paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from tpu_dra_driver.tpulib.interface import (
+    ChipInfo,
+    HealthEvent,
+    HealthHub,
+    LiveSubslice,
+    SubsliceAlreadyExistsError,
+    SubsliceNotFoundError,
+    TimesliceInterval,
+    TpuLib,
+    TpuLibError,
+)
+from tpu_dra_driver.tpulib.partition import (
+    SubsliceLiveTuple,
+    SubsliceProfile,
+    SubsliceSpec,
+    SubsliceSpecTuple,
+    parse_profile_id,
+)
+from tpu_dra_driver.tpulib.topology import GENERATIONS, SliceTopology
+
+
+def _stable_hex(*parts: object, n: int = 8) -> str:
+    h = hashlib.sha256("/".join(str(p) for p in parts).encode()).hexdigest()
+    return h[:n]
+
+
+@dataclass
+class FakeSystemConfig:
+    """Describes the fake host: which slice it sits in and where."""
+
+    accelerator_type: str = "v5p-16"   # 16 cores = 8 chips = 2 hosts
+    host_index: int = 0
+    slice_id: Optional[str] = None     # default: derived from accel type
+    driver_version: str = "fake-tpu-driver 1.0"
+    firmware_version: str = "fake-fw 2026.07"
+    devfs_root: str = "/dev"           # prefix for fabricated device paths
+
+    def resolved_slice_id(self) -> str:
+        return self.slice_id or f"slice-{_stable_hex(self.accelerator_type, 'default')}"
+
+
+@dataclass
+class _HostState:
+    """Hardware-side state that outlives a plugin process (like real MIG
+    partitions / vfio bindings do). Share one _HostState between FakeTpuLib
+    instances to simulate plugin restarts."""
+
+    subslices: Dict[SubsliceSpecTuple, SubsliceLiveTuple] = field(default_factory=dict)
+    vfio_bound: Dict[str, str] = field(default_factory=dict)   # pci -> group path
+    timeslice: Dict[str, TimesliceInterval] = field(default_factory=dict)
+    exclusive: Dict[str, bool] = field(default_factory=dict)
+    in_use: Set[str] = field(default_factory=set)              # pci addresses
+    next_partition_id: int = 1
+    next_vfio_group: int = 10
+
+
+class FakeTpuLib(TpuLib):
+    def __init__(self, config: FakeSystemConfig | None = None,
+                 host_state: _HostState | None = None):
+        self._cfg = config or FakeSystemConfig()
+        self._topo = SliceTopology.from_accelerator_type(self._cfg.accelerator_type)
+        if not (0 <= self._cfg.host_index < self._topo.num_hosts):
+            raise TpuLibError(
+                f"host_index {self._cfg.host_index} out of range for "
+                f"{self._cfg.accelerator_type} ({self._topo.num_hosts} hosts)"
+            )
+        self._state = host_state if host_state is not None else _HostState()
+        self._mu = threading.RLock()
+        self._health = HealthHub()
+        self._fail_next: Dict[str, TpuLibError] = {}
+        self._op_latency = 0.0
+        self._chips = self._build_chips()
+
+    # -- fake-only controls -------------------------------------------------
+
+    @property
+    def host_state(self) -> _HostState:
+        """Expose hardware-side state so tests can hand it to a 'restarted'
+        plugin's fresh FakeTpuLib."""
+        return self._state
+
+    def fail_next(self, op: str, error: TpuLibError | None = None) -> None:
+        self._fail_next[op] = error or TpuLibError(f"injected failure in {op}")
+
+    def set_op_latency(self, seconds: float) -> None:
+        self._op_latency = seconds
+
+    def inject_health_event(self, event: HealthEvent) -> None:
+        self._health.publish(event)
+
+    def _op(self, name: str) -> None:
+        if self._op_latency:
+            time.sleep(self._op_latency)
+        err = self._fail_next.pop(name, None)
+        if err is not None:
+            raise err
+
+    # -- enumeration --------------------------------------------------------
+
+    def _build_chips(self) -> List[ChipInfo]:
+        gen = self._topo.generation
+        slice_id = self._cfg.resolved_slice_id()
+        coords = self._topo.coords_for_host(self._cfg.host_index)
+        chips = []
+        for i, xyz in enumerate(coords):
+            uuid = f"TPU-{_stable_hex(slice_id, self._cfg.host_index, i, n=32)}"
+            bus = 4 + i
+            chips.append(
+                ChipInfo(
+                    index=i,
+                    uuid=uuid,
+                    generation=gen,
+                    pci_address=f"0000:{bus:02x}:00.0",
+                    pci_root=f"pci0000:{bus:02x}",
+                    serial=f"FAKE{_stable_hex(uuid, n=10).upper()}",
+                    devfs_path=os.path.join(self._cfg.devfs_root, f"accel{i}"),
+                    vfio_group=None,
+                    coords=xyz,
+                    host_index=self._cfg.host_index,
+                    slice_id=slice_id,
+                    driver_version=self._cfg.driver_version,
+                    firmware_version=self._cfg.firmware_version,
+                )
+            )
+        return chips
+
+    def enumerate_chips(self) -> List[ChipInfo]:
+        with self._mu:
+            self._op("enumerate_chips")
+            out = []
+            for c in self._chips:
+                group = self._state.vfio_bound.get(c.pci_address)
+                if group is not None:
+                    c = dataclasses.replace(c, vfio_group=group, devfs_path=group)
+                out.append(c)
+            return out
+
+    def host_topology(self) -> SliceTopology:
+        return self._topo
+
+    def host_index(self) -> int:
+        return self._cfg.host_index
+
+    def slice_id(self) -> str:
+        return self._cfg.resolved_slice_id()
+
+    # -- sub-slices ---------------------------------------------------------
+
+    def _chip_by_index(self, index: int) -> ChipInfo:
+        for c in self._chips:
+            if c.index == index:
+                return c
+        raise TpuLibError(f"no chip with index {index}")
+
+    def create_subslice(self, spec: SubsliceSpec) -> SubsliceLiveTuple:
+        with self._mu:
+            self._op("create_subslice")
+            chip = self._chip_by_index(spec.parent_index)
+            if chip.uuid != spec.parent_uuid:
+                raise TpuLibError(
+                    f"uuid mismatch for chip {spec.parent_index}: "
+                    f"{spec.parent_uuid} != {chip.uuid}"
+                )
+            tup = spec.tuple
+            if tup in self._state.subslices:
+                raise SubsliceAlreadyExistsError(f"sub-slice {tup.canonical_name()} exists")
+            # occupancy check: any live sub-slice overlapping the core range
+            lo = spec.placement_start
+            hi = lo + spec.profile.cores
+            for other in self._state.subslices:
+                if other.parent_index != spec.parent_index:
+                    continue
+                try:
+                    ocores, _ = parse_profile_id(other.profile_id)
+                except ValueError as e:
+                    raise TpuLibError(str(e)) from e
+                olo = other.placement_start
+                ohi = olo + ocores
+                if lo < ohi and olo < hi:
+                    raise SubsliceAlreadyExistsError(
+                        f"placement [{lo},{hi}) overlaps live sub-slice "
+                        f"{other.canonical_name()}"
+                    )
+            pid = self._state.next_partition_id
+            self._state.next_partition_id += 1
+            live = SubsliceLiveTuple(
+                uuid=f"TPUSS-{_stable_hex(chip.uuid, tup.profile_id, tup.placement_start, n=24)}",
+                partition_id=pid,
+                devfs_path=f"{chip.devfs_path}_pt{lo}",
+            )
+            self._state.subslices[tup] = live
+            return live
+
+    def destroy_subslice(self, tup: SubsliceSpecTuple) -> None:
+        with self._mu:
+            self._op("destroy_subslice")
+            if tup not in self._state.subslices:
+                raise SubsliceNotFoundError(f"no live sub-slice {tup.canonical_name()}")
+            del self._state.subslices[tup]
+
+    def list_subslices(self) -> List[LiveSubslice]:
+        with self._mu:
+            return [LiveSubslice(spec_tuple=t, live=l)
+                    for t, l in sorted(self._state.subslices.items(),
+                                       key=lambda kv: kv[0].canonical_name())]
+
+    # -- sharing knobs ------------------------------------------------------
+
+    def set_timeslice(self, chip_uuid: str, interval: TimesliceInterval) -> None:
+        with self._mu:
+            self._op("set_timeslice")
+            self._assert_chip(chip_uuid)
+            self._state.timeslice[chip_uuid] = interval
+
+    def set_exclusive_mode(self, chip_uuid: str, exclusive: bool) -> None:
+        with self._mu:
+            self._op("set_exclusive_mode")
+            self._assert_chip(chip_uuid)
+            self._state.exclusive[chip_uuid] = exclusive
+
+    def get_timeslice(self, chip_uuid: str) -> TimesliceInterval:
+        with self._mu:
+            return self._state.timeslice.get(chip_uuid, TimesliceInterval.DEFAULT)
+
+    def get_exclusive_mode(self, chip_uuid: str) -> bool:
+        with self._mu:
+            return self._state.exclusive.get(chip_uuid, False)
+
+    def _assert_chip(self, chip_uuid: str) -> ChipInfo:
+        for c in self._chips:
+            if c.uuid == chip_uuid:
+                return c
+        raise TpuLibError(f"no chip with uuid {chip_uuid}")
+
+    # -- health -------------------------------------------------------------
+
+    def subscribe_health(self, callback: Callable[[HealthEvent], None]) -> Callable[[], None]:
+        return self._health.subscribe(callback)
+
+    # -- vfio ---------------------------------------------------------------
+
+    def current_driver(self, pci_address: str) -> Optional[str]:
+        with self._mu:
+            if pci_address in self._state.vfio_bound:
+                return "vfio-pci"
+            if any(c.pci_address == pci_address for c in self._chips):
+                return "tpu"
+            return None
+
+    def bind_to_vfio(self, pci_address: str) -> str:
+        with self._mu:
+            self._op("bind_to_vfio")
+            if not any(c.pci_address == pci_address for c in self._chips):
+                raise TpuLibError(f"no chip at {pci_address}")
+            if pci_address in self._state.in_use:
+                raise TpuLibError(f"device {pci_address} busy")
+            if pci_address in self._state.vfio_bound:
+                return self._state.vfio_bound[pci_address]
+            group = f"/dev/vfio/{self._state.next_vfio_group}"
+            self._state.next_vfio_group += 1
+            self._state.vfio_bound[pci_address] = group
+            return group
+
+    def unbind_from_vfio(self, pci_address: str) -> None:
+        with self._mu:
+            self._op("unbind_from_vfio")
+            if pci_address not in self._state.vfio_bound:
+                raise TpuLibError(f"device {pci_address} not vfio-bound")
+            del self._state.vfio_bound[pci_address]
+
+    def device_in_use(self, pci_address: str) -> bool:
+        with self._mu:
+            return pci_address in self._state.in_use
+
+    def set_device_in_use(self, pci_address: str, in_use: bool) -> None:
+        with self._mu:
+            if in_use:
+                self._state.in_use.add(pci_address)
+            else:
+                self._state.in_use.discard(pci_address)
+
+    # -- versions -----------------------------------------------------------
+
+    def driver_version(self) -> str:
+        return self._cfg.driver_version

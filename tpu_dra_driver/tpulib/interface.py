@@ -1,0 +1,213 @@
+"""The TpuLib interface — every hardware touchpoint behind one seam.
+
+Reference analog: the set of operations gpu-kubelet-plugin performs against
+NVML/go-nvlib/nvidia-smi (cmd/gpu-kubelet-plugin/nvlib.go): enumeration,
+MIG create/destroy, health events, compute-mode/time-slice knobs, vfio
+driver flips. The reference calls these through concrete cgo types, which
+is why it is untestable without hardware (SURVEY.md §4). Here the seam is
+explicit: :class:`TpuLib` with a native and a fake implementation.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu_dra_driver.tpulib.partition import (
+    SubsliceLiveTuple,
+    SubsliceSpec,
+    SubsliceSpecTuple,
+)
+from tpu_dra_driver.tpulib.topology import Generation, SliceTopology
+
+
+class TpuLibError(RuntimeError):
+    pass
+
+
+class SubsliceAlreadyExistsError(TpuLibError):
+    pass
+
+
+class SubsliceNotFoundError(TpuLibError):
+    pass
+
+
+@dataclass(frozen=True)
+class ChipInfo:
+    """Everything enumeration learns about one chip.
+
+    Reference analog: GpuInfo from nvlib.go:428-566 (uuid, minor, memory,
+    architecture, brand, pciBusID, addressing mode, MIG capability).
+    """
+
+    index: int                    # accel device minor ("/dev/accel<index>")
+    uuid: str                     # stable chip id
+    generation: Generation
+    pci_address: str              # e.g. "0000:00:05.0"
+    pci_root: str                 # PCIe root complex (topology-alignment attr)
+    serial: str
+    devfs_path: str               # "/dev/accel<index>" (or vfio group path)
+    vfio_group: Optional[str]     # set when bound to vfio-pci
+    coords: Tuple[int, ...]       # ICI torus coordinates within the slice
+    host_index: int
+    slice_id: str                 # clique-id analog: slice identifier
+    driver_version: str
+    firmware_version: str
+
+    @property
+    def product_name(self) -> str:
+        return self.generation.product_name
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.generation.hbm_bytes
+
+    @property
+    def cores(self) -> int:
+        return self.generation.cores_per_chip
+
+
+class HealthEventKind(Enum):
+    # TPU analog of NVML XID critical / ECC events (device_health.go:30-121)
+    DEVICE_ERROR = "DeviceError"          # chip-fatal runtime error
+    HBM_ECC_ERROR = "HbmEccError"         # uncorrectable HBM error
+    ICI_LINK_ERROR = "IciLinkError"       # fabric link down/flap
+    THERMAL = "ThermalSlowdown"
+    PREEMPTED = "Preempted"               # maintenance event
+
+
+@dataclass(frozen=True)
+class HealthEvent:
+    kind: HealthEventKind
+    chip_uuid: str
+    code: int = 0
+    message: str = ""
+
+
+class TimesliceInterval(Enum):
+    """Time-slice scheduling interval for multi-process chip sharing.
+
+    Reference analog: api sharing.go:167-180 (Default/Short/Medium/Long →
+    nvidia-smi compute-policy --set-timeslice).
+    """
+
+    DEFAULT = "Default"
+    SHORT = "Short"
+    MEDIUM = "Medium"
+    LONG = "Long"
+
+    def micros(self) -> int:
+        return {"Default": 0, "Short": 1000, "Medium": 2000, "Long": 5000}[self.value]
+
+
+@dataclass
+class LiveSubslice:
+    spec_tuple: SubsliceSpecTuple
+    live: SubsliceLiveTuple
+
+
+class TpuLib(abc.ABC):
+    """Abstract native boundary. All methods are thread-safe."""
+
+    # -- enumeration --------------------------------------------------------
+
+    @abc.abstractmethod
+    def enumerate_chips(self) -> List[ChipInfo]:
+        """All chips visible on this host, passthrough-bound ones included
+        (their ``vfio_group`` is set)."""
+
+    @abc.abstractmethod
+    def host_topology(self) -> SliceTopology:
+        """The slice this host belongs to."""
+
+    @abc.abstractmethod
+    def host_index(self) -> int:
+        """This host's index within the slice (worker-id source of truth)."""
+
+    @abc.abstractmethod
+    def slice_id(self) -> str:
+        """Stable identifier of the ICI slice (clique-id analog)."""
+
+    # -- sub-slice partitioning (MIG analog) --------------------------------
+
+    @abc.abstractmethod
+    def create_subslice(self, spec: SubsliceSpec) -> SubsliceLiveTuple:
+        """Create a live sub-slice. Raises SubsliceAlreadyExistsError if the
+        placement is occupied."""
+
+    @abc.abstractmethod
+    def destroy_subslice(self, tup: SubsliceSpecTuple) -> None:
+        """Destroy by abstract identity (crash recovery path: identity comes
+        from a parsed canonical name, no live handle needed)."""
+
+    @abc.abstractmethod
+    def list_subslices(self) -> List[LiveSubslice]:
+        """All live sub-slices on this host (source for
+        DestroyUnknownSubslices at startup)."""
+
+    # -- sharing knobs ------------------------------------------------------
+
+    @abc.abstractmethod
+    def set_timeslice(self, chip_uuid: str, interval: TimesliceInterval) -> None: ...
+
+    @abc.abstractmethod
+    def set_exclusive_mode(self, chip_uuid: str, exclusive: bool) -> None: ...
+
+    # -- health -------------------------------------------------------------
+
+    @abc.abstractmethod
+    def subscribe_health(self, callback: Callable[[HealthEvent], None]) -> Callable[[], None]:
+        """Register a health-event callback; returns an unsubscribe fn."""
+
+    # -- passthrough (vfio) -------------------------------------------------
+
+    @abc.abstractmethod
+    def current_driver(self, pci_address: str) -> Optional[str]: ...
+
+    @abc.abstractmethod
+    def bind_to_vfio(self, pci_address: str) -> str:
+        """Unbind from the TPU runtime driver, bind to vfio-pci; returns the
+        vfio group path."""
+
+    @abc.abstractmethod
+    def unbind_from_vfio(self, pci_address: str) -> None: ...
+
+    @abc.abstractmethod
+    def device_in_use(self, pci_address: str) -> bool:
+        """True if any process holds the device node (fuser analog)."""
+
+    # -- versions -----------------------------------------------------------
+
+    @abc.abstractmethod
+    def driver_version(self) -> str: ...
+
+
+class HealthHub:
+    """Shared fan-out helper for health subscriptions."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._subs: Dict[int, Callable[[HealthEvent], None]] = {}
+        self._next = 0
+
+    def subscribe(self, cb: Callable[[HealthEvent], None]) -> Callable[[], None]:
+        with self._mu:
+            token = self._next
+            self._next += 1
+            self._subs[token] = cb
+
+        def unsub():
+            with self._mu:
+                self._subs.pop(token, None)
+
+        return unsub
+
+    def publish(self, event: HealthEvent) -> None:
+        with self._mu:
+            subs = list(self._subs.values())
+        for cb in subs:
+            cb(event)

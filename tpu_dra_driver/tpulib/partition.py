@@ -1,0 +1,204 @@
+"""Sub-slice (per-megacore) partition model — the MIG analog.
+
+Reference analog: cmd/gpu-kubelet-plugin/mig.go:33-214 — MIG identity has a
+triple representation:
+
+- an *abstract* tuple parseable **from the canonical device name** (how
+  crash-recovery re-derives what to tear down without any live handle),
+- a *live* tuple describing the concrete created object,
+- a *rich* spec carrying the full profile.
+
+We keep exactly that structure for TPU sub-slices. A sub-slice is a
+contiguous run of TensorCores on one chip with a proportional HBM share
+(megacore generations v4/v5p have 2 cores/chip; a 1-core sub-slice is the
+"half chip" unit). Canonical names:
+
+- full chip:  ``tpu-<index>``                              (gpu-<minor>)
+- sub-slice:  ``tpu-<index>-ss-<profile>-<start>``         (gpu-…-mig-…)
+- passthrough: ``tpu-vfio-<index>``                        (gpu-vfio-<idx>)
+
+where ``<profile>`` is ``<cores>c<hbmGiB>g`` (e.g. ``1c47g`` on v5p) and
+``<start>`` is the first core index of the placement. The name regex is the
+recovery contract: ``parse_canonical_name`` must round-trip every name this
+module can generate (tested in tests/test_partition.py).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Union
+
+from tpu_dra_driver.tpulib.topology import GIB, Generation
+
+PROFILE_ID_RE = re.compile(r"^(?P<cores>[0-9]+)c(?P<hbm>[0-9]+)g$")
+CHIP_NAME_RE = re.compile(r"^tpu-(?P<index>[0-9]+)$")
+SUBSLICE_NAME_RE = re.compile(
+    r"^tpu-(?P<index>[0-9]+)-ss-(?P<cores>[0-9]+)c(?P<hbm>[0-9]+)g-(?P<start>[0-9]+)$"
+)
+VFIO_NAME_RE = re.compile(r"^tpu-vfio-(?P<index>[0-9]+)$")
+
+
+@dataclass(frozen=True)
+class SubsliceProfile:
+    """A creatable sub-slice shape on a given generation (MIG profile analog)."""
+
+    generation: Generation
+    cores: int
+
+    def __post_init__(self):
+        if not (1 <= self.cores <= self.generation.cores_per_chip):
+            raise ValueError(
+                f"profile {self.cores}c invalid for {self.generation.name} "
+                f"({self.generation.cores_per_chip} cores/chip)"
+            )
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.generation.hbm_bytes_per_core * self.cores
+
+    @property
+    def hbm_gib(self) -> int:
+        return self.hbm_bytes // GIB
+
+    @property
+    def id(self) -> str:
+        """Profile string as it appears in canonical names, e.g. ``1c47g``."""
+        return f"{self.cores}c{self.hbm_gib}g"
+
+    def placements(self) -> List[int]:
+        """Valid placement start core-indices: aligned runs of ``cores``."""
+        total = self.generation.cores_per_chip
+        return list(range(0, total - self.cores + 1, self.cores))
+
+
+def profiles_for(generation: Generation) -> List[SubsliceProfile]:
+    """All sub-slice profiles a generation supports.
+
+    Power-of-two core counts that divide the chip (for 2-core megacore
+    chips: 1c and 2c; single-core chips support no strict sub-slice, only
+    the full chip).
+    """
+    out = []
+    c = 1
+    while c <= generation.cores_per_chip:
+        if generation.cores_per_chip % c == 0:
+            out.append(SubsliceProfile(generation, c))
+        c *= 2
+    return out
+
+
+@dataclass(frozen=True)
+class SubsliceSpecTuple:
+    """Abstract identity — fully recoverable from the canonical name.
+
+    Reference analog: MigSpecTuple (mig.go:33-56): parent minor + GI profile
+    id + placement start.
+    """
+
+    parent_index: int     # chip index (accel minor)
+    profile_id: str       # e.g. "1c47g"
+    placement_start: int  # first core index
+
+    def canonical_name(self) -> str:
+        return f"tpu-{self.parent_index}-ss-{self.profile_id}-{self.placement_start}"
+
+
+@dataclass(frozen=True)
+class SubsliceSpec:
+    """Rich spec used to actually create a sub-slice."""
+
+    parent_index: int
+    parent_uuid: str
+    profile: SubsliceProfile
+    placement_start: int
+
+    def __post_init__(self):
+        if self.placement_start not in self.profile.placements():
+            raise ValueError(
+                f"placement start {self.placement_start} invalid for profile "
+                f"{self.profile.id} on {self.profile.generation.name}"
+            )
+
+    @property
+    def tuple(self) -> SubsliceSpecTuple:
+        return SubsliceSpecTuple(self.parent_index, self.profile.id, self.placement_start)
+
+    def canonical_name(self) -> str:
+        return self.tuple.canonical_name()
+
+
+@dataclass(frozen=True)
+class SubsliceLiveTuple:
+    """Concrete identity of a created sub-slice (MigLiveTuple analog:
+    GIID/CIID/UUID → partition id + devfs path + uuid)."""
+
+    uuid: str             # stable id of the live partition
+    partition_id: int     # kernel/runtime partition handle
+    devfs_path: str       # device node the container gets
+
+
+ParsedName = Union["ParsedChip", "ParsedSubslice", "ParsedVfio"]
+
+
+@dataclass(frozen=True)
+class ParsedChip:
+    index: int
+
+
+@dataclass(frozen=True)
+class ParsedSubslice:
+    tuple: SubsliceSpecTuple
+
+
+@dataclass(frozen=True)
+class ParsedVfio:
+    index: int
+
+
+def canonical_chip_name(index: int) -> str:
+    return f"tpu-{index}"
+
+
+def canonical_vfio_name(index: int) -> str:
+    return f"tpu-vfio-{index}"
+
+
+def canonical_subslice_name(parent_index: int, profile: SubsliceProfile,
+                            placement_start: int) -> str:
+    return SubsliceSpecTuple(parent_index, profile.id, placement_start).canonical_name()
+
+
+def parse_profile_id(profile_id: str) -> tuple[int, int]:
+    """Parse a ``<cores>c<hbmGiB>g`` profile id → (cores, hbm_gib).
+
+    The single owner of the profile-id format (fake/native backends must not
+    re-derive it by ad-hoc string splitting). Raises ValueError on mismatch.
+    """
+    m = PROFILE_ID_RE.match(profile_id)
+    if not m:
+        raise ValueError(f"unparseable sub-slice profile id {profile_id!r}")
+    return int(m.group("cores")), int(m.group("hbm"))
+
+
+def parse_canonical_name(name: str) -> Optional[ParsedName]:
+    """Parse any canonical device name back to its abstract identity.
+
+    This is the crash-recovery entry point (reference mig.go:184-214 parses
+    MIG canonical names with a regex for the same reason): after a plugin
+    restart, checkpointed device names alone must be enough to identify
+    which live partitions to tear down.
+    """
+    m = CHIP_NAME_RE.match(name)
+    if m:
+        return ParsedChip(int(m.group("index")))
+    m = SUBSLICE_NAME_RE.match(name)
+    if m:
+        profile_id = f"{int(m.group('cores'))}c{int(m.group('hbm'))}g"
+        return ParsedSubslice(
+            SubsliceSpecTuple(int(m.group("index")), profile_id, int(m.group("start")))
+        )
+    m = VFIO_NAME_RE.match(name)
+    if m:
+        return ParsedVfio(int(m.group("index")))
+    return None

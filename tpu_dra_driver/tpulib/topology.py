@@ -1,0 +1,220 @@
+"""TPU generation and slice topology model.
+
+Reference analog: go-nvlib's device/MIG-profile model plus the NVML
+fabric/clique info (cmd/compute-domain-kubelet-plugin/nvlib.go:188-356).
+For TPUs the topology is not free-form NVLink cliques but a fixed ICI
+torus: a slice of shape (x, y, z) chips, partitioned across hosts in
+whole-host granules. The "clique id" analog is the slice identifier plus
+the deterministic host→coordinate assignment.
+
+Nominal per-generation constants (cores, HBM, ICI) are the public
+datasheet-level numbers; they feed ResourceSlice attributes/capacities and
+the bench's bandwidth targets, not any runtime decision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional, Tuple
+
+GIB = 1024 ** 3
+
+
+@dataclass(frozen=True)
+class Generation:
+    """Static description of one TPU generation."""
+
+    name: str                 # "v4", "v5e", "v5p", "v6e"
+    product_name: str
+    cores_per_chip: int       # 2 for megacore generations (v4/v5p), else 1
+    hbm_bytes: int            # per chip
+    chips_per_host: int       # default host granule
+    torus_dims: int           # 3 for v4/v5p, 2 for v5e/v6e
+    ici_links_per_chip: int
+    ici_link_gbps: int        # per-direction per-link, nominal
+    sparsecores_per_chip: int = 0
+
+    @property
+    def ici_bandwidth_gbps(self) -> int:
+        return self.ici_links_per_chip * self.ici_link_gbps
+
+    @property
+    def hbm_bytes_per_core(self) -> int:
+        return self.hbm_bytes // self.cores_per_chip
+
+
+GENERATIONS: Dict[str, Generation] = {
+    g.name: g
+    for g in (
+        Generation("v4", "TPU v4", 2, 32 * GIB, 4, 3, 6, 400, 0),
+        Generation("v5e", "TPU v5e", 1, 16 * GIB, 4, 2, 4, 400, 0),
+        Generation("v5p", "TPU v5p", 2, 95 * GIB, 4, 3, 6, 800, 4),
+        Generation("v6e", "TPU v6e (Trillium)", 1, 32 * GIB, 4, 2, 4, 896, 2),
+    )
+}
+
+_SLICE_NAME_RE = re.compile(r"^(?P<gen>v[0-9]+[ep]?)-(?P<cores>[0-9]+)$")
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """A concrete slice: e.g. ``v5p-16`` = 8 chips = 2 hosts, torus (2,2,2).
+
+    The accelerator-type naming convention counts *TensorCores*, so
+    ``v5p-16`` is 16 cores / 8 chips / 2 hosts. Host→coordinate assignment
+    is deterministic: hosts own contiguous x-major blocks of the torus, so
+    a given ``(slice, host_index)`` always maps to the same chip coords —
+    this is the TPU analog of the NVLink clique-id derivation (the fabric
+    reachability group is a property of physical wiring, not free choice).
+    """
+
+    generation: Generation
+    shape: Tuple[int, ...]          # chips per torus dimension
+
+    @classmethod
+    def from_accelerator_type(cls, accel_type: str) -> "SliceTopology":
+        m = _SLICE_NAME_RE.match(accel_type)
+        if not m:
+            raise ValueError(f"unparseable accelerator type {accel_type!r}")
+        gen = GENERATIONS.get(m.group("gen"))
+        if gen is None:
+            raise ValueError(f"unknown TPU generation in {accel_type!r}")
+        cores = int(m.group("cores"))
+        if cores <= 0 or cores % gen.cores_per_chip:
+            raise ValueError(f"{accel_type!r}: core count not divisible by "
+                             f"{gen.cores_per_chip}-core chips")
+        chips = cores // gen.cores_per_chip
+        return cls(gen, _default_shape(chips, gen.torus_dims))
+
+    @property
+    def num_chips(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def num_cores(self) -> int:
+        return self.num_chips * self.generation.cores_per_chip
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.generation.chips_per_host)
+
+    @property
+    def accelerator_type(self) -> str:
+        return f"{self.generation.name}-{self.num_cores}"
+
+    @property
+    def topology_string(self) -> str:
+        """libtpu-style ``TPU_TOPOLOGY`` value, e.g. ``2x2x2``."""
+        return "x".join(str(d) for d in self.shape)
+
+    def chip_coords(self) -> list[Tuple[int, ...]]:
+        """All chip coordinates in deterministic x-major order."""
+        return [tuple(reversed(c))
+                for c in itertools.product(*(range(d) for d in reversed(self.shape)))]
+
+    def coords_for_host(self, host_index: int) -> list[Tuple[int, ...]]:
+        """The chip coordinates owned by host ``host_index``.
+
+        Hosts own contiguous blocks in x-major order; with the default
+        4-chip host granule on a torus whose x-dim is a multiple of the
+        granule this matches the physical tray wiring.
+        """
+        n = self.num_hosts
+        if not (0 <= host_index < n):
+            raise ValueError(f"host_index {host_index} out of range [0,{n})")
+        per_host = self.num_chips // n
+        coords = self.chip_coords()
+        return coords[host_index * per_host:(host_index + 1) * per_host]
+
+    def chips_per_host_grid(self) -> Tuple[int, ...]:
+        """Per-host chip sub-grid, e.g. (2, 2, 1) for 4-chip v5p hosts."""
+        grid = []
+        remaining = self.generation.chips_per_host
+        for d in self.shape:
+            g = _gcd_block(d, remaining)
+            grid.append(g)
+            remaining = max(1, remaining // g)
+        return tuple(grid)
+
+    def bounds_for_host(self, host_index: int) -> str:
+        """libtpu ``TPU_HOST_BOUNDS``-style string describing the host grid
+        (hosts per torus dimension) — the same for every host, but validated
+        against this host's index."""
+        if not (0 <= host_index < self.num_hosts):
+            raise ValueError(f"host_index {host_index} out of range [0,{self.num_hosts})")
+        grid = self.chips_per_host_grid()
+        return ",".join(str(d // g) for d, g in zip(self.shape, grid))
+
+    def worker_env(self, host_index: int, hostnames: Iterable[str]) -> Dict[str, str]:
+        """The bootstrap env a worker on ``host_index`` needs for libtpu to
+        bring up ICI across the slice — the TPU analog of the IMEX
+        nodes-config file (reference cmd/compute-domain-daemon renders the
+        IMEX config; here env vars are the whole contract)."""
+        names = list(hostnames)
+        return {
+            "TPU_WORKER_ID": str(host_index),
+            "TPU_WORKER_HOSTNAMES": ",".join(names),
+            "TPU_ACCELERATOR_TYPE": self.accelerator_type,
+            "TPU_TOPOLOGY": self.topology_string,
+            "TPU_HOST_BOUNDS": self.bounds_for_host(host_index),
+            "TPU_CHIPS_PER_HOST_BOUNDS": _chips_per_host_bounds(self),
+            "TPU_RUNTIME_METRICS_PORTS": "8431",
+        }
+
+
+def _default_shape(chips: int, dims: int) -> Tuple[int, ...]:
+    """Standard torus shapes: factor the chip count into `dims` near-equal
+    powers-of-two-ish factors, largest last (x-major convention: shape is
+    (x, y, z) with x fastest)."""
+    if dims == 2:
+        x = _largest_factor_le_sqrt(chips)
+        return (x, chips // x)
+    # dims == 3
+    best: Optional[Tuple[int, int, int]] = None
+    for x in range(1, chips + 1):
+        if chips % x:
+            continue
+        rest = chips // x
+        for y in range(x, rest + 1):
+            if rest % y:
+                continue
+            z = rest // y
+            if z < y:
+                continue
+            cand = (x, y, z)
+            if best is None or _spread(cand) < _spread(best):
+                best = cand
+    assert best is not None
+    return best
+
+
+def _spread(t: Tuple[int, ...]) -> int:
+    return max(t) - min(t)
+
+
+def _largest_factor_le_sqrt(n: int) -> int:
+    best = 1
+    f = 1
+    while f * f <= n:
+        if n % f == 0:
+            best = f
+        f += 1
+    return best
+
+
+def _gcd_block(dim: int, granule: int) -> int:
+    g = min(dim, granule)
+    while g > 1 and dim % g:
+        g -= 1
+    return max(g, 1)
+
+
+def _chips_per_host_bounds(topo: SliceTopology) -> str:
+    """Chips-per-host sub-grid string, e.g. ``2,2,1`` for 4-chip v5p hosts."""
+    return ",".join(str(c) for c in topo.chips_per_host_grid())

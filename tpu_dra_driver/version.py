@@ -1,0 +1,10 @@
+"""Version string plumbed from the build.
+
+Reference analog: internal/info/version.go (build-flag stamped version).
+"""
+
+VERSION = "0.1.0"
+
+
+def version_string() -> str:
+    return f"tpu-dra-driver {VERSION}"
