@@ -1,0 +1,185 @@
+"""Tests for API types, opaque configs, strict/nonstrict decoders.
+
+Reference analogs: api/nvidia.com/resource/v1beta1/sharing_test.go (MPS
+limit normalization) and the strict-decode rejection contract exercised by
+tests/bats/test_cd_misc.bats (unknown opaque-config fields rejected).
+"""
+
+import pytest
+
+from tpu_dra_driver.api import (
+    ComputeDomain,
+    ComputeDomainClique,
+    NONSTRICT_DECODER,
+    STRICT_DECODER,
+    DecodeError,
+)
+from tpu_dra_driver.api.configs import (
+    ComputeDomainChannelConfig,
+    MultiProcessConfig,
+    SharingConfig,
+    TimeSlicingConfig,
+    TpuConfig,
+    ValidationError,
+)
+from tpu_dra_driver.api.types import ObjectMeta
+
+
+# ---------------------------------------------------------------------------
+# decoders
+# ---------------------------------------------------------------------------
+
+def _tpu_cfg_obj(**extra):
+    obj = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "TimeSlicing", "timeSlicing": {"interval": "Short"}},
+    }
+    obj.update(extra)
+    return obj
+
+
+def test_strict_decode_happy_path():
+    cfg = STRICT_DECODER.decode_validated(_tpu_cfg_obj())
+    assert isinstance(cfg, TpuConfig)
+    assert cfg.sharing.strategy == "TimeSlicing"
+    assert cfg.sharing.time_slicing.interval == "Short"
+
+
+def test_strict_decode_rejects_unknown_field():
+    with pytest.raises(DecodeError, match="unknown field 'bogus'"):
+        STRICT_DECODER.decode(_tpu_cfg_obj(bogus=1))
+
+
+def test_nonstrict_decode_tolerates_unknown_field():
+    cfg = NONSTRICT_DECODER.decode_validated(_tpu_cfg_obj(bogus=1))
+    assert isinstance(cfg, TpuConfig)
+
+
+def test_strict_decode_rejects_nested_unknown_field():
+    obj = _tpu_cfg_obj()
+    obj["sharing"]["whatIsThis"] = True
+    with pytest.raises(DecodeError, match="whatIsThis"):
+        STRICT_DECODER.decode(obj)
+
+
+def test_decode_rejects_wrong_group_and_kind():
+    obj = _tpu_cfg_obj()
+    obj["apiVersion"] = "resource.nvidia.com/v1beta1"
+    with pytest.raises(DecodeError, match="unknown opaque config group"):
+        STRICT_DECODER.decode(obj)
+    obj = _tpu_cfg_obj()
+    obj["kind"] = "GpuConfig"
+    with pytest.raises(DecodeError, match="unknown opaque config kind"):
+        STRICT_DECODER.decode(obj)
+
+
+def test_decode_channel_config_domain_id_camel_mapping():
+    cfg = STRICT_DECODER.decode_validated({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomainChannelConfig",
+        "domainID": "abc-123",
+    })
+    assert isinstance(cfg, ComputeDomainChannelConfig)
+    assert cfg.domain_id == "abc-123"
+    # round-trips back to camelCase with the ID suffix
+    assert cfg.to_obj()["domainID"] == "abc-123"
+
+
+def test_channel_config_requires_domain_id():
+    with pytest.raises(ValidationError):
+        STRICT_DECODER.decode_validated({
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomainChannelConfig",
+        })
+
+
+# ---------------------------------------------------------------------------
+# sharing normalization/validation (reference sharing_test.go analog)
+# ---------------------------------------------------------------------------
+
+def test_multiprocess_normalization_defaults():
+    mp = MultiProcessConfig()
+    mp.normalize()
+    assert mp.max_clients == 4
+    assert mp.hbm_limit_percent == 25
+
+
+def test_multiprocess_validation_bounds():
+    mp = MultiProcessConfig(max_clients=99)
+    with pytest.raises(ValidationError):
+        mp.validate()
+    mp = MultiProcessConfig(max_clients=2, hbm_limit_percent=0)
+    with pytest.raises(ValidationError):
+        mp.validate()
+
+
+def test_sharing_strategy_cross_field_checks():
+    s = SharingConfig(strategy="TimeSlicing",
+                      multi_process=MultiProcessConfig(max_clients=2))
+    with pytest.raises(ValidationError, match="multiProcess set"):
+        s.validate()
+    s = SharingConfig(strategy="MultiProcess",
+                      time_slicing=TimeSlicingConfig())
+    with pytest.raises(ValidationError, match="timeSlicing set"):
+        s.validate()
+    s = SharingConfig(strategy="Bogus")
+    with pytest.raises(ValidationError, match="unknown sharing strategy"):
+        s.validate()
+
+
+def test_timeslicing_interval_validation():
+    ts = TimeSlicingConfig(interval="Forever")
+    with pytest.raises(ValidationError):
+        ts.validate()
+    ts = TimeSlicingConfig(interval="")
+    ts.normalize()
+    ts.validate()
+    assert ts.interval == "Default"
+
+
+# ---------------------------------------------------------------------------
+# CRD types
+# ---------------------------------------------------------------------------
+
+def test_compute_domain_round_trip():
+    cd = ComputeDomain.from_obj({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": "cd1", "namespace": "user-ns", "uid": "u-1"},
+        "spec": {
+            "numNodes": 2,
+            "channel": {"resourceClaimTemplate": {"name": "my-rct"}},
+            "allocationMode": "All",
+        },
+    })
+    cd.validate()
+    assert cd.spec.num_nodes == 2
+    assert cd.spec.channel.resource_claim_template_name == "my-rct"
+    again = ComputeDomain.from_obj(cd.to_obj())
+    assert again.spec == cd.spec
+    assert again.metadata.uid == "u-1"
+
+
+def test_compute_domain_validation():
+    cd = ComputeDomain.from_obj({"metadata": {"name": "x"}, "spec": {"numNodes": 0}})
+    with pytest.raises(ValueError, match="numNodes"):
+        cd.validate()
+    cd = ComputeDomain.from_obj({
+        "metadata": {"name": "x"},
+        "spec": {"numNodes": 1, "channel": {"resourceClaimTemplate": {"name": "t"}},
+                 "allocationMode": "Some"},
+    })
+    with pytest.raises(ValueError, match="allocationMode"):
+        cd.validate()
+
+
+def test_clique_naming_and_daemon_lookup():
+    name = ComputeDomainClique.clique_name("cd-uid-1", "slice-abc")
+    assert name == "cd-uid-1.slice-abc"
+    cq = ComputeDomainClique(metadata=ObjectMeta.new(name, "tpu-dra"))
+    assert cq.daemon_for("node-a") is None
+    obj = cq.to_obj()
+    assert obj["daemons"] == []
+    again = ComputeDomainClique.from_obj(obj)
+    assert again.metadata.name == name

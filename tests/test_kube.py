@@ -1,0 +1,269 @@
+"""Tests for the in-memory Kubernetes machinery: CRUD, watch, finalizers,
+informers, optimistic concurrency, leader election."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra_driver.kube import (
+    AlreadyExistsError,
+    ConflictError,
+    FakeCluster,
+    Informer,
+    NotFoundError,
+)
+from tpu_dra_driver.kube.client import ClientSets, COMPUTE_DOMAINS
+from tpu_dra_driver.kube.leaderelection import LeaderElectionConfig, LeaderElector
+
+
+def _obj(name, ns="", labels=None, **rest):
+    o = {"metadata": {"name": name}}
+    if ns:
+        o["metadata"]["namespace"] = ns
+    if labels:
+        o["metadata"]["labels"] = labels
+    o.update(rest)
+    return o
+
+
+def test_crud_basics():
+    c = FakeCluster()
+    created = c.create("pods", _obj("p1", "ns1", spec={"x": 1}))
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"] == "1"
+    with pytest.raises(AlreadyExistsError):
+        c.create("pods", _obj("p1", "ns1"))
+    got = c.get("pods", "p1", "ns1")
+    assert got["spec"] == {"x": 1}
+    with pytest.raises(NotFoundError):
+        c.get("pods", "p1", "other-ns")
+    got["spec"] = {"x": 2}
+    updated = c.update("pods", got)
+    assert int(updated["metadata"]["resourceVersion"]) > 1
+    assert updated["metadata"]["generation"] == 2
+    c.delete("pods", "p1", "ns1")
+    with pytest.raises(NotFoundError):
+        c.get("pods", "p1", "ns1")
+
+
+def test_generate_name():
+    c = FakeCluster()
+    o = c.create("pods", {"metadata": {"generateName": "worker-", "namespace": "ns"}})
+    assert o["metadata"]["name"].startswith("worker-")
+
+
+def test_update_conflict_on_stale_rv():
+    c = FakeCluster()
+    c.create("pods", _obj("p1"))
+    a = c.get("pods", "p1")
+    b = c.get("pods", "p1")
+    a["spec"] = {"from": "a"}
+    c.update("pods", a)
+    b["spec"] = {"from": "b"}
+    with pytest.raises(ConflictError):
+        c.update("pods", b)
+
+
+def test_retry_update_resolves_conflicts():
+    cs = ClientSets()
+    client = cs[COMPUTE_DOMAINS]
+    client.create(_obj("cd1", "ns", spec={"count": 0}))
+
+    def bump(o):
+        o["spec"]["count"] += 1
+        return o
+
+    threads = [threading.Thread(target=lambda: client.retry_update("cd1", "ns", bump))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert client.get("cd1", "ns")["spec"]["count"] == 8
+
+
+def test_label_selector_list():
+    c = FakeCluster()
+    c.create("nodes", _obj("n1", labels={"tpu": "yes", "zone": "a"}))
+    c.create("nodes", _obj("n2", labels={"tpu": "yes", "zone": "b"}))
+    c.create("nodes", _obj("n3", labels={"zone": "a"}))
+    assert len(c.list("nodes", label_selector={"tpu": "yes"})) == 2
+    assert len(c.list("nodes", label_selector={"tpu": "yes", "zone": "a"})) == 1
+    assert len(c.list("nodes")) == 3
+
+
+def test_finalizer_aware_delete():
+    c = FakeCluster()
+    c.create("computedomains", _obj("cd1", "ns"))
+    obj = c.get("computedomains", "cd1", "ns")
+    obj["metadata"]["finalizers"] = ["tpu.google.com/cd"]
+    c.update("computedomains", obj)
+
+    c.delete("computedomains", "cd1", "ns")
+    # still present, with deletionTimestamp
+    pending = c.get("computedomains", "cd1", "ns")
+    assert pending["metadata"]["deletionTimestamp"] is not None
+    # deleting again is a no-op (idempotent)
+    c.delete("computedomains", "cd1", "ns")
+    # removing the finalizer completes deletion
+    pending["metadata"]["finalizers"] = []
+    c.update("computedomains", pending)
+    with pytest.raises(NotFoundError):
+        c.get("computedomains", "cd1", "ns")
+
+
+def test_watch_receives_selected_events():
+    c = FakeCluster()
+    sub = c.watch("pods", label_selector={"app": "daemon"})
+    c.create("pods", _obj("match", "ns", labels={"app": "daemon"}))
+    c.create("pods", _obj("nomatch", "ns", labels={"app": "other"}))
+    ev = sub.next(timeout=1.0)
+    assert ev is not None and ev[0] == "ADDED" and ev[1]["metadata"]["name"] == "match"
+    assert sub.next(timeout=0.1) is None
+
+
+def test_informer_sync_store_and_handlers():
+    cs = ClientSets()
+    pods = cs.pods
+    pods.create(_obj("existing", "ns", labels={"app": "d"}))
+
+    added, updated, deleted = [], [], []
+    inf = Informer(pods, label_selector={"app": "d"})
+    inf.add_handlers(
+        on_add=lambda o: added.append(o["metadata"]["name"]),
+        on_update=lambda old, new: updated.append(new["metadata"]["name"]),
+        on_delete=lambda o: deleted.append(o["metadata"]["name"]),
+    )
+    inf.start()
+    assert inf.wait_synced()
+    assert added == ["existing"]
+
+    pods.create(_obj("later", "ns", labels={"app": "d"}))
+    obj = pods.get("existing", "ns")
+    obj["spec"] = {"changed": True}
+    pods.update(obj)
+    pods.delete("existing", "ns")
+
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and not (
+        "later" in added and "existing" in updated and "existing" in deleted
+    ):
+        time.sleep(0.01)
+    inf.stop()
+    assert "later" in added
+    assert "existing" in updated
+    assert "existing" in deleted
+    # lister reflects final state
+    assert inf.get("later", "ns") is not None
+    assert inf.get("existing", "ns") is None
+
+
+def test_informer_late_handler_replays_store():
+    cs = ClientSets()
+    cs.pods.create(_obj("p1", "ns"))
+    inf = Informer(cs.pods)
+    inf.start()
+    assert inf.wait_synced()
+    seen = []
+    inf.add_handlers(on_add=lambda o: seen.append(o["metadata"]["name"]))
+    inf.stop()
+    assert seen == ["p1"]
+
+
+def test_leader_election_single_leader_and_failover():
+    cs = ClientSets()
+    events = []
+
+    def mk(identity):
+        return LeaderElector(
+            cs.leases,
+            LeaderElectionConfig(identity=identity, lease_duration=0.3,
+                                 retry_period=0.05),
+            on_started_leading=lambda: events.append(("start", identity)),
+            on_stopped_leading=lambda: events.append(("stop", identity)),
+        )
+
+    a, b = mk("a"), mk("b")
+    a.start()
+    time.sleep(0.15)
+    b.start()
+    time.sleep(0.15)
+    assert a.is_leader and not b.is_leader
+    # a dies without releasing; b takes over after expiry
+    a._stop.set()
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and not b.is_leader:
+        time.sleep(0.02)
+    assert b.is_leader
+    b.stop()
+
+
+# ---------------------------------------------------------------------------
+# regressions from review round 2
+# ---------------------------------------------------------------------------
+
+def test_retry_update_in_place_mutation_lands():
+    cs = ClientSets()
+    cs.pods.create(_obj("p1", "ns", spec={"x": 0}))
+    cs.pods.retry_update("p1", "ns", lambda o: o["spec"].update({"x": 1}))
+    assert cs.pods.get("p1", "ns")["spec"]["x"] == 1
+
+
+def test_retry_update_abort_skips_write():
+    from tpu_dra_driver.kube.client import ABORT
+    cs = ClientSets()
+    cs.pods.create(_obj("p1", "ns", spec={"x": 0}))
+    rv = cs.pods.get("p1", "ns")["metadata"]["resourceVersion"]
+
+    def maybe(o):
+        return ABORT
+
+    cs.pods.retry_update("p1", "ns", maybe)
+    assert cs.pods.get("p1", "ns")["metadata"]["resourceVersion"] == rv
+
+
+def test_informer_handouts_are_copies():
+    cs = ClientSets()
+    cs.pods.create(_obj("p1", "ns", spec={"x": 1}))
+    inf = Informer(cs.pods)
+    inf.start()
+    assert inf.wait_synced()
+    obj = inf.get("p1", "ns")
+    obj["spec"]["x"] = 999  # mutate the handout
+    assert inf.get("p1", "ns")["spec"]["x"] == 1
+    inf.stop()
+
+
+def test_leader_stop_demotes_and_fires_callback():
+    cs = ClientSets()
+    events = []
+    el = LeaderElector(
+        cs.leases,
+        LeaderElectionConfig(identity="a", lease_duration=5.0, retry_period=0.05),
+        on_started_leading=lambda: events.append("start"),
+        on_stopped_leading=lambda: events.append("stop"),
+    )
+    el.start()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and not el.is_leader:
+        time.sleep(0.01)
+    assert el.is_leader
+    el.stop()
+    assert not el.is_leader
+    assert events == ["start", "stop"]
+
+
+def test_decoder_wraps_type_errors():
+    from tpu_dra_driver.api import STRICT_DECODER, DecodeError
+    with pytest.raises(DecodeError, match="must be an object"):
+        STRICT_DECODER.decode({
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": "TimeSlicing",
+        })
+    with pytest.raises(DecodeError, match="unknown opaque config version"):
+        STRICT_DECODER.decode({
+            "apiVersion": "resource.tpu.google.com/v9999",
+            "kind": "TpuConfig",
+        })

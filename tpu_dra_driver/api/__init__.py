@@ -1,0 +1,30 @@
+"""api — CRD types and opaque device-config types for resource.tpu.google.com/v1beta1.
+
+Reference analog: api/nvidia.com/resource/v1beta1 — CRD types
+(ComputeDomain, ComputeDomainClique), opaque configs (GpuConfig,
+MigDeviceConfig, VfioDeviceConfig, ComputeDomainChannelConfig,
+ComputeDomainDaemonConfig) with a Strict decoder for user input and a
+Nonstrict decoder for checkpoint re-reads (api.go:46-98), and the
+Normalize()/Validate() contract every config implements (api.go:41-44).
+"""
+
+from tpu_dra_driver.api.configs import (  # noqa: F401
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    MultiProcessConfig,
+    SubsliceConfig,
+    TimeSlicingConfig,
+    TpuConfig,
+    VfioTpuConfig,
+)
+from tpu_dra_driver.api.decoder import (  # noqa: F401
+    DecodeError,
+    NONSTRICT_DECODER,
+    STRICT_DECODER,
+    Decoder,
+)
+from tpu_dra_driver.api.types import (  # noqa: F401
+    ComputeDomain,
+    ComputeDomainClique,
+    ObjectMeta,
+)

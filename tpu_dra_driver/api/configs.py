@@ -1,0 +1,300 @@
+"""Opaque per-claim device configs with the Normalize/Validate contract.
+
+Reference analog: api/nvidia.com/resource/v1beta1/{gpuconfig.go:28-89,
+sharing.go:27-273, migconfig.go:27-77, vfiodeviceconfig.go:184-210,
+computedomainconfig.go:27-86}. Every config is a runtime object with
+``apiVersion``/``kind`` that implements ``normalize()`` (fill defaults)
+and ``validate()`` (reject bad input).
+
+TPU mapping:
+
+- GpuConfig → :class:`TpuConfig` — sharing via time-slicing (runtime
+  scheduler interval) or multi-process (multiple clients on one chip with
+  per-client HBM limits; the MPS analog without a control daemon where
+  possible).
+- MigDeviceConfig → :class:`SubsliceConfig` — sharing on a sub-slice.
+- VfioDeviceConfig → :class:`VfioTpuConfig` — empty marker selecting
+  passthrough preparation.
+- ComputeDomainChannelConfig / ComputeDomainDaemonConfig — carry the
+  ``domain_id`` tying a claim to its ComputeDomain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, Optional
+
+from tpu_dra_driver import API_GROUP, API_VERSION
+
+APIV = f"{API_GROUP}/{API_VERSION}"
+
+
+class ValidationError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Sharing strategies
+# ---------------------------------------------------------------------------
+
+TIMESLICE_INTERVALS = ("Default", "Short", "Medium", "Long")
+
+# Multi-process HBM limit bounds (percent of the chip's HBM one client may
+# allocate; reference sharing.go enforces MPS thread%/pinned-mem bounds).
+HBM_LIMIT_MIN_PERCENT = 1
+HBM_LIMIT_MAX_PERCENT = 100
+MAX_MULTI_PROCESS_CLIENTS = 16
+
+
+@dataclass
+class TimeSlicingConfig:
+    interval: str = "Default"
+
+    def normalize(self) -> None:
+        if not self.interval:
+            self.interval = "Default"
+
+    def validate(self) -> None:
+        if self.interval not in TIMESLICE_INTERVALS:
+            raise ValidationError(
+                f"unknown time-slice interval {self.interval!r}; "
+                f"must be one of {TIMESLICE_INTERVALS}"
+            )
+
+
+@dataclass
+class MultiProcessConfig:
+    """Multiple processes share one chip; libtpu multi-client config.
+
+    ``hbm_limit_percent`` bounds each client's HBM allocation;
+    ``max_clients`` bounds concurrent processes.
+    """
+
+    max_clients: int = 0               # 0 → normalize to default
+    hbm_limit_percent: Optional[int] = None
+
+    DEFAULT_MAX_CLIENTS: ClassVar[int] = 4
+
+    def normalize(self) -> None:
+        if self.max_clients == 0:
+            self.max_clients = self.DEFAULT_MAX_CLIENTS
+        if self.hbm_limit_percent is None:
+            self.hbm_limit_percent = 100 // self.max_clients
+
+    def validate(self) -> None:
+        if not (1 <= self.max_clients <= MAX_MULTI_PROCESS_CLIENTS):
+            raise ValidationError(
+                f"maxClients {self.max_clients} outside [1, {MAX_MULTI_PROCESS_CLIENTS}]"
+            )
+        if self.hbm_limit_percent is not None and not (
+            HBM_LIMIT_MIN_PERCENT <= self.hbm_limit_percent <= HBM_LIMIT_MAX_PERCENT
+        ):
+            raise ValidationError(
+                f"hbmLimitPercent {self.hbm_limit_percent} outside "
+                f"[{HBM_LIMIT_MIN_PERCENT}, {HBM_LIMIT_MAX_PERCENT}]"
+            )
+
+
+SHARING_STRATEGIES = ("TimeSlicing", "MultiProcess")
+
+
+@dataclass
+class SharingConfig:
+    strategy: str = "TimeSlicing"
+    time_slicing: Optional[TimeSlicingConfig] = None
+    multi_process: Optional[MultiProcessConfig] = None
+
+    def normalize(self) -> None:
+        if self.strategy == "TimeSlicing" and self.time_slicing is None:
+            self.time_slicing = TimeSlicingConfig()
+        if self.strategy == "MultiProcess" and self.multi_process is None:
+            self.multi_process = MultiProcessConfig()
+        if self.time_slicing:
+            self.time_slicing.normalize()
+        if self.multi_process:
+            self.multi_process.normalize()
+
+    def validate(self) -> None:
+        if self.strategy not in SHARING_STRATEGIES:
+            raise ValidationError(
+                f"unknown sharing strategy {self.strategy!r}; "
+                f"must be one of {SHARING_STRATEGIES}"
+            )
+        if self.strategy == "TimeSlicing":
+            if self.multi_process is not None:
+                raise ValidationError("multiProcess set but strategy is TimeSlicing")
+            assert self.time_slicing is not None
+            self.time_slicing.validate()
+        else:
+            if self.time_slicing is not None:
+                raise ValidationError("timeSlicing set but strategy is MultiProcess")
+            assert self.multi_process is not None
+            self.multi_process.validate()
+
+
+# ---------------------------------------------------------------------------
+# Config objects (the opaque-parameter payloads)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _ConfigBase:
+    KIND: ClassVar[str] = ""
+
+    def normalize(self) -> None:
+        pass
+
+    def validate(self) -> None:
+        pass
+
+    def to_obj(self) -> Dict:
+        out = {"apiVersion": APIV, "kind": self.KIND}
+        out.update(_to_camel_dict(self))
+        return out
+
+
+@dataclass
+class TpuConfig(_ConfigBase):
+    """Per-claim config for a full-chip (or dynamic sub-slice parent) device."""
+
+    KIND: ClassVar[str] = "TpuConfig"
+    sharing: Optional[SharingConfig] = None
+
+    def normalize(self) -> None:
+        if self.sharing is not None:
+            self.sharing.normalize()
+
+    def validate(self) -> None:
+        if self.sharing is not None:
+            self.sharing.validate()
+
+
+@dataclass
+class SubsliceConfig(_ConfigBase):
+    """Per-claim config for a sub-slice device (MigDeviceConfig analog)."""
+
+    KIND: ClassVar[str] = "SubsliceConfig"
+    sharing: Optional[SharingConfig] = None
+
+    def normalize(self) -> None:
+        if self.sharing is not None:
+            self.sharing.normalize()
+
+    def validate(self) -> None:
+        if self.sharing is not None:
+            self.sharing.validate()
+        # Multi-process on a sub-slice is allowed (like MPS-on-MIG); nothing
+        # extra to check beyond the sharing config itself.
+
+
+@dataclass
+class VfioTpuConfig(_ConfigBase):
+    """Empty marker config selecting vfio passthrough preparation
+    (reference vfiodeviceconfig.go:184-210)."""
+
+    KIND: ClassVar[str] = "VfioTpuConfig"
+
+
+@dataclass
+class ComputeDomainChannelConfig(_ConfigBase):
+    """Ties a workload claim's channel device to a ComputeDomain."""
+
+    KIND: ClassVar[str] = "ComputeDomainChannelConfig"
+    domain_id: str = ""
+
+    def validate(self) -> None:
+        if not isinstance(self.domain_id, str) or not self.domain_id:
+            raise ValidationError("domainID must be a non-empty string")
+
+
+@dataclass
+class ComputeDomainDaemonConfig(_ConfigBase):
+    """Ties a daemon claim to a ComputeDomain."""
+
+    KIND: ClassVar[str] = "ComputeDomainDaemonConfig"
+    domain_id: str = ""
+
+    def validate(self) -> None:
+        if not isinstance(self.domain_id, str) or not self.domain_id:
+            raise ValidationError("domainID must be a non-empty string")
+
+
+CONFIG_KINDS = {
+    c.KIND: c
+    for c in (
+        TpuConfig,
+        SubsliceConfig,
+        VfioTpuConfig,
+        ComputeDomainChannelConfig,
+        ComputeDomainDaemonConfig,
+    )
+}
+
+
+# ---------------------------------------------------------------------------
+# camelCase <-> snake_case plumbing (objects serialize k8s-style)
+# ---------------------------------------------------------------------------
+
+def _camel(s: str) -> str:
+    parts = s.split("_")
+    out = parts[0] + "".join(p.title() for p in parts[1:])
+    # k8s convention: trailing "Id" renders as "ID"
+    if out.endswith("Id"):
+        out = out[:-2] + "ID"
+    return out
+
+
+def _snake(s: str) -> str:
+    if s.endswith("ID"):
+        s = s[:-2] + "Id"
+    out = []
+    for ch in s:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _to_camel_dict(obj) -> Dict:
+    out = {}
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if v is None:
+            continue
+        if dataclasses.is_dataclass(v):
+            v = _to_camel_dict(v)
+        out[_camel(f.name)] = v
+    return out
+
+
+def _from_dict(cls, data: Dict, strict: bool, path: str = ""):
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in data.items():
+        if k in ("apiVersion", "kind") and path == "":
+            continue
+        name = _snake(k)
+        if name not in fields:
+            if strict:
+                raise KeyError(f"unknown field {path + k!r} for {cls.__name__}")
+            continue
+        sub = _NESTED.get((cls, name))
+        if sub is not None and v is not None:
+            if not isinstance(v, dict):
+                raise TypeError(
+                    f"field {path + k!r} must be an object, got {type(v).__name__}"
+                )
+            v = _from_dict(sub, v, strict, path=f"{path}{k}.")
+        kwargs[name] = v
+    return cls(**kwargs)
+
+
+# nested dataclass fields that need recursive decoding
+_NESTED = {
+    (TpuConfig, "sharing"): SharingConfig,
+    (SubsliceConfig, "sharing"): SharingConfig,
+    (SharingConfig, "time_slicing"): TimeSlicingConfig,
+    (SharingConfig, "multi_process"): MultiProcessConfig,
+}

@@ -1,0 +1,76 @@
+"""Strict and non-strict decoders for opaque device configs.
+
+Reference analog: api/nvidia.com/resource/v1beta1/api.go:46-98 — two scheme
+decoders: **Strict** (rejects unknown fields; used on user input so typos
+fail loudly at admission/prepare time) and **Nonstrict** (tolerates unknown
+fields; used when re-reading checkpoints written by a newer/older version,
+so up/downgrades don't brick recovery).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tpu_dra_driver import API_GROUP, API_VERSION
+from tpu_dra_driver.api.configs import CONFIG_KINDS, _ConfigBase, _from_dict
+
+
+class DecodeError(ValueError):
+    pass
+
+
+class Decoder:
+    def __init__(self, strict: bool):
+        self._strict = strict
+
+    @property
+    def strict(self) -> bool:
+        return self._strict
+
+    def decode(self, obj: Dict) -> _ConfigBase:
+        """Decode a raw opaque-config object (already parsed JSON/YAML dict)."""
+        if not isinstance(obj, dict):
+            raise DecodeError(f"opaque config must be an object, got {type(obj).__name__}")
+        apiv = obj.get("apiVersion", "")
+        kind = obj.get("kind", "")
+        if not apiv or not kind:
+            raise DecodeError("opaque config missing apiVersion or kind")
+        group, _, version = apiv.partition("/")
+        if group != API_GROUP:
+            raise DecodeError(
+                f"unknown opaque config group {group!r} (expected {API_GROUP!r})"
+            )
+        if version != API_VERSION:
+            raise DecodeError(
+                f"unknown opaque config version {version!r} for group "
+                f"{API_GROUP!r} (expected {API_VERSION!r})"
+            )
+        cls = CONFIG_KINDS.get(kind)
+        if cls is None:
+            raise DecodeError(
+                f"unknown opaque config kind {kind!r} for group {API_GROUP!r}"
+            )
+        try:
+            cfg = _from_dict(cls, obj, strict=self._strict)
+        except KeyError as e:
+            raise DecodeError(f"strict decode of {kind}: {e.args[0]}") from e
+        except TypeError as e:
+            raise DecodeError(f"decode of {kind}: {e}") from e
+        return cfg
+
+    def decode_validated(self, obj: Dict) -> _ConfigBase:
+        """Decode + normalize + validate (the order the reference applies
+        to every opaque config it accepts, api.go:41-44)."""
+        cfg = self.decode(obj)
+        try:
+            cfg.normalize()
+            cfg.validate()
+        except (AttributeError, TypeError) as e:
+            # Wrong-typed field values surface here (e.g. a string where an
+            # object belongs) — keep them inside the decode-error taxonomy.
+            raise DecodeError(f"malformed {obj.get('kind')}: {e}") from e
+        return cfg
+
+
+STRICT_DECODER = Decoder(strict=True)
+NONSTRICT_DECODER = Decoder(strict=False)

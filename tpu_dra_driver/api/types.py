@@ -1,0 +1,284 @@
+"""CRD types: ComputeDomain and ComputeDomainClique.
+
+Reference analog: api/nvidia.com/resource/v1beta1/{computedomain.go:38-141,
+computedomainclique.go:109-157}.
+
+- ``ComputeDomain``: a workload-scoped, ephemeral multi-host ICI slice
+  domain (the MNNVL/IMEX-domain analog). Spec: ``num_nodes``, the name of
+  the workload ResourceClaimTemplate to stamp, and an allocation mode.
+  Status: global Ready/NotReady plus per-node entries.
+- ``ComputeDomainClique``: named ``<cdUID>.<cliqueID>`` where the clique id
+  is the ICI-reachability group (for TPUs: the physical slice id reported
+  by the device library). Holds the daemon membership list keyed by node
+  name, through which per-node daemons rendezvous and receive stable
+  worker indices.
+
+Objects serialize to/from plain k8s-style dicts so they flow through the
+generic in-memory API machinery (tpu_dra_driver.kube) and YAML templates.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra_driver import API_GROUP, API_VERSION
+
+APIV = f"{API_GROUP}/{API_VERSION}"
+
+# Max hosts per ComputeDomain. Reference: 18 nodes (GB200 IMEX domain
+# limit, compute-domain-controller/main.go:55-59). TPU pod slices go far
+# larger: a v5p pod is 960 hosts (8960 chips / 4 per host... nominal cap
+# below is per-domain, conservative default, overridable by flag).
+DEFAULT_MAX_NODES_PER_DOMAIN = 64
+
+ALLOCATION_MODE_ALL = "All"
+ALLOCATION_MODE_SINGLE = "Single"
+
+STATUS_READY = "Ready"
+STATUS_NOT_READY = "NotReady"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[Dict] = field(default_factory=list)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    generation: int = 0
+
+    @staticmethod
+    def new(name: str, namespace: str = "") -> "ObjectMeta":
+        return ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=str(uuidlib.uuid4()),
+            creation_timestamp=time.time(),
+        )
+
+    def to_obj(self) -> Dict:
+        out: Dict = {"name": self.name}
+        if self.namespace:
+            out["namespace"] = self.namespace
+        if self.uid:
+            out["uid"] = self.uid
+        if self.resource_version:
+            out["resourceVersion"] = self.resource_version
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        if self.annotations:
+            out["annotations"] = dict(self.annotations)
+        if self.finalizers:
+            out["finalizers"] = list(self.finalizers)
+        if self.owner_references:
+            out["ownerReferences"] = copy.deepcopy(self.owner_references)
+        if self.creation_timestamp:
+            out["creationTimestamp"] = self.creation_timestamp
+        if self.deletion_timestamp is not None:
+            out["deletionTimestamp"] = self.deletion_timestamp
+        if self.generation:
+            out["generation"] = self.generation
+        return out
+
+    @staticmethod
+    def from_obj(d: Dict) -> "ObjectMeta":
+        return ObjectMeta(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", ""),
+            uid=d.get("uid", ""),
+            resource_version=d.get("resourceVersion", ""),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            finalizers=list(d.get("finalizers") or []),
+            owner_references=copy.deepcopy(d.get("ownerReferences") or []),
+            creation_timestamp=d.get("creationTimestamp", 0.0),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            generation=d.get("generation", 0),
+        )
+
+
+# ---------------------------------------------------------------------------
+# ComputeDomain
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ComputeDomainChannelSpec:
+    resource_claim_template_name: str = ""
+
+
+@dataclass
+class ComputeDomainSpec:
+    num_nodes: int = 0
+    channel: ComputeDomainChannelSpec = field(default_factory=ComputeDomainChannelSpec)
+    allocation_mode: str = ALLOCATION_MODE_ALL
+
+
+@dataclass
+class ComputeDomainNodeStatus:
+    name: str = ""
+    ip_address: str = ""
+    clique_id: str = ""
+    index: int = -1
+    status: str = STATUS_NOT_READY
+
+
+@dataclass
+class ComputeDomainStatus:
+    status: str = STATUS_NOT_READY
+    nodes: List[ComputeDomainNodeStatus] = field(default_factory=list)
+
+
+@dataclass
+class ComputeDomain:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ComputeDomainSpec = field(default_factory=ComputeDomainSpec)
+    status: ComputeDomainStatus = field(default_factory=ComputeDomainStatus)
+
+    KIND = "ComputeDomain"
+    PLURAL = "computedomains"
+
+    def validate(self) -> None:
+        if self.spec.num_nodes < 1:
+            raise ValueError("spec.numNodes must be >= 1")
+        if not self.spec.channel.resource_claim_template_name:
+            raise ValueError("spec.channel.resourceClaimTemplate.name must be set")
+        if self.spec.allocation_mode not in (ALLOCATION_MODE_ALL, ALLOCATION_MODE_SINGLE):
+            raise ValueError(
+                f"spec.allocationMode must be {ALLOCATION_MODE_ALL!r} or "
+                f"{ALLOCATION_MODE_SINGLE!r}"
+            )
+
+    def to_obj(self) -> Dict:
+        return {
+            "apiVersion": APIV,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_obj(),
+            "spec": {
+                "numNodes": self.spec.num_nodes,
+                "channel": {
+                    "resourceClaimTemplate": {
+                        "name": self.spec.channel.resource_claim_template_name,
+                    }
+                },
+                "allocationMode": self.spec.allocation_mode,
+            },
+            "status": {
+                "status": self.status.status,
+                "nodes": [
+                    {
+                        "name": n.name,
+                        "ipAddress": n.ip_address,
+                        "cliqueID": n.clique_id,
+                        "index": n.index,
+                        "status": n.status,
+                    }
+                    for n in self.status.nodes
+                ],
+            },
+        }
+
+    @staticmethod
+    def from_obj(d: Dict) -> "ComputeDomain":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return ComputeDomain(
+            metadata=ObjectMeta.from_obj(d.get("metadata") or {}),
+            spec=ComputeDomainSpec(
+                num_nodes=spec.get("numNodes", 0),
+                channel=ComputeDomainChannelSpec(
+                    resource_claim_template_name=(
+                        ((spec.get("channel") or {}).get("resourceClaimTemplate") or {})
+                        .get("name", "")
+                    )
+                ),
+                allocation_mode=spec.get("allocationMode", ALLOCATION_MODE_ALL),
+            ),
+            status=ComputeDomainStatus(
+                status=status.get("status", STATUS_NOT_READY),
+                nodes=[
+                    ComputeDomainNodeStatus(
+                        name=n.get("name", ""),
+                        ip_address=n.get("ipAddress", ""),
+                        clique_id=n.get("cliqueID", ""),
+                        index=n.get("index", -1),
+                        status=n.get("status", STATUS_NOT_READY),
+                    )
+                    for n in status.get("nodes") or []
+                ],
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# ComputeDomainClique
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CliqueDaemon:
+    """One per-node daemon's membership entry (list-map keyed by node_name,
+    reference computedomainclique.go:109-157)."""
+
+    node_name: str = ""
+    ip_address: str = ""
+    index: int = -1
+    status: str = STATUS_NOT_READY
+
+
+@dataclass
+class ComputeDomainClique:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    daemons: List[CliqueDaemon] = field(default_factory=list)
+
+    KIND = "ComputeDomainClique"
+    PLURAL = "computedomaincliques"
+
+    @staticmethod
+    def clique_name(cd_uid: str, clique_id: str) -> str:
+        """Cliques are named ``<cdUID>.<cliqueID>``."""
+        return f"{cd_uid}.{clique_id}"
+
+    def daemon_for(self, node_name: str) -> Optional[CliqueDaemon]:
+        for d in self.daemons:
+            if d.node_name == node_name:
+                return d
+        return None
+
+    def to_obj(self) -> Dict:
+        return {
+            "apiVersion": APIV,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_obj(),
+            "daemons": [
+                {
+                    "nodeName": x.node_name,
+                    "ipAddress": x.ip_address,
+                    "index": x.index,
+                    "status": x.status,
+                }
+                for x in self.daemons
+            ],
+        }
+
+    @staticmethod
+    def from_obj(d: Dict) -> "ComputeDomainClique":
+        return ComputeDomainClique(
+            metadata=ObjectMeta.from_obj(d.get("metadata") or {}),
+            daemons=[
+                CliqueDaemon(
+                    node_name=x.get("nodeName", ""),
+                    ip_address=x.get("ipAddress", ""),
+                    index=x.get("index", -1),
+                    status=x.get("status", STATUS_NOT_READY),
+                )
+                for x in d.get("daemons") or []
+            ],
+        )

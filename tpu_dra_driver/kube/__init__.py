@@ -1,0 +1,28 @@
+"""kube — self-contained Kubernetes client machinery.
+
+Reference analog: client-go + the generated CRD clientset/informers/listers
+(pkg/nvidia.com/{clientset,informers,listers}). The reference vendors
+client-go; this build implements the same *protocol surface* the driver
+needs from scratch:
+
+- :mod:`fake`     — an in-memory API server with resourceVersion bookkeeping,
+  label-selector list/watch, optimistic-concurrency updates, and
+  finalizer-aware deletion (the fake clientset test seam the reference has
+  but barely uses, here the primary CI substrate).
+- :mod:`client`   — typed per-resource clients over an abstract store, so
+  components are written against the interface and can later bind to a real
+  API server via HTTPS without change.
+- :mod:`informer` — list+watch informers with local stores (listers) and
+  add/update/delete handlers.
+- :mod:`leaderelection` — lease-based leader election for the controller.
+"""
+
+from tpu_dra_driver.kube.errors import (  # noqa: F401
+    ApiError,
+    ConflictError,
+    AlreadyExistsError,
+    NotFoundError,
+)
+from tpu_dra_driver.kube.fake import FakeCluster  # noqa: F401
+from tpu_dra_driver.kube.client import ResourceClient, ClientSets  # noqa: F401
+from tpu_dra_driver.kube.informer import Informer  # noqa: F401

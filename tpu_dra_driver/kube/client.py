@@ -1,0 +1,132 @@
+"""Typed per-resource clients over the cluster store.
+
+Reference analog: pkg/flags/kubeclient.go:38-96 builds ``ClientSets{Core,
+Resource, Nvidia}``; components receive clients scoped to the resources
+they touch. Here a :class:`ResourceClient` wraps one resource; a
+:class:`ClientSets` bundle carries the standard set the driver uses.
+
+The underlying store is any object with the FakeCluster CRUD surface; a
+real HTTPS API-server binding can implement the same five methods without
+components changing.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra_driver.kube.errors import ConflictError, NotFoundError
+from tpu_dra_driver.kube.fake import FakeCluster, Object
+
+# Canonical resource names used across the driver (plural, lowercase —
+# matching k8s REST resource segments).
+NODES = "nodes"
+PODS = "pods"
+EVENTS = "events"
+DAEMONSETS = "daemonsets"
+LEASES = "leases"
+RESOURCE_SLICES = "resourceslices"
+RESOURCE_CLAIMS = "resourceclaims"
+RESOURCE_CLAIM_TEMPLATES = "resourceclaimtemplates"
+DEVICE_CLASSES = "deviceclasses"
+COMPUTE_DOMAINS = "computedomains"
+COMPUTE_DOMAIN_CLIQUES = "computedomaincliques"
+
+# Sentinel a retry_update mutate callback returns to skip the write.
+ABORT = object()
+
+
+class ResourceClient:
+    def __init__(self, cluster: FakeCluster, resource: str):
+        self._cluster = cluster
+        self.resource = resource
+
+    def create(self, obj: Object) -> Object:
+        return self._cluster.create(self.resource, obj)
+
+    def get(self, name: str, namespace: str = "") -> Object:
+        return self._cluster.get(self.resource, name, namespace)
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             name_pattern: Optional[str] = None) -> List[Object]:
+        return self._cluster.list(self.resource, namespace=namespace,
+                                  label_selector=label_selector,
+                                  name_pattern=name_pattern)
+
+    def update(self, obj: Object) -> Object:
+        return self._cluster.update(self.resource, obj)
+
+    def delete(self, name: str, namespace: str = "") -> None:
+        self._cluster.delete(self.resource, name, namespace)
+
+    def delete_ignore_missing(self, name: str, namespace: str = "") -> None:
+        try:
+            self._cluster.delete(self.resource, name, namespace)
+        except NotFoundError:
+            pass
+
+    def watch(self, label_selector: Optional[Dict[str, str]] = None):
+        return self._cluster.watch(self.resource, label_selector)
+
+    def list_and_watch(self, namespace: Optional[str] = None,
+                       label_selector: Optional[Dict[str, str]] = None):
+        return self._cluster.list_and_watch(self.resource, namespace=namespace,
+                                            label_selector=label_selector)
+
+    def stop_watch(self, sub) -> None:
+        self._cluster.stop_watch(self.resource, sub)
+
+    def retry_update(self, name: str, namespace: str, mutate, max_attempts: int = 10) -> Object:
+        """Optimistic-concurrency retry loop: get → mutate(obj) → update,
+        retrying on resourceVersion conflicts (client-go RetryOnConflict
+        analog). ``mutate`` edits the dict in place (returning ``None``) or
+        returns a replacement dict; returning :data:`ABORT` skips the write
+        and returns the object as read."""
+        last: Exception | None = None
+        for _ in range(max_attempts):
+            obj = self.get(name, namespace)
+            working = copy.deepcopy(obj)
+            edited = mutate(working)
+            if edited is ABORT:
+                return obj
+            try:
+                return self.update(working if edited is None else edited)
+            except ConflictError as e:
+                last = e
+        raise last  # type: ignore[misc]
+
+
+@dataclass
+class ClientSets:
+    """The bundle of clients driver components receive."""
+
+    cluster: FakeCluster = field(default_factory=FakeCluster)
+
+    def __getitem__(self, resource: str) -> ResourceClient:
+        return ResourceClient(self.cluster, resource)
+
+    # convenience accessors
+    @property
+    def nodes(self) -> ResourceClient: return self[NODES]
+    @property
+    def pods(self) -> ResourceClient: return self[PODS]
+    @property
+    def events(self) -> ResourceClient: return self[EVENTS]
+    @property
+    def daemonsets(self) -> ResourceClient: return self[DAEMONSETS]
+    @property
+    def leases(self) -> ResourceClient: return self[LEASES]
+    @property
+    def resource_slices(self) -> ResourceClient: return self[RESOURCE_SLICES]
+    @property
+    def resource_claims(self) -> ResourceClient: return self[RESOURCE_CLAIMS]
+    @property
+    def resource_claim_templates(self) -> ResourceClient: return self[RESOURCE_CLAIM_TEMPLATES]
+    @property
+    def device_classes(self) -> ResourceClient: return self[DEVICE_CLASSES]
+    @property
+    def compute_domains(self) -> ResourceClient: return self[COMPUTE_DOMAINS]
+    @property
+    def compute_domain_cliques(self) -> ResourceClient: return self[COMPUTE_DOMAIN_CLIQUES]

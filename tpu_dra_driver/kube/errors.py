@@ -1,0 +1,23 @@
+"""API error taxonomy mirroring k8s apimachinery StatusError reasons."""
+
+
+class ApiError(Exception):
+    code = 500
+
+
+class NotFoundError(ApiError):
+    code = 404
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+
+
+class ConflictError(ApiError):
+    """resourceVersion mismatch on update (optimistic concurrency)."""
+
+    code = 409
+
+
+class InvalidError(ApiError):
+    code = 422

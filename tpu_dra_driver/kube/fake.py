@@ -1,0 +1,253 @@
+"""In-memory Kubernetes API server fake with watch semantics.
+
+The single source of truth for tests and the in-repo e2e harness. Objects
+are plain dicts (apiVersion/kind/metadata/...). Semantics modeled on the
+real API server where the driver depends on them:
+
+- monotonically increasing cluster-wide ``resourceVersion``;
+- ``create`` assigns uid + creationTimestamp, rejects duplicates;
+- ``update`` enforces optimistic concurrency when the caller supplies a
+  resourceVersion;
+- ``delete`` is finalizer-aware: objects with finalizers get a
+  ``deletionTimestamp`` and stay visible until the last finalizer is
+  removed (this drives the controller's teardown flow exactly like the
+  real thing);
+- label-selector filtering for list/watch;
+- watch: subscribers receive (type, object) events — ADDED / MODIFIED /
+  DELETED — from the moment of subscription; informers pair an initial
+  list with a subscription atomically.
+"""
+
+from __future__ import annotations
+
+import copy
+import fnmatch
+import threading
+import time
+import uuid as uuidlib
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from tpu_dra_driver.kube.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+
+Object = Dict
+WatchEvent = Tuple[str, Object]  # ("ADDED"|"MODIFIED"|"DELETED", obj)
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+def _key(namespace: str, name: str) -> Tuple[str, str]:
+    return (namespace or "", name)
+
+
+def match_label_selector(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class _WatchSub:
+    def __init__(self, selector: Optional[Dict[str, str]]):
+        self.selector = selector
+        self._cond = threading.Condition()
+        self._events: List[WatchEvent] = []
+        self._closed = False
+
+    def push(self, ev: WatchEvent) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._events.append(ev)
+            self._cond.notify_all()
+
+    def next(self, timeout: float = 0.2) -> Optional[WatchEvent]:
+        with self._cond:
+            if not self._events:
+                self._cond.wait(timeout=timeout)
+            if self._events:
+                return self._events.pop(0)
+            return None
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class FakeCluster:
+    """The cluster: a set of resource tables + a global resourceVersion."""
+
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._rv = 0
+        # resource -> {(ns, name) -> obj}
+        self._tables: Dict[str, Dict[Tuple[str, str], Object]] = {}
+        # resource -> [subs]
+        self._subs: Dict[str, List[_WatchSub]] = {}
+
+    # -- internals ----------------------------------------------------------
+
+    def _table(self, resource: str) -> Dict[Tuple[str, str], Object]:
+        return self._tables.setdefault(resource, {})
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, resource: str, ev_type: str, obj: Object) -> None:
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        for sub in self._subs.get(resource, []):
+            if match_label_selector(labels, sub.selector):
+                sub.push((ev_type, copy.deepcopy(obj)))
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, resource: str, obj: Object) -> Object:
+        with self._mu:
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            name = meta.get("name", "")
+            if not name:
+                gen = meta.pop("generateName", "")
+                if not gen:
+                    raise InvalidError(f"{resource}: metadata.name required")
+                name = gen + uuidlib.uuid4().hex[:5]
+                meta["name"] = name
+            ns = meta.get("namespace", "")
+            k = _key(ns, name)
+            table = self._table(resource)
+            if k in table:
+                raise AlreadyExistsError(f"{resource} {ns}/{name} already exists")
+            meta.setdefault("uid", str(uuidlib.uuid4()))
+            meta.setdefault("creationTimestamp", time.time())
+            meta["resourceVersion"] = self._next_rv()
+            meta.setdefault("generation", 1)
+            table[k] = obj
+            self._notify(resource, ADDED, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, resource: str, name: str, namespace: str = "") -> Object:
+        with self._mu:
+            obj = self._table(resource).get(_key(namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(self, resource: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             name_pattern: Optional[str] = None) -> List[Object]:
+        with self._mu:
+            out = []
+            for (ns, name), obj in self._table(resource).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if not match_label_selector(labels, label_selector):
+                    continue
+                if name_pattern and not fnmatch.fnmatch(name, name_pattern):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
+                                    o["metadata"]["name"]))
+            return out
+
+    def update(self, resource: str, obj: Object) -> Object:
+        with self._mu:
+            obj = copy.deepcopy(obj)
+            meta = obj.get("metadata") or {}
+            ns, name = meta.get("namespace", ""), meta.get("name", "")
+            k = _key(ns, name)
+            table = self._table(resource)
+            cur = table.get(k)
+            if cur is None:
+                raise NotFoundError(f"{resource} {ns}/{name} not found")
+            cur_meta = cur["metadata"]
+            supplied_rv = meta.get("resourceVersion")
+            if supplied_rv and supplied_rv != cur_meta["resourceVersion"]:
+                raise ConflictError(
+                    f"{resource} {ns}/{name}: resourceVersion conflict "
+                    f"(have {supplied_rv}, want {cur_meta['resourceVersion']})"
+                )
+            # immutable fields
+            meta["uid"] = cur_meta["uid"]
+            meta["creationTimestamp"] = cur_meta["creationTimestamp"]
+            if cur_meta.get("deletionTimestamp") is not None:
+                meta["deletionTimestamp"] = cur_meta["deletionTimestamp"]
+            meta["resourceVersion"] = self._next_rv()
+            if obj.get("spec") != cur.get("spec"):
+                meta["generation"] = cur_meta.get("generation", 1) + 1
+            else:
+                meta["generation"] = cur_meta.get("generation", 1)
+            obj["metadata"] = meta
+            # finalizer-aware GC: deletion pending + no finalizers -> delete
+            if meta.get("deletionTimestamp") is not None and not meta.get("finalizers"):
+                del table[k]
+                self._notify(resource, DELETED, obj)
+                return copy.deepcopy(obj)
+            table[k] = obj
+            self._notify(resource, MODIFIED, obj)
+            return copy.deepcopy(obj)
+
+    def delete(self, resource: str, name: str, namespace: str = "") -> None:
+        with self._mu:
+            k = _key(namespace, name)
+            table = self._table(resource)
+            cur = table.get(k)
+            if cur is None:
+                raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            meta = cur["metadata"]
+            if meta.get("finalizers"):
+                if meta.get("deletionTimestamp") is None:
+                    meta["deletionTimestamp"] = time.time()
+                    meta["resourceVersion"] = self._next_rv()
+                    self._notify(resource, MODIFIED, cur)
+                return
+            del table[k]
+            meta["resourceVersion"] = self._next_rv()
+            self._notify(resource, DELETED, cur)
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, resource: str,
+              label_selector: Optional[Dict[str, str]] = None) -> _WatchSub:
+        with self._mu:
+            sub = _WatchSub(label_selector)
+            self._subs.setdefault(resource, []).append(sub)
+            return sub
+
+    def list_and_watch(self, resource: str, namespace: Optional[str] = None,
+                       label_selector: Optional[Dict[str, str]] = None
+                       ) -> Tuple[List[Object], _WatchSub]:
+        """Atomic initial-list + subscription (no missed events between)."""
+        with self._mu:
+            items = self.list(resource, namespace=namespace,
+                              label_selector=label_selector)
+            sub = self.watch(resource, label_selector)
+            return items, sub
+
+    def stop_watch(self, resource: str, sub: _WatchSub) -> None:
+        with self._mu:
+            sub.close()
+            subs = self._subs.get(resource, [])
+            if sub in subs:
+                subs.remove(sub)
+
+    # -- test helpers -------------------------------------------------------
+
+    def resource_version(self) -> int:
+        with self._mu:
+            return self._rv
+
+    def dump(self) -> Dict[str, List[Object]]:
+        with self._mu:
+            return {r: self.list(r) for r in self._tables}

@@ -1,0 +1,147 @@
+"""List+watch informers with local stores and event handlers.
+
+Reference analog: the generated informers/listers in pkg/nvidia.com/ plus
+client-go SharedInformer semantics the driver relies on: initial sync
+delivers ADDED for every existing object, then watch events stream; a
+local thread-safe store answers lister queries without API round-trips.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.kube.fake import ADDED, DELETED, MODIFIED, Object
+
+
+class Informer:
+    def __init__(self, client: ResourceClient,
+                 namespace: Optional[str] = None,
+                 label_selector: Optional[Dict[str, str]] = None,
+                 name_filter: Optional[Callable[[str], bool]] = None):
+        self._client = client
+        self._namespace = namespace
+        self._selector = label_selector
+        self._name_filter = name_filter
+        self._mu = threading.RLock()
+        self._store: Dict[Tuple[str, str], Object] = {}
+        self._handlers: List[Tuple[Optional[Callable], Optional[Callable], Optional[Callable]]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._sub = None
+        self._synced = threading.Event()
+
+    # -- handler registration ----------------------------------------------
+
+    def add_handlers(self, on_add: Optional[Callable[[Object], None]] = None,
+                     on_update: Optional[Callable[[Object, Object], None]] = None,
+                     on_delete: Optional[Callable[[Object], None]] = None) -> None:
+        # Registration, store replay, and event dispatch all serialize on
+        # _mu so a late-registering handler cannot receive a duplicate ADDED
+        # (once from replay, once from an in-flight dispatch).
+        with self._mu:
+            self._handlers.append((on_add, on_update, on_delete))
+            if self._synced.is_set() and on_add:
+                for obj in list(self._store.values()):
+                    on_add(copy.deepcopy(obj))
+
+    # -- lister -------------------------------------------------------------
+
+    def get(self, name: str, namespace: str = "") -> Optional[Object]:
+        with self._mu:
+            obj = self._store.get((namespace or "", name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, label_selector: Optional[Dict[str, str]] = None) -> List[Object]:
+        from tpu_dra_driver.kube.fake import match_label_selector
+        with self._mu:
+            out = []
+            for obj in self._store.values():
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if match_label_selector(labels, label_selector):
+                    out.append(copy.deepcopy(obj))
+            return out
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        items, sub = self._client.list_and_watch(namespace=self._namespace,
+                                                 label_selector=self._selector)
+        self._sub = sub
+        with self._mu:
+            for obj in items:
+                if self._accept(obj):
+                    meta = obj["metadata"]
+                    self._store[(meta.get("namespace", ""), meta["name"])] = obj
+            for obj in list(self._store.values()):
+                self._dispatch(ADDED, obj, None)
+            self._synced.set()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"informer-{self._client.resource}")
+        self._thread.start()
+
+    def wait_synced(self, timeout: float = 5.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sub is not None:
+            self._client.stop_watch(self._sub)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- internals ----------------------------------------------------------
+
+    def _accept(self, obj: Object) -> bool:
+        meta = obj.get("metadata") or {}
+        if self._namespace is not None and meta.get("namespace", "") != self._namespace:
+            return False
+        if self._name_filter is not None and not self._name_filter(meta.get("name", "")):
+            return False
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            ev = self._sub.next(timeout=0.2)
+            if ev is None:
+                if self._sub.closed:
+                    return
+                continue
+            ev_type, obj = ev
+            if not self._accept(obj):
+                continue
+            meta = obj["metadata"]
+            key = (meta.get("namespace", ""), meta["name"])
+            # Store update + dispatch happen under one lock acquisition so
+            # late handler registration (which replays the store under the
+            # same lock) can't interleave and double-deliver.
+            with self._mu:
+                old = self._store.get(key)
+                if ev_type == DELETED:
+                    self._store.pop(key, None)
+                else:
+                    self._store[key] = obj
+                self._dispatch(ev_type, obj, old)
+
+    def _dispatch(self, ev_type: str, obj: Object, old: Optional[Object]) -> None:
+        """Call with _mu held. Hands each handler its own deep copy so
+        handler mutations cannot corrupt the shared cache."""
+        for on_add, on_update, on_delete in list(self._handlers):
+            try:
+                if ev_type == ADDED and on_add:
+                    on_add(copy.deepcopy(obj))
+                elif ev_type == MODIFIED:
+                    if on_update:
+                        on_update(copy.deepcopy(old) if old is not None
+                                  else copy.deepcopy(obj), copy.deepcopy(obj))
+                    elif on_add:
+                        on_add(copy.deepcopy(obj))
+                elif ev_type == DELETED and on_delete:
+                    on_delete(copy.deepcopy(obj))
+            except Exception:  # handler errors must not kill the informer
+                import logging
+                logging.getLogger(__name__).exception(
+                    "informer handler error (%s %s)", ev_type,
+                    obj.get("metadata", {}).get("name"))

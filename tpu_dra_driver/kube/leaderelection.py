@@ -1,0 +1,118 @@
+"""Lease-based leader election.
+
+Reference analog: cmd/compute-domain-controller/main.go:269-370 — optional
+leader election via client-go leaderelection (15s lease, 10s renew
+deadline, 2s retry period) so exactly one controller replica reconciles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
+
+
+@dataclass
+class LeaderElectionConfig:
+    lease_name: str = "tpu-dra-driver-controller"
+    namespace: str = "kube-system"
+    identity: str = ""
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+
+class LeaderElector:
+    """Acquire/renew a Lease object; run callbacks on gain/loss."""
+
+    def __init__(self, leases: ResourceClient, config: LeaderElectionConfig,
+                 on_started_leading: Callable[[], None],
+                 on_stopped_leading: Callable[[], None]):
+        self._leases = leases
+        self._cfg = config
+        self._on_start = on_started_leading
+        self._on_stop = on_stopped_leading
+        self._stop = threading.Event()
+        self._leading = False
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="leader-elector")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        if self._leading:
+            self._leading = False
+            self._release()
+            self._on_stop()
+
+    def _run(self) -> None:
+        last_renew = 0.0
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                last_renew = time.monotonic()
+                if not self._leading:
+                    self._leading = True
+                    self._on_start()
+            elif self._leading:
+                # Transient renewal failures (e.g. a resourceVersion conflict
+                # from a rival's failed takeover) don't immediately demote the
+                # leader: leadership holds until renew_deadline elapses
+                # without a successful renewal (client-go semantics).
+                if time.monotonic() - last_renew > self._cfg.renew_deadline:
+                    self._leading = False
+                    self._on_stop()
+            self._stop.wait(self._cfg.retry_period)
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        cfg = self._cfg
+        try:
+            lease = self._leases.get(cfg.lease_name, cfg.namespace)
+        except NotFoundError:
+            try:
+                self._leases.create({
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": cfg.lease_name, "namespace": cfg.namespace},
+                    "spec": {"holderIdentity": cfg.identity, "renewTime": now,
+                             "leaseDurationSeconds": cfg.lease_duration},
+                })
+                return True
+            except AlreadyExistsError:
+                return False
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity", "")
+        renew = spec.get("renewTime", 0.0)
+        expired = now - renew > cfg.lease_duration
+        if holder != cfg.identity and not expired:
+            return False
+        lease["spec"] = {"holderIdentity": cfg.identity, "renewTime": now,
+                         "leaseDurationSeconds": cfg.lease_duration}
+        try:
+            self._leases.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def _release(self) -> None:
+        cfg = self._cfg
+        try:
+            lease = self._leases.get(cfg.lease_name, cfg.namespace)
+            if (lease.get("spec") or {}).get("holderIdentity") == cfg.identity:
+                lease["spec"]["renewTime"] = 0.0
+                self._leases.update(lease)
+        except (NotFoundError, ConflictError):
+            pass
