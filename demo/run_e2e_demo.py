@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""End-to-end demo: the "gpu-test1" equivalent, hardware-free.
+
+Reference analog: demo/specs/quickstart/v1/gpu-test1.yaml driven by
+tests/bats/test_gpu_basic.bats — one pod claims one device through DRA and
+proves it can use it (the reference asserts `nvidia-smi -L` output).
+
+Flow (all in-process against the fake cluster + fake TPU backend, except
+the workload, which runs as a real subprocess):
+
+1. start a tpu-kubelet-plugin on a fake v5p host → ResourceSlices published
+2. create a ResourceClaim requesting one chip-type device
+3. the in-repo DRA allocator (scheduler role) allocates it
+4. the plugin Prepares the claim → per-claim CDI spec written
+5. the CDI spec's container edits (env) are applied to a child process that
+   runs a real JAX computation — proving the injected environment is what a
+   TPU container would boot with
+6. Unprepare → CDI spec gone, checkpoint empty
+
+Run: python3 demo/run_e2e_demo.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dra_driver.kube.allocator import Allocator
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+WORKLOAD = r"""
+import os, json
+import jax, jax.numpy as jnp
+visible = os.environ["TPU_VISIBLE_CHIPS"]
+x = jnp.ones((512, 512), dtype=jnp.bfloat16)
+y = (x @ x).sum()
+print(json.dumps({
+    "tpu_visible_chips": visible,
+    "tpu_driver_version": os.environ.get("TPU_DRIVER_VERSION"),
+    "result": float(y),
+    "backend": jax.default_backend(),
+}))
+"""
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="tpu-dra-demo-")
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="demo-node",
+        state_dir=os.path.join(tmp, "plugin"),
+        cdi_root=os.path.join(tmp, "cdi"),
+        gates=fg.FeatureGates(),
+    ))
+    plugin.start()
+    slices = clients.resource_slices.list()
+    print(f"[1] published {len(slices)} ResourceSlice(s), "
+          f"{sum(len(s['spec']['devices']) for s in slices)} devices")
+
+    clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "tpu-test1", "namespace": "demo"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "count": 1,
+             "selectors": [{"attribute": "type", "equals": "chip"}]},
+        ]}},
+    })
+    claim = Allocator(clients).allocate("tpu-test1", "demo")
+    result = claim["status"]["allocation"]["devices"]["results"][0]
+    print(f"[2] allocated device {result['device']} on pool {result['pool']}")
+
+    res = plugin.prepare_resource_claims([claim])[claim["metadata"]["uid"]]
+    assert res.error is None, res.error
+    print(f"[3] prepared: {[d.canonical_name for d in res.devices]} "
+          f"cdi={res.cdi_device_ids}")
+
+    spec = plugin.state._cdi.read_claim_spec(claim["metadata"]["uid"])
+    env = dict(e.split("=", 1) for e in spec["containerEdits"]["env"])
+    nodes = [n["path"] for d in spec["devices"]
+             for n in d["containerEdits"]["deviceNodes"]]
+    print(f"[4] CDI env: {env}")
+    print(f"    CDI device nodes: {nodes}")
+
+    child_env = {**os.environ, **env,
+                 "JAX_PLATFORMS": "cpu"}  # no TPU in this sandbox
+    out = subprocess.run([sys.executable, "-c", WORKLOAD], env=child_env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    print(f"[5] workload ran with injected env: {payload}")
+    assert payload["tpu_visible_chips"] == "0"
+    assert payload["result"] == 512.0 * 512 * 512
+
+    plugin.unprepare_resource_claims([claim["metadata"]["uid"]])
+    assert plugin.state.get_checkpoint().claims == {}
+    assert plugin.state._cdi.read_claim_spec(claim["metadata"]["uid"]) is None
+    print("[6] unprepared; checkpoint + CDI spec clean. E2E OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

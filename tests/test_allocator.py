@@ -1,0 +1,106 @@
+"""Tests for the in-repo DRA allocator: selector matching, counts, and
+KEP-4815 counter-based mutual exclusion between a chip and its sub-slices."""
+
+import pytest
+
+from tpu_dra_driver.kube.allocator import AllocationError, Allocator
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+NODE = "node-a"
+
+
+def _cluster(tmp_path, dynamic=False):
+    clients = ClientSets()
+    gates = fg.FeatureGates()
+    if dynamic:
+        gates.set(fg.DYNAMIC_SUBSLICE, True)
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name=NODE, state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi"), gates=gates))
+    plugin.start()
+    return clients, plugin
+
+
+def _mkclaim(clients, name, requests):
+    clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "ns"},
+        "spec": {"devices": {"requests": requests}},
+    })
+
+
+def test_allocate_by_selector_and_count(tmp_path):
+    clients, _ = _cluster(tmp_path)
+    _mkclaim(clients, "c1", [{"name": "tpu", "count": 2,
+                              "selectors": [{"attribute": "type", "equals": "chip"}]}])
+    claim = Allocator(clients).allocate("c1", "ns")
+    results = claim["status"]["allocation"]["devices"]["results"]
+    assert [r["device"] for r in results] == ["tpu-0", "tpu-1"]
+    # second allocation skips taken devices
+    _mkclaim(clients, "c2", [{"name": "tpu", "count": 2,
+                              "selectors": [{"attribute": "type", "equals": "chip"}]}])
+    claim2 = Allocator(clients).allocate("c2", "ns")
+    assert [r["device"] for r in claim2["status"]["allocation"]["devices"]["results"]] \
+        == ["tpu-2", "tpu-3"]
+    # nothing left
+    _mkclaim(clients, "c3", [{"name": "tpu", "count": 1,
+                              "selectors": [{"attribute": "type", "equals": "chip"}]}])
+    with pytest.raises(AllocationError):
+        Allocator(clients).allocate("c3", "ns")
+
+
+def test_counter_mutual_exclusion_chip_vs_subslice(tmp_path):
+    clients, _ = _cluster(tmp_path, dynamic=True)
+    # take one 1-core sub-slice of chip 0
+    _mkclaim(clients, "ss", [{"name": "s", "count": 1, "selectors": [
+        {"attribute": "type", "equals": "subslice"},
+    ]}])
+    claim = Allocator(clients).allocate("ss", "ns")
+    dev = claim["status"]["allocation"]["devices"]["results"][0]["device"]
+    assert dev == "tpu-0-ss-1c47g-0"
+    # the full chip 0 is now counter-blocked; chips 1..3 still allocatable
+    _mkclaim(clients, "chips", [{"name": "c", "count": 3, "selectors": [
+        {"attribute": "type", "equals": "chip"},
+    ]}])
+    claim2 = Allocator(clients).allocate("chips", "ns")
+    got = [r["device"] for r in claim2["status"]["allocation"]["devices"]["results"]]
+    assert got == ["tpu-1", "tpu-2", "tpu-3"]
+    # a 4th chip is impossible while the sub-slice holds chip 0's counters
+    _mkclaim(clients, "one-more", [{"name": "c", "count": 1, "selectors": [
+        {"attribute": "type", "equals": "chip"},
+    ]}])
+    with pytest.raises(AllocationError):
+        Allocator(clients).allocate("one-more", "ns")
+    # but the *sibling* sub-slice placement on chip 0 still fits
+    _mkclaim(clients, "sibling", [{"name": "s", "count": 1, "selectors": [
+        {"attribute": "type", "equals": "subslice"},
+    ]}])
+    claim3 = Allocator(clients).allocate("sibling", "ns")
+    assert claim3["status"]["allocation"]["devices"]["results"][0]["device"] \
+        == "tpu-0-ss-1c47g-1"
+
+
+def test_allocation_idempotent(tmp_path):
+    clients, _ = _cluster(tmp_path)
+    _mkclaim(clients, "c1", [{"name": "t", "count": 1}])
+    a = Allocator(clients)
+    first = a.allocate("c1", "ns")
+    again = a.allocate("c1", "ns")
+    assert (first["status"]["allocation"]["devices"]["results"]
+            == again["status"]["allocation"]["devices"]["results"])
+
+
+def test_allocated_claim_prepares_cleanly(tmp_path):
+    """Full loop: allocate via slices, prepare via plugin."""
+    clients, plugin = _cluster(tmp_path)
+    _mkclaim(clients, "c1", [{"name": "t", "count": 1,
+                              "selectors": [{"attribute": "type", "equals": "chip"}]}])
+    claim = Allocator(clients).allocate("c1", "ns")
+    res = plugin.prepare_resource_claims([claim])[claim["metadata"]["uid"]]
+    assert res.error is None
+    assert res.devices[0].canonical_name == "tpu-0"

@@ -1,0 +1,470 @@
+"""Tests for the tpu-kubelet-plugin core: checkpoints, allocatable devices,
+ResourceSlices/KEP-4815 counters, the Prepare/Unprepare state machine,
+crash recovery, health republish, and checkpoint cleanup.
+
+Reference analogs: the Prepare semantics of
+cmd/gpu-kubelet-plugin/device_state.go:180-516 and the bats scenarios in
+tests/bats/test_gpu_{basic,mig,dynmig}.bats — here runnable hardware-free
+against the fake backend.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_dra_driver.cdi.generator import CdiHandler
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.allocatable import DeviceType, enumerate_allocatable
+from tpu_dra_driver.plugin.checkpoint import (
+    Checkpoint,
+    CheckpointCorruptionError,
+    CheckpointManager,
+    ClaimEntry,
+    PreparedDevice,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+)
+from tpu_dra_driver.plugin.claims import ClaimInfo, build_allocated_claim
+from tpu_dra_driver.plugin.cleanup import CheckpointCleanupManager
+from tpu_dra_driver.plugin.device_state import DeviceState, PermanentError
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.plugin.resourceslices import build_resource_slices
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind
+
+NODE = "node-a"
+
+
+def _gates(**over):
+    g = fg.FeatureGates()
+    for k, v in over.items():
+        g.set(k, v)
+    return g
+
+
+def _mkplugin(tmp_path, lib=None, gates=None, accelerator_type="v5p-8"):
+    clients = ClientSets()
+    lib = lib or FakeTpuLib(FakeSystemConfig(accelerator_type=accelerator_type))
+    cfg = PluginConfig(
+        node_name=NODE,
+        state_dir=str(tmp_path / "plugin-state"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=gates or fg.FeatureGates(),
+    )
+    plugin = TpuKubeletPlugin(clients, lib, cfg)
+    plugin.start()
+    return plugin, clients, lib
+
+
+def _claim(uid, devices, name=None, **kw):
+    return build_allocated_claim(uid, name or f"claim-{uid}", "user-ns",
+                                 devices, NODE, **kw)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_round_trip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    cp = Checkpoint(claims={
+        "u1": ClaimEntry("u1", "c1", "ns", PREPARE_COMPLETED,
+                         [PreparedDevice("tpu-0", "req", ["tpu.google.com/device=x"],
+                                         "chip", "TPU-abc", "/dev/accel0")]),
+    })
+    mgr.write(cp)
+    again = mgr.read()
+    assert again.claims["u1"].state == PREPARE_COMPLETED
+    assert again.claims["u1"].prepared_devices[0].canonical_name == "tpu-0"
+    assert again.prepared_device_owners() == {"tpu-0": "u1"}
+
+
+def test_checkpoint_checksum_detects_corruption(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(claims={"u1": ClaimEntry("u1", "c", "ns")}))
+    raw = json.loads(open(mgr.path).read())
+    raw["v2"]["claims"]["u1"]["claimName"] = "tampered"
+    open(mgr.path, "w").write(json.dumps(raw))
+    with pytest.raises(CheckpointCorruptionError):
+        mgr.read()
+
+
+def test_checkpoint_v1_fallback(tmp_path):
+    """A file written by a version that only knows V1 must still load."""
+    mgr = CheckpointManager(str(tmp_path))
+    v1 = {"claims": {"u1": ClaimEntry("u1", "c", "ns", PREPARE_COMPLETED).to_obj()}}
+    import zlib
+    crc = zlib.crc32(json.dumps(v1, sort_keys=True).encode())
+    open(mgr.path, "w").write(json.dumps({"v1": v1, "checksums": {"v1": crc}}))
+    cp = mgr.read()
+    assert cp.claims["u1"].state == PREPARE_COMPLETED
+
+
+# ---------------------------------------------------------------------------
+# allocatable + slices
+# ---------------------------------------------------------------------------
+
+def test_enumerate_allocatable_plain():
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    devs = enumerate_allocatable(lib, fg.FeatureGates())
+    assert sorted(devs) == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    assert all(d.type == DeviceType.CHIP for d in devs.values())
+
+
+def test_enumerate_allocatable_dynamic_subslice():
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    devs = enumerate_allocatable(lib, _gates(DynamicSubslice=True))
+    # 4 chips + 2 placements x 1-core profile per chip
+    assert len(devs) == 4 + 4 * 2
+    assert "tpu-0-ss-1c47g-0" in devs
+    assert "tpu-0-ss-1c47g-1" in devs
+
+
+def test_slices_combined_layout_counters():
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    devs = enumerate_allocatable(lib, _gates(DynamicSubslice=True))
+    slices = build_resource_slices(NODE, devs, layout="combined")
+    assert len(slices) == 1
+    spec = slices[0]["spec"]
+    assert len(spec["sharedCounters"]) == 4
+    cs0 = spec["sharedCounters"][0]
+    assert cs0["counters"]["tensorcores"]["value"] == "2"
+    assert "memory-slice-0" in cs0["counters"]
+    by_name = {d["name"]: d for d in spec["devices"]}
+    # full chip consumes everything in its set
+    full = by_name["tpu-0"]["consumesCounters"][0]
+    assert full["counterSet"] == "tpu-0-counter-set"
+    assert full["counters"]["tensorcores"]["value"] == "2"
+    assert set(full["counters"]) == {"tensorcores", "hbm",
+                                     "memory-slice-0", "memory-slice-1"}
+    # 1-core sub-slice at start 1 consumes only its slice
+    ss = by_name["tpu-0-ss-1c47g-1"]["consumesCounters"][0]
+    assert ss["counters"]["tensorcores"]["value"] == "1"
+    assert "memory-slice-1" in ss["counters"]
+    assert "memory-slice-0" not in ss["counters"]
+
+
+def test_slices_split_layout():
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    devs = enumerate_allocatable(lib, _gates(DynamicSubslice=True))
+    slices = build_resource_slices(NODE, devs, layout="split")
+    assert len(slices) == 5  # counters + 4 chip slices
+    assert slices[0]["spec"]["sharedCounters"]
+    assert not slices[0]["spec"]["devices"]
+    assert all(s["spec"]["pool"]["resourceSliceCount"] == 5 for s in slices)
+
+
+# ---------------------------------------------------------------------------
+# prepare / unprepare e2e
+# ---------------------------------------------------------------------------
+
+def test_prepare_chip_end_to_end(tmp_path):
+    plugin, clients, lib = _mkplugin(tmp_path)
+    # slices were published at startup
+    published = clients.resource_slices.list()
+    assert len(published) == 1
+    assert len(published[0]["spec"]["devices"]) == 4
+
+    claim = _claim("uid-1", ["tpu-0", "tpu-1"])
+    results = plugin.prepare_resource_claims([claim])
+    res = results["uid-1"]
+    assert res.error is None
+    assert [d.canonical_name for d in res.devices] == ["tpu-0", "tpu-1"]
+    assert all(d.cdi_device_ids for d in res.devices)
+
+    # CDI spec exists and carries device nodes + visible-chips env
+    spec = plugin.state._cdi.read_claim_spec("uid-1")
+    assert spec is not None
+    env = dict(e.split("=", 1) for e in spec["containerEdits"]["env"])
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+    node_paths = {d["containerEdits"]["deviceNodes"][0]["path"]
+                  for d in spec["devices"]}
+    assert node_paths == {"/dev/accel0", "/dev/accel1"}
+    mounts = spec["containerEdits"]["mounts"]
+    assert any(m["containerPath"] == "/lib/libtpu.so" for m in mounts)
+
+    # idempotency: second call returns cached result
+    res2 = plugin.prepare_resource_claims([claim])["uid-1"]
+    assert [d.canonical_name for d in res2.devices] == ["tpu-0", "tpu-1"]
+    assert plugin.state.timings[-1].cached
+
+    # unprepare removes spec + checkpoint entry
+    assert plugin.unprepare_resource_claims(["uid-1"]) == {"uid-1": None}
+    assert plugin.state._cdi.read_claim_spec("uid-1") is None
+    assert plugin.state.get_checkpoint().claims == {}
+
+
+def test_prepare_overlap_rejected(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    assert plugin.prepare_resource_claims([_claim("u1", ["tpu-0"])])["u1"].error is None
+    res = plugin.prepare_resource_claims([_claim("u2", ["tpu-0"])])["u2"]
+    assert res.error is not None and res.permanent
+    assert "already prepared" in res.error
+
+
+def test_prepare_admin_access_bypasses_overlap(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    plugin.prepare_resource_claims([_claim("u1", ["tpu-0"])])
+    claim = _claim("u2", ["tpu-0"])
+    claim["status"]["allocation"]["devices"]["results"][0]["adminAccess"] = True
+    assert plugin.prepare_resource_claims([claim])["u2"].error is None
+
+
+def test_prepare_unknown_device_permanent_error(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    res = plugin.prepare_resource_claims([_claim("u1", ["tpu-99"])])["u1"]
+    assert res.permanent
+    assert "not in this node's allocatable inventory" in res.error
+
+
+def test_prepare_subslice_lifecycle(tmp_path):
+    gates = _gates(DynamicSubslice=True)
+    plugin, _, lib = _mkplugin(tmp_path, gates=gates)
+    claim = _claim("u1", ["tpu-0-ss-1c47g-0"])
+    res = plugin.prepare_resource_claims([claim])["u1"]
+    assert res.error is None
+    assert len(lib.list_subslices()) == 1
+    live = lib.list_subslices()[0]
+    assert live.spec_tuple.canonical_name() == "tpu-0-ss-1c47g-0"
+    plugin.unprepare_resource_claims(["u1"])
+    assert lib.list_subslices() == []
+
+
+def test_startup_destroys_unknown_subslices(tmp_path):
+    """Crash recovery prong (a): a live sub-slice no checkpointed claim owns
+    is destroyed at startup."""
+    gates = _gates(DynamicSubslice=True)
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin, _, _ = _mkplugin(tmp_path, lib=lib, gates=gates)
+    plugin.prepare_resource_claims([_claim("u1", ["tpu-0-ss-1c47g-0"])])
+
+    # simulate an orphan: a partition created outside any claim
+    from tpu_dra_driver.tpulib.partition import SubsliceProfile, SubsliceSpec
+    chip = lib.enumerate_chips()[1]
+    lib.create_subslice(SubsliceSpec(chip.index, chip.uuid,
+                                     SubsliceProfile(chip.generation, 1), 0))
+    assert len(lib.list_subslices()) == 2
+
+    # "restart": new plugin over the same host state + state dir
+    lib2 = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"),
+                      host_state=lib.host_state)
+    plugin2, _, _ = _mkplugin(tmp_path, lib=lib2, gates=gates)
+    names = [l.spec_tuple.canonical_name() for l in lib2.list_subslices()]
+    assert names == ["tpu-0-ss-1c47g-0"]  # claimed one survives, orphan gone
+
+
+def test_rollback_of_prepare_started_leftover(tmp_path):
+    """Crash recovery prong (b): a PrepareStarted leftover is rolled back
+    and the claim prepared cleanly on retry."""
+    gates = _gates(DynamicSubslice=True)
+    plugin, _, lib = _mkplugin(tmp_path, gates=gates)
+    # simulate: previous attempt wrote PrepareStarted and created the
+    # partition, then crashed before completing
+    cp = plugin.state.get_checkpoint()
+    cp.claims["u1"] = ClaimEntry("u1", "c1", "user-ns", PREPARE_STARTED)
+    plugin.state._cp_mgr.write(cp)
+    from tpu_dra_driver.tpulib.partition import SubsliceProfile, SubsliceSpec
+    chip = lib.enumerate_chips()[0]
+    lib.create_subslice(SubsliceSpec(chip.index, chip.uuid,
+                                     SubsliceProfile(chip.generation, 1), 0))
+
+    res = plugin.prepare_resource_claims([_claim("u1", ["tpu-0-ss-1c47g-0"])])["u1"]
+    assert res.error is None
+    assert len(lib.list_subslices()) == 1
+    entry = plugin.state.get_checkpoint().claims["u1"]
+    assert entry.state == PREPARE_COMPLETED
+
+
+def test_cleanup_sweeps_stale_claims(tmp_path):
+    """Crash recovery prong (c): checkpointed claims whose ResourceClaim is
+    gone (or has a new UID) are unprepared by the periodic sweep."""
+    plugin, clients, _ = _mkplugin(tmp_path)
+    claim = _claim("u1", ["tpu-0"])
+    clients.resource_claims.create(claim)
+    plugin.prepare_resource_claims([claim])
+
+    # claim deleted and recreated under the same name with a new uid
+    clients.resource_claims.delete("claim-u1", "user-ns")
+    recreated = _claim("u2", ["tpu-1"], name="claim-u1")
+    clients.resource_claims.create(recreated)
+
+    cleaned = plugin.cleanup.sweep_once()
+    assert cleaned == ["u1"]
+    assert plugin.state.get_checkpoint().claims == {}
+    # a live claim is left alone
+    plugin.prepare_resource_claims([recreated])
+    assert plugin.cleanup.sweep_once() == []
+
+
+def test_sharing_timeslicing_flow(tmp_path):
+    gates = _gates(TimeSlicingSettings=True)
+    plugin, _, lib = _mkplugin(tmp_path, gates=gates)
+    cfgs = [{
+        "source": "FromClaim", "requests": [],
+        "opaque": {"driver": "tpu.google.com", "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing",
+                        "timeSlicing": {"interval": "Long"}},
+        }},
+    }]
+    claim = _claim("u1", ["tpu-0"], configs=cfgs)
+    res = plugin.prepare_resource_claims([claim])["u1"]
+    assert res.error is None
+    chip = lib.enumerate_chips()[0]
+    from tpu_dra_driver.tpulib.interface import TimesliceInterval
+    assert lib.get_timeslice(chip.uuid) == TimesliceInterval.LONG
+    assert lib.get_exclusive_mode(chip.uuid) is False
+    spec = plugin.state._cdi.read_claim_spec("u1")
+    env = dict(e.split("=", 1) for e in spec["containerEdits"]["env"])
+    assert env["TPU_TIMESLICE_INTERVAL"] == "Long"
+
+
+def test_sharing_requires_gate(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)  # gates off
+    cfgs = [{
+        "source": "FromClaim", "requests": [],
+        "opaque": {"driver": "tpu.google.com", "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "MultiProcess"},
+        }},
+    }]
+    res = plugin.prepare_resource_claims([_claim("u1", ["tpu-0"], configs=cfgs)])["u1"]
+    assert res.permanent
+    assert "MultiProcessSharing" in res.error
+
+
+def test_bad_opaque_config_is_permanent(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    cfgs = [{
+        "source": "FromClaim", "requests": [],
+        "opaque": {"driver": "tpu.google.com", "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "totallyUnknownField": 1,
+        }},
+    }]
+    res = plugin.prepare_resource_claims([_claim("u1", ["tpu-0"], configs=cfgs)])["u1"]
+    assert res.permanent
+    assert "bad opaque config" in res.error
+
+
+def test_vfio_prepare_flow_and_republish(tmp_path):
+    gates = _gates(PassthroughSupport=True)
+    plugin, clients, lib = _mkplugin(tmp_path, gates=gates)
+    devs0 = plugin.state.allocatable
+    assert "tpu-vfio-0" in devs0 and "tpu-0" in devs0
+
+    res = plugin.prepare_resource_claims([_claim("u1", ["tpu-vfio-0"])])["u1"]
+    assert res.error is None
+    assert res.devices[0].devfs_path.startswith("/dev/vfio/")
+    # after the flip, the chip personality of chip 0 is gone from published
+    published = clients.resource_slices.list()
+    names = {d["name"] for s in published for d in s["spec"]["devices"]}
+    assert "tpu-0" not in names
+    assert "tpu-vfio-0" in names
+
+    plugin.unprepare_resource_claims(["u1"])
+    published = clients.resource_slices.list()
+    names = {d["name"] for s in published for d in s["spec"]["devices"]}
+    assert "tpu-0" in names
+
+
+def test_health_event_republishes_without_chip(tmp_path):
+    gates = _gates(DeviceHealthCheck=True)
+    plugin, clients, lib = _mkplugin(tmp_path, gates=gates)
+    chip = lib.enumerate_chips()[0]
+    lib.inject_health_event(HealthEvent(HealthEventKind.HBM_ECC_ERROR,
+                                        chip.uuid, 7, "uncorrectable"))
+    names = {d["name"] for s in clients.resource_slices.list()
+             for d in s["spec"]["devices"]}
+    assert "tpu-0" not in names
+    assert {"tpu-1", "tpu-2", "tpu-3"} <= names
+    # benign events do nothing
+    chip1 = lib.enumerate_chips()[1]
+    lib.inject_health_event(HealthEvent(HealthEventKind.THERMAL, chip1.uuid))
+    names = {d["name"] for s in clients.resource_slices.list()
+             for d in s["spec"]["devices"]}
+    assert "tpu-1" in names
+
+
+def test_prepare_timing_breadcrumbs_recorded(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    plugin.prepare_resource_claims([_claim("u1", ["tpu-0"])])
+    t = plugin.state.timings[-1]
+    assert t.t_total > 0 and t.t_core >= 0 and t.t_cdi > 0
+    assert not t.cached
+    assert "user-ns/claim-u1:u1" == t.claim
+
+
+# ---------------------------------------------------------------------------
+# regressions from review round 3
+# ---------------------------------------------------------------------------
+
+def test_passthrough_publishes_counters_for_personality_exclusion(tmp_path):
+    """With passthrough on (and dynamic sub-slicing off), the chip and vfio
+    personalities must share counters so the scheduler can't double-book
+    one physical chip."""
+    gates = _gates(PassthroughSupport=True)
+    plugin, clients, _ = _mkplugin(tmp_path, gates=gates)
+    s = clients.resource_slices.list()[0]["spec"]
+    assert s.get("sharedCounters"), "counters must be emitted for chip/vfio pairs"
+    by_name = {d["name"]: d for d in s["devices"]}
+    assert by_name["tpu-0"]["consumesCounters"][0]["counterSet"] == "tpu-0-counter-set"
+    assert by_name["tpu-vfio-0"]["consumesCounters"][0]["counterSet"] == "tpu-0-counter-set"
+    # and the allocator indeed refuses the second personality
+    from tpu_dra_driver.kube.allocator import AllocationError, Allocator
+    clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": "a", "namespace": "ns"},
+        "spec": {"devices": {"requests": [
+            {"name": "r", "count": 4, "selectors": [{"attribute": "type", "equals": "chip"}]},
+        ]}}})
+    Allocator(clients).allocate("a", "ns")
+    clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": "b", "namespace": "ns"},
+        "spec": {"devices": {"requests": [
+            {"name": "r", "count": 1, "selectors": [{"attribute": "type", "equals": "vfio"}]},
+        ]}}})
+    with pytest.raises(AllocationError):
+        Allocator(clients).allocate("b", "ns")
+
+
+def test_unprepare_resets_timeslice_interval(tmp_path):
+    gates = _gates(TimeSlicingSettings=True)
+    plugin, _, lib = _mkplugin(tmp_path, gates=gates)
+    cfgs = [{
+        "source": "FromClaim", "requests": [],
+        "opaque": {"driver": "tpu.google.com", "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing",
+                        "timeSlicing": {"interval": "Long"}},
+        }},
+    }]
+    plugin.prepare_resource_claims([_claim("u1", ["tpu-0"], configs=cfgs)])
+    chip = lib.enumerate_chips()[0]
+    from tpu_dra_driver.tpulib.interface import TimesliceInterval
+    assert lib.get_timeslice(chip.uuid) == TimesliceInterval.LONG
+    plugin.unprepare_resource_claims(["u1"])
+    assert lib.get_timeslice(chip.uuid) == TimesliceInterval.DEFAULT
+    assert lib.get_exclusive_mode(chip.uuid) is True
+
+
+def test_checkpoint_v1_layout_is_genuinely_legacy(tmp_path):
+    """The dual-written V1 payload must carry only completed claims and no
+    state field — the shape a pre-state-machine downgrade reader expects."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(claims={
+        "done": ClaimEntry("done", "c1", "ns", PREPARE_COMPLETED,
+                           [PreparedDevice("tpu-0", "r")]),
+        "inflight": ClaimEntry("inflight", "c2", "ns", PREPARE_STARTED),
+    }))
+    raw = json.loads(open(mgr.path).read())
+    assert set(raw["v1"]["claims"]) == {"done"}
+    assert "state" not in raw["v1"]["claims"]["done"]
+    assert set(raw["v2"]["claims"]) == {"done", "inflight"}

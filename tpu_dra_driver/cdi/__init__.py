@@ -1,0 +1,1 @@
+from tpu_dra_driver.cdi.generator import CdiHandler, CdiSpec  # noqa: F401

@@ -1,0 +1,200 @@
+"""TPU-native CDI (Container Device Interface) spec generation.
+
+Reference analog: cmd/gpu-kubelet-plugin/cdi.go:65-304 — the reference
+delegates to the NVIDIA Container Toolkit's nvcdi to compute driver-library
+mounts/hooks and writes per-claim transient CDI specs under
+``/var/run/cdi``. The TPU build needs **no toolkit**: a TPU container needs
+
+- the device nodes (``/dev/accel*`` per claimed chip, or the vfio group
+  node, or a sub-slice partition node),
+- the libtpu shared library mounted from the host driver root,
+- ``TPU_*`` bootstrap env (visible-chip list, topology of the claimed set,
+  sharing limits, worker identity for ComputeDomains),
+- optionally ``/dev/vfio/vfio`` + the group node for passthrough.
+
+So the generator is self-contained here. Per-claim spec files are written
+atomically (tmp + rename) and named ``<vendor>_claim-<uid>.json``; device
+names inside a claim spec are claim-scoped so concurrent claims never
+collide (mirrors claim-UID-scoped transient specs in the reference).
+
+A small TTL cache keeps common edits cheap (reference cdi.go:125-182 uses a
+5-minute TTL cache for GetCommonEdits / device specs because cold NVML
+queries are O(seconds); our enumeration is cheap but the cache keeps the
+Prepare hot path allocation-free).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+CDI_VERSION = "0.6.0"
+DEFAULT_CDI_ROOT = "/var/run/cdi"
+VENDOR = "tpu.google.com"
+CLASS = "device"
+KIND = f"{VENDOR}/{CLASS}"
+
+DEFAULT_LIBTPU_HOST_PATH = "/home/kubernetes/bin/libtpu.so"
+DEFAULT_LIBTPU_CONTAINER_PATH = "/lib/libtpu.so"
+
+
+@dataclass
+class ContainerEdits:
+    """A subset of the CDI containerEdits schema the driver emits."""
+
+    env: Dict[str, str] = field(default_factory=dict)
+    device_nodes: List[Dict] = field(default_factory=list)
+    mounts: List[Dict] = field(default_factory=list)
+    hooks: List[Dict] = field(default_factory=list)
+
+    def merge(self, other: "ContainerEdits") -> "ContainerEdits":
+        out = ContainerEdits(
+            env=dict(self.env),
+            device_nodes=list(self.device_nodes),
+            mounts=list(self.mounts),
+            hooks=list(self.hooks),
+        )
+        out.env.update(other.env)
+        seen_nodes = {d["path"] for d in out.device_nodes}
+        out.device_nodes += [d for d in other.device_nodes
+                             if d["path"] not in seen_nodes]
+        seen_mounts = {m["containerPath"] for m in out.mounts}
+        out.mounts += [m for m in other.mounts
+                       if m["containerPath"] not in seen_mounts]
+        out.hooks += other.hooks
+        return out
+
+    def to_obj(self) -> Dict:
+        out: Dict = {}
+        if self.env:
+            out["env"] = [f"{k}={v}" for k, v in sorted(self.env.items())]
+        if self.device_nodes:
+            out["deviceNodes"] = self.device_nodes
+        if self.mounts:
+            out["mounts"] = self.mounts
+        if self.hooks:
+            out["hooks"] = self.hooks
+        return out
+
+
+@dataclass
+class CdiDevice:
+    """One named device entry in a claim spec."""
+
+    name: str
+    edits: ContainerEdits
+
+    @property
+    def qualified_name(self) -> str:
+        return f"{KIND}={self.name}"
+
+
+@dataclass
+class CdiSpec:
+    devices: List[CdiDevice]
+    common_edits: ContainerEdits
+
+    def to_obj(self) -> Dict:
+        return {
+            "cdiVersion": CDI_VERSION,
+            "kind": KIND,
+            "devices": [
+                {"name": d.name, "containerEdits": d.edits.to_obj()}
+                for d in self.devices
+            ],
+            "containerEdits": self.common_edits.to_obj(),
+        }
+
+
+class CdiHandler:
+    def __init__(self, cdi_root: str = DEFAULT_CDI_ROOT,
+                 driver_root: str = "/",
+                 libtpu_host_path: str = DEFAULT_LIBTPU_HOST_PATH,
+                 libtpu_container_path: str = DEFAULT_LIBTPU_CONTAINER_PATH,
+                 driver_version: str = "",
+                 common_edits_ttl: float = 300.0):
+        self._cdi_root = cdi_root
+        self._driver_root = driver_root.rstrip("/") or "/"
+        self._libtpu_host = libtpu_host_path
+        self._libtpu_container = libtpu_container_path
+        self._driver_version = driver_version
+        self._ttl = common_edits_ttl
+        self._mu = threading.Lock()
+        self._common_cache: Optional[tuple[float, ContainerEdits]] = None
+
+    # -- common edits -------------------------------------------------------
+
+    def get_common_edits(self) -> ContainerEdits:
+        """Edits every TPU container gets regardless of which device:
+        libtpu mount + driver-version env (reference: driver lib mounts,
+        nvidia-cdi-hook ldcache update — unnecessary for libtpu's single
+        dlopen'd .so)."""
+        with self._mu:
+            now = time.monotonic()
+            if self._common_cache and now - self._common_cache[0] < self._ttl:
+                return self._common_cache[1]
+            host_lib = self._libtpu_host
+            if self._driver_root != "/":
+                host_lib = self._driver_root + host_lib
+            edits = ContainerEdits(
+                env={
+                    "TPU_DRIVER_VERSION": self._driver_version or "unknown",
+                    "TPU_LIBRARY_PATH": self._libtpu_container,
+                },
+                mounts=[{
+                    "hostPath": host_lib,
+                    "containerPath": self._libtpu_container,
+                    "options": ["ro", "nosuid", "nodev", "bind"],
+                }],
+            )
+            self._common_cache = (now, edits)
+            return edits
+
+    def invalidate_cache(self) -> None:
+        with self._mu:
+            self._common_cache = None
+
+    # -- claim specs --------------------------------------------------------
+
+    def claim_spec_path(self, claim_uid: str) -> str:
+        return os.path.join(self._cdi_root, f"{VENDOR}_claim-{claim_uid}.json")
+
+    @staticmethod
+    def claim_device_name(claim_uid: str, canonical_name: str) -> str:
+        return f"claim-{claim_uid}-{canonical_name}"
+
+    def write_claim_spec(self, claim_uid: str, devices: List[CdiDevice],
+                         extra_common: Optional[ContainerEdits] = None) -> List[str]:
+        """Write the per-claim transient spec atomically; returns the
+        qualified CDI ids kubelet passes to the runtime."""
+        common = self.get_common_edits()
+        if extra_common is not None:
+            common = common.merge(extra_common)
+        spec = CdiSpec(devices=devices, common_edits=common)
+        os.makedirs(self._cdi_root, exist_ok=True)
+        path = self.claim_spec_path(claim_uid)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(spec.to_obj(), f, indent=2, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return [d.qualified_name for d in devices]
+
+    def delete_claim_spec(self, claim_uid: str) -> None:
+        try:
+            os.remove(self.claim_spec_path(claim_uid))
+        except FileNotFoundError:
+            pass
+
+    def read_claim_spec(self, claim_uid: str) -> Optional[Dict]:
+        try:
+            with open(self.claim_spec_path(claim_uid)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None

@@ -1,0 +1,186 @@
+"""A minimal DRA allocator — the kube-scheduler role for tests/demos.
+
+The reference relies on the real scheduler's DRA allocator; hardware-free
+testing here needs the same behavior in-process: satisfy ResourceClaim
+device requests against published ResourceSlices, honoring
+
+- device-class / request selectors (simple attribute matchers, standing in
+  for CEL),
+- exact counts,
+- **KEP-4815 shared counters**: a device can be allocated only if its
+  ``consumesCounters`` fit within its CounterSet's remaining capacity
+  after all existing allocations (this is what makes a full chip and an
+  overlapping sub-slice mutually exclusive).
+
+Selector format (per request)::
+
+    {"attribute": "type", "equals": "chip"}
+    {"attribute": "iciBandwidthGbps", "greaterThan": 1000}
+
+Numeric counter values are compared as integers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra_driver.kube.client import ClientSets
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+def _attr_value(dev: Dict, name: str):
+    a = (dev.get("attributes") or {}).get(name)
+    if a is None:
+        return None
+    for k in ("string", "int", "bool", "version"):
+        if k in a:
+            return a[k]
+    return None
+
+
+def _matches(dev: Dict, selectors: List[Dict]) -> bool:
+    for sel in selectors or []:
+        v = _attr_value(dev, sel.get("attribute", ""))
+        if "equals" in sel and v != sel["equals"]:
+            return False
+        if "greaterThan" in sel and not (isinstance(v, int) and v > sel["greaterThan"]):
+            return False
+        if "in" in sel and v not in sel["in"]:
+            return False
+    return True
+
+
+def _counter_usage(slices: List[Dict], allocated: List[Tuple[str, str]]
+                   ) -> Dict[Tuple[str, str], int]:
+    """(counterSet, counter) -> already-consumed amount, over the devices in
+    ``allocated`` [(pool, device-name)]."""
+    device_index: Dict[Tuple[str, str], Dict] = {}
+    for s in slices:
+        pool = s["spec"]["pool"]["name"]
+        for d in s["spec"].get("devices") or []:
+            device_index[(pool, d["name"])] = d
+    usage: Dict[Tuple[str, str], int] = {}
+    for key in allocated:
+        dev = device_index.get(key)
+        if not dev:
+            continue
+        for cc in dev.get("consumesCounters") or []:
+            cs = cc["counterSet"]
+            for cname, cval in (cc.get("counters") or {}).items():
+                usage[(cs, cname)] = usage.get((cs, cname), 0) + int(cval["value"])
+    return usage
+
+
+def _counter_capacity(slices: List[Dict]) -> Dict[Tuple[str, str], int]:
+    cap: Dict[Tuple[str, str], int] = {}
+    for s in slices:
+        for cs in s["spec"].get("sharedCounters") or []:
+            for cname, cval in (cs.get("counters") or {}).items():
+                cap[(cs["name"], cname)] = int(cval["value"])
+    return cap
+
+
+class Allocator:
+    """Allocates pending ResourceClaims against the slices in the cluster."""
+
+    def __init__(self, clients: ClientSets, driver_name: str = "tpu.google.com"):
+        self._clients = clients
+        self._driver = driver_name
+
+    def _allocated_devices(self) -> List[Tuple[str, str]]:
+        out = []
+        for c in self._clients.resource_claims.list():
+            alloc = ((c.get("status") or {}).get("allocation") or {})
+            for r in (alloc.get("devices") or {}).get("results") or []:
+                if r.get("driver") == self._driver and not r.get("adminAccess"):
+                    out.append((r.get("pool", ""), r.get("device", "")))
+        return out
+
+    def allocate(self, claim_name: str, namespace: str,
+                 node_name: Optional[str] = None) -> Dict:
+        """Allocate one claim in place (writes status.allocation) and return
+        the updated claim. Raises AllocationError if unsatisfiable."""
+        claim = self._clients.resource_claims.get(claim_name, namespace)
+        if (claim.get("status") or {}).get("allocation"):
+            return claim  # already allocated
+
+        slices = [s for s in self._clients.resource_slices.list()
+                  if s["spec"].get("driver") == self._driver
+                  and (node_name is None or s["spec"].get("nodeName") == node_name)]
+        if not slices:
+            raise AllocationError(f"no ResourceSlices published by {self._driver}")
+
+        capacity = _counter_capacity(slices)
+        allocated = self._allocated_devices()
+        usage = _counter_usage(slices, allocated)
+        taken = set(allocated)
+
+        results = []
+        for req in ((claim.get("spec") or {}).get("devices") or {}).get("requests") or []:
+            rname = req.get("name", "device")
+            count = req.get("count", 1)
+            selectors = req.get("selectors") or []
+            admin = bool(req.get("adminAccess", False))
+            picked = 0
+            for s in slices:
+                pool = s["spec"]["pool"]["name"]
+                node = s["spec"].get("nodeName", "")
+                for dev in s["spec"].get("devices") or []:
+                    if picked >= count:
+                        break
+                    key = (pool, dev["name"])
+                    if not admin and key in taken:
+                        continue
+                    if not _matches(dev, selectors):
+                        continue
+                    if not admin and not self._counters_fit(dev, capacity, usage):
+                        continue
+                    # commit
+                    if not admin:
+                        taken.add(key)
+                        self._consume(dev, usage)
+                    results.append({
+                        "request": rname, "driver": self._driver,
+                        "pool": pool, "device": dev["name"],
+                        "nodeName": node,
+                        **({"adminAccess": True} if admin else {}),
+                    })
+                    picked += 1
+            if picked < count:
+                raise AllocationError(
+                    f"request {rname!r}: only {picked}/{count} devices "
+                    f"available matching selectors"
+                )
+
+        node = results[0].get("nodeName", "") if results else ""
+        configs = []
+        for req_cfg in ((claim.get("spec") or {}).get("devices") or {}).get("config") or []:
+            configs.append({**req_cfg, "source": "FromClaim"})
+        claim.setdefault("status", {})["allocation"] = {
+            "devices": {"results": results, "config": configs},
+            "nodeSelector": {"kubernetes.io/hostname": node} if node else None,
+        }
+        return self._clients.resource_claims.update(claim)
+
+    @staticmethod
+    def _counters_fit(dev: Dict, capacity: Dict, usage: Dict) -> bool:
+        for cc in dev.get("consumesCounters") or []:
+            cs = cc["counterSet"]
+            for cname, cval in (cc.get("counters") or {}).items():
+                cap = capacity.get((cs, cname))
+                if cap is None:
+                    return False
+                if usage.get((cs, cname), 0) + int(cval["value"]) > cap:
+                    return False
+        return True
+
+    @staticmethod
+    def _consume(dev: Dict, usage: Dict) -> None:
+        for cc in dev.get("consumesCounters") or []:
+            cs = cc["counterSet"]
+            for cname, cval in (cc.get("counters") or {}).items():
+                usage[(cs, cname)] = usage.get((cs, cname), 0) + int(cval["value"])

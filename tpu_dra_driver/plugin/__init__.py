@@ -1,0 +1,7 @@
+"""plugin — the tpu-kubelet-plugin (reference analog: cmd/gpu-kubelet-plugin).
+
+Per-node DRA plugin: enumerates TPU chips / dynamic sub-slices / vfio
+devices, publishes ResourceSlices (incl. KEP-4815 partitionable devices
+with shared counters), and serves Prepare/Unprepare with a crash-safe
+checkpointed two-phase state machine and TPU-native CDI generation.
+"""

@@ -1,0 +1,188 @@
+"""The allocatable-device model: what this node can offer the scheduler.
+
+Reference analog: cmd/gpu-kubelet-plugin/{allocatable.go:39-44,
+deviceinfo.go:113-241, mig.go:98-131} — ``AllocatableDevice`` is a tagged
+union (Gpu | MigDynamic | MigStatic | Vfio) keyed by canonical name. Here:
+
+- ``CHIP``      — a whole TPU chip (``tpu-<index>``),
+- ``SUBSLICE``  — an *abstract* dynamically-creatable sub-slice
+  (``tpu-<index>-ss-<profile>-<start>``): advertised always, created only
+  when a claim lands (the DynamicMIG model),
+- ``VFIO``      — a chip offered for passthrough (``tpu-vfio-<index>``).
+
+Each device renders to a DRA device entry with typed attributes, capacity,
+and (for KEP-4815 layouts) counter consumption against its chip's
+CounterSet.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Dict, List, Optional
+
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.tpulib.interface import ChipInfo, TpuLib
+from tpu_dra_driver.tpulib.partition import (
+    SubsliceProfile,
+    canonical_chip_name,
+    canonical_subslice_name,
+    canonical_vfio_name,
+    profiles_for,
+)
+
+
+class DeviceType(Enum):
+    CHIP = "chip"
+    SUBSLICE = "subslice"
+    VFIO = "vfio"
+
+
+@dataclass(frozen=True)
+class AllocatableDevice:
+    type: DeviceType
+    chip: ChipInfo
+    profile: Optional[SubsliceProfile] = None    # SUBSLICE only
+    placement_start: int = 0                     # SUBSLICE only
+
+    @property
+    def canonical_name(self) -> str:
+        if self.type == DeviceType.CHIP:
+            return canonical_chip_name(self.chip.index)
+        if self.type == DeviceType.SUBSLICE:
+            assert self.profile is not None
+            return canonical_subslice_name(self.chip.index, self.profile,
+                                           self.placement_start)
+        return canonical_vfio_name(self.chip.index)
+
+    # -- DRA rendering ------------------------------------------------------
+
+    def attributes(self) -> Dict[str, Dict]:
+        """Typed DRA attributes (reference deviceinfo.go:159-241 publishes
+        type/uuid/productName/architecture/pciBusID/pcieRoot/driverVersion;
+        TPU adds torus coords + slice identity, which is what topology-aware
+        scheduling selects on)."""
+        c = self.chip
+        attrs: Dict[str, Dict] = {
+            "type": {"string": self.type.value},
+            "uuid": {"string": c.uuid},
+            "productName": {"string": c.product_name},
+            "generation": {"string": c.generation.name},
+            "pciBusID": {"string": c.pci_address},
+            "pcieRoot": {"string": c.pci_root},
+            "driverVersion": {"version": _semverish(c.driver_version)},
+            "firmwareVersion": {"string": c.firmware_version},
+            "sliceID": {"string": c.slice_id},
+            "hostIndex": {"int": c.host_index},
+            "iciBandwidthGbps": {"int": c.generation.ici_bandwidth_gbps},
+        }
+        for dim, val in zip(("coordX", "coordY", "coordZ"), c.coords):
+            attrs[dim] = {"int": val}
+        if self.type == DeviceType.SUBSLICE:
+            assert self.profile is not None
+            attrs["profile"] = {"string": self.profile.id}
+            attrs["placementStart"] = {"int": self.placement_start}
+        if self.type == DeviceType.VFIO:
+            attrs["vfio"] = {"bool": True}
+        return attrs
+
+    def capacity(self) -> Dict[str, Dict]:
+        if self.type == DeviceType.SUBSLICE:
+            assert self.profile is not None
+            cores = self.profile.cores
+            hbm = self.profile.hbm_bytes
+        else:
+            cores = self.chip.cores
+            hbm = self.chip.hbm_bytes
+        return {
+            "tensorcores": {"value": str(cores)},
+            "hbm": {"value": str(hbm)},
+        }
+
+    def counter_consumption(self) -> Dict[str, Dict]:
+        """KEP-4815: counters this device consumes from its chip's
+        CounterSet. The full chip consumes *everything*, a sub-slice its
+        cores + per-core memory slices — making chip and overlapping
+        sub-slice allocations mutually exclusive for the scheduler
+        (reference partitions.go:27-215)."""
+        if self.type == DeviceType.SUBSLICE:
+            assert self.profile is not None
+            cores = self.profile.cores
+            hbm = self.profile.hbm_bytes
+            slices = range(self.placement_start, self.placement_start + cores)
+        else:
+            cores = self.chip.cores
+            hbm = self.chip.hbm_bytes
+            slices = range(self.chip.cores)
+        counters = {
+            "tensorcores": {"value": str(cores)},
+            "hbm": {"value": str(hbm)},
+        }
+        for s in slices:
+            counters[f"memory-slice-{s}"] = {"value": "1"}
+        return counters
+
+    def counter_set_name(self) -> str:
+        return chip_counter_set_name(self.chip.index)
+
+
+def chip_counter_set_name(chip_index: int) -> str:
+    return f"tpu-{chip_index}-counter-set"
+
+
+def chip_counter_set(chip: ChipInfo) -> Dict:
+    """The shared CounterSet for one chip (reference partitions.go: one
+    CounterSet per GPU with capacity counters + one memory-slice counter
+    per slice)."""
+    counters: Dict[str, Dict] = {
+        "tensorcores": {"value": str(chip.cores)},
+        "hbm": {"value": str(chip.hbm_bytes)},
+    }
+    for s in range(chip.cores):
+        counters[f"memory-slice-{s}"] = {"value": "1"}
+    return {"name": chip_counter_set_name(chip.index), "counters": counters}
+
+
+def enumerate_allocatable(lib: TpuLib, gates: fg.FeatureGates
+                          ) -> Dict[str, AllocatableDevice]:
+    """Build the full allocatable-device map for this node.
+
+    Reference analog: nvlib.go:170-310 (enumerateAllPossibleDevices).
+    Chips currently bound to vfio are advertised *only* as VFIO devices
+    (their runtime-driver device node is gone); with Passthrough enabled,
+    unbound chips are advertised both ways and the scheduler's counter
+    model keeps them mutually exclusive.
+    """
+    out: Dict[str, AllocatableDevice] = {}
+    passthrough = gates.enabled(fg.PASSTHROUGH_SUPPORT)
+    dynamic = gates.enabled(fg.DYNAMIC_SUBSLICE)
+    for chip in lib.enumerate_chips():
+        if chip.vfio_group is not None:
+            # already flipped to vfio: only the passthrough personality
+            dev = AllocatableDevice(DeviceType.VFIO, chip)
+            out[dev.canonical_name] = dev
+            continue
+        dev = AllocatableDevice(DeviceType.CHIP, chip)
+        out[dev.canonical_name] = dev
+        if dynamic:
+            for prof in profiles_for(chip.generation):
+                if prof.cores == chip.generation.cores_per_chip:
+                    continue  # full-chip profile == the chip device itself
+                for start in prof.placements():
+                    ss = AllocatableDevice(DeviceType.SUBSLICE, chip,
+                                           profile=prof, placement_start=start)
+                    out[ss.canonical_name] = ss
+        if passthrough:
+            vf = AllocatableDevice(DeviceType.VFIO, chip)
+            out[vf.canonical_name] = vf
+    return out
+
+
+def _semverish(v: str) -> str:
+    """Extract a semver-ish token for the 'version' typed attribute."""
+    for tok in v.split():
+        if tok and tok[0].isdigit():
+            parts = (tok.split(".") + ["0", "0"])[:3]
+            if all(p.split("-")[0].isdigit() for p in parts[:2]):
+                return ".".join(parts)
+    return "0.0.0"

@@ -1,0 +1,201 @@
+"""Versioned, checksummed claim checkpoints with V1/V2 dual-write.
+
+Reference analog: cmd/gpu-kubelet-plugin/{checkpoint.go:26-138,
+checkpointv.go:25-98} — a kubelet-checkpointmanager JSON checkpoint with
+checksums, written in both a legacy V1 and current V2 layout so upgrades
+and *downgrades* both find a readable file (exercised by the reference's
+up/downgrade bats tests).
+
+Layout here: one JSON file ``checkpoint.json`` containing both versions::
+
+    {
+      "v1": {"claims": {...}},          # legacy: flat prepared-devices list
+      "v2": {"claims": {...}},          # current: adds per-claim state machine
+      "checksums": {"v1": <crc32>, "v2": <crc32>}
+    }
+
+Readers prefer V2 and fall back to V1 (nonstrict: unknown fields in a
+newer writer's V2 are ignored on the V1 path). Writes are atomic
+(tmp+rename+fsync). Checksum mismatch → checkpoint corruption error, the
+caller treats the file as absent-but-alarming (it refuses to guess).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# Claim prepare states (reference device_state.go:231-283)
+PREPARE_STARTED = "PrepareStarted"
+PREPARE_COMPLETED = "PrepareCompleted"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    pass
+
+
+@dataclass
+class PreparedDevice:
+    """One prepared device recorded in the checkpoint.
+
+    ``canonical_name`` alone must be enough to recover teardown identity
+    after a crash (the MigSpecTuple-from-name contract, SURVEY.md §2.3).
+    """
+
+    canonical_name: str
+    request: str                     # DRA request name this satisfied
+    cdi_device_ids: List[str] = field(default_factory=list)
+    device_type: str = "chip"        # chip | subslice | vfio | channel | daemon
+    live_uuid: str = ""              # live sub-slice uuid (informational)
+    devfs_path: str = ""
+
+    def to_obj(self) -> Dict:
+        return {
+            "canonicalName": self.canonical_name,
+            "request": self.request,
+            "cdiDeviceIDs": list(self.cdi_device_ids),
+            "deviceType": self.device_type,
+            "liveUUID": self.live_uuid,
+            "devfsPath": self.devfs_path,
+        }
+
+    @staticmethod
+    def from_obj(d: Dict) -> "PreparedDevice":
+        return PreparedDevice(
+            canonical_name=d.get("canonicalName", ""),
+            request=d.get("request", ""),
+            cdi_device_ids=list(d.get("cdiDeviceIDs") or []),
+            device_type=d.get("deviceType", "chip"),
+            live_uuid=d.get("liveUUID", ""),
+            devfs_path=d.get("devfsPath", ""),
+        )
+
+
+@dataclass
+class ClaimEntry:
+    claim_uid: str
+    claim_name: str = ""
+    namespace: str = ""
+    state: str = PREPARE_STARTED
+    prepared_devices: List[PreparedDevice] = field(default_factory=list)
+
+    def to_obj(self) -> Dict:
+        return {
+            "claimUID": self.claim_uid,
+            "claimName": self.claim_name,
+            "namespace": self.namespace,
+            "state": self.state,
+            "preparedDevices": [d.to_obj() for d in self.prepared_devices],
+        }
+
+    @staticmethod
+    def from_obj(d: Dict) -> "ClaimEntry":
+        return ClaimEntry(
+            claim_uid=d.get("claimUID", ""),
+            claim_name=d.get("claimName", ""),
+            namespace=d.get("namespace", ""),
+            state=d.get("state", PREPARE_STARTED),
+            prepared_devices=[PreparedDevice.from_obj(x)
+                              for x in d.get("preparedDevices") or []],
+        )
+
+
+@dataclass
+class Checkpoint:
+    claims: Dict[str, ClaimEntry] = field(default_factory=dict)  # by claim UID
+
+    def deepcopy(self) -> "Checkpoint":
+        return Checkpoint(claims={k: copy.deepcopy(v) for k, v in self.claims.items()})
+
+    # -- queries used by the overlap guard ---------------------------------
+
+    def prepared_device_owners(self) -> Dict[str, str]:
+        """canonical device name -> owning claim UID, for claims in
+        PrepareCompleted (the overlap guard, device_state.go:1116-1154)."""
+        out: Dict[str, str] = {}
+        for uid, entry in self.claims.items():
+            if entry.state != PREPARE_COMPLETED:
+                continue
+            for dev in entry.prepared_devices:
+                out[dev.canonical_name] = uid
+        return out
+
+
+def _crc(payload) -> int:
+    return zlib.crc32(json.dumps(payload, sort_keys=True).encode())
+
+
+class CheckpointManager:
+    """Owns the checkpoint file. Callers serialize via the cp flock held by
+    DeviceState; this class only does (de)serialization + atomicity."""
+
+    FILENAME = "checkpoint.json"
+
+    def __init__(self, state_dir: str):
+        self._path = os.path.join(state_dir, self.FILENAME)
+        os.makedirs(state_dir, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def ensure_exists(self) -> None:
+        if not os.path.exists(self._path):
+            self.write(Checkpoint())
+
+    def read(self) -> Checkpoint:
+        try:
+            with open(self._path) as f:
+                raw = json.load(f)
+        except FileNotFoundError:
+            return Checkpoint()
+        except json.JSONDecodeError as e:
+            raise CheckpointCorruptionError(f"{self._path}: invalid JSON: {e}") from e
+        checksums = raw.get("checksums") or {}
+        for version in ("v2", "v1"):
+            payload = raw.get(version)
+            if payload is None:
+                continue
+            if _crc(payload) != checksums.get(version):
+                raise CheckpointCorruptionError(
+                    f"{self._path}: {version} checksum mismatch"
+                )
+            claims = {}
+            for uid, e in (payload.get("claims") or {}).items():
+                entry = ClaimEntry.from_obj(e)
+                if version == "v1" and "state" not in e:
+                    # legacy layout records only completed claims
+                    entry.state = PREPARE_COMPLETED
+                claims[uid] = entry
+            return Checkpoint(claims=claims)
+        return Checkpoint()
+
+    def write(self, cp: Checkpoint) -> None:
+        v2 = {"claims": {uid: e.to_obj() for uid, e in cp.claims.items()}}
+        # V1 (legacy layout): no state machine — only *completed* claims
+        # with their device names, the shape a pre-state-machine downgrade
+        # reader expects (in-flight PrepareStarted entries are deliberately
+        # absent: the legacy reader would have no rollback logic for them).
+        v1 = {
+            "claims": {
+                uid: {
+                    "claimUID": e.claim_uid,
+                    "claimName": e.claim_name,
+                    "namespace": e.namespace,
+                    "preparedDevices": [d.to_obj() for d in e.prepared_devices],
+                }
+                for uid, e in cp.claims.items()
+                if e.state == PREPARE_COMPLETED
+            }
+        }
+        raw = {"v1": v1, "v2": v2, "checksums": {"v1": _crc(v1), "v2": _crc(v2)}}
+        tmp = f"{self._path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(raw, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)

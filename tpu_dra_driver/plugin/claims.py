@@ -1,0 +1,151 @@
+"""DRA ResourceClaim helpers: allocation results and opaque-config resolution.
+
+Reference analog: cmd/gpu-kubelet-plugin/device_state.go:1019-1072
+(GetOpaqueDeviceConfigs) and types.go:48-70 (canonical claim strings).
+
+A ResourceClaim (dict form, resource.k8s.io shape) carries, once allocated::
+
+    status.allocation.devices.results[]: {request, driver, pool, device}
+    status.allocation.devices.config[]:  {source: "FromClass"|"FromClaim",
+                                          requests: [...],
+                                          opaque: {driver, parameters}}
+
+Config precedence: class configs apply first, claim configs override them
+(the reference appends class configs, then claim configs, and the *last*
+matching config for a result wins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.api.decoder import Decoder, DecodeError
+
+SOURCE_CLASS = "FromClass"
+SOURCE_CLAIM = "FromClaim"
+
+
+@dataclass(frozen=True)
+class AllocationResult:
+    request: str
+    driver: str
+    pool: str
+    device: str            # canonical device name
+    admin_access: bool = False
+
+
+@dataclass
+class ClaimInfo:
+    uid: str
+    name: str
+    namespace: str
+    results: List[AllocationResult] = field(default_factory=list)
+    configs: List[Dict] = field(default_factory=list)  # raw allocation configs
+
+    @property
+    def canonical(self) -> str:
+        """``ns/name:uid`` — the canonical claim string used in every log
+        line and error (reference types.go:48-70)."""
+        return f"{self.namespace}/{self.name}:{self.uid}"
+
+    @staticmethod
+    def from_obj(obj: Dict, driver_name: str = DRIVER_NAME) -> "ClaimInfo":
+        meta = obj.get("metadata") or {}
+        alloc = ((obj.get("status") or {}).get("allocation") or {})
+        devices = alloc.get("devices") or {}
+        results = []
+        for r in devices.get("results") or []:
+            if r.get("driver") != driver_name:
+                continue
+            results.append(AllocationResult(
+                request=r.get("request", ""),
+                driver=r.get("driver", ""),
+                pool=r.get("pool", ""),
+                device=r.get("device", ""),
+                admin_access=bool(r.get("adminAccess", False)),
+            ))
+        return ClaimInfo(
+            uid=meta.get("uid", ""),
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", ""),
+            results=results,
+            configs=list(devices.get("config") or []),
+        )
+
+
+@dataclass
+class ResolvedConfig:
+    """An opaque config resolved for a specific set of requests."""
+
+    source: str
+    requests: List[str]
+    config: object  # decoded api config object
+
+
+def resolve_opaque_configs(claim: ClaimInfo, decoder: Decoder,
+                           driver_name: str = DRIVER_NAME) -> List[ResolvedConfig]:
+    """Decode + order opaque configs: FromClass first, FromClaim second, so
+    later (claim-level) configs override class defaults when both match a
+    request (reference device_state.go:1019-1072)."""
+    ordered = (
+        [c for c in claim.configs if c.get("source") == SOURCE_CLASS]
+        + [c for c in claim.configs if c.get("source") == SOURCE_CLAIM]
+    )
+    out: List[ResolvedConfig] = []
+    for c in ordered:
+        opaque = c.get("opaque")
+        if not opaque or opaque.get("driver") != driver_name:
+            continue
+        params = opaque.get("parameters")
+        if params is None:
+            raise DecodeError("opaque config missing parameters")
+        cfg = decoder.decode(params)
+        cfg.normalize()
+        cfg.validate()
+        out.append(ResolvedConfig(
+            source=c.get("source", ""),
+            requests=list(c.get("requests") or []),
+            config=cfg,
+        ))
+    return out
+
+
+def config_for_result(configs: List[ResolvedConfig],
+                      result: AllocationResult) -> Optional[ResolvedConfig]:
+    """The effective config for one allocation result: the *last* config
+    whose request list matches (or is empty = matches all)."""
+    chosen: Optional[ResolvedConfig] = None
+    for rc in configs:
+        if not rc.requests or result.request in rc.requests:
+            chosen = rc
+    return chosen
+
+
+def build_allocated_claim(uid: str, name: str, namespace: str,
+                          device_names: List[str], node: str,
+                          configs: Optional[List[Dict]] = None,
+                          driver_name: str = DRIVER_NAME,
+                          request: str = "tpu") -> Dict:
+    """Test/demo helper: fabricate an allocated ResourceClaim dict the way
+    the scheduler would after satisfying a request against our slices."""
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "spec": {"devices": {"requests": [{"name": request}]}},
+        "status": {
+            "allocation": {
+                "devices": {
+                    "results": [
+                        {"request": request, "driver": driver_name,
+                         "pool": node, "device": d}
+                        for d in device_names
+                    ],
+                    "config": configs or [],
+                },
+                "nodeSelector": {"kubernetes.io/hostname": node},
+            }
+        },
+    }

@@ -1,0 +1,70 @@
+"""Periodic checkpoint cleanup of orphaned claims.
+
+Reference analog: cmd/gpu-kubelet-plugin/cleanup.go:34-282
+(CheckpointCleanupManager): every 10 minutes, scan checkpointed claims and
+unprepare any whose ResourceClaim no longer exists in the API server — or
+exists with a *different UID* (deleted and recreated under the same name).
+This is the third prong of crash recovery: kubelet never calls Unprepare
+for a claim it never successfully finished preparing.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.plugin.device_state import DeviceState
+
+log = logging.getLogger(__name__)
+
+DEFAULT_INTERVAL = 600.0  # 10 min, matching the reference
+
+
+class CheckpointCleanupManager:
+    def __init__(self, state: DeviceState, claims_client: ResourceClient,
+                 interval: float = DEFAULT_INTERVAL):
+        self._state = state
+        self._claims = claims_client
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="checkpoint-cleanup")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.sweep_once()
+            except Exception:
+                log.exception("checkpoint cleanup sweep failed")
+
+    def sweep_once(self) -> list[str]:
+        """Unprepare checkpointed claims whose ResourceClaim is gone or has
+        a changed UID. Returns the claim UIDs cleaned up."""
+        cleaned = []
+        cp = self._state.get_checkpoint()
+        for uid, entry in list(cp.claims.items()):
+            stale = False
+            try:
+                obj = self._claims.get(entry.claim_name, entry.namespace)
+                if (obj.get("metadata") or {}).get("uid") != uid:
+                    stale = True  # same name, different incarnation
+            except NotFoundError:
+                stale = True
+            if stale:
+                log.warning("cleanup: unpreparing stale claim %s/%s:%s",
+                            entry.namespace, entry.claim_name, uid)
+                self._state.unprepare(uid)
+                cleaned.append(uid)
+        return cleaned

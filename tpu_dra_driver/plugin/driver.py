@@ -1,0 +1,205 @@
+"""The tpu-kubelet-plugin driver: startup, publish, Prepare/Unprepare.
+
+Reference analog: cmd/gpu-kubelet-plugin/driver.go — startup order
+(driver.go:66-173), node-global prepare/unprepare flock (``pu.lock``, 10 s
+timeout, driver.go:341), per-claim prepare with timing breadcrumbs
+(driver.go:334-386), health-event → republish-without-device
+(driver.go:441-505), and the gRPC healthcheck self-probe (health.go).
+
+The kubelet-facing transport (DRA plugin gRPC on ``dra.sock``) is provided
+by :mod:`tpu_dra_driver.plugin.grpc_server`; this class is the
+transport-independent core so tests and the e2e harness drive it directly
+(the kubeletplugin.Helper seam).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.cdi.generator import CdiHandler, DEFAULT_CDI_ROOT
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg.flock import Flock, FlockOptions, FlockTimeoutError
+from tpu_dra_driver.plugin.checkpoint import PreparedDevice
+from tpu_dra_driver.plugin.claims import ClaimInfo
+from tpu_dra_driver.plugin.cleanup import CheckpointCleanupManager
+from tpu_dra_driver.plugin.device_state import DeviceState, PermanentError
+from tpu_dra_driver.plugin.health import DeviceHealthMonitor
+from tpu_dra_driver.plugin.resourceslices import (
+    LAYOUT_COMBINED,
+    ResourceSlicePublisher,
+)
+from tpu_dra_driver.tpulib.interface import TpuLib
+
+log = logging.getLogger(__name__)
+
+PU_LOCK_TIMEOUT = 10.0  # reference driver.go:341
+
+
+@dataclass
+class PluginConfig:
+    node_name: str
+    state_dir: str                      # kubelet plugin dir
+    cdi_root: str = DEFAULT_CDI_ROOT
+    driver_root: str = "/"
+    slice_layout: str = LAYOUT_COMBINED
+    gates: fg.FeatureGates = field(default_factory=fg.FeatureGates)
+    cleanup_interval: float = 600.0
+
+
+@dataclass
+class PrepareResult:
+    devices: List[PreparedDevice] = field(default_factory=list)
+    error: Optional[str] = None
+    permanent: bool = False
+
+    @property
+    def cdi_device_ids(self) -> List[str]:
+        out: List[str] = []
+        for d in self.devices:
+            out.extend(d.cdi_device_ids)
+        return out
+
+
+class TpuKubeletPlugin:
+    def __init__(self, clients: ClientSets, lib: TpuLib, config: PluginConfig):
+        self._clients = clients
+        self._lib = lib
+        self._config = config
+        os.makedirs(config.state_dir, exist_ok=True)
+        self._pu_lock_path = os.path.join(config.state_dir, "pu.lock")
+        cdi = CdiHandler(cdi_root=config.cdi_root,
+                         driver_root=config.driver_root,
+                         driver_version=lib.driver_version())
+        self.state = DeviceState(lib, config.gates, cdi, config.state_dir)
+        self.publisher = ResourceSlicePublisher(
+            clients.resource_slices, config.node_name,
+            layout=config.slice_layout)
+        # republish after vfio driver flips so sibling personalities
+        # (chip vs vfio) are hidden/shown consistently (reference
+        # driver.go:361-368,392-397)
+        self.state.vfio.set_topology_change_callback(self._republish)
+        self.health: Optional[DeviceHealthMonitor] = None
+        if config.gates.enabled(fg.DEVICE_HEALTH_CHECK):
+            self.health = DeviceHealthMonitor(lib, self._on_unhealthy)
+        self.cleanup = CheckpointCleanupManager(
+            self.state, clients.resource_claims,
+            interval=config.cleanup_interval)
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference driver.go:66-173)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._config.gates.enabled(fg.DYNAMIC_SUBSLICE):
+            destroyed = self.state.destroy_unknown_subslices()
+            if destroyed:
+                log.warning("startup: destroyed %d unknown sub-slices: %s",
+                            len(destroyed), destroyed)
+        if self.health is not None:
+            self.health.start()
+        self.cleanup.start()
+        self._republish()
+        self._started = True
+        log.info("tpu-kubelet-plugin started on node %s (%d allocatable devices)",
+                 self._config.node_name, len(self.state.allocatable))
+
+    def shutdown(self) -> None:
+        self.cleanup.stop()
+        if self.health is not None:
+            self.health.stop()
+        self._started = False
+
+    def healthy(self) -> bool:
+        """gRPC healthcheck analog (reference health.go:121-149 self-probes
+        registration + a noop prepare): verify enumeration still answers and
+        the checkpoint file is readable."""
+        try:
+            self._lib.enumerate_chips()
+            self.state.get_checkpoint()
+            return True
+        except Exception:
+            log.exception("healthcheck failed")
+            return False
+
+    # ------------------------------------------------------------------
+    # resource publishing
+    # ------------------------------------------------------------------
+
+    def _republish(self) -> None:
+        self.state.refresh_allocatable()
+        exclude = self._excluded_devices()
+        # Counters must be emitted whenever a chip has multiple allocatable
+        # personalities — dynamic sub-slices OR the chip/vfio pair — else
+        # the scheduler could hand the same physical chip to two claims.
+        gates = self._config.gates
+        partitionable = (gates.enabled(fg.DYNAMIC_SUBSLICE)
+                         or gates.enabled(fg.PASSTHROUGH_SUPPORT))
+        self.publisher.republish(
+            self.state.allocatable, exclude=exclude,
+            partitionable=partitionable)
+
+    def _excluded_devices(self) -> Set[str]:
+        """Devices hidden from the scheduler: all personalities of unhealthy
+        chips, plus consistency rules around live vfio bindings (a bound
+        chip's runtime personality disappears; enumerate_allocatable already
+        models that, so here only health)."""
+        exclude: Set[str] = set()
+        unhealthy = self.health.unhealthy_uuids if self.health else set()
+        for name, dev in self.state.allocatable.items():
+            if dev.chip.uuid in unhealthy:
+                exclude.add(name)
+        return exclude
+
+    def _on_unhealthy(self, chip_uuid: str) -> None:
+        log.warning("republishing slices without unhealthy chip %s", chip_uuid)
+        self._republish()
+
+    # ------------------------------------------------------------------
+    # DRA entrypoints (reference driver.go:298-397)
+    # ------------------------------------------------------------------
+
+    def prepare_resource_claims(self, claims: List[Dict]) -> Dict[str, PrepareResult]:
+        out: Dict[str, PrepareResult] = {}
+        for obj in claims:
+            info = ClaimInfo.from_obj(obj)
+            out[info.uid] = self._node_prepare_resource(info)
+        return out
+
+    def _node_prepare_resource(self, claim: ClaimInfo) -> PrepareResult:
+        t0 = time.perf_counter()
+        try:
+            lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
+            with lock:
+                t_lock = time.perf_counter() - t0
+                devices = self.state.prepare(claim)
+            log.debug("prepare %s: pu-lock wait %.1fms", claim.canonical, t_lock * 1e3)
+            return PrepareResult(devices=devices)
+        except FlockTimeoutError as e:
+            return PrepareResult(error=f"prepare lock: {e}", permanent=False)
+        except PermanentError as e:
+            log.error("prepare %s failed permanently: %s", claim.canonical, e)
+            return PrepareResult(error=str(e), permanent=True)
+        except Exception as e:
+            log.exception("prepare %s failed", claim.canonical)
+            return PrepareResult(error=str(e), permanent=False)
+
+    def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[str]]:
+        out: Dict[str, Optional[str]] = {}
+        for uid in claim_uids:
+            try:
+                lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
+                with lock:
+                    self.state.unprepare(uid)
+                out[uid] = None
+            except Exception as e:
+                log.exception("unprepare %s failed", uid)
+                out[uid] = str(e)
+        return out

@@ -1,0 +1,69 @@
+"""Device health monitoring → ResourceSlice republish without the device.
+
+Reference analog: cmd/gpu-kubelet-plugin/device_health.go:30-351 — an NVML
+event monitor (XidCriticalError / ECC) with a skip-list of benign XIDs;
+an unhealthy device is removed from the published slices and never
+re-healed automatically (an admin restarts the plugin after servicing).
+
+TPU mapping: TpuLib health events. Benign-by-default kinds: thermal
+slowdowns and maintenance preemptions (transient, runtime-handled). Fatal:
+device errors and HBM ECC. ICI link errors are fatal for the *chip's*
+schedulability here; the ComputeDomain daemon separately reacts to fabric
+errors (CrashOnICIFabricErrors gate).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional, Set
+
+from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind, TpuLib
+
+log = logging.getLogger(__name__)
+
+DEFAULT_BENIGN_KINDS = frozenset({
+    HealthEventKind.THERMAL,
+    HealthEventKind.PREEMPTED,
+})
+
+
+class DeviceHealthMonitor:
+    def __init__(self, lib: TpuLib,
+                 on_unhealthy: Callable[[str], None],
+                 benign_kinds: Optional[Set[HealthEventKind]] = None):
+        self._lib = lib
+        self._on_unhealthy = on_unhealthy
+        self._benign = DEFAULT_BENIGN_KINDS if benign_kinds is None else frozenset(benign_kinds)
+        self._mu = threading.Lock()
+        self._unhealthy: Set[str] = set()  # chip uuids
+        self._unsub: Optional[Callable[[], None]] = None
+
+    def start(self) -> None:
+        self._unsub = self._lib.subscribe_health(self._handle)
+
+    def stop(self) -> None:
+        if self._unsub:
+            self._unsub()
+            self._unsub = None
+
+    @property
+    def unhealthy_uuids(self) -> Set[str]:
+        with self._mu:
+            return set(self._unhealthy)
+
+    def _handle(self, event: HealthEvent) -> None:
+        if event.kind in self._benign:
+            log.info("ignoring benign health event %s on %s (code %d)",
+                     event.kind.value, event.chip_uuid, event.code)
+            return
+        with self._mu:
+            if event.chip_uuid in self._unhealthy:
+                return
+            self._unhealthy.add(event.chip_uuid)
+        log.error("chip %s marked unhealthy: %s code=%d %s",
+                  event.chip_uuid, event.kind.value, event.code, event.message)
+        try:
+            self._on_unhealthy(event.chip_uuid)
+        except Exception:
+            log.exception("unhealthy-device callback failed for %s", event.chip_uuid)

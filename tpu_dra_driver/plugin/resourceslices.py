@@ -1,0 +1,147 @@
+"""ResourceSlice generation, including KEP-4815 partitionable layouts.
+
+Reference analog: cmd/gpu-kubelet-plugin/driver.go:177-268,507-540 — the
+driver publishes its allocatable devices as ResourceSlices in one of two
+layouts depending on the API server's KEP-4815 maturity:
+
+- **combined** (k8s 1.34): a single slice carrying both the SharedCounters
+  and every device;
+- **split** (k8s ≥1.35): one slice holding only the SharedCounters, plus
+  one slice per chip holding that chip's devices (keeps slice churn local
+  to a chip when health events hide devices).
+
+Slices live in a per-node pool named after the node; the pool generation
+bumps on every republish so the scheduler discards stale slices.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.plugin.allocatable import (
+    AllocatableDevice,
+    DeviceType,
+    chip_counter_set,
+)
+
+LAYOUT_COMBINED = "combined"
+LAYOUT_SPLIT = "split"
+
+
+def _device_entry(dev: AllocatableDevice, with_counters: bool) -> Dict:
+    entry: Dict = {
+        "name": dev.canonical_name,
+        "attributes": dev.attributes(),
+        "capacity": dev.capacity(),
+    }
+    if with_counters:
+        entry["consumesCounters"] = [{
+            "counterSet": dev.counter_set_name(),
+            "counters": dev.counter_consumption(),
+        }]
+    return entry
+
+
+def build_resource_slices(node_name: str,
+                          devices: Dict[str, AllocatableDevice],
+                          layout: str = LAYOUT_COMBINED,
+                          generation: int = 1,
+                          exclude: Optional[Set[str]] = None,
+                          partitionable: bool = True) -> List[Dict]:
+    """Render slices for the given allocatable devices.
+
+    ``exclude`` removes devices (unhealthy, or hidden vfio siblings) without
+    touching the rest. Counter sets are emitted only when ``partitionable``
+    (i.e. DynamicSubslice active) — whole-chip-only inventories don't need
+    the counter machinery.
+    """
+    exclude = exclude or set()
+    visible = {n: d for n, d in devices.items() if n not in exclude}
+    chips = sorted({d.chip.index: d.chip for d in visible.values()}.items())
+    counter_sets = [chip_counter_set(chip) for _, chip in chips] if partitionable else []
+
+    def slice_obj(name: str, devs: List[Dict], shared: List[Dict],
+                  count: int) -> Dict:
+        spec: Dict = {
+            "driver": DRIVER_NAME,
+            "nodeName": node_name,
+            "pool": {
+                "name": node_name,
+                "generation": generation,
+                "resourceSliceCount": count,
+            },
+            "devices": devs,
+        }
+        if shared:
+            spec["sharedCounters"] = shared
+        return {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": name},
+            "spec": spec,
+        }
+
+    ordered = [visible[k] for k in sorted(visible)]
+    if layout == LAYOUT_COMBINED or not partitionable:
+        return [slice_obj(
+            f"{node_name}-{DRIVER_NAME}",
+            [_device_entry(d, partitionable) for d in ordered],
+            counter_sets, 1,
+        )]
+
+    # split layout: counters slice + one device slice per chip
+    out = []
+    count = 1 + len(chips)
+    out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-counters", [],
+                         counter_sets, count))
+    for chip_idx, _ in chips:
+        devs = [_device_entry(d, True) for d in ordered if d.chip.index == chip_idx]
+        out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-chip{chip_idx}",
+                             devs, [], count))
+    return out
+
+
+class ResourceSlicePublisher:
+    """Owns this node's slice pool in the API server: republish() diffs the
+    desired set against what exists (create/update/delete by name) under a
+    bumped pool generation — the kubeletplugin.PublishResources analog."""
+
+    def __init__(self, client: ResourceClient, node_name: str,
+                 layout: str = LAYOUT_COMBINED):
+        self._client = client
+        self._node = node_name
+        self._layout = layout
+        self._mu = threading.Lock()
+        self._generation = 0
+
+    def republish(self, devices: Dict[str, AllocatableDevice],
+                  exclude: Optional[Set[str]] = None,
+                  partitionable: bool = True) -> List[Dict]:
+        with self._mu:
+            self._generation += 1
+            desired = build_resource_slices(
+                self._node, devices, layout=self._layout,
+                generation=self._generation, exclude=exclude,
+                partitionable=partitionable,
+            )
+            existing = {
+                o["metadata"]["name"]: o
+                for o in self._client.list()
+                if o["spec"].get("nodeName") == self._node
+                and o["spec"].get("driver") == DRIVER_NAME
+            }
+            for obj in desired:
+                name = obj["metadata"]["name"]
+                if name in existing:
+                    cur = existing.pop(name)
+                    cur["spec"] = obj["spec"]
+                    self._client.update(cur)
+                else:
+                    self._client.create(obj)
+            for leftover in existing:
+                self._client.delete_ignore_missing(leftover)
+            return desired

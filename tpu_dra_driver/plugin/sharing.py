@@ -1,0 +1,76 @@
+"""Chip-sharing managers: time-slicing and multi-process.
+
+Reference analog: cmd/gpu-kubelet-plugin/sharing.go — TimeSlicingManager
+(nvidia-smi compute-policy per GPU) and MpsManager (a per-claim MPS
+control-daemon Deployment with shm/pipe/log host dirs).
+
+TPU design departure (SURVEY.md §7.6): TPUs need **no control daemon** for
+multi-process sharing — libtpu multiplexes clients itself when the right
+env is present. So MultiProcessManager is pure CDI env injection:
+
+- ``TPU_MULTI_PROCESS=1`` + per-client HBM ceiling
+  (``TPU_HBM_LIMIT_PERCENT``, enforced by the runtime allocator) +
+  ``TPU_MAX_CLIENTS``;
+- the chip is flipped to non-exclusive mode via the device library.
+
+TimeSlicingManager maps the interval enum onto the runtime scheduler knob
+through the TpuLib seam (the ``nvidia-smi --set-timeslice`` analog).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from tpu_dra_driver.api.configs import MultiProcessConfig, TimeSlicingConfig
+from tpu_dra_driver.cdi.generator import ContainerEdits
+from tpu_dra_driver.tpulib.interface import TimesliceInterval, TpuLib
+
+
+class TimeSlicingManager:
+    def __init__(self, lib: TpuLib):
+        self._lib = lib
+        self._mu = threading.Lock()
+
+    def apply(self, chip_uuids: List[str], cfg: TimeSlicingConfig) -> ContainerEdits:
+        interval = TimesliceInterval(cfg.interval)
+        with self._mu:
+            for uuid in chip_uuids:
+                # time-slicing needs shared (non-exclusive) scheduling
+                self._lib.set_exclusive_mode(uuid, False)
+                self._lib.set_timeslice(uuid, interval)
+        return ContainerEdits(env={
+            "TPU_TIMESLICE_INTERVAL": cfg.interval,
+        })
+
+    def reset(self, chip_uuids: List[str]) -> None:
+        """Restore the default interval on unprepare so sharing settings
+        cannot leak into the next claim on the same chip."""
+        with self._mu:
+            for uuid in chip_uuids:
+                self._lib.set_timeslice(uuid, TimesliceInterval.DEFAULT)
+
+
+class MultiProcessManager:
+    def __init__(self, lib: TpuLib):
+        self._lib = lib
+        self._mu = threading.Lock()
+
+    def apply(self, chip_uuids: List[str], cfg: MultiProcessConfig) -> ContainerEdits:
+        with self._mu:
+            for uuid in chip_uuids:
+                self._lib.set_exclusive_mode(uuid, False)
+        env: Dict[str, str] = {
+            "TPU_MULTI_PROCESS": "1",
+            "TPU_MAX_CLIENTS": str(cfg.max_clients),
+        }
+        if cfg.hbm_limit_percent is not None:
+            env["TPU_HBM_LIMIT_PERCENT"] = str(cfg.hbm_limit_percent)
+        return ContainerEdits(env=env)
+
+    def release(self, chip_uuids: List[str]) -> None:
+        """Restore exclusive mode on unprepare (the reference's MPS daemon
+        teardown analog; here only a mode flip)."""
+        with self._mu:
+            for uuid in chip_uuids:
+                self._lib.set_exclusive_mode(uuid, True)

@@ -1,0 +1,70 @@
+"""VFIO passthrough manager.
+
+Reference analog: cmd/gpu-kubelet-plugin/vfio-device.go:33-307 +
+scripts/bind_to_driver.sh — flip a device between the runtime driver and
+vfio-pci via sysfs driver_override, guarded by: device-not-busy check
+(fuser analog), per-chip mutex, and slice republish after each flip so
+sibling personalities (chip vs vfio) are hidden/shown consistently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from tpu_dra_driver.cdi.generator import ContainerEdits
+from tpu_dra_driver.tpulib.interface import TpuLib, TpuLibError
+
+
+class VfioBusyError(TpuLibError):
+    pass
+
+
+class VfioPciManager:
+    def __init__(self, lib: TpuLib,
+                 on_topology_change: Optional[Callable[[], None]] = None):
+        self._lib = lib
+        self._on_change = on_topology_change
+        self._locks: Dict[str, threading.Lock] = {}
+        self._mu = threading.Lock()
+
+    def set_topology_change_callback(self, cb: Callable[[], None]) -> None:
+        self._on_change = cb
+
+    def _lock_for(self, pci: str) -> threading.Lock:
+        with self._mu:
+            return self._locks.setdefault(pci, threading.Lock())
+
+    def configure(self, pci_address: str) -> str:
+        """Bind to vfio-pci; returns the vfio group path for CDI injection."""
+        with self._lock_for(pci_address):
+            if self._lib.device_in_use(pci_address):
+                raise VfioBusyError(
+                    f"device {pci_address} is in use; refusing driver flip"
+                )
+            if self._lib.current_driver(pci_address) == "vfio-pci":
+                chips = [c for c in self._lib.enumerate_chips()
+                         if c.pci_address == pci_address]
+                if chips and chips[0].vfio_group:
+                    return chips[0].vfio_group
+            group = self._lib.bind_to_vfio(pci_address)
+        if self._on_change:
+            self._on_change()
+        return group
+
+    def unconfigure(self, pci_address: str) -> None:
+        with self._lock_for(pci_address):
+            if self._lib.current_driver(pci_address) == "vfio-pci":
+                self._lib.unbind_from_vfio(pci_address)
+        if self._on_change:
+            self._on_change()
+
+    @staticmethod
+    def container_edits(group_path: str) -> ContainerEdits:
+        return ContainerEdits(
+            env={"TPU_VFIO_GROUP": group_path},
+            device_nodes=[
+                {"path": "/dev/vfio/vfio"},
+                {"path": group_path},
+            ],
+        )
