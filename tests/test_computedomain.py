@@ -1,0 +1,336 @@
+"""Tests for the ComputeDomain stack: controller reconcile/teardown, daemon
+clique membership + gap-filled indices + hosts mapping, the CD plugin's
+readiness-gated Prepare, and full multi-host rendezvous + failover.
+
+Reference analogs: the §3.3 call stack (SURVEY.md), bats
+test_cd_imex_chan_inject.bats, test_cd_misc.bats, test_cd_failover.bats.
+"""
+
+import os
+import time
+
+import pytest
+
+from tpu_dra_driver.api.types import STATUS_READY
+from tpu_dra_driver.computedomain import (
+    COMPUTE_DOMAIN_FINALIZER,
+    COMPUTE_DOMAIN_LABEL_KEY,
+    DRIVER_NAMESPACE,
+)
+from tpu_dra_driver.computedomain.daemon.clique import gap_filled_index
+from tpu_dra_driver.computedomain.daemon.dnsnames import (
+    parse_block,
+    update_hosts_file,
+    worker_name,
+)
+from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.testing.harness import ClusterHarness
+
+@pytest.fixture
+def harness(tmp_path):
+    h = ClusterHarness(str(tmp_path), accelerator_type="v5p-16",
+                       prepare_budget=10.0)
+    h.start()
+    yield h
+    h.stop()
+
+
+def _channel_claim(uid, node, domain_uid, ns="user-ns", channel="channel-0"):
+    cfgs = [{
+        "source": "FromClaim", "requests": [],
+        "opaque": {"driver": "compute-domain.tpu.google.com", "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomainChannelConfig",
+            "domainID": domain_uid,
+        }},
+    }]
+    return build_allocated_claim(
+        uid, f"wl-{uid}", ns, [channel], node, configs=cfgs,
+        driver_name="compute-domain.tpu.google.com", request="channel")
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+def test_gap_filled_index():
+    assert gap_filled_index([]) == 0
+    assert gap_filled_index([0, 1, 2]) == 3
+    assert gap_filled_index([0, 1, 3]) == 2
+    assert gap_filled_index([1, 2]) == 0
+
+
+def test_hosts_file_idempotent_block_rewrite(tmp_path):
+    path = str(tmp_path / "hosts")
+    with open(path, "w") as f:
+        f.write("127.0.0.1\tlocalhost\n")
+    assert update_hosts_file(path, {0: "10.0.0.2", 1: "10.0.1.2"})
+    assert parse_block(path) == {0: "10.0.0.2", 1: "10.0.1.2"}
+    # idempotent
+    assert not update_hosts_file(path, {0: "10.0.0.2", 1: "10.0.1.2"})
+    # peers change: block replaced, surrounding content preserved
+    assert update_hosts_file(path, {0: "10.0.0.9"})
+    content = open(path).read()
+    assert content.startswith("127.0.0.1\tlocalhost\n")
+    assert parse_block(path) == {0: "10.0.0.9"}
+    assert content.count("BEGIN tpu-dra-driver") == 1
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+def test_controller_stamps_children_and_finalizer(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "my-rct")
+    harness.wait_for(
+        lambda: harness.clients.resource_claim_templates.list(namespace="user-ns"),
+        what="workload RCT")
+    cd = harness.clients.compute_domains.get("cd1", "user-ns")
+    assert COMPUTE_DOMAIN_FINALIZER in cd["metadata"]["finalizers"]
+    uid = cd["metadata"]["uid"]
+    ds = harness.clients.daemonsets.list(namespace=DRIVER_NAMESPACE)
+    assert len(ds) == 1
+    assert ds[0]["spec"]["template"]["spec"]["nodeSelector"] == {
+        COMPUTE_DOMAIN_LABEL_KEY: uid}
+    rct = harness.clients.resource_claim_templates.get("my-rct", "user-ns")
+    params = rct["spec"]["spec"]["devices"]["config"][0]["opaque"]["parameters"]
+    assert params["domainID"] == uid
+
+
+def test_controller_rejects_oversized_domain(tmp_path):
+    h = ClusterHarness(str(tmp_path), accelerator_type="v5p-16")
+    from tpu_dra_driver.computedomain.controller.controller import ControllerConfig
+    h.controller._config = ControllerConfig(max_nodes_per_domain=2,
+                                            status_sync_interval=0.05)
+    h.start()
+    try:
+        h.create_compute_domain("big", "ns", 3, "rct")
+        time.sleep(0.4)
+        # children never stamped
+        assert not h.clients.daemonsets.list(namespace=DRIVER_NAMESPACE)
+    finally:
+        h.stop()
+
+
+# ---------------------------------------------------------------------------
+# the full rendezvous (reference §3.3) — the centerpiece test
+# ---------------------------------------------------------------------------
+
+def test_multihost_rendezvous_end_to_end(harness):
+    """Workload claims on both hosts of a v5p-16: Prepare blocks until the
+    per-node daemons rendezvous, then releases with consistent worker
+    identity env on each host."""
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    cd = harness.clients.compute_domains.get("cd1", "user-ns")
+    uid = cd["metadata"]["uid"]
+
+    # workload pods land on both nodes; kubelet calls Prepare
+    claims = {
+        0: _channel_claim("w0", "host-0", uid),
+        1: _channel_claim("w1", "host-1", uid),
+    }
+    results = {}
+    import threading
+    def run(i):
+        plugin = harness.host(i).cd_plugin
+        results[i] = plugin.prepare_resource_claims([claims[i]])[f"w{i}"]
+    threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
+    assert results[0].error is None, results[0].error
+    assert results[1].error is None, results[1].error
+
+    # CD went globally Ready
+    status = harness.cd_status("cd1", "user-ns")
+    assert status["status"] == STATUS_READY
+    assert len(status["nodes"]) == 2
+    assert {n["name"] for n in status["nodes"]} == {"host-0", "host-1"}
+    assert sorted(n["index"] for n in status["nodes"]) == [0, 1]
+
+    # each workload container got consistent worker identity
+    envs = {}
+    for i in (0, 1):
+        spec = harness.host(i).cd_plugin.state._cdi.read_claim_spec(f"w{i}")
+        dev_env = spec["devices"][0]["containerEdits"]["env"]
+        envs[i] = dict(e.split("=", 1) for e in dev_env)
+    ids = sorted(int(envs[i]["TPU_WORKER_ID"]) for i in (0, 1))
+    assert ids == [0, 1]
+    # addresses are container-resolvable IPs, identical on both hosts and
+    # ordered by worker index; the stable DNS names ride along separately
+    assert envs[0]["TPU_WORKER_HOSTNAMES"] == envs[1]["TPU_WORKER_HOSTNAMES"]
+    assert envs[0]["TPU_WORKER_HOSTNAMES"] == "10.0.0.2,10.0.1.2"
+    assert envs[0]["TPU_WORKER_DNS_NAMES"] == f"{worker_name(0)},{worker_name(1)}"
+    assert envs[0]["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+    assert envs[0]["TPU_ICI_CHANNEL"] == "0"
+
+    # hosts files on both nodes map both workers
+    for i in (0, 1):
+        mapping = parse_block(os.path.join(harness.host(i).hosts_dir, "hosts"))
+        assert set(mapping) == {0, 1}
+
+
+def test_prepare_cross_namespace_rejected(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+    claim = _channel_claim("w0", "host-0", uid, ns="other-ns")
+    res = harness.host(0).cd_plugin.prepare_resource_claims([claim])["w0"]
+    assert res.permanent
+    assert "does not match" in res.error
+
+
+def test_prepare_unknown_domain_times_out_retryable(tmp_path):
+    h = ClusterHarness(str(tmp_path), prepare_budget=0.5)
+    h.start()
+    try:
+        claim = _channel_claim("w0", "host-0", "no-such-uid")
+        t0 = time.monotonic()
+        res = h.host(0).cd_plugin.prepare_resource_claims([claim])["w0"]
+        assert res.error is not None and not res.permanent
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        h.stop()
+
+
+def test_channel_overlap_rejected(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+    r0 = harness.host(0).cd_plugin.prepare_resource_claims(
+        [_channel_claim("w0", "host-0", uid)])["w0"]
+    assert r0.error is None
+    # second claim for the same channel on the same node → permanent
+    r1 = harness.host(0).cd_plugin.prepare_resource_claims(
+        [_channel_claim("w0b", "host-0", uid)])["w0b"]
+    assert r1.permanent
+    assert "already prepared" in r1.error
+    # a different channel id is fine
+    r2 = harness.host(0).cd_plugin.prepare_resource_claims(
+        [_channel_claim("w0c", "host-0", uid, channel="channel-1")])["w0c"]
+    assert r2.error is None
+
+
+def test_teardown_on_delete(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+    res = harness.host(0).cd_plugin.prepare_resource_claims(
+        [_channel_claim("w0", "host-0", uid)])["w0"]
+    assert res.error is None
+    # daemon pod exists
+    harness.wait_for(lambda: harness.clients.pods.list(namespace=DRIVER_NAMESPACE),
+                     what="daemon pod")
+
+    harness.clients.compute_domains.delete("cd1", "user-ns")
+    harness.wait_for(
+        lambda: not _exists(harness.clients.compute_domains, "cd1", "user-ns"),
+        what="CD gone (finalizer removed)")
+    harness.wait_for(
+        lambda: not harness.clients.daemonsets.list(namespace=DRIVER_NAMESPACE),
+        what="daemonset deleted")
+    harness.wait_for(
+        lambda: not harness.clients.pods.list(namespace=DRIVER_NAMESPACE),
+        what="daemon pods stopped")
+    # node labels removed
+    for node in harness.clients.nodes.list():
+        assert COMPUTE_DOMAIN_LABEL_KEY not in (node["metadata"].get("labels") or {})
+    # cliques removed
+    assert not harness.clients.compute_domain_cliques.list()
+
+
+def _exists(client, name, ns):
+    try:
+        client.get(name, ns)
+        return True
+    except NotFoundError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# failover (reference test_cd_failover.bats: heal <= 300s; here seconds)
+# ---------------------------------------------------------------------------
+
+def test_daemon_force_delete_heals(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+    for i in (0, 1):
+        res = harness.host(i).cd_plugin.prepare_resource_claims(
+            [_channel_claim(f"w{i}", f"host-{i}", uid)])[f"w{i}"]
+        assert res.error is None
+
+    pods = harness.clients.pods.list(namespace=DRIVER_NAMESPACE)
+    assert len(pods) == 2
+    victim = pods[0]["metadata"]["name"]
+    harness.clients.pods.delete(victim, DRIVER_NAMESPACE)
+
+    # the harness (as kubelet/DS controller) restarts the daemon; the clique
+    # re-forms and the CD returns to Ready with both nodes — within seconds.
+    def healed():
+        st = harness.cd_status("cd1", "user-ns")
+        return (st.get("status") == STATUS_READY
+                and len(st.get("nodes") or []) == 2
+                and all(n["status"] == STATUS_READY for n in st["nodes"]))
+    # allow a transient NotReady dip first
+    harness.wait_for(healed, timeout=20.0, what="CD healed after daemon kill")
+    # indices stayed stable (same node -> same index)
+    st = harness.cd_status("cd1", "user-ns")
+    assert sorted(n["index"] for n in st["nodes"]) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# regressions from review round 4
+# ---------------------------------------------------------------------------
+
+def test_fabric_error_demotes_node_and_signals_fatal(harness):
+    from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+    for i in (0, 1):
+        res = harness.host(i).cd_plugin.prepare_resource_claims(
+            [_channel_claim(f"w{i}", f"host-{i}", uid)])[f"w{i}"]
+        assert res.error is None
+    harness.wait_for(
+        lambda: harness.cd_status("cd1", "user-ns").get("status") == STATUS_READY,
+        what="CD ready")
+
+    # inject an ICI fabric error on host-0's lib
+    lib = harness.host(0).lib
+    chip = lib.enumerate_chips()[0]
+    with harness._mu:
+        daemon0 = next(d for d in harness._daemons.values()
+                       if d._config.node_name == "host-0")
+    lib.inject_health_event(HealthEvent(HealthEventKind.ICI_LINK_ERROR,
+                                        chip.uuid, 1, "link down"))
+    # fatal flag set (production main exits nonzero on it -> pod restart)
+    assert daemon0.fatal.is_set()
+    # node demoted to NotReady in the clique -> CD leaves Ready
+    def demoted():
+        st = harness.cd_status("cd1", "user-ns")
+        node0 = next((n for n in st.get("nodes", []) if n["name"] == "host-0"), None)
+        return node0 is not None and node0["status"] != STATUS_READY
+    harness.wait_for(demoted, timeout=10.0, what="host-0 demoted")
+
+
+def test_cd_and_tpu_plugins_use_distinct_cdi_vendors(harness):
+    tpu_cdi = harness.host(0).tpu_plugin.state._cdi
+    cd_cdi = harness.host(0).cd_plugin.state._cdi
+    assert tpu_cdi.vendor != cd_cdi.vendor
+    assert tpu_cdi.claim_spec_path("u") != cd_cdi.claim_spec_path("u")
+
+
+def test_invalid_cd_emits_event_not_retry_storm(tmp_path):
+    h = ClusterHarness(str(tmp_path))
+    from tpu_dra_driver.computedomain.controller.controller import ControllerConfig
+    h.controller._config = ControllerConfig(max_nodes_per_domain=1,
+                                            status_sync_interval=0.05)
+    h.start()
+    try:
+        h.create_compute_domain("toolarge", "ns", 5, "rct")
+        h.wait_for(lambda: h.clients.events.list(), what="validation event")
+        ev = h.clients.events.list()[0]
+        assert ev["reason"] == "ValidationFailed"
+        assert "exceeds the per-domain cap" in ev["message"]
+        assert not h.clients.daemonsets.list(namespace=DRIVER_NAMESPACE)
+    finally:
+        h.stop()

@@ -34,6 +34,10 @@ from typing import Dict, List, Optional
 
 CDI_VERSION = "0.6.0"
 DEFAULT_CDI_ROOT = "/var/run/cdi"
+# default vendor; each driver constructs its CdiHandler with its own vendor
+# so the two kubelet plugins never collide on claim-spec filenames or
+# qualified device names (the reference likewise uses one CDI vendor per
+# driver name)
 VENDOR = "tpu.google.com"
 CLASS = "device"
 KIND = f"{VENDOR}/{CLASS}"
@@ -87,21 +91,23 @@ class CdiDevice:
 
     name: str
     edits: ContainerEdits
+    kind: str = KIND
 
     @property
     def qualified_name(self) -> str:
-        return f"{KIND}={self.name}"
+        return f"{self.kind}={self.name}"
 
 
 @dataclass
 class CdiSpec:
     devices: List[CdiDevice]
     common_edits: ContainerEdits
+    kind: str = KIND
 
     def to_obj(self) -> Dict:
         return {
             "cdiVersion": CDI_VERSION,
-            "kind": KIND,
+            "kind": self.kind,
             "devices": [
                 {"name": d.name, "containerEdits": d.edits.to_obj()}
                 for d in self.devices
@@ -116,7 +122,10 @@ class CdiHandler:
                  libtpu_host_path: str = DEFAULT_LIBTPU_HOST_PATH,
                  libtpu_container_path: str = DEFAULT_LIBTPU_CONTAINER_PATH,
                  driver_version: str = "",
-                 common_edits_ttl: float = 300.0):
+                 common_edits_ttl: float = 300.0,
+                 vendor: str = VENDOR):
+        self.vendor = vendor
+        self.kind = f"{vendor}/{CLASS}"
         self._cdi_root = cdi_root
         self._driver_root = driver_root.rstrip("/") or "/"
         self._libtpu_host = libtpu_host_path
@@ -161,7 +170,7 @@ class CdiHandler:
     # -- claim specs --------------------------------------------------------
 
     def claim_spec_path(self, claim_uid: str) -> str:
-        return os.path.join(self._cdi_root, f"{VENDOR}_claim-{claim_uid}.json")
+        return os.path.join(self._cdi_root, f"{self.vendor}_claim-{claim_uid}.json")
 
     @staticmethod
     def claim_device_name(claim_uid: str, canonical_name: str) -> str:
@@ -174,7 +183,9 @@ class CdiHandler:
         common = self.get_common_edits()
         if extra_common is not None:
             common = common.merge(extra_common)
-        spec = CdiSpec(devices=devices, common_edits=common)
+        devices = [CdiDevice(name=d.name, edits=d.edits, kind=self.kind)
+                   for d in devices]
+        spec = CdiSpec(devices=devices, common_edits=common, kind=self.kind)
         os.makedirs(self._cdi_root, exist_ok=True)
         path = self.claim_spec_path(claim_uid)
         tmp = f"{path}.tmp.{os.getpid()}"

@@ -1,0 +1,27 @@
+"""computedomain — the multi-host ICI slice control plane.
+
+Reference analog: the ComputeDomain subsystem (cmd/compute-domain-controller,
+cmd/compute-domain-daemon, cmd/compute-domain-kubelet-plugin) that
+orchestrates Multi-Node NVLink via IMEX daemons and channels.
+
+TPU redesign (SURVEY.md §2.6/§3.3): ICI needs **no userspace broker** —
+libtpu drives the fabric directly given consistent worker identity env.
+The control plane's job reduces to the *rendezvous*:
+
+1. controller stamps a per-CD DaemonSet + ResourceClaimTemplates,
+2. per-node daemons join a ComputeDomainClique CR (clique id = physical
+   ICI slice id), receive stable gap-filled worker indices, and publish
+   hostname mappings,
+3. the CD kubelet plugin gates workload Prepare on all-nodes-Ready and
+   injects ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` / topology env +
+   the claim's ICI channel device.
+
+The event flow (label → daemon → ready → workload release) is kept
+exactly as the reference's, including the retry envelope semantics —
+that ordering is deadlock-free and battle-tested.
+"""
+
+# well-known label/finalizer keys
+COMPUTE_DOMAIN_LABEL_KEY = "resource.tpu.google.com/computeDomain"
+COMPUTE_DOMAIN_FINALIZER = "resource.tpu.google.com/computedomain-protection"
+DRIVER_NAMESPACE = "tpu-dra-driver"

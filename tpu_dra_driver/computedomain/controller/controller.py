@@ -1,0 +1,297 @@
+"""The compute-domain-controller: ComputeDomain reconciliation.
+
+Reference analog: cmd/compute-domain-controller/{computedomain.go:298-374,
+daemonset.go, resourceclaimtemplate.go, cdstatus.go:120-260, node.go,
+cleanup.go}. Responsibilities:
+
+- on CD add/update: add finalizer, stamp the per-CD DaemonSet + daemon
+  RCT (driver namespace) + workload RCT (user namespace), enforce the
+  max-nodes cap;
+- status sync loop (2 s): copy ComputeDomainClique daemon entries into
+  ``CD.status.nodes`` and flip the global status Ready when >= numNodes
+  nodes are Ready (pruning stale nodes);
+- on CD delete: tear down children (DS, RCTs, cliques, node labels), then
+  drop the finalizer;
+- periodic orphan cleanup: children whose CD no longer exists.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from tpu_dra_driver.api.types import (
+    ComputeDomain,
+    ComputeDomainClique,
+    DEFAULT_MAX_NODES_PER_DOMAIN,
+    STATUS_NOT_READY,
+    STATUS_READY,
+    ComputeDomainNodeStatus,
+)
+from tpu_dra_driver.computedomain import (
+    COMPUTE_DOMAIN_FINALIZER,
+    COMPUTE_DOMAIN_LABEL_KEY,
+    DRIVER_NAMESPACE,
+)
+from tpu_dra_driver.computedomain.controller.objects import (
+    build_daemon_rct,
+    build_daemonset,
+    build_workload_rct,
+    daemon_rct_name,
+    daemonset_name,
+)
+from tpu_dra_driver.kube.client import ABORT, ClientSets
+from tpu_dra_driver.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
+from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg.workqueue import WorkQueue, default_controller_rate_limiter
+
+log = logging.getLogger(__name__)
+
+STATUS_SYNC_INTERVAL = 2.0       # reference cdstatus.go: 2 s loop
+ORPHAN_CLEANUP_INTERVAL = 600.0
+
+
+@dataclass
+class ControllerConfig:
+    max_nodes_per_domain: int = DEFAULT_MAX_NODES_PER_DOMAIN
+    status_sync_interval: float = STATUS_SYNC_INTERVAL
+    orphan_cleanup_interval: float = ORPHAN_CLEANUP_INTERVAL
+
+
+class ComputeDomainController:
+    def __init__(self, clients: ClientSets,
+                 config: Optional[ControllerConfig] = None):
+        self._clients = clients
+        self._config = config or ControllerConfig()
+        self._queue = WorkQueue(default_controller_rate_limiter(),
+                                name="cd-controller")
+        self._cd_informer = Informer(clients.compute_domains)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._cd_informer.add_handlers(
+            on_add=self._enqueue, on_update=lambda old, new: self._enqueue(new))
+        self._cd_informer.start()
+        self._cd_informer.wait_synced()
+        self._queue.start(workers=1)
+        for name, fn, interval in (
+            ("cd-status-sync", self._sync_all_statuses,
+             self._config.status_sync_interval),
+            ("cd-orphan-cleanup", self._cleanup_orphans,
+             self._config.orphan_cleanup_interval),
+        ):
+            t = threading.Thread(target=self._loop, args=(fn, interval),
+                                 name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        log.info("compute-domain-controller started")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.shutdown()
+        self._cd_informer.stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _loop(self, fn, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                fn()
+            except Exception:
+                log.exception("periodic task failed")
+
+    # ------------------------------------------------------------------
+    # reconcile
+    # ------------------------------------------------------------------
+
+    def _enqueue(self, obj: Dict) -> None:
+        meta = obj["metadata"]
+        key = f"{meta.get('namespace','')}/{meta['name']}"
+        self._queue.enqueue_with_key(key, lambda: self._reconcile(key))
+
+    def _reconcile(self, key: str) -> None:
+        ns, _, name = key.partition("/")
+        try:
+            obj = self._clients.compute_domains.get(name, ns)
+        except NotFoundError:
+            return
+        cd = ComputeDomain.from_obj(obj)
+        if cd.metadata.deletion_timestamp is not None:
+            self._teardown(cd)
+            return
+        # Validation failures are *terminal* for this spec generation: emit
+        # an Event the user can see and stop — retrying a permanently
+        # invalid object would burn the queue forever with no signal.
+        try:
+            cd.validate()
+            if cd.spec.num_nodes > self._config.max_nodes_per_domain:
+                raise ValueError(
+                    f"numNodes {cd.spec.num_nodes} exceeds the per-domain "
+                    f"cap {self._config.max_nodes_per_domain}"
+                )
+        except ValueError as e:
+            log.error("ComputeDomain %s rejected: %s", key, e)
+            self._emit_event(cd, "ValidationFailed", str(e))
+            return
+        self._ensure_finalizer(cd)
+        self._ensure_children(cd)
+
+    def _emit_event(self, cd: ComputeDomain, reason: str, message: str) -> None:
+        try:
+            self._clients.events.create({
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"generateName": f"{cd.metadata.name}.",
+                             "namespace": cd.metadata.namespace or "default"},
+                "type": "Warning",
+                "reason": reason,
+                "message": message,
+                "involvedObject": {"kind": "ComputeDomain",
+                                   "name": cd.metadata.name,
+                                   "namespace": cd.metadata.namespace,
+                                   "uid": cd.metadata.uid},
+            })
+        except Exception:
+            log.exception("failed to emit event for %s", cd.metadata.name)
+
+    def _ensure_finalizer(self, cd: ComputeDomain) -> None:
+        def mutate(obj):
+            fins = obj["metadata"].setdefault("finalizers", [])
+            if COMPUTE_DOMAIN_FINALIZER in fins:
+                return ABORT
+            fins.append(COMPUTE_DOMAIN_FINALIZER)
+        self._clients.compute_domains.retry_update(
+            cd.metadata.name, cd.metadata.namespace, mutate)
+
+    def _ensure_children(self, cd: ComputeDomain) -> None:
+        for client, obj in (
+            (self._clients.daemonsets, build_daemonset(cd)),
+            (self._clients.resource_claim_templates, build_daemon_rct(cd)),
+            (self._clients.resource_claim_templates, build_workload_rct(cd)),
+        ):
+            try:
+                client.create(obj)
+            except AlreadyExistsError:
+                pass
+
+    # ------------------------------------------------------------------
+    # teardown (finalizer-driven, reference computedomain.go + cleanup.go)
+    # ------------------------------------------------------------------
+
+    def _teardown(self, cd: ComputeDomain) -> None:
+        uid = cd.metadata.uid
+        self._clients.daemonsets.delete_ignore_missing(
+            daemonset_name(cd), DRIVER_NAMESPACE)
+        self._clients.resource_claim_templates.delete_ignore_missing(
+            daemon_rct_name(cd), DRIVER_NAMESPACE)
+        self._clients.resource_claim_templates.delete_ignore_missing(
+            cd.spec.channel.resource_claim_template_name, cd.metadata.namespace)
+        for cq in self._clients.compute_domain_cliques.list():
+            if cq["metadata"]["name"].startswith(f"{uid}."):
+                self._clients.compute_domain_cliques.delete_ignore_missing(
+                    cq["metadata"]["name"], cq["metadata"].get("namespace", ""))
+        self._remove_node_labels(uid)
+
+        def drop_finalizer(obj):
+            fins = obj["metadata"].get("finalizers") or []
+            if COMPUTE_DOMAIN_FINALIZER not in fins:
+                return ABORT
+            obj["metadata"]["finalizers"] = [
+                f for f in fins if f != COMPUTE_DOMAIN_FINALIZER]
+        try:
+            self._clients.compute_domains.retry_update(
+                cd.metadata.name, cd.metadata.namespace, drop_finalizer)
+        except NotFoundError:
+            pass
+        log.info("ComputeDomain %s/%s torn down",
+                 cd.metadata.namespace, cd.metadata.name)
+
+    def _remove_node_labels(self, cd_uid: str) -> None:
+        """Node-label GC (reference node.go:113-166)."""
+        for node in self._clients.nodes.list(label_selector={
+                COMPUTE_DOMAIN_LABEL_KEY: cd_uid}):
+            def mutate(obj):
+                labels = obj["metadata"].get("labels") or {}
+                if labels.get(COMPUTE_DOMAIN_LABEL_KEY) != cd_uid:
+                    return ABORT
+                del labels[COMPUTE_DOMAIN_LABEL_KEY]
+            try:
+                self._clients.nodes.retry_update(node["metadata"]["name"], "",
+                                                 mutate)
+            except NotFoundError:
+                pass
+
+    def _cleanup_orphans(self) -> None:
+        """Children labeled for a CD uid that no longer exists
+        (reference cleanup.go:33-160 CleanupManager)."""
+        live_uids = {c["metadata"]["uid"]
+                     for c in self._clients.compute_domains.list()}
+        for client in (self._clients.daemonsets,
+                       self._clients.resource_claim_templates):
+            for obj in client.list():
+                uid = (obj["metadata"].get("labels") or {}).get(
+                    COMPUTE_DOMAIN_LABEL_KEY)
+                if uid and uid not in live_uids:
+                    log.warning("cleaning up orphan %s %s/%s (cd %s gone)",
+                                client.resource, obj["metadata"].get("namespace", ""),
+                                obj["metadata"]["name"], uid)
+                    client.delete_ignore_missing(
+                        obj["metadata"]["name"],
+                        obj["metadata"].get("namespace", ""))
+        for cq in self._clients.compute_domain_cliques.list():
+            uid = cq["metadata"]["name"].split(".", 1)[0]
+            if uid not in live_uids:
+                self._clients.compute_domain_cliques.delete_ignore_missing(
+                    cq["metadata"]["name"], cq["metadata"].get("namespace", ""))
+
+    # ------------------------------------------------------------------
+    # status sync (reference cdstatus.go:120-260)
+    # ------------------------------------------------------------------
+
+    def _sync_all_statuses(self) -> None:
+        for obj in self._clients.compute_domains.list():
+            try:
+                self._sync_status(ComputeDomain.from_obj(obj))
+            except (ConflictError, NotFoundError):
+                pass  # next tick
+
+    def _sync_status(self, cd: ComputeDomain) -> None:
+        uid = cd.metadata.uid
+        nodes: List[ComputeDomainNodeStatus] = []
+        for cq_obj in self._clients.compute_domain_cliques.list():
+            name = cq_obj["metadata"]["name"]
+            if not name.startswith(f"{uid}."):
+                continue
+            clique_id = name.split(".", 1)[1]
+            cq = ComputeDomainClique.from_obj(cq_obj)
+            for d in cq.daemons:
+                nodes.append(ComputeDomainNodeStatus(
+                    name=d.node_name, ip_address=d.ip_address,
+                    clique_id=clique_id, index=d.index, status=d.status))
+        nodes.sort(key=lambda n: (n.clique_id, n.index))
+        ready = sum(1 for n in nodes if n.status == STATUS_READY)
+        global_status = (STATUS_READY if ready >= cd.spec.num_nodes
+                         else STATUS_NOT_READY)
+
+        def mutate(obj):
+            cur = ComputeDomain.from_obj(obj)
+            new_nodes = [n.__dict__ for n in nodes]
+            old_nodes = [n.__dict__ for n in cur.status.nodes]
+            if old_nodes == new_nodes and cur.status.status == global_status:
+                return ABORT
+            cur.status.nodes = nodes
+            cur.status.status = global_status
+            rendered = cur.to_obj()
+            rendered["metadata"] = obj["metadata"]  # keep rv for concurrency
+            return rendered
+
+        self._clients.compute_domains.retry_update(
+            cd.metadata.name, cd.metadata.namespace, mutate)

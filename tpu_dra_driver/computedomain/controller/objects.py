@@ -1,0 +1,155 @@
+"""Stamped child objects: per-CD DaemonSet and ResourceClaimTemplates.
+
+Reference analog: the in-image Go templates
+(templates/compute-domain-daemon.tmpl.yaml,
+compute-domain-daemon-claim-template.tmpl.yaml,
+compute-domain-workload-claim-template.tmpl.yaml) rendered by
+daemonset.go:189-251 and resourceclaimtemplate.go:304-399. Here the
+objects are built as dicts (the YAML templates in /templates mirror these
+shapes for the Helm-deployed production path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tpu_dra_driver import API_GROUP, API_VERSION, COMPUTE_DOMAIN_DRIVER_NAME
+from tpu_dra_driver.api.types import ComputeDomain
+from tpu_dra_driver.computedomain import COMPUTE_DOMAIN_LABEL_KEY, DRIVER_NAMESPACE
+
+DAEMON_DEVICE_CLASS = "compute-domain-daemon.tpu.google.com"
+DEFAULT_CHANNEL_DEVICE_CLASS = "compute-domain-default-channel.tpu.google.com"
+
+
+def daemonset_name(cd: ComputeDomain) -> str:
+    return f"cd-daemon-{cd.metadata.uid}"
+
+
+def daemon_rct_name(cd: ComputeDomain) -> str:
+    return f"cd-daemon-claim-{cd.metadata.uid}"
+
+
+def build_daemonset(cd: ComputeDomain, image: str = "tpu-dra-driver:latest",
+                    log_verbosity: int = 4) -> Dict:
+    """The per-CD DaemonSet. Node targeting: only nodes labeled with this
+    CD's uid (the CD kubelet plugin adds the label when a workload pod's
+    claim first hits the node — reference daemonset.go:206-250)."""
+    uid = cd.metadata.uid
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": daemonset_name(cd),
+            "namespace": DRIVER_NAMESPACE,
+            "labels": {COMPUTE_DOMAIN_LABEL_KEY: uid},
+            "ownerReferences": [{
+                "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                "kind": "ComputeDomain",
+                "name": cd.metadata.name,
+                "uid": uid,
+            }],
+        },
+        "spec": {
+            "selector": {"matchLabels": {COMPUTE_DOMAIN_LABEL_KEY: uid}},
+            "template": {
+                "metadata": {"labels": {COMPUTE_DOMAIN_LABEL_KEY: uid}},
+                "spec": {
+                    "nodeSelector": {COMPUTE_DOMAIN_LABEL_KEY: uid},
+                    "tolerations": [{"operator": "Exists"}],
+                    "containers": [{
+                        "name": "compute-domain-daemon",
+                        "image": image,
+                        "command": ["compute-domain-daemon",
+                                    f"--compute-domain-uid={uid}",
+                                    f"--compute-domain-name={cd.metadata.name}",
+                                    f"--compute-domain-namespace={cd.metadata.namespace}",
+                                    f"-v={log_verbosity}"],
+                        # exec readiness probe = `compute-domain-daemon check`
+                        # (reference main.go:425-451); generous startup budget
+                        "startupProbe": {
+                            "exec": {"command": ["compute-domain-daemon", "check"]},
+                            "periodSeconds": 1, "failureThreshold": 1200,
+                        },
+                        "readinessProbe": {
+                            "exec": {"command": ["compute-domain-daemon", "check"]},
+                            "periodSeconds": 5,
+                        },
+                        "resources": {"claims": [{"name": "cd-daemon"}]},
+                    }],
+                    "resourceClaims": [{
+                        "name": "cd-daemon",
+                        "resourceClaimTemplateName": daemon_rct_name(cd),
+                    }],
+                },
+            },
+        },
+    }
+
+
+def build_daemon_rct(cd: ComputeDomain) -> Dict:
+    """ResourceClaimTemplate for the daemon pod's claim: one ``daemon``
+    device of the CD driver, carrying the domain id in its opaque config."""
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaimTemplate",
+        "metadata": {
+            "name": daemon_rct_name(cd),
+            "namespace": DRIVER_NAMESPACE,
+            "labels": {COMPUTE_DOMAIN_LABEL_KEY: cd.metadata.uid},
+        },
+        "spec": {"spec": {"devices": {
+            "requests": [{
+                "name": "daemon",
+                "deviceClassName": DAEMON_DEVICE_CLASS,
+                "selectors": [{"attribute": "type", "equals": "daemon"}],
+            }],
+            "config": [{
+                "requests": ["daemon"],
+                "opaque": {
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "parameters": {
+                        "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                        "kind": "ComputeDomainDaemonConfig",
+                        "domainID": cd.metadata.uid,
+                    },
+                },
+            }],
+        }}},
+    }
+
+
+def build_workload_rct(cd: ComputeDomain) -> Dict:
+    """The workload ResourceClaimTemplate, created under the user-chosen
+    name in the CD's namespace (reference resourceclaimtemplate.go:364-399).
+    Workload pods reference it; each pod's claim yields one ICI channel
+    device whose opaque config ties it back to this domain."""
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaimTemplate",
+        "metadata": {
+            "name": cd.spec.channel.resource_claim_template_name,
+            "namespace": cd.metadata.namespace,
+            "labels": {COMPUTE_DOMAIN_LABEL_KEY: cd.metadata.uid},
+        },
+        "spec": {"spec": {"devices": {
+            "requests": [{
+                "name": "channel",
+                "deviceClassName": DEFAULT_CHANNEL_DEVICE_CLASS,
+                "selectors": [
+                    {"attribute": "type", "equals": "channel"},
+                    {"attribute": "id", "equals": 0},
+                ],
+            }],
+            "config": [{
+                "requests": ["channel"],
+                "opaque": {
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "parameters": {
+                        "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                        "kind": "ComputeDomainChannelConfig",
+                        "domainID": cd.metadata.uid,
+                    },
+                },
+            }],
+        }}},
+    }

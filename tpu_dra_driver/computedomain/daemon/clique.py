@@ -1,0 +1,135 @@
+"""ComputeDomainClique membership: join, stable gap-filled index, leave.
+
+Reference analog: cmd/compute-domain-daemon/cdclique.go — cliques are
+named ``<cdUID>.<cliqueID>``; each daemon joins the clique for *its* clique
+id (for TPUs: the physical ICI slice id from the device library — fabric
+reachability is wiring, not choice) and allocates the smallest unused
+``Index`` (gap-filling, cdclique.go:350-371) so indices stay stable and
+dense as daemons come and go — the index *is* the TPU worker id, so
+stability matters: a restarted daemon on the same node must get its old
+index back (by nodeName match) rather than a fresh one.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpu_dra_driver.api.types import (
+    CliqueDaemon,
+    ComputeDomainClique,
+    STATUS_NOT_READY,
+    STATUS_READY,
+)
+from tpu_dra_driver.computedomain import DRIVER_NAMESPACE
+from tpu_dra_driver.kube.client import ABORT, ResourceClient
+from tpu_dra_driver.kube.errors import AlreadyExistsError, NotFoundError
+
+log = logging.getLogger(__name__)
+
+
+def gap_filled_index(existing: list[int]) -> int:
+    """Smallest non-negative integer not in ``existing``."""
+    used = set(existing)
+    i = 0
+    while i in used:
+        i += 1
+    return i
+
+
+class CliqueMembership:
+    def __init__(self, cliques: ResourceClient, cd_uid: str, clique_id: str,
+                 node_name: str, ip_address: str):
+        self._cliques = cliques
+        self._cd_uid = cd_uid
+        self._clique_id = clique_id
+        self._node = node_name
+        self._ip = ip_address
+        self.name = ComputeDomainClique.clique_name(cd_uid, clique_id)
+
+    # ------------------------------------------------------------------
+
+    def ensure_clique_exists(self) -> None:
+        try:
+            self._cliques.create(
+                ComputeDomainClique.from_obj({
+                    "metadata": {"name": self.name,
+                                 "namespace": DRIVER_NAMESPACE},
+                }).to_obj())
+        except AlreadyExistsError:
+            pass
+
+    def join(self) -> int:
+        """Join (or re-join) the clique; returns the stable index."""
+        self.ensure_clique_exists()
+        result: dict = {}
+
+        def mutate(obj):
+            cq = ComputeDomainClique.from_obj(obj)
+            mine = cq.daemon_for(self._node)
+            if mine is not None:
+                # restarted daemon on the same node: keep the index, refresh IP
+                if mine.ip_address == self._ip:
+                    result["index"] = mine.index
+                    return ABORT
+                mine.ip_address = self._ip
+                mine.status = STATUS_NOT_READY
+                result["index"] = mine.index
+            else:
+                idx = gap_filled_index([d.index for d in cq.daemons])
+                cq.daemons.append(CliqueDaemon(
+                    node_name=self._node, ip_address=self._ip,
+                    index=idx, status=STATUS_NOT_READY))
+                result["index"] = idx
+            rendered = cq.to_obj()
+            rendered["metadata"] = obj["metadata"]
+            return rendered
+
+        self._cliques.retry_update(self.name, DRIVER_NAMESPACE, mutate)
+        idx = result["index"]
+        log.info("joined clique %s as index %d (node %s, ip %s)",
+                 self.name, idx, self._node, self._ip)
+        return idx
+
+    def set_status(self, status: str) -> None:
+        def mutate(obj):
+            cq = ComputeDomainClique.from_obj(obj)
+            mine = cq.daemon_for(self._node)
+            if mine is None or mine.status == status:
+                return ABORT
+            mine.status = status
+            rendered = cq.to_obj()
+            rendered["metadata"] = obj["metadata"]
+            return rendered
+        try:
+            self._cliques.retry_update(self.name, DRIVER_NAMESPACE, mutate)
+        except NotFoundError:
+            pass
+
+    def set_ready(self) -> None:
+        self.set_status(STATUS_READY)
+
+    def leave(self) -> None:
+        """Remove our entry (by node + ip, reference cdclique.go:374-404
+        removes by pod IP so a *replacement* daemon's fresh entry survives a
+        late-running old pod's shutdown)."""
+        def mutate(obj):
+            cq = ComputeDomainClique.from_obj(obj)
+            mine = cq.daemon_for(self._node)
+            if mine is None or mine.ip_address != self._ip:
+                return ABORT
+            cq.daemons = [d for d in cq.daemons if d.node_name != self._node]
+            rendered = cq.to_obj()
+            rendered["metadata"] = obj["metadata"]
+            return rendered
+        try:
+            self._cliques.retry_update(self.name, DRIVER_NAMESPACE, mutate)
+        except NotFoundError:
+            pass
+
+    def get(self) -> Optional[ComputeDomainClique]:
+        try:
+            return ComputeDomainClique.from_obj(
+                self._cliques.get(self.name, DRIVER_NAMESPACE))
+        except NotFoundError:
+            return None

@@ -1,0 +1,227 @@
+"""The compute-domain daemon: per-node-per-CD membership + readiness agent.
+
+Reference analog: cmd/compute-domain-daemon/main.go — there the daemon
+renders IMEX configs, supervises the ``nvidia-imex`` child with a watchdog,
+SIGUSR1-reloads it on peer changes, and serves a ``check`` readiness
+subcommand querying ``nvidia-imex-ctl``.
+
+TPU redesign: **no child process exists** — libtpu in the *workload*
+containers drives ICI directly. The daemon reduces to:
+
+1. label its pod with the clique id (physical ICI slice id from tpulib),
+2. join the ComputeDomainClique (stable gap-filled index = worker id),
+3. maintain the worker hosts mapping (dnsnames) and a rendered
+   ``worker-env`` snapshot as peers change (the IMEX-config-reload analog,
+   minus the process to signal),
+4. readiness (``check``): our clique entry exists and every member is in
+   the hosts mapping — then report Ready into the clique,
+5. on fabric (ICI) health errors: crash when CrashOnICIFabricErrors is
+   enabled so Kubernetes restarts the pod and the fabric re-rendezvouses —
+   the reference's crash-on-NVLink-error semantics,
+6. on shutdown: leave the clique.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from tpu_dra_driver.api.types import STATUS_READY
+from tpu_dra_driver.computedomain import COMPUTE_DOMAIN_LABEL_KEY, DRIVER_NAMESPACE
+from tpu_dra_driver.computedomain.daemon.clique import CliqueMembership
+from tpu_dra_driver.computedomain.daemon.dnsnames import (
+    update_hosts_file,
+    worker_name,
+)
+from tpu_dra_driver.kube.client import ABORT, ClientSets
+from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind, TpuLib
+
+log = logging.getLogger(__name__)
+
+CLIQUE_ID_LABEL_KEY = "resource.tpu.google.com/cliqueID"
+
+
+@dataclass
+class DaemonConfig:
+    cd_uid: str
+    cd_name: str
+    cd_namespace: str
+    node_name: str
+    pod_name: str
+    pod_ip: str
+    hosts_file: str = "/etc/hosts"
+    worker_env_file: str = "/run/tpu-dra/worker-env.json"
+    gates: fg.FeatureGates = field(default_factory=fg.FeatureGates)
+
+
+class FabricError(RuntimeError):
+    """Raised (crashing the daemon) on ICI fabric errors when
+    CrashOnICIFabricErrors is enabled."""
+
+
+class ComputeDomainDaemon:
+    def __init__(self, clients: ClientSets, lib: TpuLib, config: DaemonConfig):
+        self._clients = clients
+        self._lib = lib
+        self._config = config
+        self.clique_id = lib.slice_id()
+        self.membership = CliqueMembership(
+            clients.compute_domain_cliques, config.cd_uid, self.clique_id,
+            config.node_name, config.pod_ip)
+        self.index: Optional[int] = None
+        self._informer: Optional[Informer] = None
+        self._unsub_health = None
+        self._mu = threading.Lock()
+        self._render_mu = threading.Lock()  # serializes _on_clique_change
+        self._fabric_error: Optional[HealthEvent] = None
+        self._on_fabric_error_cb = None
+        # Set on fatal fabric errors. The production entrypoint waits on
+        # this and exits nonzero so Kubernetes restarts the pod — raising
+        # from a health-callback thread could never kill the process.
+        self.fatal = threading.Event()
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._label_pod()
+        self.index = self.membership.join()
+        self._unsub_health = self._lib.subscribe_health(self._on_health)
+        # name-filtered clique informer (reference controller.go:95-133)
+        self._informer = Informer(
+            self._clients.compute_domain_cliques,
+            namespace=DRIVER_NAMESPACE,
+            name_filter=lambda n: n == self.membership.name)
+        self._informer.add_handlers(
+            on_add=lambda o: self._on_clique_change(),
+            on_update=lambda old, new: self._on_clique_change(),
+            on_delete=lambda o: None)
+        self._informer.start()
+        self._informer.wait_synced()
+        self._on_clique_change()
+        log.info("cd-daemon started: cd=%s clique=%s index=%s",
+                 self._config.cd_uid, self.clique_id, self.index)
+
+    def stop(self) -> None:
+        if self._unsub_health:
+            self._unsub_health()
+        if self._informer:
+            self._informer.stop()
+        self.membership.leave()
+
+    def set_fabric_error_callback(self, cb) -> None:
+        self._on_fabric_error_cb = cb
+
+    # ------------------------------------------------------------------
+
+    def _label_pod(self) -> None:
+        """Label our pod with the clique id (reference main.go:528-555)."""
+        def mutate(obj):
+            labels = obj["metadata"].setdefault("labels", {})
+            if labels.get(CLIQUE_ID_LABEL_KEY) == self.clique_id:
+                return ABORT
+            labels[CLIQUE_ID_LABEL_KEY] = self.clique_id
+        try:
+            self._clients.pods.retry_update(
+                self._config.pod_name, DRIVER_NAMESPACE, mutate)
+        except NotFoundError:
+            log.warning("own pod %s not found for clique-id labeling",
+                        self._config.pod_name)
+
+    # ------------------------------------------------------------------
+    # peer-change handling (the IMEX-config-reload analog)
+    # ------------------------------------------------------------------
+
+    def _on_clique_change(self) -> None:
+        # Serialized: fires from both start() and the informer watch thread;
+        # concurrent runs would race on the (pid-named) tmp files and could
+        # install a stale hosts block.
+        with self._render_mu:
+            cq = self.membership.get()
+            if cq is None:
+                return
+            mapping: Dict[int, str] = {d.index: d.ip_address for d in cq.daemons
+                                       if d.index >= 0 and d.ip_address}
+            changed = update_hosts_file(self._config.hosts_file, mapping)
+            self._write_worker_env(mapping)
+            if changed:
+                log.info("hosts mapping updated: %s",
+                         {worker_name(i): ip for i, ip in mapping.items()})
+            # readiness is not a one-way latch: report NotReady again when
+            # the check regresses (e.g. fabric error, peer inconsistency) so
+            # the controller stops releasing workloads onto this node
+            if self.check():
+                self.membership.set_ready()
+            else:
+                from tpu_dra_driver.api.types import STATUS_NOT_READY
+                self.membership.set_status(STATUS_NOT_READY)
+
+    def _write_worker_env(self, mapping: Dict[int, str]) -> None:
+        """Render the worker-identity snapshot (debugging + the CD plugin's
+        fallback source). The authoritative copy of this data lives in the
+        Clique CR; this file is the node-local rendering."""
+        topo = self._lib.host_topology()
+        names = [worker_name(i) for i in sorted(mapping)]
+        env = {
+            "TPU_WORKER_ID": str(self.index),
+            "TPU_WORKER_HOSTNAMES": ",".join(names),
+            "TPU_ACCELERATOR_TYPE": topo.accelerator_type,
+            "TPU_TOPOLOGY": topo.topology_string,
+            "cliqueID": self.clique_id,
+            "computeDomain": self._config.cd_uid,
+        }
+        path = self._config.worker_env_file
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(env, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------------------
+    # readiness (the `compute-domain-daemon check` probe)
+    # ------------------------------------------------------------------
+
+    def check(self) -> bool:
+        """Ready iff: no fabric error, we are in the clique, and every
+        clique member is present in our hosts mapping (all peers
+        resolvable — the nvidia-imex-ctl quorum-query analog)."""
+        with self._mu:
+            if self._fabric_error is not None:
+                return False
+        cq = self.membership.get()
+        if cq is None:
+            return False
+        mine = cq.daemon_for(self._config.node_name)
+        if mine is None or mine.index < 0:
+            return False
+        from tpu_dra_driver.computedomain.daemon.dnsnames import parse_block
+        mapping = parse_block(self._config.hosts_file)
+        return all(d.index in mapping for d in cq.daemons)
+
+    # ------------------------------------------------------------------
+    # fabric health
+    # ------------------------------------------------------------------
+
+    def _on_health(self, event: HealthEvent) -> None:
+        if event.kind != HealthEventKind.ICI_LINK_ERROR:
+            return
+        with self._mu:
+            self._fabric_error = event
+        log.error("ICI fabric error on %s: %s", event.chip_uuid, event.message)
+        # demote ourselves so the controller stops releasing workloads here
+        from tpu_dra_driver.api.types import STATUS_NOT_READY
+        self.membership.set_status(STATUS_NOT_READY)
+        if self._config.gates.enabled(fg.CRASH_ON_ICI_FABRIC_ERRORS):
+            # reference CrashOnNVLinkFabricErrors: die so k8s restarts the
+            # pod and the clique re-forms on healthy fabric. The health
+            # callback runs on the publisher's thread, so signal the main
+            # loop (which exits nonzero) instead of raising here.
+            self.fatal.set()
+            if self._on_fabric_error_cb is not None:
+                self._on_fabric_error_cb(event)

@@ -1,0 +1,89 @@
+"""Stable worker-name ↔ IP mappings (the /etc/hosts rewriting mechanism).
+
+Reference analog: cmd/compute-domain-daemon/dnsnames.go:34-216 — the IMEX
+nodes-config must stay *static* while pod IPs churn, so the daemon writes
+stable DNS names (``compute-domain-daemon-%04d``) into /etc/hosts and
+rewrites only its own marker-delimited block, idempotently.
+
+TPU use: ``TPU_WORKER_HOSTNAMES`` injected into workload containers names
+peers as ``cd-daemon-%04d`` (index = the stable clique index, which is the
+worker id); this module maintains the hosts-file block mapping those names
+to the per-node daemon IPs (daemons run with hostNetwork, so daemon IP ==
+node IP — worker identity is per *host*, matching TPU-VM semantics).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+BEGIN_MARKER = "# BEGIN tpu-dra-driver compute-domain workers"
+END_MARKER = "# END tpu-dra-driver compute-domain workers"
+
+WORKER_NAME_FORMAT = "cd-daemon-{index:04d}"
+
+
+def worker_name(index: int) -> str:
+    return WORKER_NAME_FORMAT.format(index=index)
+
+
+def render_block(mapping: Dict[int, str]) -> str:
+    """mapping: worker index -> IP address."""
+    lines = [BEGIN_MARKER]
+    for index in sorted(mapping):
+        lines.append(f"{mapping[index]}\t{worker_name(index)}")
+    lines.append(END_MARKER)
+    return "\n".join(lines) + "\n"
+
+
+def update_hosts_file(path: str, mapping: Dict[int, str]) -> bool:
+    """Idempotently replace (or append) our marker block in ``path``.
+    Returns True when the file changed."""
+    try:
+        with open(path) as f:
+            content = f.read()
+    except FileNotFoundError:
+        content = ""
+    block = render_block(mapping)
+    begin = content.find(BEGIN_MARKER)
+    end = content.find(END_MARKER)
+    if begin != -1 and end != -1:
+        end_of_block = end + len(END_MARKER)
+        if end_of_block < len(content) and content[end_of_block] == "\n":
+            end_of_block += 1
+        new_content = content[:begin] + block + content[end_of_block:]
+    else:
+        sep = "" if (not content or content.endswith("\n")) else "\n"
+        new_content = content + sep + block
+    if new_content == content:
+        return False
+    import threading
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        f.write(new_content)
+    os.replace(tmp, path)
+    return True
+
+
+def parse_block(path: str) -> Dict[int, str]:
+    """Read back our block: worker index -> IP (test/debug helper)."""
+    try:
+        with open(path) as f:
+            content = f.read()
+    except FileNotFoundError:
+        return {}
+    out: Dict[int, str] = {}
+    inside = False
+    for line in content.splitlines():
+        if line == BEGIN_MARKER:
+            inside = True
+            continue
+        if line == END_MARKER:
+            break
+        if inside and line.strip():
+            ip, _, name = line.partition("\t")
+            prefix = WORKER_NAME_FORMAT.split("{")[0]
+            if name.startswith(prefix):
+                out[int(name[len(prefix):])] = ip
+    return out

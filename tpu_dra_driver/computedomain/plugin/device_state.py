@@ -1,0 +1,327 @@
+"""CD plugin device state: checkpointed channel/daemon Prepare.
+
+Reference analog: cmd/compute-domain-kubelet-plugin/{device_state.go,
+computedomain.go} — the rendezvous-critical half of the driver:
+
+- **channel claims** (workload pods):
+  1. strict-decode ComputeDomainChannelConfig (bad config → permanent),
+  2. cross-namespace guard: the CD referenced by ``domainID`` must live in
+     the claim's namespace (permanent, device_state.go:491-493),
+  3. ``AddNodeLabel(node, cdUID)`` — this *triggers* the controller's
+     DaemonSet to land a daemon on this node (computedomain.go:312-338),
+  4. ``assert_compute_domain_ready``: this node must appear Ready in
+     ``CD.status.nodes`` — until then a **transient** error keeps kubelet
+     retrying while the daemon rendezvouses (computedomain.go:238-294),
+  5. inject the channel device node + worker identity env
+     (``TPU_WORKER_ID`` = this node's clique index, ``TPU_WORKER_HOSTNAMES``,
+     topology) — the moment of workload release.
+
+- **daemon claims** (the CD daemon pods): cross-ns guard against the
+  driver namespace, then inject the daemon runtime env + state dir mount
+  (device_state.go:516-573's config-mount analog).
+
+Checkpointing and the channel-overlap guard (channel-0 uniqueness,
+device_state.go:635-674) reuse the same machinery as the TPU plugin.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME
+from tpu_dra_driver.api.configs import (
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+)
+from tpu_dra_driver.api.decoder import STRICT_DECODER, DecodeError
+from tpu_dra_driver.api.types import ComputeDomain, ComputeDomainClique, STATUS_READY
+from tpu_dra_driver.cdi.generator import CdiDevice, CdiHandler, ContainerEdits
+from tpu_dra_driver.computedomain import COMPUTE_DOMAIN_LABEL_KEY, DRIVER_NAMESPACE
+from tpu_dra_driver.computedomain.daemon.dnsnames import worker_name
+from tpu_dra_driver.computedomain.plugin.devices import (
+    DAEMON_DEVICE_NAME,
+    channel_devfs_path,
+    parse_channel_name,
+)
+from tpu_dra_driver.kube.client import ABORT, ClientSets
+from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.pkg.flock import Flock, FlockOptions
+from tpu_dra_driver.plugin.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    ClaimEntry,
+    PreparedDevice,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+)
+from tpu_dra_driver.plugin.claims import (
+    ClaimInfo,
+    config_for_result,
+    resolve_opaque_configs,
+)
+from tpu_dra_driver.plugin.device_state import PermanentError
+from tpu_dra_driver.tpulib.interface import TpuLib
+
+log = logging.getLogger(__name__)
+
+
+class RetryableError(Exception):
+    """Transient prepare failure — kubelet/the retry envelope should retry
+    (most prominently: the CD not yet Ready on this node)."""
+
+
+@dataclass
+class CdPluginConfig:
+    node_name: str
+    state_dir: str
+    hosts_file_dir: str = "/run/tpu-dra"
+
+
+class CdDeviceState:
+    def __init__(self, clients: ClientSets, lib: TpuLib, cdi: CdiHandler,
+                 config: CdPluginConfig):
+        self._clients = clients
+        self._lib = lib
+        self._cdi = cdi
+        self._config = config
+        self._mu = threading.RLock()
+        self._cp_mgr = CheckpointManager(config.state_dir)
+        self._cp_lock_path = os.path.join(config.state_dir, "cp.lock")
+        self._cp_mgr.ensure_exists()
+
+    def _cp_locked(self):
+        return Flock(self._cp_lock_path, FlockOptions(timeout=10.0))
+
+    def get_checkpoint(self) -> Checkpoint:
+        with self._cp_locked():
+            return self._cp_mgr.read()
+
+    # ------------------------------------------------------------------
+
+    def prepare(self, claim: ClaimInfo) -> List[PreparedDevice]:
+        with self._mu, self._cp_locked():
+            cp = self._cp_mgr.read()
+            entry = cp.claims.get(claim.uid)
+            if entry is not None and entry.state == PREPARE_COMPLETED:
+                return entry.prepared_devices
+            self._validate_no_overlap(cp, claim)
+            cp.claims[claim.uid] = ClaimEntry(
+                claim_uid=claim.uid, claim_name=claim.name,
+                namespace=claim.namespace, state=PREPARE_STARTED)
+            self._cp_mgr.write(cp)
+
+            try:
+                prepared, cdi_devices, extra = self._prepare_devices(claim)
+            except (PermanentError, RetryableError):
+                # nothing was mutated for CD devices; drop the write-ahead
+                # entry so a later retry starts clean
+                del cp.claims[claim.uid]
+                self._cp_mgr.write(cp)
+                raise
+            qualified = self._cdi.write_claim_spec(claim.uid, cdi_devices,
+                                                   extra_common=extra)
+            for dev, qname in zip(prepared, qualified):
+                dev.cdi_device_ids = [qname]
+            cp.claims[claim.uid] = ClaimEntry(
+                claim_uid=claim.uid, claim_name=claim.name,
+                namespace=claim.namespace, state=PREPARE_COMPLETED,
+                prepared_devices=prepared)
+            self._cp_mgr.write(cp)
+            return prepared
+
+    def unprepare(self, claim_uid: str) -> None:
+        with self._mu, self._cp_locked():
+            cp = self._cp_mgr.read()
+            if claim_uid not in cp.claims:
+                return
+            self._cdi.delete_claim_spec(claim_uid)
+            del cp.claims[claim_uid]
+            self._cp_mgr.write(cp)
+
+    def _validate_no_overlap(self, cp: Checkpoint, claim: ClaimInfo) -> None:
+        """Channel devices are exclusive per node (channel-0 uniqueness:
+        two workload claims must not share a channel; use distinct channel
+        ids or a single shared claim)."""
+        owners = cp.prepared_device_owners()
+        for r in claim.results:
+            owner = owners.get(r.device)
+            if owner is not None and owner != claim.uid:
+                raise PermanentError(
+                    f"channel device {r.device} already prepared for claim "
+                    f"{owner} on this node"
+                )
+
+    # ------------------------------------------------------------------
+
+    def _prepare_devices(self, claim: ClaimInfo):
+        try:
+            configs = resolve_opaque_configs(
+                claim, STRICT_DECODER, driver_name=COMPUTE_DOMAIN_DRIVER_NAME)
+        except DecodeError as e:
+            raise PermanentError(f"bad opaque config: {e}") from e
+        if not claim.results:
+            raise PermanentError(
+                f"claim {claim.canonical} has no allocation results for "
+                f"{COMPUTE_DOMAIN_DRIVER_NAME}")
+
+        prepared: List[PreparedDevice] = []
+        cdi_devices: List[CdiDevice] = []
+        extra = ContainerEdits()
+        for result in claim.results:
+            rc = config_for_result(configs, result)
+            cfg = rc.config if rc else None
+            if result.device == DAEMON_DEVICE_NAME:
+                if not isinstance(cfg, ComputeDomainDaemonConfig):
+                    raise PermanentError(
+                        "daemon device requires a ComputeDomainDaemonConfig")
+                pd, cd, ex = self._prepare_daemon(claim, result.request, cfg)
+            else:
+                if not isinstance(cfg, ComputeDomainChannelConfig):
+                    raise PermanentError(
+                        "channel device requires a ComputeDomainChannelConfig")
+                pd, cd, ex = self._prepare_channel(claim, result.request,
+                                                   result.device, cfg)
+            prepared.append(pd)
+            cdi_devices.append(cd)
+            extra = extra.merge(ex)
+        return prepared, cdi_devices, extra
+
+    # ------------------------------------------------------------------
+    # channel path (the workload-release gate)
+    # ------------------------------------------------------------------
+
+    def _prepare_channel(self, claim: ClaimInfo, request: str,
+                         device: str, cfg: ComputeDomainChannelConfig):
+        try:
+            chan_id = parse_channel_name(device)
+        except ValueError as e:
+            raise PermanentError(str(e)) from e
+        cd = self._get_compute_domain(cfg.domain_id)
+        if cd is None:
+            raise RetryableError(
+                f"ComputeDomain {cfg.domain_id} not found (yet)")
+        if cd.metadata.namespace != claim.namespace:
+            raise PermanentError(
+                f"claim namespace {claim.namespace!r} does not match "
+                f"ComputeDomain namespace {cd.metadata.namespace!r}")
+        self._add_node_label(cfg.domain_id)
+        node_status = self._assert_compute_domain_ready(cd)
+        worker_id, addresses, dns_names = self._worker_identity(cd, node_status)
+
+        env = {
+            "TPU_WORKER_ID": str(worker_id),
+            # worker addresses must resolve *inside the workload container*,
+            # so inject the IPs directly (libtpu accepts IPs here); the
+            # stable DNS names + hosts mapping ride along for tooling that
+            # wants them (mounted at /etc/tpu-dra/hosts)
+            "TPU_WORKER_HOSTNAMES": ",".join(addresses),
+            "TPU_WORKER_DNS_NAMES": ",".join(dns_names),
+            "TPU_ICI_CHANNEL": str(chan_id),
+            "TPU_COMPUTE_DOMAIN": cd.metadata.uid,
+        }
+        topo = self._lib.host_topology()
+        env["TPU_ACCELERATOR_TYPE"] = topo.accelerator_type
+        env["TPU_TOPOLOGY"] = topo.topology_string
+
+        edits = ContainerEdits(
+            env=env,
+            device_nodes=[{"path": channel_devfs_path(chan_id)}],
+            mounts=[{
+                "hostPath": os.path.join(self._config.hosts_file_dir, "hosts"),
+                "containerPath": "/etc/tpu-dra/hosts",
+                "options": ["ro", "bind"],
+            }],
+        )
+        name = self._cdi.claim_device_name(claim.uid, device)
+        pd = PreparedDevice(canonical_name=device, request=request,
+                            device_type="channel",
+                            devfs_path=channel_devfs_path(chan_id))
+        return pd, CdiDevice(name=name, edits=edits), ContainerEdits()
+
+    def _get_compute_domain(self, domain_uid: str) -> Optional[ComputeDomain]:
+        for obj in self._clients.compute_domains.list():
+            if obj["metadata"].get("uid") == domain_uid:
+                return ComputeDomain.from_obj(obj)
+        return None
+
+    def _add_node_label(self, cd_uid: str) -> None:
+        """Label this node so the controller's DaemonSet schedules a daemon
+        here (reference computedomain.go:312-338)."""
+        def mutate(obj):
+            labels = obj["metadata"].setdefault("labels", {})
+            if labels.get(COMPUTE_DOMAIN_LABEL_KEY) == cd_uid:
+                return ABORT
+            labels[COMPUTE_DOMAIN_LABEL_KEY] = cd_uid
+        try:
+            self._clients.nodes.retry_update(self._config.node_name, "", mutate)
+        except NotFoundError:
+            raise RetryableError(
+                f"node {self._config.node_name} not registered yet")
+
+    def _assert_compute_domain_ready(self, cd: ComputeDomain):
+        """Transient failure until the daemon on *this* node is Ready
+        (reference computedomain.go:238-294). Workload pods sit in
+        ContainerCreating while kubelet retries."""
+        for n in cd.status.nodes:
+            if n.name == self._config.node_name and n.status == STATUS_READY:
+                return n
+        raise RetryableError(
+            f"ComputeDomain {cd.metadata.namespace}/{cd.metadata.name}: "
+            f"node {self._config.node_name} not Ready yet "
+            f"(status={cd.status.status}, "
+            f"nodes={[f'{n.name}:{n.status}' for n in cd.status.nodes]})")
+
+    def _worker_identity(self, cd: ComputeDomain,
+                         node_status) -> Tuple[int, List[str], List[str]]:
+        """worker id = this node's clique index; addresses = members' IPs
+        ordered by index (resolvable anywhere); dns_names = the stable
+        names backing the hosts-file mapping."""
+        clique_name = ComputeDomainClique.clique_name(
+            cd.metadata.uid, node_status.clique_id)
+        try:
+            cq = ComputeDomainClique.from_obj(
+                self._clients.compute_domain_cliques.get(
+                    clique_name, DRIVER_NAMESPACE))
+        except NotFoundError:
+            raise RetryableError(f"clique {clique_name} not found (yet)")
+        members = sorted((d for d in cq.daemons if d.index >= 0),
+                         key=lambda d: d.index)
+        return (node_status.index,
+                [d.ip_address for d in members],
+                [worker_name(d.index) for d in members])
+
+    # ------------------------------------------------------------------
+    # daemon path
+    # ------------------------------------------------------------------
+
+    def _prepare_daemon(self, claim: ClaimInfo, request: str,
+                        cfg: ComputeDomainDaemonConfig):
+        if claim.namespace != DRIVER_NAMESPACE:
+            raise PermanentError(
+                f"daemon claims must live in {DRIVER_NAMESPACE!r}, "
+                f"got {claim.namespace!r}")
+        cd = self._get_compute_domain(cfg.domain_id)
+        if cd is None:
+            raise RetryableError(f"ComputeDomain {cfg.domain_id} not found (yet)")
+        env = {
+            "CD_UID": cd.metadata.uid,
+            "CD_NAME": cd.metadata.name,
+            "CD_NAMESPACE": cd.metadata.namespace,
+            "NODE_NAME": self._config.node_name,
+        }
+        edits = ContainerEdits(
+            env=env,
+            mounts=[{
+                "hostPath": self._config.hosts_file_dir,
+                "containerPath": "/run/tpu-dra",
+                "options": ["rw", "bind"],
+            }],
+        )
+        name = self._cdi.claim_device_name(claim.uid, DAEMON_DEVICE_NAME)
+        pd = PreparedDevice(canonical_name=DAEMON_DEVICE_NAME, request=request,
+                            device_type="daemon")
+        return pd, CdiDevice(name=name, edits=edits), ContainerEdits()

@@ -1,0 +1,74 @@
+"""Compute-domain device inventory: ICI channels + the daemon device.
+
+Reference analog: cmd/compute-domain-kubelet-plugin/nvlib.go:160-186,
+358-361 — each node advertises 2048 IMEX ``channel`` devices plus one
+``daemon`` device under driver ``compute-domain.nvidia.com``.
+
+TPU mapping: a *channel* is a claim-scoped ICI-access grant — preparing it
+injects the worker-identity env + the channel device node into the
+workload container. The *daemon* device is claimed only by the per-CD
+daemon pods the controller stamps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME
+
+NUM_CHANNELS = 2048  # parity with the reference (nvlib.go:358-361)
+
+CHANNEL_DEVFS_DIR = "/dev/tpu-ici-channels"
+
+
+def channel_name(i: int) -> str:
+    return f"channel-{i}"
+
+
+def channel_devfs_path(i: int) -> str:
+    return f"{CHANNEL_DEVFS_DIR}/channel{i}"
+
+
+def parse_channel_name(name: str) -> int:
+    """channel-<i> -> i; raises ValueError otherwise."""
+    if not name.startswith("channel-"):
+        raise ValueError(f"not a channel device: {name!r}")
+    return int(name[len("channel-"):])
+
+
+DAEMON_DEVICE_NAME = "daemon"
+
+
+def build_cd_resource_slice(node_name: str, clique_id: str,
+                            num_channels: int = NUM_CHANNELS) -> Dict:
+    """One slice per node with the daemon device + all channels."""
+    devices: List[Dict] = [{
+        "name": DAEMON_DEVICE_NAME,
+        "attributes": {
+            "type": {"string": "daemon"},
+            "cliqueID": {"string": clique_id},
+        },
+        "capacity": {},
+    }]
+    for i in range(num_channels):
+        devices.append({
+            "name": channel_name(i),
+            "attributes": {
+                "type": {"string": "channel"},
+                "id": {"int": i},
+                "cliqueID": {"string": clique_id},
+            },
+            "capacity": {},
+        })
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": f"{node_name}-{COMPUTE_DOMAIN_DRIVER_NAME}"},
+        "spec": {
+            "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+            "nodeName": node_name,
+            "pool": {"name": node_name, "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": devices,
+        },
+    }

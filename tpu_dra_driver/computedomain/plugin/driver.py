@@ -1,0 +1,126 @@
+"""The compute-domain kubelet plugin driver: the retry envelope.
+
+Reference analog: cmd/compute-domain-kubelet-plugin/driver.go:40-62,
+164-232 — unlike the TPU/GPU plugin (one attempt per kubelet call), every
+CD claim prepare runs inside an internal retry loop with exponential
+backoff under a **45 s budget**, distinguishing permanent errors (no
+retry; surfaced immediately) from transient ones (most importantly "CD not
+Ready on this node yet", which resolves as the daemon rendezvous
+completes). Kubelet itself re-calls Prepare for anything that exhausts the
+budget, so workload pods sit in ContainerCreating until release.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME
+from tpu_dra_driver.cdi.generator import CdiHandler
+from tpu_dra_driver.computedomain.plugin.device_state import (
+    CdDeviceState,
+    CdPluginConfig,
+    RetryableError,
+)
+from tpu_dra_driver.computedomain.plugin.devices import build_cd_resource_slice
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.errors import AlreadyExistsError
+from tpu_dra_driver.pkg.workqueue import prep_unprep_rate_limiter
+from tpu_dra_driver.plugin.claims import ClaimInfo
+from tpu_dra_driver.plugin.device_state import PermanentError
+from tpu_dra_driver.plugin.driver import PrepareResult
+
+log = logging.getLogger(__name__)
+
+PREPARE_BUDGET = 45.0  # seconds (reference driver.go:40-46)
+
+
+@dataclass
+class CdKubeletPluginConfig:
+    node_name: str
+    state_dir: str
+    cdi_root: str
+    hosts_file_dir: str = "/run/tpu-dra"
+    prepare_budget: float = PREPARE_BUDGET
+
+
+class CdKubeletPlugin:
+    def __init__(self, clients: ClientSets, lib, config: CdKubeletPluginConfig):
+        self._clients = clients
+        self._lib = lib
+        self._config = config
+        cdi = CdiHandler(cdi_root=config.cdi_root,
+                         driver_version=lib.driver_version(),
+                         vendor=COMPUTE_DOMAIN_DRIVER_NAME)
+        self.state = CdDeviceState(clients, lib, cdi, CdPluginConfig(
+            node_name=config.node_name, state_dir=config.state_dir,
+            hosts_file_dir=config.hosts_file_dir))
+
+    def start(self) -> None:
+        slice_obj = build_cd_resource_slice(self._config.node_name,
+                                            self._lib.slice_id())
+        try:
+            self._clients.resource_slices.create(slice_obj)
+        except AlreadyExistsError:
+            existing = self._clients.resource_slices.get(
+                slice_obj["metadata"]["name"])
+            existing["spec"] = slice_obj["spec"]
+            self._clients.resource_slices.update(existing)
+        log.info("cd-kubelet-plugin started on %s (clique %s)",
+                 self._config.node_name, self._lib.slice_id())
+
+    # ------------------------------------------------------------------
+
+    def prepare_resource_claims(self, claims: List[Dict]) -> Dict[str, PrepareResult]:
+        out: Dict[str, PrepareResult] = {}
+        for obj in claims:
+            info = ClaimInfo.from_obj(obj, driver_name=COMPUTE_DOMAIN_DRIVER_NAME)
+            out[info.uid] = self._prepare_with_retry(info)
+        return out
+
+    def _prepare_with_retry(self, claim: ClaimInfo) -> PrepareResult:
+        """Synchronous retry envelope: exponential backoff within the 45 s
+        budget; the latest-wins semantics of the reference's internal
+        workqueue reduce to a simple loop when each kubelet call carries
+        one claim attempt."""
+        limiter = prep_unprep_rate_limiter()
+        deadline = time.monotonic() + self._config.prepare_budget
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                devices = self.state.prepare(claim)
+                if attempt > 1:
+                    log.info("prepare %s succeeded on attempt %d",
+                             claim.canonical, attempt)
+                return PrepareResult(devices=devices)
+            except PermanentError as e:
+                log.error("prepare %s failed permanently: %s", claim.canonical, e)
+                return PrepareResult(error=str(e), permanent=True)
+            except RetryableError as e:
+                delay = limiter.when(claim.uid)
+                if time.monotonic() + delay > deadline:
+                    log.warning("prepare %s: retry budget exhausted after "
+                                "%d attempts: %s", claim.canonical, attempt, e)
+                    return PrepareResult(error=str(e), permanent=False)
+                log.debug("prepare %s transient (attempt %d, retry in %.2fs): %s",
+                          claim.canonical, attempt, delay, e)
+                time.sleep(delay)
+            except Exception as e:
+                log.exception("prepare %s failed", claim.canonical)
+                return PrepareResult(error=str(e), permanent=False)
+
+    def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[str]]:
+        out: Dict[str, Optional[str]] = {}
+        for uid in claim_uids:
+            try:
+                self.state.unprepare(uid)
+                out[uid] = None
+            except Exception as e:
+                log.exception("unprepare %s failed", uid)
+                out[uid] = str(e)
+        return out
