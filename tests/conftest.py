@@ -7,9 +7,20 @@ exercised without TPU hardware (the env vars must be set before jax import).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite, don't setdefault: the sandbox's TPU-tunnel shim pre-imports
+# jax._src at interpreter start with JAX_PLATFORMS=axon cached, so the env
+# var alone is ignored — jax.config.update is required (and must happen
+# before the backend initializes).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # backend already initialized (can't happen under pytest startup)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

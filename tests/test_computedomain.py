@@ -50,6 +50,25 @@ def _channel_claim(uid, node, domain_uid, ns="user-ns", channel="channel-0"):
         driver_name="compute-domain.tpu.google.com", request="channel")
 
 
+
+def _prepare_concurrently(harness, uid, hosts, uids=None):
+    """Prepare channel claims on several hosts concurrently (the real-world
+    shape: a job's pods land on all nodes at once) and return results."""
+    import threading
+    uids = uids or [f"w{i}" for i in hosts]
+    results = {}
+
+    def run(host_idx, claim_uid):
+        claim = _channel_claim(claim_uid, f"host-{host_idx}", uid)
+        results[claim_uid] = harness.host(host_idx).cd_plugin.\
+            prepare_resource_claims([claim])[claim_uid]
+
+    ts = [threading.Thread(target=run, args=(h, u))
+          for h, u in zip(hosts, uids)]
+    for t in ts: t.start()
+    for t in ts: t.join(timeout=30)
+    return results
+
 # ---------------------------------------------------------------------------
 # units
 # ---------------------------------------------------------------------------
@@ -196,7 +215,7 @@ def test_prepare_unknown_domain_times_out_retryable(tmp_path):
 
 
 def test_channel_overlap_rejected(harness):
-    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    harness.create_compute_domain("cd1", "user-ns", 1, "wl-rct")
     uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
     r0 = harness.host(0).cd_plugin.prepare_resource_claims(
         [_channel_claim("w0", "host-0", uid)])["w0"]
@@ -213,7 +232,7 @@ def test_channel_overlap_rejected(harness):
 
 
 def test_teardown_on_delete(harness):
-    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    harness.create_compute_domain("cd1", "user-ns", 1, "wl-rct")
     uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
     res = harness.host(0).cd_plugin.prepare_resource_claims(
         [_channel_claim("w0", "host-0", uid)])["w0"]
@@ -254,10 +273,8 @@ def _exists(client, name, ns):
 def test_daemon_force_delete_heals(harness):
     harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
     uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
-    for i in (0, 1):
-        res = harness.host(i).cd_plugin.prepare_resource_claims(
-            [_channel_claim(f"w{i}", f"host-{i}", uid)])[f"w{i}"]
-        assert res.error is None
+    results = _prepare_concurrently(harness, uid, [0, 1])
+    assert all(r.error is None for r in results.values()), results
 
     pods = harness.clients.pods.list(namespace=DRIVER_NAMESPACE)
     assert len(pods) == 2
@@ -286,10 +303,8 @@ def test_fabric_error_demotes_node_and_signals_fatal(harness):
     from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind
     harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
     uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
-    for i in (0, 1):
-        res = harness.host(i).cd_plugin.prepare_resource_claims(
-            [_channel_claim(f"w{i}", f"host-{i}", uid)])[f"w{i}"]
-        assert res.error is None
+    results = _prepare_concurrently(harness, uid, [0, 1])
+    assert all(r.error is None for r in results.values()), results
     harness.wait_for(
         lambda: harness.cd_status("cd1", "user-ns").get("status") == STATUS_READY,
         what="CD ready")
@@ -334,3 +349,50 @@ def test_invalid_cd_emits_event_not_retry_storm(tmp_path):
         assert not h.clients.daemonsets.list(namespace=DRIVER_NAMESPACE)
     finally:
         h.stop()
+
+
+# ---------------------------------------------------------------------------
+# regressions from review round 5
+# ---------------------------------------------------------------------------
+
+def test_prepare_waits_for_full_world(harness):
+    """A workload must never be released with fewer clique members than
+    spec.numNodes — the world size the job boots with would be wrong."""
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+    # prepare only on host-0; with numNodes=2 the clique can still complete
+    # because labeling host-0 alone never places a daemon on host-1 — so the
+    # budgeted prepare must time out as transient, not release early.
+    import threading
+    res = {}
+    t = threading.Thread(target=lambda: res.update(
+        harness.host(0).cd_plugin.prepare_resource_claims(
+            [_channel_claim("w0", "host-0", uid)])))
+    t.start()
+    t.join(timeout=30)
+    r = res["w0"]
+    assert r.error is not None and not r.permanent
+    assert "1/2 daemons joined" in r.error or "not Ready" in r.error
+
+
+def test_rct_rename_cleans_up_stale_template(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "rct-a")
+    harness.wait_for(
+        lambda: _exists(harness.clients.resource_claim_templates, "rct-a", "user-ns"),
+        what="rct-a")
+    cd = harness.clients.compute_domains.get("cd1", "user-ns")
+    cd["spec"]["channel"]["resourceClaimTemplate"]["name"] = "rct-b"
+    harness.clients.compute_domains.update(cd)
+    harness.wait_for(
+        lambda: _exists(harness.clients.resource_claim_templates, "rct-b", "user-ns")
+        and not _exists(harness.clients.resource_claim_templates, "rct-a", "user-ns"),
+        what="rct-b created, rct-a removed")
+
+
+def test_daemonset_has_no_cross_namespace_owner_ref(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    harness.wait_for(
+        lambda: harness.clients.daemonsets.list(namespace=DRIVER_NAMESPACE),
+        what="daemonset")
+    ds = harness.clients.daemonsets.list(namespace=DRIVER_NAMESPACE)[0]
+    assert "ownerReferences" not in ds["metadata"]

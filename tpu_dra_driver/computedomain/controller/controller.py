@@ -172,6 +172,9 @@ class ComputeDomainController:
             cd.metadata.name, cd.metadata.namespace, mutate)
 
     def _ensure_children(self, cd: ComputeDomain) -> None:
+        """Create-or-update children to the desired state (a bare create
+        would never propagate spec changes), and delete stale workload RCTs
+        left behind by a rename of spec.channel.resourceClaimTemplate.name."""
         for client, obj in (
             (self._clients.daemonsets, build_daemonset(cd)),
             (self._clients.resource_claim_templates, build_daemon_rct(cd)),
@@ -180,7 +183,19 @@ class ComputeDomainController:
             try:
                 client.create(obj)
             except AlreadyExistsError:
-                pass
+                existing = client.get(obj["metadata"]["name"],
+                                      obj["metadata"].get("namespace", ""))
+                if existing.get("spec") != obj["spec"]:
+                    existing["spec"] = obj["spec"]
+                    client.update(existing)
+        desired_rct = cd.spec.channel.resource_claim_template_name
+        for rct in self._clients.resource_claim_templates.list(
+                namespace=cd.metadata.namespace,
+                label_selector={COMPUTE_DOMAIN_LABEL_KEY: cd.metadata.uid}):
+            name = rct["metadata"]["name"]
+            if name != desired_rct and name != daemon_rct_name(cd):
+                self._clients.resource_claim_templates.delete_ignore_missing(
+                    name, cd.metadata.namespace)
 
     # ------------------------------------------------------------------
     # teardown (finalizer-driven, reference computedomain.go + cleanup.go)

@@ -41,13 +41,12 @@ def build_daemonset(cd: ComputeDomain, image: str = "tpu-dra-driver:latest",
         "metadata": {
             "name": daemonset_name(cd),
             "namespace": DRIVER_NAMESPACE,
+            # No ownerReference: the CD lives in the *user's* namespace and
+            # Kubernetes forbids cross-namespace owners (the GC would treat
+            # the owner as absent and delete this DS). Lifecycle is handled
+            # by the label + finalizer teardown + orphan cleanup, exactly
+            # like the reference controller.
             "labels": {COMPUTE_DOMAIN_LABEL_KEY: uid},
-            "ownerReferences": [{
-                "apiVersion": f"{API_GROUP}/{API_VERSION}",
-                "kind": "ComputeDomain",
-                "name": cd.metadata.name,
-                "uid": uid,
-            }],
         },
         "spec": {
             "selector": {"matchLabels": {COMPUTE_DOMAIN_LABEL_KEY: uid}},

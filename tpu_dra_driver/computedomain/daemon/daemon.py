@@ -56,7 +56,10 @@ class DaemonConfig:
     node_name: str
     pod_name: str
     pod_ip: str
-    hosts_file: str = "/etc/hosts"
+    # The shared host path the CD plugin bind-mounts into workload
+    # containers (CdPluginConfig.hosts_file_dir + "/hosts") — NOT the
+    # daemon pod's own /etc/hosts, which workloads never see.
+    hosts_file: str = "/run/tpu-dra/hosts"
     worker_env_file: str = "/run/tpu-dra/worker-env.json"
     gates: fg.FeatureGates = field(default_factory=fg.FeatureGates)
 

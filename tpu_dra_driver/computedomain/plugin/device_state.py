@@ -290,6 +290,13 @@ class CdDeviceState:
             raise RetryableError(f"clique {clique_name} not found (yet)")
         members = sorted((d for d in cq.daemons if d.index >= 0),
                          key=lambda d: d.index)
+        # The workload must see the COMPLETE world: releasing with fewer
+        # members than spec.numNodes would start a distributed job with the
+        # wrong world size. Transient until everyone has joined.
+        if len(members) < cd.spec.num_nodes:
+            raise RetryableError(
+                f"clique {clique_name}: {len(members)}/{cd.spec.num_nodes} "
+                f"daemons joined")
         return (node_status.index,
                 [d.ip_address for d in members],
                 [worker_name(d.index) for d in members])

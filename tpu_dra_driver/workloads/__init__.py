@@ -1,0 +1,20 @@
+"""workloads — JAX slice-validation workloads (the nickelpie/nvbandwidth analog).
+
+Reference analog: the MNNVL acceptance workloads the reference drives
+through a ComputeDomain (tests/bats/test_cd_mnnvl_workload.bats: a 2-node
+NCCL send/recv job and an MPI nvbandwidth job, asserting a bandwidth
+line). A DRA driver must prove the fabric it wired up actually performs,
+so these are first-class:
+
+- :mod:`ops`      — ICI collective microbenchmarks (psum/all-gather
+  bandwidth) and MXU matmul throughput;
+- :mod:`models`   — a flagship transformer block used as the end-to-end
+  slice acceptance workload;
+- :mod:`parallel` — mesh construction + dp/tp/sp sharding rules for the
+  acceptance workload (pjit/shard_map over jax.sharding.Mesh — the XLA
+  collective path, never hand-rolled comms);
+- :mod:`utils`    — timing helpers.
+
+All workloads are pure JAX: they run identically on a real TPU slice (via
+DRA-injected env) and on a virtual CPU mesh in CI.
+"""

@@ -1,0 +1,7 @@
+from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
+    ModelConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+)

@@ -1,0 +1,125 @@
+"""Flagship acceptance workload: a small transformer LM, TPU-first.
+
+This is the driver's slice-acceptance model (the nickelpie analog with
+real FLOPs): a decoder-only transformer whose training step exercises the
+MXU (bf16 matmuls), HBM (activations), and — under a (dp, tp) mesh — the
+ICI collectives XLA inserts for Megatron-style tensor parallelism.
+
+Design for the hardware:
+- all matmuls bf16, dims multiples of 128 (MXU tiling);
+- params as a plain dict pytree (works with pjit NamedShardings directly);
+- no Python control flow inside jit; static shapes everywhere;
+- loss in fp32 for stable accumulation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 1024
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 1024
+    max_seq: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+Params = Dict
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    keys = jax.random.split(key, cfg.n_layers * 4 + 2)
+    k = iter(keys)
+    scale = 0.02
+
+    def mat(kk, shape):
+        return (scale * jax.random.normal(kk, shape)).astype(cfg.dtype)
+
+    params: Params = {
+        "embed": mat(next(k), (cfg.vocab, cfg.d_model)),
+        "pos_embed": mat(next(k), (cfg.max_seq, cfg.d_model)),
+        "layers": [],
+        "final_norm": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "ln1": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
+            "wqkv": mat(next(k), (cfg.d_model, 3 * cfg.d_model)),
+            "wo": mat(next(k), (cfg.d_model, cfg.d_model)),
+            "ln2": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
+            "w_up": mat(next(k), (cfg.d_model, cfg.d_ff)),
+            "w_down": mat(next(k), (cfg.d_ff, cfg.d_model)),
+        })
+    return params
+
+
+def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return ((x32 * rms) * g).astype(x.dtype)
+
+
+def _attention(x: jax.Array, layer: Params, n_heads: int) -> jax.Array:
+    b, t, d = x.shape
+    qkv = x @ layer["wqkv"]                      # MXU: [b,t,3d]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    hd = d // n_heads
+
+    def heads(z):
+        return z.reshape(b, t, n_heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = (q @ k.transpose(0, 1, 3, 2)).astype(jnp.float32) / (hd ** 0.5)
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(causal, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = (probs @ v).transpose(0, 2, 1, 3).reshape(b, t, d)
+    return out @ layer["wo"]
+
+
+def _mlp(x: jax.Array, layer: Params) -> jax.Array:
+    return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
+
+
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
+    b, t = tokens.shape
+    x = params["embed"][tokens] + params["pos_embed"][:t]
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer, cfg.n_heads)
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]["g"]), layer)
+    x = _rmsnorm(x, params["final_norm"]["g"])
+    return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
+            cfg: ModelConfig) -> jax.Array:
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: ModelConfig, optimizer=None):
+    """Returns (train_step, init_opt_state). train_step is pure/jittable:
+    (params, opt_state, batch) -> (params, opt_state, loss)."""
+    opt = optimizer or optax.adamw(1e-3)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, opt.init

@@ -1,0 +1,6 @@
+from tpu_dra_driver.workloads.ops.collectives import (  # noqa: F401
+    psum_bandwidth,
+    all_gather_bandwidth,
+    matmul_tflops,
+    matmul_tflops_steady,
+)

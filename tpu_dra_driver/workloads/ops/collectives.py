@@ -1,0 +1,138 @@
+"""ICI collective + MXU microbenchmarks.
+
+Reference analog: the nvbandwidth/nickelpie jobs (bats
+test_cd_mnnvl_workload.bats) that prove the fabric the driver wired up
+moves bytes. Here: ``lax.psum`` / all-gather over a device mesh
+(shard_map so the collective is explicit and measurable) and a bf16
+matmul for MXU throughput. These produce the numbers BASELINE.md targets
+(≥90% of raw ICI all-reduce bandwidth on a DRA-scheduled slice — the
+benchmark *is* the acceptance test).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dra_driver.workloads.utils.timing import Timed, time_fn
+
+
+@dataclass
+class BandwidthResult:
+    bytes_per_device: int
+    median_s: float
+    algo_gbps: float          # algorithm bandwidth: payload / time
+    bus_gbps: float           # ring-corrected bus bandwidth per device
+
+    def __str__(self) -> str:
+        return (f"RESULT bandwidth: {self.bus_gbps:.2f} GB/s "
+                f"(algo {self.algo_gbps:.2f} GB/s, "
+                f"{self.bytes_per_device >> 20} MiB/device, "
+                f"t={self.median_s*1e3:.2f} ms)")
+
+
+def _mesh1d(devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devs), axis_names=("x",))
+
+
+def psum_bandwidth(mib_per_device: int = 64,
+                   devices: Optional[Sequence] = None,
+                   dtype=jnp.float32, iters: int = 5) -> BandwidthResult:
+    """All-reduce (lax.psum) bandwidth over a 1-D mesh.
+
+    Bus bandwidth uses the ring all-reduce correction 2*(n-1)/n — the same
+    accounting nccl-tests/nvbandwidth report, so numbers are comparable to
+    the reference's jobs.
+    """
+    mesh = _mesh1d(devices)
+    n = mesh.devices.size
+    elems = (mib_per_device << 20) // jnp.dtype(dtype).itemsize
+    x = jnp.ones((n, elems), dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("x", None),
+             out_specs=P("x", None))
+    def allreduce(shard):
+        return jax.lax.psum(shard, "x")
+
+    timed = time_fn(lambda: allreduce(x), warmup=2, iters=iters)
+    payload = elems * jnp.dtype(dtype).itemsize
+    algo = payload / timed.median_s / 1e9
+    bus = algo * (2 * (n - 1) / n)
+    return BandwidthResult(payload, timed.median_s, algo, bus)
+
+
+def all_gather_bandwidth(mib_per_device: int = 64,
+                         devices: Optional[Sequence] = None,
+                         dtype=jnp.float32, iters: int = 5) -> BandwidthResult:
+    mesh = _mesh1d(devices)
+    n = mesh.devices.size
+    elems = (mib_per_device << 20) // jnp.dtype(dtype).itemsize
+    x = jnp.ones((n, elems), dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("x", None),
+             out_specs=P("x", None))
+    def gather(shard):
+        return jax.lax.all_gather(shard, "x", axis=0).reshape(1, -1)
+
+    timed = time_fn(lambda: gather(x), warmup=2, iters=iters)
+    payload = elems * jnp.dtype(dtype).itemsize
+    algo = payload / timed.median_s / 1e9
+    bus = algo * ((n - 1) / n)
+    return BandwidthResult(payload, timed.median_s, algo, bus)
+
+
+@dataclass
+class MatmulResult:
+    m: int
+    median_s: float
+    tflops: float
+
+    def __str__(self) -> str:
+        return f"RESULT matmul: {self.tflops:.2f} TFLOP/s (m={self.m}, t={self.median_s*1e3:.2f} ms)"
+
+
+def matmul_tflops(m: int = 4096, dtype=jnp.bfloat16, iters: int = 5,
+                  chain: int = 16) -> MatmulResult:
+    """Square bf16 matmul throughput — the MXU sanity number.
+
+    A *dependent* chain of ``chain`` matmuls runs inside one jit so the
+    per-call host↔device round trip (large on tunneled remote devices) is
+    amortized; normalization between steps keeps values finite without
+    leaving the MXU idle.
+    """
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, m), dtype)
+    b = jax.random.normal(key, (m, m), dtype) * (1.0 / m ** 0.5)
+
+    @jax.jit
+    def mm_chain(a, b):
+        def body(_, x):
+            return (x @ b).astype(dtype)
+        return jax.lax.fori_loop(0, chain, body, a)
+
+    timed = time_fn(lambda: mm_chain(a, b), warmup=2, iters=iters)
+    flops = 2 * m * m * m * chain
+    return MatmulResult(m, timed.median_s, flops / timed.median_s / 1e12)
+
+
+def matmul_tflops_steady(m: int = 8192, dtype=jnp.bfloat16,
+                         iters: int = 3) -> MatmulResult:
+    """Steady-state MXU throughput with fixed dispatch/transport overhead
+    subtracted: time chains of two lengths and use the marginal rate."""
+    short = matmul_tflops(m, dtype, iters, chain=16)
+    long = matmul_tflops(m, dtype, iters, chain=64)
+    dt = long.median_s - short.median_s
+    flops = 2 * m * m * m * (64 - 16)
+    tflops = flops / dt / 1e12 if dt > 0 else long.tflops
+    return MatmulResult(m, dt / (64 - 16), tflops)

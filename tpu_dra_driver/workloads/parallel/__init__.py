@@ -1,0 +1,6 @@
+from tpu_dra_driver.workloads.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    batch_sharding,
+    replicated,
+    param_shardings,
+)

@@ -1,0 +1,1 @@
+from tpu_dra_driver.workloads.utils.timing import time_fn, Timed  # noqa: F401

@@ -1,0 +1,41 @@
+"""Benchmark timing helpers: warmup + block_until_ready + median."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List
+
+import jax
+
+
+@dataclass
+class Timed:
+    median_s: float
+    best_s: float
+    times_s: List[float]
+
+
+def _sync(out) -> None:
+    """Force true completion: block_until_ready, then read one element back to
+    the host. Some remote-device transports ack block_until_ready before
+    the computation has finished; a device_get of output data cannot lie."""
+    jax.block_until_ready(out)
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        leaf = leaves[0]
+        if hasattr(leaf, "ndim"):
+            jax.device_get(leaf.ravel()[:1] if leaf.ndim else leaf)
+
+
+def time_fn(fn: Callable[[], Any], warmup: int = 2, iters: int = 5) -> Timed:
+    """Time ``fn`` (which returns jax arrays); compile/warmup excluded."""
+    for _ in range(warmup):
+        _sync(fn())
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(fn())
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return Timed(median_s=times[len(times) // 2], best_s=times[0], times_s=times)
