@@ -1,0 +1,539 @@
+// tpudevlib implementation. See tpudev.h for the design notes.
+//
+// Reference analog for mechanisms:
+//  - enumeration:     cmd/gpu-kubelet-plugin/nvlib.go:170-310 (via NVML);
+//                     here a direct sysfs PCI walk (vendor 0x1ae0).
+//  - partitions:      nvlib.go:860-1124 MIG create/delete (via NVML); here
+//                     a flock'd on-disk registry (TPU partitioning is
+//                     runtime config, not a hardware object).
+//  - vfio flips:      scripts/bind_to_driver.sh + vfio-device.go:239-267
+//                     (driver_override + unbind/bind via sysfs).
+//  - fuser analog:    vfio-device.go "wait until free" check; here a
+//                     /proc/<pid>/fd scan.
+
+#include "tpudev.h"
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kVersion = "tpudevlib 0.1.0";
+constexpr unsigned kGoogleVendor = 0x1ae0;
+
+struct GenInfo {
+  unsigned device_id;
+  int generation;
+  int cores;
+  int64_t hbm_bytes;
+};
+
+constexpr int64_t GiB = 1024LL * 1024 * 1024;
+
+// Device-id → generation table. Unknown Google accelerator device ids
+// default to the newest generation profile so enumeration never drops a
+// chip on the floor.
+const GenInfo kGenTable[] = {
+    {0x005e, TPUDEV_GEN_V4, 2, 32 * GiB},
+    {0x0062, TPUDEV_GEN_V5P, 2, 95 * GiB},
+    {0x0063, TPUDEV_GEN_V5E, 1, 16 * GiB},
+    {0x006f, TPUDEV_GEN_V6E, 1, 32 * GiB},
+};
+
+void set_err(char* err, int errlen, const char* fmt, ...) {
+  if (!err || errlen <= 0) return;
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(err, errlen, fmt, ap);
+  va_end(ap);
+}
+
+bool read_file(const std::string& path, std::string* out) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return false;
+  char buf[4096];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = 0;
+  *out = buf;
+  while (!out->empty() && (out->back() == '\n' || out->back() == ' '))
+    out->pop_back();
+  return true;
+}
+
+bool write_file(const std::string& path, const std::string& content) {
+  FILE* f = fopen(path.c_str(), "w");
+  if (!f) return false;
+  size_t n = fwrite(content.data(), 1, content.size(), f);
+  int rc = fclose(f);
+  return n == content.size() && rc == 0;
+}
+
+unsigned parse_hex(const std::string& s) {
+  return static_cast<unsigned>(strtoul(s.c_str(), nullptr, 16));
+}
+
+std::string basename_of(const std::string& p) {
+  auto pos = p.find_last_of('/');
+  return pos == std::string::npos ? p : p.substr(pos + 1);
+}
+
+std::string readlink_base(const std::string& path) {
+  char buf[512];
+  ssize_t n = readlink(path.c_str(), buf, sizeof(buf) - 1);
+  if (n <= 0) return "";
+  buf[n] = 0;
+  return basename_of(buf);
+}
+
+uint64_t fnv1a(const std::string& s) {
+  uint64_t h = 1469598103934665603ULL;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// partition registry: newline-delimited fixed-field records under flock
+// ---------------------------------------------------------------------------
+
+struct Part {
+  int parent, cores, start;
+  int64_t id;
+  std::string uuid, devfs;
+};
+
+std::string part_line(const Part& p) {
+  char buf[320];
+  snprintf(buf, sizeof(buf), "%d %d %d %lld %s %s\n", p.parent, p.cores,
+           p.start, static_cast<long long>(p.id), p.uuid.c_str(),
+           p.devfs.c_str());
+  return buf;
+}
+
+bool parse_part_line(const std::string& line, Part* p) {
+  char uuid[96] = {0}, devfs[96] = {0};
+  long long id = 0;
+  // devfs is the last field and captures to end-of-line so paths with
+  // spaces survive the round trip (uuids are generated space-free)
+  if (sscanf(line.c_str(), "%d %d %d %lld %95s %95[^\n]", &p->parent,
+             &p->cores, &p->start, &id, uuid, devfs) != 6)
+    return false;
+  p->id = id;
+  p->uuid = uuid;
+  p->devfs = devfs;
+  return true;
+}
+
+class RegistryLock {
+ public:
+  explicit RegistryLock(const std::string& state_dir) {
+    mkdir(state_dir.c_str(), 0755);
+    path_ = state_dir + "/partitions.lock";
+    fd_ = open(path_.c_str(), O_CREAT | O_RDWR, 0644);
+    if (fd_ >= 0) flock(fd_, LOCK_EX);
+  }
+  ~RegistryLock() {
+    if (fd_ >= 0) {
+      flock(fd_, LOCK_UN);
+      close(fd_);
+    }
+  }
+  bool ok() const { return fd_ >= 0; }
+
+ private:
+  std::string path_;
+  int fd_ = -1;
+};
+
+std::string registry_path(const std::string& state_dir) {
+  return state_dir + "/partitions.tab";
+}
+
+// Monotonic id source persisted beside the registry so destroyed
+// partitions' ids (and the uuids embedding them) are never reused — a
+// stale checkpoint must not match a later partition.
+int64_t next_partition_id(const std::string& state_dir) {
+  std::string path = state_dir + "/partitions.next_id";
+  std::string content;
+  int64_t next = 1;
+  if (read_file(path, &content)) next = atoll(content.c_str());
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%lld\n", static_cast<long long>(next + 1));
+  write_file(path, buf);
+  return next;
+}
+
+bool load_parts(const std::string& state_dir, std::vector<Part>* out) {
+  std::string content;
+  if (!read_file(registry_path(state_dir), &content)) return true;  // empty
+  size_t pos = 0;
+  while (pos < content.size()) {
+    size_t nl = content.find('\n', pos);
+    std::string line = content.substr(pos, nl == std::string::npos
+                                                ? std::string::npos
+                                                : nl - pos);
+    pos = nl == std::string::npos ? content.size() : nl + 1;
+    if (line.empty()) continue;
+    Part p;
+    if (parse_part_line(line, &p)) out->push_back(p);
+  }
+  return true;
+}
+
+bool store_parts(const std::string& state_dir, const std::vector<Part>& parts) {
+  std::string content;
+  for (const auto& p : parts) content += part_line(p);
+  std::string tmp = registry_path(state_dir) + ".tmp";
+  if (!write_file(tmp, content)) return false;
+  return rename(tmp.c_str(), registry_path(state_dir).c_str()) == 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// enumeration
+// ---------------------------------------------------------------------------
+
+extern "C" int tpudev_enumerate(const char* sysfs_root, const char* devfs_root,
+                                tpudev_chip_t* out, int max_out,
+                                char* err, int errlen) {
+  std::string pci_dir = std::string(sysfs_root) + "/bus/pci/devices";
+  DIR* d = opendir(pci_dir.c_str());
+  if (!d) {
+    set_err(err, errlen, "cannot open %s: %s", pci_dir.c_str(),
+            strerror(errno));
+    return -1;
+  }
+  std::vector<tpudev_chip_t> chips;
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    std::string name = ent->d_name;
+    if (name == "." || name == "..") continue;
+    std::string dev_dir = pci_dir + "/" + name;
+    std::string vendor;
+    if (!read_file(dev_dir + "/vendor", &vendor)) continue;
+    if (parse_hex(vendor) != kGoogleVendor) continue;
+    std::string device;
+    read_file(dev_dir + "/device", &device);
+    unsigned dev_id = parse_hex(device);
+
+    tpudev_chip_t c;
+    memset(&c, 0, sizeof(c));
+    snprintf(c.pci_address, sizeof(c.pci_address), "%s", name.c_str());
+    // pci root: domain+bus prefix ("0000:00:05.0" -> "pci0000:00")
+    snprintf(c.pci_root, sizeof(c.pci_root), "pci%.7s", name.c_str());
+
+    c.generation = TPUDEV_GEN_V5P;  // conservative default: newest profile
+    c.cores = 2;
+    c.hbm_bytes = 95 * GiB;
+    for (const auto& g : kGenTable) {
+      if (g.device_id == dev_id) {
+        c.generation = g.generation;
+        c.cores = g.cores;
+        c.hbm_bytes = g.hbm_bytes;
+        break;
+      }
+    }
+
+    std::string driver = readlink_base(dev_dir + "/driver");
+    snprintf(c.driver, sizeof(c.driver), "%s", driver.c_str());
+
+    // accel minor via the accel/ subdir (accelN)
+    c.index = -1;
+    std::string accel_dir = dev_dir + "/accel";
+    if (DIR* ad = opendir(accel_dir.c_str())) {
+      struct dirent* ae;
+      while ((ae = readdir(ad)) != nullptr) {
+        if (strncmp(ae->d_name, "accel", 5) == 0 && isdigit(ae->d_name[5]))
+          c.index = atoi(ae->d_name + 5);
+      }
+      closedir(ad);
+    }
+
+    std::string serial;
+    if (!read_file(dev_dir + "/serial", &serial) || serial.empty()) {
+      char fallback[64];
+      snprintf(fallback, sizeof(fallback), "TPU%016llx",
+               static_cast<unsigned long long>(fnv1a(name)));
+      serial = fallback;
+    }
+    snprintf(c.serial, sizeof(c.serial), "%s", serial.c_str());
+    snprintf(c.uuid, sizeof(c.uuid), "TPU-%016llx%016llx",
+             static_cast<unsigned long long>(fnv1a(serial)),
+             static_cast<unsigned long long>(fnv1a(name + serial)));
+
+    if (driver == "vfio-pci") {
+      std::string group = readlink_base(dev_dir + "/iommu_group");
+      snprintf(c.vfio_group, sizeof(c.vfio_group), "%s/vfio/%s", devfs_root,
+               group.c_str());
+      snprintf(c.devfs_path, sizeof(c.devfs_path), "%s", c.vfio_group);
+    } else if (c.index >= 0) {
+      snprintf(c.devfs_path, sizeof(c.devfs_path), "%s/accel%d", devfs_root,
+               c.index);
+    }
+    chips.push_back(c);
+  }
+  closedir(d);
+
+  // Chips bound to vfio-pci have no accel minor (index stays -1): the
+  // Python wrapper resolves their STABLE index from its persisted
+  // pci→index map, so device identity (tpu-<index>) survives driver
+  // flips. Sort by PCI address for deterministic output order.
+  std::sort(chips.begin(), chips.end(),
+            [](const tpudev_chip_t& a, const tpudev_chip_t& b) {
+              return strcmp(a.pci_address, b.pci_address) < 0;
+            });
+
+  int n = std::min<int>(chips.size(), max_out);
+  for (int i = 0; i < n; i++) out[i] = chips[i];
+  if (static_cast<int>(chips.size()) > max_out) {
+    set_err(err, errlen, "buffer too small: %zu chips, max %d", chips.size(),
+            max_out);
+    return -2;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// partitions
+// ---------------------------------------------------------------------------
+
+extern "C" int tpudev_partition_create(const char* state_dir,
+                                       const char* devfs_root,
+                                       int parent_index, int cores,
+                                       int placement_start,
+                                       int parent_total_cores,
+                                       tpudev_partition_t* out, char* err,
+                                       int errlen) {
+  if (cores <= 0 || placement_start < 0 ||
+      placement_start + cores > parent_total_cores) {
+    set_err(err, errlen,
+            "invalid placement: start=%d cores=%d parent has %d cores",
+            placement_start, cores, parent_total_cores);
+    return -1;
+  }
+  RegistryLock lock(state_dir);
+  if (!lock.ok()) {
+    set_err(err, errlen, "cannot lock registry in %s", state_dir);
+    return -1;
+  }
+  std::vector<Part> parts;
+  load_parts(state_dir, &parts);
+  for (const auto& p : parts) {
+    if (p.parent != parent_index) continue;
+    int lo = placement_start, hi = placement_start + cores;
+    int plo = p.start, phi = p.start + p.cores;
+    if (lo < phi && plo < hi) {
+      set_err(err, errlen,
+              "placement [%d,%d) overlaps live partition [%d,%d) on chip %d",
+              lo, hi, plo, phi, parent_index);
+      return -2;  // EEXIST-like
+    }
+  }
+  Part p;
+  p.parent = parent_index;
+  p.cores = cores;
+  p.start = placement_start;
+  p.id = next_partition_id(state_dir);
+  char uuid[96];
+  snprintf(uuid, sizeof(uuid), "TPUSS-%d-%d-%d-%lld", parent_index, cores,
+           placement_start, static_cast<long long>(p.id));
+  p.uuid = uuid;
+  char devfs[96];
+  snprintf(devfs, sizeof(devfs), "%s/accel%d_pt%d", devfs_root, parent_index,
+           placement_start);
+  p.devfs = devfs;
+  parts.push_back(p);
+  if (!store_parts(state_dir, parts)) {
+    set_err(err, errlen, "cannot write registry in %s", state_dir);
+    return -1;
+  }
+  if (out) {
+    memset(out, 0, sizeof(*out));
+    out->parent_index = p.parent;
+    out->cores = p.cores;
+    out->placement_start = p.start;
+    out->partition_id = p.id;
+    snprintf(out->uuid, sizeof(out->uuid), "%s", p.uuid.c_str());
+    snprintf(out->devfs_path, sizeof(out->devfs_path), "%s", p.devfs.c_str());
+  }
+  return 0;
+}
+
+extern "C" int tpudev_partition_destroy(const char* state_dir,
+                                        int parent_index, int cores,
+                                        int placement_start, char* err,
+                                        int errlen) {
+  RegistryLock lock(state_dir);
+  if (!lock.ok()) {
+    set_err(err, errlen, "cannot lock registry in %s", state_dir);
+    return -1;
+  }
+  std::vector<Part> parts;
+  load_parts(state_dir, &parts);
+  size_t before = parts.size();
+  parts.erase(std::remove_if(parts.begin(), parts.end(),
+                             [&](const Part& p) {
+                               return p.parent == parent_index &&
+                                      p.cores == cores &&
+                                      p.start == placement_start;
+                             }),
+              parts.end());
+  if (parts.size() == before) {
+    set_err(err, errlen, "no live partition chip=%d cores=%d start=%d",
+            parent_index, cores, placement_start);
+    return -3;  // ENOENT-like
+  }
+  if (!store_parts(state_dir, parts)) {
+    set_err(err, errlen, "cannot write registry in %s", state_dir);
+    return -1;
+  }
+  return 0;
+}
+
+extern "C" int tpudev_partition_list(const char* state_dir,
+                                     tpudev_partition_t* out, int max_out,
+                                     char* err, int errlen) {
+  RegistryLock lock(state_dir);
+  if (!lock.ok()) {
+    set_err(err, errlen, "cannot lock registry in %s", state_dir);
+    return -1;
+  }
+  std::vector<Part> parts;
+  load_parts(state_dir, &parts);
+  int n = std::min<int>(parts.size(), max_out);
+  for (int i = 0; i < n; i++) {
+    memset(&out[i], 0, sizeof(out[i]));
+    out[i].parent_index = parts[i].parent;
+    out[i].cores = parts[i].cores;
+    out[i].placement_start = parts[i].start;
+    out[i].partition_id = parts[i].id;
+    snprintf(out[i].uuid, sizeof(out[i].uuid), "%s", parts[i].uuid.c_str());
+    snprintf(out[i].devfs_path, sizeof(out[i].devfs_path), "%s",
+             parts[i].devfs.c_str());
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// vfio
+// ---------------------------------------------------------------------------
+
+extern "C" int tpudev_vfio_bind(const char* sysfs_root,
+                                const char* pci_address, int verify,
+                                char* group_out, int group_len, char* err,
+                                int errlen) {
+  std::string dev_dir =
+      std::string(sysfs_root) + "/bus/pci/devices/" + pci_address;
+  if (!write_file(dev_dir + "/driver_override", "vfio-pci\n")) {
+    set_err(err, errlen, "cannot write driver_override for %s", pci_address);
+    return -1;
+  }
+  std::string cur = readlink_base(dev_dir + "/driver");
+  if (!cur.empty() && cur != "vfio-pci") {
+    write_file(dev_dir + "/driver/unbind", pci_address);
+  }
+  if (readlink_base(dev_dir + "/driver") != "vfio-pci") {
+    // try the explicit bind first, then drivers_probe
+    std::string bind =
+        std::string(sysfs_root) + "/bus/pci/drivers/vfio-pci/bind";
+    if (!write_file(bind, pci_address)) {
+      write_file(std::string(sysfs_root) + "/bus/pci/drivers_probe",
+                 pci_address);
+    }
+  }
+  if (verify && readlink_base(dev_dir + "/driver") != "vfio-pci") {
+    // roll the override back so the original driver can reclaim the device
+    // on the next probe instead of leaving it pinned to an absent vfio-pci
+    write_file(dev_dir + "/driver_override", "\n");
+    write_file(std::string(sysfs_root) + "/bus/pci/drivers_probe",
+               pci_address);
+    set_err(err, errlen,
+            "device %s did not bind to vfio-pci (module loaded?)",
+            pci_address);
+    return -4;
+  }
+  std::string group = readlink_base(dev_dir + "/iommu_group");
+  if (group.empty()) {
+    set_err(err, errlen, "no iommu_group for %s (IOMMU enabled?)",
+            pci_address);
+    return -1;
+  }
+  snprintf(group_out, group_len, "/dev/vfio/%s", group.c_str());
+  return 0;
+}
+
+extern "C" int tpudev_vfio_unbind(const char* sysfs_root,
+                                  const char* pci_address, char* err,
+                                  int errlen) {
+  std::string dev_dir =
+      std::string(sysfs_root) + "/bus/pci/devices/" + pci_address;
+  if (!write_file(dev_dir + "/driver_override", "\n")) {
+    set_err(err, errlen, "cannot clear driver_override for %s", pci_address);
+    return -1;
+  }
+  if (readlink_base(dev_dir + "/driver") == "vfio-pci") {
+    write_file(dev_dir + "/driver/unbind", pci_address);
+  }
+  write_file(std::string(sysfs_root) + "/bus/pci/drivers_probe", pci_address);
+  return 0;
+}
+
+extern "C" int tpudev_current_driver(const char* sysfs_root,
+                                     const char* pci_address, char* out,
+                                     int outlen) {
+  std::string dev_dir =
+      std::string(sysfs_root) + "/bus/pci/devices/" + pci_address;
+  std::string driver = readlink_base(dev_dir + "/driver");
+  snprintf(out, outlen, "%s", driver.c_str());
+  return driver.empty() ? 1 : 0;
+}
+
+extern "C" int tpudev_device_in_use(const char* proc_root,
+                                    const char* devfs_path) {
+  DIR* d = opendir(proc_root);
+  if (!d) return 0;
+  struct dirent* ent;
+  int in_use = 0;
+  while (!in_use && (ent = readdir(d)) != nullptr) {
+    if (!isdigit(ent->d_name[0])) continue;
+    std::string fd_dir = std::string(proc_root) + "/" + ent->d_name + "/fd";
+    DIR* fd = opendir(fd_dir.c_str());
+    if (!fd) continue;
+    struct dirent* fe;
+    while ((fe = readdir(fd)) != nullptr) {
+      if (fe->d_name[0] == '.') continue;
+      char buf[512];
+      std::string link = fd_dir + "/" + fe->d_name;
+      ssize_t n = readlink(link.c_str(), buf, sizeof(buf) - 1);
+      if (n > 0) {
+        buf[n] = 0;
+        if (strcmp(buf, devfs_path) == 0) {
+          in_use = 1;
+          break;
+        }
+      }
+    }
+    closedir(fd);
+  }
+  closedir(d);
+  return in_use;
+}
+
+extern "C" const char* tpudev_version(void) { return kVersion; }

@@ -1,0 +1,102 @@
+/* tpudevlib — native TPU device enumeration, partitioning, and vfio flips.
+ *
+ * Reference analog: the cgo→NVML boundary (go-nvml/go-nvlib) of
+ * cmd/gpu-kubelet-plugin. For TPUs the hardware surface is:
+ *   - PCI:   <sysfs>/bus/pci/devices/<addr>/{vendor,device,driver} with
+ *            Google vendor id 0x1ae0,
+ *   - devfs: /dev/accel<N> (TPU runtime driver) or /dev/vfio/<group>,
+ *   - accel: <sysfs>/bus/pci/devices/<addr>/accel/accel<N> linking a PCI
+ *            function to its accel minor,
+ *   - vfio:  driver_override + unbind/bind via sysfs (the same mechanism
+ *            as the reference's scripts/bind_to_driver.sh),
+ *   - partitions: unlike MIG, TPU sub-chip (megacore) partitioning is a
+ *            runtime-configuration property, not a hardware object — the
+ *            native layer therefore owns a crash-safe on-disk occupancy
+ *            REGISTRY (flock'd JSONL) whose entries survive plugin
+ *            restarts, giving the driver MIG-equivalent create/list/
+ *            destroy semantics with canonical-name round-tripping.
+ *
+ * All functions return 0 on success, negative on error; err/errlen gets a
+ * human-readable message. The library is thread-compatible: callers
+ * serialize per state_dir (the Python wrapper holds the plugin's locks).
+ */
+
+#ifndef TPUDEVLIB_TPUDEV_H_
+#define TPUDEVLIB_TPUDEV_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum tpudev_generation {
+  TPUDEV_GEN_UNKNOWN = 0,
+  TPUDEV_GEN_V4 = 4,
+  TPUDEV_GEN_V5E = 50,
+  TPUDEV_GEN_V5P = 51,
+  TPUDEV_GEN_V6E = 60,
+};
+
+typedef struct {
+  int32_t index;            /* accel minor */
+  char pci_address[32];     /* 0000:00:05.0 */
+  char pci_root[32];
+  char devfs_path[96];      /* /dev/accel<N> or /dev/vfio/<group> */
+  char vfio_group[96];      /* empty if bound to the runtime driver */
+  char driver[32];          /* current kernel driver name */
+  int32_t generation;       /* tpudev_generation */
+  int32_t cores;            /* TensorCores on this chip */
+  int64_t hbm_bytes;
+  char serial[64];
+  char uuid[96];            /* stable: derived from serial|pci path */
+} tpudev_chip_t;
+
+typedef struct {
+  int32_t parent_index;
+  int32_t cores;
+  int32_t placement_start;
+  int64_t partition_id;
+  char uuid[96];
+  char devfs_path[96];
+} tpudev_partition_t;
+
+/* Enumerate TPU chips under sysfs_root (e.g. "/sys"). Returns count or <0. */
+int tpudev_enumerate(const char* sysfs_root, const char* devfs_root,
+                     tpudev_chip_t* out, int max_out,
+                     char* err, int errlen);
+
+/* Partition registry (state_dir/partitions.jsonl, flock'd). */
+int tpudev_partition_create(const char* state_dir, const char* devfs_root,
+                            int parent_index, int cores, int placement_start,
+                            int parent_total_cores,
+                            tpudev_partition_t* out, char* err, int errlen);
+int tpudev_partition_destroy(const char* state_dir, int parent_index,
+                             int cores, int placement_start,
+                             char* err, int errlen);
+int tpudev_partition_list(const char* state_dir, tpudev_partition_t* out,
+                          int max_out, char* err, int errlen);
+
+/* vfio passthrough flips (driver_override mechanism). With verify != 0,
+ * the call fails unless the device actually ends up bound to vfio-pci
+ * (e.g. module not loaded) — always set it against a real kernel; test
+ * harnesses with inert sysfs trees pass 0. */
+int tpudev_vfio_bind(const char* sysfs_root, const char* pci_address,
+                     int verify, char* group_out, int group_len,
+                     char* err, int errlen);
+int tpudev_vfio_unbind(const char* sysfs_root, const char* pci_address,
+                       char* err, int errlen);
+int tpudev_current_driver(const char* sysfs_root, const char* pci_address,
+                          char* out, int outlen);
+
+/* True (1) if any process holds the device node open (fuser analog:
+ * scans /proc/<pid>/fd). proc_root normally "/proc". */
+int tpudev_device_in_use(const char* proc_root, const char* devfs_path);
+
+const char* tpudev_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUDEVLIB_TPUDEV_H_ */

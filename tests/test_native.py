@@ -1,0 +1,300 @@
+"""Tests for the C++ native device library (libtpudev.so) through the
+NativeTpuLib ctypes wrapper, against a constructed sysfs/devfs/proc tree.
+
+Builds the library on demand (`make -C native`); skips if no C++ toolchain.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "native", "libtpudev.so")
+
+
+def _ensure_lib():
+    if os.path.exists(LIB):
+        return True
+    if shutil.which("g++") is None:
+        return False
+    return subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                          capture_output=True).returncode == 0
+
+
+pytestmark = pytest.mark.skipif(not _ensure_lib(),
+                                reason="libtpudev.so unavailable (no g++)")
+
+
+def _mk_sysfs(root, n_chips=4, device_id="0x0062", with_driver=True):
+    """Fabricate the sysfs shape the library walks."""
+    pci = os.path.join(root, "bus", "pci")
+    drivers = os.path.join(pci, "drivers", "gtpu")
+    vfio_drv = os.path.join(pci, "drivers", "vfio-pci")
+    os.makedirs(drivers)
+    os.makedirs(vfio_drv)
+    groups = os.path.join(root, "kernel", "iommu_groups")
+    for i in range(n_chips):
+        addr = f"0000:00:{4+i:02x}.0"
+        dev = os.path.join(pci, "devices", addr)
+        os.makedirs(os.path.join(dev, "accel", f"accel{i}"))
+        open(os.path.join(dev, "vendor"), "w").write("0x1ae0\n")
+        open(os.path.join(dev, "device"), "w").write(f"{device_id}\n")
+        open(os.path.join(dev, "serial"), "w").write(f"SER{i:04d}\n")
+        gdir = os.path.join(groups, str(10 + i))
+        os.makedirs(gdir, exist_ok=True)
+        os.symlink(gdir, os.path.join(dev, "iommu_group"))
+        if with_driver:
+            os.symlink(drivers, os.path.join(dev, "driver"))
+        # writable sysfs control files
+        open(os.path.join(dev, "driver_override"), "w").write("\n")
+    # a non-Google device that must be ignored
+    other = os.path.join(pci, "devices", "0000:00:1f.0")
+    os.makedirs(other)
+    open(os.path.join(other, "vendor"), "w").write("0x10de\n")
+    return root
+
+
+@pytest.fixture
+def native_lib(tmp_path):
+    from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
+    sysfs = _mk_sysfs(str(tmp_path / "sys"))
+    lib = NativeTpuLib(NativeSystemConfig(
+        sysfs_root=sysfs,
+        devfs_root=str(tmp_path / "dev"),
+        proc_root=str(tmp_path / "proc"),
+        state_dir=str(tmp_path / "native-state"),
+        accelerator_type="v5p-8",
+        host_index=0,
+        slice_id="slice-test",
+        strict_vfio_verify=False,  # inert sysfs: no kernel to flip drivers
+    ))
+    yield lib
+    lib.close()
+
+
+def test_native_enumeration(native_lib, tmp_path):
+    chips = native_lib.enumerate_chips()
+    assert len(chips) == 4  # the 0x10de device was ignored
+    c0 = chips[0]
+    assert c0.index == 0
+    assert c0.generation.name == "v5p"
+    assert c0.hbm_bytes == 95 * (1 << 30)
+    assert c0.devfs_path == str(tmp_path / "dev") + "/accel0"
+    assert c0.uuid.startswith("TPU-")
+    assert c0.serial == "SER0000"
+    assert c0.vfio_group is None
+    # stable across calls
+    assert [c.uuid for c in native_lib.enumerate_chips()] == [c.uuid for c in chips]
+    assert c0.coords in {(0, 0, 0), (0, 0, 1)} or len(c0.coords) == 3
+
+
+def test_native_generation_table(tmp_path):
+    from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
+    sysfs = _mk_sysfs(str(tmp_path / "sys"), n_chips=4, device_id="0x0063")
+    lib = NativeTpuLib(NativeSystemConfig(
+        sysfs_root=sysfs, devfs_root=str(tmp_path / "dev"),
+        state_dir=str(tmp_path / "ns"), accelerator_type="v5e-4"))
+    chips = lib.enumerate_chips()
+    assert chips[0].generation.name == "v5e"
+    assert chips[0].hbm_bytes == 16 * (1 << 30)
+    lib.close()
+
+
+def test_native_partition_lifecycle_and_persistence(native_lib, tmp_path):
+    from tpu_dra_driver.tpulib.interface import (
+        SubsliceAlreadyExistsError,
+        SubsliceNotFoundError,
+    )
+    from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
+    from tpu_dra_driver.tpulib.partition import SubsliceProfile, SubsliceSpec
+
+    chips = native_lib.enumerate_chips()
+    prof = SubsliceProfile(chips[0].generation, 1)
+    live = native_lib.create_subslice(SubsliceSpec(0, chips[0].uuid, prof, 0))
+    assert live.devfs_path.endswith("accel0_pt0")
+    with pytest.raises(SubsliceAlreadyExistsError):
+        native_lib.create_subslice(SubsliceSpec(0, chips[0].uuid, prof, 0))
+    prof2 = SubsliceProfile(chips[0].generation, 2)
+    with pytest.raises(SubsliceAlreadyExistsError):
+        native_lib.create_subslice(SubsliceSpec(0, chips[0].uuid, prof2, 0))
+    native_lib.create_subslice(SubsliceSpec(0, chips[0].uuid, prof, 1))
+    names = [l.spec_tuple.canonical_name() for l in native_lib.list_subslices()]
+    assert names == ["tpu-0-ss-1c47g-0", "tpu-0-ss-1c47g-1"]
+
+    # registry persists across process/library instances (crash recovery)
+    lib2 = NativeTpuLib(NativeSystemConfig(
+        sysfs_root=native_lib._cfg.sysfs_root,
+        devfs_root=native_lib._cfg.devfs_root,
+        state_dir=native_lib._cfg.state_dir,
+        accelerator_type="v5p-8"))
+    assert len(lib2.list_subslices()) == 2
+    from tpu_dra_driver.tpulib.partition import SubsliceSpecTuple
+    lib2.destroy_subslice(SubsliceSpecTuple(0, "1c47g", 0))
+    with pytest.raises(SubsliceNotFoundError):
+        lib2.destroy_subslice(SubsliceSpecTuple(0, "1c47g", 0))
+    assert len(native_lib.list_subslices()) == 1
+    lib2.close()
+
+
+def test_native_sched_knobs_persist(native_lib):
+    from tpu_dra_driver.tpulib.interface import TimesliceInterval
+    chip = native_lib.enumerate_chips()[0]
+    native_lib.set_timeslice(chip.uuid, TimesliceInterval.MEDIUM)
+    native_lib.set_exclusive_mode(chip.uuid, True)
+    assert native_lib.get_timeslice(chip.uuid) == TimesliceInterval.MEDIUM
+    assert native_lib.get_exclusive_mode(chip.uuid) is True
+
+
+def test_native_vfio_flip_writes_sysfs_mechanism(native_lib, tmp_path):
+    chips = native_lib.enumerate_chips()
+    pci = chips[0].pci_address
+    assert native_lib.current_driver(pci) == "gtpu"
+    group = native_lib.bind_to_vfio(pci)
+    assert group == "/dev/vfio/10"
+    dev_dir = os.path.join(native_lib._cfg.sysfs_root, "bus/pci/devices", pci)
+    assert open(os.path.join(dev_dir, "driver_override")).read().strip() == "vfio-pci"
+    # the unbind echo reached the bound driver's unbind file
+    assert open(os.path.join(dev_dir, "driver", "unbind")).read() == pci
+    # the vfio-pci bind file got the address
+    bind_file = os.path.join(native_lib._cfg.sysfs_root,
+                             "bus/pci/drivers/vfio-pci/bind")
+    assert open(bind_file).read() == pci
+    native_lib.unbind_from_vfio(pci)
+    assert open(os.path.join(dev_dir, "driver_override")).read() == "\n"
+
+
+def test_native_device_in_use_proc_scan(native_lib, tmp_path):
+    chips = native_lib.enumerate_chips()
+    assert native_lib.device_in_use(chips[0].pci_address) is False
+    # fake a process holding the device node
+    fd_dir = tmp_path / "proc" / "123" / "fd"
+    fd_dir.mkdir(parents=True)
+    os.symlink(chips[0].devfs_path, fd_dir / "7")
+    assert native_lib.device_in_use(chips[0].pci_address) is True
+
+
+def test_native_health_spool(native_lib):
+    import time
+    from tpu_dra_driver.tpulib.interface import HealthEventKind
+    got = []
+    native_lib.subscribe_health(got.append)
+    chip = native_lib.enumerate_chips()[0]
+    with open(native_lib.health_spool_path, "a") as f:
+        f.write(json.dumps({"kind": "HbmEccError", "chip_uuid": chip.uuid,
+                            "code": 9, "message": "spooled"}) + "\n")
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and not got:
+        time.sleep(0.02)
+    assert got and got[0].kind == HealthEventKind.HBM_ECC_ERROR
+    assert got[0].chip_uuid == chip.uuid
+
+
+def test_full_plugin_stack_over_native_lib(native_lib, tmp_path):
+    """The kubelet plugin runs unchanged over the native backend."""
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.pkg import featuregates as fg
+    from tpu_dra_driver.plugin.claims import build_allocated_claim
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+
+    clients = ClientSets()
+    gates = fg.FeatureGates()
+    gates.set(fg.DYNAMIC_SUBSLICE, True)
+    plugin = TpuKubeletPlugin(clients, native_lib, PluginConfig(
+        node_name="native-node", state_dir=str(tmp_path / "plugin-state"),
+        cdi_root=str(tmp_path / "cdi"), gates=gates))
+    plugin.start()
+    slices = clients.resource_slices.list()
+    names = {d["name"] for s in slices for d in s["spec"]["devices"]}
+    assert "tpu-0" in names and "tpu-0-ss-1c47g-0" in names
+
+    claim = build_allocated_claim("u1", "c1", "ns", ["tpu-0-ss-1c47g-1"],
+                                  "native-node")
+    res = plugin.prepare_resource_claims([claim])["u1"]
+    assert res.error is None, res.error
+    assert len(native_lib.list_subslices()) == 1
+    plugin.unprepare_resource_claims(["u1"])
+    assert native_lib.list_subslices() == []
+    plugin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# regressions from review round 6
+# ---------------------------------------------------------------------------
+
+def test_native_partition_ids_never_reused(native_lib):
+    from tpu_dra_driver.tpulib.partition import (
+        SubsliceProfile,
+        SubsliceSpec,
+        SubsliceSpecTuple,
+    )
+    chips = native_lib.enumerate_chips()
+    prof = SubsliceProfile(chips[0].generation, 1)
+    a = native_lib.create_subslice(SubsliceSpec(0, chips[0].uuid, prof, 0))
+    native_lib.destroy_subslice(SubsliceSpecTuple(0, "1c47g", 0))
+    b = native_lib.create_subslice(SubsliceSpec(0, chips[0].uuid, prof, 1))
+    assert b.partition_id > a.partition_id
+    assert b.uuid != a.uuid
+
+
+def test_native_stable_index_survives_vfio_flip(tmp_path):
+    """tpu-<index> identity must not shift when a chip loses its accel
+    minor to vfio-pci."""
+    from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
+    sysfs = _mk_sysfs(str(tmp_path / "sys"))
+    cfg = NativeSystemConfig(
+        sysfs_root=sysfs, devfs_root=str(tmp_path / "dev"),
+        state_dir=str(tmp_path / "state"), accelerator_type="v5p-8",
+        strict_vfio_verify=False)
+    lib = NativeTpuLib(cfg)
+    before = {c.pci_address: (c.index, c.coords) for c in lib.enumerate_chips()}
+    victim = lib.enumerate_chips()[2]
+    # emulate the kernel: the accel minor disappears and the driver link
+    # flips when a device is bound to vfio-pci
+    import shutil as sh
+    dev_dir = os.path.join(sysfs, "bus/pci/devices", victim.pci_address)
+    sh.rmtree(os.path.join(dev_dir, "accel"))
+    os.remove(os.path.join(dev_dir, "driver"))
+    os.symlink(os.path.join(sysfs, "bus/pci/drivers/vfio-pci"),
+               os.path.join(dev_dir, "driver"))
+    after = {c.pci_address: (c.index, c.coords)
+             for c in lib.enumerate_chips(refresh=True)}
+    assert after == before  # identical indices AND coords for every chip
+    lib.close()
+
+
+def test_native_registry_survives_spaces_in_devfs_path(tmp_path):
+    from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
+    from tpu_dra_driver.tpulib.partition import SubsliceProfile, SubsliceSpec
+    sysfs = _mk_sysfs(str(tmp_path / "sys with space"))
+    lib = NativeTpuLib(NativeSystemConfig(
+        sysfs_root=sysfs, devfs_root=str(tmp_path / "dev with space"),
+        state_dir=str(tmp_path / "state"), accelerator_type="v5p-8",
+        strict_vfio_verify=False))
+    chip = lib.enumerate_chips()[0]
+    prof = SubsliceProfile(chip.generation, 1)
+    live = lib.create_subslice(SubsliceSpec(0, chip.uuid, prof, 0))
+    assert " " in live.devfs_path
+    listed = lib.list_subslices()
+    assert len(listed) == 1
+    assert listed[0].live.devfs_path == live.devfs_path
+    lib.close()
+
+
+def test_native_health_poller_survives_garbage_lines(native_lib):
+    import time
+    from tpu_dra_driver.tpulib.interface import HealthEventKind
+    got = []
+    native_lib.subscribe_health(got.append)
+    chip = native_lib.enumerate_chips()[0]
+    with open(native_lib.health_spool_path, "ab") as f:
+        f.write("not json at all 🤖\n".encode())
+        f.write(json.dumps({"kind": "DeviceError", "chip_uuid": chip.uuid,
+                            "message": "böse 错误"}).encode() + b"\n")
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and not got:
+        time.sleep(0.02)
+    assert got and got[0].kind == HealthEventKind.DEVICE_ERROR
+    assert "böse" in got[0].message

@@ -1,0 +1,470 @@
+"""NativeTpuLib — ctypes binding to the C++ ``libtpudev.so`` boundary.
+
+Reference analog: the cgo binding in go-nvml. The C++ library
+(native/tpudevlib) does the real work: sysfs PCI walk (vendor 0x1ae0),
+flock'd partition registry, vfio driver_override flips, /proc fd scans.
+This wrapper adapts it to the :class:`tpu_dra_driver.tpulib.interface.TpuLib`
+seam and fills in what sysfs cannot know:
+
+- **slice topology / host identity** come from the deployment environment
+  (``TPU_ACCELERATOR_TYPE``, ``TPU_WORKER_ID``, metadata server in
+  production) — sysfs only sees this host's PCI functions;
+- **scheduling knobs** (time-slice interval, exclusive mode) are runtime
+  configuration on TPU, not ioctls: they're recorded in the state dir and
+  take effect through the CDI env the driver injects (the nvidia-smi
+  compute-policy analog);
+- **health events** arrive on a JSONL spool file the node's monitoring
+  agent (or libtpu wrapper) appends to; a poll thread publishes them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tpu_dra_driver.tpulib.interface import (
+    ChipInfo,
+    HealthEvent,
+    HealthEventKind,
+    HealthHub,
+    LiveSubslice,
+    SubsliceAlreadyExistsError,
+    SubsliceNotFoundError,
+    TimesliceInterval,
+    TpuLib,
+    TpuLibError,
+)
+from tpu_dra_driver.tpulib.partition import (
+    SubsliceLiveTuple,
+    SubsliceSpec,
+    SubsliceSpecTuple,
+)
+from tpu_dra_driver.tpulib.topology import GENERATIONS, Generation, SliceTopology
+
+_GEN_BY_CODE = {4: "v4", 50: "v5e", 51: "v5p", 60: "v6e"}
+
+
+class NativeUnavailableError(TpuLibError):
+    pass
+
+
+class _ChipStruct(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("pci_address", ctypes.c_char * 32),
+        ("pci_root", ctypes.c_char * 32),
+        ("devfs_path", ctypes.c_char * 96),
+        ("vfio_group", ctypes.c_char * 96),
+        ("driver", ctypes.c_char * 32),
+        ("generation", ctypes.c_int32),
+        ("cores", ctypes.c_int32),
+        ("hbm_bytes", ctypes.c_int64),
+        ("serial", ctypes.c_char * 64),
+        ("uuid", ctypes.c_char * 96),
+    ]
+
+
+class _PartStruct(ctypes.Structure):
+    _fields_ = [
+        ("parent_index", ctypes.c_int32),
+        ("cores", ctypes.c_int32),
+        ("placement_start", ctypes.c_int32),
+        ("partition_id", ctypes.c_int64),
+        ("uuid", ctypes.c_char * 96),
+        ("devfs_path", ctypes.c_char * 96),
+    ]
+
+
+def _default_library_paths() -> List[str]:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return [
+        os.environ.get("TPUDEV_LIBRARY", ""),
+        os.path.join(here, "native", "libtpudev.so"),
+        "/usr/local/lib/libtpudev.so",
+        "libtpudev.so",
+    ]
+
+
+def load_library(path: Optional[str] = None) -> ctypes.CDLL:
+    candidates = [path] if path else _default_library_paths()
+    last: Optional[Exception] = None
+    for cand in candidates:
+        if not cand:
+            continue
+        try:
+            lib = ctypes.CDLL(cand)
+            lib.tpudev_version.restype = ctypes.c_char_p
+            return lib
+        except OSError as e:
+            last = e
+    raise NativeUnavailableError(
+        f"libtpudev.so not found (tried {candidates}); build it with "
+        f"`make -C native`: {last}")
+
+
+@dataclass
+class NativeSystemConfig:
+    sysfs_root: str = "/sys"
+    devfs_root: str = "/dev"
+    proc_root: str = "/proc"
+    state_dir: str = "/var/lib/tpu-dra-driver/native"
+    accelerator_type: Optional[str] = None   # default: $TPU_ACCELERATOR_TYPE
+    host_index: Optional[int] = None         # default: $TPU_WORKER_ID or 0
+    slice_id: Optional[str] = None           # default: $TPU_SLICE_ID or derived
+    health_spool: Optional[str] = None       # default: <state_dir>/health-events.jsonl
+    library_path: Optional[str] = None
+    # verify vfio flips actually took effect against the kernel; test
+    # harnesses with inert (no-kernel) sysfs trees disable this
+    strict_vfio_verify: bool = True
+
+
+class NativeTpuLib(TpuLib):
+    MAX_CHIPS = 64
+    MAX_PARTS = 256
+
+    def __init__(self, config: NativeSystemConfig | None = None):
+        self._cfg = config or NativeSystemConfig()
+        self._lib = load_library(self._cfg.library_path)
+        os.makedirs(self._cfg.state_dir, exist_ok=True)
+        self._mu = threading.RLock()
+        self._health = HealthHub()
+        self._health_thread: Optional[threading.Thread] = None
+        self._health_stop = threading.Event()
+        self._health_offset = 0
+        self._sched_path = os.path.join(self._cfg.state_dir, "sched.json")
+        self._indices_path = os.path.join(self._cfg.state_dir, "indices.json")
+        self._driver_version = self._lib.tpudev_version().decode()
+        self._chips_cache: Optional[List[ChipInfo]] = None
+
+        accel = (self._cfg.accelerator_type
+                 or os.environ.get("TPU_ACCELERATOR_TYPE"))
+        if accel is None:
+            # single-host default: infer from the number of local chips
+            raw = self._enumerate_raw()
+            if not raw:
+                raise TpuLibError(
+                    "no TPU chips found and no TPU_ACCELERATOR_TYPE set")
+            gen_code = raw[0].generation
+            gen = GENERATIONS[_GEN_BY_CODE.get(gen_code, "v5p")]
+            accel = f"{gen.name}-{len(raw) * gen.cores_per_chip}"
+        self._topo = SliceTopology.from_accelerator_type(accel)
+        hi = self._cfg.host_index
+        if hi is None:
+            hi = int(os.environ.get("TPU_WORKER_ID", "0"))
+        self._host_index = hi
+        self._slice_id = (self._cfg.slice_id
+                          or os.environ.get("TPU_SLICE_ID")
+                          or f"slice-{accel}")
+
+    # ------------------------------------------------------------------
+
+    def _err(self) -> ctypes.Array:
+        return ctypes.create_string_buffer(512)
+
+    def _enumerate_raw(self) -> List[_ChipStruct]:
+        out = (_ChipStruct * self.MAX_CHIPS)()
+        err = self._err()
+        n = self._lib.tpudev_enumerate(
+            self._cfg.sysfs_root.encode(), self._cfg.devfs_root.encode(),
+            out, self.MAX_CHIPS, err, len(err))
+        if n < 0:
+            raise TpuLibError(f"enumerate: {err.value.decode()}")
+        return list(out[:n])
+
+    def _stable_index(self, pci_address: str, raw_index: int,
+                      index_map: Dict[str, int]) -> int:
+        """Device identity (``tpu-<index>``) must survive vfio flips, which
+        remove the accel minor. The first observation of each PCI address
+        persists its index; later enumerations reuse it regardless of what
+        the kernel currently exposes."""
+        if pci_address in index_map:
+            return index_map[pci_address]
+        idx = raw_index
+        if idx < 0 or idx in index_map.values():
+            used = set(index_map.values())
+            idx = 0
+            while idx in used:
+                idx += 1
+        index_map[pci_address] = idx
+        return idx
+
+    def _load_indices(self) -> Dict[str, int]:
+        try:
+            with open(self._indices_path) as f:
+                return {k: int(v) for k, v in json.load(f).items()}
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def _store_indices(self, index_map: Dict[str, int]) -> None:
+        tmp = f"{self._indices_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(index_map, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._indices_path)
+
+    def enumerate_chips(self, refresh: bool = False) -> List[ChipInfo]:
+        with self._mu:
+            if self._chips_cache is not None and not refresh:
+                return list(self._chips_cache)
+            raw = self._enumerate_raw()
+            coords = self._topo.coords_for_host(self._host_index)
+            index_map = self._load_indices()
+            chips = []
+            for c in raw:
+                gen = GENERATIONS[_GEN_BY_CODE.get(c.generation, "v5p")]
+                vfio = c.vfio_group.decode() or None
+                idx = self._stable_index(c.pci_address.decode(), c.index,
+                                         index_map)
+                devfs = c.devfs_path.decode()
+                if not devfs and not vfio:
+                    devfs = f"{self._cfg.devfs_root}/accel{idx}"
+                chips.append(ChipInfo(
+                    index=idx,
+                    uuid=c.uuid.decode(),
+                    generation=gen,
+                    pci_address=c.pci_address.decode(),
+                    pci_root=c.pci_root.decode(),
+                    serial=c.serial.decode(),
+                    devfs_path=devfs,
+                    vfio_group=vfio,
+                    # coords keyed by the STABLE index, not array position
+                    coords=coords[idx] if idx < len(coords) else (idx,),
+                    host_index=self._host_index,
+                    slice_id=self._slice_id,
+                    driver_version=self._driver_version,
+                    firmware_version="",
+                ))
+            self._store_indices(index_map)
+            chips.sort(key=lambda c: c.index)
+            self._chips_cache = chips
+            return list(chips)
+
+    def host_topology(self) -> SliceTopology:
+        return self._topo
+
+    def host_index(self) -> int:
+        return self._host_index
+
+    def slice_id(self) -> str:
+        return self._slice_id
+
+    # ------------------------------------------------------------------
+
+    def create_subslice(self, spec: SubsliceSpec) -> SubsliceLiveTuple:
+        with self._mu:
+            chip = self._chip_by_index(spec.parent_index)
+            if chip.uuid != spec.parent_uuid:
+                raise TpuLibError(
+                    f"uuid mismatch for chip {spec.parent_index}")
+            out = _PartStruct()
+            err = self._err()
+            rc = self._lib.tpudev_partition_create(
+                self._cfg.state_dir.encode(), self._cfg.devfs_root.encode(),
+                spec.parent_index, spec.profile.cores, spec.placement_start,
+                chip.cores, ctypes.byref(out), err, len(err))
+            if rc == -2:
+                raise SubsliceAlreadyExistsError(err.value.decode())
+            if rc != 0:
+                raise TpuLibError(f"create_subslice: {err.value.decode()}")
+            return SubsliceLiveTuple(
+                uuid=out.uuid.decode(),
+                partition_id=out.partition_id,
+                devfs_path=out.devfs_path.decode())
+
+    def destroy_subslice(self, tup: SubsliceSpecTuple) -> None:
+        from tpu_dra_driver.tpulib.partition import parse_profile_id
+        cores, _ = parse_profile_id(tup.profile_id)
+        err = self._err()
+        rc = self._lib.tpudev_partition_destroy(
+            self._cfg.state_dir.encode(), tup.parent_index, cores,
+            tup.placement_start, err, len(err))
+        if rc == -3:
+            raise SubsliceNotFoundError(err.value.decode())
+        if rc != 0:
+            raise TpuLibError(f"destroy_subslice: {err.value.decode()}")
+
+    def list_subslices(self) -> List[LiveSubslice]:
+        out = (_PartStruct * self.MAX_PARTS)()
+        err = self._err()
+        n = self._lib.tpudev_partition_list(
+            self._cfg.state_dir.encode(), out, self.MAX_PARTS, err, len(err))
+        if n < 0:
+            raise TpuLibError(f"list_subslices: {err.value.decode()}")
+        result = []
+        chips = {c.index: c for c in self.enumerate_chips()}
+        for p in out[:n]:
+            chip = chips.get(p.parent_index)
+            gen = chip.generation if chip else GENERATIONS["v5p"]
+            hbm_gib = (gen.hbm_bytes_per_core * p.cores) >> 30
+            tup = SubsliceSpecTuple(p.parent_index,
+                                    f"{p.cores}c{hbm_gib}g",
+                                    p.placement_start)
+            result.append(LiveSubslice(
+                spec_tuple=tup,
+                live=SubsliceLiveTuple(uuid=p.uuid.decode(),
+                                       partition_id=p.partition_id,
+                                       devfs_path=p.devfs_path.decode())))
+        return sorted(result, key=lambda l: l.spec_tuple.canonical_name())
+
+    # ------------------------------------------------------------------
+    # scheduling knobs (recorded state; applied via CDI env at prepare)
+    # ------------------------------------------------------------------
+
+    def _load_sched(self) -> Dict:
+        try:
+            with open(self._sched_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def _store_sched(self, sched: Dict) -> None:
+        tmp = f"{self._sched_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(sched, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._sched_path)
+
+    def set_timeslice(self, chip_uuid: str, interval: TimesliceInterval) -> None:
+        with self._mu:
+            self._assert_chip(chip_uuid)
+            sched = self._load_sched()
+            sched.setdefault(chip_uuid, {})["timeslice"] = interval.value
+            self._store_sched(sched)
+
+    def set_exclusive_mode(self, chip_uuid: str, exclusive: bool) -> None:
+        with self._mu:
+            self._assert_chip(chip_uuid)
+            sched = self._load_sched()
+            sched.setdefault(chip_uuid, {})["exclusive"] = exclusive
+            self._store_sched(sched)
+
+    def get_timeslice(self, chip_uuid: str) -> TimesliceInterval:
+        v = self._load_sched().get(chip_uuid, {}).get("timeslice", "Default")
+        return TimesliceInterval(v)
+
+    def get_exclusive_mode(self, chip_uuid: str) -> bool:
+        return bool(self._load_sched().get(chip_uuid, {}).get("exclusive", False))
+
+    def _assert_chip(self, chip_uuid: str) -> ChipInfo:
+        for c in self.enumerate_chips():
+            if c.uuid == chip_uuid:
+                return c
+        raise TpuLibError(f"no chip with uuid {chip_uuid}")
+
+    def _chip_by_index(self, index: int) -> ChipInfo:
+        for c in self.enumerate_chips():
+            if c.index == index:
+                return c
+        raise TpuLibError(f"no chip with index {index}")
+
+    # ------------------------------------------------------------------
+    # health: JSONL spool poller
+    # ------------------------------------------------------------------
+
+    @property
+    def health_spool_path(self) -> str:
+        return (self._cfg.health_spool
+                or os.path.join(self._cfg.state_dir, "health-events.jsonl"))
+
+    def subscribe_health(self, callback: Callable[[HealthEvent], None]) -> Callable[[], None]:
+        unsub = self._health.subscribe(callback)
+        with self._mu:
+            if self._health_thread is None:
+                self._health_stop.clear()
+                self._health_thread = threading.Thread(
+                    target=self._poll_health, daemon=True, name="tpudev-health")
+                self._health_thread.start()
+        return unsub
+
+    def _poll_health(self) -> None:
+        import logging
+        log = logging.getLogger(__name__)
+        while not self._health_stop.wait(0.2):
+            # The poller must survive anything — a dead health thread means
+            # degraded-device handling silently stops for the process
+            # lifetime. Binary mode so offsets are byte-exact even with
+            # multibyte messages or partially-written lines.
+            try:
+                with open(self.health_spool_path, "rb") as f:
+                    f.seek(self._health_offset)
+                    for raw_line in f:
+                        if not raw_line.endswith(b"\n"):
+                            break  # partial write; re-read next poll
+                        self._health_offset += len(raw_line)
+                        line = raw_line.strip()
+                        if not line:
+                            continue
+                        try:
+                            d = json.loads(line)
+                            event = HealthEvent(
+                                kind=HealthEventKind(d["kind"]),
+                                chip_uuid=d.get("chip_uuid", ""),
+                                code=d.get("code", 0),
+                                message=d.get("message", ""))
+                        except (ValueError, KeyError):
+                            continue
+                        try:
+                            self._health.publish(event)
+                        except Exception:
+                            log.exception("health subscriber failed for %s",
+                                          event)
+            except FileNotFoundError:
+                pass
+            except Exception:
+                log.exception("health spool poll failed")
+
+    def close(self) -> None:
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=1.0)
+            self._health_thread = None
+
+    # ------------------------------------------------------------------
+    # vfio
+    # ------------------------------------------------------------------
+
+    def current_driver(self, pci_address: str) -> Optional[str]:
+        out = ctypes.create_string_buffer(64)
+        self._lib.tpudev_current_driver(
+            self._cfg.sysfs_root.encode(), pci_address.encode(), out, len(out))
+        return out.value.decode() or None
+
+    def bind_to_vfio(self, pci_address: str) -> str:
+        group = ctypes.create_string_buffer(128)
+        err = self._err()
+        rc = self._lib.tpudev_vfio_bind(
+            self._cfg.sysfs_root.encode(), pci_address.encode(),
+            1 if self._cfg.strict_vfio_verify else 0,
+            group, len(group), err, len(err))
+        if rc != 0:
+            raise TpuLibError(f"vfio bind {pci_address}: {err.value.decode()}")
+        with self._mu:
+            self._chips_cache = None  # devfs/vfio personality changed
+        return group.value.decode()
+
+    def unbind_from_vfio(self, pci_address: str) -> None:
+        err = self._err()
+        rc = self._lib.tpudev_vfio_unbind(
+            self._cfg.sysfs_root.encode(), pci_address.encode(), err, len(err))
+        if rc != 0:
+            raise TpuLibError(f"vfio unbind {pci_address}: {err.value.decode()}")
+        with self._mu:
+            self._chips_cache = None
+
+    def device_in_use(self, pci_address: str) -> bool:
+        chip = None
+        for c in self.enumerate_chips():
+            if c.pci_address == pci_address:
+                chip = c
+                break
+        if chip is None:
+            return False
+        return bool(self._lib.tpudev_device_in_use(
+            self._cfg.proc_root.encode(), chip.devfs_path.encode()))
+
+    # ------------------------------------------------------------------
+
+    def driver_version(self) -> str:
+        return self._lib.tpudev_version().decode()
