@@ -1,0 +1,27 @@
+# Build/test entry points (reference analog: Makefile + common.mk).
+
+PYTHON ?= python3
+
+.PHONY: all native test bench demo e2e clean protos
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+protos:
+	cd tpu_dra_driver/grpc_api && protoc --python_out=. *.proto
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+bench: native
+	$(PYTHON) bench.py
+
+demo:
+	$(PYTHON) demo/run_e2e_demo.py
+	$(PYTHON) demo/run_computedomain_demo.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,41 @@
+#!/bin/sh
+# Prestart validation for the kubelet-plugin DaemonSet init container.
+#
+# Reference analog: hack/kubelet-plugin-prestart.sh — waits for the driver
+# install and emits actionable hints. TPU variant: validate libtpu presence
+# and TPU device nodes instead of nvidia-smi.
+set -eu
+
+DRIVER_ROOT="${TPU_DRIVER_ROOT:-/home/kubernetes/bin}"
+LIBTPU="/driver-root/libtpu.so"
+TRIES="${PRESTART_TRIES:-60}"
+
+echo "tpu-dra-driver prestart: validating TPU runtime on this node"
+
+i=0
+while [ ! -e "$LIBTPU" ]; do
+  i=$((i + 1))
+  if [ "$i" -ge "$TRIES" ]; then
+    echo >&2 "ERROR: libtpu.so not found under ${DRIVER_ROOT} after ${TRIES} tries."
+    echo >&2 "HINT: is the TPU runtime installed on this node? On GKE TPU"
+    echo >&2 "node pools libtpu ships under /home/kubernetes/bin; set"
+    echo >&2 "tpuDriverRoot in the Helm values if yours differs."
+    exit 1
+  fi
+  echo "waiting for ${LIBTPU} (attempt ${i}/${TRIES})…"
+  sleep 5
+done
+echo "found libtpu: ${LIBTPU}"
+
+if ls /dev/accel* >/dev/null 2>&1; then
+  echo "TPU device nodes: $(ls /dev/accel* | tr '\n' ' ')"
+elif ls /dev/vfio/* >/dev/null 2>&1; then
+  echo "vfio groups present (passthrough mode): $(ls /dev/vfio | tr '\n' ' ')"
+else
+  echo >&2 "ERROR: no /dev/accel* or /dev/vfio/* device nodes visible."
+  echo >&2 "HINT: the plugin pod must mount /dev and run privileged; check"
+  echo >&2 "the TPU kernel driver is loaded (lsmod | grep -i tpu)."
+  exit 1
+fi
+
+echo "prestart OK"

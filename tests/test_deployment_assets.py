@@ -1,0 +1,93 @@
+"""Sanity checks on deployment assets: CRDs and demo specs parse as valid
+YAML with the expected shapes; Helm templates reference real values."""
+
+import glob
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_all(path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def test_crds_parse_and_match_types():
+    crds = glob.glob(os.path.join(
+        REPO, "deployments/helm/tpu-dra-driver/crds/*.yaml"))
+    assert len(crds) == 2
+    by_kind = {}
+    for p in crds:
+        for doc in _load_all(p):
+            assert doc["kind"] == "CustomResourceDefinition"
+            by_kind[doc["spec"]["names"]["kind"]] = doc
+    assert set(by_kind) == {"ComputeDomain", "ComputeDomainClique"}
+    cd = by_kind["ComputeDomain"]
+    assert cd["spec"]["group"] == "resource.tpu.google.com"
+    ver = cd["spec"]["versions"][0]
+    assert ver["name"] == "v1beta1"
+    spec_props = ver["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+    assert spec_props["numNodes"]["minimum"] == 1
+    assert spec_props["allocationMode"]["enum"] == ["All", "Single"]
+    # clique daemons are a list-map keyed by nodeName (merge semantics the
+    # daemons rely on)
+    cq = by_kind["ComputeDomainClique"]
+    daemons = (cq["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+               ["properties"]["daemons"])
+    assert daemons["x-kubernetes-list-map-keys"] == ["nodeName"]
+
+
+def test_quickstart_specs_parse():
+    specs = glob.glob(os.path.join(REPO, "demo/specs/quickstart/*.yaml"))
+    assert len(specs) >= 4
+    kinds = set()
+    for p in specs:
+        for doc in _load_all(p):
+            kinds.add(doc["kind"])
+    assert {"Pod", "ResourceClaimTemplate", "ComputeDomain", "Job"} <= kinds
+
+
+def test_quickstart_device_classes_exist_in_chart():
+    chart_dc = open(os.path.join(
+        REPO, "deployments/helm/tpu-dra-driver/templates/deviceclasses.yaml")).read()
+    for p in glob.glob(os.path.join(REPO, "demo/specs/quickstart/*.yaml")):
+        for doc in _load_all(p):
+            if doc["kind"] != "ResourceClaimTemplate":
+                continue
+            for req in doc["spec"]["spec"]["devices"]["requests"]:
+                cls = req.get("deviceClassName")
+                if cls:
+                    assert f"name: {cls}" in chart_dc, cls
+
+
+def test_helm_templates_reference_declared_values():
+    """Every {{ .Values.x.y }} path in the templates exists in values.yaml."""
+    values = yaml.safe_load(open(os.path.join(
+        REPO, "deployments/helm/tpu-dra-driver/values.yaml")))
+    pattern = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+    for p in glob.glob(os.path.join(
+            REPO, "deployments/helm/tpu-dra-driver/templates/*.yaml")):
+        for m in pattern.finditer(open(p).read()):
+            node = values
+            for part in m.group(1).split("."):
+                assert isinstance(node, dict) and part in node, \
+                    f"{os.path.basename(p)}: .Values.{m.group(1)} not in values.yaml"
+                node = node[part]
+
+
+def test_repo_templates_match_controller_objects():
+    """The documented YAML template mirrors what the controller stamps."""
+    tmpl = open(os.path.join(REPO, "templates/compute-domain-daemon.tmpl.yaml")).read()
+    assert "resource.tpu.google.com/computeDomain: ${CD_UID}" in tmpl
+    assert "cd-daemon-claim-${CD_UID}" in tmpl
+    assert "hostNetwork: true" in tmpl
+    from tpu_dra_driver.api.types import ComputeDomain, ObjectMeta
+    from tpu_dra_driver.computedomain.controller.objects import build_daemonset
+    cd = ComputeDomain(metadata=ObjectMeta(name="x", namespace="ns", uid="U"))
+    ds = build_daemonset(cd)
+    assert ds["metadata"]["name"] == "cd-daemon-U"
+    assert ds["spec"]["template"]["spec"]["resourceClaims"][0][
+        "resourceClaimTemplateName"] == "cd-daemon-claim-U"

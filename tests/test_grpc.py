@@ -1,0 +1,86 @@
+"""Tests for the kubelet-facing gRPC transport (DRAPlugin + Registration +
+Health), driven over real gRPC channels exactly like kubelet would."""
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from tpu_dra_driver.grpc_api.server import DraGrpcClient, DraGrpcServer
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+
+@pytest.fixture
+def served_plugin(tmp_path):
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="node-a", state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi"), gates=fg.FeatureGates()))
+    plugin.start()
+    server = DraGrpcServer(plugin, clients.resource_claims,
+                           driver_name="tpu.google.com",
+                           dra_address="localhost:0",
+                           registration_address="localhost:0")
+    server.start()
+    client = DraGrpcClient(f"localhost:{server.dra_port}")
+    yield plugin, clients, server, client
+    client.close()
+    server.stop()
+    plugin.shutdown()
+
+
+def test_grpc_prepare_unprepare_round_trip(served_plugin, tmp_path):
+    plugin, clients, server, client = served_plugin
+    claim = build_allocated_claim("uid-1", "c1", "ns", ["tpu-0"], "node-a")
+    clients.resource_claims.create(claim)
+
+    resp = client.node_prepare_resources([claim])
+    assert list(resp.claims.keys()) == ["uid-1"]
+    result = resp.claims["uid-1"]
+    assert result.error == ""
+    assert len(result.devices) == 1
+    assert result.devices[0].device_name == "tpu-0"
+    assert result.devices[0].request_names == ["tpu"]
+    assert result.devices[0].cdi_device_ids[0].startswith("tpu.google.com/device=")
+
+    unresp = client.node_unprepare_resources(
+        [{"uid": "uid-1", "namespace": "ns", "name": "c1"}])
+    assert unresp.claims["uid-1"].error == ""
+    assert plugin.state.get_checkpoint().claims == {}
+
+
+def test_grpc_prepare_missing_claim_reports_error(served_plugin):
+    _, _, _, client = served_plugin
+    ghost = build_allocated_claim("uid-x", "ghost", "ns", ["tpu-0"], "node-a")
+    resp = client.node_prepare_resources([ghost])
+    assert "not found" in resp.claims["uid-x"].error
+
+
+def test_grpc_prepare_uid_mismatch_reports_error(served_plugin):
+    _, clients, _, client = served_plugin
+    claim = build_allocated_claim("uid-old", "c1", "ns", ["tpu-0"], "node-a")
+    clients.resource_claims.create(claim)
+    stale = build_allocated_claim("uid-new", "c1", "ns", ["tpu-0"], "node-a")
+    resp = client.node_prepare_resources([stale])
+    assert "UID mismatch" in resp.claims["uid-new"].error
+
+
+def test_grpc_registration_and_health(served_plugin):
+    _, _, server, client = served_plugin
+    info = client.get_info(f"localhost:{server.registration_port}")
+    assert info.type == "DRAPlugin"
+    assert info.name == "tpu.google.com"
+    assert "v1beta1.DRAPlugin" in info.supported_versions
+    assert client.health_check() is True
+
+
+def test_grpc_prepare_error_propagates(served_plugin):
+    _, clients, _, client = served_plugin
+    claim = build_allocated_claim("uid-2", "c2", "ns", ["tpu-99"], "node-a")
+    clients.resource_claims.create(claim)
+    resp = client.node_prepare_resources([claim])
+    assert "allocatable inventory" in resp.claims["uid-2"].error

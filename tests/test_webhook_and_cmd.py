@@ -1,0 +1,205 @@
+"""Tests for the admission webhook (pure review + HTTP server) and the
+cmd entrypoints' flag plumbing.
+
+Reference analogs: cmd/webhook/main_test.go (admission), the bats strict
+rejection test (test_cd_misc.bats), and the env-mirrored flag contract of
+cmd/*/main.go.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpu_dra_driver.webhook.server import WebhookServer, review
+
+
+def _review_request(obj):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": "req-1", "object": obj},
+    }
+
+
+def _claim_with_params(params, driver="tpu.google.com"):
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "c", "namespace": "ns"},
+        "spec": {"devices": {"config": [
+            {"opaque": {"driver": driver, "parameters": params}},
+        ]}},
+    }
+
+
+GOOD = {
+    "apiVersion": "resource.tpu.google.com/v1beta1",
+    "kind": "TpuConfig",
+    "sharing": {"strategy": "TimeSlicing", "timeSlicing": {"interval": "Short"}},
+}
+BAD_FIELD = {**GOOD, "bogusField": 1}
+BAD_CD = {
+    "apiVersion": "resource.tpu.google.com/v1beta1",
+    "kind": "ComputeDomainChannelConfig",
+    # missing domainID
+}
+
+
+def test_review_allows_valid_config():
+    out = review(_review_request(_claim_with_params(GOOD)))
+    assert out["response"]["allowed"] is True
+    assert out["response"]["uid"] == "req-1"
+
+
+def test_review_denies_unknown_field():
+    out = review(_review_request(_claim_with_params(BAD_FIELD)))
+    assert out["response"]["allowed"] is False
+    assert "bogusField" in out["response"]["status"]["message"]
+
+
+def test_review_denies_invalid_cd_config():
+    out = review(_review_request(_claim_with_params(
+        BAD_CD, driver="compute-domain.tpu.google.com")))
+    assert out["response"]["allowed"] is False
+    assert "domainID" in out["response"]["status"]["message"]
+
+
+def test_review_ignores_other_drivers():
+    out = review(_review_request(_claim_with_params(
+        {"apiVersion": "resource.nvidia.com/v1beta1", "kind": "GpuConfig"},
+        driver="gpu.nvidia.com")))
+    assert out["response"]["allowed"] is True
+
+
+def test_review_validates_claim_templates():
+    rct = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaimTemplate",
+        "spec": {"spec": {"devices": {"config": [
+            {"opaque": {"driver": "tpu.google.com", "parameters": BAD_FIELD}},
+        ]}}},
+    }
+    out = review(_review_request(rct))
+    assert out["response"]["allowed"] is False
+
+
+def test_webhook_http_round_trip():
+    server = WebhookServer(host="127.0.0.1", port=0)
+    server.start()
+    try:
+        body = json.dumps(_review_request(_claim_with_params(BAD_FIELD))).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/validate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["response"]["allowed"] is False
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# cmd flag plumbing
+# ---------------------------------------------------------------------------
+
+def test_env_mirrored_flags(monkeypatch):
+    from tpu_dra_driver.cmd.tpu_kubelet_plugin import build_parser
+    monkeypatch.setenv("NODE_NAME", "from-env")
+    monkeypatch.setenv("DEVICE_BACKEND", "fake")
+    args = build_parser().parse_args([])
+    assert args.node_name == "from-env"
+    assert args.device_backend == "fake"
+    # explicit flag wins over env
+    args = build_parser().parse_args(["--node-name=explicit"])
+    assert args.node_name == "explicit"
+
+
+def test_daemon_check_subcommand(tmp_path):
+    from tpu_dra_driver.cmd.compute_domain_daemon import main
+    rc = main(["check", "--run-dir", str(tmp_path)])
+    assert rc == 1  # not ready: no marker
+    (tmp_path / "ready").write_text("ok\n")
+    rc = main(["check", "--run-dir", str(tmp_path)])
+    assert rc == 0
+
+
+def test_all_parsers_build():
+    from tpu_dra_driver.cmd import (
+        compute_domain_controller,
+        compute_domain_daemon,
+        compute_domain_kubelet_plugin,
+        tpu_kubelet_plugin,
+        webhook,
+    )
+    for mod in (tpu_kubelet_plugin, compute_domain_kubelet_plugin,
+                compute_domain_controller, compute_domain_daemon, webhook):
+        parser = mod.build_parser()
+        assert parser.format_help()
+
+
+# ---------------------------------------------------------------------------
+# regressions from review round 7
+# ---------------------------------------------------------------------------
+
+def test_registration_reports_socket_path_and_service_names(tmp_path):
+    """kubelet dials PluginInfo.endpoint as a filesystem path and reads
+    supported_versions as service names (v1beta1.DRAPlugin)."""
+    from tpu_dra_driver.grpc_api.server import DraGrpcClient, DraGrpcServer
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.pkg import featuregates as fg
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    clients = ClientSets()
+    plugin = TpuKubeletPlugin(
+        clients, FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8")),
+        PluginConfig(node_name="n", state_dir=str(tmp_path / "s"),
+                     cdi_root=str(tmp_path / "cdi"), gates=fg.FeatureGates()))
+    plugin.start()
+    sock = str(tmp_path / "dra.sock")
+    server = DraGrpcServer(plugin, clients.resource_claims, "tpu.google.com",
+                           dra_address=f"unix://{sock}",
+                           registration_address="localhost:0")
+    server.start()
+    try:
+        client = DraGrpcClient(f"unix://{sock}")
+        info = client.get_info(f"localhost:{server.registration_port}")
+        assert info.endpoint == sock  # plain path, no unix:// scheme
+        assert list(info.supported_versions) == ["v1beta1.DRAPlugin"]
+        client.close()
+    finally:
+        server.stop()
+        plugin.shutdown()
+
+
+def test_kubeconfig_parses_inline_certs(tmp_path):
+    import base64
+    import yaml as y
+    from tpu_dra_driver.kube.rest import RestClusterConfig
+    kc = {
+        "current-context": "c",
+        "contexts": [{"name": "c", "context": {"cluster": "k", "user": "u"}}],
+        "clusters": [{"name": "k", "cluster": {
+            "server": "https://1.2.3.4:6443",
+            "certificate-authority-data": base64.b64encode(b"CA PEM").decode(),
+        }}],
+        "users": [{"name": "u", "user": {
+            "client-certificate-data": base64.b64encode(b"CERT PEM").decode(),
+            "client-key-data": base64.b64encode(b"KEY PEM").decode(),
+        }}],
+    }
+    p = tmp_path / "kubeconfig"
+    p.write_text(y.safe_dump(kc))
+    cfg = RestClusterConfig.from_kubeconfig(str(p))
+    assert cfg.server == "https://1.2.3.4:6443"
+    assert open(cfg.ca_cert, "rb").read() == b"CA PEM"
+    assert cfg.client_cert is not None
+    assert open(cfg.client_cert[0], "rb").read() == b"CERT PEM"
+    assert open(cfg.client_cert[1], "rb").read() == b"KEY PEM"
+
+
+def test_daemon_parser_has_state_dir_for_native_backend():
+    from tpu_dra_driver.cmd.compute_domain_daemon import build_parser
+    args = build_parser().parse_args(["run"])
+    assert args.state_dir  # make_lib requires it for the native backend

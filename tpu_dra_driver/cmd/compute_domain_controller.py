@@ -1,0 +1,78 @@
+"""compute-domain-controller binary
+(reference analog: cmd/compute-domain-controller/main.go:52-267).
+
+Optional leader election (main.go:269-370); with it enabled the controller
+machinery starts only while holding the lease.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tpu_dra_driver.common import dump_config, install_stack_dump_handler
+from tpu_dra_driver.computedomain.controller.controller import (
+    ComputeDomainController,
+    ControllerConfig,
+)
+from tpu_dra_driver.kube.leaderelection import LeaderElectionConfig, LeaderElector
+from tpu_dra_driver.pkg.flags import (
+    EnvArgumentParser,
+    add_common_flags,
+    config_dict,
+    setup_logging,
+)
+from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients
+
+
+def build_parser() -> EnvArgumentParser:
+    p = EnvArgumentParser(prog="compute-domain-controller")
+    add_common_flags(p)
+    p.add_argument("--max-nodes-per-domain", env="MAX_NODES_PER_DOMAIN",
+                   type=int, default=64)
+    p.add_argument("--status-sync-interval", env="STATUS_SYNC_INTERVAL",
+                   type=float, default=2.0)
+    p.add_argument("--leader-election", env="LEADER_ELECTION",
+                   action="store_true", default=False)
+    p.add_argument("--leader-election-namespace",
+                   env="LEADER_ELECTION_NAMESPACE", default="tpu-dra-driver")
+    p.add_argument("--identity", env="POD_NAME", default="controller")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.verbosity)
+    install_stack_dump_handler()
+    dump_config("compute-domain-controller", config_dict(args))
+
+    clients = make_clients(args)
+    controller = ComputeDomainController(clients, ControllerConfig(
+        max_nodes_per_domain=args.max_nodes_per_domain,
+        status_sync_interval=args.status_sync_interval))
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    if args.leader_election:
+        elector = LeaderElector(
+            clients.leases,
+            LeaderElectionConfig(identity=args.identity,
+                                 namespace=args.leader_election_namespace),
+            on_started_leading=controller.start,
+            on_stopped_leading=controller.stop)
+        elector.start()
+        stop.wait()
+        elector.stop()
+    else:
+        controller.start()
+        stop.wait()
+        controller.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

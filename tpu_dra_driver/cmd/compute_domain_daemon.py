@@ -1,0 +1,125 @@
+"""compute-domain-daemon binary
+(reference analog: cmd/compute-domain-daemon/main.go).
+
+Subcommands:
+- (default) run the daemon: join clique, maintain hosts mapping, report
+  readiness; exit nonzero on fatal ICI fabric errors so Kubernetes
+  restarts the pod (CrashOnICIFabricErrors).
+- ``check``: readiness probe (reference main.go:425-451) — exits 0 iff
+  the local daemon state says Ready (all clique peers resolvable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tpu_dra_driver.common import dump_config, install_stack_dump_handler
+from tpu_dra_driver.computedomain.daemon.daemon import (
+    ComputeDomainDaemon,
+    DaemonConfig,
+)
+from tpu_dra_driver.pkg.flags import (
+    EnvArgumentParser,
+    add_common_flags,
+    config_dict,
+    parse_gates,
+    setup_logging,
+)
+from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients, make_lib
+
+READY_FILE = "ready"
+
+
+def build_parser() -> EnvArgumentParser:
+    p = EnvArgumentParser(prog="compute-domain-daemon")
+    p.add_argument("subcommand", nargs="?", default="run",
+                   choices=["run", "check"])
+    add_common_flags(p)
+    p.add_argument("--compute-domain-uid", env="CD_UID", default="")
+    p.add_argument("--compute-domain-name", env="CD_NAME", default="")
+    p.add_argument("--compute-domain-namespace", env="CD_NAMESPACE", default="")
+    p.add_argument("--node-name", env="NODE_NAME", default="")
+    p.add_argument("--pod-name", env="POD_NAME", default="")
+    p.add_argument("--pod-ip", env="POD_IP", default="")
+    p.add_argument("--run-dir", env="RUN_DIR", default="/run/tpu-dra")
+    p.add_argument("--state-dir", env="STATE_DIR",
+                   default="/var/lib/tpu-dra-driver")
+    p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
+                   choices=["native", "fake"])
+    p.add_argument("--accelerator-type", env="TPU_ACCELERATOR_TYPE", default="")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.subcommand == "check":
+        # The probe path must be cheap and API-free: the running daemon
+        # maintains a ready marker file alongside its worker-env rendering.
+        ready_path = os.path.join(args.run_dir, READY_FILE)
+        return 0 if os.path.exists(ready_path) else 1
+
+    setup_logging(args.verbosity)
+    install_stack_dump_handler()
+    dump_config("compute-domain-daemon", config_dict(args))
+    for req in ("compute_domain_uid", "node_name", "pod_ip"):
+        if not getattr(args, req):
+            print(f"--{req.replace('_','-')} is required", file=sys.stderr)
+            return 2
+
+    clients = make_clients(args)
+    lib = make_lib(args)
+    daemon = ComputeDomainDaemon(clients, lib, DaemonConfig(
+        cd_uid=args.compute_domain_uid, cd_name=args.compute_domain_name,
+        cd_namespace=args.compute_domain_namespace,
+        node_name=args.node_name, pod_name=args.pod_name, pod_ip=args.pod_ip,
+        hosts_file=os.path.join(args.run_dir, "hosts"),
+        worker_env_file=os.path.join(args.run_dir, "worker-env.json"),
+        gates=parse_gates(args)))
+    daemon.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    ready_path = os.path.join(args.run_dir, READY_FILE)
+
+    def maintain_ready_marker():
+        while not stop.wait(1.0):
+            try:
+                if daemon.check():
+                    with open(ready_path, "w") as f:
+                        f.write("ok\n")
+                elif os.path.exists(ready_path):
+                    os.remove(ready_path)
+            except OSError:
+                pass
+
+    threading.Thread(target=maintain_ready_marker, daemon=True,
+                     name="ready-marker").start()
+
+    # block until shutdown or a fatal fabric error (exit nonzero → restart)
+    while not stop.is_set():
+        if daemon.fatal.wait(timeout=0.5):
+            daemon.stop()
+            try:
+                os.remove(ready_path)
+            except OSError:
+                pass
+            print("fatal ICI fabric error; exiting for pod restart",
+                  file=sys.stderr)
+            return 1
+    daemon.stop()
+    try:
+        os.remove(ready_path)
+    except OSError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

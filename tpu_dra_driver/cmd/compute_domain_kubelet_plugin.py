@@ -1,0 +1,82 @@
+"""compute-domain-kubelet-plugin binary
+(reference analog: cmd/compute-domain-kubelet-plugin/main.go)."""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME
+from tpu_dra_driver.common import dump_config, install_stack_dump_handler
+from tpu_dra_driver.computedomain.plugin.driver import (
+    CdKubeletPlugin,
+    CdKubeletPluginConfig,
+)
+from tpu_dra_driver.grpc_api.server import DraGrpcServer
+from tpu_dra_driver.pkg.flags import (
+    EnvArgumentParser,
+    add_common_flags,
+    config_dict,
+    setup_logging,
+)
+from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients, make_lib
+
+
+def build_parser() -> EnvArgumentParser:
+    p = EnvArgumentParser(prog="compute-domain-kubelet-plugin")
+    add_common_flags(p)
+    p.add_argument("--node-name", env="NODE_NAME", default="")
+    p.add_argument("--state-dir", env="STATE_DIR",
+                   default="/var/lib/kubelet/plugins/compute-domain.tpu.google.com")
+    p.add_argument("--cdi-root", env="CDI_ROOT", default="/var/run/cdi")
+    p.add_argument("--hosts-file-dir", env="HOSTS_FILE_DIR",
+                   default="/run/tpu-dra")
+    p.add_argument("--prepare-budget", env="PREPARE_BUDGET", type=float,
+                   default=45.0)
+    p.add_argument("--plugin-registry", env="PLUGIN_REGISTRY",
+                   default="/var/lib/kubelet/plugins_registry")
+    p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
+                   choices=["native", "fake"])
+    p.add_argument("--accelerator-type", env="TPU_ACCELERATOR_TYPE", default="")
+    p.add_argument("--health-port", env="HEALTH_PORT", type=int, default=51516)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.verbosity)
+    install_stack_dump_handler()
+    dump_config("compute-domain-kubelet-plugin", config_dict(args))
+    if not args.node_name:
+        print("--node-name/NODE_NAME is required", file=sys.stderr)
+        return 2
+
+    clients = make_clients(args)
+    lib = make_lib(args)
+    plugin = CdKubeletPlugin(clients, lib, CdKubeletPluginConfig(
+        node_name=args.node_name, state_dir=args.state_dir,
+        cdi_root=args.cdi_root, hosts_file_dir=args.hosts_file_dir,
+        prepare_budget=args.prepare_budget))
+    plugin.start()
+
+    server = DraGrpcServer(
+        plugin, clients.resource_claims, COMPUTE_DOMAIN_DRIVER_NAME,
+        dra_address=f"unix://{args.state_dir}/dra.sock",
+        registration_address=(
+            f"unix://{args.plugin_registry}/"
+            f"{COMPUTE_DOMAIN_DRIVER_NAME}-reg.sock"),
+        health_port=args.health_port)
+    server.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""tpu-kubelet-plugin binary (reference analog: cmd/gpu-kubelet-plugin/main.go).
+
+Startup order mirrors driver.go:66-173: device lib → device state (with
+startup sub-slice sweep) → gRPC registration with kubelet → health
+monitor → checkpoint cleanup → ResourceSlice publishing.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.common import dump_config, install_stack_dump_handler
+from tpu_dra_driver.grpc_api.server import DraGrpcServer
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg.flags import (
+    EnvArgumentParser,
+    add_common_flags,
+    config_dict,
+    parse_gates,
+    setup_logging,
+)
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+
+
+def build_parser() -> EnvArgumentParser:
+    p = EnvArgumentParser(prog="tpu-kubelet-plugin")
+    add_common_flags(p)
+    p.add_argument("--node-name", env="NODE_NAME", required=False, default="")
+    p.add_argument("--state-dir", env="STATE_DIR",
+                   default="/var/lib/kubelet/plugins/tpu.google.com")
+    p.add_argument("--cdi-root", env="CDI_ROOT", default="/var/run/cdi")
+    p.add_argument("--driver-root", env="DRIVER_ROOT", default="/")
+    p.add_argument("--slice-layout", env="SLICE_LAYOUT", default="combined",
+                   choices=["combined", "split"])
+    p.add_argument("--plugin-registry", env="PLUGIN_REGISTRY",
+                   default="/var/lib/kubelet/plugins_registry")
+    p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
+                   choices=["native", "fake"],
+                   help="fake runs hardware-free (demo/CI)")
+    p.add_argument("--accelerator-type", env="TPU_ACCELERATOR_TYPE", default="")
+    p.add_argument("--health-port", env="HEALTH_PORT", type=int, default=51515)
+    return p
+
+
+def make_lib(args):
+    if args.device_backend == "fake":
+        from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+        return FakeTpuLib(FakeSystemConfig(
+            accelerator_type=args.accelerator_type or "v5p-8"))
+    from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
+    # binaries without a --state-dir flag (the CD daemon) share the
+    # node-global native state dir
+    state_dir = getattr(args, "state_dir", "/var/lib/tpu-dra-driver")
+    return NativeTpuLib(NativeSystemConfig(
+        state_dir=f"{state_dir}/native",
+        accelerator_type=args.accelerator_type or None))
+
+
+def make_clients(args) -> ClientSets:
+    from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+    cfg = (RestClusterConfig.from_kubeconfig(args.kubeconfig)
+           if args.kubeconfig else RestClusterConfig.auto())
+    return ClientSets(cluster=RestCluster(cfg))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.verbosity)
+    install_stack_dump_handler()
+    dump_config("tpu-kubelet-plugin", config_dict(args))
+    if not args.node_name:
+        print("--node-name/NODE_NAME is required", file=sys.stderr)
+        return 2
+
+    clients = make_clients(args)
+    lib = make_lib(args)
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name=args.node_name, state_dir=args.state_dir,
+        cdi_root=args.cdi_root, driver_root=args.driver_root,
+        slice_layout=args.slice_layout, gates=parse_gates(args)))
+    plugin.start()
+
+    dra_sock = f"unix://{args.state_dir}/dra.sock"
+    reg_sock = f"unix://{args.plugin_registry}/{DRIVER_NAME}-reg.sock"
+    server = DraGrpcServer(plugin, clients.resource_claims, DRIVER_NAME,
+                           dra_address=dra_sock,
+                           registration_address=reg_sock,
+                           health_port=args.health_port)
+    server.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    plugin.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

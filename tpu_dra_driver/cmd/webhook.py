@@ -1,0 +1,48 @@
+"""webhook binary (reference analog: cmd/webhook/main.go)."""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tpu_dra_driver.common import dump_config, install_stack_dump_handler
+from tpu_dra_driver.pkg.flags import (
+    EnvArgumentParser,
+    add_common_flags,
+    config_dict,
+    setup_logging,
+)
+from tpu_dra_driver.webhook.server import WebhookServer
+
+
+def build_parser() -> EnvArgumentParser:
+    p = EnvArgumentParser(prog="tpu-dra-webhook")
+    add_common_flags(p)
+    p.add_argument("--bind", env="WEBHOOK_BIND", default="0.0.0.0")
+    p.add_argument("--port", env="WEBHOOK_PORT", type=int, default=8443)
+    p.add_argument("--tls-cert", env="WEBHOOK_TLS_CERT", default="")
+    p.add_argument("--tls-key", env="WEBHOOK_TLS_KEY", default="")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.verbosity)
+    install_stack_dump_handler()
+    dump_config("tpu-dra-webhook", config_dict(args))
+    server = WebhookServer(args.bind, args.port,
+                           cert_file=args.tls_cert or None,
+                           key_file=args.tls_key or None)
+    server.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+from tpu_dra_driver.common.debug import (  # noqa: F401
+    dump_config,
+    install_stack_dump_handler,
+)

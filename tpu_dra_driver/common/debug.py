@@ -1,0 +1,54 @@
+"""Debug/ops utilities shared by all binaries.
+
+Reference analogs:
+- internal/common/util.go:29-66 — SIGUSR2 → all-goroutine stack dump to
+  /tmp/goroutine-stacks.dump (tested by bats test_basics.bats:88-100);
+  here: all-thread stack dump.
+- pkg/flags/utils.go:41 — startup config dump so every pod log begins
+  with the exact effective configuration.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import signal
+import sys
+import threading
+import traceback
+from typing import Any, Mapping
+
+log = logging.getLogger(__name__)
+
+DEFAULT_DUMP_PATH = "/tmp/thread-stacks.dump"
+
+
+def install_stack_dump_handler(path: str = DEFAULT_DUMP_PATH) -> None:
+    """SIGUSR2 writes every thread's stack to ``path`` (and the log)."""
+
+    def handler(signum, frame):
+        try:
+            lines = [f"=== thread stack dump ({threading.active_count()} threads) ==="]
+            frames = sys._current_frames()
+            for t in threading.enumerate():
+                lines.append(f"--- {t.name} (daemon={t.daemon}) ---")
+                fr = frames.get(t.ident)
+                if fr is not None:
+                    lines.extend(l.rstrip() for l in traceback.format_stack(fr))
+            text = "\n".join(lines) + "\n"
+            with open(path, "w") as f:
+                f.write(text)
+            log.info("thread stacks dumped to %s", path)
+        except Exception:
+            log.exception("stack dump failed")
+
+    signal.signal(signal.SIGUSR2, handler)
+    # belt & braces: SIGABRT etc. still produce native tracebacks
+    faulthandler.enable()
+
+
+def dump_config(name: str, config: Mapping[str, Any]) -> None:
+    """Log the effective configuration at startup, one key per line."""
+    log.info("%s starting with configuration:", name)
+    for k in sorted(config):
+        log.info("  %s = %r", k, config[k])

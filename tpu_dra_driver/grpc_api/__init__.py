@@ -1,0 +1,9 @@
+"""grpc_api — kubelet-facing gRPC transport for the DRA plugins.
+
+Generated message modules (``*_pb2.py``, via ``protoc --python_out``) plus
+hand-rolled service bindings (grpc generic handlers — the image ships no
+grpc_python_plugin, and the service surface is two RPCs per API).
+
+Regenerate after editing the .proto files:
+    cd tpu_dra_driver/grpc_api && protoc --python_out=. *.proto
+"""

@@ -1,0 +1,259 @@
+"""DRA kubelet-plugin gRPC server: registration socket + dra.sock.
+
+Reference analog: the k8s.io/dynamic-resource-allocation kubeletplugin
+Helper (cmd/gpu-kubelet-plugin/driver.go:123-136): two unix sockets —
+
+- ``<plugins_registry>/<driver>-reg.sock`` serving the Registration API
+  (kubelet's plugin watcher discovers it and calls GetInfo),
+- ``<plugin_dir>/dra.sock`` serving the DRAPlugin API
+  (NodePrepareResources / NodeUnprepareResources),
+
+plus the gRPC health service used by the container's startup/liveness
+probes (reference health.go:51-110).
+
+The servicer is transport-only: it resolves claim references to full
+ResourceClaim objects via the API client and delegates to the
+transport-independent plugin core (prepare_resource_claims /
+unprepare_resource_claims), which is what unit tests drive directly.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Callable, Dict, List, Optional
+
+import grpc
+
+from tpu_dra_driver.grpc_api import dra_v1beta1_pb2 as dra_pb
+from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
+from tpu_dra_driver.grpc_api import pluginregistration_v1_pb2 as reg_pb
+from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.kube.errors import NotFoundError
+
+log = logging.getLogger(__name__)
+
+DRA_SERVICE = "v1beta1.DRAPlugin"
+REGISTRATION_SERVICE = "pluginregistration.Registration"
+HEALTH_SERVICE = "grpc.health.v1.Health"
+SUPPORTED_VERSIONS = ("v1beta1",)
+
+
+def _health_handlers(status_fn: Callable[[], bool]) -> grpc.GenericRpcHandler:
+    """grpc.health.v1 via generic handlers (no grpc_health package in the
+    image). ``status_fn`` is polled per Check so probes see live state."""
+
+    def check(request: health_pb.HealthCheckRequest, context):
+        serving = status_fn()
+        return health_pb.HealthCheckResponse(
+            status=(health_pb.HealthCheckResponse.SERVING if serving
+                    else health_pb.HealthCheckResponse.NOT_SERVING))
+
+    return grpc.method_handlers_generic_handler(HEALTH_SERVICE, {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            check,
+            request_deserializer=health_pb.HealthCheckRequest.FromString,
+            response_serializer=health_pb.HealthCheckResponse.SerializeToString,
+        ),
+    })
+
+
+def _dra_handlers(plugin, claims_client: ResourceClient) -> grpc.GenericRpcHandler:
+    """Build the DRAPlugin service from generic method handlers."""
+
+    def node_prepare(request: dra_pb.NodePrepareResourcesRequest, context):
+        response = dra_pb.NodePrepareResourcesResponse()
+        full_claims: List[Dict] = []
+        missing: Dict[str, str] = {}
+        for ref in request.claims:
+            try:
+                obj = claims_client.get(ref.name, ref.namespace)
+            except NotFoundError:
+                missing[ref.uid] = (f"ResourceClaim {ref.namespace}/{ref.name} "
+                                    f"not found")
+                continue
+            if obj["metadata"].get("uid") != ref.uid:
+                missing[ref.uid] = (
+                    f"ResourceClaim {ref.namespace}/{ref.name}: UID mismatch")
+                continue
+            full_claims.append(obj)
+        results = plugin.prepare_resource_claims(full_claims)
+        for uid, err in missing.items():
+            response.claims[uid].error = err
+        for uid, res in results.items():
+            out = response.claims[uid]
+            if res.error is not None:
+                out.error = res.error
+                continue
+            for dev in res.devices:
+                d = out.devices.add()
+                d.request_names.append(dev.request)
+                d.device_name = dev.canonical_name
+                d.cdi_device_ids.extend(dev.cdi_device_ids)
+        return response
+
+    def node_unprepare(request: dra_pb.NodeUnprepareResourcesRequest, context):
+        response = dra_pb.NodeUnprepareResourcesResponse()
+        results = plugin.unprepare_resource_claims(
+            [ref.uid for ref in request.claims])
+        for uid, err in results.items():
+            if err is not None:
+                response.claims[uid].error = err
+            else:
+                response.claims[uid].SetInParent()
+        return response
+
+    handlers = {
+        "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+            node_prepare,
+            request_deserializer=dra_pb.NodePrepareResourcesRequest.FromString,
+            response_serializer=dra_pb.NodePrepareResourcesResponse.SerializeToString,
+        ),
+        "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+            node_unprepare,
+            request_deserializer=dra_pb.NodeUnprepareResourcesRequest.FromString,
+            response_serializer=dra_pb.NodeUnprepareResourcesResponse.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(DRA_SERVICE, handlers)
+
+
+def _registration_handlers(driver_name: str, endpoint_path: str,
+                           on_status: Optional[Callable[[bool, str], None]] = None
+                           ) -> grpc.GenericRpcHandler:
+    def get_info(request: reg_pb.InfoRequest, context):
+        # kubelet dials `endpoint` as a filesystem socket PATH (not a grpc
+        # target) and reads supported_versions as provided *service* names
+        # (reference vendor kubeletplugin/registrationserver.go:49-50,
+        # noderegistrar.go:39)
+        return reg_pb.PluginInfo(
+            type="DRAPlugin", name=driver_name, endpoint=endpoint_path,
+            supported_versions=[DRA_SERVICE])
+
+    def notify(request: reg_pb.RegistrationStatus, context):
+        if on_status:
+            on_status(request.plugin_registered, request.error)
+        if not request.plugin_registered:
+            log.error("kubelet rejected plugin registration: %s", request.error)
+        else:
+            log.info("kubelet registered plugin %s", driver_name)
+        return reg_pb.RegistrationStatusResponse()
+
+    handlers = {
+        "GetInfo": grpc.unary_unary_rpc_method_handler(
+            get_info,
+            request_deserializer=reg_pb.InfoRequest.FromString,
+            response_serializer=reg_pb.PluginInfo.SerializeToString,
+        ),
+        "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+            notify,
+            request_deserializer=reg_pb.RegistrationStatus.FromString,
+            response_serializer=reg_pb.RegistrationStatusResponse.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(REGISTRATION_SERVICE, handlers)
+
+
+class DraGrpcServer:
+    """Serves the DRAPlugin + Registration + Health services."""
+
+    def __init__(self, plugin, claims_client: ResourceClient,
+                 driver_name: str, dra_address: str,
+                 registration_address: Optional[str] = None,
+                 health_port: Optional[int] = None):
+        """``dra_address``/``registration_address`` are grpc bind targets
+        (``unix:///path/dra.sock`` in production, ``localhost:0`` in
+        tests). ``health_port`` additionally serves the health service on
+        TCP for kubelet's grpc probes. The registration response reports
+        the dra socket's *filesystem path* (kubelet's dialing contract)."""
+        self._plugin = plugin
+        self._driver_name = driver_name
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((
+            _dra_handlers(plugin, claims_client),
+            _health_handlers(self._plugin_healthy),
+        ))
+        self._reg_server = None
+        self.dra_port = self._server.add_insecure_port(dra_address)
+        self.health_port: Optional[int] = None
+        if health_port is not None:
+            self.health_port = self._server.add_insecure_port(
+                f"0.0.0.0:{health_port}")
+        if registration_address is not None:
+            endpoint_path = (dra_address[len("unix://"):]
+                             if dra_address.startswith("unix://")
+                             else dra_address)
+            self._reg_server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+            self._reg_server.add_generic_rpc_handlers((
+                _registration_handlers(driver_name, endpoint_path),
+            ))
+            self.registration_port = self._reg_server.add_insecure_port(
+                registration_address)
+
+    def _plugin_healthy(self) -> bool:
+        if hasattr(self._plugin, "healthy"):
+            return bool(self._plugin.healthy())
+        return True
+
+    def start(self) -> None:
+        self._server.start()
+        if self._reg_server is not None:
+            self._reg_server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+        if self._reg_server is not None:
+            self._reg_server.stop(grace)
+
+
+class DraGrpcClient:
+    """Test/tooling client speaking the same wire protocol as kubelet."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+
+    def node_prepare_resources(self, claims: List[Dict]) -> dra_pb.NodePrepareResourcesResponse:
+        req = dra_pb.NodePrepareResourcesRequest()
+        for c in claims:
+            meta = c.get("metadata") or {}
+            ref = req.claims.add()
+            ref.uid = meta.get("uid", "")
+            ref.namespace = meta.get("namespace", "")
+            ref.name = meta.get("name", "")
+        return self._channel.unary_unary(
+            f"/{DRA_SERVICE}/NodePrepareResources",
+            request_serializer=dra_pb.NodePrepareResourcesRequest.SerializeToString,
+            response_deserializer=dra_pb.NodePrepareResourcesResponse.FromString,
+        )(req)
+
+    def node_unprepare_resources(self, refs: List[Dict]) -> dra_pb.NodeUnprepareResourcesResponse:
+        req = dra_pb.NodeUnprepareResourcesRequest()
+        for c in refs:
+            ref = req.claims.add()
+            ref.uid = c.get("uid", "")
+            ref.namespace = c.get("namespace", "")
+            ref.name = c.get("name", "")
+        return self._channel.unary_unary(
+            f"/{DRA_SERVICE}/NodeUnprepareResources",
+            request_serializer=dra_pb.NodeUnprepareResourcesRequest.SerializeToString,
+            response_deserializer=dra_pb.NodeUnprepareResourcesResponse.FromString,
+        )(req)
+
+    def get_info(self, target: str) -> reg_pb.PluginInfo:
+        channel = grpc.insecure_channel(target)
+        return channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/GetInfo",
+            request_serializer=reg_pb.InfoRequest.SerializeToString,
+            response_deserializer=reg_pb.PluginInfo.FromString,
+        )(reg_pb.InfoRequest())
+
+    def health_check(self) -> bool:
+        resp = self._channel.unary_unary(
+            f"/{HEALTH_SERVICE}/Check",
+            request_serializer=health_pb.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb.HealthCheckResponse.FromString,
+        )(health_pb.HealthCheckRequest(service=""))
+        return resp.status == health_pb.HealthCheckResponse.SERVING
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,286 @@
+"""RestCluster — a real Kubernetes API-server binding for the client seam.
+
+Reference analog: client-go's rest.Config / clientsets built in
+pkg/flags/kubeclient.go:38-96. Implements the same CRUD+watch surface as
+:class:`tpu_dra_driver.kube.fake.FakeCluster`, so every component runs
+unchanged against a live cluster:
+
+- in-cluster config (service-account token + CA + KUBERNETES_SERVICE_HOST),
+- or a minimal kubeconfig (current-context server + token / insecure),
+- watch via the chunked ``?watch=true`` JSON stream,
+- optimistic concurrency and finalizer semantics come from the real API
+  server; errors map onto the same taxonomy as the fake.
+
+Built on ``requests`` (no kubernetes-client dependency in the image).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import requests
+
+from tpu_dra_driver.kube.errors import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+from tpu_dra_driver.kube.fake import _WatchSub  # same consumer-side queue
+
+log = logging.getLogger(__name__)
+
+# resource name -> (api prefix, namespaced)
+_RESOURCE_MAP: Dict[str, Tuple[str, bool]] = {
+    "nodes": ("/api/v1", False),
+    "pods": ("/api/v1", True),
+    "events": ("/api/v1", True),
+    "daemonsets": ("/apis/apps/v1", True),
+    "leases": ("/apis/coordination.k8s.io/v1", True),
+    "resourceslices": ("/apis/resource.k8s.io/v1beta1", False),
+    "resourceclaims": ("/apis/resource.k8s.io/v1beta1", True),
+    "resourceclaimtemplates": ("/apis/resource.k8s.io/v1beta1", True),
+    "deviceclasses": ("/apis/resource.k8s.io/v1beta1", False),
+    "computedomains": ("/apis/resource.tpu.google.com/v1beta1", True),
+    "computedomaincliques": ("/apis/resource.tpu.google.com/v1beta1", True),
+}
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class RestClusterConfig:
+    def __init__(self, server: str, token: Optional[str] = None,
+                 ca_cert: Optional[str] = None, verify: bool = True,
+                 client_cert: Optional[Tuple[str, str]] = None,
+                 qps: float = 50.0):
+        self.server = server.rstrip("/")
+        self.token = token
+        self.ca_cert = ca_cert
+        self.verify = ca_cert if (verify and ca_cert) else verify
+        self.client_cert = client_cert   # (cert_path, key_path)
+        self.qps = qps
+
+    @staticmethod
+    def in_cluster() -> "RestClusterConfig":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError("not running in a cluster "
+                               "(KUBERNETES_SERVICE_HOST unset)")
+        with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as f:
+            token = f.read().strip()
+        ca = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+        return RestClusterConfig(f"https://{host}:{port}", token=token,
+                                 ca_cert=ca if os.path.exists(ca) else None)
+
+    @staticmethod
+    def from_kubeconfig(path: Optional[str] = None) -> "RestClusterConfig":
+        """Minimal kubeconfig support: current-context server, CA
+        (certificate-authority or -data), bearer token, and client
+        cert/key (file or inline -data), which is what kind/minikube/GKE
+        kubeconfigs actually carry."""
+        import base64
+        import tempfile
+
+        import yaml
+
+        path = path or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context")
+        ctx = next(c["context"] for c in cfg["contexts"]
+                   if c["name"] == ctx_name)
+        cluster = next(c["cluster"] for c in cfg["clusters"]
+                       if c["name"] == ctx["cluster"])
+        user = next(u["user"] for u in cfg["users"]
+                    if u["name"] == ctx["user"])
+
+        def materialize(file_key: str, data_key: str, src: Dict) -> Optional[str]:
+            if src.get(file_key):
+                return src[file_key]
+            if src.get(data_key):
+                tmp = tempfile.NamedTemporaryFile(
+                    prefix="kubecfg-", suffix=".pem", delete=False)
+                tmp.write(base64.b64decode(src[data_key]))
+                tmp.close()
+                return tmp.name
+            return None
+
+        ca = materialize("certificate-authority",
+                         "certificate-authority-data", cluster)
+        cert = materialize("client-certificate", "client-certificate-data",
+                           user)
+        key = materialize("client-key", "client-key-data", user)
+        return RestClusterConfig(
+            cluster["server"],
+            token=user.get("token"),
+            ca_cert=ca,
+            verify=not cluster.get("insecure-skip-tls-verify", False),
+            client_cert=(cert, key) if cert and key else None,
+        )
+
+    @staticmethod
+    def auto() -> "RestClusterConfig":
+        try:
+            return RestClusterConfig.in_cluster()
+        except (RuntimeError, FileNotFoundError):
+            return RestClusterConfig.from_kubeconfig()
+
+
+class RestCluster:
+    """Same surface as FakeCluster, backed by a real API server."""
+
+    def __init__(self, config: RestClusterConfig):
+        self._cfg = config
+        self._session = requests.Session()
+        if config.token:
+            self._session.headers["Authorization"] = f"Bearer {config.token}"
+        self._session.verify = config.verify
+        if config.client_cert:
+            self._session.cert = config.client_cert
+        self._watch_threads: List[threading.Thread] = []
+
+    # -- url helpers --------------------------------------------------------
+
+    def _url(self, resource: str, namespace: str = "",
+             name: str = "") -> str:
+        prefix, namespaced = _RESOURCE_MAP[resource]
+        url = f"{self._cfg.server}{prefix}"
+        if namespaced and namespace:
+            url += f"/namespaces/{namespace}"
+        url += f"/{resource}"
+        if name:
+            url += f"/{name}"
+        return url
+
+    @staticmethod
+    def _raise_for(resp: requests.Response, what: str) -> None:
+        if resp.status_code < 400:
+            return
+        msg = what
+        try:
+            msg = f"{what}: {resp.json().get('message', resp.text[:200])}"
+        except ValueError:
+            pass
+        if resp.status_code == 404:
+            raise NotFoundError(msg)
+        if resp.status_code == 409:
+            if "AlreadyExists" in resp.text or "already exists" in resp.text:
+                raise AlreadyExistsError(msg)
+            raise ConflictError(msg)
+        if resp.status_code == 422:
+            raise InvalidError(msg)
+        raise ApiError(f"{resp.status_code} {msg}")
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, resource: str, obj: Dict) -> Dict:
+        ns = (obj.get("metadata") or {}).get("namespace", "")
+        resp = self._session.post(self._url(resource, ns), json=obj)
+        self._raise_for(resp, f"create {resource}")
+        return resp.json()
+
+    def get(self, resource: str, name: str, namespace: str = "") -> Dict:
+        resp = self._session.get(self._url(resource, namespace, name))
+        self._raise_for(resp, f"get {resource} {namespace}/{name}")
+        return resp.json()
+
+    def list(self, resource: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             name_pattern: Optional[str] = None) -> List[Dict]:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        resp = self._session.get(self._url(resource, namespace or ""),
+                                 params=params)
+        self._raise_for(resp, f"list {resource}")
+        items = resp.json().get("items", [])
+        if name_pattern:
+            import fnmatch
+            items = [o for o in items if fnmatch.fnmatch(
+                o["metadata"]["name"], name_pattern)]
+        return items
+
+    def update(self, resource: str, obj: Dict) -> Dict:
+        meta = obj.get("metadata") or {}
+        resp = self._session.put(
+            self._url(resource, meta.get("namespace", ""), meta["name"]),
+            json=obj)
+        self._raise_for(resp, f"update {resource} {meta.get('name')}")
+        return resp.json()
+
+    def delete(self, resource: str, name: str, namespace: str = "") -> None:
+        resp = self._session.delete(self._url(resource, namespace, name))
+        self._raise_for(resp, f"delete {resource} {namespace}/{name}")
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, resource: str,
+              label_selector: Optional[Dict[str, str]] = None) -> _WatchSub:
+        sub = _WatchSub(label_selector)
+        t = threading.Thread(target=self._watch_loop,
+                             args=(resource, label_selector, sub),
+                             daemon=True, name=f"watch-{resource}")
+        t.start()
+        self._watch_threads.append(t)
+        return sub
+
+    def list_and_watch(self, resource: str, namespace: Optional[str] = None,
+                       label_selector: Optional[Dict[str, str]] = None):
+        items = self.list(resource, namespace=namespace,
+                          label_selector=label_selector)
+        rv = ""  # start the watch from "now"; the initial list covers history
+        sub = _WatchSub(label_selector)
+        t = threading.Thread(target=self._watch_loop,
+                             args=(resource, label_selector, sub, rv),
+                             daemon=True, name=f"watch-{resource}")
+        t.start()
+        self._watch_threads.append(t)
+        return items, sub
+
+    def stop_watch(self, resource: str, sub: _WatchSub) -> None:
+        sub.close()
+
+    def _watch_loop(self, resource: str,
+                    label_selector: Optional[Dict[str, str]],
+                    sub: _WatchSub, resource_version: str = "") -> None:
+        params: Dict[str, str] = {"watch": "true"}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        while not sub.closed:
+            try:
+                with self._session.get(self._url(resource), params=params,
+                                       stream=True, timeout=305) as resp:
+                    self._raise_for(resp, f"watch {resource}")
+                    for line in resp.iter_lines():
+                        if sub.closed:
+                            return
+                        if not line:
+                            continue
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        obj = ev.get("object") or {}
+                        rv = (obj.get("metadata") or {}).get("resourceVersion")
+                        if rv:
+                            params["resourceVersion"] = rv
+                        sub.push((ev.get("type", ""), obj))
+            except (requests.RequestException, ApiError) as e:
+                if sub.closed:
+                    return
+                log.warning("watch %s dropped (%s); re-establishing",
+                            resource, e)
+                params.pop("resourceVersion", None)
+                import time
+                time.sleep(1.0)

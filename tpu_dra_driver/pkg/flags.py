@@ -1,0 +1,61 @@
+"""Flag plumbing with environment-variable mirrors.
+
+Reference analog: the urfave/cli setup in cmd/*/main.go — every flag has
+an env-var mirror (e.g. ``--node-name`` / ``NODE_NAME``,
+``gpu-kubelet-plugin/main.go:83-166``) so Helm can configure pods purely
+through env, plus the ``FEATURE_GATES`` env flag (pkg/flags/featuregates.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, Optional
+
+from tpu_dra_driver.pkg.featuregates import FeatureGates, from_env_spec
+
+
+class EnvArgumentParser(argparse.ArgumentParser):
+    """add_argument(..., env="NODE_NAME") uses the env var as the default
+    (explicit CLI value still wins)."""
+
+    def add_argument(self, *args, env: Optional[str] = None, **kwargs):  # type: ignore[override]
+        if env is not None:
+            env_val = os.environ.get(env)
+            if env_val is not None:
+                typ = kwargs.get("type")
+                if kwargs.get("action") in ("store_true", "store_false"):
+                    kwargs["default"] = env_val.lower() in ("1", "true", "yes")
+                else:
+                    kwargs["default"] = typ(env_val) if typ else env_val
+            help_text = kwargs.get("help", "")
+            kwargs["help"] = f"{help_text} [env: {env}]".strip()
+        return super().add_argument(*args, **kwargs)
+
+
+def add_common_flags(parser: EnvArgumentParser) -> None:
+    parser.add_argument("--feature-gates", env="FEATURE_GATES", default="",
+                        help="comma-separated Gate=true|false overrides")
+    parser.add_argument("-v", "--verbosity", env="LOG_VERBOSITY", type=int,
+                        default=4, help="log verbosity (klog-style 0-7)")
+    parser.add_argument("--kube-api-qps", env="KUBE_API_QPS", type=float,
+                        default=50.0)
+    parser.add_argument("--kubeconfig", env="KUBECONFIG", default="",
+                        help="out-of-cluster kubeconfig path")
+
+
+def parse_gates(args: argparse.Namespace) -> FeatureGates:
+    return from_env_spec(getattr(args, "feature_gates", "") or None)
+
+
+def setup_logging(verbosity: int) -> None:
+    import logging
+    level = (logging.DEBUG if verbosity >= 6
+             else logging.INFO if verbosity >= 2 else logging.WARNING)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname).1s %(name)s] %(message)s")
+
+
+def config_dict(args: argparse.Namespace) -> Dict[str, Any]:
+    return dict(sorted(vars(args).items()))

@@ -1,0 +1,139 @@
+"""Validating admission webhook for ResourceClaims/ResourceClaimTemplates.
+
+Reference analog: cmd/webhook/{main.go:112-260, resource.go:33-140} — an
+optional webhook that strict-decodes the opaque device configs of *both*
+driver names in incoming ResourceClaim[Template]s and runs
+Normalize()+Validate(), so typos fail at admission time instead of at
+Prepare time on the node. When disabled, the Helm chart's
+ValidatingAdmissionPolicy provides a coarser fallback.
+
+``review()`` is the pure core (AdmissionReview in → AdmissionReview out);
+``WebhookServer`` wraps it in HTTPS.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME, DRIVER_NAME
+from tpu_dra_driver.api.decoder import STRICT_DECODER, DecodeError
+from tpu_dra_driver.api.configs import ValidationError
+
+log = logging.getLogger(__name__)
+
+OUR_DRIVERS = (DRIVER_NAME, COMPUTE_DOMAIN_DRIVER_NAME)
+
+
+def _validate_device_config(cfg: Dict, where: str) -> List[str]:
+    errors = []
+    opaque = cfg.get("opaque")
+    if not opaque:
+        return errors
+    if opaque.get("driver") not in OUR_DRIVERS:
+        return errors  # not ours to validate
+    params = opaque.get("parameters")
+    if params is None:
+        return [f"{where}: opaque config missing parameters"]
+    try:
+        STRICT_DECODER.decode_validated(params)
+    except (DecodeError, ValidationError) as e:
+        errors.append(f"{where}: {e}")
+    return errors
+
+
+def validate_claim_spec(spec: Dict, where: str) -> List[str]:
+    errors = []
+    for i, cfg in enumerate((spec.get("devices") or {}).get("config") or []):
+        errors.extend(_validate_device_config(cfg, f"{where}.devices.config[{i}]"))
+    return errors
+
+
+def validate_object(obj: Dict) -> List[str]:
+    kind = obj.get("kind", "")
+    if kind == "ResourceClaim":
+        return validate_claim_spec(obj.get("spec") or {}, "spec")
+    if kind == "ResourceClaimTemplate":
+        return validate_claim_spec(
+            ((obj.get("spec") or {}).get("spec") or {}), "spec.spec")
+    return []
+
+
+def review(admission_review: Dict) -> Dict:
+    """AdmissionReview(v1) request → response; allowed unless a strict
+    decode/validation of one of our opaque configs fails."""
+    request = admission_review.get("request") or {}
+    uid = request.get("uid", "")
+    obj = request.get("object") or {}
+    errors = validate_object(obj)
+    response: Dict = {"uid": uid, "allowed": not errors}
+    if errors:
+        response["status"] = {
+            "code": 422,
+            "message": "; ".join(errors),
+        }
+        log.info("denied %s %s: %s", obj.get("kind"),
+                 (obj.get("metadata") or {}).get("name"), errors)
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length)
+        try:
+            try:
+                incoming = json.loads(body)
+            except ValueError:
+                self.send_response(400)
+                self.end_headers()
+                return
+            outgoing = review(incoming)
+            payload = json.dumps(outgoing).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except Exception:
+            log.exception("admission review failed")
+            self.send_response(500)
+            self.end_headers()
+
+    def log_message(self, fmt, *args):
+        log.debug("webhook http: " + fmt, *args)
+
+
+class WebhookServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 8443,
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        if cert_file and key_file:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_file, key_file)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="webhook")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=2.0)
