@@ -188,7 +188,8 @@ def test_multihost_rendezvous_end_to_end(harness):
 
     # hosts files on both nodes map both workers
     for i in (0, 1):
-        mapping = parse_block(os.path.join(harness.host(i).hosts_dir, "hosts"))
+        # daemon state is scoped per CD UID under the node-shared run dir
+        mapping = parse_block(os.path.join(harness.host(i).hosts_dir, uid, "hosts"))
         assert set(mapping) == {0, 1}
 
 

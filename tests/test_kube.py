@@ -267,3 +267,47 @@ def test_decoder_wraps_type_errors():
             "apiVersion": "resource.tpu.google.com/v9999",
             "kind": "TpuConfig",
         })
+
+
+def test_informer_relist_resync_diffs_store():
+    """After a watch gap the source pushes a RELIST snapshot; the informer
+    must emit ADDED for new, MODIFIED for changed-RV, DELETED for vanished
+    objects (client-go relist semantics — rest.py _watch_loop analog)."""
+    from tpu_dra_driver.kube.client import ResourceClient
+    from tpu_dra_driver.kube.fake import RELIST
+
+    cluster = FakeCluster()
+    client = ResourceClient(cluster, "computedomains")
+    keep = client.create({"metadata": {"name": "keep", "namespace": "ns"}})
+    client.create({"metadata": {"name": "gone", "namespace": "ns"}})
+
+    inf = Informer(client)
+    events = []
+    inf.add_handlers(
+        on_add=lambda o: events.append(("add", o["metadata"]["name"])),
+        on_update=lambda old, new: events.append(("mod", new["metadata"]["name"])),
+        on_delete=lambda o: events.append(("del", o["metadata"]["name"])))
+    inf.start()
+    assert inf.wait_synced()
+    events.clear()
+
+    changed = dict(keep)
+    changed["metadata"] = dict(keep["metadata"],
+                               resourceVersion="999", labels={"x": "y"})
+    snapshot = {"items": [
+        changed,
+        {"metadata": {"name": "fresh", "namespace": "ns",
+                      "resourceVersion": "1"}},
+    ]}
+    inf._sub.push((RELIST, snapshot))
+
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(events) < 3:
+        time.sleep(0.01)
+    inf.stop()
+    assert ("add", "fresh") in events
+    assert ("mod", "keep") in events
+    assert ("del", "gone") in events
+    assert inf.get("gone", "ns") is None
+    assert inf.get("fresh", "ns") is not None
+    assert inf.get("keep", "ns")["metadata"]["resourceVersion"] == "999"

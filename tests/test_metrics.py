@@ -1,0 +1,193 @@
+"""Metrics registry, workqueue instrumentation, and the debug HTTP endpoint
+(reference analog: cmd/compute-domain-controller/main.go:372-419 —
+Prometheus legacyregistry + net/http/pprof)."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_dra_driver.pkg.metrics import (
+    DebugHTTPServer,
+    QueueMetrics,
+    Registry,
+    dump_thread_stacks,
+)
+from tpu_dra_driver.pkg.workqueue import WorkQueue
+
+
+def fetch(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_counter_gauge_render():
+    reg = Registry()
+    c = reg.counter("requests_total", "Total requests", ("verb",))
+    c.labels("GET").inc()
+    c.labels("GET").inc(2)
+    c.labels("PUT").inc()
+    g = reg.gauge("active", "Active things")
+    g.set(5)
+    g.dec()
+    text = reg.render()
+    assert '# TYPE requests_total counter' in text
+    assert 'requests_total{verb="GET"} 3' in text
+    assert 'requests_total{verb="PUT"} 1' in text
+    assert 'active 4' in text
+
+
+def test_counter_rejects_negative_and_label_misuse():
+    reg = Registry()
+    c = reg.counter("c_total", "c", ("a",))
+    with pytest.raises(ValueError):
+        c.inc()  # has labels; must go through .labels()
+    with pytest.raises(ValueError):
+        c.labels("x").inc(-1)
+    with pytest.raises(ValueError):
+        c.labels("x", "y")
+
+
+def test_histogram_buckets_cumulative():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "Latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.render()
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 3' in text
+    assert 'lat_seconds_bucket{le="10"} 4' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 5' in text
+    assert 'lat_seconds_count 5' in text
+
+
+def test_reregistration_returns_same_family_and_conflicts_raise():
+    reg = Registry()
+    a = reg.counter("x_total", "x")
+    b = reg.counter("x_total", "x again")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "now a gauge")
+
+
+def test_workqueue_metrics_flow():
+    reg = Registry()
+    q = WorkQueue(name="q", metrics=QueueMetrics("q", reg))
+    done = threading.Event()
+    attempts = []
+
+    def work():
+        attempts.append(1)
+        if len(attempts) < 2:
+            raise RuntimeError("flaky")
+        done.set()
+
+    stop = q.start()
+    q.enqueue_with_key("k", work)
+    assert done.wait(10)
+    q.wait_idle()
+    stop.set()
+    q.shutdown()
+    text = reg.render()
+    assert 'workqueue_adds_total{name="q"} 1' in text
+    assert 'workqueue_retries_total{name="q"} 1' in text
+    assert 'workqueue_depth{name="q"} 0' in text
+    assert 'workqueue_work_duration_seconds_count{name="q"} 2' in text
+
+
+def test_debug_http_server_endpoints():
+    reg = Registry()
+    reg.counter("hello_total", "hi").inc()
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=reg)
+    srv.start()
+    try:
+        status, body = fetch(srv.port, "/metrics")
+        assert status == 200 and "hello_total 1" in body
+        status, body = fetch(srv.port, "/healthz")
+        assert status == 200 and body == "ok"
+        status, body = fetch(srv.port, "/readyz")
+        assert status == 200
+        status, body = fetch(srv.port, "/debug/threads")
+        assert status == 200 and "MainThread" in body
+    finally:
+        srv.stop()
+
+
+def test_debug_http_readyz_not_ready():
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry(),
+                          ready_check=lambda: False)
+    srv.start()
+    try:
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/readyz")
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        srv.stop()
+
+
+def test_dump_thread_stacks_mentions_this_function():
+    assert "test_dump_thread_stacks_mentions_this_function" in dump_thread_stacks()
+
+
+def test_controller_exports_reconcile_metrics():
+    from tpu_dra_driver.computedomain.controller.controller import (
+        ComputeDomainController, ControllerConfig)
+    from tpu_dra_driver.kube.client import ClientSets
+
+    reg = Registry()
+    clients = ClientSets()
+    ctl = ComputeDomainController(clients, ControllerConfig(
+        status_sync_interval=0.05, orphan_cleanup_interval=600.0),
+        registry=reg)
+    ctl.start()
+    try:
+        clients.compute_domains.create({
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {"name": "cd1", "namespace": "default",
+                         "uid": "uid-cd1"},
+            "spec": {"numNodes": 1,
+                     "channel": {"resourceClaimTemplate": {"name": "rct"}}},
+        })
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if 'computedomain_reconciles_total{result="ok"}' in reg.render():
+                break
+            time.sleep(0.05)
+        text = reg.render()
+        assert 'computedomain_reconciles_total{result="ok"}' in text
+        assert 'workqueue_adds_total{name="cd-controller"}' in text
+    finally:
+        ctl.stop()
+
+
+def test_plugin_prepare_metrics_observed(tmp_path):
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="n1", state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi")))
+    plugin.start()
+    chip = sorted(plugin.state.allocatable)[0]
+    claim = {
+        "metadata": {"name": "c", "namespace": "default", "uid": "uid-m1"},
+        "status": {"allocation": {"devices": {"results": [{
+            "driver": "tpu.google.com", "request": "r0",
+            "device": chip, "pool": "n1"}]}}},
+    }
+    res = plugin.prepare_resource_claims([claim])
+    assert res["uid-m1"].error is None
+    plugin.unprepare_resource_claims(["uid-m1"])
+    plugin.shutdown()
+    text = DEFAULT_REGISTRY.render()
+    assert 'dra_claim_prepare_duration_seconds_count{result="ok"}' in text
+    assert 'dra_claim_unprepare_duration_seconds_count{result="ok"}' in text
+    assert 'dra_prepare_lock_wait_seconds_count' in text

@@ -1,0 +1,122 @@
+"""REST watch-loop gap handling against a scripted stub API server:
+an in-stream ERROR (410 Gone) event must not be forwarded to subscribers;
+instead the loop relists and pushes a RELIST snapshot, resuming the watch
+from the list's resourceVersion (client-go Reflector semantics)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpu_dra_driver.kube.fake import RELIST
+from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+
+
+class StubApiServer:
+    """Serves /apis/resource.tpu.google.com/v1beta1/computedomains.
+
+    Watch call #1: one ADDED event, then an ERROR(410) event, then EOF.
+    Watch call #2+: holds the stream open (no events).
+    List: one item, list resourceVersion "50".
+    """
+
+    def __init__(self):
+        outer = self
+        self.watch_calls = []
+        self.list_calls = 0
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if "watch=true" in self.path:
+                    outer.watch_calls.append(self.path)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    if len(outer.watch_calls) == 1:
+                        self._chunk({"type": "ADDED", "object": {
+                            "metadata": {"name": "cd1", "namespace": "ns",
+                                         "resourceVersion": "10"}}})
+                        self._chunk({"type": "ERROR", "object": {
+                            "kind": "Status", "code": 410,
+                            "reason": "Expired",
+                            "message": "too old resource version"}})
+                        self._chunk_end()
+                    else:
+                        # hold open briefly, then end cleanly
+                        time.sleep(0.5)
+                        self._chunk_end()
+                    return
+                outer.list_calls += 1
+                body = json.dumps({
+                    "kind": "ComputeDomainList",
+                    "metadata": {"resourceVersion": "50"},
+                    "items": [{"metadata": {"name": "cd2", "namespace": "ns",
+                                            "resourceVersion": "42"}}],
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _chunk(self, obj):
+                data = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
+            def _chunk_end(self):
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    @property
+    def url(self):
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_watch_410_triggers_relist_not_error_forwarding():
+    stub = StubApiServer()
+    stub.start()
+    try:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        sub = cluster.watch("computedomains")
+        events = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(events) < 2:
+            ev = sub.next(timeout=0.2)
+            if ev is not None:
+                events.append(ev)
+        # let the loop re-dial the watch so the resume RV is observable
+        while time.monotonic() < deadline and len(stub.watch_calls) < 2:
+            time.sleep(0.05)
+        sub.close()
+
+        types = [t for t, _ in events]
+        assert types[0] == "ADDED"
+        assert "ERROR" not in types, "Status objects must not reach subscribers"
+        assert types[1] == RELIST
+        relist_obj = events[1][1]
+        assert [o["metadata"]["name"] for o in relist_obj["items"]] == ["cd2"]
+        assert stub.list_calls == 1
+        # the watch resumed from the list's RV, not the stale one
+        assert len(stub.watch_calls) >= 2
+        assert "resourceVersion=50" in stub.watch_calls[1]
+    finally:
+        stub.stop()

@@ -203,3 +203,35 @@ def test_daemon_parser_has_state_dir_for_native_backend():
     from tpu_dra_driver.cmd.compute_domain_daemon import build_parser
     args = build_parser().parse_args(["run"])
     assert args.state_dir  # make_lib requires it for the native backend
+
+
+def test_parse_http_endpoint():
+    from tpu_dra_driver.pkg.flags import parse_http_endpoint
+    assert parse_http_endpoint("") is None
+    assert parse_http_endpoint(":8085") == ("0.0.0.0", 8085)
+    assert parse_http_endpoint("127.0.0.1:9") == ("127.0.0.1", 9)
+    assert parse_http_endpoint("[::]:8080") == ("::", 8080)
+    import pytest
+    with pytest.raises(SystemExit, match="host:port"):
+        parse_http_endpoint("localhost")       # port-less
+    with pytest.raises(SystemExit, match="host:port"):
+        parse_http_endpoint("host:notaport")
+
+
+def test_daemon_check_is_scoped_per_compute_domain(tmp_path):
+    """The run dir is one node-shared hostPath: daemon A's ready marker must
+    not satisfy daemon B's probe (cd_run_dir scoping), and a stale marker
+    from a crashed incarnation is cleared before the daemon starts."""
+    from tpu_dra_driver.cmd.compute_domain_daemon import cd_run_dir, main
+
+    # a marker for CD uid-a ...
+    (tmp_path / "uid-a").mkdir()
+    (tmp_path / "uid-a" / "ready").write_text("ok\n")
+    rc = main(["check", "--run-dir", str(tmp_path),
+               "--compute-domain-uid", "uid-a"])
+    assert rc == 0
+    # ... does not make CD uid-b ready
+    rc = main(["check", "--run-dir", str(tmp_path),
+               "--compute-domain-uid", "uid-b"])
+    assert rc == 1
+    assert cd_run_dir(str(tmp_path), "u") == str(tmp_path / "u")

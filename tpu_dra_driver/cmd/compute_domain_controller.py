@@ -18,10 +18,12 @@ from tpu_dra_driver.computedomain.controller.controller import (
     ControllerConfig,
 )
 from tpu_dra_driver.kube.leaderelection import LeaderElectionConfig, LeaderElector
+from tpu_dra_driver.pkg.metrics import DebugHTTPServer
 from tpu_dra_driver.pkg.flags import (
     EnvArgumentParser,
     add_common_flags,
     config_dict,
+    parse_http_endpoint,
     setup_logging,
 )
 from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients
@@ -39,6 +41,10 @@ def build_parser() -> EnvArgumentParser:
     p.add_argument("--leader-election-namespace",
                    env="LEADER_ELECTION_NAMESPACE", default="tpu-dra-driver")
     p.add_argument("--identity", env="POD_NAME", default="controller")
+    p.add_argument("--http-endpoint", env="HTTP_ENDPOINT", default="",
+                   help="host:port for /metrics, /healthz, /readyz and "
+                        "/debug/threads (reference main.go:372-419); "
+                        "empty disables the endpoint")
     return p
 
 
@@ -57,6 +63,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
 
+    debug_server = None
+    address = parse_http_endpoint(args.http_endpoint)
+    if address is not None:
+        debug_server = DebugHTTPServer(address, registry=controller.registry)
+        debug_server.start()
+
     if args.leader_election:
         elector = LeaderElector(
             clients.leases,
@@ -71,6 +83,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         controller.start()
         stop.wait()
         controller.stop()
+    if debug_server is not None:
+        debug_server.stop()
     return 0
 
 

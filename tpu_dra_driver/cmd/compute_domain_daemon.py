@@ -35,6 +35,16 @@ from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients, make_lib
 READY_FILE = "ready"
 
 
+def cd_run_dir(base: str, cd_uid: str) -> str:
+    """Per-ComputeDomain subdirectory of the node-shared hostPath run dir.
+
+    The base dir is one hostPath shared by every CD daemon pod on the node
+    (and it survives pod restarts), so all daemon state — hosts mapping,
+    worker-env snapshot, ready marker — must be scoped by CD UID or two
+    domains on one node would read each other's files."""
+    return os.path.join(base, cd_uid) if cd_uid else base
+
+
 def build_parser() -> EnvArgumentParser:
     p = EnvArgumentParser(prog="compute-domain-daemon")
     p.add_argument("subcommand", nargs="?", default="run",
@@ -60,7 +70,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.subcommand == "check":
         # The probe path must be cheap and API-free: the running daemon
         # maintains a ready marker file alongside its worker-env rendering.
-        ready_path = os.path.join(args.run_dir, READY_FILE)
+        ready_path = os.path.join(
+            cd_run_dir(args.run_dir, args.compute_domain_uid), READY_FILE)
         return 0 if os.path.exists(ready_path) else 1
 
     setup_logging(args.verbosity)
@@ -71,22 +82,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"--{req.replace('_','-')} is required", file=sys.stderr)
             return 2
 
+    run_dir = cd_run_dir(args.run_dir, args.compute_domain_uid)
+    ready_path = os.path.join(run_dir, READY_FILE)
+    # A stale marker from a previous incarnation (the dir is a hostPath
+    # that survives crashes) must never satisfy probes before *this*
+    # daemon reaches Ready.
+    try:
+        os.remove(ready_path)
+    except OSError:
+        pass
+
     clients = make_clients(args)
     lib = make_lib(args)
     daemon = ComputeDomainDaemon(clients, lib, DaemonConfig(
         cd_uid=args.compute_domain_uid, cd_name=args.compute_domain_name,
         cd_namespace=args.compute_domain_namespace,
         node_name=args.node_name, pod_name=args.pod_name, pod_ip=args.pod_ip,
-        hosts_file=os.path.join(args.run_dir, "hosts"),
-        worker_env_file=os.path.join(args.run_dir, "worker-env.json"),
+        hosts_file=os.path.join(run_dir, "hosts"),
+        worker_env_file=os.path.join(run_dir, "worker-env.json"),
         gates=parse_gates(args)))
     daemon.start()
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
-
-    ready_path = os.path.join(args.run_dir, READY_FILE)
 
     def maintain_ready_marker():
         while not stop.wait(1.0):

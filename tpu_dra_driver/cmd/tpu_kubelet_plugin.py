@@ -43,6 +43,9 @@ def build_parser() -> EnvArgumentParser:
                    help="fake runs hardware-free (demo/CI)")
     p.add_argument("--accelerator-type", env="TPU_ACCELERATOR_TYPE", default="")
     p.add_argument("--health-port", env="HEALTH_PORT", type=int, default=51515)
+    p.add_argument("--http-endpoint", env="HTTP_ENDPOINT", default="",
+                   help="host:port for /metrics (dra_claim_* histograms), "
+                        "/healthz and /debug/threads; empty disables")
     return p
 
 
@@ -92,10 +95,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                            health_port=args.health_port)
     server.start()
 
+    debug_server = None
+    from tpu_dra_driver.pkg.flags import parse_http_endpoint
+    address = parse_http_endpoint(args.http_endpoint)
+    if address is not None:
+        from tpu_dra_driver.pkg.metrics import DebugHTTPServer
+        debug_server = DebugHTTPServer(address, ready_check=plugin.healthy)
+        debug_server.start()
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if debug_server is not None:
+        debug_server.stop()
     server.stop()
     plugin.shutdown()
     return 0

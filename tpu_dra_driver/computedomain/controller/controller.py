@@ -46,6 +46,7 @@ from tpu_dra_driver.computedomain.controller.objects import (
 from tpu_dra_driver.kube.client import ABORT, ClientSets
 from tpu_dra_driver.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
 from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY, QueueMetrics, Registry
 from tpu_dra_driver.pkg.workqueue import WorkQueue, default_controller_rate_limiter
 
 log = logging.getLogger(__name__)
@@ -63,11 +64,21 @@ class ControllerConfig:
 
 class ComputeDomainController:
     def __init__(self, clients: ClientSets,
-                 config: Optional[ControllerConfig] = None):
+                 config: Optional[ControllerConfig] = None,
+                 registry: Optional[Registry] = None):
         self._clients = clients
         self._config = config or ControllerConfig()
+        self.registry = registry or DEFAULT_REGISTRY
         self._queue = WorkQueue(default_controller_rate_limiter(),
-                                name="cd-controller")
+                                name="cd-controller",
+                                metrics=QueueMetrics("cd-controller",
+                                                     self.registry))
+        self._reconciles = self.registry.counter(
+            "computedomain_reconciles_total",
+            "ComputeDomain reconcile attempts by result", ("result",))
+        self._reconcile_duration = self.registry.histogram(
+            "computedomain_reconcile_duration_seconds",
+            "Wall time of one ComputeDomain reconcile")
         self._cd_informer = Informer(clients.compute_domains)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -118,6 +129,15 @@ class ComputeDomainController:
         self._queue.enqueue_with_key(key, lambda: self._reconcile(key))
 
     def _reconcile(self, key: str) -> None:
+        with self._reconcile_duration.time():
+            try:
+                self._reconcile_inner(key)
+            except Exception:
+                self._reconciles.labels("error").inc()
+                raise
+            self._reconciles.labels("ok").inc()
+
+    def _reconcile_inner(self, key: str) -> None:
         ns, _, name = key.partition("/")
         try:
             obj = self._clients.compute_domains.get(name, ns)

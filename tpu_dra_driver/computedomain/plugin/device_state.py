@@ -231,7 +231,11 @@ class CdDeviceState:
             env=env,
             device_nodes=[{"path": channel_devfs_path(chan_id)}],
             mounts=[{
-                "hostPath": os.path.join(self._config.hosts_file_dir, "hosts"),
+                # the daemon scopes its files per CD UID under the
+                # node-shared hostPath run dir (cmd/compute_domain_daemon
+                # cd_run_dir) so co-located domains never cross-read
+                "hostPath": os.path.join(self._config.hosts_file_dir,
+                                         cd.metadata.uid, "hosts"),
                 "containerPath": "/etc/tpu-dra/hosts",
                 "options": ["ro", "bind"],
             }],

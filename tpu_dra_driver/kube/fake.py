@@ -40,6 +40,11 @@ WatchEvent = Tuple[str, Object]  # ("ADDED"|"MODIFIED"|"DELETED", obj)
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+# Synthetic event pushed by a watch source after a gap it could not bridge
+# (HTTP 410 Gone / transport error): ``object`` is ``{"items": [...]}`` — a
+# fresh full list. Consumers (the informer) diff it against their store and
+# emit ADDED/MODIFIED/DELETED, client-go relist semantics.
+RELIST = "RELIST"
 
 
 def _key(namespace: str, name: str) -> Tuple[str, str]:

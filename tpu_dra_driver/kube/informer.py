@@ -13,7 +13,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu_dra_driver.kube.client import ResourceClient
-from tpu_dra_driver.kube.fake import ADDED, DELETED, MODIFIED, Object
+from tpu_dra_driver.kube.fake import ADDED, DELETED, MODIFIED, RELIST, Object
 
 
 class Informer:
@@ -110,6 +110,9 @@ class Informer:
                     return
                 continue
             ev_type, obj = ev
+            if ev_type == RELIST:
+                self._resync(obj.get("items") or [])
+                continue
             if not self._accept(obj):
                 continue
             meta = obj["metadata"]
@@ -124,6 +127,29 @@ class Informer:
                 else:
                     self._store[key] = obj
                 self._dispatch(ev_type, obj, old)
+
+    def _resync(self, items: List[Object]) -> None:
+        """Reconcile the store against a fresh full list after a watch gap
+        (client-go relist): emits ADDED for new objects, MODIFIED for
+        changed resourceVersions, DELETED for objects gone from the list —
+        so deletions that happened during the outage are not lost."""
+        fresh: Dict[Tuple[str, str], Object] = {}
+        for obj in items:
+            if self._accept(obj):
+                meta = obj["metadata"]
+                fresh[(meta.get("namespace", ""), meta["name"])] = obj
+        with self._mu:
+            for key, obj in fresh.items():
+                old = self._store.get(key)
+                self._store[key] = obj
+                if old is None:
+                    self._dispatch(ADDED, obj, None)
+                elif ((old.get("metadata") or {}).get("resourceVersion")
+                      != (obj.get("metadata") or {}).get("resourceVersion")):
+                    self._dispatch(MODIFIED, obj, old)
+            for key in [k for k in self._store if k not in fresh]:
+                gone = self._store.pop(key)
+                self._dispatch(DELETED, gone, None)
 
     def _dispatch(self, ev_type: str, obj: Object, old: Optional[Object]) -> None:
         """Call with _mu held. Hands each handler its own deep copy so

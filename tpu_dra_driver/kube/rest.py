@@ -31,7 +31,7 @@ from tpu_dra_driver.kube.errors import (
     InvalidError,
     NotFoundError,
 )
-from tpu_dra_driver.kube.fake import _WatchSub  # same consumer-side queue
+from tpu_dra_driver.kube.fake import RELIST, _WatchSub  # same consumer-side queue
 
 log = logging.getLogger(__name__)
 
@@ -248,16 +248,41 @@ class RestCluster:
     def stop_watch(self, resource: str, sub: _WatchSub) -> None:
         sub.close()
 
+    def _relist_for_watch(self, resource: str,
+                          label_selector: Optional[Dict[str, str]]
+                          ) -> Tuple[List[Dict], str]:
+        """Fresh full list + the list's resourceVersion (the point a new
+        watch can safely resume from)."""
+        params: Dict[str, str] = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        resp = self._session.get(self._url(resource), params=params)
+        self._raise_for(resp, f"list {resource}")
+        body = resp.json()
+        rv = (body.get("metadata") or {}).get("resourceVersion") or ""
+        return body.get("items", []), rv
+
     def _watch_loop(self, resource: str,
                     label_selector: Optional[Dict[str, str]],
                     sub: _WatchSub, resource_version: str = "") -> None:
+        """Watch with client-go Reflector gap semantics: any break the
+        stream cannot bridge (HTTP 410 Gone delivered as an in-stream
+        ``ERROR`` event, or a transport error) triggers a backed-off
+        **relist** — a RELIST event carrying the fresh item set is pushed
+        for the informer to diff — and the watch resumes from the list's
+        resourceVersion, so deletions during the outage are never lost."""
+        import time as _time
+
         params: Dict[str, str] = {"watch": "true"}
         if label_selector:
             params["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in label_selector.items())
         if resource_version:
             params["resourceVersion"] = resource_version
+        backoff = 1.0
         while not sub.closed:
+            gap = False
             try:
                 with self._session.get(self._url(resource), params=params,
                                        stream=True, timeout=305) as resp:
@@ -271,16 +296,38 @@ class RestCluster:
                             ev = json.loads(line)
                         except ValueError:
                             continue
+                        ev_type = ev.get("type", "")
                         obj = ev.get("object") or {}
+                        if ev_type == "ERROR":
+                            # Status object, typically 410 Gone after etcd
+                            # compaction: our resourceVersion is too old.
+                            log.warning("watch %s: server error event "
+                                        "(code %s); relisting",
+                                        resource, obj.get("code"))
+                            gap = True
+                            break
                         rv = (obj.get("metadata") or {}).get("resourceVersion")
                         if rv:
                             params["resourceVersion"] = rv
-                        sub.push((ev.get("type", ""), obj))
+                        sub.push((ev_type, obj))
+                        backoff = 1.0
             except (requests.RequestException, ApiError) as e:
                 if sub.closed:
                     return
-                log.warning("watch %s dropped (%s); re-establishing",
-                            resource, e)
+                log.warning("watch %s dropped (%s); relisting", resource, e)
+                gap = True
+            if not gap or sub.closed:
+                continue
+            _time.sleep(backoff)
+            backoff = min(backoff * 2, 30.0)
+            try:
+                items, rv = self._relist_for_watch(resource, label_selector)
+            except (requests.RequestException, ApiError) as e:
+                log.warning("relist %s failed (%s); retrying", resource, e)
                 params.pop("resourceVersion", None)
-                import time
-                time.sleep(1.0)
+                continue
+            if rv:
+                params["resourceVersion"] = rv
+            else:
+                params.pop("resourceVersion", None)
+            sub.push((RELIST, {"items": items}))

@@ -59,3 +59,19 @@ def setup_logging(verbosity: int) -> None:
 
 def config_dict(args: argparse.Namespace) -> Dict[str, Any]:
     return dict(sorted(vars(args).items()))
+
+
+def parse_http_endpoint(value: str):
+    """``host:port`` / ``:port`` / ``[v6]:port`` → (host, port); '' → None.
+
+    Raises SystemExit with a clear message on malformed values (a raw
+    ValueError traceback would crash-loop the pod with no hint)."""
+    if not value:
+        return None
+    host, sep, port = value.strip().rpartition(":")
+    if host.startswith("[") and host.endswith("]"):  # [::]:8080
+        host = host[1:-1]
+    if not sep or not port.isdigit():
+        raise SystemExit(
+            f"--http-endpoint: expected host:port or :port, got {value!r}")
+    return (host or "0.0.0.0", int(port))

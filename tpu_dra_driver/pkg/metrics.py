@@ -1,0 +1,429 @@
+"""Prometheus-style metrics registry + debug HTTP endpoint.
+
+Reference analog: cmd/compute-domain-controller/main.go:372-419 — the
+controller exposes client-go/workqueue/Go-runtime Prometheus metrics via
+component-base legacyregistry plus full ``net/http/pprof`` when
+``--http-endpoint`` is set. The kubelet plugins there rely on V(6) timing
+log breadcrumbs instead; here the same breadcrumbs additionally feed
+histograms so the ResourceClaim-to-ready metric (BASELINE.md north star)
+is scrapeable, not just greppable.
+
+From-scratch implementation of the Prometheus *text exposition format*
+(counters, gauges, histograms with labels) — no client library dependency.
+The pprof analog is ``/debug/threads`` (all-thread stack dump, the same
+payload as the SIGUSR2 handler in :mod:`tpu_dra_driver.common.debug`).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_INF = float("inf")
+
+# client-go workqueue histogram buckets (seconds)
+DEFAULT_TIME_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5, 5.0, 10.0)
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _format_labels(names: Sequence[str], values: Sequence[str],
+                   extra: Optional[List[Tuple[str, str]]] = None) -> str:
+    pairs = [(n, v) for n, v in zip(names, values)]
+    if extra:
+        pairs.extend(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(f'{n}="{_escape(str(v))}"' for n, v in pairs)
+    return "{" + inner + "}"
+
+
+def _format_value(v: float) -> str:
+    if v == _INF:
+        return "+Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class _Metric:
+    """Base: a named family with fixed label names and per-labelset children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._mu = threading.Lock()
+
+    def labels(self, *values: str):
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} label values, "
+                f"got {len(values)}")
+        key = tuple(str(v) for v in values)
+        with self._mu:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                self._children[key] = child
+            return child
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def _iter_children(self) -> Iterable[Tuple[Tuple[str, ...], object]]:
+        with self._mu:
+            items = list(self._children.items())
+        return items
+
+    def render(self) -> List[str]:
+        raise NotImplementedError
+
+
+class _CounterChild:
+    __slots__ = ("_value", "_mu")
+
+    def __init__(self):
+        self._value = 0.0
+        self._mu = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._mu:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._mu:
+            return self._value
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        if not label_names:
+            self._children[()] = _CounterChild()
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self.label_names:
+            raise ValueError(f"{self.name} has labels; use .labels(...)")
+        self._children[()].inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._children[()].value
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key, child in self._iter_children():
+            lines.append(f"{self.name}{_format_labels(self.label_names, key)}"
+                         f" {_format_value(child.value)}")
+        return lines
+
+
+class _GaugeChild:
+    __slots__ = ("_value", "_mu")
+
+    def __init__(self):
+        self._value = 0.0
+        self._mu = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._mu:
+            self._value = float(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._mu:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._mu:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._mu:
+            return self._value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        if not label_names:
+            self._children[()] = _GaugeChild()
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def _self_child(self) -> _GaugeChild:
+        if self.label_names:
+            raise ValueError(f"{self.name} has labels; use .labels(...)")
+        return self._children[()]
+
+    def set(self, v: float) -> None:
+        self._self_child().set(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._self_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._self_child().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._self_child().value
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key, child in self._iter_children():
+            lines.append(f"{self.name}{_format_labels(self.label_names, key)}"
+                         f" {_format_value(child.value)}")
+        return lines
+
+
+class _HistogramChild:
+    __slots__ = ("_buckets", "_counts", "_sum", "_count", "_mu")
+
+    def __init__(self, buckets: Sequence[float]):
+        self._buckets = buckets
+        self._counts = [0] * len(buckets)
+        self._sum = 0.0
+        self._count = 0
+        self._mu = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._mu:
+            self._sum += v
+            self._count += 1
+            for i, bound in enumerate(self._buckets):
+                if v <= bound:
+                    self._counts[i] += 1
+                    break
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._mu:
+            return list(self._counts), self._sum, self._count
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, label_names=(),
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self._buckets = tuple(sorted(buckets))
+        if not label_names:
+            self._children[()] = _HistogramChild(self._buckets)
+
+    def _new_child(self):
+        return _HistogramChild(self._buckets)
+
+    def observe(self, v: float) -> None:
+        if self.label_names:
+            raise ValueError(f"{self.name} has labels; use .labels(...)")
+        self._children[()].observe(v)
+
+    def time(self):
+        """Context manager observing the elapsed wall time in seconds."""
+        return _Timer(self)
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key, child in self._iter_children():
+            counts, total, count = child.snapshot()
+            cumulative = 0
+            for bound, c in zip(self._buckets, counts):
+                cumulative += c
+                le = _format_labels(self.label_names, key,
+                                    extra=[("le", _format_value(bound))])
+                lines.append(f"{self.name}_bucket{le} {cumulative}")
+            le = _format_labels(self.label_names, key, extra=[("le", "+Inf")])
+            lines.append(f"{self.name}_bucket{le} {count}")
+            plain = _format_labels(self.label_names, key)
+            lines.append(f"{self.name}_sum{plain} {repr(total)}")
+            lines.append(f"{self.name}_count{plain} {count}")
+        return lines
+
+
+class _Timer:
+    def __init__(self, hist):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.monotonic() - self._t0)
+        return False
+
+
+class Registry:
+    """A named collection of metric families, rendered in text format."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._mu = threading.Lock()
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._mu:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric) or \
+                        existing.label_names != metric.label_names:
+                    raise ValueError(
+                        f"metric {metric.name} re-registered with a "
+                        f"different type or labels")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str,
+                label_names: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(name, help_text, label_names))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_text: str,
+              label_names: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help_text, label_names))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_text: str,
+                  label_names: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_text, label_names, buckets))  # type: ignore[return-value]
+
+    def render(self) -> str:
+        with self._mu:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: List[str] = []
+        for m in metrics:
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+
+#: Process-wide default registry (the legacyregistry analog).
+DEFAULT_REGISTRY = Registry()
+
+
+class QueueMetrics:
+    """client-go workqueue metric set for one named queue.
+
+    Families (matching upstream names): depth, adds_total, retries_total,
+    queue_duration_seconds (enqueue→pop), work_duration_seconds.
+    """
+
+    def __init__(self, queue_name: str, registry: Optional[Registry] = None):
+        reg = registry or DEFAULT_REGISTRY
+        self.depth = reg.gauge(
+            "workqueue_depth", "Current depth of the workqueue",
+            ("name",)).labels(queue_name)
+        self.adds = reg.counter(
+            "workqueue_adds_total", "Total adds handled by the workqueue",
+            ("name",)).labels(queue_name)
+        self.retries = reg.counter(
+            "workqueue_retries_total", "Total retries handled by the workqueue",
+            ("name",)).labels(queue_name)
+        self.queue_duration = reg.histogram(
+            "workqueue_queue_duration_seconds",
+            "How long an item stays queued before being processed",
+            ("name",)).labels(queue_name)
+        self.work_duration = reg.histogram(
+            "workqueue_work_duration_seconds",
+            "How long processing an item takes",
+            ("name",)).labels(queue_name)
+
+
+def dump_thread_stacks() -> str:
+    """All-thread stack dump — the pprof goroutine-profile analog, same
+    payload as the SIGUSR2 handler (internal/common/util.go:33-66)."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for ident, frame in frames.items():
+        header = f"--- thread {ident} ({names.get(ident, '?')}) ---"
+        chunks.append(header + "\n" + "".join(traceback.format_stack(frame)))
+    return "\n".join(chunks)
+
+
+class DebugHTTPServer:
+    """``--http-endpoint`` server: /metrics, /healthz, /readyz,
+    /debug/threads (the net/http/pprof analog)."""
+
+    def __init__(self, address: Tuple[str, int],
+                 registry: Optional[Registry] = None,
+                 ready_check=None):
+        self._registry = registry or DEFAULT_REGISTRY
+        self._ready_check = ready_check or (lambda: True)
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "text/plain; charset=utf-8") -> None:
+                payload = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200, outer._registry.render(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    self._send(200, "ok")
+                elif path == "/readyz":
+                    ok = False
+                    try:
+                        ok = bool(outer._ready_check())
+                    except Exception:
+                        ok = False
+                    self._send(200 if ok else 503, "ok" if ok else "not ready")
+                elif path == "/debug/threads":
+                    self._send(200, dump_thread_stacks())
+                else:
+                    self._send(404, "not found")
+
+        self._server = ThreadingHTTPServer(address, Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="debug-http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None

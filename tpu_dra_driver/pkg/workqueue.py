@@ -182,12 +182,15 @@ class WorkQueue:
     daemon thread.
     """
 
-    def __init__(self, rate_limiter: Optional[RateLimiter] = None, name: str = "workqueue"):
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None, name: str = "workqueue",
+                 metrics: Optional[Any] = None):
         self._limiter = rate_limiter or default_controller_rate_limiter()
         self._name = name
+        self._metrics = metrics  # pkg.metrics.QueueMetrics or None
         self._mu = threading.Condition()
         self._heap: list[_HeapEntry] = []
-        self._items: dict[str, tuple[int, Callable[[], Any]]] = {}  # key -> (gen, fn)
+        # key -> (gen, fn, enqueued_at)
+        self._items: dict[str, tuple[int, Callable[[], Any], float]] = {}
         self._seq = 0
         self._autokey = 0
         self._shutdown = False
@@ -212,10 +215,13 @@ class WorkQueue:
             # entry from an earlier incarnation match a re-enqueued item's
             # generation and fire it before its scheduled delay.
             self._seq += 1
-            self._items[key] = (self._seq, fn)
+            self._items[key] = (self._seq, fn, time.monotonic())
             heapq.heappush(
                 self._heap, _HeapEntry(time.monotonic() + delay, self._seq, key, self._seq)
             )
+            if self._metrics:
+                self._metrics.adds.inc()
+                self._metrics.depth.set(len(self._items))
             self._mu.notify_all()
 
     def forget(self, key: str) -> None:
@@ -241,8 +247,11 @@ class WorkQueue:
                     break
                 if self._heap and self._heap[0].ready_at <= now:
                     entry = heapq.heappop(self._heap)
-                    gen, fn = self._items.pop(entry.key)
+                    gen, fn, enqueued_at = self._items.pop(entry.key)
                     self._inflight += 1
+                    if self._metrics:
+                        self._metrics.depth.set(len(self._items))
+                        self._metrics.queue_duration.observe(now - enqueued_at)
                     return entry.key, gen, fn
                 timeout = (self._heap[0].ready_at - now) if self._heap else 0.2
                 self._mu.wait(timeout=min(timeout, 0.2))
@@ -253,22 +262,30 @@ class WorkQueue:
             if got is None:
                 return
             key, gen, fn = got
+            started = time.monotonic()
             try:
                 fn()
             except Exception:
+                if self._metrics:
+                    self._metrics.work_duration.observe(time.monotonic() - started)
+                    self._metrics.retries.inc()
                 delay = self._limiter.when(key)
                 with self._mu:
                     self._inflight -= 1
                     # Re-enqueue only if nothing newer arrived meanwhile.
                     if key not in self._items and not self._shutdown:
-                        self._items[key] = (gen, fn)
+                        self._items[key] = (gen, fn, time.monotonic())
                         self._seq += 1
                         heapq.heappush(
                             self._heap,
                             _HeapEntry(time.monotonic() + delay, self._seq, key, gen),
                         )
+                        if self._metrics:
+                            self._metrics.depth.set(len(self._items))
                     self._mu.notify_all()
             else:
+                if self._metrics:
+                    self._metrics.work_duration.observe(time.monotonic() - started)
                 self._limiter.forget(key)
                 with self._mu:
                     self._inflight -= 1

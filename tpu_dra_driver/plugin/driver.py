@@ -26,6 +26,7 @@ from tpu_dra_driver.cdi.generator import CdiHandler, DEFAULT_CDI_ROOT
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.pkg import featuregates as fg
 from tpu_dra_driver.pkg.flock import Flock, FlockOptions, FlockTimeoutError
+from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY, Registry
 from tpu_dra_driver.plugin.checkpoint import PreparedDevice
 from tpu_dra_driver.plugin.claims import ClaimInfo
 from tpu_dra_driver.plugin.cleanup import CheckpointCleanupManager
@@ -92,6 +93,20 @@ class TpuKubeletPlugin:
             self.state, clients.resource_claims,
             interval=config.cleanup_interval)
         self._started = False
+        # The ResourceClaim-to-ready north-star metric (BASELINE.md): the
+        # scrapeable form of the reference's t_prep* log breadcrumbs.
+        reg: Registry = DEFAULT_REGISTRY
+        self._m_prepare = reg.histogram(
+            "dra_claim_prepare_duration_seconds",
+            "NodePrepareResources wall time per claim by result",
+            ("result",))
+        self._m_unprepare = reg.histogram(
+            "dra_claim_unprepare_duration_seconds",
+            "NodeUnprepareResources wall time per claim by result",
+            ("result",))
+        self._m_lock_wait = reg.histogram(
+            "dra_prepare_lock_wait_seconds",
+            "Node-global prepare/unprepare flock acquisition wait")
 
     # ------------------------------------------------------------------
     # lifecycle (reference driver.go:66-173)
@@ -175,10 +190,20 @@ class TpuKubeletPlugin:
 
     def _node_prepare_resource(self, claim: ClaimInfo) -> PrepareResult:
         t0 = time.perf_counter()
+        result = self._node_prepare_resource_inner(claim, t0)
+        elapsed = time.perf_counter() - t0
+        outcome = ("ok" if result.error is None
+                   else "permanent_error" if result.permanent else "error")
+        self._m_prepare.labels(outcome).observe(elapsed)
+        return result
+
+    def _node_prepare_resource_inner(self, claim: ClaimInfo,
+                                     t0: float) -> PrepareResult:
         try:
             lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
             with lock:
                 t_lock = time.perf_counter() - t0
+                self._m_lock_wait.observe(t_lock)
                 devices = self.state.prepare(claim)
             log.debug("prepare %s: pu-lock wait %.1fms", claim.canonical, t_lock * 1e3)
             return PrepareResult(devices=devices)
@@ -194,12 +219,15 @@ class TpuKubeletPlugin:
     def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[str]]:
         out: Dict[str, Optional[str]] = {}
         for uid in claim_uids:
+            t0 = time.perf_counter()
             try:
                 lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
                 with lock:
                     self.state.unprepare(uid)
                 out[uid] = None
+                self._m_unprepare.labels("ok").observe(time.perf_counter() - t0)
             except Exception as e:
                 log.exception("unprepare %s failed", uid)
                 out[uid] = str(e)
+                self._m_unprepare.labels("error").observe(time.perf_counter() - t0)
         return out

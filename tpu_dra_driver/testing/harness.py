@@ -196,8 +196,10 @@ class ClusterHarness:
                 daemon = ComputeDomainDaemon(self.clients, host.lib, DaemonConfig(
                     cd_uid=cd_uid, cd_name="", cd_namespace="",
                     node_name=node_name, pod_name=pod_name, pod_ip=pod_ip,
-                    hosts_file=os.path.join(host.hosts_dir, "hosts"),
-                    worker_env_file=os.path.join(host.hosts_dir,
+                    # per-CD scoping, mirroring cmd/compute_domain_daemon
+                    # cd_run_dir: the run dir hostPath is node-shared
+                    hosts_file=os.path.join(host.hosts_dir, cd_uid, "hosts"),
+                    worker_env_file=os.path.join(host.hosts_dir, cd_uid,
                                                  "worker-env.json"),
                     gates=self.gates))
                 daemon.start()
