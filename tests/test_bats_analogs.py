@@ -1,0 +1,234 @@
+"""Hardware-free analogs of the reference's bats e2e scenarios that had no
+unit-level coverage yet (SURVEY.md §4):
+
+- stress: N concurrent consumers × M iterations over one shared claim
+  (tests/bats/test_gpu_stress.bats:42),
+- up/downgrade: checkpoint written by the "current" version must be
+  readable after a downgrade to a V1-only layout and vice versa
+  (tests/bats/test_{gpu,cd}_updowngrade.bats),
+- logging contract: V-level gating of the timing breadcrumbs
+  (tests/bats/test_cd_logging.bats),
+- SIGUSR2 stack dump (tests/bats/test_basics.bats:88-100).
+"""
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import zlib
+
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.checkpoint import CheckpointManager
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+NODE = "node-a"
+
+
+def _mkplugin(tmp_path, gates=None):
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    cfg = PluginConfig(
+        node_name=NODE,
+        state_dir=str(tmp_path / "plugin-state"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=gates or fg.FeatureGates(),
+    )
+    plugin = TpuKubeletPlugin(clients, lib, cfg)
+    plugin.start()
+    return plugin
+
+
+def _claim(uid, devices):
+    return build_allocated_claim(uid, f"claim-{uid}", "user-ns", devices, NODE)
+
+
+# ---------------------------------------------------------------------------
+# stress (test_gpu_stress.bats: N pods × M iterations over one shared claim)
+# ---------------------------------------------------------------------------
+
+def test_stress_shared_claim_concurrent_iterations(tmp_path):
+    plugin = _mkplugin(tmp_path)
+    chips = sorted(plugin.state.allocatable)
+    n_consumers, n_iters = 6, 8
+    for it in range(n_iters):
+        uid = f"stress-{it}"
+        claim = _claim(uid, chips)
+        results = [None] * n_consumers
+
+        def consume(i):
+            # every "pod" sharing the claim triggers its own Prepare; all
+            # must converge on the same prepared device set (idempotency)
+            results[i] = plugin.prepare_resource_claims([claim])[uid]
+
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in range(n_consumers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(r is not None for r in results)
+        assert all(r.error is None for r in results), \
+            [r.error for r in results if r.error]
+        device_sets = {tuple(sorted(d.canonical_name for d in r.devices))
+                       for r in results}
+        assert device_sets == {tuple(chips)}
+        errs = plugin.unprepare_resource_claims([uid])
+        assert errs[uid] is None
+    # after the churn: no claims left in the checkpoint, no CDI leftovers
+    assert plugin.state.get_checkpoint().claims == {}
+    cdi_dir = str(tmp_path / "cdi")
+    leftovers = [f for f in os.listdir(cdi_dir)] if os.path.isdir(cdi_dir) else []
+    assert not [f for f in leftovers if "stress" in f], leftovers
+
+
+def test_stress_distinct_claims_contend_for_devices(tmp_path):
+    """Distinct claims over the same chip must serialize via the overlap
+    guard: exactly one wins while the other gets a (retryable) error, and
+    after release the loser succeeds."""
+    plugin = _mkplugin(tmp_path)
+    chip = sorted(plugin.state.allocatable)[0]
+    a, b = _claim("uid-a", [chip]), _claim("uid-b", [chip])
+    ra = plugin.prepare_resource_claims([a])["uid-a"]
+    rb = plugin.prepare_resource_claims([b])["uid-b"]
+    assert ra.error is None
+    assert rb.error is not None and "already prepared" in rb.error, rb.error
+    plugin.unprepare_resource_claims(["uid-a"])
+    rb2 = plugin.prepare_resource_claims([b])["uid-b"]
+    assert rb2.error is None
+    plugin.unprepare_resource_claims(["uid-b"])
+
+
+# ---------------------------------------------------------------------------
+# up/downgrade (test_gpu_updowngrade.bats / test_cd_updowngrade.bats)
+# ---------------------------------------------------------------------------
+
+def _crc(payload):
+    return zlib.crc32(json.dumps(payload, sort_keys=True).encode())
+
+
+def test_downgrade_v1_only_reader_sees_completed_claims(tmp_path):
+    """Current version prepares; a V1-only "old" reader (no state machine)
+    must find every completed claim with its device names."""
+    plugin = _mkplugin(tmp_path)
+    chips = sorted(plugin.state.allocatable)[:2]
+    claim = _claim("uid-dg", chips)
+    assert plugin.prepare_resource_claims([claim])["uid-dg"].error is None
+
+    path = plugin.state._cp_mgr.path
+    raw = json.load(open(path))
+    assert _crc(raw["v1"]) == raw["checksums"]["v1"]  # old reader's check
+    v1_claims = raw["v1"]["claims"]
+    assert set(v1_claims) == {"uid-dg"}
+    assert [d["canonicalName"] for d in
+            v1_claims["uid-dg"]["preparedDevices"]] == chips
+    # V1 layout must be genuinely legacy: no state machine field
+    assert "state" not in v1_claims["uid-dg"]
+
+
+def test_upgrade_from_v1_only_checkpoint_full_flow(tmp_path):
+    """Simulated upgrade: the state dir holds a checkpoint written by an
+    old V1-only version. The new plugin must (a) not treat the claim's
+    sub-state as unknown, (b) refuse overlapping prepares against it, and
+    (c) unprepare it cleanly — after which it dual-writes v1+v2."""
+    plugin = _mkplugin(tmp_path)
+    chips = sorted(plugin.state.allocatable)[:1]
+    claim = _claim("uid-ug", chips)
+    assert plugin.prepare_resource_claims([claim])["uid-ug"].error is None
+    path = plugin.state._cp_mgr.path
+
+    # rewrite the file the way an old writer would have: v1 only
+    raw = json.load(open(path))
+    old = {"v1": raw["v1"], "checksums": {"v1": raw["checksums"]["v1"]}}
+    with open(path, "w") as f:
+        json.dump(old, f)
+
+    # "upgraded" plugin instance over the same state dir
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin2 = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name=NODE, state_dir=str(tmp_path / "plugin-state"),
+        cdi_root=str(tmp_path / "cdi")))
+    plugin2.start()
+    cp = plugin2.state.get_checkpoint()
+    assert set(cp.claims) == {"uid-ug"}
+    # (b) the migrated claim still owns its device
+    other = _claim("uid-other", chips)
+    assert plugin2.prepare_resource_claims([other])["uid-other"].error
+    # (a)+(c) unprepare proceeds from V1 data alone
+    errs = plugin2.unprepare_resource_claims(["uid-ug"])
+    assert errs["uid-ug"] is None
+    raw2 = json.load(open(path))
+    assert "v2" in raw2 and "v1" in raw2  # dual-write restored
+
+
+def test_corrupt_checkpoint_refuses_to_guess(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    cm.ensure_exists()
+    raw = json.load(open(cm.path))
+    raw["checksums"]["v2"] = raw["checksums"]["v2"] ^ 0xDEAD
+    with open(cm.path, "w") as f:
+        json.dump(raw, f)
+    import pytest
+    from tpu_dra_driver.plugin.checkpoint import CheckpointCorruptionError
+    with pytest.raises(CheckpointCorruptionError):
+        cm.read()
+
+
+# ---------------------------------------------------------------------------
+# logging contract (test_cd_logging.bats)
+# ---------------------------------------------------------------------------
+
+def test_verbosity_maps_to_levels():
+    from tpu_dra_driver.pkg.flags import setup_logging
+    for verbosity, level in ((0, logging.WARNING), (2, logging.INFO),
+                             (4, logging.INFO), (6, logging.DEBUG),
+                             (7, logging.DEBUG)):
+        root = logging.getLogger()
+        for h in root.handlers[:]:
+            root.removeHandler(h)
+        setup_logging(verbosity)
+        assert root.level == level, (verbosity, root.level)
+
+
+def test_prepare_breadcrumbs_gated_behind_debug(tmp_path, caplog):
+    """The pu-lock timing breadcrumb is the V(6) contract: absent at the
+    default verbosity, present at debug (reference driver.go:340-386)."""
+    plugin = _mkplugin(tmp_path)
+    chip = sorted(plugin.state.allocatable)[0]
+
+    with caplog.at_level(logging.INFO, logger="tpu_dra_driver.plugin.driver"):
+        plugin.prepare_resource_claims([_claim("uid-l1", [chip])])
+    assert not [r for r in caplog.records if "pu-lock wait" in r.message]
+    plugin.unprepare_resource_claims(["uid-l1"])
+
+    caplog.clear()
+    with caplog.at_level(logging.DEBUG, logger="tpu_dra_driver.plugin.driver"):
+        plugin.prepare_resource_claims([_claim("uid-l2", [chip])])
+    assert [r for r in caplog.records if "pu-lock wait" in r.message]
+    plugin.unprepare_resource_claims(["uid-l2"])
+
+
+# ---------------------------------------------------------------------------
+# SIGUSR2 stack dump (test_basics.bats:88-100)
+# ---------------------------------------------------------------------------
+
+def test_sigusr2_writes_stack_dump(tmp_path):
+    from tpu_dra_driver.common.debug import install_stack_dump_handler
+    dump = str(tmp_path / "stacks.dump")
+    old = signal.getsignal(signal.SIGUSR2)
+    try:
+        install_stack_dump_handler(path=dump)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not os.path.exists(dump):
+            time.sleep(0.05)
+        text = open(dump).read()
+        assert "MainThread" in text
+        assert "test_sigusr2_writes_stack_dump" in text
+    finally:
+        signal.signal(signal.SIGUSR2, old)

@@ -91,3 +91,56 @@ def test_repo_templates_match_controller_objects():
     assert ds["metadata"]["name"] == "cd-daemon-U"
     assert ds["spec"]["template"]["spec"]["resourceClaims"][0][
         "resourceClaimTemplateName"] == "cd-daemon-claim-U"
+
+
+def test_network_policies_render_and_lock_down_egress():
+    """NetworkPolicy templates (reference networkpolicy-*.yaml analogs):
+    egress-only lockdown to API-server ports, gated per component."""
+    text = open(os.path.join(
+        REPO, "deployments/helm/tpu-dra-driver/templates/networkpolicy.yaml")).read()
+    assert "controller.networkPolicy.enabled" in text
+    assert "kubeletPlugin.networkPolicy.enabled" in text
+    # strip helm gating to validate the YAML bodies
+    body = re.sub(r"\{\{-? .*?\}\}", "", text)
+    docs = [d for d in yaml.safe_load_all(body) if d]
+    assert len(docs) == 2
+    for doc in docs:
+        assert doc["kind"] == "NetworkPolicy"
+        assert doc["spec"]["policyTypes"] == ["Egress"]
+        ports = {p["port"] for rule in doc["spec"]["egress"]
+                 for p in rule["ports"]}
+        assert ports == {443, 6443}
+    selectors = {d["spec"]["podSelector"]["matchLabels"]
+                 ["app.kubernetes.io/component"] for d in docs}
+    assert selectors == {"controller", "kubelet-plugin"}
+
+
+def test_metrics_endpoints_wired_in_chart():
+    """The Prometheus endpoints must actually be reachable as deployed:
+    HTTP_ENDPOINT plumbed to the controller and the tpu kubelet plugin."""
+    controller = open(os.path.join(
+        REPO, "deployments/helm/tpu-dra-driver/templates/controller.yaml")).read()
+    plugin = open(os.path.join(
+        REPO, "deployments/helm/tpu-dra-driver/templates/kubeletplugin.yaml")).read()
+    assert "HTTP_ENDPOINT" in controller
+    assert "controller.httpEndpoint" in controller
+    assert "HTTP_ENDPOINT" in plugin
+    assert "metrics.pluginHttpEndpoint" in plugin
+
+
+def test_quickstart_opaque_configs_strict_decode():
+    """Every opaque config in the quickstart specs must pass the strict
+    decoder + Normalize/Validate — specs that the webhook would reject
+    must never ship as demos."""
+    from tpu_dra_driver.api import STRICT_DECODER
+    n = 0
+    for p in glob.glob(os.path.join(REPO, "demo/specs/quickstart/*.yaml")):
+        for doc in _load_all(p):
+            spec = doc.get("spec") or {}
+            inner = spec.get("spec") or spec  # RCT nests spec.spec
+            for cfg in (inner.get("devices") or {}).get("config") or []:
+                obj = STRICT_DECODER.decode(cfg["opaque"]["parameters"])
+                obj.normalize()
+                obj.validate()
+                n += 1
+    assert n >= 3  # timeslicing, multiprocess, vfio at minimum
