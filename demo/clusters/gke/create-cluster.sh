@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Create a GKE cluster with a multi-host TPU node pool for the real-hardware
+# path (reference analog: demo/clusters/gke/create-cluster.sh). Requires
+# gcloud auth + a project with TPU quota.
+set -euo pipefail
+
+PROJECT="${PROJECT:?set PROJECT}"
+ZONE="${ZONE:-us-east5-a}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+# v5p-16: 2 hosts × 4 chips — the smallest multi-host ICI slice, matching
+# the north-star benchmark in BASELINE.md
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x2x2}"
+MACHINE_TYPE="${MACHINE_TYPE:-ct5p-hightpu-4t}"
+
+gcloud container clusters create "${CLUSTER_NAME}" \
+  --project "${PROJECT}" --zone "${ZONE}" \
+  --cluster-version "${CLUSTER_VERSION:-1.34}" \
+  --enable-kubernetes-unstable-apis=resource.k8s.io/v1beta1/deviceclasses,resource.k8s.io/v1beta1/resourceclaims,resource.k8s.io/v1beta1/resourceclaimtemplates,resource.k8s.io/v1beta1/resourceslices \
+  --no-enable-autorepair --no-enable-autoupgrade
+
+gcloud container node-pools create tpu-pool \
+  --project "${PROJECT}" --zone "${ZONE}" --cluster "${CLUSTER_NAME}" \
+  --machine-type "${MACHINE_TYPE}" \
+  --tpu-topology "${TPU_TOPOLOGY}" \
+  --num-nodes 2
+
+echo "Cluster ready. Next: DEVICE_BACKEND=native ../kind/install-dra-driver-tpu.sh"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Tear down the GKE demo cluster (reference analog:
+# demo/clusters/gke/delete-cluster.sh).
+set -euo pipefail
+PROJECT="${PROJECT:?set PROJECT}"
+ZONE="${ZONE:-us-east5-a}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+gcloud container clusters delete "${CLUSTER_NAME}" \
+  --project "${PROJECT}" --zone "${ZONE}" --quiet

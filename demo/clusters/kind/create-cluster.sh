@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Create a kind cluster suitable for the tpu-dra-driver in fake-backend
+# mode (reference analog: demo/clusters/kind/create-cluster.sh — which
+# mounts the NVIDIA toolkit; TPU mode needs no toolkit, so a plain kind
+# node with the DRA feature gates is enough).
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+K8S_IMAGE="${K8S_IMAGE:-kindest/node:v1.34.0}"
+
+cat <<EOF | kind create cluster --name "${CLUSTER_NAME}" --image "${K8S_IMAGE}" --config=-
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+featureGates:
+  # DRA core + KEP-4815 partitionable devices
+  DynamicResourceAllocation: true
+  DRAPartitionableDevices: true
+containerdConfigPatches:
+  # CDI injection is how prepared devices reach containers
+  - |-
+    [plugins."io.containerd.grpc.v1.cri"]
+      enable_cdi = true
+nodes:
+  - role: control-plane
+  - role: worker
+    # the fake backend needs no devices; a hostPath for driver state is
+    # created on demand by the DaemonSet
+  - role: worker
+EOF
+
+kubectl cluster-info --context "kind-${CLUSTER_NAME}"
+echo "Cluster ${CLUSTER_NAME} ready. Next: ./install-dra-driver-tpu.sh"

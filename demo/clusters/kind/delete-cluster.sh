@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Tear down the kind demo cluster (reference analog:
+# demo/clusters/kind/delete-cluster.sh).
+set -euo pipefail
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+kind delete cluster --name "${CLUSTER_NAME}"

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Install the tpu-dra-driver chart into the kind cluster in fake-backend
+# mode so the full control flow (ResourceSlices → claims → Prepare → CDI)
+# runs without TPU hardware (reference analog:
+# demo/clusters/kind/install-dra-driver-gpu.sh).
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+REPO_ROOT="$(cd -- "$(dirname -- "${BASH_SOURCE[0]}")/../../.." &>/dev/null && pwd)"
+DRIVER_IMAGE="${DRIVER_IMAGE:-tpu-dra-driver:dev}"
+
+# load a locally built image if present
+if docker images --filter "reference=${DRIVER_IMAGE}" -q | grep -q .; then
+  kind load docker-image "${DRIVER_IMAGE}" --name "${CLUSTER_NAME}"
+fi
+
+helm upgrade --install tpu-dra-driver \
+  "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
+  --namespace tpu-dra-driver --create-namespace \
+  --set image.repository="${DRIVER_IMAGE%%:*}" \
+  --set image.tag="${DRIVER_IMAGE##*:}" \
+  --set-string featureGates="DynamicSubslice=true" \
+  --set deviceBackend="${DEVICE_BACKEND:-fake}" \
+  --set controller.httpEndpoint=":8085" \
+  "$@"
+
+kubectl -n tpu-dra-driver rollout status deploy/tpu-dra-driver-controller --timeout=120s
+echo "Driver installed. Try: kubectl apply -f ${REPO_ROOT}/demo/specs/quickstart/tpu-test1.yaml"

@@ -38,6 +38,9 @@ def build_parser() -> EnvArgumentParser:
                    type=float, default=2.0)
     p.add_argument("--leader-election", env="LEADER_ELECTION",
                    action="store_true", default=False)
+    p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
+                   choices=["native", "fake"],
+                   help="backend the stamped CD daemon pods run against")
     p.add_argument("--leader-election-namespace",
                    env="LEADER_ELECTION_NAMESPACE", default="tpu-dra-driver")
     p.add_argument("--identity", env="POD_NAME", default="controller")
@@ -57,7 +60,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     clients = make_clients(args)
     controller = ComputeDomainController(clients, ControllerConfig(
         max_nodes_per_domain=args.max_nodes_per_domain,
-        status_sync_interval=args.status_sync_interval))
+        status_sync_interval=args.status_sync_interval,
+        device_backend=args.device_backend))
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -60,6 +60,9 @@ class ControllerConfig:
     max_nodes_per_domain: int = DEFAULT_MAX_NODES_PER_DOMAIN
     status_sync_interval: float = STATUS_SYNC_INTERVAL
     orphan_cleanup_interval: float = ORPHAN_CLEANUP_INTERVAL
+    # hardware backend the stamped CD daemon pods must use; matches the
+    # chart-wide deviceBackend value ("fake" on demo clusters)
+    device_backend: str = "native"
 
 
 class ComputeDomainController:
@@ -196,7 +199,8 @@ class ComputeDomainController:
         would never propagate spec changes), and delete stale workload RCTs
         left behind by a rename of spec.channel.resourceClaimTemplate.name."""
         for client, obj in (
-            (self._clients.daemonsets, build_daemonset(cd)),
+            (self._clients.daemonsets,
+             build_daemonset(cd, device_backend=self._config.device_backend)),
             (self._clients.resource_claim_templates, build_daemon_rct(cd)),
             (self._clients.resource_claim_templates, build_workload_rct(cd)),
         ):

@@ -30,7 +30,8 @@ def daemon_rct_name(cd: ComputeDomain) -> str:
 
 
 def build_daemonset(cd: ComputeDomain, image: str = "tpu-dra-driver:latest",
-                    log_verbosity: int = 4) -> Dict:
+                    log_verbosity: int = 4,
+                    device_backend: str = "native") -> Dict:
     """The per-CD DaemonSet. Node targeting: only nodes labeled with this
     CD's uid (the CD kubelet plugin adds the label when a workload pod's
     claim first hits the node — reference daemonset.go:206-250)."""
@@ -63,6 +64,10 @@ def build_daemonset(cd: ComputeDomain, image: str = "tpu-dra-driver:latest",
                                     f"--compute-domain-name={cd.metadata.name}",
                                     f"--compute-domain-namespace={cd.metadata.namespace}",
                                     f"-v={log_verbosity}"],
+                        # the daemon must run the same hardware backend as
+                        # the plugins (fake on demo clusters)
+                        "env": [{"name": "DEVICE_BACKEND",
+                                 "value": device_backend}],
                         # exec readiness probe = `compute-domain-daemon check`
                         # (reference main.go:425-451); generous startup budget
                         "startupProbe": {
