@@ -8,16 +8,26 @@ set -euo pipefail
 CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
 REPO_ROOT="$(cd -- "$(dirname -- "${BASH_SOURCE[0]}")/../../.." &>/dev/null && pwd)"
 DRIVER_IMAGE="${DRIVER_IMAGE:-tpu-dra-driver:dev}"
+# ensure an explicit tag so repo/tag splitting below is well-defined even
+# for registries with ports (localhost:5001/img:tag)
+case "${DRIVER_IMAGE##*/}" in
+  *:*) ;;
+  *) DRIVER_IMAGE="${DRIVER_IMAGE}:latest" ;;
+esac
 
-# load a locally built image if present
-if docker images --filter "reference=${DRIVER_IMAGE}" -q | grep -q .; then
+# load a locally built image if present — only when the target kind
+# cluster actually exists (this script is also the install path for
+# GKE-style clusters, where `kind load` must be skipped)
+if command -v kind >/dev/null 2>&1 \
+    && kind get clusters 2>/dev/null | grep -qx "${CLUSTER_NAME}" \
+    && docker images --filter "reference=${DRIVER_IMAGE}" -q | grep -q .; then
   kind load docker-image "${DRIVER_IMAGE}" --name "${CLUSTER_NAME}"
 fi
 
 helm upgrade --install tpu-dra-driver \
   "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
   --namespace tpu-dra-driver --create-namespace \
-  --set image.repository="${DRIVER_IMAGE%%:*}" \
+  --set image.repository="${DRIVER_IMAGE%:*}" \
   --set image.tag="${DRIVER_IMAGE##*:}" \
   --set-string featureGates="DynamicSubslice=true" \
   --set deviceBackend="${DEVICE_BACKEND:-fake}" \

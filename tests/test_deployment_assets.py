@@ -2,6 +2,7 @@
 YAML with the expected shapes; Helm templates reference real values."""
 
 import glob
+import json
 import os
 import re
 
@@ -79,18 +80,106 @@ def test_helm_templates_reference_declared_values():
 
 
 def test_repo_templates_match_controller_objects():
-    """The documented YAML template mirrors what the controller stamps."""
+    """The controller renders the template *files* (reference
+    daemonset.go:189-251 behavior), so template and stamped object
+    cannot drift. Assert the runnable-pod contract of the rendered DS."""
     tmpl = open(os.path.join(REPO, "templates/compute-domain-daemon.tmpl.yaml")).read()
-    assert "resource.tpu.google.com/computeDomain: ${CD_UID}" in tmpl
+    assert 'resource.tpu.google.com/computeDomain: "${CD_UID}"' in tmpl
     assert "cd-daemon-claim-${CD_UID}" in tmpl
     assert "hostNetwork: true" in tmpl
     from tpu_dra_driver.api.types import ComputeDomain, ObjectMeta
     from tpu_dra_driver.computedomain.controller.objects import build_daemonset
     cd = ComputeDomain(metadata=ObjectMeta(name="x", namespace="ns", uid="U"))
-    ds = build_daemonset(cd)
+    ds = build_daemonset(cd, image="img:tag", device_backend="fake")
+    assert "${" not in json.dumps(ds), "leftover template placeholder"
     assert ds["metadata"]["name"] == "cd-daemon-U"
-    assert ds["spec"]["template"]["spec"]["resourceClaims"][0][
+    pod = ds["spec"]["template"]["spec"]
+    assert pod["resourceClaims"][0][
         "resourceClaimTemplateName"] == "cd-daemon-claim-U"
+    assert pod["hostNetwork"] is True
+    ctr = pod["containers"][0]
+    # in-image entrypoint is the module, not a console script
+    assert ctr["command"][:3] == ["python3", "-m",
+                                  "tpu_dra_driver.cmd.compute_domain_daemon"]
+    assert ctr["image"] == "img:tag"
+    env = {e["name"]: e for e in ctr["env"]}
+    # the daemon exits without these (cmd/compute_domain_daemon.py flags)
+    assert env["NODE_NAME"]["valueFrom"]["fieldRef"]["fieldPath"] == "spec.nodeName"
+    assert env["POD_IP"]["valueFrom"]["fieldRef"]["fieldPath"] == "status.podIP"
+    assert env["DEVICE_BACKEND"]["value"] == "fake"
+    for probe in ("startupProbe", "readinessProbe", "livenessProbe"):
+        assert ctr[probe]["exec"]["command"][-1] == "check"
+    # the arg-less probe `check` resolves the per-CD ready marker through
+    # the env-bound --compute-domain-uid flag; without CD_UID in the pod
+    # env every probe would look at the wrong path and never pass
+    assert env["CD_UID"]["value"] == "U"
+
+
+def test_templates_quote_user_controlled_strings():
+    """YAML-bool/int-looking user values ("true", "2024") must stay
+    strings after rendering — unquoted scalars would be type-coerced."""
+    from tpu_dra_driver.api.types import (
+        ComputeDomain, ComputeDomainChannelSpec, ComputeDomainSpec, ObjectMeta,
+    )
+    from tpu_dra_driver.computedomain.controller.objects import (
+        build_daemonset, build_workload_rct,
+    )
+    cd = ComputeDomain(
+        metadata=ObjectMeta(name="true", namespace="2024", uid="123"),
+        spec=ComputeDomainSpec(
+            num_nodes=1,
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name="2024")))
+    wrct = build_workload_rct(cd)
+    assert wrct["metadata"]["name"] == "2024"          # str, not int
+    assert wrct["metadata"]["namespace"] == "2024"
+    ds = build_daemonset(cd, image="i:t")
+    assert ds["metadata"]["labels"][
+        "resource.tpu.google.com/computeDomain"] == "123"
+    env = {e["name"]: e for e in
+           ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["CD_UID"]["value"] == "123"
+
+
+def test_rendered_claim_templates_round_trip():
+    """Daemon + workload RCTs render from their template files with the
+    opaque config (domainID) intact and strict-decodable."""
+    from tpu_dra_driver.api.types import (
+        ComputeDomain, ComputeDomainChannelSpec, ComputeDomainSpec, ObjectMeta,
+    )
+    from tpu_dra_driver.computedomain.controller.objects import (
+        build_daemon_rct, build_workload_rct,
+    )
+    from tpu_dra_driver.api.decoder import STRICT_DECODER
+    cd = ComputeDomain(
+        metadata=ObjectMeta(name="cd1", namespace="userns", uid="UID9"),
+        spec=ComputeDomainSpec(
+            num_nodes=2,
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name="my-rct")))
+    drct = build_daemon_rct(cd)
+    wrct = build_workload_rct(cd)
+    assert "${" not in json.dumps(drct) and "${" not in json.dumps(wrct)
+    assert wrct["metadata"]["name"] == "my-rct"
+    assert wrct["metadata"]["namespace"] == "userns"
+    for rct, kind in ((drct, "ComputeDomainDaemonConfig"),
+                      (wrct, "ComputeDomainChannelConfig")):
+        params = rct["spec"]["spec"]["devices"]["config"][0]["opaque"]["parameters"]
+        assert params["kind"] == kind
+        assert params["domainID"] == "UID9"
+        cfg = STRICT_DECODER.decode(params)
+        cfg.normalize()
+        cfg.validate()
+
+
+def test_template_rendering_is_strict():
+    """A missing placeholder must raise, not apply half-rendered YAML."""
+    import pytest
+    from tpu_dra_driver.computedomain.controller.objects import (
+        TemplateError, render_template,
+    )
+    with pytest.raises(TemplateError):
+        render_template("compute-domain-daemon.tmpl.yaml", {"CD_UID": "x"})
 
 
 def test_network_policies_render_and_lock_down_egress():

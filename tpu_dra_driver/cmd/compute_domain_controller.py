@@ -41,6 +41,13 @@ def build_parser() -> EnvArgumentParser:
     p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
                    choices=["native", "fake"],
                    help="backend the stamped CD daemon pods run against")
+    p.add_argument("--driver-image", env="DRIVER_IMAGE", default="",
+                   help="image for stamped CD daemon pods (defaults to "
+                        "this controller's own image in the chart)")
+    p.add_argument("--daemon-log-verbosity", env="DAEMON_LOG_VERBOSITY",
+                   type=int, default=4,
+                   help="verbosity plumbed into stamped CD daemon pods "
+                        "(reference daemonset.go:206-217)")
     p.add_argument("--leader-election-namespace",
                    env="LEADER_ELECTION_NAMESPACE", default="tpu-dra-driver")
     p.add_argument("--identity", env="POD_NAME", default="controller")
@@ -61,7 +68,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     controller = ComputeDomainController(clients, ControllerConfig(
         max_nodes_per_domain=args.max_nodes_per_domain,
         status_sync_interval=args.status_sync_interval,
-        device_backend=args.device_backend))
+        device_backend=args.device_backend,
+        daemon_image=args.driver_image,
+        daemon_log_verbosity=args.daemon_log_verbosity))
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

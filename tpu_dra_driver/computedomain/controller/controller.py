@@ -63,6 +63,11 @@ class ControllerConfig:
     # hardware backend the stamped CD daemon pods must use; matches the
     # chart-wide deviceBackend value ("fake" on demo clusters)
     device_backend: str = "native"
+    # image + verbosity for stamped CD daemon pods ("" → $DRIVER_IMAGE or
+    # the objects.DEFAULT_IMAGE fallback; reference plumbs these through
+    # the DaemonSet template, daemonset.go:206-217)
+    daemon_image: str = ""
+    daemon_log_verbosity: int = 4
 
 
 class ComputeDomainController:
@@ -200,7 +205,9 @@ class ComputeDomainController:
         left behind by a rename of spec.channel.resourceClaimTemplate.name."""
         for client, obj in (
             (self._clients.daemonsets,
-             build_daemonset(cd, device_backend=self._config.device_backend)),
+             build_daemonset(cd, image=self._config.daemon_image,
+                             log_verbosity=self._config.daemon_log_verbosity,
+                             device_backend=self._config.device_backend)),
             (self._clients.resource_claim_templates, build_daemon_rct(cd)),
             (self._clients.resource_claim_templates, build_workload_rct(cd)),
         ):

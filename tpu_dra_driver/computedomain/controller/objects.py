@@ -4,14 +4,19 @@ Reference analog: the in-image Go templates
 (templates/compute-domain-daemon.tmpl.yaml,
 compute-domain-daemon-claim-template.tmpl.yaml,
 compute-domain-workload-claim-template.tmpl.yaml) rendered by
-daemonset.go:189-251 and resourceclaimtemplate.go:304-399. Here the
-objects are built as dicts (the YAML templates in /templates mirror these
-shapes for the Helm-deployed production path).
+daemonset.go:189-251 and resourceclaimtemplate.go:304-399. Like the
+reference, the controller renders the template *files* (shipped in-image
+under /templates) rather than hand-building dicts, so the documented
+contract and the stamped objects cannot drift.
 """
 
 from __future__ import annotations
 
+import os
+import string
 from typing import Dict
+
+import yaml
 
 from tpu_dra_driver import API_GROUP, API_VERSION, COMPUTE_DOMAIN_DRIVER_NAME
 from tpu_dra_driver.api.types import ComputeDomain
@@ -19,6 +24,43 @@ from tpu_dra_driver.computedomain import COMPUTE_DOMAIN_LABEL_KEY, DRIVER_NAMESP
 
 DAEMON_DEVICE_CLASS = "compute-domain-daemon.tpu.google.com"
 DEFAULT_CHANNEL_DEVICE_CLASS = "compute-domain-default-channel.tpu.google.com"
+
+# In-image template location (reference: /templates baked into the
+# container, versions.mk; here the repo root's templates/ dir, override
+# via env for containerized layouts).
+_DEFAULT_TEMPLATES_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..", "templates")
+
+DEFAULT_IMAGE = "tpu-dra-driver:latest"
+
+
+def templates_dir() -> str:
+    return os.environ.get("TPU_DRA_TEMPLATES_DIR",
+                          os.path.normpath(_DEFAULT_TEMPLATES_DIR))
+
+
+class TemplateError(RuntimeError):
+    pass
+
+
+def render_template(name: str, variables: Dict[str, str]) -> Dict:
+    """Substitute ``${VAR}`` placeholders in templates/<name> and parse.
+
+    Strict: an unknown or leftover placeholder raises (a half-rendered
+    manifest applied to a cluster is worse than a loud failure)."""
+    path = os.path.join(templates_dir(), name)
+    with open(path, "r", encoding="utf-8") as fh:
+        raw = fh.read()
+    try:
+        rendered = string.Template(raw).substitute(variables)
+    except KeyError as exc:
+        raise TemplateError(f"{name}: unsubstituted placeholder {exc}") from exc
+    except ValueError as exc:   # bare `$` → invalid placeholder syntax
+        raise TemplateError(f"{name}: invalid placeholder: {exc}") from exc
+    obj = yaml.safe_load(rendered)
+    if not isinstance(obj, dict):
+        raise TemplateError(f"{name}: rendered to {type(obj).__name__}, not a mapping")
+    return obj
 
 
 def daemonset_name(cd: ComputeDomain) -> str:
@@ -29,97 +71,50 @@ def daemon_rct_name(cd: ComputeDomain) -> str:
     return f"cd-daemon-claim-{cd.metadata.uid}"
 
 
-def build_daemonset(cd: ComputeDomain, image: str = "tpu-dra-driver:latest",
+def _common_vars(cd: ComputeDomain) -> Dict[str, str]:
+    return {
+        "CD_UID": cd.metadata.uid,
+        "CD_NAME": cd.metadata.name,
+        "CD_NAMESPACE": cd.metadata.namespace,
+        "DRIVER_NAMESPACE": DRIVER_NAMESPACE,
+        "DRIVER_NAME": COMPUTE_DOMAIN_DRIVER_NAME,
+        "API_GROUP_VERSION": f"{API_GROUP}/{API_VERSION}",
+    }
+
+
+def build_daemonset(cd: ComputeDomain, image: str = "",
                     log_verbosity: int = 4,
                     device_backend: str = "native") -> Dict:
     """The per-CD DaemonSet. Node targeting: only nodes labeled with this
     CD's uid (the CD kubelet plugin adds the label when a workload pod's
-    claim first hits the node — reference daemonset.go:206-250)."""
-    uid = cd.metadata.uid
-    return {
-        "apiVersion": "apps/v1",
-        "kind": "DaemonSet",
-        "metadata": {
-            "name": daemonset_name(cd),
-            "namespace": DRIVER_NAMESPACE,
-            # No ownerReference: the CD lives in the *user's* namespace and
-            # Kubernetes forbids cross-namespace owners (the GC would treat
-            # the owner as absent and delete this DS). Lifecycle is handled
-            # by the label + finalizer teardown + orphan cleanup, exactly
-            # like the reference controller.
-            "labels": {COMPUTE_DOMAIN_LABEL_KEY: uid},
-        },
-        "spec": {
-            "selector": {"matchLabels": {COMPUTE_DOMAIN_LABEL_KEY: uid}},
-            "template": {
-                "metadata": {"labels": {COMPUTE_DOMAIN_LABEL_KEY: uid}},
-                "spec": {
-                    "nodeSelector": {COMPUTE_DOMAIN_LABEL_KEY: uid},
-                    "tolerations": [{"operator": "Exists"}],
-                    "containers": [{
-                        "name": "compute-domain-daemon",
-                        "image": image,
-                        "command": ["compute-domain-daemon",
-                                    f"--compute-domain-uid={uid}",
-                                    f"--compute-domain-name={cd.metadata.name}",
-                                    f"--compute-domain-namespace={cd.metadata.namespace}",
-                                    f"-v={log_verbosity}"],
-                        # the daemon must run the same hardware backend as
-                        # the plugins (fake on demo clusters)
-                        "env": [{"name": "DEVICE_BACKEND",
-                                 "value": device_backend}],
-                        # exec readiness probe = `compute-domain-daemon check`
-                        # (reference main.go:425-451); generous startup budget
-                        "startupProbe": {
-                            "exec": {"command": ["compute-domain-daemon", "check"]},
-                            "periodSeconds": 1, "failureThreshold": 1200,
-                        },
-                        "readinessProbe": {
-                            "exec": {"command": ["compute-domain-daemon", "check"]},
-                            "periodSeconds": 5,
-                        },
-                        "resources": {"claims": [{"name": "cd-daemon"}]},
-                    }],
-                    "resourceClaims": [{
-                        "name": "cd-daemon",
-                        "resourceClaimTemplateName": daemon_rct_name(cd),
-                    }],
-                },
-            },
-        },
-    }
+    claim first hits the node — reference daemonset.go:206-250).
+
+    No ownerReference: the CD lives in the *user's* namespace and
+    Kubernetes forbids cross-namespace owners (the GC would treat the
+    owner as absent and delete this DS). Lifecycle is handled by the
+    label + finalizer teardown + orphan cleanup, like the reference."""
+    # env resolution happens at the flag layer (--driver-image env
+    # DRIVER_IMAGE in cmd/compute_domain_controller.py) — no ambient
+    # environment reads here
+    image = image or DEFAULT_IMAGE
+    vars_ = _common_vars(cd)
+    vars_.update({
+        "IMAGE": image,
+        "LOG_VERBOSITY": str(log_verbosity),
+        "DEVICE_BACKEND": device_backend,
+    })
+    ds = render_template("compute-domain-daemon.tmpl.yaml", vars_)
+    assert ds["metadata"]["labels"][COMPUTE_DOMAIN_LABEL_KEY] == cd.metadata.uid
+    return ds
 
 
 def build_daemon_rct(cd: ComputeDomain) -> Dict:
     """ResourceClaimTemplate for the daemon pod's claim: one ``daemon``
     device of the CD driver, carrying the domain id in its opaque config."""
-    return {
-        "apiVersion": "resource.k8s.io/v1beta1",
-        "kind": "ResourceClaimTemplate",
-        "metadata": {
-            "name": daemon_rct_name(cd),
-            "namespace": DRIVER_NAMESPACE,
-            "labels": {COMPUTE_DOMAIN_LABEL_KEY: cd.metadata.uid},
-        },
-        "spec": {"spec": {"devices": {
-            "requests": [{
-                "name": "daemon",
-                "deviceClassName": DAEMON_DEVICE_CLASS,
-                "selectors": [{"attribute": "type", "equals": "daemon"}],
-            }],
-            "config": [{
-                "requests": ["daemon"],
-                "opaque": {
-                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
-                    "parameters": {
-                        "apiVersion": f"{API_GROUP}/{API_VERSION}",
-                        "kind": "ComputeDomainDaemonConfig",
-                        "domainID": cd.metadata.uid,
-                    },
-                },
-            }],
-        }}},
-    }
+    vars_ = _common_vars(cd)
+    vars_["DAEMON_DEVICE_CLASS"] = DAEMON_DEVICE_CLASS
+    return render_template("compute-domain-daemon-claim-template.tmpl.yaml",
+                           vars_)
 
 
 def build_workload_rct(cd: ComputeDomain) -> Dict:
@@ -127,33 +122,10 @@ def build_workload_rct(cd: ComputeDomain) -> Dict:
     name in the CD's namespace (reference resourceclaimtemplate.go:364-399).
     Workload pods reference it; each pod's claim yields one ICI channel
     device whose opaque config ties it back to this domain."""
-    return {
-        "apiVersion": "resource.k8s.io/v1beta1",
-        "kind": "ResourceClaimTemplate",
-        "metadata": {
-            "name": cd.spec.channel.resource_claim_template_name,
-            "namespace": cd.metadata.namespace,
-            "labels": {COMPUTE_DOMAIN_LABEL_KEY: cd.metadata.uid},
-        },
-        "spec": {"spec": {"devices": {
-            "requests": [{
-                "name": "channel",
-                "deviceClassName": DEFAULT_CHANNEL_DEVICE_CLASS,
-                "selectors": [
-                    {"attribute": "type", "equals": "channel"},
-                    {"attribute": "id", "equals": 0},
-                ],
-            }],
-            "config": [{
-                "requests": ["channel"],
-                "opaque": {
-                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
-                    "parameters": {
-                        "apiVersion": f"{API_GROUP}/{API_VERSION}",
-                        "kind": "ComputeDomainChannelConfig",
-                        "domainID": cd.metadata.uid,
-                    },
-                },
-            }],
-        }}},
-    }
+    vars_ = _common_vars(cd)
+    vars_.update({
+        "RCT_NAME": cd.spec.channel.resource_claim_template_name,
+        "CHANNEL_DEVICE_CLASS": DEFAULT_CHANNEL_DEVICE_CLASS,
+    })
+    return render_template("compute-domain-workload-claim-template.tmpl.yaml",
+                           vars_)

@@ -4,3 +4,9 @@ from tpu_dra_driver.workloads.parallel.mesh import (  # noqa: F401
     replicated,
     param_shardings,
 )
+from tpu_dra_driver.workloads.parallel.ringattention import (  # noqa: F401
+    make_ring_attention,
+    make_ulysses_attention,
+    ring_attention,
+    ulysses_attention,
+)
