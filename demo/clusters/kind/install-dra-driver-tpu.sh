@@ -9,7 +9,13 @@ CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
 REPO_ROOT="$(cd -- "$(dirname -- "${BASH_SOURCE[0]}")/../../.." &>/dev/null && pwd)"
 DRIVER_IMAGE="${DRIVER_IMAGE:-tpu-dra-driver:dev}"
 # ensure an explicit tag so repo/tag splitting below is well-defined even
-# for registries with ports (localhost:5001/img:tag)
+# for registries with ports (localhost:5001/img:tag); digest-pinned refs
+# (repo@sha256:...) cannot be expressed as chart repository+tag values
+if [[ "${DRIVER_IMAGE}" == *@* ]]; then
+  echo "ERROR: digest-pinned DRIVER_IMAGE (${DRIVER_IMAGE}) is not supported;" \
+       "use a repo:tag reference" >&2
+  exit 1
+fi
 case "${DRIVER_IMAGE##*/}" in
   *:*) ;;
   *) DRIVER_IMAGE="${DRIVER_IMAGE}:latest" ;;

@@ -182,6 +182,29 @@ def test_template_rendering_is_strict():
         render_template("compute-domain-daemon.tmpl.yaml", {"CD_UID": "x"})
 
 
+def test_template_rendering_rejects_yaml_injection():
+    """Quotes/newlines in user-controlled values must raise TemplateError,
+    never alter the parsed structure or escape as a yaml.ParserError."""
+    import pytest
+    from tpu_dra_driver.api.types import (
+        ComputeDomain, ComputeDomainChannelSpec, ComputeDomainSpec, ObjectMeta,
+    )
+    from tpu_dra_driver.computedomain.controller.objects import (
+        TemplateError, build_workload_rct,
+    )
+    for evil in ('x", namespace: "kube-system',
+                 "x\nkind: ClusterRole",
+                 "a b"):
+        cd = ComputeDomain(
+            metadata=ObjectMeta(name="cd", namespace="ns", uid="u1"),
+            spec=ComputeDomainSpec(
+                num_nodes=1,
+                channel=ComputeDomainChannelSpec(
+                    resource_claim_template_name=evil)))
+        with pytest.raises(TemplateError, match="unsafe"):
+            build_workload_rct(cd)
+
+
 def test_network_policies_render_and_lock_down_egress():
     """NetworkPolicy templates (reference networkpolicy-*.yaml analogs):
     egress-only lockdown to API-server ports, gated per component."""

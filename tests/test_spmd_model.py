@@ -1,0 +1,116 @@
+"""SPMD correctness of the flagship workload: the fully sharded
+(dp, sp, tp, ep) training step — ring attention over sp, Megatron tp,
+MoE experts over ep — must produce the same numbers as the unsharded
+single-device step. This is the test the driver's ``dryrun_multichip``
+compiles; here we also assert numerics, not just that it runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra_driver.workloads.models import (
+    ModelConfig, init_params, loss_fn, make_train_step,
+)
+from tpu_dra_driver.workloads.parallel import (
+    batch_sharding, build_mesh_spmd, make_ring_attention, param_shardings,
+)
+
+
+def _cfg(n_experts=0):
+    return ModelConfig(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                       d_ff=128, max_seq=64, dtype=jnp.float32,
+                       n_experts=n_experts)
+
+
+def _data(cfg, batch=4, seed=0):
+    key = jax.random.PRNGKey(seed)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (batch, cfg.max_seq), 0, cfg.vocab)
+    targets = jax.random.randint(key, (batch, cfg.max_seq), 0, cfg.vocab)
+    return params, tokens, targets
+
+
+def test_build_mesh_spmd_factorization():
+    devs = jax.devices()[:8]
+    mesh = build_mesh_spmd(devs)
+    assert dict(mesh.shape) == {"dp": 1, "sp": 2, "tp": 2, "ep": 2}
+    mesh2 = build_mesh_spmd(devs, dp=2, sp=2, tp=2, ep=1)
+    assert dict(mesh2.shape) == {"dp": 2, "sp": 2, "tp": 2, "ep": 1}
+    # explicit axes claim factors before defaults: a full-size explicit
+    # axis must not be starved by default tp/sp grabbing factors first
+    mesh3 = build_mesh_spmd(devs, ep=8)
+    assert dict(mesh3.shape) == {"dp": 1, "sp": 1, "tp": 1, "ep": 8}
+    mesh4 = build_mesh_spmd(devs, sp=4)
+    assert dict(mesh4.shape)["sp"] == 4
+    with pytest.raises(ValueError):
+        build_mesh_spmd(devs, dp=3)
+    with pytest.raises(ValueError):
+        build_mesh_spmd(devs, dp=2, sp=2, tp=1, ep=1)  # product 4 != 8
+
+
+def test_moe_forward_finite_and_expert_dependent():
+    cfg = _cfg(n_experts=4)
+    params, tokens, targets = _data(cfg)
+    loss = loss_fn(params, (tokens, targets), cfg)
+    assert np.isfinite(float(loss))
+    # experts must actually contribute: zeroing the bank changes the loss
+    params2 = jax.tree.map(lambda x: x, params)
+    params2["layers"][0]["moe_up"] = jnp.zeros_like(
+        params2["layers"][0]["moe_up"])
+    assert float(loss) != float(loss_fn(params2, (tokens, targets), cfg))
+
+
+@pytest.mark.parametrize("n_experts", [0, 4])
+def test_sharded_step_matches_single_device(n_experts):
+    cfg = _cfg(n_experts=n_experts)
+    params, tokens, targets = _data(cfg)
+
+    # oracle: unsharded step on device 0
+    step_ref, opt_init = make_train_step(cfg)
+    o_params, o_opt, o_loss = jax.jit(step_ref)(
+        params, opt_init(params), (tokens, targets))
+
+    # sharded over the 8-device CPU mesh; default factorization gives
+    # (dp=1, sp=2, tp=2, ep=2) so MoE exercises real expert parallelism
+    mesh = build_mesh_spmd(jax.devices()[:8], sp=2, tp=2)
+    ring = make_ring_attention(mesh, axis_name="sp", batch_axes=("dp",),
+                               head_axis="tp")
+    step_sh, _ = make_train_step(cfg, attn_fn=ring)
+
+    p_shard = param_shardings(mesh, params)
+    s_params = jax.device_put(params, p_shard)
+    s_opt = jax.jit(opt_init)(s_params)
+    b_shard = batch_sharding(mesh)
+    s_tokens = jax.device_put(tokens, b_shard)
+    s_targets = jax.device_put(targets, b_shard)
+
+    s_params, s_opt, s_loss = jax.jit(step_sh)(
+        s_params, s_opt, (s_tokens, s_targets))
+
+    assert abs(float(s_loss) - float(o_loss)) < 1e-4, \
+        f"sharded loss {float(s_loss)} != oracle {float(o_loss)}"
+    flat_o = jax.tree_util.tree_leaves(o_params)
+    flat_s = jax.tree_util.tree_leaves(s_params)
+    for a, b in zip(flat_o, flat_s):
+        np.testing.assert_allclose(np.asarray(b, np.float32),
+                                   np.asarray(a, np.float32),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_second_step_reduces_loss_under_sharding():
+    cfg = _cfg(n_experts=2)
+    params, tokens, targets = _data(cfg)
+    mesh = build_mesh_spmd(jax.devices()[:8])
+    ring = make_ring_attention(mesh, axis_name="sp", batch_axes=("dp",),
+                               head_axis="tp")
+    step, opt_init = make_train_step(cfg, attn_fn=ring)
+    p = jax.device_put(params, param_shardings(mesh, params))
+    o = jax.jit(opt_init)(p)
+    b = (jax.device_put(tokens, batch_sharding(mesh)),
+         jax.device_put(targets, batch_sharding(mesh)))
+    jstep = jax.jit(step)
+    p, o, l1 = jstep(p, o, b)
+    p, o, l2 = jstep(p, o, b)
+    assert float(l2) < float(l1)

@@ -13,6 +13,7 @@ contract and the stamped objects cannot drift.
 from __future__ import annotations
 
 import os
+import re
 import string
 from typing import Dict
 
@@ -43,21 +44,40 @@ class TemplateError(RuntimeError):
     pass
 
 
+# Textual substitution into YAML means every value must be inert YAML
+# scalar content. This allowlist covers all legitimate values (DNS-1123
+# names/uids, image refs incl. registries/digests, group/version paths)
+# and excludes quotes, whitespace and newlines — the YAML-injection
+# characters. User-controlled names that fail this never reach the
+# cluster half-rendered; they fail loudly at reconcile.
+_SAFE_VALUE = re.compile(r"^[A-Za-z0-9._:/@\-]+$")
+
+
 def render_template(name: str, variables: Dict[str, str]) -> Dict:
     """Substitute ``${VAR}`` placeholders in templates/<name> and parse.
 
-    Strict: an unknown or leftover placeholder raises (a half-rendered
-    manifest applied to a cluster is worse than a loud failure)."""
+    Strict: an unknown or leftover placeholder raises, and every value
+    must match the inert-scalar allowlist (a half-rendered or
+    structure-altered manifest applied to a cluster is worse than a
+    loud failure)."""
     path = os.path.join(templates_dir(), name)
     with open(path, "r", encoding="utf-8") as fh:
         raw = fh.read()
+    for key, val in variables.items():
+        if not _SAFE_VALUE.match(str(val)):
+            raise TemplateError(
+                f"{name}: value for ${{{key}}} contains characters unsafe "
+                f"for YAML substitution: {val!r}")
     try:
         rendered = string.Template(raw).substitute(variables)
     except KeyError as exc:
         raise TemplateError(f"{name}: unsubstituted placeholder {exc}") from exc
     except ValueError as exc:   # bare `$` → invalid placeholder syntax
         raise TemplateError(f"{name}: invalid placeholder: {exc}") from exc
-    obj = yaml.safe_load(rendered)
+    try:
+        obj = yaml.safe_load(rendered)
+    except yaml.YAMLError as exc:
+        raise TemplateError(f"{name}: rendered YAML does not parse: {exc}") from exc
     if not isinstance(obj, dict):
         raise TemplateError(f"{name}: rendered to {type(obj).__name__}, not a mapping")
     return obj

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from tpu_dra_driver.workloads.ops.attention import attention_reference
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -32,13 +34,17 @@ class ModelConfig:
     d_ff: int = 1024
     max_seq: int = 256
     dtype: jnp.dtype = jnp.bfloat16
+    # n_experts > 0 replaces the dense MLP with a softmax-gated dense
+    # mixture of experts (all experts computed, gate-weighted — static
+    # shapes, XLA-friendly; expert dim shards over the mesh's ep axis)
+    n_experts: int = 0
 
 
 Params = Dict
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
-    keys = jax.random.split(key, cfg.n_layers * 4 + 2)
+    keys = jax.random.split(key, cfg.n_layers * 5 + 2)
     k = iter(keys)
     scale = 0.02
 
@@ -52,14 +58,22 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         "final_norm": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
     }
     for _ in range(cfg.n_layers):
-        params["layers"].append({
+        layer = {
             "ln1": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
             "wqkv": mat(next(k), (cfg.d_model, 3 * cfg.d_model)),
             "wo": mat(next(k), (cfg.d_model, cfg.d_model)),
             "ln2": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
-            "w_up": mat(next(k), (cfg.d_model, cfg.d_ff)),
-            "w_down": mat(next(k), (cfg.d_ff, cfg.d_model)),
-        })
+        }
+        if cfg.n_experts > 0:
+            layer["router"] = mat(next(k), (cfg.d_model, cfg.n_experts))
+            layer["moe_up"] = mat(next(k),
+                                  (cfg.n_experts, cfg.d_model, cfg.d_ff))
+            layer["moe_down"] = mat(next(k),
+                                    (cfg.n_experts, cfg.d_ff, cfg.d_model))
+        else:
+            layer["w_up"] = mat(next(k), (cfg.d_model, cfg.d_ff))
+            layer["w_down"] = mat(next(k), (cfg.d_ff, cfg.d_model))
+        params["layers"].append(layer)
     return params
 
 
@@ -69,7 +83,11 @@ def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
     return ((x32 * rms) * g).astype(x.dtype)
 
 
-def _attention(x: jax.Array, layer: Params, n_heads: int) -> jax.Array:
+def _attention(x: jax.Array, layer: Params, n_heads: int,
+               attn_fn=None) -> jax.Array:
+    """``attn_fn(q, k, v) -> out`` on [b, h, t, hd] tensors; plug point
+    for flash_attention / ring_attention / ulysses_attention. Default is
+    the shared causal oracle (ops.attention.attention_reference)."""
     b, t, d = x.shape
     qkv = x @ layer["wqkv"]                      # MXU: [b,t,3d]
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -78,12 +96,8 @@ def _attention(x: jax.Array, layer: Params, n_heads: int) -> jax.Array:
     def heads(z):
         return z.reshape(b, t, n_heads, hd).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
-    scores = (q @ k.transpose(0, 1, 3, 2)).astype(jnp.float32) / (hd ** 0.5)
-    causal = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(causal, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = (probs @ v).transpose(0, 2, 1, 3).reshape(b, t, d)
+    out = (attn_fn or attention_reference)(heads(q), heads(k), heads(v))
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
     return out @ layer["wo"]
 
 
@@ -91,33 +105,53 @@ def _mlp(x: jax.Array, layer: Params) -> jax.Array:
     return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
 
 
-def forward(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+def _moe(x: jax.Array, layer: Params) -> jax.Array:
+    """Softmax-gated dense mixture of experts.
+
+    All experts run on all tokens and outputs are gate-weighted — a
+    deliberate TPU-first choice: static shapes, no dynamic dispatch or
+    capacity overflow, experts shard cleanly over the mesh ``ep`` axis
+    (XLA inserts one psum over ep at the weighted sum). Top-k sparse
+    routing is a scale optimization, not needed at acceptance scale.
+    """
+    gates = jax.nn.softmax((x @ layer["router"]).astype(jnp.float32), axis=-1)
+    up = jnp.einsum("btd,edf->betf", x, layer["moe_up"])          # [b,E,t,ff]
+    act = jax.nn.gelu(up)
+    down = jnp.einsum("betf,efd->betd", act, layer["moe_down"])   # [b,E,t,d]
+    return jnp.einsum("bte,betd->btd", gates.astype(x.dtype), down)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
+            attn_fn=None) -> jax.Array:
     """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
     b, t = tokens.shape
     x = params["embed"][tokens] + params["pos_embed"][:t]
     for layer in params["layers"]:
-        x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer, cfg.n_heads)
-        x = x + _mlp(_rmsnorm(x, layer["ln2"]["g"]), layer)
+        x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
+                           cfg.n_heads, attn_fn)
+        ffn = _moe if "moe_up" in layer else _mlp
+        x = x + ffn(_rmsnorm(x, layer["ln2"]["g"]), layer)
     x = _rmsnorm(x, params["final_norm"]["g"])
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
 def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
-            cfg: ModelConfig) -> jax.Array:
+            cfg: ModelConfig, attn_fn=None) -> jax.Array:
     tokens, targets = batch
-    logits = forward(params, tokens, cfg)
+    logits = forward(params, tokens, cfg, attn_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
 
 
-def make_train_step(cfg: ModelConfig, optimizer=None):
+def make_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None):
     """Returns (train_step, init_opt_state). train_step is pure/jittable:
     (params, opt_state, batch) -> (params, opt_state, loss)."""
     opt = optimizer or optax.adamw(1e-3)
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(params, batch)
+        loss, grads = jax.value_and_grad(
+            partial(loss_fn, cfg=cfg, attn_fn=attn_fn))(params, batch)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -1,5 +1,6 @@
 from tpu_dra_driver.workloads.parallel.mesh import (  # noqa: F401
     build_mesh,
+    build_mesh_spmd,
     batch_sharding,
     replicated,
     param_shardings,

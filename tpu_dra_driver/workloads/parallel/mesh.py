@@ -46,8 +46,55 @@ def _largest_pow2_divisor_le(n: int, cap: int) -> int:
     return best
 
 
+def build_mesh_spmd(devices: Optional[Sequence] = None,
+                    dp: Optional[int] = None, sp: Optional[int] = None,
+                    tp: Optional[int] = None, ep: Optional[int] = None) -> Mesh:
+    """4-axis ``(dp, sp, tp, ep)`` mesh for the full SPMD workload:
+    data, sequence (ring attention), tensor (Megatron), and expert (MoE)
+    parallelism.
+
+    Axis order puts ``ep`` innermost so the most latency-sensitive
+    collectives (expert psum, tp psum) ride adjacent-device ICI links;
+    ``dp`` outermost (its all-reduce is per-step, amortizable).
+    Default factorization gives each of tp/sp/ep a factor of 2 when the
+    device count allows, dp the remainder — so an 8-device dryrun
+    exercises sp, tp and ep nontrivially at once.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+
+    # explicit axes claim their factors first, then defaults (tp, sp, ep
+    # in that priority) take a factor of 2 each, dp absorbs the rest
+    sizes = {"tp": tp, "sp": sp, "ep": ep, "dp": dp}
+    rem = n
+    for ax, size in sizes.items():
+        if size is not None:
+            if size <= 0 or rem % size:
+                raise ValueError(
+                    f"{ax}={size} does not divide remaining device count "
+                    f"{rem} (of {n})")
+            rem //= size
+    for ax in ("tp", "sp", "ep"):
+        if sizes[ax] is None:
+            sizes[ax] = 2 if rem % 2 == 0 else 1
+            rem //= sizes[ax]
+    if sizes["dp"] is None:
+        sizes["dp"] = rem
+        rem = 1
+    if rem != 1:
+        raise ValueError(
+            f"dp({sizes['dp']}) * sp({sizes['sp']}) * tp({sizes['tp']}) * "
+            f"ep({sizes['ep']}) != device count ({n})")
+    arr = np.array(devs).reshape(sizes["dp"], sizes["sp"], sizes["tp"],
+                                 sizes["ep"])
+    return Mesh(arr, axis_names=("dp", "sp", "tp", "ep"))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Inputs: batch dim sharded over dp, replicated over tp."""
+    if "sp" in mesh.shape:
+        # SPMD mesh: tokens [b, t] shard batch over dp, sequence over sp
+        return NamedSharding(mesh, P("dp", "sp"))
     return NamedSharding(mesh, P("dp", None))
 
 
@@ -65,8 +112,17 @@ def param_shardings(mesh: Mesh, params) -> "jax.tree_util.PyTreeDef":
     XLA then emits exactly one psum per block boundary per step direction,
     which is the minimal-collective schedule for this family.
     """
+    ep_ax = "ep" if "ep" in mesh.shape else None
+
     def rule(path: str, x):
         if x.ndim < 2:
+            return NamedSharding(mesh, P())
+        # MoE expert banks: expert dim over ep, then Megatron within expert
+        if "moe_up" in path:
+            return NamedSharding(mesh, P(ep_ax, None, "tp"))
+        if "moe_down" in path:
+            return NamedSharding(mesh, P(ep_ax, "tp", None))
+        if "router" in path:
             return NamedSharding(mesh, P())
         if any(k in path for k in ("wqkv", "w_up", "w_gate")):
             return NamedSharding(mesh, P(None, "tp"))
