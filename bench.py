@@ -142,6 +142,17 @@ def bench_accelerator() -> dict:
             bw = psum_bandwidth(mib_per_device=64, iters=3)
             out["psum_bus_gbps"] = round(bw.bus_gbps, 2)
             log(f"  {bw}")
+        if backend == "tpu":
+            # compiled Mosaic kernel only; interpreter mode (cpu) would
+            # take minutes and measure nothing meaningful
+            from tpu_dra_driver.workloads.ops import flash_attention_tflops
+            fa = flash_attention_tflops()
+            out["flash_attn_tflops"] = round(fa["flash_attn_tflops"], 2)
+            out["flash_attn_speedup_vs_xla_ref"] = round(
+                fa["speedup_vs_ref"], 2)
+            log(f"  flash attention: {fa['flash_attn_tflops']:.2f} TFLOP/s "
+                f"({fa['shape']}), {fa['speedup_vs_ref']:.2f}x vs XLA "
+                f"reference attention ({fa['ref_attn_tflops']:.2f})")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out

@@ -7,4 +7,5 @@ from tpu_dra_driver.workloads.ops.collectives import (  # noqa: F401
 from tpu_dra_driver.workloads.ops.attention import (  # noqa: F401
     attention_reference,
     flash_attention,
+    flash_attention_tflops,
 )

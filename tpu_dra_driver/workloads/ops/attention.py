@@ -70,7 +70,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_kv: int,
     t = k_ref.shape[0]
     d = q_ref.shape[1]
 
-    q = q_ref[:].astype(jnp.float32) * sm_scale                 # [bq, d]
+    # keep the matmul operands in the input dtype (bf16 on TPU) so the
+    # MXU runs at full rate; accumulation is f32 via preferred_element_type
+    q = q_ref[:]                                                # [bq, d]
 
     num_kv = t // block_kv
     if causal:
@@ -85,11 +87,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_kv: int,
 
     def body(j, carry):
         acc, m, l = carry
-        kb = k_ref[pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        vb = v_ref[pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        kb = k_ref[pl.ds(j * block_kv, block_kv), :]
+        vb = v_ref[pl.ds(j * block_kv, block_kv), :]
         s = jax.lax.dot_general(                                 # [bq, bkv]
             q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32) * sm_scale
         if causal:
             col_ids = j * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
@@ -98,8 +100,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_kv: int,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, vb, dimension_numbers=(((1,), (0,)), ((), ())),
+        pv = jax.lax.dot_general(                                # [bq, d]
+            p.astype(vb.dtype), vb,
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc = acc * alpha + pv
         return acc, m_new, l
@@ -115,11 +118,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_kv: int,
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
                    interpret: bool):
     b, h, t, d = q.shape
-    block_q = min(block_q, t)
-    block_kv = min(block_kv, t)
-    if t % block_q or t % block_kv:
-        raise ValueError(f"seq len {t} not divisible by blocks "
-                         f"({block_q}, {block_kv})")
+
+    def fit(req):
+        # largest divisor of t not exceeding the requested block, so any
+        # t works with the (tuned, large) defaults
+        blk = min(req, t)
+        while t % blk:
+            blk -= 1
+        return blk
+
+    block_q, block_kv = fit(block_q), fit(block_kv)
     sm_scale = 1.0 / math.sqrt(d)
 
     qf = q.reshape(b * h, t, d)
@@ -158,8 +166,8 @@ def _on_tpu() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 128,
-                    block_kv: int = 128,
+                    causal: bool = True, block_q: int = 512,
+                    block_kv: int = 512,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Blockwise flash attention. q/k/v: [b, h, t, d] → [b, h, t, d].
 
@@ -189,3 +197,47 @@ def _flash_bwd(causal, block_q, block_kv, interpret, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
+                           d: int = 128, dtype=jnp.bfloat16,
+                           iters: int = 3, chain_short: int = 64,
+                           chain_long: int = 192):
+    """Causal flash-attention forward throughput (TFLOP/s) and speedup
+    vs the XLA-compiled reference attention at the same shape.
+
+    Steady-state accounting: dependent chains of two lengths run inside
+    one jit each, and the *marginal* rate between them cancels the fixed
+    dispatch/transport overhead (large on tunneled remote devices) —
+    the same method as matmul_tflops_steady. FLOP accounting:
+    4*b*h*t^2*d (QK^T + PV), halved for causality."""
+    from tpu_dra_driver.workloads.utils.timing import time_fn
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, t, d), dtype)
+    k = jax.random.normal(kk, (b, h, t, d), dtype)
+    v = jax.random.normal(kv, (b, h, t, d), dtype)
+
+    def measure(attn):
+        times = {}
+        for n in (chain_short, chain_long):
+            @jax.jit
+            def run(q, k, v, n=n):
+                def body(_, qq):
+                    return attn(qq, k, v).astype(dtype)
+                return jax.lax.fori_loop(0, n, body, q)
+            times[n] = time_fn(lambda r=run: r(q, k, v),
+                               warmup=2, iters=iters).median_s
+        dt = times[chain_long] - times[chain_short]
+        return max(dt, 1e-9) / (chain_long - chain_short)
+
+    per_flash = measure(lambda q, k, v: flash_attention(q, k, v, True))
+    per_ref = measure(lambda q, k, v: attention_reference(q, k, v, True))
+    flops = 4 * b * h * t * t * d / 2
+    return {
+        "flash_attn_tflops": flops / per_flash / 1e12,
+        "ref_attn_tflops": flops / per_ref / 1e12,
+        "speedup_vs_ref": per_ref / per_flash,
+        "shape": f"b{b} h{h} t{t} d{d} {jnp.dtype(dtype).name}",
+    }
