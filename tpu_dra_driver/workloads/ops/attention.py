@@ -16,10 +16,12 @@ MXU. This module provides:
   are skipped entirely (the fori_loop upper bound is derived from the
   Q-block index), halving the work.
 
-Gradients flow through a ``jax.custom_vjp``: forward runs the kernel,
-backward recomputes through the reference formulation (rematerialized —
-no residual score matrix is stored between fwd and bwd). A fused Pallas
-backward is a further optimization, not a correctness gap.
+Gradients flow through a ``jax.custom_vjp`` with *Pallas backward
+kernels* (the FlashAttention-2 recipe): the forward additionally emits
+the per-row logsumexp, and the backward recomputes P blockwise from
+(q, k, lse) in two kernels — one accumulating dq over KV blocks, one
+accumulating dk/dv over Q blocks — so the backward is O(t) memory too
+(no [t, t] score matrix ever exists in either direction).
 
 Off-TPU (CPU tests, virtual meshes) the kernel runs under the Pallas
 interpreter so the exact same code path is unit-testable without
@@ -35,13 +37,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-
-try:  # pltpu imports cleanly on CPU builds of jaxlib; guard anyway
-    from jax.experimental.pallas import tpu as pltpu
-    _HAVE_PLTPU = True
-except ImportError:  # pragma: no cover
-    pltpu = None
-    _HAVE_PLTPU = False
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -59,102 +55,379 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_kv: int,
-                  causal: bool, sm_scale: float):
-    """One (batch*head, q-block) grid cell.
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
+                  block_q: int, block_kv: int, causal: bool, sm_scale: float,
+                  num_super: int):
+    """One (batch*head, q-block, kv-superblock) grid cell.
 
-    q_ref: [block_q, d]; k_ref/v_ref: [t, d] (whole sequence for this
-    batch*head, resident in VMEM); o_ref: [block_q, d].
+    Two-level KV tiling: the innermost grid axis steps over
+    *superblocks* (one [super, d] K/V tile VMEM-resident at a time,
+    double-buffered from HBM by pallas — so sequence length is bounded
+    by HBM, not the 16 MB VMEM), and an inner fori_loop walks
+    [block_kv]-sized slices of the superblock with the iteration count
+    *trimmed to the causal prefix* (no wasted MXU work past the
+    diagonal). Online-softmax state (acc/m/l) lives in VMEM scratch,
+    carried across superblock steps of one q block; output and per-row
+    logsumexp (the backward's residual) are written on the last step.
+    Fully-masked superblocks skip all compute via pl.when.
     """
     qi = pl.program_id(1)
-    t = k_ref.shape[0]
+    sj = pl.program_id(2)
+    super_kv = k_ref.shape[0]
+    nb = super_kv // block_kv
+    row_max = qi * block_q + block_q - 1       # last causal-visible column
     d = q_ref.shape[1]
 
-    # keep the matmul operands in the input dtype (bf16 on TPU) so the
-    # MXU runs at full rate; accumulation is f32 via preferred_element_type
-    q = q_ref[:]                                                # [bq, d]
-
-    num_kv = t // block_kv
-    if causal:
-        # last kv block that intersects the causal triangle for this q block
-        upper = (qi * block_q + block_q + block_kv - 1) // block_kv
-        upper = jnp.minimum(upper, num_kv)
-    else:
-        upper = num_kv
-
-    row_ids = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_kv), 0)
-
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[pl.ds(j * block_kv, block_kv), :]
-        vb = v_ref[pl.ds(j * block_kv, block_kv), :]
-        s = jax.lax.dot_general(                                 # [bq, bkv]
-            q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+    def steps(carry):
+        """Online-softmax over this superblock's causal prefix."""
         if causal:
-            col_ids = j * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(                                # [bq, d]
-            p.astype(vb.dtype), vb,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        acc = acc * alpha + pv
-        return acc, m_new, l
+            # number of inner blocks intersecting the causal triangle
+            upper = jnp.minimum(
+                nb, (row_max - sj * super_kv) // block_kv + 1)
+        else:
+            upper = nb
+        q = q_ref[:]                                             # [bq, d]
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+        def body(j2, carry):
+            acc, m, l = carry
+            # matmul operands stay in the input dtype (bf16 on TPU) so
+            # the MXU runs at full rate; accumulation is f32
+            kb = k_ref[pl.ds(j2 * block_kv, block_kv), :]
+            vb = v_ref[pl.ds(j2 * block_kv, block_kv), :]
+            s = jax.lax.dot_general(                             # [bq, bkv]
+                q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                row_ids = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_kv), 0)
+                col_ids = (sj * super_kv + j2 * block_kv
+                           + jax.lax.broadcasted_iota(
+                               jnp.int32, (block_q, block_kv), 1))
+                s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(                            # [bq, d]
+                p.astype(vb.dtype), vb,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc * alpha + pv, m_new, l
 
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        return jax.lax.fori_loop(0, upper, body, carry)
+
+    def finish(carry):
+        acc, m, l = carry
+        l = jnp.maximum(l, 1e-30)
+        o_ref[:] = (acc / l).astype(o_ref.dtype)
+        lse_ref[:] = (m + jnp.log(l)).reshape(1, block_q)
+
+    zeros = lambda: (jnp.zeros((block_q, d), jnp.float32),
+                     jnp.full((block_q, 1), NEG_INF, jnp.float32),
+                     jnp.zeros((block_q, 1), jnp.float32))
+
+    live = True if not causal else (sj * super_kv <= row_max)
+    _grid_accumulate(num_super, sj, live, steps, finish,
+                     (acc_sc, m_sc, l_sc), zeros)
+
+
+# kv superblock VMEM budget: K + V tiles at [4096, 128] bf16 are 1 MB
+# each, 4 MB with double buffering — comfortably inside 16 MB alongside
+# the q/o blocks and f32 scratch.
+_SUPER_KV = 4096
+
+
+def _fit_block(req: int, t: int) -> int:
+    """Largest divisor of t not exceeding the requested block, so any
+    reasonable t works with the (tuned, large) defaults. A t whose only
+    small divisors are degenerate (primes, 2*prime, ...) would silently
+    compile a pathological grid of near-scalar tiles — error instead."""
+    blk = min(req, t)
+    while t % blk:
+        blk -= 1
+    if blk < min(128, t, req):
+        raise ValueError(
+            f"seq len {t} has no block divisor >= 128 (got {blk}); pad the "
+            f"sequence to a multiple of 128 for the MXU")
+    return blk
+
+
+def _scratch(block_q: int, d: int):
+    return [pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32)]
+
+
+def _compiler_params():
+    # kv is a carried-accumulation axis, bh/q-block are parallel
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))}
+
+
+def _grid_accumulate(num_super, sj, live, steps, finish, scratch, zeros):
+    """Shared scaffolding for superblock-accumulating kernels.
+
+    ``steps(carry) -> carry`` folds one superblock into the running
+    state; ``finish(carry)`` writes the outputs on the last grid step;
+    ``scratch`` is the tuple of VMEM refs carrying state across steps.
+    When the grid has a single superblock the scratch round-trip is
+    skipped entirely (pure local carry — the fast path for t <= super).
+    """
+    if num_super == 1:
+        finish(steps(zeros()))
+        return
+
+    @pl.when(sj == 0)
+    def _init():
+        for ref, z in zip(scratch, zeros()):
+            ref[:] = z
+
+    @pl.when(live)
+    def _steps():
+        out = steps(tuple(ref[:] for ref in scratch))
+        for ref, val in zip(scratch, out):
+            ref[:] = val
+
+    @pl.when(sj == num_super - 1)
+    def _finish():
+        finish(tuple(ref[:] for ref in scratch))
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
                    interpret: bool):
+    """Returns (out [b,h,t,d], lse [b*h, 1, t] f32)."""
     b, h, t, d = q.shape
-
-    def fit(req):
-        # largest divisor of t not exceeding the requested block, so any
-        # t works with the (tuned, large) defaults
-        blk = min(req, t)
-        while t % blk:
-            blk -= 1
-        return blk
-
-    block_q, block_kv = fit(block_q), fit(block_kv)
+    super_kv = _fit_block(_SUPER_KV, t)
+    block_q = _fit_block(block_q, t)
+    block_kv = _fit_block(block_kv, super_kv)
     sm_scale = 1.0 / math.sqrt(d)
+    num_super = t // super_kv
 
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
 
-    grid = (b * h, t // block_q)
+    grid = (b * h, t // block_q, num_super)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_kv=block_kv,
-        causal=causal, sm_scale=sm_scale)
+        causal=causal, sm_scale=sm_scale, num_super=num_super)
 
-    vmem = {"memory_space": pltpu.VMEM} if _HAVE_PLTPU else {}
+    vmem = {"memory_space": pltpu.VMEM}
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0), **vmem),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0), **vmem),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0), **vmem),
+            pl.BlockSpec((None, block_q, d), lambda i, qi, j: (i, qi, 0), **vmem),
+            pl.BlockSpec((None, super_kv, d), lambda i, qi, j: (i, j, 0), **vmem),
+            pl.BlockSpec((None, super_kv, d), lambda i, qi, j: (i, j, 0), **vmem),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0),
-                               **vmem),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((None, block_q, d), lambda i, qi, j: (i, qi, 0), **vmem),
+            pl.BlockSpec((None, 1, block_q), lambda i, qi, j: (i, 0, qi), **vmem),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, t), jnp.float32),
+        ),
+        scratch_shapes=_scratch(block_q, d),
         interpret=interpret,
+        **_compiler_params(),
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+    return out.reshape(b, h, t, d), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
+                         dq_ref, acc_sc, *, block_q: int, block_kv: int,
+                         causal: bool, sm_scale: float, num_super: int):
+    """dq for one (batch*head, q-block, kv-superblock) grid cell.
+
+    P is rebuilt from (q, k, lse); dS = P * (dP - D); dq = sum_j dS @ K_j
+    * scale. D = rowsum(dO * O) is precomputed outside (one fused
+    elementwise pass). Same two-level KV tiling as the forward: one
+    superblock VMEM-resident per grid step, inner fori trimmed to the
+    causal prefix, dq accumulated in VMEM scratch."""
+    qi = pl.program_id(1)
+    sj = pl.program_id(2)
+    super_kv = k_ref.shape[0]
+    nb = super_kv // block_kv
+    row_max = qi * block_q + block_q - 1
+
+    def steps(acc0):
+        upper = (jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
+                 if causal else nb)
+        lse = lse_ref[:].reshape(block_q, 1)
+        dD = dD_ref[:].reshape(block_q, 1)
+
+        def body(j2, acc):
+            kb = k_ref[pl.ds(j2 * block_kv, block_kv), :]
+            vb = v_ref[pl.ds(j2 * block_kv, block_kv), :]
+            s = jax.lax.dot_general(
+                q_ref[:], kb, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                row_ids = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_kv), 0)
+                col_ids = (sj * super_kv + j2 * block_kv
+                           + jax.lax.broadcasted_iota(
+                               jnp.int32, (block_q, block_kv), 1))
+                s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+            p = jnp.exp(s - lse)                                 # [bq, bkv]
+            dp = jax.lax.dot_general(                            # dO @ V^T
+                do_ref[:], vb, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dD) * sm_scale
+            return acc + jax.lax.dot_general(                    # dS @ K
+                ds.astype(kb.dtype), kb,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        return jax.lax.fori_loop(0, upper, body, acc0)
+
+    d = q_ref.shape[1]
+
+    def finish(carry):
+        dq_ref[:] = carry[0].astype(dq_ref.dtype)
+
+    live = True if not causal else (sj * super_kv <= row_max)
+    _grid_accumulate(
+        num_super, sj, live,
+        steps=lambda carry: (steps(carry[0]),),
+        finish=finish,
+        scratch=(acc_sc,),
+        zeros=lambda: (jnp.zeros((block_q, d), jnp.float32),))
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
+                          dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
+                          block_kv: int, causal: bool, sm_scale: float,
+                          num_super: int):
+    """dk/dv for one (batch*head, kv-block, q-superblock) grid cell.
+
+    dv = sum_i P_i^T @ dO_i; dk = sum_i dS_i^T @ Q_i * scale. The q axis
+    is superblock-tiled; causality starts the inner loop at the first Q
+    block that can see this KV block and skips superblocks entirely
+    above the diagonal."""
+    kj = pl.program_id(1)
+    si = pl.program_id(2)
+    super_q = q_ref.shape[0]
+    nb = super_q // block_q
+    kv_start = kj * block_kv
+
+    def steps(carry):
+        lower = (jnp.maximum(0, (kv_start - si * super_q) // block_q)
+                 if causal else 0)
+        kb = k_ref[:]
+        vb = v_ref[:]
+
+        def body(i2, carry):
+            dk_acc, dv_acc = carry
+            qb = q_ref[pl.ds(i2 * block_q, block_q), :]
+            dob = do_ref[pl.ds(i2 * block_q, block_q), :]
+            lse = lse_ref[:, pl.ds(i2 * block_q, block_q)].reshape(block_q, 1)
+            dD = dD_ref[:, pl.ds(i2 * block_q, block_q)].reshape(block_q, 1)
+            s = jax.lax.dot_general(
+                qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                row_ids = (si * super_q + i2 * block_q
+                           + jax.lax.broadcasted_iota(
+                               jnp.int32, (block_q, block_kv), 0))
+                col_ids = kv_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_kv), 1)
+                s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+            p = jnp.exp(s - lse)                                 # [bq, bkv]
+            dv_acc = dv_acc + jax.lax.dot_general(               # P^T @ dO
+                p.astype(dob.dtype), dob,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(                            # dO @ V^T
+                dob, vb, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dD) * sm_scale
+            dk_acc = dk_acc + jax.lax.dot_general(               # dS^T @ Q
+                ds.astype(qb.dtype), qb,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk_acc, dv_acc
+
+        return jax.lax.fori_loop(lower, nb, body, carry)
+
+    d = k_ref.shape[1]
+
+    def finish(carry):
+        dk_acc, dv_acc = carry
+        dk_ref[:] = dk_acc.astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc.astype(dv_ref.dtype)
+
+    live = (True if not causal
+            else (si * super_q + super_q - 1 >= kv_start))
+    _grid_accumulate(
+        num_super, si, live, steps, finish, (dk_sc, dv_sc),
+        zeros=lambda: (jnp.zeros((block_kv, d), jnp.float32),
+                       jnp.zeros((block_kv, d), jnp.float32)))
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
+                    block_kv: int, interpret: bool):
+    b, h, t, d = q.shape
+    block_q = _fit_block(block_q, t)
+    block_kv = _fit_block(block_kv, t)
+    sm_scale = 1.0 / math.sqrt(d)
+
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    gf = g.reshape(b * h, t, d)
+    # D = rowsum(dO * O): one fused elementwise+reduce pass in XLA
+    dD = jnp.sum(gf.astype(jnp.float32)
+                 * out.reshape(b * h, t, d).astype(jnp.float32),
+                 axis=-1).reshape(b * h, 1, t)
+
+    super_kv = _fit_block(_SUPER_KV, t)
+    super_q = _fit_block(_SUPER_KV, t)
+    block_kv_dq = _fit_block(block_kv, super_kv)
+    block_q_dkv = _fit_block(block_q, super_q)
+    vmem = {"memory_space": pltpu.VMEM}
+    # dq grid: (bh, q-block, kv-superblock)
+    q_outer = pl.BlockSpec((None, block_q, d), lambda i, a, b_: (i, a, 0), **vmem)
+    kvs_inner = pl.BlockSpec((None, super_kv, d), lambda i, a, b_: (i, b_, 0), **vmem)
+    row_outer = pl.BlockSpec((None, 1, block_q), lambda i, a, b_: (i, 0, a), **vmem)
+    # dkv grid: (bh, kv-block, q-superblock)
+    kv_outer = pl.BlockSpec((None, block_kv, d), lambda i, a, b_: (i, a, 0), **vmem)
+    qs_inner = pl.BlockSpec((None, super_q, d), lambda i, a, b_: (i, b_, 0), **vmem)
+    rows_inner = pl.BlockSpec((None, 1, super_q), lambda i, a, b_: (i, 0, b_), **vmem)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_kv=block_kv_dq, causal=causal,
+                          sm_scale=sm_scale, num_super=t // super_kv),
+        grid=(b * h, t // block_q, t // super_kv),
+        in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
+        out_specs=q_outer,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=_scratch(block_q, d)[:1],
+        interpret=interpret,
+        **_compiler_params(),
+    )(qf, gf, lse, dD, kf, vf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q_dkv,
+                          block_kv=block_kv, causal=causal,
+                          sm_scale=sm_scale, num_super=t // super_q),
+        grid=(b * h, t // block_kv, t // super_q),
+        in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
+        out_specs=(kv_outer, kv_outer),
+        out_shape=(jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, t, d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
+        interpret=interpret,
+        **_compiler_params(),
+    )(kf, vf, qf, gf, lse, dD)
+
+    rs = lambda x: x.reshape(b, h, t, d)
+    return rs(dq), rs(dk), rs(dv)
 
 
 def _on_tpu() -> bool:
@@ -177,23 +450,23 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if interpret is None:
         interpret = not _on_tpu()
-    return _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret):
     if interpret is None:
         interpret = not _on_tpu()
-    out = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_kv, interpret, residuals, g):
-    q, k, v = residuals
-    # rematerialized backward through the reference formulation; a fused
-    # Pallas dq/dk/dv kernel would cut HBM traffic further
-    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    if interpret is None:   # nondiff arg: static, resolved the same way
+        interpret = not _on_tpu()
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv,
+                           interpret)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -233,11 +506,15 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
         return max(dt, 1e-9) / (chain_long - chain_short)
 
     per_flash = measure(lambda q, k, v: flash_attention(q, k, v, True))
-    per_ref = measure(lambda q, k, v: attention_reference(q, k, v, True))
     flops = 4 * b * h * t * t * d / 2
-    return {
+    out = {
         "flash_attn_tflops": flops / per_flash / 1e12,
-        "ref_attn_tflops": flops / per_ref / 1e12,
-        "speedup_vs_ref": per_ref / per_flash,
         "shape": f"b{b} h{h} t{t} d{d} {jnp.dtype(dtype).name}",
     }
+    # the reference materializes the [t, t] score matrix; past ~4k it
+    # OOMs HBM (b*h*t*t*4 bytes) — which is the point of the kernel
+    if b * h * t * t * 4 < 4 << 30:
+        per_ref = measure(lambda q, k, v: attention_reference(q, k, v, True))
+        out["ref_attn_tflops"] = flops / per_ref / 1e12
+        out["speedup_vs_ref"] = per_ref / per_flash
+    return out
