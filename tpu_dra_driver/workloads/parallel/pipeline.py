@@ -1,0 +1,190 @@
+"""Pipeline parallelism (pp): GPipe-style microbatch pipeline over a
+mesh axis, expressed with shard_map + ppermute.
+
+Each device along ``pp`` owns a *stage* — a contiguous group of
+transformer blocks whose stacked weights are sharded on the leading
+(stage) axis. Activations flow stage-to-stage over ICI neighbor hops
+(``lax.ppermute``), with the classic GPipe schedule: M microbatches
+drain through S stages in M + S - 1 steps, the (S-1)-step bubble at
+each end. Bubble steps compute on zeros and are masked out of the
+output — XLA-friendly (static schedule, no data-dependent control
+flow), and the whole thing differentiates through scan + ppermute so
+the backward pipeline runs in reverse automatically.
+
+TPU-first notes: the schedule is a ``lax.scan`` (one compiled step,
+S-way SPMD), stage weights never move (only [mb, t, d] activations
+cross ICI), and the final collect is a single masked psum.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dra_driver.workloads.models.transformer import (
+    ModelConfig, _attention, _mlp, _rmsnorm,
+)
+
+# stage-stacked parameter keys -> how many leading stack dims they carry
+_BLOCK_KEYS = ("ln1_g", "wqkv", "wo", "ln2_g", "w_up", "w_down")
+
+
+def stack_layers(layers: List[Dict], n_stages: int) -> Dict[str, jax.Array]:
+    """[n_layers] list of block param dicts → dict of [S, L/S, ...] arrays
+    (the layout that shards over the pp axis on dim 0)."""
+    n = len(layers)
+    if n % n_stages:
+        raise ValueError(f"{n} layers not divisible into {n_stages} stages")
+    per = n // n_stages
+
+    def get(layer, key):
+        if key == "ln1_g":
+            return layer["ln1"]["g"]
+        if key == "ln2_g":
+            return layer["ln2"]["g"]
+        return layer[key]
+
+    out = {}
+    for key in _BLOCK_KEYS:
+        rows = [jnp.stack([get(layers[s * per + i], key)
+                           for i in range(per)])
+                for s in range(n_stages)]
+        out[key] = jnp.stack(rows)          # [S, L/S, ...]
+    return out
+
+
+def stage_shardings(mesh: Mesh, stacked: Dict, axis_name: str = "pp") -> Dict:
+    return {k: NamedSharding(mesh, P(axis_name)) for k in stacked}
+
+
+def _apply_stage(stage_p: Dict, x: jax.Array, n_heads: int,
+                 attn_fn=None) -> jax.Array:
+    """Run this stage's L blocks on [mb, t, d] activations."""
+    n_layers = stage_p["wqkv"].shape[0]
+    for i in range(n_layers):
+        layer = {
+            "wqkv": stage_p["wqkv"][i], "wo": stage_p["wo"][i],
+            "w_up": stage_p["w_up"][i], "w_down": stage_p["w_down"][i],
+        }
+        x = x + _attention(_rmsnorm(x, stage_p["ln1_g"][i]), layer,
+                           n_heads, attn_fn)
+        x = x + _mlp(_rmsnorm(x, stage_p["ln2_g"][i]), layer)
+    return x
+
+
+def pipeline_apply(stacked: Dict, x_mb: jax.Array, *, axis_name: str,
+                   n_heads: int, n_stages: int, n_micro: int,
+                   attn_fn=None) -> jax.Array:
+    """GPipe schedule; call inside shard_map over ``axis_name``.
+
+    stacked: this device's stage slice [1, L, ...]; x_mb: the full
+    [M, mb, t, d] microbatch stack (replicated — only stage 0 reads it).
+    Returns the [M, mb, t, d] outputs, identical on every device.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    stage_p = {k: v[0] for k, v in stacked.items()}
+    is_first = idx == 0
+    is_last = idx == n_stages - 1
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    act0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+
+    def step(carry, s):
+        act, out = carry
+        mb_idx = s - idx                      # microbatch this stage holds
+        valid = (mb_idx >= 0) & (mb_idx < n_micro)
+        inject = x_mb[jnp.clip(s, 0, n_micro - 1)]
+        xin = jnp.where(is_first, inject, act)
+        y = _apply_stage(stage_p, xin, n_heads, attn_fn)
+        slot = jnp.clip(mb_idx, 0, n_micro - 1)
+        out = out.at[slot].set(
+            jnp.where(valid & is_last, y.astype(out.dtype), out[slot]))
+        if n_stages > 1:
+            act = jax.lax.ppermute(y, axis_name, perm)
+        else:
+            act = y
+        return (act, out), None
+
+    steps = jnp.arange(n_micro + n_stages - 1)
+    (_, out), _ = jax.lax.scan(step, (act0, out0), steps)
+    # only the last stage's buffer is real; masked psum replicates it
+    out = jnp.where(is_last, out, jnp.zeros_like(out))
+    return jax.lax.psum(out, axis_name)
+
+
+def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
+                    n_micro: int, axis_name: str = "pp", attn_fn=None):
+    """Build ``forward(pp_params, tokens) -> logits`` where the block
+    stack runs as a pipeline over ``axis_name``. ``pp_params`` =
+    {"embed", "pos_embed", "final_norm_g", "stages": stack_layers(...)}
+    (embed/unembed replicated; only stages shard)."""
+    spec_stage = {k: P(axis_name) for k in _BLOCK_KEYS}
+
+    pipe = jax.shard_map(
+        functools.partial(pipeline_apply, axis_name=axis_name,
+                          n_heads=cfg.n_heads, n_stages=n_stages,
+                          n_micro=n_micro, attn_fn=attn_fn),
+        mesh=mesh, in_specs=(spec_stage, P()), out_specs=P())
+
+    def forward(pp_params: Dict, tokens: jax.Array) -> jax.Array:
+        b, t = tokens.shape
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
+        x = pp_params["embed"][tokens] + pp_params["pos_embed"][:t]
+        x_mb = x.reshape(n_micro, b // n_micro, t, cfg.d_model)
+        y_mb = pipe(pp_params["stages"], x_mb)
+        x = y_mb.reshape(b, t, cfg.d_model)
+        x = _rmsnorm(x, pp_params["final_norm_g"])
+        return (x @ pp_params["embed"].T).astype(jnp.float32)
+
+    return forward
+
+
+def params_to_pp(params: Dict, n_stages: int) -> Dict:
+    """Convert transformer.init_params output to the pipeline layout."""
+    return {
+        "embed": params["embed"],
+        "pos_embed": params["pos_embed"],
+        "final_norm_g": params["final_norm"]["g"],
+        "stages": stack_layers(params["layers"], n_stages),
+    }
+
+
+def pp_param_shardings(mesh: Mesh, pp_params: Dict,
+                       axis_name: str = "pp") -> Dict:
+    repl = NamedSharding(mesh, P())
+    return {
+        "embed": repl, "pos_embed": repl, "final_norm_g": repl,
+        "stages": stage_shardings(mesh, pp_params["stages"], axis_name),
+    }
+
+
+def make_pp_train_step(mesh: Mesh, cfg: ModelConfig, n_stages: int,
+                       n_micro: int, axis_name: str = "pp",
+                       optimizer=None, attn_fn=None):
+    """(pp_params, opt_state, (tokens, targets)) -> (params', opt', loss)."""
+    import optax
+
+    opt = optimizer or optax.adamw(1e-3)
+    forward = make_pp_forward(mesh, cfg, n_stages, n_micro, axis_name,
+                              attn_fn)
+
+    def loss_fn(pp_params, batch):
+        tokens, targets = batch
+        logits = forward(pp_params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    def train_step(pp_params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(pp_params, batch)
+        updates, opt_state = opt.update(grads, opt_state, pp_params)
+        pp_params = optax.apply_updates(pp_params, updates)
+        return pp_params, opt_state, loss
+
+    return train_step, opt.init
