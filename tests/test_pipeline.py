@@ -9,7 +9,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dra_driver.workloads.models import (
-    ModelConfig, forward, init_params, loss_fn, make_train_step,
+    ModelConfig, forward, init_params, make_train_step,
 )
 from tpu_dra_driver.workloads.parallel.pipeline import (
     make_pp_forward, make_pp_train_step, params_to_pp, pp_param_shardings,

@@ -3,5 +3,6 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     init_params,
     forward,
     loss_fn,
+    nll_from_logits,
     make_train_step,
 )

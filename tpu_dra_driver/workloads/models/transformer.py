@@ -135,13 +135,18 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
-def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
-            cfg: ModelConfig, attn_fn=None) -> jax.Array:
-    tokens, targets = batch
-    logits = forward(params, tokens, cfg, attn_fn)
+def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean token-level negative log-likelihood; shared by every trainer
+    (plain, sharded, pipeline) so loss changes land everywhere at once."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
+
+
+def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
+            cfg: ModelConfig, attn_fn=None) -> jax.Array:
+    tokens, targets = batch
+    return nll_from_logits(forward(params, tokens, cfg, attn_fn), targets)
 
 
 def make_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None):

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dra_driver.workloads.models.transformer import (
-    ModelConfig, _attention, _mlp, _rmsnorm,
+    ModelConfig, _attention, _mlp, _rmsnorm, nll_from_logits,
 )
 
 # stage-stacked parameter keys -> how many leading stack dims they carry
@@ -40,6 +40,10 @@ def stack_layers(layers: List[Dict], n_stages: int) -> Dict[str, jax.Array]:
     if n % n_stages:
         raise ValueError(f"{n} layers not divisible into {n_stages} stages")
     per = n // n_stages
+
+    if any("moe_up" in layer for layer in layers):
+        raise ValueError("pipeline parallelism does not support MoE layers; "
+                         "use the ep mesh axis (spmd.py) for expert parallelism")
 
     def get(layer, key):
         if key == "ln1_g":
@@ -91,8 +95,15 @@ def pipeline_apply(stacked: Dict, x_mb: jax.Array, *, axis_name: str,
     is_last = idx == n_stages - 1
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-    act0 = jnp.zeros_like(x_mb[0])
-    out0 = jnp.zeros_like(x_mb)
+    # The carry becomes pp-varying after the stage compute (stage weights
+    # are sharded over pp), so the initial carry must be marked varying
+    # too or scan rejects the carry-type mismatch.
+    if hasattr(jax.lax, "pcast"):
+        pvary = lambda x, n: jax.lax.pcast(x, n, to="varying")
+    else:
+        pvary = getattr(jax.lax, "pvary", lambda x, _: x)
+    act0 = pvary(jnp.zeros_like(x_mb[0]), axis_name)
+    out0 = pvary(jnp.zeros_like(x_mb), axis_name)
 
     def step(carry, s):
         act, out = carry
@@ -123,6 +134,10 @@ def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
     stack runs as a pipeline over ``axis_name``. ``pp_params`` =
     {"embed", "pos_embed", "final_norm_g", "stages": stack_layers(...)}
     (embed/unembed replicated; only stages shard)."""
+    if mesh.shape[axis_name] != n_stages:
+        raise ValueError(
+            f"mesh axis {axis_name!r} has size {mesh.shape[axis_name]} "
+            f"but n_stages={n_stages}")
     spec_stage = {k: P(axis_name) for k in _BLOCK_KEYS}
 
     pipe = jax.shard_map(
@@ -135,6 +150,10 @@ def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
         b, t = tokens.shape
         if b % n_micro:
             raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
+        got = pp_params["stages"]["wqkv"].shape[0]
+        if got != n_stages:
+            raise ValueError(
+                f"pp_params stacked for {got} stages but n_stages={n_stages}")
         x = pp_params["embed"][tokens] + pp_params["pos_embed"][:t]
         x_mb = x.reshape(n_micro, b // n_micro, t, cfg.d_model)
         y_mb = pipe(pp_params["stages"], x_mb)
@@ -176,10 +195,7 @@ def make_pp_train_step(mesh: Mesh, cfg: ModelConfig, n_stages: int,
 
     def loss_fn(pp_params, batch):
         tokens, targets = batch
-        logits = forward(pp_params, tokens)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        return nll_from_logits(forward(pp_params, tokens), targets)
 
     def train_step(pp_params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(pp_params, batch)
