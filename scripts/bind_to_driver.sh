@@ -1,0 +1,50 @@
+#!/bin/bash
+# Bind a TPU PCI function to a specific kernel driver via driver_override.
+#
+# Usage: bind_to_driver.sh <ssss:bb:dd.f> <driver>
+#   e.g. bind_to_driver.sh 0000:00:05.0 vfio-pci      (passthrough)
+#        bind_to_driver.sh 0000:00:05.0 google-accel  (back to the runtime)
+#
+# Reference analog: scripts/bind_to_driver.sh (nvidia<->vfio-pci flip). The
+# in-process path used by the plugin is VfioPciManager
+# (tpu_dra_driver/plugin/vfio.py); this standalone helper exists for manual
+# operator recovery and for the demo specs.
+set -euo pipefail
+
+pci="${1:?usage: bind_to_driver.sh <ssss:bb:dd.f> <driver>}"
+driver="${2:?usage: bind_to_driver.sh <ssss:bb:dd.f> <driver>}"
+
+dev="/sys/bus/pci/devices/$pci"
+override="$dev/driver_override"
+bind="/sys/bus/pci/drivers/$driver/bind"
+
+[ -e "$dev" ] || { echo "no PCI device $pci" >&2; exit 1; }
+
+vendor="$(cat "$dev/vendor")"
+if [ "$vendor" != "0x1ae0" ]; then
+    echo "refusing: $pci vendor $vendor is not Google (0x1ae0)" >&2
+    exit 1
+fi
+
+# Guard: never flip a device that still has an open /dev/accel* or vfio fd.
+if command -v fuser >/dev/null 2>&1; then
+    for node in /dev/accel* /dev/vfio/*; do
+        [ -e "$node" ] || continue
+        if fuser -s "$node" 2>/dev/null; then
+            echo "refusing: $node is busy" >&2
+            exit 1
+        fi
+    done
+fi
+
+[ -e "$override" ] || { echo "$override missing" >&2; exit 1; }
+echo "$driver" > "$override"
+
+if [ ! -e "$bind" ]; then
+    # vfio-pci may need loading first (the plugin does modprobe via chroot).
+    modprobe "$driver" 2>/dev/null || true
+fi
+[ -e "$bind" ] || { echo "driver $driver not present ($bind missing)" >&2; exit 1; }
+
+echo "$pci" > "$bind" || { echo "" > "$override"; exit 1; }
+echo "bound $pci -> $driver"

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Unbind a TPU PCI function from its current kernel driver and clear the
+# driver_override so the default driver can claim it on rescan.
+#
+# Usage: unbind_from_driver.sh <ssss:bb:dd.f>
+#
+# Reference analog: scripts/unbind_from_driver.sh. In-process path:
+# VfioPciManager.unconfigure (tpu_dra_driver/plugin/vfio.py).
+set -euo pipefail
+
+pci="${1:?usage: unbind_from_driver.sh <ssss:bb:dd.f>}"
+dev="/sys/bus/pci/devices/$pci"
+
+[ -e "$dev" ] || { echo "no PCI device $pci" >&2; exit 1; }
+
+if [ -e "$dev/driver" ]; then
+    current="$(basename "$(readlink "$dev/driver")")"
+    echo "$pci" > "$dev/driver/unbind"
+    echo "unbound $pci from $current"
+else
+    echo "$pci has no bound driver"
+fi
+
+if [ -e "$dev/driver_override" ]; then
+    echo "" > "$dev/driver_override"
+fi

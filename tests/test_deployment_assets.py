@@ -51,6 +51,30 @@ def test_quickstart_specs_parse():
     assert {"Pod", "ResourceClaimTemplate", "ComputeDomain", "Job"} <= kinds
 
 
+def test_all_demo_spec_dirs_parse():
+    """Every spec dir mirroring the reference's demo/specs/* (quickstart,
+    extended-resources, ici, subslice+multiprocess, selectors) parses."""
+    dirs = {os.path.basename(os.path.dirname(p))
+            for p in glob.glob(os.path.join(REPO, "demo/specs/*/"))}
+    assert {"quickstart", "extended-resources", "ici",
+            "subslice+multiprocess", "selectors"} <= dirs
+    for p in glob.glob(os.path.join(REPO, "demo/specs/*/*.yaml")):
+        for doc in _load_all(p):
+            assert "kind" in doc, p
+
+
+def test_extended_resource_specs_use_limits_syntax():
+    checked = 0
+    for p in glob.glob(os.path.join(REPO, "demo/specs/extended-resources/*.yaml")):
+        for doc in _load_all(p):
+            if doc["kind"] != "Pod":
+                continue
+            limits = doc["spec"]["containers"][0]["resources"]["limits"]
+            assert any(k.startswith("google.com/tpu") for k in limits), p
+            checked += 1
+    assert checked >= 2
+
+
 def test_quickstart_device_classes_exist_in_chart():
     chart_dc = open(os.path.join(
         REPO, "deployments/helm/tpu-dra-driver/templates/deviceclasses.yaml")).read()
@@ -246,7 +270,7 @@ def test_quickstart_opaque_configs_strict_decode():
     must never ship as demos."""
     from tpu_dra_driver.api import STRICT_DECODER
     n = 0
-    for p in glob.glob(os.path.join(REPO, "demo/specs/quickstart/*.yaml")):
+    for p in glob.glob(os.path.join(REPO, "demo/specs/*/*.yaml")):
         for doc in _load_all(p):
             spec = doc.get("spec") or {}
             inner = spec.get("spec") or spec  # RCT nests spec.spec
@@ -255,7 +279,7 @@ def test_quickstart_opaque_configs_strict_decode():
                 obj.normalize()
                 obj.validate()
                 n += 1
-    assert n >= 3  # timeslicing, multiprocess, vfio at minimum
+    assert n >= 4  # timeslicing, multiprocess, vfio, subslice-sharing
 
 
 def test_cluster_scripts_are_valid_shell():

@@ -136,3 +136,23 @@ def matmul_tflops_steady(m: int = 8192, dtype=jnp.bfloat16,
     flops = 2 * m * m * m * (64 - 16)
     tflops = flops / dt / 1e12 if dt > 0 else long.tflops
     return MatmulResult(m, dt / (64 - 16), tflops)
+
+
+def main() -> None:
+    """Entry point for the in-cluster collective bench job
+    (demo/specs/ici/collective-bench-job.yaml — the nvbandwidth-job
+    analog). Initializes jax.distributed from the driver-injected worker
+    env when running multi-host, then prints RESULT lines."""
+    import os
+
+    if (os.environ.get("TPU_WORKER_HOSTNAMES")
+            and "JAX_COORDINATOR_ADDRESS" not in os.environ):
+        import jax
+
+        jax.distributed.initialize()
+    print(psum_bandwidth(), flush=True)
+    print(all_gather_bandwidth(), flush=True)
+
+
+if __name__ == "__main__":
+    main()
