@@ -44,7 +44,9 @@ if [ ! -e "$bind" ]; then
     # vfio-pci may need loading first (the plugin does modprobe via chroot).
     modprobe "$driver" 2>/dev/null || true
 fi
-[ -e "$bind" ] || { echo "driver $driver not present ($bind missing)" >&2; exit 1; }
+# Roll back the override before bailing, or the device can no longer bind
+# to any driver on rescan (same rollback as the bind-failure path below).
+[ -e "$bind" ] || { echo "driver $driver not present ($bind missing)" >&2; echo "" > "$override"; exit 1; }
 
 echo "$pci" > "$bind" || { echo "" > "$override"; exit 1; }
 echo "bound $pci -> $driver"

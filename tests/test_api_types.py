@@ -149,29 +149,46 @@ def test_compute_domain_round_trip():
         "metadata": {"name": "cd1", "namespace": "user-ns", "uid": "u-1"},
         "spec": {
             "numNodes": 2,
-            "channel": {"resourceClaimTemplate": {"name": "my-rct"}},
-            "allocationMode": "All",
+            "channel": {"resourceClaimTemplate": {"name": "my-rct"},
+                        "allocationMode": "All"},
         },
     })
     cd.validate()
     assert cd.spec.num_nodes == 2
     assert cd.spec.channel.resource_claim_template_name == "my-rct"
+    assert cd.spec.channel.allocation_mode == "All"
     again = ComputeDomain.from_obj(cd.to_obj())
     assert again.spec == cd.spec
     assert again.metadata.uid == "u-1"
 
 
 def test_compute_domain_validation():
-    cd = ComputeDomain.from_obj({"metadata": {"name": "x"}, "spec": {"numNodes": 0}})
+    cd = ComputeDomain.from_obj({"metadata": {"name": "x"}, "spec": {"numNodes": -1}})
     with pytest.raises(ValueError, match="numNodes"):
         cd.validate()
+    # numNodes 0 is legal (reference computedomain.go:63-88)
     cd = ComputeDomain.from_obj({
         "metadata": {"name": "x"},
-        "spec": {"numNodes": 1, "channel": {"resourceClaimTemplate": {"name": "t"}},
-                 "allocationMode": "Some"},
+        "spec": {"numNodes": 0,
+                 "channel": {"resourceClaimTemplate": {"name": "t"}}},
+    })
+    cd.validate()
+    cd = ComputeDomain.from_obj({
+        "metadata": {"name": "x"},
+        "spec": {"numNodes": 1,
+                 "channel": {"resourceClaimTemplate": {"name": "t"},
+                             "allocationMode": "Some"}},
     })
     with pytest.raises(ValueError, match="allocationMode"):
         cd.validate()
+    # legacy spec-level location still decodes (pre-fix specs)
+    cd = ComputeDomain.from_obj({
+        "metadata": {"name": "x"},
+        "spec": {"numNodes": 1, "channel": {"resourceClaimTemplate": {"name": "t"}},
+                 "allocationMode": "All"},
+    })
+    cd.validate()
+    assert cd.spec.channel.allocation_mode == "All"
 
 
 def test_clique_naming_and_daemon_lookup():

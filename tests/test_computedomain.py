@@ -397,3 +397,29 @@ def test_daemonset_has_no_cross_namespace_owner_ref(harness):
         what="daemonset")
     ds = harness.clients.daemonsets.list(namespace=DRIVER_NAMESPACE)[0]
     assert "ownerReferences" not in ds["metadata"]
+
+
+def test_channel_allocation_mode_all_injects_every_channel(harness):
+    """allocationMode=All in the opaque channel config: the claim holds one
+    DRA channel device but Prepare injects ALL channel device nodes
+    (reference device_state.go:472-476,508-511)."""
+    from tpu_dra_driver.computedomain.plugin.devices import NUM_CHANNELS
+    harness.create_compute_domain("cd1", "user-ns", 1, "wl-rct")
+    uid = harness.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+    claim = _channel_claim("wall", "host-0", uid)
+    claim["status"]["allocation"]["devices"]["config"][0]["opaque"][
+        "parameters"]["allocationMode"] = "All"
+    res = harness.host(0).cd_plugin.prepare_resource_claims([claim])["wall"]
+    assert res.error is None
+    spec = harness.host(0).cd_plugin.state._cdi.read_claim_spec("wall")
+    nodes = [dn["path"] for dev in spec["devices"]
+             for dn in dev["containerEdits"].get("deviceNodes", [])]
+    assert len(nodes) == NUM_CHANNELS
+    # Single mode (default) injects exactly one
+    claim1 = _channel_claim("wsingle", "host-0", uid, channel="channel-1")
+    res1 = harness.host(0).cd_plugin.prepare_resource_claims([claim1])["wsingle"]
+    assert res1.error is None
+    spec1 = harness.host(0).cd_plugin.state._cdi.read_claim_spec("wsingle")
+    nodes1 = [dn["path"] for dev in spec1["devices"]
+              for dn in dev["containerEdits"].get("deviceNodes", [])]
+    assert len(nodes1) == 1

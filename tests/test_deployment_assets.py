@@ -31,8 +31,10 @@ def test_crds_parse_and_match_types():
     ver = cd["spec"]["versions"][0]
     assert ver["name"] == "v1beta1"
     spec_props = ver["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
-    assert spec_props["numNodes"]["minimum"] == 1
-    assert spec_props["allocationMode"]["enum"] == ["All", "Single"]
+    assert spec_props["numNodes"]["minimum"] == 0
+    chan_props = spec_props["channel"]["properties"]
+    assert chan_props["allocationMode"]["enum"] == ["All", "Single"]
+    assert chan_props["allocationMode"]["default"] == "Single"
     # clique daemons are a list-map keyed by nodeName (merge semantics the
     # daemons rely on)
     cq = by_kind["ComputeDomainClique"]

@@ -84,3 +84,70 @@ def test_grpc_prepare_error_propagates(served_plugin):
     clients.resource_claims.create(claim)
     resp = client.node_prepare_resources([claim])
     assert "allocatable inventory" in resp.claims["uid-2"].error
+
+
+# -- self-probing healthcheck service (reference health.go:51-149) --------
+
+def _check_health(port: int, service: str = ""):
+    from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    try:
+        return channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb.HealthCheckResponse.FromString,
+        )(health_pb.HealthCheckRequest(service=service), timeout=10)
+    finally:
+        channel.close()
+
+
+def test_selfprobe_healthcheck_serving(served_plugin):
+    from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
+    from tpu_dra_driver.grpc_api.healthcheck import SelfProbeHealthcheck
+    _, _, server, _ = served_plugin
+    hc = SelfProbeHealthcheck(
+        registration_target=f"localhost:{server.registration_port}",
+        dra_target=f"localhost:{server.dra_port}",
+        port=0, host="localhost")
+    hc.start()
+    try:
+        resp = _check_health(hc.port)
+        assert resp.status == health_pb.HealthCheckResponse.SERVING
+        # the "liveness" service name is also known (reference health.go:122)
+        resp = _check_health(hc.port, service="liveness")
+        assert resp.status == health_pb.HealthCheckResponse.SERVING
+    finally:
+        hc.stop()
+
+
+def test_selfprobe_healthcheck_unknown_service(served_plugin):
+    from tpu_dra_driver.grpc_api.healthcheck import SelfProbeHealthcheck
+    _, _, server, _ = served_plugin
+    hc = SelfProbeHealthcheck(
+        registration_target=f"localhost:{server.registration_port}",
+        dra_target=f"localhost:{server.dra_port}",
+        port=0, host="localhost")
+    hc.start()
+    try:
+        with pytest.raises(grpc.RpcError) as exc:
+            _check_health(hc.port, service="bogus")
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        hc.stop()
+
+
+def test_selfprobe_healthcheck_not_serving_when_sockets_dead(served_plugin):
+    """The probe is end-to-end: a dead DRA socket must flip the answer to
+    NOT_SERVING even though the healthcheck server itself is alive."""
+    from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
+    from tpu_dra_driver.grpc_api.healthcheck import SelfProbeHealthcheck
+    hc = SelfProbeHealthcheck(
+        registration_target="localhost:1",  # nothing listens there
+        dra_target="localhost:1",
+        port=0, host="localhost")
+    hc.start()
+    try:
+        resp = _check_health(hc.port)
+        assert resp.status == health_pb.HealthCheckResponse.NOT_SERVING
+    finally:
+        hc.stop()

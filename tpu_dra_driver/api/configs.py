@@ -197,14 +197,30 @@ class VfioTpuConfig(_ConfigBase):
 
 @dataclass
 class ComputeDomainChannelConfig(_ConfigBase):
-    """Ties a workload claim's channel device to a ComputeDomain."""
+    """Ties a workload claim's channel device to a ComputeDomain.
+
+    ``allocation_mode`` mirrors reference computedomainconfig.go:31 and
+    device_state.go:474-485: the claim always allocates exactly one DRA
+    channel device, but ``All`` makes Prepare inject *every* channel
+    device node into the container."""
 
     KIND: ClassVar[str] = "ComputeDomainChannelConfig"
     domain_id: str = ""
+    allocation_mode: str = ""
+
+    ALLOCATION_MODES: ClassVar[tuple] = ("Single", "All")
+
+    def normalize(self) -> None:
+        if not self.allocation_mode:
+            self.allocation_mode = "Single"
 
     def validate(self) -> None:
         if not isinstance(self.domain_id, str) or not self.domain_id:
             raise ValidationError("domainID must be a non-empty string")
+        if self.allocation_mode not in self.ALLOCATION_MODES:
+            raise ValidationError(
+                f"allocationMode {self.allocation_mode!r} must be one of "
+                f"{self.ALLOCATION_MODES}")
 
 
 @dataclass

@@ -112,14 +112,18 @@ class ObjectMeta:
 
 @dataclass
 class ComputeDomainChannelSpec:
+    """Reference ComputeDomainChannelSpec (computedomain.go:93-101):
+    allocationMode lives under spec.channel, enum All|Single, default
+    Single — "All" requests every ICI channel, "Single" exactly one."""
+
     resource_claim_template_name: str = ""
+    allocation_mode: str = ALLOCATION_MODE_SINGLE
 
 
 @dataclass
 class ComputeDomainSpec:
     num_nodes: int = 0
     channel: ComputeDomainChannelSpec = field(default_factory=ComputeDomainChannelSpec)
-    allocation_mode: str = ALLOCATION_MODE_ALL
 
 
 @dataclass
@@ -147,14 +151,18 @@ class ComputeDomain:
     PLURAL = "computedomains"
 
     def validate(self) -> None:
-        if self.spec.num_nodes < 1:
-            raise ValueError("spec.numNodes must be >= 1")
+        # numNodes may be zero (reference computedomain.go:63-88: with the
+        # DNSNames gate the workload tracks its own worker count and
+        # numNodes only drives the global Ready status).
+        if self.spec.num_nodes < 0:
+            raise ValueError("spec.numNodes must be >= 0")
         if not self.spec.channel.resource_claim_template_name:
             raise ValueError("spec.channel.resourceClaimTemplate.name must be set")
-        if self.spec.allocation_mode not in (ALLOCATION_MODE_ALL, ALLOCATION_MODE_SINGLE):
+        if self.spec.channel.allocation_mode not in (
+                ALLOCATION_MODE_ALL, ALLOCATION_MODE_SINGLE):
             raise ValueError(
-                f"spec.allocationMode must be {ALLOCATION_MODE_ALL!r} or "
-                f"{ALLOCATION_MODE_SINGLE!r}"
+                f"spec.channel.allocationMode must be {ALLOCATION_MODE_ALL!r} "
+                f"or {ALLOCATION_MODE_SINGLE!r}"
             )
 
     def to_obj(self) -> Dict:
@@ -167,9 +175,9 @@ class ComputeDomain:
                 "channel": {
                     "resourceClaimTemplate": {
                         "name": self.spec.channel.resource_claim_template_name,
-                    }
+                    },
+                    "allocationMode": self.spec.channel.allocation_mode,
                 },
-                "allocationMode": self.spec.allocation_mode,
             },
             "status": {
                 "status": self.status.status,
@@ -198,9 +206,14 @@ class ComputeDomain:
                     resource_claim_template_name=(
                         ((spec.get("channel") or {}).get("resourceClaimTemplate") or {})
                         .get("name", "")
-                    )
+                    ),
+                    allocation_mode=(
+                        (spec.get("channel") or {}).get(
+                            "allocationMode",
+                            # legacy location (pre-fix specs) at spec level
+                            spec.get("allocationMode", ALLOCATION_MODE_SINGLE))
+                    ),
                 ),
-                allocation_mode=spec.get("allocationMode", ALLOCATION_MODE_ALL),
             ),
             status=ComputeDomainStatus(
                 status=status.get("status", STATUS_NOT_READY),

@@ -61,19 +61,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         prepare_budget=args.prepare_budget))
     plugin.start()
 
+    dra_sock = f"unix://{args.state_dir}/dra.sock"
+    reg_sock = (f"unix://{args.plugin_registry}/"
+                f"{COMPUTE_DOMAIN_DRIVER_NAME}-reg.sock")
     server = DraGrpcServer(
         plugin, clients.resource_claims, COMPUTE_DOMAIN_DRIVER_NAME,
-        dra_address=f"unix://{args.state_dir}/dra.sock",
-        registration_address=(
-            f"unix://{args.plugin_registry}/"
-            f"{COMPUTE_DOMAIN_DRIVER_NAME}-reg.sock"),
-        health_port=args.health_port)
+        dra_address=dra_sock, registration_address=reg_sock)
     server.start()
+
+    # Self-probing healthcheck on TCP for gRPC startup/liveness probes
+    # (reference health.go, shared by both kubelet plugins).
+    healthcheck = None
+    if args.health_port >= 0:
+        from tpu_dra_driver.grpc_api.healthcheck import SelfProbeHealthcheck
+        healthcheck = SelfProbeHealthcheck(
+            registration_target=reg_sock, dra_target=dra_sock,
+            port=args.health_port)
+        healthcheck.start()
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if healthcheck is not None:
+        healthcheck.stop()
     server.stop()
     return 0
 

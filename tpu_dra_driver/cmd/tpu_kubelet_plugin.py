@@ -64,6 +64,8 @@ def make_lib(args):
 
 
 def make_clients(args) -> ClientSets:
+    if getattr(args, "kube_backend", "rest") == "fake":
+        return ClientSets()  # in-memory FakeCluster (hardware-free mode)
     from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
     cfg = (RestClusterConfig.from_kubeconfig(args.kubeconfig)
            if args.kubeconfig else RestClusterConfig.auto())
@@ -91,9 +93,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     reg_sock = f"unix://{args.plugin_registry}/{DRIVER_NAME}-reg.sock"
     server = DraGrpcServer(plugin, clients.resource_claims, DRIVER_NAME,
                            dra_address=dra_sock,
-                           registration_address=reg_sock,
-                           health_port=args.health_port)
+                           registration_address=reg_sock)
     server.start()
+
+    # Dedicated healthcheck service for the container's gRPC startup/
+    # liveness probes: self-probes both unix sockets end-to-end per Check
+    # (reference health.go:51-149). --health-port < 0 disables.
+    healthcheck = None
+    if args.health_port >= 0:
+        from tpu_dra_driver.grpc_api.healthcheck import SelfProbeHealthcheck
+        healthcheck = SelfProbeHealthcheck(
+            registration_target=reg_sock, dra_target=dra_sock,
+            port=args.health_port)
+        healthcheck.start()
 
     debug_server = None
     from tpu_dra_driver.pkg.flags import parse_http_endpoint
@@ -109,6 +121,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     stop.wait()
     if debug_server is not None:
         debug_server.stop()
+    if healthcheck is not None:
+        healthcheck.stop()
     server.stop()
     plugin.shutdown()
     return 0

@@ -146,6 +146,10 @@ def build_workload_rct(cd: ComputeDomain) -> Dict:
     vars_.update({
         "RCT_NAME": cd.spec.channel.resource_claim_template_name,
         "CHANNEL_DEVICE_CLASS": DEFAULT_CHANNEL_DEVICE_CLASS,
+        # flows into the opaque ComputeDomainChannelConfig; the claim still
+        # allocates exactly one channel device, "All" widens the CDI
+        # injection (reference resourceclaimtemplate.go:378)
+        "ALLOCATION_MODE": cd.spec.channel.allocation_mode or "Single",
     })
     return render_template("compute-domain-workload-claim-template.tmpl.yaml",
                            vars_)

@@ -44,6 +44,7 @@ from tpu_dra_driver.computedomain import COMPUTE_DOMAIN_LABEL_KEY, DRIVER_NAMESP
 from tpu_dra_driver.computedomain.daemon.dnsnames import worker_name
 from tpu_dra_driver.computedomain.plugin.devices import (
     DAEMON_DEVICE_NAME,
+    NUM_CHANNELS,
     channel_devfs_path,
     parse_channel_name,
 )
@@ -227,9 +228,17 @@ class CdDeviceState:
         env["TPU_ACCELERATOR_TYPE"] = topo.accelerator_type
         env["TPU_TOPOLOGY"] = topo.topology_string
 
+        # allocationMode=All: the claim still holds exactly one DRA channel
+        # device, but every channel device node is injected (reference
+        # device_state.go:472-476,508-511).
+        if cfg.allocation_mode == "All":
+            device_nodes = [{"path": channel_devfs_path(i)}
+                            for i in range(NUM_CHANNELS)]
+        else:
+            device_nodes = [{"path": channel_devfs_path(chan_id)}]
         edits = ContainerEdits(
             env=env,
-            device_nodes=[{"path": channel_devfs_path(chan_id)}],
+            device_nodes=device_nodes,
             mounts=[{
                 # the daemon scopes its files per CD UID under the
                 # node-shared hostPath run dir (cmd/compute_domain_daemon

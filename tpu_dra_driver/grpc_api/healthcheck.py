@@ -1,0 +1,125 @@
+"""Self-probing kubelet-plugin healthcheck service.
+
+Reference analog: ``cmd/gpu-kubelet-plugin/health.go:51-149`` (same file in
+the compute-domain plugin). The container's startup/liveness probes are gRPC
+probes against a TCP port; the service behind that port does NOT report its
+own in-process state — on every ``Check`` it dials the plugin's two unix
+sockets and performs an end-to-end self-probe:
+
+1. ``GetInfo`` on the registration socket (proves the kubelet plugin
+   watcher can still discover us), and
+2. a **noop** ``NodePrepareResources`` on ``dra.sock`` (proves the DRA
+   service is actually serving, not just bound).
+
+Only if both round-trips succeed does it answer ``SERVING``. Known service
+names are ``""`` and ``"liveness"`` (reference health.go:122); anything else
+is a NOT_FOUND error, which lets probe configs detect typos instead of
+silently probing a default service.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from tpu_dra_driver.grpc_api import dra_v1beta1_pb2 as dra_pb
+from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
+from tpu_dra_driver.grpc_api import pluginregistration_v1_pb2 as reg_pb
+
+log = logging.getLogger(__name__)
+
+HEALTH_SERVICE = "grpc.health.v1.Health"
+KNOWN_SERVICES = ("", "liveness")
+_PROBE_TIMEOUT_S = 4.0
+
+
+class SelfProbeHealthcheck:
+    """gRPC health service on TCP that probes the plugin's own sockets.
+
+    ``registration_target`` / ``dra_target`` are grpc dial targets
+    (``unix:///path/to/sock`` in production, ``localhost:<port>`` in
+    tests). ``port=0`` binds an ephemeral port (tests); the bound port is
+    exposed as ``.port``.
+    """
+
+    def __init__(self, registration_target: str, dra_target: str,
+                 port: int = 0, host: str = "0.0.0.0"):
+        self._reg_target = registration_target
+        self._dra_target = dra_target
+        self._lock = threading.Lock()
+        self._reg_channel: Optional[grpc.Channel] = None
+        self._dra_channel: Optional[grpc.Channel] = None
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    # -- channel management (lazy, reused across probes like the
+    #    reference's long-lived grpc.NewClient connections) -------------
+    def _channels(self):
+        with self._lock:
+            if self._reg_channel is None:
+                self._reg_channel = grpc.insecure_channel(self._reg_target)
+            if self._dra_channel is None:
+                self._dra_channel = grpc.insecure_channel(self._dra_target)
+            return self._reg_channel, self._dra_channel
+
+    def _probe(self) -> bool:
+        """One end-to-end self-probe; True iff both sockets answered."""
+        reg, dra = self._channels()
+        try:
+            info = reg.unary_unary(
+                "/pluginregistration.Registration/GetInfo",
+                request_serializer=reg_pb.InfoRequest.SerializeToString,
+                response_deserializer=reg_pb.PluginInfo.FromString,
+            )(reg_pb.InfoRequest(), timeout=_PROBE_TIMEOUT_S)
+            log.debug("healthcheck: GetInfo ok: %s", info.name)
+        except grpc.RpcError as exc:
+            log.error("healthcheck: GetInfo failed: %s", exc)
+            return False
+        try:
+            dra.unary_unary(
+                "/v1beta1.DRAPlugin/NodePrepareResources",
+                request_serializer=(
+                    dra_pb.NodePrepareResourcesRequest.SerializeToString),
+                response_deserializer=(
+                    dra_pb.NodePrepareResourcesResponse.FromString),
+            )(dra_pb.NodePrepareResourcesRequest(), timeout=_PROBE_TIMEOUT_S)
+            log.debug("healthcheck: noop NodePrepareResources ok")
+        except grpc.RpcError as exc:
+            log.error("healthcheck: noop NodePrepareResources failed: %s", exc)
+            return False
+        return True
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        def check(request: health_pb.HealthCheckRequest, context):
+            if request.service not in KNOWN_SERVICES:
+                context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+            ok = self._probe()
+            return health_pb.HealthCheckResponse(
+                status=(health_pb.HealthCheckResponse.SERVING if ok
+                        else health_pb.HealthCheckResponse.NOT_SERVING))
+
+        return grpc.method_handlers_generic_handler(HEALTH_SERVICE, {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                check,
+                request_deserializer=health_pb.HealthCheckRequest.FromString,
+                response_serializer=(
+                    health_pb.HealthCheckResponse.SerializeToString),
+            ),
+        })
+
+    def start(self) -> None:
+        self._server.start()
+        log.info("healthcheck service listening on port %d", self.port)
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+        with self._lock:
+            for ch in (self._reg_channel, self._dra_channel):
+                if ch is not None:
+                    ch.close()
+            self._reg_channel = self._dra_channel = None

@@ -159,13 +159,14 @@ class DraGrpcServer:
 
     def __init__(self, plugin, claims_client: ResourceClient,
                  driver_name: str, dra_address: str,
-                 registration_address: Optional[str] = None,
-                 health_port: Optional[int] = None):
+                 registration_address: Optional[str] = None):
         """``dra_address``/``registration_address`` are grpc bind targets
         (``unix:///path/dra.sock`` in production, ``localhost:0`` in
-        tests). ``health_port`` additionally serves the health service on
-        TCP for kubelet's grpc probes. The registration response reports
-        the dra socket's *filesystem path* (kubelet's dialing contract)."""
+        tests). The registration response reports the dra socket's
+        *filesystem path* (kubelet's dialing contract). The TCP health
+        endpoint for kubelet's grpc probes is the separate
+        SelfProbeHealthcheck (healthcheck.py), matching reference
+        health.go."""
         self._plugin = plugin
         self._driver_name = driver_name
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
@@ -175,10 +176,6 @@ class DraGrpcServer:
         ))
         self._reg_server = None
         self.dra_port = self._server.add_insecure_port(dra_address)
-        self.health_port: Optional[int] = None
-        if health_port is not None:
-            self.health_port = self._server.add_insecure_port(
-                f"0.0.0.0:{health_port}")
         if registration_address is not None:
             endpoint_path = (dra_address[len("unix://"):]
                              if dra_address.startswith("unix://")

@@ -42,6 +42,10 @@ def add_common_flags(parser: EnvArgumentParser) -> None:
                         default=50.0)
     parser.add_argument("--kubeconfig", env="KUBECONFIG", default="",
                         help="out-of-cluster kubeconfig path")
+    parser.add_argument("--kube-backend", env="KUBE_BACKEND", default="rest",
+                        choices=["rest", "fake"],
+                        help="fake = in-memory API server (hardware-free "
+                             "demo/CI mode, pairs with --device-backend fake)")
 
 
 def parse_gates(args: argparse.Namespace) -> FeatureGates:

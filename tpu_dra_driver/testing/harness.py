@@ -216,8 +216,8 @@ class ClusterHarness:
             "kind": "ComputeDomain",
             "metadata": {"name": name, "namespace": namespace},
             "spec": {"numNodes": num_nodes,
-                     "channel": {"resourceClaimTemplate": {"name": rct_name}},
-                     "allocationMode": "All"},
+                     "channel": {"resourceClaimTemplate": {"name": rct_name},
+                                 "allocationMode": "Single"}},
         })
 
     def wait_for(self, predicate, timeout: float = 10.0, what: str = "") -> None:

@@ -145,10 +145,12 @@ def main() -> None:
     env when running multi-host, then prints RESULT lines."""
     import os
 
-    if (os.environ.get("TPU_WORKER_HOSTNAMES")
-            and "JAX_COORDINATOR_ADDRESS" not in os.environ):
+    if os.environ.get("TPU_WORKER_HOSTNAMES"):
         import jax
 
+        # initialize() picks up JAX_COORDINATOR_ADDRESS itself when set;
+        # it must run either way or each pod only sees local devices and
+        # the bench silently degrades to single-host.
         jax.distributed.initialize()
     print(psum_bandwidth(), flush=True)
     print(all_gather_bandwidth(), flush=True)
