@@ -423,3 +423,116 @@ def test_channel_allocation_mode_all_injects_every_channel(harness):
     nodes1 = [dn["path"] for dev in spec1["devices"]
               for dn in dev["containerEdits"].get("deviceNodes", [])]
     assert len(nodes1) == 1
+
+
+def test_multi_namespace_daemonset_adoption_and_teardown(tmp_path):
+    """--additional-namespaces (reference mnsdaemonset.go): a CD DaemonSet
+    already living in an additional managed namespace is adopted there (no
+    duplicate in the driver namespace); teardown spans all managed
+    namespaces."""
+    from tpu_dra_driver.computedomain import DRIVER_NAMESPACE
+    from tpu_dra_driver.computedomain.controller.controller import (
+        ControllerConfig)
+    from tpu_dra_driver.computedomain.controller.objects import (
+        build_daemonset, daemonset_name)
+
+    h = ClusterHarness(str(tmp_path),
+                       controller_config=ControllerConfig(
+                           status_sync_interval=0.05,
+                           additional_namespaces=["legacy-ns"]))
+    # Pre-create the CD and a DS for its uid in legacy-ns BEFORE the
+    # controller starts, as if a previous driver install managed it there
+    # (the adoption scenario: controller restart after a namespace move).
+    h.create_compute_domain("cd1", "user-ns", 1, "wl-rct")
+    cd_obj = h.clients.compute_domains.get("cd1", "user-ns")
+    from tpu_dra_driver.api.types import ComputeDomain
+    cd = ComputeDomain.from_obj(cd_obj)
+    legacy_ds = build_daemonset(cd)
+    legacy_ds["metadata"]["namespace"] = "legacy-ns"
+    legacy_ds["spec"]["template"]["metadata"] = {"labels": {"stale": "y"}}
+    h.clients.daemonsets.create(legacy_ds)
+    h.start()
+    try:
+        # Reconcile must adopt the legacy-ns DS (update it in place)...
+        def adopted():
+            ds = h.clients.daemonsets.get(daemonset_name(cd), "legacy-ns")
+            return ds["spec"] == build_daemonset(cd)["spec"]
+        h.wait_for(adopted, what="legacy DS adopted")
+        # ...and never create a duplicate in the driver namespace.
+        assert not h.clients.daemonsets.list(namespace=DRIVER_NAMESPACE)
+
+        # Teardown spans managed namespaces.
+        h.clients.compute_domains.delete("cd1", "user-ns")
+        h.wait_for(lambda: not h.clients.daemonsets.list(namespace="legacy-ns"),
+                   what="legacy DS removed")
+    finally:
+        h.stop()
+
+
+def test_stale_clique_entry_pruned_when_pod_never_returns(tmp_path):
+    """A clique entry whose daemon pod is gone for good must be pruned by
+    the controller's status sync (reference cdstatus.go cleanupClique) —
+    without it a force-deleted node leaves a permanently-Ready ghost."""
+    h = ClusterHarness(str(tmp_path))
+    h.start()
+    try:
+        h.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+        uid = h.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+        results = _prepare_concurrently(h, uid, [0, 1])
+        assert all(r.error is None for r in results.values()), results
+
+        # Remove host-1's node label so the DS no longer wants a daemon
+        # there, then force-delete its pod: it will NOT come back.
+        def unlabel(obj):
+            (obj["metadata"].get("labels") or {}).pop(
+                COMPUTE_DOMAIN_LABEL_KEY, None)
+        h.clients.nodes.retry_update("host-1", "", unlabel)
+        victim = next(p["metadata"]["name"] for p in
+                      h.clients.pods.list(namespace=DRIVER_NAMESPACE)
+                      if (p.get("spec") or {}).get("nodeName") == "host-1")
+        h.clients.pods.delete(victim, DRIVER_NAMESPACE)
+
+        def pruned():
+            st = h.cd_status("cd1", "user-ns")
+            names = [n["name"] for n in st.get("nodes") or []]
+            return names == ["host-0"]
+        h.wait_for(pruned, timeout=20.0, what="ghost node pruned")
+    finally:
+        h.stop()
+
+
+def test_non_fabric_daemon_pod_contributes_status(tmp_path):
+    """A daemon pod labeled with an explicitly-empty cliqueID is a
+    non-fabric-attached node: its status entry is built from the pod
+    itself (reference cdstatus.go buildNodesFromPods: cliqueID "",
+    index -1, readiness from pod conditions)."""
+    h = ClusterHarness(str(tmp_path))
+    h.start()
+    try:
+        h.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+        uid = h.clients.compute_domains.get("cd1", "user-ns")["metadata"]["uid"]
+        results = _prepare_concurrently(h, uid, [0, 1])
+        assert all(r.error is None for r in results.values()), results
+
+        from tpu_dra_driver.computedomain.daemon.daemon import (
+            CLIQUE_ID_LABEL_KEY)
+        h.clients.pods.create({
+            "metadata": {"name": "cd-daemon-nonfabric",
+                         "namespace": DRIVER_NAMESPACE,
+                         "labels": {COMPUTE_DOMAIN_LABEL_KEY: uid,
+                                    CLIQUE_ID_LABEL_KEY: ""}},
+            "spec": {"nodeName": "island-0"},
+            "status": {"podIP": "10.9.9.9",
+                       "conditions": [{"type": "Ready", "status": "True"}]},
+        })
+
+        def merged():
+            st = h.cd_status("cd1", "user-ns")
+            node = next((n for n in st.get("nodes") or []
+                         if n["name"] == "island-0"), None)
+            return (node is not None and node["cliqueID"] == ""
+                    and node["index"] == -1
+                    and node["status"] == STATUS_READY)
+        h.wait_for(merged, timeout=10.0, what="non-fabric node merged")
+    finally:
+        h.stop()

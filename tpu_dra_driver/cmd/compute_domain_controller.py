@@ -48,6 +48,11 @@ def build_parser() -> EnvArgumentParser:
                    type=int, default=4,
                    help="verbosity plumbed into stamped CD daemon pods "
                         "(reference daemonset.go:206-217)")
+    p.add_argument("--additional-namespaces", env="ADDITIONAL_NAMESPACES",
+                   default="",
+                   help="comma-separated extra namespaces where the driver "
+                        "may manage CD DaemonSets (reference "
+                        "main.go --additional-namespaces)")
     p.add_argument("--leader-election-namespace",
                    env="LEADER_ELECTION_NAMESPACE", default="tpu-dra-driver")
     p.add_argument("--identity", env="POD_NAME", default="controller")
@@ -70,7 +75,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         status_sync_interval=args.status_sync_interval,
         device_backend=args.device_backend,
         daemon_image=args.driver_image,
-        daemon_log_verbosity=args.daemon_log_verbosity))
+        daemon_log_verbosity=args.daemon_log_verbosity,
+        additional_namespaces=[ns.strip() for ns in
+                               args.additional_namespaces.split(",")
+                               if ns.strip()]))
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -104,7 +104,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpu_dra_driver.grpc_api.healthcheck import SelfProbeHealthcheck
         healthcheck = SelfProbeHealthcheck(
             registration_target=reg_sock, dra_target=dra_sock,
-            port=args.health_port)
+            port=args.health_port, healthy_fn=plugin.healthy)
         healthcheck.start()
 
     debug_server = None

@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from tpu_dra_driver.api.types import (
@@ -36,6 +36,7 @@ from tpu_dra_driver.computedomain import (
     COMPUTE_DOMAIN_LABEL_KEY,
     DRIVER_NAMESPACE,
 )
+from tpu_dra_driver.computedomain.daemon.daemon import CLIQUE_ID_LABEL_KEY
 from tpu_dra_driver.computedomain.controller.objects import (
     build_daemon_rct,
     build_daemonset,
@@ -60,6 +61,12 @@ class ControllerConfig:
     max_nodes_per_domain: int = DEFAULT_MAX_NODES_PER_DOMAIN
     status_sync_interval: float = STATUS_SYNC_INTERVAL
     orphan_cleanup_interval: float = ORPHAN_CLEANUP_INTERVAL
+    # Extra namespaces where the driver may manage CD DaemonSets
+    # (reference mnsdaemonset.go + --additional-namespaces): a CD's
+    # DaemonSet found in any managed namespace is adopted/updated there;
+    # new ones are always created in the driver namespace; teardown and
+    # orphan cleanup span all managed namespaces.
+    additional_namespaces: List[str] = field(default_factory=list)
     # hardware backend the stamped CD daemon pods must use; matches the
     # chart-wide deviceBackend value ("fake" on demo clusters)
     device_backend: str = "native"
@@ -199,15 +206,43 @@ class ComputeDomainController:
         self._clients.compute_domains.retry_update(
             cd.metadata.name, cd.metadata.namespace, mutate)
 
+    def _managed_namespaces(self) -> List[str]:
+        """Driver namespace + additional namespaces, deduplicated
+        (reference mnsdaemonset.go:42-48)."""
+        seen = {DRIVER_NAMESPACE}
+        seen.update(self._config.additional_namespaces)
+        return sorted(seen)
+
+    def _find_daemonset(self, cd_uid: str) -> Optional[Dict]:
+        """Locate an existing CD DaemonSet in ANY managed namespace
+        (reference mnsdaemonset.go:81-90: adopt before create)."""
+        for ns in self._managed_namespaces():
+            for ds in self._clients.daemonsets.list(
+                    namespace=ns,
+                    label_selector={COMPUTE_DOMAIN_LABEL_KEY: cd_uid}):
+                return ds
+        return None
+
     def _ensure_children(self, cd: ComputeDomain) -> None:
         """Create-or-update children to the desired state (a bare create
         would never propagate spec changes), and delete stale workload RCTs
         left behind by a rename of spec.channel.resourceClaimTemplate.name."""
+        desired_ds = build_daemonset(
+            cd, image=self._config.daemon_image,
+            log_verbosity=self._config.daemon_log_verbosity,
+            device_backend=self._config.device_backend)
+        existing_ds = self._find_daemonset(cd.metadata.uid)
+        if existing_ds is not None:
+            # adopt wherever it lives (possibly an additional namespace)
+            if existing_ds.get("spec") != desired_ds["spec"]:
+                existing_ds["spec"] = desired_ds["spec"]
+                self._clients.daemonsets.update(existing_ds)
+        else:
+            try:
+                self._clients.daemonsets.create(desired_ds)
+            except AlreadyExistsError:
+                pass  # raced with ourselves; next reconcile converges
         for client, obj in (
-            (self._clients.daemonsets,
-             build_daemonset(cd, image=self._config.daemon_image,
-                             log_verbosity=self._config.daemon_log_verbosity,
-                             device_backend=self._config.device_backend)),
             (self._clients.resource_claim_templates, build_daemon_rct(cd)),
             (self._clients.resource_claim_templates, build_workload_rct(cd)),
         ):
@@ -234,8 +269,17 @@ class ComputeDomainController:
 
     def _teardown(self, cd: ComputeDomain) -> None:
         uid = cd.metadata.uid
-        self._clients.daemonsets.delete_ignore_missing(
-            daemonset_name(cd), DRIVER_NAMESPACE)
+        # DaemonSets may live in any managed namespace (mnsdaemonset.go
+        # Delete spans all of them); delete by the CD-uid label so an
+        # adopted DS with a non-canonical name is torn down too.
+        for ns in self._managed_namespaces():
+            self._clients.daemonsets.delete_ignore_missing(
+                daemonset_name(cd), ns)
+            for ds in self._clients.daemonsets.list(
+                    namespace=ns,
+                    label_selector={COMPUTE_DOMAIN_LABEL_KEY: uid}):
+                self._clients.daemonsets.delete_ignore_missing(
+                    ds["metadata"]["name"], ns)
         self._clients.resource_claim_templates.delete_ignore_missing(
             daemon_rct_name(cd), DRIVER_NAMESPACE)
         self._clients.resource_claim_templates.delete_ignore_missing(
@@ -302,26 +346,99 @@ class ComputeDomainController:
     # status sync (reference cdstatus.go:120-260)
     # ------------------------------------------------------------------
 
+    def _daemon_pods_by_cd(self) -> Dict[str, List[Dict]]:
+        """Daemon pods grouped by CD uid, across all managed namespaces
+        (reference daemonsetpods.go DaemonSetPodManager.List)."""
+        by_cd: Dict[str, List[Dict]] = {}
+        for ns in self._managed_namespaces():
+            for pod in self._clients.pods.list(namespace=ns):
+                uid = (pod["metadata"].get("labels") or {}).get(
+                    COMPUTE_DOMAIN_LABEL_KEY)
+                if uid:
+                    by_cd.setdefault(uid, []).append(pod)
+        return by_cd
+
+    def _cliques_by_cd(self) -> Dict[str, List[Dict]]:
+        """One cluster-wide clique LIST per tick, grouped by CD uid (the
+        clique name is ``<cdUID>.<cliqueID>``)."""
+        by_cd: Dict[str, List[Dict]] = {}
+        for cq_obj in self._clients.compute_domain_cliques.list():
+            uid = cq_obj["metadata"]["name"].split(".", 1)[0]
+            by_cd.setdefault(uid, []).append(cq_obj)
+        return by_cd
+
     def _sync_all_statuses(self) -> None:
+        pods_by_cd = self._daemon_pods_by_cd()
+        cliques_by_cd = self._cliques_by_cd()
         for obj in self._clients.compute_domains.list():
+            uid = obj["metadata"].get("uid", "")
             try:
-                self._sync_status(ComputeDomain.from_obj(obj))
+                self._cleanup_cliques(cliques_by_cd.get(uid, []),
+                                      pods_by_cd.get(uid, []))
+                self._sync_status(ComputeDomain.from_obj(obj),
+                                  cliques_by_cd.get(uid, []),
+                                  pods_by_cd.get(uid, []))
             except (ConflictError, NotFoundError):
                 pass  # next tick
 
-    def _sync_status(self, cd: ComputeDomain) -> None:
-        uid = cd.metadata.uid
-        nodes: List[ComputeDomainNodeStatus] = []
-        for cq_obj in self._clients.compute_domain_cliques.list():
+    def _cleanup_cliques(self, cliques: List[Dict], pods: List[Dict]) -> None:
+        """Remove clique daemon entries whose pod is gone — the heal path
+        for force-deleted daemon pods (reference cdstatus.go:286-326
+        cleanupClique)."""
+        running_nodes = {(p.get("spec") or {}).get("nodeName")
+                         for p in pods}
+        running_nodes.discard(None)
+        running_nodes.discard("")
+        for cq_obj in cliques:
             name = cq_obj["metadata"]["name"]
-            if not name.startswith(f"{uid}."):
+            stale = [d.get("nodeName") for d in cq_obj.get("daemons") or []
+                     if d.get("nodeName") not in running_nodes]
+            if not stale:
                 continue
-            clique_id = name.split(".", 1)[1]
+
+            def prune(obj):
+                daemons = obj.get("daemons") or []
+                kept = [d for d in daemons
+                        if d.get("nodeName") in running_nodes]
+                if len(kept) == len(daemons):
+                    return ABORT
+                obj["daemons"] = kept
+            log.info("pruning stale clique entries %s from %s", stale, name)
+            try:
+                self._clients.compute_domain_cliques.retry_update(
+                    name, cq_obj["metadata"].get("namespace", ""), prune)
+            except NotFoundError:
+                pass
+
+    def _sync_status(self, cd: ComputeDomain, cliques: List[Dict],
+                     pods: List[Dict]) -> None:
+        nodes: List[ComputeDomainNodeStatus] = []
+        for cq_obj in cliques:
+            clique_id = cq_obj["metadata"]["name"].split(".", 1)[1]
             cq = ComputeDomainClique.from_obj(cq_obj)
             for d in cq.daemons:
                 nodes.append(ComputeDomainNodeStatus(
                     name=d.node_name, ip_address=d.ip_address,
                     clique_id=clique_id, index=d.index, status=d.status))
+        # Non-fabric nodes: daemon pods whose clique-id label is explicitly
+        # empty contribute status entries built from the pod itself
+        # (reference cdstatus.go:258-283 buildNodesFromPods; cliqueID "",
+        # index -1, status from pod readiness).
+        fabric_nodes = {n.name for n in nodes}
+        for pod in pods:
+            labels = pod["metadata"].get("labels") or {}
+            if labels.get(CLIQUE_ID_LABEL_KEY, "missing") != "":
+                continue
+            node_name = (pod.get("spec") or {}).get("nodeName", "")
+            pod_ip = (pod.get("status") or {}).get("podIP", "")
+            if not node_name or not pod_ip or node_name in fabric_nodes:
+                continue
+            conditions = (pod.get("status") or {}).get("conditions") or []
+            ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                        for c in conditions)
+            nodes.append(ComputeDomainNodeStatus(
+                name=node_name, ip_address=pod_ip, clique_id="", index=-1,
+                status=STATUS_READY if ready else STATUS_NOT_READY))
         nodes.sort(key=lambda n: (n.clique_id, n.index))
         ready = sum(1 for n in nodes if n.status == STATUS_READY)
         global_status = (STATUS_READY if ready >= cd.spec.num_nodes

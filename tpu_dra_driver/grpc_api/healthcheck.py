@@ -47,9 +47,15 @@ class SelfProbeHealthcheck:
     """
 
     def __init__(self, registration_target: str, dra_target: str,
-                 port: int = 0, host: str = "0.0.0.0"):
+                 port: int = 0, host: str = "0.0.0.0",
+                 healthy_fn=None):
+        """``healthy_fn`` (optional, () -> bool) folds the plugin's own
+        health state (e.g. device-health monitor) into the probe on top of
+        the two socket round-trips — a strict superset of the reference's
+        probe, preserving kubelet restarts on persistent device faults."""
         self._reg_target = registration_target
         self._dra_target = dra_target
+        self._healthy_fn = healthy_fn
         self._lock = threading.Lock()
         self._reg_channel: Optional[grpc.Channel] = None
         self._dra_channel: Optional[grpc.Channel] = None
@@ -68,7 +74,16 @@ class SelfProbeHealthcheck:
             return self._reg_channel, self._dra_channel
 
     def _probe(self) -> bool:
-        """One end-to-end self-probe; True iff both sockets answered."""
+        """One end-to-end self-probe; True iff both sockets answered (and
+        the plugin's own health hook, when wired, agrees)."""
+        if self._healthy_fn is not None:
+            try:
+                if not self._healthy_fn():
+                    log.error("healthcheck: plugin reports unhealthy")
+                    return False
+            except Exception:
+                log.exception("healthcheck: healthy_fn raised")
+                return False
         reg, dra = self._channels()
         try:
             info = reg.unary_unary(

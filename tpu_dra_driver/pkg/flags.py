@@ -44,8 +44,12 @@ def add_common_flags(parser: EnvArgumentParser) -> None:
                         help="out-of-cluster kubeconfig path")
     parser.add_argument("--kube-backend", env="KUBE_BACKEND", default="rest",
                         choices=["rest", "fake"],
-                        help="fake = in-memory API server (hardware-free "
-                             "demo/CI mode, pairs with --device-backend fake)")
+                        help="fake = per-process in-memory API server for "
+                             "single-binary smoke tests (state is NOT "
+                             "shared between processes; for a multi-"
+                             "component hardware-free demo use "
+                             "demo/run_e2e_demo.py, which drives all "
+                             "components in one process)")
 
 
 def parse_gates(args: argparse.Namespace) -> FeatureGates:

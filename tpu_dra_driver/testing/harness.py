@@ -62,14 +62,16 @@ class ClusterHarness:
     def __init__(self, tmp_dir: str, accelerator_type: str = "v5p-16",
                  gates: Optional[fg.FeatureGates] = None,
                  prepare_budget: float = 45.0,
-                 slice_id: Optional[str] = None):
+                 slice_id: Optional[str] = None,
+                 controller_config: Optional[ControllerConfig] = None):
         self.clients = ClientSets()
         self.tmp = tmp_dir
         self.gates = gates or fg.FeatureGates()
         self.hosts: List[HostRuntime] = []
         self.controller = ComputeDomainController(
-            self.clients, ControllerConfig(status_sync_interval=0.05,
-                                           orphan_cleanup_interval=3600.0))
+            self.clients,
+            controller_config or ControllerConfig(
+                status_sync_interval=0.05, orphan_cleanup_interval=3600.0))
         self._daemons: Dict[str, ComputeDomainDaemon] = {}   # pod name -> daemon
         self._stop = threading.Event()
         self._ds_thread: Optional[threading.Thread] = None
@@ -188,6 +190,7 @@ class ClusterHarness:
                         "metadata": {"name": pod_name,
                                      "namespace": DRIVER_NAMESPACE,
                                      "labels": {COMPUTE_DOMAIN_LABEL_KEY: cd_uid}},
+                        "spec": {"nodeName": node_name},
                         "status": {"podIP": pod_ip},
                     })
                 except AlreadyExistsError:
