@@ -66,6 +66,29 @@ def test_flash_fits_blocks_to_any_seq_len():
                                    atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("block_q,block_kv", [(256, 64), (64, 256)])
+def test_flash_asymmetric_blocks(block_q, block_kv):
+    """block_q != block_kv exercises the diagonal-split loop bounds
+    (n_full in the fwd/dq kernels, first_full ceil-division in dkv):
+    with unequal tiles the mask-free/masked partition is non-trivial in
+    both walk directions. Fwd and all grads must match the oracle."""
+    q, k, v = _qkv(jax.random.PRNGKey(10), t=1024, d=32)
+    ref = attention_reference(q, k, v, True)
+    out = flash_attention(q, k, v, True, block_q, block_kv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, True, block_q,
+                                         block_kv) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (attention_reference(q, k, v) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3, rtol=5e-3)
+
+
 def test_flash_causality_ignores_future():
     """Perturbing K/V beyond position p must not change output[:p+1]."""
     q, k, v = _qkv(jax.random.PRNGKey(3), t=128)

@@ -273,8 +273,8 @@ class ComputeDomainController:
         # Delete spans all of them); delete by the CD-uid label so an
         # adopted DS with a non-canonical name is torn down too.
         for ns in self._managed_namespaces():
-            self._clients.daemonsets.delete_ignore_missing(
-                daemonset_name(cd), ns)
+            # build_daemonset always stamps the CD-uid label, so the
+            # label-selector delete covers the canonically-named DS too
             for ds in self._clients.daemonsets.list(
                     namespace=ns,
                     label_selector={COMPUTE_DOMAIN_LABEL_KEY: uid}):
@@ -373,7 +373,7 @@ class ComputeDomainController:
         for obj in self._clients.compute_domains.list():
             uid = obj["metadata"].get("uid", "")
             try:
-                self._cleanup_cliques(cliques_by_cd.get(uid, []),
+                self._cleanup_cliques(uid, cliques_by_cd.get(uid, []),
                                       pods_by_cd.get(uid, []))
                 self._sync_status(ComputeDomain.from_obj(obj),
                                   cliques_by_cd.get(uid, []),
@@ -381,14 +381,12 @@ class ComputeDomainController:
             except (ConflictError, NotFoundError):
                 pass  # next tick
 
-    def _cleanup_cliques(self, cliques: List[Dict], pods: List[Dict]) -> None:
+    def _cleanup_cliques(self, cd_uid: str, cliques: List[Dict],
+                         pods: List[Dict]) -> None:
         """Remove clique daemon entries whose pod is gone — the heal path
         for force-deleted daemon pods (reference cdstatus.go:286-326
         cleanupClique)."""
-        running_nodes = {(p.get("spec") or {}).get("nodeName")
-                         for p in pods}
-        running_nodes.discard(None)
-        running_nodes.discard("")
+        running_nodes = self._pod_nodes(pods)
         for cq_obj in cliques:
             name = cq_obj["metadata"]["name"]
             stale = [d.get("nodeName") for d in cq_obj.get("daemons") or []
@@ -397,9 +395,14 @@ class ComputeDomainController:
                 continue
 
             def prune(obj):
+                # Re-list pods inside the mutate: the tick's snapshot may
+                # predate a replacement daemon's join (DS rolling update),
+                # and evicting a just-joined entry would strand the node —
+                # join() only runs at daemon startup.
+                fresh_nodes = self._pod_nodes(self._daemon_pods_for(cd_uid))
                 daemons = obj.get("daemons") or []
                 kept = [d for d in daemons
-                        if d.get("nodeName") in running_nodes]
+                        if d.get("nodeName") in fresh_nodes]
                 if len(kept) == len(daemons):
                     return ABORT
                 obj["daemons"] = kept
@@ -409,6 +412,21 @@ class ComputeDomainController:
                     name, cq_obj["metadata"].get("namespace", ""), prune)
             except NotFoundError:
                 pass
+
+    @staticmethod
+    def _pod_nodes(pods: List[Dict]) -> set:
+        nodes = {(p.get("spec") or {}).get("nodeName") for p in pods}
+        nodes.discard(None)
+        nodes.discard("")
+        return nodes
+
+    def _daemon_pods_for(self, cd_uid: str) -> List[Dict]:
+        out: List[Dict] = []
+        for ns in self._managed_namespaces():
+            out.extend(self._clients.pods.list(
+                namespace=ns,
+                label_selector={COMPUTE_DOMAIN_LABEL_KEY: cd_uid}))
+        return out
 
     def _sync_status(self, cd: ComputeDomain, cliques: List[Dict],
                      pods: List[Dict]) -> None:

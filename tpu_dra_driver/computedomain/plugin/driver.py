@@ -73,6 +73,17 @@ class CdKubeletPlugin:
         log.info("cd-kubelet-plugin started on %s (clique %s)",
                  self._config.node_name, self._lib.slice_id())
 
+    def healthy(self) -> bool:
+        """gRPC healthcheck analog (reference health.go:121-149): verify
+        the fabric metadata still answers and the checkpoint is readable."""
+        try:
+            self._lib.slice_id()
+            self.state.get_checkpoint()
+            return True
+        except Exception:
+            log.exception("healthcheck failed")
+            return False
+
     # ------------------------------------------------------------------
 
     def prepare_resource_claims(self, claims: List[Dict]) -> Dict[str, PrepareResult]:

@@ -40,6 +40,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# Softmax runs in base-2 inside the kernels: the VPU has a native pow2,
+# so exp(x) is computed as exp2(x * log2(e)) with the log2(e) folded
+# into the score scale (one multiply that the MXU epilogue absorbs).
+# The stored logsumexp stays in natural units at the API boundary.
+LOG2E = 1.4426950408889634
 
 
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -79,16 +84,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
     d = q_ref.shape[1]
 
     def steps(carry):
-        """Online-softmax over this superblock's causal prefix."""
-        if causal:
-            # number of inner blocks intersecting the causal triangle
-            upper = jnp.minimum(
-                nb, (row_max - sj * super_kv) // block_kv + 1)
-        else:
-            upper = nb
+        """Online-softmax over this superblock's causal prefix.
+
+        The walk is split at the diagonal: blocks wholly below it take
+        the mask-free path (no iota/where — pure MXU + softmax update),
+        only the 1-2 diagonal-straddling blocks per q row pay for mask
+        generation. Scores are kept in base-2 (see LOG2E)."""
         q = q_ref[:]                                             # [bq, d]
 
-        def body(j2, carry):
+        def body(j2, carry, masked):
             acc, m, l = carry
             # matmul operands stay in the input dtype (bf16 on TPU) so
             # the MXU runs at full rate; accumulation is f32
@@ -96,8 +100,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
             vb = v_ref[pl.ds(j2 * block_kv, block_kv), :]
             s = jax.lax.dot_general(                             # [bq, bkv]
                 q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if causal:
+                preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+            if masked:
                 row_ids = qi * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 0)
                 col_ids = (sj * super_kv + j2 * block_kv
@@ -105,8 +109,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                                jnp.int32, (block_q, block_kv), 1))
                 s = jnp.where(row_ids >= col_ids, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m - m_new)
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
             l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             pv = jax.lax.dot_general(                            # [bq, d]
                 p.astype(vb.dtype), vb,
@@ -114,13 +118,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                 preferred_element_type=jnp.float32)
             return acc * alpha + pv, m_new, l
 
-        return jax.lax.fori_loop(0, upper, body, carry)
+        if not causal:
+            return jax.lax.fori_loop(
+                0, nb, functools.partial(body, masked=False), carry)
+        # blocks intersecting the causal triangle for this q row
+        upper = jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
+        # blocks wholly below the diagonal (every col <= every row)
+        row_min = qi * block_q
+        n_full = jnp.clip((row_min - sj * super_kv + 1) // block_kv, 0, upper)
+        carry = jax.lax.fori_loop(
+            0, n_full, functools.partial(body, masked=False), carry)
+        return jax.lax.fori_loop(
+            n_full, upper, functools.partial(body, masked=True), carry)
 
     def finish(carry):
         acc, m, l = carry
         l = jnp.maximum(l, 1e-30)
         o_ref[:] = (acc / l).astype(o_ref.dtype)
-        lse_ref[:] = (m + jnp.log(l)).reshape(1, block_q)
+        # m is in base-2 units; publish natural-log lse for the backward
+        lse_ref[:] = ((m + jnp.log2(l)) / LOG2E).reshape(1, block_q)
 
     zeros = lambda: (jnp.zeros((block_q, d), jnp.float32),
                      jnp.full((block_q, 1), NEG_INF, jnp.float32),
@@ -254,25 +270,24 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
     row_max = qi * block_q + block_q - 1
 
     def steps(acc0):
-        upper = (jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
-                 if causal else nb)
-        lse = lse_ref[:].reshape(block_q, 1)
+        # base-2 softmax: p = exp(s - lse) == exp2(s*log2e - lse*log2e)
+        lse2 = lse_ref[:].reshape(block_q, 1) * LOG2E
         dD = dD_ref[:].reshape(block_q, 1)
 
-        def body(j2, acc):
+        def body(j2, acc, masked):
             kb = k_ref[pl.ds(j2 * block_kv, block_kv), :]
             vb = v_ref[pl.ds(j2 * block_kv, block_kv), :]
             s = jax.lax.dot_general(
                 q_ref[:], kb, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if causal:
+                preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+            if masked:
                 row_ids = qi * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 0)
                 col_ids = (sj * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (block_q, block_kv), 1))
                 s = jnp.where(row_ids >= col_ids, s, NEG_INF)
-            p = jnp.exp(s - lse)                                 # [bq, bkv]
+            p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dp = jax.lax.dot_general(                            # dO @ V^T
                 do_ref[:], vb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -282,7 +297,16 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        return jax.lax.fori_loop(0, upper, body, acc0)
+        if not causal:
+            return jax.lax.fori_loop(
+                0, nb, functools.partial(body, masked=False), acc0)
+        upper = jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
+        n_full = jnp.clip(
+            (qi * block_q - sj * super_kv + 1) // block_kv, 0, upper)
+        acc0 = jax.lax.fori_loop(
+            0, n_full, functools.partial(body, masked=False), acc0)
+        return jax.lax.fori_loop(
+            n_full, upper, functools.partial(body, masked=True), acc0)
 
     d = q_ref.shape[1]
 
@@ -315,28 +339,27 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
     kv_start = kj * block_kv
 
     def steps(carry):
-        lower = (jnp.maximum(0, (kv_start - si * super_q) // block_q)
-                 if causal else 0)
         kb = k_ref[:]
         vb = v_ref[:]
 
-        def body(i2, carry):
+        def body(i2, carry, masked):
             dk_acc, dv_acc = carry
             qb = q_ref[pl.ds(i2 * block_q, block_q), :]
             dob = do_ref[pl.ds(i2 * block_q, block_q), :]
-            lse = lse_ref[:, pl.ds(i2 * block_q, block_q)].reshape(block_q, 1)
+            lse2 = (lse_ref[:, pl.ds(i2 * block_q, block_q)]
+                    .reshape(block_q, 1) * LOG2E)
             dD = dD_ref[:, pl.ds(i2 * block_q, block_q)].reshape(block_q, 1)
             s = jax.lax.dot_general(
                 qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if causal:
+                preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+            if masked:
                 row_ids = (si * super_q + i2 * block_q
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (block_q, block_kv), 0))
                 col_ids = kv_start + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 1)
                 s = jnp.where(row_ids >= col_ids, s, NEG_INF)
-            p = jnp.exp(s - lse)                                 # [bq, bkv]
+            p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dv_acc = dv_acc + jax.lax.dot_general(               # P^T @ dO
                 p.astype(dob.dtype), dob,
                 dimension_numbers=(((0,), (0,)), ((), ())),
@@ -351,7 +374,19 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                 preferred_element_type=jnp.float32)
             return dk_acc, dv_acc
 
-        return jax.lax.fori_loop(lower, nb, body, carry)
+        if not causal:
+            return jax.lax.fori_loop(
+                0, nb, functools.partial(body, masked=False), carry)
+        # masked rows straddle the diagonal; rows are mask-free once
+        # every row >= this block's last column
+        lower = jnp.maximum(0, (kv_start - si * super_q) // block_q)
+        first_full = jnp.clip(
+            -(-(kv_start + block_kv - 1 - si * super_q) // block_q),
+            lower, nb)
+        carry = jax.lax.fori_loop(
+            lower, first_full, functools.partial(body, masked=True), carry)
+        return jax.lax.fori_loop(
+            first_full, nb, functools.partial(body, masked=False), carry)
 
     d = k_ref.shape[1]
 
@@ -439,7 +474,7 @@ def _on_tpu() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 512,
+                    causal: bool = True, block_q: int = 1024,
                     block_kv: int = 512,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Blockwise flash attention. q/k/v: [b, h, t, d] → [b, h, t, d].
