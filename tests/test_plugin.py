@@ -468,3 +468,29 @@ def test_checkpoint_v1_layout_is_genuinely_legacy(tmp_path):
     assert set(raw["v1"]["claims"]) == {"done"}
     assert "state" not in raw["v1"]["claims"]["done"]
     assert set(raw["v2"]["claims"]) == {"done", "inflight"}
+
+
+def test_find_libtpu_searches_driver_root(tmp_path):
+    """Reference root.go:28-96 — probe well-known library dirs under the
+    driver root, not one hardcoded path."""
+    from tpu_dra_driver.cdi.generator import dev_root_for, find_libtpu
+
+    assert find_libtpu(str(tmp_path)) is None
+    lib_dir = tmp_path / "usr" / "lib"
+    lib_dir.mkdir(parents=True)
+    (lib_dir / "libtpu.so").write_bytes(b"\x7fELF")
+    assert find_libtpu(str(tmp_path)) == str(lib_dir / "libtpu.so")
+    # dev-root detection (root.go:65-80): only a root with /dev qualifies
+    assert dev_root_for(str(tmp_path)) == "/"
+    (tmp_path / "dev").mkdir()
+    assert dev_root_for(str(tmp_path)) == str(tmp_path)
+
+
+def test_cdi_common_edits_prefer_probed_libtpu(tmp_path):
+    lib_dir = tmp_path / "home" / "kubernetes" / "bin"
+    lib_dir.mkdir(parents=True)
+    (lib_dir / "libtpu.so").write_bytes(b"\x7fELF")
+    cdi = CdiHandler(cdi_root=str(tmp_path / "cdi"),
+                     driver_root=str(tmp_path), driver_version="v")
+    edits = cdi.get_common_edits()
+    assert edits.mounts[0]["hostPath"] == str(lib_dir / "libtpu.so")

@@ -45,6 +45,44 @@ KIND = f"{VENDOR}/{CLASS}"
 DEFAULT_LIBTPU_HOST_PATH = "/home/kubernetes/bin/libtpu.so"
 DEFAULT_LIBTPU_CONTAINER_PATH = "/lib/libtpu.so"
 
+# Well-known libtpu locations, probed in order under the driver root
+# (reference root.go:28-45 getDriverLibraryPath searches the standard
+# library dirs for libnvidia-ml.so.1 the same way).
+LIBTPU_SEARCH_PATHS = (
+    "/home/kubernetes/bin/libtpu.so",          # GKE node image
+    "/usr/lib/libtpu.so",
+    "/usr/lib64/libtpu.so",
+    "/usr/local/lib/libtpu.so",
+    "/lib/libtpu.so",
+    "/usr/lib/x86_64-linux-gnu/libtpu.so",
+    "/usr/lib/aarch64-linux-gnu/libtpu.so",
+)
+
+
+def find_libtpu(driver_root: str = "/") -> Optional[str]:
+    """First existing libtpu under the driver root, or None.
+
+    Reference analog: root.findFile (root.go:82-96) — the driver may be
+    installed on the host (driver_root "/") or via an installer container
+    mounted at e.g. /driver-root.
+    """
+    root = driver_root.rstrip("/")
+    for rel in LIBTPU_SEARCH_PATHS:
+        cand = root + rel
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+def dev_root_for(driver_root: str = "/") -> str:
+    """Where this driver root's device nodes live (reference
+    root.go:65-80 isDevRoot/getDevRoot): a root containing a /dev
+    directory is a dev root; otherwise device nodes come from "/"."""
+    root = driver_root.rstrip("/") or "/"
+    if root != "/" and os.path.isdir(os.path.join(root, "dev")):
+        return root
+    return "/"
+
 
 @dataclass
 class ContainerEdits:
@@ -146,9 +184,14 @@ class CdiHandler:
             now = time.monotonic()
             if self._common_cache and now - self._common_cache[0] < self._ttl:
                 return self._common_cache[1]
-            host_lib = self._libtpu_host
-            if self._driver_root != "/":
-                host_lib = self._driver_root + host_lib
+            # Prefer a probed well-known location under the driver root;
+            # fall back to the configured path (which may not exist yet —
+            # the prestart init container waits for the installer).
+            host_lib = find_libtpu(self._driver_root)
+            if host_lib is None:
+                host_lib = self._libtpu_host
+                if self._driver_root != "/":
+                    host_lib = self._driver_root + host_lib
             edits = ContainerEdits(
                 env={
                     "TPU_DRIVER_VERSION": self._driver_version or "unknown",

@@ -34,6 +34,10 @@ class ModelConfig:
     d_ff: int = 1024
     max_seq: int = 256
     dtype: jnp.dtype = jnp.bfloat16
+    # 0 → n_heads (plain MHA). Fewer KV than query heads = GQA/MQA:
+    # wqkv shrinks to d + 2*d*n_kv/n_heads and the attention kernel
+    # shares KV tiles across each query-head group.
+    n_kv_heads: int = 0
     # n_experts > 0 replaces the dense MLP with a softmax-gated dense
     # mixture of experts (all experts computed, gate-weighted — static
     # shapes, XLA-friendly; expert dim shards over the mesh's ep axis)
@@ -57,10 +61,12 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         "layers": [],
         "final_norm": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
     }
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    kv_d = cfg.d_model * n_kv // cfg.n_heads
     for _ in range(cfg.n_layers):
         layer = {
             "ln1": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
-            "wqkv": mat(next(k), (cfg.d_model, 3 * cfg.d_model)),
+            "wqkv": mat(next(k), (cfg.d_model, cfg.d_model + 2 * kv_d)),
             "wo": mat(next(k), (cfg.d_model, cfg.d_model)),
             "ln2": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
         }
@@ -84,19 +90,23 @@ def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
 
 
 def _attention(x: jax.Array, layer: Params, n_heads: int,
-               attn_fn=None) -> jax.Array:
+               n_kv_heads: int = 0, attn_fn=None) -> jax.Array:
     """``attn_fn(q, k, v) -> out`` on [b, h, t, hd] tensors; plug point
     for flash_attention / ring_attention / ulysses_attention. Default is
-    the shared causal oracle (ops.attention.attention_reference)."""
+    the shared causal oracle (ops.attention.attention_reference). With
+    n_kv_heads < n_heads the K/V projections are grouped (GQA)."""
     b, t, d = x.shape
-    qkv = x @ layer["wqkv"]                      # MXU: [b,t,3d]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    n_kv = n_kv_heads or n_heads
     hd = d // n_heads
+    kv_d = hd * n_kv
+    qkv = x @ layer["wqkv"]                      # MXU: [b,t,d+2*kv_d]
+    q, k, v = jnp.split(qkv, [d, d + kv_d], axis=-1)
 
-    def heads(z):
-        return z.reshape(b, t, n_heads, hd).transpose(0, 2, 1, 3)
+    def heads(z, nh):
+        return z.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
 
-    out = (attn_fn or attention_reference)(heads(q), heads(k), heads(v))
+    out = (attn_fn or attention_reference)(
+        heads(q, n_heads), heads(k, n_kv), heads(v, n_kv))
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
     return out @ layer["wo"]
 
@@ -128,7 +138,7 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     x = params["embed"][tokens] + params["pos_embed"][:t]
     for layer in params["layers"]:
         x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
-                           cfg.n_heads, attn_fn)
+                           cfg.n_heads, cfg.n_kv_heads, attn_fn)
         ffn = _moe if "moe_up" in layer else _mlp
         x = x + ffn(_rmsnorm(x, layer["ln2"]["g"]), layer)
     x = _rmsnorm(x, params["final_norm"]["g"])

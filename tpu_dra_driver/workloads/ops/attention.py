@@ -49,8 +49,13 @@ LOG2E = 1.4426950408889634
 
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True) -> jax.Array:
-    """Oracle attention. q/k/v: [b, h, t, d] → [b, h, t, d]."""
+    """Oracle attention. q: [b, h, t, d], k/v: [b, h_kv, t, d] with
+    h % h_kv == 0 (GQA/MQA: kv heads broadcast over query groups)."""
     *_, t, d = q.shape
+    h, h_kv = q.shape[1], k.shape[1]
+    if h != h_kv:
+        k = jnp.repeat(k, h // h_kv, axis=1)
+        v = jnp.repeat(v, h // h_kv, axis=1)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
     scores = scores / math.sqrt(d)
     if causal:
@@ -63,7 +68,11 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                   block_q: int, block_kv: int, causal: bool, sm_scale: float,
                   num_super: int):
-    """One (batch*head, q-block, kv-superblock) grid cell.
+    """One (batch*kv-head, q-group, q-block, kv-superblock) grid cell.
+
+    GQA: the grid's axis 1 walks the query heads sharing this cell's KV
+    head; the K/V BlockSpecs ignore it, so grouped heads reuse the same
+    VMEM-resident KV tiles without materializing repeats in HBM.
 
     Two-level KV tiling: the innermost grid axis steps over
     *superblocks* (one [super, d] K/V tile VMEM-resident at a time,
@@ -76,8 +85,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
     logsumexp (the backward's residual) are written on the last step.
     Fully-masked superblocks skip all compute via pl.when.
     """
-    qi = pl.program_id(1)
-    sj = pl.program_id(2)
+    qi = pl.program_id(2)
+    sj = pl.program_id(3)
     super_kv = k_ref.shape[0]
     nb = super_kv // block_kv
     row_max = qi * block_q + block_q - 1       # last causal-visible column
@@ -174,10 +183,12 @@ def _scratch(block_q: int, d: int):
             pltpu.VMEM((block_q, 1), jnp.float32)]
 
 
-def _compiler_params():
-    # kv is a carried-accumulation axis, bh/q-block are parallel
+def _compiler_params(semantics=("parallel", "parallel", "parallel",
+                                "arbitrary")):
+    # superblock axes carry accumulation state ("arbitrary" = sequential);
+    # bh/group/q-block axes are parallel
     return {"compiler_params": pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))}
+        dimension_semantics=semantics)}
 
 
 def _grid_accumulate(num_super, sj, live, steps, finish, scratch, zeros):
@@ -209,21 +220,31 @@ def _grid_accumulate(num_super, sj, live, steps, finish, scratch, zeros):
         finish(tuple(ref[:] for ref in scratch))
 
 
+def _gqa_group(q, k):
+    b, h, t, d = q.shape
+    h_kv = k.shape[1]
+    if h % h_kv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    return h_kv, h // h_kv
+
+
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
                    interpret: bool):
-    """Returns (out [b,h,t,d], lse [b*h, 1, t] f32)."""
+    """Returns (out [b,h,t,d], lse [b*h, 1, t] f32). k/v may carry fewer
+    (grouped/multi-query) heads than q."""
     b, h, t, d = q.shape
+    h_kv, group = _gqa_group(q, k)
     super_kv = _fit_block(_SUPER_KV, t)
     block_q = _fit_block(block_q, t)
     block_kv = _fit_block(block_kv, super_kv)
     sm_scale = 1.0 / math.sqrt(d)
     num_super = t // super_kv
 
-    qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, t, d)
-    vf = v.reshape(b * h, t, d)
+    qf = q.reshape(b * h_kv, group, t, d)
+    kf = k.reshape(b * h_kv, t, d)
+    vf = v.reshape(b * h_kv, t, d)
 
-    grid = (b * h, t // block_q, num_super)
+    grid = (b * h_kv, group, t // block_q, num_super)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_kv=block_kv,
         causal=causal, sm_scale=sm_scale, num_super=num_super)
@@ -234,37 +255,43 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, qi, j: (i, qi, 0), **vmem),
-            pl.BlockSpec((None, super_kv, d), lambda i, qi, j: (i, j, 0), **vmem),
-            pl.BlockSpec((None, super_kv, d), lambda i, qi, j: (i, j, 0), **vmem),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda i, g, qi, j: (i, g, qi, 0), **vmem),
+            pl.BlockSpec((None, super_kv, d),
+                         lambda i, g, qi, j: (i, j, 0), **vmem),
+            pl.BlockSpec((None, super_kv, d),
+                         lambda i, g, qi, j: (i, j, 0), **vmem),
         ],
         out_specs=(
-            pl.BlockSpec((None, block_q, d), lambda i, qi, j: (i, qi, 0), **vmem),
-            pl.BlockSpec((None, 1, block_q), lambda i, qi, j: (i, 0, qi), **vmem),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda i, g, qi, j: (i, g, qi, 0), **vmem),
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda i, g, qi, j: (i, g, 0, qi), **vmem),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 1, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h_kv, group, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h_kv, group, 1, t), jnp.float32),
         ),
         scratch_shapes=_scratch(block_q, d),
         interpret=interpret,
         **_compiler_params(),
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d), lse
+    return out.reshape(b, h, t, d), lse.reshape(b * h, 1, t)
 
 
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                          dq_ref, acc_sc, *, block_q: int, block_kv: int,
                          causal: bool, sm_scale: float, num_super: int):
-    """dq for one (batch*head, q-block, kv-superblock) grid cell.
+    """dq for one (batch*kv-head, q-group, q-block, kv-superblock) cell.
 
     P is rebuilt from (q, k, lse); dS = P * (dP - D); dq = sum_j dS @ K_j
     * scale. D = rowsum(dO * O) is precomputed outside (one fused
     elementwise pass). Same two-level KV tiling as the forward: one
     superblock VMEM-resident per grid step, inner fori trimmed to the
-    causal prefix, dq accumulated in VMEM scratch."""
-    qi = pl.program_id(1)
-    sj = pl.program_id(2)
+    causal prefix, dq accumulated in VMEM scratch; grouped q heads (axis
+    1) share the KV tiles."""
+    qi = pl.program_id(2)
+    sj = pl.program_id(3)
     super_kv = k_ref.shape[0]
     nb = super_kv // block_kv
     row_max = qi * block_q + block_q - 1
@@ -325,15 +352,18 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                           dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
                           block_kv: int, causal: bool, sm_scale: float,
-                          num_super: int):
-    """dk/dv for one (batch*head, kv-block, q-superblock) grid cell.
+                          num_super: int, group: int):
+    """dk/dv for one (batch*kv-head, kv-block, q-group, q-superblock) cell.
 
     dv = sum_i P_i^T @ dO_i; dk = sum_i dS_i^T @ Q_i * scale. The q axis
     is superblock-tiled; causality starts the inner loop at the first Q
     block that can see this KV block and skips superblocks entirely
-    above the diagonal."""
+    above the diagonal. GQA: each grouped q head contributes to the same
+    dk/dv block, so the accumulation carry spans the (group, superblock)
+    step pair — both axes are sequential."""
     kj = pl.program_id(1)
-    si = pl.program_id(2)
+    gi = pl.program_id(2)
+    si = pl.program_id(3)
     super_q = q_ref.shape[0]
     nb = super_q // block_q
     kv_start = kj * block_kv
@@ -398,7 +428,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
     live = (True if not causal
             else (si * super_q + super_q - 1 >= kv_start))
     _grid_accumulate(
-        num_super, si, live, steps, finish, (dk_sc, dv_sc),
+        group * num_super, gi * num_super + si, live, steps, finish,
+        (dk_sc, dv_sc),
         zeros=lambda: (jnp.zeros((block_kv, d), jnp.float32),
                        jnp.zeros((block_kv, d), jnp.float32)))
 
@@ -406,63 +437,75 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                     block_kv: int, interpret: bool):
     b, h, t, d = q.shape
+    h_kv, group = _gqa_group(q, k)
     block_q = _fit_block(block_q, t)
     block_kv = _fit_block(block_kv, t)
     sm_scale = 1.0 / math.sqrt(d)
 
-    qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, t, d)
-    vf = v.reshape(b * h, t, d)
-    gf = g.reshape(b * h, t, d)
+    qf = q.reshape(b * h_kv, group, t, d)
+    kf = k.reshape(b * h_kv, t, d)
+    vf = v.reshape(b * h_kv, t, d)
+    gf = g.reshape(b * h_kv, group, t, d)
+    lse4 = lse.reshape(b * h_kv, group, 1, t)
     # D = rowsum(dO * O): one fused elementwise+reduce pass in XLA
     dD = jnp.sum(gf.astype(jnp.float32)
-                 * out.reshape(b * h, t, d).astype(jnp.float32),
-                 axis=-1).reshape(b * h, 1, t)
+                 * out.reshape(b * h_kv, group, t, d).astype(jnp.float32),
+                 axis=-1).reshape(b * h_kv, group, 1, t)
 
     super_kv = _fit_block(_SUPER_KV, t)
     super_q = _fit_block(_SUPER_KV, t)
     block_kv_dq = _fit_block(block_kv, super_kv)
     block_q_dkv = _fit_block(block_q, super_q)
     vmem = {"memory_space": pltpu.VMEM}
-    # dq grid: (bh, q-block, kv-superblock)
-    q_outer = pl.BlockSpec((None, block_q, d), lambda i, a, b_: (i, a, 0), **vmem)
-    kvs_inner = pl.BlockSpec((None, super_kv, d), lambda i, a, b_: (i, b_, 0), **vmem)
-    row_outer = pl.BlockSpec((None, 1, block_q), lambda i, a, b_: (i, 0, a), **vmem)
-    # dkv grid: (bh, kv-block, q-superblock)
-    kv_outer = pl.BlockSpec((None, block_kv, d), lambda i, a, b_: (i, a, 0), **vmem)
-    qs_inner = pl.BlockSpec((None, super_q, d), lambda i, a, b_: (i, b_, 0), **vmem)
-    rows_inner = pl.BlockSpec((None, 1, super_q), lambda i, a, b_: (i, 0, b_), **vmem)
+    # dq grid: (b*h_kv, group, q-block, kv-superblock)
+    q_outer = pl.BlockSpec((None, None, block_q, d),
+                           lambda i, g_, a, b_: (i, g_, a, 0), **vmem)
+    kvs_inner = pl.BlockSpec((None, super_kv, d),
+                             lambda i, g_, a, b_: (i, b_, 0), **vmem)
+    row_outer = pl.BlockSpec((None, None, 1, block_q),
+                             lambda i, g_, a, b_: (i, g_, 0, a), **vmem)
+    # dkv grid: (b*h_kv, kv-block, q-group, q-superblock); the kv-block
+    # output index ignores the two sequential axes — each grouped head's
+    # contribution folds into the same dk/dv block via the scratch carry
+    kv_outer = pl.BlockSpec((None, block_kv, d),
+                            lambda i, a, g_, b_: (i, a, 0), **vmem)
+    qs_inner = pl.BlockSpec((None, None, super_q, d),
+                            lambda i, a, g_, b_: (i, g_, b_, 0), **vmem)
+    rows_inner = pl.BlockSpec((None, None, 1, super_q),
+                              lambda i, a, g_, b_: (i, g_, 0, b_), **vmem)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_kv=block_kv_dq, causal=causal,
                           sm_scale=sm_scale, num_super=t // super_kv),
-        grid=(b * h, t // block_q, t // super_kv),
+        grid=(b * h_kv, group, t // block_q, t // super_kv),
         in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
         out_specs=q_outer,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h_kv, group, t, d), q.dtype),
         scratch_shapes=_scratch(block_q, d)[:1],
         interpret=interpret,
         **_compiler_params(),
-    )(qf, gf, lse, dD, kf, vf)
+    )(qf, gf, lse4, dD, kf, vf)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q_dkv,
                           block_kv=block_kv, causal=causal,
-                          sm_scale=sm_scale, num_super=t // super_q),
-        grid=(b * h, t // block_kv, t // super_q),
+                          sm_scale=sm_scale, num_super=t // super_q,
+                          group=group),
+        grid=(b * h_kv, t // block_kv, group, t // super_q),
         in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
         out_specs=(kv_outer, kv_outer),
-        out_shape=(jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, t, d), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((b * h_kv, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h_kv, t, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         interpret=interpret,
-        **_compiler_params(),
-    )(kf, vf, qf, gf, lse, dD)
+        **_compiler_params(("parallel", "parallel", "arbitrary",
+                            "arbitrary")),
+    )(kf, vf, qf, gf, lse4, dD)
 
-    rs = lambda x: x.reshape(b, h, t, d)
-    return rs(dq), rs(dk), rs(dv)
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h_kv, t, d),
+            dv.reshape(b, h_kv, t, d))
 
 
 def _on_tpu() -> bool:
