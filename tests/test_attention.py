@@ -163,7 +163,8 @@ def test_ring_attention_matches_full(causal):
     spec = P(None, None, "sp", None)
     ring = jax.jit(jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, "sp", causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        mesh=mesh, check_vma=False,
+        in_specs=(spec, spec, spec), out_specs=spec))
     sh = NamedSharding(mesh, spec)
     out = ring(*(jax.device_put(x, sh) for x in (q, k, v)))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -177,7 +178,8 @@ def test_ring_attention_gradients_flow_through_ppermute():
     sh = NamedSharding(mesh, spec)
     ring = jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, "sp", True),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, check_vma=False,
+        in_specs=(spec, spec, spec), out_specs=spec)
 
     def loss_ring(q, k, v):
         return (ring(q, k, v) ** 2).sum()

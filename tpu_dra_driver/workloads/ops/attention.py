@@ -220,6 +220,18 @@ def _grid_accumulate(num_super, sj, live, steps, finish, scratch, zeros):
         finish(tuple(ref[:] for ref in scratch))
 
 
+def _sds(shape, dtype, *like):
+    """ShapeDtypeStruct that, inside a shard_map trace, declares the
+    output varying over the union of the inputs' manual mesh axes (jax
+    requires explicit vma on pallas out_shapes when check_vma=True)."""
+    vma = frozenset()
+    for x in like:
+        vma = vma | (getattr(jax.typeof(x), "vma", None) or frozenset())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _gqa_group(q, k):
     b, h, t, d = q.shape
     h_kv = k.shape[1]
@@ -231,18 +243,24 @@ def _gqa_group(q, k):
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
                    interpret: bool):
     """Returns (out [b,h,t,d], lse [b*h, 1, t] f32). k/v may carry fewer
-    (grouped/multi-query) heads than q."""
+    (grouped/multi-query) heads than q, and a different sequence length
+    (KV chunks, cross-attention, decode) when non-causal."""
     b, h, t, d = q.shape
+    tkv = k.shape[2]
+    if causal and tkv != t:
+        raise ValueError(
+            f"causal flash attention needs t_q == t_kv (got {t} vs {tkv}); "
+            f"chunked-causal belongs to the caller (see ring_attention)")
     h_kv, group = _gqa_group(q, k)
-    super_kv = _fit_block(_SUPER_KV, t)
+    super_kv = _fit_block(_SUPER_KV, tkv)
     block_q = _fit_block(block_q, t)
     block_kv = _fit_block(block_kv, super_kv)
     sm_scale = 1.0 / math.sqrt(d)
-    num_super = t // super_kv
+    num_super = tkv // super_kv
 
     qf = q.reshape(b * h_kv, group, t, d)
-    kf = k.reshape(b * h_kv, t, d)
-    vf = v.reshape(b * h_kv, t, d)
+    kf = k.reshape(b * h_kv, tkv, d)
+    vf = v.reshape(b * h_kv, tkv, d)
 
     grid = (b * h_kv, group, t // block_q, num_super)
     kernel = functools.partial(
@@ -269,8 +287,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
                          lambda i, g, qi, j: (i, g, 0, qi), **vmem),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((b * h_kv, group, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h_kv, group, 1, t), jnp.float32),
+            _sds((b * h_kv, group, t, d), q.dtype, q, k, v),
+            _sds((b * h_kv, group, 1, t), jnp.float32, q, k, v),
         ),
         scratch_shapes=_scratch(block_q, d),
         interpret=interpret,
@@ -435,24 +453,31 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
-                    block_kv: int, interpret: bool):
+                    block_kv: int, interpret: bool, g_lse=None):
     b, h, t, d = q.shape
+    tkv = k.shape[2]
     h_kv, group = _gqa_group(q, k)
     block_q = _fit_block(block_q, t)
-    block_kv = _fit_block(block_kv, t)
+    block_kv = _fit_block(block_kv, tkv)
     sm_scale = 1.0 / math.sqrt(d)
 
     qf = q.reshape(b * h_kv, group, t, d)
-    kf = k.reshape(b * h_kv, t, d)
-    vf = v.reshape(b * h_kv, t, d)
+    kf = k.reshape(b * h_kv, tkv, d)
+    vf = v.reshape(b * h_kv, tkv, d)
     gf = g.reshape(b * h_kv, group, t, d)
     lse4 = lse.reshape(b * h_kv, group, 1, t)
-    # D = rowsum(dO * O): one fused elementwise+reduce pass in XLA
+    # D = rowsum(dO * O): one fused elementwise+reduce pass in XLA.
+    # When the caller also consumed the lse output (partial-attention
+    # merging, see flash_attention_with_lse), its cotangent enters the
+    # score gradient as dS += g_lse * P — the same per-row additive form
+    # as D, so it folds in here and the kernels stay untouched.
     dD = jnp.sum(gf.astype(jnp.float32)
                  * out.reshape(b * h_kv, group, t, d).astype(jnp.float32),
                  axis=-1).reshape(b * h_kv, group, 1, t)
+    if g_lse is not None:
+        dD = dD - g_lse.astype(jnp.float32).reshape(b * h_kv, group, 1, t)
 
-    super_kv = _fit_block(_SUPER_KV, t)
+    super_kv = _fit_block(_SUPER_KV, tkv)
     super_q = _fit_block(_SUPER_KV, t)
     block_kv_dq = _fit_block(block_kv, super_kv)
     block_q_dkv = _fit_block(block_q, super_q)
@@ -477,11 +502,11 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_kv=block_kv_dq, causal=causal,
-                          sm_scale=sm_scale, num_super=t // super_kv),
-        grid=(b * h_kv, group, t // block_q, t // super_kv),
+                          sm_scale=sm_scale, num_super=tkv // super_kv),
+        grid=(b * h_kv, group, t // block_q, tkv // super_kv),
         in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
         out_specs=q_outer,
-        out_shape=jax.ShapeDtypeStruct((b * h_kv, group, t, d), q.dtype),
+        out_shape=_sds((b * h_kv, group, t, d), q.dtype, q, k, v, g),
         scratch_shapes=_scratch(block_q, d)[:1],
         interpret=interpret,
         **_compiler_params(),
@@ -492,11 +517,11 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                           block_kv=block_kv, causal=causal,
                           sm_scale=sm_scale, num_super=t // super_q,
                           group=group),
-        grid=(b * h_kv, t // block_kv, group, t // super_q),
+        grid=(b * h_kv, tkv // block_kv, group, t // super_q),
         in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
         out_specs=(kv_outer, kv_outer),
-        out_shape=(jax.ShapeDtypeStruct((b * h_kv, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h_kv, t, d), v.dtype)),
+        out_shape=(_sds((b * h_kv, tkv, d), k.dtype, q, k, v, g),
+                   _sds((b * h_kv, tkv, d), v.dtype, q, k, v, g)),
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         interpret=interpret,
@@ -504,8 +529,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                             "arbitrary")),
     )(kf, vf, qf, gf, lse4, dD)
 
-    return (dq.reshape(b, h, t, d), dk.reshape(b, h_kv, t, d),
-            dv.reshape(b, h_kv, t, d))
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h_kv, tkv, d),
+            dv.reshape(b, h_kv, tkv, d))
 
 
 def _on_tpu() -> bool:
@@ -548,6 +573,60 @@ def _flash_bwd(causal, block_q, block_kv, interpret, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = True, block_q: int = 1024,
+                             block_kv: int = 512,
+                             interpret: Optional[bool] = None):
+    """Like ``flash_attention`` but also returns the per-row natural-log
+    logsumexp ``[b, h, t]`` (f32). The pair (out, lse) is the mergeable
+    *partial attention* form: results over disjoint KV chunks combine
+    exactly via logsumexp weighting (``merge_partials``) — the primitive
+    ring attention is built from. Gradients flow through both outputs.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    b, h, t, _ = q.shape
+    return out, lse.reshape(b, h, t)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_kv, interpret):
+    if interpret is None:
+        interpret = not _on_tpu()
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    b, h, t, _ = q.shape
+    return (out, lse.reshape(b, h, t)), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_kv, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    g_out, g_lse = g
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash_backward(q, k, v, out, lse, g_out, causal, block_q,
+                           block_kv, interpret, g_lse=g_lse)
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def merge_partials(o1: jax.Array, lse1: jax.Array,
+                   o2: jax.Array, lse2: jax.Array):
+    """Exactly combine two partial-attention results over disjoint KV
+    sets. o: [b, h, t, d] (any float dtype, merged in f32), lse: [b, h, t]
+    natural log. Associative; a fully-masked partial (lse = -inf)
+    contributes zero weight."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    lse = m + jnp.log(denom)
+    out = (o1.astype(jnp.float32) * (w1 / denom)[..., None]
+           + o2.astype(jnp.float32) * (w2 / denom)[..., None])
+    return out.astype(o1.dtype), lse
 
 
 def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,

@@ -35,33 +35,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_dra_driver.workloads.ops.attention import (
-    NEG_INF, attention_reference, flash_attention,
+    attention_reference, flash_attention, flash_attention_with_lse,
+    merge_partials,
 )
-
-
-def _block_update(q_scaled, kc, vc, acc, m, l, row_off, col_off, causal):
-    """Online-softmax accumulation of one K/V chunk.
-
-    q_scaled: [b,h,tq,d] (pre-scaled fp32); kc/vc: [b,h,tk,d];
-    acc [b,h,tq,d] fp32, m/l [b,h,tq,1] fp32. row_off/col_off are the
-    global sequence offsets of the Q shard / visiting chunk (traced).
-    """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q_scaled,
-                   kc.astype(jnp.float32),
-                   preferred_element_type=jnp.float32)
-    if causal:
-        tq, tk = q_scaled.shape[2], kc.shape[2]
-        rows = row_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
-        cols = col_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        s = jnp.where(rows >= cols, s, NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
-    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                   vc.astype(jnp.float32),
-                                   preferred_element_type=jnp.float32)
-    return acc, m_new, l
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -71,31 +47,39 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Per-device shapes [b, h, t_local, d]; the sequence axis is the one
     sharded over ``axis_name``. Returns the local [b, h, t_local, d]
     output shard.
+
+    Every chunk runs through the Pallas flash kernel (MXU-tiled, O(t/n)
+    memory — no [t/n, t/n] score matrix even per-chunk) and partial
+    results merge by logsumexp weighting. Causality per ring step is
+    structural, not elementwise: at step 0 the visiting chunk is the
+    device's own (standard causal mask, offsets cancel); at step s the
+    chunk is wholly past iff ``idx >= s`` (mask-free flash) and wholly
+    future otherwise (skipped via lax.cond — zero FLOPs, zero weight).
+    The ring is statically unrolled so XLA overlaps each ppermute hop
+    with the previous chunk's compute.
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    b, h, tl, d = q.shape
-    scale = 1.0 / (d ** 0.5)
-    q32 = q.astype(jnp.float32) * scale
-    row_off = idx * tl
-
-    acc = jnp.zeros((b, h, tl, d), jnp.float32)
-    m = jnp.full((b, h, tl, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, tl, 1), jnp.float32)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    out, lse = flash_attention_with_lse(q, k, v, causal)
     kk, vv = k, v
-    # static unrolled ring: n is a mesh constant, so XLA sees a fixed
-    # schedule and overlaps each ppermute hop with the block compute
-    for step in range(n):
-        src = (idx - step) % n           # owner of the visiting chunk
-        acc, m, l = _block_update(q32, kk, vv, acc, m, l,
-                                  row_off, src * tl, causal)
-        if step < n - 1:
-            kk = jax.lax.ppermute(kk, axis_name, perm)
-            vv = jax.lax.ppermute(vv, axis_name, perm)
+    for step in range(1, n):
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
 
-    out = acc / jnp.maximum(l, 1e-30)
+        def visit(out, lse, kc, vc):
+            o2, l2 = flash_attention_with_lse(q, kc, vc, False)
+            return merge_partials(out, lse, o2, l2)
+
+        if causal:
+            # chunk owner is (idx - step) % n: past (visible) iff no wrap
+            out, lse = jax.lax.cond(
+                idx >= step, visit,
+                lambda out, lse, kc, vc: (out, lse),
+                out, lse, kk, vv)
+        else:
+            out, lse = visit(out, lse, kk, vv)
     return out.astype(q.dtype)
 
 
@@ -136,7 +120,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     here), sequence rides ``axis_name``."""
     spec = P(batch_axes, head_axis, axis_name, None)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
                        in_specs=(spec, spec, spec), out_specs=spec)
     def wrapped(q, k, v):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
@@ -150,7 +134,7 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
                            attn_fn: Optional[Callable] = None) -> Callable:
     spec = P(batch_axes, head_axis, axis_name, None)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
                        in_specs=(spec, spec, spec), out_specs=spec)
     def wrapped(q, k, v):
         return ulysses_attention(q, k, v, axis_name=axis_name,
