@@ -153,6 +153,14 @@ def bench_accelerator() -> dict:
             log(f"  flash attention: {fa['flash_attn_tflops']:.2f} TFLOP/s "
                 f"({fa['shape']}), {fa['speedup_vs_ref']:.2f}x vs XLA "
                 f"reference attention ({fa['ref_attn_tflops']:.2f})")
+            from tpu_dra_driver.workloads.ops import (
+                flash_attention_train_tflops,
+            )
+            ft = flash_attention_train_tflops()
+            out["flash_attn_train_tflops"] = round(
+                ft["flash_attn_train_tflops"], 2)
+            log(f"  flash attention fwd+bwd: "
+                f"{ft['flash_attn_train_tflops']:.2f} TFLOP/s ({ft['shape']})")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out

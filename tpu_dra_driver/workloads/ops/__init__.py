@@ -8,4 +8,7 @@ from tpu_dra_driver.workloads.ops.attention import (  # noqa: F401
     attention_reference,
     flash_attention,
     flash_attention_tflops,
+    flash_attention_train_tflops,
+    flash_attention_with_lse,
+    merge_partials,
 )

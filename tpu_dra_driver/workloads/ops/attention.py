@@ -675,3 +675,46 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
         out["ref_attn_tflops"] = flops / per_ref / 1e12
         out["speedup_vs_ref"] = per_ref / per_flash
     return out
+
+
+def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
+                                 d: int = 128, dtype=jnp.bfloat16,
+                                 iters: int = 3, chain_short: int = 16,
+                                 chain_long: int = 48):
+    """Forward+backward (training) flash-attention throughput.
+
+    Chains full value_and_grad steps (all three grad kernels live — the
+    carry folds dq/dk/dv back into q/k/v so nothing is dead-code
+    eliminated); marginal-rate timing as flash_attention_tflops. FLOP
+    accounting: 2 fwd matmuls + 5 bwd matmuls = 3.5x the forward's
+    4*b*h*t^2*d/2 (causal)."""
+    from tpu_dra_driver.workloads.utils.timing import time_fn
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, t, d), dtype)
+    k = jax.random.normal(kk, (b, h, t, d), dtype)
+    v = jax.random.normal(kv, (b, h, t, d), dtype)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32) ** 2)
+
+    times = {}
+    for n in (chain_short, chain_long):
+        @jax.jit
+        def run(q, k, v, n=n):
+            def body(_, carry):
+                qq, kk_, vv = carry
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(qq, kk_, vv)
+                lr = jnp.asarray(1e-4, jnp.float32)
+                return ((qq - lr * dq).astype(dtype),
+                        (kk_ - lr * dk).astype(dtype),
+                        (vv - lr * dv).astype(dtype))
+            return jax.lax.fori_loop(0, n, body, (q, k, v))
+        times[n] = time_fn(lambda r=run: r(q, k, v),
+                           warmup=2, iters=iters).median_s
+    per = max(times[chain_long] - times[chain_short], 1e-9) / (
+        chain_long - chain_short)
+    flops = 3.5 * 4 * b * h * t * t * d / 2
+    return {"flash_attn_train_tflops": flops / per / 1e12,
+            "shape": f"b{b} h{h} t{t} d{d} {jnp.dtype(dtype).name}"}
