@@ -123,6 +123,11 @@ class ComputeDomainChannelSpec:
 @dataclass
 class ComputeDomainSpec:
     num_nodes: int = 0
+    # numSlices > 1 = a multislice domain: the CD spans that many ICI
+    # slices (one clique each) stitched over DCN; workloads additionally
+    # get MEGASCALE_* bootstrap env. TPU-native extension beyond the
+    # reference (whose IMEX domain is always one fabric).
+    num_slices: int = 1
     channel: ComputeDomainChannelSpec = field(default_factory=ComputeDomainChannelSpec)
 
 
@@ -156,6 +161,13 @@ class ComputeDomain:
         # numNodes only drives the global Ready status).
         if self.spec.num_nodes < 0:
             raise ValueError("spec.numNodes must be >= 0")
+        if self.spec.num_slices < 1:
+            raise ValueError("spec.numSlices must be >= 1")
+        if (self.spec.num_slices > 1 and self.spec.num_nodes
+                and self.spec.num_nodes % self.spec.num_slices):
+            raise ValueError(
+                f"spec.numNodes ({self.spec.num_nodes}) must be a multiple "
+                f"of spec.numSlices ({self.spec.num_slices})")
         if not self.spec.channel.resource_claim_template_name:
             raise ValueError("spec.channel.resourceClaimTemplate.name must be set")
         if self.spec.channel.allocation_mode not in (
@@ -172,6 +184,7 @@ class ComputeDomain:
             "metadata": self.metadata.to_obj(),
             "spec": {
                 "numNodes": self.spec.num_nodes,
+                "numSlices": self.spec.num_slices,
                 "channel": {
                     "resourceClaimTemplate": {
                         "name": self.spec.channel.resource_claim_template_name,
@@ -202,6 +215,7 @@ class ComputeDomain:
             metadata=ObjectMeta.from_obj(d.get("metadata") or {}),
             spec=ComputeDomainSpec(
                 num_nodes=spec.get("numNodes", 0),
+                num_slices=spec.get("numSlices", 1),
                 channel=ComputeDomainChannelSpec(
                     resource_claim_template_name=(
                         ((spec.get("channel") or {}).get("resourceClaimTemplate") or {})

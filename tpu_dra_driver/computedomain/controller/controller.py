@@ -459,7 +459,15 @@ class ComputeDomainController:
                 status=STATUS_READY if ready else STATUS_NOT_READY))
         nodes.sort(key=lambda n: (n.clique_id, n.index))
         ready = sum(1 for n in nodes if n.status == STATUS_READY)
-        global_status = (STATUS_READY if ready >= cd.spec.num_nodes
+        # multislice: enough ready nodes all piled into one fabric is NOT a
+        # usable domain — the ready set must span numSlices distinct
+        # cliques (numNodes=0 domains stay Ready-at-zero as before)
+        ready_slices = len({n.clique_id for n in nodes
+                            if n.status == STATUS_READY and n.clique_id})
+        slices_ok = (cd.spec.num_slices <= 1 or cd.spec.num_nodes == 0
+                     or ready_slices >= cd.spec.num_slices)
+        global_status = (STATUS_READY
+                         if ready >= cd.spec.num_nodes and slices_ok
                          else STATUS_NOT_READY)
 
         def mutate(obj):

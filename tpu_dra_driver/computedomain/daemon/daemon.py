@@ -84,6 +84,7 @@ class ComputeDomainDaemon:
         self._mu = threading.Lock()
         self._render_mu = threading.Lock()  # serializes _on_clique_change
         self._fabric_error: Optional[HealthEvent] = None
+        self._num_slices = 1
         self._on_fabric_error_cb = None
         # Set on fatal fabric errors. The production entrypoint waits on
         # this and exits nonzero so Kubernetes restarts the pod — raising
@@ -95,12 +96,20 @@ class ComputeDomainDaemon:
     def start(self) -> None:
         self._label_pod()
         self.index = self.membership.join()
+        self._num_slices = self._cd_num_slices()
         self._unsub_health = self._lib.subscribe_health(self._on_health)
-        # name-filtered clique informer (reference controller.go:95-133)
+        # name-filtered clique informer (reference controller.go:95-133);
+        # a multislice CD watches all sibling cliques (the coordinator
+        # address in worker-env depends on slice 0's membership)
+        if self._num_slices > 1:
+            prefix = f"{self._config.cd_uid}."
+            name_filter = lambda n: n.startswith(prefix)  # noqa: E731
+        else:
+            name_filter = lambda n: n == self.membership.name  # noqa: E731
         self._informer = Informer(
             self._clients.compute_domain_cliques,
             namespace=DRIVER_NAMESPACE,
-            name_filter=lambda n: n == self.membership.name)
+            name_filter=name_filter)
         self._informer.add_handlers(
             on_add=lambda o: self._on_clique_change(),
             on_update=lambda old, new: self._on_clique_change(),
@@ -179,12 +188,53 @@ class ComputeDomainDaemon:
             "cliqueID": self.clique_id,
             "computeDomain": self._config.cd_uid,
         }
+        if self._num_slices > 1:
+            env.update(self._megascale_env())
         path = self._config.worker_env_file
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
             json.dump(env, f, indent=1, sort_keys=True)
         os.replace(tmp, path)
+
+    def _cd_num_slices(self) -> int:
+        """numSlices from our ComputeDomain's spec (1 when unreadable —
+        the single-slice behavior is always safe)."""
+        try:
+            obj = self._clients.compute_domains.get(
+                self._config.cd_name, self._config.cd_namespace)
+            return max(1, int((obj.get("spec") or {}).get("numSlices", 1)))
+        except (NotFoundError, ValueError, TypeError):
+            return 1
+
+    def _megascale_env(self) -> Dict[str, str]:
+        """Best-effort MEGASCALE_* snapshot for the node-local rendering
+        (the authoritative, release-gated copy is computed by the CD
+        kubelet plugin at Prepare). Fields that aren't knowable yet are
+        simply omitted — this file never gates anything."""
+        from tpu_dra_driver.computedomain.plugin.device_state import (
+            MEGASCALE_PORT,
+        )
+        prefix = f"{self._config.cd_uid}."
+        cliques = sorted(
+            (o for o in self._clients.compute_domain_cliques.list(
+                namespace=DRIVER_NAMESPACE)
+             if o["metadata"]["name"].startswith(prefix)),
+            key=lambda o: o["metadata"]["name"])
+        env = {"MEGASCALE_NUM_SLICES": str(self._num_slices),
+               "MEGASCALE_PORT": str(MEGASCALE_PORT)}
+        clique_ids = [o["metadata"]["name"][len(prefix):] for o in cliques]
+        if self.clique_id in clique_ids:
+            env["MEGASCALE_SLICE_ID"] = str(clique_ids.index(self.clique_id))
+        if cliques:
+            from tpu_dra_driver.api.types import ComputeDomainClique
+            coord = ComputeDomainClique.from_obj(cliques[0])
+            c0 = next((d for d in coord.daemons
+                       if d.index == 0 and d.ip_address), None)
+            if c0 is not None:
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                    f"{c0.ip_address}:{MEGASCALE_PORT}")
+        return env
 
     # ------------------------------------------------------------------
     # readiness (the `compute-domain-daemon check` probe)

@@ -69,6 +69,9 @@ from tpu_dra_driver.tpulib.interface import TpuLib
 
 log = logging.getLogger(__name__)
 
+# DCN rendezvous port the megascale transport listens on (multislice CDs).
+MEGASCALE_PORT = 8080
+
 
 class RetryableError(Exception):
     """Transient prepare failure — kubelet/the retry envelope should retry
@@ -227,6 +230,8 @@ class CdDeviceState:
         topo = self._lib.host_topology()
         env["TPU_ACCELERATOR_TYPE"] = topo.accelerator_type
         env["TPU_TOPOLOGY"] = topo.topology_string
+        if cd.spec.num_slices > 1:
+            env.update(self._multislice_env(cd, node_status))
 
         # allocationMode=All: the claim still holds exactly one DRA channel
         # device, but every channel device node is injected (reference
@@ -304,15 +309,54 @@ class CdDeviceState:
         members = sorted((d for d in cq.daemons if d.index >= 0),
                          key=lambda d: d.index)
         # The workload must see the COMPLETE world: releasing with fewer
-        # members than spec.numNodes would start a distributed job with the
-        # wrong world size. Transient until everyone has joined.
-        if len(members) < cd.spec.num_nodes:
+        # members than expected would start a distributed job with the
+        # wrong world size. Transient until everyone has joined. For a
+        # multislice CD the per-clique world is numNodes/numSlices (the
+        # TPU_WORKER_* identity is slice-local; MEGASCALE_* spans slices).
+        expected = cd.spec.num_nodes // max(1, cd.spec.num_slices)
+        if len(members) < expected:
             raise RetryableError(
-                f"clique {clique_name}: {len(members)}/{cd.spec.num_nodes} "
+                f"clique {clique_name}: {len(members)}/{expected} "
                 f"daemons joined")
         return (node_status.index,
                 [d.ip_address for d in members],
                 [worker_name(d.index) for d in members])
+
+    def _multislice_env(self, cd: ComputeDomain, node_status) -> Dict[str, str]:
+        """MEGASCALE_* DCN bootstrap env for a multislice domain.
+
+        Slice ordering is the lexicographic order of clique names (stable
+        across nodes — every plugin derives the same ids with no extra
+        coordination); the coordinator is slice 0's index-0 worker.
+        Transient until every slice has a clique and the coordinator has
+        joined — releasing earlier would boot megascale with a wrong or
+        unreachable world.
+        """
+        prefix = f"{cd.metadata.uid}."
+        cliques = sorted(
+            (o for o in self._clients.compute_domain_cliques.list()
+             if o["metadata"]["name"].startswith(prefix)),
+            key=lambda o: o["metadata"]["name"])
+        if len(cliques) < cd.spec.num_slices:
+            raise RetryableError(
+                f"multislice {cd.metadata.name}: {len(cliques)}/"
+                f"{cd.spec.num_slices} slices have formed cliques")
+        clique_ids = [o["metadata"]["name"][len(prefix):] for o in cliques]
+        slice_id = clique_ids.index(node_status.clique_id)
+        coord = ComputeDomainClique.from_obj(cliques[0])
+        c0 = next((d for d in coord.daemons
+                   if d.index == 0 and d.ip_address), None)
+        if c0 is None:
+            raise RetryableError(
+                f"multislice {cd.metadata.name}: coordinator (slice 0 "
+                f"worker 0) not joined yet")
+        return {
+            "MEGASCALE_NUM_SLICES": str(cd.spec.num_slices),
+            "MEGASCALE_SLICE_ID": str(slice_id),
+            "MEGASCALE_COORDINATOR_ADDRESS":
+                f"{c0.ip_address}:{MEGASCALE_PORT}",
+            "MEGASCALE_PORT": str(MEGASCALE_PORT),
+        }
 
     # ------------------------------------------------------------------
     # daemon path

@@ -63,6 +63,7 @@ class ClusterHarness:
                  gates: Optional[fg.FeatureGates] = None,
                  prepare_budget: float = 45.0,
                  slice_id: Optional[str] = None,
+                 num_slices: int = 1,
                  controller_config: Optional[ControllerConfig] = None):
         self.clients = ClientSets()
         self.tmp = tmp_dir
@@ -79,11 +80,18 @@ class ClusterHarness:
 
         from tpu_dra_driver.tpulib.topology import SliceTopology
         topo = SliceTopology.from_accelerator_type(accelerator_type)
-        for h in range(topo.num_hosts):
+        # num_slices > 1: a multislice fleet — num_slices independent ICI
+        # slices (distinct slice ids → distinct cliques), each with the
+        # accelerator type's host count, DCN between them
+        for h in range(topo.num_hosts * num_slices):
             node = f"host-{h}"
+            s = h // topo.num_hosts
+            sid = (slice_id if num_slices == 1
+                   else f"{slice_id or 'slice'}-{s}")
             lib = FakeTpuLib(FakeSystemConfig(
-                accelerator_type=accelerator_type, host_index=h,
-                slice_id=slice_id))
+                accelerator_type=accelerator_type,
+                host_index=h % topo.num_hosts,
+                slice_id=sid))
             self.clients.nodes.create({"metadata": {"name": node}})
             hosts_dir = os.path.join(tmp_dir, node, "run-tpu-dra")
             os.makedirs(hosts_dir, exist_ok=True)
@@ -213,12 +221,13 @@ class ClusterHarness:
     # ------------------------------------------------------------------
 
     def create_compute_domain(self, name: str, namespace: str, num_nodes: int,
-                              rct_name: str) -> Dict:
+                              rct_name: str, num_slices: int = 1) -> Dict:
         return self.clients.compute_domains.create({
             "apiVersion": "resource.tpu.google.com/v1beta1",
             "kind": "ComputeDomain",
             "metadata": {"name": name, "namespace": namespace},
             "spec": {"numNodes": num_nodes,
+                     "numSlices": num_slices,
                      "channel": {"resourceClaimTemplate": {"name": rct_name},
                                  "allocationMode": "Single"}},
         })
