@@ -6,3 +6,8 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     nll_from_logits,
     make_train_step,
 )
+from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
+    decode_step,
+    generate,
+    init_kv_cache,
+)

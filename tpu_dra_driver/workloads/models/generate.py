@@ -1,0 +1,141 @@
+"""Autoregressive decoding with a KV cache for the flagship transformer.
+
+TPU-first inference path: the cache is a static-shape [b, h_kv, max_t, hd]
+ring per layer (no dynamic shapes under jit — a masked full-length
+attention read instead of a data-dependent slice), tokens step through
+``lax.scan``, and writes are ``lax.dynamic_update_slice`` at the traced
+position. GQA falls out for free: the cache holds h_kv heads and the
+query's head groups broadcast against it (ops.attention semantics).
+
+The reference driver has no inference surface at all; this is part of the
+validation-workload layer proving the chips the driver wired up
+(PARITY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra_driver.workloads.models.transformer import (
+    ModelConfig,
+    Params,
+    _rmsnorm,
+)
+
+NEG_INF = -1e30
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_t: int) -> Dict:
+    """Zeroed per-layer KV cache. h_kv = n_kv_heads or n_heads (GQA)."""
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    hd = cfg.d_model // cfg.n_heads
+    shape = (batch, n_kv, max_t, hd)
+    return {
+        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+    }
+
+
+def _decode_attention(q, k_cache, v_cache, pos):
+    """q: [b, h, 1, hd] against the full cache [b, h_kv, max_t, hd],
+    masked to positions <= pos. One fused masked softmax-weighted read —
+    the flash-decoding shape (t_q = 1) where XLA's fusion is already
+    optimal; no Pallas kernel needed."""
+    b, h, _, hd = q.shape
+    h_kv = k_cache.shape[1]
+    if h != h_kv:
+        k_cache = jnp.repeat(k_cache, h // h_kv, axis=1)
+        v_cache = jnp.repeat(v_cache, h // h_kv, axis=1)
+    s = jnp.einsum("bhqd,bhtd->bhqt", q, k_cache).astype(jnp.float32)
+    s = s / math.sqrt(hd)
+    max_t = k_cache.shape[2]
+    visible = jnp.arange(max_t) <= pos                     # [max_t]
+    s = jnp.where(visible[None, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqt,bhtd->bhqd", p, v_cache)
+
+
+def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
+                pos: jax.Array, token: jax.Array):
+    """One token step: token [b] int32 at position ``pos`` (traced scalar)
+    → (logits [b, vocab], updated cache)."""
+    b = token.shape[0]
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    hd = cfg.d_model // cfg.n_heads
+    kv_d = hd * n_kv
+
+    pos_emb = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos, 1, 0)
+    x = params["embed"][token][:, None, :] + pos_emb[None]   # [b, 1, d]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        xn = _rmsnorm(x, layer["ln1"]["g"])
+        qkv = xn @ layer["wqkv"]                             # [b,1,d+2kv_d]
+        q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_d], axis=-1)
+        q = q.reshape(b, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
+        k_cache = jax.lax.dynamic_update_slice(
+            cache["k"][li], k.astype(cache["k"][li].dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache["v"][li], v.astype(cache["v"][li].dtype), (0, 0, pos, 0))
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        att = _decode_attention(q, k_cache, v_cache, pos)
+        att = att.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+        x = x + att @ layer["wo"]
+
+        xn2 = _rmsnorm(x, layer["ln2"]["g"])
+        if "moe_up" in layer:
+            from tpu_dra_driver.workloads.models.transformer import _moe
+            x = x + _moe(xn2, layer)
+        else:
+            from tpu_dra_driver.workloads.models.transformer import _mlp
+            x = x + _mlp(xn2, layer)
+
+    x = _rmsnorm(x, params["final_norm"]["g"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)[:, 0]   # [b, vocab]
+    return logits, {"k": new_k, "v": new_v}
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"))
+def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
+             steps: int) -> jax.Array:
+    """Greedy generation: prompt [b, t0] int32 → [b, t0 + steps].
+
+    Prefill runs the prompt through decode steps under ``lax.scan``
+    (teacher-forced: cache fills, outputs discarded), then ``steps``
+    greedy tokens extend it. Everything static-shape, one compile.
+    """
+    b, t0 = prompt.shape
+    max_t = t0 + steps
+    if max_t > cfg.max_seq:
+        raise ValueError(f"t0+steps ({max_t}) exceeds max_seq {cfg.max_seq}")
+    cache = init_kv_cache(cfg, b, max_t)
+
+    def prefill_body(carry, tok):
+        cache, pos = carry
+        logits, cache = decode_step(params, cfg, cache, pos, tok)
+        return (cache, pos + 1), logits
+
+    (cache, pos), logits = jax.lax.scan(
+        prefill_body, (cache, jnp.int32(0)), prompt.T)   # scan over time
+
+    def gen_body(carry, _):
+        cache, pos, tok = carry
+        logits, cache = decode_step(params, cfg, cache, pos, tok)
+        nxt = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return (cache, pos + 1, nxt), nxt
+
+    first = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+    if steps == 1:
+        return jnp.concatenate([prompt, first[:, None]], axis=1)
+    (_, _, _), toks = jax.lax.scan(
+        gen_body, (cache, pos, first), None, length=steps - 1)
+    out = jnp.concatenate([first[:, None], toks.T], axis=1)
+    return jnp.concatenate([prompt, out], axis=1)

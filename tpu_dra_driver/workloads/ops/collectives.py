@@ -145,13 +145,21 @@ def main() -> None:
     env when running multi-host, then prints RESULT lines."""
     import os
 
-    if os.environ.get("TPU_WORKER_HOSTNAMES"):
+    hosts = [h for h in
+             os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    worker_id = os.environ.get("TPU_WORKER_ID")
+    if len(hosts) > 1 and worker_id is not None:
         import jax
 
-        # initialize() picks up JAX_COORDINATOR_ADDRESS itself when set;
-        # it must run either way or each pod only sees local devices and
-        # the bench silently degrades to single-host.
-        jax.distributed.initialize()
+        # Form the multi-host runtime from the driver-injected identity:
+        # coordinator = worker 0, world size = the hostname list. Without
+        # this each pod only sees local devices and the bench silently
+        # degrades to single-host.
+        port = os.environ.get("JAX_COORDINATOR_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{hosts[0]}:{port}",
+            num_processes=len(hosts),
+            process_id=int(worker_id))
     print(psum_bandwidth(), flush=True)
     print(all_gather_bandwidth(), flush=True)
 
