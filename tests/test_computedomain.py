@@ -622,3 +622,37 @@ def test_compute_domain_num_slices_validation():
     })
     with pytest.raises(ValueError, match="numSlices"):
         bad2.validate()
+
+
+def test_multislice_ignores_stale_empty_cliques():
+    """A departed slice leaves an empty clique shell (leave() removes
+    members, the object lives until CD teardown) — slice ordering and the
+    coordinator lookup must skip it rather than wedge or shift ids."""
+    from tpu_dra_driver.computedomain.multislice import (
+        MultisliceIncomplete, live_cliques, multislice_env,
+    )
+    from tpu_dra_driver.kube.client import ClientSets
+    clients = ClientSets()
+
+    def mk(name, daemons):
+        clients.compute_domain_cliques.create({
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomainClique",
+            "metadata": {"name": name, "namespace": DRIVER_NAMESPACE},
+            "daemons": daemons,
+        })
+    # stale shell sorts FIRST lexicographically — the dangerous case
+    mk("u1.aaa-stale", [])
+    mk("u1.bbb", [{"nodeName": "n0", "ipAddress": "10.0.0.1", "index": 0,
+                   "status": "Ready"}])
+    mk("u1.ccc", [{"nodeName": "n2", "ipAddress": "10.0.2.1", "index": 0,
+                   "status": "Ready"}])
+    assert [o["metadata"]["name"] for o in
+            live_cliques(clients.compute_domain_cliques, "u1")] == [
+                "u1.bbb", "u1.ccc"]
+    env = multislice_env(clients.compute_domain_cliques, "u1", 2, "ccc")
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith("10.0.0.1:")
+    # a node whose clique is outside the canonical set is not releasable
+    with pytest.raises(MultisliceIncomplete):
+        multislice_env(clients.compute_domain_cliques, "u1", 1, "ccc")

@@ -197,44 +197,41 @@ class ComputeDomainDaemon:
             json.dump(env, f, indent=1, sort_keys=True)
         os.replace(tmp, path)
 
-    def _cd_num_slices(self) -> int:
-        """numSlices from our ComputeDomain's spec (1 when unreadable —
-        the single-slice behavior is always safe)."""
-        try:
-            obj = self._clients.compute_domains.get(
-                self._config.cd_name, self._config.cd_namespace)
-            return max(1, int((obj.get("spec") or {}).get("numSlices", 1)))
-        except (NotFoundError, ValueError, TypeError):
-            return 1
+    def _cd_num_slices(self, attempts: int = 5, delay: float = 0.2) -> int:
+        """numSlices from our ComputeDomain's spec. Retries a transient
+        404 (API lag at daemon start) — silently caching 1 would strip a
+        multislice daemon of its wide clique watch for its whole life."""
+        import time as _time
+        for i in range(attempts):
+            try:
+                obj = self._clients.compute_domains.get(
+                    self._config.cd_name, self._config.cd_namespace)
+                return max(1, int((obj.get("spec") or {}).get("numSlices", 1)))
+            except NotFoundError:
+                if i + 1 < attempts:
+                    _time.sleep(delay)
+            except (ValueError, TypeError):
+                break
+        log.warning("could not read numSlices for cd %s/%s; assuming 1",
+                    self._config.cd_namespace, self._config.cd_name)
+        return 1
 
     def _megascale_env(self) -> Dict[str, str]:
         """Best-effort MEGASCALE_* snapshot for the node-local rendering
         (the authoritative, release-gated copy is computed by the CD
-        kubelet plugin at Prepare). Fields that aren't knowable yet are
-        simply omitted — this file never gates anything."""
-        from tpu_dra_driver.computedomain.plugin.device_state import (
-            MEGASCALE_PORT,
+        kubelet plugin at Prepare, via the same shared derivation). While
+        the cross-slice world is still forming only the static fields are
+        rendered — this file never gates anything."""
+        from tpu_dra_driver.computedomain.multislice import (
+            MEGASCALE_PORT, MultisliceIncomplete, multislice_env,
         )
-        prefix = f"{self._config.cd_uid}."
-        cliques = sorted(
-            (o for o in self._clients.compute_domain_cliques.list(
-                namespace=DRIVER_NAMESPACE)
-             if o["metadata"]["name"].startswith(prefix)),
-            key=lambda o: o["metadata"]["name"])
-        env = {"MEGASCALE_NUM_SLICES": str(self._num_slices),
-               "MEGASCALE_PORT": str(MEGASCALE_PORT)}
-        clique_ids = [o["metadata"]["name"][len(prefix):] for o in cliques]
-        if self.clique_id in clique_ids:
-            env["MEGASCALE_SLICE_ID"] = str(clique_ids.index(self.clique_id))
-        if cliques:
-            from tpu_dra_driver.api.types import ComputeDomainClique
-            coord = ComputeDomainClique.from_obj(cliques[0])
-            c0 = next((d for d in coord.daemons
-                       if d.index == 0 and d.ip_address), None)
-            if c0 is not None:
-                env["MEGASCALE_COORDINATOR_ADDRESS"] = (
-                    f"{c0.ip_address}:{MEGASCALE_PORT}")
-        return env
+        try:
+            return multislice_env(
+                self._clients.compute_domain_cliques, self._config.cd_uid,
+                self._num_slices, self.clique_id)
+        except MultisliceIncomplete:
+            return {"MEGASCALE_NUM_SLICES": str(self._num_slices),
+                    "MEGASCALE_PORT": str(MEGASCALE_PORT)}
 
     # ------------------------------------------------------------------
     # readiness (the `compute-domain-daemon check` probe)

@@ -1,0 +1,75 @@
+"""Multislice (DCN) bootstrap derivation — the single source of truth.
+
+Both the CD kubelet plugin (authoritative, release-gated, at Prepare) and
+the per-node daemon (best-effort worker-env rendering) derive the same
+facts from the ComputeDomain's cliques:
+
+- slice ordering: lexicographic over the *live* cliques' ids, so every
+  node computes identical slice ids with no extra coordination;
+- the coordinator: slice 0's index-0 worker.
+
+"Live" excludes empty cliques: a departed/replaced slice leaves its
+clique object behind with no members (``leave()`` removes entries, the
+object itself is only deleted at CD teardown), and counting such shells
+would wedge the coordinator lookup or shift slice ids.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tpu_dra_driver.api.types import ComputeDomainClique
+from tpu_dra_driver.computedomain import DRIVER_NAMESPACE
+
+# DCN rendezvous port the megascale transport listens on.
+MEGASCALE_PORT = 8080
+
+
+class MultisliceIncomplete(Exception):
+    """The cross-slice world cannot be derived yet — transient; callers
+    gating workload release map this to their retry mechanism."""
+
+
+def live_cliques(cliques_client, cd_uid: str) -> List[Dict]:
+    """The CD's cliques that have at least one indexed member, in slice
+    order (lexicographic by clique name)."""
+    prefix = f"{cd_uid}."
+    out = [o for o in cliques_client.list(namespace=DRIVER_NAMESPACE)
+           if o["metadata"]["name"].startswith(prefix)
+           and any((d.get("index", -1)) >= 0 for d in o.get("daemons") or [])]
+    out.sort(key=lambda o: o["metadata"]["name"])
+    return out
+
+
+def multislice_env(cliques_client, cd_uid: str, num_slices: int,
+                   own_clique_id: str) -> Dict[str, str]:
+    """MEGASCALE_* env for one worker, or raises MultisliceIncomplete.
+
+    With more live cliques than numSlices (should not persist — the
+    controller prunes dead members and empty shells are ignored), the
+    first numSlices in slice order are canonical; a node whose clique
+    is outside that set is not releasable.
+    """
+    cliques = live_cliques(cliques_client, cd_uid)
+    if len(cliques) < num_slices:
+        raise MultisliceIncomplete(
+            f"{len(cliques)}/{num_slices} slices have formed cliques")
+    prefix = f"{cd_uid}."
+    clique_ids = [o["metadata"]["name"][len(prefix):]
+                  for o in cliques[:num_slices]]
+    if own_clique_id not in clique_ids:
+        raise MultisliceIncomplete(
+            f"own clique {own_clique_id!r} not among the {num_slices} "
+            f"canonical slices {clique_ids}")
+    coord = ComputeDomainClique.from_obj(cliques[0])
+    c0 = next((d for d in coord.daemons
+               if d.index == 0 and d.ip_address), None)
+    if c0 is None:
+        raise MultisliceIncomplete(
+            "coordinator (slice 0 worker 0) not joined yet")
+    return {
+        "MEGASCALE_NUM_SLICES": str(num_slices),
+        "MEGASCALE_SLICE_ID": str(clique_ids.index(own_clique_id)),
+        "MEGASCALE_COORDINATOR_ADDRESS": f"{c0.ip_address}:{MEGASCALE_PORT}",
+        "MEGASCALE_PORT": str(MEGASCALE_PORT),
+    }

@@ -69,9 +69,6 @@ from tpu_dra_driver.tpulib.interface import TpuLib
 
 log = logging.getLogger(__name__)
 
-# DCN rendezvous port the megascale transport listens on (multislice CDs).
-MEGASCALE_PORT = 8080
-
 
 class RetryableError(Exception):
     """Transient prepare failure — kubelet/the retry envelope should retry
@@ -323,40 +320,20 @@ class CdDeviceState:
                 [worker_name(d.index) for d in members])
 
     def _multislice_env(self, cd: ComputeDomain, node_status) -> Dict[str, str]:
-        """MEGASCALE_* DCN bootstrap env for a multislice domain.
-
-        Slice ordering is the lexicographic order of clique names (stable
-        across nodes — every plugin derives the same ids with no extra
-        coordination); the coordinator is slice 0's index-0 worker.
-        Transient until every slice has a clique and the coordinator has
-        joined — releasing earlier would boot megascale with a wrong or
-        unreachable world.
-        """
-        prefix = f"{cd.metadata.uid}."
-        cliques = sorted(
-            (o for o in self._clients.compute_domain_cliques.list()
-             if o["metadata"]["name"].startswith(prefix)),
-            key=lambda o: o["metadata"]["name"])
-        if len(cliques) < cd.spec.num_slices:
+        """MEGASCALE_* DCN bootstrap env (shared derivation:
+        computedomain.multislice). Transient until every slice has a live
+        clique and the coordinator has joined — releasing earlier would
+        boot megascale with a wrong or unreachable world."""
+        from tpu_dra_driver.computedomain.multislice import (
+            MultisliceIncomplete, multislice_env,
+        )
+        try:
+            return multislice_env(
+                self._clients.compute_domain_cliques, cd.metadata.uid,
+                cd.spec.num_slices, node_status.clique_id)
+        except MultisliceIncomplete as e:
             raise RetryableError(
-                f"multislice {cd.metadata.name}: {len(cliques)}/"
-                f"{cd.spec.num_slices} slices have formed cliques")
-        clique_ids = [o["metadata"]["name"][len(prefix):] for o in cliques]
-        slice_id = clique_ids.index(node_status.clique_id)
-        coord = ComputeDomainClique.from_obj(cliques[0])
-        c0 = next((d for d in coord.daemons
-                   if d.index == 0 and d.ip_address), None)
-        if c0 is None:
-            raise RetryableError(
-                f"multislice {cd.metadata.name}: coordinator (slice 0 "
-                f"worker 0) not joined yet")
-        return {
-            "MEGASCALE_NUM_SLICES": str(cd.spec.num_slices),
-            "MEGASCALE_SLICE_ID": str(slice_id),
-            "MEGASCALE_COORDINATOR_ADDRESS":
-                f"{c0.ip_address}:{MEGASCALE_PORT}",
-            "MEGASCALE_PORT": str(MEGASCALE_PORT),
-        }
+                f"multislice {cd.metadata.name}: {e}") from e
 
     # ------------------------------------------------------------------
     # daemon path

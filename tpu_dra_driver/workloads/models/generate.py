@@ -113,6 +113,8 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
     greedy tokens extend it. Everything static-shape, one compile.
     """
     b, t0 = prompt.shape
+    if steps <= 0:
+        return prompt
     max_t = t0 + steps
     if max_t > cfg.max_seq:
         raise ValueError(f"t0+steps ({max_t}) exceeds max_seq {cfg.max_seq}")

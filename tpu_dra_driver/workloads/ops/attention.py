@@ -616,9 +616,11 @@ flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 def merge_partials(o1: jax.Array, lse1: jax.Array,
                    o2: jax.Array, lse2: jax.Array):
     """Exactly combine two partial-attention results over disjoint KV
-    sets. o: [b, h, t, d] (any float dtype, merged in f32), lse: [b, h, t]
-    natural log. Associative; a fully-masked partial (lse = -inf)
-    contributes zero weight."""
+    sets. o: [b, h, t, d] (any float dtype), lse: [b, h, t] natural log.
+    Associative; a fully-masked partial (lse = -inf) contributes zero
+    weight. The merged output is returned in f32 — chained merges (ring
+    attention) must accumulate at full precision, with one cast at the
+    very end; callers cast down themselves."""
     m = jnp.maximum(lse1, lse2)
     w1 = jnp.exp(lse1 - m)
     w2 = jnp.exp(lse2 - m)
@@ -626,7 +628,7 @@ def merge_partials(o1: jax.Array, lse1: jax.Array,
     lse = m + jnp.log(denom)
     out = (o1.astype(jnp.float32) * (w1 / denom)[..., None]
            + o2.astype(jnp.float32) * (w2 / denom)[..., None])
-    return out.astype(o1.dtype), lse
+    return out, lse
 
 
 def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,

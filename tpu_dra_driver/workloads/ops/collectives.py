@@ -148,18 +148,30 @@ def main() -> None:
     hosts = [h for h in
              os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
     worker_id = os.environ.get("TPU_WORKER_ID")
-    if len(hosts) > 1 and worker_id is not None:
+    num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
+    slice_id = int(os.environ.get("MEGASCALE_SLICE_ID", "0"))
+    world = len(hosts) * num_slices
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        import jax
+        jax.distributed.initialize()   # fully caller-specified
+    elif world > 1 and worker_id is not None:
         import jax
 
-        # Form the multi-host runtime from the driver-injected identity:
-        # coordinator = worker 0, world size = the hostname list. Without
-        # this each pod only sees local devices and the bench silently
-        # degrades to single-host.
+        # Form the multi-host runtime from the driver-injected identity.
+        # Single slice: coordinator = worker 0 of the hostname list.
+        # Multislice: the driver's MEGASCALE_* env defines the global
+        # world — process id = slice_id * hosts_per_slice + worker_id,
+        # coordinator = MEGASCALE_COORDINATOR_ADDRESS (slice 0 worker 0).
+        # Without this each pod only sees local devices and the bench
+        # silently degrades to single-host (or slice-local) scope.
         port = os.environ.get("JAX_COORDINATOR_PORT", "8476")
+        coord = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        if coord is not None and ":" in coord:
+            coord = f"{coord.rsplit(':', 1)[0]}:{port}"
         jax.distributed.initialize(
-            coordinator_address=f"{hosts[0]}:{port}",
-            num_processes=len(hosts),
-            process_id=int(worker_id))
+            coordinator_address=coord or f"{hosts[0]}:{port}",
+            num_processes=world,
+            process_id=slice_id * len(hosts) + int(worker_id))
     print(psum_bandwidth(), flush=True)
     print(all_gather_bandwidth(), flush=True)
 
