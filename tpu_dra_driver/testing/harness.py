@@ -204,8 +204,14 @@ class ClusterHarness:
                 except AlreadyExistsError:
                     pass
                 host = self.hosts[host_idx]
+                cd_name = cd_ns = ""
+                for cd_obj in self.clients.compute_domains.list():
+                    if cd_obj["metadata"].get("uid") == cd_uid:
+                        cd_name = cd_obj["metadata"]["name"]
+                        cd_ns = cd_obj["metadata"].get("namespace", "")
+                        break
                 daemon = ComputeDomainDaemon(self.clients, host.lib, DaemonConfig(
-                    cd_uid=cd_uid, cd_name="", cd_namespace="",
+                    cd_uid=cd_uid, cd_name=cd_name, cd_namespace=cd_ns,
                     node_name=node_name, pod_name=pod_name, pod_ip=pod_ip,
                     # per-CD scoping, mirroring cmd/compute_domain_daemon
                     # cd_run_dir: the run dir hostPath is node-shared
