@@ -91,12 +91,16 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
         x = x + att @ layer["wo"]
 
         xn2 = _rmsnorm(x, layer["ln2"]["g"])
-        if "moe_up" in layer:
-            from tpu_dra_driver.workloads.models.transformer import _moe
-            x = x + _moe(xn2, layer)
-        else:
-            from tpu_dra_driver.workloads.models.transformer import _mlp
+        from tpu_dra_driver.workloads.models.transformer import (
+            _mlp, _moe, _moe_topk,
+        )
+        if "moe_up" not in layer:
             x = x + _mlp(xn2, layer)
+        elif cfg.moe_top_k > 0:
+            x = x + _moe_topk(xn2, layer, cfg.moe_top_k,
+                              cfg.moe_capacity_factor)
+        else:
+            x = x + _moe(xn2, layer)
 
     x = _rmsnorm(x, params["final_norm"]["g"])
     logits = (x @ params["embed"].T).astype(jnp.float32)[:, 0]   # [b, vocab]

@@ -42,6 +42,13 @@ class ModelConfig:
     # mixture of experts (all experts computed, gate-weighted — static
     # shapes, XLA-friendly; expert dim shards over the mesh's ep axis)
     n_experts: int = 0
+    # moe_top_k > 0 switches the MoE to sparse top-k routing with a
+    # capacity-bounded dispatch/combine (GShard/Switch formulation):
+    # FLOPs drop from all-experts to ~top_k/n_experts of dense, tokens
+    # over capacity are dropped (residual passes them through). Static
+    # shapes throughout — top_k, cumsum, one-hot einsums only.
+    moe_top_k: int = 0
+    moe_capacity_factor: float = 1.25
 
 
 Params = Dict
@@ -131,6 +138,49 @@ def _moe(x: jax.Array, layer: Params) -> jax.Array:
     return jnp.einsum("bte,betd->btd", gates.astype(x.dtype), down)
 
 
+def _moe_topk(x: jax.Array, layer: Params, top_k: int,
+              capacity_factor: float) -> jax.Array:
+    """Sparse top-k MoE with capacity (GShard/Switch dispatch-combine).
+
+    TPU-first: everything is static-shape one-hot algebra the compiler
+    turns into dense einsums — ``lax.top_k`` routing, a cumsum position
+    within each expert, capacity-masked dispatch [b,t,E,C], expert FFN on
+    the gathered [b,E,C,d] block (MXU-friendly: C is a fixed tile), and a
+    weighted combine. Tokens past an expert's capacity are dropped (their
+    contribution is zero; the transformer's residual carries them). The
+    expert axis shards over the mesh ``ep`` axis exactly like the dense
+    path — XLA inserts the ep collectives at the dispatch/combine einsums.
+    """
+    b, t, d = x.shape
+    n_e = layer["router"].shape[-1]
+    capacity = max(1, int(capacity_factor * top_k * t / n_e))
+
+    logits = (x @ layer["router"]).astype(jnp.float32)        # [b,t,E]
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)          # [b,t,k]
+    weights = jax.nn.softmax(top_vals, axis=-1)               # renormalized
+    # one-hot expert assignment per routing slot
+    assign = jax.nn.one_hot(top_idx, n_e, dtype=jnp.float32)  # [b,t,k,E]
+    # position of each (token, slot) within its expert's queue: rank
+    # slots in (t, k) order with an exclusive cumsum per expert
+    flat = assign.reshape(b, t * top_k, n_e)
+    pos = jnp.cumsum(flat, axis=1) - flat                      # exclusive
+    pos = pos.reshape(b, t, top_k, n_e)
+    within = (pos < capacity) * assign                         # keep mask
+    pos_oh = jax.nn.one_hot(
+        jnp.sum(pos * assign, axis=-1).astype(jnp.int32),      # [b,t,k]
+        capacity, dtype=jnp.float32)                           # [b,t,k,C]
+    # dispatch [b,t,E,C]: does token t go to expert e at slot c?
+    dispatch = jnp.einsum("btke,btkc->btec", within, pos_oh)
+    # combine = dispatch weighted by the (kept) gate weights
+    combine = jnp.einsum("btke,btk,btkc->btec", within, weights, pos_oh)
+
+    xin = jnp.einsum("btec,btd->becd", dispatch.astype(x.dtype), x)
+    up = jnp.einsum("becd,edf->becf", xin, layer["moe_up"])
+    act = jax.nn.gelu(up)
+    out = jnp.einsum("becf,efd->becd", act, layer["moe_down"])
+    return jnp.einsum("btec,becd->btd", combine.astype(x.dtype), out)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             attn_fn=None) -> jax.Array:
     """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
@@ -139,8 +189,14 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     for layer in params["layers"]:
         x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
                            cfg.n_heads, cfg.n_kv_heads, attn_fn)
-        ffn = _moe if "moe_up" in layer else _mlp
-        x = x + ffn(_rmsnorm(x, layer["ln2"]["g"]), layer)
+        xn2 = _rmsnorm(x, layer["ln2"]["g"])
+        if "moe_up" not in layer:
+            x = x + _mlp(xn2, layer)
+        elif cfg.moe_top_k > 0:
+            x = x + _moe_topk(xn2, layer, cfg.moe_top_k,
+                              cfg.moe_capacity_factor)
+        else:
+            x = x + _moe(xn2, layer)
     x = _rmsnorm(x, params["final_norm"]["g"])
     return (x @ params["embed"].T).astype(jnp.float32)
 
