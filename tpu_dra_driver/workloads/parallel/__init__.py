@@ -4,6 +4,7 @@ from tpu_dra_driver.workloads.parallel.mesh import (  # noqa: F401
     batch_sharding,
     replicated,
     param_shardings,
+    zero1_opt_shardings,
 )
 from tpu_dra_driver.workloads.parallel.ringattention import (  # noqa: F401
     make_ring_attention,
