@@ -228,3 +228,64 @@ def test_moe_topk_model_trains():
         params, opt_state, loss = step(params, opt_state, (tokens, tokens))
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_rope_model_trains_and_decodes_consistently():
+    """RoPE (no learned pos table): training works, and KV-cache decode —
+    where the rotation angle comes from a traced cache position — must
+    reproduce the full-context forward logits exactly."""
+    from tpu_dra_driver.workloads.models import (
+        ModelConfig, forward, init_params, make_train_step,
+    )
+    from tpu_dra_driver.workloads.models.generate import (
+        decode_step, init_kv_cache,
+    )
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=16, dtype=jnp.float32,
+                      use_rope=True)
+    key = jax.random.PRNGKey(7)
+    params = init_params(cfg, key)
+    assert "pos_embed" not in params
+    tokens = jax.random.randint(key, (2, 12), 0, cfg.vocab)
+
+    train_step, opt_init = make_train_step(cfg)
+    opt_state = opt_init(params)
+    step = jax.jit(train_step)
+    losses = []
+    p = params
+    for _ in range(6):
+        p, opt_state, loss = step(p, opt_state, (tokens, tokens))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    full = forward(params, tokens, cfg)
+    cache = init_kv_cache(cfg, 2, 12)
+    dstep = jax.jit(lambda c, p_, t: decode_step(params, cfg, c, p_, t))
+    for t in range(12):
+        logits, cache = dstep(cache, jnp.int32(t), tokens[:, t])
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, t]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_remat_identical_loss_and_grads():
+    """jax.checkpoint per block must not change numerics — only where
+    activations live."""
+    from tpu_dra_driver.workloads.models import (
+        ModelConfig, init_params, loss_fn,
+    )
+    import dataclasses
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=64, max_seq=32, dtype=jnp.float32)
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    key = jax.random.PRNGKey(8)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (2, 32), 0, cfg.vocab)
+    batch = (tokens, tokens)
+    l0, g0 = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg_r))(params)
+    assert float(l0) == pytest.approx(float(l1), abs=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)

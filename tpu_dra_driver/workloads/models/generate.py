@@ -69,8 +69,10 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
     hd = cfg.d_model // cfg.n_heads
     kv_d = hd * n_kv
 
-    pos_emb = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos, 1, 0)
-    x = params["embed"][token][:, None, :] + pos_emb[None]   # [b, 1, d]
+    x = params["embed"][token][:, None, :]                   # [b, 1, d]
+    if not cfg.use_rope:
+        pos_emb = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos, 1, 0)
+        x = x + pos_emb[None]
 
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
@@ -80,6 +82,10 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
         q = q.reshape(b, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
+        if cfg.use_rope:
+            from tpu_dra_driver.workloads.models.transformer import apply_rope
+            q = apply_rope(q, pos0=pos)
+            k = apply_rope(k, pos0=pos)
         k_cache = jax.lax.dynamic_update_slice(
             cache["k"][li], k.astype(cache["k"][li].dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(

@@ -49,6 +49,15 @@ class ModelConfig:
     # shapes throughout — top_k, cumsum, one-hot einsums only.
     moe_top_k: int = 0
     moe_capacity_factor: float = 1.25
+    # Rotary position embeddings instead of the learned pos_embed table
+    # (relative positions encoded in q/k phase — no max_seq-bound table,
+    # the modern default). Split-half rotation (llama convention): lane-
+    # friendly on the VPU, no interleaved stride-2 gathers.
+    use_rope: bool = False
+    # jax.checkpoint each block: activations are recomputed in the
+    # backward instead of living in HBM across the whole forward — the
+    # standard TPU memory/FLOPs trade for deep or long-context models.
+    remat: bool = False
 
 
 Params = Dict
@@ -64,10 +73,11 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 
     params: Params = {
         "embed": mat(next(k), (cfg.vocab, cfg.d_model)),
-        "pos_embed": mat(next(k), (cfg.max_seq, cfg.d_model)),
         "layers": [],
         "final_norm": {"g": jnp.ones((cfg.d_model,), jnp.float32)},
     }
+    if not cfg.use_rope:
+        params["pos_embed"] = mat(next(k), (cfg.max_seq, cfg.d_model))
     n_kv = cfg.n_kv_heads or cfg.n_heads
     kv_d = cfg.d_model * n_kv // cfg.n_heads
     for _ in range(cfg.n_layers):
@@ -96,8 +106,24 @@ def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
     return ((x32 * rms) * g).astype(x.dtype)
 
 
+def apply_rope(x: jax.Array, pos0=0, theta: float = 10000.0) -> jax.Array:
+    """Rotary embedding on [b, h, t, hd] (split-half rotation). ``pos0``
+    may be a traced scalar (decode: the cache position)."""
+    b, h, t, hd = x.shape
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, hd // 2, dtype=jnp.float32)
+                                / (hd // 2)))
+    ang = (pos0 + jnp.arange(t, dtype=jnp.float32))[:, None] * inv_freq
+    cos = jnp.cos(ang)[None, None]                       # [1,1,t,hd/2]
+    sin = jnp.sin(ang)[None, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def _attention(x: jax.Array, layer: Params, n_heads: int,
-               n_kv_heads: int = 0, attn_fn=None) -> jax.Array:
+               n_kv_heads: int = 0, attn_fn=None,
+               use_rope: bool = False) -> jax.Array:
     """``attn_fn(q, k, v) -> out`` on [b, h, t, hd] tensors; plug point
     for flash_attention / ring_attention / ulysses_attention. Default is
     the shared causal oracle (ops.attention.attention_reference). With
@@ -112,8 +138,10 @@ def _attention(x: jax.Array, layer: Params, n_heads: int,
     def heads(z, nh):
         return z.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
 
-    out = (attn_fn or attention_reference)(
-        heads(q, n_heads), heads(k, n_kv), heads(v, n_kv))
+    qh, kh = heads(q, n_heads), heads(k, n_kv)
+    if use_rope:
+        qh, kh = apply_rope(qh), apply_rope(kh)
+    out = (attn_fn or attention_reference)(qh, kh, heads(v, n_kv))
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
     return out @ layer["wo"]
 
@@ -185,18 +213,26 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             attn_fn=None) -> jax.Array:
     """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
     b, t = tokens.shape
-    x = params["embed"][tokens] + params["pos_embed"][:t]
-    for layer in params["layers"]:
+    x = params["embed"][tokens]
+    if not cfg.use_rope:
+        x = x + params["pos_embed"][:t]
+
+    def block(x, layer):
         x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
-                           cfg.n_heads, cfg.n_kv_heads, attn_fn)
+                           cfg.n_heads, cfg.n_kv_heads, attn_fn,
+                           use_rope=cfg.use_rope)
         xn2 = _rmsnorm(x, layer["ln2"]["g"])
         if "moe_up" not in layer:
-            x = x + _mlp(xn2, layer)
-        elif cfg.moe_top_k > 0:
-            x = x + _moe_topk(xn2, layer, cfg.moe_top_k,
-                              cfg.moe_capacity_factor)
-        else:
-            x = x + _moe(xn2, layer)
+            return x + _mlp(xn2, layer)
+        if cfg.moe_top_k > 0:
+            return x + _moe_topk(xn2, layer, cfg.moe_top_k,
+                                 cfg.moe_capacity_factor)
+        return x + _moe(xn2, layer)
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    for layer in params["layers"]:
+        x = block(x, layer)
     x = _rmsnorm(x, params["final_norm"]["g"])
     return (x @ params["embed"].T).astype(jnp.float32)
 
