@@ -117,6 +117,80 @@ def test_flash_asymmetric_blocks(block_q, block_kv):
                                    atol=5e-3, rtol=5e-3)
 
 
+@pytest.mark.parametrize("window", [1, 7, 64, 100, 256])
+def test_flash_sliding_window_matches_reference(window):
+    """Sliding-window attention: windows smaller than / equal to / larger
+    than the block size, aligned and unaligned, incl. window >= t (which
+    must degenerate to plain causal)."""
+    q, k, v = _qkv(jax.random.PRNGKey(20), t=256)
+    ref = attention_reference(q, k, v, True, window=window)
+    out = flash_attention(q, k, v, True, 64, 64, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [32, 100])
+def test_flash_sliding_window_gradients(window):
+    q, k, v = _qkv(jax.random.PRNGKey(21), t=256, d=32)
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(
+            q, k, v, True, 64, 64, window=window) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (attention_reference(
+            q, k, v, True, window=window) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_flash_sliding_window_multi_superblock(monkeypatch):
+    """Windowed loop bounds interact with the superblock walk: shrink
+    _SUPER_KV so superblocks both fully inside, straddling, and fully
+    outside the band all occur."""
+    import tpu_dra_driver.workloads.ops.attention as A
+    q, k, v = _qkv(jax.random.PRNGKey(22), t=256, d=32)
+    ref = attention_reference(q, k, v, True, window=80)
+    monkeypatch.setattr(A, "_SUPER_KV", 64)
+    out = flash_attention(q, k, v, True, 64, 32, window=80)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, True, 64, 32, window=80) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (attention_reference(
+        q, k, v, True, window=80) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_flash_sliding_window_locality():
+    """Perturbing K/V older than the window must not change the output
+    for rows whose band excludes them (fwd AND dq)."""
+    q, k, v = _qkv(jax.random.PRNGKey(23), t=256)
+    w = 64
+    base = flash_attention(q, k, v, True, 64, 64, window=w)
+    # rows >= 192 only see cols (r-64, r] ⊂ [129, 255]; clobber cols < 128
+    k2 = k.at[:, :, :128, :].set(37.0)
+    v2 = v.at[:, :, :128, :].set(-37.0)
+    pert = flash_attention(q, k2, v2, True, 64, 64, window=w)
+    np.testing.assert_allclose(np.asarray(base[:, :, 192:]),
+                               np.asarray(pert[:, :, 192:]), atol=1e-6)
+    assert not np.allclose(np.asarray(base[:, :, :128]),
+                           np.asarray(pert[:, :, :128]))
+
+
+def test_flash_sliding_window_rejects_noncausal():
+    q, k, v = _qkv(jax.random.PRNGKey(24), t=64)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, False, window=16)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, True, window=0)
+
+
 def test_flash_causality_ignores_future():
     """Perturbing K/V beyond position p must not change output[:p+1]."""
     q, k, v = _qkv(jax.random.PRNGKey(3), t=128)

@@ -48,9 +48,14 @@ LOG2E = 1.4426950408889634
 
 
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
-                        causal: bool = True) -> jax.Array:
+                        causal: bool = True,
+                        window: Optional[int] = None) -> jax.Array:
     """Oracle attention. q: [b, h, t, d], k/v: [b, h_kv, t, d] with
-    h % h_kv == 0 (GQA/MQA: kv heads broadcast over query groups)."""
+    h % h_kv == 0 (GQA/MQA: kv heads broadcast over query groups).
+    ``window`` (causal only): row r sees cols (r-window, r] — sliding-
+    window / local attention."""
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     *_, t, d = q.shape
     h, h_kv = q.shape[1], k.shape[1]
     if h != h_kv:
@@ -60,6 +65,9 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     scores = scores / math.sqrt(d)
     if causal:
         mask = jnp.tril(jnp.ones((t, t), bool))
+        if window is not None:
+            rows = jnp.arange(t)[:, None]
+            mask = mask & (rows - jnp.arange(t)[None, :] < window)
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
@@ -67,7 +75,7 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                   block_q: int, block_kv: int, causal: bool, sm_scale: float,
-                  num_super: int):
+                  num_super: int, window=None):
     """One (batch*kv-head, q-group, q-block, kv-superblock) grid cell.
 
     GQA: the grid's axis 1 walks the query heads sharing this cell's KV
@@ -110,15 +118,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
             s = jax.lax.dot_general(                             # [bq, bkv]
                 q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+            vis = None
             if masked:
                 row_ids = qi * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 0)
                 col_ids = (sj * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (block_q, block_kv), 1))
-                s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+                vis = row_ids >= col_ids
+                if window is not None:
+                    vis &= row_ids - col_ids < window
+                s = jnp.where(vis, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp2(s - m_new)
+            if masked and window is not None:
+                # a row with NO visible entry in its first processed
+                # block has m == m_new == NEG_INF and exp2(0) == 1 for
+                # every (masked!) entry — zero them explicitly so such
+                # rows contribute nothing (reachable with small windows;
+                # without a window every row's first block has a visible
+                # column, so plain causal skips this select)
+                p = jnp.where(vis, p, 0.0)
             alpha = jnp.exp2(m - m_new)
             l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             pv = jax.lax.dot_general(                            # [bq, d]
@@ -134,11 +154,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
         upper = jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
         # blocks wholly below the diagonal (every col <= every row)
         row_min = qi * block_q
-        n_full = jnp.clip((row_min - sj * super_kv + 1) // block_kv, 0, upper)
+        n_full_hi = jnp.clip((row_min - sj * super_kv + 1) // block_kv,
+                             0, upper)
+        if window is None:
+            lower = 0
+            full_lo = 0
+        else:
+            # sliding window: visible cols for this q block span
+            # [row_min - window + 1, row_max]; blocks straddling the
+            # window's left edge get the mask too, fully-aged blocks are
+            # skipped outright
+            lo_col = row_min - window + 1
+            lower = jnp.clip((lo_col - sj * super_kv) // block_kv, 0, upper)
+            full_lo = jnp.clip(
+                -(-(row_max - window + 1 - sj * super_kv) // block_kv),
+                lower, n_full_hi)
         carry = jax.lax.fori_loop(
-            0, n_full, functools.partial(body, masked=False), carry)
+            lower, full_lo, functools.partial(body, masked=True), carry)
+        carry = jax.lax.fori_loop(
+            full_lo, n_full_hi, functools.partial(body, masked=False), carry)
         return jax.lax.fori_loop(
-            n_full, upper, functools.partial(body, masked=True), carry)
+            n_full_hi, upper, functools.partial(body, masked=True), carry)
 
     def finish(carry):
         acc, m, l = carry
@@ -152,6 +188,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                      jnp.zeros((block_q, 1), jnp.float32))
 
     live = True if not causal else (sj * super_kv <= row_max)
+    if causal and window is not None:
+        live &= (sj * super_kv + super_kv - 1
+                 >= qi * block_q - window + 1)
     _grid_accumulate(num_super, sj, live, steps, finish,
                      (acc_sc, m_sc, l_sc), zeros)
 
@@ -241,7 +280,7 @@ def _gqa_group(q, k):
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
-                   interpret: bool):
+                   interpret: bool, window=None):
     """Returns (out [b,h,t,d], lse [b*h, 1, t] f32). k/v may carry fewer
     (grouped/multi-query) heads than q, and a different sequence length
     (KV chunks, cross-attention, decode) when non-causal."""
@@ -251,6 +290,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
         raise ValueError(
             f"causal flash attention needs t_q == t_kv (got {t} vs {tkv}); "
             f"chunked-causal belongs to the caller (see ring_attention)")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     h_kv, group = _gqa_group(q, k)
     super_kv = _fit_block(_SUPER_KV, tkv)
     block_q = _fit_block(block_q, t)
@@ -265,7 +306,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     grid = (b * h_kv, group, t // block_q, num_super)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_kv=block_kv,
-        causal=causal, sm_scale=sm_scale, num_super=num_super)
+        causal=causal, sm_scale=sm_scale, num_super=num_super,
+        window=window)
 
     vmem = {"memory_space": pltpu.VMEM}
 
@@ -299,7 +341,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
 
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                          dq_ref, acc_sc, *, block_q: int, block_kv: int,
-                         causal: bool, sm_scale: float, num_super: int):
+                         causal: bool, sm_scale: float, num_super: int,
+                         window=None):
     """dq for one (batch*kv-head, q-group, q-block, kv-superblock) cell.
 
     P is rebuilt from (q, k, lse); dS = P * (dP - D); dq = sum_j dS @ K_j
@@ -331,7 +374,10 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                 col_ids = (sj * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (block_q, block_kv), 1))
-                s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+                vis = row_ids >= col_ids
+                if window is not None:
+                    vis &= row_ids - col_ids < window
+                s = jnp.where(vis, s, NEG_INF)
             p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dp = jax.lax.dot_general(                            # dO @ V^T
                 do_ref[:], vb, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -346,12 +392,24 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), acc0)
         upper = jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
-        n_full = jnp.clip(
-            (qi * block_q - sj * super_kv + 1) // block_kv, 0, upper)
+        row_min = qi * block_q
+        n_full_hi = jnp.clip(
+            (row_min - sj * super_kv + 1) // block_kv, 0, upper)
+        if window is None:
+            lower = 0
+            full_lo = 0
+        else:
+            lo_col = row_min - window + 1
+            lower = jnp.clip((lo_col - sj * super_kv) // block_kv, 0, upper)
+            full_lo = jnp.clip(
+                -(-(row_max - window + 1 - sj * super_kv) // block_kv),
+                lower, n_full_hi)
         acc0 = jax.lax.fori_loop(
-            0, n_full, functools.partial(body, masked=False), acc0)
+            lower, full_lo, functools.partial(body, masked=True), acc0)
+        acc0 = jax.lax.fori_loop(
+            full_lo, n_full_hi, functools.partial(body, masked=False), acc0)
         return jax.lax.fori_loop(
-            n_full, upper, functools.partial(body, masked=True), acc0)
+            n_full_hi, upper, functools.partial(body, masked=True), acc0)
 
     d = q_ref.shape[1]
 
@@ -359,6 +417,9 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
         dq_ref[:] = carry[0].astype(dq_ref.dtype)
 
     live = True if not causal else (sj * super_kv <= row_max)
+    if causal and window is not None:
+        live &= (sj * super_kv + super_kv - 1
+                 >= qi * block_q - window + 1)
     _grid_accumulate(
         num_super, sj, live,
         steps=lambda carry: (steps(carry[0]),),
@@ -370,7 +431,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                           dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
                           block_kv: int, causal: bool, sm_scale: float,
-                          num_super: int, group: int):
+                          num_super: int, group: int, window=None):
     """dk/dv for one (batch*kv-head, kv-block, q-group, q-superblock) cell.
 
     dv = sum_i P_i^T @ dO_i; dk = sum_i dS_i^T @ Q_i * scale. The q axis
@@ -406,7 +467,10 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                                jnp.int32, (block_q, block_kv), 0))
                 col_ids = kv_start + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 1)
-                s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+                vis = row_ids >= col_ids
+                if window is not None:
+                    vis &= row_ids - col_ids < window
+                s = jnp.where(vis, s, NEG_INF)
             p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dv_acc = dv_acc + jax.lax.dot_general(               # P^T @ dO
                 p.astype(dob.dtype), dob,
@@ -425,16 +489,30 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
         if not causal:
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), carry)
-        # masked rows straddle the diagonal; rows are mask-free once
-        # every row >= this block's last column
+        # masked rows straddle the diagonal (and, windowed, the far edge
+        # where rows age out of every column's window); a row block is
+        # mask-free iff every row >= this kv block's last column and,
+        # with a window, every row < first column + window
         lower = jnp.maximum(0, (kv_start - si * super_q) // block_q)
         first_full = jnp.clip(
             -(-(kv_start + block_kv - 1 - si * super_q) // block_q),
             lower, nb)
+        if window is None:
+            upper = nb
+            full_end = nb
+        else:
+            hi_row = kv_start + block_kv - 1 + window - 1   # last seeing row
+            upper = jnp.clip((hi_row - si * super_q) // block_q + 1,
+                             lower, nb)
+            full_end = jnp.clip(
+                (kv_start + window - block_q - si * super_q) // block_q + 1,
+                first_full, upper)
         carry = jax.lax.fori_loop(
             lower, first_full, functools.partial(body, masked=True), carry)
+        carry = jax.lax.fori_loop(
+            first_full, full_end, functools.partial(body, masked=False), carry)
         return jax.lax.fori_loop(
-            first_full, nb, functools.partial(body, masked=False), carry)
+            full_end, upper, functools.partial(body, masked=True), carry)
 
     d = k_ref.shape[1]
 
@@ -445,6 +523,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
 
     live = (True if not causal
             else (si * super_q + super_q - 1 >= kv_start))
+    if causal and window is not None:
+        live &= si * super_q <= kv_start + block_kv - 1 + window - 1
     _grid_accumulate(
         group * num_super, gi * num_super + si, live, steps, finish,
         (dk_sc, dv_sc),
@@ -453,7 +533,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
-                    block_kv: int, interpret: bool, g_lse=None):
+                    block_kv: int, interpret: bool, g_lse=None, window=None):
     b, h, t, d = q.shape
     tkv = k.shape[2]
     h_kv, group = _gqa_group(q, k)
@@ -502,7 +582,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_kv=block_kv_dq, causal=causal,
-                          sm_scale=sm_scale, num_super=tkv // super_kv),
+                          sm_scale=sm_scale, num_super=tkv // super_kv,
+                          window=window),
         grid=(b * h_kv, group, t // block_q, tkv // super_kv),
         in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
         out_specs=q_outer,
@@ -516,7 +597,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q_dkv,
                           block_kv=block_kv, causal=causal,
                           sm_scale=sm_scale, num_super=t // super_q,
-                          group=group),
+                          group=group, window=window),
         grid=(b * h_kv, tkv // block_kv, group, t // super_q),
         in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
         out_specs=(kv_outer, kv_outer),
@@ -540,36 +621,41 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 1024,
                     block_kv: int = 512,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    window: Optional[int] = None) -> jax.Array:
     """Blockwise flash attention. q/k/v: [b, h, t, d] → [b, h, t, d].
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
     Pallas interpreter elsewhere (so CPU meshes and unit tests execute
-    the identical kernel body).
+    the identical kernel body). ``window`` (causal only): sliding-window
+    attention — row r attends to cols (r-window, r]; blocks wholly
+    outside the band are skipped, so FLOPs are O(t*window) not O(t^2).
     """
     if interpret is None:
         interpret = not _on_tpu()
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
+                            window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret, window):
     if interpret is None:
         interpret = not _on_tpu()
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
+                              window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_kv, interpret, residuals, g):
+def _flash_bwd(causal, block_q, block_kv, interpret, window, residuals, g):
     q, k, v, out, lse = residuals
     if interpret is None:   # nondiff arg: static, resolved the same way
         interpret = not _on_tpu()
     return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv,
-                           interpret)
+                           interpret, window=window)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
