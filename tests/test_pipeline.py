@@ -46,6 +46,29 @@ def test_pp_forward_matches_plain(n_stages, n_micro):
                                atol=2e-5, rtol=2e-5)
 
 
+def test_pp_forward_with_attn_fn_window_and_gqa():
+    """attn_fn must be honored (regression: it was once swallowed into
+    the n_kv_heads positional slot) and cfg.window / cfg.n_kv_heads must
+    thread through the stages — pp output must match the plain windowed
+    GQA forward."""
+    from tpu_dra_driver.workloads.ops.attention import flash_attention
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=4, n_kv_heads=2,
+                      n_layers=4, d_ff=128, max_seq=64, window=16,
+                      dtype=jnp.float32)
+    key = jax.random.PRNGKey(4)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (4, cfg.max_seq), 0, cfg.vocab)
+    ref = forward(params, tokens, cfg)          # windowed GQA oracle
+
+    mesh = _mesh(2)
+    pp_params = _place(mesh, params_to_pp(params, 2))
+    for attn_fn in (None, flash_attention):
+        fwd = jax.jit(make_pp_forward(mesh, cfg, 2, 2, attn_fn=attn_fn))
+        out = fwd(pp_params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+
 def test_pp_train_step_matches_plain():
     cfg = _cfg()
     key = jax.random.PRNGKey(1)

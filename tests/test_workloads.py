@@ -173,6 +173,76 @@ def test_generate_greedy_matches_iterated_forward():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+def test_sliding_window_model_trains_with_flash():
+    """cfg.window wires sliding-window attention through the model: the
+    windowed flash kernel must agree with the windowed oracle on logits,
+    and train end-to-end."""
+    from tpu_dra_driver.workloads.models import forward
+    from tpu_dra_driver.workloads.ops.attention import flash_attention
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=64, max_seq=64, use_rope=True, window=16,
+                      dtype=jnp.float32)
+    key = jax.random.PRNGKey(12)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (2, 64), 0, cfg.vocab)
+    ref = forward(params, tokens, cfg)                    # windowed oracle
+    out = forward(params, tokens, cfg, attn_fn=flash_attention)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    train_step, opt_init = make_train_step(cfg, attn_fn=flash_attention)
+    step = jax.jit(train_step)
+    opt_state = opt_init(params)
+    losses = []
+    for _ in range(6):
+        params, opt_state, loss = step(params, opt_state, (tokens, tokens))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_windowed_decode_ring_cache_matches_full_forward():
+    """Windowed decode uses a rolling ring-buffer cache of length
+    `window`; teacher-forced logits must match the full-context windowed
+    forward at every position, including well past the wrap point."""
+    from tpu_dra_driver.workloads.models import (
+        ModelConfig, forward, init_params,
+    )
+    from tpu_dra_driver.workloads.models.generate import (
+        decode_step, init_kv_cache,
+    )
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=24, use_rope=True,
+                      window=6, dtype=jnp.float32)
+    key = jax.random.PRNGKey(13)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (2, 20), 0, cfg.vocab)
+    full = forward(params, tokens, cfg)
+
+    cache = init_kv_cache(cfg, 2, 20)
+    assert cache["k"][0].shape[2] == 6          # ring, not full length
+    step = jax.jit(lambda c, p, t: decode_step(params, cfg, c, p, t))
+    for t in range(20):
+        logits, cache = step(cache, jnp.int32(t), tokens[:, t])
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, t]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_windowed_rope_generation_beyond_max_seq():
+    """RoPE + window: generation length is not bound by max_seq (no
+    pos_embed table) and cache memory stays O(window)."""
+    from tpu_dra_driver.workloads.models import (
+        ModelConfig, generate, init_params,
+    )
+    cfg = ModelConfig(vocab=48, d_model=64, n_heads=4, n_layers=1,
+                      d_ff=64, max_seq=8, use_rope=True, window=4,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(14))
+    prompt = jax.random.randint(jax.random.PRNGKey(15), (1, 3), 0, cfg.vocab)
+    out = generate(params, cfg, prompt, steps=13)        # t0+steps = 16 > 8
+    assert out.shape == (1, 16)
+    assert np.array_equal(np.asarray(out[:, :3]), np.asarray(prompt))
+
+
 def test_moe_topk_equals_dense_when_k_is_all_experts():
     """With top_k = n_experts and ample capacity nothing is dropped and
     the renormalized top-k softmax equals the full softmax — the sparse

@@ -31,10 +31,16 @@ NEG_INF = -1e30
 
 
 def init_kv_cache(cfg: ModelConfig, batch: int, max_t: int) -> Dict:
-    """Zeroed per-layer KV cache. h_kv = n_kv_heads or n_heads (GQA)."""
+    """Zeroed per-layer KV cache. h_kv = n_kv_heads or n_heads (GQA).
+
+    With cfg.window > 0 the cache is a rolling ring buffer of length
+    min(max_t, window) (Mistral-style): decode writes slot pos % len and
+    the buffer only ever holds the last `window` positions, so cache
+    memory is O(window) regardless of generation length."""
     n_kv = cfg.n_kv_heads or cfg.n_heads
     hd = cfg.d_model // cfg.n_heads
-    shape = (batch, n_kv, max_t, hd)
+    length = min(max_t, cfg.window) if cfg.window > 0 else max_t
+    shape = (batch, n_kv, length, hd)
     return {
         "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
         "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
@@ -42,10 +48,17 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_t: int) -> Dict:
 
 
 def _decode_attention(q, k_cache, v_cache, pos):
-    """q: [b, h, 1, hd] against the full cache [b, h_kv, max_t, hd],
-    masked to positions <= pos. One fused masked softmax-weighted read —
-    the flash-decoding shape (t_q = 1) where XLA's fusion is already
-    optimal; no Pallas kernel needed."""
+    """q: [b, h, 1, hd] against the cache [b, h_kv, L, hd], masked to
+    written slots. One fused masked softmax-weighted read — the
+    flash-decoding shape (t_q = 1) where XLA's fusion is already
+    optimal; no Pallas kernel needed.
+
+    The mask ``slot <= pos`` covers both cache modes: full-length
+    (L = max_t, slot index == absolute position, the causal mask) and
+    ring buffer (L = window: for pos < L only slots 0..pos are written;
+    once pos >= L every slot holds one of the last L positions, all of
+    which the window admits — softmax is permutation-invariant over KV,
+    so slot order never matters)."""
     b, h, _, hd = q.shape
     h_kv = k_cache.shape[1]
     if h != h_kv:
@@ -53,8 +66,8 @@ def _decode_attention(q, k_cache, v_cache, pos):
         v_cache = jnp.repeat(v_cache, h // h_kv, axis=1)
     s = jnp.einsum("bhqd,bhtd->bhqt", q, k_cache).astype(jnp.float32)
     s = s / math.sqrt(hd)
-    max_t = k_cache.shape[2]
-    visible = jnp.arange(max_t) <= pos                     # [max_t]
+    length = k_cache.shape[2]
+    visible = jnp.arange(length) <= pos                    # [L]
     s = jnp.where(visible[None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqt,bhtd->bhqd", p, v_cache)
@@ -86,10 +99,13 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
             from tpu_dra_driver.workloads.models.transformer import apply_rope
             q = apply_rope(q, pos0=pos)
             k = apply_rope(k, pos0=pos)
+        # ring write: slot = pos % L is the identity while pos < L (the
+        # full-length cache) and wraps only in windowed ring mode
+        slot = pos % cache["k"][li].shape[2]
         k_cache = jax.lax.dynamic_update_slice(
-            cache["k"][li], k.astype(cache["k"][li].dtype), (0, 0, pos, 0))
+            cache["k"][li], k.astype(cache["k"][li].dtype), (0, 0, slot, 0))
         v_cache = jax.lax.dynamic_update_slice(
-            cache["v"][li], v.astype(cache["v"][li].dtype), (0, 0, pos, 0))
+            cache["v"][li], v.astype(cache["v"][li].dtype), (0, 0, slot, 0))
         new_k.append(k_cache)
         new_v.append(v_cache)
         att = _decode_attention(q, k_cache, v_cache, pos)
@@ -126,7 +142,10 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
     if steps <= 0:
         return prompt
     max_t = t0 + steps
-    if max_t > cfg.max_seq:
+    if max_t > cfg.max_seq and not cfg.use_rope:
+        # learned pos_embed table bounds the sequence; RoPE doesn't —
+        # with a window the ring cache even keeps memory O(window), so
+        # rope+window generation length is unbounded
         raise ValueError(f"t0+steps ({max_t}) exceeds max_seq {cfg.max_seq}")
     cache = init_kv_cache(cfg, b, max_t)
 

@@ -58,6 +58,12 @@ class ModelConfig:
     # backward instead of living in HBM across the whole forward — the
     # standard TPU memory/FLOPs trade for deep or long-context models.
     remat: bool = False
+    # window > 0 makes every layer's attention sliding-window (local):
+    # row r attends to the last `window` positions only. Training FLOPs
+    # drop to O(t*window) via the flash kernel's band skipping; decode
+    # switches to a rolling ring-buffer KV cache of length window
+    # (Mistral-style), so cache memory is O(window) not O(t).
+    window: int = 0
 
 
 Params = Dict
@@ -123,11 +129,14 @@ def apply_rope(x: jax.Array, pos0=0, theta: float = 10000.0) -> jax.Array:
 
 def _attention(x: jax.Array, layer: Params, n_heads: int,
                n_kv_heads: int = 0, attn_fn=None,
-               use_rope: bool = False) -> jax.Array:
+               use_rope: bool = False, window: int = 0) -> jax.Array:
     """``attn_fn(q, k, v) -> out`` on [b, h, t, hd] tensors; plug point
     for flash_attention / ring_attention / ulysses_attention. Default is
     the shared causal oracle (ops.attention.attention_reference). With
-    n_kv_heads < n_heads the K/V projections are grouped (GQA)."""
+    n_kv_heads < n_heads the K/V projections are grouped (GQA). With
+    window > 0 the attn fn is called with ``window=`` (flash_attention
+    and the oracle accept it; ring/Ulysses don't — local attention
+    removes the need for sequence parallelism at these lengths)."""
     b, t, d = x.shape
     n_kv = n_kv_heads or n_heads
     hd = d // n_heads
@@ -141,7 +150,10 @@ def _attention(x: jax.Array, layer: Params, n_heads: int,
     qh, kh = heads(q, n_heads), heads(k, n_kv)
     if use_rope:
         qh, kh = apply_rope(qh), apply_rope(kh)
-    out = (attn_fn or attention_reference)(qh, kh, heads(v, n_kv))
+    attn = attn_fn or attention_reference
+    if window > 0:
+        attn = partial(attn, window=window)
+    out = attn(qh, kh, heads(v, n_kv))
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
     return out @ layer["wo"]
 
@@ -220,7 +232,7 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     def block(x, layer):
         x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
                            cfg.n_heads, cfg.n_kv_heads, attn_fn,
-                           use_rope=cfg.use_rope)
+                           use_rope=cfg.use_rope, window=cfg.window)
         xn2 = _rmsnorm(x, layer["ln2"]["g"])
         if "moe_up" not in layer:
             return x + _mlp(xn2, layer)

@@ -150,31 +150,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
         if not causal:
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), carry)
-        # blocks intersecting the causal triangle for this q row
-        upper = jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
-        # blocks wholly below the diagonal (every col <= every row)
-        row_min = qi * block_q
-        n_full_hi = jnp.clip((row_min - sj * super_kv + 1) // block_kv,
-                             0, upper)
-        if window is None:
-            lower = 0
-            full_lo = 0
-        else:
-            # sliding window: visible cols for this q block span
-            # [row_min - window + 1, row_max]; blocks straddling the
-            # window's left edge get the mask too, fully-aged blocks are
-            # skipped outright
-            lo_col = row_min - window + 1
-            lower = jnp.clip((lo_col - sj * super_kv) // block_kv, 0, upper)
-            full_lo = jnp.clip(
-                -(-(row_max - window + 1 - sj * super_kv) // block_kv),
-                lower, n_full_hi)
+        lower, full_lo, full_hi, upper = _kv_band_bounds(
+            qi * block_q, row_max, sj * super_kv, block_kv, nb, window)
         carry = jax.lax.fori_loop(
             lower, full_lo, functools.partial(body, masked=True), carry)
         carry = jax.lax.fori_loop(
-            full_lo, n_full_hi, functools.partial(body, masked=False), carry)
+            full_lo, full_hi, functools.partial(body, masked=False), carry)
         return jax.lax.fori_loop(
-            n_full_hi, upper, functools.partial(body, masked=True), carry)
+            full_hi, upper, functools.partial(body, masked=True), carry)
 
     def finish(carry):
         acc, m, l = carry
@@ -193,6 +176,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                  >= qi * block_q - window + 1)
     _grid_accumulate(num_super, sj, live, steps, finish,
                      (acc_sc, m_sc, l_sc), zeros)
+
+
+def _kv_band_bounds(row_min, row_max, base, block_kv, nb, window):
+    """KV block-index bounds for one q block walking one superblock.
+
+    Rows [row_min, row_max] see cols [row_min - window + 1, row_max]
+    (window None → [0, row_max]); the superblock starts at col ``base``
+    and holds ``nb`` blocks of ``block_kv``. Returns (lower, full_lo,
+    full_hi, upper): [lower, full_lo) and [full_hi, upper) straddle the
+    band's edges and take the masked path, [full_lo, full_hi) is wholly
+    inside the band (mask-free), blocks outside [lower, upper) are
+    skipped. Shared by the forward and dq kernels, whose walks are
+    identical; dkv walks q blocks for a kv block (the transpose)."""
+    upper = jnp.minimum(nb, (row_max - base) // block_kv + 1)
+    full_hi = jnp.clip((row_min - base + 1) // block_kv, 0, upper)
+    if window is None:
+        return 0, 0, full_hi, upper
+    lower = jnp.clip((row_min - window + 1 - base) // block_kv, 0, upper)
+    full_lo = jnp.clip(-(-(row_max - window + 1 - base) // block_kv),
+                       lower, full_hi)
+    return lower, full_lo, full_hi, upper
 
 
 # kv superblock VMEM budget: K + V tiles at [4096, 128] bf16 are 1 MB
@@ -391,25 +395,14 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
         if not causal:
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), acc0)
-        upper = jnp.minimum(nb, (row_max - sj * super_kv) // block_kv + 1)
-        row_min = qi * block_q
-        n_full_hi = jnp.clip(
-            (row_min - sj * super_kv + 1) // block_kv, 0, upper)
-        if window is None:
-            lower = 0
-            full_lo = 0
-        else:
-            lo_col = row_min - window + 1
-            lower = jnp.clip((lo_col - sj * super_kv) // block_kv, 0, upper)
-            full_lo = jnp.clip(
-                -(-(row_max - window + 1 - sj * super_kv) // block_kv),
-                lower, n_full_hi)
+        lower, full_lo, full_hi, upper = _kv_band_bounds(
+            qi * block_q, row_max, sj * super_kv, block_kv, nb, window)
         acc0 = jax.lax.fori_loop(
             lower, full_lo, functools.partial(body, masked=True), acc0)
         acc0 = jax.lax.fori_loop(
-            full_lo, n_full_hi, functools.partial(body, masked=False), acc0)
+            full_lo, full_hi, functools.partial(body, masked=False), acc0)
         return jax.lax.fori_loop(
-            n_full_hi, upper, functools.partial(body, masked=True), acc0)
+            full_hi, upper, functools.partial(body, masked=True), acc0)
 
     d = q_ref.shape[1]
 

@@ -66,7 +66,8 @@ def stage_shardings(mesh: Mesh, stacked: Dict, axis_name: str = "pp") -> Dict:
 
 
 def _apply_stage(stage_p: Dict, x: jax.Array, n_heads: int,
-                 attn_fn=None) -> jax.Array:
+                 n_kv_heads: int = 0, attn_fn=None,
+                 window: int = 0) -> jax.Array:
     """Run this stage's L blocks on [mb, t, d] activations."""
     n_layers = stage_p["wqkv"].shape[0]
     for i in range(n_layers):
@@ -75,14 +76,15 @@ def _apply_stage(stage_p: Dict, x: jax.Array, n_heads: int,
             "w_up": stage_p["w_up"][i], "w_down": stage_p["w_down"][i],
         }
         x = x + _attention(_rmsnorm(x, stage_p["ln1_g"][i]), layer,
-                           n_heads, attn_fn)
+                           n_heads, n_kv_heads, attn_fn, window=window)
         x = x + _mlp(_rmsnorm(x, stage_p["ln2_g"][i]), layer)
     return x
 
 
 def pipeline_apply(stacked: Dict, x_mb: jax.Array, *, axis_name: str,
                    n_heads: int, n_stages: int, n_micro: int,
-                   attn_fn=None) -> jax.Array:
+                   n_kv_heads: int = 0, attn_fn=None,
+                   window: int = 0) -> jax.Array:
     """GPipe schedule; call inside shard_map over ``axis_name``.
 
     stacked: this device's stage slice [1, L, ...]; x_mb: the full
@@ -111,7 +113,8 @@ def pipeline_apply(stacked: Dict, x_mb: jax.Array, *, axis_name: str,
         valid = (mb_idx >= 0) & (mb_idx < n_micro)
         inject = x_mb[jnp.clip(s, 0, n_micro - 1)]
         xin = jnp.where(is_first, inject, act)
-        y = _apply_stage(stage_p, xin, n_heads, attn_fn)
+        y = _apply_stage(stage_p, xin, n_heads, n_kv_heads, attn_fn,
+                         window=window)
         slot = jnp.clip(mb_idx, 0, n_micro - 1)
         out = out.at[slot].set(
             jnp.where(valid & is_last, y.astype(out.dtype), out[slot]))
@@ -143,7 +146,8 @@ def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
     pipe = jax.shard_map(
         functools.partial(pipeline_apply, axis_name=axis_name,
                           n_heads=cfg.n_heads, n_stages=n_stages,
-                          n_micro=n_micro, attn_fn=attn_fn),
+                          n_micro=n_micro, n_kv_heads=cfg.n_kv_heads,
+                          attn_fn=attn_fn, window=cfg.window),
         mesh=mesh, in_specs=(spec_stage, P()), out_specs=P())
 
     def forward(pp_params: Dict, tokens: jax.Array) -> jax.Array:
