@@ -134,9 +134,10 @@ def _attention(x: jax.Array, layer: Params, n_heads: int,
     for flash_attention / ring_attention / ulysses_attention. Default is
     the shared causal oracle (ops.attention.attention_reference). With
     n_kv_heads < n_heads the K/V projections are grouped (GQA). With
-    window > 0 the attn fn is called with ``window=`` (flash_attention
-    and the oracle accept it; ring/Ulysses don't — local attention
-    removes the need for sequence parallelism at these lengths)."""
+    window > 0 the attn fn is called with ``window=`` — flash_attention,
+    the oracle, and the make_ring_attention / make_ulysses_attention
+    wrappers all accept it (the ring statically skips out-of-band
+    hops)."""
     b, t, d = x.shape
     n_kv = n_kv_heads or n_heads
     hd = d // n_heads

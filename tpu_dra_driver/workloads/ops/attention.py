@@ -49,33 +49,46 @@ LOG2E = 1.4426950408889634
 
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
-                        window: Optional[int] = None) -> jax.Array:
-    """Oracle attention. q: [b, h, t, d], k/v: [b, h_kv, t, d] with
+                        window: Optional[int] = None,
+                        row_offset: int = 0) -> jax.Array:
+    """Oracle attention. q: [b, h, t, d], k/v: [b, h_kv, tkv, d] with
     h % h_kv == 0 (GQA/MQA: kv heads broadcast over query groups).
     ``window`` (causal only): row r sees cols (r-window, r] — sliding-
-    window / local attention."""
+    window / local attention. ``row_offset`` (causal only): q rows sit
+    at global positions [row_offset, row_offset + t) against cols
+    [0, tkv) — chunked-causal, the ring-attention hop primitive."""
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal=True and window >= 1")
+    if row_offset and (not causal or row_offset < 0):
+        raise ValueError("row_offset requires causal=True and >= 0")
     *_, t, d = q.shape
+    tkv = k.shape[2]
     h, h_kv = q.shape[1], k.shape[1]
     if h != h_kv:
         k = jnp.repeat(k, h // h_kv, axis=1)
         v = jnp.repeat(v, h // h_kv, axis=1)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
     scores = scores / math.sqrt(d)
+    mask = None
     if causal:
-        mask = jnp.tril(jnp.ones((t, t), bool))
+        rows = jnp.arange(t)[:, None] + row_offset
+        cols = jnp.arange(tkv)[None, :]
+        mask = rows >= cols
         if window is not None:
-            rows = jnp.arange(t)[:, None]
-            mask = mask & (rows - jnp.arange(t)[None, :] < window)
+            mask = mask & (rows - cols < window)
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if mask is not None:
+        # a row with an empty band (chunked view: the whole chunk aged
+        # out of its window) contributes ZERO, matching the kernel's
+        # lse=-inf partial semantics — not softmax's uniform fallback
+        probs = jnp.where(mask.any(-1)[:, None], probs, 0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                   block_q: int, block_kv: int, causal: bool, sm_scale: float,
-                  num_super: int, window=None):
+                  num_super: int, window=None, row_offset: int = 0):
     """One (batch*kv-head, q-group, q-block, kv-superblock) grid cell.
 
     GQA: the grid's axis 1 walks the query heads sharing this cell's KV
@@ -97,7 +110,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
     sj = pl.program_id(3)
     super_kv = k_ref.shape[0]
     nb = super_kv // block_kv
-    row_max = qi * block_q + block_q - 1       # last causal-visible column
+    # global row coordinates: chunked-causal (ring hops) offsets them
+    row_min = row_offset + qi * block_q
+    row_max = row_min + block_q - 1            # last causal-visible column
     d = q_ref.shape[1]
 
     def steps(carry):
@@ -120,7 +135,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                 preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
             vis = None
             if masked:
-                row_ids = qi * block_q + jax.lax.broadcasted_iota(
+                row_ids = row_min + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 0)
                 col_ids = (sj * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
@@ -151,7 +166,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), carry)
         lower, full_lo, full_hi, upper = _kv_band_bounds(
-            qi * block_q, row_max, sj * super_kv, block_kv, nb, window)
+            row_min, row_max, sj * super_kv, block_kv, nb, window)
         carry = jax.lax.fori_loop(
             lower, full_lo, functools.partial(body, masked=True), carry)
         carry = jax.lax.fori_loop(
@@ -173,7 +188,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
     live = True if not causal else (sj * super_kv <= row_max)
     if causal and window is not None:
         live &= (sj * super_kv + super_kv - 1
-                 >= qi * block_q - window + 1)
+                 >= row_min - window + 1)
     _grid_accumulate(num_super, sj, live, steps, finish,
                      (acc_sc, m_sc, l_sc), zeros)
 
@@ -284,18 +299,23 @@ def _gqa_group(q, k):
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
-                   interpret: bool, window=None):
+                   interpret: bool, window=None, row_offset: int = 0):
     """Returns (out [b,h,t,d], lse [b*h, 1, t] f32). k/v may carry fewer
     (grouped/multi-query) heads than q, and a different sequence length
-    (KV chunks, cross-attention, decode) when non-causal."""
+    (KV chunks, cross-attention, decode) when non-causal or when
+    ``row_offset`` places the q rows at global positions
+    [row_offset, row_offset + t) against cols [0, tkv) (chunked-causal:
+    ring hops, block prefill)."""
     b, h, t, d = q.shape
     tkv = k.shape[2]
-    if causal and tkv != t:
+    if causal and row_offset == 0 and tkv != t:
         raise ValueError(
             f"causal flash attention needs t_q == t_kv (got {t} vs {tkv}); "
-            f"chunked-causal belongs to the caller (see ring_attention)")
+            f"chunked-causal takes row_offset (see ring_attention)")
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal=True and window >= 1")
+    if row_offset and (not causal or row_offset < 0):
+        raise ValueError("row_offset requires causal=True and >= 0")
     h_kv, group = _gqa_group(q, k)
     super_kv = _fit_block(_SUPER_KV, tkv)
     block_q = _fit_block(block_q, t)
@@ -311,7 +331,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_kv=block_kv,
         causal=causal, sm_scale=sm_scale, num_super=num_super,
-        window=window)
+        window=window, row_offset=row_offset)
 
     vmem = {"memory_space": pltpu.VMEM}
 
@@ -346,7 +366,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                          dq_ref, acc_sc, *, block_q: int, block_kv: int,
                          causal: bool, sm_scale: float, num_super: int,
-                         window=None):
+                         window=None, row_offset: int = 0):
     """dq for one (batch*kv-head, q-group, q-block, kv-superblock) cell.
 
     P is rebuilt from (q, k, lse); dS = P * (dP - D); dq = sum_j dS @ K_j
@@ -359,7 +379,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
     sj = pl.program_id(3)
     super_kv = k_ref.shape[0]
     nb = super_kv // block_kv
-    row_max = qi * block_q + block_q - 1
+    row_min = row_offset + qi * block_q
+    row_max = row_min + block_q - 1
 
     def steps(acc0):
         # base-2 softmax: p = exp(s - lse) == exp2(s*log2e - lse*log2e)
@@ -373,7 +394,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                 q_ref[:], kb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
             if masked:
-                row_ids = qi * block_q + jax.lax.broadcasted_iota(
+                row_ids = row_min + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 0)
                 col_ids = (sj * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
@@ -396,7 +417,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), acc0)
         lower, full_lo, full_hi, upper = _kv_band_bounds(
-            qi * block_q, row_max, sj * super_kv, block_kv, nb, window)
+            row_min, row_max, sj * super_kv, block_kv, nb, window)
         acc0 = jax.lax.fori_loop(
             lower, full_lo, functools.partial(body, masked=True), acc0)
         acc0 = jax.lax.fori_loop(
@@ -412,7 +433,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
     live = True if not causal else (sj * super_kv <= row_max)
     if causal and window is not None:
         live &= (sj * super_kv + super_kv - 1
-                 >= qi * block_q - window + 1)
+                 >= row_min - window + 1)
     _grid_accumulate(
         num_super, sj, live,
         steps=lambda carry: (steps(carry[0]),),
@@ -424,7 +445,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                           dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
                           block_kv: int, causal: bool, sm_scale: float,
-                          num_super: int, group: int, window=None):
+                          num_super: int, group: int, window=None,
+                          row_offset: int = 0):
     """dk/dv for one (batch*kv-head, kv-block, q-group, q-superblock) cell.
 
     dv = sum_i P_i^T @ dO_i; dk = sum_i dS_i^T @ Q_i * scale. The q axis
@@ -455,7 +477,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                 qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
             if masked:
-                row_ids = (si * super_q + i2 * block_q
+                row_ids = (row_offset + si * super_q + i2 * block_q
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (block_q, block_kv), 0))
                 col_ids = kv_start + jax.lax.broadcasted_iota(
@@ -485,20 +507,23 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
         # masked rows straddle the diagonal (and, windowed, the far edge
         # where rows age out of every column's window); a row block is
         # mask-free iff every row >= this kv block's last column and,
-        # with a window, every row < first column + window
-        lower = jnp.maximum(0, (kv_start - si * super_q) // block_q)
+        # with a window, every row < first column + window. Row
+        # coordinates are global (row_offset + local) — the superblock's
+        # local origin si * super_q shifts by row_offset.
+        q0 = row_offset + si * super_q          # first global row here
+        lower = jnp.maximum(0, (kv_start - q0) // block_q)
         first_full = jnp.clip(
-            -(-(kv_start + block_kv - 1 - si * super_q) // block_q),
+            -(-(kv_start + block_kv - 1 - q0) // block_q),
             lower, nb)
         if window is None:
             upper = nb
             full_end = nb
         else:
             hi_row = kv_start + block_kv - 1 + window - 1   # last seeing row
-            upper = jnp.clip((hi_row - si * super_q) // block_q + 1,
+            upper = jnp.clip((hi_row - q0) // block_q + 1,
                              lower, nb)
             full_end = jnp.clip(
-                (kv_start + window - block_q - si * super_q) // block_q + 1,
+                (kv_start + window - block_q - q0) // block_q + 1,
                 first_full, upper)
         carry = jax.lax.fori_loop(
             lower, first_full, functools.partial(body, masked=True), carry)
@@ -515,9 +540,10 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
         dv_ref[:] = dv_acc.astype(dv_ref.dtype)
 
     live = (True if not causal
-            else (si * super_q + super_q - 1 >= kv_start))
+            else (row_offset + si * super_q + super_q - 1 >= kv_start))
     if causal and window is not None:
-        live &= si * super_q <= kv_start + block_kv - 1 + window - 1
+        live &= (row_offset + si * super_q
+                 <= kv_start + block_kv - 1 + window - 1)
     _grid_accumulate(
         group * num_super, gi * num_super + si, live, steps, finish,
         (dk_sc, dv_sc),
@@ -526,7 +552,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
-                    block_kv: int, interpret: bool, g_lse=None, window=None):
+                    block_kv: int, interpret: bool, g_lse=None, window=None,
+                    row_offset: int = 0):
     b, h, t, d = q.shape
     tkv = k.shape[2]
     h_kv, group = _gqa_group(q, k)
@@ -576,7 +603,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_kv=block_kv_dq, causal=causal,
                           sm_scale=sm_scale, num_super=tkv // super_kv,
-                          window=window),
+                          window=window, row_offset=row_offset),
         grid=(b * h_kv, group, t // block_q, tkv // super_kv),
         in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
         out_specs=q_outer,
@@ -590,7 +617,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q_dkv,
                           block_kv=block_kv, causal=causal,
                           sm_scale=sm_scale, num_super=t // super_q,
-                          group=group, window=window),
+                          group=group, window=window,
+                          row_offset=row_offset),
         grid=(b * h_kv, tkv // block_kv, group, t // super_q),
         in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
         out_specs=(kv_outer, kv_outer),
@@ -614,12 +642,13 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 1024,
                     block_kv: int = 512,
                     interpret: Optional[bool] = None,
-                    window: Optional[int] = None) -> jax.Array:
+                    window: Optional[int] = None,
+                    row_offset: int = 0) -> jax.Array:
     """Blockwise flash attention. q/k/v: [b, h, t, d] → [b, h, t, d].
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
@@ -627,66 +656,81 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     the identical kernel body). ``window`` (causal only): sliding-window
     attention — row r attends to cols (r-window, r]; blocks wholly
     outside the band are skipped, so FLOPs are O(t*window) not O(t^2).
+    ``row_offset`` (causal only): chunked-causal — q rows sit at global
+    positions [row_offset, row_offset + t_q) against cols [0, t_kv),
+    so a q chunk can attend a longer (or rotated ring) KV chunk with
+    exact causal/window semantics and banded block skipping.
     """
     if interpret is None:
         interpret = not _on_tpu()
     out, _ = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
-                            window)
+                            window, row_offset)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret, window):
+def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret, window,
+               row_offset):
     if interpret is None:
         interpret = not _on_tpu()
     out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
-                              window)
+                              window, row_offset)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_kv, interpret, window, residuals, g):
+def _flash_bwd(causal, block_q, block_kv, interpret, window, row_offset,
+               residuals, g):
     q, k, v, out, lse = residuals
     if interpret is None:   # nondiff arg: static, resolved the same way
         interpret = not _on_tpu()
     return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv,
-                           interpret, window=window)
+                           interpret, window=window, row_offset=row_offset)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              causal: bool = True, block_q: int = 1024,
                              block_kv: int = 512,
-                             interpret: Optional[bool] = None):
+                             interpret: Optional[bool] = None,
+                             window: Optional[int] = None,
+                             row_offset: int = 0):
     """Like ``flash_attention`` but also returns the per-row natural-log
     logsumexp ``[b, h, t]`` (f32). The pair (out, lse) is the mergeable
     *partial attention* form: results over disjoint KV chunks combine
     exactly via logsumexp weighting (``merge_partials``) — the primitive
-    ring attention is built from. Gradients flow through both outputs.
+    ring attention is built from; a row whose chunk is fully masked
+    (windowed ring hop) comes back with lse ≈ -inf, i.e. zero merge
+    weight. Gradients flow through both outputs.
     """
     if interpret is None:
         interpret = not _on_tpu()
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
+                              window, row_offset)
     b, h, t, _ = q.shape
     return out, lse.reshape(b, h, t)
 
 
-def _flash_lse_fwd(q, k, v, causal, block_q, block_kv, interpret):
+def _flash_lse_fwd(q, k, v, causal, block_q, block_kv, interpret, window,
+                   row_offset):
     if interpret is None:
         interpret = not _on_tpu()
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
+                              window, row_offset)
     b, h, t, _ = q.shape
     return (out, lse.reshape(b, h, t)), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd(causal, block_q, block_kv, interpret, residuals, g):
+def _flash_lse_bwd(causal, block_q, block_kv, interpret, window, row_offset,
+                   residuals, g):
     q, k, v, out, lse = residuals
     g_out, g_lse = g
     if interpret is None:
         interpret = not _on_tpu()
     return _flash_backward(q, k, v, out, lse, g_out, causal, block_q,
-                           block_kv, interpret, g_lse=g_lse)
+                           block_kv, interpret, g_lse=g_lse, window=window,
+                           row_offset=row_offset)
 
 
 flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

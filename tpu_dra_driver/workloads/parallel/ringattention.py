@@ -41,7 +41,8 @@ from tpu_dra_driver.workloads.ops.attention import (
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str = "sp", causal: bool = True) -> jax.Array:
+                   axis_name: str = "sp", causal: bool = True,
+                   window: Optional[int] = None) -> jax.Array:
     """Ring attention over ``axis_name``; call inside shard_map.
 
     Per-device shapes [b, h, t_local, d]; the sequence axis is the one
@@ -57,22 +58,44 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     future otherwise (skipped via lax.cond — zero FLOPs, zero weight).
     The ring is statically unrolled so XLA overlaps each ppermute hop
     with the previous chunk's compute.
+
+    ``window`` (causal only) composes sliding-window attention with the
+    ring: a chunk s hops back ends at global col (idx-s+1)*t_local - 1,
+    whose distance to the nearest local row is (s-1)*t_local + 1 — hops
+    beyond ceil((window-1)/t_local) can contain nothing in any row's
+    band and are skipped *statically* (no ppermute, no kernel launch),
+    so ring FLOPs and ICI traffic drop to O(window/t_local) hops.
+    Visited hops express the global band exactly via the kernel's
+    chunked-causal ``row_offset = s * t_local`` (rows [s*tl, (s+1)*tl)
+    against chunk cols [0, tl) reproduce every global row-col distance).
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
+    tl = q.shape[2]
 
     perm = [(i, (i + 1) % n) for i in range(n)]
-    out, lse = flash_attention_with_lse(q, k, v, causal)
+    out, lse = flash_attention_with_lse(q, k, v, causal, window=window)
     # f32 running accumulator across merges (merge_partials stays in f32);
     # one cast back to q.dtype at the end
     out = out.astype(jnp.float32)
     kk, vv = k, v
-    for step in range(1, n):
+    if causal and window is not None:
+        max_hops = min(n - 1, -(-(window - 1) // tl))
+    else:
+        max_hops = n - 1
+    for step in range(1, max_hops + 1):
         kk = jax.lax.ppermute(kk, axis_name, perm)
         vv = jax.lax.ppermute(vv, axis_name, perm)
 
-        def visit(out, lse, kc, vc):
-            o2, l2 = flash_attention_with_lse(q, kc, vc, False)
+        def visit(out, lse, kc, vc, step=step):
+            if causal and window is not None:
+                # windowed past chunk: banded, possibly partial — the
+                # offset causal mask is all-true (rows >= tl > cols) and
+                # the window band lands exactly on the global one
+                o2, l2 = flash_attention_with_lse(
+                    q, kc, vc, True, window=window, row_offset=step * tl)
+            else:
+                o2, l2 = flash_attention_with_lse(q, kc, vc, False)
             return merge_partials(out, lse, o2, l2)
 
         if causal:
@@ -88,19 +111,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = True,
-                      attn_fn: Optional[Callable] = None) -> jax.Array:
+                      attn_fn: Optional[Callable] = None,
+                      window: Optional[int] = None) -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Re-shards seq-sharded [b, h, t/n, d] into head-sharded [b, h/n, t, d]
     with one all-to-all, runs full-sequence attention per chip (flash
     kernel by default), and re-shards back. Requires h % n == 0.
-    Call inside shard_map over ``axis_name``.
+    Call inside shard_map over ``axis_name``. ``window`` passes through
+    to the per-chip full-sequence attention (the attn_fn must accept a
+    ``window`` kwarg; flash_attention and attention_reference do).
     """
     n = jax.lax.axis_size(axis_name)
     h = q.shape[1]
     if h % n:
         raise ValueError(f"ulysses needs heads ({h}) divisible by axis size ({n})")
-    fn = attn_fn or (lambda q, k, v, c: flash_attention(q, k, v, c))
+    fn = attn_fn or (lambda q, k, v, c, **kw: flash_attention(q, k, v, c, **kw))
+    kw = {"window": window} if window is not None else {}
 
     def scatter_heads(x):   # [b, h, tl, d] -> [b, h/n, t, d]
         return jax.lax.all_to_all(x, axis_name, split_axis=1,
@@ -110,23 +137,37 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return jax.lax.all_to_all(x, axis_name, split_axis=2,
                                   concat_axis=1, tiled=True)
 
-    out = fn(scatter_heads(q), scatter_heads(k), scatter_heads(v), causal)
+    out = fn(scatter_heads(q), scatter_heads(k), scatter_heads(v), causal,
+             **kw)
     return gather_heads(out)
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
                         batch_axes=("dp",), head_axis: Optional[str] = "tp",
-                        causal: bool = True) -> Callable:
+                        causal: bool = True,
+                        window: Optional[int] = None) -> Callable:
     """Wrap ``ring_attention`` in shard_map over ``mesh`` so it can be
     called on full [b, h, t, d] arrays from inside jit. Batch rides
     ``batch_axes``, heads ``head_axis`` (both embarrassingly parallel
-    here), sequence rides ``axis_name``."""
+    here), sequence rides ``axis_name``.
+
+    The returned fn also accepts a call-time ``window`` kwarg (the model
+    layer calls ``partial(attn, window=cfg.window)``); each distinct
+    window builds its own shard_map (cached) since the ring's hop count
+    is static in it."""
     spec = P(batch_axes, head_axis, axis_name, None)
 
-    @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
-                       in_specs=(spec, spec, spec), out_specs=spec)
-    def wrapped(q, k, v):
-        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    @functools.lru_cache(maxsize=None)
+    def build(w):
+        @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+        def sharded(q, k, v):
+            return ring_attention(q, k, v, axis_name=axis_name,
+                                  causal=causal, window=w)
+        return sharded
+
+    def wrapped(q, k, v, window=window):
+        return build(window)(q, k, v)
 
     return wrapped
 
@@ -134,17 +175,25 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
                            batch_axes=("dp",), head_axis: Optional[str] = "tp",
                            causal: bool = True,
-                           attn_fn: Optional[Callable] = None) -> Callable:
+                           attn_fn: Optional[Callable] = None,
+                           window: Optional[int] = None) -> Callable:
     spec = P(batch_axes, head_axis, axis_name, None)
 
     # check_vma stays ON here: the pallas out_shapes declare their vma
     # (_sds) and ulysses has no cond/scan carry to trip the checker —
     # only ring_attention needs the opt-out.
-    @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(spec, spec, spec), out_specs=spec)
-    def wrapped(q, k, v):
-        return ulysses_attention(q, k, v, axis_name=axis_name,
-                                 causal=causal, attn_fn=attn_fn)
+    @functools.lru_cache(maxsize=None)
+    def build(w):
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+        def sharded(q, k, v):
+            return ulysses_attention(q, k, v, axis_name=axis_name,
+                                     causal=causal, attn_fn=attn_fn,
+                                     window=w)
+        return sharded
+
+    def wrapped(q, k, v, window=window):
+        return build(window)(q, k, v)
 
     return wrapped
 
