@@ -161,6 +161,16 @@ def bench_accelerator() -> dict:
                 ft["flash_attn_train_tflops"], 2)
             log(f"  flash attention fwd+bwd: "
                 f"{ft['flash_attn_train_tflops']:.2f} TFLOP/s ({ft['shape']})")
+            from tpu_dra_driver.workloads.ops import (
+                flash_attention_long_context_tflops,
+            )
+            fl = flash_attention_long_context_tflops()
+            out["flash_attn_long_ctx_tflops"] = round(
+                fl["flash_attn_long_ctx_tflops"], 2)
+            log(f"  sliding-window long context: "
+                f"{fl['flash_attn_long_ctx_tflops']:.2f} TFLOP/s "
+                f"({fl['shape']}, {fl['long_ctx_step_ms']:.1f} ms/step; "
+                f"the [t,t] reference OOMs at this length)")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out

@@ -85,6 +85,17 @@ def test_matmul_bench_runs():
     assert m.tflops > 0
 
 
+def test_long_context_bench_runs():
+    from tpu_dra_driver.workloads.ops import (
+        flash_attention_long_context_tflops,
+    )
+    r = flash_attention_long_context_tflops(
+        b=1, h=2, t=256, d=32, window=64, iters=2,
+        chain_short=1, chain_long=3)
+    assert r["flash_attn_long_ctx_tflops"] > 0
+    assert "w64" in r["shape"]
+
+
 def test_graft_entry_compiles():
     import __graft_entry__ as g
     fn, args = g.entry()

@@ -7,6 +7,7 @@ from tpu_dra_driver.workloads.ops.collectives import (  # noqa: F401
 from tpu_dra_driver.workloads.ops.attention import (  # noqa: F401
     attention_reference,
     flash_attention,
+    flash_attention_long_context_tflops,
     flash_attention_tflops,
     flash_attention_train_tflops,
     flash_attention_with_lse,

@@ -766,7 +766,7 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
     dispatch/transport overhead (large on tunneled remote devices) —
     the same method as matmul_tflops_steady. FLOP accounting:
     4*b*h*t^2*d (QK^T + PV), halved for causality."""
-    from tpu_dra_driver.workloads.utils.timing import time_fn
+    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -775,17 +775,14 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
     v = jax.random.normal(kv, (b, h, t, d), dtype)
 
     def measure(attn):
-        times = {}
-        for n in (chain_short, chain_long):
+        def make_run(n):
             @jax.jit
-            def run(q, k, v, n=n):
+            def run(q, k, v):
                 def body(_, qq):
                     return attn(qq, k, v).astype(dtype)
                 return jax.lax.fori_loop(0, n, body, q)
-            times[n] = time_fn(lambda r=run: r(q, k, v),
-                               warmup=2, iters=iters).median_s
-        dt = times[chain_long] - times[chain_short]
-        return max(dt, 1e-9) / (chain_long - chain_short)
+            return lambda: run(q, k, v)
+        return marginal_chain_rate(make_run, chain_short, chain_long, iters)
 
     per_flash = measure(lambda q, k, v: flash_attention(q, k, v, True))
     flops = 4 * b * h * t * t * d / 2
@@ -802,6 +799,45 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
     return out
 
 
+def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
+                                        t: int = 16384, d: int = 128,
+                                        window: int = 2048,
+                                        dtype=jnp.bfloat16, iters: int = 3,
+                                        chain_short: int = 8,
+                                        chain_long: int = 24):
+    """Sliding-window flash attention at long context.
+
+    The capability this measures: at t = 16k the reference attention's
+    score matrix is b*h*t^2*4 bytes (8 GiB at these defaults) — it
+    cannot run — while the banded kernel touches O(t*window) and its
+    FLOPs drop by ~t/(2*window). Useful-FLOP accounting counts only the
+    visible band: sum_r min(r+1, window) pairs, 4*d FLOPs each. Marginal
+    chain-rate timing as the other attention benches."""
+    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, t, d), dtype)
+    k = jax.random.normal(kk, (b, h, t, d), dtype)
+    v = jax.random.normal(kv, (b, h, t, d), dtype)
+
+    def make_run(n):
+        @jax.jit
+        def run(q, k, v):
+            def body(_, qq):
+                return flash_attention(qq, k, v, True,
+                                       window=window).astype(dtype)
+            return jax.lax.fori_loop(0, n, body, q)
+        return lambda: run(q, k, v)
+
+    per = marginal_chain_rate(make_run, chain_short, chain_long, iters)
+    visible = window * (window + 1) // 2 + (t - window) * window
+    flops = 4 * b * h * d * visible
+    return {"flash_attn_long_ctx_tflops": flops / per / 1e12,
+            "long_ctx_step_ms": per * 1e3,
+            "shape": f"b{b} h{h} t{t} w{window} d{d} {jnp.dtype(dtype).name}"}
+
+
 def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
                                  d: int = 128, dtype=jnp.bfloat16,
                                  iters: int = 3, chain_short: int = 16,
@@ -813,7 +849,7 @@ def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
     eliminated); marginal-rate timing as flash_attention_tflops. FLOP
     accounting: 2 fwd matmuls + 5 bwd matmuls = 3.5x the forward's
     4*b*h*t^2*d/2 (causal)."""
-    from tpu_dra_driver.workloads.utils.timing import time_fn
+    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -824,10 +860,9 @@ def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
     def loss(q, k, v):
         return jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32) ** 2)
 
-    times = {}
-    for n in (chain_short, chain_long):
+    def make_run(n):
         @jax.jit
-        def run(q, k, v, n=n):
+        def run(q, k, v):
             def body(_, carry):
                 qq, kk_, vv = carry
                 dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(qq, kk_, vv)
@@ -836,10 +871,9 @@ def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
                         (kk_ - lr * dk).astype(dtype),
                         (vv - lr * dv).astype(dtype))
             return jax.lax.fori_loop(0, n, body, (q, k, v))
-        times[n] = time_fn(lambda r=run: r(q, k, v),
-                           warmup=2, iters=iters).median_s
-    per = max(times[chain_long] - times[chain_short], 1e-9) / (
-        chain_long - chain_short)
+        return lambda: run(q, k, v)
+
+    per = marginal_chain_rate(make_run, chain_short, chain_long, iters)
     flops = 3.5 * 4 * b * h * t * t * d / 2
     return {"flash_attn_train_tflops": flops / per / 1e12,
             "shape": f"b{b} h{h} t{t} d{d} {jnp.dtype(dtype).name}"}

@@ -39,3 +39,20 @@ def time_fn(fn: Callable[[], Any], warmup: int = 2, iters: int = 5) -> Timed:
         times.append(time.perf_counter() - t0)
     times.sort()
     return Timed(median_s=times[len(times) // 2], best_s=times[0], times_s=times)
+
+
+def marginal_chain_rate(make_run: Callable[[int], Callable[[], Any]],
+                        chain_short: int, chain_long: int,
+                        iters: int = 3, warmup: int = 2) -> float:
+    """Steady-state seconds-per-step with fixed dispatch/transport
+    overhead cancelled: time dependent chains of two lengths (each one
+    jitted program) and return the marginal rate between them — on
+    tunneled remote devices the per-call overhead dwarfs short kernels,
+    and only the marginal slope measures the device. ``make_run(n)``
+    returns a zero-arg callable executing an n-step chain."""
+    times = {}
+    for n in (chain_short, chain_long):
+        run = make_run(n)
+        times[n] = time_fn(run, warmup=warmup, iters=iters).median_s
+    dt = times[chain_long] - times[chain_short]
+    return max(dt, 1e-9) / (chain_long - chain_short)
