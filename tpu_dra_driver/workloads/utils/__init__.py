@@ -1,1 +1,12 @@
-from tpu_dra_driver.workloads.utils.timing import time_fn, Timed  # noqa: F401
+from tpu_dra_driver.workloads.utils.timing import (  # noqa: F401
+    Timed,
+    marginal_chain_rate,
+    time_fn,
+)
+from tpu_dra_driver.workloads.utils.checkpoint import (  # noqa: F401
+    abstract_like,
+    latest_step,
+    list_steps,
+    restore_train_state,
+    save_train_state,
+)
