@@ -63,6 +63,19 @@ def _claim(uid, devices, name=None, **kw):
                                  devices, NODE, **kw)
 
 
+def _tpu_config(**fields):
+    """One FromClaim opaque TpuConfig entry (the boilerplate envelope
+    every sharing/validation test needs)."""
+    return [{
+        "source": "FromClaim", "requests": [],
+        "opaque": {"driver": "tpu.google.com", "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            **fields,
+        }},
+    }]
+
+
 # ---------------------------------------------------------------------------
 # checkpoint
 # ---------------------------------------------------------------------------
@@ -196,6 +209,36 @@ def test_prepare_chip_end_to_end(tmp_path):
     assert plugin.state.get_checkpoint().claims == {}
 
 
+def test_plugin_restart_preserves_prepared_claims(tmp_path):
+    """Kubelet-restart analog (bats: helpers.sh kubelet restart): a new
+    plugin process over the same state dir must (a) treat the completed
+    claim's sub-slice as known (no startup obliteration), (b) answer a
+    re-Prepare from the checkpoint, and (c) unprepare cleanly."""
+    gates = _gates(DynamicSubslice=True)
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin, _, _ = _mkplugin(tmp_path, lib=lib, gates=gates)
+    sub = [d.canonical_name for d in enumerate_allocatable(lib, gates).values()
+           if d.type == DeviceType.SUBSLICE][0]
+    res = plugin.prepare_resource_claims([_claim("u1", [sub])])["u1"]
+    assert res.error is None
+    assert len(lib.list_subslices()) == 1
+    plugin.shutdown()
+
+    # a restarted plugin gets a FRESH lib over the same persistent host
+    # state (the pattern host_state exists for) — only disk state and
+    # live partitions survive, not in-process lib caches
+    lib2 = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"),
+                      host_state=lib.host_state)
+    plugin2, _, _ = _mkplugin(tmp_path, lib=lib2, gates=gates)
+    # startup cleanup must NOT tear down the checkpointed sub-slice
+    assert len(lib2.list_subslices()) == 1
+    res2 = plugin2.prepare_resource_claims([_claim("u1", [sub])])["u1"]
+    assert res2.error is None and plugin2.state.timings[-1].cached
+    assert plugin2.unprepare_resource_claims(["u1"]) == {"u1": None}
+    assert lib2.list_subslices() == []
+    assert plugin2.state.get_checkpoint().claims == {}
+
+
 def test_prepare_overlap_rejected(tmp_path):
     plugin, _, _ = _mkplugin(tmp_path)
     assert plugin.prepare_resource_claims([_claim("u1", ["tpu-0"])])["u1"].error is None
@@ -301,15 +344,10 @@ def test_cleanup_sweeps_stale_claims(tmp_path):
 def test_sharing_timeslicing_flow(tmp_path):
     gates = _gates(TimeSlicingSettings=True)
     plugin, _, lib = _mkplugin(tmp_path, gates=gates)
-    cfgs = [{
-        "source": "FromClaim", "requests": [],
-        "opaque": {"driver": "tpu.google.com", "parameters": {
-            "apiVersion": "resource.tpu.google.com/v1beta1",
-            "kind": "TpuConfig",
-            "sharing": {"strategy": "TimeSlicing",
+    cfgs = _tpu_config(
+        sharing={"strategy": "TimeSlicing",
                         "timeSlicing": {"interval": "Long"}},
-        }},
-    }]
+    )
     claim = _claim("u1", ["tpu-0"], configs=cfgs)
     res = plugin.prepare_resource_claims([claim])["u1"]
     assert res.error is None
@@ -322,16 +360,37 @@ def test_sharing_timeslicing_flow(tmp_path):
     assert env["TPU_TIMESLICE_INTERVAL"] == "Long"
 
 
+def test_sharing_multiprocess_flow(tmp_path):
+    """MultiProcess sharing (the MPS analog, daemonless by design): the
+    chip flips to non-exclusive and the workload gets the libtpu
+    multi-client env; unprepare restores exclusive mode so the setting
+    cannot leak into the next claim."""
+    gates = _gates(MultiProcessSharing=True)
+    plugin, _, lib = _mkplugin(tmp_path, gates=gates)
+    cfgs = _tpu_config(
+        sharing={"strategy": "MultiProcess",
+                        "multiProcess": {"maxClients": 4,
+                                         "hbmLimitPercent": 25}},
+    )
+    claim = _claim("u1", ["tpu-0"], configs=cfgs)
+    res = plugin.prepare_resource_claims([claim])["u1"]
+    assert res.error is None
+    chip = lib.enumerate_chips()[0]
+    assert lib.get_exclusive_mode(chip.uuid) is False
+    spec = plugin.state._cdi.read_claim_spec("u1")
+    env = dict(e.split("=", 1) for e in spec["containerEdits"]["env"])
+    assert env["TPU_MULTI_PROCESS"] == "1"
+    assert env["TPU_MAX_CLIENTS"] == "4"
+    assert env["TPU_HBM_LIMIT_PERCENT"] == "25"
+    plugin.unprepare_resource_claims(["u1"])
+    assert lib.get_exclusive_mode(chip.uuid) is True
+
+
 def test_sharing_requires_gate(tmp_path):
     plugin, _, _ = _mkplugin(tmp_path)  # gates off
-    cfgs = [{
-        "source": "FromClaim", "requests": [],
-        "opaque": {"driver": "tpu.google.com", "parameters": {
-            "apiVersion": "resource.tpu.google.com/v1beta1",
-            "kind": "TpuConfig",
-            "sharing": {"strategy": "MultiProcess"},
-        }},
-    }]
+    cfgs = _tpu_config(
+        sharing={"strategy": "MultiProcess"},
+    )
     res = plugin.prepare_resource_claims([_claim("u1", ["tpu-0"], configs=cfgs)])["u1"]
     assert res.permanent
     assert "MultiProcessSharing" in res.error
@@ -339,14 +398,9 @@ def test_sharing_requires_gate(tmp_path):
 
 def test_bad_opaque_config_is_permanent(tmp_path):
     plugin, _, _ = _mkplugin(tmp_path)
-    cfgs = [{
-        "source": "FromClaim", "requests": [],
-        "opaque": {"driver": "tpu.google.com", "parameters": {
-            "apiVersion": "resource.tpu.google.com/v1beta1",
-            "kind": "TpuConfig",
-            "totallyUnknownField": 1,
-        }},
-    }]
+    cfgs = _tpu_config(
+        totallyUnknownField=1,
+    )
     res = plugin.prepare_resource_claims([_claim("u1", ["tpu-0"], configs=cfgs)])["u1"]
     assert res.permanent
     assert "bad opaque config" in res.error
@@ -437,15 +491,10 @@ def test_passthrough_publishes_counters_for_personality_exclusion(tmp_path):
 def test_unprepare_resets_timeslice_interval(tmp_path):
     gates = _gates(TimeSlicingSettings=True)
     plugin, _, lib = _mkplugin(tmp_path, gates=gates)
-    cfgs = [{
-        "source": "FromClaim", "requests": [],
-        "opaque": {"driver": "tpu.google.com", "parameters": {
-            "apiVersion": "resource.tpu.google.com/v1beta1",
-            "kind": "TpuConfig",
-            "sharing": {"strategy": "TimeSlicing",
+    cfgs = _tpu_config(
+        sharing={"strategy": "TimeSlicing",
                         "timeSlicing": {"interval": "Long"}},
-        }},
-    }]
+    )
     plugin.prepare_resource_claims([_claim("u1", ["tpu-0"], configs=cfgs)])
     chip = lib.enumerate_chips()[0]
     from tpu_dra_driver.tpulib.interface import TimesliceInterval
