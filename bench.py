@@ -171,6 +171,12 @@ def bench_accelerator() -> dict:
                 f"{fl['flash_attn_long_ctx_tflops']:.2f} TFLOP/s "
                 f"({fl['shape']}, {fl['long_ctx_step_ms']:.1f} ms/step; "
                 f"the [t,t] reference OOMs at this length)")
+            from tpu_dra_driver.workloads.models import decode_tokens_per_sec
+            dt = decode_tokens_per_sec()
+            out["decode_tokens_per_sec"] = round(dt["decode_tokens_per_sec"], 1)
+            log(f"  KV-cache greedy decode: "
+                f"{dt['decode_tokens_per_sec']:.0f} tok/s "
+                f"({dt['shape']}, {dt['decode_step_ms']:.2f} ms/token-step)")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out

@@ -85,6 +85,17 @@ def test_matmul_bench_runs():
     assert m.tflops > 0
 
 
+def test_decode_throughput_bench_runs():
+    from tpu_dra_driver.workloads.models import decode_tokens_per_sec
+    from tpu_dra_driver.workloads.models.transformer import ModelConfig
+    tiny = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=1,
+                       d_ff=64, max_seq=24, use_rope=True,
+                       dtype=jnp.float32)
+    r = decode_tokens_per_sec(b=2, prompt_len=4, gen_short=2, gen_long=6,
+                              iters=2, cfg=tiny)
+    assert r["decode_tokens_per_sec"] > 0
+
+
 def test_long_context_bench_runs():
     from tpu_dra_driver.workloads.ops import (
         flash_attention_long_context_tflops,

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -129,19 +129,61 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
     return logits, {"k": new_k, "v": new_v}
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps"))
+def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
+                          gen_short: int = 64, gen_long: int = 192,
+                          iters: int = 3,
+                          cfg: "ModelConfig" = None) -> dict:
+    """Greedy-decoding throughput (tokens/s) through the KV-cache path.
+
+    Marginal-rate timing over two generation lengths cancels the prefill
+    and dispatch overhead, so the number is the steady-state per-token
+    decode rate — the latency-bound regime (matvec-shaped attention
+    reads, cache updates) as opposed to the attention benches'
+    FLOP-bound one. Default model: a GQA + RoPE block stack sized so
+    weights stream from HBM like a real (if small) LM."""
+    from tpu_dra_driver.workloads.models.transformer import (
+        ModelConfig as _MC, init_params,
+    )
+    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+
+    cfg = cfg or _MC(vocab=4096, d_model=512, n_heads=8, n_kv_heads=2,
+                     n_layers=4, d_ff=2048, max_seq=prompt_len + gen_long,
+                     use_rope=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (b, prompt_len),
+                                0, cfg.vocab)
+
+    def make_run(n):
+        # identical cache capacity for both chain lengths — otherwise the
+        # shorter run's smaller masked-cache reads would not cancel in
+        # the marginal rate
+        return lambda: generate(params, cfg, prompt, steps=n,
+                                max_t=prompt_len + gen_long)
+
+    per_step = marginal_chain_rate(make_run, gen_short, gen_long, iters)
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    return {"decode_tokens_per_sec": b / per_step,
+            "decode_step_ms": per_step * 1e3,
+            "shape": (f"b{b} L{cfg.n_layers} d{cfg.d_model} "
+                      f"h{cfg.n_heads}/kv{n_kv} "
+                      f"prompt{prompt_len}")}
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "max_t"))
 def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
-             steps: int) -> jax.Array:
+             steps: int, max_t: Optional[int] = None) -> jax.Array:
     """Greedy generation: prompt [b, t0] int32 → [b, t0 + steps].
 
     Prefill runs the prompt through decode steps under ``lax.scan``
     (teacher-forced: cache fills, outputs discarded), then ``steps``
     greedy tokens extend it. Everything static-shape, one compile.
+    ``max_t`` overrides the cache capacity (default t0 + steps) — e.g.
+    to compare runs of different lengths at identical cache cost.
     """
     b, t0 = prompt.shape
     if steps <= 0:
         return prompt
-    max_t = t0 + steps
+    max_t = max(max_t or 0, t0 + steps)
     if max_t > cfg.max_seq and not cfg.use_rope:
         # learned pos_embed table bounds the sequence; RoPE doesn't —
         # with a window the ring cache even keeps memory O(window), so
