@@ -265,6 +265,78 @@ def test_windowed_rope_generation_beyond_max_seq():
     assert np.array_equal(np.asarray(out[:, :3]), np.asarray(prompt))
 
 
+def test_scan_layers_matches_loop():
+    """cfg.scan_layers (lax.scan over [L, ...]-stacked block weights,
+    O(1) compile in depth) must be numerically identical to the Python
+    loop — logits and grads, incl. composed with remat, GQA, window, and
+    MoE. Stacked storage is init_params' layout under the flag; the
+    stack/unstack helpers round-trip it."""
+    from tpu_dra_driver.workloads.models import (
+        forward, stack_layer_params, unstack_layer_params,
+    )
+    import dataclasses
+    for base in (
+        ModelConfig(vocab=64, d_model=64, n_heads=4, n_kv_heads=2,
+                    n_layers=4, d_ff=64, max_seq=32, use_rope=True,
+                    window=8, remat=True, dtype=jnp.float32),
+        ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                    d_ff=64, max_seq=32, n_experts=2, moe_top_k=1,
+                    dtype=jnp.float32),
+    ):
+        scan_cfg = dataclasses.replace(base, scan_layers=True)
+        params = init_params(base, jax.random.PRNGKey(17))
+        stacked = stack_layer_params(params)
+        assert isinstance(stacked["layers"], dict)
+        rt = unstack_layer_params(stacked)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(rt)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        toks = jax.random.randint(jax.random.PRNGKey(18), (2, 32), 0, 64)
+        ref = forward(params, toks, base)
+        # scan over stacked storage AND loop over stacked storage
+        for p, cfg in ((stacked, scan_cfg), (stacked, base),
+                       (params, scan_cfg)):
+            out = forward(p, toks, cfg)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+        gr = jax.grad(lambda p: loss_fn(p, (toks, toks), base))(params)
+        gs = jax.grad(lambda p: loss_fn(p, (toks, toks), scan_cfg))(stacked)
+        gs = unstack_layer_params(gs)
+        for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+
+def test_scan_layers_sharded_train_step():
+    """Stacked storage under the (dp, tp) mesh: param_shardings applies
+    the Megatron rules at the per-layer rank with the stack axis
+    replicated, and a jitted sharded train step runs."""
+    import dataclasses
+    from tpu_dra_driver.workloads.models import forward
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=4, n_layers=4,
+                      d_ff=128, max_seq=32, scan_layers=True,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(19))
+    assert isinstance(params["layers"], dict)
+    mesh = build_mesh(jax.devices())
+    shardings = param_shardings(mesh, params)
+    spec = shardings["layers"]["wqkv"].spec
+    assert spec == __import__("jax").sharding.PartitionSpec(None, None, "tp")
+
+    params = jax.device_put(params, shardings)
+    step, opt_init = make_train_step(cfg)
+    toks = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(20), (4, 32), 0, cfg.vocab),
+        batch_sharding(mesh))
+    p, o, loss = jax.jit(step)(params, opt_init(params), (toks, toks))
+    assert float(loss) > 0
+    # decode accepts the stacked storage too
+    from tpu_dra_driver.workloads.models import generate
+    seq = generate(jax.device_put(p, shardings), cfg,
+                   jnp.zeros((1, 2), jnp.int32), steps=3)
+    assert seq.shape == (1, 5)
+
+
 def test_moe_topk_equals_dense_when_k_is_all_experts():
     """With top_k = n_experts and ample capacity nothing is dropped and
     the renormalized top-k softmax equals the full softmax — the sparse

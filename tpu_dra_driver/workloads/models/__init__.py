@@ -5,6 +5,8 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     loss_fn,
     nll_from_logits,
     make_train_step,
+    stack_layer_params,
+    unstack_layer_params,
 )
 from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     decode_step,

@@ -25,6 +25,7 @@ from tpu_dra_driver.workloads.models.transformer import (
     ModelConfig,
     Params,
     _rmsnorm,
+    unstack_layer_params,
 )
 
 NEG_INF = -1e30
@@ -87,6 +88,7 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
         pos_emb = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos, 1, 0)
         x = x + pos_emb[None]
 
+    params = unstack_layer_params(params)    # no-op for list storage
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         xn = _rmsnorm(x, layer["ln1"]["g"])

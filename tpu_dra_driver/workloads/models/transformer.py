@@ -64,9 +64,41 @@ class ModelConfig:
     # switches to a rolling ring-buffer KV cache of length window
     # (Mistral-style), so cache memory is O(window) not O(t).
     window: int = 0
+    # scan_layers runs the block stack as one lax.scan over stacked
+    # [L, ...] weights instead of a Python loop: the block traces and
+    # compiles ONCE regardless of depth (compile time O(1) in n_layers,
+    # the standard XLA pattern for deep models). Layers are stacked
+    # inside forward, so the param pytree and its shardings are
+    # unchanged. Requires homogeneous layers (init_params always builds
+    # them so); composes with remat (checkpoint inside the scan body).
+    scan_layers: bool = False
 
 
 Params = Dict
+
+
+def stack_layer_params(params: Params) -> Params:
+    """[n_layers]-list layer pytrees → one pytree of [L, ...] arrays (the
+    ``scan_layers`` storage layout: leaf count independent of depth, so
+    optimizer/update HLO is O(1) in n_layers too)."""
+    layers = params["layers"]
+    if isinstance(layers, dict):
+        return params
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return out
+
+
+def unstack_layer_params(params: Params) -> Params:
+    """Inverse of :func:`stack_layer_params` (for the per-layer
+    consumers: decode's cache loop, the pipeline's stage stacking)."""
+    layers = params["layers"]
+    if isinstance(layers, list):
+        return params
+    n = jax.tree.leaves(layers)[0].shape[0]
+    out = dict(params)
+    out["layers"] = [jax.tree.map(lambda a: a[i], layers) for i in range(n)]
+    return out
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
@@ -103,6 +135,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             layer["w_up"] = mat(next(k), (cfg.d_model, cfg.d_ff))
             layer["w_down"] = mat(next(k), (cfg.d_ff, cfg.d_model))
         params["layers"].append(layer)
+    if cfg.scan_layers:
+        params = stack_layer_params(params)
     return params
 
 
@@ -242,10 +276,20 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
                                  cfg.moe_capacity_factor)
         return x + _moe(xn2, layer)
 
-    if cfg.remat:
-        block = jax.checkpoint(block)
-    for layer in params["layers"]:
-        x = block(x, layer)
+    if cfg.scan_layers:
+        if cfg.remat:
+            # CSE-prevention barriers are unnecessary under lax.scan
+            # (per jax.checkpoint docs) and only inhibit XLA
+            block = jax.checkpoint(block, prevent_cse=False)
+        stacked = stack_layer_params(params)["layers"]
+        x, _ = jax.lax.scan(lambda x, layer: (block(x, layer), None),
+                            x, stacked)
+    else:
+        if cfg.remat:
+            block = jax.checkpoint(block)
+        layers = unstack_layer_params(params)["layers"]
+        for layer in layers:
+            x = block(x, layer)
     x = _rmsnorm(x, params["final_norm"]["g"])
     return (x @ params["embed"].T).astype(jnp.float32)
 

@@ -111,23 +111,36 @@ def param_shardings(mesh: Mesh, params) -> "jax.tree_util.PyTreeDef":
 
     XLA then emits exactly one psum per block boundary per step direction,
     which is the minimal-collective schedule for this family.
+
+    Works for both layer-param layouts: the per-layer list
+    (``layers/<i>/wqkv``) and the scan_layers stacked dict
+    (``layers/wqkv`` with a leading [L] axis — the rule applies to the
+    unstacked rank and the L axis stays unsharded/replicated so the
+    scan body sees whole per-layer shards).
     """
     ep_ax = "ep" if "ep" in mesh.shape else None
+    stacked = isinstance(params, dict) and isinstance(
+        params.get("layers"), dict)
 
     def rule(path: str, x):
-        if x.ndim < 2:
+        ndim = x.ndim
+        lead = []
+        if stacked and "layers" in path:
+            ndim -= 1                   # rules see the per-layer rank
+            lead = [None]               # the stack axis is unsharded
+        if ndim < 2:
             return NamedSharding(mesh, P())
         # MoE expert banks: expert dim over ep, then Megatron within expert
         if "moe_up" in path:
-            return NamedSharding(mesh, P(ep_ax, None, "tp"))
+            return NamedSharding(mesh, P(*lead, ep_ax, None, "tp"))
         if "moe_down" in path:
-            return NamedSharding(mesh, P(ep_ax, "tp", None))
+            return NamedSharding(mesh, P(*lead, ep_ax, "tp", None))
         if "router" in path:
             return NamedSharding(mesh, P())
         if any(k in path for k in ("wqkv", "w_up", "w_gate")):
-            return NamedSharding(mesh, P(None, "tp"))
+            return NamedSharding(mesh, P(*lead, None, "tp"))
         if any(k in path for k in ("wo", "w_down")):
-            return NamedSharding(mesh, P("tp", None))
+            return NamedSharding(mesh, P(*lead, "tp", None))
         if "embed" in path:
             return NamedSharding(mesh, P("tp", None))
         return NamedSharding(mesh, P())

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dra_driver.workloads.models.transformer import (
     ModelConfig, _attention, _mlp, _rmsnorm, nll_from_logits,
+    unstack_layer_params,
 )
 
 # stage-stacked parameter keys -> how many leading stack dims they carry
@@ -170,6 +171,7 @@ def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
 
 def params_to_pp(params: Dict, n_stages: int) -> Dict:
     """Convert transformer.init_params output to the pipeline layout."""
+    params = unstack_layer_params(params)    # no-op for list storage
     return {
         "embed": params["embed"],
         "pos_embed": params["pos_embed"],
