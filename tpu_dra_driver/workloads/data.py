@@ -1,0 +1,138 @@
+"""Input pipeline: host-side batch packing + async device prefetch.
+
+The TPU input recipe: the host prepares the next batches (NumPy, no jax
+tracing) while the device computes, and a background thread pushes them
+to HBM ahead of need — so the accelerator never stalls on input. This is
+the data-loader tier of the framework (the reference driver has none;
+its jobs synthesize data in-kernel), built TPU-first:
+
+- ``packed_lm_batches``: streams documents into fixed-shape [b, t]
+  next-token batches by *packing* — documents are concatenated with a
+  separator and sliced into contiguous windows, so no padding waste and
+  every step has identical (static) shapes for XLA.
+- ``prefetch_to_device``: wraps any host-batch iterator; a daemon thread
+  ``jax.device_put``s up to ``size`` batches ahead (optionally with a
+  NamedSharding, so dp/sp-sharded inputs land directly on their shards
+  and never materialize unsharded), overlapping H2D DMA with compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def packed_lm_batches(documents: Iterable[np.ndarray], batch: int, seq: int,
+                      sep_token: int = 0,
+                      drop_remainder: bool = True
+                      ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Pack variable-length token documents into (tokens, targets)
+    next-token-prediction batches of static shape [batch, seq].
+
+    Documents are joined with ``sep_token`` into one contiguous stream;
+    each row is a ``seq + 1`` window (inputs = w[:-1], targets = w[1:]).
+    Static shapes at every step — the XLA requirement — with zero pad
+    tokens. The remainder that doesn't fill a final batch is dropped
+    unless ``drop_remainder=False`` (then the last batch repeats the
+    stream tail to fill, still static-shape).
+    """
+    if batch < 1 or seq < 1:
+        raise ValueError(f"batch ({batch}) and seq ({seq}) must be >= 1")
+    need = batch * (seq + 1)
+    sep = np.array([sep_token], np.int32)
+    # accumulate chunks and concatenate only when a batch's worth is
+    # ready — O(total_tokens), not O(n_docs * batch*seq)
+    chunks, total = [], 0
+    for doc in documents:
+        doc = np.asarray(doc, dtype=np.int32).ravel()
+        chunks += [doc, sep]
+        total += len(doc) + 1
+        if total < need:
+            continue
+        buf = np.concatenate(chunks)
+        while len(buf) >= need:
+            rows = buf[:need].reshape(batch, seq + 1)
+            buf = buf[need:]
+            yield rows[:, :-1].copy(), rows[:, 1:].copy()
+        chunks, total = [buf], len(buf)
+    if not drop_remainder and total >= 2:
+        buf = np.concatenate(chunks)
+        reps = -(-need // len(buf))
+        rows = np.tile(buf, reps)[:need].reshape(batch, seq + 1)
+        yield rows[:, :-1].copy(), rows[:, 1:].copy()
+
+
+def prefetch_to_device(batches: Iterable[Any], size: int = 2,
+                       sharding: Optional[Any] = None,
+                       put: Optional[Callable[[Any], Any]] = None
+                       ) -> Iterator[Any]:
+    """Iterate ``batches`` with up to ``size`` of them already resident
+    on device.
+
+    A daemon thread pulls host batches and ``jax.device_put``s them
+    (each leaf; with ``sharding`` they land pre-sharded — pass the
+    batch NamedSharding from ``parallel.batch_sharding``). jax's async
+    dispatch makes device_put non-blocking on the producer side, so the
+    thread's only job is staying ``size`` batches ahead; the consumer
+    gets device arrays whose H2D copies were issued during the previous
+    step's compute. Exceptions in the source iterator propagate to the
+    consumer at the point of the failed batch.
+    """
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+    if put is not None and sharding is not None:
+        raise ValueError("pass either sharding or a custom put, not both "
+                         "(a custom put owns placement)")
+    if put is None:
+        def put(b):
+            return jax.tree.map(
+                lambda x: jax.device_put(x, sharding), b)
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    _END = object()
+
+    def send(item) -> bool:
+        """Blocking put that aborts when the consumer went away."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for b in batches:
+                if stop.is_set() or not send(put(b)):
+                    return
+        except BaseException as e:          # propagate to consumer
+            send((_END, e))
+            return
+        send((_END, None))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] is _END):
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        # consumer abandoned the loop (break / NaN bail / GeneratorExit):
+        # release the producer and the buffered device batches
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
