@@ -337,6 +337,50 @@ def test_scan_layers_sharded_train_step():
     assert seq.shape == (1, 5)
 
 
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=N (scan over microbatches, one optimizer update) must
+    reproduce the full-batch step: equal microbatch sizes make the
+    averaged microbatch grads exactly the full-batch mean."""
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=64, max_seq=16, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(21))
+    toks = jax.random.randint(jax.random.PRNGKey(22), (8, 16), 0, 64)
+    batch = (toks, toks)
+
+    import optax
+    outs = {}
+    for n in (1, 4):
+        step, opt_init = make_train_step(
+            cfg, optimizer=optax.adamw(1e-3), accum_steps=n)
+        p, o, loss = jax.jit(step)(params, opt_init(params), batch)
+        outs[n] = (p, float(loss))
+    assert abs(outs[1][1] - outs[4][1]) < 1e-5
+    for a, b in zip(jax.tree.leaves(outs[1][0]), jax.tree.leaves(outs[4][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="divisible"):
+        step, opt_init = make_train_step(cfg, accum_steps=3)
+        jax.jit(step)(params, opt_init(params), batch)
+
+
+def test_default_optimizer_trains_with_warmup_and_clipping():
+    from tpu_dra_driver.workloads.models import default_optimizer
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=1,
+                      d_ff=64, max_seq=16, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(23))
+    opt = default_optimizer(lr=1e-3, warmup_steps=2, total_steps=20)
+    step, opt_init = make_train_step(cfg, optimizer=opt)
+    st = jax.jit(step)
+    o = opt_init(params)
+    toks = jax.random.randint(jax.random.PRNGKey(24), (4, 16), 0, 64)
+    losses = []
+    for _ in range(8):
+        params, o, loss = st(params, o, (toks, toks))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
 def test_moe_topk_equals_dense_when_k_is_all_experts():
     """With top_k = n_experts and ample capacity nothing is dropped and
     the renormalized top-k softmax equals the full softmax — the sparse

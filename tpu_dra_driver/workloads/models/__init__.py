@@ -1,5 +1,6 @@
 from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     ModelConfig,
+    default_optimizer,
     init_params,
     forward,
     loss_fn,

@@ -308,14 +308,66 @@ def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
     return nll_from_logits(forward(params, tokens, cfg, attn_fn), targets)
 
 
-def make_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None):
+def default_optimizer(lr: float = 3e-4, warmup_steps: int = 100,
+                      total_steps: int = 10_000, clip_norm: float = 1.0,
+                      weight_decay: float = 0.1):
+    """The standard LM training recipe: global-norm gradient clipping +
+    AdamW on a linear-warmup cosine-decay schedule. One optax chain —
+    pure pytree transforms, shards with whatever the params shard as
+    (incl. ZeRO-1 via zero1_opt_shardings)."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
+        decay_steps=total_steps, end_value=lr * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(schedule, weight_decay=weight_decay),
+    )
+
+
+def make_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,
+                    accum_steps: int = 1):
     """Returns (train_step, init_opt_state). train_step is pure/jittable:
-    (params, opt_state, batch) -> (params, opt_state, loss)."""
+    (params, opt_state, batch) -> (params, opt_state, loss).
+
+    ``accum_steps > 1`` splits the batch into that many microbatches and
+    accumulates gradients over a ``lax.scan`` before the single optimizer
+    update — activation memory drops by ~accum_steps at identical
+    numerics (the scan averages microbatch grads; equal microbatch sizes
+    make that exactly the full-batch mean). The batch dim must divide.
+    """
     opt = optimizer or optax.adamw(1e-3)
+    grad_fn = jax.value_and_grad(partial(loss_fn, cfg=cfg, attn_fn=attn_fn))
+
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(
-            partial(loss_fn, cfg=cfg, attn_fn=attn_fn))(params, batch)
+        if accum_steps == 1:
+            loss, grads = grad_fn(params, batch)
+        else:
+            tokens, targets = batch
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum_steps}")
+            mb = b // accum_steps
+            micro = (tokens.reshape(accum_steps, mb, *tokens.shape[1:]),
+                     targets.reshape(accum_steps, mb, *targets.shape[1:]))
+
+            def body(carry, mbatch):
+                gsum, lsum = carry
+                loss, g = grad_fn(params, mbatch)
+                return (jax.tree.map(jnp.add, gsum, g), lsum + loss), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (gsum, lsum), _ = jax.lax.scan(body, (zeros, 0.0), micro)
+            # accumulate in f32 but hand the optimizer param-dtype grads,
+            # exactly like the accum_steps=1 path — otherwise bf16 Adam
+            # moments silently flip to f32 (and the jit retraces)
+            grads = jax.tree.map(
+                lambda g, p: (g / accum_steps).astype(p.dtype), gsum, params)
+            loss = lsum / accum_steps
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
