@@ -331,3 +331,4 @@ def test_fake_backend_mode_relaxes_hardware_requirements():
     script = open(os.path.join(
         REPO, "demo/clusters/kind/install-dra-driver-tpu.sh")).read()
     assert '${DEVICE_BACKEND:-fake}' in script
+

@@ -1,0 +1,20 @@
+"""Subprocess smoke test for the flagship training demo
+(demo/run_training_demo.py): claim -> sharded training -> crash ->
+bit-identical resume -> clean unprepare. Kept out of the fast asset
+checks — this compiles and trains a real (small) model."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_training_demo_end_to_end():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "demo", "run_training_demo.py")],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Training demo OK" in out.stdout
+    assert "resume bit-identical" in out.stdout
+    assert "dp=1 tp=4" in out.stdout      # the claim's 4 chips, really
