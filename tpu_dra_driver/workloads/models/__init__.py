@@ -12,6 +12,7 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
 from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     decode_step,
     decode_tokens_per_sec,
+    evaluate_nll,
     generate,
     init_kv_cache,
 )

@@ -20,6 +20,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_dra_driver.workloads.models.transformer import (
     ModelConfig,
@@ -171,27 +172,65 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
                       f"prompt{prompt_len}")}
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "max_t"))
 def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
-             steps: int, max_t: Optional[int] = None) -> jax.Array:
-    """Greedy generation: prompt [b, t0] int32 → [b, t0 + steps].
+             steps: int, max_t: Optional[int] = None,
+             temperature: float = 0.0, top_k: int = 0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Generation: prompt [b, t0] int32 → [b, t0 + steps].
 
     Prefill runs the prompt through decode steps under ``lax.scan``
     (teacher-forced: cache fills, outputs discarded), then ``steps``
-    greedy tokens extend it. Everything static-shape, one compile.
-    ``max_t`` overrides the cache capacity (default t0 + steps) — e.g.
-    to compare runs of different lengths at identical cache cost.
+    tokens extend it. Everything static-shape, one compile. ``max_t``
+    overrides the cache capacity (default t0 + steps) — e.g. to compare
+    runs of different lengths at identical cache cost.
+
+    Decoding rule: ``temperature == 0`` (default) is greedy argmax;
+    ``temperature > 0`` samples ``categorical(logits / temperature)``
+    (requires ``key``), optionally truncated to the ``top_k`` highest
+    logits first. The sampling key is split per step inside the scan —
+    one fixed-shape PRNG chain, no host round-trips. Only the
+    greedy-vs-sampling choice and ``top_k`` are compile-time: sweeping
+    temperatures reuses one compiled program.
     """
-    b, t0 = prompt.shape
     if steps <= 0:
         return prompt
-    max_t = max(max_t or 0, t0 + steps)
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if top_k > 0 and temperature == 0:
+        raise ValueError("top_k has no effect at temperature=0 (greedy); "
+                         "set temperature > 0 to sample")
+    if top_k < 0 or top_k > cfg.vocab:
+        raise ValueError(f"top_k must be in [0, vocab={cfg.vocab}], "
+                         f"got {top_k}")
+    max_t = max(max_t or 0, prompt.shape[1] + steps)
     if max_t > cfg.max_seq and not cfg.use_rope:
         # learned pos_embed table bounds the sequence; RoPE doesn't —
         # with a window the ring cache even keeps memory O(window), so
         # rope+window generation length is unbounded
         raise ValueError(f"t0+steps ({max_t}) exceeds max_seq {cfg.max_seq}")
+    if key is None:
+        key = jax.random.PRNGKey(0)          # unused on the greedy path
+    return _generate(params, cfg, prompt, steps, max_t,
+                     temperature > 0, top_k, jnp.float32(temperature), key)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "steps", "max_t", "sample", "top_k"))
+def _generate(params, cfg, prompt, steps, max_t, sample, top_k,
+              temperature, key):
+    b, t0 = prompt.shape
     cache = init_kv_cache(cfg, b, max_t)
+
+    def pick(logits, k):
+        if not sample:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        s = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(s, top_k)[0][..., -1:]   # [b, 1]
+            s = jnp.where(s >= kth, s, NEG_INF)
+        return jax.random.categorical(k, s, axis=-1).astype(prompt.dtype)
 
     def prefill_body(carry, tok):
         cache, pos = carry
@@ -202,15 +241,42 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
         prefill_body, (cache, jnp.int32(0)), prompt.T)   # scan over time
 
     def gen_body(carry, _):
-        cache, pos, tok = carry
+        cache, pos, tok, k = carry
         logits, cache = decode_step(params, cfg, cache, pos, tok)
-        nxt = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return (cache, pos + 1, nxt), nxt
+        k, sub = jax.random.split(k)
+        nxt = pick(logits, sub)
+        return (cache, pos + 1, nxt, k), nxt
 
-    first = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+    key, sub = jax.random.split(key)
+    first = pick(logits[-1], sub)
     if steps == 1:
         return jnp.concatenate([prompt, first[:, None]], axis=1)
-    (_, _, _), toks = jax.lax.scan(
-        gen_body, (cache, pos, first), None, length=steps - 1)
+    _, toks = jax.lax.scan(
+        gen_body, (cache, pos, first, key), None, length=steps - 1)
     out = jnp.concatenate([first[:, None], toks.T], axis=1)
     return jnp.concatenate([prompt, out], axis=1)
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_fn"))
+def _eval_loss(params, batch, cfg, attn_fn):
+    from tpu_dra_driver.workloads.models.transformer import loss_fn
+    return loss_fn(params, batch, cfg, attn_fn)
+
+
+def evaluate_nll(params: Params, cfg: ModelConfig, batches,
+                 attn_fn=None) -> Dict[str, float]:
+    """Token-weighted mean negative log-likelihood + perplexity over a
+    host iterator of (tokens, targets) batches (e.g. from
+    ``data.packed_lm_batches``). The jitted forward is cached across
+    calls (module-level jit keyed on (cfg, attn_fn) + shapes), so
+    periodic in-training evals compile once."""
+    total, tokens = 0.0, 0
+    for batch in batches:
+        toks = batch[0]
+        n = int(np.prod(toks.shape))
+        total += float(_eval_loss(params, batch, cfg, attn_fn)) * n
+        tokens += n
+    if tokens == 0:
+        raise ValueError("evaluate_nll got an empty batch iterator")
+    nll = total / tokens
+    return {"nll": nll, "ppl": math.exp(nll), "tokens": tokens}
