@@ -21,6 +21,8 @@ bench: native
 demo:
 	$(PYTHON) demo/run_e2e_demo.py
 	$(PYTHON) demo/run_computedomain_demo.py
+	$(PYTHON) demo/run_multislice_demo.py
+	$(PYTHON) demo/run_training_demo.py
 
 clean:
 	$(MAKE) -C native clean

@@ -212,6 +212,9 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
         raise ValueError(f"t0+steps ({max_t}) exceeds max_seq {cfg.max_seq}")
     if key is None:
         key = jax.random.PRNGKey(0)          # unused on the greedy path
+    # coerce to host types: temperature may arrive as a np/jnp scalar,
+    # and the static `sample` flag must be a hashable Python bool
+    temperature = float(temperature)
     return _generate(params, cfg, prompt, steps, max_t,
                      temperature > 0, top_k, jnp.float32(temperature), key)
 
