@@ -291,6 +291,87 @@ def test_ring_and_ulysses_makers_accept_window():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("prefix", [1, 33, 64, 100, 256, 300])
+def test_flash_prefix_lm_matches_reference(prefix):
+    """Prefix-LM: cols < prefix visible to every row. Prefixes below /
+    at / above the block size, beyond t (→ full bidirectional), fwd."""
+    q, k, v = _qkv(jax.random.PRNGKey(40), t=256)
+    ref = attention_reference(q, k, v, True, prefix=prefix)
+    out = flash_attention(q, k, v, True, 64, 64, prefix=prefix)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    if prefix >= 256:
+        # degenerates to full bidirectional attention
+        full = attention_reference(q, k, v, False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("prefix", [40, 128])
+def test_flash_prefix_lm_gradients(prefix):
+    q, k, v = _qkv(jax.random.PRNGKey(41), t=256, d=32)
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(
+            q, k, v, True, 64, 64, prefix=prefix) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: (attention_reference(
+            q, k, v, True, prefix=prefix) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_flash_prefix_lm_multi_superblock_and_gqa(monkeypatch):
+    import tpu_dra_driver.workloads.ops.attention as A
+    key = jax.random.PRNGKey(42)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 4, 256, 32))
+    k = jax.random.normal(kk, (1, 2, 256, 32))
+    v = jax.random.normal(kv, (1, 2, 256, 32))
+    ref = attention_reference(q, k, v, True, prefix=90)
+    monkeypatch.setattr(A, "_SUPER_KV", 64)
+    out = flash_attention(q, k, v, True, 64, 32, prefix=90)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, True, 64, 32, prefix=90) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (attention_reference(
+        q, k, v, True, prefix=90) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_flash_prefix_lm_bidirectional_prefix_sees_future():
+    """Rows inside the prefix attend bidirectionally: perturbing a
+    future column inside the prefix changes earlier rows' outputs
+    (which plain causal forbids)."""
+    q, k, v = _qkv(jax.random.PRNGKey(43), t=128)
+    base = flash_attention(q, k, v, True, 64, 64, prefix=64)
+    k2 = k.at[:, :, 50, :].set(9.0)
+    v2 = v.at[:, :, 50, :].set(-9.0)
+    pert = flash_attention(q, k2, v2, True, 64, 64, prefix=64)
+    assert not np.allclose(np.asarray(base[:, :, :50]),
+                           np.asarray(pert[:, :, :50]))
+    # but cols beyond the prefix stay causal
+    k3 = k.at[:, :, 100:, :].set(9.0)
+    pert2 = flash_attention(q, k3, v, True, 64, 64, prefix=64)
+    np.testing.assert_allclose(np.asarray(base[:, :, :100]),
+                               np.asarray(pert2[:, :, :100]), atol=1e-6)
+
+
+def test_flash_prefix_rejects_window_combo():
+    q, k, v = _qkv(jax.random.PRNGKey(44), t=64)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        flash_attention(q, k, v, True, prefix=16, window=8)
+    with pytest.raises(ValueError, match="prefix"):
+        flash_attention(q, k, v, False, prefix=16)
+
+
 def test_flash_causality_ignores_future():
     """Perturbing K/V beyond position p must not change output[:p+1]."""
     q, k, v = _qkv(jax.random.PRNGKey(3), t=128)

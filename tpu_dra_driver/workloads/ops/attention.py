@@ -50,17 +50,24 @@ LOG2E = 1.4426950408889634
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
                         window: Optional[int] = None,
-                        row_offset: int = 0) -> jax.Array:
+                        row_offset: int = 0,
+                        prefix: Optional[int] = None) -> jax.Array:
     """Oracle attention. q: [b, h, t, d], k/v: [b, h_kv, tkv, d] with
     h % h_kv == 0 (GQA/MQA: kv heads broadcast over query groups).
     ``window`` (causal only): row r sees cols (r-window, r] — sliding-
     window / local attention. ``row_offset`` (causal only): q rows sit
     at global positions [row_offset, row_offset + t) against cols
-    [0, tkv) — chunked-causal, the ring-attention hop primitive."""
+    [0, tkv) — chunked-causal, the ring-attention hop primitive.
+    ``prefix`` (causal only): cols < prefix are visible to EVERY row —
+    prefix-LM / encoder-decoder-style bidirectional prefix."""
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal=True and window >= 1")
     if row_offset and (not causal or row_offset < 0):
         raise ValueError("row_offset requires causal=True and >= 0")
+    if prefix is not None and (not causal or prefix < 0):
+        raise ValueError("prefix requires causal=True and >= 0")
+    if prefix is not None and window is not None:
+        raise ValueError("prefix and window are mutually exclusive")
     *_, t, d = q.shape
     tkv = k.shape[2]
     h, h_kv = q.shape[1], k.shape[1]
@@ -76,6 +83,8 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
         mask = rows >= cols
         if window is not None:
             mask = mask & (rows - cols < window)
+        if prefix is not None:
+            mask = mask | (cols < prefix)
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     if mask is not None:
@@ -88,7 +97,8 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                   block_q: int, block_kv: int, causal: bool, sm_scale: float,
-                  num_super: int, window=None, row_offset: int = 0):
+                  num_super: int, window=None, row_offset: int = 0,
+                  prefix=None):
     """One (batch*kv-head, q-group, q-block, kv-superblock) grid cell.
 
     GQA: the grid's axis 1 walks the query heads sharing this cell's KV
@@ -143,6 +153,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
                 vis = row_ids >= col_ids
                 if window is not None:
                     vis &= row_ids - col_ids < window
+                if prefix is not None:
+                    vis |= col_ids < prefix
                 s = jnp.where(vis, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp2(s - m_new)
@@ -166,7 +178,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), carry)
         lower, full_lo, full_hi, upper = _kv_band_bounds(
-            row_min, row_max, sj * super_kv, block_kv, nb, window)
+            row_min, row_max, sj * super_kv, block_kv, nb, window, prefix)
         carry = jax.lax.fori_loop(
             lower, full_lo, functools.partial(body, masked=True), carry)
         carry = jax.lax.fori_loop(
@@ -189,29 +201,45 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
     if causal and window is not None:
         live &= (sj * super_kv + super_kv - 1
                  >= row_min - window + 1)
+    if causal and prefix is not None:
+        live |= sj * super_kv < prefix
     _grid_accumulate(num_super, sj, live, steps, finish,
                      (acc_sc, m_sc, l_sc), zeros)
 
 
-def _kv_band_bounds(row_min, row_max, base, block_kv, nb, window):
+def _kv_band_bounds(row_min, row_max, base, block_kv, nb, window,
+                    prefix=None):
     """KV block-index bounds for one q block walking one superblock.
 
     Rows [row_min, row_max] see cols [row_min - window + 1, row_max]
-    (window None → [0, row_max]); the superblock starts at col ``base``
-    and holds ``nb`` blocks of ``block_kv``. Returns (lower, full_lo,
-    full_hi, upper): [lower, full_lo) and [full_hi, upper) straddle the
-    band's edges and take the masked path, [full_lo, full_hi) is wholly
-    inside the band (mask-free), blocks outside [lower, upper) are
-    skipped. Shared by the forward and dq kernels, whose walks are
-    identical; dkv walks q blocks for a kv block (the transpose)."""
-    upper = jnp.minimum(nb, (row_max - base) // block_kv + 1)
-    full_hi = jnp.clip((row_min - base + 1) // block_kv, 0, upper)
-    if window is None:
-        return 0, 0, full_hi, upper
-    lower = jnp.clip((row_min - window + 1 - base) // block_kv, 0, upper)
-    full_lo = jnp.clip(-(-(row_max - window + 1 - base) // block_kv),
-                       lower, full_hi)
-    return lower, full_lo, full_hi, upper
+    (window None → [0, row_max]; with ``prefix``, cols < prefix are
+    additionally visible to every row); the superblock starts at col
+    ``base`` and holds ``nb`` blocks of ``block_kv``. Returns (lower,
+    full_lo, full_hi, upper): [lower, full_lo) and [full_hi, upper)
+    straddle the band's edges and take the masked path,
+    [full_lo, full_hi) is wholly inside the band (mask-free), blocks
+    outside [lower, upper) are skipped. Shared by the forward and dq
+    kernels, whose walks are identical; dkv walks q blocks for a kv
+    block (the transpose). window and prefix are mutually exclusive
+    (enforced upstream)."""
+    if prefix is None:
+        upper = jnp.minimum(nb, (row_max - base) // block_kv + 1)
+        full_hi = jnp.clip((row_min - base + 1) // block_kv, 0, upper)
+        if window is None:
+            return 0, 0, full_hi, upper
+        lower = jnp.clip((row_min - window + 1 - base) // block_kv,
+                         0, upper)
+        full_lo = jnp.clip(-(-(row_max - window + 1 - base) // block_kv),
+                           lower, full_hi)
+        return lower, full_lo, full_hi, upper
+    # prefix-LM: visible cols = [0, prefix) ∪ [0, row] — upper extends to
+    # the prefix end for rows above it, and the mask-free region grows to
+    # blocks wholly inside max(causal prefix of row_min, the prefix)
+    upper = jnp.minimum(
+        nb, (jnp.maximum(row_max, prefix - 1) - base) // block_kv + 1)
+    full_hi = jnp.clip(
+        (jnp.maximum(row_min + 1, prefix) - base) // block_kv, 0, upper)
+    return 0, 0, full_hi, upper
 
 
 # kv superblock VMEM budget: K + V tiles at [4096, 128] bf16 are 1 MB
@@ -299,13 +327,15 @@ def _gqa_group(q, k):
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
-                   interpret: bool, window=None, row_offset: int = 0):
+                   interpret: bool, window=None, row_offset: int = 0,
+                   prefix=None):
     """Returns (out [b,h,t,d], lse [b*h, 1, t] f32). k/v may carry fewer
     (grouped/multi-query) heads than q, and a different sequence length
     (KV chunks, cross-attention, decode) when non-causal or when
     ``row_offset`` places the q rows at global positions
     [row_offset, row_offset + t) against cols [0, tkv) (chunked-causal:
-    ring hops, block prefill)."""
+    ring hops, block prefill). ``prefix`` marks cols [0, prefix) visible
+    to every row (prefix-LM)."""
     b, h, t, d = q.shape
     tkv = k.shape[2]
     if causal and row_offset == 0 and tkv != t:
@@ -316,6 +346,10 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
         raise ValueError("window requires causal=True and window >= 1")
     if row_offset and (not causal or row_offset < 0):
         raise ValueError("row_offset requires causal=True and >= 0")
+    if prefix is not None and (not causal or prefix < 0):
+        raise ValueError("prefix requires causal=True and >= 0")
+    if prefix is not None and window is not None:
+        raise ValueError("prefix and window are mutually exclusive")
     h_kv, group = _gqa_group(q, k)
     super_kv = _fit_block(_SUPER_KV, tkv)
     block_q = _fit_block(block_q, t)
@@ -331,7 +365,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_kv=block_kv,
         causal=causal, sm_scale=sm_scale, num_super=num_super,
-        window=window, row_offset=row_offset)
+        window=window, row_offset=row_offset, prefix=prefix)
 
     vmem = {"memory_space": pltpu.VMEM}
 
@@ -366,7 +400,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                          dq_ref, acc_sc, *, block_q: int, block_kv: int,
                          causal: bool, sm_scale: float, num_super: int,
-                         window=None, row_offset: int = 0):
+                         window=None, row_offset: int = 0, prefix=None):
     """dq for one (batch*kv-head, q-group, q-block, kv-superblock) cell.
 
     P is rebuilt from (q, k, lse); dS = P * (dP - D); dq = sum_j dS @ K_j
@@ -402,6 +436,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                 vis = row_ids >= col_ids
                 if window is not None:
                     vis &= row_ids - col_ids < window
+                if prefix is not None:
+                    vis |= col_ids < prefix
                 s = jnp.where(vis, s, NEG_INF)
             p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dp = jax.lax.dot_general(                            # dO @ V^T
@@ -417,7 +453,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), acc0)
         lower, full_lo, full_hi, upper = _kv_band_bounds(
-            row_min, row_max, sj * super_kv, block_kv, nb, window)
+            row_min, row_max, sj * super_kv, block_kv, nb, window, prefix)
         acc0 = jax.lax.fori_loop(
             lower, full_lo, functools.partial(body, masked=True), acc0)
         acc0 = jax.lax.fori_loop(
@@ -434,6 +470,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
     if causal and window is not None:
         live &= (sj * super_kv + super_kv - 1
                  >= row_min - window + 1)
+    if causal and prefix is not None:
+        live |= sj * super_kv < prefix
     _grid_accumulate(
         num_super, sj, live,
         steps=lambda carry: (steps(carry[0]),),
@@ -446,7 +484,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                           dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
                           block_kv: int, causal: bool, sm_scale: float,
                           num_super: int, group: int, window=None,
-                          row_offset: int = 0):
+                          row_offset: int = 0, prefix=None):
     """dk/dv for one (batch*kv-head, kv-block, q-group, q-superblock) cell.
 
     dv = sum_i P_i^T @ dO_i; dk = sum_i dS_i^T @ Q_i * scale. The q axis
@@ -485,6 +523,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                 vis = row_ids >= col_ids
                 if window is not None:
                     vis &= row_ids - col_ids < window
+                if prefix is not None:
+                    vis |= col_ids < prefix
                 s = jnp.where(vis, s, NEG_INF)
             p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dv_acc = dv_acc + jax.lax.dot_general(               # P^T @ dO
@@ -515,6 +555,14 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
         first_full = jnp.clip(
             -(-(kv_start + block_kv - 1 - q0) // block_q),
             lower, nb)
+        if prefix is not None:
+            # any prefix col in this kv block → every row block
+            # contributes (masked until wholly below the diagonal); a kv
+            # block wholly inside the prefix is visible everywhere
+            lower = jnp.where(kv_start < prefix, 0, lower)
+            first_full = jnp.clip(
+                jnp.where(kv_start + block_kv <= prefix, 0, first_full),
+                lower, nb)
         if window is None:
             upper = nb
             full_end = nb
@@ -544,6 +592,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
     if causal and window is not None:
         live &= (row_offset + si * super_q
                  <= kv_start + block_kv - 1 + window - 1)
+    if causal and prefix is not None:
+        live |= kv_start < prefix
     _grid_accumulate(
         group * num_super, gi * num_super + si, live, steps, finish,
         (dk_sc, dv_sc),
@@ -553,7 +603,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                     block_kv: int, interpret: bool, g_lse=None, window=None,
-                    row_offset: int = 0):
+                    row_offset: int = 0, prefix=None):
     b, h, t, d = q.shape
     tkv = k.shape[2]
     h_kv, group = _gqa_group(q, k)
@@ -603,7 +653,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_kv=block_kv_dq, causal=causal,
                           sm_scale=sm_scale, num_super=tkv // super_kv,
-                          window=window, row_offset=row_offset),
+                          window=window, row_offset=row_offset,
+                          prefix=prefix),
         grid=(b * h_kv, group, t // block_q, tkv // super_kv),
         in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
         out_specs=q_outer,
@@ -618,7 +669,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                           block_kv=block_kv, causal=causal,
                           sm_scale=sm_scale, num_super=t // super_q,
                           group=group, window=window,
-                          row_offset=row_offset),
+                          row_offset=row_offset, prefix=prefix),
         grid=(b * h_kv, tkv // block_kv, group, t // super_q),
         in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
         out_specs=(kv_outer, kv_outer),
@@ -642,13 +693,14 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 1024,
                     block_kv: int = 512,
                     interpret: Optional[bool] = None,
                     window: Optional[int] = None,
-                    row_offset: int = 0) -> jax.Array:
+                    row_offset: int = 0,
+                    prefix: Optional[int] = None) -> jax.Array:
     """Blockwise flash attention. q/k/v: [b, h, t, d] → [b, h, t, d].
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
@@ -660,42 +712,47 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     positions [row_offset, row_offset + t_q) against cols [0, t_kv),
     so a q chunk can attend a longer (or rotated ring) KV chunk with
     exact causal/window semantics and banded block skipping.
+    ``prefix`` (causal only, exclusive with window): cols [0, prefix)
+    are visible to every row — prefix-LM / bidirectional-prefix
+    (T5/PaLM-style); prefix >= t degenerates to full bidirectional.
     """
     if interpret is None:
         interpret = not _on_tpu()
     out, _ = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
-                            window, row_offset)
+                            window, row_offset, prefix)
     return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret, window,
-               row_offset):
+               row_offset, prefix):
     if interpret is None:
         interpret = not _on_tpu()
     out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
-                              window, row_offset)
+                              window, row_offset, prefix)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_kv, interpret, window, row_offset,
-               residuals, g):
+               prefix, residuals, g):
     q, k, v, out, lse = residuals
     if interpret is None:   # nondiff arg: static, resolved the same way
         interpret = not _on_tpu()
     return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv,
-                           interpret, window=window, row_offset=row_offset)
+                           interpret, window=window, row_offset=row_offset,
+                           prefix=prefix)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              causal: bool = True, block_q: int = 1024,
                              block_kv: int = 512,
                              interpret: Optional[bool] = None,
                              window: Optional[int] = None,
-                             row_offset: int = 0):
+                             row_offset: int = 0,
+                             prefix: Optional[int] = None):
     """Like ``flash_attention`` but also returns the per-row natural-log
     logsumexp ``[b, h, t]`` (f32). The pair (out, lse) is the mergeable
     *partial attention* form: results over disjoint KV chunks combine
@@ -707,30 +764,30 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     if interpret is None:
         interpret = not _on_tpu()
     out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
-                              window, row_offset)
+                              window, row_offset, prefix)
     b, h, t, _ = q.shape
     return out, lse.reshape(b, h, t)
 
 
 def _flash_lse_fwd(q, k, v, causal, block_q, block_kv, interpret, window,
-                   row_offset):
+                   row_offset, prefix):
     if interpret is None:
         interpret = not _on_tpu()
     out, lse = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
-                              window, row_offset)
+                              window, row_offset, prefix)
     b, h, t, _ = q.shape
     return (out, lse.reshape(b, h, t)), (q, k, v, out, lse)
 
 
 def _flash_lse_bwd(causal, block_q, block_kv, interpret, window, row_offset,
-                   residuals, g):
+                   prefix, residuals, g):
     q, k, v, out, lse = residuals
     g_out, g_lse = g
     if interpret is None:
         interpret = not _on_tpu()
     return _flash_backward(q, k, v, out, lse, g_out, causal, block_q,
                            block_kv, interpret, g_lse=g_lse, window=window,
-                           row_offset=row_offset)
+                           row_offset=row_offset, prefix=prefix)
 
 
 flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
