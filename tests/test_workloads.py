@@ -431,6 +431,78 @@ def test_evaluate_nll_matches_loss_fn():
         evaluate_nll(params, cfg, iter([]))
 
 
+def test_block_prefill_matches_sequential():
+    """Block prefill (one wide forward) must produce the same cache and
+    next-token logits as stepping the prompt through decode_step."""
+    from tpu_dra_driver.workloads.models import (
+        ModelConfig, block_prefill, decode_step, init_kv_cache, init_params,
+    )
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=16, use_rope=True,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(50))
+    toks = jax.random.randint(jax.random.PRNGKey(51), (2, 10), 0, 64)
+
+    cache_a = init_kv_cache(cfg, 2, 16)
+    for t in range(10):
+        logits_seq, cache_a = decode_step(params, cfg, cache_a,
+                                          jnp.int32(t), toks[:, t])
+    logits_blk, cache_b, pos = block_prefill(
+        params, cfg, init_kv_cache(cfg, 2, 16), toks)
+    assert int(pos) == 10
+    np.testing.assert_allclose(np.asarray(logits_blk),
+                               np.asarray(logits_seq), atol=1e-4, rtol=1e-4)
+    for a, b in zip(cache_a["k"] + cache_a["v"],
+                    cache_b["k"] + cache_b["v"]):
+        np.testing.assert_allclose(np.asarray(a[:, :, :10]),
+                                   np.asarray(b[:, :, :10]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_prefix_lm_generation_matches_oracle():
+    """prefix_lm=True: the prompt attends bidirectionally, the generated
+    suffix causally — every emitted token must match iterated full
+    forwards with attention_reference(prefix=t0)."""
+    from functools import partial as fpartial
+    from tpu_dra_driver.workloads.models import (
+        ModelConfig, forward, generate, init_params,
+    )
+    from tpu_dra_driver.workloads.ops.attention import attention_reference
+    cfg = ModelConfig(vocab=48, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=64, max_seq=16, use_rope=True,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(52))
+    prompt = jax.random.randint(jax.random.PRNGKey(53), (2, 5), 0, 48)
+    out = generate(params, cfg, prompt, steps=6, prefix_lm=True)
+
+    seq = prompt
+    for _ in range(6):
+        logits = forward(params, seq, cfg,
+                         attn_fn=fpartial(attention_reference, prefix=5))
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+    # bidirectionality is real: prefix vs causal logits differ (argmax
+    # can coincide on an untrained model, so compare logits not tokens)
+    lp = forward(params, prompt, cfg,
+                 attn_fn=fpartial(attention_reference, prefix=5))
+    lc = forward(params, prompt, cfg)
+    assert not np.allclose(np.asarray(lp[:, 0]), np.asarray(lc[:, 0]))
+
+
+def test_prefix_lm_rejects_windowed_cache():
+    from tpu_dra_driver.workloads.models import (
+        ModelConfig, generate, init_params,
+    )
+    cfg = ModelConfig(vocab=48, d_model=64, n_heads=4, n_layers=1,
+                      d_ff=64, max_seq=16, use_rope=True, window=4,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(54))
+    prompt = jax.random.randint(jax.random.PRNGKey(55), (1, 4), 0, 48)
+    with pytest.raises(ValueError, match="prefix_lm"):
+        generate(params, cfg, prompt, steps=2, prefix_lm=True)
+
+
 def test_moe_topk_equals_dense_when_k_is_all_experts():
     """With top_k = n_experts and ample capacity nothing is dropped and
     the renormalized top-k softmax equals the full softmax — the sparse

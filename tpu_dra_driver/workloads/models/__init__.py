@@ -10,6 +10,7 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     unstack_layer_params,
 )
 from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
+    block_prefill,
     decode_step,
     decode_tokens_per_sec,
     evaluate_nll,

@@ -75,6 +75,65 @@ def _decode_attention(q, k_cache, v_cache, pos):
     return jnp.einsum("bhqt,bhtd->bhqd", p, v_cache)
 
 
+def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
+                  tokens: jax.Array, attn_fn=None,
+                  prefix_lm: bool = False):
+    """Fill the KV cache from a whole [b, t0] prompt in ONE forward.
+
+    The scan prefill steps one token at a time — t0 sequential matvec
+    layers; this runs the block as full [t0]-wide matmuls (the MXU
+    shape), writes each layer's K/V into the cache at positions
+    [0, t0), and returns the last position's logits. ``prefix_lm=True``
+    makes the prompt region bidirectional (attention with
+    ``prefix=t0``) — the T5/PaLM prefix-LM decode, which a sequential
+    prefill cannot express at all. Requires the full-length cache
+    (cfg.window == 0: the ring buffer's wrap layout is sequential by
+    nature). Returns (logits [b, vocab], cache, pos=t0).
+    """
+    from tpu_dra_driver.workloads.ops.attention import attention_reference
+    from tpu_dra_driver.workloads.models.transformer import _ffn
+
+    if cfg.window > 0:
+        raise ValueError("block_prefill requires cfg.window == 0 "
+                         "(ring caches fill sequentially)")
+    b, t0 = tokens.shape
+    params = unstack_layer_params(params)
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    hd = cfg.d_model // cfg.n_heads
+    kv_d = hd * n_kv
+    attn = attn_fn or attention_reference
+    kw = {"prefix": t0} if prefix_lm else {}
+
+    x = params["embed"][tokens]
+    if not cfg.use_rope:
+        x = x + params["pos_embed"][:t0]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        xn = _rmsnorm(x, layer["ln1"]["g"])
+        qkv = xn @ layer["wqkv"]
+        q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_d], axis=-1)
+        q = q.reshape(b, t0, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t0, n_kv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t0, n_kv, hd).transpose(0, 2, 1, 3)
+        if cfg.use_rope:
+            from tpu_dra_driver.workloads.models.transformer import apply_rope
+            q = apply_rope(q)
+            k = apply_rope(k)
+        new_k.append(jax.lax.dynamic_update_slice(
+            cache["k"][li], k.astype(cache["k"][li].dtype), (0, 0, 0, 0)))
+        new_v.append(jax.lax.dynamic_update_slice(
+            cache["v"][li], v.astype(cache["v"][li].dtype), (0, 0, 0, 0)))
+        att = attn(q, k, v, True, **kw)
+        att = att.transpose(0, 2, 1, 3).reshape(b, t0, cfg.d_model)
+        x = x + att @ layer["wo"]
+        x = x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
+
+    x = _rmsnorm(x[:, -1:], params["final_norm"]["g"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)[:, 0]
+    return logits, {"k": new_k, "v": new_v}, jnp.int32(t0)
+
+
 def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
                 pos: jax.Array, token: jax.Array):
     """One token step: token [b] int32 at position ``pos`` (traced scalar)
@@ -115,17 +174,8 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
         att = att.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
         x = x + att @ layer["wo"]
 
-        xn2 = _rmsnorm(x, layer["ln2"]["g"])
-        from tpu_dra_driver.workloads.models.transformer import (
-            _mlp, _moe, _moe_topk,
-        )
-        if "moe_up" not in layer:
-            x = x + _mlp(xn2, layer)
-        elif cfg.moe_top_k > 0:
-            x = x + _moe_topk(xn2, layer, cfg.moe_top_k,
-                              cfg.moe_capacity_factor)
-        else:
-            x = x + _moe(xn2, layer)
+        from tpu_dra_driver.workloads.models.transformer import _ffn
+        x = x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
 
     x = _rmsnorm(x, params["final_norm"]["g"])
     logits = (x @ params["embed"].T).astype(jnp.float32)[:, 0]   # [b, vocab]
@@ -175,14 +225,16 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
 def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
              steps: int, max_t: Optional[int] = None,
              temperature: float = 0.0, top_k: int = 0,
-             key: Optional[jax.Array] = None) -> jax.Array:
+             key: Optional[jax.Array] = None,
+             prefix_lm: bool = False) -> jax.Array:
     """Generation: prompt [b, t0] int32 → [b, t0 + steps].
 
-    Prefill runs the prompt through decode steps under ``lax.scan``
-    (teacher-forced: cache fills, outputs discarded), then ``steps``
-    tokens extend it. Everything static-shape, one compile. ``max_t``
-    overrides the cache capacity (default t0 + steps) — e.g. to compare
-    runs of different lengths at identical cache cost.
+    Prefill fills the KV cache from the prompt (block forward, or a
+    sequential decode-step scan for windowed ring caches — see below),
+    then ``steps`` tokens extend it. Everything static-shape, one
+    compile. ``max_t`` overrides the cache capacity (default t0 +
+    steps) — e.g. to compare runs of different lengths at identical
+    cache cost.
 
     Decoding rule: ``temperature == 0`` (default) is greedy argmax;
     ``temperature > 0`` samples ``categorical(logits / temperature)``
@@ -191,6 +243,12 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
     one fixed-shape PRNG chain, no host round-trips. Only the
     greedy-vs-sampling choice and ``top_k`` are compile-time: sweeping
     temperatures reuses one compiled program.
+
+    Prefill: full-length caches (cfg.window == 0) fill from ONE wide
+    forward (``block_prefill`` — MXU matmuls instead of t0 sequential
+    matvec steps); windowed ring caches use the sequential scan.
+    ``prefix_lm=True`` additionally makes the prompt region
+    bidirectional (T5/PaLM prefix-LM decode; needs the block path).
     """
     if steps <= 0:
         return prompt
@@ -210,19 +268,24 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
         # with a window the ring cache even keeps memory O(window), so
         # rope+window generation length is unbounded
         raise ValueError(f"t0+steps ({max_t}) exceeds max_seq {cfg.max_seq}")
+    if prefix_lm and cfg.window > 0:
+        raise ValueError("prefix_lm needs the block prefill, which the "
+                         "windowed ring cache cannot host (window == 0)")
     if key is None:
         key = jax.random.PRNGKey(0)          # unused on the greedy path
     # coerce to host types: temperature may arrive as a np/jnp scalar,
     # and the static `sample` flag must be a hashable Python bool
     temperature = float(temperature)
     return _generate(params, cfg, prompt, steps, max_t,
-                     temperature > 0, top_k, jnp.float32(temperature), key)
+                     temperature > 0, top_k, jnp.float32(temperature), key,
+                     bool(prefix_lm))
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "steps", "max_t", "sample", "top_k"))
+         static_argnames=("cfg", "steps", "max_t", "sample", "top_k",
+                          "prefix_lm"))
 def _generate(params, cfg, prompt, steps, max_t, sample, top_k,
-              temperature, key):
+              temperature, key, prefix_lm=False):
     b, t0 = prompt.shape
     cache = init_kv_cache(cfg, b, max_t)
 
@@ -235,13 +298,22 @@ def _generate(params, cfg, prompt, steps, max_t, sample, top_k,
             s = jnp.where(s >= kth, s, NEG_INF)
         return jax.random.categorical(k, s, axis=-1).astype(prompt.dtype)
 
-    def prefill_body(carry, tok):
-        cache, pos = carry
-        logits, cache = decode_step(params, cfg, cache, pos, tok)
-        return (cache, pos + 1), logits
+    if cfg.window > 0:
+        # ring cache: fill sequentially (wrap layout is positional);
+        # only the latest logits ride the carry — no [t0, b, vocab]
+        # stack of discarded per-step outputs
+        def prefill_body(carry, tok):
+            cache, pos, _ = carry
+            logits, cache = decode_step(params, cfg, cache, pos, tok)
+            return (cache, pos + 1, logits), None
 
-    (cache, pos), logits = jax.lax.scan(
-        prefill_body, (cache, jnp.int32(0)), prompt.T)   # scan over time
+        zero_logits = jnp.zeros((b, cfg.vocab), jnp.float32)
+        (cache, pos, last_logits), _ = jax.lax.scan(
+            prefill_body, (cache, jnp.int32(0), zero_logits),
+            prompt.T)                                       # over time
+    else:
+        last_logits, cache, pos = block_prefill(
+            params, cfg, cache, prompt, prefix_lm=prefix_lm)
 
     def gen_body(carry, _):
         cache, pos, tok, k = carry
@@ -251,7 +323,7 @@ def _generate(params, cfg, prompt, steps, max_t, sample, top_k,
         return (cache, pos + 1, nxt, k), nxt
 
     key, sub = jax.random.split(key)
-    first = pick(logits[-1], sub)
+    first = pick(last_logits, sub)
     if steps == 1:
         return jnp.concatenate([prompt, first[:, None]], axis=1)
     _, toks = jax.lax.scan(

@@ -256,6 +256,17 @@ def _moe_topk(x: jax.Array, layer: Params, top_k: int,
     return jnp.einsum("btec,becd->btd", combine.astype(x.dtype), out)
 
 
+def _ffn(xn2: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
+    """The block's FFN half: dense MLP, dense-gated MoE, or top-k MoE by
+    config/params — shared by training forward, decode, and prefill so
+    the dispatch can't desynchronize."""
+    if "moe_up" not in layer:
+        return _mlp(xn2, layer)
+    if cfg.moe_top_k > 0:
+        return _moe_topk(xn2, layer, cfg.moe_top_k, cfg.moe_capacity_factor)
+    return _moe(xn2, layer)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             attn_fn=None) -> jax.Array:
     """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
@@ -268,13 +279,7 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
         x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
                            cfg.n_heads, cfg.n_kv_heads, attn_fn,
                            use_rope=cfg.use_rope, window=cfg.window)
-        xn2 = _rmsnorm(x, layer["ln2"]["g"])
-        if "moe_up" not in layer:
-            return x + _mlp(xn2, layer)
-        if cfg.moe_top_k > 0:
-            return x + _moe_topk(xn2, layer, cfg.moe_top_k,
-                                 cfg.moe_capacity_factor)
-        return x + _moe(xn2, layer)
+        return x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
 
     if cfg.scan_layers:
         if cfg.remat:
