@@ -5,6 +5,7 @@ import jax
 import numpy as np
 import jax.numpy as jnp
 import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dra_driver.workloads.models import (
     ModelConfig,
@@ -488,6 +489,70 @@ def test_prefix_lm_generation_matches_oracle():
                  attn_fn=fpartial(attention_reference, prefix=5))
     lc = forward(params, prompt, cfg)
     assert not np.allclose(np.asarray(lp[:, 0]), np.asarray(lc[:, 0]))
+
+
+def test_prefix_lm_model_config_trains_and_matches_flash():
+    """cfg.prefix wires prefix-LM attention through the model: windowed
+    oracle forward == flash forward, trains end-to-end, and generate()
+    auto-enables the bidirectional prefill."""
+    from tpu_dra_driver.workloads.models import forward, generate
+    from tpu_dra_driver.workloads.ops.attention import flash_attention
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=64, max_seq=32, use_rope=True, prefix=8,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(56))
+    toks = jax.random.randint(jax.random.PRNGKey(57), (2, 32), 0, 64)
+    ref = forward(params, toks, cfg)                   # prefix oracle
+    out = forward(params, toks, cfg, attn_fn=flash_attention)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    step, opt_init = make_train_step(cfg, attn_fn=flash_attention)
+    p, o, loss = jax.jit(step)(params, opt_init(params), (toks, toks))
+    assert float(loss) > 0
+    seq = generate(params, cfg, toks[:, :6], steps=4)  # auto prefix_lm
+    assert seq.shape == (2, 10)
+
+
+def test_prefix_loss_excludes_bidirectional_region():
+    """With cfg.prefix the loss must count only suffix positions — the
+    bidirectional region can attend its own targets (label leak)."""
+    from tpu_dra_driver.workloads.models import forward, loss_fn
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=1,
+                      d_ff=64, max_seq=16, use_rope=True, prefix=6,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(58))
+    toks = jax.random.randint(jax.random.PRNGKey(59), (2, 16), 0, 64)
+    got = float(loss_fn(params, (toks, toks), cfg))
+    logits = forward(params, toks, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, toks[..., None], axis=-1)[..., 0]
+    want = float(nll[:, 6:].mean())
+    assert abs(got - want) < 1e-6
+
+
+def test_ulysses_supports_prefix_ring_rejects_it():
+    from functools import partial as fpartial
+    from tpu_dra_driver.workloads.parallel.ringattention import (
+        make_ring_attention, make_ulysses_attention,
+    )
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, axis_names=("dp", "tp", "sp"))
+    key = jax.random.PRNGKey(60)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 8, 128, 32))
+    k = jax.random.normal(kk, (2, 8, 128, 32))
+    v = jax.random.normal(kv, (2, 8, 128, 32))
+    from tpu_dra_driver.workloads.ops.attention import attention_reference
+    ref = attention_reference(q, k, v, True, prefix=40)
+    sh = NamedSharding(mesh, P("dp", "tp", "sp", None))
+    args = tuple(jax.device_put(x, sh) for x in (q, k, v))
+    uly = jax.jit(fpartial(
+        make_ulysses_attention(mesh, attn_fn=attention_reference),
+        prefix=40))
+    np.testing.assert_allclose(np.asarray(uly(*args)), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError, match="ring attention does not support"):
+        make_ring_attention(mesh)(q, k, v, prefix=40)
 
 
 def test_prefix_lm_rejects_windowed_cache():

@@ -4,6 +4,7 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     init_params,
     forward,
     loss_fn,
+    loss_positions,
     nll_from_logits,
     make_train_step,
     stack_layer_params,

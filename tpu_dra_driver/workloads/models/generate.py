@@ -226,7 +226,7 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
              steps: int, max_t: Optional[int] = None,
              temperature: float = 0.0, top_k: int = 0,
              key: Optional[jax.Array] = None,
-             prefix_lm: bool = False) -> jax.Array:
+             prefix_lm: Optional[bool] = None) -> jax.Array:
     """Generation: prompt [b, t0] int32 → [b, t0 + steps].
 
     Prefill fills the KV cache from the prompt (block forward, or a
@@ -268,6 +268,10 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
         # with a window the ring cache even keeps memory O(window), so
         # rope+window generation length is unbounded
         raise ValueError(f"t0+steps ({max_t}) exceeds max_seq {cfg.max_seq}")
+    if prefix_lm is None:
+        # default: a prefix-LM-trained model decodes with its prompt as
+        # the bidirectional region; an explicit False stays causal
+        prefix_lm = cfg.prefix > 0
     if prefix_lm and cfg.window > 0:
         raise ValueError("prefix_lm needs the block prefill, which the "
                          "windowed ring cache cannot host (window == 0)")

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +72,11 @@ class ModelConfig:
     # unchanged. Requires homogeneous layers (init_params always builds
     # them so); composes with remat (checkpoint inside the scan body).
     scan_layers: bool = False
+    # prefix > 0 trains a prefix-LM (T5/PaLM style): positions < prefix
+    # attend bidirectionally, the rest causally. Mutually exclusive
+    # with window. Inference-side, generate(prefix_lm=True) makes the
+    # whole prompt the bidirectional region instead of a fixed length.
+    prefix: int = 0
 
 
 Params = Dict
@@ -163,7 +168,8 @@ def apply_rope(x: jax.Array, pos0=0, theta: float = 10000.0) -> jax.Array:
 
 def _attention(x: jax.Array, layer: Params, n_heads: int,
                n_kv_heads: int = 0, attn_fn=None,
-               use_rope: bool = False, window: int = 0) -> jax.Array:
+               use_rope: bool = False, window: int = 0,
+               prefix: int = 0) -> jax.Array:
     """``attn_fn(q, k, v) -> out`` on [b, h, t, hd] tensors; plug point
     for flash_attention / ring_attention / ulysses_attention. Default is
     the shared causal oracle (ops.attention.attention_reference). With
@@ -188,6 +194,8 @@ def _attention(x: jax.Array, layer: Params, n_heads: int,
     attn = attn_fn or attention_reference
     if window > 0:
         attn = partial(attn, window=window)
+    if prefix > 0:
+        attn = partial(attn, prefix=prefix)
     out = attn(qh, kh, heads(v, n_kv))
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
     return out @ layer["wo"]
@@ -278,7 +286,8 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     def block(x, layer):
         x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
                            cfg.n_heads, cfg.n_kv_heads, attn_fn,
-                           use_rope=cfg.use_rope, window=cfg.window)
+                           use_rope=cfg.use_rope, window=cfg.window,
+                           prefix=cfg.prefix)
         return x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
 
     if cfg.scan_layers:
@@ -299,18 +308,36 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
-def nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
+def nll_from_logits(logits: jax.Array, targets: jax.Array,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
     """Mean token-level negative log-likelihood; shared by every trainer
-    (plain, sharded, pipeline) so loss changes land everywhere at once."""
+    (plain, sharded, pipeline) so loss changes land everywhere at once.
+    ``mask`` ([t] or broadcastable bool) selects the positions that
+    count — the prefix-LM trainers exclude the bidirectional region."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    if mask is None:
+        return nll.mean()
+    w = jnp.broadcast_to(mask, nll.shape).astype(nll.dtype)
+    return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def loss_positions(cfg: ModelConfig, t: int) -> Optional[jax.Array]:
+    """Positions whose NLL counts, or None for all. With cfg.prefix the
+    bidirectional region is excluded: position i < prefix - 1 can attend
+    the embedding of its own target token[i+1] (a label leak), so —
+    following the T5/PaLM convention — loss is taken on the suffix
+    only."""
+    if cfg.prefix > 0:
+        return jnp.arange(t) >= cfg.prefix
+    return None
 
 
 def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
             cfg: ModelConfig, attn_fn=None) -> jax.Array:
     tokens, targets = batch
-    return nll_from_logits(forward(params, tokens, cfg, attn_fn), targets)
+    return nll_from_logits(forward(params, tokens, cfg, attn_fn), targets,
+                           loss_positions(cfg, tokens.shape[1]))
 
 
 def default_optimizer(lr: float = 3e-4, warmup_steps: int = 100,

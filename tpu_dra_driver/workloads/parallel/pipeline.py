@@ -26,8 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dra_driver.workloads.models.transformer import (
-    ModelConfig, _attention, _mlp, _rmsnorm, nll_from_logits,
-    unstack_layer_params,
+    ModelConfig, _attention, _mlp, _rmsnorm, loss_positions,
+    nll_from_logits, unstack_layer_params,
 )
 
 # stage-stacked parameter keys -> how many leading stack dims they carry
@@ -68,7 +68,7 @@ def stage_shardings(mesh: Mesh, stacked: Dict, axis_name: str = "pp") -> Dict:
 
 def _apply_stage(stage_p: Dict, x: jax.Array, n_heads: int,
                  n_kv_heads: int = 0, attn_fn=None,
-                 window: int = 0) -> jax.Array:
+                 window: int = 0, prefix: int = 0) -> jax.Array:
     """Run this stage's L blocks on [mb, t, d] activations."""
     n_layers = stage_p["wqkv"].shape[0]
     for i in range(n_layers):
@@ -77,7 +77,8 @@ def _apply_stage(stage_p: Dict, x: jax.Array, n_heads: int,
             "w_up": stage_p["w_up"][i], "w_down": stage_p["w_down"][i],
         }
         x = x + _attention(_rmsnorm(x, stage_p["ln1_g"][i]), layer,
-                           n_heads, n_kv_heads, attn_fn, window=window)
+                           n_heads, n_kv_heads, attn_fn, window=window,
+                           prefix=prefix)
         x = x + _mlp(_rmsnorm(x, stage_p["ln2_g"][i]), layer)
     return x
 
@@ -85,7 +86,7 @@ def _apply_stage(stage_p: Dict, x: jax.Array, n_heads: int,
 def pipeline_apply(stacked: Dict, x_mb: jax.Array, *, axis_name: str,
                    n_heads: int, n_stages: int, n_micro: int,
                    n_kv_heads: int = 0, attn_fn=None,
-                   window: int = 0) -> jax.Array:
+                   window: int = 0, prefix: int = 0) -> jax.Array:
     """GPipe schedule; call inside shard_map over ``axis_name``.
 
     stacked: this device's stage slice [1, L, ...]; x_mb: the full
@@ -115,7 +116,7 @@ def pipeline_apply(stacked: Dict, x_mb: jax.Array, *, axis_name: str,
         inject = x_mb[jnp.clip(s, 0, n_micro - 1)]
         xin = jnp.where(is_first, inject, act)
         y = _apply_stage(stage_p, xin, n_heads, n_kv_heads, attn_fn,
-                         window=window)
+                         window=window, prefix=prefix)
         slot = jnp.clip(mb_idx, 0, n_micro - 1)
         out = out.at[slot].set(
             jnp.where(valid & is_last, y.astype(out.dtype), out[slot]))
@@ -148,7 +149,8 @@ def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
         functools.partial(pipeline_apply, axis_name=axis_name,
                           n_heads=cfg.n_heads, n_stages=n_stages,
                           n_micro=n_micro, n_kv_heads=cfg.n_kv_heads,
-                          attn_fn=attn_fn, window=cfg.window),
+                          attn_fn=attn_fn, window=cfg.window,
+                          prefix=cfg.prefix),
         mesh=mesh, in_specs=(spec_stage, P()), out_specs=P())
 
     def forward(pp_params: Dict, tokens: jax.Array) -> jax.Array:
@@ -201,7 +203,8 @@ def make_pp_train_step(mesh: Mesh, cfg: ModelConfig, n_stages: int,
 
     def loss_fn(pp_params, batch):
         tokens, targets = batch
-        return nll_from_logits(forward(pp_params, tokens), targets)
+        return nll_from_logits(forward(pp_params, tokens), targets,
+                               loss_positions(cfg, tokens.shape[1]))
 
     def train_step(pp_params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(pp_params, batch)

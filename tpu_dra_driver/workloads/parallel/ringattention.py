@@ -112,22 +112,29 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = True,
                       attn_fn: Optional[Callable] = None,
-                      window: Optional[int] = None) -> jax.Array:
+                      window: Optional[int] = None,
+                      prefix: Optional[int] = None) -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Re-shards seq-sharded [b, h, t/n, d] into head-sharded [b, h/n, t, d]
     with one all-to-all, runs full-sequence attention per chip (flash
     kernel by default), and re-shards back. Requires h % n == 0.
-    Call inside shard_map over ``axis_name``. ``window`` passes through
-    to the per-chip full-sequence attention (the attn_fn must accept a
-    ``window`` kwarg; flash_attention and attention_reference do).
+    Call inside shard_map over ``axis_name``. ``window`` / ``prefix``
+    pass through to the per-chip full-sequence attention (the attn_fn
+    must accept those kwargs; flash_attention and attention_reference
+    do) — since each chip sees the whole sequence, every mask family
+    works unchanged, including prefix-LM, which the ring cannot host.
     """
     n = jax.lax.axis_size(axis_name)
     h = q.shape[1]
     if h % n:
         raise ValueError(f"ulysses needs heads ({h}) divisible by axis size ({n})")
     fn = attn_fn or (lambda q, k, v, c, **kw: flash_attention(q, k, v, c, **kw))
-    kw = {"window": window} if window is not None else {}
+    kw = {}
+    if window is not None:
+        kw["window"] = window
+    if prefix is not None:
+        kw["prefix"] = prefix
 
     def scatter_heads(x):   # [b, h, tl, d] -> [b, h/n, t, d]
         return jax.lax.all_to_all(x, axis_name, split_axis=1,
@@ -166,7 +173,13 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
                                   causal=causal, window=w)
         return sharded
 
-    def wrapped(q, k, v, window=window):
+    def wrapped(q, k, v, window=window, prefix=None):
+        if prefix is not None:
+            raise ValueError(
+                "ring attention does not support prefix-LM: prefix cols "
+                "would be visible to ring-future devices the causal "
+                "schedule never visits; use Ulysses (full-sequence "
+                "attention per chip) or dp/tp/pp sharding instead")
         return build(window)(q, k, v)
 
     return wrapped
@@ -179,21 +192,23 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
                            window: Optional[int] = None) -> Callable:
     spec = P(batch_axes, head_axis, axis_name, None)
 
-    # check_vma stays ON here: the pallas out_shapes declare their vma
-    # (_sds) and ulysses has no cond/scan carry to trip the checker —
-    # only ring_attention needs the opt-out.
+    # check_vma=False: the flash kernel's banded fori-loop carries mix
+    # q-derived (varying) and zero-init leaves, which the vma checker
+    # flags as a carry mismatch under the pallas interpreter even though
+    # the program is correct (jax suggests exactly this workaround);
+    # first observed with prefix-LM masks, same opt-out as the ring.
     @functools.lru_cache(maxsize=None)
-    def build(w):
-        @functools.partial(jax.shard_map, mesh=mesh,
+    def build(w, p):
+        @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
                            in_specs=(spec, spec, spec), out_specs=spec)
         def sharded(q, k, v):
             return ulysses_attention(q, k, v, axis_name=axis_name,
                                      causal=causal, attn_fn=attn_fn,
-                                     window=w)
+                                     window=w, prefix=p)
         return sharded
 
-    def wrapped(q, k, v, window=window):
-        return build(window)(q, k, v)
+    def wrapped(q, k, v, window=window, prefix=None):
+        return build(window, prefix)(q, k, v)
 
     return wrapped
 
