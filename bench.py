@@ -171,12 +171,29 @@ def bench_accelerator() -> dict:
                 f"{fl['flash_attn_long_ctx_tflops']:.2f} TFLOP/s "
                 f"({fl['shape']}, {fl['long_ctx_step_ms']:.1f} ms/step; "
                 f"the [t,t] reference OOMs at this length)")
-            from tpu_dra_driver.workloads.models import decode_tokens_per_sec
-            dt = decode_tokens_per_sec()
+            from tpu_dra_driver.workloads.models import (
+                ModelConfig, decode_tokens_per_sec,
+            )
+            # HBM-bound size: ~700 MiB of bf16 weights stream per token
+            # step, so the number measures sustained HBM bandwidth (and
+            # the int8 variant its halved-bytes win), not dispatch
+            dcfg = ModelConfig(vocab=8192, d_model=2048, n_heads=16,
+                               n_kv_heads=4, n_layers=8, d_ff=8192,
+                               max_seq=128 + 1056, use_rope=True)
+            dkw = dict(b=8, prompt_len=128, gen_short=32, gen_long=1056,
+                       iters=3, cfg=dcfg)
+            dt = decode_tokens_per_sec(**dkw)
             out["decode_tokens_per_sec"] = round(dt["decode_tokens_per_sec"], 1)
             log(f"  KV-cache greedy decode: "
                 f"{dt['decode_tokens_per_sec']:.0f} tok/s "
                 f"({dt['shape']}, {dt['decode_step_ms']:.2f} ms/token-step)")
+            dq = decode_tokens_per_sec(quantized=True, **dkw)
+            out["decode_tokens_per_sec_int8"] = round(
+                dq["decode_tokens_per_sec"], 1)
+            log(f"  KV-cache greedy decode int8: "
+                f"{dq['decode_tokens_per_sec']:.0f} tok/s "
+                f"({dq['shape']}, {dq['decode_step_ms']:.2f} ms/token-step, "
+                f"params {dq['param_mib']:.0f} MiB vs {dt['param_mib']:.0f})")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out

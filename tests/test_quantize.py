@@ -1,0 +1,158 @@
+"""Int8 weight-only quantization: numerics, structure, and decode parity
+(virtual 8-device CPU mesh via conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra_driver.workloads.models import (
+    ModelConfig,
+    QTensor,
+    forward,
+    generate,
+    init_params,
+    is_quantized,
+    param_bytes,
+    quantize,
+    quantize_params,
+)
+from tpu_dra_driver.workloads.models.quantize import (
+    embed_lookup, lm_head, mm,
+)
+
+CFG = ModelConfig(vocab=256, d_model=128, n_heads=4, n_kv_heads=2,
+                  n_layers=2, d_ff=256, max_seq=64, use_rope=True)
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (128, 64)) * 0.05
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.s.shape == (64,)
+    err = jnp.abs(qt.dequant(jnp.float32) - w)
+    # absmax/127 per column bounds the rounding error at half a step
+    step = jnp.max(jnp.abs(w), axis=0) / 127.0
+    assert float(jnp.max(err / step)) <= 0.51
+
+
+def test_mm_matches_dequant_matmul():
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 32)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 64)).astype(jnp.float32)
+    qt = quantize(w)
+    np.testing.assert_allclose(np.asarray(mm(x, qt)),
+                               np.asarray(x @ qt.dequant(jnp.float32)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_embed_row_quantization_serves_lookup_and_head():
+    embed = jax.random.normal(jax.random.PRNGKey(3), (32, 16)) * 0.2
+    qt = quantize(embed, axis=-1)
+    assert qt.s.shape == (32,)
+    toks = jnp.array([0, 5, 31])
+    got = embed_lookup(qt, toks, jnp.float32)
+    want = qt.dequant(jnp.float32)[toks]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 16)).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(lm_head(x, qt)),
+                               np.asarray(x @ qt.dequant(jnp.float32).T),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_square_embed_dequant_uses_row_scales():
+    # vocab == d_model makes per-row and per-column scale shapes collide;
+    # the stored static axis must disambiguate (regression: shape-based
+    # inference silently applied row scales per column)
+    embed = jax.random.normal(jax.random.PRNGKey(7), (64, 64)) * 0.2
+    qt = quantize(embed, axis=-1)
+    want = np.asarray(qt.q, np.float32) * np.asarray(qt.s)[:, None]
+    np.testing.assert_allclose(np.asarray(qt.dequant(jnp.float32)), want,
+                               rtol=1e-6, atol=1e-6)
+    err = np.abs(np.asarray(qt.dequant(jnp.float32)) - np.asarray(embed))
+    step = np.max(np.abs(np.asarray(embed)), axis=1, keepdims=True) / 127.0
+    assert float(np.max(err / step)) <= 0.51
+
+
+def test_quantize_params_structure_and_bytes():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    assert is_quantized(qp) and not is_quantized(params)
+    assert isinstance(qp["embed"], QTensor)
+    for layer in qp["layers"]:
+        assert isinstance(layer["wqkv"], QTensor)
+        assert isinstance(layer["wo"], QTensor)
+        assert isinstance(layer["w_up"], QTensor)
+        # norm gains stay fp32
+        assert layer["ln1"]["g"].dtype == jnp.float32
+    # bf16 -> int8(+scales): close to half the bytes
+    assert param_bytes(qp) < 0.62 * param_bytes(params)
+
+
+def test_quantized_forward_close_to_fp():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, CFG.vocab)
+    lp = forward(params, toks, CFG)
+    lq = forward(qp, toks, CFG)
+    # logits track closely in cosine terms (per-channel int8, small net)
+    a = np.asarray(lp, np.float64).ravel()
+    b = np.asarray(lq, np.float64).ravel()
+    cos = (a @ b) / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.995, cos
+
+
+def test_quantized_generate_runs_and_mostly_agrees():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, CFG.vocab)
+    out_fp = generate(params, CFG, prompt, steps=12)
+    out_q = generate(qp, CFG, prompt, steps=12)
+    assert out_q.shape == out_fp.shape
+    # greedy argmax is brittle to tiny logit shifts at random init; require
+    # broad agreement, not identity
+    agree = float(jnp.mean((out_fp == out_q).astype(jnp.float32)))
+    assert agree > 0.6, agree
+
+
+def test_quantized_scan_layers_forward():
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=3,
+                      d_ff=128, max_seq=32, scan_layers=True, use_rope=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    # stacked storage: one QTensor with [L, in, out] codes per weight
+    assert isinstance(qp["layers"]["wqkv"], QTensor)
+    assert qp["layers"]["wqkv"].q.shape[0] == 3
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab)
+    lq = forward(qp, toks, cfg)
+    lp = forward(params, toks, cfg)
+    a = np.asarray(lp, np.float64).ravel()
+    b = np.asarray(lq, np.float64).ravel()
+    cos = (a @ b) / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.995, cos
+
+
+def test_quantized_moe_forward():
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=2,
+                      d_ff=128, max_seq=32, n_experts=4, moe_top_k=2,
+                      use_rope=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    assert isinstance(qp["layers"][0]["moe_up"], QTensor)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab)
+    lq = forward(qp, toks, cfg)
+    lp = forward(params, toks, cfg)
+    a = np.asarray(lp, np.float64).ravel()
+    b = np.asarray(lq, np.float64).ravel()
+    cos = (a @ b) / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.99, cos
+
+
+def test_quantized_decode_bench_runs():
+    from tpu_dra_driver.workloads.models import decode_tokens_per_sec
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_kv_heads=1,
+                      n_layers=2, d_ff=128, max_seq=64, use_rope=True)
+    out = decode_tokens_per_sec(b=2, prompt_len=8, gen_short=4, gen_long=16,
+                                iters=1, cfg=cfg, quantized=True)
+    assert out["decode_tokens_per_sec"] > 0
+    assert "int8" in out["shape"]

@@ -10,6 +10,13 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     stack_layer_params,
     unstack_layer_params,
 )
+from tpu_dra_driver.workloads.models.quantize import (  # noqa: F401
+    QTensor,
+    is_quantized,
+    param_bytes,
+    quantize,
+    quantize_params,
+)
 from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     block_prefill,
     decode_step,

@@ -22,6 +22,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_dra_driver.workloads.models.quantize import (
+    embed_lookup, lm_head, mm,
+)
 from tpu_dra_driver.workloads.models.transformer import (
     ModelConfig,
     Params,
@@ -104,14 +107,14 @@ def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
     attn = attn_fn or attention_reference
     kw = {"prefix": t0} if prefix_lm else {}
 
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     if not cfg.use_rope:
         x = x + params["pos_embed"][:t0]
 
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         xn = _rmsnorm(x, layer["ln1"]["g"])
-        qkv = xn @ layer["wqkv"]
+        qkv = mm(xn, layer["wqkv"])
         q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_d], axis=-1)
         q = q.reshape(b, t0, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t0, n_kv, hd).transpose(0, 2, 1, 3)
@@ -126,11 +129,11 @@ def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
             cache["v"][li], v.astype(cache["v"][li].dtype), (0, 0, 0, 0)))
         att = attn(q, k, v, True, **kw)
         att = att.transpose(0, 2, 1, 3).reshape(b, t0, cfg.d_model)
-        x = x + att @ layer["wo"]
+        x = x + mm(att, layer["wo"])
         x = x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
 
     x = _rmsnorm(x[:, -1:], params["final_norm"]["g"])
-    logits = (x @ params["embed"].T).astype(jnp.float32)[:, 0]
+    logits = lm_head(x, params["embed"])[:, 0]
     return logits, {"k": new_k, "v": new_v}, jnp.int32(t0)
 
 
@@ -143,7 +146,7 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
     hd = cfg.d_model // cfg.n_heads
     kv_d = hd * n_kv
 
-    x = params["embed"][token][:, None, :]                   # [b, 1, d]
+    x = embed_lookup(params["embed"], token, cfg.dtype)[:, None, :]  # [b,1,d]
     if not cfg.use_rope:
         pos_emb = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos, 1, 0)
         x = x + pos_emb[None]
@@ -152,7 +155,7 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         xn = _rmsnorm(x, layer["ln1"]["g"])
-        qkv = xn @ layer["wqkv"]                             # [b,1,d+2kv_d]
+        qkv = mm(xn, layer["wqkv"])                          # [b,1,d+2kv_d]
         q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_d], axis=-1)
         q = q.reshape(b, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
@@ -172,28 +175,38 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
         new_v.append(v_cache)
         att = _decode_attention(q, k_cache, v_cache, pos)
         att = att.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
-        x = x + att @ layer["wo"]
+        x = x + mm(att, layer["wo"])
 
         from tpu_dra_driver.workloads.models.transformer import _ffn
         x = x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
 
     x = _rmsnorm(x, params["final_norm"]["g"])
-    logits = (x @ params["embed"].T).astype(jnp.float32)[:, 0]   # [b, vocab]
+    logits = lm_head(x, params["embed"])[:, 0]                   # [b, vocab]
     return logits, {"k": new_k, "v": new_v}
 
 
 def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
-                          gen_short: int = 64, gen_long: int = 192,
-                          iters: int = 3,
-                          cfg: "ModelConfig" = None) -> dict:
+                          gen_short: int = 64, gen_long: int = 1056,
+                          iters: int = 5,
+                          cfg: "ModelConfig" = None,
+                          quantized: bool = False) -> dict:
     """Greedy-decoding throughput (tokens/s) through the KV-cache path.
 
     Marginal-rate timing over two generation lengths cancels the prefill
     and dispatch overhead, so the number is the steady-state per-token
     decode rate — the latency-bound regime (matvec-shaped attention
     reads, cache updates) as opposed to the attention benches'
-    FLOP-bound one. Default model: a GQA + RoPE block stack sized so
-    weights stream from HBM like a real (if small) LM."""
+    FLOP-bound one. The chain lengths sit ~1000 steps apart so the delta
+    clears remote-tunnel dispatch jitter (marginal_chain_rate uses
+    best-of-iters). Default model: a GQA + RoPE block stack sized so
+    weights stream from HBM like a real (if small) LM.
+
+    ``quantized=True`` runs the same model with int8 weight-only
+    quantization (quantize.quantize_params) — the HBM-bound regime's
+    bytes-per-step halve, which is the expected throughput lever."""
+    from tpu_dra_driver.workloads.models.quantize import (
+        param_bytes, quantize_params,
+    )
     from tpu_dra_driver.workloads.models.transformer import (
         ModelConfig as _MC, init_params,
     )
@@ -203,6 +216,8 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
                      n_layers=4, d_ff=2048, max_seq=prompt_len + gen_long,
                      use_rope=True)
     params = init_params(cfg, jax.random.PRNGKey(0))
+    if quantized:
+        params = quantize_params(params)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (b, prompt_len),
                                 0, cfg.vocab)
 
@@ -217,9 +232,11 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
     n_kv = cfg.n_kv_heads or cfg.n_heads
     return {"decode_tokens_per_sec": b / per_step,
             "decode_step_ms": per_step * 1e3,
+            "param_mib": param_bytes(params) / 2**20,
             "shape": (f"b{b} L{cfg.n_layers} d{cfg.d_model} "
                       f"h{cfg.n_heads}/kv{n_kv} "
-                      f"prompt{prompt_len}")}
+                      f"prompt{prompt_len}"
+                      + (" int8" if quantized else ""))}
 
 
 def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,

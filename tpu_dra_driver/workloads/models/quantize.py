@@ -1,0 +1,180 @@
+"""Int8 weight-only quantization for the inference path.
+
+TPU-first rationale: autoregressive decode is HBM-bandwidth-bound — every
+step streams the full weight set through matvec-shaped matmuls. Storing
+weights as int8 with per-output-channel fp32 scales halves the bytes per
+step, which is a direct ~2x ceiling lift on the decode rate (and v5e's
+MXU natively multiplies sub-bf16 operands, so the int8→bf16 widening
+fuses into the matmul's operand load — no extra HBM pass).
+
+Scheme: symmetric per-channel int8 (absmax / 127) over the contraction
+axis of every matmul weight, so the dequant is one multiply by a
+broadcastable scale *after* the matmul — XLA fuses it into the matmul
+epilogue. The embedding table is quantized per *row* (per vocab entry),
+which serves both of its uses: table lookup (row scale) and the tied
+lm_head ``x @ embed.T`` (per-output-column scale).
+
+Quantized params keep the exact pytree structure of the fp params, with
+each selected weight leaf replaced by a :class:`QTensor` pytree node —
+``forward``/``decode_step``/``generate`` consume either form through the
+:func:`mm` / :func:`embed_lookup` / :func:`lm_head` helpers. Inference
+only: optimizer updates on int8 storage are meaningless (train in
+bf16, quantize the snapshot you serve).
+
+The reference driver has no inference surface; this extends the
+validation-workload tier (PARITY.md §2.6) the way its nvbandwidth /
+nickelpie jobs prove GPUs — here, proving sustained HBM-bound decode on
+the chips the driver prepared.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class QTensor:
+    """Symmetric int8 weight + fp32 per-channel scale.
+
+    ``q`` carries the integer codes; ``axis`` is the axis the absmax was
+    reduced over — the contraction axis for matmul weights (-2), the
+    embedding dim for per-row tables (-1). It is always stored
+    *negative* (trailing-relative), so stacking layers to [L, ...]
+    storage leaves it meaningful. ``s`` has ``q``'s shape minus that
+    axis and broadcasts back when expanded there. ``axis`` is pytree
+    metadata (static), so the two layouts can never be confused, even
+    for square weights.
+    """
+
+    q: jax.Array          # int8, same shape as the fp weight
+    s: jax.Array          # fp32 scale, shape = q.shape minus `axis`
+    axis: int = -2        # static: the reduced (quantization) axis
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.size * self.q.dtype.itemsize + self.s.size * 4
+
+    def dequant(self, dtype=jnp.bfloat16) -> jax.Array:
+        """Full dequantized weight (the general-einsum fallback; the 2-D
+        matmul path never materializes this — see :func:`mm`)."""
+        s = jnp.expand_dims(self.s, self.axis)
+        return (self.q.astype(jnp.float32) * s).astype(dtype)
+
+
+jax.tree_util.register_dataclass(
+    QTensor, data_fields=["q", "s"], meta_fields=["axis"])
+
+
+def quantize(w: jax.Array, axis: int = -2) -> QTensor:
+    """Symmetric absmax int8 quantization, scale per channel along every
+    axis except ``axis`` (the contraction axis)."""
+    axis = axis % w.ndim                    # normalize so stacking can't
+    w32 = w.astype(jnp.float32)             # shift a negative axis's meaning
+    absmax = jnp.max(jnp.abs(w32), axis=axis)
+    s = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.round(w32 / jnp.expand_dims(s, axis)).astype(jnp.int8)
+    return QTensor(q=q, s=s, axis=axis - w.ndim)
+
+
+# weight-leaf names quantized over the matmul contraction axis (-2);
+# works identically for per-layer [in, out] and scan-stacked [L, in, out]
+# storage, and for the MoE banks [E, in, out] / [L, E, in, out]
+_MATMUL_KEYS = ("wqkv", "wo", "w_up", "w_down", "moe_up", "moe_down",
+                "router")
+
+
+def quantize_params(params: Dict, include_embed: bool = True) -> Dict:
+    """fp params → same-structure pytree with int8 :class:`QTensor`
+    weight leaves (norm gains and pos_embed stay fp — they're tiny and
+    precision-critical)."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, (dict, list)):
+                out[k] = ([walk(x) for x in v] if isinstance(v, list)
+                          else walk(v))
+            elif k in _MATMUL_KEYS:
+                out[k] = quantize(v, axis=-2)
+            elif k == "embed" and include_embed:
+                out[k] = quantize(v, axis=-1)       # per vocab row
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)
+
+
+def is_quantized(params: Dict) -> bool:
+    return any(isinstance(leaf, QTensor)
+               for leaf in jax.tree.leaves(
+                   params, is_leaf=lambda x: isinstance(x, QTensor)))
+
+
+def mm(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` for fp or quantized ``w``. Quantized: the int8 codes
+    widen to x.dtype inside the matmul and the fp32 per-output-channel
+    scale multiplies the result (a fused epilogue, not a second HBM
+    pass; the bf16*fp32 product promotes, so the scale applies at full
+    precision before the cast back)."""
+    if isinstance(w, QTensor):
+        assert w.axis == -2, (
+            f"mm() needs contraction-axis scales (axis=-2), got {w.axis}")
+        return ((x @ w.q.astype(x.dtype)) * w.s).astype(x.dtype)
+    return x @ w
+
+
+def embed_lookup(embed, tokens: jax.Array, dtype=None) -> jax.Array:
+    """Embedding-table row gather for fp or row-quantized tables."""
+    if isinstance(embed, QTensor):
+        assert embed.axis == -1, (
+            f"embed_lookup() needs per-row scales (axis=-1), got {embed.axis}")
+        rows = embed.q[tokens].astype(jnp.float32)
+        return (rows * embed.s[tokens][..., None]).astype(
+            dtype or jnp.bfloat16)
+    return embed[tokens]
+
+
+def lm_head(x: jax.Array, embed) -> jax.Array:
+    """Tied output projection ``x @ embed.T`` → fp32 logits. For the
+    row-quantized table the row scale becomes the logit column scale."""
+    if isinstance(embed, QTensor):
+        assert embed.axis == -1, (
+            f"lm_head() needs per-row scales (axis=-1), got {embed.axis}")
+        logits = x @ embed.q.T.astype(x.dtype)
+        return logits.astype(jnp.float32) * embed.s
+    return (x @ embed.T).astype(jnp.float32)
+
+
+def ffn_weights(layer: Dict, dtype=jnp.bfloat16) -> Dict:
+    """Layer view with MoE banks dequantized for the einsum paths (the
+    dense-matmul leaves stay quantized — :func:`mm` handles them)."""
+    if not any(isinstance(layer.get(k), QTensor)
+               for k in ("moe_up", "moe_down", "router")):
+        return layer
+    out = dict(layer)
+    for k in ("moe_up", "moe_down", "router"):
+        if isinstance(out.get(k), QTensor):
+            out[k] = out[k].dequant(dtype)
+    return out
+
+
+def param_bytes(params: Dict) -> int:
+    """Total parameter storage in bytes (QTensor-aware) — the quantity
+    decode streams per step; the quantization win is this halving."""
+    total = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)):
+        total += (leaf.nbytes if isinstance(leaf, QTensor)
+                  else leaf.size * leaf.dtype.itemsize)
+    return total

@@ -23,6 +23,9 @@ import jax.numpy as jnp
 import optax
 
 from tpu_dra_driver.workloads.ops.attention import attention_reference
+from tpu_dra_driver.workloads.models.quantize import (
+    embed_lookup, ffn_weights, lm_head, mm,
+)
 
 
 @dataclass(frozen=True)
@@ -182,7 +185,7 @@ def _attention(x: jax.Array, layer: Params, n_heads: int,
     n_kv = n_kv_heads or n_heads
     hd = d // n_heads
     kv_d = hd * n_kv
-    qkv = x @ layer["wqkv"]                      # MXU: [b,t,d+2*kv_d]
+    qkv = mm(x, layer["wqkv"])                   # MXU: [b,t,d+2*kv_d]
     q, k, v = jnp.split(qkv, [d, d + kv_d], axis=-1)
 
     def heads(z, nh):
@@ -198,11 +201,11 @@ def _attention(x: jax.Array, layer: Params, n_heads: int,
         attn = partial(attn, prefix=prefix)
     out = attn(qh, kh, heads(v, n_kv))
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
-    return out @ layer["wo"]
+    return mm(out, layer["wo"])
 
 
 def _mlp(x: jax.Array, layer: Params) -> jax.Array:
-    return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
+    return mm(jax.nn.gelu(mm(x, layer["w_up"])), layer["w_down"])
 
 
 def _moe(x: jax.Array, layer: Params) -> jax.Array:
@@ -270,6 +273,7 @@ def _ffn(xn2: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
     the dispatch can't desynchronize."""
     if "moe_up" not in layer:
         return _mlp(xn2, layer)
+    layer = ffn_weights(layer, xn2.dtype)   # dequant int8 MoE banks (einsums)
     if cfg.moe_top_k > 0:
         return _moe_topk(xn2, layer, cfg.moe_top_k, cfg.moe_capacity_factor)
     return _moe(xn2, layer)
@@ -279,7 +283,7 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             attn_fn=None) -> jax.Array:
     """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
     b, t = tokens.shape
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     if not cfg.use_rope:
         x = x + params["pos_embed"][:t]
 
@@ -305,7 +309,7 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
         for layer in layers:
             x = block(x, layer)
     x = _rmsnorm(x, params["final_norm"]["g"])
-    return (x @ params["embed"].T).astype(jnp.float32)
+    return lm_head(x, params["embed"])
 
 
 def nll_from_logits(logits: jax.Array, targets: jax.Array,

@@ -49,10 +49,17 @@ def marginal_chain_rate(make_run: Callable[[int], Callable[[], Any]],
     jitted program) and return the marginal rate between them — on
     tunneled remote devices the per-call overhead dwarfs short kernels,
     and only the marginal slope measures the device. ``make_run(n)``
-    returns a zero-arg callable executing an n-step chain."""
+    returns a zero-arg callable executing an n-step chain.
+
+    Uses best-of-iters (not the median): per-call transport overhead on a
+    tunneled device is a noisy floor — the minimum is the cleanest
+    estimate of dispatch + compute, and the chain delta must rise above
+    that noise, not its average. Callers should pick chain lengths far
+    enough apart that the delta is several times the observed jitter
+    (e.g. ~1000 decode steps, not ~100)."""
     times = {}
     for n in (chain_short, chain_long):
         run = make_run(n)
-        times[n] = time_fn(run, warmup=warmup, iters=iters).median_s
+        times[n] = time_fn(run, warmup=warmup, iters=iters).best_s
     dt = times[chain_long] - times[chain_short]
     return max(dt, 1e-9) / (chain_long - chain_short)
