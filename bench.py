@@ -194,6 +194,19 @@ def bench_accelerator() -> dict:
                 f"{dq['decode_tokens_per_sec']:.0f} tok/s "
                 f"({dq['shape']}, {dq['decode_step_ms']:.2f} ms/token-step, "
                 f"params {dq['param_mib']:.0f} MiB vs {dt['param_mib']:.0f})")
+            # int8 self-speculation at b=1 (the latency-bound serving
+            # case); acceptance at random init is the pessimistic floor —
+            # trained (peaked) models accept more
+            from tpu_dra_driver.workloads.models import (
+                speculative_decode_tokens_per_sec,
+            )
+            sp = speculative_decode_tokens_per_sec(b=1, gamma=8, gen=256)
+            out["spec_decode_speedup_b1"] = round(sp["speedup"], 3)
+            log(f"  int8 self-speculative decode (b=1, gamma=8): "
+                f"{sp['spec_tokens_per_sec']:.0f} tok/s vs "
+                f"{sp['plain_tokens_per_sec']:.0f} plain "
+                f"({sp['speedup']:.2f}x, mean accepted "
+                f"{sp['mean_accepted']:.1f}/8, exact-greedy output)")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out

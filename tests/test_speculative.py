@@ -1,0 +1,157 @@
+"""Speculative decoding: exact-greedy invariant, wide verify step, and
+acceptance stats (virtual 8-device CPU mesh via conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra_driver.workloads.models import (
+    ModelConfig,
+    generate,
+    init_kv_cache,
+    init_params,
+    quantize_params,
+    self_speculative_generate,
+    speculative_generate,
+    wide_step,
+)
+from tpu_dra_driver.workloads.models.generate import block_prefill, decode_step
+
+TCFG = ModelConfig(vocab=256, d_model=128, n_heads=4, n_kv_heads=2,
+                   n_layers=2, d_ff=256, max_seq=128, use_rope=True)
+DCFG = ModelConfig(vocab=256, d_model=64, n_heads=2, n_layers=1,
+                   d_ff=128, max_seq=128, use_rope=True)
+
+
+def _prompt(b=2, t=8, key=1, vocab=256):
+    return jax.random.randint(jax.random.PRNGKey(key), (b, t), 0, vocab)
+
+
+def test_wide_step_matches_sequential_decode_steps():
+    params = init_params(TCFG, jax.random.PRNGKey(0))
+    prompt = _prompt()
+    toks = _prompt(key=3)[:, :4]
+
+    cache = init_kv_cache(TCFG, 2, 64)
+    _, cache, pos = block_prefill(params, TCFG, cache, prompt)
+    wl, wcache = wide_step(params, TCFG, cache, pos, toks)
+
+    cache2 = init_kv_cache(TCFG, 2, 64)
+    _, cache2, pos2 = block_prefill(params, TCFG, cache2, prompt)
+    seq_logits = []
+    for i in range(4):
+        li, cache2 = decode_step(params, TCFG, cache2, pos2 + i, toks[:, i])
+        seq_logits.append(li)
+    np.testing.assert_allclose(np.asarray(wl),
+                               np.asarray(jnp.stack(seq_logits, axis=1)),
+                               rtol=2e-2, atol=2e-2)
+    for li in range(TCFG.n_layers):
+        np.testing.assert_allclose(np.asarray(wcache["k"][li]),
+                                   np.asarray(cache2["k"][li]),
+                                   rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_spec_matches_target_greedy_any_draft(gamma):
+    # an unrelated random draft: acceptance is poor, output must still be
+    # EXACTLY the target's greedy decode
+    tparams = init_params(TCFG, jax.random.PRNGKey(0))
+    dparams = init_params(DCFG, jax.random.PRNGKey(9))
+    prompt = _prompt()
+    want = generate(tparams, TCFG, prompt, steps=17)
+    got = speculative_generate(tparams, TCFG, dparams, DCFG, prompt,
+                               steps=17, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_identical_draft_accepts_everything():
+    tparams = init_params(TCFG, jax.random.PRNGKey(0))
+    prompt = _prompt(b=1)
+    out, stats = speculative_generate(tparams, TCFG, tparams, TCFG, prompt,
+                                      steps=16, gamma=4, return_stats=True)
+    want = generate(tparams, TCFG, prompt, steps=16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    # target-as-draft agrees with itself: every round accepts gamma
+    assert stats["mean_accepted"] == pytest.approx(4.0)
+    # gamma+1 tokens per round
+    assert stats["rounds"] <= 4
+
+
+def test_self_speculative_int8_draft():
+    params = init_params(TCFG, jax.random.PRNGKey(0))
+    prompt = _prompt()
+    out, stats = self_speculative_generate(params, TCFG, prompt, steps=12,
+                                           gamma=3, return_stats=True)
+    want = generate(params, TCFG, prompt, steps=12)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    # int8 draft tracks the fp target: acceptance should be decent
+    assert stats["mean_accepted"] >= 1.0, stats
+
+
+def test_spec_learned_pos_embed_model():
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=2,
+                      d_ff=128, max_seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = _prompt(vocab=128)
+    want = generate(params, cfg, prompt, steps=10)
+    got = speculative_generate(params, cfg, params, cfg, prompt,
+                               steps=10, gamma=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # capacity guard: pos_embed-bounded model rejects oversized runs
+    with pytest.raises(ValueError, match="max_seq"):
+        speculative_generate(params, cfg, params, cfg, prompt,
+                             steps=60, gamma=2)
+
+
+def test_spec_prefix_lm_matches_generate():
+    # prefix-LM target: the spec prefill must use the bidirectional
+    # prompt region exactly like generate()'s default
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=2,
+                      d_ff=128, max_seq=64, use_rope=True, prefix=8)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = _prompt(vocab=128)
+    want = generate(params, cfg, prompt, steps=10)
+    got = speculative_generate(params, cfg, params, cfg, prompt,
+                               steps=10, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_wide_step_rejects_ring_cache():
+    wcfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=1,
+                       d_ff=128, max_seq=64, use_rope=True, window=16)
+    params = init_params(wcfg, jax.random.PRNGKey(0))
+    cache = init_kv_cache(wcfg, 2, 64)
+    toks = _prompt(vocab=128)[:, :4]
+    with pytest.raises(ValueError, match="window"):
+        wide_step(params, wcfg, cache, jnp.int32(0), toks)
+
+
+def test_spec_rejects_bad_configs():
+    params = init_params(TCFG, jax.random.PRNGKey(0))
+    prompt = _prompt()
+    wcfg = ModelConfig(vocab=256, d_model=128, n_heads=4, n_layers=2,
+                       d_ff=256, max_seq=128, use_rope=True, window=16)
+    with pytest.raises(ValueError, match="window"):
+        speculative_generate(params, TCFG, params, wcfg, prompt, steps=4)
+    vcfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=1,
+                       d_ff=128, max_seq=128, use_rope=True)
+    vparams = init_params(vcfg, jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(params, TCFG, vparams, vcfg, prompt, steps=4)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(params, TCFG, params, TCFG, prompt, steps=4,
+                             gamma=0)
+
+
+def test_spec_bench_runs():
+    from tpu_dra_driver.workloads.models import (
+        speculative_decode_tokens_per_sec,
+    )
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_kv_heads=1,
+                      n_layers=2, d_ff=128, max_seq=64, use_rope=True)
+    out = speculative_decode_tokens_per_sec(b=2, prompt_len=8, gen=12,
+                                            gamma=2, iters=1, cfg=cfg)
+    assert out["spec_tokens_per_sec"] > 0
+    assert out["plain_tokens_per_sec"] > 0
+    assert 0.0 <= out["mean_accepted"] <= 2.0

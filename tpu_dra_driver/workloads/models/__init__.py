@@ -17,6 +17,11 @@ from tpu_dra_driver.workloads.models.quantize import (  # noqa: F401
     quantize,
     quantize_params,
 )
+from tpu_dra_driver.workloads.models.speculative import (  # noqa: F401
+    self_speculative_generate,
+    speculative_decode_tokens_per_sec,
+    speculative_generate,
+)
 from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     block_prefill,
     decode_step,
@@ -24,4 +29,5 @@ from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     evaluate_nll,
     generate,
     init_kv_cache,
+    wide_step,
 )

@@ -53,18 +53,20 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_t: int) -> Dict:
 
 
 def _decode_attention(q, k_cache, v_cache, pos):
-    """q: [b, h, 1, hd] against the cache [b, h_kv, L, hd], masked to
-    written slots. One fused masked softmax-weighted read — the
-    flash-decoding shape (t_q = 1) where XLA's fusion is already
-    optimal; no Pallas kernel needed.
+    """q: [b, h, g, hd] against the cache [b, h_kv, L, hd], masked to
+    written slots: block row i sees ``slot <= pos + i``. One fused
+    masked softmax-weighted read — for g = 1 this is the flash-decoding
+    shape where XLA's fusion is already optimal (no Pallas kernel
+    needed); for g > 1 it is the speculative wide-verify read.
 
-    The mask ``slot <= pos`` covers both cache modes: full-length
-    (L = max_t, slot index == absolute position, the causal mask) and
-    ring buffer (L = window: for pos < L only slots 0..pos are written;
-    once pos >= L every slot holds one of the last L positions, all of
-    which the window admits — softmax is permutation-invariant over KV,
-    so slot order never matters)."""
-    b, h, _, hd = q.shape
+    For g = 1 the mask ``slot <= pos`` covers both cache modes:
+    full-length (L = max_t, slot index == absolute position, the causal
+    mask) and ring buffer (L = window: for pos < L only slots 0..pos
+    are written; once pos >= L every slot holds one of the last L
+    positions, all of which the window admits — softmax is
+    permutation-invariant over KV, so slot order never matters). g > 1
+    assumes the full-length cache (wide_step enforces that)."""
+    b, h, g, hd = q.shape
     h_kv = k_cache.shape[1]
     if h != h_kv:
         k_cache = jnp.repeat(k_cache, h // h_kv, axis=1)
@@ -72,8 +74,9 @@ def _decode_attention(q, k_cache, v_cache, pos):
     s = jnp.einsum("bhqd,bhtd->bhqt", q, k_cache).astype(jnp.float32)
     s = s / math.sqrt(hd)
     length = k_cache.shape[2]
-    visible = jnp.arange(length) <= pos                    # [L]
-    s = jnp.where(visible[None, None, None, :], s, NEG_INF)
+    visible = (jnp.arange(length)[None, :]
+               <= (pos + jnp.arange(g))[:, None])          # [g, L]
+    s = jnp.where(visible[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqt,bhtd->bhqd", p, v_cache)
 
@@ -137,36 +140,46 @@ def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
     return logits, {"k": new_k, "v": new_v}, jnp.int32(t0)
 
 
-def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
-                pos: jax.Array, token: jax.Array):
-    """One token step: token [b] int32 at position ``pos`` (traced scalar)
-    → (logits [b, vocab], updated cache)."""
-    b = token.shape[0]
+def wide_step(params: Params, cfg: ModelConfig, cache: Dict,
+              pos: jax.Array, toks: jax.Array):
+    """Multi-token decode step: ``toks`` [b, g] int32 at positions
+    [pos, pos+g) → (logits [b, g, vocab], updated cache).
+
+    g = 1 is the ordinary decode step (ring-cache-aware: the write slot
+    wraps at the cache length). g > 1 is the speculative wide-verify
+    forward — the same layer stack with MXU-shaped [g]-wide matmuls
+    instead of g matvec steps; it requires the full-length cache
+    (cfg.window == 0), since a wide write into a wrapped ring would
+    straddle the buffer edge."""
+    b, g = toks.shape
+    if g > 1 and cfg.window > 0:
+        raise ValueError("wide_step with g > 1 requires cfg.window == 0 "
+                         "(ring caches fill one slot at a time)")
     n_kv = cfg.n_kv_heads or cfg.n_heads
     hd = cfg.d_model // cfg.n_heads
     kv_d = hd * n_kv
 
-    x = embed_lookup(params["embed"], token, cfg.dtype)[:, None, :]  # [b,1,d]
+    x = embed_lookup(params["embed"], toks, cfg.dtype)           # [b,g,d]
     if not cfg.use_rope:
-        pos_emb = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos, 1, 0)
+        pos_emb = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos, g, 0)
         x = x + pos_emb[None]
 
     params = unstack_layer_params(params)    # no-op for list storage
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         xn = _rmsnorm(x, layer["ln1"]["g"])
-        qkv = mm(xn, layer["wqkv"])                          # [b,1,d+2kv_d]
+        qkv = mm(xn, layer["wqkv"])                          # [b,g,d+2kv_d]
         q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_d], axis=-1)
-        q = q.reshape(b, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
+        q = q.reshape(b, g, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, g, n_kv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, g, n_kv, hd).transpose(0, 2, 1, 3)
         if cfg.use_rope:
             from tpu_dra_driver.workloads.models.transformer import apply_rope
             q = apply_rope(q, pos0=pos)
             k = apply_rope(k, pos0=pos)
-        # ring write: slot = pos % L is the identity while pos < L (the
-        # full-length cache) and wraps only in windowed ring mode
-        slot = pos % cache["k"][li].shape[2]
+        # ring write (g=1 only): slot = pos % L is the identity while
+        # pos < L (the full-length cache) and wraps only in ring mode
+        slot = pos % cache["k"][li].shape[2] if g == 1 else pos
         k_cache = jax.lax.dynamic_update_slice(
             cache["k"][li], k.astype(cache["k"][li].dtype), (0, 0, slot, 0))
         v_cache = jax.lax.dynamic_update_slice(
@@ -174,15 +187,24 @@ def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
         new_k.append(k_cache)
         new_v.append(v_cache)
         att = _decode_attention(q, k_cache, v_cache, pos)
-        att = att.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+        att = att.transpose(0, 2, 1, 3).reshape(b, g, cfg.d_model)
         x = x + mm(att, layer["wo"])
 
         from tpu_dra_driver.workloads.models.transformer import _ffn
         x = x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
 
     x = _rmsnorm(x, params["final_norm"]["g"])
-    logits = lm_head(x, params["embed"])[:, 0]                   # [b, vocab]
+    logits = lm_head(x, params["embed"])                     # [b, g, vocab]
     return logits, {"k": new_k, "v": new_v}
+
+
+def decode_step(params: Params, cfg: ModelConfig, cache: Dict,
+                pos: jax.Array, token: jax.Array):
+    """One token step: token [b] int32 at position ``pos`` (traced scalar)
+    → (logits [b, vocab], updated cache). The g = 1 case of
+    :func:`wide_step`."""
+    logits, cache = wide_step(params, cfg, cache, pos, token[:, None])
+    return logits[:, 0], cache
 
 
 def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,

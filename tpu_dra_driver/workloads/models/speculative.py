@@ -1,0 +1,240 @@
+"""Greedy speculative decoding: draft-and-verify over the KV-cache path.
+
+A small draft model proposes ``gamma`` tokens sequentially (cheap,
+latency-bound steps); the target model verifies all of them in ONE wide
+forward (an MXU-shaped [gamma+1]-token block instead of gamma+1 matvec
+steps). Accepted drafts cost the target a single weight stream per
+round, so tokens/s rises by roughly the mean accepted length while the
+output stays *exactly* the target's greedy decode (the acceptance rule
+compares the target's argmax to the draft token — no distribution
+drift, unlike sampling-based acceptance which this module doesn't do).
+
+A TPU-natural draft is the int8-quantized target itself
+(``quantize_params``): half the HBM bytes per draft step, and its argmax
+tracks the fp target closely, so acceptance is high with no second
+model to train. ``self_speculative_generate`` wires that up.
+
+Design for the hardware (all static shapes, one compile):
+- the outer loop is ``lax.while_loop`` over rounds; every round does a
+  fixed ``gamma+1`` draft steps + 1 wide verify, writing into a
+  fixed-size token buffer with ``dynamic_update_slice``;
+- verification attends queries [b, h, g+1, hd] against the full-length
+  cache with a per-row visibility mask (slot <= pos + row) — the same
+  masked-read shape as decode, widened; stale cache slots beyond the
+  accepted prefix are invisible by construction and get overwritten by
+  later rounds;
+- batched acceptance uses the batch-minimum accepted length: still
+  exactly greedy for every element, conservatively fewer tokens per
+  round (per-element cache positions would need gather/scatter
+  cache addressing, hostile to XLA's static layouts).
+
+The draft's cache can lag one entry behind on full acceptance, so each
+round begins with a catch-up feed of the token at ``pos - 1`` — a
+byte-identical rewrite when the entry already exists, the missing entry
+when it doesn't (branch-free uniformity instead of lax.cond).
+
+The reference driver has no inference surface; this extends the
+validation-workload tier (PARITY.md §2.6).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra_driver.workloads.models.quantize import quantize_params
+from tpu_dra_driver.workloads.models.transformer import ModelConfig, Params
+from tpu_dra_driver.workloads.models.generate import (
+    block_prefill,
+    decode_step,
+    init_kv_cache,
+    wide_step,
+)
+
+
+def speculative_generate(target_params: Params, target_cfg: ModelConfig,
+                         draft_params: Params, draft_cfg: ModelConfig,
+                         prompt: jax.Array, steps: int, gamma: int = 4,
+                         return_stats: bool = False):
+    """Greedy generation of ``steps`` tokens, draft-verified in rounds of
+    ``gamma``. The output matches
+    ``generate(target_params, target_cfg, prompt, steps)`` for ANY
+    draft — the draft only changes the speed. (The acceptance rule
+    compares the target's own argmax, and verify shares the decode
+    forward — :func:`generate.wide_step` — so the only divergence
+    source left is bf16 reduction-order on near-tie logits, where the
+    g-wide matmul may tile differently from the g=1 matvec; exact
+    agreement is pinned by tests at g ∈ {1,2,3,5}.)
+
+    Prefix-LM targets (``cfg.prefix > 0``) prefill with a bidirectional
+    prompt region exactly like ``generate()``'s default; decode steps
+    are causal in both paths.
+
+    ``return_stats=True`` additionally returns
+    ``{"rounds": n, "mean_accepted": k̄}`` (k̄ ∈ [0, gamma]; the
+    tokens-per-round is k̄ + 1 counting the target's bonus token).
+    """
+    if steps <= 0:
+        return (prompt, {"rounds": 0, "mean_accepted": 0.0}) \
+            if return_stats else prompt
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_cfg.window > 0 or draft_cfg.window > 0:
+        raise ValueError("speculative decoding needs full-length caches "
+                         "(window == 0) — the wide verify is positional")
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"target/draft vocab mismatch: {target_cfg.vocab} vs "
+            f"{draft_cfg.vocab}")
+    out, rounds, acc = _spec_generate(
+        target_params, draft_params, prompt, target_cfg, draft_cfg,
+        steps, gamma)
+    if return_stats:
+        r = max(int(rounds), 1)
+        return out, {"rounds": int(rounds),
+                     "mean_accepted": float(acc) / r}
+    return out
+
+
+def self_speculative_generate(params: Params, cfg: ModelConfig,
+                              prompt: jax.Array, steps: int,
+                              gamma: int = 4, return_stats: bool = False):
+    """Quantized self-speculation: the draft is the int8 quantization of
+    the target — no second model, half the draft bytes/step, high
+    acceptance (int8 argmax tracks fp closely). Output matches the fp
+    target's greedy decode (see :func:`speculative_generate`)."""
+    return speculative_generate(params, cfg, quantize_params(params), cfg,
+                                prompt, steps, gamma,
+                                return_stats=return_stats)
+
+
+@partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
+                                   "gamma"))
+def _spec_generate(target_params, draft_params, prompt, target_cfg,
+                   draft_cfg, steps, gamma):
+    b, t0 = prompt.shape
+    # capacity: prompt + generated + one round's overshoot
+    max_t = t0 + steps + gamma + 2
+    for cfg in (target_cfg, draft_cfg):
+        if not cfg.use_rope and max_t > cfg.max_seq:
+            raise ValueError(
+                f"t0+steps+gamma+2 ({max_t}) exceeds max_seq {cfg.max_seq} "
+                f"(learned pos_embed bounds the sequence)")
+
+    tcache = init_kv_cache(target_cfg, b, max_t)
+    dcache = init_kv_cache(draft_cfg, b, max_t)
+
+    # prefill both models; target's last logits give the first token.
+    # prefix-LM configs get the bidirectional prompt region, mirroring
+    # generate()'s default (decode steps are causal either way)
+    last_logits, tcache, pos = block_prefill(
+        target_params, target_cfg, tcache, prompt,
+        prefix_lm=target_cfg.prefix > 0)
+    _, dcache, _ = block_prefill(draft_params, draft_cfg, dcache, prompt,
+                                 prefix_lm=draft_cfg.prefix > 0)
+    first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)   # [b]
+
+    # token buffer: prompt + everything generated (+ round overshoot)
+    buf = jnp.zeros((b, max_t), prompt.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, t0))
+
+    # carry: n = tokens generated so far; pos = cache entries valid for
+    # positions < pos; t_last = the token AT position pos (not yet in
+    # either cache)
+    def cond(c):
+        return c["n"] < steps
+
+    def body(c):
+        buf, n, pos, t_last = c["buf"], c["n"], c["pos"], c["t_last"]
+        tcache, dcache = c["tcache"], c["dcache"]
+
+        # draft catch-up: re-feed the token at pos-1 (identical rewrite
+        # when present; fills the one-entry lag after a full-accept)
+        prev = jax.lax.dynamic_slice(buf, (0, pos - 1), (b, 1))[:, 0]
+        _, dcache = decode_step(draft_params, draft_cfg, dcache,
+                                pos - 1, prev)
+
+        # propose gamma tokens sequentially
+        def propose(carry, _):
+            dcache, p, tok = carry
+            logits, dcache = decode_step(draft_params, draft_cfg, dcache,
+                                         p, tok)
+            nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+            return (dcache, p + 1, nxt), nxt
+
+        (dcache, _, _), drafts = jax.lax.scan(
+            propose, (dcache, pos, t_last), None, length=gamma)
+        drafts = drafts.transpose(1, 0)                        # [b, gamma]
+
+        # one wide target verify over [t_last, d_1..d_gamma]
+        block = jnp.concatenate([t_last[:, None], drafts], axis=1)
+        logits, tcache = wide_step(target_params, target_cfg, tcache,
+                                   pos, block)
+        greedy = jnp.argmax(logits, axis=-1).astype(t_last.dtype)  # [b,g+1]
+
+        # accept while target argmax == draft token; batch-min k
+        match = (greedy[:, :-1] == drafts)                     # [b, gamma]
+        acc_count = jnp.sum(jnp.cumprod(
+            match.astype(jnp.int32), axis=1), axis=1)          # [b]
+        k = jnp.min(acc_count)
+
+        # tokens this round: d_1..d_k then the bonus greedy[:, k];
+        # slots past k are garbage and overwritten by the next round
+        cols = jnp.arange(gamma + 1)
+        bonus = jnp.take_along_axis(greedy, jnp.full((b, 1), k), axis=1)
+        drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))         # [b, g+1]
+        outk = jnp.where(cols[None, :] < k, drafts_pad, bonus)
+        buf = jax.lax.dynamic_update_slice(buf, outk, (0, pos + 1))
+
+        return {"buf": buf, "n": n + k + 1, "pos": pos + k + 1,
+                "t_last": bonus[:, 0], "tcache": tcache, "dcache": dcache,
+                "rounds": c["rounds"] + 1, "acc": c["acc"] + k}
+
+    init = {"buf": buf, "n": jnp.int32(1), "pos": jnp.int32(t0),
+            "t_last": first, "tcache": tcache, "dcache": dcache,
+            "rounds": jnp.int32(0), "acc": jnp.int32(0)}
+    final = jax.lax.while_loop(cond, body, init)
+    out = jax.lax.dynamic_slice(final["buf"], (0, 0), (b, t0 + steps))
+    return out, final["rounds"], final["acc"]
+
+
+def speculative_decode_tokens_per_sec(
+        b: int = 8, prompt_len: int = 128, gen: int = 256, gamma: int = 4,
+        iters: int = 3, cfg: Optional[ModelConfig] = None) -> dict:
+    """Throughput of int8 self-speculation vs plain greedy decode on the
+    same (HBM-bound by default) model: end-to-end wall time for ``gen``
+    tokens, best-of-iters. Reports both rates, the speedup, and the
+    mean accepted length."""
+    from tpu_dra_driver.workloads.models.generate import generate
+    from tpu_dra_driver.workloads.models.transformer import init_params
+    from tpu_dra_driver.workloads.utils.timing import time_fn
+
+    cfg = cfg or ModelConfig(vocab=8192, d_model=2048, n_heads=16,
+                             n_kv_heads=4, n_layers=8, d_ff=8192,
+                             max_seq=prompt_len + gen + gamma + 2,
+                             use_rope=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qdraft = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (b, prompt_len),
+                                0, cfg.vocab)
+
+    _, stats = speculative_generate(params, cfg, qdraft, cfg, prompt,
+                                    steps=gen, gamma=gamma,
+                                    return_stats=True)
+    t_spec = time_fn(lambda: speculative_generate(
+        params, cfg, qdraft, cfg, prompt, steps=gen, gamma=gamma),
+        warmup=1, iters=iters).best_s
+    t_plain = time_fn(lambda: generate(params, cfg, prompt, steps=gen),
+                      warmup=1, iters=iters).best_s
+    return {
+        "spec_tokens_per_sec": b * gen / t_spec,
+        "plain_tokens_per_sec": b * gen / t_plain,
+        "speedup": t_plain / t_spec,
+        "mean_accepted": stats["mean_accepted"],
+        "gamma": gamma,
+        "shape": f"b{b} L{cfg.n_layers} d{cfg.d_model} gen{gen}",
+    }
