@@ -4,7 +4,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpu_dra_driver.workloads.models import (
     ModelConfig,

@@ -155,6 +155,14 @@ def wide_step(params: Params, cfg: ModelConfig, cache: Dict,
     if g > 1 and cfg.window > 0:
         raise ValueError("wide_step with g > 1 requires cfg.window == 0 "
                          "(ring caches fill one slot at a time)")
+    if not cfg.use_rope and cache["k"][0].shape[2] > cfg.max_seq:
+        # dynamic_slice clamps out-of-range starts instead of erroring,
+        # so a cache longer than the learned pos_embed table would read
+        # silently wrong positional rows; catch the static mismatch here
+        # (pos itself is traced and assumed in-bounds, as in generate())
+        raise ValueError(
+            f"cache length {cache['k'][0].shape[2]} exceeds max_seq "
+            f"{cfg.max_seq} (learned pos_embed bounds positions)")
     n_kv = cfg.n_kv_heads or cfg.n_heads
     hd = cfg.d_model // cfg.n_heads
     kv_d = hd * n_kv

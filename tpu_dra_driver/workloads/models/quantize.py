@@ -30,7 +30,7 @@ the chips the driver prepared.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -86,15 +86,17 @@ def quantize(w: jax.Array, axis: int = -2) -> QTensor:
 
 # weight-leaf names quantized over the matmul contraction axis (-2);
 # works identically for per-layer [in, out] and scan-stacked [L, in, out]
-# storage, and for the MoE banks [E, in, out] / [L, E, in, out]
-_MATMUL_KEYS = ("wqkv", "wo", "w_up", "w_down", "moe_up", "moe_down",
-                "router")
+# storage, and for the MoE banks [E, in, out] / [L, E, in, out]. The MoE
+# router stays fp deliberately: it is tiny ([d, n_experts] — no HBM win)
+# and its rounding error flips discrete top-k expert choices instead of
+# adding small numeric drift.
+_MATMUL_KEYS = ("wqkv", "wo", "w_up", "w_down", "moe_up", "moe_down")
 
 
 def quantize_params(params: Dict, include_embed: bool = True) -> Dict:
     """fp params → same-structure pytree with int8 :class:`QTensor`
-    weight leaves (norm gains and pos_embed stay fp — they're tiny and
-    precision-critical)."""
+    weight leaves (norm gains, pos_embed, and the MoE router stay fp —
+    tiny and precision-critical)."""
 
     def walk(node):
         if not isinstance(node, dict):
@@ -128,8 +130,9 @@ def mm(x: jax.Array, w) -> jax.Array:
     pass; the bf16*fp32 product promotes, so the scale applies at full
     precision before the cast back)."""
     if isinstance(w, QTensor):
-        assert w.axis == -2, (
-            f"mm() needs contraction-axis scales (axis=-2), got {w.axis}")
+        if w.axis != -2:
+            raise ValueError(
+                f"mm() needs contraction-axis scales (axis=-2), got {w.axis}")
         return ((x @ w.q.astype(x.dtype)) * w.s).astype(x.dtype)
     return x @ w
 
@@ -137,8 +140,10 @@ def mm(x: jax.Array, w) -> jax.Array:
 def embed_lookup(embed, tokens: jax.Array, dtype=None) -> jax.Array:
     """Embedding-table row gather for fp or row-quantized tables."""
     if isinstance(embed, QTensor):
-        assert embed.axis == -1, (
-            f"embed_lookup() needs per-row scales (axis=-1), got {embed.axis}")
+        if embed.axis != -1:
+            raise ValueError(
+                f"embed_lookup() needs per-row scales (axis=-1), "
+                f"got {embed.axis}")
         rows = embed.q[tokens].astype(jnp.float32)
         return (rows * embed.s[tokens][..., None]).astype(
             dtype or jnp.bfloat16)
@@ -149,8 +154,10 @@ def lm_head(x: jax.Array, embed) -> jax.Array:
     """Tied output projection ``x @ embed.T`` → fp32 logits. For the
     row-quantized table the row scale becomes the logit column scale."""
     if isinstance(embed, QTensor):
-        assert embed.axis == -1, (
-            f"lm_head() needs per-row scales (axis=-1), got {embed.axis}")
+        if embed.axis != -1:
+            raise ValueError(
+                f"lm_head() needs per-row scales (axis=-1), "
+                f"got {embed.axis}")
         logits = x @ embed.q.T.astype(x.dtype)
         return logits.astype(jnp.float32) * embed.s
     return (x @ embed.T).astype(jnp.float32)
@@ -158,12 +165,13 @@ def lm_head(x: jax.Array, embed) -> jax.Array:
 
 def ffn_weights(layer: Dict, dtype=jnp.bfloat16) -> Dict:
     """Layer view with MoE banks dequantized for the einsum paths (the
-    dense-matmul leaves stay quantized — :func:`mm` handles them)."""
+    dense-matmul leaves stay quantized — :func:`mm` handles them; the
+    router is never quantized, see _MATMUL_KEYS)."""
     if not any(isinstance(layer.get(k), QTensor)
-               for k in ("moe_up", "moe_down", "router")):
+               for k in ("moe_up", "moe_down")):
         return layer
     out = dict(layer)
-    for k in ("moe_up", "moe_down", "router"):
+    for k in ("moe_up", "moe_down"):
         if isinstance(out.get(k), QTensor):
             out[k] = out[k].dequant(dtype)
     return out

@@ -40,7 +40,7 @@ validation-workload tier (PARITY.md §2.6).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -101,12 +101,19 @@ def speculative_generate(target_params: Params, target_cfg: ModelConfig,
 
 def self_speculative_generate(params: Params, cfg: ModelConfig,
                               prompt: jax.Array, steps: int,
-                              gamma: int = 4, return_stats: bool = False):
+                              gamma: int = 4, return_stats: bool = False,
+                              quantized_params: Optional[Params] = None):
     """Quantized self-speculation: the draft is the int8 quantization of
     the target — no second model, half the draft bytes/step, high
     acceptance (int8 argmax tracks fp closely). Output matches the fp
-    target's greedy decode (see :func:`speculative_generate`)."""
-    return speculative_generate(params, cfg, quantize_params(params), cfg,
+    target's greedy decode (see :func:`speculative_generate`).
+
+    Callers generating repeatedly should pass ``quantized_params``
+    (= ``quantize_params(params)``, computed once); otherwise the
+    quantization pass re-runs on every call."""
+    draft = (quantized_params if quantized_params is not None
+             else quantize_params(params))
+    return speculative_generate(params, cfg, draft, cfg,
                                 prompt, steps, gamma,
                                 return_stats=return_stats)
 
