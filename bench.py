@@ -174,26 +174,36 @@ def bench_accelerator() -> dict:
             from tpu_dra_driver.workloads.models import (
                 ModelConfig, decode_tokens_per_sec,
             )
-            # HBM-bound size: ~700 MiB of bf16 weights stream per token
-            # step, so the number measures sustained HBM bandwidth (and
-            # the int8 variant its halved-bytes win), not dispatch
+            # HBM-bound long-context regime: ~700 MiB of bf16 weights
+            # PLUS ~400 MiB of KV cache stream per token step, so the
+            # number measures sustained HBM bandwidth on both decode
+            # streams — and the int8 variants their halved-bytes wins
+            from dataclasses import replace
             dcfg = ModelConfig(vocab=8192, d_model=2048, n_heads=16,
                                n_kv_heads=4, n_layers=8, d_ff=8192,
-                               max_seq=128 + 1056, use_rope=True)
-            dkw = dict(b=8, prompt_len=128, gen_short=32, gen_long=1056,
-                       iters=3, cfg=dcfg)
-            dt = decode_tokens_per_sec(**dkw)
+                               max_seq=2048 + 1056, use_rope=True)
+            dkw = dict(b=8, prompt_len=2048, gen_short=32, gen_long=1056,
+                       iters=3)
+            dt = decode_tokens_per_sec(cfg=dcfg, **dkw)
             out["decode_tokens_per_sec"] = round(dt["decode_tokens_per_sec"], 1)
             log(f"  KV-cache greedy decode: "
                 f"{dt['decode_tokens_per_sec']:.0f} tok/s "
                 f"({dt['shape']}, {dt['decode_step_ms']:.2f} ms/token-step)")
-            dq = decode_tokens_per_sec(quantized=True, **dkw)
+            dq = decode_tokens_per_sec(cfg=dcfg, quantized=True, **dkw)
             out["decode_tokens_per_sec_int8"] = round(
                 dq["decode_tokens_per_sec"], 1)
-            log(f"  KV-cache greedy decode int8: "
+            log(f"  KV-cache greedy decode int8 weights: "
                 f"{dq['decode_tokens_per_sec']:.0f} tok/s "
                 f"({dq['shape']}, {dq['decode_step_ms']:.2f} ms/token-step, "
                 f"params {dq['param_mib']:.0f} MiB vs {dt['param_mib']:.0f})")
+            dqq = decode_tokens_per_sec(cfg=replace(dcfg, kv_int8=True),
+                                        quantized=True, **dkw)
+            out["decode_tokens_per_sec_int8_kv8"] = round(
+                dqq["decode_tokens_per_sec"], 1)
+            log(f"  KV-cache greedy decode int8 weights + int8 KV: "
+                f"{dqq['decode_tokens_per_sec']:.0f} tok/s "
+                f"({dqq['decode_step_ms']:.2f} ms/token-step, "
+                f"{dqq['decode_tokens_per_sec']/dt['decode_tokens_per_sec']:.2f}x bf16)")
             # int8 self-speculation at b=1 (the latency-bound serving
             # case); acceptance at random init is the pessimistic floor —
             # trained (peaked) models accept more

@@ -1,0 +1,163 @@
+"""Flash-decode kernel (interpret mode) and int8 KV cache correctness
+(virtual 8-device CPU mesh via conftest)."""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra_driver.workloads.models import (
+    ModelConfig,
+    generate,
+    init_kv_cache,
+    init_params,
+    speculative_generate,
+)
+from tpu_dra_driver.workloads.models.generate import _decode_attention
+from tpu_dra_driver.workloads.ops.decode_attention import (
+    decode_block_t,
+    flash_decode_attention,
+)
+
+CFG = ModelConfig(vocab=256, d_model=128, n_heads=4, n_kv_heads=2,
+                  n_layers=2, d_ff=256, max_seq=256, use_rope=True)
+
+
+def _qkv(b=2, h=8, h_kv=2, L=640, hd=64, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, 1, hd), dtype)
+    kc = jax.random.normal(ks[1], (b, h_kv, L, hd), dtype)
+    vc = jax.random.normal(ks[2], (b, h_kv, L, hd), dtype)
+    return q, kc, vc
+
+
+@pytest.mark.parametrize("pos", [0, 5, 127, 128, 300, 639])
+def test_kernel_matches_einsum_fp(pos):
+    q, kc, vc = _qkv()
+    ref = _decode_attention(q, kc, vc, jnp.int32(pos))
+    got = flash_decode_attention(q, kc, vc, jnp.int32(pos), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("pos", [7, 300, 639])
+def test_kernel_matches_einsum_int8(pos):
+    q, kc, vc = _qkv()
+    b, h_kv, L = 2, 2, 640
+    sk = jnp.abs(jax.random.normal(jax.random.PRNGKey(3),
+                                   (b, h_kv, L))) * 0.02 + 0.01
+    sv = jnp.abs(jax.random.normal(jax.random.PRNGKey(4),
+                                   (b, h_kv, L))) * 0.02 + 0.01
+    kc8 = (kc * 5).astype(jnp.int8)
+    vc8 = (vc * 5).astype(jnp.int8)
+    ref = _decode_attention(q, kc8, vc8, jnp.int32(pos), sk, sv)
+    got = flash_decode_attention(q, kc8, vc8, jnp.int32(pos), sk, sv,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_rejects_bad_shapes():
+    q, kc, vc = _qkv()
+    with pytest.raises(ValueError, match="g=1"):
+        flash_decode_attention(jnp.concatenate([q, q], axis=2), kc, vc,
+                               jnp.int32(0), interpret=True)
+    with pytest.raises(ValueError, match="k_scale"):
+        flash_decode_attention(
+            q, kc.astype(jnp.int8), vc.astype(jnp.int8), jnp.int32(0),
+            jnp.zeros((2, 2, 10)), jnp.zeros((2, 2, 10)), interpret=True)
+    with pytest.raises(ValueError, match="v_scale"):
+        flash_decode_attention(
+            q, kc.astype(jnp.int8), vc.astype(jnp.int8), jnp.int32(0),
+            jnp.zeros((2, 2, 640)), jnp.zeros((2, 2, 10)), interpret=True)
+    with pytest.raises(ValueError, match="divisor"):
+        flash_decode_attention(q, kc[:, :, :70], vc[:, :, :70],
+                               jnp.int32(0), interpret=True)
+
+
+def test_decode_block_t():
+    assert decode_block_t(3584) == 512
+    assert decode_block_t(3200) == 128
+    assert decode_block_t(640) == 128
+    assert decode_block_t(70) == 0
+
+
+def test_cache_lengths_are_128_padded():
+    cache = init_kv_cache(CFG, 2, 200)
+    assert cache["k"][0].shape[2] == 256          # rounded up
+    ring = init_kv_cache(replace(CFG, window=48), 2, 200)
+    assert ring["k"][0].shape[2] == 48            # ring keeps the window
+
+
+def test_kv_int8_cache_structure_and_bytes():
+    qcfg = replace(CFG, kv_int8=True)
+    cache = init_kv_cache(qcfg, 2, 128)
+    assert cache["k"][0].dtype == jnp.int8
+    assert cache["k_s"][0].shape == cache["k"][0].shape[:3]
+    fp = init_kv_cache(CFG, 2, 128)
+    kv_bytes = lambda c: sum(a.size * a.dtype.itemsize
+                             for a in jax.tree.leaves(c))
+    # int8 codes + fp32/hd scales ~= 0.53x of bf16
+    assert kv_bytes(cache) < 0.6 * kv_bytes(fp)
+
+
+def _teacher_forced_logits(params, cfg, toks):
+    """Per-step decode logits over a FIXED token stream — no
+    autoregressive coupling, so one near-tie argmax flip cannot cascade
+    (the failure mode that makes whole-generation comparisons bimodal)."""
+    from tpu_dra_driver.workloads.models import decode_step
+    b, t = toks.shape
+    cache = init_kv_cache(cfg, b, t)
+    out = []
+    for i in range(t):
+        logits, cache = decode_step(params, cfg, cache, jnp.int32(i),
+                                    toks[:, i])
+        out.append(logits)
+    return jnp.stack(out, axis=1)                     # [b, t, vocab]
+
+
+def _cosine(a, b):
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    return (a @ b) / (np.linalg.norm(a) * np.linalg.norm(b))
+
+
+def test_kv_int8_decode_logits_match_fp():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, CFG.vocab)
+    lp = _teacher_forced_logits(params, CFG, toks)
+    lq = _teacher_forced_logits(params, replace(CFG, kv_int8=True), toks)
+    assert _cosine(lp, lq) > 0.999
+    # and the end-to-end generation still runs on the int8 cache
+    prompt = toks[:, :8]
+    out = generate(params, replace(CFG, kv_int8=True), prompt, steps=8)
+    assert out.shape == (2, 16)
+
+
+def test_kv_int8_ring_cache_logits_match_fp():
+    wcfg = replace(CFG, window=16)
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, CFG.vocab)
+    lp = _teacher_forced_logits(params, wcfg, toks)
+    lq = _teacher_forced_logits(params, replace(wcfg, kv_int8=True), toks)
+    assert _cosine(lp, lq) > 0.999
+
+
+def test_kv_int8_speculative_matches_generate():
+    qcfg = replace(CFG, kv_int8=True)
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab)
+    want = generate(params, qcfg, prompt, steps=12)
+    got = speculative_generate(params, qcfg, params, qcfg, prompt,
+                               steps=12, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_kv_int8_decode_bench_runs():
+    from tpu_dra_driver.workloads.models import decode_tokens_per_sec
+    cfg = replace(CFG, kv_int8=True)
+    out = decode_tokens_per_sec(b=2, prompt_len=8, gen_short=4, gen_long=16,
+                                iters=1, cfg=cfg)
+    assert out["decode_tokens_per_sec"] > 0

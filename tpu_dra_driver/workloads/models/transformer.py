@@ -80,6 +80,14 @@ class ModelConfig:
     # with window. Inference-side, generate(prefix_lm=True) makes the
     # whole prompt the bidirectional region instead of a fixed length.
     prefix: int = 0
+    # kv_int8 stores the decode KV cache as int8 codes with one fp32
+    # scale per written vector (absmax over head_dim): cache HBM reads
+    # and memory halve — the long-context decode lever (cache traffic
+    # grows with context; weights don't). Dequantization factors out of
+    # the attention contractions exactly (scores scale per key, combine
+    # weights scale per value), so the only error is the int8 rounding
+    # of each cached vector. Training is unaffected (no cache).
+    kv_int8: bool = False
 
 
 Params = Dict

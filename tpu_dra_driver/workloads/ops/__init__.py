@@ -4,6 +4,9 @@ from tpu_dra_driver.workloads.ops.collectives import (  # noqa: F401
     matmul_tflops,
     matmul_tflops_steady,
 )
+from tpu_dra_driver.workloads.ops.decode_attention import (  # noqa: F401
+    flash_decode_attention,
+)
 from tpu_dra_driver.workloads.ops.attention import (  # noqa: F401
     attention_reference,
     flash_attention,

@@ -1,0 +1,211 @@
+"""Pallas flash-decode: single-query attention reads over the KV cache.
+
+**When to use**: caches preallocated far beyond the written prefix
+(pos << L) — the serving pattern that reserves a max_t-long buffer and
+fills it as it decodes. Measured on v5e (b8, kv4, hd128, L=32k,
+pos=512): 178 us/read vs 741 us for the masked-einsum formulation —
+the kernel reads O(pos), the einsum O(L). At pos ~= L the einsum wins
+(~1.6x: XLA pipelines a full-length stream better), which is why
+models/generate.py — whose caches are tightly allocated — uses the
+grouped einsum and not this kernel.
+
+The kernel's levers:
+
+- **O(pos), not O(max_t)**: the cache is allocated at max_t but only
+  ``pos + 1`` slots are written. ``pos`` rides scalar prefetch into the
+  BlockSpec index maps, which clamp every out-of-range block index to
+  the last live block — Pallas then re-issues the same (already
+  resident) DMA instead of streaming the dead cache tail, and
+  ``pl.when`` skips the compute. XLA's masked-einsum formulation cannot
+  do this (masking happens after the full read).
+- **GQA without materialization**: the query-head group folds into
+  matmul rows ([group, hd] @ [hd, block_t]) against the shared KV head
+  — no ``jnp.repeat`` of the cache (the repeat materializes a
+  group-times-larger cache copy per step; measured ~4x step cost at
+  decode shapes).
+- **int8 caches stream as int8**: codes widen to bf16 in VMEM after the
+  DMA; per-vector fp32 scales factor exactly out of both contractions
+  (score_t = scale_t * (q · codes_t); combine weights scale per value).
+  The XLA path materializes a widened cache copy per step, erasing the
+  bandwidth win; here HBM only ever sees int8.
+- one-pass **online softmax** (flash-decoding), f32 accumulators.
+
+Shapes: q [b, h, 1, hd], cache [b, h_kv, L, hd] (bf16/fp32 or int8),
+scales [b, h_kv, L] fp32. Ring caches work unchanged: the visibility
+mask ``slot <= pos`` admits every slot once the ring has wrapped, and
+the index-map clamp never exceeds the ring length.
+
+Reference: the driver has no inference surface (PARITY.md §2.6); this
+is the serving-path analog of ops/attention.py's training kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# minimum cache-block width the TPU lowering can tile; init_kv_cache pads
+# full-length caches to a multiple of this so the kernel always qualifies
+KV_BLOCK = 128
+
+
+def round_up_kv(n: int) -> int:
+    """n rounded up to the next KV_BLOCK multiple."""
+    return -(-n // KV_BLOCK) * KV_BLOCK
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
+                   block_t: int, num_t: int, sm_scale: float,
+                   quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        o_ref, m_sc, l_sc, acc_sc = rest
+    j = pl.program_id(1)
+    pos = pos_ref[0]
+    jmax = jnp.minimum(pos // block_t, num_t - 1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when(j <= jmax)
+    def _step():
+        q = q_ref[0]                                   # [R, hd]
+        k = k_ref[0]                                   # [block_t, hd]
+        s = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [R, block_t]
+        if quantized:
+            s = s * ks_ref[...]                        # [1, block_t]
+        s = s * sm_scale
+        slot = j * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(slot <= pos, s, NEG_INF)
+
+        m_prev, l_prev, acc_prev = m_sc[:], l_sc[:], acc_sc[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                         # [R, block_t] f32
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0].astype(q.dtype)
+        if quantized:
+            p = p * vs_ref[...]                        # [1, block_t]
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = m_new
+        l_sc[:] = l_new
+        acc_sc[:] = acc_new
+
+    @pl.when(j == num_t - 1)
+    def _finish():
+        o_ref[0] = (acc_sc[:] / l_sc[:]).astype(o_ref.dtype)
+
+
+def decode_block_t(L: int, requested: int = 512) -> int:
+    """Largest power-of-two divisor of L up to ``requested``, or 0 when
+    none >= KV_BLOCK exists (callers fall back to the einsum read).
+    Cache lengths padded to KV_BLOCK multiples (init_kv_cache does this
+    for full-length caches) always qualify."""
+    blk = min(requested, L)
+    while blk >= KV_BLOCK:
+        if L % blk == 0:
+            return blk
+        blk //= 2
+    return 0
+
+
+def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
+                           v_cache: jax.Array, pos: jax.Array,
+                           k_scale=None, v_scale=None,
+                           block_t: int = 512,
+                           interpret: bool = False) -> jax.Array:
+    """Single-step decode attention: q [b, h, 1, hd] against the cache
+    [b, h_kv, L, hd], visibility ``slot <= pos``. Returns [b, h, 1, hd]
+    in q.dtype. See the module docstring for the design."""
+    b, h, g, hd = q.shape
+    if g != 1:
+        raise ValueError(f"flash_decode_attention is the g=1 decode read "
+                         f"(got g={g}); wide verifies use the einsum path")
+    h_kv, L = k_cache.shape[1], k_cache.shape[2]
+    if h % h_kv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    quantized = k_scale is not None
+    if quantized and (v_scale is None or k_scale.shape != (b, h_kv, L)
+                      or v_scale.shape != (b, h_kv, L)):
+        raise ValueError("int8 cache needs k_scale and v_scale [b, h_kv, L]")
+    rep = h // h_kv
+    block_t = decode_block_t(L, block_t)
+    if not block_t:
+        raise ValueError(
+            f"cache length {L} has no block divisor >= {KV_BLOCK}; "
+            f"pad cache lengths to a multiple of {KV_BLOCK}")
+    num_t = L // block_t
+
+    qf = q.reshape(b * h_kv, rep, hd)
+    kf = k_cache.reshape(b * h_kv, L, hd)
+    vf = v_cache.reshape(b * h_kv, L, hd)
+
+    def clamped(ndim):
+        # cache-block index clamped to the last live block: the dead
+        # tail is never DMA'd (re-reading a resident block is free next
+        # to a fresh HBM stream)
+        def index_map(i, j, pos_ref):
+            jmax = jnp.minimum(pos_ref[0] // block_t, num_t - 1)
+            return (i, jnp.minimum(j, jmax), 0)[:ndim]
+        return index_map
+
+    fixed = lambda i, j, pos_ref: (i, 0, 0)
+    vmem = {"memory_space": pltpu.VMEM}
+    in_specs = [
+        pl.BlockSpec((1, rep, hd), fixed, **vmem),
+        pl.BlockSpec((1, block_t, hd), clamped(3), **vmem),
+        pl.BlockSpec((1, block_t, hd), clamped(3), **vmem),
+    ]
+    args = [qf, kf, vf]
+    if quantized:
+        # scales ride as [B, 1, L]: Mosaic requires the second-minor
+        # block dim to divide 8 or equal the array dim — the inserted
+        # unit dim satisfies the latter, and the None squeezes B
+        def scale_map(i, j, pos_ref):
+            jmax = jnp.minimum(pos_ref[0] // block_t, num_t - 1)
+            return (i, 0, jnp.minimum(j, jmax))
+        scale_spec = pl.BlockSpec((None, 1, block_t), scale_map, **vmem)
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale.astype(jnp.float32).reshape(b * h_kv, 1, L),
+                 v_scale.astype(jnp.float32).reshape(b * h_kv, 1, L)]
+
+    kernel = functools.partial(
+        _decode_kernel, block_t=block_t, num_t=num_t,
+        sm_scale=1.0 / math.sqrt(hd), quantized=quantized)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h_kv, num_t),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, rep, hd), fixed, **vmem),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h_kv, rep, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(jnp.atleast_1d(pos).astype(jnp.int32), *args)
+    return out.reshape(b, h, 1, hd)
