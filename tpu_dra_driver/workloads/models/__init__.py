@@ -24,6 +24,7 @@ from tpu_dra_driver.workloads.models.speculative import (  # noqa: F401
 )
 from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     block_prefill,
+    chunked_prefill,
     decode_step,
     decode_tokens_per_sec,
     evaluate_nll,

@@ -218,6 +218,43 @@ def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
     return logits, new_cache, jnp.int32(t0)
 
 
+def chunked_prefill(params: Params, cfg: ModelConfig, cache: Dict,
+                    tokens: jax.Array, chunk: int):
+    """Fill the cache from a [b, t0] prompt in t0/chunk wide steps
+    (lax.scan over :func:`wide_step`).
+
+    The single-block prefill materializes O(t0^2) attention scores; the
+    chunked form bounds the transient at O(chunk * t0) while keeping
+    every matmul [chunk]-wide on the MXU — the standard long-prompt
+    prefill (32k+ tokens) where one wide block would blow HBM. Causality
+    falls out of wide_step's per-row visibility (row i of a chunk at
+    base p sees slots <= p + i). Requires the full-length cache and a
+    causal model (no prefix_lm: the bidirectional prompt region needs
+    the whole prompt in one block). Returns (last-position logits
+    [b, vocab], cache, pos=t0)."""
+    b, t0 = tokens.shape
+    if cfg.window > 0:
+        raise ValueError("chunked_prefill requires cfg.window == 0 "
+                         "(ring caches fill one slot at a time)")
+    if chunk < 1 or t0 % chunk:
+        raise ValueError(
+            f"prompt length {t0} must divide into chunks of {chunk}")
+    chunks = tokens.reshape(b, t0 // chunk, chunk).transpose(1, 0, 2)
+
+    # only the latest chunk's last-position logits ride the carry — a
+    # scan *output* would stack a [t0/chunk, b, vocab] buffer of
+    # discarded logits (the ring prefill in _generate avoids the same)
+    def body(carry, tk):
+        cache, pos, _ = carry
+        logits, cache = wide_step(params, cfg, cache, pos, tk)
+        return (cache, pos + chunk, logits[:, -1]), None
+
+    zero_logits = jnp.zeros((b, cfg.vocab), jnp.float32)
+    (cache, _, last), _ = jax.lax.scan(
+        body, (cache, jnp.int32(0), zero_logits), chunks)
+    return last, cache, jnp.int32(t0)
+
+
 def wide_step(params: Params, cfg: ModelConfig, cache: Dict,
               pos: jax.Array, toks: jax.Array):
     """Multi-token decode step: ``toks`` [b, g] int32 at positions
@@ -359,7 +396,8 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
              steps: int, max_t: Optional[int] = None,
              temperature: float = 0.0, top_k: int = 0,
              key: Optional[jax.Array] = None,
-             prefix_lm: Optional[bool] = None) -> jax.Array:
+             prefix_lm: Optional[bool] = None,
+             prefill_chunk: Optional[int] = None) -> jax.Array:
     """Generation: prompt [b, t0] int32 → [b, t0 + steps].
 
     Prefill fills the KV cache from the prompt (block forward, or a
@@ -382,6 +420,9 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
     matvec steps); windowed ring caches use the sequential scan.
     ``prefix_lm=True`` additionally makes the prompt region
     bidirectional (T5/PaLM prefix-LM decode; needs the block path).
+    ``prefill_chunk`` switches to :func:`chunked_prefill` (t0/chunk
+    wide steps) — bounds the prefill's attention transient at
+    O(chunk * t0) for long prompts; causal models only.
     """
     if steps <= 0:
         return prompt
@@ -408,6 +449,16 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
     if prefix_lm and cfg.window > 0:
         raise ValueError("prefix_lm needs the block prefill, which the "
                          "windowed ring cache cannot host (window == 0)")
+    if prefill_chunk is not None:
+        if cfg.window > 0:
+            raise ValueError("prefill_chunk needs a full-length cache "
+                             "(window == 0)")
+        if prefix_lm:
+            raise ValueError("prefill_chunk is causal-only (prefix_lm "
+                             "needs the whole prompt in one block)")
+        if prefill_chunk < 1 or prompt.shape[1] % prefill_chunk:
+            raise ValueError(f"prompt length {prompt.shape[1]} must divide "
+                             f"into chunks of {prefill_chunk}")
     if key is None:
         key = jax.random.PRNGKey(0)          # unused on the greedy path
     # coerce to host types: temperature may arrive as a np/jnp scalar,
@@ -415,14 +466,14 @@ def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
     temperature = float(temperature)
     return _generate(params, cfg, prompt, steps, max_t,
                      temperature > 0, top_k, jnp.float32(temperature), key,
-                     bool(prefix_lm))
+                     bool(prefix_lm), prefill_chunk)
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "steps", "max_t", "sample", "top_k",
-                          "prefix_lm"))
+                          "prefix_lm", "prefill_chunk"))
 def _generate(params, cfg, prompt, steps, max_t, sample, top_k,
-              temperature, key, prefix_lm=False):
+              temperature, key, prefix_lm=False, prefill_chunk=None):
     b, t0 = prompt.shape
     cache = init_kv_cache(cfg, b, max_t)
 
@@ -448,6 +499,9 @@ def _generate(params, cfg, prompt, steps, max_t, sample, top_k,
         (cache, pos, last_logits), _ = jax.lax.scan(
             prefill_body, (cache, jnp.int32(0), zero_logits),
             prompt.T)                                       # over time
+    elif prefill_chunk is not None:
+        last_logits, cache, pos = chunked_prefill(
+            params, cfg, cache, prompt, prefill_chunk)
     else:
         last_logits, cache, pos = block_prefill(
             params, cfg, cache, prompt, prefix_lm=prefix_lm)

@@ -31,9 +31,11 @@ The kernel's levers:
 - one-pass **online softmax** (flash-decoding), f32 accumulators.
 
 Shapes: q [b, h, 1, hd], cache [b, h_kv, L, hd] (bf16/fp32 or int8),
-scales [b, h_kv, L] fp32. Ring caches work unchanged: the visibility
-mask ``slot <= pos`` admits every slot once the ring has wrapped, and
-the index-map clamp never exceeds the ring length.
+scales [b, h_kv, L] fp32. Ring caches work unchanged when the window
+has a block divisor >= KV_BLOCK (init_kv_cache pads only full-length
+caches): the visibility mask ``slot <= pos`` admits every slot once the
+ring has wrapped, and the index-map clamp never exceeds the ring
+length.
 
 Reference: the driver has no inference surface (PARITY.md §2.6); this
 is the serving-path analog of ops/attention.py's training kernels.
@@ -113,8 +115,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
 
 
 def decode_block_t(L: int, requested: int = 512) -> int:
-    """Largest power-of-two divisor of L up to ``requested``, or 0 when
-    none >= KV_BLOCK exists (callers fall back to the einsum read).
+    """A divisor of L to use as the cache block: min(requested, L), then
+    halved until it divides L; 0 when nothing >= KV_BLOCK divides
+    (callers fall back to the einsum read).
     Cache lengths padded to KV_BLOCK multiples (init_kv_cache does this
     for full-length caches) always qualify."""
     blk = min(requested, L)
