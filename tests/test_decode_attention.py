@@ -79,9 +79,12 @@ def test_kernel_rejects_bad_shapes():
 
 def test_decode_block_t():
     assert decode_block_t(3584) == 512
-    assert decode_block_t(3200) == 128
+    assert decode_block_t(3200) == 128       # largest 128-multiple divisor
     assert decode_block_t(640) == 128
+    assert decode_block_t(1280) == 256
+    assert decode_block_t(640, requested=384) == 128   # non-pow2 request
     assert decode_block_t(70) == 0
+    assert decode_block_t(128) == 128
 
 
 def test_cache_lengths_are_128_padded():

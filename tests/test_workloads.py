@@ -684,3 +684,14 @@ def test_remat_identical_loss_and_grads():
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_train_throughput_bench_runs():
+    from tpu_dra_driver.workloads.models import train_tokens_per_sec
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=2,
+                      d_ff=128, max_seq=16, use_rope=True, remat=True,
+                      scan_layers=True)
+    out = train_tokens_per_sec(b=2, t=16, iters=1, steps_short=1,
+                               steps_long=3, cfg=cfg, use_flash=False)
+    assert out["train_tokens_per_sec"] > 0
+    assert out["params_m"] > 0

@@ -7,7 +7,9 @@ from tpu_dra_driver.workloads.models.transformer import (  # noqa: F401
     loss_positions,
     nll_from_logits,
     make_train_step,
+    param_count,
     stack_layer_params,
+    train_tokens_per_sec,
     unstack_layer_params,
 )
 from tpu_dra_driver.workloads.models.quantize import (  # noqa: F401

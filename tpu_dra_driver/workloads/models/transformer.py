@@ -352,6 +352,80 @@ def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
                            loss_positions(cfg, tokens.shape[1]))
 
 
+def param_count(params: Params) -> int:
+    from tpu_dra_driver.workloads.models.quantize import QTensor
+    n = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)):
+        n += (leaf.q.size if isinstance(leaf, QTensor) else leaf.size)
+    return n
+
+
+def train_tokens_per_sec(b: int = 8, t: int = 2048, iters: int = 3,
+                         steps_short: int = 2, steps_long: int = 12,
+                         cfg: Optional[ModelConfig] = None,
+                         use_flash: Optional[bool] = None) -> dict:
+    """Full-model training throughput: tokens/s and achieved model
+    TFLOP/s for chained train steps (grad + AdamW update) on a
+    GPT-class block stack — the end-to-end number the per-op benches
+    (matmul, flash attention) bound from above.
+
+    Marginal-rate timed over two chain lengths so dispatch and the
+    first step's overheads cancel. FLOPs use the standard estimate
+    6*N per token (fwd+bwd matmuls) plus 6*n_layers*t*d_model for
+    causal attention scores/values fwd+bwd — approximate by design;
+    the interesting signal is tokens/s and the trend."""
+    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+
+    cfg = cfg or ModelConfig(vocab=8192, d_model=2048, n_heads=16,
+                             n_kv_heads=4, n_layers=8, d_ff=8192,
+                             max_seq=t, use_rope=True, remat=True,
+                             scan_layers=True)
+    if use_flash is None:
+        from tpu_dra_driver.workloads.ops.attention import _on_tpu
+        use_flash = _on_tpu()
+    attn_fn = None
+    if use_flash:
+        from tpu_dra_driver.workloads.ops.attention import flash_attention
+        attn_fn = flash_attention
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    train_step, opt_init = make_train_step(
+        cfg, optimizer=default_optimizer(), attn_fn=attn_fn)
+    opt_state = opt_init(params)
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (b, t), 0, cfg.vocab)
+    batch = (tokens, tokens)
+
+    from functools import lru_cache
+
+    @lru_cache
+    def prog(n):
+        @jax.jit
+        def run(params, opt_state, batch):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = train_step(p, o, batch)
+                return (p, o), loss
+            (_, _), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=n)
+            return losses[-1]
+        return run
+
+    def make_run(n):
+        return lambda: prog(n)(params, opt_state, batch)
+
+    per_step = marginal_chain_rate(make_run, steps_short, steps_long, iters)
+    n_params = param_count(params)
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * t * cfg.d_model
+    tps = b * t / per_step
+    return {"train_tokens_per_sec": tps,
+            "train_step_ms": per_step * 1e3,
+            "model_tflops": tps * flops_per_token / 1e12,
+            "params_m": n_params / 1e6,
+            "shape": (f"b{b} t{t} L{cfg.n_layers} d{cfg.d_model}"
+                      + (" flash" if use_flash else ""))}
+
+
 def default_optimizer(lr: float = 3e-4, warmup_steps: int = 100,
                       total_steps: int = 10_000, clip_norm: float = 1.0,
                       weight_decay: float = 0.1):

@@ -115,16 +115,17 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
 
 
 def decode_block_t(L: int, requested: int = 512) -> int:
-    """A divisor of L to use as the cache block: min(requested, L), then
-    halved until it divides L; 0 when nothing >= KV_BLOCK divides
-    (callers fall back to the einsum read).
-    Cache lengths padded to KV_BLOCK multiples (init_kv_cache does this
-    for full-length caches) always qualify."""
-    blk = min(requested, L)
-    while blk >= KV_BLOCK:
+    """The largest KV_BLOCK-multiple divisor of L that is <= requested,
+    or 0 when none exists (callers fall back to the einsum read). The
+    KV_BLOCK multiplicity is a Mosaic tiling constraint: block_t is the
+    minor dim of the scale blocks (must divide 128) and the second-minor
+    dim of the K/V blocks (must divide 8). Cache lengths padded to
+    KV_BLOCK multiples (init_kv_cache does this for full-length caches)
+    always qualify. Trace-time only — a short linear scan."""
+    top = (min(requested, L) // KV_BLOCK) * KV_BLOCK
+    for blk in range(top, KV_BLOCK - 1, -KV_BLOCK):
         if L % blk == 0:
             return blk
-        blk //= 2
     return 0
 
 
