@@ -23,6 +23,7 @@ demo:
 	$(PYTHON) demo/run_computedomain_demo.py
 	$(PYTHON) demo/run_multislice_demo.py
 	$(PYTHON) demo/run_training_demo.py
+	$(PYTHON) demo/run_serving_demo.py
 
 clean:
 	$(MAKE) -C native clean

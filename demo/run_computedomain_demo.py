@@ -18,11 +18,9 @@ import os
 import subprocess
 import sys
 import tempfile
-import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_dra_driver.plugin.claims import build_allocated_claim
 from tpu_dra_driver.testing.harness import ClusterHarness
 
 WORKLOAD = r"""
@@ -52,29 +50,7 @@ def main() -> int:
         uid = h.clients.compute_domains.get("demo-cd", "demo")["metadata"]["uid"]
         print(f"[1] ComputeDomain created (uid {uid[:8]}…), daemonset stamped")
 
-        cfgs = [{
-            "source": "FromClaim", "requests": [],
-            "opaque": {"driver": "compute-domain.tpu.google.com", "parameters": {
-                "apiVersion": "resource.tpu.google.com/v1beta1",
-                "kind": "ComputeDomainChannelConfig", "domainID": uid,
-            }},
-        }]
-        results = {}
-
-        def prep(i):
-            claim = build_allocated_claim(
-                f"w{i}", f"wl-{i}", "demo", ["channel-0"], f"host-{i}",
-                configs=cfgs, driver_name="compute-domain.tpu.google.com",
-                request="channel")
-            results[i] = h.host(i).cd_plugin.prepare_resource_claims([claim])[f"w{i}"]
-
-        threads = [threading.Thread(target=prep, args=(i,)) for i in (0, 1)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=60)
-        for i in (0, 1):
-            assert results[i].error is None, results[i].error
+        h.prepare_channel_claims(uid, (0, 1), "w")
         st = h.cd_status("demo-cd", "demo")
         print(f"[2] rendezvous complete: CD status={st['status']}, "
               f"nodes={[(n['name'], n['index'], n['status']) for n in st['nodes']]}")

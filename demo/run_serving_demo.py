@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Serving demo: DRA-provisioned ComputeDomain -> replicated tp-sharded
+int8 inference, hardware-free.
+
+The driver's job ends at wiring chips and worker identity; this demo is
+the serving-side proof that what it wired is usable: a 2-host
+ComputeDomain rendezvous (the imex-test1-shaped flow), then each host
+runs a real JAX "model server" under its injected CDI env — the same
+int8-quantized transformer, tensor-parallel over a virtual 8-device
+mesh — and both replicas must produce IDENTICAL tokens (the consistency
+a serving fleet relies on when any replica may answer a request).
+
+Covers, end to end: ComputeDomain create -> daemon rendezvous
+(gap-filled TPU_WORKER_ID, stable hostnames) -> readiness-gated Prepare
+-> CDI env injection -> quantize_params (int8 weights) -> Megatron
+param shardings -> generate() under the mesh -> cross-replica equality.
+
+Run: python3 demo/run_serving_demo.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dra_driver.testing.harness import ClusterHarness
+
+SERVER = r"""
+import os, json
+ident = {
+    "worker_id": os.environ["TPU_WORKER_ID"],
+    "hostnames": os.environ["TPU_WORKER_HOSTNAMES"],
+}
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax.sharding import NamedSharding, PartitionSpec as P
+from tpu_dra_driver.workloads.models import (
+    ModelConfig, generate, init_params, quantize_params)
+from tpu_dra_driver.workloads.parallel import build_mesh, param_shardings
+
+# "the checkpoint": every replica loads identical weights (seeded init
+# stands in for a shared checkpoint read)
+cfg = ModelConfig(vocab=512, d_model=256, n_heads=8, n_kv_heads=2,
+                  n_layers=2, d_ff=512, max_seq=128, use_rope=True,
+                  dtype=jax.numpy.float32)
+params = quantize_params(init_params(cfg, jax.random.PRNGKey(7)))
+mesh = build_mesh(jax.devices(), dp=2, tp=4)
+params = jax.device_put(params, param_shardings(mesh, params))
+prompt = jax.numpy.tile(jax.numpy.arange(16, dtype=jax.numpy.int32)[None],
+                        (2, 1))
+prompt = jax.device_put(prompt, NamedSharding(mesh, P("dp", None)))
+out = generate(params, cfg, prompt, steps=24)
+# report only the GENERATED tokens — echoing the fixed prompt would make
+# the cross-replica equality trivially true
+ident["tokens"] = [int(t) for t in out[0, prompt.shape[1]:]]
+ident["mesh"] = f"dp={mesh.shape['dp']} tp={mesh.shape['tp']}"
+print(json.dumps(ident))
+"""
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="tpu-serving-demo-")
+    h = ClusterHarness(tmp, accelerator_type="v5p-16", prepare_budget=30.0)
+    h.start()
+    try:
+        h.create_compute_domain("serve-cd", "demo", 2, "wl-rct")
+        uid = h.clients.compute_domains.get(
+            "serve-cd", "demo")["metadata"]["uid"]
+        print(f"[1] ComputeDomain created (uid {uid[:8]}…)")
+
+        h.prepare_channel_claims(uid, (0, 1), "s")
+        print("[2] rendezvous complete; both claims prepared")
+
+        payloads = {}
+        for i in (0, 1):
+            spec = h.host(i).cd_plugin.state._cdi.read_claim_spec(f"s{i}")
+            env = dict(e.split("=", 1)
+                       for e in spec["devices"][0]["containerEdits"]["env"])
+            out = subprocess.run(
+                [sys.executable, "-c", SERVER],
+                env={**os.environ, **env},
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                capture_output=True, text=True, timeout=600)
+            assert out.returncode == 0, out.stderr[-2000:]
+            payloads[i] = json.loads(out.stdout.strip().splitlines()[-1])
+            print(f"[3] host-{i} replica: worker_id={payloads[i]['worker_id']} "
+                  f"mesh({payloads[i]['mesh']}) "
+                  f"tokens[:6]={payloads[i]['tokens'][:6]}")
+
+        assert payloads[0]["worker_id"] != payloads[1]["worker_id"]
+        assert payloads[0]["tokens"] == payloads[1]["tokens"], \
+            "replicas disagree — serving consistency broken"
+        print("[4] replicas agree on all generated tokens. Serving demo OK")
+        return 0
+    finally:
+        h.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

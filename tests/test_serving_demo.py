@@ -1,0 +1,19 @@
+"""Subprocess smoke test for the serving demo
+(demo/run_serving_demo.py): ComputeDomain rendezvous -> per-host
+tp-sharded int8 replicas -> cross-replica token equality."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_serving_demo_end_to_end():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "demo", "run_serving_demo.py")],
+        capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Serving demo OK" in out.stdout
+    assert "replicas agree" in out.stdout
+    assert "mesh(dp=2 tp=4)" in out.stdout

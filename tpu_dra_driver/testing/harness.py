@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME as CD_DRIVER_NAME
 from tpu_dra_driver.computedomain import COMPUTE_DOMAIN_LABEL_KEY, DRIVER_NAMESPACE
 from tpu_dra_driver.computedomain.controller.controller import (
     ComputeDomainController,
@@ -248,3 +249,53 @@ class ClusterHarness:
 
     def cd_status(self, name: str, namespace: str) -> Dict:
         return self.clients.compute_domains.get(name, namespace).get("status") or {}
+
+    def prepare_channel_claims(self, uid: str, hosts, claim_prefix: str,
+                               namespace: str = "demo",
+                               timeout: float = 60.0) -> Dict:
+        """Prepare one ComputeDomain channel claim per host, concurrently
+        (the workload-pods-land-together shape every CD demo needs).
+
+        Joins with liveness checks and re-raises thread-side exceptions,
+        so a rendezvous hang or prepare error surfaces as itself rather
+        than as a missing-result KeyError. Returns {host_index:
+        PrepareResult}, all already asserted error-free."""
+        from tpu_dra_driver.plugin.claims import build_allocated_claim
+        cfgs = [{
+            "source": "FromClaim", "requests": [],
+            "opaque": {"driver": CD_DRIVER_NAME, "parameters": {
+                "apiVersion": "resource.tpu.google.com/v1beta1",
+                "kind": "ComputeDomainChannelConfig", "domainID": uid,
+            }},
+        }]
+        results: Dict[int, object] = {}
+        errors: Dict[int, BaseException] = {}
+
+        def prep(i: int) -> None:
+            try:
+                claim = build_allocated_claim(
+                    f"{claim_prefix}{i}", f"{claim_prefix}-wl-{i}",
+                    namespace, ["channel-0"], f"host-{i}", configs=cfgs,
+                    driver_name=CD_DRIVER_NAME, request="channel")
+                results[i] = self.host(i).cd_plugin.prepare_resource_claims(
+                    [claim])[f"{claim_prefix}{i}"]
+            except BaseException as e:       # noqa: BLE001 — re-raised below
+                errors[i] = e
+
+        threads = [threading.Thread(target=prep, args=(i,), daemon=True)
+                   for i in hosts]
+        for t in threads:
+            t.start()
+        for i, t in zip(hosts, threads):
+            t.join(timeout=timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"host-{i} claim prepare still running after {timeout}s "
+                    f"(rendezvous hang?)")
+        if errors:
+            raise next(iter(errors.values()))
+        for i in hosts:
+            if results[i].error is not None:
+                raise AssertionError(
+                    f"host-{i} prepare failed: {results[i].error}")
+        return results

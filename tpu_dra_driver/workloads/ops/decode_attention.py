@@ -118,8 +118,9 @@ def decode_block_t(L: int, requested: int = 512) -> int:
     """The largest KV_BLOCK-multiple divisor of L that is <= requested,
     or 0 when none exists (callers fall back to the einsum read). The
     KV_BLOCK multiplicity is a Mosaic tiling constraint: block_t is the
-    minor dim of the scale blocks (must divide 128) and the second-minor
-    dim of the K/V blocks (must divide 8). Cache lengths padded to
+    minor dim of the scale blocks (must be a multiple of 128) and the
+    second-minor dim of the K/V blocks (a multiple of 8) — any 128
+    multiple satisfies both. Cache lengths padded to
     KV_BLOCK multiples (init_kv_cache does this for full-length caches)
     always qualify. Trace-time only — a short linear scan."""
     top = (min(requested, L) // KV_BLOCK) * KV_BLOCK
