@@ -19,6 +19,10 @@ from tpu_dra_driver.workloads.models.quantize import (  # noqa: F401
     quantize,
     quantize_params,
 )
+from tpu_dra_driver.workloads.models.beam import (  # noqa: F401
+    beam_search,
+    sequence_logprob,
+)
 from tpu_dra_driver.workloads.models.speculative import (  # noqa: F401
     self_speculative_generate,
     speculative_decode_tokens_per_sec,

@@ -1,0 +1,138 @@
+"""Beam search over the KV-cache decode path.
+
+Fixed-width, fixed-length beam search (no EOS semantics — the workload
+tier has no tokenizer; sequences all have t0 + steps tokens and compare
+by total log-probability). TPU-first mechanics:
+
+- prefill runs ONCE per batch row ([b, t0] block forward), then the
+  cache tiles to [b*beam, ...] — no per-beam prefill FLOPs;
+- each step is one [b*beam]-batched ``decode_step`` followed by a
+  top-(beam) over the [beam * vocab] continuation scores;
+- beam reordering gathers the cache along the batch axis
+  (``jnp.take(leaf, parent, axis=0)``). This copies the live cache
+  every step — the textbook cost of beam search on accelerators; the
+  copy is batched, contiguous, and XLA-pipelined;
+- everything static-shape under one jit: tokens buffer [b, beam,
+  steps] rides the scan carry, reordered by parent alongside the cache.
+
+The returned best row satisfies: teacher-forced re-scoring of the
+returned tokens reproduces the reported score exactly (tested) — the
+invariant that catches cache-reorder bugs.
+
+Reference: the driver has no inference surface (PARITY.md §2.6); this
+completes the generation API family (greedy/sampling in generate.py,
+draft-verify in speculative.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra_driver.workloads.models.generate import (
+    block_prefill,
+    decode_step,
+    init_kv_cache,
+)
+from tpu_dra_driver.workloads.models.transformer import ModelConfig, Params
+
+
+def beam_search(params: Params, cfg: ModelConfig, prompt: jax.Array,
+                steps: int, beam: int = 4,
+                return_all: bool = False):
+    """prompt [b, t0] → best continuation [b, t0 + steps] (or, with
+    ``return_all``, (sequences [b, beam, t0 + steps], scores [b, beam])
+    sorted best-first). Scores are total log-probability of the
+    generated suffix under the model."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if beam < 1:
+        raise ValueError(f"beam must be >= 1, got {beam}")
+    if cfg.window > 0:
+        raise ValueError("beam_search requires a full-length cache "
+                         "(window == 0)")
+    if beam > cfg.vocab:
+        raise ValueError(f"beam {beam} exceeds vocab {cfg.vocab}")
+    if not cfg.use_rope and prompt.shape[1] + steps > cfg.max_seq:
+        # same guard as generate(): the learned pos_embed table bounds
+        # positions, and dynamic_slice would clamp silently past it
+        raise ValueError(f"t0+steps ({prompt.shape[1] + steps}) exceeds "
+                         f"max_seq {cfg.max_seq}")
+    seqs, scores = _beam_search(params, cfg, prompt, steps, beam)
+    if return_all:
+        return seqs, scores
+    return seqs[:, 0]
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "beam"))
+def _beam_search(params, cfg, prompt, steps, beam):
+    b, t0 = prompt.shape
+    V = cfg.vocab
+    cache = init_kv_cache(cfg, b, t0 + steps)
+    last_logits, cache, pos = block_prefill(
+        params, cfg, cache, prompt, prefix_lm=cfg.prefix > 0)
+
+    # first expansion: top-beam tokens of the prefill logits seed the
+    # beams (distinct by construction, so no -inf masking dance)
+    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+    scores, first = jax.lax.top_k(logp0, beam)             # [b, beam]
+    first = first.astype(prompt.dtype)
+
+    # tile the prefilled cache to one row per beam: [b*beam, ...]
+    cache = jax.tree.map(lambda a: jnp.repeat(a, beam, axis=0), cache)
+
+    toks = jnp.zeros((b, beam, steps), prompt.dtype)
+    toks = toks.at[:, :, 0].set(first)
+
+    def body(carry, i):
+        cache, toks, scores, last = carry
+        # `last` holds the tokens at position pos + i - 1 (buffer slot
+        # i - 1); this step scores slot i
+        logits, cache = decode_step(params, cfg, cache, pos + i - 1,
+                                    last.reshape(b * beam))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        total = scores[:, :, None] + logp.reshape(b, beam, V)
+        scores, flat = jax.lax.top_k(total.reshape(b, beam * V), beam)
+        parent = flat // V                                  # [b, beam]
+        tok = (flat % V).astype(toks.dtype)
+        # reorder beam-major state by parent: cache rows are b*beam with
+        # row r = batch * beam + beam_idx
+        gather = (jnp.arange(b)[:, None] * beam + parent).reshape(-1)
+        cache = jax.tree.map(lambda a: jnp.take(a, gather, axis=0), cache)
+        toks = jnp.take_along_axis(toks, parent[:, :, None], axis=1)
+        toks = jax.lax.dynamic_update_index_in_dim(toks, tok, i, axis=2)
+        return (cache, toks, scores, tok), None
+
+    if steps > 1:
+        (cache, toks, scores, _), _ = jax.lax.scan(
+            body, (cache, toks, scores, first), jnp.arange(1, steps))
+
+    # beams come out of top_k best-first already
+    seqs = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None], (b, beam, t0)), toks], axis=2)
+    return seqs, scores
+
+
+def sequence_logprob(params: Params, cfg: ModelConfig, prompt: jax.Array,
+                     full: jax.Array) -> jax.Array:
+    """Total log-probability of the generated suffix ``full[:, t0:]``
+    given ``full[:, :-1]`` as teacher-forced input — the re-scoring
+    oracle the beam tests pin beam_search's reported scores against.
+
+    Prefix-LM models are scored with the whole prompt as the
+    bidirectional region (prefix = t0), mirroring what the generation
+    prefill attended — cfg.prefix is the *training* prefix length and
+    would be a different attention pattern."""
+    from dataclasses import replace
+    from tpu_dra_driver.workloads.models.transformer import forward
+    t0 = prompt.shape[1]
+    if cfg.prefix > 0:
+        cfg = replace(cfg, prefix=t0)
+    logits = forward(params, full[:, :-1], cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt = full[:, 1:]
+    tok_lp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return tok_lp[:, t0 - 1:].sum(axis=-1)
