@@ -19,6 +19,12 @@ from tpu_dra_driver.workloads.models.quantize import (  # noqa: F401
     quantize,
     quantize_params,
 )
+from tpu_dra_driver.workloads.models.lora import (  # noqa: F401
+    init_lora,
+    lora_param_counts,
+    make_lora_train_step,
+    merge_lora,
+)
 from tpu_dra_driver.workloads.models.beam import (  # noqa: F401
     beam_search,
     sequence_logprob,

@@ -1,0 +1,141 @@
+"""LoRA: low-rank adapter fine-tuning over the flagship transformer.
+
+Formulation: merged-weight recompute. Adapters are a sparse mirror of
+the param pytree holding {"a": [in, r], "b": [r, out]} pairs for the
+chosen weight leaves; ``merge_lora`` rebuilds a full param pytree as
+``W + scale * (a @ b)`` and the ordinary ``forward``/``loss_fn`` runs
+unchanged — no model-code hooks, so LoRA composes with everything the
+base model does (remat, scan_layers, GQA, MoE, flash attention,
+sharded training). ``jax.grad`` w.r.t. the adapter pytree alone gives
+adapter-only gradients; the AdamW state lives only on adapters — the
+actual LoRA win on TPU, where optimizer moments double the HBM bill of
+full fine-tuning.
+
+The per-step ``a @ b`` recompute is one [in, r] @ [r, out] matmul per
+adapted weight — negligible next to the forward's [tokens, in] @
+[in, out] (r << tokens), and XLA fuses the add into the consumer
+matmul's operand stream.
+
+Reference: the driver has no training surface (PARITY.md §2.6); this
+extends the validation-workload tier's training family (full
+fine-tuning in transformer.py, ZeRO-1 in parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_dra_driver.workloads.models.transformer import (
+    ModelConfig,
+    Params,
+    loss_fn,
+    param_count,
+)
+
+# weight leaves that take adapters by default: the attention projections
+# (the standard LoRA target set; w_up/w_down opt-in via `targets`)
+DEFAULT_TARGETS = ("wqkv", "wo")
+
+
+def init_lora(params: Params, rank: int, key: jax.Array,
+              targets: Tuple[str, ...] = DEFAULT_TARGETS,
+              dtype=jnp.bfloat16) -> Dict:
+    """Adapter pytree mirroring ``params``' structure at the targeted
+    2-D (or stacked [L, in, out]) weight leaves: {"a": gaussian-init
+    [.., in, r], "b": zero-init [.., r, out]} — b = 0 makes step 0 the
+    base model exactly."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    # one fold per adapted leaf — no fixed key pool to exhaust at depth
+    counter = iter(range(1 << 31))
+
+    def next_key():
+        return jax.random.fold_in(key, next(counter))
+
+    def walk(node):
+        if isinstance(node, list):
+            return [walk(x) for x in node]
+        if not isinstance(node, dict):
+            return None
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, (dict, list)):
+                sub = walk(v)
+                if sub is not None and jax.tree.leaves(sub):
+                    out[k] = sub
+            elif k in targets and hasattr(v, "ndim") and v.ndim >= 2:
+                lead = v.shape[:-2]
+                a = (0.02 * jax.random.normal(
+                    next_key(), (*lead, v.shape[-2], rank))).astype(dtype)
+                b = jnp.zeros((*lead, rank, v.shape[-1]), dtype)
+                out[k] = {"a": a, "b": b}
+        return out
+
+    adapters = walk(params)
+    if not jax.tree.leaves(adapters):
+        raise ValueError(f"no adapter targets {targets} found in params")
+    return adapters
+
+
+def merge_lora(params: Params, adapters: Dict,
+               scale: float = 1.0) -> Params:
+    """Full param pytree with ``W + scale * (a @ b)`` at every adapted
+    leaf (other leaves pass through by reference)."""
+
+    def walk(p, ad):
+        if ad is None:
+            return p
+        if isinstance(p, list):
+            return [walk(x, ad[i] if isinstance(ad, list) else None)
+                    for i, x in enumerate(p)]
+        if not isinstance(p, dict):
+            return p
+        out = {}
+        for k, v in p.items():
+            sub = ad.get(k) if isinstance(ad, dict) else None
+            if (isinstance(sub, dict) and set(sub.keys()) == {"a", "b"}
+                    and not isinstance(sub.get("a"), dict)):
+                delta = jnp.matmul(sub["a"].astype(jnp.float32),
+                                   sub["b"].astype(jnp.float32))
+                out[k] = (v.astype(jnp.float32)
+                          + scale * delta).astype(v.dtype)
+            elif isinstance(v, (dict, list)):
+                out[k] = walk(v, sub)
+            else:
+                out[k] = v
+        return out
+
+    return walk(params, adapters)
+
+
+def make_lora_train_step(cfg: ModelConfig, base_params: Params,
+                         rank_scale: float = 1.0, optimizer=None,
+                         attn_fn=None):
+    """Returns (train_step, init_opt_state) where train_step is
+    (adapters, opt_state, batch) -> (adapters, opt_state, loss) — the
+    base stays frozen (closed over as a jit constant) and the optimizer
+    state covers adapters only."""
+    opt = optimizer or optax.adamw(1e-3)
+
+    def lora_loss(adapters, batch):
+        merged = merge_lora(base_params, adapters, rank_scale)
+        return loss_fn(merged, batch, cfg, attn_fn)
+
+    grad_fn = jax.value_and_grad(lora_loss)
+
+    def train_step(adapters, opt_state, batch):
+        loss, grads = grad_fn(adapters, batch)
+        updates, opt_state = opt.update(grads, opt_state, adapters)
+        adapters = optax.apply_updates(adapters, updates)
+        return adapters, opt_state, loss
+
+    return train_step, opt.init
+
+
+def lora_param_counts(params: Params, adapters: Dict) -> Dict[str, int]:
+    return {"base": param_count(params),
+            "adapters": sum(x.size for x in jax.tree.leaves(adapters))}
