@@ -45,12 +45,12 @@ def test_lora_training_reduces_loss_base_frozen():
     params, batch = _data()
     base_snapshot = jax.tree.map(lambda x: np.asarray(x).copy(), params)
     adapters = init_lora(params, rank=4, key=jax.random.PRNGKey(2))
-    step, opt_init = make_lora_train_step(CFG, params)
+    step, opt_init = make_lora_train_step(CFG)
     opt_state = opt_init(adapters)
     jstep = jax.jit(step)
     losses = []
     for _ in range(10):
-        adapters, opt_state, loss = jstep(adapters, opt_state, batch)
+        adapters, opt_state, loss = jstep(params, adapters, opt_state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
     # frozen base: bit-identical after training
@@ -81,16 +81,17 @@ def test_lora_scan_layers_storage():
     l0 = float(loss_fn(params, batch, cfg))
     lm = float(loss_fn(merged, batch, cfg))
     assert abs(l0 - lm) < 1e-5
-    step, opt_init = make_lora_train_step(cfg, params)
-    adapters, _, loss = jax.jit(step)(adapters, opt_init(adapters), batch)
+    step, opt_init = make_lora_train_step(cfg)
+    adapters, _, loss = jax.jit(step)(params, adapters, opt_init(adapters),
+                                      batch)
     assert float(loss) > 0
 
 
 def test_lora_merged_model_generates():
     params, batch = _data()
     adapters = init_lora(params, rank=4, key=jax.random.PRNGKey(2))
-    step, opt_init = make_lora_train_step(CFG, params)
-    adapters, _, _ = jax.jit(step)(adapters, opt_init(adapters), batch)
+    step, opt_init = make_lora_train_step(CFG)
+    adapters, _, _ = jax.jit(step)(params, adapters, opt_init(adapters), batch)
     merged = merge_lora(params, adapters)
     out = generate(merged, CFG, batch[0][:, :8], steps=8)
     assert out.shape == (4, 16)

@@ -695,3 +695,46 @@ def test_train_throughput_bench_runs():
                                steps_long=3, cfg=cfg, use_flash=False)
     assert out["train_tokens_per_sec"] > 0
     assert out["params_m"] > 0
+
+
+def test_new_collective_benches_run_on_mesh():
+    from tpu_dra_driver.workloads.ops import (
+        all_to_all_bandwidth, ppermute_latency, reduce_scatter_bandwidth,
+    )
+    rs = reduce_scatter_bandwidth(mib_per_device=1, iters=1)
+    assert rs.algo_gbps > 0
+    aa = all_to_all_bandwidth(mib_per_device=1, iters=1)
+    assert aa.algo_gbps > 0
+    pl = ppermute_latency(hops=16, elems=256, iters=1)  # 16 % 8 == 0: self-checks
+    assert pl.per_hop_us > 0
+
+
+def test_adafactor_optimizer_trains_and_state_is_small():
+    import jax.numpy as jnp
+    from tpu_dra_driver.workloads.models import default_optimizer
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=2,
+                      d_ff=128, max_seq=32, dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    step, opt_init = make_train_step(
+        cfg, optimizer=default_optimizer(warmup_steps=1, kind="adafactor"))
+    opt_state = opt_init(params)
+    tokens = jax.random.randint(key, (4, 32), 0, cfg.vocab)
+    jstep = jax.jit(step)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = jstep(params, opt_state, (tokens, tokens))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    def state_bytes(s):
+        return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(s)
+                   if hasattr(x, "size"))
+    adam_state = default_optimizer(warmup_steps=1).init(params)
+    # at these tiny dims (< optax's min_dim_size_to_factor=128) nothing
+    # factors, so the saving is "no first moment" ~= half of Adam; real
+    # model dims factor the second moment down to row+col vectors too
+    assert state_bytes(opt_state) <= 0.55 * state_bytes(adam_state)
+    import pytest
+    with pytest.raises(ValueError, match="kind"):
+        default_optimizer(kind="sgd9000")

@@ -112,23 +112,24 @@ def merge_lora(params: Params, adapters: Dict,
     return walk(params, adapters)
 
 
-def make_lora_train_step(cfg: ModelConfig, base_params: Params,
-                         rank_scale: float = 1.0, optimizer=None,
-                         attn_fn=None):
+def make_lora_train_step(cfg: ModelConfig, rank_scale: float = 1.0,
+                         optimizer=None, attn_fn=None):
     """Returns (train_step, init_opt_state) where train_step is
-    (adapters, opt_state, batch) -> (adapters, opt_state, loss) — the
-    base stays frozen (closed over as a jit constant) and the optimizer
-    state covers adapters only."""
+    (base_params, adapters, opt_state, batch) -> (adapters, opt_state,
+    loss). The base rides as an explicit argument — not a jit-captured
+    constant — so it stays a single device buffer (no constant-folded
+    fp32 copy baked into the executable) and can be donated or resharded
+    per call; gradients flow to the adapter pytree only."""
     opt = optimizer or optax.adamw(1e-3)
 
-    def lora_loss(adapters, batch):
+    def lora_loss(adapters, base_params, batch):
         merged = merge_lora(base_params, adapters, rank_scale)
         return loss_fn(merged, batch, cfg, attn_fn)
 
     grad_fn = jax.value_and_grad(lora_loss)
 
-    def train_step(adapters, opt_state, batch):
-        loss, grads = grad_fn(adapters, batch)
+    def train_step(base_params, adapters, opt_state, batch):
+        loss, grads = grad_fn(adapters, base_params, batch)
         updates, opt_state = opt.update(grads, opt_state, adapters)
         adapters = optax.apply_updates(adapters, updates)
         return adapters, opt_state, loss

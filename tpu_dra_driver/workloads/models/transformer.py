@@ -428,18 +428,39 @@ def train_tokens_per_sec(b: int = 8, t: int = 2048, iters: int = 3,
 
 def default_optimizer(lr: float = 3e-4, warmup_steps: int = 100,
                       total_steps: int = 10_000, clip_norm: float = 1.0,
-                      weight_decay: float = 0.1):
+                      weight_decay: Optional[float] = None,
+                      kind: str = "adamw"):
     """The standard LM training recipe: global-norm gradient clipping +
-    AdamW on a linear-warmup cosine-decay schedule. One optax chain —
-    pure pytree transforms, shards with whatever the params shard as
-    (incl. ZeRO-1 via zero1_opt_shardings)."""
+    the chosen optimizer on a linear-warmup cosine-decay schedule. One
+    optax chain — pure pytree transforms, shards with whatever the
+    params shard as (incl. ZeRO-1 via zero1_opt_shardings).
+
+    ``kind="adafactor"`` swaps in Adafactor (factored second moments,
+    no first moment): optimizer state drops from 2x params to ~the row
+    + column factor vectors — the classic TPU memory trade when HBM,
+    not steps, is the constraint. ``weight_decay`` is the AdamW-style
+    decoupled coefficient (default 0.1 under adamw) and is rejected,
+    not silently dropped, with adafactor — its ``weight_decay_rate`` is
+    a per-step multiplicative shrink with entirely different units."""
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
         decay_steps=total_steps, end_value=lr * 0.1)
-    return optax.chain(
-        optax.clip_by_global_norm(clip_norm),
-        optax.adamw(schedule, weight_decay=weight_decay),
-    )
+    if kind == "adamw":
+        inner = optax.adamw(
+            schedule, weight_decay=0.1 if weight_decay is None
+            else weight_decay)
+    elif kind == "adafactor":
+        if weight_decay is not None:
+            raise ValueError(
+                "weight_decay is the AdamW-style decoupled coefficient; "
+                "adafactor's weight_decay_rate has different (per-step "
+                "multiplicative) semantics — configure optax.adafactor "
+                "directly if you need it")
+        inner = optax.adafactor(learning_rate=schedule)
+    else:
+        raise ValueError(f"unknown optimizer kind {kind!r} "
+                         f"(adamw | adafactor)")
+    return optax.chain(optax.clip_by_global_norm(clip_norm), inner)
 
 
 def make_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,

@@ -1,8 +1,11 @@
 from tpu_dra_driver.workloads.ops.collectives import (  # noqa: F401
-    psum_bandwidth,
     all_gather_bandwidth,
+    all_to_all_bandwidth,
     matmul_tflops,
     matmul_tflops_steady,
+    ppermute_latency,
+    psum_bandwidth,
+    reduce_scatter_bandwidth,
 )
 from tpu_dra_driver.workloads.ops.decode_attention import (  # noqa: F401
     flash_decode_attention,

@@ -42,6 +42,27 @@ def _mesh1d(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.array(devs), axis_names=("x",))
 
 
+def _bandwidth_bench(body, bus_factor, mib_per_device, devices, dtype,
+                     iters, divisible=False) -> BandwidthResult:
+    """Shared scaffold: build the 1-D mesh, place [n, elems] data, time
+    the shard_mapped collective, convert to algo/bus GB/s."""
+    mesh = _mesh1d(devices)
+    n = mesh.devices.size
+    elems = (mib_per_device << 20) // jnp.dtype(dtype).itemsize
+    if divisible:
+        elems -= elems % n
+    x = jnp.ones((n, elems), dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+
+    fn = jax.jit(partial(jax.shard_map, mesh=mesh, in_specs=P("x", None),
+                         out_specs=P("x", None))(body(n, elems)))
+    timed = time_fn(lambda: fn(x), warmup=2, iters=iters)
+    payload = elems * jnp.dtype(dtype).itemsize
+    algo = payload / timed.median_s / 1e9
+    return BandwidthResult(payload, timed.median_s, algo,
+                           algo * bus_factor(n))
+
+
 def psum_bandwidth(mib_per_device: int = 64,
                    devices: Optional[Sequence] = None,
                    dtype=jnp.float32, iters: int = 5) -> BandwidthResult:
@@ -51,45 +72,87 @@ def psum_bandwidth(mib_per_device: int = 64,
     accounting nccl-tests/nvbandwidth report, so numbers are comparable to
     the reference's jobs.
     """
-    mesh = _mesh1d(devices)
-    n = mesh.devices.size
-    elems = (mib_per_device << 20) // jnp.dtype(dtype).itemsize
-    x = jnp.ones((n, elems), dtype)
-    x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
-
-    @jax.jit
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("x", None),
-             out_specs=P("x", None))
-    def allreduce(shard):
-        return jax.lax.psum(shard, "x")
-
-    timed = time_fn(lambda: allreduce(x), warmup=2, iters=iters)
-    payload = elems * jnp.dtype(dtype).itemsize
-    algo = payload / timed.median_s / 1e9
-    bus = algo * (2 * (n - 1) / n)
-    return BandwidthResult(payload, timed.median_s, algo, bus)
+    return _bandwidth_bench(
+        lambda n, e: (lambda shard: jax.lax.psum(shard, "x")),
+        lambda n: 2 * (n - 1) / n, mib_per_device, devices, dtype, iters)
 
 
 def all_gather_bandwidth(mib_per_device: int = 64,
                          devices: Optional[Sequence] = None,
                          dtype=jnp.float32, iters: int = 5) -> BandwidthResult:
+    return _bandwidth_bench(
+        lambda n, e: (lambda shard: jax.lax.all_gather(
+            shard, "x", axis=0).reshape(1, -1)),
+        lambda n: (n - 1) / n, mib_per_device, devices, dtype, iters)
+
+
+def reduce_scatter_bandwidth(mib_per_device: int = 64,
+                             devices: Optional[Sequence] = None,
+                             dtype=jnp.float32,
+                             iters: int = 5) -> BandwidthResult:
+    """Reduce-scatter (lax.psum_scatter) bandwidth over a 1-D mesh — the
+    collective behind ZeRO sharded-grad sync; bus factor (n-1)/n."""
+    return _bandwidth_bench(
+        lambda n, e: (lambda shard: jax.lax.psum_scatter(
+            shard, "x", scatter_dimension=1, tiled=True)),
+        lambda n: (n - 1) / n, mib_per_device, devices, dtype, iters,
+        divisible=True)
+
+
+def all_to_all_bandwidth(mib_per_device: int = 64,
+                         devices: Optional[Sequence] = None,
+                         dtype=jnp.float32,
+                         iters: int = 5) -> BandwidthResult:
+    """All-to-all bandwidth over a 1-D mesh — the collective behind
+    Ulysses sequence parallelism and MoE dispatch; each device sends
+    (n-1)/n of its payload."""
+    return _bandwidth_bench(
+        lambda n, e: (lambda shard: jax.lax.all_to_all(
+            shard.reshape(n, e // n), "x", split_axis=0,
+            concat_axis=0, tiled=True).reshape(1, -1)),
+        lambda n: (n - 1) / n, mib_per_device, devices, dtype, iters,
+        divisible=True)
+
+
+@dataclass
+class LatencyResult:
+    hops: int
+    per_hop_us: float
+
+    def __str__(self) -> str:
+        return (f"RESULT ppermute latency: {self.per_hop_us:.1f} us/hop "
+                f"({self.hops} chained ring hops)")
+
+
+def ppermute_latency(hops: int = 64, elems: int = 1024,
+                     devices: Optional[Sequence] = None,
+                     iters: int = 5) -> LatencyResult:
+    """Latency of a small-message neighbor ppermute (the ring-attention
+    hop), measured as a dependent chain of ring rotations so per-call
+    dispatch amortizes. After n hops the data returns home, so
+    correctness is self-checking (asserted)."""
     mesh = _mesh1d(devices)
     n = mesh.devices.size
-    elems = (mib_per_device << 20) // jnp.dtype(dtype).itemsize
-    x = jnp.ones((n, elems), dtype)
-    x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    x = jnp.arange(n * elems, dtype=jnp.float32).reshape(n, elems)
+    xs = jax.device_put(x, NamedSharding(mesh, P("x", None)))
 
     @jax.jit
     @partial(jax.shard_map, mesh=mesh, in_specs=P("x", None),
              out_specs=P("x", None))
-    def gather(shard):
-        return jax.lax.all_gather(shard, "x", axis=0).reshape(1, -1)
+    def ring(shard):
+        def body(_, z):
+            return jax.lax.ppermute(z, "x", perm)
+        return jax.lax.fori_loop(0, hops, body, shard)
 
-    timed = time_fn(lambda: gather(x), warmup=2, iters=iters)
-    payload = elems * jnp.dtype(dtype).itemsize
-    algo = payload / timed.median_s / 1e9
-    bus = algo * ((n - 1) / n)
-    return BandwidthResult(payload, timed.median_s, algo, bus)
+    out = ring(xs)
+    if hops % n == 0 and out.is_fully_addressable:
+        # after a multiple of n hops the data is home again; only check
+        # when this process can read every shard (multi-host runs can't
+        # np.asarray a globally-sharded array)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    timed = time_fn(lambda: ring(xs), warmup=2, iters=iters)
+    return LatencyResult(hops, timed.median_s / hops * 1e6)
 
 
 @dataclass
@@ -174,6 +237,9 @@ def main() -> None:
             process_id=slice_id * len(hosts) + int(worker_id))
     print(psum_bandwidth(), flush=True)
     print(all_gather_bandwidth(), flush=True)
+    print(reduce_scatter_bandwidth(), flush=True)
+    print(all_to_all_bandwidth(), flush=True)
+    print(ppermute_latency(), flush=True)
 
 
 if __name__ == "__main__":
