@@ -1,0 +1,104 @@
+"""Paged attention: kernel vs oracle, pool appends, ragged batches
+(virtual 8-device CPU mesh via conftest; kernel in interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra_driver.workloads.ops.paged_attention import (
+    init_pool,
+    paged_attention_reference,
+    paged_decode_attention,
+    pool_append,
+)
+from tpu_dra_driver.workloads.models.generate import _decode_attention
+
+
+def _fill_pool(b=2, h=8, h_kv=2, hd=64, block_t=128, n_blocks=12,
+               lens=(300, 135), seed=0):
+    """Build a pool whose per-sequence contents equal a dense reference
+    cache, with shuffled (non-contiguous) physical block assignment."""
+    key = jax.random.split(jax.random.PRNGKey(seed), 4)
+    max_blocks = max((l + block_t - 1) // block_t for l in lens) + 1
+    dense_L = max_blocks * block_t
+    kc = jax.random.normal(key[0], (b, h_kv, dense_L, hd), jnp.float32)
+    vc = jax.random.normal(key[1], (b, h_kv, dense_L, hd), jnp.float32)
+    q = jax.random.normal(key[2], (b, h, 1, hd), jnp.float32)
+
+    pool_k, pool_v = init_pool(n_blocks, block_t, h_kv, hd, jnp.float32)
+    # physical ids 1.. in an interleaved order (block 0 = null block)
+    phys = iter(np.random.RandomState(seed).permutation(
+        np.arange(1, n_blocks)))
+    table = np.zeros((b, max_blocks), np.int32)
+    for i in range(b):
+        nb = (lens[i] + block_t - 1) // block_t
+        for j in range(nb):
+            blk = int(next(phys))
+            table[i, j] = blk
+            sl = kc[i, :, j * block_t:(j + 1) * block_t]
+            pool_k = pool_k.at[blk].set(sl)
+            pool_v = pool_v.at[blk].set(vc[i, :, j * block_t:(j + 1) * block_t])
+    return (q, kc, vc, pool_k, pool_v, jnp.asarray(table),
+            jnp.asarray(lens, jnp.int32), dense_L)
+
+
+def test_reference_matches_dense_masked_attention():
+    q, kc, vc, pk, pv, table, lens, dense_L = _fill_pool()
+    got = paged_attention_reference(q, pk, pv, table, lens)
+    for i, L in enumerate([int(x) for x in lens]):
+        want = _decode_attention(q[i:i+1], kc[i:i+1], vc[i:i+1],
+                                 jnp.int32(L - 1))
+        np.testing.assert_allclose(np.asarray(got[i:i+1]), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("lens", [(300, 135), (128, 128), (1, 257)])
+def test_kernel_matches_reference(lens):
+    q, kc, vc, pk, pv, table, jlens, _ = _fill_pool(lens=lens)
+    want = paged_attention_reference(q, pk, pv, table, jlens)
+    got = paged_decode_attention(q, pk, pv, table, jlens, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_handles_zero_length_rows():
+    q, kc, vc, pk, pv, table, _, _ = _fill_pool()
+    lens = jnp.asarray([300, 0], jnp.int32)
+    got = paged_decode_attention(q, pk, pv, table, lens, interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+    # row 0 unaffected by row 1 being empty
+    want = paged_attention_reference(q, pk, pv, table, lens)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pool_append_then_read():
+    b, h_kv, hd, block_t, n_blocks = 2, 2, 64, 128, 6
+    pk, pv = init_pool(n_blocks, block_t, h_kv, hd, jnp.float32)
+    table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    lens = jnp.zeros((b,), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    n_append = block_t + 5                 # crosses a block boundary
+    ks = jax.random.normal(key, (n_append, b, h_kv, hd))
+    vs = jax.random.normal(jax.random.PRNGKey(1), (n_append, b, h_kv, hd))
+    for t in range(n_append):
+        pk, pv = pool_append(pk, pv, table, lens, ks[t], vs[t])
+        lens = lens + 1
+    q = jax.random.normal(jax.random.PRNGKey(2), (b, 4, 1, hd))
+    got = paged_decode_attention(q, pk, pv, table, lens, interpret=True)
+    # dense oracle from the appended vectors
+    kc = ks.transpose(1, 2, 0, 3)          # [b, h_kv, t, hd]
+    vc = vs.transpose(1, 2, 0, 3)
+    want = _decode_attention(q, kc, vc, jnp.int32(n_append - 1))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_validation():
+    q, kc, vc, pk, pv, table, lens, _ = _fill_pool()
+    with pytest.raises(ValueError, match="g=1"):
+        paged_decode_attention(jnp.concatenate([q, q], axis=2), pk, pv,
+                               table, lens, interpret=True)
+    with pytest.raises(ValueError, match="batch"):
+        paged_decode_attention(q, pk, pv, table[:1], lens, interpret=True)

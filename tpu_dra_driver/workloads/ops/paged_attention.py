@@ -1,0 +1,218 @@
+"""Paged attention: decode reads over a block-pooled KV cache.
+
+vLLM-style memory model, TPU-native mechanics. Instead of one
+contiguous [b, h_kv, max_t, hd] cache per batch row, K/V live in a
+shared pool of fixed-size blocks ``[n_blocks, h_kv, block_t, hd]`` and
+each sequence owns an int32 **block table** — physical block ids for
+its logical positions. Sequences grow by appending blocks from a free
+list; memory scales with tokens actually written, not with
+max_t * batch, and ragged batches (continuous batching) stop paying
+for their longest member.
+
+The read kernel follows the block table with **scalar prefetch**: the
+table rides in SMEM ahead of the grid, and each (sequence*head, j)
+grid step's BlockSpec index map looks up ``table[seq, j]`` to DMA the
+right physical block — the table indirection costs nothing on the data
+path (this is the part XLA cannot express: a gather would materialize
+per-sequence contiguous copies every step). Out-of-range j (past the
+sequence's length) clamps to block 0 with compute skipped, so grid
+size is the batch max while HBM traffic is per-sequence O(len).
+
+Appends are plain ``dynamic_update_slice`` scatters into the pool at
+(physical block, offset) — one vector per sequence per step.
+
+The einsum fallback (`paged_attention_reference`) gathers pool blocks
+per sequence and is the CPU-testable oracle.
+
+Reference: the driver has no inference surface (PARITY.md §2.6); this
+is the serving-scale cache layout on top of ops/decode_attention.py's
+flash-decode machinery.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def init_pool(n_blocks: int, block_t: int, h_kv: int, hd: int,
+              dtype=jnp.bfloat16) -> Tuple[jax.Array, jax.Array]:
+    """Zeroed K and V pools [n_blocks, h_kv, block_t, hd] (head-major
+    inside a block so the kernel's per-head BlockSpec tiles cleanly on
+    the (block_t, hd) minor dims). Block 0 is conventionally reserved
+    as the null block the kernel's clamp reads (its contents are
+    masked, never mixed in)."""
+    shape = (n_blocks, h_kv, block_t, hd)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def pool_append(pool_k: jax.Array, pool_v: jax.Array, table: jax.Array,
+                lens: jax.Array, k: jax.Array, v: jax.Array):
+    """Write one new K/V vector per sequence at its next position.
+
+    pool_*: [n_blocks, h_kv, block_t, hd]; table: [b, max_blocks] int32
+    physical ids; lens: [b] tokens already written; k/v: [b, h_kv, hd].
+    Returns updated (pool_k, pool_v). The caller guarantees each
+    sequence's table already maps block ``lens // block_t``."""
+    block_t = pool_k.shape[2]
+    b = k.shape[0]
+
+    def write_one(i, pools):
+        pk, pv = pools
+        blk = table[i, lens[i] // block_t]
+        off = lens[i] % block_t
+        pk = jax.lax.dynamic_update_slice(
+            pk, k[i][None, :, None].astype(pk.dtype), (blk, 0, off, 0))
+        pv = jax.lax.dynamic_update_slice(
+            pv, v[i][None, :, None].astype(pv.dtype), (blk, 0, off, 0))
+        return pk, pv
+
+    return jax.lax.fori_loop(0, b, write_one, (pool_k, pool_v))
+
+
+def paged_attention_reference(q, pool_k, pool_v, table, lens):
+    """Oracle: gather each sequence's blocks and run masked attention.
+    q: [b, h, 1, hd]; table: [b, max_blocks]; lens: [b]."""
+    b, h, _, hd = q.shape
+    n_blocks, h_kv, block_t, _ = pool_k.shape
+    max_blocks = table.shape[1]
+    # [b, max_blocks, h_kv, block_t, hd] -> [b, h_kv, L, hd]
+    def gather(pool):
+        g = pool[table]                              # [b, mb, h_kv, bt, hd]
+        g = g.transpose(0, 2, 1, 3, 4)
+        return g.reshape(b, h_kv, max_blocks * block_t, hd)
+    kc, vc = gather(pool_k), gather(pool_v)
+    rep = h // h_kv
+    qg = q.reshape(b, h_kv, rep, hd)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg,
+                   kc.astype(q.dtype)).astype(jnp.float32)
+    s = s / math.sqrt(hd)
+    visible = jnp.arange(max_blocks * block_t)[None, :] < lens[:, None]
+    s = jnp.where(visible[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgt,bktd->bkgd", p, vc.astype(q.dtype))
+    return out.reshape(b, h, 1, hd)
+
+
+def _paged_kernel(tbl_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_sc, l_sc, acc_sc, *, block_t: int, max_blocks: int,
+                  h_kv: int, sm_scale: float):
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    seq = bh // h_kv
+    length = lens_ref[seq]
+    jmax = jnp.maximum(length - 1, 0) // block_t
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when((j <= jmax) & (length > 0))
+    def _step():
+        q = q_ref[0]                                   # [R, hd]
+        k = k_ref[...]       # [block_t, hd] (block+head dims squeezed)
+        s = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        slot = j * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(slot < length, s, NEG_INF)
+
+        m_prev, l_prev, acc_prev = m_sc[:], l_sc[:], acc_sc[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[...].astype(q.dtype)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = m_new
+        l_sc[:] = l_new
+        acc_sc[:] = acc_new
+
+    @pl.when(j == max_blocks - 1)
+    def _finish():
+        o_ref[0] = (acc_sc[:] / jnp.maximum(l_sc[:], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
+                           pool_v: jax.Array, table: jax.Array,
+                           lens: jax.Array,
+                           interpret: bool = False) -> jax.Array:
+    """Block-table decode read: q [b, h, 1, hd] against pooled caches.
+
+    table [b, max_blocks] int32 physical block ids (entries past the
+    live range may be anything valid — they clamp to the last live
+    block and are skipped); lens [b] written-token counts. Returns
+    [b, h, 1, hd]. Per-sequence HBM traffic is O(lens[i]), whatever
+    max_blocks is."""
+    b, h, g, hd = q.shape
+    if g != 1:
+        raise ValueError(f"paged_decode_attention is the g=1 decode read "
+                         f"(got g={g})")
+    n_blocks, h_kv, block_t, hd_p = pool_k.shape
+    if hd_p != hd:
+        raise ValueError(f"pool head dim {hd_p} != query head dim {hd}")
+    if h % h_kv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    if table.shape[0] != b or lens.shape != (b,):
+        raise ValueError("table/lens batch mismatch")
+    max_blocks = table.shape[1]
+    rep = h // h_kv
+
+    qf = q.reshape(b * h_kv, rep, hd)
+    # pool laid out [n_blocks, h_kv, block_t, hd]; the kernel wants one
+    # head's [block_t, hd] per grid cell — BlockSpec picks
+    # (physical block, head, 0, 0)
+    def kv_map(i, j, tbl_ref, lens_ref):
+        seq = i // h_kv
+        head = i % h_kv
+        length = lens_ref[seq]
+        jmax = jnp.maximum(length - 1, 0) // block_t
+        jj = jnp.minimum(j, jmax)
+        return (tbl_ref[seq, jj], head, 0, 0)
+
+    kernel = functools.partial(
+        _paged_kernel, block_t=block_t, max_blocks=max_blocks,
+        h_kv=h_kv, sm_scale=1.0 / math.sqrt(hd))
+
+    vmem = {"memory_space": pltpu.VMEM}
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                    # table, lens
+        grid=(b * h_kv, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, rep, hd),
+                         lambda i, j, t_, l_: (i, 0, 0), **vmem),
+            pl.BlockSpec((None, None, block_t, hd), kv_map, **vmem),
+            pl.BlockSpec((None, None, block_t, hd), kv_map, **vmem),
+        ],
+        out_specs=pl.BlockSpec((1, rep, hd),
+                               lambda i, j, t_, l_: (i, 0, 0), **vmem),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h_kv, rep, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(table.astype(jnp.int32), lens.astype(jnp.int32), qf,
+      pool_k, pool_v)
+    return out.reshape(b, h, 1, hd)
