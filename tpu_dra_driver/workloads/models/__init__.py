@@ -25,6 +25,10 @@ from tpu_dra_driver.workloads.models.lora import (  # noqa: F401
     make_lora_train_step,
     merge_lora,
 )
+from tpu_dra_driver.workloads.models.serving import (  # noqa: F401
+    ServingEngine,
+    paged_decode_step,
+)
 from tpu_dra_driver.workloads.models.beam import (  # noqa: F401
     beam_search,
     sequence_logprob,

@@ -1,0 +1,304 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The serving-scale decode loop: a fixed-capacity batch of rows, each row
+one in-flight request with its own block table into the shared K/V
+pools (ops/paged_attention.py). Requests join mid-flight (prefill into
+freshly allocated blocks), decode steps run for ALL active rows at once
+(one jitted program regardless of batch composition), and finished
+requests free their blocks back to the pool — the vLLM execution model,
+jit-compatible because every device-side shape is static: tables
+[max_batch, max_blocks], lens [max_batch], pools [n_blocks, ...];
+raggedness lives in the *values*.
+
+Division of labor:
+- device (``paged_decode_step``, one jit): embed the batch's pending
+  tokens, per layer project + RoPE at per-row positions, append one
+  K/V vector per row into the pools, paged-attention read, FFN, logits;
+- host (``ServingEngine``): block allocation (free list), table/lens
+  bookkeeping, admission (prefill via a dense forward whose per-layer
+  K/V are scattered into the pools), completion, detokenized-output
+  accumulation. Host work is O(batch) python per step — the device
+  program never recompiles as requests come and go.
+
+Correctness bar (tested): every request's tokens equal
+``generate(params, cfg, prompt, steps)`` run alone — continuous
+batching must be invisible to the output.
+
+Reference: the driver has no inference surface (PARITY.md §2.6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dra_driver.workloads.models.quantize import (
+    embed_lookup, lm_head, mm,
+)
+from tpu_dra_driver.workloads.models.transformer import (
+    ModelConfig,
+    Params,
+    _ffn,
+    _rmsnorm,
+    apply_rope,
+    unstack_layer_params,
+)
+from tpu_dra_driver.workloads.ops.paged_attention import (
+    init_pool,
+    paged_decode_attention,
+    pool_append,
+)
+
+
+def _on_tpu() -> bool:
+    from tpu_dra_driver.workloads.ops.attention import _on_tpu as f
+    return f()
+
+
+@partial(jax.jit, static_argnames=("cfg", "interpret"))
+def paged_decode_step(params, cfg: ModelConfig, pool_ks, pool_vs,
+                      tables, lens, tokens, interpret=False):
+    """One decode step for every row: tokens [B] at per-row positions
+    ``lens`` → (logits [B, vocab], updated pools). Rows with table row
+    0 (inactive) write into the null block and their logits are
+    garbage the host ignores."""
+    b = tokens.shape[0]
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    hd = cfg.d_model // cfg.n_heads
+    kv_d = hd * n_kv
+
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)[:, None]  # [B,1,d]
+    if not cfg.use_rope:
+        x = x + jnp.take(params["pos_embed"], jnp.minimum(
+            lens, params["pos_embed"].shape[0] - 1), axis=0)[:, None]
+
+    params = unstack_layer_params(params)
+    new_ks, new_vs = [], []
+    for li, layer in enumerate(params["layers"]):
+        xn = _rmsnorm(x, layer["ln1"]["g"])
+        qkv = mm(xn, layer["wqkv"])
+        q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_d], axis=-1)
+        q = q.reshape(b, 1, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, 1, n_kv, hd).transpose(0, 2, 1, 3)
+        if cfg.use_rope:
+            q = apply_rope(q, pos0=lens)
+            k = apply_rope(k, pos0=lens)
+        pk, pv = pool_append(pool_ks[li], pool_vs[li], tables, lens,
+                             k[:, :, 0], v[:, :, 0])
+        new_ks.append(pk)
+        new_vs.append(pv)
+        att = paged_decode_attention(q, pk, pv, tables, lens + 1,
+                                     interpret=interpret)
+        att = att.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+        x = x + mm(att, layer["wo"])
+        x = x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
+
+    x = _rmsnorm(x, params["final_norm"]["g"])
+    logits = lm_head(x, params["embed"])[:, 0]
+    return logits, new_ks, new_vs
+
+
+@partial(jax.jit, static_argnames=("cfg", "block_t"),
+         donate_argnums=(2, 3))
+def _admit_prefill(params, tokens, pool_ks, pool_vs, blocks,
+                   cfg: ModelConfig, block_t: int):
+    """Admission, one jit: dense prompt prefill through the SAME
+    block_prefill the generate() path uses (no forked forward to
+    drift), then scatter each layer's K/V into the allocated pool
+    blocks. Pools are donated — no full-pool copies per block. Compiles
+    per prompt-length bucket."""
+    from tpu_dra_driver.workloads.models.generate import (
+        block_prefill, init_kv_cache,
+    )
+    b, t0 = tokens.shape
+    nb = blocks.shape[0]
+    cache = init_kv_cache(cfg, 1, t0)
+    last_logits, cache, _ = block_prefill(params, cfg, cache, tokens)
+
+    for li in range(cfg.n_layers):
+        kc = cache["k"][li][0]                    # [h_kv, Lpad, hd]
+        vc = cache["v"][li][0]
+        pad = nb * block_t - kc.shape[1]
+        if pad > 0:
+            kc = jnp.pad(kc, ((0, 0), (0, pad), (0, 0)))
+            vc = jnp.pad(vc, ((0, 0), (0, pad), (0, 0)))
+
+        def write(j, pools, kc=kc, vc=vc, li=li):
+            pk, pv = pools
+            ck = jax.lax.dynamic_slice(
+                kc, (0, j * block_t, 0), (kc.shape[0], block_t, kc.shape[2]))
+            cv = jax.lax.dynamic_slice(
+                vc, (0, j * block_t, 0), (vc.shape[0], block_t, vc.shape[2]))
+            pk = jax.lax.dynamic_update_slice(
+                pk, ck[None].astype(pk.dtype), (blocks[j], 0, 0, 0))
+            pv = jax.lax.dynamic_update_slice(
+                pv, cv[None].astype(pv.dtype), (blocks[j], 0, 0, 0))
+            return pk, pv
+
+        pool_ks[li], pool_vs[li] = jax.lax.fori_loop(
+            0, nb, write, (pool_ks[li], pool_vs[li]))
+    return last_logits, pool_ks, pool_vs
+
+
+@dataclass
+class _Request:
+    rid: int
+    row: int
+    remaining: int
+    tokens: List[int] = field(default_factory=list)   # generated so far
+    pending: int = 0                                  # next token to feed
+
+
+class ServingEngine:
+    """Fixed-capacity continuous-batching decoder. Not thread-safe; the
+    caller owns the step loop (``run`` is the batteries-included
+    version)."""
+
+    def __init__(self, params: Params, cfg: ModelConfig, n_blocks: int,
+                 block_t: int = 128, max_batch: int = 8,
+                 max_blocks_per_seq: int = 32,
+                 interpret: Optional[bool] = None):
+        if cfg.window > 0 or cfg.prefix > 0:
+            raise ValueError("ServingEngine supports causal full-cache "
+                             "models (window == 0, prefix == 0)")
+        if cfg.kv_int8:
+            raise ValueError("ServingEngine pools are not quantized; "
+                             "cfg.kv_int8 would silently diverge from "
+                             "generate() — use int8 weights instead")
+        self.params, self.cfg = params, cfg
+        self.block_t = block_t
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        hd = cfg.d_model // cfg.n_heads
+        self.pool_ks, self.pool_vs = [], []
+        for _ in range(cfg.n_layers):
+            pk, pv = init_pool(n_blocks, block_t, n_kv, hd, cfg.dtype)
+            self.pool_ks.append(pk)
+            self.pool_vs.append(pv)
+        self.free = list(range(n_blocks - 1, 0, -1))   # block 0 = null
+        self.tables = np.zeros((max_batch, max_blocks_per_seq), np.int32)
+        self.lens = np.zeros((max_batch,), np.int32)
+        self.rows: List[Optional[_Request]] = [None] * max_batch
+        self._next_rid = 0
+        self.finished: Dict[int, List[int]] = {}
+        self.interpret = (not _on_tpu()) if interpret is None else interpret
+
+    # -- admission -------------------------------------------------------
+    def add(self, prompt: List[int], max_new_tokens: int) -> int:
+        """Prefill + admit one request; returns its request id. Raises
+        RuntimeError when no row or not enough blocks are free."""
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        t0 = len(prompt)
+        if not self.cfg.use_rope and t0 + max_new_tokens > self.cfg.max_seq:
+            # same contract as generate(): the learned pos_embed table
+            # bounds positions — fail loudly, never clamp silently
+            raise ValueError(f"t0+max_new_tokens ({t0 + max_new_tokens}) "
+                             f"exceeds max_seq {self.cfg.max_seq}")
+        need = -(-(t0 + max_new_tokens) // self.block_t)
+        if need > self.tables.shape[1]:
+            raise RuntimeError(f"request needs {need} blocks > "
+                               f"max_blocks_per_seq {self.tables.shape[1]}")
+        row = next((i for i, r in enumerate(self.rows) if r is None), None)
+        if row is None:
+            raise RuntimeError("batch full")
+        if len(self.free) < need:
+            raise RuntimeError("pool exhausted")
+
+        # prefill BEFORE taking blocks from the free list — a prefill
+        # failure must not leak pool capacity. The prompt's blocks are
+        # the first n_prompt of the allocation; the rest are decode room.
+        toks = jnp.asarray(prompt, jnp.int32)[None]
+        n_prompt = -(-t0 // self.block_t)
+        blocks = [self.free.pop() for _ in range(need)]
+        try:
+            last_logits, self.pool_ks, self.pool_vs = _admit_prefill(
+                self.params, toks, self.pool_ks, self.pool_vs,
+                jnp.asarray(blocks[:n_prompt], jnp.int32),
+                self.cfg, self.block_t)
+        except BaseException:
+            self.free.extend(reversed(blocks))
+            raise
+        self.tables[row, :need] = blocks
+        self.tables[row, need:] = 0
+        self.lens[row] = t0
+
+        req = _Request(rid=self._next_rid, row=row,
+                       remaining=max_new_tokens)
+        self._next_rid += 1
+        first = int(jnp.argmax(last_logits))
+        req.tokens.append(first)
+        req.remaining -= 1
+        req.pending = first
+        self.rows[row] = req
+        if req.remaining == 0:
+            self._finish(req)
+        return req.rid
+
+    # -- stepping --------------------------------------------------------
+    def step(self) -> Dict[int, int]:
+        """One batched decode step; returns {rid: new_token} for rows
+        that produced one. No-op on an idle engine."""
+        active = [r for r in self.rows if r is not None]
+        if not active:
+            return {}
+        tokens = np.zeros((len(self.rows),), np.int32)
+        for r in active:
+            tokens[r.row] = r.pending
+        logits, self.pool_ks, self.pool_vs = paged_decode_step(
+            self.params, self.cfg, self.pool_ks, self.pool_vs,
+            jnp.asarray(self.tables), jnp.asarray(self.lens),
+            jnp.asarray(tokens), interpret=self.interpret)
+        picked = np.asarray(jnp.argmax(logits, axis=-1))
+        out: Dict[int, int] = {}
+        for r in active:
+            self.lens[r.row] += 1
+            tok = int(picked[r.row])
+            r.tokens.append(tok)
+            r.pending = tok
+            r.remaining -= 1
+            out[r.rid] = tok
+            if r.remaining == 0:
+                self._finish(r)
+        return out
+
+    def _finish(self, req: _Request) -> None:
+        used = {int(b) for b in self.tables[req.row] if b != 0}
+        self.free.extend(sorted(used, reverse=True))
+        self.tables[req.row] = 0
+        self.lens[req.row] = 0
+        self.rows[req.row] = None
+        self.finished = getattr(self, "finished", {})
+        self.finished[req.rid] = req.tokens
+
+    # -- convenience -----------------------------------------------------
+    def run(self, prompts: List[List[int]],
+            max_new_tokens: int) -> Dict[int, List[int]]:
+        """Admit as many prompts as fit, decode to completion, admit the
+        rest as rows free up; returns {rid: generated tokens} in
+        admission order of rid."""
+        self.finished = getattr(self, "finished", {})
+        pending = list(prompts)
+        rids = []
+        while pending or any(r is not None for r in self.rows):
+            admitted = False
+            while pending:
+                try:
+                    rids.append(self.add(pending[0], max_new_tokens))
+                    pending.pop(0)
+                    admitted = True
+                except RuntimeError as e:
+                    if not any(r is not None for r in self.rows):
+                        # nothing running and this request can never fit
+                        raise RuntimeError(
+                            f"request cannot be admitted even on an idle "
+                            f"engine: {e}") from e
+                    break
+            if not self.step() and not admitted and pending:
+                raise RuntimeError("engine stalled with pending requests")
+        return {rid: self.finished[rid] for rid in rids}

@@ -164,13 +164,21 @@ def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
 
 def apply_rope(x: jax.Array, pos0=0, theta: float = 10000.0) -> jax.Array:
     """Rotary embedding on [b, h, t, hd] (split-half rotation). ``pos0``
-    may be a traced scalar (decode: the cache position)."""
+    may be a traced scalar (decode: the cache position) or a [b] vector
+    of per-sequence positions (ragged continuous-batching decode)."""
     b, h, t, hd = x.shape
     inv_freq = 1.0 / (theta ** (jnp.arange(0, hd // 2, dtype=jnp.float32)
                                 / (hd // 2)))
-    ang = (pos0 + jnp.arange(t, dtype=jnp.float32))[:, None] * inv_freq
-    cos = jnp.cos(ang)[None, None]                       # [1,1,t,hd/2]
-    sin = jnp.sin(ang)[None, None]
+    p0 = jnp.asarray(pos0, jnp.float32)
+    if p0.ndim == 1:                       # per-sequence positions [b]
+        ang = (p0[:, None] + jnp.arange(t, dtype=jnp.float32))
+        ang = ang[:, :, None] * inv_freq                 # [b,t,hd/2]
+        cos = jnp.cos(ang)[:, None]                      # [b,1,t,hd/2]
+        sin = jnp.sin(ang)[:, None]
+    else:
+        ang = (p0 + jnp.arange(t, dtype=jnp.float32))[:, None] * inv_freq
+        cos = jnp.cos(ang)[None, None]                   # [1,1,t,hd/2]
+        sin = jnp.sin(ang)[None, None]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin,
                            x1 * sin + x2 * cos], axis=-1)
