@@ -738,3 +738,15 @@ def test_adafactor_optimizer_trains_and_state_is_small():
     import pytest
     with pytest.raises(ValueError, match="kind"):
         default_optimizer(kind="sgd9000")
+
+
+def test_profiler_trace_capture(tmp_path):
+    import os
+    from tpu_dra_driver.workloads.utils import annotate, latest_trace, trace_to
+    d = str(tmp_path / "prof")
+    with trace_to(d):
+        with annotate("matmul"):
+            x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+            jax.block_until_ready(x)
+    run = latest_trace(d)
+    assert run is not None and len(os.listdir(run)) > 0

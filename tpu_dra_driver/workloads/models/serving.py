@@ -60,13 +60,15 @@ def _on_tpu() -> bool:
     return f()
 
 
-@partial(jax.jit, static_argnames=("cfg", "interpret"))
+@partial(jax.jit, static_argnames=("cfg", "interpret"),
+         donate_argnums=(2, 3))
 def paged_decode_step(params, cfg: ModelConfig, pool_ks, pool_vs,
                       tables, lens, tokens, interpret=False):
     """One decode step for every row: tokens [B] at per-row positions
-    ``lens`` → (logits [B, vocab], updated pools). Rows with table row
-    0 (inactive) write into the null block and their logits are
-    garbage the host ignores."""
+    ``lens`` → (logits [B, vocab], updated pools). Pools are donated —
+    the per-step appends update in place instead of copying every
+    layer's pool. Rows with table row 0 (inactive) write into the null
+    block and their logits are garbage the host ignores."""
     b = tokens.shape[0]
     n_kv = cfg.n_kv_heads or cfg.n_heads
     hd = cfg.d_model // cfg.n_heads
@@ -111,8 +113,11 @@ def _admit_prefill(params, tokens, pool_ks, pool_vs, blocks,
     """Admission, one jit: dense prompt prefill through the SAME
     block_prefill the generate() path uses (no forked forward to
     drift), then scatter each layer's K/V into the allocated pool
-    blocks. Pools are donated — no full-pool copies per block. Compiles
-    per prompt-length bucket."""
+    blocks. Pools are donated — no full-pool copies per block. NOTE:
+    compiles per exact prompt length (the jitted shape); callers with
+    many distinct lengths should bucket/pad prompts themselves —
+    padding interacts with the last-position logits, so the engine
+    does not do it implicitly."""
     from tpu_dra_driver.workloads.models.generate import (
         block_prefill, init_kv_cache,
     )
@@ -210,9 +215,10 @@ class ServingEngine:
         if len(self.free) < need:
             raise RuntimeError("pool exhausted")
 
-        # prefill BEFORE taking blocks from the free list — a prefill
-        # failure must not leak pool capacity. The prompt's blocks are
-        # the first n_prompt of the allocation; the rest are decode room.
+        # blocks pop eagerly (the jit needs the physical ids) and are
+        # restored on ANY prefill failure, so a failed admission cannot
+        # leak pool capacity. The prompt's blocks are the first n_prompt
+        # of the allocation; the rest are decode room.
         toks = jnp.asarray(prompt, jnp.int32)[None]
         n_prompt = -(-t0 // self.block_t)
         blocks = [self.free.pop() for _ in range(need)]
@@ -273,7 +279,6 @@ class ServingEngine:
         self.tables[req.row] = 0
         self.lens[req.row] = 0
         self.rows[req.row] = None
-        self.finished = getattr(self, "finished", {})
         self.finished[req.rid] = req.tokens
 
     # -- convenience -----------------------------------------------------
@@ -282,7 +287,6 @@ class ServingEngine:
         """Admit as many prompts as fit, decode to completion, admit the
         rest as rows free up; returns {rid: generated tokens} in
         admission order of rid."""
-        self.finished = getattr(self, "finished", {})
         pending = list(prompts)
         rids = []
         while pending or any(r is not None for r in self.rows):

@@ -10,3 +10,8 @@ from tpu_dra_driver.workloads.utils.checkpoint import (  # noqa: F401
     restore_train_state,
     save_train_state,
 )
+from tpu_dra_driver.workloads.utils.profiling import (  # noqa: F401
+    annotate,
+    latest_trace,
+    trace_to,
+)

@@ -1,0 +1,57 @@
+"""Profiling helpers: jax.profiler wrappers for the workload tier.
+
+The reference's observability is logs + Prometheus (SURVEY §5 — no
+distributed tracing); the TPU-side analog that actually matters for
+workloads is XLA's own profiler: per-op device timelines, HBM usage,
+and fusion views, browsable with TensorBoard or Perfetto. These
+helpers make capturing one as cheap as a context manager so demos,
+benches, and users share one idiom:
+
+    with trace_to("/tmp/prof"):
+        step(params, opt_state, batch)      # traced region
+
+    with annotate("prefill"):               # named range inside a trace
+        block_prefill(...)
+
+Traces land under <dir>/plugins/profile/<ts>/ (TensorBoard's layout).
+``annotate`` is jax.profiler.TraceAnnotation — visible as named spans
+on the device timeline even inside jit (it wraps dispatch; XLA op
+names carry the rest).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: str) -> Iterator[str]:
+    """Capture a jax.profiler trace of the with-block into ``log_dir``.
+    Yields the directory; nested uses raise (one trace at a time —
+    the profiler is process-global)."""
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_trace=False)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span on the profiler timeline (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def latest_trace(log_dir: str) -> Optional[str]:
+    """Path of the newest capture under ``log_dir`` (TensorBoard layout),
+    or None."""
+    root = os.path.join(log_dir, "plugins", "profile")
+    if not os.path.isdir(root):
+        return None
+    runs = sorted(os.listdir(root))
+    return os.path.join(root, runs[-1]) if runs else None
