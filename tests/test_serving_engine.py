@@ -92,3 +92,18 @@ def test_engine_rejects_windowed_models():
     params = init_params(CFG, jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="causal full-cache"):
         ServingEngine(params, replace(CFG, window=8), n_blocks=4)
+
+
+def test_serving_throughput_runs():
+    # rates are trivially positive; the real check is that the engine's
+    # outputs equal the sequential baseline's inside the measured runs
+    from tpu_dra_driver.workloads.models.serving import serving_throughput
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    prompts = _prompts(9, [5, 9])
+    r = serving_throughput(params, CFG, prompts, max_new_tokens=4,
+                           n_blocks=16, block_t=8, max_batch=4,
+                           max_blocks_per_seq=8)
+    assert r["engine_tokens_per_sec"] > 0
+    assert r["speedup"] > 0
+    for i, p in enumerate(prompts):
+        assert r["outputs"][i] == _solo(params, p, 4)
