@@ -13,8 +13,11 @@ from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
 from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
 
 
-@pytest.fixture
-def served_plugin(tmp_path):
+@pytest.fixture(params=["v1", "v1beta1"])
+def served_plugin(tmp_path, request):
+    """Each test runs against BOTH served DRAPlugin versions — a modern
+    kubelet dials v1, an older one v1beta1, on the same server (reference
+    draplugin.go:618-657 registers both)."""
     clients = ClientSets()
     lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
     plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
@@ -26,7 +29,8 @@ def served_plugin(tmp_path):
                            dra_address="localhost:0",
                            registration_address="localhost:0")
     server.start()
-    client = DraGrpcClient(f"localhost:{server.dra_port}")
+    client = DraGrpcClient(f"localhost:{server.dra_port}",
+                           api_version=request.param)
     yield plugin, clients, server, client
     client.close()
     server.stop()
@@ -74,8 +78,46 @@ def test_grpc_registration_and_health(served_plugin):
     info = client.get_info(f"localhost:{server.registration_port}")
     assert info.type == "DRAPlugin"
     assert info.name == "tpu.google.com"
-    assert "v1beta1.DRAPlugin" in info.supported_versions
+    # both versions advertised, v1 first (reference draplugin.go:618-621)
+    assert list(info.supported_versions) == [
+        "v1.DRAPlugin", "v1beta1.DRAPlugin"]
     assert client.health_check() is True
+
+
+def test_grpc_wire_format_matches_kubelet():
+    """Pin the exact wire contract a real kubelet relies on: the method
+    paths use the full proto package (k8s.io.kubelet.pkg.apis.dra.*) and
+    Claim fields are numbered namespace=1, uid=2, name=3 (upstream
+    dra/v1/api.proto; a uid-first numbering would silently swap fields)."""
+    from tpu_dra_driver.grpc_api import dra_v1_pb2, dra_v1beta1_pb2
+    from tpu_dra_driver.grpc_api.server import (
+        DRA_SERVICE_V1,
+        DRA_SERVICE_V1BETA1,
+    )
+    assert DRA_SERVICE_V1 == "k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin"
+    assert DRA_SERVICE_V1BETA1 == (
+        "k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin")
+    for pb in (dra_v1_pb2, dra_v1beta1_pb2):
+        claim = pb.Claim(namespace="ns", uid="u", name="n")
+        # field 1 = "ns" (0x0a), field 2 = "u" (0x12), field 3 = "n" (0x1a)
+        assert claim.SerializeToString() == b"\n\x02ns\x12\x01u\x1a\x01n"
+        dev = pb.Device(request_names=["r"], pool_name="p",
+                        device_name="d", cdi_device_ids=["c"])
+        assert dev.SerializeToString() == b"\n\x01r\x12\x01p\x1a\x01d\"\x01c"
+
+
+def test_grpc_prepare_reports_pool_name(served_plugin):
+    """kubelet matches prepared devices back to the claim's allocation by
+    (pool, device); an empty pool_name breaks that (reference
+    device_state.go:738 echoes result.Pool)."""
+    plugin, clients, server, client = served_plugin
+    claim = build_allocated_claim("uid-p", "cp", "ns", ["tpu-0"], "node-a")
+    clients.resource_claims.create(claim)
+    resp = client.node_prepare_resources([claim])
+    dev = resp.claims["uid-p"].devices[0]
+    assert dev.pool_name == "node-a"
+    client.node_unprepare_resources(
+        [{"uid": "uid-p", "namespace": "ns", "name": "cp"}])
 
 
 def test_grpc_prepare_error_propagates(served_plugin):

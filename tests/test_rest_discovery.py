@@ -1,0 +1,250 @@
+"""resource.k8s.io group-version discovery + wire-shape conversion.
+
+A real cluster serves the group at v1 (GA since k8s 1.34), v1beta1, or
+both; the driver must probe ``/apis/resource.k8s.io`` and speak whichever
+version is offered (reference: client-go discovery does this for the Go
+driver). These tests run the RestCluster against a scripted stub API
+server for each topology and pin the on-the-wire shapes (v1beta1 wraps
+slice devices in ``basic``; v1 wraps exact claim requests in
+``exactly``)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_dra_driver.kube import resourceversions as rv
+from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+
+
+class DiscoveryStub:
+    """Stub API server: group discovery + echoing CRUD for resource.k8s.io
+    resources. Records every request path and the JSON body POSTed."""
+
+    def __init__(self, versions, discovery_status=200):
+        outer = self
+        self.paths = []
+        self.bodies = []
+        self.discovery_calls = 0
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                outer.paths.append(self.path)
+                if self.path == "/apis/resource.k8s.io":
+                    outer.discovery_calls += 1
+                    if discovery_status != 200:
+                        self._send(discovery_status, {"kind": "Status"})
+                        return
+                    self._send(200, {
+                        "kind": "APIGroup", "name": "resource.k8s.io",
+                        "versions": [
+                            {"groupVersion": f"resource.k8s.io/{v}",
+                             "version": v} for v in versions],
+                        "preferredVersion": {
+                            "groupVersion": f"resource.k8s.io/{versions[0]}",
+                            "version": versions[0]},
+                    })
+                    return
+                # echo back the last POSTed object, or an empty list
+                if outer.bodies and not self.path.endswith("s"):
+                    self._send(200, outer.bodies[-1])
+                else:
+                    self._send(200, {"kind": "List", "metadata": {},
+                                     "items": list(outer.bodies)})
+
+            def do_POST(self):
+                outer.paths.append(self.path)
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                outer.bodies.append(body)
+                self._send(201, body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    @property
+    def url(self):
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _canonical_slice():
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",  # stale; rewritten on wire
+        "kind": "ResourceSlice",
+        "metadata": {"name": "node-a-tpu.google.com"},
+        "spec": {
+            "driver": "tpu.google.com",
+            "nodeName": "node-a",
+            "pool": {"name": "node-a", "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": [{
+                "name": "tpu-0",
+                "attributes": {"type": {"string": "chip"}},
+                "capacity": {"memory": {"value": "95Gi"}},
+            }],
+        },
+    }
+
+
+def _canonical_claim_template():
+    return {
+        "kind": "ResourceClaimTemplate",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"spec": {"devices": {"requests": [{
+            "name": "tpu",
+            "deviceClassName": "tpu.google.com",
+            "count": 2,
+        }]}}},
+    }
+
+
+def test_discovery_prefers_v1_when_both_served():
+    with DiscoveryStub(["v1", "v1beta1"]) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        assert cluster.discover_resource_version() == "v1"
+        cluster.list("resourceclaims", namespace="ns")
+        assert any("/apis/resource.k8s.io/v1/" in p for p in stub.paths)
+        # discovery is cached: one probe only
+        cluster.list("resourceslices")
+        assert stub.discovery_calls == 1
+
+
+def test_discovery_falls_back_to_v1beta1_only_cluster():
+    with DiscoveryStub(["v1beta1"]) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        assert cluster.discover_resource_version() == "v1beta1"
+        cluster.list("resourceslices")
+        assert any("/apis/resource.k8s.io/v1beta1/" in p for p in stub.paths)
+
+
+def test_discovery_error_assumes_v1beta1():
+    with DiscoveryStub(["v1"], discovery_status=404) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        assert cluster.discover_resource_version() == "v1beta1"
+
+
+def test_slice_create_wraps_basic_on_v1beta1_wire():
+    with DiscoveryStub(["v1beta1"]) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        created = cluster.create("resourceslices", _canonical_slice())
+        wire = stub.bodies[0]
+        assert wire["apiVersion"] == "resource.k8s.io/v1beta1"
+        dev = wire["spec"]["devices"][0]
+        assert set(dev) == {"name", "basic"}
+        assert dev["basic"]["attributes"]["type"] == {"string": "chip"}
+        # the client's return value is canonical (flat) again
+        assert created["spec"]["devices"][0]["attributes"]["type"] == {
+            "string": "chip"}
+
+
+def test_slice_create_stays_flat_on_v1_wire():
+    with DiscoveryStub(["v1"]) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        cluster.create("resourceslices", _canonical_slice())
+        wire = stub.bodies[0]
+        assert wire["apiVersion"] == "resource.k8s.io/v1"
+        assert "basic" not in wire["spec"]["devices"][0]
+        assert wire["spec"]["devices"][0]["attributes"]["type"] == {
+            "string": "chip"}
+
+
+def test_claim_template_wraps_exactly_on_v1_wire():
+    with DiscoveryStub(["v1"]) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        created = cluster.create("resourceclaimtemplates",
+                                 _canonical_claim_template())
+        wire = stub.bodies[0]
+        req = wire["spec"]["spec"]["devices"]["requests"][0]
+        assert req["name"] == "tpu"
+        assert "deviceClassName" not in req
+        assert req["exactly"] == {"deviceClassName": "tpu.google.com",
+                                  "count": 2}
+        # canonical again on the way back
+        got = created["spec"]["spec"]["devices"]["requests"][0]
+        assert got["deviceClassName"] == "tpu.google.com"
+
+
+def test_claim_template_flat_on_v1beta1_wire():
+    with DiscoveryStub(["v1beta1"]) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        cluster.create("resourceclaimtemplates", _canonical_claim_template())
+        req = stub.bodies[0]["spec"]["spec"]["devices"]["requests"][0]
+        assert req["deviceClassName"] == "tpu.google.com"
+        assert "exactly" not in req
+
+
+# -- pure conversion round-trips ------------------------------------------
+
+@pytest.mark.parametrize("version", ["v1", "v1beta1"])
+def test_slice_round_trip(version):
+    obj = _canonical_slice()
+    back = rv.from_wire("resourceslices",
+                        rv.to_wire("resourceslices", obj, version), version)
+    assert back["spec"]["devices"] == obj["spec"]["devices"]
+
+
+@pytest.mark.parametrize("version", ["v1", "v1beta1"])
+def test_claim_template_round_trip(version):
+    obj = _canonical_claim_template()
+    back = rv.from_wire(
+        "resourceclaimtemplates",
+        rv.to_wire("resourceclaimtemplates", obj, version), version)
+    assert (back["spec"]["spec"]["devices"]["requests"]
+            == obj["spec"]["spec"]["devices"]["requests"])
+
+
+def test_from_wire_accepts_user_submitted_v1_claim():
+    """A user may kubectl-apply claims in the GA shape even when we read
+    them back at v1beta1 semantics — unwrap is driven by what's present."""
+    wire = {
+        "apiVersion": "resource.k8s.io/v1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "c", "namespace": "ns"},
+        "spec": {"devices": {"requests": [{
+            "name": "tpu", "exactly": {"deviceClassName": "tpu.google.com"},
+        }]}},
+    }
+    got = rv.from_wire("resourceclaims", wire, "v1")
+    req = got["spec"]["devices"]["requests"][0]
+    assert req["deviceClassName"] == "tpu.google.com"
+    assert "exactly" not in req
+
+
+def test_firstavailable_requests_not_wrapped():
+    obj = {
+        "kind": "ResourceClaim",
+        "metadata": {"name": "c", "namespace": "ns"},
+        "spec": {"devices": {"requests": [{
+            "name": "tpu",
+            "firstAvailable": [{"name": "a",
+                                "deviceClassName": "tpu.google.com"}],
+        }]}},
+    }
+    wire = rv.to_wire("resourceclaims", obj, "v1")
+    req = wire["spec"]["devices"]["requests"][0]
+    assert "exactly" not in req
+    assert "firstAvailable" in req

@@ -144,7 +144,8 @@ def test_all_parsers_build():
 
 def test_registration_reports_socket_path_and_service_names(tmp_path):
     """kubelet dials PluginInfo.endpoint as a filesystem path and reads
-    supported_versions as service names (v1beta1.DRAPlugin)."""
+    supported_versions as service names — both DRA versions, v1 first
+    (reference draplugin.go:618-657)."""
     from tpu_dra_driver.grpc_api.server import DraGrpcClient, DraGrpcServer
     from tpu_dra_driver.kube.client import ClientSets
     from tpu_dra_driver.pkg import featuregates as fg
@@ -166,7 +167,8 @@ def test_registration_reports_socket_path_and_service_names(tmp_path):
         client = DraGrpcClient(f"unix://{sock}")
         info = client.get_info(f"localhost:{server.registration_port}")
         assert info.endpoint == sock  # plain path, no unix:// scheme
-        assert list(info.supported_versions) == ["v1beta1.DRAPlugin"]
+        assert list(info.supported_versions) == [
+            "v1.DRAPlugin", "v1beta1.DRAPlugin"]
         client.close()
     finally:
         server.stop()

@@ -58,6 +58,7 @@ from tpu_dra_driver.plugin.checkpoint import (
     PreparedDevice,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
+    backfill_pools,
 )
 from tpu_dra_driver.plugin.claims import (
     ClaimInfo,
@@ -108,6 +109,7 @@ class CdDeviceState:
             cp = self._cp_mgr.read()
             entry = cp.claims.get(claim.uid)
             if entry is not None and entry.state == PREPARE_COMPLETED:
+                backfill_pools(entry, claim)
                 return entry.prepared_devices
             self._validate_no_overlap(cp, claim)
             cp.claims[claim.uid] = ClaimEntry(
@@ -186,6 +188,7 @@ class CdDeviceState:
                         "channel device requires a ComputeDomainChannelConfig")
                 pd, cd, ex = self._prepare_channel(claim, result.request,
                                                    result.device, cfg)
+            pd.pool = result.pool
             prepared.append(pd)
             cdi_devices.append(cd)
             extra = extra.merge(ex)

@@ -26,7 +26,8 @@ from typing import Optional
 
 import grpc
 
-from tpu_dra_driver.grpc_api import dra_v1beta1_pb2 as dra_pb
+from tpu_dra_driver.grpc_api import dra_v1_pb2 as dra_pb
+from tpu_dra_driver.grpc_api.server import DRA_SERVICE_V1
 from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
 from tpu_dra_driver.grpc_api import pluginregistration_v1_pb2 as reg_pb
 
@@ -97,7 +98,7 @@ class SelfProbeHealthcheck:
             return False
         try:
             dra.unary_unary(
-                "/v1beta1.DRAPlugin/NodePrepareResources",
+                f"/{DRA_SERVICE_V1}/NodePrepareResources",
                 request_serializer=(
                     dra_pb.NodePrepareResourcesRequest.SerializeToString),
                 response_deserializer=(

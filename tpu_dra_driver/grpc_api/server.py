@@ -25,7 +25,8 @@ from typing import Callable, Dict, List, Optional
 
 import grpc
 
-from tpu_dra_driver.grpc_api import dra_v1beta1_pb2 as dra_pb
+from tpu_dra_driver.grpc_api import dra_v1_pb2
+from tpu_dra_driver.grpc_api import dra_v1beta1_pb2
 from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
 from tpu_dra_driver.grpc_api import pluginregistration_v1_pb2 as reg_pb
 from tpu_dra_driver.kube.client import ResourceClient
@@ -33,10 +34,19 @@ from tpu_dra_driver.kube.errors import NotFoundError
 
 log = logging.getLogger(__name__)
 
-DRA_SERVICE = "v1beta1.DRAPlugin"
+# Full gRPC service names — the method paths kubelet actually dials
+# (reference vendor k8s.io/kubelet/pkg/apis/dra/{v1,v1beta1}/api.pb.go
+# ServiceName). Both are served, matching kubeletplugin/draplugin.go:618-657.
+DRA_SERVICE_V1 = "k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin"
+DRA_SERVICE_V1BETA1 = "k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin"
+_DRA_PB = {"v1": dra_v1_pb2, "v1beta1": dra_v1beta1_pb2}
+_DRA_SERVICE = {"v1": DRA_SERVICE_V1, "v1beta1": DRA_SERVICE_V1BETA1}
 REGISTRATION_SERVICE = "pluginregistration.Registration"
 HEALTH_SERVICE = "grpc.health.v1.Health"
-SUPPORTED_VERSIONS = ("v1beta1",)
+# Version strings advertised to kubelet's plugin watcher, highest first
+# (reference v1/types.go:23 "v1.DRAPlugin", v1beta1/types.go:23
+# "v1beta1.DRAPlugin"; order per draplugin.go:618-621).
+SUPPORTED_VERSIONS = ("v1.DRAPlugin", "v1beta1.DRAPlugin")
 
 
 def _health_handlers(status_fn: Callable[[], bool]) -> grpc.GenericRpcHandler:
@@ -58,10 +68,15 @@ def _health_handlers(status_fn: Callable[[], bool]) -> grpc.GenericRpcHandler:
     })
 
 
-def _dra_handlers(plugin, claims_client: ResourceClient) -> grpc.GenericRpcHandler:
-    """Build the DRAPlugin service from generic method handlers."""
+def _dra_handlers(plugin, claims_client: ResourceClient,
+                  api_version: str) -> grpc.GenericRpcHandler:
+    """Build one DRAPlugin service (v1 or v1beta1) from generic method
+    handlers. The two versions are wire-identical message-for-message
+    (reference conversion.go wraps one server for both); only the package
+    prefix in the method path differs."""
+    dra_pb = _DRA_PB[api_version]
 
-    def node_prepare(request: dra_pb.NodePrepareResourcesRequest, context):
+    def node_prepare(request, context):
         response = dra_pb.NodePrepareResourcesResponse()
         full_claims: List[Dict] = []
         missing: Dict[str, str] = {}
@@ -88,11 +103,12 @@ def _dra_handlers(plugin, claims_client: ResourceClient) -> grpc.GenericRpcHandl
             for dev in res.devices:
                 d = out.devices.add()
                 d.request_names.append(dev.request)
+                d.pool_name = dev.pool
                 d.device_name = dev.canonical_name
                 d.cdi_device_ids.extend(dev.cdi_device_ids)
         return response
 
-    def node_unprepare(request: dra_pb.NodeUnprepareResourcesRequest, context):
+    def node_unprepare(request, context):
         response = dra_pb.NodeUnprepareResourcesResponse()
         results = plugin.unprepare_resource_claims(
             [ref.uid for ref in request.claims])
@@ -115,7 +131,8 @@ def _dra_handlers(plugin, claims_client: ResourceClient) -> grpc.GenericRpcHandl
             response_serializer=dra_pb.NodeUnprepareResourcesResponse.SerializeToString,
         ),
     }
-    return grpc.method_handlers_generic_handler(DRA_SERVICE, handlers)
+    return grpc.method_handlers_generic_handler(_DRA_SERVICE[api_version],
+                                                handlers)
 
 
 def _registration_handlers(driver_name: str, endpoint_path: str,
@@ -128,7 +145,7 @@ def _registration_handlers(driver_name: str, endpoint_path: str,
         # noderegistrar.go:39)
         return reg_pb.PluginInfo(
             type="DRAPlugin", name=driver_name, endpoint=endpoint_path,
-            supported_versions=[DRA_SERVICE])
+            supported_versions=list(SUPPORTED_VERSIONS))
 
     def notify(request: reg_pb.RegistrationStatus, context):
         if on_status:
@@ -171,7 +188,8 @@ class DraGrpcServer:
         self._driver_name = driver_name
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers((
-            _dra_handlers(plugin, claims_client),
+            _dra_handlers(plugin, claims_client, "v1"),
+            _dra_handlers(plugin, claims_client, "v1beta1"),
             _health_handlers(self._plugin_healthy),
         ))
         self._reg_server = None
@@ -204,13 +222,19 @@ class DraGrpcServer:
 
 
 class DraGrpcClient:
-    """Test/tooling client speaking the same wire protocol as kubelet."""
+    """Test/tooling client speaking the same wire protocol as kubelet.
 
-    def __init__(self, target: str):
+    ``api_version`` selects which served DRAPlugin service to dial ("v1"
+    default, matching a modern kubelet; "v1beta1" for the beta path) —
+    both are served simultaneously by :class:`DraGrpcServer`."""
+
+    def __init__(self, target: str, api_version: str = "v1"):
         self._channel = grpc.insecure_channel(target)
+        self._pb = _DRA_PB[api_version]
+        self._service = _DRA_SERVICE[api_version]
 
-    def node_prepare_resources(self, claims: List[Dict]) -> dra_pb.NodePrepareResourcesResponse:
-        req = dra_pb.NodePrepareResourcesRequest()
+    def node_prepare_resources(self, claims: List[Dict]):
+        req = self._pb.NodePrepareResourcesRequest()
         for c in claims:
             meta = c.get("metadata") or {}
             ref = req.claims.add()
@@ -218,22 +242,22 @@ class DraGrpcClient:
             ref.namespace = meta.get("namespace", "")
             ref.name = meta.get("name", "")
         return self._channel.unary_unary(
-            f"/{DRA_SERVICE}/NodePrepareResources",
-            request_serializer=dra_pb.NodePrepareResourcesRequest.SerializeToString,
-            response_deserializer=dra_pb.NodePrepareResourcesResponse.FromString,
+            f"/{self._service}/NodePrepareResources",
+            request_serializer=self._pb.NodePrepareResourcesRequest.SerializeToString,
+            response_deserializer=self._pb.NodePrepareResourcesResponse.FromString,
         )(req)
 
-    def node_unprepare_resources(self, refs: List[Dict]) -> dra_pb.NodeUnprepareResourcesResponse:
-        req = dra_pb.NodeUnprepareResourcesRequest()
+    def node_unprepare_resources(self, refs: List[Dict]):
+        req = self._pb.NodeUnprepareResourcesRequest()
         for c in refs:
             ref = req.claims.add()
             ref.uid = c.get("uid", "")
             ref.namespace = c.get("namespace", "")
             ref.name = c.get("name", "")
         return self._channel.unary_unary(
-            f"/{DRA_SERVICE}/NodeUnprepareResources",
-            request_serializer=dra_pb.NodeUnprepareResourcesRequest.SerializeToString,
-            response_deserializer=dra_pb.NodeUnprepareResourcesResponse.FromString,
+            f"/{self._service}/NodeUnprepareResources",
+            request_serializer=self._pb.NodeUnprepareResourcesRequest.SerializeToString,
+            response_deserializer=self._pb.NodeUnprepareResourcesResponse.FromString,
         )(req)
 
     def get_info(self, target: str) -> reg_pb.PluginInfo:

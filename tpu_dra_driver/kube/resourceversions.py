@@ -1,0 +1,131 @@
+"""resource.k8s.io group-version conversion for the REST client.
+
+The reference gets multi-version support from client-go's generated
+conversions; here the driver keeps ONE canonical in-memory shape and the
+REST layer converts at the wire boundary, so every component (plugin,
+controller, allocator, tests) is version-agnostic.
+
+Canonical shape (what FakeCluster stores and all components produce):
+
+- ResourceSlice devices are **flat** ``{name, attributes, capacity,
+  consumesCounters}`` — the v1 / v1beta2 shape. v1beta1 wraps everything
+  except ``name`` in a ``basic`` object
+  (vendor/k8s.io/api/resource/v1beta1/types.go:263-309).
+- ResourceClaim[Template] device requests are **flat**
+  ``{name, deviceClassName, selectors, allocationMode, count,
+  adminAccess, ...}`` — the v1beta1 shape. v1 wraps the exact-request
+  fields in ``exactly`` (vendor/k8s.io/api/resource/v1/types.go:781-790);
+  ``firstAvailable`` stays request-level in both.
+
+Allocation results, opaque configs, and DeviceClass bodies are
+shape-identical across the served versions and pass through untouched.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict
+
+API_GROUP = "resource.k8s.io"
+
+# Resources living in the resource.k8s.io group (subject to conversion).
+GROUP_RESOURCES = frozenset({
+    "resourceslices", "resourceclaims", "resourceclaimtemplates",
+    "deviceclasses",
+})
+
+# ExactDeviceRequest fields (v1 types.go ExactDeviceRequest): everything a
+# flat request may carry except its name and firstAvailable.
+_EXACT_FIELDS = ("deviceClassName", "selectors", "allocationMode", "count",
+                 "adminAccess", "tolerations", "capacity")
+
+_KINDS = {
+    "resourceslices": "ResourceSlice",
+    "resourceclaims": "ResourceClaim",
+    "resourceclaimtemplates": "ResourceClaimTemplate",
+    "deviceclasses": "DeviceClass",
+}
+
+
+def _claim_spec_paths(resource: str, obj: Dict):
+    """Yield every ResourceClaimSpec dict inside ``obj`` (claims carry one
+    at .spec, templates at .spec.spec)."""
+    if resource == "resourceclaims":
+        spec = obj.get("spec")
+        if spec:
+            yield spec
+    elif resource == "resourceclaimtemplates":
+        spec = (obj.get("spec") or {}).get("spec")
+        if spec:
+            yield spec
+
+
+def _needs_request_unwrap(resource: str, obj: Dict) -> bool:
+    for spec in _claim_spec_paths(resource, obj):
+        for req in (spec.get("devices") or {}).get("requests") or []:
+            if "exactly" in req:
+                return True
+    return False
+
+
+def to_wire(resource: str, obj: Dict, version: str) -> Dict:
+    """Canonical → wire shape for the given served group-version."""
+    if resource not in GROUP_RESOURCES:
+        return obj
+    obj = copy.deepcopy(obj)
+    obj["apiVersion"] = f"{API_GROUP}/{version}"
+    obj.setdefault("kind", _KINDS[resource])
+    if version == "v1beta1":
+        if resource == "resourceslices":
+            devices = (obj.get("spec") or {}).get("devices") or []
+            for i, dev in enumerate(devices):
+                basic = {k: v for k, v in dev.items() if k != "name"}
+                devices[i] = {"name": dev.get("name", ""), "basic": basic}
+    else:  # v1 / v1beta2: wrap exact-request fields
+        for spec in _claim_spec_paths(resource, obj):
+            requests = (spec.get("devices") or {}).get("requests") or []
+            for req in requests:
+                if "firstAvailable" in req or "exactly" in req:
+                    continue
+                exact = {k: req.pop(k) for k in _EXACT_FIELDS if k in req}
+                if exact:
+                    req["exactly"] = exact
+    return obj
+
+
+def from_wire(resource: str, obj: Dict, version: str) -> Dict:
+    """Wire → canonical shape. Tolerates objects already canonical (the
+    API server echoes what we wrote, but a user may have created claims
+    in any served version — conversion is driven by what's present, not
+    by ``version`` alone)."""
+    if resource not in GROUP_RESOURCES or not isinstance(obj, dict):
+        return obj
+    # Cheap pre-check: most objects need no mutation (v1 wire for slices is
+    # already canonical, v1beta1 wire for claims likewise) — skip the
+    # deepcopy on the hot list/watch path unless conversion applies.
+    devices = (obj.get("spec") or {}).get("devices")
+    needs_slice = (resource == "resourceslices" and devices
+                   and any("basic" in d for d in devices))
+    needs_api = obj.get("apiVersion", "").startswith(f"{API_GROUP}/") and \
+        obj.get("apiVersion") != f"{API_GROUP}/{version}"
+    if not (needs_slice or needs_api or _needs_request_unwrap(resource, obj)):
+        return obj
+    obj = copy.deepcopy(obj)
+    if obj.get("apiVersion", "").startswith(f"{API_GROUP}/"):
+        obj["apiVersion"] = f"{API_GROUP}/{version}"
+    if resource == "resourceslices":
+        devices = (obj.get("spec") or {}).get("devices") or []
+        for i, dev in enumerate(devices):
+            if "basic" in dev:
+                flat = {"name": dev.get("name", "")}
+                flat.update(dev["basic"] or {})
+                devices[i] = flat
+    else:
+        for spec in _claim_spec_paths(resource, obj):
+            requests = (spec.get("devices") or {}).get("requests") or []
+            for req in requests:
+                exact = req.pop("exactly", None)
+                if exact:
+                    for k, v in exact.items():
+                        req.setdefault(k, v)
+    return obj

@@ -32,23 +32,36 @@ from tpu_dra_driver.kube.errors import (
     NotFoundError,
 )
 from tpu_dra_driver.kube.fake import RELIST, _WatchSub  # same consumer-side queue
+from tpu_dra_driver.kube.resourceversions import (
+    GROUP_RESOURCES,
+    from_wire,
+    to_wire,
+)
 
 log = logging.getLogger(__name__)
 
-# resource name -> (api prefix, namespaced)
+# resource name -> (api prefix, namespaced). resource.k8s.io prefixes use
+# the {RESOURCE_VERSION} placeholder filled by group discovery (v1 on
+# k8s >= 1.34 where the group is GA, v1beta1 before that) — reference gets
+# this via client-go's discovery-backed clientsets; hard-pinning v1beta1
+# left the driver unable to talk to v1-only clusters (see
+# discover_resource_version).
 _RESOURCE_MAP: Dict[str, Tuple[str, bool]] = {
     "nodes": ("/api/v1", False),
     "pods": ("/api/v1", True),
     "events": ("/api/v1", True),
     "daemonsets": ("/apis/apps/v1", True),
     "leases": ("/apis/coordination.k8s.io/v1", True),
-    "resourceslices": ("/apis/resource.k8s.io/v1beta1", False),
-    "resourceclaims": ("/apis/resource.k8s.io/v1beta1", True),
-    "resourceclaimtemplates": ("/apis/resource.k8s.io/v1beta1", True),
-    "deviceclasses": ("/apis/resource.k8s.io/v1beta1", False),
+    "resourceslices": ("/apis/resource.k8s.io/{RESOURCE_VERSION}", False),
+    "resourceclaims": ("/apis/resource.k8s.io/{RESOURCE_VERSION}", True),
+    "resourceclaimtemplates": ("/apis/resource.k8s.io/{RESOURCE_VERSION}", True),
+    "deviceclasses": ("/apis/resource.k8s.io/{RESOURCE_VERSION}", False),
     "computedomains": ("/apis/resource.tpu.google.com/v1beta1", True),
     "computedomaincliques": ("/apis/resource.tpu.google.com/v1beta1", True),
 }
+
+# Group-versions this client can speak, most preferred first.
+SUPPORTED_RESOURCE_VERSIONS = ("v1", "v1beta1")
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -145,12 +158,75 @@ class RestCluster:
         if config.client_cert:
             self._session.cert = config.client_cert
         self._watch_threads: List[threading.Thread] = []
+        self._resource_version_lock = threading.Lock()
+        self._resource_version: Optional[str] = None
+        self._resource_probe_failed_at: float = 0.0
+
+    # -- API group discovery ------------------------------------------------
+
+    def discover_resource_version(self) -> str:
+        """Probe ``/apis/resource.k8s.io`` and pick the newest served
+        group-version this client speaks (v1 preferred, v1beta1 fallback).
+        Cached for the client's lifetime. Mirrors what client-go discovery
+        gives the reference for free: on k8s >= 1.34 resource.k8s.io is GA
+        at v1 and a cluster may not serve the beta group at all."""
+        import time as _time
+
+        with self._resource_version_lock:
+            if self._resource_version is not None:
+                return self._resource_version
+            # After a failed probe, stick with the fallback for a grace
+            # period instead of re-probing on every call: per-object
+            # conversions and watch events all funnel through here, and a
+            # hanging probe under this lock would stall every caller.
+            if _time.monotonic() - self._resource_probe_failed_at < 30.0:
+                return "v1beta1"
+            versions: List[str] = []
+            probe_failed = False
+            try:
+                resp = self._session.get(
+                    f"{self._cfg.server}/apis/resource.k8s.io", timeout=30)
+                if resp.status_code == 200:
+                    body = resp.json()
+                    versions = [v.get("version", "")
+                                for v in body.get("versions", [])]
+                else:
+                    probe_failed = True
+                    log.warning("resource.k8s.io discovery returned HTTP %d; "
+                                "assuming v1beta1 for now",
+                                resp.status_code)
+            except (requests.RequestException, ValueError) as e:
+                probe_failed = True
+                log.warning("resource.k8s.io discovery failed (%s); "
+                            "assuming v1beta1 for now", e)
+            chosen = next((v for v in SUPPORTED_RESOURCE_VERSIONS
+                           if v in versions), None)
+            if chosen is None:
+                if versions:
+                    log.warning(
+                        "API server serves resource.k8s.io versions %s, none "
+                        "of which this driver speaks %s; trying v1beta1",
+                        versions, SUPPORTED_RESOURCE_VERSIONS)
+                chosen = "v1beta1"
+            else:
+                log.info("using resource.k8s.io/%s (server offers %s)",
+                         chosen, versions)
+            # Only cache a *successful* probe: a transient outage at startup
+            # must not wedge the driver on v1beta1 against a v1-only cluster.
+            if probe_failed:
+                self._resource_probe_failed_at = _time.monotonic()
+            else:
+                self._resource_version = chosen
+            return chosen
 
     # -- url helpers --------------------------------------------------------
 
     def _url(self, resource: str, namespace: str = "",
              name: str = "") -> str:
         prefix, namespaced = _RESOURCE_MAP[resource]
+        if "{RESOURCE_VERSION}" in prefix:
+            prefix = prefix.replace("{RESOURCE_VERSION}",
+                                    self.discover_resource_version())
         url = f"{self._cfg.server}{prefix}"
         if namespaced and namespace:
             url += f"/namespaces/{namespace}"
@@ -180,16 +256,27 @@ class RestCluster:
 
     # -- CRUD ---------------------------------------------------------------
 
+    def _to_wire(self, resource: str, obj: Dict) -> Dict:
+        if resource in GROUP_RESOURCES:
+            return to_wire(resource, obj, self.discover_resource_version())
+        return obj
+
+    def _from_wire(self, resource: str, obj: Dict) -> Dict:
+        if resource in GROUP_RESOURCES:
+            return from_wire(resource, obj, self.discover_resource_version())
+        return obj
+
     def create(self, resource: str, obj: Dict) -> Dict:
         ns = (obj.get("metadata") or {}).get("namespace", "")
-        resp = self._session.post(self._url(resource, ns), json=obj)
+        resp = self._session.post(self._url(resource, ns),
+                                  json=self._to_wire(resource, obj))
         self._raise_for(resp, f"create {resource}")
-        return resp.json()
+        return self._from_wire(resource, resp.json())
 
     def get(self, resource: str, name: str, namespace: str = "") -> Dict:
         resp = self._session.get(self._url(resource, namespace, name))
         self._raise_for(resp, f"get {resource} {namespace}/{name}")
-        return resp.json()
+        return self._from_wire(resource, resp.json())
 
     def list(self, resource: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None,
@@ -201,7 +288,8 @@ class RestCluster:
         resp = self._session.get(self._url(resource, namespace or ""),
                                  params=params)
         self._raise_for(resp, f"list {resource}")
-        items = resp.json().get("items", [])
+        items = [self._from_wire(resource, o)
+                 for o in resp.json().get("items", [])]
         if name_pattern:
             import fnmatch
             items = [o for o in items if fnmatch.fnmatch(
@@ -212,9 +300,9 @@ class RestCluster:
         meta = obj.get("metadata") or {}
         resp = self._session.put(
             self._url(resource, meta.get("namespace", ""), meta["name"]),
-            json=obj)
+            json=self._to_wire(resource, obj))
         self._raise_for(resp, f"update {resource} {meta.get('name')}")
-        return resp.json()
+        return self._from_wire(resource, resp.json())
 
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
         resp = self._session.delete(self._url(resource, namespace, name))
@@ -261,7 +349,9 @@ class RestCluster:
         self._raise_for(resp, f"list {resource}")
         body = resp.json()
         rv = (body.get("metadata") or {}).get("resourceVersion") or ""
-        return body.get("items", []), rv
+        items = [self._from_wire(resource, o)
+                 for o in body.get("items", [])]
+        return items, rv
 
     def _watch_loop(self, resource: str,
                     label_selector: Optional[Dict[str, str]],
@@ -309,7 +399,7 @@ class RestCluster:
                         rv = (obj.get("metadata") or {}).get("resourceVersion")
                         if rv:
                             params["resourceVersion"] = rv
-                        sub.push((ev_type, obj))
+                        sub.push((ev_type, self._from_wire(resource, obj)))
                         backoff = 1.0
             except (requests.RequestException, ApiError) as e:
                 if sub.closed:

@@ -52,6 +52,8 @@ class PreparedDevice:
     device_type: str = "chip"        # chip | subslice | vfio | channel | daemon
     live_uuid: str = ""              # live sub-slice uuid (informational)
     devfs_path: str = ""
+    pool: str = ""                   # allocation result's pool, echoed to
+                                     # kubelet (reference device_state.go:738)
 
     def to_obj(self) -> Dict:
         return {
@@ -61,6 +63,7 @@ class PreparedDevice:
             "deviceType": self.device_type,
             "liveUUID": self.live_uuid,
             "devfsPath": self.devfs_path,
+            "pool": self.pool,
         }
 
     @staticmethod
@@ -72,7 +75,20 @@ class PreparedDevice:
             device_type=d.get("deviceType", "chip"),
             live_uuid=d.get("liveUUID", ""),
             devfs_path=d.get("devfsPath", ""),
+            pool=d.get("pool", ""),
         )
+
+
+def backfill_pools(entry: "ClaimEntry", claim) -> None:
+    """Fill empty ``pool`` on checkpointed devices from the live claim's
+    allocation results. Checkpoints written before the pool field existed
+    replay with pool="" on the idempotent re-prepare path, and kubelet
+    matches prepared devices by (pool, device) — so upgrades must heal
+    in-place (reference device_state.go:738 always echoes result.Pool)."""
+    pools = {r.device: r.pool for r in claim.results}
+    for pd in entry.prepared_devices:
+        if not pd.pool:
+            pd.pool = pools.get(pd.canonical_name, "")
 
 
 @dataclass

@@ -52,6 +52,7 @@ from tpu_dra_driver.plugin.checkpoint import (
     PreparedDevice,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
+    backfill_pools,
 )
 from tpu_dra_driver.plugin.claims import (
     ClaimInfo,
@@ -148,6 +149,7 @@ class DeviceState:
                 timing.t_total = time.perf_counter() - t0
                 self.timings.append(timing)
                 log.debug("prepare %s: already completed (idempotent)", claim.canonical)
+                backfill_pools(entry, claim)
                 return entry.prepared_devices
 
             self._validate_no_overlap(cp, claim)
@@ -238,6 +240,7 @@ class DeviceState:
                 pd, cd = self._prepare_subslice(claim, result.request, dev)
             else:
                 pd, cd = self._prepare_vfio(claim, result.request, dev)
+            pd.pool = result.pool
             prepared.append(pd)
             cdi_devices.append(cd)
 
