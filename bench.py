@@ -133,11 +133,21 @@ def bench_accelerator() -> dict:
         from tpu_dra_driver.workloads.ops import (
             matmul_tflops_steady, psum_bandwidth,
         )
+        from tpu_dra_driver.workloads.ops.collectives import (
+            device_peak_tflops,
+        )
         # full-size chains would take hours at CPU throughput
         m = 8192 if backend not in ("cpu",) else 512
         mm = matmul_tflops_steady(m=m, iters=3)
         out["matmul_tflops_bf16_steady"] = round(mm.tflops, 2)
-        log(f"  steady-state {mm}")
+        peak = device_peak_tflops()
+        if peak:
+            out["peak_tflops_bf16"] = peak
+            out["matmul_mfu"] = round(mm.tflops / peak, 3)
+            log(f"  steady-state {mm} — {100*mm.tflops/peak:.1f}% MFU "
+                f"(peak {peak:.0f})")
+        else:
+            log(f"  steady-state {mm}")
         if n >= 2:
             bw = psum_bandwidth(mib_per_device=64, iters=3)
             out["psum_bus_gbps"] = round(bw.bus_gbps, 2)
@@ -150,17 +160,38 @@ def bench_accelerator() -> dict:
             out["flash_attn_tflops"] = round(fa["flash_attn_tflops"], 2)
             out["flash_attn_speedup_vs_xla_ref"] = round(
                 fa["speedup_vs_ref"], 2)
+            if peak:
+                out["flash_attn_mfu"] = round(fa["flash_attn_tflops"] / peak, 3)
             log(f"  flash attention: {fa['flash_attn_tflops']:.2f} TFLOP/s "
                 f"({fa['shape']}), {fa['speedup_vs_ref']:.2f}x vs XLA "
-                f"reference attention ({fa['ref_attn_tflops']:.2f})")
+                f"reference attention ({fa['ref_attn_tflops']:.2f})"
+                + (f", {100*fa['flash_attn_tflops']/peak:.1f}% MFU"
+                   if peak else ""))
+            # achievable bar: jax's tuned splash-attention at this shape
+            from tpu_dra_driver.workloads.ops.attention import (
+                splash_attention_bar,
+            )
+            bar = splash_attention_bar()
+            if bar:
+                out["splash_attn_bar_tflops"] = round(bar, 2)
+                out["flash_vs_splash"] = round(
+                    fa["flash_attn_tflops"] / bar, 3)
+                log(f"  splash-attention bar (public tuned kernel, same "
+                    f"shape): {bar:.2f} TFLOP/s -> ours is "
+                    f"{100*fa['flash_attn_tflops']/bar:.1f}% of it")
             from tpu_dra_driver.workloads.ops import (
                 flash_attention_train_tflops,
             )
             ft = flash_attention_train_tflops()
             out["flash_attn_train_tflops"] = round(
                 ft["flash_attn_train_tflops"], 2)
+            if peak:
+                out["flash_attn_train_mfu"] = round(
+                    ft["flash_attn_train_tflops"] / peak, 3)
             log(f"  flash attention fwd+bwd: "
-                f"{ft['flash_attn_train_tflops']:.2f} TFLOP/s ({ft['shape']})")
+                f"{ft['flash_attn_train_tflops']:.2f} TFLOP/s ({ft['shape']})"
+                + (f", {100*ft['flash_attn_train_tflops']/peak:.1f}% MFU"
+                   if peak else ""))
             from tpu_dra_driver.workloads.ops import (
                 flash_attention_long_context_tflops,
             )
@@ -216,6 +247,8 @@ def bench_accelerator() -> dict:
                 out["train_tokens_per_sec"] = round(
                     tr["train_tokens_per_sec"], 1)
                 out["train_model_tflops"] = round(tr["model_tflops"], 2)
+                if peak:
+                    out["train_mfu"] = round(tr["model_tflops"] / peak, 3)
                 log(f"  training: {tr['train_tokens_per_sec']:.0f} tok/s, "
                     f"{tr['model_tflops']:.1f} model TFLOP/s "
                     f"({tr['shape']}, {tr['params_m']:.0f}M params, "

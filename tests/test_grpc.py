@@ -193,3 +193,45 @@ def test_selfprobe_healthcheck_not_serving_when_sockets_dead(served_plugin):
         assert resp.status == health_pb.HealthCheckResponse.NOT_SERVING
     finally:
         hc.stop()
+
+
+def test_unix_socket_full_round_trip(tmp_path):
+    """VERDICT r1 #10: DraGrpcServer on real unix:// sockets driven by
+    DraGrpcClient — registration reports the filesystem path kubelet
+    dials, and prepare/unprepare complete over that socket for both
+    served API versions."""
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="node-a", state_dir=str(tmp_path / "s"),
+        cdi_root=str(tmp_path / "cdi"), gates=fg.FeatureGates()))
+    plugin.start()
+    sock = str(tmp_path / "dra.sock")
+    reg_sock = str(tmp_path / "reg.sock")
+    server = DraGrpcServer(plugin, clients.resource_claims, "tpu.google.com",
+                           dra_address=f"unix://{sock}",
+                           registration_address=f"unix://{reg_sock}")
+    server.start()
+    try:
+        import os
+        assert os.path.exists(sock) and os.path.exists(reg_sock)
+        info = DraGrpcClient(f"unix://{sock}").get_info(f"unix://{reg_sock}")
+        assert info.endpoint == sock          # plain path, kubelet dials it
+        for ver in ("v1", "v1beta1"):
+            uid = f"uid-{ver}"
+            claim = build_allocated_claim(uid, f"c-{ver}", "ns",
+                                          ["tpu-0"], "node-a")
+            clients.resource_claims.create(claim)
+            client = DraGrpcClient(f"unix://{info.endpoint}", api_version=ver)
+            resp = client.node_prepare_resources([claim])
+            assert resp.claims[uid].error == ""
+            assert resp.claims[uid].devices[0].pool_name == "node-a"
+            unresp = client.node_unprepare_resources(
+                [{"uid": uid, "namespace": "ns", "name": f"c-{ver}"}])
+            assert unresp.claims[uid].error == ""
+            clients.resource_claims.delete(f"c-{ver}", "ns")
+            client.close()
+        assert plugin.state.get_checkpoint().claims == {}
+    finally:
+        server.stop()
+        plugin.shutdown()

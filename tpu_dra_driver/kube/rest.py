@@ -177,47 +177,53 @@ class RestCluster:
                 return self._resource_version
             # After a failed probe, stick with the fallback for a grace
             # period instead of re-probing on every call: per-object
-            # conversions and watch events all funnel through here, and a
-            # hanging probe under this lock would stall every caller.
+            # conversions and watch events all funnel through here.
             if _time.monotonic() - self._resource_probe_failed_at < 30.0:
                 return "v1beta1"
-            versions: List[str] = []
-            probe_failed = False
-            try:
-                resp = self._session.get(
-                    f"{self._cfg.server}/apis/resource.k8s.io", timeout=30)
-                if resp.status_code == 200:
-                    body = resp.json()
-                    versions = [v.get("version", "")
-                                for v in body.get("versions", [])]
-                else:
-                    probe_failed = True
-                    log.warning("resource.k8s.io discovery returned HTTP %d; "
-                                "assuming v1beta1 for now",
-                                resp.status_code)
-            except (requests.RequestException, ValueError) as e:
+            # mark the probe window NOW, so concurrent callers fall back
+            # immediately instead of convoying behind the in-flight probe
+            self._resource_probe_failed_at = _time.monotonic()
+
+        # Probe OUTSIDE the lock (short timeout << the grace window): a
+        # hanging discovery endpoint must not stall every CRUD call and
+        # watch relist that funnels through _url().
+        versions: List[str] = []
+        probe_failed = False
+        try:
+            resp = self._session.get(
+                f"{self._cfg.server}/apis/resource.k8s.io", timeout=5)
+            if resp.status_code == 200:
+                body = resp.json()
+                versions = [v.get("version", "")
+                            for v in body.get("versions", [])]
+            else:
                 probe_failed = True
-                log.warning("resource.k8s.io discovery failed (%s); "
-                            "assuming v1beta1 for now", e)
-            chosen = next((v for v in SUPPORTED_RESOURCE_VERSIONS
-                           if v in versions), None)
-            if chosen is None:
-                if versions:
-                    log.warning(
-                        "API server serves resource.k8s.io versions %s, none "
-                        "of which this driver speaks %s; trying v1beta1",
-                        versions, SUPPORTED_RESOURCE_VERSIONS)
-                chosen = "v1beta1"
-            else:
-                log.info("using resource.k8s.io/%s (server offers %s)",
-                         chosen, versions)
-            # Only cache a *successful* probe: a transient outage at startup
-            # must not wedge the driver on v1beta1 against a v1-only cluster.
-            if probe_failed:
-                self._resource_probe_failed_at = _time.monotonic()
-            else:
+                log.warning("resource.k8s.io discovery returned HTTP %d; "
+                            "assuming v1beta1 for now", resp.status_code)
+        except (requests.RequestException, ValueError) as e:
+            probe_failed = True
+            log.warning("resource.k8s.io discovery failed (%s); "
+                        "assuming v1beta1 for now", e)
+        chosen = next((v for v in SUPPORTED_RESOURCE_VERSIONS
+                       if v in versions), None)
+        if chosen is None:
+            if versions:
+                log.warning(
+                    "API server serves resource.k8s.io versions %s, none "
+                    "of which this driver speaks %s; trying v1beta1",
+                    versions, SUPPORTED_RESOURCE_VERSIONS)
+            chosen = "v1beta1"
+        else:
+            log.info("using resource.k8s.io/%s (server offers %s)",
+                     chosen, versions)
+        with self._resource_version_lock:
+            # Only cache a *successful* probe: a transient outage at
+            # startup must not wedge the driver on v1beta1 against a
+            # v1-only cluster (the failure stamp above already arms the
+            # retry grace window).
+            if not probe_failed and self._resource_version is None:
                 self._resource_version = chosen
-            return chosen
+            return self._resource_version or chosen
 
     # -- url helpers --------------------------------------------------------
 

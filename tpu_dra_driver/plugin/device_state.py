@@ -62,6 +62,7 @@ from tpu_dra_driver.plugin.claims import (
 from tpu_dra_driver.plugin.sharing import MultiProcessManager, TimeSlicingManager
 from tpu_dra_driver.plugin.vfio import VfioPciManager
 from tpu_dra_driver.tpulib.interface import (
+    SharingExhaustedError,
     SubsliceAlreadyExistsError,
     SubsliceNotFoundError,
     TpuLib,
@@ -246,7 +247,7 @@ class DeviceState:
 
             # sharing config applies once per underlying chip
             if cfg is not None and dev.chip.uuid not in sharing_applied:
-                edits = self._apply_sharing(dev, cfg)
+                edits = self._apply_sharing(claim, dev, cfg)
                 if edits is not None:
                     extra_common = extra_common.merge(edits)
                     sharing_applied.add(dev.chip.uuid)
@@ -274,7 +275,8 @@ class DeviceState:
                 f"{dev.type.value} device {name}"
             )
 
-    def _apply_sharing(self, dev: AllocatableDevice, cfg) -> Optional[ContainerEdits]:
+    def _apply_sharing(self, claim: ClaimInfo, dev: AllocatableDevice,
+                       cfg) -> Optional[ContainerEdits]:
         sharing = getattr(cfg, "sharing", None)
         if sharing is None:
             return None
@@ -290,7 +292,13 @@ class DeviceState:
                 "MultiProcess sharing requested but the "
                 "MultiProcessSharing feature gate is disabled"
             )
-        return self._multiprocess.apply([dev.chip.uuid], sharing.multi_process)
+        try:
+            return self._multiprocess.apply(
+                [dev.chip.uuid], sharing.multi_process, owner=claim.uid)
+        except SharingExhaustedError as e:
+            # over-subscribed limits / foreign share: retrying without a
+            # config change cannot succeed
+            raise PermanentError(str(e)) from e
 
     def _prepare_chip(self, claim: ClaimInfo, request: str,
                       dev: AllocatableDevice):

@@ -6,12 +6,20 @@ control-daemon Deployment with shm/pipe/log host dirs).
 
 TPU design departure (SURVEY.md §7.6): TPUs need **no control daemon** for
 multi-process sharing — libtpu multiplexes clients itself when the right
-env is present. So MultiProcessManager is pure CDI env injection:
+env is present. MultiProcessManager therefore has two jobs:
 
-- ``TPU_MULTI_PROCESS=1`` + per-client HBM ceiling
-  (``TPU_HBM_LIMIT_PERCENT``, enforced by the runtime allocator) +
-  ``TPU_MAX_CLIENTS``;
-- the chip is flipped to non-exclusive mode via the device library.
+- **grant bookkeeping through the device library's share ledger**
+  (``allocate_multiprocess_share``): rejects over-subscribed configs
+  (clients x per-client HBM > chip) and double-grants as *permanent*
+  errors, persists the grant so a crashed plugin's share is released on
+  unprepare, and sizes the per-client HBM budget in bytes — the
+  enforcement-accounting half of the reference's MPS control daemon
+  (sharing.go:151-436). The fake backend models client connections and
+  per-client HBM budgets so tests prove the limits bind.
+- **CDI env injection**: ``TPU_MULTI_PROCESS=1``, ``TPU_MAX_CLIENTS``,
+  per-client ``TPU_HBM_LIMIT_PERCENT``/``TPU_HBM_LIMIT_BYTES`` (the
+  runtime allocator reads these); the chip is flipped to non-exclusive
+  mode via the device library.
 
 TimeSlicingManager maps the interval enum onto the runtime scheduler knob
 through the TpuLib seam (the ``nvidia-smi --set-timeslice`` analog).
@@ -56,21 +64,41 @@ class MultiProcessManager:
         self._lib = lib
         self._mu = threading.Lock()
 
-    def apply(self, chip_uuids: List[str], cfg: MultiProcessConfig) -> ContainerEdits:
+    def apply(self, chip_uuids: List[str], cfg: MultiProcessConfig,
+              owner: str) -> ContainerEdits:
+        """Grant the claim's share on every chip, then inject the client
+        env. SharingExhaustedError (over-subscription, foreign share)
+        propagates as a permanent prepare failure; a grant failure on a
+        later chip rolls back earlier grants so nothing leaks."""
+        pct = cfg.hbm_limit_percent if cfg.hbm_limit_percent is not None else 100
+        granted = []
         with self._mu:
-            for uuid in chip_uuids:
-                self._lib.set_exclusive_mode(uuid, False)
+            try:
+                share = None
+                for uuid in chip_uuids:
+                    share = self._lib.allocate_multiprocess_share(
+                        uuid, owner, cfg.max_clients, pct)
+                    granted.append(uuid)
+                    self._lib.set_exclusive_mode(uuid, False)
+            except Exception:
+                for uuid in granted:
+                    self._lib.release_multiprocess_share(uuid, owner)
+                    self._lib.set_exclusive_mode(uuid, True)
+                raise
         env: Dict[str, str] = {
             "TPU_MULTI_PROCESS": "1",
             "TPU_MAX_CLIENTS": str(cfg.max_clients),
         }
         if cfg.hbm_limit_percent is not None:
             env["TPU_HBM_LIMIT_PERCENT"] = str(cfg.hbm_limit_percent)
+        if share is not None:
+            env["TPU_HBM_LIMIT_BYTES"] = str(share.client_hbm_bytes)
         return ContainerEdits(env=env)
 
     def release(self, chip_uuids: List[str]) -> None:
-        """Restore exclusive mode on unprepare (the reference's MPS daemon
-        teardown analog; here only a mode flip)."""
+        """Release the chips' shares and restore exclusive mode on
+        unprepare (the reference's MPS daemon teardown analog)."""
         with self._mu:
             for uuid in chip_uuids:
+                self._lib.release_multiprocess_share(uuid)
                 self._lib.set_exclusive_mode(uuid, True)

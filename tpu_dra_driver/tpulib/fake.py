@@ -34,6 +34,8 @@ from tpu_dra_driver.tpulib.interface import (
     HealthEvent,
     HealthHub,
     LiveSubslice,
+    MultiProcessShare,
+    SharingExhaustedError,
     SubsliceAlreadyExistsError,
     SubsliceNotFoundError,
     TimesliceInterval,
@@ -83,6 +85,11 @@ class _HostState:
     in_use: Set[str] = field(default_factory=set)              # pci addresses
     next_partition_id: int = 1
     next_vfio_group: int = 10
+    # multi-process sharing ledger: chip uuid -> grant, plus the modeled
+    # runtime contention state (connected clients and their allocations)
+    mp_shares: Dict[str, MultiProcessShare] = field(default_factory=dict)
+    mp_clients: Dict[str, Dict[int, int]] = field(default_factory=dict)
+    mp_next_client: int = 1
 
 
 class FakeTpuLib(TpuLib):
@@ -256,6 +263,95 @@ class FakeTpuLib(TpuLib):
     def get_exclusive_mode(self, chip_uuid: str) -> bool:
         with self._mu:
             return self._state.exclusive.get(chip_uuid, False)
+
+    # -- multi-process share ledger + modeled contention --------------------
+
+    def allocate_multiprocess_share(self, chip_uuid: str, owner: str,
+                                    max_clients: int,
+                                    hbm_limit_percent: int) -> MultiProcessShare:
+        with self._mu:
+            self._op("allocate_multiprocess_share")
+            chip = self._assert_chip(chip_uuid)
+            existing = self._state.mp_shares.get(chip_uuid)
+            if existing is not None:
+                if existing.owner == owner:
+                    return existing      # idempotent re-prepare
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid} already shared by claim "
+                    f"{existing.owner}")
+            if max_clients * hbm_limit_percent > 100:
+                raise SharingExhaustedError(
+                    f"over-subscribed: {max_clients} clients x "
+                    f"{hbm_limit_percent}% HBM exceeds the chip")
+            share = MultiProcessShare(
+                chip_uuid=chip_uuid, owner=owner, max_clients=max_clients,
+                hbm_limit_percent=hbm_limit_percent,
+                client_hbm_bytes=chip.hbm_bytes * hbm_limit_percent // 100)
+            self._state.mp_shares[chip_uuid] = share
+            self._state.mp_clients[chip_uuid] = {}
+            return share
+
+    def release_multiprocess_share(self, chip_uuid: str,
+                                   owner: Optional[str] = None) -> None:
+        with self._mu:
+            self._op("release_multiprocess_share")
+            share = self._state.mp_shares.get(chip_uuid)
+            if share is None:
+                return
+            if owner is not None and share.owner != owner:
+                raise TpuLibError(
+                    f"share on {chip_uuid} owned by {share.owner}, "
+                    f"not {owner}")
+            del self._state.mp_shares[chip_uuid]
+            self._state.mp_clients.pop(chip_uuid, None)
+
+    def get_multiprocess_share(self, chip_uuid: str) -> Optional[MultiProcessShare]:
+        with self._mu:
+            return self._state.mp_shares.get(chip_uuid)
+
+    # what the runtime (libtpu) does with the grant — modeled so tests
+    # can prove the limits bind (the reference's MPS daemon enforcement,
+    # sharing.go:151-436):
+
+    def connect_multiprocess_client(self, chip_uuid: str) -> int:
+        """A workload process attaches to the shared chip. Fails once
+        max_clients are connected."""
+        with self._mu:
+            share = self._state.mp_shares.get(chip_uuid)
+            if share is None:
+                raise TpuLibError(f"chip {chip_uuid} is not shared")
+            clients = self._state.mp_clients[chip_uuid]
+            if len(clients) >= share.max_clients:
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid}: {share.max_clients} clients "
+                    f"already connected")
+            cid = self._state.mp_next_client
+            self._state.mp_next_client += 1
+            clients[cid] = 0
+            return cid
+
+    def disconnect_multiprocess_client(self, chip_uuid: str, cid: int) -> None:
+        with self._mu:
+            self._state.mp_clients.get(chip_uuid, {}).pop(cid, None)
+
+    def client_allocate_hbm(self, chip_uuid: str, cid: int, nbytes: int) -> None:
+        """Model a client's HBM allocation: bounded by its per-client
+        budget AND the physical chip (so even conspiring clients cannot
+        exceed the hardware)."""
+        with self._mu:
+            share = self._state.mp_shares.get(chip_uuid)
+            clients = self._state.mp_clients.get(chip_uuid, {})
+            if share is None or cid not in clients:
+                raise TpuLibError(f"client {cid} not connected to {chip_uuid}")
+            chip = self._assert_chip(chip_uuid)
+            if clients[cid] + nbytes > share.client_hbm_bytes:
+                raise SharingExhaustedError(
+                    f"client {cid} exceeds its "
+                    f"{share.client_hbm_bytes}-byte HBM budget")
+            if sum(clients.values()) + nbytes > chip.hbm_bytes:
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid} HBM exhausted")
+            clients[cid] += nbytes
 
     def _assert_chip(self, chip_uuid: str) -> ChipInfo:
         for c in self._chips:

@@ -36,6 +36,32 @@ class SubsliceNotFoundError(TpuLibError):
     pass
 
 
+class SharingExhaustedError(TpuLibError):
+    """A multi-process share cannot be granted: over-subscribed limits or
+    the chip already carries another owner's share. Permanent — retrying
+    without a config/claim change cannot succeed (the reference surfaces
+    the analogous MPS daemon failures as non-retryable,
+    sharing.go:151-436)."""
+
+
+@dataclass(frozen=True)
+class MultiProcessShare:
+    """A granted per-claim multi-process share on one chip: up to
+    ``max_clients`` processes, each bounded to ``client_hbm_bytes`` of
+    HBM. The driver-level ledger entry backing the env the CDI spec
+    injects — the runtime (libtpu) enforces the budgets at allocation
+    time; the fake backend models that enforcement so tests can prove
+    two clients really get disjoint bounded shares (the reference's MPS
+    control daemon materially enforces the same way,
+    sharing.go:151-436)."""
+
+    chip_uuid: str
+    owner: str                 # claim uid holding the share
+    max_clients: int
+    hbm_limit_percent: int
+    client_hbm_bytes: int
+
+
 @dataclass(frozen=True)
 class ChipInfo:
     """Everything enumeration learns about one chip.
@@ -156,6 +182,26 @@ class TpuLib(abc.ABC):
 
     @abc.abstractmethod
     def set_exclusive_mode(self, chip_uuid: str, exclusive: bool) -> None: ...
+
+    @abc.abstractmethod
+    def allocate_multiprocess_share(self, chip_uuid: str, owner: str,
+                                    max_clients: int,
+                                    hbm_limit_percent: int) -> MultiProcessShare:
+        """Grant a per-claim multi-process share. Raises
+        SharingExhaustedError when max_clients * hbm_limit_percent > 100
+        (the clients' combined ceilings cannot exceed the chip) or the
+        chip already carries a different owner's share. Idempotent for
+        the same owner (re-prepare returns the existing grant)."""
+
+    @abc.abstractmethod
+    def release_multiprocess_share(self, chip_uuid: str,
+                                   owner: Optional[str] = None) -> None:
+        """Release the chip's share (any owner when ``owner`` is None —
+        the unprepare path tears down whatever the claim left). No-op
+        when none exists."""
+
+    @abc.abstractmethod
+    def get_multiprocess_share(self, chip_uuid: str) -> Optional[MultiProcessShare]: ...
 
     # -- health -------------------------------------------------------------
 

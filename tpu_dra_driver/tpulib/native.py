@@ -32,6 +32,8 @@ from tpu_dra_driver.tpulib.interface import (
     HealthEventKind,
     HealthHub,
     LiveSubslice,
+    MultiProcessShare,
+    SharingExhaustedError,
     SubsliceAlreadyExistsError,
     SubsliceNotFoundError,
     TimesliceInterval,
@@ -346,6 +348,69 @@ class NativeTpuLib(TpuLib):
 
     def get_exclusive_mode(self, chip_uuid: str) -> bool:
         return bool(self._load_sched().get(chip_uuid, {}).get("exclusive", False))
+
+    # -- multi-process share ledger (persisted like the scheduler knobs:
+    # a crashed plugin's grants survive and unprepare can release them;
+    # runtime budget enforcement itself is libtpu's job — the driver's
+    # ledger prevents double-grants and over-subscribed configs, the
+    # reference's MPS-daemon-bookkeeping analog, sharing.go:151-436) ----
+
+    def allocate_multiprocess_share(self, chip_uuid: str, owner: str,
+                                    max_clients: int,
+                                    hbm_limit_percent: int) -> MultiProcessShare:
+        with self._mu:
+            chip = self._assert_chip(chip_uuid)
+            sched = self._load_sched()
+            entry = sched.get(chip_uuid, {}).get("mp_share")
+            if entry is not None:
+                if entry.get("owner") == owner:
+                    return MultiProcessShare(
+                        chip_uuid=chip_uuid, owner=owner,
+                        max_clients=entry["max_clients"],
+                        hbm_limit_percent=entry["hbm_limit_percent"],
+                        client_hbm_bytes=entry["client_hbm_bytes"])
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid} already shared by claim "
+                    f"{entry.get('owner')}")
+            if max_clients * hbm_limit_percent > 100:
+                raise SharingExhaustedError(
+                    f"over-subscribed: {max_clients} clients x "
+                    f"{hbm_limit_percent}% HBM exceeds the chip")
+            share = MultiProcessShare(
+                chip_uuid=chip_uuid, owner=owner, max_clients=max_clients,
+                hbm_limit_percent=hbm_limit_percent,
+                client_hbm_bytes=chip.hbm_bytes * hbm_limit_percent // 100)
+            sched.setdefault(chip_uuid, {})["mp_share"] = {
+                "owner": owner, "max_clients": max_clients,
+                "hbm_limit_percent": hbm_limit_percent,
+                "client_hbm_bytes": share.client_hbm_bytes,
+            }
+            self._store_sched(sched)
+            return share
+
+    def release_multiprocess_share(self, chip_uuid: str,
+                                   owner: Optional[str] = None) -> None:
+        with self._mu:
+            sched = self._load_sched()
+            entry = sched.get(chip_uuid, {}).get("mp_share")
+            if entry is None:
+                return
+            if owner is not None and entry.get("owner") != owner:
+                raise TpuLibError(
+                    f"share on {chip_uuid} owned by {entry.get('owner')}, "
+                    f"not {owner}")
+            del sched[chip_uuid]["mp_share"]
+            self._store_sched(sched)
+
+    def get_multiprocess_share(self, chip_uuid: str) -> Optional[MultiProcessShare]:
+        entry = self._load_sched().get(chip_uuid, {}).get("mp_share")
+        if entry is None:
+            return None
+        return MultiProcessShare(
+            chip_uuid=chip_uuid, owner=entry.get("owner", ""),
+            max_clients=entry["max_clients"],
+            hbm_limit_percent=entry["hbm_limit_percent"],
+            client_hbm_bytes=entry["client_hbm_bytes"])
 
     def _assert_chip(self, chip_uuid: str) -> ChipInfo:
         for c in self.enumerate_chips():

@@ -363,7 +363,7 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
     from tpu_dra_driver.workloads.models.transformer import (
         ModelConfig as _MC, init_params,
     )
-    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
 
     cfg = cfg or _MC(vocab=4096, d_model=512, n_heads=8, n_kv_heads=2,
                      n_layers=4, d_ff=2048, max_seq=prompt_len + gen_long,
@@ -381,7 +381,7 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
         return lambda: generate(params, cfg, prompt, steps=n,
                                 max_t=prompt_len + gen_long)
 
-    per_step = marginal_chain_rate(make_run, gen_short, gen_long, iters)
+    per_step = chain_seconds_per_step(make_run, gen_short, gen_long, iters)
     n_kv = cfg.n_kv_heads or cfg.n_heads
     return {"decode_tokens_per_sec": b / per_step,
             "decode_step_ms": per_step * 1e3,

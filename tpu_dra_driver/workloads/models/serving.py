@@ -76,8 +76,13 @@ def paged_decode_step(params, cfg: ModelConfig, pool_ks, pool_vs,
 
     x = embed_lookup(params["embed"], tokens, cfg.dtype)[:, None]  # [B,1,d]
     if not cfg.use_rope:
-        x = x + jnp.take(params["pos_embed"], jnp.minimum(
-            lens, params["pos_embed"].shape[0] - 1), axis=0)[:, None]
+        # Caller contract: lens < max_seq (pos_embed rows). ServingEngine
+        # enforces it at admission; direct callers must too — this is a
+        # promise, not a silent clamp (the repo-wide "fail loudly" rule:
+        # reusing the last learned positional row would corrupt outputs
+        # quietly).
+        x = x + jnp.take(params["pos_embed"], lens, axis=0,
+                         mode="promise_in_bounds")[:, None]
 
     params = unstack_layer_params(params)
     new_ks, new_vs = [], []
@@ -192,11 +197,17 @@ class ServingEngine:
         self._next_rid = 0
         self.finished: Dict[int, List[int]] = {}
         self.interpret = (not _on_tpu()) if interpret is None else interpret
+        self._poisoned: Optional[str] = None
+
+    def _check_alive(self) -> None:
+        if self._poisoned:
+            raise RuntimeError(f"ServingEngine poisoned: {self._poisoned}")
 
     # -- admission -------------------------------------------------------
     def add(self, prompt: List[int], max_new_tokens: int) -> int:
         """Prefill + admit one request; returns its request id. Raises
         RuntimeError when no row or not enough blocks are free."""
+        self._check_alive()
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         t0 = len(prompt)
@@ -229,6 +240,18 @@ class ServingEngine:
                 self.cfg, self.block_t)
         except BaseException:
             self.free.extend(reversed(blocks))
+            # _admit_prefill donates the pools: a post-trace failure
+            # (e.g. device OOM) has already invalidated the old buffers,
+            # so the engine cannot keep serving from them — poison it
+            # rather than let later steps read deleted arrays.
+            try:
+                donated = any(getattr(p, "is_deleted", lambda: False)()
+                              for p in self.pool_ks)
+            except Exception:
+                donated = True
+            if donated:
+                self._poisoned = ("admission failed after pool donation; "
+                                  "engine state is unrecoverable")
             raise
         self.tables[row, :need] = blocks
         self.tables[row, need:] = 0
@@ -250,16 +273,31 @@ class ServingEngine:
     def step(self) -> Dict[int, int]:
         """One batched decode step; returns {rid: new_token} for rows
         that produced one. No-op on an idle engine."""
+        self._check_alive()
         active = [r for r in self.rows if r is not None]
         if not active:
             return {}
         tokens = np.zeros((len(self.rows),), np.int32)
         for r in active:
             tokens[r.row] = r.pending
-        logits, self.pool_ks, self.pool_vs = paged_decode_step(
-            self.params, self.cfg, self.pool_ks, self.pool_vs,
-            jnp.asarray(self.tables), jnp.asarray(self.lens),
-            jnp.asarray(tokens), interpret=self.interpret)
+        try:
+            logits, self.pool_ks, self.pool_vs = paged_decode_step(
+                self.params, self.cfg, self.pool_ks, self.pool_vs,
+                jnp.asarray(self.tables), jnp.asarray(self.lens),
+                jnp.asarray(tokens), interpret=self.interpret)
+        except BaseException:
+            # same donation hazard as add(): a post-trace failure has
+            # already consumed the pools — poison instead of letting a
+            # retry read deleted buffers
+            try:
+                donated = any(getattr(p, "is_deleted", lambda: False)()
+                              for p in self.pool_ks)
+            except Exception:
+                donated = True
+            if donated:
+                self._poisoned = ("decode step failed after pool donation; "
+                                  "engine state is unrecoverable")
+            raise
         picked = np.asarray(jnp.argmax(logits, axis=-1))
         out: Dict[int, int] = {}
         for r in active:

@@ -383,7 +383,7 @@ def train_tokens_per_sec(b: int = 8, t: int = 2048, iters: int = 3,
     6*N per token (fwd+bwd matmuls) plus 6*n_layers*t*d_model for
     causal attention scores/values fwd+bwd — approximate by design;
     the interesting signal is tokens/s and the trend."""
-    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
 
     cfg = cfg or ModelConfig(vocab=8192, d_model=2048, n_heads=16,
                              n_kv_heads=4, n_layers=8, d_ff=8192,
@@ -422,7 +422,7 @@ def train_tokens_per_sec(b: int = 8, t: int = 2048, iters: int = 3,
     def make_run(n):
         return lambda: prog(n)(params, opt_state, batch)
 
-    per_step = marginal_chain_rate(make_run, steps_short, steps_long, iters)
+    per_step = chain_seconds_per_step(make_run, steps_short, steps_long, iters)
     n_params = param_count(params)
     flops_per_token = 6 * n_params + 6 * cfg.n_layers * t * cfg.d_model
     tps = b * t / per_step

@@ -95,10 +95,10 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
-                  block_q: int, block_kv: int, causal: bool, sm_scale: float,
-                  num_super: int, window=None, row_offset: int = 0,
-                  prefix=None):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                  block_q: int, block_kv: int, causal: bool,
+                  num_super: int, emit_lse: bool = True, window=None,
+                  row_offset: int = 0, prefix=None):
     """One (batch*kv-head, q-group, q-block, kv-superblock) grid cell.
 
     GQA: the grid's axis 1 walks the query heads sharing this cell's KV
@@ -116,6 +116,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
     logsumexp (the backward's residual) are written on the last step.
     Fully-masked superblocks skip all compute via pl.when.
     """
+    if emit_lse:
+        lse_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        lse_ref, (acc_sc, m_sc, l_sc) = None, rest
     qi = pl.program_id(2)
     sj = pl.program_id(3)
     super_kv = k_ref.shape[0]
@@ -137,19 +141,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
         def body(j2, carry, masked):
             acc, m, l = carry
             # matmul operands stay in the input dtype (bf16 on TPU) so
-            # the MXU runs at full rate; accumulation is f32
+            # the MXU runs at full rate; accumulation is f32. The
+            # sm_scale * LOG2E factor is pre-folded into q by the caller
+            # — one [t, d] multiply outside replaces a [bq, bkv] multiply
+            # per block (measured ~10% of the kernel's VPU time).
             kb = k_ref[pl.ds(j2 * block_kv, block_kv), :]
             vb = v_ref[pl.ds(j2 * block_kv, block_kv), :]
             s = jax.lax.dot_general(                             # [bq, bkv]
                 q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+                preferred_element_type=jnp.float32)
             vis = None
             if masked:
+                # [bq,1] >= [1,bkv] broadcast compare: two vector iotas
+                # instead of two full [bq, bkv] tiles
                 row_ids = row_min + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_kv), 0)
+                    jnp.int32, (block_q, 1), 0)
                 col_ids = (sj * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
-                               jnp.int32, (block_q, block_kv), 1))
+                               jnp.int32, (1, block_kv), 1))
                 vis = row_ids >= col_ids
                 if window is not None:
                     vis &= row_ids - col_ids < window
@@ -190,8 +199,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
         acc, m, l = carry
         l = jnp.maximum(l, 1e-30)
         o_ref[:] = (acc / l).astype(o_ref.dtype)
-        # m is in base-2 units; publish natural-log lse for the backward
-        lse_ref[:] = ((m + jnp.log2(l)) / LOG2E).reshape(1, block_q)
+        if lse_ref is not None:
+            # m is in base-2 units; publish natural-log lse for the
+            # backward. Stored as a [bq, 1] column: a (1, bq) row here
+            # would be a cross-lane transpose (~20% of the kernel).
+            lse_ref[:] = (m + jnp.log2(l)) / LOG2E
 
     zeros = lambda: (jnp.zeros((block_q, d), jnp.float32),
                      jnp.full((block_q, 1), NEG_INF, jnp.float32),
@@ -328,8 +340,10 @@ def _gqa_group(q, k):
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
                    interpret: bool, window=None, row_offset: int = 0,
-                   prefix=None):
-    """Returns (out [b,h,t,d], lse [b*h, 1, t] f32). k/v may carry fewer
+                   prefix=None, with_lse: bool = True):
+    """Returns (out [b,h,t,d], lse [b*h, 1, t] f32 — or None when
+    ``with_lse=False``; inference callers skip the lse write entirely).
+    k/v may carry fewer
     (grouped/multi-query) heads than q, and a different sequence length
     (KV chunks, cross-attention, decode) when non-causal or when
     ``row_offset`` places the q rows at global positions
@@ -357,6 +371,9 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     sm_scale = 1.0 / math.sqrt(d)
     num_super = tkv // super_kv
 
+    # fold sm_scale * LOG2E into q once (f32 multiply, cast back): the
+    # kernels then run base-2 softmax on raw dot products
+    q = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
     qf = q.reshape(b * h_kv, group, t, d)
     kf = k.reshape(b * h_kv, tkv, d)
     vf = v.reshape(b * h_kv, tkv, d)
@@ -364,12 +381,19 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     grid = (b * h_kv, group, t // block_q, num_super)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_kv=block_kv,
-        causal=causal, sm_scale=sm_scale, num_super=num_super,
+        causal=causal, num_super=num_super, emit_lse=with_lse,
         window=window, row_offset=row_offset, prefix=prefix)
 
     vmem = {"memory_space": pltpu.VMEM}
 
-    out, lse = pl.pallas_call(
+    o_spec = pl.BlockSpec((None, None, block_q, d),
+                          lambda i, g, qi, j: (i, g, qi, 0), **vmem)
+    lse_spec = pl.BlockSpec((None, None, block_q, 1),
+                            lambda i, g, qi, j: (i, g, qi, 0), **vmem)
+    o_shape = _sds((b * h_kv, group, t, d), q.dtype, q, k, v)
+    lse_shape = _sds((b * h_kv, group, t, 1), jnp.float32, q, k, v)
+
+    result = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -380,26 +404,22 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
             pl.BlockSpec((None, super_kv, d),
                          lambda i, g, qi, j: (i, j, 0), **vmem),
         ],
-        out_specs=(
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda i, g, qi, j: (i, g, qi, 0), **vmem),
-            pl.BlockSpec((None, None, 1, block_q),
-                         lambda i, g, qi, j: (i, g, 0, qi), **vmem),
-        ),
-        out_shape=(
-            _sds((b * h_kv, group, t, d), q.dtype, q, k, v),
-            _sds((b * h_kv, group, 1, t), jnp.float32, q, k, v),
-        ),
+        out_specs=(o_spec, lse_spec) if with_lse else o_spec,
+        out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=_scratch(block_q, d),
         interpret=interpret,
         **_compiler_params(),
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d), lse.reshape(b * h, 1, t)
+    if with_lse:
+        out, lse = result
+        # lse layout is a [t, 1] column per head; contiguous (bh, t) order
+        return out.reshape(b, h, t, d), lse.reshape(b * h, 1, t)
+    return result.reshape(b, h, t, d), None
 
 
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                          dq_ref, acc_sc, *, block_q: int, block_kv: int,
-                         causal: bool, sm_scale: float, num_super: int,
+                         causal: bool, num_super: int,
                          window=None, row_offset: int = 0, prefix=None):
     """dq for one (batch*kv-head, q-group, q-block, kv-superblock) cell.
 
@@ -418,21 +438,21 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
 
     def steps(acc0):
         # base-2 softmax: p = exp(s - lse) == exp2(s*log2e - lse*log2e)
-        lse2 = lse_ref[:].reshape(block_q, 1) * LOG2E
-        dD = dD_ref[:].reshape(block_q, 1)
+        lse2 = lse_ref[:] * LOG2E                # [bq, 1]
+        dD = dD_ref[:]                           # [bq, 1]
 
         def body(j2, acc, masked):
             kb = k_ref[pl.ds(j2 * block_kv, block_kv), :]
             vb = v_ref[pl.ds(j2 * block_kv, block_kv), :]
             s = jax.lax.dot_general(
                 q_ref[:], kb, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+                preferred_element_type=jnp.float32)
             if masked:
                 row_ids = row_min + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_kv), 0)
+                    jnp.int32, (block_q, 1), 0)
                 col_ids = (sj * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
-                               jnp.int32, (block_q, block_kv), 1))
+                               jnp.int32, (1, block_kv), 1))
                 vis = row_ids >= col_ids
                 if window is not None:
                     vis &= row_ids - col_ids < window
@@ -443,7 +463,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
             dp = jax.lax.dot_general(                            # dO @ V^T
                 do_ref[:], vb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            ds = p * (dp - dD) * sm_scale
+            ds = p * (dp - dD)        # sm_scale applied by the caller
             return acc + jax.lax.dot_general(                    # dS @ K
                 ds.astype(kb.dtype), kb,
                 dimension_numbers=(((1,), (0,)), ((), ())),
@@ -482,7 +502,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
 
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                           dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
-                          block_kv: int, causal: bool, sm_scale: float,
+                          block_kv: int, causal: bool,
                           num_super: int, group: int, window=None,
                           row_offset: int = 0, prefix=None):
     """dk/dv for one (batch*kv-head, kv-block, q-group, q-superblock) cell.
@@ -508,18 +528,17 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
             dk_acc, dv_acc = carry
             qb = q_ref[pl.ds(i2 * block_q, block_q), :]
             dob = do_ref[pl.ds(i2 * block_q, block_q), :]
-            lse2 = (lse_ref[:, pl.ds(i2 * block_q, block_q)]
-                    .reshape(block_q, 1) * LOG2E)
-            dD = dD_ref[:, pl.ds(i2 * block_q, block_q)].reshape(block_q, 1)
+            lse2 = lse_ref[pl.ds(i2 * block_q, block_q), :] * LOG2E
+            dD = dD_ref[pl.ds(i2 * block_q, block_q), :]
             s = jax.lax.dot_general(
                 qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+                preferred_element_type=jnp.float32)
             if masked:
                 row_ids = (row_offset + si * super_q + i2 * block_q
                            + jax.lax.broadcasted_iota(
-                               jnp.int32, (block_q, block_kv), 0))
+                               jnp.int32, (block_q, 1), 0))
                 col_ids = kv_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_kv), 1)
+                    jnp.int32, (1, block_kv), 1)
                 vis = row_ids >= col_ids
                 if window is not None:
                     vis &= row_ids - col_ids < window
@@ -534,7 +553,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
             dp = jax.lax.dot_general(                            # dO @ V^T
                 dob, vb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            ds = p * (dp - dD) * sm_scale
+            ds = p * (dp - dD)        # scale applied by the caller (on dk)
             dk_acc = dk_acc + jax.lax.dot_general(               # dS^T @ Q
                 ds.astype(qb.dtype), qb,
                 dimension_numbers=(((0,), (0,)), ((), ())),
@@ -611,11 +630,16 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     block_kv = _fit_block(block_kv, tkv)
     sm_scale = 1.0 / math.sqrt(d)
 
-    qf = q.reshape(b * h_kv, group, t, d)
+    # Same pre-folded scale as the forward: the kernels see
+    # qs = q * sm_scale * LOG2E, compute ds = p * (dp - dD) with no
+    # in-loop scale, and the tiny [.., d]-shaped corrections below restore
+    # dq = (ds @ K) * sm_scale and dk = (ds^T @ qs) / LOG2E.
+    qs = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+    qf = qs.reshape(b * h_kv, group, t, d)
     kf = k.reshape(b * h_kv, tkv, d)
     vf = v.reshape(b * h_kv, tkv, d)
     gf = g.reshape(b * h_kv, group, t, d)
-    lse4 = lse.reshape(b * h_kv, group, 1, t)
+    lse4 = lse.reshape(b * h_kv, group, t, 1)
     # D = rowsum(dO * O): one fused elementwise+reduce pass in XLA.
     # When the caller also consumed the lse output (partial-attention
     # merging, see flash_attention_with_lse), its cotangent enters the
@@ -623,9 +647,9 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     # as D, so it folds in here and the kernels stay untouched.
     dD = jnp.sum(gf.astype(jnp.float32)
                  * out.reshape(b * h_kv, group, t, d).astype(jnp.float32),
-                 axis=-1).reshape(b * h_kv, group, 1, t)
+                 axis=-1).reshape(b * h_kv, group, t, 1)
     if g_lse is not None:
-        dD = dD - g_lse.astype(jnp.float32).reshape(b * h_kv, group, 1, t)
+        dD = dD - g_lse.astype(jnp.float32).reshape(b * h_kv, group, t, 1)
 
     super_kv = _fit_block(_SUPER_KV, tkv)
     super_q = _fit_block(_SUPER_KV, t)
@@ -637,8 +661,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                            lambda i, g_, a, b_: (i, g_, a, 0), **vmem)
     kvs_inner = pl.BlockSpec((None, super_kv, d),
                              lambda i, g_, a, b_: (i, b_, 0), **vmem)
-    row_outer = pl.BlockSpec((None, None, 1, block_q),
-                             lambda i, g_, a, b_: (i, g_, 0, a), **vmem)
+    row_outer = pl.BlockSpec((None, None, block_q, 1),
+                             lambda i, g_, a, b_: (i, g_, a, 0), **vmem)
     # dkv grid: (b*h_kv, kv-block, q-group, q-superblock); the kv-block
     # output index ignores the two sequential axes — each grouped head's
     # contribution folds into the same dk/dv block via the scratch carry
@@ -646,13 +670,13 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                             lambda i, a, g_, b_: (i, a, 0), **vmem)
     qs_inner = pl.BlockSpec((None, None, super_q, d),
                             lambda i, a, g_, b_: (i, g_, b_, 0), **vmem)
-    rows_inner = pl.BlockSpec((None, None, 1, super_q),
-                              lambda i, a, g_, b_: (i, g_, 0, b_), **vmem)
+    rows_inner = pl.BlockSpec((None, None, super_q, 1),
+                              lambda i, a, g_, b_: (i, g_, b_, 0), **vmem)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_kv=block_kv_dq, causal=causal,
-                          sm_scale=sm_scale, num_super=tkv // super_kv,
+                          num_super=tkv // super_kv,
                           window=window, row_offset=row_offset,
                           prefix=prefix),
         grid=(b * h_kv, group, t // block_q, tkv // super_kv),
@@ -667,7 +691,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q_dkv,
                           block_kv=block_kv, causal=causal,
-                          sm_scale=sm_scale, num_super=t // super_q,
+                          num_super=t // super_q,
                           group=group, window=window,
                           row_offset=row_offset, prefix=prefix),
         grid=(b * h_kv, tkv // block_kv, group, t // super_q),
@@ -682,6 +706,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                             "arbitrary")),
     )(kf, vf, qf, gf, lse4, dD)
 
+    dq = (dq.astype(jnp.float32) * sm_scale).astype(q.dtype)
+    dk = (dk.astype(jnp.float32) * (1.0 / LOG2E)).astype(k.dtype)
     return (dq.reshape(b, h, t, d), dk.reshape(b, h_kv, tkv, d),
             dv.reshape(b, h_kv, tkv, d))
 
@@ -695,7 +721,7 @@ def _on_tpu() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 1024,
+                    causal: bool = True, block_q: int = 512,
                     block_kv: int = 512,
                     interpret: Optional[bool] = None,
                     window: Optional[int] = None,
@@ -719,7 +745,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if interpret is None:
         interpret = not _on_tpu()
     out, _ = _flash_forward(q, k, v, causal, block_q, block_kv, interpret,
-                            window, row_offset, prefix)
+                            window, row_offset, prefix, with_lse=False)
     return out
 
 
@@ -747,7 +773,7 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
-                             causal: bool = True, block_q: int = 1024,
+                             causal: bool = True, block_q: int = 512,
                              block_kv: int = 512,
                              interpret: Optional[bool] = None,
                              window: Optional[int] = None,
@@ -818,12 +844,11 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
     """Causal flash-attention forward throughput (TFLOP/s) and speedup
     vs the XLA-compiled reference attention at the same shape.
 
-    Steady-state accounting: dependent chains of two lengths run inside
-    one jit each, and the *marginal* rate between them cancels the fixed
-    dispatch/transport overhead (large on tunneled remote devices) —
-    the same method as matmul_tflops_steady. FLOP accounting:
+    Timing: on-device profiler trace when available (host clocks on
+    tunneled devices carry O(100 ms) noise), marginal-chain fallback
+    elsewhere — see timing.chain_seconds_per_step. FLOP accounting:
     4*b*h*t^2*d (QK^T + PV), halved for causality."""
-    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -839,7 +864,7 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
                     return attn(qq, k, v).astype(dtype)
                 return jax.lax.fori_loop(0, n, body, q)
             return lambda: run(q, k, v)
-        return marginal_chain_rate(make_run, chain_short, chain_long, iters)
+        return chain_seconds_per_step(make_run, chain_short, chain_long, iters)
 
     per_flash = measure(lambda q, k, v: flash_attention(q, k, v, True))
     flops = 4 * b * h * t * t * d / 2
@@ -856,6 +881,59 @@ def flash_attention_tflops(b: int = 4, h: int = 8, t: int = 2048,
     return out
 
 
+def splash_attention_bar(b: int = 4, h: int = 8, t: int = 2048,
+                         d: int = 128, dtype=jnp.bfloat16,
+                         block: int = 1024) -> Optional[float]:
+    """Throughput (TFLOP/s, causal-half accounting) of jax's tuned
+    splash-attention kernel at the same shape — the best public TPU
+    attention kernel (used by maxtext), measured here as the achievable
+    bar our kernel is judged against on this chip. Returns None when the
+    kernel or profiler is unavailable. Block sizes tuned for v5e-class
+    chips at t>=2k (1024/1024 measured fastest)."""
+    try:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk,
+        )
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_mask as sm,
+        )
+        from tpu_dra_driver.workloads.utils.timing import (
+            device_seconds_per_step,
+        )
+
+        bs = sk.BlockSizes(
+            block_q=block, block_kv=block, block_kv_compute=block,
+            block_q_dkv=block, block_kv_dkv=block,
+            block_kv_dkv_compute=block, block_q_dq=block,
+            block_kv_dq=block)
+        mask = sm.MultiHeadMask([sm.CausalMask((t, t))] * h)
+        kern = sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
+                                  block_sizes=bs)
+        fv = jax.vmap(kern)
+        scale = 1.0 / math.sqrt(d)
+
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, h, t, d), dtype)
+        k = jax.random.normal(kk, (b, h, t, d), dtype)
+        v = jax.random.normal(kv, (b, h, t, d), dtype)
+
+        n = 32
+
+        @jax.jit
+        def chain(q, k, v):
+            def body(_, qq):
+                return fv(qq * scale, k, v).astype(dtype)
+            return jax.lax.fori_loop(0, n, body, q)
+
+        per = device_seconds_per_step(lambda: chain(q, k, v), n)
+        if per is None:
+            return None
+        return 4 * b * h * t * t * d / 2 / per / 1e12
+    except Exception:
+        return None
+
+
 def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
                                         t: int = 16384, d: int = 128,
                                         window: int = 2048,
@@ -868,9 +946,9 @@ def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
     score matrix is b*h*t^2*4 bytes (8 GiB at these defaults) — it
     cannot run — while the banded kernel touches O(t*window) and its
     FLOPs drop by ~t/(2*window). Useful-FLOP accounting counts only the
-    visible band: sum_r min(r+1, window) pairs, 4*d FLOPs each. Marginal
-    chain-rate timing as the other attention benches."""
-    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+    visible band: sum_r min(r+1, window) pairs, 4*d FLOPs each.
+    Device-trace timing as the other attention benches."""
+    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -887,7 +965,7 @@ def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
             return jax.lax.fori_loop(0, n, body, q)
         return lambda: run(q, k, v)
 
-    per = marginal_chain_rate(make_run, chain_short, chain_long, iters)
+    per = chain_seconds_per_step(make_run, chain_short, chain_long, iters)
     visible = window * (window + 1) // 2 + (t - window) * window
     flops = 4 * b * h * d * visible
     return {"flash_attn_long_ctx_tflops": flops / per / 1e12,
@@ -903,10 +981,10 @@ def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
 
     Chains full value_and_grad steps (all three grad kernels live — the
     carry folds dq/dk/dv back into q/k/v so nothing is dead-code
-    eliminated); marginal-rate timing as flash_attention_tflops. FLOP
+    eliminated); device-trace timing as flash_attention_tflops. FLOP
     accounting: 2 fwd matmuls + 5 bwd matmuls = 3.5x the forward's
     4*b*h*t^2*d/2 (causal)."""
-    from tpu_dra_driver.workloads.utils.timing import marginal_chain_rate
+    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -930,7 +1008,7 @@ def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
             return jax.lax.fori_loop(0, n, body, (q, k, v))
         return lambda: run(q, k, v)
 
-    per = marginal_chain_rate(make_run, chain_short, chain_long, iters)
+    per = chain_seconds_per_step(make_run, chain_short, chain_long, iters)
     flops = 3.5 * 4 * b * h * t * t * d / 2
     return {"flash_attn_train_tflops": flops / per / 1e12,
             "shape": f"b{b} h{h} t{t} d{d} {jnp.dtype(dtype).name}"}

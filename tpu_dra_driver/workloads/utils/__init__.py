@@ -1,5 +1,7 @@
 from tpu_dra_driver.workloads.utils.timing import (  # noqa: F401
     Timed,
+    chain_seconds_per_step,
+    device_seconds_per_step,
     marginal_chain_rate,
     time_fn,
 )

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, List
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
@@ -63,3 +63,75 @@ def marginal_chain_rate(make_run: Callable[[int], Callable[[], Any]],
         times[n] = time_fn(run, warmup=warmup, iters=iters).best_s
     dt = times[chain_long] - times[chain_short]
     return max(dt, 1e-9) / (chain_long - chain_short)
+
+
+def device_seconds_per_step(run: Callable[[], Any], n_steps: int) -> Optional[float]:
+    """On-device seconds per step of an n-step jitted chain, measured from
+    a jax profiler trace (the ``XLA Modules`` lane of the TPU device pid).
+
+    This is the ground-truth timing path: on tunneled/remote devices the
+    host-side clock carries O(100 ms) dispatch noise with high variance —
+    enough to corrupt even marginal-chain estimates for sub-millisecond
+    kernels (observed: the same kernel "measuring" 41 and 143 TFLOP/s
+    across runs). Device-side trace durations are immune. Returns None
+    when no profiler/device lane is available (CPU, interpret mode) —
+    callers fall back to marginal_chain_rate.
+    """
+    import glob
+    import gzip
+    import json
+    import shutil
+    import tempfile
+
+    _sync(run())  # compile + warm
+    tmpdir = tempfile.mkdtemp(prefix="tpu-dra-devtime-")
+    try:
+        try:
+            jax.profiler.start_trace(tmpdir)
+            _sync(run())
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        traces = sorted(glob.glob(
+            f"{tmpdir}/plugins/profile/*/*.trace.json.gz"))
+        if not traces:
+            return None
+        with gzip.open(traces[-1]) as f:
+            tr = json.load(f)
+        events = tr.get("traceEvents", [])
+        device_pids = set()
+        module_tids: Dict[int, int] = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                if "device:" in e.get("args", {}).get("name", ""):
+                    device_pids.add(e["pid"])
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                if e.get("args", {}).get("name") == "XLA Modules":
+                    module_tids[e["pid"]] = e.get("tid")
+        total_us = 0.0
+        found = False
+        for e in events:
+            if (e.get("ph") == "X" and e.get("pid") in device_pids
+                    and e.get("tid") == module_tids.get(e.get("pid"))):
+                total_us += e.get("dur", 0)
+                found = True
+        if not found:
+            return None
+        return total_us / 1e6 / n_steps
+    except Exception:
+        return None
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def chain_seconds_per_step(make_run: Callable[[int], Callable[[], Any]],
+                           chain_short: int, chain_long: int,
+                           iters: int = 3) -> float:
+    """Seconds per step: profiler-based device time when available (the
+    long chain only — one trace), else the marginal-chain fallback."""
+    dev = device_seconds_per_step(make_run(chain_long), chain_long)
+    if dev is not None:
+        return dev
+    return marginal_chain_rate(make_run, chain_short, chain_long, iters)
