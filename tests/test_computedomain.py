@@ -656,3 +656,102 @@ def test_multislice_ignores_stale_empty_cliques():
     # a node whose clique is outside the canonical set is not releasable
     with pytest.raises(MultisliceIncomplete):
         multislice_env(clients.compute_domain_cliques, "u1", 1, "ccc")
+
+
+# ---------------------------------------------------------------------------
+# controller-driven failover (VERDICT r1 #9): the harness's fake DS
+# controller — not the test body — reschedules killed daemon pods; clique
+# indices and labels must survive the churn (reference bar:
+# test_cd_failover.bats + lib/test_cd_nvb_failover.sh, 300 s budget)
+# ---------------------------------------------------------------------------
+
+def _index_by_node(harness, name, ns):
+    st = harness.cd_status(name, ns)
+    return {n["name"]: n["index"] for n in (st.get("nodes") or [])}
+
+
+def test_ds_controller_reschedules_daemon_with_stable_identity(harness):
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get(
+        "cd1", "user-ns")["metadata"]["uid"]
+    results = _prepare_concurrently(harness, uid, [0, 1])
+    assert all(r.error is None for r in results.values()), results
+    harness.wait_for(
+        lambda: harness.cd_status("cd1", "user-ns").get("status")
+        == STATUS_READY, what="CD ready")
+    before = _index_by_node(harness, "cd1", "user-ns")
+    assert len(before) == 2
+
+    victim = harness.clients.pods.list(namespace=DRIVER_NAMESPACE)[0]
+    victim_name = victim["metadata"]["name"]
+    victim_node = victim["spec"]["nodeName"]
+    harness.clients.pods.delete(victim_name, DRIVER_NAMESPACE)
+
+    # ONLY the DS controller may recreate the pod — the test never touches
+    # daemons. Wait for the pod object to exist again...
+    def pod_back():
+        try:
+            harness.clients.pods.get(victim_name, DRIVER_NAMESPACE)
+            return True
+        except NotFoundError:
+            return False
+    harness.wait_for(pod_back, timeout=20.0,
+                     what="DS controller recreated the daemon pod")
+
+    # ...and for the clique to re-form Ready with UNCHANGED per-node
+    # indices (worker identity must be stable across daemon restarts —
+    # a shuffled TPU_WORKER_ID would rewire the whole slice)
+    def healed_with_same_indices():
+        st = harness.cd_status("cd1", "user-ns")
+        return (st.get("status") == STATUS_READY
+                and _index_by_node(harness, "cd1", "user-ns") == before)
+    harness.wait_for(healed_with_same_indices, timeout=20.0,
+                     what="CD healed with stable indices")
+    # the victim node kept its CD label throughout
+    node = harness.clients.nodes.get(victim_node)
+    assert (node["metadata"].get("labels") or {}).get(
+        COMPUTE_DOMAIN_LABEL_KEY) == uid
+
+
+def test_label_removal_drains_daemon_and_readd_restores_index(harness):
+    """Removing a node's CD label must drain that node's daemon (the DS
+    controller GCs the pod); re-adding it (what a kubelet Prepare retry
+    does) must bring the daemon back with its ORIGINAL clique index —
+    gap-filling may not reassign a returning node."""
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get(
+        "cd1", "user-ns")["metadata"]["uid"]
+    results = _prepare_concurrently(harness, uid, [0, 1])
+    assert all(r.error is None for r in results.values()), results
+    harness.wait_for(
+        lambda: harness.cd_status("cd1", "user-ns").get("status")
+        == STATUS_READY, what="CD ready")
+    before = _index_by_node(harness, "cd1", "user-ns")
+    node_name = harness.host(0).node_name
+
+    def set_label(value):
+        node = harness.clients.nodes.get(node_name)
+        labels = node["metadata"].setdefault("labels", {})
+        if value is None:
+            labels.pop(COMPUTE_DOMAIN_LABEL_KEY, None)
+        else:
+            labels[COMPUTE_DOMAIN_LABEL_KEY] = value
+        harness.clients.nodes.update(node)
+
+    set_label(None)
+
+    def drained():
+        pods = harness.clients.pods.list(namespace=DRIVER_NAMESPACE)
+        return (len(pods) == 1
+                and pods[0]["spec"]["nodeName"] != node_name)
+    harness.wait_for(drained, timeout=20.0,
+                     what="DS controller drained the unlabeled node")
+
+    set_label(uid)
+
+    def restored():
+        st = harness.cd_status("cd1", "user-ns")
+        return (st.get("status") == STATUS_READY
+                and _index_by_node(harness, "cd1", "user-ns") == before)
+    harness.wait_for(restored, timeout=20.0,
+                     what="daemon back with original index after re-label")

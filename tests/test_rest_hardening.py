@@ -1,0 +1,207 @@
+"""Hardened REST client paths against a scripted stub API server
+(VERDICT r1 weak #4): list pagination via continue tokens, 429/503
+backoff honoring Retry-After, 401-triggered service-account token
+re-read, and watch BOOKMARK handling."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import tpu_dra_driver.kube.rest as rest_mod
+from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+
+
+class Stub:
+    def __init__(self, handler_fn):
+        outer = self
+        self.requests = []
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                outer.requests.append(
+                    (self.path, dict(self.headers)))
+                handler_fn(self, outer)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    @property
+    def url(self):
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _discovery(handler):
+    handler._send(200, {
+        "kind": "APIGroup", "name": "resource.k8s.io",
+        "versions": [{"groupVersion": "resource.k8s.io/v1",
+                      "version": "v1"}],
+    })
+
+
+def test_list_follows_continue_tokens():
+    pages = {
+        None: {"metadata": {"resourceVersion": "100", "continue": "tok1"},
+               "items": [{"metadata": {"name": "a"}}]},
+        "tok1": {"metadata": {"continue": "tok2"},
+                 "items": [{"metadata": {"name": "b"}}]},
+        "tok2": {"metadata": {},
+                 "items": [{"metadata": {"name": "c"}}]},
+    }
+
+    def handle(h, outer):
+        if h.path == "/apis/resource.k8s.io":
+            return _discovery(h)
+        from urllib.parse import parse_qs, urlparse
+        q = parse_qs(urlparse(h.path).query)
+        cont = q.get("continue", [None])[0]
+        assert q.get("limit") == [str(rest_mod.LIST_PAGE_LIMIT)]
+        h._send(200, pages[cont])
+
+    with Stub(handle) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        items = cluster.list("resourceslices")
+        assert [o["metadata"]["name"] for o in items] == ["a", "b", "c"]
+
+
+def test_429_retry_after_is_honored():
+    state = {"n": 0}
+
+    def handle(h, outer):
+        if h.path == "/apis/resource.k8s.io":
+            return _discovery(h)
+        state["n"] += 1
+        if state["n"] == 1:
+            h._send(429, {"kind": "Status", "code": 429},
+                    headers={"Retry-After": "0"})
+        else:
+            h._send(200, {"metadata": {}, "items": [
+                {"metadata": {"name": "ok"}}]})
+
+    with Stub(handle) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        items = cluster.list("resourceslices")
+        assert [o["metadata"]["name"] for o in items] == ["ok"]
+        assert state["n"] == 2
+
+
+def test_503_exhausts_retries_then_raises():
+    def handle(h, outer):
+        if h.path == "/apis/resource.k8s.io":
+            return _discovery(h)
+        h._send(503, {"kind": "Status", "code": 503},
+                headers={"Retry-After": "0"})
+
+    with Stub(handle) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        from tpu_dra_driver.kube.errors import ApiError
+        with pytest.raises(ApiError):
+            cluster.list("resourceslices")
+        # initial + MAX_RETRIES attempts (discovery request excluded)
+        list_calls = [r for r in stub.requests if "resourceslices" in r[0]]
+        assert len(list_calls) == rest_mod.MAX_RETRIES + 1
+
+
+def test_401_rereads_rotated_token(tmp_path, monkeypatch):
+    token_file = tmp_path / "token"
+    token_file.write_text("OLD")
+    seen = []
+
+    def handle(h, outer):
+        if h.path == "/apis/resource.k8s.io":
+            return _discovery(h)
+        auth = h.headers.get("Authorization", "")
+        seen.append(auth)
+        if auth == "Bearer OLD":
+            h._send(401, {"kind": "Status", "code": 401})
+        else:
+            h._send(200, {"metadata": {}, "items": []})
+
+    with Stub(handle) as stub:
+        cluster = RestCluster(RestClusterConfig(
+            server=stub.url, token="OLD", verify=False))
+        cluster._token_path = str(token_file)
+        token_file.write_text("NEW")        # kubelet rotated the projection
+        cluster.list("resourceslices")
+    assert "Bearer OLD" in seen and "Bearer NEW" in seen
+
+
+def test_watch_bookmark_updates_rv_without_surfacing():
+    """BOOKMARK events refresh the resume RV silently; after a stream
+    drop the watch re-dials from the bookmarked RV, and subscribers
+    never see the bookmark."""
+    watch_paths = []
+
+    def handle(h, outer):
+        if h.path == "/apis/resource.k8s.io":
+            return _discovery(h)
+        if "watch=true" in h.path:
+            watch_paths.append(h.path)
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def chunk(obj):
+                data = (json.dumps(obj) + "\n").encode()
+                h.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                h.wfile.flush()
+
+            if len(watch_paths) == 1:
+                chunk({"type": "ADDED", "object": {
+                    "metadata": {"name": "s1", "resourceVersion": "5"}}})
+                chunk({"type": "BOOKMARK", "object": {
+                    "metadata": {"resourceVersion": "77"}}})
+                h.wfile.write(b"0\r\n\r\n")
+                h.wfile.flush()
+            else:
+                time.sleep(0.5)
+                h.wfile.write(b"0\r\n\r\n")
+                h.wfile.flush()
+            return
+        h._send(200, {"metadata": {"resourceVersion": "77"}, "items": []})
+
+    with Stub(handle) as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        sub = cluster.watch("resourceslices")
+        events = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(watch_paths) < 2:
+            ev = sub.next(timeout=0.1)
+            if ev is not None:
+                events.append(ev)
+        sub.close()
+        types = [t for t, _ in events]
+        assert "BOOKMARK" not in types
+        assert "ADDED" in types
+        # a clean EOF is not a gap: the SECOND dial resumes from the
+        # bookmarked RV (77), not the last ADDED object's (5)
+        assert len(watch_paths) >= 2
+        assert "resourceVersion=77" in watch_paths[1]

@@ -146,8 +146,29 @@ class RestClusterConfig:
             return RestClusterConfig.from_kubeconfig()
 
 
+LIST_PAGE_LIMIT = 500        # client-go Reflector's default page size
+# 429 is always safe to retry (the server rejected before processing);
+# 5xx may follow a committed mutation, so only idempotent verbs retry it
+# (client-go's default transport does the same).
+RETRYABLE_ALWAYS = (429,)
+RETRYABLE_IDEMPOTENT = (429, 503)
+MAX_RETRIES = 4
+
+
 class RestCluster:
-    """Same surface as FakeCluster, backed by a real API server."""
+    """Same surface as FakeCluster, backed by a real API server.
+
+    Hardened request path (client-go parity the reference gets for free):
+
+    - **pagination**: lists walk ``continue`` tokens in LIST_PAGE_LIMIT
+      pages (a 10k-slice cluster would otherwise truncate or OOM),
+    - **429/503 backoff**: retried honoring ``Retry-After`` (API-server
+      priority-and-fairness throttling returns these under load),
+    - **401 token refresh**: bound service-account tokens rotate (~1 h);
+      a 401 re-reads the projected token file once and retries,
+    - **watch bookmarks**: ``allowWatchBookmarks`` keeps the resume
+      resourceVersion fresh so relists after idle periods are cheap.
+    """
 
     def __init__(self, config: RestClusterConfig):
         self._cfg = config
@@ -157,6 +178,7 @@ class RestCluster:
         self._session.verify = config.verify
         if config.client_cert:
             self._session.cert = config.client_cert
+        self._token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
         self._watch_threads: List[threading.Thread] = []
         self._resource_version_lock = threading.Lock()
         self._resource_version: Optional[str] = None
@@ -241,6 +263,54 @@ class RestCluster:
             url += f"/{name}"
         return url
 
+    # -- hardened request path ---------------------------------------------
+
+    def _refresh_token(self) -> bool:
+        """Re-read the projected SA token (bound tokens rotate ~hourly);
+        returns True when a new token was loaded."""
+        try:
+            with open(self._token_path) as f:
+                token = f.read().strip()
+        except OSError:
+            return False
+        current = self._session.headers.get("Authorization")
+        if token and current != f"Bearer {token}":
+            self._session.headers["Authorization"] = f"Bearer {token}"
+            log.info("reloaded rotated service-account token")
+            return True
+        return False
+
+    def _request(self, method: str, url: str, **kw) -> requests.Response:
+        """One API call with 429/503 Retry-After backoff and a single
+        401-triggered token refresh."""
+        import time as _time
+
+        refreshed = False
+        backoff = 1.0
+        retryable = (RETRYABLE_IDEMPOTENT if method in ("GET", "HEAD")
+                     else RETRYABLE_ALWAYS)
+        for attempt in range(MAX_RETRIES + 1):
+            resp = self._session.request(method, url, **kw)
+            if resp.status_code == 401 and not refreshed:
+                refreshed = True
+                if self._refresh_token():
+                    continue
+                return resp
+            if resp.status_code in retryable and attempt < MAX_RETRIES:
+                retry_after = resp.headers.get("Retry-After")
+                try:
+                    delay = float(retry_after) if retry_after else backoff
+                except ValueError:
+                    delay = backoff
+                delay = max(0.0, min(delay, 30.0))
+                log.warning("%s %s: HTTP %d, retrying in %.1fs",
+                            method, url, resp.status_code, delay)
+                _time.sleep(delay)
+                backoff = min(backoff * 2, 16.0)
+                continue
+            return resp
+        return resp
+
     @staticmethod
     def _raise_for(resp: requests.Response, what: str) -> None:
         if resp.status_code < 400:
@@ -274,28 +344,62 @@ class RestCluster:
 
     def create(self, resource: str, obj: Dict) -> Dict:
         ns = (obj.get("metadata") or {}).get("namespace", "")
-        resp = self._session.post(self._url(resource, ns),
-                                  json=self._to_wire(resource, obj))
+        resp = self._request("POST", self._url(resource, ns),
+                             json=self._to_wire(resource, obj))
         self._raise_for(resp, f"create {resource}")
         return self._from_wire(resource, resp.json())
 
     def get(self, resource: str, name: str, namespace: str = "") -> Dict:
-        resp = self._session.get(self._url(resource, namespace, name))
+        resp = self._request("GET", self._url(resource, namespace, name))
         self._raise_for(resp, f"get {resource} {namespace}/{name}")
         return self._from_wire(resource, resp.json())
+
+    def _paged_list(self, resource: str, namespace: str,
+                    label_selector: Optional[Dict[str, str]]
+                    ) -> Tuple[List[Dict], str]:
+        """Full list via continue-token pages; returns (items, the
+        FIRST page's resourceVersion — the consistent snapshot point a
+        watch resumes from, per client-go pager semantics)."""
+        params: Dict[str, str] = {"limit": str(LIST_PAGE_LIMIT)}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        items: List[Dict] = []
+        rv = ""
+        while True:
+            resp = self._request("GET", self._url(resource, namespace),
+                                 params=params)
+            if resp.status_code == 410 and "continue" in params:
+                # the continue token outlived the etcd compaction window:
+                # fall back to one unpaginated full list (client-go pager
+                # semantics) rather than failing or livelocking relists
+                log.warning("list %s: continue token expired; falling back "
+                            "to unpaginated list", resource)
+                full = dict(params)
+                full.pop("continue", None)
+                full.pop("limit", None)
+                resp = self._request("GET", self._url(resource, namespace),
+                                     params=full)
+                self._raise_for(resp, f"list {resource}")
+                body = resp.json()
+                rv = (body.get("metadata") or {}).get("resourceVersion") or rv
+                return ([self._from_wire(resource, o)
+                         for o in body.get("items", [])], rv)
+            self._raise_for(resp, f"list {resource}")
+            body = resp.json()
+            if not rv:
+                rv = (body.get("metadata") or {}).get("resourceVersion") or ""
+            items.extend(self._from_wire(resource, o)
+                         for o in body.get("items", []))
+            cont = (body.get("metadata") or {}).get("continue")
+            if not cont:
+                return items, rv
+            params["continue"] = cont
 
     def list(self, resource: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None,
              name_pattern: Optional[str] = None) -> List[Dict]:
-        params = {}
-        if label_selector:
-            params["labelSelector"] = ",".join(
-                f"{k}={v}" for k, v in label_selector.items())
-        resp = self._session.get(self._url(resource, namespace or ""),
-                                 params=params)
-        self._raise_for(resp, f"list {resource}")
-        items = [self._from_wire(resource, o)
-                 for o in resp.json().get("items", [])]
+        items, _ = self._paged_list(resource, namespace or "", label_selector)
         if name_pattern:
             import fnmatch
             items = [o for o in items if fnmatch.fnmatch(
@@ -304,14 +408,14 @@ class RestCluster:
 
     def update(self, resource: str, obj: Dict) -> Dict:
         meta = obj.get("metadata") or {}
-        resp = self._session.put(
-            self._url(resource, meta.get("namespace", ""), meta["name"]),
+        resp = self._request(
+            "PUT", self._url(resource, meta.get("namespace", ""), meta["name"]),
             json=self._to_wire(resource, obj))
         self._raise_for(resp, f"update {resource} {meta.get('name')}")
         return self._from_wire(resource, resp.json())
 
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
-        resp = self._session.delete(self._url(resource, namespace, name))
+        resp = self._request("DELETE", self._url(resource, namespace, name))
         self._raise_for(resp, f"delete {resource} {namespace}/{name}")
 
     # -- watch --------------------------------------------------------------
@@ -347,17 +451,7 @@ class RestCluster:
                           ) -> Tuple[List[Dict], str]:
         """Fresh full list + the list's resourceVersion (the point a new
         watch can safely resume from)."""
-        params: Dict[str, str] = {}
-        if label_selector:
-            params["labelSelector"] = ",".join(
-                f"{k}={v}" for k, v in label_selector.items())
-        resp = self._session.get(self._url(resource), params=params)
-        self._raise_for(resp, f"list {resource}")
-        body = resp.json()
-        rv = (body.get("metadata") or {}).get("resourceVersion") or ""
-        items = [self._from_wire(resource, o)
-                 for o in body.get("items", [])]
-        return items, rv
+        return self._paged_list(resource, "", label_selector)
 
     def _watch_loop(self, resource: str,
                     label_selector: Optional[Dict[str, str]],
@@ -370,7 +464,8 @@ class RestCluster:
         resourceVersion, so deletions during the outage are never lost."""
         import time as _time
 
-        params: Dict[str, str] = {"watch": "true"}
+        params: Dict[str, str] = {"watch": "true",
+                                  "allowWatchBookmarks": "true"}
         if label_selector:
             params["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in label_selector.items())
@@ -394,6 +489,14 @@ class RestCluster:
                             continue
                         ev_type = ev.get("type", "")
                         obj = ev.get("object") or {}
+                        if ev_type == "BOOKMARK":
+                            # progress marker only: refresh the resume RV,
+                            # never surface to subscribers
+                            rv = (obj.get("metadata") or {}).get(
+                                "resourceVersion")
+                            if rv:
+                                params["resourceVersion"] = rv
+                            continue
                         if ev_type == "ERROR":
                             # Status object, typically 410 Gone after etcd
                             # compaction: our resourceVersion is too old.
