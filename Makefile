@@ -2,7 +2,7 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test bench demo e2e clean protos
+.PHONY: all native test bench demo e2e e2e-kind clean protos
 
 all: native
 
@@ -17,6 +17,11 @@ test: native
 
 bench: native
 	$(PYTHON) bench.py
+
+# Full e2e against a real kind cluster (docker+kind+helm+kubectl needed;
+# fake TPU backend — no hardware). Reference bar: make bats.
+e2e-kind:
+	tests/e2e/run_e2e_kind.sh
 
 demo:
 	$(PYTHON) demo/run_e2e_demo.py

@@ -83,6 +83,63 @@ def bench_claim_to_ready(n_claims: int = 60, dynamic: bool = False) -> list:
     return lat_ms
 
 
+def bench_claim_to_ready_grpc(n_claims: int = 30) -> list:
+    """Claim-to-ready through the kubelet TRANSPORT: allocated claim ->
+    v1 DRAPlugin NodePrepareResources over a real unix:// dra.sock ->
+    checkpointed prepare -> CDI spec on disk -> unprepare. Adds the gRPC
+    hop kubelet pays that the in-process number cannot see. (The live
+    kubelet+containerd window is measured by the kind suite,
+    tests/e2e/measure_claim_to_ready.py.)"""
+    from tpu_dra_driver.grpc_api.server import DraGrpcClient, DraGrpcServer
+    from tpu_dra_driver.kube.allocator import Allocator
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.pkg import featuregates as fg
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    tmp = tempfile.mkdtemp(prefix="tpu-dra-bench-grpc-")
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="bench-node", state_dir=os.path.join(tmp, "state"),
+        cdi_root=os.path.join(tmp, "cdi"), gates=fg.FeatureGates()))
+    plugin.start()
+    sock = os.path.join(tmp, "state", "dra.sock")
+    server = DraGrpcServer(plugin, clients.resource_claims, "tpu.google.com",
+                           dra_address=f"unix://{sock}")
+    server.start()
+    client = DraGrpcClient(f"unix://{sock}")
+    allocator = Allocator(clients)
+    lat_ms = []
+    try:
+        for i in range(n_claims):
+            name = f"bench-g{i}"
+            clients.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "bench"},
+                "spec": {"devices": {"requests": [
+                    {"name": "tpu", "count": 1,
+                     "selectors": [{"attribute": "type",
+                                    "equals": "chip"}]}]}},
+            })
+            claim = allocator.allocate(name, "bench")
+            uid = claim["metadata"]["uid"]
+            t0 = time.perf_counter()
+            resp = client.node_prepare_resources([claim])
+            dt = (time.perf_counter() - t0) * 1e3
+            assert resp.claims[uid].error == "", resp.claims[uid].error
+            lat_ms.append(dt)
+            client.node_unprepare_resources(
+                [{"uid": uid, "namespace": "bench", "name": name}])
+            clients.resource_claims.delete(name, "bench")
+    finally:
+        client.close()
+        server.stop()
+        plugin.shutdown()
+    return lat_ms
+
+
 def bench_cd_rendezvous() -> float:
     from tpu_dra_driver.plugin.claims import build_allocated_claim
     from tpu_dra_driver.testing.harness import ClusterHarness
@@ -263,11 +320,20 @@ def bench_accelerator() -> dict:
             )
             sp = speculative_decode_tokens_per_sec(b=1, gamma=8, gen=256)
             out["spec_decode_speedup_b1"] = round(sp["speedup"], 3)
+            out["spec_decode_bound_b1"] = round(
+                sp["perfect_acceptance_bound"], 3)
+            out["spec_decode_draft_cost_ratio"] = round(
+                sp["draft_cost_ratio"], 3)
             log(f"  int8 self-speculative decode (b=1, gamma=8): "
                 f"{sp['spec_tokens_per_sec']:.0f} tok/s vs "
                 f"{sp['plain_tokens_per_sec']:.0f} plain "
                 f"({sp['speedup']:.2f}x, mean accepted "
-                f"{sp['mean_accepted']:.1f}/8, exact-greedy output)")
+                f"{sp['mean_accepted']:.1f}/8, exact-greedy output; "
+                f"perfect-acceptance ceiling at this draft cost "
+                f"r={sp['draft_cost_ratio']:.2f} is "
+                f"{sp['perfect_acceptance_bound']:.2f}x — the draft "
+                f"economics, not the machinery, bound b=1 here; "
+                f"early-exit drafts lift it on trained checkpoints)")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out
@@ -285,6 +351,10 @@ def main() -> int:
     lat_ss = bench_claim_to_ready(n_claims=30, dynamic=True)
     log(f"  p50={statistics.median(lat_ss):.2f} ms (n={len(lat_ss)})")
 
+    log("[bench] claim-to-ready over unix-socket gRPC (kubelet transport)…")
+    lat_g = bench_claim_to_ready_grpc(n_claims=30)
+    log(f"  p50={statistics.median(lat_g):.2f} ms (n={len(lat_g)})")
+
     log("[bench] 2-host ComputeDomain rendezvous…")
     rdv_ms = bench_cd_rendezvous()
     log(f"  CD create -> both workloads released: {rdv_ms:.0f} ms")
@@ -300,7 +370,14 @@ def main() -> int:
         "extra": {
             "p95_ms": round(p95, 3),
             "subslice_p50_ms": round(statistics.median(lat_ss), 3),
+            "grpc_p50_ms": round(statistics.median(lat_g), 3),
             "cd_rendezvous_ms": round(rdv_ms, 1),
+            "vs_baseline_note": (
+                "vs_baseline = reference cold NVML MIG-prepare O(10s) / "
+                "our in-process prepare p50; not apples-to-apples with a "
+                "containerized path — grpc_p50_ms adds the kubelet "
+                "transport hop, and tests/e2e measures the live "
+                "kubelet+containerd window"),
             **accel,
         },
     }))

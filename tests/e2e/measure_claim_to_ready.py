@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Claim-to-ready p50 with the REAL kubelet in the loop.
+
+Measures, per run: create a ResourceClaimTemplate-consuming pod, then
+take (PodReadyToStartContainers condition time) - (claim allocation
+time). That window contains exactly the driver-owned path the in-process
+bench cannot see: kubelet -> registration -> NodePrepareResources over
+unix:// dra.sock -> checkpointed prepare -> CDI spec -> containerd
+applying the spec. (The reference leaves this uninstrumented beyond
+t_prep* logs; BENCH vs_baseline compares the same window.)
+
+Requires kubectl context pointing at the e2e cluster. Used by
+run_e2e_kind.sh; also runnable standalone against any live cluster with
+the driver installed.
+"""
+
+import argparse
+import json
+import statistics
+import subprocess
+import sys
+import time
+import uuid
+
+
+def sh(*args: str) -> str:
+    return subprocess.run(args, check=True, capture_output=True,
+                          text=True).stdout
+
+
+def kubectl_json(*args: str):
+    return json.loads(sh("kubectl", *args, "-o", "json"))
+
+
+def parse_time(ts: str) -> float:
+    import datetime as dt
+    return dt.datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+
+
+POD_TMPL = """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: {name}
+  namespace: {ns}
+spec:
+  restartPolicy: Never
+  containers:
+    - name: w
+      image: registry.k8s.io/pause:3.9
+      resources:
+        claims: [{{name: tpu}}]
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: single-tpu
+"""
+
+
+def one_run(ns: str) -> float:
+    name = f"ctr-{uuid.uuid4().hex[:8]}"
+    spec = POD_TMPL.format(name=name, ns=ns)
+    subprocess.run(["kubectl", "apply", "-f", "-"], input=spec,
+                   text=True, check=True, capture_output=True)
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            pod = kubectl_json("get", "pod", "-n", ns, name)
+            conds = {c["type"]: c for c in
+                     (pod.get("status", {}).get("conditions") or [])}
+            ready = conds.get("PodReadyToStartContainers") \
+                or conds.get("Initialized")
+            if ready and ready.get("status") == "True":
+                claim_name = next(
+                    (s.get("resourceClaimName") for s in
+                     pod["spec"].get("resourceClaims", [])
+                     if s.get("resourceClaimName")), None) or next(
+                    (s.get("resourceClaimName") for s in
+                     (pod.get("status", {}).get("resourceClaimStatuses")
+                      or [])), None)
+                if not claim_name:
+                    raise RuntimeError("pod has no bound claim name")
+                claim = kubectl_json("get", "resourceclaim", "-n", ns,
+                                     claim_name)
+                alloc_t = None
+                for c in (claim.get("status", {}).get("conditions") or []):
+                    if c.get("type") == "Allocated":
+                        alloc_t = parse_time(c["lastTransitionTime"])
+                if alloc_t is None:
+                    # fall back to the pod Scheduled condition (allocation
+                    # happens during scheduling in DRA)
+                    alloc_t = parse_time(
+                        conds["PodScheduled"]["lastTransitionTime"])
+                return parse_time(ready["lastTransitionTime"]) - alloc_t
+            time.sleep(0.5)
+        raise RuntimeError(f"pod {name} never became ready")
+    finally:
+        subprocess.run(["kubectl", "delete", "pod", "-n", ns, name,
+                        "--wait=false"], capture_output=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--namespace", default="tpu-test1")
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--out", default="E2E_RESULTS.json")
+    args = ap.parse_args()
+
+    samples = []
+    for i in range(args.runs):
+        t = one_run(args.namespace)
+        samples.append(t)
+        print(f"[claim-to-ready] run {i + 1}/{args.runs}: {t * 1e3:.0f} ms",
+              file=sys.stderr)
+    samples.sort()
+    out = {
+        "metric": "claim_to_ready_kubelet_in_loop_p50",
+        "value": round(statistics.median(samples) * 1e3, 1),
+        "unit": "ms",
+        "extra": {
+            "p95_ms": round(samples[int(len(samples) * 0.95) - 1] * 1e3, 1),
+            "n": len(samples),
+            "note": ("allocation -> PodReadyToStartContainers through real "
+                     "kubelet + containerd; in-process bench.py measures "
+                     "only the driver-side prepare"),
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

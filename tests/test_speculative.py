@@ -155,3 +155,44 @@ def test_spec_bench_runs():
     assert out["spec_tokens_per_sec"] > 0
     assert out["plain_tokens_per_sec"] > 0
     assert 0.0 <= out["mean_accepted"] <= 2.0
+
+
+def test_early_exit_draft_output_is_exactly_target_greedy():
+    """The acceptance rule guarantees target-greedy output for ANY
+    draft — including a layer-skipping early-exit draft whose proposals
+    are mostly rejected at random init."""
+    import jax
+    from tpu_dra_driver.workloads.models.generate import generate
+    from tpu_dra_driver.workloads.models.speculative import (
+        early_exit_draft, speculative_generate)
+    from tpu_dra_driver.workloads.models.transformer import (
+        ModelConfig, init_params)
+
+    cfg = ModelConfig(vocab=128, d_model=64, n_heads=4, n_layers=4,
+                      d_ff=128, max_seq=64, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+
+    ref = generate(params, cfg, prompt, steps=12)
+    for k in (1, 2):
+        draft, dcfg = early_exit_draft(params, cfg, k, quantized=False)
+        assert dcfg.n_layers == k
+        out, stats = speculative_generate(params, cfg, draft, dcfg, prompt,
+                                          steps=12, gamma=3,
+                                          return_stats=True)
+        assert (out == ref).all(), f"early-exit k={k} diverged from greedy"
+
+
+def test_early_exit_draft_validation():
+    import jax
+    import pytest as pt
+    from tpu_dra_driver.workloads.models.speculative import early_exit_draft
+    from tpu_dra_driver.workloads.models.transformer import (
+        ModelConfig, init_params)
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pt.raises(ValueError):
+        early_exit_draft(params, cfg, 0)
+    with pt.raises(ValueError):
+        early_exit_draft(params, cfg, 3)

@@ -39,6 +39,7 @@ validation-workload tier (PARITY.md §2.6).
 
 from __future__ import annotations
 
+from dataclasses import replace
 from functools import partial
 from typing import Optional
 
@@ -116,6 +117,29 @@ def self_speculative_generate(params: Params, cfg: ModelConfig,
     return speculative_generate(params, cfg, draft, cfg,
                                 prompt, steps, gamma,
                                 return_stats=return_stats)
+
+
+def early_exit_draft(params: Params, cfg: ModelConfig, n_layers: int,
+                     quantized: bool = True):
+    """Layer-skipping self-draft: the target's FIRST ``n_layers`` blocks
+    plus its own final norm / tied head — no second model, draft
+    bytes/step ~ n_layers/L of the target (x0.5 again when
+    ``quantized``). The classic early-exit speculative recipe: on
+    trained models the shallow trunk's argmax tracks the full model
+    closely; acceptance at random init only measures structural
+    agreement. Returns (draft_params, draft_cfg) for
+    :func:`speculative_generate`."""
+    if not (1 <= n_layers <= cfg.n_layers):
+        raise ValueError(f"n_layers {n_layers} outside [1, {cfg.n_layers}]")
+    if cfg.scan_layers:
+        raise ValueError("early_exit_draft needs per-layer params "
+                         "(scan_layers=False)")
+    draft = dict(params)
+    draft["layers"] = list(params["layers"][:n_layers])
+    dcfg = replace(cfg, n_layers=n_layers)
+    if quantized:
+        draft = quantize_params(draft)
+    return draft, dcfg
 
 
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
@@ -237,11 +261,28 @@ def speculative_decode_tokens_per_sec(
         warmup=1, iters=iters).best_s
     t_plain = time_fn(lambda: generate(params, cfg, prompt, steps=gen),
                       warmup=1, iters=iters).best_s
+    # Draft-economics ceiling (why this chip cannot do much better at
+    # this batch): a round of gamma draft steps + one wide verify yields
+    # at most gamma+1 tokens, so speedup <= (gamma+1)/(gamma*r + v) with
+    # r = int8/bf16 step-cost ratio and v ~ 1 verify. r is ~0.8 here —
+    # b=1 decode is not purely weight-bandwidth-bound (cache reads and
+    # per-step overheads are paid by both models) — so even PERFECT
+    # acceptance caps near 1.2-1.3x. Cheaper drafts (early_exit_draft)
+    # move r toward n_layers/L * 0.8 and reach 2x+ on TRAINED
+    # checkpoints; at random init their acceptance is ~0 (shallow-trunk
+    # argmax agreement is a property of trained models), so this bench
+    # reports the int8 self-draft configuration.
+    t_int8 = time_fn(lambda: generate(qdraft, cfg, prompt, steps=gen),
+                     warmup=1, iters=iters).best_s
+    r = t_int8 / t_plain
+    bound = (gamma + 1) / (gamma * r + 1.0)
     return {
         "spec_tokens_per_sec": b * gen / t_spec,
         "plain_tokens_per_sec": b * gen / t_plain,
         "speedup": t_plain / t_spec,
         "mean_accepted": stats["mean_accepted"],
         "gamma": gamma,
+        "draft_cost_ratio": r,
+        "perfect_acceptance_bound": bound,
         "shape": f"b{b} L{cfg.n_layers} d{cfg.d_model} gen{gen}",
     }
