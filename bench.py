@@ -59,6 +59,24 @@ def bench_claim_to_ready(n_claims: int = 60, dynamic: bool = False) -> list:
     plugin.start()
     allocator = Allocator(clients)
 
+    def prepare(claim):
+        uid = claim["metadata"]["uid"]
+        return plugin.prepare_resource_claims([claim])[uid].error
+
+    def unprepare(uid, name):
+        plugin.unprepare_resource_claims([uid])
+
+    try:
+        return _claim_loop(clients, allocator, prepare, unprepare,
+                           n_claims, dynamic=dynamic)
+    finally:
+        plugin.shutdown()
+
+
+def _claim_loop(clients, allocator, prepare, unprepare, n_claims,
+                dynamic=False):
+    """Shared create->allocate->time(prepare)->unprepare->delete loop so
+    the in-process and gRPC-transport benches measure identical claims."""
     sel = [{"attribute": "type",
             "equals": "subslice" if dynamic else "chip"}]
     lat_ms = []
@@ -73,13 +91,12 @@ def bench_claim_to_ready(n_claims: int = 60, dynamic: bool = False) -> list:
         claim = allocator.allocate(name, "bench")
         uid = claim["metadata"]["uid"]
         t0 = time.perf_counter()
-        res = plugin.prepare_resource_claims([claim])[uid]
+        err = prepare(claim)
         dt = (time.perf_counter() - t0) * 1e3
-        assert res.error is None, res.error
+        assert not err, err
         lat_ms.append(dt)
-        plugin.unprepare_resource_claims([uid])
+        unprepare(uid, name)
         clients.resource_claims.delete(name, "bench")
-    plugin.shutdown()
     return lat_ms
 
 
@@ -109,35 +126,23 @@ def bench_claim_to_ready_grpc(n_claims: int = 30) -> list:
                            dra_address=f"unix://{sock}")
     server.start()
     client = DraGrpcClient(f"unix://{sock}")
-    allocator = Allocator(clients)
-    lat_ms = []
+
+    def prepare(claim):
+        uid = claim["metadata"]["uid"]
+        resp = client.node_prepare_resources([claim])
+        return resp.claims[uid].error or None
+
+    def unprepare(uid, name):
+        client.node_unprepare_resources(
+            [{"uid": uid, "namespace": "bench", "name": name}])
+
     try:
-        for i in range(n_claims):
-            name = f"bench-g{i}"
-            clients.resource_claims.create({
-                "apiVersion": "resource.k8s.io/v1beta1",
-                "kind": "ResourceClaim",
-                "metadata": {"name": name, "namespace": "bench"},
-                "spec": {"devices": {"requests": [
-                    {"name": "tpu", "count": 1,
-                     "selectors": [{"attribute": "type",
-                                    "equals": "chip"}]}]}},
-            })
-            claim = allocator.allocate(name, "bench")
-            uid = claim["metadata"]["uid"]
-            t0 = time.perf_counter()
-            resp = client.node_prepare_resources([claim])
-            dt = (time.perf_counter() - t0) * 1e3
-            assert resp.claims[uid].error == "", resp.claims[uid].error
-            lat_ms.append(dt)
-            client.node_unprepare_resources(
-                [{"uid": uid, "namespace": "bench", "name": name}])
-            clients.resource_claims.delete(name, "bench")
+        return _claim_loop(clients, Allocator(clients), prepare, unprepare,
+                           n_claims)
     finally:
         client.close()
         server.stop()
         plugin.shutdown()
-    return lat_ms
 
 
 def bench_cd_rendezvous() -> float:
@@ -343,7 +348,8 @@ def main() -> int:
     log("[bench] claim-to-ready (whole-chip claims)…")
     lat = bench_claim_to_ready(n_claims=60, dynamic=False)
     p50 = statistics.median(lat)
-    p95 = sorted(lat)[int(len(lat) * 0.95) - 1]
+    import math
+    p95 = sorted(lat)[max(0, math.ceil(len(lat) * 0.95) - 1)]
     log(f"  p50={p50:.2f} ms p95={p95:.2f} ms "
         f"min={min(lat):.2f} max={max(lat):.2f} (n={len(lat)})")
 

@@ -104,3 +104,52 @@ def test_allocated_claim_prepares_cleanly(tmp_path):
     res = plugin.prepare_resource_claims([claim])[claim["metadata"]["uid"]]
     assert res.error is None
     assert res.devices[0].canonical_name == "tpu-0"
+
+
+def test_cel_selectors_match_like_the_real_scheduler(tmp_path):
+    """The controller's claim templates ship real CEL on the wire; the
+    in-process allocator must honor the same expressions."""
+    clients, _ = _cluster(tmp_path)
+    clients.resource_claims.create({
+        "metadata": {"name": "cel1", "namespace": "ns", "uid": "u-cel1"},
+        "spec": {"devices": {"requests": [{
+            "name": "tpu",
+            "selectors": [{"cel": {"expression":
+                'device.driver == "tpu.google.com" && '
+                'device.attributes["tpu.google.com"].type == "chip"'}}],
+        }]}},
+    })
+    claim = Allocator(clients).allocate("cel1", "ns")
+    res = claim["status"]["allocation"]["devices"]["results"]
+    assert len(res) == 1 and res[0]["device"].startswith("tpu-")
+
+
+def test_cel_int_comparison_and_mismatch(tmp_path):
+    clients, _ = _cluster(tmp_path)
+    import pytest as pt
+    clients.resource_claims.create({
+        "metadata": {"name": "cel2", "namespace": "ns", "uid": "u-cel2"},
+        "spec": {"devices": {"requests": [{
+            "name": "tpu",
+            "selectors": [{"cel": {"expression":
+                'device.attributes["tpu.google.com"].type == "subslice"'}}],
+        }]}},
+    })
+    # whole-chip-only inventory: a subslice selector matches nothing
+    with pt.raises(AllocationError):
+        Allocator(clients).allocate("cel2", "ns")
+
+
+def test_cel_unsupported_term_fails_loudly(tmp_path):
+    clients, _ = _cluster(tmp_path)
+    import pytest as pt
+    clients.resource_claims.create({
+        "metadata": {"name": "cel3", "namespace": "ns", "uid": "u-cel3"},
+        "spec": {"devices": {"requests": [{
+            "name": "tpu",
+            "selectors": [{"cel": {"expression":
+                'device.capacity["tpu.google.com"].memory > 1'}}],
+        }]}},
+    })
+    with pt.raises(AllocationError, match="unsupported CEL"):
+        Allocator(clients).allocate("cel3", "ns")

@@ -156,11 +156,19 @@ def test_production_binary_end_to_end(tmp_path):
             "HEALTH_PORT": "-1",
             "JAX_PLATFORMS": "cpu",
         })
+        # log to files, not PIPEs: an undrained pipe buffer would block
+        # the plugin mid-run and masquerade as a socket/SIGTERM failure
+        out_f = open(tmp_path / "plugin.out", "w+")
+        err_f = open(tmp_path / "plugin.err", "w+")
         proc = subprocess.Popen(
             [sys.executable, "-m", "tpu_dra_driver.cmd.tpu_kubelet_plugin",
              "--kubeconfig", str(kubeconfig)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True)
+            env=env, stdout=out_f, stderr=err_f, text=True)
+
+        def stderr_tail():
+            err_f.flush()
+            err_f.seek(0)
+            return err_f.read()[-2000:]
         try:
             # kubelet's view: the registration socket appears...
             reg_sock = registry / "tpu.google.com-reg.sock"
@@ -171,7 +179,7 @@ def test_production_binary_end_to_end(tmp_path):
                     and api.slices):
                 if proc.poll() is not None:
                     raise AssertionError(
-                        f"plugin exited early: {proc.stderr.read()[-2000:]}")
+                        f"plugin exited early: {stderr_tail()}")
                 time.sleep(0.2)
             assert reg_sock.exists(), "registration socket missing"
             assert dra_sock.exists(), "dra socket missing"
@@ -214,4 +222,6 @@ def test_production_binary_end_to_end(tmp_path):
             except subprocess.TimeoutExpired:
                 proc.kill()
                 raise AssertionError("plugin did not exit on SIGTERM")
-        assert rc == 0, f"plugin exited {rc}: {proc.stderr.read()[-2000:]}"
+        assert rc == 0, f"plugin exited {rc}: {stderr_tail()}"
+        out_f.close()
+        err_f.close()

@@ -4,8 +4,11 @@ The reference relies on the real scheduler's DRA allocator; hardware-free
 testing here needs the same behavior in-process: satisfy ResourceClaim
 device requests against published ResourceSlices, honoring
 
-- device-class / request selectors (simple attribute matchers, standing in
-  for CEL),
+- request selectors, in BOTH wire forms: real restricted-CEL expressions
+  (what the chart's DeviceClasses and the controller's claim templates
+  actually ship — conjunctions of ==/!=/</> over device.driver and
+  device.attributes) and the legacy simple attribute matchers used by
+  older tests,
 - exact counts,
 - **KEP-4815 shared counters**: a device can be allocated only if its
   ``consumesCounters`` fit within its CounterSet's remaining capacity
@@ -22,6 +25,7 @@ Numeric counter values are compared as integers.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -42,8 +46,64 @@ def _attr_value(dev: Dict, name: str):
     return None
 
 
-def _matches(dev: Dict, selectors: List[Dict]) -> bool:
+# Restricted CEL: conjunctions of comparisons over device.driver and
+# device.attributes["<ns>"].<name> — the subset the chart's DeviceClasses
+# and the controller's claim templates use ON THE WIRE (the real
+# scheduler evaluates full CEL; this keeps the in-process allocator able
+# to honor the exact selectors shipped to real clusters).
+_CEL_TERM = re.compile(
+    r'^\s*device\.(?:'
+    r'(?P<drv>driver)'
+    r'|attributes\["(?P<ns>[^"]+)"\]\.(?P<attr>\w+)'
+    r')\s*(?P<op>==|!=|>=|<=|>|<)\s*(?P<lit>"[^"]*"|-?\d+|true|false)\s*$')
+
+
+def _cel_literal(tok: str):
+    if tok.startswith('"'):
+        return tok[1:-1]
+    if tok in ("true", "false"):
+        return tok == "true"
+    return int(tok)
+
+
+def _eval_cel(dev: Dict, driver: str, expression: str) -> bool:
+    for term in expression.split("&&"):
+        m = _CEL_TERM.match(term)
+        if not m:
+            raise AllocationError(
+                f"unsupported CEL term {term.strip()!r} (the in-process "
+                f"allocator evaluates conjunctions of ==/!=/</> over "
+                f"device.driver and device.attributes)")
+        lit = _cel_literal(m.group("lit"))
+        if m.group("drv"):
+            v = driver
+        else:
+            # qualified attributes resolve within their domain; a
+            # different domain than the publishing driver's is a miss on
+            # a real scheduler (missing map key) — mirror that instead of
+            # silently matching mistyped templates
+            if driver and m.group("ns") != driver:
+                return False
+            v = _attr_value(dev, m.group("attr"))
+        op = m.group("op")
+        ok = ((op == "==" and v == lit) or (op == "!=" and v != lit)
+              or (op in (">", ">=", "<", "<=")
+                  and isinstance(v, int) and isinstance(lit, int)
+                  and ((op == ">" and v > lit) or (op == ">=" and v >= lit)
+                       or (op == "<" and v < lit)
+                       or (op == "<=" and v <= lit))))
+        if not ok:
+            return False
+    return True
+
+
+def _matches(dev: Dict, selectors: List[Dict], driver: str = "") -> bool:
     for sel in selectors or []:
+        if "cel" in sel:
+            if not _eval_cel(dev, driver,
+                             (sel["cel"] or {}).get("expression", "")):
+                return False
+            continue
         v = _attr_value(dev, sel.get("attribute", ""))
         if "equals" in sel and v != sel["equals"]:
             return False
@@ -135,7 +195,9 @@ class Allocator:
                     key = (pool, dev["name"])
                     if not admin and key in taken:
                         continue
-                    if not _matches(dev, selectors):
+                    if not _matches(dev, selectors,
+                                    driver=s["spec"].get("driver",
+                                                         self._driver)):
                         continue
                     if not admin and not self._counters_fit(dev, capacity, usage):
                         continue

@@ -960,8 +960,11 @@ def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
         @jax.jit
         def run(q, k, v):
             def body(_, qq):
-                return flash_attention(qq, k, v, True,
-                                       window=window).astype(dtype)
+                # banded walks profile fastest with wider KV tiles on
+                # v5e (512/1024 measured ~20% over the 512/512 default)
+                return flash_attention(qq, k, v, True, window=window,
+                                       block_q=512,
+                                       block_kv=1024).astype(dtype)
             return jax.lax.fori_loop(0, n, body, q)
         return lambda: run(q, k, v)
 
