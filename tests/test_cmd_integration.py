@@ -154,12 +154,14 @@ def test_production_binary_end_to_end(tmp_path):
             "CDI_ROOT": str(cdi),
             "KUBECONFIG": str(kubeconfig),
             "HEALTH_PORT": "-1",
+            "FEATURE_GATES": "DeviceHealthCheck=true",
             "JAX_PLATFORMS": "cpu",
         })
         # log to files, not PIPEs: an undrained pipe buffer would block
         # the plugin mid-run and masquerade as a socket/SIGTERM failure
-        out_f = open(tmp_path / "plugin.out", "w+")
-        err_f = open(tmp_path / "plugin.err", "w+")
+        stack = __import__("contextlib").ExitStack()
+        out_f = stack.enter_context(open(tmp_path / "plugin.out", "w+"))
+        err_f = stack.enter_context(open(tmp_path / "plugin.err", "w+"))
         proc = subprocess.Popen(
             [sys.executable, "-m", "tpu_dra_driver.cmd.tpu_kubelet_plugin",
              "--kubeconfig", str(kubeconfig)],
@@ -189,7 +191,8 @@ def test_production_binary_end_to_end(tmp_path):
                 f"unix://{reg_sock}")
             assert info.endpoint == str(dra_sock)
             assert list(info.supported_versions) == [
-                "v1.DRAPlugin", "v1beta1.DRAPlugin"]
+                "v1.DRAPlugin", "v1beta1.DRAPlugin",
+                "v1alpha1.DRAResourceHealth"]
             # ...slices were published to the API server at the v1 paths
             assert api.slices, "no ResourceSlices published"
             assert any("/apis/resource.k8s.io/v1/" in p
@@ -221,7 +224,7 @@ def test_production_binary_end_to_end(tmp_path):
                 rc = proc.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 proc.kill()
+                stack.close()
                 raise AssertionError("plugin did not exit on SIGTERM")
         assert rc == 0, f"plugin exited {rc}: {stderr_tail()}"
-        out_f.close()
-        err_f.close()
+        stack.close()

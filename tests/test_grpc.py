@@ -78,7 +78,9 @@ def test_grpc_registration_and_health(served_plugin):
     info = client.get_info(f"localhost:{server.registration_port}")
     assert info.type == "DRAPlugin"
     assert info.name == "tpu.google.com"
-    # both versions advertised, v1 first (reference draplugin.go:618-621)
+    # both DRA versions advertised v1-first; the device-health stream is
+    # NOT advertised here (DeviceHealthCheck gate off -> no monitor, and
+    # an unmonitored plugin must not stream authoritative verdicts)
     assert list(info.supported_versions) == [
         "v1.DRAPlugin", "v1beta1.DRAPlugin"]
     assert client.health_check() is True
@@ -232,6 +234,58 @@ def test_unix_socket_full_round_trip(tmp_path):
             clients.resource_claims.delete(f"c-{ver}", "ns")
             client.close()
         assert plugin.state.get_checkpoint().claims == {}
+    finally:
+        server.stop()
+        plugin.shutdown()
+
+
+def test_device_health_stream(tmp_path):
+    """kubelet's v1alpha1.DRAResourceHealth stream (KEP-4680 — the
+    reference vendors but never serves it): initial snapshot all-healthy,
+    a transition message when the monitor marks a chip unhealthy, and the
+    service advertised in supported_versions."""
+    from tpu_dra_driver.grpc_api import dra_health_v1alpha1_pb2 as hp
+    from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind
+
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    gates = fg.FeatureGates()
+    gates.set(fg.DEVICE_HEALTH_CHECK, True)
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="node-a", state_dir=str(tmp_path / "s"),
+        cdi_root=str(tmp_path / "cdi"), gates=gates))
+    plugin.start()
+    server = DraGrpcServer(plugin, clients.resource_claims, "tpu.google.com",
+                           dra_address="localhost:0",
+                           registration_address="localhost:0")
+    server.start()
+    try:
+        info = DraGrpcClient("localhost:1").get_info(
+            f"localhost:{server.registration_port}")
+        assert "v1alpha1.DRAResourceHealth" in list(info.supported_versions)
+
+        channel = grpc.insecure_channel(f"localhost:{server.dra_port}")
+        stream = channel.unary_stream(
+            "/v1alpha1.DRAResourceHealth/NodeWatchResources",
+            request_serializer=hp.NodeWatchResourcesRequest.SerializeToString,
+            response_deserializer=hp.NodeWatchResourcesResponse.FromString,
+        )(hp.NodeWatchResourcesRequest(), timeout=30)
+
+        first = next(stream)
+        assert len(first.devices) >= 4
+        assert all(d.health == hp.HealthStatus.HEALTHY
+                   for d in first.devices)
+        assert all(d.device.pool_name == "node-a" for d in first.devices)
+
+        sick = lib.enumerate_chips()[0]
+        lib.inject_health_event(HealthEvent(
+            HealthEventKind.DEVICE_ERROR, chip_uuid=sick.uuid,
+            message="injected"))
+        second = next(stream)
+        by_name = {d.device.device_name: d.health for d in second.devices}
+        assert by_name["tpu-0"] == hp.HealthStatus.UNHEALTHY
+        assert by_name["tpu-1"] == hp.HealthStatus.HEALTHY
+        channel.close()
     finally:
         server.stop()
         plugin.shutdown()

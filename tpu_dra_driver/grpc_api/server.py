@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional
 
 import grpc
 
+from tpu_dra_driver.grpc_api import dra_health_v1alpha1_pb2 as dra_health_pb
 from tpu_dra_driver.grpc_api import dra_v1_pb2
 from tpu_dra_driver.grpc_api import dra_v1beta1_pb2
 from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
@@ -43,10 +44,50 @@ _DRA_PB = {"v1": dra_v1_pb2, "v1beta1": dra_v1beta1_pb2}
 _DRA_SERVICE = {"v1": DRA_SERVICE_V1, "v1beta1": DRA_SERVICE_V1BETA1}
 REGISTRATION_SERVICE = "pluginregistration.Registration"
 HEALTH_SERVICE = "grpc.health.v1.Health"
+DRA_HEALTH_SERVICE = "v1alpha1.DRAResourceHealth"
 # Version strings advertised to kubelet's plugin watcher, highest first
 # (reference v1/types.go:23 "v1.DRAPlugin", v1beta1/types.go:23
-# "v1beta1.DRAPlugin"; order per draplugin.go:618-621).
+# "v1beta1.DRAPlugin"; order per draplugin.go:618-621; the device-health
+# stream is appended when served, draplugin.go:623-627).
 SUPPORTED_VERSIONS = ("v1.DRAPlugin", "v1beta1.DRAPlugin")
+
+
+def _dra_health_handlers(plugin) -> grpc.GenericRpcHandler:
+    """kubelet's per-device health stream (KEP-4680): an initial snapshot
+    followed by a response on every health transition. The reference
+    vendors but never implements this service; the TPU health monitor
+    feeds it directly."""
+
+    def watch(request, context):
+        sent = None    # last version actually yielded
+        while context.is_active():
+            version = plugin.wait_health_change(
+                -1 if sent is None else sent, timeout=30.0)
+            if version is None:
+                return               # plugin shutting down: end the stream
+            if sent is not None and version == sent:
+                continue             # poll timeout, nothing changed
+            resp = dra_health_pb.NodeWatchResourcesResponse()
+            for d in plugin.device_health():
+                dh = resp.devices.add()
+                dh.device.pool_name = d["pool"]
+                dh.device.device_name = d["device"]
+                dh.health = (dra_health_pb.HealthStatus.HEALTHY
+                             if d["healthy"]
+                             else dra_health_pb.HealthStatus.UNHEALTHY)
+                dh.last_updated_time = int(d["stamp"])
+            sent = version
+            yield resp
+
+    return grpc.method_handlers_generic_handler(DRA_HEALTH_SERVICE, {
+        "NodeWatchResources": grpc.unary_stream_rpc_method_handler(
+            watch,
+            request_deserializer=(
+                dra_health_pb.NodeWatchResourcesRequest.FromString),
+            response_serializer=(
+                dra_health_pb.NodeWatchResourcesResponse.SerializeToString),
+        ),
+    })
 
 
 def _health_handlers(status_fn: Callable[[], bool]) -> grpc.GenericRpcHandler:
@@ -136,8 +177,10 @@ def _dra_handlers(plugin, claims_client: ResourceClient,
 
 
 def _registration_handlers(driver_name: str, endpoint_path: str,
-                           on_status: Optional[Callable[[bool, str], None]] = None
-                           ) -> grpc.GenericRpcHandler:
+                           on_status: Optional[Callable[[bool, str], None]] = None,
+                           supported_versions=None) -> grpc.GenericRpcHandler:
+    versions = list(supported_versions or SUPPORTED_VERSIONS)
+
     def get_info(request: reg_pb.InfoRequest, context):
         # kubelet dials `endpoint` as a filesystem socket PATH (not a grpc
         # target) and reads supported_versions as provided *service* names
@@ -145,7 +188,7 @@ def _registration_handlers(driver_name: str, endpoint_path: str,
         # noderegistrar.go:39)
         return reg_pb.PluginInfo(
             type="DRAPlugin", name=driver_name, endpoint=endpoint_path,
-            supported_versions=list(SUPPORTED_VERSIONS))
+            supported_versions=versions)
 
     def notify(request: reg_pb.RegistrationStatus, context):
         if on_status:
@@ -187,11 +230,22 @@ class DraGrpcServer:
         self._plugin = plugin
         self._driver_name = driver_name
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
-        self._server.add_generic_rpc_handlers((
+        handlers = [
             _dra_handlers(plugin, claims_client, "v1"),
             _dra_handlers(plugin, claims_client, "v1beta1"),
             _health_handlers(self._plugin_healthy),
-        ))
+        ]
+        # the device-health stream is served only when a health monitor
+        # actually runs (DeviceHealthCheck gate on the TPU plugin) — an
+        # unmonitored plugin must NOT advertise authoritative HEALTHY
+        # verdicts; kubelet then falls back to its no-health-service
+        # default (reference helper's conditional registration,
+        # draplugin.go:623-627)
+        self.supported_versions = list(SUPPORTED_VERSIONS)
+        if getattr(plugin, "health", None) is not None:
+            handlers.append(_dra_health_handlers(plugin))
+            self.supported_versions.append(DRA_HEALTH_SERVICE)
+        self._server.add_generic_rpc_handlers(tuple(handlers))
         self._reg_server = None
         self.dra_port = self._server.add_insecure_port(dra_address)
         if registration_address is not None:
@@ -200,7 +254,9 @@ class DraGrpcServer:
                              else dra_address)
             self._reg_server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
             self._reg_server.add_generic_rpc_handlers((
-                _registration_handlers(driver_name, endpoint_path),
+                _registration_handlers(
+                    driver_name, endpoint_path,
+                    supported_versions=self.supported_versions),
             ))
             self.registration_port = self._reg_server.add_insecure_port(
                 registration_address)

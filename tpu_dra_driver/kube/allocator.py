@@ -50,7 +50,10 @@ def _attr_value(dev: Dict, name: str):
 # device.attributes["<ns>"].<name> — the subset the chart's DeviceClasses
 # and the controller's claim templates use ON THE WIRE (the real
 # scheduler evaluates full CEL; this keeps the in-process allocator able
-# to honor the exact selectors shipped to real clusters).
+# to honor the exact selectors shipped to real clusters). Known
+# restriction: the conjunction split is textual, so a quoted literal
+# containing "&&" is rejected (fail-loud) even though real CEL accepts
+# it — none of the shipped selectors carry one.
 _CEL_TERM = re.compile(
     r'^\s*device\.(?:'
     r'(?P<drv>driver)'

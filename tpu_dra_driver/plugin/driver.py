@@ -93,6 +93,14 @@ class TpuKubeletPlugin:
             self.state, clients.resource_claims,
             interval=config.cleanup_interval)
         self._started = False
+        # device-health stream state (kubelet's v1alpha1.DRAResourceHealth
+        # service reads these; KEP-4680): a monotonically bumped version +
+        # condvar so watchers wake exactly on changes
+        self._health_cond = threading.Condition()
+        self._health_version = 0
+        self._health_stopped = False
+        self._health_started_at = time.time()
+        self._health_stamps: Dict[str, float] = {}   # chip uuid -> flip time
         # The ResourceClaim-to-ready north-star metric (BASELINE.md): the
         # scrapeable form of the reference's t_prep* log breadcrumbs.
         reg: Registry = DEFAULT_REGISTRY
@@ -131,6 +139,11 @@ class TpuKubeletPlugin:
         if self.health is not None:
             self.health.stop()
         self._started = False
+        # wake any device-health stream watchers parked in cond.wait so
+        # SIGTERM exit isn't held hostage for up to the 30s poll period
+        with self._health_cond:
+            self._health_stopped = True
+            self._health_cond.notify_all()
 
     def healthy(self) -> bool:
         """gRPC healthcheck analog (reference health.go:121-149 self-probes
@@ -176,6 +189,52 @@ class TpuKubeletPlugin:
     def _on_unhealthy(self, chip_uuid: str) -> None:
         log.warning("republishing slices without unhealthy chip %s", chip_uuid)
         self._republish()
+        self._bump_health(chip_uuid)
+
+    # ------------------------------------------------------------------
+    # device-health stream (kubelet v1alpha1.DRAResourceHealth, KEP-4680)
+    # ------------------------------------------------------------------
+
+    def _bump_health(self, chip_uuid: str) -> None:
+        with self._health_cond:
+            self._health_version += 1
+            self._health_stamps[chip_uuid] = time.time()
+            self._health_cond.notify_all()
+
+    def device_health(self) -> List[Dict]:
+        """Current per-device health: every allocatable device name in
+        this node's pool with healthy=False for devices whose underlying
+        chip the monitor marked unhealthy. Includes hidden (excluded)
+        personalities — kubelet needs the UNHEALTHY verdict precisely for
+        devices no longer published. Timestamps are per-device flip
+        times (KEP-4680 semantics), start time for never-flipped chips."""
+        unhealthy = self.health.unhealthy_uuids if self.health else set()
+        out = []
+        for name, dev in sorted(self.state.allocatable.items()):
+            out.append({
+                "pool": self._config.node_name,
+                "device": name,
+                "healthy": dev.chip.uuid not in unhealthy,
+                "stamp": self._health_stamps.get(dev.chip.uuid,
+                                                 self._health_started_at),
+            })
+        return out
+
+    def wait_health_change(self, seen_version: int,
+                           timeout: float = 30.0) -> Optional[int]:
+        """Block until the health version advances past ``seen_version``
+        (or timeout); returns the current version, or None once the
+        plugin is shutting down (watchers must end their streams).
+        seen_version=-1 returns immediately (initial snapshot)."""
+        with self._health_cond:
+            if self._health_stopped:
+                return None
+            if seen_version < 0 or self._health_version > seen_version:
+                return self._health_version
+            self._health_cond.wait(timeout)
+            if self._health_stopped:
+                return None
+            return self._health_version
 
     # ------------------------------------------------------------------
     # DRA entrypoints (reference driver.go:298-397)
