@@ -317,6 +317,40 @@ def bench_accelerator() -> dict:
                     f"{tr['train_step_ms']:.0f} ms/step)")
             except Exception as e:
                 log(f"  training bench skipped: {type(e).__name__}: {e}")
+            # continuous batching: the ServingEngine vs per-request
+            # sequential decoding at ragged lengths (the vLLM-style
+            # throughput story; outputs are token-identical)
+            try:
+                from tpu_dra_driver.workloads.models import init_params
+                from tpu_dra_driver.workloads.models.serving import (
+                    serving_throughput,
+                )
+                s_cfg = ModelConfig(vocab=8192, d_model=1024, n_heads=8,
+                                    n_kv_heads=4, n_layers=6, d_ff=4096,
+                                    max_seq=1664, use_rope=True)
+                s_params = init_params(s_cfg, jax.random.PRNGKey(3))
+                key = jax.random.PRNGKey(4)
+                prompts = []
+                # 3 distinct lengths (2 requests each): _admit_prefill
+                # compiles per distinct prompt length (~30s each on the
+                # tunneled dev chip) — ragged enough without 6 compiles
+                for plen in (512, 256, 384, 256, 512, 384):
+                    key, k2 = jax.random.split(key)
+                    prompts.append([int(t) for t in jax.random.randint(
+                        k2, (plen,), 0, s_cfg.vocab)])
+                sv = serving_throughput(s_params, s_cfg, prompts,
+                                        max_new_tokens=96, n_blocks=64,
+                                        block_t=128, max_batch=8)
+                out["serving_throughput_speedup"] = round(sv["speedup"], 2)
+                out["serving_tokens_per_sec"] = round(
+                    sv["engine_tokens_per_sec"], 1)
+                log(f"  serving: continuous batching "
+                    f"{sv['engine_tokens_per_sec']:.0f} tok/s vs "
+                    f"{sv['sequential_tokens_per_sec']:.0f} sequential "
+                    f"({sv['speedup']:.2f}x, 6 ragged requests, "
+                    f"token-identical outputs)")
+            except Exception as e:
+                log(f"  serving bench skipped: {type(e).__name__}: {e}")
             # int8 self-speculation at b=1 (the latency-bound serving
             # case); acceptance at random init is the pessimistic floor —
             # trained (peaked) models accept more
