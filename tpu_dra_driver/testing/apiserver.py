@@ -1,0 +1,345 @@
+"""SimApiServer — the FakeCluster served over real HTTP.
+
+The kind e2e suite needs docker; this is the closest attainable substrate
+without it (kwok-style): the in-memory :class:`FakeCluster` exposed through
+the Kubernetes REST wire protocol, so **production binaries run as real
+subprocesses** against it via their normal `--kubeconfig` path
+(``kube/rest.py``'s RestCluster) — real process boundaries, real HTTP,
+real chunked ``?watch=true`` streams, real group-version conversion at the
+wire (the server speaks resource.k8s.io/v1, exercising
+``kube/resourceversions.py`` on both ends).
+
+Reference analog: the bats suite's live API server
+(tests/bats/helpers.sh); kwok plays this role in upstream k8s DRA CI.
+
+Served surface (exactly what RestCluster dials):
+
+- ``GET /apis/resource.k8s.io`` — group discovery (advertises v1+v1beta1);
+- CRUD on every resource in ``rest._RESOURCE_MAP`` under both core
+  (``/api/v1``) and group (``/apis/<group>/<version>``) prefixes, with
+  and without a ``namespaces/<ns>`` segment;
+- ``GET ...?watch=true`` — chunked JSON event stream from the fake's
+  watch hub (one line per event, client-go framing);
+- label selectors (``labelSelector=k=v,k2=v2``), list pagination params
+  accepted (served as a single page — the fake holds the whole set).
+
+The harness process shares the underlying FakeCluster object, so test
+orchestration (node/pod simulation, assertions) uses the fast in-process
+seam while the drivers-under-test see only HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from tpu_dra_driver.kube.errors import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+from tpu_dra_driver.kube.fake import FakeCluster
+from tpu_dra_driver.kube.resourceversions import (
+    GROUP_RESOURCES,
+    from_wire,
+    to_wire,
+)
+
+log = logging.getLogger(__name__)
+
+# resource plural -> kind (for List kinds; single-object kinds ride on the
+# stored object's own "kind" field)
+_LIST_KINDS = {
+    "nodes": "NodeList", "pods": "PodList", "events": "EventList",
+    "daemonsets": "DaemonSetList", "leases": "LeaseList",
+    "resourceslices": "ResourceSliceList",
+    "resourceclaims": "ResourceClaimList",
+    "resourceclaimtemplates": "ResourceClaimTemplateList",
+    "deviceclasses": "DeviceClassList",
+    "computedomains": "ComputeDomainList",
+    "computedomaincliques": "ComputeDomainCliqueList",
+}
+
+_KNOWN_RESOURCES = frozenset(_LIST_KINDS)
+
+
+def _parse_path(path: str) -> Optional[Tuple[str, str, str, str]]:
+    """``/apis/resource.k8s.io/v1/namespaces/ns/resourceclaims/name`` →
+    (resource, namespace, name, wire_version). Returns None when the path
+    is not a resource path (e.g. bare discovery)."""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api":            # core: api/v1/...
+        rest, version = parts[2:], "v1"
+    elif parts[0] == "apis":         # group: apis/<group>/<version>/...
+        if len(parts) < 3:
+            return None
+        rest, version = parts[3:], parts[2]
+    else:
+        return None
+    namespace = ""
+    if rest and rest[0] == "namespaces" and len(rest) >= 2 and \
+            (len(rest) == 2 or rest[2] in _KNOWN_RESOURCES):
+        # /namespaces/<ns>/<resource>[/<name>] — but NOT /namespaces/<name>
+        # of the core "namespaces" resource itself (unserved here)
+        if len(rest) == 2:
+            return None
+        namespace, rest = rest[1], rest[2:]
+    if not rest or rest[0] not in _KNOWN_RESOURCES:
+        return None
+    resource = rest[0]
+    name = rest[1] if len(rest) > 1 else ""
+    return resource, namespace, name, version
+
+
+def _selector_from_query(q: Dict[str, List[str]]) -> Optional[Dict[str, str]]:
+    raw = (q.get("labelSelector") or [""])[0]
+    if not raw:
+        return None
+    sel: Dict[str, str] = {}
+    for term in raw.split(","):
+        if "=" in term:
+            k, _, v = term.partition("=")
+            sel[k.strip().lstrip("!")] = v.strip()
+    return sel or None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "SimApiServer/1.0"
+
+    # quiet the default per-request stderr lines
+    def log_message(self, fmt, *args):  # noqa: N802
+        log.debug("apiserver: " + fmt, *args)
+
+    @property
+    def cluster(self) -> FakeCluster:
+        return self.server.cluster  # type: ignore[attr-defined]
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _send_json(self, code: int, body: Dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_status(self, code: int, reason: str, message: str) -> None:
+        self._send_json(code, {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "message": message, "code": code,
+        })
+
+    def _send_error(self, e: Exception) -> None:
+        if isinstance(e, NotFoundError):
+            self._send_status(404, "NotFound", str(e))
+        elif isinstance(e, AlreadyExistsError):
+            self._send_status(409, "AlreadyExists", str(e))
+        elif isinstance(e, ConflictError):
+            self._send_status(409, "Conflict", str(e))
+        elif isinstance(e, InvalidError):
+            self._send_status(422, "Invalid", str(e))
+        else:
+            self._send_status(500, "InternalError", f"{type(e).__name__}: {e}")
+
+    def _read_body(self) -> Dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    def _to_wire(self, resource: str, obj: Dict, version: str) -> Dict:
+        if resource in GROUP_RESOURCES:
+            return to_wire(resource, obj, version)
+        return obj
+
+    def _from_wire(self, resource: str, obj: Dict, version: str) -> Dict:
+        if resource in GROUP_RESOURCES:
+            return from_wire(resource, obj, version)
+        return obj
+
+    # -- verbs ---------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        if url.path.rstrip("/") == "/apis/resource.k8s.io":
+            self._send_json(200, {
+                "kind": "APIGroup", "apiVersion": "v1",
+                "name": "resource.k8s.io",
+                "versions": [{"groupVersion": "resource.k8s.io/v1",
+                              "version": "v1"},
+                             {"groupVersion": "resource.k8s.io/v1beta1",
+                              "version": "v1beta1"}],
+                "preferredVersion": {"groupVersion": "resource.k8s.io/v1",
+                                     "version": "v1"},
+            })
+            return
+        if url.path.rstrip("/") in ("", "/healthz", "/readyz", "/livez"):
+            self._send_json(200, {"status": "ok"})
+            return
+        parsed = _parse_path(url.path)
+        if parsed is None:
+            self._send_status(404, "NotFound", f"unserved path {url.path}")
+            return
+        resource, namespace, name, version = parsed
+        selector = _selector_from_query(q)
+        try:
+            if name:
+                obj = self.cluster.get(resource, name, namespace)
+                self._send_json(200, self._to_wire(resource, obj, version))
+            elif (q.get("watch") or ["false"])[0] == "true":
+                self._serve_watch(resource, selector, version)
+            else:
+                items = self.cluster.list(
+                    resource,
+                    namespace=namespace or None,
+                    label_selector=selector)
+                self._send_json(200, {
+                    "kind": _LIST_KINDS[resource], "apiVersion": "v1",
+                    "metadata": {
+                        "resourceVersion": str(self.cluster.resource_version()),
+                    },
+                    "items": [self._to_wire(resource, o, version)
+                              for o in items],
+                })
+        except ApiError as e:
+            self._send_error(e)
+
+    def _serve_watch(self, resource: str, selector: Optional[Dict[str, str]],
+                     version: str) -> None:
+        """Chunked JSON event stream. Subscribes to the fake's watch hub;
+        each (type, object) becomes one newline-terminated JSON line, the
+        exact framing RestCluster (and client-go) consumes."""
+        sub = self.cluster.watch(resource, selector)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            while not self.server.stopping:  # type: ignore[attr-defined]
+                ev = sub.next(timeout=0.5)
+                if ev is None:
+                    continue
+                ev_type, obj = ev
+                line = json.dumps({
+                    "type": ev_type,
+                    "object": self._to_wire(resource, obj, version),
+                }).encode() + b"\n"
+                write_chunk(line)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client hung up
+        finally:
+            self.cluster.stop_watch(resource, sub)
+            try:
+                write_chunk(b"")  # terminating chunk
+            except OSError:
+                pass
+
+    def do_POST(self) -> None:  # noqa: N802
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None:
+            self._send_status(404, "NotFound", f"unserved path {self.path}")
+            return
+        resource, namespace, _, version = parsed
+        try:
+            obj = self._from_wire(resource, self._read_body(), version)
+            if namespace:
+                obj.setdefault("metadata", {}).setdefault(
+                    "namespace", namespace)
+            created = self.cluster.create(resource, obj)
+            self._send_json(201, self._to_wire(resource, created, version))
+        except ApiError as e:
+            self._send_error(e)
+        except (ValueError, KeyError) as e:
+            self._send_status(400, "BadRequest", str(e))
+
+    def do_PUT(self) -> None:  # noqa: N802
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None or not parsed[2]:
+            self._send_status(404, "NotFound", f"unserved path {self.path}")
+            return
+        resource, namespace, name, version = parsed
+        try:
+            obj = self._from_wire(resource, self._read_body(), version)
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("namespace", namespace)
+            meta.setdefault("name", name)
+            updated = self.cluster.update(resource, obj)
+            self._send_json(200, self._to_wire(resource, updated, version))
+        except ApiError as e:
+            self._send_error(e)
+        except (ValueError, KeyError) as e:
+            self._send_status(400, "BadRequest", str(e))
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None or not parsed[2]:
+            self._send_status(404, "NotFound", f"unserved path {self.path}")
+            return
+        resource, namespace, name, _ = parsed
+        try:
+            self.cluster.delete(resource, name, namespace)
+            self._send_json(200, {"kind": "Status", "apiVersion": "v1",
+                                  "status": "Success"})
+        except ApiError as e:
+            self._send_error(e)
+
+
+class SimApiServer:
+    """Run a FakeCluster behind real HTTP on 127.0.0.1:<port>."""
+
+    def __init__(self, cluster: Optional[FakeCluster] = None, port: int = 0):
+        self.cluster = cluster or FakeCluster()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.cluster = self.cluster          # type: ignore[attr-defined]
+        self._httpd.stopping = False                # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "SimApiServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="sim-apiserver")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.stopping = True                 # type: ignore[attr-defined]
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def write_kubeconfig(self, path: str) -> str:
+        """Minimal kubeconfig the production binaries consume via
+        ``--kubeconfig`` (RestClusterConfig.from_kubeconfig)."""
+        cfg = {
+            "apiVersion": "v1", "kind": "Config",
+            "current-context": "sim",
+            "contexts": [{"name": "sim",
+                          "context": {"cluster": "sim", "user": "sim"}}],
+            "clusters": [{"name": "sim", "cluster": {"server": self.url}}],
+            "users": [{"name": "sim", "user": {}}],
+        }
+        import yaml
+        with open(path, "w") as f:
+            yaml.safe_dump(cfg, f)
+        return path
