@@ -241,12 +241,10 @@ def main() -> int:
         finally:
             cluster.teardown()
     if "compute-domain" in phases:
+        from run_e2e_sim_cd import phase_compute_domain
         try:
-            from run_e2e_sim_cd import phase_compute_domain
             results["compute_domain"] = phase_compute_domain(
-                os.path.join(root, "cd"), quick=args.quick)
-        except ImportError:
-            pass  # CD phase not built yet
+                os.path.join(root, "cd"))
         except Exception as e:  # noqa: BLE001
             log(f"FAIL compute-domain: {e}")
             results["compute_domain"] = {"status": "failed", "error": str(e)}

@@ -174,10 +174,13 @@ class SimNode:
     """Per-node runtime dirs + the plugins that live on the node."""
 
     def __init__(self, root: str, node_name: str, kubeconfig: str,
-                 accelerator_type: str = "v5p-8"):
+                 accelerator_type: str = "v5p-8",
+                 host_index: int = 0, slice_id: str = ""):
         self.node_name = node_name
         self.kubeconfig = kubeconfig
         self.accelerator_type = accelerator_type
+        self.host_index = host_index
+        self.slice_id = slice_id
         self.root = os.path.join(root, node_name)
         self.state_dir = os.path.join(self.root, "state", "tpu.google.com")
         self.cd_state_dir = os.path.join(self.root, "state",
@@ -185,10 +188,9 @@ class SimNode:
         self.registry_dir = os.path.join(self.root, "plugins_registry")
         self.cdi_root = os.path.join(self.root, "cdi")
         self.run_dir = os.path.join(self.root, "run")
-        self.hosts_dir = os.path.join(self.root, "hosts")
         self.log_dir = os.path.join(self.root, "logs")
         for d in (self.state_dir, self.cd_state_dir, self.registry_dir,
-                  self.cdi_root, self.run_dir, self.hosts_dir, self.log_dir):
+                  self.cdi_root, self.run_dir, self.log_dir):
             os.makedirs(d, exist_ok=True)
         self.kubelet = KubeletReplay(self.registry_dir)
         self.processes: List[PluginProcess] = []
@@ -198,7 +200,19 @@ class SimNode:
                 "metadata": {"name": self.node_name, "labels": {
                     "kubernetes.io/hostname": self.node_name}},
                 "status": {"addresses": [
-                    {"type": "InternalIP", "address": "127.0.0.1"}]}}
+                    {"type": "InternalIP", "address": self.node_ip}]}}
+
+    @property
+    def node_ip(self) -> str:
+        return f"10.0.{self.host_index}.2"
+
+    def fake_env(self) -> Dict[str, str]:
+        """Per-node fake-backend identity (host index + slice id), the
+        way a real node's DaemonSet env carries its downward-API facts."""
+        env = {"FAKE_TPU_HOST_INDEX": str(self.host_index)}
+        if self.slice_id:
+            env["FAKE_TPU_SLICE_ID"] = self.slice_id
+        return env
 
     def spawn_tpu_plugin(self, extra_args: Optional[List[str]] = None,
                          tag: str = "") -> PluginProcess:
@@ -215,17 +229,21 @@ class SimNode:
                 "-v", "6"] + (extra_args or [])
         p = PluginProcess(
             f"tpu-plugin-{self.node_name}{tag}", argv,
-            os.path.join(self.log_dir, f"tpu-plugin{tag}.log"))
+            os.path.join(self.log_dir, f"tpu-plugin{tag}.log"),
+            env=self.fake_env())
         self.processes.append(p)
         return p
 
     def spawn_cd_plugin(self, extra_args: Optional[List[str]] = None,
                         tag: str = "") -> PluginProcess:
+        # --hosts-file-dir must be the same node dir the CD daemons use as
+        # --run-dir: the plugin reads the daemon-rendered worker-env.json
+        # from there (one hostPath shared by both containers on a real node)
         argv = ["-m", "tpu_dra_driver.cmd.compute_domain_kubelet_plugin",
                 "--node-name", self.node_name,
                 "--state-dir", self.cd_state_dir,
                 "--cdi-root", self.cdi_root,
-                "--hosts-file-dir", self.hosts_dir,
+                "--hosts-file-dir", self.run_dir,
                 "--plugin-registry", self.registry_dir,
                 "--device-backend", "fake",
                 "--accelerator-type", self.accelerator_type,
@@ -235,7 +253,45 @@ class SimNode:
                 "-v", "6"] + (extra_args or [])
         p = PluginProcess(
             f"cd-plugin-{self.node_name}{tag}", argv,
-            os.path.join(self.log_dir, f"cd-plugin{tag}.log"))
+            os.path.join(self.log_dir, f"cd-plugin{tag}.log"),
+            env=self.fake_env())
+        self.processes.append(p)
+        return p
+
+    def spawn_daemon_from_pod_template(self, ds: Dict, pod: Dict,
+                                       tag: str = "") -> PluginProcess:
+        """The kubelet role for a CD daemon pod: execute the command the
+        controller stamped into the DaemonSet template, with the
+        downward-API env (NODE_NAME/POD_NAME/POD_IP) resolved from the
+        materialized pod object — the daemon runs exactly as its
+        container would."""
+        tmpl = ds["spec"]["template"]["spec"]["containers"][0]
+        command = list(tmpl.get("command") or [])
+        if not command or "compute_domain_daemon" not in " ".join(command):
+            raise HarnessError(f"unexpected DS container command: {command}")
+        argv = command[1:]   # drop the python3 argv[0]; we exec sys.executable
+        env: Dict[str, str] = {
+            "KUBECONFIG": self.kubeconfig,
+            "RUN_DIR": self.run_dir,
+            "STATE_DIR": os.path.join(self.root, "state", "daemon"),
+            "TPU_ACCELERATOR_TYPE": self.accelerator_type,
+        }
+        env.update(self.fake_env())
+        downward = {"spec.nodeName": pod["spec"].get("nodeName", ""),
+                    "metadata.name": pod["metadata"]["name"],
+                    "status.podIP": (pod.get("status") or {}).get("podIP", "")}
+        for e in tmpl.get("env") or []:
+            if "value" in e:
+                env[e["name"]] = str(e["value"])
+            elif "valueFrom" in e:
+                path = ((e["valueFrom"] or {}).get("fieldRef") or {}).get(
+                    "fieldPath", "")
+                env[e["name"]] = downward.get(path, "")
+        p = PluginProcess(
+            f"cd-daemon-{self.node_name}{tag}", argv,
+            os.path.join(self.log_dir,
+                         f"cd-daemon-{pod['metadata']['name']}{tag}.log"),
+            env=env)
         self.processes.append(p)
         return p
 
@@ -260,13 +316,32 @@ class SimCluster:
         # with the HTTP surface the subprocesses dial)
         self.clients = ClientSets(cluster=self.apiserver.cluster)
         self.nodes: List[SimNode] = []
+        self.controller_proc: Optional[PluginProcess] = None
 
-    def add_node(self, name: str, accelerator_type: str = "v5p-8") -> SimNode:
+    def add_node(self, name: str, accelerator_type: str = "v5p-8",
+                 host_index: int = 0, slice_id: str = "") -> SimNode:
         node = SimNode(self.root, name, self.kubeconfig,
-                       accelerator_type=accelerator_type)
+                       accelerator_type=accelerator_type,
+                       host_index=host_index, slice_id=slice_id)
         self.clients.nodes.create(node.node_object())
         self.nodes.append(node)
         return node
+
+    def spawn_controller(self, extra_args: Optional[List[str]] = None
+                         ) -> PluginProcess:
+        log_dir = os.path.join(self.root, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        argv = ["-m", "tpu_dra_driver.cmd.compute_domain_controller",
+                "--kube-backend", "rest",
+                "--kubeconfig", self.kubeconfig,
+                "--device-backend", "fake",
+                "--driver-image", "sim-image:e2e",
+                "--status-sync-interval", "0.2",
+                "-v", "6"] + (extra_args or [])
+        p = PluginProcess("cd-controller", argv,
+                          os.path.join(log_dir, "cd-controller.log"))
+        self.controller_proc = p
+        return p
 
     # -- the scheduler role --------------------------------------------------
 
@@ -296,15 +371,35 @@ class SimCluster:
     def teardown(self) -> None:
         for node in self.nodes:
             node.stop_all()
+        if self.controller_proc is not None:
+            self.controller_proc.stop()
         self.apiserver.stop()
 
     def dump_logs(self) -> str:
         out = []
-        for node in self.nodes:
-            for p in node.processes:
-                out.append(f"--- {p.name} (rc={p.proc.poll()}) ---")
-                out.append(p.tail())
+        procs = [p for node in self.nodes for p in node.processes]
+        if self.controller_proc is not None:
+            procs.append(self.controller_proc)
+        for p in procs:
+            out.append(f"--- {p.name} (rc={p.proc.poll()}) ---")
+            out.append(p.tail())
         return "\n".join(out)
+
+
+def claim_from_template(rct: Dict, name: str) -> Dict:
+    """Instantiate a ResourceClaim from a ResourceClaimTemplate, the way
+    kubelet/resourceclaim-controller does: spec.spec becomes the claim
+    spec, template labels carry over."""
+    import copy
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {
+            "name": name,
+            "namespace": rct["metadata"].get("namespace", ""),
+            "labels": dict((rct["metadata"].get("labels") or {})),
+        },
+        "spec": copy.deepcopy((rct.get("spec") or {}).get("spec") or {}),
+    }
 
 
 def percentile(values: List[float], pct: float) -> float:

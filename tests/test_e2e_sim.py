@@ -13,18 +13,31 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_sim_e2e_tpu_plugin_quick(tmp_path):
+def _run_phase(tmp_path, phase):
     out = tmp_path / "results.json"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # subprocesses don't import jax
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "tests/e2e/run_e2e_sim.py"),
-         "--quick", "--phases", "tpu-plugin", "--out", str(out)],
-        capture_output=True, text=True, timeout=300, env=env)
+         "--quick", "--phases", phase, "--out", str(out)],
+        capture_output=True, text=True, timeout=900, env=env)
     assert proc.returncode == 0, f"harness failed:\n{proc.stderr[-4000:]}"
-    results = json.loads(out.read_text())
-    tp = results["tpu_plugin"]
+    return json.loads(out.read_text())
+
+
+def test_sim_e2e_tpu_plugin_quick(tmp_path):
+    tp = _run_phase(tmp_path, "tpu-plugin")["tpu_plugin"]
     assert tp["status"] == "green"
     assert tp["t1"]["cdi_valid"] and tp["t2"]["idempotent"] and tp["t3"]["distinct"]
     assert tp["crash_recovery"]["unprepare_after_restart"]
     assert tp["claim_to_ready_ms"]["p50"] > 0
+
+
+def test_sim_e2e_compute_domain(tmp_path):
+    cd = _run_phase(tmp_path, "compute-domain")["compute_domain"]
+    assert cd["status"] == "green"
+    assert cd["worker_env"]["ids"] == ["0", "1"]
+    assert cd["worker_env"]["cdi_valid"]
+    assert cd["failover_observed_degradation"] and cd["index_stability"]
+    assert cd["failover_heal_s"] <= 300
+    assert cd["teardown_clean"]

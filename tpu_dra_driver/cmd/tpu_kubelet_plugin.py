@@ -7,6 +7,7 @@ monitor → checkpoint cleanup → ResourceSlice publishing.
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import threading
@@ -52,8 +53,14 @@ def build_parser() -> EnvArgumentParser:
 def make_lib(args):
     if args.device_backend == "fake":
         from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+        # Per-node identity for hardware-free multi-host runs (the sim e2e
+        # suite, demo kind clusters): a real deployment derives these from
+        # the hardware/metadata server; fake mode takes them from the pod
+        # env the way the DaemonSet's downward API feeds NODE_NAME.
         return FakeTpuLib(FakeSystemConfig(
-            accelerator_type=args.accelerator_type or "v5p-8"))
+            accelerator_type=args.accelerator_type or "v5p-8",
+            host_index=int(os.environ.get("FAKE_TPU_HOST_INDEX") or 0),
+            slice_id=os.environ.get("FAKE_TPU_SLICE_ID") or None))
     from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
     # binaries without a --state-dir flag (the CD daemon) share the
     # node-global native state dir

@@ -299,12 +299,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(e)
 
 
+class _Server(ThreadingHTTPServer):
+    def handle_error(self, request, client_address):  # noqa: D102
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return  # client (a stopped plugin process) hung up mid-watch
+        super().handle_error(request, client_address)
+
+
 class SimApiServer:
     """Run a FakeCluster behind real HTTP on 127.0.0.1:<port>."""
 
     def __init__(self, cluster: Optional[FakeCluster] = None, port: int = 0):
         self.cluster = cluster or FakeCluster()
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd = _Server(("127.0.0.1", port), _Handler)
         self._httpd.cluster = self.cluster          # type: ignore[attr-defined]
         self._httpd.stopping = False                # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
