@@ -536,4 +536,173 @@ extern "C" int tpudev_device_in_use(const char* proc_root,
   return in_use;
 }
 
+// ---------------------------------------------------------------------------
+// health poller (see tpudev.h design notes; reference analog
+// device_health.go:30-351)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Parse an AER counter file: prefer the TOTAL_ERR_* line the kernel
+// emits; otherwise sum every "NAME COUNT" line. Returns -1 if the file
+// does not exist (device/kernel without AER).
+long long read_aer_total(const std::string& path) {
+  std::string content;
+  if (!read_file(path, &content)) return -1;
+  long long total = 0, sum = 0;
+  bool have_total = false;
+  size_t pos = 0;
+  while (pos < content.size()) {
+    size_t eol = content.find('\n', pos);
+    std::string line = content.substr(
+        pos, eol == std::string::npos ? std::string::npos : eol - pos);
+    pos = eol == std::string::npos ? content.size() : eol + 1;
+    size_t sp = line.find_last_of(" \t");
+    if (sp == std::string::npos) continue;
+    char* end = nullptr;
+    long long v = strtoll(line.c_str() + sp + 1, &end, 10);
+    if (end == line.c_str() + sp + 1) continue;  // no number on this line
+    if (line.compare(0, 9, "TOTAL_ERR") == 0) {
+      total += v;
+      have_total = true;
+    } else {
+      sum += v;
+    }
+  }
+  return have_total ? total : sum;
+}
+
+// Plain single-integer counter file (TPU driver counters). -1 if absent.
+long long read_counter(const std::string& path) {
+  std::string content;
+  if (!read_file(path, &content)) return -1;
+  char* end = nullptr;
+  long long v = strtoll(content.c_str(), &end, 10);
+  return end == content.c_str() ? -1 : v;
+}
+
+struct HealthSource {
+  const char* file;
+  int kind;
+  int code;
+};
+
+// Counter sources per chip, relative to the PCI device dir.
+const HealthSource kCounterSources[] = {
+    {"hbm_ecc_errors", TPUDEV_HEALTH_HBM_ECC, 0},
+    {"ici_link_errors", TPUDEV_HEALTH_ICI_LINK, 0},
+    {"thermal_throttle_events", TPUDEV_HEALTH_THERMAL, 0},
+};
+
+}  // namespace
+
+struct tpudev_health_poller {
+  std::string sysfs_root;
+  std::string devfs_root;
+  bool primed = false;
+  // pci address -> (source name -> last value); uuid remembered so a
+  // vanished chip can still be reported by uuid.
+  std::vector<std::string> seen_pci;
+  std::vector<std::string> seen_uuid;
+  std::vector<std::vector<long long>> last;  // parallel to seen_pci
+};
+
+extern "C" tpudev_health_poller_t* tpudev_health_poller_new(
+    const char* sysfs_root, const char* devfs_root) {
+  tpudev_health_poller* p = new tpudev_health_poller();
+  p->sysfs_root = sysfs_root ? sysfs_root : "/sys";
+  p->devfs_root = devfs_root ? devfs_root : "/dev";
+  return p;
+}
+
+extern "C" void tpudev_health_poller_free(tpudev_health_poller_t* p) {
+  delete p;
+}
+
+// Per chip we track: AER fatal, AER nonfatal, then kCounterSources.
+constexpr int kNumSources = 2 + 3;
+
+extern "C" int tpudev_health_poll(tpudev_health_poller_t* p,
+                                  tpudev_health_event_t* out, int max_out,
+                                  char* err, int errlen) {
+  if (!p) {
+    set_err(err, errlen, "null poller");
+    return -1;
+  }
+  tpudev_chip_t chips[64];
+  int n = tpudev_enumerate(p->sysfs_root.c_str(), p->devfs_root.c_str(),
+                           chips, 64, err, errlen);
+  if (n < 0) return -1;
+
+  int emitted = 0;
+  auto emit = [&](const char* uuid, int kind, int code, const char* fmt,
+                  long long a, long long b) {
+    if (emitted >= max_out) return;
+    tpudev_health_event_t* e = &out[emitted++];
+    memset(e, 0, sizeof(*e));
+    e->kind = kind;
+    e->code = code;
+    snprintf(e->chip_uuid, sizeof(e->chip_uuid), "%s", uuid);
+    snprintf(e->message, sizeof(e->message), fmt, a, b);
+  };
+
+  std::vector<std::string> now_pci, now_uuid;
+  std::vector<std::vector<long long>> now_vals;
+  for (int i = 0; i < n; i++) {
+    std::string dev_dir =
+        p->sysfs_root + "/bus/pci/devices/" + chips[i].pci_address;
+    std::vector<long long> vals(kNumSources, -1);
+    vals[0] = read_aer_total(dev_dir + "/aer_dev_fatal");
+    vals[1] = read_aer_total(dev_dir + "/aer_dev_nonfatal");
+    for (size_t s = 0; s < 3; s++)
+      vals[2 + s] = read_counter(dev_dir + "/" + kCounterSources[s].file);
+
+    // diff against the previous poll for this pci address
+    for (size_t j = 0; j < p->seen_pci.size(); j++) {
+      if (p->seen_pci[j] != chips[i].pci_address) continue;
+      const std::vector<long long>& prev = p->last[j];
+      if (vals[0] >= 0 && prev[0] >= 0 && vals[0] > prev[0])
+        emit(chips[i].uuid, TPUDEV_HEALTH_DEVICE_ERROR, 1,
+             "PCIe AER fatal errors: %lld (+%lld)", vals[0],
+             vals[0] - prev[0]);
+      if (vals[1] >= 0 && prev[1] >= 0 && vals[1] > prev[1])
+        emit(chips[i].uuid, TPUDEV_HEALTH_DEVICE_ERROR, 2,
+             "PCIe AER nonfatal errors: %lld (+%lld)", vals[1],
+             vals[1] - prev[1]);
+      for (size_t s = 0; s < 3; s++) {
+        long long cur = vals[2 + s], pv = prev[2 + s];
+        if (cur >= 0 && pv >= 0 && cur > pv)
+          emit(chips[i].uuid, kCounterSources[s].kind,
+               kCounterSources[s].code, "counter: %lld (+%lld)", cur,
+               cur - pv);
+      }
+      break;
+    }
+    now_pci.push_back(chips[i].pci_address);
+    now_uuid.push_back(chips[i].uuid);
+    now_vals.push_back(vals);
+  }
+
+  // surprise removal: chip seen before, absent now. vfio flips keep the
+  // PCI function enumerable (only the driver changes), so absence means
+  // the function itself fell off the bus.
+  if (p->primed) {
+    for (size_t j = 0; j < p->seen_pci.size(); j++) {
+      bool found = false;
+      for (const auto& pci : now_pci)
+        if (pci == p->seen_pci[j]) { found = true; break; }
+      if (!found)
+        emit(p->seen_uuid[j].c_str(), TPUDEV_HEALTH_DEVICE_ERROR, 3,
+             "device no longer enumerable (surprise removal)%.0lld%.0lld",
+             0LL, 0LL);
+    }
+  }
+
+  p->seen_pci.swap(now_pci);
+  p->seen_uuid.swap(now_uuid);
+  p->last.swap(now_vals);
+  p->primed = true;
+  return emitted;
+}
+
 extern "C" const char* tpudev_version(void) { return kVersion; }

@@ -93,6 +93,54 @@ int tpudev_current_driver(const char* sysfs_root, const char* pci_address,
  * scans /proc/<pid>/fd). proc_root normally "/proc". */
 int tpudev_device_in_use(const char* proc_root, const char* devfs_path);
 
+/* ---- health events (reference analog: the NVML event set consumed by
+ * cmd/gpu-kubelet-plugin/device_health.go:30-351) -----------------------
+ *
+ * TPUs have no NVML event fd; the kernel-visible health surface is sysfs
+ * counters on the PCI function. The poller diffs them between calls:
+ *
+ *   - PCIe AER:  <pci>/aer_dev_fatal, <pci>/aer_dev_nonfatal (standard
+ *                kernel files, "NAME COUNT" lines; TOTAL_ERR_* preferred
+ *                when present) -> DEVICE_ERROR code 1 (fatal) / 2
+ *                (nonfatal). aer_dev_correctable is deliberately ignored
+ *                (the benign-XID skip-list analog).
+ *   - TPU driver counters (read when the accel driver exposes them on
+ *                the device dir): hbm_ecc_errors -> HBM_ECC,
+ *                ici_link_errors -> ICI_LINK,
+ *                thermal_throttle_events -> THERMAL.
+ *   - disappearance: a chip seen by an earlier poll that no longer
+ *                enumerates (and was not vfio-flipped by us) ->
+ *                DEVICE_ERROR code 3 ("surprise removal").
+ *
+ * The first poll establishes the baseline and reports nothing. */
+
+enum tpudev_health_kind {
+  TPUDEV_HEALTH_DEVICE_ERROR = 1,
+  TPUDEV_HEALTH_HBM_ECC = 2,
+  TPUDEV_HEALTH_ICI_LINK = 3,
+  TPUDEV_HEALTH_THERMAL = 4,
+};
+
+typedef struct {
+  int32_t kind;             /* tpudev_health_kind */
+  int32_t code;
+  char chip_uuid[96];
+  char message[160];
+} tpudev_health_event_t;
+
+typedef struct tpudev_health_poller tpudev_health_poller_t;
+
+tpudev_health_poller_t* tpudev_health_poller_new(const char* sysfs_root,
+                                                 const char* devfs_root);
+void tpudev_health_poller_free(tpudev_health_poller_t* p);
+
+/* Poll once. Returns the number of events written to out (<= max_out),
+ * or <0 on error. Counter deltas larger than the out capacity are
+ * coalesced into one event per (chip, source). */
+int tpudev_health_poll(tpudev_health_poller_t* p,
+                       tpudev_health_event_t* out, int max_out,
+                       char* err, int errlen);
+
 const char* tpudev_version(void);
 
 #ifdef __cplusplus

@@ -1,0 +1,257 @@
+"""GCE metadata-backed identity (tpulib/metadata.py): hardware-derived
+slice/worker identity with env as fallback, not source of truth.
+
+Reference bar: clique identity from the hardware probe
+(/root/reference/cmd/compute-domain-kubelet-plugin/nvlib.go:188-356).
+"""
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_dra_driver.tpulib.metadata import (
+    MetadataClient,
+    parse_tpu_env,
+)
+
+TPU_ENV_BLOB = """\
+ACCELERATOR_TYPE: 'v5p-16'
+CHIPS_PER_HOST_BOUNDS: '2,2,1'
+HOST_BOUNDS: '1,1,2'
+TPU_SLICE_ID: 'slice-cafe42'
+WORKER_ID: '1'
+"""
+
+ATTRS = {
+    "accelerator-type": "v5p-16",
+    "agent-worker-number": "1",
+    "worker-network-endpoints": "w0:uuid0:10.9.0.2,w1:uuid1:10.9.0.3",
+    "tpu-env": TPU_ENV_BLOB,
+}
+
+
+class FakeMetadataServer:
+    """The 169.254.169.254 surface, faithfully: Metadata-Flavor header
+    checked on requests and echoed on responses."""
+
+    def __init__(self, attrs=None):
+        attrs = ATTRS if attrs is None else attrs
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.headers.get("Metadata-Flavor") != "Google":
+                    self.send_response(403)
+                    self.end_headers()
+                    return
+                prefix = "/computeMetadata/v1/instance/attributes/"
+                body = None
+                if self.path == "/computeMetadata/v1/":
+                    body = "instance/\nproject/\n"
+                elif self.path.startswith(prefix):
+                    body = attrs.get(self.path[len(prefix):])
+                if body is None:
+                    self.send_response(404)
+                    self.send_header("Metadata-Flavor", "Google")
+                    self.end_headers()
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Metadata-Flavor", "Google")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server.daemon_threads = True
+        self.host = f"127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def metadata_server():
+    srv = FakeMetadataServer()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def no_tpu_env(monkeypatch):
+    """The VERDICT done-criterion: env vars UNSET, metadata authoritative."""
+    for var in ("TPU_ACCELERATOR_TYPE", "TPU_WORKER_ID", "TPU_SLICE_ID",
+                "GCE_METADATA_HOST"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_parse_tpu_env():
+    env = parse_tpu_env(TPU_ENV_BLOB)
+    assert env["ACCELERATOR_TYPE"] == "v5p-16"
+    assert env["WORKER_ID"] == "1"
+    assert env["TPU_SLICE_ID"] == "slice-cafe42"
+
+
+def test_client_reads_tpu_metadata(metadata_server, no_tpu_env):
+    md = MetadataClient(host=metadata_server.host).tpu_metadata()
+    assert md is not None
+    assert md.accelerator_type == "v5p-16"
+    assert md.worker_id == 1
+    assert md.worker_endpoints == ["10.9.0.2", "10.9.0.3"]
+    assert md.slice_id == "slice-cafe42"
+
+
+def test_client_rejects_wrong_flavor_and_absence(no_tpu_env):
+    # nothing listening -> unavailable, never raises
+    c = MetadataClient(host="127.0.0.1:1", timeout=0.2)
+    assert not c.available()
+    assert c.tpu_metadata() is None
+    assert c.instance_attribute("accelerator-type") is None
+
+
+def test_non_tpu_vm_returns_none(no_tpu_env):
+    srv = FakeMetadataServer(attrs={})   # CPU node: no TPU attributes
+    try:
+        assert MetadataClient(host=srv.host).tpu_metadata() is None
+    finally:
+        srv.stop()
+
+
+def test_env_override_points_client_at_fake(metadata_server, monkeypatch):
+    monkeypatch.setenv("GCE_METADATA_HOST", metadata_server.host)
+    md = MetadataClient().tpu_metadata()
+    assert md is not None and md.accelerator_type == "v5p-16"
+
+
+# ---------------------------------------------------------------------------
+# NativeTpuLib integration: metadata > env, env fallback intact
+# ---------------------------------------------------------------------------
+
+def _native_lib(tmp_path, **cfg_kw):
+    pytest.importorskip("ctypes")
+    from tests.test_native import _ensure_lib, _mk_sysfs
+    if not _ensure_lib():
+        pytest.skip("libtpudev.so unavailable")
+    from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
+    sysfs = _mk_sysfs(str(tmp_path / "sys"))
+    return NativeTpuLib(NativeSystemConfig(
+        sysfs_root=sysfs, devfs_root=str(tmp_path / "dev"),
+        proc_root=str(tmp_path / "proc"),
+        state_dir=str(tmp_path / "state"),
+        strict_vfio_verify=False, **cfg_kw))
+
+
+def test_native_lib_identity_from_metadata(tmp_path, metadata_server,
+                                           no_tpu_env):
+    lib = _native_lib(tmp_path, metadata_host=metadata_server.host)
+    assert lib.slice_id() == "slice-cafe42"
+    assert lib.host_topology().num_hosts == 2     # v5p-16 from metadata
+    assert lib._host_index == 1                   # agent-worker-number
+    lib.close()
+
+
+def test_native_lib_explicit_config_beats_metadata(tmp_path, metadata_server,
+                                                   no_tpu_env):
+    lib = _native_lib(tmp_path, metadata_host=metadata_server.host,
+                      accelerator_type="v5p-8", host_index=0,
+                      slice_id="operator-pinned")
+    assert lib.slice_id() == "operator-pinned"
+    assert lib.host_topology().num_hosts == 1
+    lib.close()
+
+
+def test_native_lib_env_fallback_without_metadata(tmp_path, monkeypatch,
+                                                  no_tpu_env):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_SLICE_ID", "env-slice")
+    lib = _native_lib(tmp_path, metadata_host="127.0.0.1:1")
+    assert lib.slice_id() == "env-slice"
+    assert lib._host_index == 1
+    lib.close()
+
+
+def test_daemon_clique_identity_from_metadata(tmp_path, metadata_server,
+                                              no_tpu_env):
+    """The CD daemon derives its clique id from the metadata-fed lib —
+    no TPU_* env anywhere (VERDICT r2 #4 done-criterion)."""
+    from tpu_dra_driver.computedomain.daemon.daemon import (
+        ComputeDomainDaemon,
+        DaemonConfig,
+    )
+    from tpu_dra_driver.kube.client import ClientSets
+    lib = _native_lib(tmp_path, metadata_host=metadata_server.host)
+    clients = ClientSets()
+    clients.compute_domains.create({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": "cd", "namespace": "ns", "uid": "cd-uid-1"},
+        "spec": {"numNodes": 2}})
+    clients.pods.create({"metadata": {"name": "pod-0",
+                                      "namespace": "tpu-dra-driver"}})
+    daemon = ComputeDomainDaemon(clients, lib, DaemonConfig(
+        cd_uid="cd-uid-1", cd_name="cd", cd_namespace="ns",
+        node_name="host-1", pod_name="pod-0", pod_ip="10.9.0.3",
+        hosts_file=str(tmp_path / "hosts"),
+        worker_env_file=str(tmp_path / "worker-env.json")))
+    daemon.start()
+    try:
+        cliques = clients.compute_domain_cliques.list()
+        assert len(cliques) == 1
+        # clique named <cdUID>.<cliqueID>; cliqueID == metadata slice id
+        assert cliques[0]["metadata"]["name"] == "cd-uid-1.slice-cafe42"
+    finally:
+        daemon.stop()
+        lib.close()
+
+
+def test_plugin_slices_carry_metadata_identity(tmp_path, metadata_server,
+                                               no_tpu_env):
+    """The TPU kubelet plugin publishes ResourceSlices whose device
+    attributes carry the metadata-derived slice id — env-free."""
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    lib = _native_lib(tmp_path, metadata_host=metadata_server.host)
+    clients = ClientSets()
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="host-1", state_dir=str(tmp_path / "plugin-state"),
+        cdi_root=str(tmp_path / "cdi")))
+    plugin.start()
+    try:
+        slices = clients.resource_slices.list()
+        assert slices
+        chips = [d for s in slices for d in s["spec"]["devices"]
+                 if d["attributes"].get("type", {}).get("string") == "chip"]
+        assert chips
+        assert all(d["attributes"]["sliceID"]["string"] == "slice-cafe42"
+                   for d in chips)
+    finally:
+        plugin.shutdown()
+        lib.close()
+
+
+def test_v5litepod_spelling_normalized(tmp_path, no_tpu_env):
+    """GCE reports v5e slices as 'v5litepod-N' — the exact spelling a
+    stock deployment sees; it must parse as v5e."""
+    from tpu_dra_driver.tpulib.topology import (
+        SliceTopology,
+        normalize_accelerator_type,
+    )
+    assert normalize_accelerator_type("v5litepod-16") == "v5e-16"
+    assert SliceTopology.from_accelerator_type("v5litepod-16").generation.name == "v5e"
+    srv = FakeMetadataServer(attrs={"accelerator-type": "v5litepod-16",
+                                    "agent-worker-number": "0"})
+    try:
+        md = MetadataClient(host=srv.host).tpu_metadata()
+        assert md.accelerator_type == "v5e-16"
+        lib = _native_lib(tmp_path, metadata_host=srv.host)
+        assert lib.host_topology().generation.name == "v5e"
+        lib.close()
+    finally:
+        srv.stop()

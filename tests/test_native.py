@@ -62,6 +62,7 @@ def native_lib(tmp_path):
     from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
     sysfs = _mk_sysfs(str(tmp_path / "sys"))
     lib = NativeTpuLib(NativeSystemConfig(
+        use_metadata=False,
         sysfs_root=sysfs,
         devfs_root=str(tmp_path / "dev"),
         proc_root=str(tmp_path / "proc"),
@@ -95,6 +96,7 @@ def test_native_generation_table(tmp_path):
     from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
     sysfs = _mk_sysfs(str(tmp_path / "sys"), n_chips=4, device_id="0x0063")
     lib = NativeTpuLib(NativeSystemConfig(
+        use_metadata=False,
         sysfs_root=sysfs, devfs_root=str(tmp_path / "dev"),
         state_dir=str(tmp_path / "ns"), accelerator_type="v5e-4"))
     chips = lib.enumerate_chips()
@@ -126,6 +128,7 @@ def test_native_partition_lifecycle_and_persistence(native_lib, tmp_path):
 
     # registry persists across process/library instances (crash recovery)
     lib2 = NativeTpuLib(NativeSystemConfig(
+        use_metadata=False,
         sysfs_root=native_lib._cfg.sysfs_root,
         devfs_root=native_lib._cfg.devfs_root,
         state_dir=native_lib._cfg.state_dir,
@@ -245,6 +248,7 @@ def test_native_stable_index_survives_vfio_flip(tmp_path):
     from tpu_dra_driver.tpulib.native import NativeSystemConfig, NativeTpuLib
     sysfs = _mk_sysfs(str(tmp_path / "sys"))
     cfg = NativeSystemConfig(
+        use_metadata=False,
         sysfs_root=sysfs, devfs_root=str(tmp_path / "dev"),
         state_dir=str(tmp_path / "state"), accelerator_type="v5p-8",
         strict_vfio_verify=False)
@@ -270,6 +274,7 @@ def test_native_registry_survives_spaces_in_devfs_path(tmp_path):
     from tpu_dra_driver.tpulib.partition import SubsliceProfile, SubsliceSpec
     sysfs = _mk_sysfs(str(tmp_path / "sys with space"))
     lib = NativeTpuLib(NativeSystemConfig(
+        use_metadata=False,
         sysfs_root=sysfs, devfs_root=str(tmp_path / "dev with space"),
         state_dir=str(tmp_path / "state"), accelerator_type="v5p-8",
         strict_vfio_verify=False))
@@ -298,3 +303,93 @@ def test_native_health_poller_survives_garbage_lines(native_lib):
         time.sleep(0.02)
     assert got and got[0].kind == HealthEventKind.DEVICE_ERROR
     assert "böse" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# native health poller (tpudev_health_poll): the NVML-event-set analog
+# (reference device_health.go:30-351) reading sysfs error counters
+# ---------------------------------------------------------------------------
+
+def _dev_dir(native_lib, chip):
+    return os.path.join(native_lib._cfg.sysfs_root, "bus/pci/devices",
+                        chip.pci_address)
+
+
+def test_native_health_aer_counters(native_lib):
+    from tpu_dra_driver.tpulib.interface import HealthEventKind
+    chips = native_lib.enumerate_chips()
+    for c in chips:   # counters exist before the baseline poll
+        open(os.path.join(_dev_dir(native_lib, c), "aer_dev_fatal"), "w").write(
+            "RxErr 0\nBadTLP 0\nTOTAL_ERR_FATAL 0\n")
+        open(os.path.join(_dev_dir(native_lib, c), "aer_dev_nonfatal"),
+             "w").write("TOTAL_ERR_NONFATAL 0\n")
+    poller = native_lib._native_health_poller()
+    assert poller is not None, "loaded libtpudev.so lacks the health API"
+    assert native_lib._poll_native_health(poller) == []   # baseline primes
+    assert native_lib._poll_native_health(poller) == []   # steady state
+    victim = chips[1]
+    open(os.path.join(_dev_dir(native_lib, victim), "aer_dev_fatal"),
+         "w").write("RxErr 1\nBadTLP 0\nTOTAL_ERR_FATAL 2\n")
+    events = native_lib._poll_native_health(poller)
+    assert len(events) == 1
+    assert events[0].kind == HealthEventKind.DEVICE_ERROR
+    assert events[0].code == 1
+    assert events[0].chip_uuid == victim.uuid
+    assert "+2" in events[0].message
+    # delta consumed: next poll is quiet again
+    assert native_lib._poll_native_health(poller) == []
+
+
+def test_native_health_driver_counters(native_lib):
+    from tpu_dra_driver.tpulib.interface import HealthEventKind
+    chips = native_lib.enumerate_chips()
+    d = _dev_dir(native_lib, chips[0])
+    open(os.path.join(d, "hbm_ecc_errors"), "w").write("0\n")
+    open(os.path.join(d, "ici_link_errors"), "w").write("5\n")
+    open(os.path.join(d, "thermal_throttle_events"), "w").write("0\n")
+    poller = native_lib._native_health_poller()
+    assert native_lib._poll_native_health(poller) == []
+    open(os.path.join(d, "hbm_ecc_errors"), "w").write("3\n")
+    open(os.path.join(d, "ici_link_errors"), "w").write("6\n")
+    events = native_lib._poll_native_health(poller)
+    kinds = sorted(e.kind.value for e in events)
+    assert kinds == ["HbmEccError", "IciLinkError"]
+    assert all(e.chip_uuid == chips[0].uuid for e in events)
+
+
+def test_native_health_surprise_removal(native_lib):
+    import shutil as _shutil
+    from tpu_dra_driver.tpulib.interface import HealthEventKind
+    chips = native_lib.enumerate_chips()
+    poller = native_lib._native_health_poller()
+    assert native_lib._poll_native_health(poller) == []
+    victim = chips[-1]
+    _shutil.rmtree(_dev_dir(native_lib, victim))
+    events = native_lib._poll_native_health(poller)
+    assert len(events) == 1
+    assert events[0].kind == HealthEventKind.DEVICE_ERROR
+    assert events[0].code == 3
+    assert events[0].chip_uuid == victim.uuid
+    assert native_lib._poll_native_health(poller) == []   # reported once
+
+
+def test_native_health_thread_publishes_sysfs_events(native_lib):
+    """End-to-end through subscribe_health: the background thread reads
+    the native poller and publishes to subscribers (spool not involved)."""
+    import time
+    from tpu_dra_driver.tpulib.interface import HealthEventKind
+    chip = native_lib.enumerate_chips()[0]
+    d = _dev_dir(native_lib, chip)
+    open(os.path.join(d, "hbm_ecc_errors"), "w").write("0\n")
+    got = []
+    # production paces native polls at 5s to keep sysfs churn low; the
+    # test shrinks it (instance attr shadows the class constant)
+    native_lib.NATIVE_HEALTH_POLL_INTERVAL = 0.2
+    native_lib.subscribe_health(got.append)
+    time.sleep(0.5)   # let the thread take its baseline
+    open(os.path.join(d, "hbm_ecc_errors"), "w").write("7\n")
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and not got:
+        time.sleep(0.02)
+    assert got and got[0].kind == HealthEventKind.HBM_ECC_ERROR
+    assert got[0].chip_uuid == chip.uuid

@@ -1,0 +1,151 @@
+"""GCE metadata-server client: hardware-derived slice/worker identity.
+
+Reference analog: the clique-identity probe in
+cmd/compute-domain-kubelet-plugin/nvlib.go:188-356, which asks the
+*hardware* (NVML fabric info) rather than trusting deployment env. On a
+real TPU VM the authoritative identity source is the GCE metadata server
+(169.254.169.254 / metadata.google.internal): the TPU control plane
+publishes the accelerator type, this VM's worker number, and the
+slice-wide worker endpoints as instance attributes, plus a ``tpu-env``
+attribute carrying the libtpu bootstrap env block.
+
+Resolution order used by :class:`NativeTpuLib`: explicit config >
+metadata server > ``TPU_*`` env vars > derived defaults — so operators
+can still hand-feed identity (air-gapped bring-up, tests), but a stock
+GKE/GCE deployment needs nothing.
+
+Override knobs (also the test seam): ``GCE_METADATA_HOST`` (the
+convention Google client libraries honor) points the client at a fake
+server; no env var and no reachable server -> ``available()`` is False
+and everything degrades to the env/default path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_HOST = "169.254.169.254"
+_ATTR_BASE = "/computeMetadata/v1/instance/attributes/"
+
+
+@dataclass
+class TpuMetadata:
+    """What the metadata server knows about this worker's slice."""
+
+    accelerator_type: str = ""          # e.g. "v5p-16"
+    worker_id: Optional[int] = None     # this host's index in the slice
+    worker_endpoints: List[str] = field(default_factory=list)  # peer IPs
+    slice_id: str = ""                  # from tpu-env (MEGASCALE/SLICE id)
+    tpu_env: Dict[str, str] = field(default_factory=dict)
+
+
+def parse_tpu_env(blob: str) -> Dict[str, str]:
+    """The ``tpu-env`` attribute is a newline-separated KEY: 'value'
+    block (YAML-ish, values may be quoted)."""
+    out: Dict[str, str] = {}
+    for line in blob.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or ":" not in line:
+            continue
+        k, _, v = line.partition(":")
+        v = v.strip().strip("'\"")
+        out[k.strip()] = v
+    return out
+
+
+class MetadataClient:
+    """Minimal metadata-server client (requests-based, no SDK)."""
+
+    def __init__(self, host: Optional[str] = None, timeout: float = 0.5,
+                 probe_attempts: int = 3):
+        self._host = (host or os.environ.get("GCE_METADATA_HOST")
+                      or DEFAULT_HOST)
+        if "://" not in self._host:
+            self._host = f"http://{self._host}"
+        self._timeout = timeout
+        self._probe_attempts = max(1, probe_attempts)
+        self._mu = threading.Lock()
+        self._available: Optional[bool] = None
+
+    def available(self) -> bool:
+        """Cached reachability probe (the canonical flavor check). The
+        metadata server can be briefly unreachable during VM boot
+        (Google client libraries retry for exactly this reason), so the
+        first determination retries before caching a negative — a wrong
+        "unavailable" here silently degrades identity to env/inference."""
+        with self._mu:
+            if self._available is not None:
+                return self._available
+        import time
+
+        import requests
+        ok = False
+        for attempt in range(self._probe_attempts):
+            try:
+                resp = requests.get(f"{self._host}/computeMetadata/v1/",
+                                    headers={"Metadata-Flavor": "Google"},
+                                    timeout=self._timeout)
+                ok = (resp.status_code == 200
+                      and resp.headers.get("Metadata-Flavor") == "Google")
+                if ok:
+                    break
+            except requests.RequestException:
+                ok = False
+            if attempt + 1 < self._probe_attempts:
+                time.sleep(0.3)
+        with self._mu:
+            self._available = ok
+        return ok
+
+    def instance_attribute(self, name: str) -> Optional[str]:
+        if not self.available():
+            return None
+        import requests
+        try:
+            resp = requests.get(f"{self._host}{_ATTR_BASE}{name}",
+                                headers={"Metadata-Flavor": "Google"},
+                                timeout=self._timeout)
+            if resp.status_code == 200:
+                return resp.text
+        except requests.RequestException as e:
+            log.warning("metadata attribute %s: %s", name, e)
+        return None
+
+    def tpu_metadata(self) -> Optional[TpuMetadata]:
+        """None when no metadata server is reachable or the VM carries no
+        TPU attributes (a CPU node in the same pool)."""
+        if not self.available():
+            return None
+        accel = self.instance_attribute("accelerator-type") or ""
+        worker = self.instance_attribute("agent-worker-number")
+        endpoints_raw = self.instance_attribute("worker-network-endpoints") or ""
+        tpu_env = parse_tpu_env(self.instance_attribute("tpu-env") or "")
+        if not accel and not tpu_env:
+            return None
+        # worker-network-endpoints entries are ":"-separated records whose
+        # last field is the worker IP
+        endpoints = [e.rsplit(":", 1)[-1].strip()
+                     for e in endpoints_raw.split(",") if e.strip()]
+        worker_id: Optional[int] = None
+        if worker is not None and worker.strip().isdigit():
+            worker_id = int(worker.strip())
+        elif tpu_env.get("WORKER_ID", "").isdigit():
+            worker_id = int(tpu_env["WORKER_ID"])
+        slice_id = (tpu_env.get("MEGASCALE_SLICE_ID")
+                    or tpu_env.get("TPU_SLICE_ID")
+                    or tpu_env.get("SLICE_ID", ""))
+        if not accel:
+            accel = tpu_env.get("ACCELERATOR_TYPE", "")
+        # GCE reports v5e as "v5litepod-N" etc.; canonicalize here so every
+        # consumer sees the driver's grammar
+        from tpu_dra_driver.tpulib.topology import normalize_accelerator_type
+        accel = normalize_accelerator_type(accel) if accel else accel
+        return TpuMetadata(accelerator_type=accel, worker_id=worker_id,
+                           worker_endpoints=endpoints, slice_id=slice_id,
+                           tpu_env=tpu_env)

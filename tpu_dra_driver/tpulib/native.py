@@ -13,8 +13,12 @@ seam and fills in what sysfs cannot know:
   configuration on TPU, not ioctls: they're recorded in the state dir and
   take effect through the CDI env the driver injects (the nvidia-smi
   compute-policy analog);
-- **health events** arrive on a JSONL spool file the node's monitoring
-  agent (or libtpu wrapper) appends to; a poll thread publishes them.
+- **health events** come from the native poller in ``libtpudev``
+  (``tpudev_health_poll``: PCIe AER fatal/nonfatal counters, TPU driver
+  error counters on the PCI device dir, surprise-removal detection — the
+  NVML-event-set analog, device_health.go:30-351). A JSONL spool file
+  remains as the secondary *injection* path for tests and external
+  monitoring agents.
 """
 
 from __future__ import annotations
@@ -81,6 +85,23 @@ class _PartStruct(ctypes.Structure):
     ]
 
 
+class _HealthEventStruct(ctypes.Structure):
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("code", ctypes.c_int32),
+        ("chip_uuid", ctypes.c_char * 96),
+        ("message", ctypes.c_char * 160),
+    ]
+
+
+_HEALTH_KIND_BY_CODE = {
+    1: HealthEventKind.DEVICE_ERROR,
+    2: HealthEventKind.HBM_ECC_ERROR,
+    3: HealthEventKind.ICI_LINK_ERROR,
+    4: HealthEventKind.THERMAL,
+}
+
+
 def _default_library_paths() -> List[str]:
     here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     return [
@@ -119,6 +140,11 @@ class NativeSystemConfig:
     slice_id: Optional[str] = None           # default: $TPU_SLICE_ID or derived
     health_spool: Optional[str] = None       # default: <state_dir>/health-events.jsonl
     library_path: Optional[str] = None
+    # GCE metadata server: the authoritative identity source on real TPU
+    # VMs (tpulib/metadata.py). None -> GCE_METADATA_HOST env or the
+    # well-known 169.254.169.254; use_metadata=False skips the probe.
+    metadata_host: Optional[str] = None
+    use_metadata: bool = True
     # verify vfio flips actually took effect against the kernel; test
     # harnesses with inert (no-kernel) sysfs trees disable this
     strict_vfio_verify: bool = True
@@ -142,9 +168,36 @@ class NativeTpuLib(TpuLib):
         self._driver_version = self._lib.tpudev_version().decode()
         self._chips_cache: Optional[List[ChipInfo]] = None
 
+        # Identity resolution: explicit config > GCE metadata server >
+        # TPU_* env > inference/defaults (reference analog: clique id from
+        # the hardware probe, nvlib.go:188-356 — env is the fallback, not
+        # the source of truth).
+        md = None
+        if self._cfg.use_metadata and not (
+                self._cfg.accelerator_type is not None
+                and self._cfg.host_index is not None
+                and self._cfg.slice_id is not None):
+            from tpu_dra_driver.tpulib.metadata import MetadataClient
+            import logging
+            md = MetadataClient(host=self._cfg.metadata_host).tpu_metadata()
+            if md is not None:
+                logging.getLogger(__name__).info(
+                    "identity from GCE metadata: accel=%s worker=%s slice=%s",
+                    md.accelerator_type, md.worker_id, md.slice_id)
+            elif not os.environ.get("TPU_ACCELERATOR_TYPE"):
+                # No metadata AND no env: identity will be inferred from
+                # local chips (single-host assumption). Wrong on a
+                # multi-host slice whose metadata server was unreachable
+                # at boot — shout about it.
+                logging.getLogger(__name__).warning(
+                    "no GCE metadata server and no TPU_* env: inferring "
+                    "single-host identity from local chips; on a "
+                    "multi-host slice this publishes WRONG topology")
+
         accel = (self._cfg.accelerator_type
+                 or (md.accelerator_type if md else None)
                  or os.environ.get("TPU_ACCELERATOR_TYPE"))
-        if accel is None:
+        if not accel:
             # single-host default: infer from the number of local chips
             raw = self._enumerate_raw()
             if not raw:
@@ -155,10 +208,13 @@ class NativeTpuLib(TpuLib):
             accel = f"{gen.name}-{len(raw) * gen.cores_per_chip}"
         self._topo = SliceTopology.from_accelerator_type(accel)
         hi = self._cfg.host_index
+        if hi is None and md is not None:
+            hi = md.worker_id
         if hi is None:
             hi = int(os.environ.get("TPU_WORKER_ID", "0"))
         self._host_index = hi
         self._slice_id = (self._cfg.slice_id
+                          or (md.slice_id if md else None)
                           or os.environ.get("TPU_SLICE_ID")
                           or f"slice-{accel}")
 
@@ -425,7 +481,7 @@ class NativeTpuLib(TpuLib):
         raise TpuLibError(f"no chip with index {index}")
 
     # ------------------------------------------------------------------
-    # health: JSONL spool poller
+    # health: native sysfs poller (primary) + JSONL spool (injection)
     # ------------------------------------------------------------------
 
     @property
@@ -443,14 +499,65 @@ class NativeTpuLib(TpuLib):
                 self._health_thread.start()
         return unsub
 
+    def _native_health_poller(self):
+        """Create the C-side poller; None when the loaded .so predates the
+        health API (binding stays compatible with older builds)."""
+        if not hasattr(self._lib, "tpudev_health_poll"):
+            return None
+        self._lib.tpudev_health_poller_new.restype = ctypes.c_void_p
+        return self._lib.tpudev_health_poller_new(
+            self._cfg.sysfs_root.encode(), self._cfg.devfs_root.encode())
+
+    def _poll_native_health(self, poller) -> List[HealthEvent]:
+        out = (_HealthEventStruct * 64)()
+        err = self._err()
+        n = self._lib.tpudev_health_poll(ctypes.c_void_p(poller), out, 64,
+                                         err, len(err))
+        if n < 0:
+            raise TpuLibError(f"health poll: {err.value.decode()}")
+        return [HealthEvent(
+                    kind=_HEALTH_KIND_BY_CODE.get(
+                        e.kind, HealthEventKind.DEVICE_ERROR),
+                    chip_uuid=e.chip_uuid.decode(),
+                    code=e.code,
+                    message=e.message.decode())
+                for e in out[:n]]
+
+    # The native poll re-enumerates the PCI bus and reads per-chip counter
+    # files; the counters are cumulative so nothing is lost by polling
+    # slowly. The spool tail is cheap (one open+seek) and is the
+    # low-latency injection seam, so it keeps the tight cadence.
+    NATIVE_HEALTH_POLL_INTERVAL = 5.0
+    SPOOL_POLL_INTERVAL = 0.2
+
     def _poll_health(self) -> None:
         import logging
+        import time as _time
         log = logging.getLogger(__name__)
-        while not self._health_stop.wait(0.2):
+        poller = self._native_health_poller()
+        next_native = 0.0   # first pass primes the native baseline
+        while not self._health_stop.wait(self.SPOOL_POLL_INTERVAL):
             # The poller must survive anything — a dead health thread means
             # degraded-device handling silently stops for the process
-            # lifetime. Binary mode so offsets are byte-exact even with
-            # multibyte messages or partially-written lines.
+            # lifetime.
+            # Primary source: the native sysfs poller (AER + TPU driver
+            # counters + surprise removal), the NVML event-set analog.
+            if poller is not None and _time.monotonic() >= next_native:
+                next_native = _time.monotonic() + self.NATIVE_HEALTH_POLL_INTERVAL
+                try:
+                    for event in self._poll_native_health(poller):
+                        try:
+                            self._health.publish(event)
+                        except Exception:
+                            log.exception("health subscriber failed for %s",
+                                          event)
+                except Exception:
+                    log.exception("native health poll failed")
+            # Secondary: the JSONL spool — the injection seam for tests
+            # and for external monitoring agents that see signals sysfs
+            # cannot (libtpu runtime errors, maintenance notices). Binary
+            # mode so offsets are byte-exact even with multibyte messages
+            # or partially-written lines.
             try:
                 with open(self.health_spool_path, "rb") as f:
                     f.seek(self._health_offset)
@@ -479,6 +586,8 @@ class NativeTpuLib(TpuLib):
                 pass
             except Exception:
                 log.exception("health spool poll failed")
+        if poller is not None:
+            self._lib.tpudev_health_poller_free(ctypes.c_void_p(poller))
 
     def close(self) -> None:
         self._health_stop.set()

@@ -58,6 +58,24 @@ GENERATIONS: Dict[str, Generation] = {
 
 _SLICE_NAME_RE = re.compile(r"^(?P<gen>v[0-9]+[ep]?)-(?P<cores>[0-9]+)$")
 
+# GCE metadata / gcloud spellings -> canonical generation names. The
+# metadata server reports v5e slices as "v5litepod-N" (and v5p existed
+# briefly as "v5pod-N"); the driver speaks the canonical short form.
+_GEN_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v5pod": "v5p",
+}
+
+
+def normalize_accelerator_type(accel_type: str) -> str:
+    """Map GCE spellings onto the canonical ``v<gen>-<cores>`` grammar."""
+    accel_type = accel_type.strip()
+    gen, sep, cores = accel_type.partition("-")
+    if sep and gen in _GEN_ALIASES:
+        return f"{_GEN_ALIASES[gen]}-{cores}"
+    return accel_type
+
 
 @dataclass(frozen=True)
 class SliceTopology:
@@ -76,6 +94,7 @@ class SliceTopology:
 
     @classmethod
     def from_accelerator_type(cls, accel_type: str) -> "SliceTopology":
+        accel_type = normalize_accelerator_type(accel_type)
         m = _SLICE_NAME_RE.match(accel_type)
         if not m:
             raise ValueError(f"unparseable accelerator type {accel_type!r}")
