@@ -67,17 +67,23 @@ class KubeletReplay:
     def __init__(self, registry_dir: str):
         self.registry_dir = registry_dir
 
-    def discover_socket(self, driver_name: str, timeout: float = 30.0) -> str:
-        """The plugin watcher role: wait for <driver>-reg.sock to appear."""
-        sock = os.path.join(self.registry_dir, f"{driver_name}-reg.sock")
+    def discover_socket(self, driver_name: str, timeout: float = 30.0,
+                        instance_uid: str = "") -> str:
+        """The plugin watcher role: wait for the registration socket to
+        appear — ``<driver>-reg.sock``, or ``<driver>-<uid>-reg.sock``
+        when the plugin runs in rolling-update mode."""
+        uid_part = f"-{instance_uid}" if instance_uid else ""
+        sock = os.path.join(self.registry_dir,
+                            f"{driver_name}{uid_part}-reg.sock")
         wait_for(lambda: os.path.exists(sock), timeout,
                  f"registration socket {sock}")
         return sock
 
-    def register(self, driver_name: str,
-                 timeout: float = 30.0) -> reg_pb.PluginInfo:
+    def register(self, driver_name: str, timeout: float = 30.0,
+                 instance_uid: str = "") -> reg_pb.PluginInfo:
         """GetInfo → validate → NotifyRegistrationStatus(registered)."""
-        sock = self.discover_socket(driver_name, timeout)
+        sock = self.discover_socket(driver_name, timeout,
+                                    instance_uid=instance_uid)
         channel = grpc.insecure_channel(f"unix://{sock}")
         get_info = channel.unary_unary(
             f"/{REGISTRATION_SERVICE}/GetInfo",

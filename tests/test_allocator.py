@@ -147,9 +147,11 @@ def test_cel_unsupported_term_fails_loudly(tmp_path):
         "metadata": {"name": "cel3", "namespace": "ns", "uid": "u-cel3"},
         "spec": {"devices": {"requests": [{
             "name": "tpu",
+            # CEL macros are outside the evaluator's subset: the allocator
+            # must refuse rather than silently (mis)match
             "selectors": [{"cel": {"expression":
-                'device.capacity["tpu.google.com"].memory > 1'}}],
+                'device.attributes["tpu.google.com"].exists(a, a == "x")'}}],
         }]}},
     })
-    with pt.raises(AllocationError, match="unsupported CEL"):
+    with pt.raises(AllocationError, match="selector"):
         Allocator(clients).allocate("cel3", "ns")

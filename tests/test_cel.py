@@ -1,0 +1,194 @@
+"""The in-process allocator's CEL subset (kube/cel.py): every selector
+shipped in the chart and the controller's claim templates, plus the
+shapes users realistically write (||, !, parentheses, `in`), with
+fail-loud behavior for genuinely unsupported CEL (VERDICT r2 #8)."""
+
+import os
+
+import pytest
+import yaml
+
+from tpu_dra_driver.kube import cel
+from tpu_dra_driver.kube.allocator import AllocationError, _eval_cel, _matches
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHIP = {
+    "name": "tpu-0",
+    "attributes": {
+        "type": {"string": "chip"},
+        "generation": {"string": "v5p"},
+        "cores": {"int": 2},
+        "sliceID": {"string": "slice-a"},
+        "healthy": {"bool": True},
+    },
+    "capacity": {"hbm": {"value": 95}},
+}
+CHANNEL0 = {
+    "name": "channel-0",
+    "attributes": {"type": {"string": "channel"}, "id": {"int": 0}},
+}
+DAEMON = {"name": "daemon", "attributes": {"type": {"string": "daemon"}}}
+
+TPU = "tpu.google.com"
+CD = "compute-domain.tpu.google.com"
+
+
+def ev(dev, driver, expr):
+    return _eval_cel(dev, driver, expr)
+
+
+# ---------------------------------------------------------------------------
+# every selector actually shipped must evaluate (the VERDICT done-bar)
+# ---------------------------------------------------------------------------
+
+def _shipped_expressions():
+    out = []
+    dc_path = os.path.join(
+        REPO, "deployments/helm/tpu-dra-driver/templates/deviceclasses.yaml")
+    raw = open(dc_path).read()
+    # strip helm templating lines; selectors carry no templating
+    raw = "\n".join(line for line in raw.splitlines() if "{{" not in line)
+    for doc in yaml.safe_load_all(raw):
+        if not doc:
+            continue
+        for sel in (doc.get("spec") or {}).get("selectors") or []:
+            out.append(("deviceclass:" + doc["metadata"]["name"],
+                        sel["cel"]["expression"]))
+    for tmpl in ("compute-domain-workload-claim-template.tmpl.yaml",
+                 "compute-domain-daemon-claim-template.tmpl.yaml"):
+        text = open(os.path.join(REPO, "templates", tmpl)).read()
+        text = (text.replace("${DRIVER_NAME}", CD)
+                    .replace("${DAEMON_DEVICE_CLASS}", "x")
+                    .replace("${CHANNEL_DEVICE_CLASS}", "x"))
+        for doc in yaml.safe_load_all(text):
+            spec = ((doc.get("spec") or {}).get("spec") or {})
+            for req in (spec.get("devices") or {}).get("requests") or []:
+                for sel in req.get("selectors") or []:
+                    out.append((tmpl, sel["cel"]["expression"]))
+    return out
+
+
+@pytest.mark.parametrize("source,expr", _shipped_expressions())
+def test_every_shipped_selector_evaluates(source, expr):
+    for dev, driver in ((CHIP, TPU), (CHANNEL0, CD), (DAEMON, CD)):
+        result = ev(dev, driver, expr)      # must not raise
+        assert isinstance(result, bool)
+
+
+def test_shipped_selectors_match_their_devices():
+    chip_sel = ('device.driver == "tpu.google.com" && '
+                'device.attributes["tpu.google.com"].type == "chip"')
+    assert ev(CHIP, TPU, chip_sel)
+    assert not ev(CHANNEL0, CD, chip_sel)
+    chan_sel = (f'device.driver == "{CD}" && '
+                f'device.attributes["{CD}"].type == "channel" && '
+                f'device.attributes["{CD}"].id == 0')
+    assert ev(CHANNEL0, CD, chan_sel)
+    assert not ev(DAEMON, CD, chan_sel)
+
+
+# ---------------------------------------------------------------------------
+# the extended subset
+# ---------------------------------------------------------------------------
+
+def test_disjunction():
+    expr = (f'device.attributes["{TPU}"].type == "chip" || '
+            f'device.attributes["{TPU}"].type == "subslice"')
+    assert ev(CHIP, TPU, expr)
+    assert not ev(dict(CHIP, attributes={"type": {"string": "vfio"}}),
+                  TPU, expr)
+
+
+def test_parentheses_and_precedence():
+    # || binds looser than &&: a && b || c  ==  (a && b) || c
+    expr = (f'device.attributes["{TPU}"].type == "chip" && '
+            f'device.attributes["{TPU}"].cores > 4 || '
+            f'device.attributes["{TPU}"].generation == "v5p"')
+    assert ev(CHIP, TPU, expr)       # rhs of || carries it
+    grouped = (f'device.attributes["{TPU}"].type == "chip" && '
+               f'(device.attributes["{TPU}"].cores > 4 || '
+               f'device.attributes["{TPU}"].generation == "v5p")')
+    assert ev(CHIP, TPU, grouped)
+    assert not ev(CHIP, TPU, grouped.replace("v5p", "v4"))
+
+
+def test_in_operator():
+    assert ev(CHIP, TPU,
+              f'device.attributes["{TPU}"].generation in ["v5p", "v6e"]')
+    assert not ev(CHIP, TPU,
+                  f'device.attributes["{TPU}"].generation in ["v4", "v5e"]')
+    assert ev(CHIP, TPU, f'device.attributes["{TPU}"].cores in [1, 2]')
+
+
+def test_negation_and_bool_attr():
+    assert ev(CHIP, TPU, f'device.attributes["{TPU}"].healthy')
+    assert not ev(CHIP, TPU, f'!device.attributes["{TPU}"].healthy')
+    assert ev(CHIP, TPU, f'!(device.attributes["{TPU}"].type == "vfio")')
+
+
+def test_ordered_comparisons_and_capacity():
+    assert ev(CHIP, TPU, f'device.attributes["{TPU}"].cores >= 2')
+    assert not ev(CHIP, TPU, f'device.attributes["{TPU}"].cores > 2')
+    assert ev(CHIP, TPU, f'device.capacity["{TPU}"].hbm > 90')
+
+
+def test_missing_attribute_is_no_match_not_error():
+    assert not ev(CHIP, TPU, f'device.attributes["{TPU}"].nope == "x"')
+    # wrong domain == missing map key on a real scheduler
+    assert not ev(CHIP, TPU,
+                  'device.attributes["other.example.com"].type == "chip"')
+    assert not ev(CHIP, TPU, f'device.attributes["{TPU}"].nope in ["x"]')
+
+
+def test_missing_propagates_like_a_cel_error():
+    """A missing map key is a CEL runtime error: it propagates through
+    != and !, and only && with false / || with true absorb it — so a
+    negative selector over an absent attribute must NOT match everything
+    (the real scheduler would not match the device)."""
+    miss = f'device.attributes["{TPU}"].nope'
+    assert not ev(CHIP, TPU, f'{miss} != "x"')
+    assert not ev(CHIP, TPU, f'!({miss} == "x")')
+    assert not ev(CHIP, TPU,
+                  'device.attributes["typo.domain"].type != "chip"')
+    # absorption: false && error -> false (still no match), true || error
+    # -> true (match)
+    assert ev(CHIP, TPU,
+              f'device.attributes["{TPU}"].type == "chip" || {miss} == "x"')
+    assert not ev(CHIP, TPU,
+                  f'device.attributes["{TPU}"].type == "vfio" && {miss} == "x"')
+    # error && true -> error -> no match
+    assert not ev(CHIP, TPU,
+                  f'{miss} == "x" && device.attributes["{TPU}"].type == "chip"')
+
+
+def test_quoted_literal_containing_and_operator():
+    # the old textual && split choked on this; the tokenizer must not
+    assert not ev(CHIP, TPU,
+                  f'device.attributes["{TPU}"].generation == "a && b"')
+
+
+def test_unsupported_constructs_fail_loud():
+    for expr in (
+        'device.attributes["x"].y.exists(z, z == 1)',   # macro
+        "1 + 2 == 3",                                   # arithmetic
+        'device.driver == "a" ? true : false',          # ternary
+        "cel.bind(x, 1, x)",                            # function call
+        "device.allAttributes",                         # unknown field
+        'device.attributes["x"]',                       # bare map access
+    ):
+        with pytest.raises(AllocationError):
+            ev(CHIP, TPU, expr)
+
+
+def test_non_boolean_result_fails_loud():
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f'device.attributes["{TPU}"].cores')
+
+
+def test_matches_integration():
+    sel = [{"cel": {"expression":
+            f'device.attributes["{TPU}"].type in ["chip", "subslice"] || '
+            f'device.attributes["{TPU}"].cores > 100'}}]
+    assert _matches(CHIP, sel, driver=TPU)
+    assert not _matches(DAEMON, sel, driver=TPU)

@@ -41,6 +41,10 @@ def build_parser() -> EnvArgumentParser:
                    choices=["native", "fake"])
     p.add_argument("--accelerator-type", env="TPU_ACCELERATOR_TYPE", default="")
     p.add_argument("--health-port", env="HEALTH_PORT", type=int, default=51516)
+    p.add_argument("--rolling-update-uid", env="POD_UID", default="",
+                   help="pod UID (downward API); unique-per-instance "
+                        "socket names for gap-free DaemonSet rolling "
+                        "updates (kubelet >= 1.33)")
     return p
 
 
@@ -61,9 +65,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         prepare_budget=args.prepare_budget))
     plugin.start()
 
-    dra_sock = f"unix://{args.state_dir}/dra.sock"
+    uid_part = (f"-{args.rolling_update_uid}" if args.rolling_update_uid
+                else "")
+    dra_sock = f"unix://{args.state_dir}/dra{uid_part}.sock"
     reg_sock = (f"unix://{args.plugin_registry}/"
-                f"{COMPUTE_DOMAIN_DRIVER_NAME}-reg.sock")
+                f"{COMPUTE_DOMAIN_DRIVER_NAME}{uid_part}-reg.sock")
     server = DraGrpcServer(
         plugin, clients.resource_claims, COMPUTE_DOMAIN_DRIVER_NAME,
         dra_address=dra_sock, registration_address=reg_sock)

@@ -44,6 +44,11 @@ def build_parser() -> EnvArgumentParser:
                    help="fake runs hardware-free (demo/CI)")
     p.add_argument("--accelerator-type", env="TPU_ACCELERATOR_TYPE", default="")
     p.add_argument("--health-port", env="HEALTH_PORT", type=int, default=51515)
+    p.add_argument("--rolling-update-uid", env="POD_UID", default="",
+                   help="pod UID (downward API); when set, socket names "
+                        "are unique per instance so a DaemonSet rolling "
+                        "update never drops registration (reference "
+                        "kubeletplugin RollingUpdate; kubelet >= 1.33)")
     p.add_argument("--http-endpoint", env="HTTP_ENDPOINT", default="",
                    help="host:port for /metrics (dra_claim_* histograms), "
                         "/healthz and /debug/threads; empty disables")
@@ -96,8 +101,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         slice_layout=args.slice_layout, gates=parse_gates(args)))
     plugin.start()
 
-    dra_sock = f"unix://{args.state_dir}/dra.sock"
-    reg_sock = f"unix://{args.plugin_registry}/{DRIVER_NAME}-reg.sock"
+    # Rolling update: unique-per-instance socket names (dra-<uid>.sock /
+    # <driver>-<uid>-reg.sock, the reference helper's exact naming,
+    # draplugin.go:560-574) let old and new DaemonSet pods serve
+    # simultaneously; kubelet registers both and the prepare window never
+    # gaps. Cross-instance safety comes from the node-global pu.lock/
+    # cp.lock flocks the prepare path already takes (the serialize.lock
+    # analog).
+    uid_part = (f"-{args.rolling_update_uid}" if args.rolling_update_uid
+                else "")
+    dra_sock = f"unix://{args.state_dir}/dra{uid_part}.sock"
+    reg_sock = (f"unix://{args.plugin_registry}/"
+                f"{DRIVER_NAME}{uid_part}-reg.sock")
     server = DraGrpcServer(plugin, clients.resource_claims, DRIVER_NAME,
                            dra_address=dra_sock,
                            registration_address=reg_sock)

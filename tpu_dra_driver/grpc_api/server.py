@@ -20,6 +20,7 @@ unprepare_resource_claims), which is what unit tests drive directly.
 from __future__ import annotations
 
 import logging
+import os
 from concurrent import futures
 from typing import Callable, Dict, List, Optional
 
@@ -247,7 +248,15 @@ class DraGrpcServer:
             self.supported_versions.append(DRA_HEALTH_SERVICE)
         self._server.add_generic_rpc_handlers(tuple(handlers))
         self._reg_server = None
+        # Socket files this instance owns. A cleanly-stopping instance must
+        # remove them: during a rolling update (unique-per-pod socket
+        # names, reference kubeletplugin RollingUpdate option) the NEW
+        # instance cannot remove the old one's sockets, and a stale
+        # registration socket would keep kubelet dialing a dead endpoint.
+        self._socket_paths: List[str] = []
         self.dra_port = self._server.add_insecure_port(dra_address)
+        if dra_address.startswith("unix://"):
+            self._socket_paths.append(dra_address[len("unix://"):])
         if registration_address is not None:
             endpoint_path = (dra_address[len("unix://"):]
                              if dra_address.startswith("unix://")
@@ -260,6 +269,9 @@ class DraGrpcServer:
             ))
             self.registration_port = self._reg_server.add_insecure_port(
                 registration_address)
+            if registration_address.startswith("unix://"):
+                self._socket_paths.append(
+                    registration_address[len("unix://"):])
 
     def _plugin_healthy(self) -> bool:
         if hasattr(self._plugin, "healthy"):
@@ -275,6 +287,11 @@ class DraGrpcServer:
         self._server.stop(grace)
         if self._reg_server is not None:
             self._reg_server.stop(grace)
+        for path in self._socket_paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 class DraGrpcClient:

@@ -4,11 +4,13 @@ The reference relies on the real scheduler's DRA allocator; hardware-free
 testing here needs the same behavior in-process: satisfy ResourceClaim
 device requests against published ResourceSlices, honoring
 
-- request selectors, in BOTH wire forms: real restricted-CEL expressions
-  (what the chart's DeviceClasses and the controller's claim templates
-  actually ship — conjunctions of ==/!=/</> over device.driver and
-  device.attributes) and the legacy simple attribute matchers used by
-  older tests,
+- request selectors, in BOTH wire forms: real CEL expressions evaluated
+  by the recursive-descent subset in ``kube/cel.py`` (||, &&, !,
+  parentheses, ``in``, comparisons over device.driver /
+  device.attributes / device.capacity — everything the chart's
+  DeviceClasses and the controller's claim templates ship, fail-loud on
+  the rest) and the legacy simple attribute matchers used by older
+  tests,
 - exact counts,
 - **KEP-4815 shared counters**: a device can be allocated only if its
   ``consumesCounters`` fit within its CounterSet's remaining capacity
@@ -25,7 +27,6 @@ Numeric counter values are compared as integers.
 
 from __future__ import annotations
 
-import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -46,58 +47,35 @@ def _attr_value(dev: Dict, name: str):
     return None
 
 
-# Restricted CEL: conjunctions of comparisons over device.driver and
-# device.attributes["<ns>"].<name> — the subset the chart's DeviceClasses
-# and the controller's claim templates use ON THE WIRE (the real
-# scheduler evaluates full CEL; this keeps the in-process allocator able
-# to honor the exact selectors shipped to real clusters). Known
-# restriction: the conjunction split is textual, so a quoted literal
-# containing "&&" is rejected (fail-loud) even though real CEL accepts
-# it — none of the shipped selectors carry one.
-_CEL_TERM = re.compile(
-    r'^\s*device\.(?:'
-    r'(?P<drv>driver)'
-    r'|attributes\["(?P<ns>[^"]+)"\]\.(?P<attr>\w+)'
-    r')\s*(?P<op>==|!=|>=|<=|>|<)\s*(?P<lit>"[^"]*"|-?\d+|true|false)\s*$')
-
-
-def _cel_literal(tok: str):
-    if tok.startswith('"'):
-        return tok[1:-1]
-    if tok in ("true", "false"):
-        return tok == "true"
-    return int(tok)
-
-
 def _eval_cel(dev: Dict, driver: str, expression: str) -> bool:
-    for term in expression.split("&&"):
-        m = _CEL_TERM.match(term)
-        if not m:
-            raise AllocationError(
-                f"unsupported CEL term {term.strip()!r} (the in-process "
-                f"allocator evaluates conjunctions of ==/!=/</> over "
-                f"device.driver and device.attributes)")
-        lit = _cel_literal(m.group("lit"))
-        if m.group("drv"):
-            v = driver
-        else:
-            # qualified attributes resolve within their domain; a
-            # different domain than the publishing driver's is a miss on
-            # a real scheduler (missing map key) — mirror that instead of
-            # silently matching mistyped templates
-            if driver and m.group("ns") != driver:
-                return False
-            v = _attr_value(dev, m.group("attr"))
-        op = m.group("op")
-        ok = ((op == "==" and v == lit) or (op == "!=" and v != lit)
-              or (op in (">", ">=", "<", "<=")
-                  and isinstance(v, int) and isinstance(lit, int)
-                  and ((op == ">" and v > lit) or (op == ">=" and v >= lit)
-                       or (op == "<" and v < lit)
-                       or (op == "<=" and v <= lit))))
-        if not ok:
-            return False
-    return True
+    """Evaluate a selector with the recursive-descent CEL subset
+    (kube/cel.py: ||, &&, !, parentheses, `in`, comparisons). Unsupported
+    constructs fail loud — a selector the allocator cannot faithfully
+    evaluate must never silently match or mismatch."""
+    from tpu_dra_driver.kube import cel
+
+    def resolver(section: str, domain: str, name: str):
+        if section == "driver":
+            return driver
+        # qualified attributes resolve within their domain; a different
+        # domain than the publishing driver's is a missing map key on a
+        # real scheduler — mirror that instead of silently matching
+        # mistyped templates
+        if driver and domain != driver:
+            return cel.MISSING
+        if section == "attributes":
+            v = _attr_value(dev, name)
+            return cel.MISSING if v is None else v
+        # capacity values are quantities; the driver publishes plain ints
+        v = (dev.get("capacity") or {}).get(name)
+        if isinstance(v, dict):
+            v = v.get("value")
+        return cel.MISSING if v is None else v
+
+    try:
+        return cel.evaluate(expression, resolver)
+    except (cel.CelUnsupportedError, cel.CelEvalError) as e:
+        raise AllocationError(f"selector {expression!r}: {e}") from e
 
 
 def _matches(dev: Dict, selectors: List[Dict], driver: str = "") -> bool:

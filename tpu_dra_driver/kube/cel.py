@@ -1,0 +1,295 @@
+"""A small recursive-descent CEL evaluator for DRA device selectors.
+
+The real scheduler evaluates full CEL against each device
+(k8s.io/dynamic-resource-allocation/cel); the in-process allocator (the
+scheduler stand-in for tests, demos, and the sim e2e suite) needs to
+honor the same selectors that ship in `deviceclasses.yaml` and the
+controller's claim templates — plus the shapes users realistically
+write: `||`, `!`, parentheses, `in` over list literals.
+
+Supported grammar (fail-loud `CelUnsupportedError` on anything else, so
+a selector the allocator cannot faithfully evaluate never silently
+matches or mismatches):
+
+    expr   := or
+    or     := and ( "||" and )*
+    and    := unary ( "&&" unary )*
+    unary  := "!" unary | cmp
+    cmp    := operand ( ("=="|"!="|">="|"<="|">"|"<") operand
+                       | "in" list )?
+    operand:= literal | path | "(" expr ")"
+    path   := "device" "." "driver"
+            | "device" "." ("attributes"|"capacity") "[" string "]"
+              "." ident
+    list   := "[" ( literal ( "," literal )* )? "]"
+    literal:= string | int | "true" | "false"
+
+Semantics follow the scheduler where the driver depends on them:
+attribute domains resolve within the publishing driver's domain; a
+qualified domain that is not the device's driver yields a *missing*
+value. Missing propagates the way a CEL runtime error does: through
+comparisons (including ``!=``), ``in``, and ``!``; it is absorbed by
+``&&`` when the other side is false and by ``||`` when the other side
+is true (CEL's commutative short-circuit); a missing overall result
+means the device does not match.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, NamedTuple, Optional
+
+# Sentinel for "attribute absent / wrong domain" — the public name is the
+# resolver contract (allocator.py returns it); it behaves like a CEL
+# runtime error during evaluation.
+MISSING = object()
+_MISSING = MISSING
+
+
+class CelUnsupportedError(ValueError):
+    """The expression uses CEL the in-process allocator does not speak."""
+
+
+class CelEvalError(ValueError):
+    """The expression parsed but evaluated to something non-boolean."""
+
+
+class _Tok(NamedTuple):
+    kind: str     # op | ident | str | int
+    value: Any
+
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<op>\|\||&&|==|!=|>=|<=|[!><()\[\],.])
+    | (?P<str>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+    | (?P<int>-?\d+)
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    )""", re.X)
+
+
+def _tokenize(src: str) -> List[_Tok]:
+    toks: List[_Tok] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m or m.end() == pos:
+            rest = src[pos:].strip()
+            if not rest:
+                break
+            raise CelUnsupportedError(f"unsupported CEL at {rest[:40]!r}")
+        pos = m.end()
+        if m.group("op"):
+            toks.append(_Tok("op", m.group("op")))
+        elif m.group("str") is not None:
+            raw = m.group("str")
+            body = raw[1:-1]
+            body = re.sub(r"\\(.)", r"\1", body)
+            toks.append(_Tok("str", body))
+        elif m.group("int") is not None:
+            toks.append(_Tok("int", int(m.group("int"))))
+        else:
+            toks.append(_Tok("ident", m.group("ident")))
+    return toks
+
+
+# resolver(section, domain, name) -> value or _MISSING.
+# section: "driver" (domain/name empty), "attributes", "capacity".
+Resolver = Callable[[str, str, str], Any]
+
+
+class _Parser:
+    def __init__(self, toks: List[_Tok], resolver: Resolver):
+        self.toks = toks
+        self.i = 0
+        self.resolve = resolver
+
+    def peek(self) -> Optional[_Tok]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> _Tok:
+        tok = self.peek()
+        if tok is None:
+            raise CelUnsupportedError("unexpected end of expression")
+        self.i += 1
+        return tok
+
+    def expect_op(self, op: str) -> None:
+        tok = self.next()
+        if tok.kind != "op" or tok.value != op:
+            raise CelUnsupportedError(f"expected {op!r}, got {tok.value!r}")
+
+    # -- grammar -----------------------------------------------------------
+
+    def parse(self) -> Any:
+        val = self.or_expr()
+        if self.peek() is not None:
+            raise CelUnsupportedError(
+                f"trailing tokens from {self.peek().value!r}")
+        return val
+
+    def or_expr(self) -> Any:
+        val = self.and_expr()
+        while self._at_op("||"):
+            self.next()
+            rhs = self.and_expr()   # evaluation is pure; combine after
+            # CEL's commutative ||: true absorbs an error on either side
+            a, b = self._boolish(val), self._boolish(rhs)
+            if a is True or b is True:
+                val = True
+            elif a is _MISSING or b is _MISSING:
+                val = _MISSING
+            else:
+                val = False
+        return val
+
+    def and_expr(self) -> Any:
+        val = self.unary()
+        while self._at_op("&&"):
+            self.next()
+            rhs = self.unary()
+            # CEL's commutative &&: false absorbs an error on either side
+            a, b = self._boolish(val), self._boolish(rhs)
+            if a is False or b is False:
+                val = False
+            elif a is _MISSING or b is _MISSING:
+                val = _MISSING
+            else:
+                val = True
+        return val
+
+    def unary(self) -> Any:
+        if self._at_op("!"):
+            self.next()
+            val = self._boolish(self.unary())
+            return _MISSING if val is _MISSING else not val
+        return self.cmp()
+
+    def cmp(self) -> Any:
+        lhs = self.operand()
+        tok = self.peek()
+        if tok is None:
+            return lhs
+        if tok.kind == "op" and tok.value in ("==", "!=", ">", "<", ">=", "<="):
+            op = self.next().value
+            rhs = self.operand()
+            return self._compare(op, lhs, rhs)
+        if tok.kind == "ident" and tok.value == "in":
+            self.next()
+            items = self.list_literal()
+            return _MISSING if lhs is _MISSING else lhs in items
+        return lhs
+
+    def operand(self) -> Any:
+        tok = self.peek()
+        if tok is None:
+            raise CelUnsupportedError("unexpected end of expression")
+        if tok.kind == "op" and tok.value == "(":
+            self.next()
+            val = self.or_expr()
+            self.expect_op(")")
+            return val
+        if tok.kind in ("str", "int"):
+            return self.next().value
+        if tok.kind == "ident":
+            if tok.value == "true":
+                self.next()
+                return True
+            if tok.value == "false":
+                self.next()
+                return False
+            if tok.value == "device":
+                return self.device_path()
+            raise CelUnsupportedError(f"unsupported identifier {tok.value!r}")
+        raise CelUnsupportedError(f"unsupported token {tok.value!r}")
+
+    def device_path(self) -> Any:
+        self.next()              # device
+        self.expect_op(".")
+        field = self.next()
+        if field.kind != "ident":
+            raise CelUnsupportedError(f"expected field after device., got "
+                                      f"{field.value!r}")
+        if field.value == "driver":
+            return self.resolve("driver", "", "")
+        if field.value in ("attributes", "capacity"):
+            self.expect_op("[")
+            domain = self.next()
+            if domain.kind != "str":
+                raise CelUnsupportedError(
+                    "expected quoted domain in device."
+                    f"{field.value}[...], got {domain.value!r}")
+            self.expect_op("]")
+            self.expect_op(".")
+            name = self.next()
+            if name.kind != "ident":
+                raise CelUnsupportedError(
+                    f"expected attribute name, got {name.value!r}")
+            return self.resolve(field.value, domain.value, name.value)
+        raise CelUnsupportedError(f"unsupported device field "
+                                  f"{field.value!r}")
+
+    def list_literal(self) -> List[Any]:
+        self.expect_op("[")
+        items: List[Any] = []
+        if self._at_op("]"):
+            self.next()
+            return items
+        while True:
+            tok = self.next()
+            if tok.kind in ("str", "int"):
+                items.append(tok.value)
+            elif tok.kind == "ident" and tok.value in ("true", "false"):
+                items.append(tok.value == "true")
+            else:
+                raise CelUnsupportedError(
+                    f"unsupported list element {tok.value!r}")
+            nxt = self.next()
+            if nxt.kind == "op" and nxt.value == "]":
+                return items
+            if not (nxt.kind == "op" and nxt.value == ","):
+                raise CelUnsupportedError(f"expected , or ] in list, got "
+                                          f"{nxt.value!r}")
+
+    # -- helpers -----------------------------------------------------------
+
+    def _at_op(self, op: str) -> bool:
+        tok = self.peek()
+        return tok is not None and tok.kind == "op" and tok.value == op
+
+    @staticmethod
+    def _boolish(val: Any) -> Any:
+        """True / False / _MISSING; anything else is a type error."""
+        if val is _MISSING or isinstance(val, bool):
+            return val
+        raise CelEvalError(f"expected boolean, got {val!r}")
+
+    @staticmethod
+    def _compare(op: str, lhs: Any, rhs: Any) -> Any:
+        if lhs is _MISSING or rhs is _MISSING:
+            # a CEL runtime error (missing map key) propagates through
+            # every comparison, != included
+            return _MISSING
+        if op == "==":
+            return lhs == rhs
+        if op == "!=":
+            return lhs != rhs
+        if not (isinstance(lhs, int) and not isinstance(lhs, bool)
+                and isinstance(rhs, int) and not isinstance(rhs, bool)):
+            raise CelUnsupportedError(
+                f"ordered comparison needs ints, got {lhs!r} {op} {rhs!r}")
+        return {"<": lhs < rhs, "<=": lhs <= rhs,
+                ">": lhs > rhs, ">=": lhs >= rhs}[op]
+
+
+def evaluate(expression: str, resolver: Resolver) -> bool:
+    """Evaluate a selector expression to a boolean. Raises
+    CelUnsupportedError (construct outside the subset) or CelEvalError
+    (non-boolean result)."""
+    result = _Parser(_tokenize(expression), resolver).parse()
+    if result is _MISSING:
+        return False
+    if not isinstance(result, bool):
+        raise CelEvalError(
+            f"selector evaluated to non-boolean {result!r}")
+    return result
