@@ -132,18 +132,21 @@ class PluginProcess:
     """One production binary under test, with captured logs."""
 
     def __init__(self, name: str, argv: List[str], log_path: str,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None):
         self.name = name
         self.log_path = log_path
         self._log = open(log_path, "ab")
         full_env = dict(os.environ)
-        full_env["PYTHONPATH"] = REPO_ROOT
+        full_env["PYTHONPATH"] = cwd or REPO_ROOT
         full_env.pop("KUBERNETES_SERVICE_HOST", None)
         if env:
             full_env.update(env)
+        # cwd matters: `python -m` puts it first on sys.path, so running
+        # an older checked-out tree requires pointing cwd at it
         self.proc = subprocess.Popen(
             [sys.executable, "-u"] + argv, stdout=self._log,
-            stderr=subprocess.STDOUT, env=full_env, cwd=REPO_ROOT)
+            stderr=subprocess.STDOUT, env=full_env, cwd=cwd or REPO_ROOT)
 
     @property
     def alive(self) -> bool:
@@ -221,7 +224,10 @@ class SimNode:
         return env
 
     def spawn_tpu_plugin(self, extra_args: Optional[List[str]] = None,
-                         tag: str = "") -> PluginProcess:
+                         tag: str = "",
+                         cwd: Optional[str] = None) -> PluginProcess:
+        """``cwd`` selects the source tree to execute (an older checkout
+        for up/downgrade tests); defaults to this repo."""
         argv = ["-m", "tpu_dra_driver.cmd.tpu_kubelet_plugin",
                 "--node-name", self.node_name,
                 "--state-dir", self.state_dir,
@@ -236,7 +242,7 @@ class SimNode:
         p = PluginProcess(
             f"tpu-plugin-{self.node_name}{tag}", argv,
             os.path.join(self.log_dir, f"tpu-plugin{tag}.log"),
-            env=self.fake_env())
+            env=self.fake_env(), cwd=cwd)
         self.processes.append(p)
         return p
 
