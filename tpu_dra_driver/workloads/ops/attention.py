@@ -98,7 +98,7 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_kv: int, causal: bool,
                   num_super: int, emit_lse: bool = True, window=None,
-                  row_offset: int = 0, prefix=None):
+                  row_offset: int = 0, prefix=None, kv_first=None):
     """One (batch*kv-head, q-group, q-block, kv-superblock) grid cell.
 
     GQA: the grid's axis 1 walks the query heads sharing this cell's KV
@@ -128,6 +128,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     row_min = row_offset + qi * block_q
     row_max = row_min + block_q - 1            # last causal-visible column
     d = q_ref.shape[1]
+    # Banded grid remap (window): the innermost axis walks only the
+    # num_super superblocks this q block's band can touch; the K/V
+    # index_map fetched superblock kv_first(qi)+sj, so column
+    # coordinates use the ABSOLUTE index. kv_first is the SAME closure
+    # the wrapper's BlockSpec index_map uses (_window_super_first) — one
+    # formula, no mirror to desynchronize.
+    sj_abs = sj if kv_first is None else kv_first(qi) + sj
 
     def steps(carry):
         """Online-softmax over this superblock's causal prefix.
@@ -139,6 +146,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         q = q_ref[:]                                             # [bq, d]
 
         def body(j2, carry, masked):
+            # masked: None (band interior, no mask math at all), "diag"
+            # (causal compare only), "edge" (window compare only + the
+            # empty-row zeroing), or "both" (all terms — the fallback
+            # for narrow windows and prefix-LM)
             acc, m, l = carry
             # matmul operands stay in the input dtype (bf16 on TPU) so
             # the MXU runs at full rate; accumulation is f32. The
@@ -156,25 +167,28 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                 # instead of two full [bq, bkv] tiles
                 row_ids = row_min + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, 1), 0)
-                col_ids = (sj * super_kv + j2 * block_kv
+                col_ids = (sj_abs * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (1, block_kv), 1))
-                vis = row_ids >= col_ids
-                if window is not None:
-                    vis &= row_ids - col_ids < window
-                if prefix is not None:
-                    vis |= col_ids < prefix
-                s = jnp.where(vis, s, NEG_INF)
+                if masked == "diag":
+                    vis = row_ids >= col_ids
+                elif masked == "edge":
+                    vis = row_ids - col_ids < window
+                else:
+                    vis = row_ids >= col_ids
+                    if window is not None:
+                        vis &= row_ids - col_ids < window
+                    if prefix is not None:
+                        vis |= col_ids < prefix
+                # fill strictly below the m-init sentinel (2x NEG_INF):
+                # a fully-masked row keeps m_new == NEG_INF and every
+                # masked entry computes exp2(fill - m_new) ==
+                # exp2(NEG_INF) == 0 — no explicit p-zeroing select
+                # needed for empty-band rows (one [bq,bkv] VPU select
+                # per edge tile saved)
+                s = jnp.where(vis, s, 2 * NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp2(s - m_new)
-            if masked and window is not None:
-                # a row with NO visible entry in its first processed
-                # block has m == m_new == NEG_INF and exp2(0) == 1 for
-                # every (masked!) entry — zero them explicitly so such
-                # rows contribute nothing (reachable with small windows;
-                # without a window every row's first block has a visible
-                # column, so plain causal skips this select)
-                p = jnp.where(vis, p, 0.0)
             alpha = jnp.exp2(m - m_new)
             l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             pv = jax.lax.dot_general(                            # [bq, d]
@@ -185,15 +199,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
         if not causal:
             return jax.lax.fori_loop(
-                0, nb, functools.partial(body, masked=False), carry)
+                0, nb, functools.partial(body, masked=None), carry)
         lower, full_lo, full_hi, upper = _kv_band_bounds(
-            row_min, row_max, sj * super_kv, block_kv, nb, window, prefix)
+            row_min, row_max, sj_abs * super_kv, block_kv, nb, window, prefix)
+        # Mask specialization (masked tiles are the VPU-bound part of a
+        # banded walk): band-edge blocks ([lower, full_lo)) sit at cols
+        # <= row_min by construction (full_lo <= full_hi), so they never
+        # need the causal compare; diagonal blocks ([full_hi, upper))
+        # stay within the window whenever window >= block_q + block_kv,
+        # dropping the window compare AND the p-zeroing select there.
+        edge_mode = "edge" if window is not None else "both"
+        diag_mode = "diag" if prefix is None and (
+            window is None or window >= block_q + block_kv) else "both"
         carry = jax.lax.fori_loop(
-            lower, full_lo, functools.partial(body, masked=True), carry)
+            lower, full_lo, functools.partial(body, masked=edge_mode), carry)
         carry = jax.lax.fori_loop(
-            full_lo, full_hi, functools.partial(body, masked=False), carry)
+            full_lo, full_hi, functools.partial(body, masked=None), carry)
         return jax.lax.fori_loop(
-            full_hi, upper, functools.partial(body, masked=True), carry)
+            full_hi, upper, functools.partial(body, masked=diag_mode), carry)
 
     def finish(carry):
         acc, m, l = carry
@@ -209,12 +232,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                      jnp.full((block_q, 1), NEG_INF, jnp.float32),
                      jnp.zeros((block_q, 1), jnp.float32))
 
-    live = True if not causal else (sj * super_kv <= row_max)
+    live = True if not causal else (sj_abs * super_kv <= row_max)
     if causal and window is not None:
-        live &= (sj * super_kv + super_kv - 1
+        live &= (sj_abs * super_kv + super_kv - 1
                  >= row_min - window + 1)
     if causal and prefix is not None:
-        live |= sj * super_kv < prefix
+        live |= sj_abs * super_kv < prefix
     _grid_accumulate(num_super, sj, live, steps, finish,
                      (acc_sc, m_sc, l_sc), zeros)
 
@@ -258,6 +281,37 @@ def _kv_band_bounds(row_min, row_max, base, block_kv, nb, window,
 # each, 4 MB with double buffering — comfortably inside 16 MB alongside
 # the q/o blocks and f32 scratch.
 _SUPER_KV = 4096
+
+
+def _window_super(window, block_kv: int) -> int:
+    """Superblock size request. Measured on v5e (t=16k, w=2048): keeping
+    the large superblock and remapping the grid beats shrinking the
+    superblock to hug the band — fewer grid steps (scratch round-trips,
+    DMA setups) outweigh the extra fetched columns (53 vs 45 TFLOP/s for
+    super 4096/1024)."""
+    return _SUPER_KV if window is None else max(block_kv, _SUPER_KV)
+
+
+def _window_super_first(window, prefix, row_offset: int, block_q: int,
+                        super_kv: int, num_super_total: int):
+    """(n_live, kv_first) for the banded grid remap: how many
+    superblocks one q block's walk visits, and the K/V index-map offset.
+    Identity walk unless a window (sans prefix — prefix cols break band
+    locality) bounds the band to fewer superblocks than the total."""
+    if window is None or prefix is not None:
+        return num_super_total, lambda qi: 0
+    n_live = min(num_super_total, (window + block_q - 2) // super_kv + 2)
+    if n_live == num_super_total:
+        return num_super_total, lambda qi: 0
+
+    def kv_first(qi):
+        # clamped so first + n_live never walks past the end: early q
+        # blocks visit trailing dead superblocks (skipped via pl.when)
+        # instead of duplicating fetched tiles
+        return jnp.clip(
+            (row_offset + qi * block_q - window + 1) // super_kv,
+            0, num_super_total - n_live)
+    return n_live, kv_first
 
 
 def _fit_block(req: int, t: int) -> int:
@@ -365,11 +419,19 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     if prefix is not None and window is not None:
         raise ValueError("prefix and window are mutually exclusive")
     h_kv, group = _gqa_group(q, k)
-    super_kv = _fit_block(_SUPER_KV, tkv)
+    super_kv = _fit_block(_window_super(window, block_kv), tkv)
     block_q = _fit_block(block_q, t)
     block_kv = _fit_block(block_kv, super_kv)
     sm_scale = 1.0 / math.sqrt(d)
-    num_super = tkv // super_kv
+    num_super_total = tkv // super_kv
+    # Banded (sliding-window) grid remap: each q block's band touches at
+    # most n_live consecutive superblocks — walking (and DMAing!) all of
+    # them made long-context windowed attention HBM-bound (pl.when skips
+    # compute but the BlockSpec copy still runs: at t=16k/w=2048 ~60% of
+    # K/V DMA was dead → 39 TFLOP/s). The K/V index_map offsets the walk
+    # to the band's first superblock instead.
+    num_super, kv_first = _window_super_first(
+        window, prefix, row_offset, block_q, super_kv, num_super_total)
 
     # fold sm_scale * LOG2E into q once (f32 multiply, cast back): the
     # kernels then run base-2 softmax on raw dot products
@@ -382,7 +444,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_kv=block_kv,
         causal=causal, num_super=num_super, emit_lse=with_lse,
-        window=window, row_offset=row_offset, prefix=prefix)
+        window=window, row_offset=row_offset, prefix=prefix,
+        kv_first=None if num_super == num_super_total else kv_first)
 
     vmem = {"memory_space": pltpu.VMEM}
 
@@ -400,9 +463,11 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
             pl.BlockSpec((None, None, block_q, d),
                          lambda i, g, qi, j: (i, g, qi, 0), **vmem),
             pl.BlockSpec((None, super_kv, d),
-                         lambda i, g, qi, j: (i, j, 0), **vmem),
+                         lambda i, g, qi, j: (i, kv_first(qi) + j, 0),
+                         **vmem),
             pl.BlockSpec((None, super_kv, d),
-                         lambda i, g, qi, j: (i, j, 0), **vmem),
+                         lambda i, g, qi, j: (i, kv_first(qi) + j, 0),
+                         **vmem),
         ],
         out_specs=(o_spec, lse_spec) if with_lse else o_spec,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
