@@ -264,6 +264,17 @@ def bench_accelerator() -> dict:
                 f"{fl['flash_attn_long_ctx_tflops']:.2f} TFLOP/s "
                 f"({fl['shape']}, {fl['long_ctx_step_ms']:.1f} ms/step; "
                 f"the [t,t] reference OOMs at this length)")
+            from tpu_dra_driver.workloads.ops.attention import (
+                flash_attention_long_context_train_tflops,
+            )
+            flt = flash_attention_long_context_train_tflops()
+            out["flash_attn_long_ctx_train_tflops"] = round(
+                flt["flash_attn_long_ctx_train_tflops"], 2)
+            log(f"  sliding-window long context fwd+bwd: "
+                f"{flt['flash_attn_long_ctx_train_tflops']:.2f} TFLOP/s "
+                f"({flt['shape']}, "
+                f"{flt['long_ctx_train_step_ms']:.1f} ms/step — the "
+                f"banded grid remap applies to all three kernels)")
             from tpu_dra_driver.workloads.models import (
                 ModelConfig, decode_tokens_per_sec,
             )

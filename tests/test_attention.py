@@ -229,6 +229,49 @@ def test_flash_chunked_causal_row_offset(window):
                                    atol=5e-4, rtol=5e-4)
 
 
+def test_flash_windowed_row_offset_with_remap(monkeypatch):
+    """The banded grid remap under chunked-causal offsets: row_offset
+    enters kv_first (fwd/dq) and q_first (dkv) — with _SUPER_KV shrunk
+    so all three remaps are ACTIVE (n_live < num_super_total), a sign or
+    off-by-one in the offset arithmetic produces wrong output/grads
+    here and nowhere else in the suite."""
+    import tpu_dra_driver.workloads.ops.attention as A
+    monkeypatch.setattr(A, "_SUPER_KV", 64)
+    key = jax.random.PRNGKey(33)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h, t, d, w = 1, 2, 512, 32, 96
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, h, t, d))
+    v = jax.random.normal(kv, (b, h, t, d))
+    off, tq = 128, 384          # chunk long enough that BOTH backward
+                                # remaps activate (dkv walks tq/64=6 > 4)
+    qc = q[:, :, off:off + tq]
+
+    # remaps really active at these shapes (guards against the test
+    # silently degrading to the identity walk)
+    ns_fwd, _ = A._window_super_first(w, None, off, 64, 64, t // 64)
+    ns_dkv, _ = A._window_super_first_q(w, None, off, 64, 64, tq // 64)
+    assert ns_fwd < t // 64 and ns_dkv < tq // 64
+
+    full = attention_reference(q, k, v, True, window=w)
+    out = flash_attention(qc, k, v, True, 64, 64, window=w,
+                          row_offset=off)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(full[:, :, off:off + tq]),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(
+        lambda qc, k, v: (flash_attention(
+            qc, k, v, True, 64, 64, window=w, row_offset=off) ** 2).sum(),
+        argnums=(0, 1, 2))(qc, k, v)
+    gr = jax.grad(
+        lambda qc, k, v: (attention_reference(
+            qc, k, v, True, window=w, row_offset=off) ** 2).sum(),
+        argnums=(0, 1, 2))(qc, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=5e-4)
+
+
 @pytest.mark.parametrize("window", [10, 32, 100, 256])
 def test_ring_attention_sliding_window(window):
     """Windowed ring attention: hops beyond ceil((window-1)/t_local) are

@@ -314,6 +314,23 @@ def _window_super_first(window, prefix, row_offset: int, block_q: int,
     return n_live, kv_first
 
 
+def _window_super_first_q(window, prefix, row_offset: int, block_kv: int,
+                          super_q: int, num_super_total: int):
+    """The dkv transpose of :func:`_window_super_first`: kv block kj is
+    seen by global rows [kj*block_kv, kj*block_kv + block_kv + window - 2]
+    — (n_live, q_first) bound the q-superblock walk to that span."""
+    if window is None or prefix is not None:
+        return num_super_total, lambda kj: 0
+    n_live = min(num_super_total, (window + block_kv - 2) // super_q + 2)
+    if n_live == num_super_total:
+        return num_super_total, lambda kj: 0
+
+    def q_first(kj):
+        return jnp.clip((kj * block_kv - row_offset) // super_q,
+                        0, num_super_total - n_live)
+    return n_live, q_first
+
+
 def _fit_block(req: int, t: int) -> int:
     """Largest divisor of t not exceeding the requested block, so any
     reasonable t works with the (tuned, large) defaults. A t whose only
@@ -485,7 +502,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                          dq_ref, acc_sc, *, block_q: int, block_kv: int,
                          causal: bool, num_super: int,
-                         window=None, row_offset: int = 0, prefix=None):
+                         window=None, row_offset: int = 0, prefix=None,
+                         kv_first=None):
     """dq for one (batch*kv-head, q-group, q-block, kv-superblock) cell.
 
     P is rebuilt from (q, k, lse); dS = P * (dP - D); dq = sum_j dS @ K_j
@@ -500,6 +518,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
     nb = super_kv // block_kv
     row_min = row_offset + qi * block_q
     row_max = row_min + block_q - 1
+    # banded grid remap: same closure as the K/V BlockSpec index_map
+    sj_abs = sj if kv_first is None else kv_first(qi) + sj
 
     def steps(acc0):
         # base-2 softmax: p = exp(s - lse) == exp2(s*log2e - lse*log2e)
@@ -515,7 +535,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
             if masked:
                 row_ids = row_min + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, 1), 0)
-                col_ids = (sj * super_kv + j2 * block_kv
+                col_ids = (sj_abs * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (1, block_kv), 1))
                 vis = row_ids >= col_ids
@@ -538,7 +558,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
             return jax.lax.fori_loop(
                 0, nb, functools.partial(body, masked=False), acc0)
         lower, full_lo, full_hi, upper = _kv_band_bounds(
-            row_min, row_max, sj * super_kv, block_kv, nb, window, prefix)
+            row_min, row_max, sj_abs * super_kv, block_kv, nb, window, prefix)
         acc0 = jax.lax.fori_loop(
             lower, full_lo, functools.partial(body, masked=True), acc0)
         acc0 = jax.lax.fori_loop(
@@ -551,12 +571,12 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
     def finish(carry):
         dq_ref[:] = carry[0].astype(dq_ref.dtype)
 
-    live = True if not causal else (sj * super_kv <= row_max)
+    live = True if not causal else (sj_abs * super_kv <= row_max)
     if causal and window is not None:
-        live &= (sj * super_kv + super_kv - 1
+        live &= (sj_abs * super_kv + super_kv - 1
                  >= row_min - window + 1)
     if causal and prefix is not None:
-        live |= sj * super_kv < prefix
+        live |= sj_abs * super_kv < prefix
     _grid_accumulate(
         num_super, sj, live,
         steps=lambda carry: (steps(carry[0]),),
@@ -569,7 +589,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                           dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
                           block_kv: int, causal: bool,
                           num_super: int, group: int, window=None,
-                          row_offset: int = 0, prefix=None):
+                          row_offset: int = 0, prefix=None, q_first=None):
     """dk/dv for one (batch*kv-head, kv-block, q-group, q-superblock) cell.
 
     dv = sum_i P_i^T @ dO_i; dk = sum_i dS_i^T @ Q_i * scale. The q axis
@@ -584,6 +604,9 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
     super_q = q_ref.shape[0]
     nb = super_q // block_q
     kv_start = kj * block_kv
+    # banded grid remap (transpose of the forward's): the q-superblock
+    # walk is offset by the same closure the Q/dO/lse/dD index_maps use
+    si_abs = si if q_first is None else q_first(kj) + si
 
     def steps(carry):
         kb = k_ref[:]
@@ -599,7 +622,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                 qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             if masked:
-                row_ids = (row_offset + si * super_q + i2 * block_q
+                row_ids = (row_offset + si_abs * super_q + i2 * block_q
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (block_q, 1), 0))
                 col_ids = kv_start + jax.lax.broadcasted_iota(
@@ -633,8 +656,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
         # mask-free iff every row >= this kv block's last column and,
         # with a window, every row < first column + window. Row
         # coordinates are global (row_offset + local) — the superblock's
-        # local origin si * super_q shifts by row_offset.
-        q0 = row_offset + si * super_q          # first global row here
+        # local origin si_abs * super_q shifts by row_offset.
+        q0 = row_offset + si_abs * super_q          # first global row here
         lower = jnp.maximum(0, (kv_start - q0) // block_q)
         first_full = jnp.clip(
             -(-(kv_start + block_kv - 1 - q0) // block_q),
@@ -672,9 +695,9 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
         dv_ref[:] = dv_acc.astype(dv_ref.dtype)
 
     live = (True if not causal
-            else (row_offset + si * super_q + super_q - 1 >= kv_start))
+            else (row_offset + si_abs * super_q + super_q - 1 >= kv_start))
     if causal and window is not None:
-        live &= (row_offset + si * super_q
+        live &= (row_offset + si_abs * super_q
                  <= kv_start + block_kv - 1 + window - 1)
     if causal and prefix is not None:
         live |= kv_start < prefix
@@ -716,16 +739,28 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     if g_lse is not None:
         dD = dD - g_lse.astype(jnp.float32).reshape(b * h_kv, group, t, 1)
 
-    super_kv = _fit_block(_SUPER_KV, tkv)
-    super_q = _fit_block(_SUPER_KV, t)
+    # Windowed backward uses half-size superblocks: the dkv kernel holds
+    # q AND dO superblock tiles (double-buffered) plus k/v blocks and two
+    # f32 scratch accumulators — at super 4096 that overflows the 16 MB
+    # scoped VMEM; 2048 fits with the remap still bounding dead DMA.
+    super_req = _SUPER_KV if window is None else _SUPER_KV // 2
+    super_kv = _fit_block(super_req, tkv)
+    super_q = _fit_block(super_req, t)
     block_kv_dq = _fit_block(block_kv, super_kv)
     block_q_dkv = _fit_block(block_q, super_q)
+    # banded grid remaps, both directions (dead superblock DMA is as
+    # real in the backward as in the forward)
+    ns_dq, kv_first = _window_super_first(
+        window, prefix, row_offset, block_q, super_kv, tkv // super_kv)
+    ns_dkv, q_first = _window_super_first_q(
+        window, prefix, row_offset, block_kv, super_q, t // super_q)
     vmem = {"memory_space": pltpu.VMEM}
     # dq grid: (b*h_kv, group, q-block, kv-superblock)
     q_outer = pl.BlockSpec((None, None, block_q, d),
                            lambda i, g_, a, b_: (i, g_, a, 0), **vmem)
     kvs_inner = pl.BlockSpec((None, super_kv, d),
-                             lambda i, g_, a, b_: (i, b_, 0), **vmem)
+                             lambda i, g_, a, b_: (i, kv_first(a) + b_, 0),
+                             **vmem)
     row_outer = pl.BlockSpec((None, None, block_q, 1),
                              lambda i, g_, a, b_: (i, g_, a, 0), **vmem)
     # dkv grid: (b*h_kv, kv-block, q-group, q-superblock); the kv-block
@@ -734,17 +769,21 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     kv_outer = pl.BlockSpec((None, block_kv, d),
                             lambda i, a, g_, b_: (i, a, 0), **vmem)
     qs_inner = pl.BlockSpec((None, None, super_q, d),
-                            lambda i, a, g_, b_: (i, g_, b_, 0), **vmem)
+                            lambda i, a, g_, b_: (i, g_, q_first(a) + b_, 0),
+                            **vmem)
     rows_inner = pl.BlockSpec((None, None, super_q, 1),
-                              lambda i, a, g_, b_: (i, g_, b_, 0), **vmem)
+                              lambda i, a, g_, b_: (i, g_, q_first(a) + b_, 0),
+                              **vmem)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_kv=block_kv_dq, causal=causal,
-                          num_super=tkv // super_kv,
+                          num_super=ns_dq,
                           window=window, row_offset=row_offset,
-                          prefix=prefix),
-        grid=(b * h_kv, group, t // block_q, tkv // super_kv),
+                          prefix=prefix,
+                          kv_first=None if ns_dq == tkv // super_kv
+                          else kv_first),
+        grid=(b * h_kv, group, t // block_q, ns_dq),
         in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
         out_specs=q_outer,
         out_shape=_sds((b * h_kv, group, t, d), q.dtype, q, k, v, g),
@@ -756,10 +795,12 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q_dkv,
                           block_kv=block_kv, causal=causal,
-                          num_super=t // super_q,
+                          num_super=ns_dkv,
                           group=group, window=window,
-                          row_offset=row_offset, prefix=prefix),
-        grid=(b * h_kv, tkv // block_kv, group, t // super_q),
+                          row_offset=row_offset, prefix=prefix,
+                          q_first=None if ns_dkv == t // super_q
+                          else q_first),
+        grid=(b * h_kv, tkv // block_kv, group, ns_dkv),
         in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
         out_specs=(kv_outer, kv_outer),
         out_shape=(_sds((b * h_kv, tkv, d), k.dtype, q, k, v, g),
@@ -1080,3 +1121,47 @@ def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
     flops = 3.5 * 4 * b * h * t * t * d / 2
     return {"flash_attn_train_tflops": flops / per / 1e12,
             "shape": f"b{b} h{h} t{t} d{d} {jnp.dtype(dtype).name}"}
+
+
+def flash_attention_long_context_train_tflops(
+        b: int = 1, h: int = 8, t: int = 16384, d: int = 128,
+        window: int = 2048, dtype=jnp.bfloat16, iters: int = 3,
+        chain_short: int = 4, chain_long: int = 12):
+    """Forward+backward sliding-window attention at long context — the
+    long-context TRAINING capability. All three kernels run with the
+    banded grid remap (without it the backward pays the same dead
+    superblock DMA the forward did). FLOP accounting mirrors
+    flash_attention_train_tflops: 3.5x the forward's band-visible
+    pairs."""
+    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, t, d), dtype)
+    k = jax.random.normal(kk, (b, h, t, d), dtype)
+    v = jax.random.normal(kv, (b, h, t, d), dtype)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, True, window=window, block_q=512,
+            block_kv=1024).astype(jnp.float32) ** 2)
+
+    def make_run(n):
+        @jax.jit
+        def run(q, k, v):
+            def body(_, carry):
+                qq, kk_, vv = carry
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(qq, kk_, vv)
+                lr = jnp.asarray(1e-4, jnp.float32)
+                return ((qq - lr * dq).astype(dtype),
+                        (kk_ - lr * dk).astype(dtype),
+                        (vv - lr * dv).astype(dtype))
+            return jax.lax.fori_loop(0, n, body, (q, k, v))
+        return lambda: run(q, k, v)
+
+    per = chain_seconds_per_step(make_run, chain_short, chain_long, iters)
+    visible = window * (window + 1) // 2 + (t - window) * window
+    flops = 3.5 * 4 * b * h * d * visible
+    return {"flash_attn_long_ctx_train_tflops": flops / per / 1e12,
+            "long_ctx_train_step_ms": per * 1e3,
+            "shape": f"b{b} h{h} t{t} w{window} d{d} {jnp.dtype(dtype).name}"}
