@@ -355,11 +355,16 @@ def bench_accelerator() -> dict:
                 out["serving_throughput_speedup"] = round(sv["speedup"], 2)
                 out["serving_tokens_per_sec"] = round(
                     sv["engine_tokens_per_sec"], 1)
-                log(f"  serving: continuous batching "
-                    f"{sv['engine_tokens_per_sec']:.0f} tok/s vs "
-                    f"{sv['sequential_tokens_per_sec']:.0f} sequential "
-                    f"({sv['speedup']:.2f}x, 6 ragged requests, "
-                    f"token-identical outputs)")
+                log(f"  serving: continuous batching + multi-step "
+                    f"device scan: {sv['engine_tokens_per_sec']:.0f} "
+                    f"tok/s vs {sv['sequential_tokens_per_sec']:.0f} "
+                    f"per-request sequential ({sv['speedup']:.2f}x, 6 "
+                    f"ragged requests, token-identical outputs; the "
+                    f"gain combines batching with chunked dispatch — "
+                    f"up to 32 greedy steps per device round-trip — "
+                    f"which dominates on the tunneled dev chip's "
+                    f"O(100ms) dispatch and still removes per-token "
+                    f"host latency in production)")
             except Exception as e:
                 log(f"  serving bench skipped: {type(e).__name__}: {e}")
             # int8 self-speculation at b=1 (the latency-bound serving
@@ -448,8 +453,15 @@ def main() -> int:
                 "vs_baseline = reference cold NVML MIG-prepare O(10s) / "
                 "our in-process prepare p50; not apples-to-apples with a "
                 "containerized path — grpc_p50_ms adds the kubelet "
-                "transport hop, and tests/e2e measures the live "
-                "kubelet+containerd window"),
+                "transport hop. cd_rendezvous_ms is likewise in-process "
+                "(threads over the fake cluster). The cross-PROCESS "
+                "numbers live in E2E_RESULTS.json (make e2e-sim): "
+                "claim-to-ready ~50 ms p50 with the kubelet dial "
+                "sequence + REST transport in the loop, and the full "
+                "multi-node CD rendezvous (controller + plugins + "
+                "daemons as separate production processes) in ~5 s; "
+                "tests/e2e/run_e2e_kind.sh measures the live "
+                "kubelet+containerd window where docker exists"),
             **accel,
         },
     }))

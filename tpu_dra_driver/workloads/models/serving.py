@@ -60,15 +60,13 @@ def _on_tpu() -> bool:
     return f()
 
 
-@partial(jax.jit, static_argnames=("cfg", "interpret"),
-         donate_argnums=(2, 3))
-def paged_decode_step(params, cfg: ModelConfig, pool_ks, pool_vs,
-                      tables, lens, tokens, interpret=False):
+def _decode_core(params, cfg: ModelConfig, pool_ks, pool_vs,
+                 tables, lens, tokens, interpret=False):
     """One decode step for every row: tokens [B] at per-row positions
-    ``lens`` → (logits [B, vocab], updated pools). Pools are donated —
-    the per-step appends update in place instead of copying every
-    layer's pool. Rows with table row 0 (inactive) write into the null
-    block and their logits are garbage the host ignores."""
+    ``lens`` → (logits [B, vocab], updated pools). Rows with table row 0
+    (inactive) write into the null block and their logits are garbage
+    the host ignores. Unjitted core shared by the single-step and
+    multi-step (scanned) entry points."""
     b = tokens.shape[0]
     n_kv = cfg.n_kv_heads or cfg.n_heads
     hd = cfg.d_model // cfg.n_heads
@@ -109,6 +107,45 @@ def paged_decode_step(params, cfg: ModelConfig, pool_ks, pool_vs,
     x = _rmsnorm(x, params["final_norm"]["g"])
     logits = lm_head(x, params["embed"])[:, 0]
     return logits, new_ks, new_vs
+
+
+@partial(jax.jit, static_argnames=("cfg", "interpret"),
+         donate_argnums=(2, 3))
+def paged_decode_step(params, cfg: ModelConfig, pool_ks, pool_vs,
+                      tables, lens, tokens, interpret=False):
+    """Single-step entry point (pools donated)."""
+    return _decode_core(params, cfg, pool_ks, pool_vs, tables, lens,
+                        tokens, interpret=interpret)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "interpret"),
+         donate_argnums=(2, 3))
+def paged_decode_steps(params, cfg: ModelConfig, pool_ks, pool_vs,
+                       tables, lens, tokens, n_steps: int,
+                       interpret=False):
+    """``n_steps`` greedy decode steps in ONE dispatch: a lax.scan feeds
+    each step's argmax back as the next token, appending to the pools
+    device-side. Returns (tokens [B, n_steps], pools). One device
+    round-trip per CHUNK instead of per token — the host dispatch
+    overhead (dominant at small batch; O(100 ms) on tunneled dev chips,
+    tens of µs in production) amortizes by n_steps.
+
+    The host consumes per-row prefixes of the [B, n_steps] result (a
+    row finishing mid-chunk discards its tail); callers must bound
+    n_steps so no active row appends past its block allocation — the
+    engine uses min(remaining) over active rows."""
+
+    def body(carry, _):
+        pool_ks, pool_vs, lens, toks = carry
+        logits, pool_ks, pool_vs = _decode_core(
+            params, cfg, pool_ks, pool_vs, tables, lens, toks,
+            interpret=interpret)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (pool_ks, pool_vs, lens + 1, nxt), nxt
+
+    (pool_ks, pool_vs, _, _), out = jax.lax.scan(
+        body, (pool_ks, pool_vs, lens, tokens), None, length=n_steps)
+    return out.T, pool_ks, pool_vs
 
 
 @partial(jax.jit, static_argnames=("cfg", "block_t"),
@@ -203,6 +240,18 @@ class ServingEngine:
         if self._poisoned:
             raise RuntimeError(f"ServingEngine poisoned: {self._poisoned}")
 
+    def _poison_if_donated(self, msg: str) -> None:
+        """After a failed donated-pool call: if donation already consumed
+        the old buffers, later calls must not retry against deleted
+        arrays — poison the engine. Shared by every donation site."""
+        try:
+            donated = any(getattr(p, "is_deleted", lambda: False)()
+                          for p in self.pool_ks)
+        except Exception:
+            donated = True
+        if donated:
+            self._poisoned = msg
+
     # -- admission -------------------------------------------------------
     def add(self, prompt: List[int], max_new_tokens: int) -> int:
         """Prefill + admit one request; returns its request id. Raises
@@ -240,18 +289,8 @@ class ServingEngine:
                 self.cfg, self.block_t)
         except BaseException:
             self.free.extend(reversed(blocks))
-            # _admit_prefill donates the pools: a post-trace failure
-            # (e.g. device OOM) has already invalidated the old buffers,
-            # so the engine cannot keep serving from them — poison it
-            # rather than let later steps read deleted arrays.
-            try:
-                donated = any(getattr(p, "is_deleted", lambda: False)()
-                              for p in self.pool_ks)
-            except Exception:
-                donated = True
-            if donated:
-                self._poisoned = ("admission failed after pool donation; "
-                                  "engine state is unrecoverable")
+            self._poison_if_donated("admission failed after pool donation; "
+                                    "engine state is unrecoverable")
             raise
         self.tables[row, :need] = blocks
         self.tables[row, need:] = 0
@@ -286,17 +325,9 @@ class ServingEngine:
                 jnp.asarray(self.tables), jnp.asarray(self.lens),
                 jnp.asarray(tokens), interpret=self.interpret)
         except BaseException:
-            # same donation hazard as add(): a post-trace failure has
-            # already consumed the pools — poison instead of letting a
-            # retry read deleted buffers
-            try:
-                donated = any(getattr(p, "is_deleted", lambda: False)()
-                              for p in self.pool_ks)
-            except Exception:
-                donated = True
-            if donated:
-                self._poisoned = ("decode step failed after pool donation; "
-                                  "engine state is unrecoverable")
+            self._poison_if_donated("decode step failed after pool "
+                                    "donation; engine state is "
+                                    "unrecoverable")
             raise
         picked = np.asarray(jnp.argmax(logits, axis=-1))
         out: Dict[int, int] = {}
@@ -307,6 +338,53 @@ class ServingEngine:
             r.pending = tok
             r.remaining -= 1
             out[r.rid] = tok
+            if r.remaining == 0:
+                self._finish(r)
+        return out
+
+    # chunk sizes the multi-step path compiles for (one compile each;
+    # arbitrary k would recompile per distinct chunk length)
+    CHUNK_SIZES = (32, 16, 8, 4, 2)
+
+    def step_chunk(self, max_steps: int = 32) -> Dict[int, List[int]]:
+        """Up to ``max_steps`` decode steps in one device dispatch
+        (greedy argmax fed back device-side). The chunk length is the
+        largest precompiled size <= min(max_steps, min remaining over
+        active rows), so no row ever appends past its allocation, every
+        produced token is consumed, and no row can finish mid-chunk —
+        the bound lands exactly on the next completion, keeping
+        admission cadence identical to single stepping. Falls back to
+        step() when the bound is 1. Returns {rid: new tokens}."""
+        self._check_alive()
+        active = [r for r in self.rows if r is not None]
+        if not active:
+            return {}
+        bound = min(max_steps, min(r.remaining for r in active))
+        k = next((c for c in self.CHUNK_SIZES if c <= bound), 1)
+        if k <= 1:
+            return {rid: [tok] for rid, tok in self.step().items()}
+        tokens = np.zeros((len(self.rows),), np.int32)
+        for r in active:
+            tokens[r.row] = r.pending
+        try:
+            toks, self.pool_ks, self.pool_vs = paged_decode_steps(
+                self.params, self.cfg, self.pool_ks, self.pool_vs,
+                jnp.asarray(self.tables), jnp.asarray(self.lens),
+                jnp.asarray(tokens), n_steps=k, interpret=self.interpret)
+        except BaseException:
+            self._poison_if_donated("decode chunk failed after pool "
+                                    "donation; engine state is "
+                                    "unrecoverable")
+            raise
+        toks = np.asarray(toks)
+        out: Dict[int, List[int]] = {}
+        for r in active:
+            got = [int(t) for t in toks[r.row]]
+            self.lens[r.row] += k
+            r.tokens.extend(got)
+            r.pending = got[-1]
+            r.remaining -= k
+            out[r.rid] = got
             if r.remaining == 0:
                 self._finish(r)
         return out
@@ -341,7 +419,7 @@ class ServingEngine:
                             f"request cannot be admitted even on an idle "
                             f"engine: {e}") from e
                     break
-            if not self.step() and not admitted and pending:
+            if not self.step_chunk() and not admitted and pending:
                 raise RuntimeError("engine stalled with pending requests")
         return {rid: self.finished[rid] for rid in rids}
 
