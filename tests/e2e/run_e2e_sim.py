@@ -58,16 +58,21 @@ CHIP_SELECTOR = [{"cel": {"expression":
     'device.attributes["tpu.google.com"].type == "chip"'}}]
 
 
-def _visible_chips(spec: dict) -> str:
-    """Pull TPU_VISIBLE_CHIPS out of a parsed CDI spec's env edits."""
+def _env_entries(spec: dict) -> list:
+    """All env entries across a CDI spec's common + per-device edits."""
     edits = [spec.get("containerEdits", {})] + \
         [d.get("containerEdits", {}) for d in spec.get("devices", [])]
-    for e in edits:
-        for env in e.get("env") or []:
-            if env.startswith("TPU_VISIBLE_CHIPS="):
-                return env.split("=", 1)[1]
+    return [env for e in edits for env in e.get("env") or []]
+
+
+def _visible_chips(spec: dict) -> str:
+    """Pull TPU_VISIBLE_CHIPS out of a parsed CDI spec's env edits."""
+    envs = _env_entries(spec)
+    for env in envs:
+        if env.startswith("TPU_VISIBLE_CHIPS="):
+            return env.split("=", 1)[1]
     raise HarnessError(f"TPU_VISIBLE_CHIPS not in CDI spec "
-                       f"(env entries: {[v for e in edits for v in e.get('env') or []]})")
+                       f"(env entries: {envs})")
 
 
 def _prepare(cluster: SimCluster, node: SimNode, dra, name: str,
@@ -179,9 +184,7 @@ def phase_tpu_plugin(cluster: SimCluster, iterations: int) -> dict:
     spec4 = validate_file(next(os.path.join(node.cdi_root, f)
                                for f in os.listdir(node.cdi_root)
                                if uid4 in f))
-    envs4 = [e for ed in [spec4.get("containerEdits", {})]
-             + [d.get("containerEdits", {}) for d in spec4.get("devices", [])]
-             for e in ed.get("env") or []]
+    envs4 = _env_entries(spec4)
     if "TPU_TIMESLICE_INTERVAL=Long" not in envs4:
         raise HarnessError(f"t4: TimeSlicing env not in CDI spec: {envs4}")
     dra.node_unprepare_resources([

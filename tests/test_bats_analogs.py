@@ -185,14 +185,24 @@ def test_corrupt_checkpoint_refuses_to_guess(tmp_path):
 
 def test_verbosity_maps_to_levels():
     from tpu_dra_driver.pkg.flags import setup_logging
-    for verbosity, level in ((0, logging.WARNING), (2, logging.INFO),
-                             (4, logging.INFO), (6, logging.DEBUG),
-                             (7, logging.DEBUG)):
-        root = logging.getLogger()
+    root = logging.getLogger()
+    prev_level, prev_handlers = root.level, root.handlers[:]
+    try:
+        for verbosity, level in ((0, logging.WARNING), (2, logging.INFO),
+                                 (4, logging.INFO), (6, logging.DEBUG),
+                                 (7, logging.DEBUG)):
+            for h in root.handlers[:]:
+                root.removeHandler(h)
+            setup_logging(verbosity)
+            assert root.level == level, (verbosity, root.level)
+    finally:
+        # leaving the root logger at DEBUG floods every later test (and
+        # teardown watch threads) with urllib3/apiserver noise
         for h in root.handlers[:]:
             root.removeHandler(h)
-        setup_logging(verbosity)
-        assert root.level == level, (verbosity, root.level)
+        for h in prev_handlers:
+            root.addHandler(h)
+        root.setLevel(prev_level)
 
 
 def test_prepare_breadcrumbs_gated_behind_debug(tmp_path, caplog):
