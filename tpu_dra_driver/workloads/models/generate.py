@@ -152,7 +152,7 @@ def _decode_attention(q, k_cache, v_cache, pos, k_scale=None, v_scale=None):
 
 def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
                   tokens: jax.Array, attn_fn=None,
-                  prefix_lm: bool = False):
+                  prefix_lm: bool = False, last_index=None):
     """Fill the KV cache from a whole [b, t0] prompt in ONE forward.
 
     The scan prefill steps one token at a time — t0 sequential matvec
@@ -164,7 +164,16 @@ def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
     prefill cannot express at all. Requires the full-length cache
     (cfg.window == 0: the ring buffer's wrap layout is sequential by
     nature). Returns (logits [b, vocab], cache, pos=t0).
+
+    ``last_index`` (traced scalar, causal only): return logits at that
+    position instead of the last — the bucketed-admission hook: a
+    prompt right-padded to a compile bucket reads its logits at the
+    REAL last token, and causality keeps positions <= last_index
+    untouched by the padding.
     """
+    if last_index is not None and prefix_lm:
+        raise ValueError("last_index requires causal prefill (prefix_lm "
+                         "treats the padded length as the prefix)")
     from tpu_dra_driver.workloads.ops.attention import attention_reference
     from tpu_dra_driver.workloads.models.transformer import _ffn
 
@@ -209,7 +218,11 @@ def block_prefill(params: Params, cfg: ModelConfig, cache: Dict,
         x = x + mm(att, layer["wo"])
         x = x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
 
-    x = _rmsnorm(x[:, -1:], params["final_norm"]["g"])
+    if last_index is None:
+        x = x[:, -1:]
+    else:
+        x = jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+    x = _rmsnorm(x, params["final_norm"]["g"])
     logits = lm_head(x, params["embed"])[:, 0]
     new_cache = {"k": new_k, "v": new_v}
     if new_ks:

@@ -151,22 +151,29 @@ def paged_decode_steps(params, cfg: ModelConfig, pool_ks, pool_vs,
 @partial(jax.jit, static_argnames=("cfg", "block_t"),
          donate_argnums=(2, 3))
 def _admit_prefill(params, tokens, pool_ks, pool_vs, blocks,
-                   cfg: ModelConfig, block_t: int):
+                   cfg: ModelConfig, block_t: int, true_len=None):
     """Admission, one jit: dense prompt prefill through the SAME
     block_prefill the generate() path uses (no forked forward to
     drift), then scatter each layer's K/V into the allocated pool
-    blocks. Pools are donated — no full-pool copies per block. NOTE:
-    compiles per exact prompt length (the jitted shape); callers with
-    many distinct lengths should bucket/pad prompts themselves —
-    padding interacts with the last-position logits, so the engine
-    does not do it implicitly."""
+    blocks. Pools are donated — no full-pool copies per block.
+
+    Compiles per (tokens, blocks) SHAPE; the engine pads both to
+    power-of-two buckets and passes ``true_len`` (traced scalar) so a
+    handful of programs cover every request. Bucketing is silently
+    correct: logits are read at the real last token (causality shields
+    it from the right-padding), the padded tail's cache entries either
+    land past the scattered blocks, in lens-invisible slots the next
+    appends overwrite, or in the null block (padded table entries are
+    0, whose content nothing ever reads)."""
     from tpu_dra_driver.workloads.models.generate import (
         block_prefill, init_kv_cache,
     )
     b, t0 = tokens.shape
     nb = blocks.shape[0]
     cache = init_kv_cache(cfg, 1, t0)
-    last_logits, cache, _ = block_prefill(params, cfg, cache, tokens)
+    last_logits, cache, _ = block_prefill(
+        params, cfg, cache, tokens,
+        last_index=None if true_len is None else true_len - 1)
 
     for li in range(cfg.n_layers):
         kc = cache["k"][li][0]                    # [h_kv, Lpad, hd]
@@ -279,14 +286,34 @@ class ServingEngine:
         # restored on ANY prefill failure, so a failed admission cannot
         # leak pool capacity. The prompt's blocks are the first n_prompt
         # of the allocation; the rest are decode room.
-        toks = jnp.asarray(prompt, jnp.int32)[None]
+        #
+        # Admission shapes are bucketed to powers of two (prompt length
+        # AND block count): _admit_prefill compiles per shape, and
+        # unbucketed ragged serving pays one compile per distinct prompt
+        # length. true_len keeps the logits on the real last token;
+        # padded table entries are 0 = the null block (see
+        # _admit_prefill's docstring for why every padding path is
+        # inert).
         n_prompt = -(-t0 // self.block_t)
+        t_bucket = max(32, 1 << (t0 - 1).bit_length())
+        if not self.cfg.use_rope:
+            # learned pos_embed bounds positions — the padded region
+            # still needs valid table rows
+            t_bucket = min(t_bucket, self.cfg.max_seq)
+        nb_bucket = max(1, 1 << (n_prompt - 1).bit_length())
+        # token array built BEFORE the pop (any conversion failure must
+        # not leak pool blocks); list() tolerates ndarray/tuple prompts
+        toks = jnp.asarray(list(prompt) + [0] * (t_bucket - t0),
+                           jnp.int32)[None]
         blocks = [self.free.pop() for _ in range(need)]
         try:
+            padded_blocks = jnp.asarray(
+                blocks[:n_prompt] + [0] * (nb_bucket - n_prompt),
+                jnp.int32)
             last_logits, self.pool_ks, self.pool_vs = _admit_prefill(
                 self.params, toks, self.pool_ks, self.pool_vs,
-                jnp.asarray(blocks[:n_prompt], jnp.int32),
-                self.cfg, self.block_t)
+                padded_blocks, self.cfg, self.block_t,
+                true_len=jnp.asarray(t0, jnp.int32))
         except BaseException:
             self.free.extend(reversed(blocks))
             self._poison_if_donated("admission failed after pool donation; "
