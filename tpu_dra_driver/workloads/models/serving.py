@@ -267,6 +267,11 @@ class ServingEngine:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         t0 = len(prompt)
+        if t0 == 0:
+            # bucketed admission would otherwise pad an empty prompt into
+            # a deterministic-garbage completion (last_index=-1 clamps to
+            # position 0 of all-pad tokens) — fail loudly instead
+            raise ValueError("prompt must be non-empty")
         if not self.cfg.use_rope and t0 + max_new_tokens > self.cfg.max_seq:
             # same contract as generate(): the learned pos_embed table
             # bounds positions — fail loudly, never clamp silently
