@@ -311,3 +311,48 @@ def test_informer_relist_resync_diffs_store():
     assert inf.get("gone", "ns") is None
     assert inf.get("fresh", "ns") is not None
     assert inf.get("keep", "ns")["metadata"]["resourceVersion"] == "999"
+
+
+def test_watch_since_rv_replays_journal():
+    """A watch opened with since_rv replays retained events after that
+    point before going live — the watch-cache resume that closes the
+    list→watch startup race."""
+    c = FakeCluster()
+    c.create("pods", _obj("before", ns="ns"))
+    rv = c.resource_version()
+    c.create("pods", _obj("in-window", ns="ns"))   # lands "during the gap"
+    sub = c.watch("pods", since_rv=rv)
+    ev = sub.next(timeout=1)
+    assert ev is not None and ev[0] == "ADDED"
+    assert ev[1]["metadata"]["name"] == "in-window"
+    # live events still flow after the replay
+    c.create("pods", _obj("after", ns="ns"))
+    ev = sub.next(timeout=1)
+    assert ev is not None and ev[1]["metadata"]["name"] == "after"
+    c.stop_watch("pods", sub)
+
+
+def test_watch_since_rv_replay_respects_selector():
+    c = FakeCluster()
+    rv = c.resource_version()
+    c.create("pods", _obj("miss", ns="ns"))
+    c.create("pods", _obj("hit", ns="ns", labels={"app": "x"}))
+    sub = c.watch("pods", label_selector={"app": "x"}, since_rv=rv)
+    ev = sub.next(timeout=1)
+    assert ev is not None and ev[1]["metadata"]["name"] == "hit"
+    assert sub.next(timeout=0.1) is None
+    c.stop_watch("pods", sub)
+
+
+def test_watch_since_rv_compacted_raises_gone():
+    from tpu_dra_driver.kube.errors import GoneError
+
+    c = FakeCluster(journal_limit=4)
+    for i in range(10):
+        c.create("pods", _obj(f"p{i}", ns="ns"))
+    with pytest.raises(GoneError):
+        c.watch("pods", since_rv=1)
+    # within the retained window is still fine
+    sub = c.watch("pods", since_rv=c.resource_version())
+    assert sub.next(timeout=0.1) is None
+    c.stop_watch("pods", sub)

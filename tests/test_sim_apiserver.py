@@ -117,3 +117,54 @@ def test_finalizer_aware_delete(sim):
     rc.update("computedomains", obj)             # finalizer removed -> gone
     with pytest.raises(NotFoundError):
         rc.get("computedomains", "cd", "ns")
+
+
+def test_list_and_watch_bridges_list_to_watch_gap(sim):
+    """Deterministically create an object INSIDE the list→watch window:
+    list_and_watch lists synchronously, then starts the watch thread —
+    wrapping _watch_loop injects a create after the list response but
+    before the watch request is dialed. The ADDED event must still
+    arrive, because the watch resumes from the list's resourceVersion
+    (the round-3 flake: rv="" dropped it ~1 in 4)."""
+    srv, rc = sim
+    rc.create("resourceclaims", _claim("pre"))
+    orig = rc._watch_loop
+
+    def delayed_watch_loop(*args, **kwargs):
+        srv.cluster.create("resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "mid-gap", "namespace": "default"},
+            "spec": {}})
+        orig(*args, **kwargs)
+
+    rc._watch_loop = delayed_watch_loop
+    items, sub = rc.list_and_watch("resourceclaims")
+    assert [o["metadata"]["name"] for o in items] == ["pre"]
+    ev = sub.next(timeout=5)
+    assert ev is not None and ev[0] == "ADDED"
+    assert ev[1]["metadata"]["name"] == "mid-gap"
+    rc.stop_watch("resourceclaims", sub)
+
+
+def test_watch_compacted_rv_answers_in_stream_410(sim):
+    """A watch resuming below the journal window gets HTTP 200 + one
+    in-stream ERROR(410) event — the real apiserver's shape, which the
+    client watch loop converts into a relist."""
+    import json as jsonlib
+
+    import requests
+
+    srv, rc = sim
+    srv.cluster._journal_limit = 4
+    for i in range(10):
+        rc.create("resourceclaims", _claim(f"c{i}"))
+    resp = requests.get(
+        f"{srv.url}/apis/resource.k8s.io/v1/resourceclaims",
+        params={"watch": "true", "resourceVersion": "1"},
+        stream=True, timeout=5)
+    assert resp.status_code == 200
+    line = next(resp.iter_lines())
+    ev = jsonlib.loads(line)
+    assert ev["type"] == "ERROR"
+    assert ev["object"]["code"] == 410
+    resp.close()

@@ -21,6 +21,7 @@ from tpu_dra_driver.kube.errors import (  # noqa: F401
     ApiError,
     ConflictError,
     AlreadyExistsError,
+    GoneError,
     NotFoundError,
 )
 from tpu_dra_driver.kube.fake import FakeCluster  # noqa: F401

@@ -21,3 +21,13 @@ class ConflictError(ApiError):
 
 class InvalidError(ApiError):
     code = 422
+
+
+class GoneError(ApiError):
+    """Watch resourceVersion too old (HTTP 410 / reason Expired).
+
+    Raised when a watch asks to resume from a resourceVersion that has
+    been compacted out of the event journal; the client must relist
+    (client-go Reflector relist semantics)."""
+
+    code = 410

@@ -25,11 +25,13 @@ import fnmatch
 import threading
 import time
 import uuid as uuidlib
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from collections import deque
+from typing import Callable, Deque, Dict, Iterator, List, Optional, Tuple
 
 from tpu_dra_driver.kube.errors import (
     AlreadyExistsError,
     ConflictError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -92,13 +94,27 @@ class _WatchSub:
 class FakeCluster:
     """The cluster: a set of resource tables + a global resourceVersion."""
 
-    def __init__(self):
+    #: retained watch-event history; resuming below the window -> GoneError
+    #: (models etcd compaction — small enough that tests can exercise 410)
+    JOURNAL_LIMIT = 2048
+
+    def __init__(self, journal_limit: Optional[int] = None):
         self._mu = threading.RLock()
         self._rv = 0
         # resource -> {(ns, name) -> obj}
         self._tables: Dict[str, Dict[Tuple[str, str], Object]] = {}
         # resource -> [subs]
         self._subs: Dict[str, List[_WatchSub]] = {}
+        # bounded PER-RESOURCE event journals so a watch can resume from
+        # a past resourceVersion (the apiserver's watch cache, which is
+        # per resource type): entries are (rv, type, snapshot), oldest
+        # first; churn on one resource never evicts another's history
+        self._journal_limit = (self.JOURNAL_LIMIT if journal_limit is None
+                               else journal_limit)
+        self._journals: Dict[str, Deque[Tuple[int, str, Object]]] = {}
+        # per resource: highest rv ever evicted from its journal;
+        # resuming below this point cannot be bridged -> 410 Gone
+        self._journal_trim_rv: Dict[str, int] = {}
 
     # -- internals ----------------------------------------------------------
 
@@ -110,6 +126,13 @@ class FakeCluster:
         return str(self._rv)
 
     def _notify(self, resource: str, ev_type: str, obj: Object) -> None:
+        rv = int((obj.get("metadata") or {}).get("resourceVersion") or 0)
+        journal = self._journals.setdefault(resource, deque())
+        journal.append((rv, ev_type, copy.deepcopy(obj)))
+        while len(journal) > self._journal_limit:
+            evicted_rv, _, _ = journal.popleft()
+            self._journal_trim_rv[resource] = max(
+                self._journal_trim_rv.get(resource, 0), evicted_rv)
         labels = (obj.get("metadata") or {}).get("labels") or {}
         for sub in self._subs.get(resource, []):
             if match_label_selector(labels, sub.selector):
@@ -165,6 +188,18 @@ class FakeCluster:
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
                                     o["metadata"]["name"]))
             return out
+
+    def list_with_rv(self, resource: str, namespace: Optional[str] = None,
+                     label_selector: Optional[Dict[str, str]] = None
+                     ) -> Tuple[List[Object], int]:
+        """List + the cluster resourceVersion of the snapshot, read under
+        ONE lock acquisition — a watch resuming from this rv is gap-free
+        with respect to these items (two separate calls could interleave
+        a write between them, advertising an rv newer than the items)."""
+        with self._mu:
+            return (self.list(resource, namespace=namespace,
+                              label_selector=label_selector),
+                    self._rv)
 
     def update(self, resource: str, obj: Object) -> Object:
         with self._mu:
@@ -224,9 +259,36 @@ class FakeCluster:
     # -- watch --------------------------------------------------------------
 
     def watch(self, resource: str,
-              label_selector: Optional[Dict[str, str]] = None) -> _WatchSub:
+              label_selector: Optional[Dict[str, str]] = None,
+              since_rv: Optional[int] = None) -> _WatchSub:
+        """Subscribe to ``resource`` events.
+
+        ``since_rv=None`` watches "from now". A numeric ``since_rv``
+        replays every retained event with resourceVersion > since_rv
+        before the subscription goes live (atomic under the cluster
+        lock, so no event between replay and registration is lost) —
+        the apiserver watch-cache resume that closes the list→watch
+        startup race. Raises :class:`GoneError` when ``since_rv``
+        predates the resource's journal window, exactly like a compacted
+        etcd — including ``since_rv=0`` once trimming has occurred
+        (silently replaying a trimmed journal would drop events; a 410
+        forces the client to relist, which always converges). A fresh
+        cluster (trim rv 0) resumes from 0 without error, so a
+        list-at-rv-0 → watch handoff stays gap-free."""
         with self._mu:
             sub = _WatchSub(label_selector)
+            if since_rv is not None:
+                trim_rv = self._journal_trim_rv.get(resource, 0)
+                if since_rv < trim_rv:
+                    raise GoneError(
+                        f"watch {resource}: resourceVersion {since_rv} "
+                        f"is too old (oldest retained: {trim_rv})")
+                for rv, ev_type, obj in self._journals.get(resource, ()):
+                    if rv <= since_rv:
+                        continue
+                    labels = (obj.get("metadata") or {}).get("labels") or {}
+                    if match_label_selector(labels, label_selector):
+                        sub.push((ev_type, copy.deepcopy(obj)))
             self._subs.setdefault(resource, []).append(sub)
             return sub
 

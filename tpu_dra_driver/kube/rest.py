@@ -28,6 +28,7 @@ from tpu_dra_driver.kube.errors import (
     AlreadyExistsError,
     ApiError,
     ConflictError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -328,6 +329,8 @@ class RestCluster:
             raise ConflictError(msg)
         if resp.status_code == 422:
             raise InvalidError(msg)
+        if resp.status_code == 410:
+            raise GoneError(msg)
         raise ApiError(f"{resp.status_code} {msg}")
 
     # -- CRUD ---------------------------------------------------------------
@@ -422,6 +425,11 @@ class RestCluster:
 
     def watch(self, resource: str,
               label_selector: Optional[Dict[str, str]] = None) -> _WatchSub:
+        """Bare watch "from now" (resourceVersion unset). There is no
+        list to bridge, so events racing the connection handshake can be
+        missed — callers that need gap-free startup must use
+        :meth:`list_and_watch`, which resumes from the list's
+        resourceVersion (client-go Reflector semantics)."""
         sub = _WatchSub(label_selector)
         t = threading.Thread(target=self._watch_loop,
                              args=(resource, label_selector, sub),
@@ -432,9 +440,15 @@ class RestCluster:
 
     def list_and_watch(self, resource: str, namespace: Optional[str] = None,
                        label_selector: Optional[Dict[str, str]] = None):
-        items = self.list(resource, namespace=namespace,
-                          label_selector=label_selector)
-        rv = ""  # start the watch from "now"; the initial list covers history
+        """List, then watch **from the list's resourceVersion** so any
+        event landing between the list response and the watch connection
+        being established is replayed, not dropped (client-go Reflector
+        ListAndWatch, reference
+        vendor/k8s.io/client-go/tools/cache/reflector.go). If that RV has
+        already been compacted server-side, the watch loop's 410 handling
+        relists — the gap is bridged either way."""
+        items, rv = self._paged_list(resource, namespace or "",
+                                     label_selector)
         sub = _WatchSub(label_selector)
         t = threading.Thread(target=self._watch_loop,
                              args=(resource, label_selector, sub, rv),

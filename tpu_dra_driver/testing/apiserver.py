@@ -41,6 +41,7 @@ from tpu_dra_driver.kube.errors import (
     AlreadyExistsError,
     ApiError,
     ConflictError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -148,6 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status(409, "Conflict", str(e))
         elif isinstance(e, InvalidError):
             self._send_status(422, "Invalid", str(e))
+        elif isinstance(e, GoneError):
+            self._send_status(410, "Expired", str(e))
         else:
             self._send_status(500, "InternalError", f"{type(e).__name__}: {e}")
 
@@ -197,16 +200,21 @@ class _Handler(BaseHTTPRequestHandler):
                 obj = self.cluster.get(resource, name, namespace)
                 self._send_json(200, self._to_wire(resource, obj, version))
             elif (q.get("watch") or ["false"])[0] == "true":
-                self._serve_watch(resource, selector, version)
+                raw_rv = (q.get("resourceVersion") or [""])[0]
+                since_rv = int(raw_rv) if raw_rv.isdecimal() else None
+                self._serve_watch(resource, selector, version, since_rv)
             else:
-                items = self.cluster.list(
+                # items + rv under one lock acquisition: an rv read after
+                # the snapshot could be newer than the items, and a watch
+                # resuming from it would skip the in-between event
+                items, list_rv = self.cluster.list_with_rv(
                     resource,
                     namespace=namespace or None,
                     label_selector=selector)
                 self._send_json(200, {
                     "kind": _LIST_KINDS[resource], "apiVersion": "v1",
                     "metadata": {
-                        "resourceVersion": str(self.cluster.resource_version()),
+                        "resourceVersion": str(list_rv),
                     },
                     "items": [self._to_wire(resource, o, version)
                               for o in items],
@@ -215,11 +223,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(e)
 
     def _serve_watch(self, resource: str, selector: Optional[Dict[str, str]],
-                     version: str) -> None:
+                     version: str, since_rv: Optional[int] = None) -> None:
         """Chunked JSON event stream. Subscribes to the fake's watch hub;
         each (type, object) becomes one newline-terminated JSON line, the
-        exact framing RestCluster (and client-go) consumes."""
-        sub = self.cluster.watch(resource, selector)
+        exact framing RestCluster (and client-go) consumes.
+
+        ``since_rv`` (the ``resourceVersion`` query param) resumes from
+        the watch cache: retained events after that point are replayed
+        first. A too-old resourceVersion is answered the way the real
+        apiserver does — HTTP 200 with a single in-stream ``ERROR``
+        event carrying a 410 Status — which RestCluster._watch_loop
+        turns into a relist."""
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
@@ -228,6 +242,22 @@ class _Handler(BaseHTTPRequestHandler):
         def write_chunk(data: bytes) -> None:
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             self.wfile.flush()
+
+        try:
+            sub = self.cluster.watch(resource, selector, since_rv=since_rv)
+        except GoneError as e:
+            line = json.dumps({
+                "type": "ERROR",
+                "object": {"kind": "Status", "apiVersion": "v1",
+                           "status": "Failure", "reason": "Expired",
+                           "message": str(e), "code": 410},
+            }).encode() + b"\n"
+            try:
+                write_chunk(line)
+                write_chunk(b"")
+            except OSError:
+                pass
+            return
 
         try:
             while not self.server.stopping:  # type: ignore[attr-defined]
