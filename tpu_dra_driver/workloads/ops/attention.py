@@ -98,7 +98,8 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_kv: int, causal: bool,
                   num_super: int, emit_lse: bool = True, window=None,
-                  row_offset: int = 0, prefix=None, kv_first=None):
+                  row_offset: int = 0, prefix=None, kv_first=None,
+                  q_scale: float = 1.0):
     """One (batch*kv-head, q-group, q-block, kv-superblock) grid cell.
 
     GQA: the grid's axis 1 walks the query heads sharing this cell's KV
@@ -143,7 +144,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         the mask-free path (no iota/where — pure MXU + softmax update),
         only the 1-2 diagonal-straddling blocks per q row pay for mask
         generation. Scores are kept in base-2 (see LOG2E)."""
-        q = q_ref[:]                                             # [bq, d]
+        # sm_scale * LOG2E folded into the q tile HERE, once per grid
+        # cell ([bq, d] f32 multiply + cast — trivial VPU work), not as
+        # an XLA pass outside the kernel: the outside fold materialized
+        # a scaled copy of the whole q tensor, an extra HBM write+read
+        # worth ~8% of the kernel's runtime at t=2048 (the kernel is
+        # that close to the VPU softmax limit).
+        q = (q_ref[:].astype(jnp.float32) * q_scale).astype(q_ref.dtype)
 
         def body(j2, carry, masked):
             # masked: None (band interior, no mask math at all), "diag"
@@ -450,9 +457,9 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     num_super, kv_first = _window_super_first(
         window, prefix, row_offset, block_q, super_kv, num_super_total)
 
-    # fold sm_scale * LOG2E into q once (f32 multiply, cast back): the
-    # kernels then run base-2 softmax on raw dot products
-    q = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+    # sm_scale * LOG2E is folded into the q TILE inside the kernel (see
+    # _flash_kernel.steps) — doing it here as an XLA op would write and
+    # re-read a scaled copy of q through HBM
     qf = q.reshape(b * h_kv, group, t, d)
     kf = k.reshape(b * h_kv, tkv, d)
     vf = v.reshape(b * h_kv, tkv, d)
@@ -462,7 +469,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
         _flash_kernel, block_q=block_q, block_kv=block_kv,
         causal=causal, num_super=num_super, emit_lse=with_lse,
         window=window, row_offset=row_offset, prefix=prefix,
-        kv_first=None if num_super == num_super_total else kv_first)
+        kv_first=None if num_super == num_super_total else kv_first,
+        q_scale=sm_scale * LOG2E)
 
     vmem = {"memory_space": pltpu.VMEM}
 
@@ -473,6 +481,16 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
     o_shape = _sds((b * h_kv, group, t, d), q.dtype, q, k, v)
     lse_shape = _sds((b * h_kv, group, t, 1), jnp.float32, q, k, v)
 
+    # Inference path (no lse residual): write o in place of q. q and o
+    # share identical BlockSpecs, each q block's last read strictly
+    # precedes its cell's o write, and later cells touch different
+    # blocks — so the alias is race-free under pallas pipelining. It
+    # removes the out-buffer copy XLA otherwise inserts when attention
+    # output feeds a loop carry (autoregressive/serving loops: measured
+    # ~5% of step time at t=2048). The lse path keeps q alive as a
+    # custom-vjp residual, where a forced alias would just reintroduce
+    # the copy on the input side.
+    alias = {} if with_lse else {"input_output_aliases": {0: 0}}
     result = pl.pallas_call(
         kernel,
         grid=grid,
@@ -490,6 +508,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_kv: int,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=_scratch(block_q, d),
         interpret=interpret,
+        **alias,
         **_compiler_params(),
     )(qf, kf, vf)
     if with_lse:
@@ -503,7 +522,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                          dq_ref, acc_sc, *, block_q: int, block_kv: int,
                          causal: bool, num_super: int,
                          window=None, row_offset: int = 0, prefix=None,
-                         kv_first=None):
+                         kv_first=None, q_scale: float = 1.0,
+                         out_scale: float = 1.0):
     """dq for one (batch*kv-head, q-group, q-block, kv-superblock) cell.
 
     P is rebuilt from (q, k, lse); dS = P * (dP - D); dq = sum_j dS @ K_j
@@ -525,12 +545,19 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
         # base-2 softmax: p = exp(s - lse) == exp2(s*log2e - lse*log2e)
         lse2 = lse_ref[:] * LOG2E                # [bq, 1]
         dD = dD_ref[:]                           # [bq, 1]
+        # in-kernel scale fold, as in the forward: no scaled-q copy of
+        # the whole tensor through HBM
+        qt = (q_ref[:].astype(jnp.float32) * q_scale).astype(q_ref.dtype)
 
         def body(j2, acc, masked):
+            # masked modes mirror the forward's specialization: "diag"
+            # (causal compare only), "edge" (window compare only),
+            # "both" (fallback) — masked tiles dominate a banded walk,
+            # and each dropped compare is a [bq, bkv] VPU op saved
             kb = k_ref[pl.ds(j2 * block_kv, block_kv), :]
             vb = v_ref[pl.ds(j2 * block_kv, block_kv), :]
             s = jax.lax.dot_general(
-                q_ref[:], kb, dimension_numbers=(((1,), (1,)), ((), ())),
+                qt, kb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             if masked:
                 row_ids = row_min + jax.lax.broadcasted_iota(
@@ -538,11 +565,16 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                 col_ids = (sj_abs * super_kv + j2 * block_kv
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (1, block_kv), 1))
-                vis = row_ids >= col_ids
-                if window is not None:
-                    vis &= row_ids - col_ids < window
-                if prefix is not None:
-                    vis |= col_ids < prefix
+                if masked == "diag":
+                    vis = row_ids >= col_ids
+                elif masked == "edge":
+                    vis = row_ids - col_ids < window
+                else:
+                    vis = row_ids >= col_ids
+                    if window is not None:
+                        vis &= row_ids - col_ids < window
+                    if prefix is not None:
+                        vis |= col_ids < prefix
                 s = jnp.where(vis, s, NEG_INF)
             p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dp = jax.lax.dot_general(                            # dO @ V^T
@@ -559,17 +591,25 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dD_ref, k_ref, v_ref,
                 0, nb, functools.partial(body, masked=False), acc0)
         lower, full_lo, full_hi, upper = _kv_band_bounds(
             row_min, row_max, sj_abs * super_kv, block_kv, nb, window, prefix)
+        # same specialization conditions as the forward: band-edge tiles
+        # sit at cols <= row_min (causal compare redundant), diagonal
+        # tiles stay inside the window when window >= block_q + block_kv
+        edge_mode = "edge" if window is not None else "both"
+        diag_mode = "diag" if prefix is None and (
+            window is None or window >= block_q + block_kv) else "both"
         acc0 = jax.lax.fori_loop(
-            lower, full_lo, functools.partial(body, masked=True), acc0)
+            lower, full_lo, functools.partial(body, masked=edge_mode), acc0)
         acc0 = jax.lax.fori_loop(
             full_lo, full_hi, functools.partial(body, masked=False), acc0)
         return jax.lax.fori_loop(
-            full_hi, upper, functools.partial(body, masked=True), acc0)
+            full_hi, upper, functools.partial(body, masked=diag_mode), acc0)
 
     d = q_ref.shape[1]
 
     def finish(carry):
-        dq_ref[:] = carry[0].astype(dq_ref.dtype)
+        # dq = (dS @ K) * sm_scale applied on the in-register carry —
+        # the caller previously did this as a whole-tensor XLA pass
+        dq_ref[:] = (carry[0] * out_scale).astype(dq_ref.dtype)
 
     live = True if not causal else (sj_abs * super_kv <= row_max)
     if causal and window is not None:
@@ -589,7 +629,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                           dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
                           block_kv: int, causal: bool,
                           num_super: int, group: int, window=None,
-                          row_offset: int = 0, prefix=None, q_first=None):
+                          row_offset: int = 0, prefix=None, q_first=None,
+                          q_scale: float = 1.0, dk_scale: float = 1.0):
     """dk/dv for one (batch*kv-head, kv-block, q-group, q-superblock) cell.
 
     dv = sum_i P_i^T @ dO_i; dk = sum_i dS_i^T @ Q_i * scale. The q axis
@@ -614,7 +655,10 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
 
         def body(i2, carry, masked):
             dk_acc, dv_acc = carry
-            qb = q_ref[pl.ds(i2 * block_q, block_q), :]
+            # in-kernel scale fold ([bq, d] multiply per q block — small
+            # next to the three [bq, bkv] matmuls it sits beside)
+            qb = (q_ref[pl.ds(i2 * block_q, block_q), :]
+                  .astype(jnp.float32) * q_scale).astype(q_ref.dtype)
             dob = do_ref[pl.ds(i2 * block_q, block_q), :]
             lse2 = lse_ref[pl.ds(i2 * block_q, block_q), :] * LOG2E
             dD = dD_ref[pl.ds(i2 * block_q, block_q), :]
@@ -622,16 +666,26 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
                 qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             if masked:
+                # specialized like the forward: "diag" tiles straddle
+                # the diagonal (causal compare only), "edge" tiles are
+                # where rows age out of the window (window compare
+                # only) — in a banded walk nearly every tile is masked,
+                # so the dropped compare is a large VPU saving
                 row_ids = (row_offset + si_abs * super_q + i2 * block_q
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (block_q, 1), 0))
                 col_ids = kv_start + jax.lax.broadcasted_iota(
                     jnp.int32, (1, block_kv), 1)
-                vis = row_ids >= col_ids
-                if window is not None:
-                    vis &= row_ids - col_ids < window
-                if prefix is not None:
-                    vis |= col_ids < prefix
+                if masked == "diag":
+                    vis = row_ids >= col_ids
+                elif masked == "edge":
+                    vis = row_ids - col_ids < window
+                else:
+                    vis = row_ids >= col_ids
+                    if window is not None:
+                        vis &= row_ids - col_ids < window
+                    if prefix is not None:
+                        vis |= col_ids < prefix
                 s = jnp.where(vis, s, NEG_INF)
             p = jnp.exp2(s - lse2)                               # [bq, bkv]
             dv_acc = dv_acc + jax.lax.dot_general(               # P^T @ dO
@@ -680,18 +734,27 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dD_ref,
             full_end = jnp.clip(
                 (kv_start + window - block_q - q0) // block_q + 1,
                 first_full, upper)
+        # [lower, first_full) straddles the diagonal; [full_end, upper)
+        # is the window edge; both compares only needed in the fallback
+        # (narrow windows / prefix-LM)
+        diag_mode = "diag" if prefix is None and (
+            window is None or window >= block_q + block_kv) else "both"
+        edge_mode = ("edge" if window is not None
+                     and window >= block_q + block_kv else "both")
         carry = jax.lax.fori_loop(
-            lower, first_full, functools.partial(body, masked=True), carry)
+            lower, first_full, functools.partial(body, masked=diag_mode), carry)
         carry = jax.lax.fori_loop(
             first_full, full_end, functools.partial(body, masked=False), carry)
         return jax.lax.fori_loop(
-            full_end, upper, functools.partial(body, masked=True), carry)
+            full_end, upper, functools.partial(body, masked=edge_mode), carry)
 
     d = k_ref.shape[1]
 
     def finish(carry):
         dk_acc, dv_acc = carry
-        dk_ref[:] = dk_acc.astype(dk_ref.dtype)
+        # dk accumulated against the scaled q tiles carries a stray
+        # LOG2E — divided out here in-register (was a whole-tensor pass)
+        dk_ref[:] = (dk_acc * dk_scale).astype(dk_ref.dtype)
         dv_ref[:] = dv_acc.astype(dv_ref.dtype)
 
     live = (True if not causal
@@ -718,12 +781,14 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     block_kv = _fit_block(block_kv, tkv)
     sm_scale = 1.0 / math.sqrt(d)
 
-    # Same pre-folded scale as the forward: the kernels see
-    # qs = q * sm_scale * LOG2E, compute ds = p * (dp - dD) with no
-    # in-loop scale, and the tiny [.., d]-shaped corrections below restore
-    # dq = (ds @ K) * sm_scale and dk = (ds^T @ qs) / LOG2E.
-    qs = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
-    qf = qs.reshape(b * h_kv, group, t, d)
+    # Scale handling mirrors the forward: sm_scale * LOG2E is folded
+    # into q TILES inside each kernel (no scaled whole-tensor copy
+    # through HBM), the kernels compute ds = p * (dp - dD) with no
+    # in-loop scale, and the output corrections — dq = (ds @ K) *
+    # sm_scale, dk = (ds^T @ qs) / LOG2E — are applied in-register in
+    # each kernel's finish (previously two more whole-tensor XLA
+    # passes).
+    qf = q.reshape(b * h_kv, group, t, d)
     kf = k.reshape(b * h_kv, tkv, d)
     vf = v.reshape(b * h_kv, tkv, d)
     gf = g.reshape(b * h_kv, group, t, d)
@@ -782,7 +847,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                           window=window, row_offset=row_offset,
                           prefix=prefix,
                           kv_first=None if ns_dq == tkv // super_kv
-                          else kv_first),
+                          else kv_first,
+                          q_scale=sm_scale * LOG2E, out_scale=sm_scale),
         grid=(b * h_kv, group, t // block_q, ns_dq),
         in_specs=[q_outer, q_outer, row_outer, row_outer, kvs_inner, kvs_inner],
         out_specs=q_outer,
@@ -799,7 +865,9 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                           group=group, window=window,
                           row_offset=row_offset, prefix=prefix,
                           q_first=None if ns_dkv == t // super_q
-                          else q_first),
+                          else q_first,
+                          q_scale=sm_scale * LOG2E,
+                          dk_scale=1.0 / LOG2E),
         grid=(b * h_kv, tkv // block_kv, group, ns_dkv),
         in_specs=[kv_outer, kv_outer, qs_inner, qs_inner, rows_inner, rows_inner],
         out_specs=(kv_outer, kv_outer),
@@ -812,8 +880,6 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                             "arbitrary")),
     )(kf, vf, qf, gf, lse4, dD)
 
-    dq = (dq.astype(jnp.float32) * sm_scale).astype(q.dtype)
-    dk = (dk.astype(jnp.float32) * (1.0 / LOG2E)).astype(k.dtype)
     return (dq.reshape(b, h, t, d), dk.reshape(b, h_kv, tkv, d),
             dv.reshape(b, h_kv, tkv, d))
 
