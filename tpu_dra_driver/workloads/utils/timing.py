@@ -126,6 +126,17 @@ def device_seconds_per_step(run: Callable[[], Any], n_steps: int) -> Optional[fl
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def device_seconds_total(run: Callable[[], Any]) -> Optional[float]:
+    """Total on-device seconds of one ``run()`` invocation (profiler
+    trace, XLA Modules lane) — host-side dispatch gaps and transport
+    latency excluded. The honest numerator/denominator for comparing
+    two host-driven loops whose dispatch patterns differ (e.g. batched
+    serving vs per-request decoding): wall clock on a tunneled device
+    would mostly measure the dispatch pattern, not the chip. None when
+    no device lane is available (CPU/interpret)."""
+    return device_seconds_per_step(run, 1)
+
+
 def chain_seconds_per_step(make_run: Callable[[int], Callable[[], Any]],
                            chain_short: int, chain_long: int,
                            iters: int = 3) -> float:
