@@ -2,7 +2,7 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test bench demo e2e e2e-kind e2e-sim clean protos
+.PHONY: all native test test-fast lint typecheck bench demo e2e e2e-kind e2e-sim clean protos
 
 all: native
 
@@ -12,8 +12,23 @@ native:
 protos:
 	cd tpu_dra_driver/grpc_api && protoc --python_out=. *.proto
 
-test: native
+# Static analysis gate (reference: make lint / golangci-lint + CodeQL,
+# Makefile:33-35,84-85). Uses ruff/mypy when installed; this image has
+# neither, so tools/ fall back to stdlib-AST lint + import/annotation
+# resolution. Both exit nonzero on findings.
+lint:
+	$(PYTHON) tools/lint.py
+
+typecheck:
+	$(PYTHON) tools/typecheck.py
+
+test: native lint typecheck
 	$(PYTHON) -m pytest tests/ -q
+
+# Driver tier only (< 2 min): gates every commit; the slow tier is the
+# JAX workload suite (see pytest.ini)
+test-fast: native lint typecheck
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
 bench: native
 	$(PYTHON) bench.py

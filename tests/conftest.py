@@ -24,3 +24,33 @@ except RuntimeError:
     pass  # backend already initialized (can't happen under pytest startup)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+# JAX-compile-heavy modules (Pallas kernels, SPMD meshes, end-to-end
+# model demos): the "slow" tier. Everything else is the driver tier,
+# which `pytest -m "not slow"` runs in under two minutes — fast enough
+# to gate every commit (see pytest.ini).
+_SLOW_MODULES = frozenset({
+    "test_attention",
+    "test_beam",
+    "test_data",
+    "test_decode_attention",
+    "test_lora",
+    "test_paged_attention",
+    "test_pipeline",
+    "test_quantize",
+    "test_serving_demo",
+    "test_serving_engine",
+    "test_speculative",
+    "test_spmd_model",
+    "test_train_checkpoint",
+    "test_training_demo",
+    "test_workloads",
+})
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)

@@ -20,7 +20,6 @@ the contract containerd's CDI cache enforces before applying edits.
 
 from __future__ import annotations
 
-import json
 import os
 import signal
 import subprocess

@@ -5,7 +5,6 @@ Reference bar: clique identity from the hardware probe
 (/root/reference/cmd/compute-domain-kubelet-plugin/nvlib.go:188-356).
 """
 
-import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -253,5 +252,36 @@ def test_v5litepod_spelling_normalized(tmp_path, no_tpu_env):
         lib = _native_lib(tmp_path, metadata_host=srv.host)
         assert lib.host_topology().generation.name == "v5e"
         lib.close()
+    finally:
+        srv.stop()
+
+
+def test_ipv6_worker_endpoints_parse_whole_address(no_tpu_env):
+    """worker-network-endpoints records are colon-separated with the IP
+    last — an IPv6 address has colons INSIDE the field, so the parser
+    must take the longest valid-IP suffix, not the last token
+    (ADVICE r3: rsplit alone yields the final hextet)."""
+    attrs = dict(ATTRS)
+    attrs["worker-network-endpoints"] = (
+        "w0:uuid0:2001:db8::1,w1:uuid1:10.9.0.3,w2:uuid2:not-an-ip")
+    srv = FakeMetadataServer(attrs)
+    try:
+        md = MetadataClient(host=srv.host).tpu_metadata()
+        # the malformed record is skipped, not mangled
+        assert md.worker_endpoints == ["2001:db8::1", "10.9.0.3"]
+    finally:
+        srv.stop()
+
+
+def test_hexlike_field_does_not_absorb_into_ipv6(no_tpu_env):
+    """Field position is the primary parse: a hex-like uuid field next
+    to a compressed IPv6 must NOT be absorbed into the address (the
+    suffix scan alone would yield 'beef:2001:db8::1')."""
+    attrs = dict(ATTRS)
+    attrs["worker-network-endpoints"] = "w0:beef:2001:db8::1"
+    srv = FakeMetadataServer(attrs)
+    try:
+        md = MetadataClient(host=srv.host).tpu_metadata()
+        assert md.worker_endpoints == ["2001:db8::1"]
     finally:
         srv.stop()

@@ -9,7 +9,6 @@ against the fake backend.
 """
 
 import json
-import os
 
 import pytest
 
@@ -26,9 +25,7 @@ from tpu_dra_driver.plugin.checkpoint import (
     PREPARE_COMPLETED,
     PREPARE_STARTED,
 )
-from tpu_dra_driver.plugin.claims import ClaimInfo, build_allocated_claim
-from tpu_dra_driver.plugin.cleanup import CheckpointCleanupManager
-from tpu_dra_driver.plugin.device_state import DeviceState, PermanentError
+from tpu_dra_driver.plugin.claims import build_allocated_claim
 from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
 from tpu_dra_driver.plugin.resourceslices import build_resource_slices
 from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib

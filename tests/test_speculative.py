@@ -11,7 +11,6 @@ from tpu_dra_driver.workloads.models import (
     generate,
     init_kv_cache,
     init_params,
-    quantize_params,
     self_speculative_generate,
     speculative_generate,
     wide_step,

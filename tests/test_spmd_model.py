@@ -226,7 +226,6 @@ def test_tp_sharded_int8_decode():
     sharded int8 decode tracks the single-device int8 decode."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from tpu_dra_driver.workloads.models import generate, quantize_params
-    from tpu_dra_driver.workloads.models.quantize import QTensor
     from tpu_dra_driver.workloads.parallel import build_mesh
 
     cfg = ModelConfig(vocab=256, d_model=128, n_heads=4, n_kv_heads=2,

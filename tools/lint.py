@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Repo linter: ruff when available, stdlib-AST fallback otherwise.
+
+Reference analog: `make lint` running golangci-lint
+(/root/reference/Makefile:33-35,84-85). This image has no ruff/flake8
+and installs are barred, so the fallback implements the highest-value
+subset directly on the stdlib ``ast``:
+
+- E9: syntax errors (ast.parse);
+- F401: unused imports (skipped in ``__init__.py`` — re-export files —
+  and on lines carrying ``# noqa``);
+- B006: mutable default arguments;
+- E722: bare ``except:``;
+- E711: comparison to None with ==/!=;
+- F541/F-str: f-strings without placeholders;
+- W291/W191: trailing whitespace / tab indentation.
+
+Exit 0 = clean. Any finding prints ``path:line: CODE message`` and
+exits 1, so the target is CI-gating like the reference's.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TARGETS = [
+    "tpu_dra_driver",
+    "tests",
+    "demo",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+# protoc output is generated, not maintained here
+GENERATED_MARKERS = ("_pb2.py", "_pb2_grpc.py")
+
+
+def _try_ruff(paths) -> int | None:
+    import importlib.util
+    if importlib.util.find_spec("ruff") is None:
+        return None
+    proc = subprocess.run(
+        [sys.executable, "-m", "ruff", "check", *paths], cwd=REPO)
+    return proc.returncode
+
+
+def _py_files(paths):
+    for target in paths:
+        full = os.path.join(REPO, target)
+        if os.path.isfile(full):
+            yield full
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in sorted(filenames):
+                if f.endswith(".py") and not f.endswith(GENERATED_MARKERS):
+                    yield os.path.join(dirpath, f)
+
+
+class _UseCollector(ast.NodeVisitor):
+    """Collects every name that could consume an import: bare names,
+    attribute roots, names inside string annotations, __all__ strings."""
+
+    def __init__(self):
+        self.used: set[str] = set()
+
+    def visit_Name(self, node):  # noqa: N802
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):  # noqa: N802
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            self.used.add(root.id)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node):  # noqa: N802
+        # string annotations / __all__ entries / typing forward refs
+        if isinstance(node.value, str):
+            for tok in (node.value.replace("[", " ").replace("]", " ")
+                        .replace(",", " ").replace(".", " ").split()):
+                if tok.isidentifier():
+                    self.used.add(tok)
+        self.generic_visit(node)
+
+
+def _noqa_lines(src: str) -> set:
+    return {i for i, line in enumerate(src.splitlines(), 1)
+            if "# noqa" in line}
+
+
+def lint_file(path: str) -> list:
+    findings = []
+    rel = os.path.relpath(path, REPO)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [(rel, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+    noqa = _noqa_lines(src)
+
+    for i, line in enumerate(src.splitlines(), 1):
+        if i in noqa:
+            continue
+        if line.rstrip("\n") != line.rstrip():
+            findings.append((rel, i, "W291", "trailing whitespace"))
+        if line.startswith("\t"):
+            findings.append((rel, i, "W191", "tab indentation"))
+
+    uses = _UseCollector()
+    uses.visit(tree)
+    is_init = os.path.basename(path) == "__init__.py"
+
+    # format specs ({x:.2f}) are themselves JoinedStr nodes — never
+    # F541 candidates
+    spec_nodes = {id(n.format_spec) for n in ast.walk(tree)
+                  if isinstance(n, ast.FormattedValue)
+                  and n.format_spec is not None}
+
+    for node in ast.walk(tree):
+        line = getattr(node, "lineno", 0)
+        if line in noqa:
+            continue
+        if isinstance(node, (ast.Import, ast.ImportFrom)) and not is_init:
+            if getattr(node, "module", None) == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = (alias.asname or alias.name).split(".")[0]
+                # "import x as x" is the typed re-export idiom
+                if alias.asname and alias.asname == alias.name:
+                    continue
+                if bound not in uses.used:
+                    findings.append(
+                        (rel, line, "F401",
+                         f"'{alias.asname or alias.name}' imported but "
+                         f"unused"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in (node.args.defaults
+                      + [d for d in node.args.kw_defaults if d is not None]):
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(d, ast.Call)
+                        and isinstance(d.func, ast.Name)
+                        and d.func.id in ("list", "dict", "set")):
+                    findings.append(
+                        (rel, d.lineno, "B006",
+                         f"mutable default argument in {node.name}()"))
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append((rel, line, "E722", "bare 'except:'"))
+        elif isinstance(node, ast.Compare):
+            for op, right in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(right, ast.Constant)
+                        and right.value is None):
+                    findings.append(
+                        (rel, line, "E711",
+                         "comparison to None with ==/!= (use is/is not)"))
+        elif isinstance(node, ast.JoinedStr) and id(node) not in spec_nodes:
+            if not any(isinstance(v, ast.FormattedValue)
+                       for v in node.values):
+                findings.append(
+                    (rel, line, "F541", "f-string without placeholders"))
+    return findings
+
+
+def main() -> int:
+    paths = sys.argv[1:] or TARGETS
+    rc = _try_ruff(paths)
+    if rc is not None:
+        return rc
+    findings = []
+    n = 0
+    for path in _py_files(paths):
+        n += 1
+        findings.extend(lint_file(path))
+    for rel, line, code, msg in sorted(findings):
+        print(f"{rel}:{line}: {code} {msg}")
+    print(f"lint: {n} files, {len(findings)} finding(s) "
+          f"(stdlib-AST fallback; ruff not installed)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

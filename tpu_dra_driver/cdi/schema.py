@@ -11,7 +11,7 @@ tags.cncf.io/container-device-interface/specs-go/config.go.
 from __future__ import annotations
 
 import re
-from typing import Dict, List
+from typing import Dict
 
 import jsonschema
 

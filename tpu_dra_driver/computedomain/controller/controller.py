@@ -42,7 +42,6 @@ from tpu_dra_driver.computedomain.controller.objects import (
     build_daemonset,
     build_workload_rct,
     daemon_rct_name,
-    daemonset_name,
 )
 from tpu_dra_driver.kube.client import ABORT, ClientSets
 from tpu_dra_driver.kube.errors import AlreadyExistsError, ConflictError, NotFoundError

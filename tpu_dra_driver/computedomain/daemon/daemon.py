@@ -30,8 +30,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
-from tpu_dra_driver.api.types import STATUS_READY
-from tpu_dra_driver.computedomain import COMPUTE_DOMAIN_LABEL_KEY, DRIVER_NAMESPACE
+from tpu_dra_driver.computedomain import DRIVER_NAMESPACE
 from tpu_dra_driver.computedomain.daemon.clique import CliqueMembership
 from tpu_dra_driver.computedomain.daemon.dnsnames import (
     update_hosts_file,

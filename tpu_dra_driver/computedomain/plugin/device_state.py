@@ -29,7 +29,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME

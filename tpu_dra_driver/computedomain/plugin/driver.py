@@ -13,10 +13,8 @@ budget, so workload pods sit in ContainerCreating until release.
 from __future__ import annotations
 
 import logging
-import os
-import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME

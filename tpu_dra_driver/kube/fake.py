@@ -26,7 +26,7 @@ import threading
 import time
 import uuid as uuidlib
 from collections import deque
-from typing import Callable, Deque, Dict, Iterator, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from tpu_dra_driver.kube.errors import (
     AlreadyExistsError,

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Dict, Iterable, Mapping
+from typing import Dict, Mapping
 
 
 class Stage(Enum):

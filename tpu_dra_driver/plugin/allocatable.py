@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from enum import Enum
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from tpu_dra_driver.pkg import featuregates as fg
 from tpu_dra_driver.tpulib.interface import ChipInfo, TpuLib

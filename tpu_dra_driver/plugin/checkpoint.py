@@ -27,7 +27,7 @@ import json
 import os
 import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 # Claim prepare states (reference device_state.go:231-283)
 PREPARE_STARTED = "PrepareStarted"

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, Optional
+from typing import Optional
 
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.kube.errors import NotFoundError

@@ -26,16 +26,10 @@ import os
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Set
 
-from tpu_dra_driver.api.configs import (
-    MultiProcessConfig,
-    SubsliceConfig,
-    TimeSlicingConfig,
-    TpuConfig,
-    VfioTpuConfig,
-)
+from tpu_dra_driver.api.configs import SubsliceConfig, TpuConfig, VfioTpuConfig
 from tpu_dra_driver.api.decoder import STRICT_DECODER, DecodeError
 from tpu_dra_driver.cdi.generator import CdiDevice, CdiHandler, ContainerEdits
 from tpu_dra_driver.pkg import featuregates as fg
@@ -72,10 +66,8 @@ from tpu_dra_driver.tpulib.partition import (
     ParsedChip,
     ParsedSubslice,
     ParsedVfio,
-    SubsliceProfile,
     SubsliceSpec,
     parse_canonical_name,
-    parse_profile_id,
 )
 
 log = logging.getLogger(__name__)

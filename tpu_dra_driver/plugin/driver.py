@@ -21,7 +21,6 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
-from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.cdi.generator import CdiHandler, DEFAULT_CDI_ROOT
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.pkg import featuregates as fg

@@ -17,14 +17,12 @@ bumps on every republish so the scheduler discards stale slices.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.plugin.allocatable import (
     AllocatableDevice,
-    DeviceType,
     chip_counter_set,
 )
 

@@ -24,7 +24,7 @@ import logging
 import os
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from tpu_dra_driver import COMPUTE_DOMAIN_DRIVER_NAME as CD_DRIVER_NAME

@@ -44,12 +44,11 @@ from tpu_dra_driver.tpulib.interface import (
 )
 from tpu_dra_driver.tpulib.partition import (
     SubsliceLiveTuple,
-    SubsliceProfile,
     SubsliceSpec,
     SubsliceSpecTuple,
     parse_profile_id,
 )
-from tpu_dra_driver.tpulib.topology import GENERATIONS, SliceTopology
+from tpu_dra_driver.tpulib.topology import SliceTopology
 
 
 def _stable_hex(*parts: object, n: int = 8) -> str:

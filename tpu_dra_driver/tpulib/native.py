@@ -27,7 +27,7 @@ import ctypes
 import json
 import os
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from tpu_dra_driver.tpulib.interface import (
@@ -49,7 +49,7 @@ from tpu_dra_driver.tpulib.partition import (
     SubsliceSpec,
     SubsliceSpecTuple,
 )
-from tpu_dra_driver.tpulib.topology import GENERATIONS, Generation, SliceTopology
+from tpu_dra_driver.tpulib.topology import GENERATIONS, SliceTopology
 
 _GEN_BY_CODE = {4: "v4", 50: "v5e", 51: "v5p", 60: "v6e"}
 
@@ -508,11 +508,16 @@ class NativeTpuLib(TpuLib):
         return self._lib.tpudev_health_poller_new(
             self._cfg.sysfs_root.encode(), self._cfg.devfs_root.encode())
 
-    def _poll_native_health(self, poller) -> List[HealthEvent]:
-        out = (_HealthEventStruct * 64)()
+    def _poll_native_health(self, poller,
+                            max_out: int = 64) -> List[HealthEvent]:
+        """One native poll. A full buffer (len == max_out) may mean
+        truncation; the C side keeps the affected chips' baselines so
+        dropped deltas re-emit on the next poll — poll again rather
+        than assuming quiet."""
+        out = (_HealthEventStruct * max_out)()
         err = self._err()
-        n = self._lib.tpudev_health_poll(ctypes.c_void_p(poller), out, 64,
-                                         err, len(err))
+        n = self._lib.tpudev_health_poll(ctypes.c_void_p(poller), out,
+                                         max_out, err, len(err))
         if n < 0:
             raise TpuLibError(f"health poll: {err.value.decode()}")
         return [HealthEvent(

@@ -14,7 +14,6 @@ the bench's bandwidth targets, not any runtime decision.
 
 from __future__ import annotations
 
-import dataclasses
 import itertools
 import re
 from dataclasses import dataclass

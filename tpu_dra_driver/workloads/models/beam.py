@@ -27,7 +27,6 @@ draft-verify in speculative.py).
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

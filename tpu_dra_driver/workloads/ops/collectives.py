@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_dra_driver.workloads.utils.timing import Timed, time_fn
+from tpu_dra_driver.workloads.utils.timing import time_fn
 
 
 @dataclass
