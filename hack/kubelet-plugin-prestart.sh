@@ -1,41 +1,154 @@
 #!/bin/sh
 # Prestart validation for the kubelet-plugin DaemonSet init container.
 #
-# Reference analog: hack/kubelet-plugin-prestart.sh — waits for the driver
-# install and emits actionable hints. TPU variant: validate libtpu presence
-# and TPU device nodes instead of nvidia-smi.
-set -eu
+# Main intent (mirroring the reference hack/kubelet-plugin-prestart.sh:1-166):
+# when the TPU runtime is not set up properly before this DRA driver is
+# installed, the log of THIS init container must yield an actionable,
+# per-failure-mode error message — not a generic timeout. The container
+# retries at constant frequency and leaves only on success; k8s handles
+# higher-level backoff.
+#
+# Failure modes distinguished (each with its own HINT):
+#   M1  driver root empty on the host         -> runtime not installed
+#   M2  root non-empty but libtpu.so missing  -> wrong tpuDriverRoot
+#   M3  libtpu found under a COMMON ALTERNATE root -> exact --set hint
+#   M4  libtpu present but not an ELF object  -> corrupt/partial install
+#   M5  no /dev/accel* or /dev/vfio/* nodes   -> kernel driver/privilege
+#   M6  device nodes exist but are unreadable -> pod not privileged
+#
+# Testable seams (used by tests/test_prestart_script.py, no effect in
+# production): DRIVER_ROOT_MNT (default /driver-root), TPU_DEV_DIR
+# (default /dev), PRESTART_TRIES, PRESTART_WAIT_S.
+set -u
 
 DRIVER_ROOT="${TPU_DRIVER_ROOT:-/home/kubernetes/bin}"
-LIBTPU="/driver-root/libtpu.so"
-TRIES="${PRESTART_TRIES:-60}"
+ROOT_MNT="${DRIVER_ROOT_MNT:-/driver-root}"
+PARENT_MNT="${DRIVER_ROOT_PARENT_MNT:-/driver-root-parent}"
+DEV_DIR="${TPU_DEV_DIR:-/dev}"
+TRIES="${PRESTART_TRIES:-0}"          # 0 = retry forever (init-container mode)
+WAIT_S="${PRESTART_WAIT_S:-10}"
+HINT_EVERY="${PRESTART_HINT_EVERY:-6}"
 
-echo "tpu-dra-driver prestart: validating TPU runtime on this node"
+# Alternate host locations libtpu commonly lands in; scanned for the M3
+# hint. Checked relative to the parent mount when present.
+ALT_ROOTS="/usr/lib /usr/local/lib /lib /run/tpu/driver/lib"
 
-i=0
-while [ ! -e "$LIBTPU" ]; do
-  i=$((i + 1))
-  if [ "$i" -ge "$TRIES" ]; then
-    echo >&2 "ERROR: libtpu.so not found under ${DRIVER_ROOT} after ${TRIES} tries."
-    echo >&2 "HINT: is the TPU runtime installed on this node? On GKE TPU"
-    echo >&2 "node pools libtpu ships under /home/kubernetes/bin; set"
-    echo >&2 "tpuDriverRoot in the Helm values if yours differs."
-    exit 1
-  fi
-  echo "waiting for ${LIBTPU} (attempt ${i}/${TRIES})…"
-  sleep 5
-done
-echo "found libtpu: ${LIBTPU}"
+log() { echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $*"; }
+err() { echo "$@" >&2; }
 
-if ls /dev/accel* >/dev/null 2>&1; then
-  echo "TPU device nodes: $(ls /dev/accel* | tr '\n' ' ')"
-elif ls /dev/vfio/* >/dev/null 2>&1; then
-  echo "vfio groups present (passthrough mode): $(ls /dev/vfio | tr '\n' ' ')"
-else
-  echo >&2 "ERROR: no /dev/accel* or /dev/vfio/* device nodes visible."
-  echo >&2 "HINT: the plugin pod must mount /dev and run privileged; check"
-  echo >&2 "the TPU kernel driver is loaded (lsmod | grep -i tpu)."
-  exit 1
+# The DS also mounts the HOST ROOT read-only at $PARENT_MNT (chart
+# kubeletplugin.yaml volume driver-root-parent): that is what lets the
+# M3 hint find a libtpu living under a different root, and it gives the
+# driver-root view a chance to "heal" by symlink when the direct mount
+# is absent (the reference's symlink trick).
+if [ ! -e "$ROOT_MNT" ] && [ -d "$PARENT_MNT" ]; then
+  target="${PARENT_MNT}${DRIVER_ROOT%/}"
+  log "create symlink: $ROOT_MNT -> $target"
+  ln -s "$target" "$ROOT_MNT" 2>/dev/null || true
 fi
 
-echo "prestart OK"
+find_libtpu() {
+  for d in "$ROOT_MNT" "$ROOT_MNT/lib" "$ROOT_MNT/lib64" \
+           "$ROOT_MNT/usr/lib" "$ROOT_MNT/usr/lib64"; do
+    if [ -f "$d/libtpu.so" ]; then
+      echo "$d/libtpu.so"
+      return 0
+    fi
+  done
+  return 1
+}
+
+emit_hints() {
+  err ""
+  err "Check failed. Has the TPU runtime been set up? libtpu.so is"
+  err "expected under TPU_DRIVER_ROOT (currently '${DRIVER_ROOT}') in the"
+  err "host filesystem. If that path looks wrong, review the chart's"
+  err "'tpuDriverRoot' value; otherwise verify the runtime is actually"
+  err "installed there."
+  if [ ! -e "$ROOT_MNT" ] || [ -z "$(ls -A "$ROOT_MNT" 2>/dev/null)" ]; then
+    err "HINT(M1): host directory '${DRIVER_ROOT}' is empty or missing —"
+    err "  the TPU runtime is not installed on this node. On GKE TPU node"
+    err "  pools libtpu ships under /home/kubernetes/bin; on self-managed"
+    err "  nodes install the libtpu runtime first."
+  elif [ -z "${LIBTPU:-}" ]; then
+    err "HINT(M2): '${DRIVER_ROOT}' is not empty but libtpu.so was not"
+    err "  found in it (searched ., lib, lib64, usr/lib, usr/lib64) —"
+    err "  tpuDriverRoot likely points at the wrong directory."
+    for alt in $ALT_ROOTS; do
+      if [ -f "${PARENT_MNT}${alt}/libtpu.so" ]; then
+        err "HINT(M3): found libtpu.so under host path '${alt}' —"
+        err "  re-install the chart with --set tpuDriverRoot=${alt}"
+        break
+      fi
+    done
+  fi
+  err ""
+}
+
+attempt=0
+while :; do
+  attempt=$((attempt + 1))
+  LIBTPU="$(find_libtpu || true)"
+  if [ -n "$LIBTPU" ]; then
+    # ELF magic: a truncated/corrupt libtpu fails here with its own hint
+    magic="$(head -c 4 "$LIBTPU" 2>/dev/null | od -An -c | tr -d ' \n')"
+    case "$magic" in
+      *177ELF*)
+        log "found libtpu: $LIBTPU (valid ELF)"
+        if ls "$DEV_DIR"/accel* >/dev/null 2>&1; then
+          nodes="$(ls "$DEV_DIR"/accel* | tr '\n' ' ')"
+          log "TPU device nodes: $nodes"
+          unreadable=""
+          for n in "$DEV_DIR"/accel*; do
+            [ -r "$n" ] || unreadable="$unreadable $n"
+          done
+          if [ -n "$unreadable" ]; then
+            err "ERROR(M6): device node(s)$unreadable exist but are not"
+            err "  readable by this pod."
+            err "HINT(M6): the kubelet-plugin pod must run privileged and"
+            err "  mount ${DEV_DIR}; check the DaemonSet securityContext."
+          else
+            log "prestart OK"
+            exit 0
+          fi
+        elif ls "$DEV_DIR"/vfio/* >/dev/null 2>&1; then
+          log "vfio groups present (passthrough mode): $(ls "$DEV_DIR"/vfio | tr '\n' ' ')"
+          log "prestart OK"
+          exit 0
+        else
+          err "ERROR(M5): no ${DEV_DIR}/accel* or ${DEV_DIR}/vfio/* device"
+          err "  nodes visible."
+          err "HINT(M5): check the TPU kernel driver is loaded on the host"
+          err "  (lsmod | grep -i tpu) and that the pod mounts ${DEV_DIR}."
+        fi
+        ;;
+      *)
+        err "ERROR(M4): $LIBTPU exists but is not an ELF object"
+        err "  (magic: '$magic')."
+        err "HINT(M4): the runtime install looks corrupt or partial —"
+        err "  re-install libtpu on the node, then restart this pod."
+        ;;
+    esac
+  elif [ $((attempt % HINT_EVERY)) -eq 1 ]; then
+    # throttle the long diagnosis to every Nth attempt, like the
+    # reference (log volume); the first attempt always explains itself
+    emit_hints
+  fi
+
+  if [ "$TRIES" -gt 0 ] && [ "$attempt" -ge "$TRIES" ]; then
+    err "ERROR: TPU runtime validation failed after ${TRIES} attempt(s)."
+    if [ -z "$LIBTPU" ]; then
+      # libtpu never appeared: the M1/M2/M3 diagnosis is the story
+      emit_hints
+    else
+      # libtpu WAS found — the cause is the last ERROR(M4/M5/M6) above;
+      # repeating the missing-libtpu preamble here would point the
+      # operator at the wrong failure mode
+      err "libtpu was found at '$LIBTPU'; see the last ERROR above for"
+      err "the failing check."
+    fi
+    exit 1
+  fi
+  log "retrying in ${WAIT_S}s (attempt ${attempt})"
+  sleep "$WAIT_S"
+done
