@@ -634,16 +634,23 @@ extern "C" int tpudev_health_poll(tpudev_health_poller_t* p,
                            chips, 64, err, errlen);
   if (n < 0) return -1;
 
+  // emit() returns false when the event no longer fits in out[] —
+  // callers of that lambda must then keep the PREVIOUS baseline for the
+  // affected chip so the dropped delta is re-detected (and re-emitted)
+  // on the next poll. Advancing the baseline past a dropped event would
+  // permanently lose an unhealthy signal (latent with today's 64-slot
+  // buffers, but a contract, not a hope).
   int emitted = 0;
   auto emit = [&](const char* uuid, int kind, int code, const char* fmt,
-                  long long a, long long b) {
-    if (emitted >= max_out) return;
+                  long long a, long long b) -> bool {
+    if (emitted >= max_out) return false;
     tpudev_health_event_t* e = &out[emitted++];
     memset(e, 0, sizeof(*e));
     e->kind = kind;
     e->code = code;
     snprintf(e->chip_uuid, sizeof(e->chip_uuid), "%s", uuid);
     snprintf(e->message, sizeof(e->message), fmt, a, b);
+    return true;
   };
 
   std::vector<std::string> now_pci, now_uuid;
@@ -658,43 +665,56 @@ extern "C" int tpudev_health_poll(tpudev_health_poller_t* p,
       vals[2 + s] = read_counter(dev_dir + "/" + kCounterSources[s].file);
 
     // diff against the previous poll for this pci address
+    bool dropped = false;
+    size_t prev_idx = p->seen_pci.size();
     for (size_t j = 0; j < p->seen_pci.size(); j++) {
       if (p->seen_pci[j] != chips[i].pci_address) continue;
+      prev_idx = j;
       const std::vector<long long>& prev = p->last[j];
       if (vals[0] >= 0 && prev[0] >= 0 && vals[0] > prev[0])
-        emit(chips[i].uuid, TPUDEV_HEALTH_DEVICE_ERROR, 1,
-             "PCIe AER fatal errors: %lld (+%lld)", vals[0],
-             vals[0] - prev[0]);
+        dropped |= !emit(chips[i].uuid, TPUDEV_HEALTH_DEVICE_ERROR, 1,
+                         "PCIe AER fatal errors: %lld (+%lld)", vals[0],
+                         vals[0] - prev[0]);
       if (vals[1] >= 0 && prev[1] >= 0 && vals[1] > prev[1])
-        emit(chips[i].uuid, TPUDEV_HEALTH_DEVICE_ERROR, 2,
-             "PCIe AER nonfatal errors: %lld (+%lld)", vals[1],
-             vals[1] - prev[1]);
+        dropped |= !emit(chips[i].uuid, TPUDEV_HEALTH_DEVICE_ERROR, 2,
+                         "PCIe AER nonfatal errors: %lld (+%lld)", vals[1],
+                         vals[1] - prev[1]);
       for (size_t s = 0; s < 3; s++) {
         long long cur = vals[2 + s], pv = prev[2 + s];
         if (cur >= 0 && pv >= 0 && cur > pv)
-          emit(chips[i].uuid, kCounterSources[s].kind,
-               kCounterSources[s].code, "counter: %lld (+%lld)", cur,
-               cur - pv);
+          dropped |= !emit(chips[i].uuid, kCounterSources[s].kind,
+                           kCounterSources[s].code, "counter: %lld (+%lld)",
+                           cur, cur - pv);
       }
       break;
     }
     now_pci.push_back(chips[i].pci_address);
     now_uuid.push_back(chips[i].uuid);
-    now_vals.push_back(vals);
+    // baseline only advances when every event for this chip was
+    // delivered; otherwise the old baseline re-detects the delta next
+    // poll
+    now_vals.push_back(dropped && prev_idx < p->last.size()
+                           ? p->last[prev_idx]
+                           : vals);
   }
 
   // surprise removal: chip seen before, absent now. vfio flips keep the
   // PCI function enumerable (only the driver changes), so absence means
-  // the function itself fell off the bus.
+  // the function itself fell off the bus. A removal event that does not
+  // fit keeps the chip in the seen set, so it re-reports next poll.
   if (p->primed) {
     for (size_t j = 0; j < p->seen_pci.size(); j++) {
       bool found = false;
       for (const auto& pci : now_pci)
         if (pci == p->seen_pci[j]) { found = true; break; }
-      if (!found)
-        emit(p->seen_uuid[j].c_str(), TPUDEV_HEALTH_DEVICE_ERROR, 3,
-             "device no longer enumerable (surprise removal)%.0lld%.0lld",
-             0LL, 0LL);
+      if (!found &&
+          !emit(p->seen_uuid[j].c_str(), TPUDEV_HEALTH_DEVICE_ERROR, 3,
+                "device no longer enumerable (surprise removal)%.0lld%.0lld",
+                0LL, 0LL)) {
+        now_pci.push_back(p->seen_pci[j]);
+        now_uuid.push_back(p->seen_uuid[j]);
+        now_vals.push_back(p->last[j]);
+      }
     }
   }
 

@@ -393,3 +393,46 @@ def test_native_health_thread_publishes_sysfs_events(native_lib):
         time.sleep(0.02)
     assert got and got[0].kind == HealthEventKind.HBM_ECC_ERROR
     assert got[0].chip_uuid == chip.uuid
+
+
+def test_native_health_truncation_reemits_next_poll(native_lib):
+    """Events that do not fit in max_out must NOT advance the affected
+    chip's baseline: the dropped delta re-emits on the next poll
+    (ADVICE r3 — a truncated poll previously lost the signal forever)."""
+    chips = native_lib.enumerate_chips()
+    a, b = chips[0], chips[1]
+    for c in (a, b):
+        open(os.path.join(_dev_dir(native_lib, c), "hbm_ecc_errors"),
+             "w").write("0\n")
+    poller = native_lib._native_health_poller()
+    assert poller is not None
+    assert native_lib._poll_native_health(poller) == []   # prime
+    for c in (a, b):
+        open(os.path.join(_dev_dir(native_lib, c), "hbm_ecc_errors"),
+             "w").write("7\n")
+    first = native_lib._poll_native_health(poller, max_out=1)
+    assert len(first) == 1
+    second = native_lib._poll_native_health(poller)
+    assert len(second) == 1, "dropped event was not re-emitted"
+    assert {first[0].chip_uuid, second[0].chip_uuid} == {a.uuid, b.uuid}
+    assert native_lib._poll_native_health(poller) == []   # now quiet
+
+
+def test_native_health_truncated_removal_reemits(native_lib):
+    """A surprise-removal event dropped by a full buffer keeps the chip
+    in the seen set and re-reports on the next poll."""
+    import shutil as _shutil
+    chips = native_lib.enumerate_chips()
+    d = _dev_dir(native_lib, chips[0])
+    open(os.path.join(d, "hbm_ecc_errors"), "w").write("0\n")
+    poller = native_lib._native_health_poller()
+    assert native_lib._poll_native_health(poller) == []
+    # one counter jump on chip 0 fills the 1-slot buffer; chip 1 vanishes
+    open(os.path.join(d, "hbm_ecc_errors"), "w").write("1\n")
+    _shutil.rmtree(_dev_dir(native_lib, chips[-1]))
+    first = native_lib._poll_native_health(poller, max_out=1)
+    assert len(first) == 1 and first[0].chip_uuid == chips[0].uuid
+    second = native_lib._poll_native_health(poller)
+    assert [e.chip_uuid for e in second] == [chips[-1].uuid]
+    assert second[0].code == 3
+    assert native_lib._poll_native_health(poller) == []

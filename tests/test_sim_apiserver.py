@@ -168,3 +168,33 @@ def test_watch_compacted_rv_answers_in_stream_410(sim):
     assert ev["type"] == "ERROR"
     assert ev["object"]["code"] == 410
     resp.close()
+
+
+def test_unsupported_label_selector_syntax_is_400(sim):
+    """Negated/set-based selector syntax must be rejected, not silently
+    served as a positive equality match (ADVICE r3: '!key' used to be
+    lstripped into 'key')."""
+    import requests
+
+    srv, _ = sim
+    for bad in ("!app", "app!=x", "app in (a,b)"):
+        resp = requests.get(
+            f"{srv.url}/api/v1/pods",
+            params={"labelSelector": bad}, timeout=5)
+        assert resp.status_code == 400, (bad, resp.status_code)
+        assert resp.json()["reason"] == "BadRequest"
+
+
+def test_double_equals_selector_is_equality(sim):
+    """'k==v' is legal k8s equality syntax and must match like 'k=v'
+    (previously partition('=') turned the value into '=v')."""
+    import requests
+
+    srv, rc = sim
+    rc.create("pods", {"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "p", "namespace": "ns",
+                                    "labels": {"app": "web"}}})
+    resp = requests.get(f"{srv.url}/api/v1/pods",
+                        params={"labelSelector": "app==web"}, timeout=5)
+    assert resp.status_code == 200
+    assert [o["metadata"]["name"] for o in resp.json()["items"]] == ["p"]

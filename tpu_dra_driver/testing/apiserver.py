@@ -100,15 +100,34 @@ def _parse_path(path: str) -> Optional[Tuple[str, str, str, str]]:
     return resource, namespace, name, version
 
 
+class SelectorSyntaxError(ValueError):
+    """Label selector uses syntax outside the supported k=v subset."""
+
+
 def _selector_from_query(q: Dict[str, List[str]]) -> Optional[Dict[str, str]]:
+    """Parse ``labelSelector=k=v,k2=v2``. Only positive equality terms
+    are supported; anything else (``!key``, ``key!=v``, set-based
+    ``key in (a,b)``) raises so the handler answers 400 — silently
+    serving a negation as a positive match would invert results for any
+    caller that ever uses one (ADVICE r3)."""
     raw = (q.get("labelSelector") or [""])[0]
     if not raw:
         return None
     sel: Dict[str, str] = {}
     for term in raw.split(","):
-        if "=" in term:
-            k, _, v = term.partition("=")
-            sel[k.strip().lstrip("!")] = v.strip()
+        term = term.strip()
+        if not term:
+            continue
+        if term.startswith("!") or "!=" in term or "(" in term:
+            raise SelectorSyntaxError(
+                f"unsupported label selector term {term!r}: the sim "
+                f"apiserver speaks only 'k=v' equality terms")
+        if "=" not in term:
+            raise SelectorSyntaxError(
+                f"unsupported label selector term {term!r} (no '=')")
+        # both k8s equality spellings: "k=v" and "k==v"
+        k, _, v = term.partition("==" if "==" in term else "=")
+        sel[k.strip()] = v.strip()
     return sel or None
 
 
@@ -194,7 +213,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status(404, "NotFound", f"unserved path {url.path}")
             return
         resource, namespace, name, version = parsed
-        selector = _selector_from_query(q)
+        try:
+            selector = _selector_from_query(q)
+        except SelectorSyntaxError as e:
+            self._send_status(400, "BadRequest", str(e))
+            return
         try:
             if name:
                 obj = self.cluster.get(resource, name, namespace)

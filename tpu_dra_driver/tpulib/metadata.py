@@ -128,10 +128,52 @@ class MetadataClient:
         tpu_env = parse_tpu_env(self.instance_attribute("tpu-env") or "")
         if not accel and not tpu_env:
             return None
-        # worker-network-endpoints entries are ":"-separated records whose
-        # last field is the worker IP
-        endpoints = [e.rsplit(":", 1)[-1].strip()
-                     for e in endpoints_raw.split(",") if e.strip()]
+        # worker-network-endpoints entries are ":"-separated records
+        # whose last field is the worker IP. Validate the extracted
+        # token as an actual IP literal instead of trusting field
+        # position: an IPv6 address carries colons INSIDE the field, so
+        # rsplit alone would yield only its last hextet (ADVICE r3).
+        # Records carry two prefix fields (worker name, uuid) before the
+        # IP, so the IP is everything from field 3 on — parsed by FIELD
+        # POSITION first, which handles IPv6 exactly (colons inside the
+        # address stay attached). Only if that remainder fails to parse
+        # do we fall back to the longest valid-IP suffix (tolerates
+        # extra prefix fields); longest-first, because "db8::1" is
+        # itself valid IPv6 and a shorter match would silently truncate
+        # — and conversely a hex-like prefix field could be absorbed,
+        # which is why position is primary, not the scan. Entries with
+        # no parseable IP are skipped with a warning: a wrong peer IP
+        # is worse than a missing one.
+        import ipaddress
+
+        def _valid_ip(s):
+            try:
+                ipaddress.ip_address(s)
+                return True
+            except ValueError:
+                return False
+
+        endpoints = []
+        for rec in endpoints_raw.split(","):
+            rec = rec.strip()
+            if not rec:
+                continue
+            parts = rec.split(":")
+            ip = None
+            positional = ":".join(parts[2:]).strip() if len(parts) > 2 else ""
+            if positional and _valid_ip(positional):
+                ip = positional
+            else:
+                for take in range(len(parts), 0, -1):
+                    candidate = ":".join(parts[-take:]).strip()
+                    if _valid_ip(candidate):
+                        ip = candidate
+                        break
+            if ip is None:
+                log.warning("worker-network-endpoints: no parseable IP "
+                            "in record %r; skipping", rec)
+                continue
+            endpoints.append(ip)
         worker_id: Optional[int] = None
         if worker is not None and worker.strip().isdigit():
             worker_id = int(worker.strip())
