@@ -194,6 +194,30 @@ def phase_tpu_plugin(cluster: SimCluster, iterations: int) -> dict:
     log("t4 OK: TimeSlicing opaque config -> TPU_TIMESLICE_INTERVAL in "
         "validated CDI spec")
 
+    # -- t5: capacity-based quantity selector (the tpu-16gi DeviceClass) ----
+    # The chart ships a class selecting chips by HBM quantity
+    # (compareTo(quantity("16Gi")) >= 0); prove the same selector
+    # allocates through the production path (v5p chips publish 95Gi).
+    claim5 = cluster.create_and_allocate_claim(
+        "t5-claim", "e2e", [{"name": "tpu", "count": 1,
+                             "deviceClassName": "tpu-16gi.google.com",
+                             "selectors": [{"cel": {"expression":
+            'device.driver == "tpu.google.com" && '
+            'device.attributes["tpu.google.com"].type == "chip" && '
+            'device.capacity["tpu.google.com"].hbm'
+            '.compareTo(quantity("16Gi")) >= 0'}}]}],
+        node_name=node.node_name)
+    uid5 = claim5["metadata"]["uid"]
+    resp5 = dra.node_prepare_resources([claim5])
+    if resp5.claims[uid5].error:
+        raise HarnessError(f"t5 prepare: {resp5.claims[uid5].error}")
+    dra.node_unprepare_resources([
+        {"uid": uid5, "namespace": "e2e", "name": "t5-claim"}])
+    cluster.clients.resource_claims.delete("t5-claim", "e2e")
+    results["t5"] = {"quantity_selector_allocated": True}
+    log("t5 OK: HBM quantity selector (compareTo(quantity(\"16Gi\"))) "
+        "allocated + prepared through the production path")
+
     # -- crash: SIGKILL + restart + re-register -> checkpoint survives ------
     proc.kill()
     proc2 = node.spawn_tpu_plugin(tag="-restarted")

@@ -22,7 +22,8 @@ CHIP = {
         "sliceID": {"string": "slice-a"},
         "healthy": {"bool": True},
     },
-    "capacity": {"hbm": {"value": 95}},
+    # production shape (allocatable.py): quantity STRING byte count
+    "capacity": {"hbm": {"value": str(96 * 1024**3)}},
 }
 CHANNEL0 = {
     "name": "channel-0",
@@ -130,7 +131,12 @@ def test_negation_and_bool_attr():
 def test_ordered_comparisons_and_capacity():
     assert ev(CHIP, TPU, f'device.attributes["{TPU}"].cores >= 2')
     assert not ev(CHIP, TPU, f'device.attributes["{TPU}"].cores > 2')
-    assert ev(CHIP, TPU, f'device.capacity["{TPU}"].hbm > 90')
+    # capacity values are quantities now: ordered OPERATORS fail loud
+    # (no such overload on the real scheduler); methods are the path
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f'device.capacity["{TPU}"].hbm > 90')
+    assert ev(CHIP, TPU,
+              f'device.capacity["{TPU}"].hbm.isGreaterThan(quantity("90"))')
 
 
 def test_missing_attribute_is_no_match_not_error():
@@ -192,3 +198,94 @@ def test_matches_integration():
             f'device.attributes["{TPU}"].cores > 100'}}]
     assert _matches(CHIP, sel, driver=TPU)
     assert not _matches(DAEMON, sel, driver=TPU)
+
+
+# ---------------------------------------------------------------------------
+# quantities (VERDICT r3 #7): the k8s CEL quantity library surface
+# ---------------------------------------------------------------------------
+
+HBM_DEV = {
+    "name": "tpu-q",
+    "attributes": {"type": {"string": "chip"}},
+    # as published by allocatable.py: raw byte count as a quantity string
+    "capacity": {"hbm": {"value": str(16 * 1024**3)},
+                 "tensorcores": {"value": "2"}},
+}
+
+
+def test_quantity_parsing_exact():
+    q = cel.Quantity
+    assert q("16Gi").value == 16 * 2**30
+    assert q("1Gi").value == q("1024Mi").value
+    assert q("1.5Gi").value == 3 * 2**29
+    assert q("100m").value * 10 == 1
+    assert q("12e6").value == 12_000_000
+    assert q("-5").sign() == -1
+    assert q("3k").asInteger() == 3000
+    assert not q("1500m").isInteger()
+    with pytest.raises(cel.CelEvalError):
+        q("16GiB")          # not a k8s suffix
+    with pytest.raises(cel.CelEvalError):
+        q("")
+
+
+def test_capacity_quantity_compare_to(tmp_path):
+    expr = (f'device.capacity["{TPU}"].hbm'
+            f'.compareTo(quantity("16Gi")) >= 0')
+    assert ev(HBM_DEV, TPU, expr)
+    expr_gt = (f'device.capacity["{TPU}"].hbm'
+               f'.isGreaterThan(quantity("8Gi"))')
+    assert ev(HBM_DEV, TPU, expr_gt)
+    expr_lt = (f'device.capacity["{TPU}"].hbm'
+               f'.isLessThan(quantity("32Gi"))')
+    assert ev(HBM_DEV, TPU, expr_lt)
+    # numeric equality across units
+    assert ev(HBM_DEV, TPU,
+              f'device.capacity["{TPU}"].hbm == quantity("16384Mi")')
+    assert not ev(HBM_DEV, TPU,
+                  f'device.capacity["{TPU}"].hbm == quantity("8Gi")')
+
+
+def test_quantity_ordered_operators_fail_loud():
+    # the real CEL environment has no < on quantities; matching
+    # in-process then type-erroring on the real scheduler is the
+    # worst outcome — so this must raise, not guess
+    with pytest.raises(AllocationError):
+        ev(HBM_DEV, TPU,
+           f'device.capacity["{TPU}"].hbm > quantity("8Gi")')
+
+
+def test_quantity_method_on_missing_propagates():
+    assert not ev(HBM_DEV, TPU,
+                  f'device.capacity["{TPU}"].nope'
+                  f'.compareTo(quantity("1")) == 0')
+
+
+def test_quantity_method_arity_and_receiver_fail_loud():
+    with pytest.raises(AllocationError):
+        ev(HBM_DEV, TPU, 'quantity("1").compareTo()')
+    with pytest.raises(AllocationError):
+        ev(HBM_DEV, TPU, f'device.attributes["{TPU}"].type.sign() == 0')
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r3: CEL-faithful corners
+# ---------------------------------------------------------------------------
+
+def test_heterogeneous_equality_is_type_strict():
+    # Python's True == 1 must not leak into selector semantics
+    assert not ev(CHIP, TPU, "true == 1")
+    assert ev(CHIP, TPU, "true != 1")
+    assert not ev(CHIP, TPU, "1 in [true]")
+    assert ev(CHIP, TPU, f'device.attributes["{TPU}"].healthy == true')
+
+
+def test_not_binds_tighter_than_comparison():
+    # CEL precedence: !a == b is (!a) == b
+    assert ev(CHIP, TPU, "!false == true")
+    with pytest.raises(AllocationError):
+        # (!1) is a type error -> fail loud, not !(1 == 1)
+        ev(CHIP, TPU, "!1 == 1")
+    # negating a comparison needs parens, same as real CEL
+    assert ev(CHIP, TPU,
+              f'!(device.attributes["{TPU}"].type == "daemon")')

@@ -22,12 +22,13 @@ Selector format (per request)::
     {"attribute": "type", "equals": "chip"}
     {"attribute": "iciBandwidthGbps", "greaterThan": 1000}
 
-Numeric counter values are compared as integers.
+Counter values are k8s quantities (parsed exactly — "16Gi" and plain
+integer strings both work); arithmetic happens on exact integer byte
+counts.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from tpu_dra_driver.kube.client import ClientSets
@@ -35,6 +36,19 @@ from tpu_dra_driver.kube.client import ClientSets
 
 class AllocationError(RuntimeError):
     pass
+
+
+def _qty_int(value) -> int:
+    """Counter/capacity value -> exact int. Accepts plain ints and any
+    k8s quantity string ("8", "16Gi", "1500m" is rejected as
+    non-integral — counters are whole units)."""
+    from tpu_dra_driver.kube import cel
+    if isinstance(value, int):
+        return value
+    q = cel.Quantity(str(value))
+    if not q.isInteger():
+        raise AllocationError(f"counter value {value!r} is not integral")
+    return q.asInteger()
 
 
 def _attr_value(dev: Dict, name: str):
@@ -66,11 +80,21 @@ def _eval_cel(dev: Dict, driver: str, expression: str) -> bool:
         if section == "attributes":
             v = _attr_value(dev, name)
             return cel.MISSING if v is None else v
-        # capacity values are quantities; the driver publishes plain ints
+        # capacity values are k8s quantities on the wire: resolve
+        # strings to cel.Quantity (so "16Gi"-style selectors via
+        # .compareTo/.isGreaterThan work exactly); a plain int stays an
+        # int for the legacy counter-style comparisons
         v = (dev.get("capacity") or {}).get(name)
         if isinstance(v, dict):
             v = v.get("value")
-        return cel.MISSING if v is None else v
+        if v is None:
+            return cel.MISSING
+        if isinstance(v, str):
+            try:
+                return cel.Quantity(v)
+            except cel.CelEvalError:
+                return v
+        return v
 
     try:
         return cel.evaluate(expression, resolver)
@@ -112,7 +136,8 @@ def _counter_usage(slices: List[Dict], allocated: List[Tuple[str, str]]
         for cc in dev.get("consumesCounters") or []:
             cs = cc["counterSet"]
             for cname, cval in (cc.get("counters") or {}).items():
-                usage[(cs, cname)] = usage.get((cs, cname), 0) + int(cval["value"])
+                usage[(cs, cname)] = (usage.get((cs, cname), 0)
+                                      + _qty_int(cval["value"]))
     return usage
 
 
@@ -121,7 +146,7 @@ def _counter_capacity(slices: List[Dict]) -> Dict[Tuple[str, str], int]:
     for s in slices:
         for cs in s["spec"].get("sharedCounters") or []:
             for cname, cval in (cs.get("counters") or {}).items():
-                cap[(cs["name"], cname)] = int(cval["value"])
+                cap[(cs["name"], cname)] = _qty_int(cval["value"])
     return cap
 
 
@@ -217,7 +242,7 @@ class Allocator:
                 cap = capacity.get((cs, cname))
                 if cap is None:
                     return False
-                if usage.get((cs, cname), 0) + int(cval["value"]) > cap:
+                if usage.get((cs, cname), 0) + _qty_int(cval["value"]) > cap:
                     return False
         return True
 
@@ -226,4 +251,5 @@ class Allocator:
         for cc in dev.get("consumesCounters") or []:
             cs = cc["counterSet"]
             for cname, cval in (cc.get("counters") or {}).items():
-                usage[(cs, cname)] = usage.get((cs, cname), 0) + int(cval["value"])
+                usage[(cs, cname)] = (usage.get((cs, cname), 0)
+                                      + _qty_int(cval["value"]))

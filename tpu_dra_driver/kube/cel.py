@@ -13,30 +13,49 @@ matches or mismatches):
 
     expr   := or
     or     := and ( "||" and )*
-    and    := unary ( "&&" unary )*
-    unary  := "!" unary | cmp
-    cmp    := operand ( ("=="|"!="|">="|"<="|">"|"<") operand
-                       | "in" list )?
-    operand:= literal | path | "(" expr ")"
+    and    := cmp ( "&&" cmp )*
+    cmp    := uop ( ("=="|"!="|">="|"<="|">"|"<") uop
+                   | "in" list )?
+    uop    := "!" uop | operand ( "." ident "(" args ")" )*
+    operand:= literal | path | "quantity" "(" string ")" | "(" expr ")"
     path   := "device" "." "driver"
             | "device" "." ("attributes"|"capacity") "[" string "]"
               "." ident
     list   := "[" ( literal ( "," literal )* )? "]"
     literal:= string | int | "true" | "false"
 
+``!`` binds tighter than comparisons (CEL precedence: ``!a == b`` is
+``(!a) == b``); parenthesize to negate a comparison.
+
+Quantities (the k8s CEL quantity library, apiserver
+pkg/cel/library/quantity.go): ``quantity("16Gi")`` constructs one;
+``device.capacity[...]`` values that are quantity STRINGS resolve to
+one (plain ints stay ints). Methods: ``.compareTo(q)``,
+``.isGreaterThan(q)``, ``.isLessThan(q)``, ``.sign()``,
+``.asInteger()``, ``.isInteger()``. Ordered OPERATORS on quantities
+are deliberately unsupported (the real CEL environment has no such
+overloads — a selector must not match in-process and then type-error
+on the real scheduler); use ``.compareTo``/``.isGreaterThan``.
+
+Equality is heterogeneous the way modern CEL's is: values of different
+types (bool vs int vs string vs quantity) compare unequal instead of
+borrowing Python's ``True == 1``; quantity==quantity compares
+numerically ("1Gi" equals "1024Mi").
+
 Semantics follow the scheduler where the driver depends on them:
 attribute domains resolve within the publishing driver's domain; a
 qualified domain that is not the device's driver yields a *missing*
 value. Missing propagates the way a CEL runtime error does: through
-comparisons (including ``!=``), ``in``, and ``!``; it is absorbed by
-``&&`` when the other side is false and by ``||`` when the other side
-is true (CEL's commutative short-circuit); a missing overall result
-means the device does not match.
+comparisons (including ``!=``), ``in``, ``!``, and method calls; it is
+absorbed by ``&&`` when the other side is false and by ``||`` when the
+other side is true (CEL's commutative short-circuit); a missing
+overall result means the device does not match.
 """
 
 from __future__ import annotations
 
 import re
+from fractions import Fraction
 from typing import Any, Callable, List, NamedTuple, Optional
 
 # Sentinel for "attribute absent / wrong domain" — the public name is the
@@ -52,6 +71,108 @@ class CelUnsupportedError(ValueError):
 
 class CelEvalError(ValueError):
     """The expression parsed but evaluated to something non-boolean."""
+
+
+_QTY_SUFFIX = {
+    "": 1, "n": Fraction(1, 10**9), "u": Fraction(1, 10**6),
+    "m": Fraction(1, 1000),
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "E": 10**18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "Ei": 2**60,
+}
+
+_QTY_RE = re.compile(
+    r"^([+-]?)(\d+(?:\.\d*)?|\.\d+)"
+    r"(?:([eE][+-]?\d+)|(Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE]))?$")
+
+
+class Quantity:
+    """k8s resource.Quantity: exact decimal/binary-suffixed number.
+
+    Parsed per apimachinery's grammar (sign, decimal digits, then one of
+    an e-exponent or a binary/decimal SI suffix); held as an exact
+    Fraction so "1Gi" == "1024Mi" and comparisons never round. Only the
+    operations the k8s CEL quantity library exposes are offered (see
+    module docstring)."""
+
+    __slots__ = ("value", "text")
+
+    def __init__(self, text: str):
+        if isinstance(text, Quantity):
+            self.value, self.text = text.value, text.text
+            return
+        m = _QTY_RE.match(str(text).strip())
+        if not m:
+            raise CelEvalError(f"invalid quantity {text!r}")
+        sign, digits, exp, suffix = m.groups()
+        val = Fraction(digits)
+        if exp:
+            val *= Fraction(10) ** int(exp[1:])
+        if suffix:
+            val *= _QTY_SUFFIX[suffix]
+        if sign == "-":
+            val = -val
+        self.value = val
+        self.text = str(text).strip()
+
+    # -- the k8s CEL quantity library surface -----------------------------
+    def compareTo(self, other: "Quantity") -> int:  # noqa: N802
+        o = _require_quantity(other, "compareTo")
+        return (self.value > o.value) - (self.value < o.value)
+
+    def isGreaterThan(self, other: "Quantity") -> bool:  # noqa: N802
+        return self.value > _require_quantity(other, "isGreaterThan").value
+
+    def isLessThan(self, other: "Quantity") -> bool:  # noqa: N802
+        return self.value < _require_quantity(other, "isLessThan").value
+
+    def sign(self) -> int:
+        return (self.value > 0) - (self.value < 0)
+
+    def isInteger(self) -> bool:  # noqa: N802
+        return self.value.denominator == 1
+
+    def asInteger(self) -> int:  # noqa: N802
+        if self.value.denominator != 1:
+            raise CelEvalError(f"quantity {self.text!r} is not an integer")
+        return self.value.numerator
+
+    def __repr__(self) -> str:
+        return f"quantity({self.text!r})"
+
+
+def _require_quantity(v: Any, method: str) -> Quantity:
+    if isinstance(v, Quantity):
+        return v
+    raise CelUnsupportedError(
+        f"{method}() takes a quantity argument (use quantity(\"...\")), "
+        f"got {v!r}")
+
+
+#: methods callable on a Quantity from a selector, with arity
+_QTY_METHODS = {"compareTo": 1, "isGreaterThan": 1, "isLessThan": 1,
+                "sign": 0, "isInteger": 0, "asInteger": 0}
+
+
+def _type_tag(v: Any) -> str:
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, Quantity):
+        return "quantity"
+    if isinstance(v, int):
+        return "int"
+    return type(v).__name__
+
+
+def _hetero_eq(lhs: Any, rhs: Any) -> bool:
+    """Modern-CEL heterogeneous equality: cross-type is unequal (never
+    Python's True == 1); quantities compare numerically."""
+    if _type_tag(lhs) != _type_tag(rhs):
+        return False
+    if isinstance(lhs, Quantity):
+        return lhs.value == rhs.value
+    return lhs == rhs
 
 
 class _Tok(NamedTuple):
@@ -144,10 +265,10 @@ class _Parser:
         return val
 
     def and_expr(self) -> Any:
-        val = self.unary()
+        val = self.cmp()
         while self._at_op("&&"):
             self.next()
-            rhs = self.unary()
+            rhs = self.cmp()
             # CEL's commutative &&: false absorbs an error on either side
             a, b = self._boolish(val), self._boolish(rhs)
             if a is False or b is False:
@@ -158,27 +279,67 @@ class _Parser:
                 val = True
         return val
 
-    def unary(self) -> Any:
-        if self._at_op("!"):
-            self.next()
-            val = self._boolish(self.unary())
-            return _MISSING if val is _MISSING else not val
-        return self.cmp()
-
     def cmp(self) -> Any:
-        lhs = self.operand()
+        # ``!`` lives INSIDE the comparison operands (CEL precedence:
+        # ``!a == b`` is ``(!a) == b``, not ``!(a == b)``)
+        lhs = self.unary_operand()
         tok = self.peek()
         if tok is None:
             return lhs
         if tok.kind == "op" and tok.value in ("==", "!=", ">", "<", ">=", "<="):
             op = self.next().value
-            rhs = self.operand()
+            rhs = self.unary_operand()
             return self._compare(op, lhs, rhs)
         if tok.kind == "ident" and tok.value == "in":
             self.next()
             items = self.list_literal()
-            return _MISSING if lhs is _MISSING else lhs in items
+            if lhs is _MISSING:
+                return _MISSING
+            return any(_hetero_eq(lhs, item) for item in items)
         return lhs
+
+    def unary_operand(self) -> Any:
+        if self._at_op("!"):
+            self.next()
+            val = self._boolish(self.unary_operand())
+            return _MISSING if val is _MISSING else not val
+        return self.postfix()
+
+    def postfix(self) -> Any:
+        """An operand with any trailing ``.method(args)`` calls (the
+        quantity library surface)."""
+        val = self.operand()
+        while (self._at_op(".")
+               and self.i + 1 < len(self.toks)
+               and self.toks[self.i + 1].kind == "ident"
+               and self.i + 2 < len(self.toks)
+               and self.toks[self.i + 2] == _Tok("op", "(")):
+            self.next()                      # .
+            method = self.next().value       # ident
+            self.expect_op("(")
+            args: List[Any] = []
+            if not self._at_op(")"):
+                args.append(self.unary_operand())
+                while self._at_op(","):
+                    self.next()
+                    args.append(self.unary_operand())
+            self.expect_op(")")
+            val = self._call_method(val, method, args)
+        return val
+
+    def _call_method(self, val: Any, method: str, args: List[Any]) -> Any:
+        if method not in _QTY_METHODS:
+            raise CelUnsupportedError(f"unsupported method .{method}()")
+        if len(args) != _QTY_METHODS[method]:
+            raise CelUnsupportedError(
+                f".{method}() takes {_QTY_METHODS[method]} argument(s), "
+                f"got {len(args)}")
+        if val is _MISSING or any(a is _MISSING for a in args):
+            return _MISSING
+        if not isinstance(val, Quantity):
+            raise CelUnsupportedError(
+                f".{method}() is a quantity method; receiver is {val!r}")
+        return getattr(val, method)(*args)
 
     def operand(self) -> Any:
         tok = self.peek()
@@ -200,6 +361,16 @@ class _Parser:
                 return False
             if tok.value == "device":
                 return self.device_path()
+            if tok.value == "quantity":
+                self.next()
+                self.expect_op("(")
+                arg = self.next()
+                if arg.kind != "str":
+                    raise CelUnsupportedError(
+                        f"quantity() takes a string literal, got "
+                        f"{arg.value!r}")
+                self.expect_op(")")
+                return Quantity(arg.value)
             raise CelUnsupportedError(f"unsupported identifier {tok.value!r}")
         raise CelUnsupportedError(f"unsupported token {tok.value!r}")
 
@@ -271,9 +442,17 @@ class _Parser:
             # every comparison, != included
             return _MISSING
         if op == "==":
-            return lhs == rhs
+            return _hetero_eq(lhs, rhs)
         if op == "!=":
-            return lhs != rhs
+            return not _hetero_eq(lhs, rhs)
+        if isinstance(lhs, Quantity) or isinstance(rhs, Quantity):
+            # the real CEL environment has no ordered-operator overloads
+            # for quantity — matching here and type-erroring on the real
+            # scheduler would be the worst outcome
+            raise CelUnsupportedError(
+                f"ordered operators are not defined on quantities "
+                f"({lhs!r} {op} {rhs!r}); use "
+                f".compareTo(quantity(\"...\")) or .isGreaterThan(...)")
         if not (isinstance(lhs, int) and not isinstance(lhs, bool)
                 and isinstance(rhs, int) and not isinstance(rhs, bool)):
             raise CelUnsupportedError(
