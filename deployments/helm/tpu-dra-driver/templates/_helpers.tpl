@@ -1,0 +1,38 @@
+{{/*
+Shared label/name helpers (reference analog: _helpers.tpl in the
+reference chart). Components stamp their own
+app.kubernetes.io/component on top of these.
+*/}}
+
+{{- define "tpu-dra-driver.name" -}}
+tpu-dra-driver
+{{- end }}
+
+{{- define "tpu-dra-driver.labels" -}}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name | default "tpu-dra-driver" }}
+app.kubernetes.io/managed-by: {{ .Release.Service | default "Helm" }}
+{{- end }}
+
+{{- define "tpu-dra-driver.selectorLabels" -}}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" . }}
+{{- end }}
+
+{{/* Per-component ServiceAccount names (least-privilege RBAC split,
+     reference analog: rbac-{controller,kubeletplugin,compute-domain-daemon}.yaml) */}}
+
+{{- define "tpu-dra-driver.serviceAccountName.controller" -}}
+tpu-dra-driver-controller
+{{- end }}
+
+{{- define "tpu-dra-driver.serviceAccountName.kubeletPlugin" -}}
+tpu-dra-driver-kubelet-plugin
+{{- end }}
+
+{{- define "tpu-dra-driver.serviceAccountName.cdDaemon" -}}
+tpu-dra-driver-cd-daemon
+{{- end }}
+
+{{- define "tpu-dra-driver.serviceAccountName.webhook" -}}
+tpu-dra-driver-webhook
+{{- end }}
