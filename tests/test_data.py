@@ -134,3 +134,52 @@ def test_prefetch_feeds_training_loop():
         losses.append(float(loss))
     assert len(losses) > 5
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_byte_corpus_walk_split_and_binary_skip(tmp_path):
+    """Deterministic walk, holdout split disjoint from train, NUL files
+    skipped (keeps byte 0 free as the packer separator)."""
+    import numpy as np
+    from tpu_dra_driver.workloads.data import byte_corpus
+    root = tmp_path / "src"
+    root.mkdir()
+    for i in range(8):
+        (root / f"f{i}.py").write_text(f"def f{i}():\n    return {i}\n" * 20)
+    (root / "blob.py").write_bytes(b"\x00\x01binary")
+    (root / "skip.bin").write_bytes(b"not a text ext")
+    tr1, ho1 = byte_corpus(roots=[str(root)], holdout_every=3)
+    tr2, ho2 = byte_corpus(roots=[str(root)], holdout_every=3)
+    assert len(tr1) + len(ho1) == 8          # binary + non-text skipped
+    assert len(ho1) == 8 // 3 + (8 % 3 >= 3)  # every 3rd file
+    assert all((a == b).all() for a, b in zip(tr1, tr2))
+    assert all((a == b).all() for a, b in zip(ho1, ho2))
+    assert all(d.dtype == np.int32 and (d >= 0).all() and (d < 256).all()
+               for d in tr1 + ho1)
+    assert not any((d == 0).any() for d in tr1 + ho1)
+
+
+def test_byte_corpus_respects_byte_caps(tmp_path):
+    from tpu_dra_driver.workloads.data import byte_corpus
+    root = tmp_path / "src"
+    root.mkdir()
+    for i in range(30):
+        (root / f"f{i:02d}.txt").write_text("x" * 1000)
+    tr, ho = byte_corpus(roots=[str(root)], max_total_bytes=5000,
+                         max_file_bytes=400, holdout_every=2)
+    assert all(len(d) <= 400 for d in tr + ho)
+    assert sum(len(d) for d in tr) <= 5000 + 400   # stops at the cap
+    # errors loud when a split would be empty
+    import pytest
+    with pytest.raises(RuntimeError):
+        byte_corpus(roots=[str(tmp_path / "nowhere")])
+
+
+def test_byte_corpus_default_roots_find_real_text():
+    """The default roots (this package + the stdlib) must yield several
+    MB of real text on any host — the real-data bench depends on it."""
+    from tpu_dra_driver.workloads.data import byte_corpus
+    tr, ho = byte_corpus(max_total_bytes=1 << 20)
+    assert sum(len(d) for d in tr) >= 1 << 20
+    assert len(ho) >= 1     # cap-before-first-holdout hosts still split
+
+

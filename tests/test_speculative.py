@@ -195,3 +195,43 @@ def test_early_exit_draft_validation():
         early_exit_draft(params, cfg, 0)
     with pt.raises(ValueError):
         early_exit_draft(params, cfg, 3)
+
+
+def test_early_exit_real_data_trains_and_stays_exact(tmp_path):
+    """The real-data early-exit bench: trains on a byte corpus through
+    the production packing pipeline, evaluates on heldout prompts, and
+    the speculative output must equal the target's greedy decode
+    exactly. Tiny shapes; the honest numbers come from bench.py."""
+    from tpu_dra_driver.workloads.models.speculative import (
+        early_exit_real_data_tokens_per_sec,
+    )
+    from tpu_dra_driver.workloads.models.transformer import ModelConfig
+    root = tmp_path / "corpus"
+    root.mkdir()
+    for i in range(20):                     # >17 so the holdout split
+        (root / f"doc{i:02d}.txt").write_text(  # (every 17th) is non-empty
+            ("the quick brown fox jumps over the lazy dog %d\n" % i) * 40)
+    cfg = ModelConfig(vocab=256, d_model=64, n_heads=2, n_kv_heads=2,
+                      n_layers=2, d_ff=128, max_seq=32 + 16 + 3 + 2,
+                      use_rope=True)
+    r = early_exit_real_data_tokens_per_sec(
+        b=1, prompt_len=32, gen=16, gamma=3, draft_layers=1,
+        train_steps=10, train_batch=2, train_seq=64, iters=1, cfg=cfg,
+        corpus_roots=[str(root)])
+    assert r["exact_greedy"] is True
+    assert r["train_steps"] >= 10
+    assert 0.0 <= r["mean_accepted"] <= 3.0
+    assert r["corpus_bytes"] > 0 and r["holdout_docs"] >= 1
+    assert r["final_train_loss"] < 6.0     # it actually learned something
+
+
+def test_early_exit_real_data_rejects_small_vocab():
+    import pytest as pt
+    from tpu_dra_driver.workloads.models.speculative import (
+        early_exit_real_data_tokens_per_sec,
+    )
+    from tpu_dra_driver.workloads.models.transformer import ModelConfig
+    cfg = ModelConfig(vocab=128, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=64)
+    with pt.raises(ValueError):
+        early_exit_real_data_tokens_per_sec(cfg=cfg)
