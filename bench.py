@@ -476,81 +476,129 @@ def bench_accelerator() -> dict:
                     f"effects — quote the decomposed numbers)")
             except Exception as e:
                 log(f"  serving bench skipped: {type(e).__name__}: {e}")
-            # int8 self-speculation at b=1 (the latency-bound serving
-            # case); acceptance at random init is the pessimistic floor —
-            # trained (peaked) models accept more
-            from tpu_dra_driver.workloads.models import (
-                speculative_decode_tokens_per_sec,
-            )
-            sp = _attempt(lambda: speculative_decode_tokens_per_sec(b=1, gamma=8, gen=256))
-            out["spec_decode_speedup_b1"] = round(sp["speedup"], 3)
-            out["spec_decode_bound_b1"] = round(
-                sp["perfect_acceptance_bound"], 3)
-            out["spec_decode_draft_cost_ratio"] = round(
-                sp["draft_cost_ratio"], 3)
-            log(f"  int8 self-speculative decode (b=1, gamma=8): "
-                f"{sp['spec_tokens_per_sec']:.0f} tok/s vs "
-                f"{sp['plain_tokens_per_sec']:.0f} plain "
-                f"({sp['speedup']:.2f}x, mean accepted "
-                f"{sp['mean_accepted']:.1f}/8, exact-greedy output; "
-                f"perfect-acceptance ceiling at this draft cost "
-                f"r={sp['draft_cost_ratio']:.2f} is "
-                f"{sp['perfect_acceptance_bound']:.2f}x — the draft "
-                f"economics, not the machinery, bound b=1 here)")
-            # early-exit drafting on a trained-ish checkpoint: the b=1
-            # configuration that actually earns speculation's keep (the
-            # quick-trained bigram chain stands in for a real trained
-            # model — shallow-trunk agreement is a trained-model
-            # property; output asserted exactly-greedy either way)
-            from tpu_dra_driver.workloads.models.speculative import (
-                early_exit_decode_tokens_per_sec,
-            )
-            se = _attempt(lambda: early_exit_decode_tokens_per_sec(b=1, gamma=8, gen=256))
-            out["spec_decode_early_exit_speedup_b1"] = round(
-                se["speedup"], 3)
-            out["spec_decode_early_exit_accepted"] = round(
-                se["mean_accepted"], 2)
-            out["spec_decode_early_exit_exact"] = se["exact_greedy"]
-            log(f"  early-exit speculative decode (b=1, gamma=8, "
-                f"2-of-8-layer int8 draft, quick-trained target): "
-                f"{se['spec_tokens_per_sec']:.0f} tok/s vs "
-                f"{se['plain_tokens_per_sec']:.0f} plain "
-                f"({se['speedup']:.2f}x, mean accepted "
-                f"{se['mean_accepted']:.1f}/8, draft cost "
-                f"r={se['draft_cost_ratio']:.2f}, "
-                f"exact-greedy={se['exact_greedy']})")
-            # the honest number (VERDICT r3 #4): same early-exit draft,
-            # but the target trains on REAL byte-level text (source +
-            # docs via data.byte_corpus, streamed through the production
-            # packing pipeline) and prompts come from the heldout split —
-            # acceptance is earned on genuinely unpredictable spans, not
-            # a peaked synthetic chain
-            from tpu_dra_driver.workloads.models.speculative import (
-                early_exit_real_data_tokens_per_sec,
-            )
-            sr = _attempt(lambda: early_exit_real_data_tokens_per_sec(
-                b=1, gamma=8, gen=256, train_steps=300))
-            out["spec_decode_early_exit_real_data"] = round(
-                sr["speedup"], 3)
-            out["spec_decode_real_data_accepted"] = round(
-                sr["mean_accepted"], 2)
-            out["spec_decode_real_data_exact"] = sr["exact_greedy"]
-            out["spec_decode_real_data_train_loss"] = round(
-                sr["final_train_loss"], 3)
-            log(f"  early-exit speculative decode on REAL data (b=1, "
-                f"gamma=8, 2-of-8-layer int8 draft; byte-LM trained "
-                f"{sr['train_steps']} steps on "
-                f"{sr['corpus_bytes'] / 1e6:.1f} MB of local source/docs "
-                f"to loss {sr['final_train_loss']:.2f}, heldout "
-                f"prompts): {sr['spec_tokens_per_sec']:.0f} tok/s vs "
-                f"{sr['plain_tokens_per_sec']:.0f} plain "
-                f"({sr['speedup']:.2f}x, mean accepted "
-                f"{sr['mean_accepted']:.2f}/8 — honestly <8/8, draft "
-                f"cost r={sr['draft_cost_ratio']:.2f}, "
-                f"exact-greedy={sr['exact_greedy']})")
+            # each spec-decode sub-bench is isolated: a failure in one
+            # (e.g. a non-tie divergence raise) must not discard the
+            # other metrics already gathered in this section
+            try:
+                _bench_spec_int8(out)
+            except Exception as e:
+                log(f"  int8 self-spec bench skipped: "
+                    f"{type(e).__name__}: {e}")
+            try:
+                _bench_spec_early_exit(out)
+            except Exception as e:
+                log(f"  early-exit spec bench skipped: "
+                    f"{type(e).__name__}: {e}")
+            try:
+                _bench_spec_real_data(out)
+            except Exception as e:
+                log(f"  real-data spec bench skipped: "
+                    f"{type(e).__name__}: {e}")
     except Exception as e:
         log(f"  accelerator bench skipped: {type(e).__name__}: {e}")
     return out
+
+
+def _bench_spec_int8(out: dict) -> None:
+    # int8 self-speculation at b=1 (the latency-bound serving case);
+    # acceptance at random init is the pessimistic floor — trained
+    # (peaked) models accept more
+    from tpu_dra_driver.workloads.models import (
+        speculative_decode_tokens_per_sec,
+    )
+    sp = _attempt(lambda: speculative_decode_tokens_per_sec(b=1, gamma=8, gen=256))
+    out["spec_decode_speedup_b1"] = round(sp["speedup"], 3)
+    out["spec_decode_bound_b1"] = round(
+        sp["perfect_acceptance_bound"], 3)
+    out["spec_decode_draft_cost_ratio"] = round(
+        sp["draft_cost_ratio"], 3)
+    log(f"  int8 self-speculative decode (b=1, gamma=8): "
+        f"{sp['spec_tokens_per_sec']:.0f} tok/s vs "
+        f"{sp['plain_tokens_per_sec']:.0f} plain "
+        f"({sp['speedup']:.2f}x, mean accepted "
+        f"{sp['mean_accepted']:.1f}/8, exact-greedy output; "
+        f"perfect-acceptance ceiling at this draft cost "
+        f"r={sp['draft_cost_ratio']:.2f} is "
+        f"{sp['perfect_acceptance_bound']:.2f}x — the draft "
+        f"economics, not the machinery, bound b=1 here)")
+
+
+def _bench_spec_early_exit(out: dict) -> None:
+    # early-exit drafting on a trained-ish checkpoint: the b=1
+    # configuration that actually earns speculation's keep (the
+    # quick-trained bigram chain stands in for a real trained
+    # model — shallow-trunk agreement is a trained-model property)
+    from tpu_dra_driver.workloads.models.speculative import (
+        early_exit_decode_tokens_per_sec,
+    )
+    se = _attempt(lambda: early_exit_decode_tokens_per_sec(b=1, gamma=8, gen=256))
+    out["spec_decode_early_exit_speedup_b1"] = round(
+        se["speedup"], 3)
+    out["spec_decode_early_exit_accepted"] = round(
+        se["mean_accepted"], 2)
+    out["spec_decode_early_exit_exact"] = se["exact_greedy"]
+    if se["divergence"]:
+        out["spec_decode_early_exit_tie_divergence"] = _tie_evidence(se)
+    log(f"  early-exit speculative decode (b=1, gamma=8, "
+        f"2-of-8-layer int8 draft, quick-trained target): "
+        f"{se['spec_tokens_per_sec']:.0f} tok/s vs "
+        f"{se['plain_tokens_per_sec']:.0f} plain "
+        f"({se['speedup']:.2f}x, mean accepted "
+        f"{se['mean_accepted']:.1f}/8, draft cost "
+        f"r={se['draft_cost_ratio']:.2f}, "
+        f"exact-greedy={se['exact_greedy']})")
+
+
+def _tie_evidence(result: dict) -> list:
+    """Machine-readable evidence for tolerated bf16 tie divergences, so
+    a metrics consumer can tell a tolerated tie from a suppressed
+    correctness failure (non-tie divergence raises instead)."""
+    return [{k: (round(v, 5) if k == "top2_gap" else v)
+             for k, v in d.items()}        # row/pos/top2_gap (+ prompt
+            for d in result["divergence"]]  # index for multi-prompt runs)
+
+
+def _bench_spec_real_data(out: dict) -> None:
+    # the honest number (VERDICT r3 #4): same early-exit draft, but the
+    # target trains on REAL byte-level text (source + docs via
+    # data.byte_corpus, streamed through the production packing
+    # pipeline) and prompts come from the heldout split — acceptance is
+    # earned on genuinely unpredictable spans, not a peaked synthetic
+    # chain. exact_greedy=False is possible here (a bf16 near-tie can
+    # legitimately flip the wide-verify argmax vs the matvec decode on
+    # trained models — non-tie divergence still raises) and is reported
+    # as-is with the tie evidence.
+    from tpu_dra_driver.workloads.models.speculative import (
+        early_exit_real_data_tokens_per_sec,
+    )
+    sr = _attempt(lambda: early_exit_real_data_tokens_per_sec(
+        b=1, gamma=8, gen=256, train_steps=600))
+    out["spec_decode_early_exit_real_data"] = round(
+        sr["speedup"], 3)                   # median over heldout prompts
+    out["spec_decode_real_data_per_prompt"] = sr["per_prompt"]
+    out["spec_decode_real_data_accepted"] = round(
+        sr["mean_accepted"], 2)
+    out["spec_decode_real_data_exact"] = sr["exact_greedy"]
+    if sr["divergence"]:
+        out["spec_decode_real_data_tie_divergence"] = _tie_evidence(sr)
+    out["spec_decode_real_data_train_loss"] = round(
+        sr["final_train_loss"], 3)
+    div_msg = ("" if not sr["divergence"] else
+               f"; diverged on bf16 near-tie(s) at {_tie_evidence(sr)}")
+    log(f"  early-exit speculative decode on REAL data (b=1, "
+        f"gamma=8, 2-of-8-layer int8 draft trained WITH the "
+        f"early-exit aux loss; byte-LM trained "
+        f"{sr['train_steps']} steps on "
+        f"{sr['corpus_bytes'] / 1e6:.1f} MB of local source/docs "
+        f"to loss {sr['final_train_loss']:.2f}, heldout "
+        f"prompts): {sr['spec_tokens_per_sec']:.0f} tok/s vs "
+        f"{sr['plain_tokens_per_sec']:.0f} plain "
+        f"({sr['speedup']:.2f}x MEDIAN of "
+        f"{[p['speedup'] for p in sr['per_prompt']]} over distinct "
+        f"heldout prompts, mean accepted "
+        f"{sr['mean_accepted']:.2f}/8 — honestly <8/8, draft "
+        f"cost r={sr['draft_cost_ratio']:.2f}, "
+        f"exact-greedy={sr['exact_greedy']}{div_msg})")
 
 
 def main() -> int:

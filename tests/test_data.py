@@ -179,7 +179,11 @@ def test_byte_corpus_default_roots_find_real_text():
     MB of real text on any host — the real-data bench depends on it."""
     from tpu_dra_driver.workloads.data import byte_corpus
     tr, ho = byte_corpus(max_total_bytes=1 << 20)
-    assert sum(len(d) for d in tr) >= 1 << 20
+    # train + holdout together must cover the cap: on hosts where the
+    # cap lands before the first every-17th holdout pick, the library
+    # moves one train doc into holdout, so asserting on train alone
+    # would contradict the split fallback this test also covers
+    assert sum(len(d) for d in tr + ho) >= 1 << 20
     assert len(ho) >= 1     # cap-before-first-holdout hosts still split
 
 

@@ -235,3 +235,67 @@ def test_early_exit_real_data_rejects_small_vocab():
                       d_ff=64, max_seq=64)
     with pt.raises(ValueError):
         early_exit_real_data_tokens_per_sec(cfg=cfg)
+
+
+def _tie_policy_setup(monkeypatch, gap: float):
+    """Force a single-token divergence and control the target's top-2
+    logit gap at that position, to pin _measure_early_exit's policy:
+    bf16 near-ties are tolerated and reported, anything else raises."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tpu_dra_driver.workloads.models import speculative as spec
+    from tpu_dra_driver.workloads.models import transformer as tf
+    from tpu_dra_driver.workloads.models.transformer import (
+        ModelConfig, init_params)
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=8 + 8 + 2 + 2,
+                      use_rope=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 8), jnp.int32)
+
+    real_spec = spec.speculative_generate
+
+    def tampered(tp, tc, dp, dc, pr, steps, gamma=4, return_stats=False):
+        out = real_spec(tp, tc, dp, dc, pr, steps, gamma,
+                        return_stats=return_stats)
+        toks, stats = out if return_stats else (out, None)
+        toks = np.array(toks)                # writable copy
+        plain_tok = int(toks[0, 8])          # greedy choice at pos 8
+        toks[0, 8] = (plain_tok + 1) % tc.vocab   # flip to the runner-up
+        toks = jnp.asarray(toks)
+        return (toks, stats) if return_stats else toks
+
+    def fake_forward(p, tokens, c, **kw):
+        # logits whose top-2 are {plain_tok, plain_tok+1} with the
+        # requested gap; recompute plain_tok from the real model
+        real_logits = np.full((1, tokens.shape[1], c.vocab), -30.0,
+                              np.float32)
+        from tpu_dra_driver.workloads.models.generate import generate
+        plain = np.asarray(generate(params, cfg, prompt, steps=1))
+        t0 = int(plain[0, 8])
+        real_logits[0, -1, t0] = 5.0
+        real_logits[0, -1, (t0 + 1) % c.vocab] = 5.0 - gap
+        return jnp.asarray(real_logits)
+
+    monkeypatch.setattr(spec, "speculative_generate", tampered)
+    monkeypatch.setattr(tf, "forward", fake_forward)
+    return spec, params, cfg, prompt
+
+
+def test_tie_divergence_within_tolerance_is_reported(monkeypatch):
+    spec, params, cfg, prompt = _tie_policy_setup(monkeypatch, gap=0.01)
+    r = spec._measure_early_exit(params, cfg, prompt, draft_layers=1,
+                                 gen=8, gamma=2, iters=1)
+    assert r["exact_greedy"] is False
+    assert r["divergence"] == [
+        {"row": 0, "pos": 8, "top2_gap": pytest.approx(0.01, abs=1e-3)}]
+
+
+def test_non_tie_divergence_raises(monkeypatch):
+    import pytest as pt
+    spec, params, cfg, prompt = _tie_policy_setup(monkeypatch, gap=3.0)
+    with pt.raises(RuntimeError, match="NOT a bf16 near-tie"):
+        spec._measure_early_exit(params, cfg, prompt, draft_layers=1,
+                                 gen=8, gamma=2, iters=1)

