@@ -48,3 +48,10 @@ from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     init_kv_cache,
     wide_step,
 )
+from tpu_dra_driver.workloads.models.encoder import (  # noqa: F401
+    encoder_config,
+    make_mlm_train_step,
+    mlm_accuracy,
+    mlm_corrupt,
+    mlm_loss_fn,
+)

@@ -1,0 +1,124 @@
+"""Encoder model family: bidirectional masked-LM (BERT-recipe) training.
+
+The decoder stack already computes full bidirectional attention when
+``cfg.prefix >= t`` (every position in the prefix region attends both
+ways — transformer._attention's prefix mask), so an encoder is the SAME
+``forward`` under an all-prefix config plus the MLM objective: corrupt a
+random subset of input positions (BERT's 80/10/10 recipe: [MASK] /
+random token / kept), train to reconstruct the originals at corrupted
+positions only. ``nll_from_logits`` already takes a position mask, so
+the loss tier is shared with every other trainer.
+
+TPU-first details:
+- masking happens on device inside the jitted step (one PRNG key in,
+  all-vectorized bernoulli/where — no host-side batch mutation, static
+  shapes);
+- the [MASK] token id is reserved as ``cfg.vocab - 1`` by convention
+  (callers building vocabularies leave the last id free);
+- loss positions are the corruption mask, so padding/uncorrupted
+  positions contribute exactly zero.
+
+The reference driver has no model tier at all; this extends the
+validation-workload family set (decoder LM, prefix-LM, MoE, encoder)
+per PARITY.md §2.6.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_dra_driver.workloads.models.transformer import (
+    ModelConfig,
+    Params,
+    forward,
+    nll_from_logits,
+)
+
+
+def encoder_config(cfg: ModelConfig) -> ModelConfig:
+    """An encoder is the decoder stack with the whole sequence in the
+    bidirectional prefix region. window (causal-only) must be off."""
+    if cfg.window:
+        raise ValueError("encoder attention is bidirectional; "
+                         "cfg.window (causal sliding window) conflicts")
+    return replace(cfg, prefix=cfg.max_seq)
+
+
+def mlm_corrupt(tokens: jax.Array, key: jax.Array, vocab: int,
+                mask_rate: float = 0.15,
+                keep_rate: float = 0.1, random_rate: float = 0.1
+                ) -> Tuple[jax.Array, jax.Array]:
+    """BERT corruption, fully vectorized: select ``mask_rate`` of
+    positions; of those, 80% become the [MASK] id (vocab-1), 10% a
+    random token, 10% stay unchanged (but still count in the loss).
+    Returns (corrupted_tokens, selected_mask)."""
+    if not 0.0 < mask_rate < 1.0:
+        raise ValueError(f"mask_rate must be in (0, 1), got {mask_rate}")
+    if keep_rate < 0 or random_rate < 0 or keep_rate + random_rate > 1:
+        raise ValueError(
+            f"keep_rate ({keep_rate}) and random_rate ({random_rate}) must "
+            f"be >= 0 and sum to <= 1 — the remainder is the [MASK] share")
+    ksel, kmode, krand = jax.random.split(key, 3)
+    selected = jax.random.bernoulli(ksel, mask_rate, tokens.shape)
+    mode = jax.random.uniform(kmode, tokens.shape)
+    # vocab-1 is the reserved [MASK] id; the random branch must draw
+    # real vocabulary tokens only
+    rand_tok = jax.random.randint(krand, tokens.shape, 0, vocab - 1)
+    mask_tok = jnp.full_like(tokens, vocab - 1)
+    corrupted = jnp.where(mode < 1.0 - keep_rate - random_rate,
+                          mask_tok,
+                          jnp.where(mode < 1.0 - keep_rate,
+                                    rand_tok, tokens))
+    return jnp.where(selected, corrupted, tokens), selected
+
+
+def mlm_loss_fn(params: Params, tokens: jax.Array, key: jax.Array,
+                cfg: ModelConfig, attn_fn=None,
+                mask_rate: float = 0.15) -> jax.Array:
+    """Masked-LM objective: corrupt on device, reconstruct originals at
+    the corrupted positions. ``cfg`` is normalized to an encoder config
+    (bidirectional prefix over the whole sequence) — passing a causal
+    config silently training a degraded 'encoder' is the failure this
+    guards against."""
+    cfg = encoder_config(cfg)
+    corrupted, selected = mlm_corrupt(tokens, key, cfg.vocab, mask_rate)
+    logits = forward(params, corrupted, cfg, attn_fn)
+    return nll_from_logits(logits, tokens, selected)
+
+
+def make_mlm_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,
+                        mask_rate: float = 0.15):
+    """Returns (train_step, init_opt_state); train_step is pure/jittable:
+    (params, opt_state, tokens, key) -> (params, opt_state, loss).
+    The PRNG key threads through so every step draws a fresh corruption
+    pattern inside the jitted computation."""
+    cfg = encoder_config(cfg)
+    opt = optimizer or optax.adamw(1e-3)
+    grad_fn = jax.value_and_grad(partial(
+        mlm_loss_fn, cfg=cfg, attn_fn=attn_fn, mask_rate=mask_rate))
+
+    def train_step(params, opt_state, tokens, key):
+        loss, grads = grad_fn(params, tokens, key)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, opt.init
+
+
+def mlm_accuracy(params: Params, tokens: jax.Array, key: jax.Array,
+                 cfg: ModelConfig, mask_rate: float = 0.15,
+                 attn_fn=None) -> float:
+    """Reconstruction accuracy at corrupted positions (the MLM eval
+    metric)."""
+    cfg = encoder_config(cfg)
+    corrupted, selected = mlm_corrupt(tokens, key, cfg.vocab, mask_rate)
+    pred = jnp.argmax(forward(params, corrupted, cfg, attn_fn), axis=-1)
+    hits = jnp.where(selected, (pred == tokens), False)
+    return float(hits.sum() / jnp.maximum(selected.sum(), 1))
