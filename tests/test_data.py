@@ -175,8 +175,9 @@ def test_byte_corpus_respects_byte_caps(tmp_path):
 
 
 def test_byte_corpus_default_roots_find_real_text():
-    """The default roots (this package + the stdlib) must yield several
-    MB of real text on any host — the real-data bench depends on it."""
+    """The default root (the Python stdlib — stable across repo edits,
+    so bench corpora are reproducible) must yield several MB of real
+    text on any host — the real-data bench depends on it."""
     from tpu_dra_driver.workloads.data import byte_corpus
     tr, ho = byte_corpus(max_total_bytes=1 << 20)
     # train + holdout together must cover the cap: on hosts where the

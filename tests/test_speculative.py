@@ -299,3 +299,22 @@ def test_non_tie_divergence_raises(monkeypatch):
     with pt.raises(RuntimeError, match="NOT a bf16 near-tie"):
         spec._measure_early_exit(params, cfg, prompt, draft_layers=1,
                                  gen=8, gamma=2, iters=1)
+
+
+def test_early_exit_synthetic_bench_runs():
+    """Regression: the synthetic (bigram-chain) early-exit bench is a
+    distinct code path from the real-data one and must run standalone
+    (a shared-refactor edit once broke only this path)."""
+    from tpu_dra_driver.workloads.models.speculative import (
+        early_exit_decode_tokens_per_sec,
+    )
+    from tpu_dra_driver.workloads.models.transformer import ModelConfig
+    cfg = ModelConfig(vocab=256, d_model=64, n_heads=2, n_kv_heads=2,
+                      n_layers=2, d_ff=128, max_seq=16 + 16 + 3 + 2,
+                      use_rope=True)
+    r = early_exit_decode_tokens_per_sec(
+        b=1, prompt_len=16, gen=16, gamma=3, draft_layers=1,
+        train_steps=10, iters=1, cfg=cfg)
+    assert r["exact_greedy"] in (True, False)
+    assert r["train_steps"] >= 10
+    assert r["spec_tokens_per_sec"] > 0

@@ -89,17 +89,18 @@ def byte_corpus(roots: Optional[Iterable[str]] = None,
     This is the "real data" source for trained-checkpoint benchmarks in
     an offline environment: source code and docs have natural-language
     statistics (long-range structure, a heavy-tailed byte distribution,
-    genuinely unpredictable spans) that synthetic chains lack. Default
-    roots are this package's own tree plus the Python stdlib — several
-    MB of human-written text available on any host.
+    genuinely unpredictable spans) that synthetic chains lack. The
+    default root is the Python stdlib — several MB of human-written
+    text available on any host, and (unlike this package's own tree,
+    which changes with every commit) STABLE across runs, so benchmark
+    corpora and holdout splits are reproducible.
 
     Deterministic: files walk in sorted order, so the same roots yield
     the same corpus (and the same train/holdout split) on every run.
     """
     if roots is None:
         import sysconfig
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        roots = [pkg_root, sysconfig.get_paths()["stdlib"]]
+        roots = [sysconfig.get_paths()["stdlib"]]
     train, holdout, total, idx = [], [], 0, 0
     for root in roots:
         if total >= max_total_bytes:

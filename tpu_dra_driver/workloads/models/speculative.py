@@ -337,8 +337,7 @@ def early_exit_decode_tokens_per_sec(
         return toks.T                                   # [nb, t_train]
 
     train_step, opt_init = make_train_step(
-        cfg, optimizer=optax.adamw(3e-4),
-        exit_layer=draft_layers if exit_aux else None)
+        cfg, optimizer=optax.adamw(3e-4))
     opt_state = opt_init(params)
 
     @jax.jit
@@ -464,7 +463,7 @@ def early_exit_real_data_tokens_per_sec(
         train_seq: int = 512, iters: int = 3,
         cfg: Optional[ModelConfig] = None,
         corpus_roots=None, exit_aux: bool = True,
-        n_prompts: int = 3) -> dict:
+        n_prompts: int = 5) -> dict:
     """Early-exit speculative decode on a REAL-DATA-trained checkpoint.
 
     The honest version of ``early_exit_decode_tokens_per_sec``: instead
@@ -567,10 +566,13 @@ def early_exit_real_data_tokens_per_sec(
     # --- measure on n_prompts distinct heldout prompts ------------------
     pools = [d for d in holdout_docs if len(d) >= prompt_len] or holdout_docs
     runs = []
+    # spread prompt picks across the whole heldout pool (adjacent files
+    # in a sorted walk are correlated — same directory, same style)
+    stride = max(1, len(pools) // max(n_prompts * b, 1))
     for pi in range(n_prompts):
         rows = []
         for i in range(b):
-            d = pools[(pi * b + i) % len(pools)]
+            d = pools[((pi * b + i) * stride) % len(pools)]
             row = d[:prompt_len]
             if len(row) < prompt_len:       # tiny holdout doc: tile
                 row = np.tile(d, -(-prompt_len // len(d)))[:prompt_len]
