@@ -910,3 +910,26 @@ def test_encoder_rejects_window():
                       d_ff=64, max_seq=16, use_rope=True, window=8)
     with pytest.raises(ValueError, match="bidirectional"):
         encoder_config(cfg)
+
+
+def test_mlm_encoder_trains_under_mesh_shardings():
+    """The encoder family composes with the SPMD tier: the MLM step
+    under dp/tp param+batch shardings computes the same loss as
+    unsharded (XLA inserts the tp collectives; masking stays on
+    device)."""
+    from tpu_dra_driver.workloads.models.encoder import (
+        encoder_config, mlm_loss_fn)
+    ecfg = encoder_config(CFG)
+    key = jax.random.PRNGKey(2)
+    params = init_params(ecfg, key)
+    tokens = jax.random.randint(key, (8, 32), 0, CFG.vocab)
+    mkey = jax.random.PRNGKey(7)
+    ref = float(jax.jit(lambda p, t: mlm_loss_fn(p, t, mkey, CFG))(
+        params, tokens))
+
+    mesh = build_mesh(jax.devices(), dp=4, tp=2)
+    params_s = jax.device_put(params, param_shardings(mesh, params))
+    tokens_s = jax.device_put(tokens, batch_sharding(mesh))
+    got = float(jax.jit(lambda p, t: mlm_loss_fn(p, t, mkey, CFG))(
+        params_s, tokens_s))
+    assert abs(got - ref) < 1e-3, (got, ref)
