@@ -318,3 +318,101 @@ def test_early_exit_synthetic_bench_runs():
     assert r["exact_greedy"] in (True, False)
     assert r["train_steps"] >= 10
     assert r["spec_tokens_per_sec"] > 0
+
+
+def test_speculative_sample_identical_draft_accepts_all():
+    """With draft == target the acceptance ratio is exactly 1, so every
+    proposal is accepted (u < 1 a.s.) and rounds finalize gamma+1."""
+    tparams = init_params(TCFG, jax.random.PRNGKey(0))
+    prompt = _prompt(b=2)
+    from tpu_dra_driver.workloads.models.speculative import (
+        speculative_sample,
+    )
+    out, stats = speculative_sample(tparams, TCFG, tparams, TCFG, prompt,
+                                    steps=16, key=jax.random.PRNGKey(5),
+                                    gamma=4, temperature=1.0,
+                                    return_stats=True)
+    assert out.shape == (2, prompt.shape[1] + 16)
+    assert stats["mean_accepted"] == pytest.approx(4.0)
+
+
+def test_speculative_sample_validation():
+    from tpu_dra_driver.workloads.models.speculative import (
+        speculative_sample,
+    )
+    tparams = init_params(TCFG, jax.random.PRNGKey(0))
+    prompt = _prompt()
+    with pytest.raises(ValueError, match="temperature"):
+        speculative_sample(tparams, TCFG, tparams, TCFG, prompt, steps=4,
+                           key=jax.random.PRNGKey(0), temperature=0.0)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_sample(tparams, TCFG, tparams, TCFG, prompt, steps=4,
+                           key=jax.random.PRNGKey(0), gamma=0)
+
+
+def test_speculative_sample_matches_target_distribution():
+    """The exactness claim, empirically: with a MISMATCHED draft (random
+    init, different seed/width — acceptance is poor, so the residual
+    path is exercised constantly), the conditional law of the
+    second generated token given the first must match the target's
+    tempered softmax. Batched rows give thousands of independent
+    samples in a handful of compiled calls."""
+    from tpu_dra_driver.workloads.models.generate import block_prefill
+    from tpu_dra_driver.workloads.models.speculative import (
+        speculative_sample,
+    )
+    from tpu_dra_driver.workloads.models.transformer import forward
+    vocab = 8
+    tcfg = ModelConfig(vocab=vocab, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, max_seq=32, use_rope=True,
+                       dtype=jnp.float32)
+    dcfg = ModelConfig(vocab=vocab, d_model=16, n_heads=2, n_layers=1,
+                       d_ff=32, max_seq=32, use_rope=True,
+                       dtype=jnp.float32)
+    tparams = init_params(tcfg, jax.random.PRNGKey(0))
+    dparams = init_params(dcfg, jax.random.PRNGKey(99))
+    T = 1.3
+    b, t0, reps = 512, 4, 8
+    prompt_row = jnp.asarray([[1, 5, 2, 7]], jnp.int32)
+    prompt = jnp.tile(prompt_row, (b, 1))
+
+    pairs = []
+    for r in range(reps):
+        out = speculative_sample(tparams, tcfg, dparams, dcfg, prompt,
+                                 steps=2, key=jax.random.PRNGKey(1000 + r),
+                                 gamma=3, temperature=T)
+        pairs.append(np.asarray(out[:, t0:t0 + 2]))
+    pairs = np.concatenate(pairs)                      # [b*reps, 2]
+
+    # oracle conditionals P_t(x2 | x1) for each observed first token
+    for x1 in range(vocab):
+        sel = pairs[pairs[:, 0] == x1]
+        if len(sel) < 300:
+            continue
+        ctx = jnp.concatenate(
+            [prompt_row, jnp.full((1, 1), x1, jnp.int32)], axis=1)
+        logits = forward(tparams, ctx, tcfg)[0, -1].astype(jnp.float32)
+        want = np.asarray(jax.nn.softmax(logits / T))
+        got = np.bincount(sel[:, 1], minlength=vocab) / len(sel)
+        # 4-sigma binomial tolerance per bin
+        tol = 4.0 * np.sqrt(want * (1 - want) / len(sel)) + 1e-3
+        assert (np.abs(got - want) < tol).all(), (
+            x1, len(sel), got, want, tol)
+
+
+def test_speculative_sample_low_temperature_approaches_greedy():
+    """As T -> 0 the tempered softmax concentrates on the argmax, so
+    sampling speculation must reproduce the greedy speculative output
+    (same tokens, any key)."""
+    from tpu_dra_driver.workloads.models.speculative import (
+        speculative_generate, speculative_sample,
+    )
+    tparams = init_params(TCFG, jax.random.PRNGKey(0))
+    dparams = init_params(DCFG, jax.random.PRNGKey(9))
+    prompt = _prompt(b=2)
+    want = speculative_generate(tparams, TCFG, dparams, DCFG, prompt,
+                                steps=12, gamma=3)
+    got = speculative_sample(tparams, TCFG, dparams, DCFG, prompt,
+                             steps=12, key=jax.random.PRNGKey(3),
+                             gamma=3, temperature=1e-4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -37,6 +37,7 @@ from tpu_dra_driver.workloads.models.speculative import (  # noqa: F401
     self_speculative_generate,
     speculative_decode_tokens_per_sec,
     speculative_generate,
+    speculative_sample,
 )
 from tpu_dra_driver.workloads.models.generate import (  # noqa: F401
     block_prefill,

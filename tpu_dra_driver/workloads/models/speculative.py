@@ -7,7 +7,10 @@ steps). Accepted drafts cost the target a single weight stream per
 round, so tokens/s rises by roughly the mean accepted length while the
 output stays *exactly* the target's greedy decode (the acceptance rule
 compares the target's argmax to the draft token — no distribution
-drift, unlike sampling-based acceptance which this module doesn't do).
+drift). :func:`speculative_sample` is the temperature-sampling variant:
+the Leviathan/Chen rejection rule (accept w.p. min(1, p_t/p_d),
+residual-resample on reject) keeps the output distributed exactly as
+target sampling, for any draft.
 
 A TPU-natural draft is the int8-quantized target itself
 (``quantize_params``): half the HBM bytes per draft step, and its argmax
@@ -81,15 +84,7 @@ def speculative_generate(target_params: Params, target_cfg: ModelConfig,
     if steps <= 0:
         return (prompt, {"rounds": 0, "mean_accepted": 0.0}) \
             if return_stats else prompt
-    if gamma < 1:
-        raise ValueError(f"gamma must be >= 1, got {gamma}")
-    if target_cfg.window > 0 or draft_cfg.window > 0:
-        raise ValueError("speculative decoding needs full-length caches "
-                         "(window == 0) — the wide verify is positional")
-    if target_cfg.vocab != draft_cfg.vocab:
-        raise ValueError(
-            f"target/draft vocab mismatch: {target_cfg.vocab} vs "
-            f"{draft_cfg.vocab}")
+    _validate_spec(target_cfg, draft_cfg, gamma)
     out, rounds, acc = _spec_generate(
         target_params, draft_params, prompt, target_cfg, draft_cfg,
         steps, gamma)
@@ -142,10 +137,26 @@ def early_exit_draft(params: Params, cfg: ModelConfig, n_layers: int,
     return draft, dcfg
 
 
-@partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
-                                   "gamma"))
-def _spec_generate(target_params, draft_params, prompt, target_cfg,
-                   draft_cfg, steps, gamma):
+def _validate_spec(target_cfg, draft_cfg, gamma):
+    """Wrapper-level checks shared by the greedy and sampling variants
+    (one place to fix means no drift between them)."""
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_cfg.window > 0 or draft_cfg.window > 0:
+        raise ValueError("speculative decoding needs full-length caches "
+                         "(window == 0) — the wide verify is positional")
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"target/draft vocab mismatch: {target_cfg.vocab} vs "
+            f"{draft_cfg.vocab}")
+
+
+def _spec_setup(target_params, draft_params, prompt, target_cfg,
+                draft_cfg, steps, gamma):
+    """Shared loop preamble: capacity check, cache allocation, dual
+    prefill. Returns (last_logits, tcache, dcache, pos, max_t). The
+    prefix-LM prompt region is bidirectional in both models, mirroring
+    generate()'s default (decode steps are causal either way)."""
     b, t0 = prompt.shape
     # capacity: prompt + generated + one round's overshoot
     max_t = t0 + steps + gamma + 2
@@ -154,18 +165,24 @@ def _spec_generate(target_params, draft_params, prompt, target_cfg,
             raise ValueError(
                 f"t0+steps+gamma+2 ({max_t}) exceeds max_seq {cfg.max_seq} "
                 f"(learned pos_embed bounds the sequence)")
-
     tcache = init_kv_cache(target_cfg, b, max_t)
     dcache = init_kv_cache(draft_cfg, b, max_t)
-
-    # prefill both models; target's last logits give the first token.
-    # prefix-LM configs get the bidirectional prompt region, mirroring
-    # generate()'s default (decode steps are causal either way)
     last_logits, tcache, pos = block_prefill(
         target_params, target_cfg, tcache, prompt,
         prefix_lm=target_cfg.prefix > 0)
     _, dcache, _ = block_prefill(draft_params, draft_cfg, dcache, prompt,
                                  prefix_lm=draft_cfg.prefix > 0)
+    return last_logits, tcache, dcache, pos, max_t
+
+
+@partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
+                                   "gamma"))
+def _spec_generate(target_params, draft_params, prompt, target_cfg,
+                   draft_cfg, steps, gamma):
+    b, t0 = prompt.shape
+    last_logits, tcache, dcache, pos, max_t = _spec_setup(
+        target_params, draft_params, prompt, target_cfg, draft_cfg,
+        steps, gamma)
     first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)   # [b]
 
     # token buffer: prompt + everything generated (+ round overshoot)
@@ -607,3 +624,168 @@ def early_exit_real_data_tokens_per_sec(
         shape=runs[mid]["shape"] + " byte-LM",
     )
     return out
+
+
+def speculative_sample(target_params: Params, target_cfg: ModelConfig,
+                       draft_params: Params, draft_cfg: ModelConfig,
+                       prompt: jax.Array, steps: int, key: jax.Array,
+                       gamma: int = 4, temperature: float = 1.0,
+                       return_stats: bool = False):
+    """Sampling-based speculative decoding (the Leviathan/Chen rejection
+    rule): the draft SAMPLES gamma tokens from its own
+    softmax(logits/T); the target verifies them in one wide forward and
+    accepts token x with probability min(1, p_t(x)/p_d(x)); the first
+    rejected position resamples from the residual normalize(max(p_t -
+    p_d, 0)); a fully-accepted round samples the bonus token from the
+    target directly. Per position the output token's law is the
+    accept/residual MIXTURE, which telescopes to exactly ``p_t`` — so
+    the output is distributed EXACTLY as the target sampling at this
+    temperature, for ANY draft (the draft only changes the speed).
+
+    Batched rounds use the batch-minimum finalized length (same
+    conservative rule as greedy): truncation only changes how MANY
+    positions finalize per round, never the law of a finalized token —
+    rows that accepted at the cut keep their accepted draft token, rows
+    that rejected there take their residual sample.
+
+    Plain temperature only (no top-k): truncation would have to be
+    applied identically to both distributions for the residual algebra
+    to stay exact, which ``generate()``'s top-k does not guarantee for
+    the draft. ``temperature`` must be > 0 — use
+    :func:`speculative_generate` for greedy.
+    """
+    if steps <= 0:
+        return (prompt, {"rounds": 0, "mean_accepted": 0.0}) \
+            if return_stats else prompt
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if temperature <= 0:
+        raise ValueError("speculative_sample needs temperature > 0; "
+                         "greedy is speculative_generate")
+    _validate_spec(target_cfg, draft_cfg, gamma)
+    out, rounds, acc = _spec_sample_generate(
+        target_params, draft_params, prompt, key, target_cfg, draft_cfg,
+        steps, gamma, temperature)
+    if return_stats:
+        r = max(int(rounds), 1)
+        return out, {"rounds": int(rounds),
+                     "mean_accepted": float(acc) / r}
+    return out
+
+
+@partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
+                                   "gamma"))
+def _spec_sample_generate(target_params, draft_params, prompt, key,
+                          target_cfg, draft_cfg, steps, gamma,
+                          temperature):
+    # temperature is a TRACED operand (same choice as generate()):
+    # sweeping temperatures reuses one compiled program
+    b, t0 = prompt.shape
+    inv_t = 1.0 / jnp.float32(temperature)
+    last_logits, tcache, dcache, pos, max_t = _spec_setup(
+        target_params, draft_params, prompt, target_cfg, draft_cfg,
+        steps, gamma)
+    key, kfirst = jax.random.split(key)
+    first = jax.random.categorical(
+        kfirst, last_logits.astype(jnp.float32) * inv_t,
+        axis=-1).astype(prompt.dtype)                           # [b]
+
+    buf = jnp.zeros((b, max_t), prompt.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, t0))
+
+    def cond(c):
+        return c["n"] < steps
+
+    def body(c):
+        buf, n, pos, t_last = c["buf"], c["n"], c["pos"], c["t_last"]
+        tcache, dcache = c["tcache"], c["dcache"]
+        key = c["key"]
+        key, kdraft, kacc, kfix = jax.random.split(key, 4)
+
+        prev = jax.lax.dynamic_slice(buf, (0, pos - 1), (b, 1))[:, 0]
+        _, dcache = decode_step(draft_params, draft_cfg, dcache,
+                                pos - 1, prev)
+
+        # draft SAMPLES gamma tokens; keep its full tempered
+        # distribution per step for the acceptance ratio + residual
+        def propose(carry, kk):
+            dcache, p, tok = carry
+            logits, dcache = decode_step(draft_params, draft_cfg, dcache,
+                                         p, tok)
+            dist = jax.nn.softmax(
+                logits.astype(jnp.float32) * inv_t, axis=-1)    # [b, V]
+            nxt = jax.random.categorical(
+                kk, logits.astype(jnp.float32) * inv_t,
+                axis=-1).astype(tok.dtype)
+            return (dcache, p + 1, nxt), (nxt, dist)
+
+        (dcache, _, _), (drafts, ddists) = jax.lax.scan(
+            propose, (dcache, pos, t_last),
+            jax.random.split(kdraft, gamma))
+        drafts = drafts.transpose(1, 0)                         # [b, g]
+        ddists = ddists.transpose(1, 0, 2)                      # [b, g, V]
+
+        block = jnp.concatenate([t_last[:, None], drafts], axis=1)
+        logits, tcache = wide_step(target_params, target_cfg, tcache,
+                                   pos, block)
+        tdists = jax.nn.softmax(
+            logits.astype(jnp.float32) * inv_t, axis=-1)     # [b, g+1, V]
+
+        # accept d_i with prob min(1, pt(d_i)/pd(d_i))
+        d_idx = drafts[..., None].astype(jnp.int32)
+        pt_d = jnp.take_along_axis(tdists[:, :-1], d_idx, axis=2)[..., 0]
+        pd_d = jnp.take_along_axis(ddists, d_idx, axis=2)[..., 0]
+        u = jax.random.uniform(kacc, (b, gamma))
+        accept = u * pd_d < pt_d                               # [b, g]
+        acc_count = jnp.sum(jnp.cumprod(
+            accept.astype(jnp.int32), axis=1), axis=1)          # [b]
+        k = jnp.min(acc_count)
+
+        # the token at column k, per row:
+        #   row rejected at k (acc_count == k, k < gamma) -> residual
+        #     sample from normalize(max(pt_k - pd_k, 0))
+        #   row accepted at k (acc_count > k)             -> draft d_k
+        #   k == gamma (everyone accepted it all)          -> bonus ~ pt_g
+        kk = jnp.minimum(k, gamma - 1)          # safe gather index
+        pt_k = jnp.take_along_axis(
+            tdists, jnp.full((b, 1, 1), kk), axis=1)[:, 0]      # [b, V]
+        pd_k = jnp.take_along_axis(
+            ddists, jnp.full((b, 1, 1), kk), axis=1)[:, 0]      # [b, V]
+        resid = jnp.maximum(pt_k - pd_k, 0.0)
+        # a rejection guarantees resid has mass; the +eps floor only
+        # guards the never-sampled branches from log(0)
+        resid = resid / jnp.maximum(resid.sum(-1, keepdims=True), 1e-30)
+        pt_bonus = tdists[:, -1]                                # [b, V]
+        use_bonus = (k == gamma)
+        # fp32-rounded all-zero residual after a rejection falls back to
+        # the REJECTED position's target distribution (pt_k), not the
+        # bonus column's — the pathological branch stays at the right
+        # conditional
+        fix_dist = jnp.where(use_bonus, pt_bonus,
+                             jnp.where(resid.sum(-1, keepdims=True) > 0,
+                                       resid, pt_k))
+        fixed = jax.random.categorical(
+            kfix, jnp.log(jnp.maximum(fix_dist, 1e-30)),
+            axis=-1).astype(t_last.dtype)                       # [b]
+        d_at_k = jnp.take_along_axis(
+            drafts, jnp.full((b, 1), kk), axis=1)[:, 0]         # [b]
+        tok_k = jnp.where(use_bonus | (acc_count == k), fixed, d_at_k)
+
+        cols = jnp.arange(gamma + 1)
+        drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))          # [b, g+1]
+        outk = jnp.where(cols[None, :] < k, drafts_pad,
+                         tok_k[:, None])
+        buf = jax.lax.dynamic_update_slice(buf, outk, (0, pos + 1))
+
+        return {"buf": buf, "n": n + k + 1, "pos": pos + k + 1,
+                "t_last": tok_k, "tcache": tcache, "dcache": dcache,
+                "key": key,
+                "rounds": c["rounds"] + 1, "acc": c["acc"] + k}
+
+    init = {"buf": buf, "n": jnp.int32(1), "pos": jnp.int32(t0),
+            "t_last": first, "tcache": tcache, "dcache": dcache,
+            "key": key, "rounds": jnp.int32(0), "acc": jnp.int32(0)}
+    final = jax.lax.while_loop(cond, body, init)
+    out = jax.lax.dynamic_slice(final["buf"], (0, 0), (b, t0 + steps))
+    return out, final["rounds"], final["acc"]
