@@ -416,3 +416,66 @@ def test_speculative_sample_low_temperature_approaches_greedy():
                              steps=12, key=jax.random.PRNGKey(3),
                              gamma=3, temperature=1e-4)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_sample_top_k_matches_truncated_target():
+    """With top_k both models truncate their own tempered distribution;
+    the rejection identity still telescopes to the TRUNCATED target
+    law — checked empirically against the truncated-softmax oracle."""
+    from tpu_dra_driver.workloads.models.speculative import (
+        speculative_sample,
+    )
+    from tpu_dra_driver.workloads.models.transformer import forward
+    vocab, top_k = 8, 3
+    tcfg = ModelConfig(vocab=vocab, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, max_seq=32, use_rope=True,
+                       dtype=jnp.float32)
+    dcfg = ModelConfig(vocab=vocab, d_model=16, n_heads=2, n_layers=1,
+                       d_ff=32, max_seq=32, use_rope=True,
+                       dtype=jnp.float32)
+    tparams = init_params(tcfg, jax.random.PRNGKey(0))
+    dparams = init_params(dcfg, jax.random.PRNGKey(99))
+    T = 1.1
+    b, t0, reps = 512, 4, 8
+    prompt_row = jnp.asarray([[1, 5, 2, 7]], jnp.int32)
+    prompt = jnp.tile(prompt_row, (b, 1))
+    pairs = []
+    for r in range(reps):
+        out = speculative_sample(tparams, tcfg, dparams, dcfg, prompt,
+                                 steps=2, key=jax.random.PRNGKey(2000 + r),
+                                 gamma=3, temperature=T, top_k=top_k)
+        pairs.append(np.asarray(out[:, t0:t0 + 2]))
+    pairs = np.concatenate(pairs)
+
+    for x1 in range(vocab):
+        sel = pairs[pairs[:, 0] == x1]
+        if len(sel) < 300:
+            continue
+        ctx = jnp.concatenate(
+            [prompt_row, jnp.full((1, 1), x1, jnp.int32)], axis=1)
+        logits = np.asarray(
+            forward(tparams, ctx, tcfg)[0, -1].astype(jnp.float32))
+        kth = np.sort(logits)[-top_k]
+        trunc = np.where(logits >= kth, logits, -np.inf)
+        want = np.asarray(jax.nn.softmax(jnp.asarray(trunc) / T))
+        got = np.bincount(sel[:, 1], minlength=vocab) / len(sel)
+        # tokens outside the target's top-k must never appear at all
+        assert (got[want == 0] == 0).all(), (x1, got, want)
+        tol = 4.0 * np.sqrt(want * (1 - want) / len(sel)) + 1e-3
+        assert (np.abs(got - want) < tol).all(), (
+            x1, len(sel), got, want, tol)
+
+
+def test_speculative_sample_top_k_validation():
+    from tpu_dra_driver.workloads.models.speculative import (
+        speculative_sample,
+    )
+    tparams = init_params(TCFG, jax.random.PRNGKey(0))
+    prompt = _prompt()
+    with pytest.raises(ValueError, match="top_k"):
+        speculative_sample(tparams, TCFG, tparams, TCFG, prompt, steps=4,
+                           key=jax.random.PRNGKey(0), top_k=-1)
+    with pytest.raises(ValueError, match="top_k"):
+        speculative_sample(tparams, TCFG, tparams, TCFG, prompt, steps=4,
+                           key=jax.random.PRNGKey(0),
+                           top_k=TCFG.vocab + 1)

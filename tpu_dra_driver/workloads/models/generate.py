@@ -405,6 +405,17 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
                       + (" int8" if quantized else ""))}
 
 
+def truncate_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Mask logits outside the k largest (last axis) to NEG_INF; the ONE
+    top-k truncation both generate() and speculative_sample() apply, so
+    their sampling laws cannot drift (ties at the k-th value keep the
+    lax.top_k winner). top_k == 0 is a no-op."""
+    if top_k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, NEG_INF)
+
+
 def generate(params: Params, cfg: ModelConfig, prompt: jax.Array,
              steps: int, max_t: Optional[int] = None,
              temperature: float = 0.0, top_k: int = 0,
@@ -493,10 +504,7 @@ def _generate(params, cfg, prompt, steps, max_t, sample, top_k,
     def pick(logits, k):
         if not sample:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        s = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            kth = jax.lax.top_k(s, top_k)[0][..., -1:]   # [b, 1]
-            s = jnp.where(s >= kth, s, NEG_INF)
+        s = truncate_top_k(logits.astype(jnp.float32) / temperature, top_k)
         return jax.random.categorical(k, s, axis=-1).astype(prompt.dtype)
 
     if cfg.window > 0:

@@ -53,6 +53,7 @@ from tpu_dra_driver.workloads.models.quantize import quantize_params
 from tpu_dra_driver.workloads.models.transformer import ModelConfig, Params
 from tpu_dra_driver.workloads.models.generate import (
     block_prefill,
+    truncate_top_k,
     decode_step,
     init_kv_cache,
     wide_step,
@@ -630,6 +631,7 @@ def speculative_sample(target_params: Params, target_cfg: ModelConfig,
                        draft_params: Params, draft_cfg: ModelConfig,
                        prompt: jax.Array, steps: int, key: jax.Array,
                        gamma: int = 4, temperature: float = 1.0,
+                       top_k: int = 0,
                        return_stats: bool = False):
     """Sampling-based speculative decoding (the Leviathan/Chen rejection
     rule): the draft SAMPLES gamma tokens from its own
@@ -648,24 +650,27 @@ def speculative_sample(target_params: Params, target_cfg: ModelConfig,
     rows that accepted at the cut keep their accepted draft token, rows
     that rejected there take their residual sample.
 
-    Plain temperature only (no top-k): truncation would have to be
-    applied identically to both distributions for the residual algebra
-    to stay exact, which ``generate()``'s top-k does not guarantee for
-    the draft. ``temperature`` must be > 0 — use
+    ``top_k > 0`` truncates BOTH models' tempered distributions to
+    their own k highest-probability tokens before the accept/residual
+    algebra runs. The rejection identity holds for any (p_t', p_d')
+    pair, so the output is distributed exactly as the target's
+    truncated sampling — the same law ``generate(top_k=k)`` draws
+    from. ``temperature`` must be > 0 — use
     :func:`speculative_generate` for greedy.
     """
     if steps <= 0:
         return (prompt, {"rounds": 0, "mean_accepted": 0.0}) \
             if return_stats else prompt
-    if gamma < 1:
-        raise ValueError(f"gamma must be >= 1, got {gamma}")
     if temperature <= 0:
         raise ValueError("speculative_sample needs temperature > 0; "
                          "greedy is speculative_generate")
+    if top_k < 0 or top_k > target_cfg.vocab:
+        raise ValueError(
+            f"top_k {top_k} outside [0, vocab={target_cfg.vocab}]")
     _validate_spec(target_cfg, draft_cfg, gamma)
     out, rounds, acc = _spec_sample_generate(
         target_params, draft_params, prompt, key, target_cfg, draft_cfg,
-        steps, gamma, temperature)
+        steps, gamma, temperature, top_k)
     if return_stats:
         r = max(int(rounds), 1)
         return out, {"rounds": int(rounds),
@@ -674,12 +679,13 @@ def speculative_sample(target_params: Params, target_cfg: ModelConfig,
 
 
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
-                                   "gamma"))
+                                   "gamma", "top_k"))
 def _spec_sample_generate(target_params, draft_params, prompt, key,
                           target_cfg, draft_cfg, steps, gamma,
-                          temperature):
+                          temperature, top_k=0):
     # temperature is a TRACED operand (same choice as generate()):
-    # sweeping temperatures reuses one compiled program
+    # sweeping temperatures reuses one compiled program; top_k is
+    # static (it changes the truncation computation's shape of work)
     b, t0 = prompt.shape
     inv_t = 1.0 / jnp.float32(temperature)
     last_logits, tcache, dcache, pos, max_t = _spec_setup(
@@ -687,7 +693,8 @@ def _spec_sample_generate(target_params, draft_params, prompt, key,
         steps, gamma)
     key, kfirst = jax.random.split(key)
     first = jax.random.categorical(
-        kfirst, last_logits.astype(jnp.float32) * inv_t,
+        kfirst, truncate_top_k(last_logits.astype(jnp.float32),
+                                top_k) * inv_t,
         axis=-1).astype(prompt.dtype)                           # [b]
 
     buf = jnp.zeros((b, max_t), prompt.dtype)
@@ -713,11 +720,10 @@ def _spec_sample_generate(target_params, draft_params, prompt, key,
             dcache, p, tok = carry
             logits, dcache = decode_step(draft_params, draft_cfg, dcache,
                                          p, tok)
-            dist = jax.nn.softmax(
-                logits.astype(jnp.float32) * inv_t, axis=-1)    # [b, V]
+            tl = truncate_top_k(logits.astype(jnp.float32), top_k)
+            dist = jax.nn.softmax(tl * inv_t, axis=-1)          # [b, V]
             nxt = jax.random.categorical(
-                kk, logits.astype(jnp.float32) * inv_t,
-                axis=-1).astype(tok.dtype)
+                kk, tl * inv_t, axis=-1).astype(tok.dtype)
             return (dcache, p + 1, nxt), (nxt, dist)
 
         (dcache, _, _), (drafts, ddists) = jax.lax.scan(
@@ -730,7 +736,8 @@ def _spec_sample_generate(target_params, draft_params, prompt, key,
         logits, tcache = wide_step(target_params, target_cfg, tcache,
                                    pos, block)
         tdists = jax.nn.softmax(
-            logits.astype(jnp.float32) * inv_t, axis=-1)     # [b, g+1, V]
+            truncate_top_k(logits.astype(jnp.float32), top_k) * inv_t,
+            axis=-1)                                         # [b, g+1, V]
 
         # accept d_i with prob min(1, pt(d_i)/pd(d_i))
         d_idx = drafts[..., None].astype(jnp.int32)
