@@ -20,7 +20,10 @@ Also measured (stderr, informational):
   workload claims released),
 - on-accelerator MXU matmul TFLOP/s and (if >1 device) ICI psum GB/s.
 
-Prints ONE JSON line on stdout.
+Prints ONE compact JSON line on stdout (headline scalars only, sized to
+survive a 2000-byte tail capture); the full evidence — per-prompt
+speculation arrays, tie-divergence records, baseline notes — is written
+to ``BENCH_DETAIL.json`` next to this script.
 """
 
 import json
@@ -34,6 +37,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REFERENCE_COLD_PREPARE_MS = 10_000.0  # reference nvlib.go:120-126 O(10s) cold path
+
+# Long-context kernels are timed this many times and reported as
+# median+min: the train bar is tight (54.05 vs >=54 in round 4) and a
+# single noisy run must not decide pass/fail.
+LONG_CTX_RUNS = 3
 
 
 def log(msg: str) -> None:
@@ -345,26 +353,42 @@ def bench_accelerator() -> dict:
                 f"{ft['flash_attn_train_tflops']:.2f} TFLOP/s ({ft['shape']})"
                 + (f", {100*ft['flash_attn_train_tflops']/peak:.1f}% MFU"
                    if peak else ""))
+            # long-context keys are reported as median+min over >=3
+            # device-traced runs (VERDICT r4 #3): the train bar (>=54)
+            # was met by 0.1% in round 4, and a single noisy run must
+            # not be able to read as a regression. Run 1 pays the
+            # compile; runs 2..n re-time the cached executable.
             from tpu_dra_driver.workloads.ops import (
                 flash_attention_long_context_tflops,
             )
-            fl = flash_attention_long_context_tflops()
+            fls = [flash_attention_long_context_tflops()
+                   for _ in range(LONG_CTX_RUNS)]
+            fl_vals = sorted(f["flash_attn_long_ctx_tflops"] for f in fls)
             out["flash_attn_long_ctx_tflops"] = round(
-                fl["flash_attn_long_ctx_tflops"], 2)
-            log(f"  sliding-window long context: "
-                f"{fl['flash_attn_long_ctx_tflops']:.2f} TFLOP/s "
-                f"({fl['shape']}, {fl['long_ctx_step_ms']:.1f} ms/step; "
-                f"the [t,t] reference OOMs at this length)")
+                statistics.median(fl_vals), 2)
+            out["flash_attn_long_ctx_min"] = round(fl_vals[0], 2)
+            out["flash_attn_long_ctx_n"] = len(fl_vals)
+            log(f"  sliding-window long context: median "
+                f"{statistics.median(fl_vals):.2f} min {fl_vals[0]:.2f} "
+                f"TFLOP/s over n={len(fl_vals)} runs "
+                f"({fls[0]['shape']}, {fls[0]['long_ctx_step_ms']:.1f} "
+                f"ms/step; the [t,t] reference OOMs at this length)")
             from tpu_dra_driver.workloads.ops.attention import (
                 flash_attention_long_context_train_tflops,
             )
-            flt = flash_attention_long_context_train_tflops()
+            flts = [flash_attention_long_context_train_tflops()
+                    for _ in range(LONG_CTX_RUNS)]
+            flt_vals = sorted(
+                f["flash_attn_long_ctx_train_tflops"] for f in flts)
             out["flash_attn_long_ctx_train_tflops"] = round(
-                flt["flash_attn_long_ctx_train_tflops"], 2)
-            log(f"  sliding-window long context fwd+bwd: "
-                f"{flt['flash_attn_long_ctx_train_tflops']:.2f} TFLOP/s "
-                f"({flt['shape']}, "
-                f"{flt['long_ctx_train_step_ms']:.1f} ms/step — the "
+                statistics.median(flt_vals), 2)
+            out["flash_attn_long_ctx_train_min"] = round(flt_vals[0], 2)
+            out["flash_attn_long_ctx_train_n"] = len(flt_vals)
+            log(f"  sliding-window long context fwd+bwd: median "
+                f"{statistics.median(flt_vals):.2f} min {flt_vals[0]:.2f} "
+                f"TFLOP/s over n={len(flt_vals)} runs "
+                f"({flts[0]['shape']}, "
+                f"{flts[0]['long_ctx_train_step_ms']:.1f} ms/step — the "
                 f"banded grid remap applies to all three kernels)")
             from tpu_dra_driver.workloads.models import (
                 ModelConfig, decode_tokens_per_sec,
@@ -536,7 +560,7 @@ def _bench_spec_early_exit(out: dict) -> None:
         se["speedup"], 3)
     out["spec_decode_early_exit_accepted"] = round(
         se["mean_accepted"], 2)
-    out["spec_decode_early_exit_exact"] = se["exact_greedy"]
+    out["spec_decode_early_exit_verdict"] = _exactness_verdict(se)
     if se["divergence"]:
         out["spec_decode_early_exit_tie_divergence"] = _tie_evidence(se)
     log(f"  early-exit speculative decode (b=1, gamma=8, "
@@ -546,7 +570,7 @@ def _bench_spec_early_exit(out: dict) -> None:
         f"({se['speedup']:.2f}x, mean accepted "
         f"{se['mean_accepted']:.1f}/8, draft cost "
         f"r={se['draft_cost_ratio']:.2f}, "
-        f"exact-greedy={se['exact_greedy']})")
+        f"verdict={out['spec_decode_early_exit_verdict']})")
 
 
 def _tie_evidence(result: dict) -> list:
@@ -556,6 +580,28 @@ def _tie_evidence(result: dict) -> list:
     return [{k: (round(v, 5) if k == "top2_gap" else v)
              for k, v in d.items()}        # row/pos/top2_gap (+ prompt
             for d in result["divergence"]]  # index for multi-prompt runs)
+
+
+def _exactness_verdict(result: dict) -> str:
+    """Three-state exactness verdict a JSON consumer can trust without
+    re-deriving the tie analysis (VERDICT r4 weak #4):
+
+    - ``exact``: speculative output is token-identical to plain greedy.
+    - ``exact_up_to_bf16_ties``: the only mismatches are bf16 near-ties
+      (top-2 logit gap within tolerance), where the wide-verify and
+      matvec decode paths legitimately argmax-flip — each already
+      individually vetted by the workload, which RAISES on any non-tie
+      mismatch (speculative.py:440-453).
+    - ``diverged``: never reported — a true divergence raises here (and
+      upstream) instead of being recorded as a clean metric.
+    """
+    if result["exact_greedy"]:
+        return "exact"
+    if result["divergence"]:
+        return "exact_up_to_bf16_ties"
+    raise AssertionError(
+        "speculative decode diverged from plain greedy with no tie "
+        "evidence — correctness failure, refusing to record a verdict")
 
 
 def _bench_spec_real_data(out: dict) -> None:
@@ -578,7 +624,7 @@ def _bench_spec_real_data(out: dict) -> None:
     out["spec_decode_real_data_per_prompt"] = sr["per_prompt"]
     out["spec_decode_real_data_accepted"] = round(
         sr["mean_accepted"], 2)
-    out["spec_decode_real_data_exact"] = sr["exact_greedy"]
+    out["spec_decode_real_data_verdict"] = _exactness_verdict(sr)
     if sr["divergence"]:
         out["spec_decode_real_data_tie_divergence"] = _tie_evidence(sr)
     out["spec_decode_real_data_train_loss"] = round(
@@ -598,7 +644,51 @@ def _bench_spec_real_data(out: dict) -> None:
         f"heldout prompts, mean accepted "
         f"{sr['mean_accepted']:.2f}/8 — honestly <8/8, draft "
         f"cost r={sr['draft_cost_ratio']:.2f}, "
-        f"exact-greedy={sr['exact_greedy']}{div_msg})")
+        f"verdict={out['spec_decode_real_data_verdict']}{div_msg})")
+
+
+# Headline scalars only. A whitelist, so a stray evidence array can
+# never re-bloat the summary line past the capture tail.
+SUMMARY_KEYS = [
+    "crossproc", "inprocess_p50_ms", "grpc_p50_ms", "cd_rendezvous_ms",
+    "backend", "devices",
+    "matmul_tflops_bf16_steady", "matmul_mfu",
+    "flash_attn_tflops", "flash_vs_splash",
+    "flash_attn_train_tflops",
+    "flash_attn_long_ctx_tflops", "flash_attn_long_ctx_min",
+    "flash_attn_long_ctx_n",
+    "flash_attn_long_ctx_train_tflops", "flash_attn_long_ctx_train_min",
+    "flash_attn_long_ctx_train_n",
+    "decode_tokens_per_sec", "decode_tokens_per_sec_int8_kv8",
+    "train_tokens_per_sec", "train_mfu",
+    "serving_speedup_batching", "serving_tokens_per_sec_device",
+    "spec_decode_early_exit_speedup_b1",
+    "spec_decode_early_exit_verdict",
+    "spec_decode_early_exit_real_data",
+    "spec_decode_real_data_accepted",
+    "spec_decode_real_data_verdict",
+]
+
+# Keep well under the harness's 2000-byte tail capture: the committed
+# artifact wraps this line in its own JSON envelope, so leave headroom.
+SUMMARY_LINE_BUDGET = 1500
+
+
+def summary_line(header: dict, detail_extra: dict) -> str:
+    """The one stdout line: header + whitelisted headline scalars.
+
+    Belt-and-braces: the whitelist keeps the line ~1.1 kB; if it ever
+    grows anyway, shed headline keys from the tail (never the header)
+    until it fits the capture budget.
+    """
+    keys = list(SUMMARY_KEYS)
+    extra = {k: detail_extra[k] for k in keys if k in detail_extra}
+    extra["detail"] = "BENCH_DETAIL.json"
+    line = json.dumps({**header, "extra": extra})
+    while len(line.encode()) > SUMMARY_LINE_BUDGET and keys:
+        extra.pop(keys.pop(), None)
+        line = json.dumps({**header, "extra": extra})
+    return line
 
 
 def main() -> int:
@@ -664,25 +754,44 @@ def main() -> int:
         "the inprocess_*/subslice/grpc keys; cd_rendezvous_ms is "
         "in-process threads over the fake cluster, the cross-process "
         "CD rendezvous (~5 s) lives in E2E_RESULTS.json (make e2e-sim)")
-    print(json.dumps({
+    header = {
         "metric": "resourceclaim_to_ready_p50",
         "value": round(primary_p50, 3),
         "unit": "ms",
         "vs_baseline": round(REFERENCE_COLD_PREPARE_MS / primary_p50, 1),
-        "extra": {
-            "crossproc": xp50 is not None,
-            "crossproc_p95_ms": round(xp95, 3) if xp95 is not None else None,
-            "inprocess_p50_ms": round(p50, 3),
-            "inprocess_p95_ms": round(p95, 3),
-            "subslice_p50_ms": round(statistics.median(lat_ss), 3),
-            "grpc_p50_ms": round(statistics.median(lat_g), 3),
-            "cd_rendezvous_ms": round(rdv_ms, 1),
-            "vs_baseline_note": (
-                (crossproc_note if xp50 is not None else fallback_note)
-                + note_tail),
-            **accel,
-        },
-    }))
+    }
+    detail_extra = {
+        "crossproc": xp50 is not None,
+        "crossproc_p95_ms": round(xp95, 3) if xp95 is not None else None,
+        "inprocess_p50_ms": round(p50, 3),
+        "inprocess_p95_ms": round(p95, 3),
+        "subslice_p50_ms": round(statistics.median(lat_ss), 3),
+        "grpc_p50_ms": round(statistics.median(lat_g), 3),
+        "cd_rendezvous_ms": round(rdv_ms, 1),
+        "vs_baseline_note": (
+            (crossproc_note if xp50 is not None else fallback_note)
+            + note_tail),
+        **accel,
+    }
+    # Full evidence (per-prompt arrays, tie divergence records, long
+    # notes) goes to a side file; the one stdout line stays compact so
+    # a tail-capture harness records the primary metric intact
+    # (VERDICT r4 #1: round 4's line outgrew a 2000-byte tail and the
+    # committed artifact lost its parsed block).
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    try:
+        with open(detail_path, "w") as f:
+            json.dump({**header, "extra": detail_extra}, f, indent=1)
+            f.write("\n")
+        log(f"[bench] full evidence written to {detail_path}")
+    except OSError as e:
+        # the detail file is secondary evidence — losing it (read-only
+        # checkout, disk full) must never cost the stdout summary line
+        # that minutes of TPU work just earned
+        log(f"[bench] WARNING: could not write {detail_path}: {e}")
+
+    print(summary_line(header, detail_extra))
     return 0
 
 
