@@ -278,7 +278,8 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="fewer perf iterations (CI mode)")
     ap.add_argument("--keep-root", action="store_true")
-    ap.add_argument("--phases", default="tpu-plugin,compute-domain",
+    ap.add_argument("--phases",
+                    default="tpu-plugin,compute-domain,collective-bench",
                     help="comma-separated phase list")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT,
                                                   "E2E_RESULTS.json"))
@@ -313,6 +314,16 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             log(f"FAIL compute-domain: {e}")
             results["compute_domain"] = {"status": "failed", "error": str(e)}
+            rc = 1
+    if "collective-bench" in phases:
+        from run_e2e_sim_cd import phase_collective_bench_spec
+        try:
+            results["collective_bench_spec"] = phase_collective_bench_spec(
+                os.path.join(root, "ici"))
+        except Exception as e:  # noqa: BLE001
+            log(f"FAIL collective-bench: {e}")
+            results["collective_bench_spec"] = {"status": "failed",
+                                                "error": str(e)}
             rc = 1
 
     with open(args.out, "w") as f:

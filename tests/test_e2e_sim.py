@@ -33,6 +33,20 @@ def test_sim_e2e_tpu_plugin_quick(tmp_path):
     assert tp["claim_to_ready_ms"]["p50"] > 0
 
 
+def test_sim_e2e_collective_bench_spec(tmp_path):
+    """The committed ICI collective-bench job YAML allocates end to end:
+    CD doc -> controller-stamped template -> indexed worker claims on
+    distinct nodes -> worker env rendered (VERDICT r4 #5; reference bar
+    tests/bats/test_cd_mnnvl_workload.bats)."""
+    cb = _run_phase(tmp_path, "collective-bench")["collective_bench_spec"]
+    assert cb["status"] == "green"
+    assert cb["spec"] == "demo/specs/ici/collective-bench-job.yaml"
+    assert cb["entrypoint"] == "tpu_dra_driver.workloads.ops.collectives"
+    assert cb["worker_env"]["ids"] == ["0", "1"]
+    assert len(cb["worker_env"]["hostnames"].split(",")) == 2
+    assert cb["teardown_clean"]
+
+
 def test_sim_e2e_compute_domain(tmp_path):
     cd = _run_phase(tmp_path, "compute-domain")["compute_domain"]
     assert cd["status"] == "green"

@@ -1111,7 +1111,8 @@ def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
                                         window: int = 2048,
                                         dtype=jnp.bfloat16, iters: int = 3,
                                         chain_short: int = 8,
-                                        chain_long: int = 24):
+                                        chain_long: int = 24,
+                                        n_runs: int = 1):
     """Sliding-window flash attention at long context.
 
     The capability this measures: at t = 16k the reference attention's
@@ -1119,8 +1120,13 @@ def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
     cannot run — while the banded kernel touches O(t*window) and its
     FLOPs drop by ~t/(2*window). Useful-FLOP accounting counts only the
     visible band: sum_r min(r+1, window) pairs, 4*d FLOPs each.
-    Device-trace timing as the other attention benches."""
-    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
+    Device-trace timing as the other attention benches. ``n_runs`` > 1
+    re-times the SAME compiled chain and returns every sample in
+    ``runs_tflops`` (headline key = median) — the stability evidence for
+    the tight BASELINE.md bar."""
+    from tpu_dra_driver.workloads.utils.timing import (
+        chain_seconds_per_step_runs,
+    )
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -1140,10 +1146,14 @@ def flash_attention_long_context_tflops(b: int = 1, h: int = 8,
             return jax.lax.fori_loop(0, n, body, q)
         return lambda: run(q, k, v)
 
-    per = chain_seconds_per_step(make_run, chain_short, chain_long, iters)
+    pers = chain_seconds_per_step_runs(make_run, chain_short, chain_long,
+                                       iters, n_runs)
     visible = window * (window + 1) // 2 + (t - window) * window
     flops = 4 * b * h * d * visible
-    return {"flash_attn_long_ctx_tflops": flops / per / 1e12,
+    runs = sorted(flops / p / 1e12 for p in pers)
+    per = sorted(pers)[len(pers) // 2]
+    return {"flash_attn_long_ctx_tflops": runs[len(runs) // 2],
+            "runs_tflops": runs,
             "long_ctx_step_ms": per * 1e3,
             "shape": f"b{b} h{h} t{t} w{window} d{d} {jnp.dtype(dtype).name}"}
 
@@ -1192,14 +1202,17 @@ def flash_attention_train_tflops(b: int = 4, h: int = 8, t: int = 2048,
 def flash_attention_long_context_train_tflops(
         b: int = 1, h: int = 8, t: int = 16384, d: int = 128,
         window: int = 2048, dtype=jnp.bfloat16, iters: int = 3,
-        chain_short: int = 4, chain_long: int = 12):
+        chain_short: int = 4, chain_long: int = 12, n_runs: int = 1):
     """Forward+backward sliding-window attention at long context — the
     long-context TRAINING capability. All three kernels run with the
     banded grid remap (without it the backward pays the same dead
     superblock DMA the forward did). FLOP accounting mirrors
     flash_attention_train_tflops: 3.5x the forward's band-visible
-    pairs."""
-    from tpu_dra_driver.workloads.utils.timing import chain_seconds_per_step
+    pairs. ``n_runs`` > 1 re-times the SAME compiled chain and returns
+    every sample in ``runs_tflops`` (headline key = median)."""
+    from tpu_dra_driver.workloads.utils.timing import (
+        chain_seconds_per_step_runs,
+    )
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -1225,9 +1238,13 @@ def flash_attention_long_context_train_tflops(
             return jax.lax.fori_loop(0, n, body, (q, k, v))
         return lambda: run(q, k, v)
 
-    per = chain_seconds_per_step(make_run, chain_short, chain_long, iters)
+    pers = chain_seconds_per_step_runs(make_run, chain_short, chain_long,
+                                       iters, n_runs)
     visible = window * (window + 1) // 2 + (t - window) * window
     flops = 3.5 * 4 * b * h * d * visible
-    return {"flash_attn_long_ctx_train_tflops": flops / per / 1e12,
+    runs = sorted(flops / p / 1e12 for p in pers)
+    per = sorted(pers)[len(pers) // 2]
+    return {"flash_attn_long_ctx_train_tflops": runs[len(runs) // 2],
+            "runs_tflops": runs,
             "long_ctx_train_step_ms": per * 1e3,
             "shape": f"b{b} h{h} t{t} w{window} d{d} {jnp.dtype(dtype).name}"}

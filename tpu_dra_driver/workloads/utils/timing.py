@@ -146,3 +146,27 @@ def chain_seconds_per_step(make_run: Callable[[int], Callable[[], Any]],
     if dev is not None:
         return dev
     return marginal_chain_rate(make_run, chain_short, chain_long, iters)
+
+
+def chain_seconds_per_step_runs(make_run: Callable[[int], Callable[[], Any]],
+                                chain_short: int, chain_long: int,
+                                iters: int = 3,
+                                n_runs: int = 1) -> List[float]:
+    """Per-step seconds measured ``n_runs`` times on ONE compiled chain.
+
+    ``make_run(chain_long)`` is called once, so every repetition re-times
+    the same jitted executable (the first device trace pays compile via
+    its warmup sync; later traces hit the jit cache on the same
+    callable). This is the run-to-run stability probe for bars with thin
+    margins: spread across the returned list is device/trace noise, not
+    compilation variance. Falls back to a single marginal-chain estimate
+    when no device trace is available (CPU/interpret)."""
+    run = make_run(chain_long)
+    out: List[float] = []
+    for _ in range(n_runs):
+        dev = device_seconds_per_step(run, chain_long)
+        if dev is None:
+            return [marginal_chain_rate(make_run, chain_short, chain_long,
+                                        iters)]
+        out.append(dev)
+    return out
