@@ -289,3 +289,77 @@ def test_not_binds_tighter_than_comparison():
     # negating a comparison needs parens, same as real CEL
     assert ev(CHIP, TPU,
               f'!(device.attributes["{TPU}"].type == "daemon")')
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r4 #8: CEL string functions
+# ---------------------------------------------------------------------------
+
+def test_string_functions_on_attributes():
+    gen = f'device.attributes["{TPU}"].generation'
+    assert ev(CHIP, TPU, f'{gen}.startsWith("v5")')
+    assert not ev(CHIP, TPU, f'{gen}.startsWith("v6")')
+    assert ev(CHIP, TPU, f'{gen}.endsWith("5p")')
+    assert not ev(CHIP, TPU, f'{gen}.endsWith("5e")')
+    assert ev(CHIP, TPU, f'{gen}.contains("5")')
+    assert not ev(CHIP, TPU, f'{gen}.contains("lite")')
+    assert ev(CHIP, TPU, 'device.driver.contains("tpu")')
+
+
+def test_matches_is_unanchored_partial_match():
+    gen = f'device.attributes["{TPU}"].generation'
+    assert ev(CHIP, TPU, f'{gen}.matches("^v[0-9]+[ep]?$")')
+    # partial: matches anywhere in the string, like RE2's Match
+    assert ev(CHIP, TPU, f'{gen}.matches("5")')
+    assert not ev(CHIP, TPU, f'{gen}.matches("^5")')
+
+
+def test_string_functions_compose_with_boolean_operators():
+    gen = f'device.attributes["{TPU}"].generation'
+    assert ev(CHIP, TPU,
+              f'{gen}.startsWith("v5") && !{gen}.endsWith("e") && '
+              f'({gen}.contains("p") || {gen}.contains("lite"))')
+
+
+def test_string_function_on_missing_propagates():
+    gen = f'device.attributes["{TPU}"].missingAttr'
+    assert not ev(CHIP, TPU, f'{gen}.startsWith("v5")')
+    # absorbed by CEL's commutative || with a true side
+    assert ev(CHIP, TPU, f'{gen}.startsWith("v5") || true')
+
+
+def test_string_function_type_errors_fail_loud():
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f'device.attributes["{TPU}"].cores.startsWith("2")')
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f'device.attributes["{TPU}"].generation.contains(5)')
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, 'device.driver.startsWith("a", "b")')
+
+
+def test_matches_re2_fidelity():
+    gen = f'device.attributes["{TPU}"].generation'
+    # constructs legal in Python re but rejected by RE2 — evaluating
+    # them here would silently diverge from the scheduler
+    for bad in ('v(?=5)',            # lookahead
+                '(v)\\\\1',          # numeric backreference
+                '(?P<a>v)(?P=a)',    # named backreference
+                '(?>v5)',            # atomic group
+                'v5*+'):             # possessive quantifier
+        with pytest.raises(AllocationError):
+            ev(CHIP, TPU, f'{gen}.matches("{bad}")')
+    # named GROUPS (no backref) are valid in both engines
+    assert ev(CHIP, TPU, f'{gen}.matches("(?P<g>v5)")')
+    # a pattern that does not compile here is fail-loud too: without an
+    # RE2 engine, invalid-in-both vs Python-only-reject (e.g. RE2's \z)
+    # cannot be distinguished, and guessing can silently diverge
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f'{gen}.matches("[unclosed")')
+
+
+def test_string_ordered_comparison_is_lexicographic():
+    gen = f'device.attributes["{TPU}"].generation'
+    assert ev(CHIP, TPU, f'{gen} >= "v5p"')
+    assert ev(CHIP, TPU, f'{gen} < "v6e"')
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f'{gen} < 5')  # mixed pair = scheduler type error

@@ -1,0 +1,149 @@
+"""The fallback linter's F821 undefined-name analysis (VERDICT r4 #6).
+
+The stdlib-AST linter gates CI where ruff/golangci-lint would in the
+reference (/root/reference/Makefile:33-35); undefined names are the
+class of rot the previous fallback could not see. These tests prove the
+checker (a) flags fixture-injected undefined names, and (b) stays silent
+on the legal-but-tricky scoping patterns the repo actually uses — a
+false positive would break the lint gate, so the traps matter as much as
+the detections.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import lint  # noqa: E402
+
+
+def _f821(src: str):
+    import ast
+    checker = lint._F821Checker()
+    checker.build(ast.parse(src))
+    return [(line, msg) for _, line, code, msg in
+            checker.findings("fixture.py", set()) if code == "F821"]
+
+
+def _codes(tmp_path, src: str):
+    p = tmp_path / "fixture.py"
+    p.write_text(src)
+    return [(line, code) for _, line, code, _ in lint.lint_file(str(p))]
+
+
+def test_flags_undefined_module_and_function_names():
+    out = _f821(
+        "x = defined_nowhere\n"                       # line 1
+        "def f():\n"
+        "    return also_missing + 1\n"               # line 3
+    )
+    assert out == [(1, "undefined name 'defined_nowhere'"),
+                   (3, "undefined name 'also_missing'")]
+
+
+def test_flags_typo_of_local():
+    out = _f821("def f(value):\n    return vaule\n")
+    assert out == [(2, "undefined name 'vaule'")]
+
+
+def test_lint_file_reports_f821(tmp_path):
+    assert (2, "F821") in _codes(tmp_path, "import os\nprint(osx.path)\n")
+
+
+def test_noqa_suppresses(tmp_path):
+    p = tmp_path / "fixture.py"
+    p.write_text("print(missing)  # noqa: F821\n")
+    assert not [f for f in lint.lint_file(str(p)) if f[2] == "F821"]
+
+
+def test_no_false_positives_on_legal_scoping():
+    src = """
+from __future__ import annotations
+import os
+import typing
+if typing.TYPE_CHECKING:
+    from collections import OrderedDict
+
+GLOBAL = 1
+
+
+def forward_ref(x: LaterClass) -> LaterClass:
+    return later_function(x)
+
+
+class LaterClass:
+    X = os.sep
+
+    def method(self, arg=X):          # default sees the class scope
+        return GLOBAL + self.y
+
+    def uses_super(self):
+        return super().__init__ and __class__
+
+
+def later_function(v):
+    out = [y := v, y + 1]             # walrus escapes the comprehension
+    squares = [i * i for i in range(3) if i]
+    pairs = {k: w for k, w in zip(out, squares)}
+    try:
+        q = 1 / v
+    except ZeroDivisionError as exc:
+        q = str(exc)
+    with open(os.devnull) as fh:
+        for a, (b, c) in []:
+            fh, a, b, c
+    lam = lambda p, *args, **kw: p + len(args) + len(kw)
+    match v:
+        case [first, *rest]:
+            return first, rest
+        case {"k": captured, **others}:
+            return captured, others
+        case LaterClass() as inst:
+            return inst
+    del squares
+    return y, pairs, q, lam
+
+
+def counter():
+    global GLOBAL
+    GLOBAL += 1
+
+    def inner():
+        nonlocal_target = 0
+
+        def innermost():
+            nonlocal nonlocal_target
+            nonlocal_target += 1
+        innermost()
+        return nonlocal_target
+    return inner()
+
+
+def type_params[T](x: T) -> T:        # PEP 695
+    return x
+"""
+    assert _f821(src) == []
+
+
+def test_pep695_type_alias_statement():
+    assert _f821("type Alias[T] = list[T]\nx: Alias[int] = []\n") == []
+    assert _f821("type Bad = list[Missing]\n") == [
+        (1, "undefined name 'Missing'")]
+
+
+def test_comprehension_cannot_see_class_scope_is_tolerated_but_module_is():
+    # names from the MODULE scope resolve inside class-body comprehensions
+    assert _f821("N = 3\nclass C:\n    xs = [N for _ in range(2)]\n") == []
+
+
+def test_star_import_disables_judgement():
+    assert _f821("from os.path import *\nprint(join('a', 'b'))\n") == []
+
+
+def test_repo_is_clean():
+    """The gate itself: the whole repo lints clean with F821 active."""
+    findings = []
+    for path in lint._py_files(lint.TARGETS):
+        findings.extend(f for f in lint.lint_file(path) if f[2] == "F821")
+    assert findings == []

@@ -9,6 +9,12 @@ subset directly on the stdlib ``ast``:
 - E9: syntax errors (ast.parse);
 - F401: unused imports (skipped in ``__init__.py`` — re-export files —
   and on lines carrying ``# noqa``);
+- F821: undefined names, via real lexical-scope analysis (module /
+  function / class / comprehension scopes, the class-scope skip rule,
+  walrus-in-comprehension hoisting, global/nonlocal) — the
+  highest-value Python check (VERDICT r4 #6). Order-insensitive by
+  design: a name bound anywhere in a scope counts as bound, so
+  conditional/late definitions never false-positive;
 - B006: mutable default arguments;
 - E722: bare ``except:``;
 - E711: comparison to None with ==/!=;
@@ -92,6 +98,228 @@ class _UseCollector(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+import builtins as _builtins
+
+_BUILTIN_NAMES = set(dir(_builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__",
+    "__annotations__", "__dict__", "__module__", "__qualname__",
+    # implicit cell for zero-arg super() in methods
+    "__class__",
+}
+
+
+class _Scope:
+    """One lexical scope in the F821 analysis."""
+
+    __slots__ = ("kind", "parent", "bound", "star")
+
+    def __init__(self, kind: str, parent: "_Scope | None"):
+        self.kind = kind          # module | function | class | comp
+        self.parent = parent
+        self.bound: set[str] = set()
+        self.star = False         # `from x import *` seen → can't judge
+
+    def resolves(self, name: str) -> bool:
+        scope, own = self, True
+        while scope is not None:
+            # the class-scope skip rule: a class body's names are
+            # visible to the body itself but NOT to scopes nested
+            # inside it (methods, comprehensions)
+            if (own or scope.kind != "class") and name in scope.bound:
+                return True
+            if scope.star:
+                return True
+            own = False
+            scope = scope.parent
+        return name in _BUILTIN_NAMES
+
+
+class _F821Checker:
+    """Two-pass undefined-name detection on the stdlib AST.
+
+    Pass 1 builds the scope tree, collecting every binding (imports,
+    assignment targets, defs/classes, arguments, for/with/except/match
+    targets, comprehension variables, walrus targets hoisted out of
+    comprehension scopes, global/nonlocal declarations) and every
+    Load-context Name with the scope it occurs in. Pass 2 resolves each
+    use through the lexical chain. Collecting all bindings first makes
+    the check order-insensitive — module-level use-before-def is left to
+    runtime, in exchange for zero false positives on conditional
+    imports, TYPE_CHECKING blocks, and forward references.
+    """
+
+    def __init__(self):
+        self.uses: list[tuple] = []   # (name, lineno, scope)
+
+    # -- pass 1: scope construction ------------------------------------
+    def build(self, tree: ast.Module) -> None:
+        module = _Scope("module", None)
+        self._walk_body(tree.body, module)
+
+    def _bind(self, name: str, scope: _Scope) -> None:
+        scope.bound.add(name)
+
+    def _bind_walrus(self, name: str, scope: _Scope) -> None:
+        # NamedExpr targets bind in the nearest enclosing non-comp scope
+        while scope.kind == "comp" and scope.parent is not None:
+            scope = scope.parent
+        scope.bound.add(name)
+
+    def _walk_body(self, stmts, scope: _Scope) -> None:
+        for stmt in stmts:
+            self._visit(stmt, scope)
+
+    def _visit(self, node, scope: _Scope) -> None:  # noqa: C901
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._bind(node.name, scope)
+            for dec in node.decorator_list:
+                self._visit(dec, scope)
+            # defaults and annotations evaluate in the ENCLOSING scope
+            # (so a method default may reference a class attribute) —
+            # except PEP 695 type params, which get their own scope
+            # wrapping the annotations and body
+            if getattr(node, "type_params", []):
+                scope = _Scope("function", scope)
+                for tp in node.type_params:
+                    self._bind(tp.name, scope)
+            for d in node.args.defaults:
+                self._visit(d, scope)
+            for d in node.args.kw_defaults:
+                if d is not None:
+                    self._visit(d, scope)
+            for a in self._all_args(node.args):
+                if a.annotation is not None:
+                    self._visit(a.annotation, scope)
+            if node.returns is not None:
+                self._visit(node.returns, scope)
+            inner = _Scope("function", scope)
+            for a in self._all_args(node.args):
+                self._bind(a.arg, inner)
+            self._walk_body(node.body, inner)
+        elif isinstance(node, ast.Lambda):
+            for d in node.args.defaults:
+                self._visit(d, scope)
+            for d in node.args.kw_defaults:
+                if d is not None:
+                    self._visit(d, scope)
+            inner = _Scope("function", scope)
+            for a in self._all_args(node.args):
+                self._bind(a.arg, inner)
+            self._visit(node.body, inner)
+        elif isinstance(node, ast.ClassDef):
+            self._bind(node.name, scope)
+            for dec in node.decorator_list:
+                self._visit(dec, scope)
+            if getattr(node, "type_params", []):
+                scope = _Scope("function", scope)
+                for tp in node.type_params:
+                    self._bind(tp.name, scope)
+            for base in node.bases:
+                self._visit(base, scope)
+            for kw in node.keywords:
+                self._visit(kw.value, scope)
+            inner = _Scope("class", scope)
+            self._walk_body(node.body, inner)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            inner = _Scope("comp", scope)
+            for i, gen in enumerate(node.generators):
+                # the first iterable evaluates in the enclosing scope
+                self._visit(gen.iter, scope if i == 0 else inner)
+                self._bind_targets(gen.target, inner)
+                for cond in gen.ifs:
+                    self._visit(cond, inner)
+            if isinstance(node, ast.DictComp):
+                self._visit(node.key, inner)
+                self._visit(node.value, inner)
+            else:
+                self._visit(node.elt, inner)
+        elif isinstance(node, ast.NamedExpr):
+            self._bind_walrus(node.target.id, scope)
+            self._visit(node.value, scope)
+        elif isinstance(node, getattr(ast, "TypeAlias", ())):
+            # PEP 695 `type Alias[T] = ...`: the alias name binds in the
+            # enclosing scope; its type params get their own scope
+            # wrapping the value expression
+            self._bind(node.name.id, scope)
+            if node.type_params:
+                scope = _Scope("function", scope)
+                for tp in node.type_params:
+                    self._bind(tp.name, scope)
+            self._visit(node.value, scope)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    scope.star = True
+                else:
+                    self._bind((alias.asname
+                                or alias.name).split(".")[0], scope)
+        elif isinstance(node, ast.Global):
+            root = scope
+            while root.parent is not None:
+                root = root.parent
+            for name in node.names:
+                self._bind(name, root)
+                self._bind(name, scope)
+        elif isinstance(node, ast.Nonlocal):
+            for name in node.names:
+                self._bind(name, scope)
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name:
+                self._bind(node.name, scope)
+            if node.type is not None:
+                self._visit(node.type, scope)
+            self._walk_body(node.body, scope)
+        elif isinstance(node, ast.MatchAs):
+            if node.pattern is not None:
+                self._visit(node.pattern, scope)
+            if node.name:
+                self._bind(node.name, scope)
+        elif isinstance(node, ast.MatchStar):
+            if node.name:
+                self._bind(node.name, scope)
+        elif isinstance(node, ast.MatchMapping):
+            for k, p in zip(node.keys, node.patterns):
+                self._visit(k, scope)
+                self._visit(p, scope)
+            if node.rest:
+                self._bind(node.rest, scope)
+        elif isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                self.uses.append((node.id, node.lineno, scope))
+            else:
+                self._bind(node.id, scope)
+        else:
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, scope)
+
+    @staticmethod
+    def _all_args(args: ast.arguments):
+        out = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        if args.vararg:
+            out.append(args.vararg)
+        if args.kwarg:
+            out.append(args.kwarg)
+        return out
+
+    def _bind_targets(self, target, scope: _Scope) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self._bind(n.id, scope)
+
+    # -- pass 2: resolution --------------------------------------------
+    def findings(self, rel: str, noqa: set) -> list:
+        out = []
+        for name, lineno, scope in self.uses:
+            if lineno in noqa:
+                continue
+            if not scope.resolves(name):
+                out.append((rel, lineno, "F821",
+                            f"undefined name '{name}'"))
+        return out
+
+
 def _noqa_lines(src: str) -> set:
     return {i for i, line in enumerate(src.splitlines(), 1)
             if "# noqa" in line}
@@ -119,6 +347,10 @@ def lint_file(path: str) -> list:
     uses = _UseCollector()
     uses.visit(tree)
     is_init = os.path.basename(path) == "__init__.py"
+
+    f821 = _F821Checker()
+    f821.build(tree)
+    findings.extend(f821.findings(rel, noqa))
 
     # format specs ({x:.2f}) are themselves JoinedStr nodes — never
     # F541 candidates

@@ -27,6 +27,18 @@ matches or mismatches):
 ``!`` binds tighter than comparisons (CEL precedence: ``!a == b`` is
 ``(!a) == b``); parenthesize to negate a comparison.
 
+String functions (the cel-spec standard surface real DeviceClass
+selectors use — reference deviceclass-gpu.yaml:10-11):
+``.startsWith(s)``, ``.endsWith(s)``, ``.contains(s)``, ``.matches(re)``.
+``matches`` is an unanchored partial match; patterns using
+backreferences, lookaround, atomic/conditional groups, or possessive
+quantifiers are rejected fail-loud (RE2, the real CEL regex engine, has
+no such constructs — evaluating them here would silently diverge from
+the scheduler), and a pattern that does not compile here is likewise
+fail-loud (the RE2 verdict cannot be mirrored without RE2). Ordered
+operators cover int/int and string/string (lexicographic), per the CEL
+standard definitions.
+
 Quantities (the k8s CEL quantity library, apiserver
 pkg/cel/library/quantity.go): ``quantity("16Gi")`` constructs one;
 ``device.capacity[...]`` values that are quantity STRINGS resolve to
@@ -153,6 +165,42 @@ def _require_quantity(v: Any, method: str) -> Quantity:
 #: methods callable on a Quantity from a selector, with arity
 _QTY_METHODS = {"compareTo": 1, "isGreaterThan": 1, "isLessThan": 1,
                 "sign": 0, "isInteger": 0, "asInteger": 0}
+
+#: CEL string functions (cel-spec standard definitions; the surface real
+#: DeviceClass selectors use, reference deviceclass-gpu.yaml:10-11)
+_STR_METHODS = {"startsWith": 1, "endsWith": 1, "contains": 1,
+                "matches": 1}
+
+# Python-re constructs RE2 (CEL's regex engine) rejects: lookaround and
+# atomic/conditional groups `(?=` `(?!` `(?<` `(?>` `(?(`, named and
+# numeric backreferences `(?P=` `\1`, and possessive quantifiers `a*+`.
+# A pattern using them would EVALUATE here but runtime-error on the real
+# scheduler — the silent-divergence case the fail-loud boundary exists
+# to prevent. Best-effort textual guard ((?P<name>...> groups are fine —
+# both engines take them); the re.error path below fail-louds the rest.
+_NON_RE2_RE = re.compile(r"\(\?[=!<>(]|\(\?P=|\\[1-9]"
+                         r"|(?<!\\)(?:[*+?]|\})\+")
+
+
+def _cel_matches(s: str, pattern: str) -> Any:
+    if _NON_RE2_RE.search(pattern):
+        raise CelUnsupportedError(
+            f"matches() pattern {pattern!r} uses regex constructs RE2 "
+            f"(the real CEL regex engine) rejects — backreferences, "
+            f"lookaround, atomic/conditional groups, or possessive "
+            f"quantifiers")
+    try:
+        compiled = re.compile(pattern)
+    except re.error as e:
+        # Without an RE2 engine we cannot tell invalid-in-both (real
+        # scheduler runtime-errors -> missing) from Python-only rejects
+        # of valid RE2 (e.g. RE2's \z) — guessing either way can
+        # silently diverge, so fail loud like any unsupported construct.
+        raise CelUnsupportedError(
+            f"matches() pattern {pattern!r} does not compile here "
+            f"({e}); cannot faithfully mirror the RE2 verdict") from e
+    # CEL matches() is an UNANCHORED partial match (re.search semantics)
+    return compiled.search(s) is not None
 
 
 def _type_tag(v: Any) -> str:
@@ -328,14 +376,28 @@ class _Parser:
         return val
 
     def _call_method(self, val: Any, method: str, args: List[Any]) -> Any:
-        if method not in _QTY_METHODS:
+        arity = _QTY_METHODS.get(method, _STR_METHODS.get(method))
+        if arity is None:
             raise CelUnsupportedError(f"unsupported method .{method}()")
-        if len(args) != _QTY_METHODS[method]:
+        if len(args) != arity:
             raise CelUnsupportedError(
-                f".{method}() takes {_QTY_METHODS[method]} argument(s), "
-                f"got {len(args)}")
+                f".{method}() takes {arity} argument(s), got {len(args)}")
         if val is _MISSING or any(a is _MISSING for a in args):
             return _MISSING
+        if method in _STR_METHODS:
+            if not isinstance(val, str):
+                raise CelUnsupportedError(
+                    f".{method}() is a string method; receiver is {val!r}")
+            if not isinstance(args[0], str):
+                raise CelUnsupportedError(
+                    f".{method}() takes a string argument, got {args[0]!r}")
+            if method == "startsWith":
+                return val.startswith(args[0])
+            if method == "endsWith":
+                return val.endswith(args[0])
+            if method == "contains":
+                return args[0] in val
+            return _cel_matches(val, args[0])
         if not isinstance(val, Quantity):
             raise CelUnsupportedError(
                 f".{method}() is a quantity method; receiver is {val!r}")
@@ -453,10 +515,15 @@ class _Parser:
                 f"ordered operators are not defined on quantities "
                 f"({lhs!r} {op} {rhs!r}); use "
                 f".compareTo(quantity(\"...\")) or .isGreaterThan(...)")
-        if not (isinstance(lhs, int) and not isinstance(lhs, bool)
-                and isinstance(rhs, int) and not isinstance(rhs, bool)):
+        int_pair = (isinstance(lhs, int) and not isinstance(lhs, bool)
+                    and isinstance(rhs, int) and not isinstance(rhs, bool))
+        str_pair = isinstance(lhs, str) and isinstance(rhs, str)
+        if not (int_pair or str_pair):
+            # CEL defines < <= > >= on int/int and string/string
+            # (lexicographic); a mixed pair is a real-scheduler type error
             raise CelUnsupportedError(
-                f"ordered comparison needs ints, got {lhs!r} {op} {rhs!r}")
+                f"ordered comparison needs two ints or two strings, "
+                f"got {lhs!r} {op} {rhs!r}")
         return {"<": lhs < rhs, "<=": lhs <= rhs,
                 ">": lhs > rhs, ">=": lhs >= rhs}[op]
 
