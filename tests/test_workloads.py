@@ -876,6 +876,19 @@ def test_mlm_corruption_recipe():
         mlm_corrupt(tokens, key, 256, mask_rate=0.0)
     with pytest.raises(ValueError, match="keep_rate"):
         mlm_corrupt(tokens, key, 256, keep_rate=0.5, random_rate=0.6)
+    # pad_id excludes separator/padding positions from selection — and
+    # therefore from the loss (ADVICE r4): with byte 0 as the packed
+    # separator, no selected position may sit on a zero token
+    packed = tokens.at[:, ::7].set(0)
+    cp, sel_pad = mlm_corrupt(packed, key, 256, pad_id=0)
+    assert not bool((sel_pad & (packed == 0)).any())
+    # ...and the random branch never injects the pad id into real
+    # positions (a drawn 0 would create a spurious segment boundary)
+    assert not bool(((cp == 0) & (packed != 0)).any())
+    # and without pad_id, uniform selection does hit pads (the documented
+    # default)
+    _, sel_uni = mlm_corrupt(packed, key, 256)
+    assert bool((sel_uni & (packed == 0)).any())
 
 
 def test_mlm_training_reduces_loss_and_reconstructs():

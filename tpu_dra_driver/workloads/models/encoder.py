@@ -15,8 +15,10 @@ TPU-first details:
   shapes);
 - the [MASK] token id is reserved as ``cfg.vocab - 1`` by convention
   (callers building vocabularies leave the last id free);
-- loss positions are the corruption mask, so padding/uncorrupted
-  positions contribute exactly zero.
+- loss positions are the corruption mask, so uncorrupted positions
+  contribute exactly zero; pass ``pad_id`` to additionally exclude
+  packed-batch separator/padding tokens from selection (without it,
+  selection is uniform over all positions, pads included).
 
 The reference driver has no model tier at all; this extends the
 validation-workload family set (decoder LM, prefix-LM, MoE, encoder)
@@ -27,7 +29,7 @@ from __future__ import annotations
 
 from dataclasses import replace
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,11 +54,15 @@ def encoder_config(cfg: ModelConfig) -> ModelConfig:
 
 def mlm_corrupt(tokens: jax.Array, key: jax.Array, vocab: int,
                 mask_rate: float = 0.15,
-                keep_rate: float = 0.1, random_rate: float = 0.1
+                keep_rate: float = 0.1, random_rate: float = 0.1,
+                pad_id: Optional[int] = None
                 ) -> Tuple[jax.Array, jax.Array]:
     """BERT corruption, fully vectorized: select ``mask_rate`` of
     positions; of those, 80% become the [MASK] id (vocab-1), 10% a
     random token, 10% stay unchanged (but still count in the loss).
+    ``pad_id`` (e.g. the packed-batch separator byte) excludes those
+    positions from selection so they never enter the loss; with the
+    default None, selection is uniform over every position.
     Returns (corrupted_tokens, selected_mask)."""
     if not 0.0 < mask_rate < 1.0:
         raise ValueError(f"mask_rate must be in (0, 1), got {mask_rate}")
@@ -66,10 +72,18 @@ def mlm_corrupt(tokens: jax.Array, key: jax.Array, vocab: int,
             f"be >= 0 and sum to <= 1 — the remainder is the [MASK] share")
     ksel, kmode, krand = jax.random.split(key, 3)
     selected = jax.random.bernoulli(ksel, mask_rate, tokens.shape)
+    if pad_id is not None:
+        selected &= tokens != pad_id
     mode = jax.random.uniform(kmode, tokens.shape)
     # vocab-1 is the reserved [MASK] id; the random branch must draw
-    # real vocabulary tokens only
-    rand_tok = jax.random.randint(krand, tokens.shape, 0, vocab - 1)
+    # real vocabulary tokens only — and never the pad/separator id
+    # either, which would inject spurious segment boundaries into the
+    # corrupted stream
+    if pad_id is not None and 0 <= pad_id < vocab - 1:
+        rand_tok = jax.random.randint(krand, tokens.shape, 0, vocab - 2)
+        rand_tok += (rand_tok >= pad_id).astype(rand_tok.dtype)
+    else:
+        rand_tok = jax.random.randint(krand, tokens.shape, 0, vocab - 1)
     mask_tok = jnp.full_like(tokens, vocab - 1)
     corrupted = jnp.where(mode < 1.0 - keep_rate - random_rate,
                           mask_tok,
@@ -80,20 +94,23 @@ def mlm_corrupt(tokens: jax.Array, key: jax.Array, vocab: int,
 
 def mlm_loss_fn(params: Params, tokens: jax.Array, key: jax.Array,
                 cfg: ModelConfig, attn_fn=None,
-                mask_rate: float = 0.15) -> jax.Array:
+                mask_rate: float = 0.15,
+                pad_id: Optional[int] = None) -> jax.Array:
     """Masked-LM objective: corrupt on device, reconstruct originals at
     the corrupted positions. ``cfg`` is normalized to an encoder config
     (bidirectional prefix over the whole sequence) — passing a causal
     config silently training a degraded 'encoder' is the failure this
     guards against."""
     cfg = encoder_config(cfg)
-    corrupted, selected = mlm_corrupt(tokens, key, cfg.vocab, mask_rate)
+    corrupted, selected = mlm_corrupt(tokens, key, cfg.vocab, mask_rate,
+                                      pad_id=pad_id)
     logits = forward(params, corrupted, cfg, attn_fn)
     return nll_from_logits(logits, tokens, selected)
 
 
 def make_mlm_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,
-                        mask_rate: float = 0.15):
+                        mask_rate: float = 0.15,
+                        pad_id: Optional[int] = None):
     """Returns (train_step, init_opt_state); train_step is pure/jittable:
     (params, opt_state, tokens, key) -> (params, opt_state, loss).
     The PRNG key threads through so every step draws a fresh corruption
@@ -101,7 +118,8 @@ def make_mlm_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,
     cfg = encoder_config(cfg)
     opt = optimizer or optax.adamw(1e-3)
     grad_fn = jax.value_and_grad(partial(
-        mlm_loss_fn, cfg=cfg, attn_fn=attn_fn, mask_rate=mask_rate))
+        mlm_loss_fn, cfg=cfg, attn_fn=attn_fn, mask_rate=mask_rate,
+        pad_id=pad_id))
 
     def train_step(params, opt_state, tokens, key):
         loss, grads = grad_fn(params, tokens, key)
@@ -114,11 +132,12 @@ def make_mlm_train_step(cfg: ModelConfig, optimizer=None, attn_fn=None,
 
 def mlm_accuracy(params: Params, tokens: jax.Array, key: jax.Array,
                  cfg: ModelConfig, mask_rate: float = 0.15,
-                 attn_fn=None) -> float:
+                 attn_fn=None, pad_id: Optional[int] = None) -> float:
     """Reconstruction accuracy at corrupted positions (the MLM eval
     metric)."""
     cfg = encoder_config(cfg)
-    corrupted, selected = mlm_corrupt(tokens, key, cfg.vocab, mask_rate)
+    corrupted, selected = mlm_corrupt(tokens, key, cfg.vocab, mask_rate,
+                                      pad_id=pad_id)
     pred = jnp.argmax(forward(params, corrupted, cfg, attn_fn), axis=-1)
     hits = jnp.where(selected, (pred == tokens), False)
     return float(hits.sum() / jnp.maximum(selected.sum(), 1))

@@ -406,10 +406,13 @@ def decode_tokens_per_sec(b: int = 8, prompt_len: int = 128,
 
 
 def truncate_top_k(logits: jax.Array, top_k: int) -> jax.Array:
-    """Mask logits outside the k largest (last axis) to NEG_INF; the ONE
-    top-k truncation both generate() and speculative_sample() apply, so
-    their sampling laws cannot drift (ties at the k-th value keep the
-    lax.top_k winner). top_k == 0 is a no-op."""
+    """Mask logits strictly below the k-th largest (last axis) to
+    NEG_INF; the ONE top-k truncation both generate() and
+    speculative_sample() apply, so their sampling laws cannot drift.
+    Ties at the k-th value are ALL kept (the ``>= kth`` mask), so the
+    surviving set can exceed k when the boundary is tied — the same
+    tie-inclusive law on both paths, which is what exactness needs.
+    top_k == 0 is a no-op."""
     if top_k <= 0:
         return logits
     kth = jax.lax.top_k(logits, top_k)[0][..., -1:]

@@ -145,11 +145,14 @@ def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
             f"but n_stages={n_stages}")
     spec_stage = {k: P(axis_name) for k in _BLOCK_KEYS}
 
-    # check_vma=False: same reason as ringattention.py — a Pallas
-    # attn_fn's kernel mixes axis-varying ref reads with unvarying
-    # scalar constants (the in-kernel scale fold), which the vma checker
-    # rejects under interpret mode; replication of the psum'd output is
-    # handled explicitly by the is_last masking in pipeline_apply
+    # check_vma only off for a custom (Pallas) attn_fn — same reason as
+    # ringattention.py: such a kernel mixes axis-varying ref reads with
+    # unvarying scalar constants (the in-kernel scale fold), which the
+    # vma checker rejects under interpret mode. The default XLA
+    # attention path keeps the checker ON so it can still catch
+    # out_specs/replication bugs (ADVICE r4); replication of the psum'd
+    # output is handled explicitly by the is_last masking in
+    # pipeline_apply either way.
     pipe = jax.shard_map(
         functools.partial(pipeline_apply, axis_name=axis_name,
                           n_heads=cfg.n_heads, n_stages=n_stages,
@@ -157,7 +160,7 @@ def make_pp_forward(mesh: Mesh, cfg: ModelConfig, n_stages: int,
                           attn_fn=attn_fn, window=cfg.window,
                           prefix=cfg.prefix),
         mesh=mesh, in_specs=(spec_stage, P()), out_specs=P(),
-        check_vma=False)
+        check_vma=attn_fn is None)
 
     def forward(pp_params: Dict, tokens: jax.Array) -> jax.Array:
         b, t = tokens.shape
