@@ -405,3 +405,29 @@ def test_e2e_kind_scripts_are_wired():
         assert rel.split("/")[-1] in sh or rel in sh
         assert os.path.exists(os.path.join(REPO, rel)), f"missing {rel}"
     assert os.access(os.path.join(REPO, "tests/e2e/run_e2e_kind.sh"), os.X_OK)
+
+
+def test_parity_proof_anchors_exist():
+    """Every test citation in PARITY.md (the row -> code -> test map the
+    final-round reviewer walks) must point at a real test: a renamed or
+    deleted test must break this, not silently rot the parity document."""
+    import re
+    text = open(os.path.join(REPO, "PARITY.md")).read()
+    anchors = []
+    current_file = None
+    # full anchors `tests/test_x.py::test_y` set the file context;
+    # bare `::test_y` continuations inherit it
+    for m in re.finditer(r"`(tests/test_\w+\.py)?::(test_\w+)`", text):
+        if m.group(1):
+            current_file = m.group(1)
+        assert current_file, f"continuation anchor before any file: {m.group(0)}"
+        anchors.append((current_file, m.group(2)))
+    assert len(anchors) > 80, f"expected a dense proof map, found {len(anchors)}"
+    missing = []
+    for fname, tname in anchors:
+        path = os.path.join(REPO, fname)
+        if not os.path.isfile(path):
+            missing.append(f"{fname} (file missing)")
+        elif f"def {tname}(" not in open(path).read():
+            missing.append(f"{fname}::{tname}")
+    assert not missing, f"PARITY.md cites nonexistent tests: {missing}"
