@@ -14,15 +14,27 @@ matches or mismatches):
     expr   := or
     or     := and ( "||" and )*
     and    := cmp ( "&&" cmp )*
-    cmp    := uop ( ("=="|"!="|">="|"<="|">"|"<") uop
+    cmp    := sum ( ("=="|"!="|">="|"<="|">"|"<") sum
                    | "in" list )?
-    uop    := "!" uop | operand ( "." ident "(" args ")" )*
-    operand:= literal | path | "quantity" "(" string ")" | "(" expr ")"
+    sum    := term ( ("+"|"-") term )*
+    term   := uop ( ("*"|"/"|"%") uop )*
+    uop    := "!" uop | "-" uop
+            | operand ( "." ident "(" args ")"
+                      | "." ("exists"|"all") "(" ident "," expr ")" )*
+    operand:= literal | path | list | macro-var
+            | "quantity" "(" string ")" | "(" expr ")"
     path   := "device" "." "driver"
             | "device" "." ("attributes"|"capacity") "[" string "]"
               "." ident
-    list   := "[" ( literal ( "," literal )* )? "]"
+    list   := "[" ( ("-"? int | string | bool) ( "," ... )* )? "]"
     literal:= string | int | "true" | "false"
+
+Arithmetic follows the CEL/Go int64 semantics: `/` truncates toward
+zero, `%` takes the dividend's sign (both differ from Python's floor
+behavior on negatives), division by zero is a runtime error
+(propagates like a missing value), and `+` also concatenates two
+strings. The `exists`/`all` comprehension macros run over list
+literals with CEL's OR/AND error-absorption aggregation.
 
 ``!`` binds tighter than comparisons (CEL precedence: ``!a == b`` is
 ``(!a) == b``); parenthesize to negate a comparison.
@@ -223,6 +235,17 @@ def _hetero_eq(lhs: Any, rhs: Any) -> bool:
     return lhs == rhs
 
 
+_INT64_MIN, _INT64_MAX = -(2 ** 63), 2 ** 63 - 1
+
+
+def _int64_or_error(v: int) -> Any:
+    """CEL ints are int64 and overflow is a RUNTIME error in cel-go;
+    Python's unbounded ints would silently succeed where the real
+    scheduler errors — return missing (runtime-error semantics) so the
+    two never diverge on a match."""
+    return v if _INT64_MIN <= v <= _INT64_MAX else _MISSING
+
+
 class _Tok(NamedTuple):
     kind: str     # op | ident | str | int
     value: Any
@@ -230,9 +253,9 @@ class _Tok(NamedTuple):
 
 _TOKEN_RE = re.compile(r"""
     \s*(?:
-      (?P<op>\|\||&&|==|!=|>=|<=|[!><()\[\],.])
+      (?P<op>\|\||&&|==|!=|>=|<=|[!><()\[\],.+\-*/%])
     | (?P<str>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
-    | (?P<int>-?\d+)
+    | (?P<int>\d+)
     | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     )""", re.X)
 
@@ -272,6 +295,7 @@ class _Parser:
         self.toks = toks
         self.i = 0
         self.resolve = resolver
+        self.locals: dict = {}   # macro-bound variables (exists/all)
 
     def peek(self) -> Optional[_Tok]:
         return self.toks[self.i] if self.i < len(self.toks) else None
@@ -330,13 +354,13 @@ class _Parser:
     def cmp(self) -> Any:
         # ``!`` lives INSIDE the comparison operands (CEL precedence:
         # ``!a == b`` is ``(!a) == b``, not ``!(a == b)``)
-        lhs = self.unary_operand()
+        lhs = self.sum()
         tok = self.peek()
         if tok is None:
             return lhs
         if tok.kind == "op" and tok.value in ("==", "!=", ">", "<", ">=", "<="):
             op = self.next().value
-            rhs = self.unary_operand()
+            rhs = self.sum()
             return self._compare(op, lhs, rhs)
         if tok.kind == "ident" and tok.value == "in":
             self.next()
@@ -346,16 +370,45 @@ class _Parser:
             return any(_hetero_eq(lhs, item) for item in items)
         return lhs
 
+    def sum(self) -> Any:
+        """Additive arithmetic: int+int / int-int, and CEL's string
+        concatenation for +. Binds tighter than comparisons, looser
+        than * / %."""
+        val = self.term()
+        while self._at_op("+") or self._at_op("-"):
+            op = self.next().value
+            rhs = self.term()
+            val = self._arith(op, val, rhs)
+        return val
+
+    def term(self) -> Any:
+        val = self.unary_operand()
+        while self._at_op("*") or self._at_op("/") or self._at_op("%"):
+            op = self.next().value
+            rhs = self.unary_operand()
+            val = self._arith(op, val, rhs)
+        return val
+
     def unary_operand(self) -> Any:
         if self._at_op("!"):
             self.next()
             val = self._boolish(self.unary_operand())
             return _MISSING if val is _MISSING else not val
+        if self._at_op("-"):
+            self.next()
+            val = self.unary_operand()
+            if val is _MISSING:
+                return _MISSING
+            if not isinstance(val, int) or isinstance(val, bool):
+                raise CelUnsupportedError(f"unary - needs an int, "
+                                          f"got {val!r}")
+            return _int64_or_error(-val)
         return self.postfix()
 
     def postfix(self) -> Any:
         """An operand with any trailing ``.method(args)`` calls (the
-        quantity library surface)."""
+        quantity/string library surfaces) or ``.exists(v, p)`` /
+        ``.all(v, p)`` macros."""
         val = self.operand()
         while (self._at_op(".")
                and self.i + 1 < len(self.toks)
@@ -365,15 +418,93 @@ class _Parser:
             self.next()                      # .
             method = self.next().value       # ident
             self.expect_op("(")
+            if method in ("exists", "all"):
+                val = self._macro(method, val)
+                self.expect_op(")")
+                continue
             args: List[Any] = []
             if not self._at_op(")"):
-                args.append(self.unary_operand())
+                args.append(self.or_expr())
                 while self._at_op(","):
                     self.next()
-                    args.append(self.unary_operand())
+                    args.append(self.or_expr())
             self.expect_op(")")
             val = self._call_method(val, method, args)
         return val
+
+    def _macro(self, name: str, receiver: Any) -> Any:
+        """CEL comprehension macros over list literals: the parser is a
+        one-pass evaluator, so the predicate's token span is re-parsed
+        once per element with the bound variable in ``locals``. CEL
+        aggregation semantics: ``exists`` = logical OR with error
+        absorption (any true wins, else error if any erred), ``all`` =
+        the dual."""
+        if not isinstance(receiver, list):
+            raise CelUnsupportedError(
+                f".{name}() macro needs a list receiver, got {receiver!r}")
+        var = self.next()
+        if var.kind != "ident":
+            raise CelUnsupportedError(
+                f".{name}() takes a variable name, got {var.value!r}")
+        if var.value in self.locals:
+            raise CelUnsupportedError(
+                f".{name}() variable {var.value!r} shadows an outer "
+                f"macro variable")
+        if var.value in ("device", "quantity", "true", "false", "in"):
+            raise CelUnsupportedError(
+                f".{name}() variable {var.value!r} shadows a reserved name")
+        self.expect_op(",")
+        start = self.i
+        results: List[Any] = []
+        # empty list: the predicate still has to be consumed (never
+        # observed in CEL; a MISSING binding keeps evaluation inert)
+        for elem in (receiver or [_MISSING]):
+            self.i = start
+            self.locals[var.value] = elem
+            try:
+                results.append(self._boolish(self.or_expr()))
+            finally:
+                del self.locals[var.value]
+        if not receiver:
+            return name == "all"
+        if name == "exists":
+            if any(r is True for r in results):
+                return True
+            return _MISSING if any(r is _MISSING for r in results) else False
+        if any(r is False for r in results):
+            return False
+        return _MISSING if any(r is _MISSING for r in results) else True
+
+    @staticmethod
+    def _arith(op: str, lhs: Any, rhs: Any) -> Any:
+        if lhs is _MISSING or rhs is _MISSING:
+            return _MISSING
+        if op == "+" and isinstance(lhs, str) and isinstance(rhs, str):
+            return lhs + rhs
+        int_pair = (isinstance(lhs, int) and not isinstance(lhs, bool)
+                    and isinstance(rhs, int) and not isinstance(rhs, bool))
+        if not int_pair:
+            # the k8s CEL environment defines arithmetic on int/int
+            # (and + on string/string); anything else is a type error
+            raise CelUnsupportedError(
+                f"arithmetic needs two ints (or + on two strings), "
+                f"got {lhs!r} {op} {rhs!r}")
+        if op == "+":
+            return _int64_or_error(lhs + rhs)
+        if op == "-":
+            return _int64_or_error(lhs - rhs)
+        if op == "*":
+            return _int64_or_error(lhs * rhs)
+        if rhs == 0:
+            return _MISSING      # CEL runtime error: division by zero
+        # CEL (Go) semantics: division truncates toward zero and the
+        # modulo's sign follows the dividend — Python's floor division
+        # differs on negatives
+        q = abs(lhs) // abs(rhs)
+        if (lhs < 0) != (rhs < 0):
+            q = -q
+        # -2^63 / -1 overflows int64 (the one division overflow)
+        return _int64_or_error(q if op == "/" else lhs - q * rhs)
 
     def _call_method(self, val: Any, method: str, args: List[Any]) -> Any:
         arity = _QTY_METHODS.get(method, _STR_METHODS.get(method))
@@ -412,7 +543,13 @@ class _Parser:
             val = self.or_expr()
             self.expect_op(")")
             return val
+        if tok.kind == "op" and tok.value == "[":
+            return self.list_literal()       # a list operand (macros)
         if tok.kind in ("str", "int"):
+            if tok.kind == "int" and tok.value > _INT64_MAX:
+                # int literal overflow is a COMPILE error in cel-go
+                raise CelUnsupportedError(
+                    f"int literal {tok.value} exceeds int64")
             return self.next().value
         if tok.kind == "ident":
             if tok.value == "true":
@@ -423,6 +560,9 @@ class _Parser:
                 return False
             if tok.value == "device":
                 return self.device_path()
+            if tok.value in self.locals:
+                self.next()
+                return self.locals[tok.value]
             if tok.value == "quantity":
                 self.next()
                 self.expect_op("(")
@@ -470,7 +610,13 @@ class _Parser:
             return items
         while True:
             tok = self.next()
-            if tok.kind in ("str", "int"):
+            if tok.kind == "op" and tok.value == "-":
+                tok = self.next()
+                if tok.kind != "int":
+                    raise CelUnsupportedError(
+                        f"expected int after - in list, got {tok.value!r}")
+                items.append(-tok.value)
+            elif tok.kind in ("str", "int"):
                 items.append(tok.value)
             elif tok.kind == "ident" and tok.value in ("true", "false"):
                 items.append(tok.value == "true")
