@@ -457,3 +457,18 @@ def test_arithmetic_int64_overflow_is_runtime_error():
     assert not ev(CHIP, TPU, f"-({big}) - 2 < 0")   # negative overflow
     with pytest.raises(AllocationError):            # literal overflow =
         ev(CHIP, TPU, f"{2 ** 63} > 0")             # compile error
+
+
+def test_int64_min_literal_and_list_literal_bounds():
+    lo = str(-(2 ** 63))
+    assert ev(CHIP, TPU, f"{lo} < 0")                  # INT64_MIN folds
+    assert ev(CHIP, TPU, f"{lo} in [{lo}]")
+    with pytest.raises(AllocationError):               # below INT64_MIN
+        ev(CHIP, TPU, f"-{2 ** 63 + 1} < 0")
+    with pytest.raises(AllocationError):               # list literal too
+        ev(CHIP, TPU, f"1 in [{2 ** 63}]")
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f"[{2 ** 63}].exists(x, x > 0)")
+    # INT64_MIN / -1 is the one division overflow -> runtime error
+    assert not ev(CHIP, TPU, f"{lo} / -1 > 0")
+    assert ev(CHIP, TPU, f"{lo} / -1 > 0 || true")

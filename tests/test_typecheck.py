@@ -1,0 +1,122 @@
+"""The typecheck fallback's call-arity gate (tools/typecheck.py).
+
+The annotation-resolution pass catches dangling types; this pass
+catches mis-called same-module functions — the remaining high-value
+class a real checker (mypy/golangci-lint) would gate on. As with F821,
+the conservatism matters as much as the detection: a false positive
+breaks `make test`, so the skip rules get their own cases.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import typecheck  # noqa: E402
+
+
+def _arity(tmp_path, src: str):
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    return typecheck.check_call_arity("mod", str(p))
+
+
+def test_clean_calls_pass(tmp_path):
+    assert _arity(tmp_path, """
+def f(a, b, c=1, *, d=2):
+    return a + b + c + d
+f(1, 2)
+f(1, 2, 3, d=4)
+f(1, b=2)
+""") == []
+
+
+def test_too_many_positional(tmp_path):
+    out = _arity(tmp_path, "def f(a):\n    return a\nf(1, 2)\n")
+    assert len(out) == 1 and "at most 1 positional" in out[0]
+
+
+def test_unknown_keyword(tmp_path):
+    out = _arity(tmp_path, "def f(a):\n    return a\nf(a=1, zz=2)\n")
+    assert len(out) == 1 and "zz" in out[0]
+
+
+def test_missing_required(tmp_path):
+    out = _arity(tmp_path,
+                 "def f(a, b, *, c):\n    return a\nf(1, c=3)\nf(1, 2)\n")
+    assert len(out) == 2
+    assert "['b']" in out[0] and "['c']" in out[1]
+
+
+def test_duplicate_binding(tmp_path):
+    out = _arity(tmp_path, "def f(a, b=0):\n    return a\nf(1, a=2)\n")
+    assert len(out) == 1 and "multiple values" in out[0]
+
+
+def test_conservative_skips(tmp_path):
+    # all of these COULD be wrong at runtime, but the checker must stay
+    # silent: decorator may rewrap, rebinding may shadow, star-args are
+    # unknowable statically, vararg/kwarg defs absorb anything
+    assert _arity(tmp_path, """
+import functools
+
+def deco(fn):
+    @functools.wraps(fn)
+    def inner(*a, **kw):
+        return fn(1)
+    return inner
+
+@deco
+def decorated(a):
+    return a
+decorated(1, 2, 3)        # decorator changed the signature
+
+def rebound(a):
+    return a
+rebound = print
+rebound(1, 2, 3)          # name no longer the def
+
+def star_target(a):
+    return a
+args = (1,)
+star_target(*args)        # star call site
+
+def absorbing(*a, **kw):
+    return a, kw
+absorbing(1, 2, 3, z=9)   # vararg/kwarg def
+""") == []
+
+
+def test_repo_is_clean():
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(typecheck.__file__),
+                                      "typecheck.py")],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_shadowing_via_params_nested_defs_and_imports(tmp_path):
+    # each of these shadows the module-level name somewhere — the
+    # checker must skip the call rather than bind the wrong signature
+    assert _arity(tmp_path, """
+def send(a, b):
+    return a + b
+
+def retry(send):
+    return send(1)            # parameter shadows
+
+def outer():
+    def helper(x):
+        return x
+    return helper(1)
+
+def helper(x, y):
+    return x + y
+
+from os.path import join as f
+
+def f_caller():
+    return f("a", "b", "c")   # import alias: 3 args fine for join
+""") == []

@@ -11,13 +11,20 @@ rot a checker exists for day-to-day:
 - every public function/method annotation must RESOLVE via
   ``typing.get_type_hints`` — dangling forward references, renamed
   types, and misspelled annotations fail here instead of at some
-  user's first call.
+  user's first call;
+- every same-module call to an undecorated module-level function must
+  BIND: positional count within bounds, no unknown keywords, every
+  required parameter covered (the mis-called-function class a real
+  checker gates on). Deliberately conservative — decorated functions,
+  rebound names, attribute calls, and star-args call sites are all
+  skipped — so a finding is a genuine arity bug, never a false alarm.
 
 Exit 0 = clean; failures print ``module: message`` and exit 1.
 """
 
 from __future__ import annotations
 
+import ast
 import importlib
 import inspect
 import os
@@ -78,6 +85,92 @@ def check_module(name: str) -> list:
     return failures
 
 
+def check_call_arity(name: str, path: str) -> list:
+    """Pure-AST arity check of same-module calls to module-level
+    functions. Skips everything that could surprise it: decorated defs
+    (signature may change), names rebound anywhere in the file (a local
+    may shadow the function), ``f(*a)``/``f(**kw)`` call sites, and
+    attribute calls — what remains binds exactly or is a real bug."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return []            # the import/lint gates own those failures
+
+    defs = {}
+    top_level_defs = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            top_level_defs.add(node)
+            if not node.decorator_list:
+                defs[node.name] = node.args
+    if not defs:
+        return []
+    # ANY other binding of the name anywhere in the file might shadow
+    # the module-level function in some scope: assignments/deletes,
+    # parameters, nested defs/classes, import aliases, except/match
+    # capture names. Cheap over-approximation — each skip costs at most
+    # one unchecked call, never a false alarm.
+    rebound = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                  (ast.Store, ast.Del)):
+            rebound.add(n.id)
+        elif isinstance(n, ast.arg):
+            rebound.add(n.arg)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            if n not in top_level_defs:
+                rebound.add(n.name)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for alias in n.names:
+                if alias.name != "*":
+                    rebound.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            rebound.add(n.name)
+        elif isinstance(n, (ast.MatchAs, ast.MatchStar)) and n.name:
+            rebound.add(n.name)
+        elif isinstance(n, ast.MatchMapping) and n.rest:
+            rebound.add(n.rest)
+    failures = []
+    for call in ast.walk(tree):
+        if not (isinstance(call, ast.Call) and isinstance(call.func, ast.Name)
+                and call.func.id in defs and call.func.id not in rebound):
+            continue
+        if any(isinstance(a, ast.Starred) for a in call.args) or \
+                any(kw.arg is None for kw in call.keywords):
+            continue
+        a = defs[call.func.id]
+        pos_params = [p.arg for p in a.posonlyargs + a.args]
+        kw_names = set(pos_params[len(a.posonlyargs):]) | \
+            {p.arg for p in a.kwonlyargs}
+        n_pos = len(call.args)
+        where = f"{name}:{call.lineno}: {call.func.id}()"
+        if n_pos > len(pos_params) and a.vararg is None:
+            failures.append(
+                f"{where} takes at most {len(pos_params)} positional "
+                f"argument(s), got {n_pos}")
+            continue
+        bad_kw = [kw.arg for kw in call.keywords
+                  if kw.arg not in kw_names] if a.kwarg is None else []
+        if bad_kw:
+            failures.append(f"{where} got unknown keyword(s) {bad_kw}")
+            continue
+        covered = set(pos_params[:n_pos]) | {kw.arg for kw in call.keywords}
+        n_pos_default = len(a.defaults)
+        required = set(pos_params[:len(pos_params) - n_pos_default]) | \
+            {p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults) if d is None}
+        missing = sorted(required - covered)
+        if missing:
+            failures.append(f"{where} missing required argument(s) "
+                            f"{missing}")
+        dup = [kw.arg for kw in call.keywords
+               if kw.arg in set(pos_params[:n_pos])]
+        if dup:
+            failures.append(f"{where} got multiple values for {dup}")
+    return failures
+
+
 def main() -> int:
     rc = _try_mypy()
     if rc is not None:
@@ -90,6 +183,9 @@ def main() -> int:
     for name in _iter_modules():
         n += 1
         failures.extend(check_module(name))
+        mod = sys.modules.get(name)
+        if mod is not None and getattr(mod, "__file__", None):
+            failures.extend(check_call_arity(name, mod.__file__))
     for f in failures:
         print(f)
     print(f"typecheck: {n} modules, {len(failures)} failure(s) "

@@ -396,6 +396,14 @@ class _Parser:
             return _MISSING if val is _MISSING else not val
         if self._at_op("-"):
             self.next()
+            # cel-go folds the minus into an int literal, which is how
+            # INT64_MIN (whose magnitude alone exceeds INT64_MAX) is
+            # written; fold here too before the literal-overflow check
+            nxt = self.peek()
+            if (nxt is not None and nxt.kind == "int"
+                    and nxt.value == -_INT64_MIN):
+                self.next()
+                return _INT64_MIN
             val = self.unary_operand()
             if val is _MISSING:
                 return _MISSING
@@ -615,8 +623,14 @@ class _Parser:
                 if tok.kind != "int":
                     raise CelUnsupportedError(
                         f"expected int after - in list, got {tok.value!r}")
+                if -tok.value < _INT64_MIN:
+                    raise CelUnsupportedError(
+                        f"int literal -{tok.value} exceeds int64")
                 items.append(-tok.value)
             elif tok.kind in ("str", "int"):
+                if tok.kind == "int" and tok.value > _INT64_MAX:
+                    raise CelUnsupportedError(
+                        f"int literal {tok.value} exceeds int64")
                 items.append(tok.value)
             elif tok.kind == "ident" and tok.value in ("true", "false"):
                 items.append(tok.value == "true")
