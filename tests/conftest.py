@@ -40,6 +40,7 @@ _SLOW_MODULES = frozenset({
     "test_paged_attention",
     "test_pipeline",
     "test_quantize",
+    "test_seq2seq",
     "test_serving_demo",
     "test_serving_engine",
     "test_speculative",

@@ -1,0 +1,166 @@
+"""Encoder-decoder family (workloads/models/seq2seq.py).
+
+The functional bar is the REVERSAL task: predicting tgt = reversed(src)
+at position i requires attending to src position ts-1-i — a causal
+decoder-only model without cross-attention cannot do it from the BOS
+prompt alone, so a trained model that reverses heldout sequences proves
+the cross-attention path carries real information, not just shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_dra_driver.workloads.models.seq2seq import (
+    Seq2SeqConfig,
+    decode_forward,
+    encode,
+    greedy_decode,
+    init_seq2seq_params,
+    make_seq2seq_train_step,
+    seq2seq_loss_fn,
+    seq2seq_param_shardings,
+)
+
+CFG = Seq2SeqConfig(vocab=16, d_model=64, n_heads=4, n_enc_layers=2,
+                    n_dec_layers=2, d_ff=128, max_src=12, max_tgt=12,
+                    bos=0)
+
+
+def _batch(key, b=16, t=6):
+    # tokens 1..vocab-1 (0 is BOS); target = reversed source
+    src = jax.random.randint(key, (b, t), 1, CFG.vocab)
+    return src, src[:, ::-1]
+
+
+def test_loss_and_shapes():
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    src, tgt = _batch(jax.random.PRNGKey(1))
+    loss = seq2seq_loss_fn(params, (src, tgt), CFG)
+    assert jnp.isfinite(loss) and float(loss) > 0
+    logits = decode_forward(params, src, tgt, CFG)
+    assert logits.shape == (src.shape[0], tgt.shape[1], CFG.vocab)
+    assert logits.dtype == jnp.float32
+
+
+def test_encoder_is_bidirectional():
+    """Flipping the LAST source token must change the FIRST encoder
+    state — impossible under a causal mask."""
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    src, _ = _batch(jax.random.PRNGKey(1), b=1)
+    e1 = encode(params, src, CFG)
+    src2 = src.at[0, -1].set((src[0, -1] % (CFG.vocab - 1)) + 1)
+    e2 = encode(params, src2, CFG)
+    assert not jnp.allclose(e1[0, 0], e2[0, 0])
+
+
+def test_cross_attention_carries_source_information():
+    """Same decoder input, DIFFERENT source content -> different logits
+    (after a few train steps so wo_x is no longer its zero init).
+
+    Note the ablation must change content, not order: attention is a
+    set operation over (k, v) pairs, so permuting the encoder output
+    along the source axis permutes k and v together and provably leaves
+    the output unchanged (cross-attention carries no positions — the
+    encoder's own RoPE is what encodes source order)."""
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    step, opt_init = make_seq2seq_train_step(CFG)
+    opt = opt_init(params)
+    jstep = jax.jit(step)
+    key = jax.random.PRNGKey(2)
+    for _ in range(5):
+        key, k = jax.random.split(key)
+        params, opt, _ = jstep(params, opt, _batch(k))
+    src, tgt = _batch(jax.random.PRNGKey(3), b=2)
+    enc_out = encode(params, src, CFG)
+    other = encode(params, jnp.roll(src, 1, axis=0), CFG)
+    l1 = decode_forward(params, src, tgt, CFG, enc_out=enc_out)
+    l2 = decode_forward(params, src, tgt, CFG, enc_out=other)
+    assert not jnp.allclose(l1, l2)
+    # and the set-invariance itself, pinned as documented behavior
+    l3 = decode_forward(params, src, tgt, CFG, enc_out=enc_out[:, ::-1])
+    assert jnp.allclose(l1, l3, atol=1e-5)
+
+
+def test_zero_init_cross_path_starts_as_plain_lm():
+    """At init, wo_x = 0: the decoder must ignore the encoder entirely
+    (the LoRA-style stability recipe the docstring promises)."""
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    src, tgt = _batch(jax.random.PRNGKey(1), b=2)
+    enc_out = encode(params, src, CFG)
+    l1 = decode_forward(params, src, tgt, CFG, enc_out=enc_out)
+    l2 = decode_forward(params, src, tgt, CFG,
+                        enc_out=jnp.zeros_like(enc_out))
+    assert jnp.allclose(l1, l2)
+
+
+def test_training_learns_reversal_and_greedy_decodes_it():
+    """The family's end-to-end proof: train on reversal, then greedy-
+    decode HELDOUT sequences exactly. Only cross-attention can do this
+    (the decoder's own input is BOS + its previous outputs — the source
+    is reachable solely through the cross path). Recipe measured on the
+    CPU mesh: warmup-cosine to 3e-3 over 1500 steps reaches loss ~0.008
+    and 100% heldout accuracy in ~30 s."""
+    import optax
+
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    sched = optax.warmup_cosine_decay_schedule(0.0, 3e-3, 100, 1500, 1e-4)
+    step, opt_init = make_seq2seq_train_step(CFG, optax.adamw(sched))
+    opt = opt_init(params)
+    jstep = jax.jit(step)
+    key = jax.random.PRNGKey(10)
+    first = last = None
+    for i in range(1500):
+        key, k = jax.random.split(key)
+        params, opt, loss = jstep(params, opt, _batch(k, b=32))
+        if i == 0:
+            first = float(loss)
+        last = float(loss)
+    assert last < first / 10, (first, last)
+    # heldout (fresh key never seen in training)
+    src, tgt = _batch(jax.random.PRNGKey(999), b=8)
+    out = greedy_decode(params, src, CFG, steps=src.shape[1])
+    acc = float((out == tgt).mean())
+    assert acc > 0.95, f"reversal accuracy {acc} (loss {first}->{last})"
+
+
+def test_greedy_decode_validation():
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    src, _ = _batch(jax.random.PRNGKey(1), b=1)
+    with pytest.raises(ValueError, match="max_tgt"):
+        greedy_decode(params, src, CFG, steps=CFG.max_tgt)
+
+
+def test_gqa_decoder_runs():
+    cfg = Seq2SeqConfig(vocab=16, d_model=64, n_heads=4, n_kv_heads=2,
+                        n_enc_layers=1, n_dec_layers=1, d_ff=64,
+                        max_src=8, max_tgt=8)
+    params = init_seq2seq_params(cfg, jax.random.PRNGKey(0))
+    src = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 1, 16)
+    logits = decode_forward(params, src, src, cfg)
+    assert logits.shape == (2, 6, 16)
+    assert jnp.isfinite(logits).all()
+
+
+def test_seq2seq_composes_with_mesh_shardings():
+    """One sharded train step under a (dp, tp) mesh: params placed by
+    the Megatron rules (cross-attention projections included), loss
+    finite, and the step's loss matches the unsharded step bitwise-close
+    (same math, different partitioning)."""
+    from tpu_dra_driver.workloads.parallel import build_mesh
+
+    mesh = build_mesh(jax.devices()[:4])
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    src, tgt = _batch(jax.random.PRNGKey(1), b=4 * mesh.shape["dp"])
+    loss_ref = float(seq2seq_loss_fn(params, (src, tgt), CFG))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    shardings = seq2seq_param_shardings(mesh, params)
+    placed = jax.device_put(params, shardings)
+    b_shard = NamedSharding(mesh, P("dp", None))
+    src_s = jax.device_put(src, b_shard)
+    tgt_s = jax.device_put(tgt, b_shard)
+    loss_sharded = float(jax.jit(
+        lambda p, s, t: seq2seq_loss_fn(p, (s, t), CFG))(
+            placed, src_s, tgt_s))
+    assert abs(loss_sharded - loss_ref) < 1e-2 * max(1.0, abs(loss_ref))

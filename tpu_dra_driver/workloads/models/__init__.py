@@ -56,3 +56,11 @@ from tpu_dra_driver.workloads.models.encoder import (  # noqa: F401
     mlm_corrupt,
     mlm_loss_fn,
 )
+from tpu_dra_driver.workloads.models.seq2seq import (  # noqa: F401
+    Seq2SeqConfig,
+    greedy_decode,
+    init_seq2seq_params,
+    make_seq2seq_train_step,
+    seq2seq_loss_fn,
+    seq2seq_param_shardings,
+)

@@ -309,8 +309,11 @@ def _ffn(xn2: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
 
 
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            attn_fn=None) -> jax.Array:
-    """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out)."""
+            attn_fn=None, return_hidden: bool = False) -> jax.Array:
+    """tokens [b, t] int32 → logits [b, t, vocab] (bf16 matmuls, fp32 out).
+    ``return_hidden`` returns the final-normed hidden states [b, t, d]
+    instead of logits — the encoder half of the seq2seq family, sharing
+    this exact body (scan_layers/remat included)."""
     t = tokens.shape[1]
     x = embed_lookup(params["embed"], tokens, cfg.dtype)
     if not cfg.use_rope:
@@ -340,6 +343,8 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
         for layer in layers:
             x = block(x, layer)
     x = _rmsnorm(x, params["final_norm"]["g"])
+    if return_hidden:
+        return x
     return lm_head(x, params["embed"])
 
 
