@@ -181,7 +181,6 @@ def test_unsupported_constructs_fail_loud():
         "cel.bind(x, 1, x)",                            # function call
         "device.allAttributes",                         # unknown field
         'device.attributes["x"]',                       # bare map access
-        "size([1]) == 1",                               # size() function
     ):
         with pytest.raises(AllocationError):
             ev(CHIP, TPU, expr)
@@ -472,3 +471,17 @@ def test_int64_min_literal_and_list_literal_bounds():
     # INT64_MIN / -1 is the one division overflow -> runtime error
     assert not ev(CHIP, TPU, f"{lo} / -1 > 0")
     assert ev(CHIP, TPU, f"{lo} / -1 > 0 || true")
+
+
+def test_size_function_and_method():
+    gen = f'device.attributes["{TPU}"].generation'
+    assert ev(CHIP, TPU, f'size({gen}) == 3')
+    assert ev(CHIP, TPU, f'{gen}.size() == 3')
+    assert ev(CHIP, TPU, 'size(["a", "b"]) == 2')
+    assert ev(CHIP, TPU, 'size("") == 0')
+    # missing propagates; wrong type fails loud
+    assert not ev(CHIP, TPU, f'size(device.attributes["{TPU}"].nope) == 1')
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, 'size(1) == 1')
+    with pytest.raises(AllocationError):
+        ev(CHIP, TPU, f'{gen}.size(1) == 3')

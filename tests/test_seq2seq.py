@@ -131,6 +131,22 @@ def test_greedy_decode_validation():
         greedy_decode(params, src, CFG, steps=CFG.max_tgt)
 
 
+def test_length_capacity_validation_fails_loud():
+    """Past-capacity inputs must raise, not silently degrade: beyond
+    max_src the prefix mask turns the source tail causal, and beyond
+    max_tgt a learned pos_embed would clamp-index."""
+    params = init_seq2seq_params(CFG, jax.random.PRNGKey(0))
+    long_src = jax.random.randint(jax.random.PRNGKey(1),
+                                  (1, CFG.max_src + 4), 1, CFG.vocab)
+    with pytest.raises(ValueError, match="max_src"):
+        encode(params, long_src, CFG)
+    src, _ = _batch(jax.random.PRNGKey(1), b=1)
+    long_tgt = jax.random.randint(jax.random.PRNGKey(2),
+                                  (1, CFG.max_tgt + 1), 1, CFG.vocab)
+    with pytest.raises(ValueError, match="max_tgt"):
+        decode_forward(params, src, long_tgt, CFG)
+
+
 def test_gqa_decoder_runs():
     cfg = Seq2SeqConfig(vocab=16, d_model=64, n_heads=4, n_kv_heads=2,
                         n_enc_layers=1, n_dec_layers=1, d_ff=64,

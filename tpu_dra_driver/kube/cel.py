@@ -22,7 +22,8 @@ matches or mismatches):
             | operand ( "." ident "(" args ")"
                       | "." ("exists"|"all") "(" ident "," expr ")" )*
     operand:= literal | path | list | macro-var
-            | "quantity" "(" string ")" | "(" expr ")"
+            | "quantity" "(" string ")" | "size" "(" expr ")"
+            | "(" expr ")"
     path   := "device" "." "driver"
             | "device" "." ("attributes"|"capacity") "[" string "]"
               "." ident
@@ -34,7 +35,8 @@ zero, `%` takes the dividend's sign (both differ from Python's floor
 behavior on negatives), division by zero is a runtime error
 (propagates like a missing value), and `+` also concatenates two
 strings. The `exists`/`all` comprehension macros run over list
-literals with CEL's OR/AND error-absorption aggregation.
+literals with CEL's OR/AND error-absorption aggregation; `size()`
+(global and method form) covers strings and lists.
 
 ``!`` binds tighter than comparisons (CEL precedence: ``!a == b`` is
 ``(!a) == b``); parenthesize to negate a comparison.
@@ -192,6 +194,18 @@ _STR_METHODS = {"startsWith": 1, "endsWith": 1, "contains": 1,
 # both engines take them); the re.error path below fail-louds the rest.
 _NON_RE2_RE = re.compile(r"\(\?[=!<>(]|\(\?P=|\\[1-9]"
                          r"|(?<!\\)(?:[*+?]|\})\+")
+
+
+def _cel_size(v: Any) -> Any:
+    """CEL's size(): string length (unicode code points) or list
+    length. Errors (missing) propagate; other types are real-scheduler
+    type errors, fail-loud."""
+    if v is _MISSING:
+        return _MISSING
+    if isinstance(v, (str, list)):
+        return len(v)
+    raise CelUnsupportedError(
+        f"size() takes a string or list, got {v!r}")
 
 
 def _cel_matches(s: str, pattern: str) -> Any:
@@ -458,7 +472,8 @@ class _Parser:
             raise CelUnsupportedError(
                 f".{name}() variable {var.value!r} shadows an outer "
                 f"macro variable")
-        if var.value in ("device", "quantity", "true", "false", "in"):
+        if var.value in ("device", "quantity", "size", "true", "false",
+                         "in"):
             raise CelUnsupportedError(
                 f".{name}() variable {var.value!r} shadows a reserved name")
         self.expect_op(",")
@@ -515,6 +530,10 @@ class _Parser:
         return _int64_or_error(q if op == "/" else lhs - q * rhs)
 
     def _call_method(self, val: Any, method: str, args: List[Any]) -> Any:
+        if method == "size":               # receiver form: x.size()
+            if args:
+                raise CelUnsupportedError(".size() takes no arguments")
+            return _cel_size(val)
         arity = _QTY_METHODS.get(method, _STR_METHODS.get(method))
         if arity is None:
             raise CelUnsupportedError(f"unsupported method .{method}()")
@@ -581,6 +600,12 @@ class _Parser:
                         f"{arg.value!r}")
                 self.expect_op(")")
                 return Quantity(arg.value)
+            if tok.value == "size":
+                self.next()
+                self.expect_op("(")
+                arg = self.or_expr()
+                self.expect_op(")")
+                return _cel_size(arg)
             raise CelUnsupportedError(f"unsupported identifier {tok.value!r}")
         raise CelUnsupportedError(f"unsupported token {tok.value!r}")
 

@@ -148,6 +148,11 @@ def encode(params: Params, src: jax.Array, cfg: Seq2SeqConfig) -> jax.Array:
     This IS transformer.forward under the all-prefix config (the exact
     bidirectional stack the MLM family trains, scan_layers/remat
     included), stopped before the LM head."""
+    if src.shape[1] > cfg.max_src:
+        # beyond max_src the prefix mask would silently turn the tail
+        # CAUSAL (and a learned pos_embed would clamp-index) — fail loud
+        raise ValueError(f"source length {src.shape[1]} exceeds "
+                         f"max_src ({cfg.max_src})")
     return forward(params["encoder"], src, cfg.encoder_cfg(),
                    return_hidden=True)
 
@@ -159,6 +164,11 @@ def decode_forward(params: Params, src: jax.Array, tgt_in: jax.Array,
     [b,tt,vocab]. Pass ``enc_out`` to reuse a precomputed encoding
     (decode loop); omitted, the encoder runs inline (training)."""
     dcfg = cfg.decoder_cfg()
+    if tgt_in.shape[1] > cfg.max_tgt:
+        # a learned pos_embed would clamp-index past max_tgt; RoPE would
+        # run but lie about the configured capacity — fail loud either way
+        raise ValueError(f"target length {tgt_in.shape[1]} exceeds "
+                         f"max_tgt ({cfg.max_tgt})")
     if enc_out is None:
         enc_out = encode(params, src, cfg)
     dec = params["decoder"]
