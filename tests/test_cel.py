@@ -485,3 +485,32 @@ def test_size_function_and_method():
         ev(CHIP, TPU, 'size(1) == 1')
     with pytest.raises(AllocationError):
         ev(CHIP, TPU, f'{gen}.size(1) == 3')
+
+
+def test_has_presence_macro():
+    # the ONE construct where a missing FINAL attribute yields false,
+    # not an error — the guard idiom: has(a) && a == ... never errors
+    # on absent attributes
+    assert ev(CHIP, TPU, f'has(device.attributes["{TPU}"].generation)')
+    assert not ev(CHIP, TPU, f'has(device.attributes["{TPU}"].nope)')
+    assert ev(CHIP, TPU, f'has(device.capacity["{TPU}"].hbm)')
+    guard = (f'has(device.attributes["{TPU}"].nope) && '
+             f'device.attributes["{TPU}"].nope == "x"')
+    assert not ev(CHIP, TPU, guard)          # false, never an error
+    assert ev(CHIP, TPU, f'!has(device.attributes["{TPU}"].nope)')
+    with pytest.raises(AllocationError):     # non-path argument
+        ev(CHIP, TPU, 'has(1)')
+
+
+def test_has_wrong_domain_is_still_an_error():
+    """cel-spec: has() wraps the FINAL select only; indexing an absent
+    DOMAIN key errors first and that error propagates. So a wrong-domain
+    has() is no-match, and critically `!has(wrong-domain)` must NOT
+    match everything — the real scheduler errors there."""
+    wrong = 'has(device.attributes["other.example.com"].x)'
+    assert not ev(CHIP, TPU, wrong)              # error -> no match
+    assert not ev(CHIP, TPU, f'!{wrong}')        # NOT true: still error
+    assert ev(CHIP, TPU, f'{wrong} || true')     # absorbable like errors
+    # same-domain absent attribute stays the absorbing false
+    assert ev(CHIP, TPU,
+              f'!has(device.attributes["{TPU}"].nope) && true')

@@ -72,11 +72,13 @@ def _eval_cel(dev: Dict, driver: str, expression: str) -> bool:
         if section == "driver":
             return driver
         # qualified attributes resolve within their domain; a different
-        # domain than the publishing driver's is a missing map key on a
-        # real scheduler — mirror that instead of silently matching
-        # mistyped templates
+        # domain than the publishing driver's is a missing DOMAIN map
+        # key on a real scheduler — a runtime error even under has(),
+        # which only absorbs absence of the final attribute. The
+        # distinct sentinel keeps `!has(wrong.domain...)` from silently
+        # matching where the real scheduler errors.
         if driver and domain != driver:
-            return cel.MISSING
+            return cel.MISSING_DOMAIN
         if section == "attributes":
             v = _attr_value(dev, name)
             return cel.MISSING if v is None else v

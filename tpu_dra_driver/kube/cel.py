@@ -23,7 +23,7 @@ matches or mismatches):
                       | "." ("exists"|"all") "(" ident "," expr ")" )*
     operand:= literal | path | list | macro-var
             | "quantity" "(" string ")" | "size" "(" expr ")"
-            | "(" expr ")"
+            | "has" "(" path ")" | "(" expr ")"
     path   := "device" "." "driver"
             | "device" "." ("attributes"|"capacity") "[" string "]"
               "." ident
@@ -36,7 +36,9 @@ behavior on negatives), division by zero is a runtime error
 (propagates like a missing value), and `+` also concatenates two
 strings. The `exists`/`all` comprehension macros run over list
 literals with CEL's OR/AND error-absorption aggregation; `size()`
-(global and method form) covers strings and lists.
+(global and method form) covers strings and lists; `has(path)` is
+the cel-spec presence macro — the one construct where a missing
+attribute yields false instead of an error.
 
 ``!`` binds tighter than comparisons (CEL precedence: ``!a == b`` is
 ``(!a) == b``); parenthesize to negate a comparison.
@@ -84,11 +86,19 @@ import re
 from fractions import Fraction
 from typing import Any, Callable, List, NamedTuple, Optional
 
-# Sentinel for "attribute absent / wrong domain" — the public name is the
-# resolver contract (allocator.py returns it); it behaves like a CEL
-# runtime error during evaluation.
+# Sentinel for "attribute absent" — the public name is the resolver
+# contract (allocator.py returns it); it behaves like a CEL runtime
+# error during evaluation.
 MISSING = object()
 _MISSING = MISSING
+
+# Sentinel for "the DOMAIN map key itself is absent" (a qualified domain
+# that is not the device's driver). Everywhere it behaves exactly like
+# MISSING — except under has(): per cel-spec, has() absorbs absence of
+# the FINAL field only, while an error from indexing the domain map
+# still propagates. Collapsing the two would let `!has(...)` silently
+# match where the real scheduler errors.
+MISSING_DOMAIN = object()
 
 
 class CelUnsupportedError(ValueError):
@@ -472,8 +482,8 @@ class _Parser:
             raise CelUnsupportedError(
                 f".{name}() variable {var.value!r} shadows an outer "
                 f"macro variable")
-        if var.value in ("device", "quantity", "size", "true", "false",
-                         "in"):
+        if var.value in ("device", "quantity", "size", "has", "true",
+                         "false", "in"):
             raise CelUnsupportedError(
                 f".{name}() variable {var.value!r} shadows a reserved name")
         self.expect_op(",")
@@ -606,10 +616,32 @@ class _Parser:
                 arg = self.or_expr()
                 self.expect_op(")")
                 return _cel_size(arg)
+            if tok.value == "has":
+                # the cel-spec presence macro: has(device.attributes[d].a)
+                # is the ONE construct where a missing FINAL field yields
+                # false instead of an error — the guard idiom selectors
+                # use. Absence of the domain map key itself is still an
+                # error (cel-spec: has() wraps the final select only; the
+                # inner index evaluates first and its error propagates).
+                self.next()
+                self.expect_op("(")
+                tok2 = self.peek()
+                if not (tok2 is not None and tok2.kind == "ident"
+                        and tok2.value == "device"):
+                    raise CelUnsupportedError(
+                        "has() takes a device.attributes/capacity path")
+                val = self.device_path(raw=True)
+                self.expect_op(")")
+                if val is MISSING_DOMAIN:
+                    return _MISSING
+                return val is not _MISSING
             raise CelUnsupportedError(f"unsupported identifier {tok.value!r}")
         raise CelUnsupportedError(f"unsupported token {tok.value!r}")
 
-    def device_path(self) -> Any:
+    def device_path(self, raw: bool = False) -> Any:
+        """``raw=True`` (the has() macro) preserves the MISSING_DOMAIN
+        sentinel; normal evaluation collapses it to missing — the two
+        only differ under has()."""
         self.next()              # device
         self.expect_op(".")
         field = self.next()
@@ -631,7 +663,10 @@ class _Parser:
             if name.kind != "ident":
                 raise CelUnsupportedError(
                     f"expected attribute name, got {name.value!r}")
-            return self.resolve(field.value, domain.value, name.value)
+            val = self.resolve(field.value, domain.value, name.value)
+            if val is MISSING_DOMAIN and not raw:
+                return _MISSING
+            return val
         raise CelUnsupportedError(f"unsupported device field "
                                   f"{field.value!r}")
 
