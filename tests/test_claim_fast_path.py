@@ -1,0 +1,399 @@
+"""The claim-to-ready fast path: compiled-CEL cache semantics and the
+group-commit (batched) prepare/unprepare state machine.
+
+Two invariant families, each provable from instrumentation alone
+(pkg/metrics.py counters):
+
+- CEL: one parse per (expression, batch) no matter how many devices a
+  selector scans; compile errors cached AS errors with identical
+  messages on hit and miss; eval (value-dependent) errors still raised
+  per device; the cache is a bounded LRU keyed by expression text, so
+  ``device.`` resolution stays per-device.
+- Prepare: a batch of N claims pays exactly 2 fsync-bearing checkpoint
+  writes (write-ahead + commit); a claim failing mid-batch neither
+  fails nor rolls back its peers; a crash between write-ahead and
+  commit leaves only PrepareStarted entries, rolled back on restart
+  exactly like the per-claim path.
+"""
+
+import json
+
+import pytest
+
+from tpu_dra_driver.kube import cel
+from tpu_dra_driver.kube.allocator import AllocationError, _eval_cel
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg.metrics import (
+    CEL_COMPILE_CACHE_HITS,
+    CEL_COMPILE_CACHE_MISSES,
+    CHECKPOINT_WRITES,
+)
+from tpu_dra_driver.plugin.checkpoint import (
+    CheckpointManager,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+)
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+NODE = "node-a"
+TPU = "tpu.google.com"
+
+CHIP = {
+    "name": "tpu-0",
+    "attributes": {
+        "type": {"string": "chip"},
+        "generation": {"string": "v5p"},
+        "cores": {"int": 2},
+    },
+}
+
+
+def _mkplugin(tmp_path, lib=None, subdir="plugin-state"):
+    clients = ClientSets()
+    lib = lib or FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name=NODE,
+        state_dir=str(tmp_path / subdir),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=fg.FeatureGates(),
+    ))
+    plugin.start()
+    return plugin, clients, lib
+
+
+def _claim(uid, devices):
+    return build_allocated_claim(uid, f"claim-{uid}", "user-ns", devices, NODE)
+
+
+def _cache_deltas():
+    """Snapshot (hits, misses) for delta assertions."""
+    return CEL_COMPILE_CACHE_HITS.value, CEL_COMPILE_CACHE_MISSES.value
+
+
+# ---------------------------------------------------------------------------
+# CEL compile-cache semantics
+# ---------------------------------------------------------------------------
+
+def test_cached_expression_still_raises_per_device_eval_errors():
+    """A value-dependent (eval-time) error must surface per device with
+    an identical message whether the compilation was a miss or a hit —
+    and the same compiled expression must still match a device whose
+    values are fine."""
+    cel.clear_compile_cache()
+    expr = f'device.attributes["{TPU}"].cores.startsWith("2")'
+    with pytest.raises(AllocationError) as e_miss:
+        _eval_cel(CHIP, TPU, expr)          # cores is an int: type error
+    with pytest.raises(AllocationError) as e_hit:
+        _eval_cel(CHIP, TPU, expr)
+    assert str(e_miss.value) == str(e_hit.value)
+    assert "string method" in str(e_hit.value)
+    # same cached expression, a device where the receiver IS a string
+    ok_dev = {"name": "d", "attributes": {"cores": {"string": "2x"}}}
+    assert _eval_cel(ok_dev, TPU, expr)
+
+
+def test_compile_errors_cached_and_identical_on_hit_and_miss():
+    cel.clear_compile_cache()
+    for expr in (
+        f"{2 ** 63} > 0",                   # int64 literal overflow
+        'device.driver.matches("v(?=5)")',  # non-RE2 literal pattern
+        'device.driver.matches("[unclosed")',
+        "device.allAttributes",             # syntax/unknown field
+    ):
+        with pytest.raises(cel.CelUnsupportedError) as e_miss:
+            cel.compile_selector(expr)
+        _, misses0 = _cache_deltas()
+        with pytest.raises(cel.CelUnsupportedError) as e_hit:
+            cel.compile_selector(expr)
+        _, misses1 = _cache_deltas()
+        assert str(e_miss.value) == str(e_hit.value)
+        assert misses1 == misses0, "cached error must not reparse"
+
+
+def test_compile_cache_is_bounded_lru(monkeypatch):
+    monkeypatch.setattr(cel, "COMPILE_CACHE_MAXSIZE", 4)
+    cel.clear_compile_cache()
+    exprs = [f'device.attributes["{TPU}"].cores == {i}' for i in range(6)]
+    for e in exprs:
+        cel.compile_selector(e)
+    assert cel.compile_cache_info()["size"] <= 4
+    # oldest two were evicted: recompiling expr 0 is a miss; the most
+    # recent expr is still a hit
+    _, m0 = _cache_deltas()
+    cel.compile_selector(exprs[0])
+    _, m1 = _cache_deltas()
+    assert m1 == m0 + 1
+    h0, _ = _cache_deltas()
+    cel.compile_selector(exprs[-1])
+    h1, _ = _cache_deltas()
+    assert h1 == h0 + 1
+
+
+def test_cache_key_keeps_device_resolution_per_device():
+    """The cache is keyed by expression text only; the resolver binds at
+    evaluate time, so one cached compilation answers differently per
+    device."""
+    cel.clear_compile_cache()
+    expr = f'device.attributes["{TPU}"].generation == "v5p"'
+    v4 = {"name": "old", "attributes": {"generation": {"string": "v4"}}}
+    h0, m0 = _cache_deltas()
+    assert _eval_cel(CHIP, TPU, expr) is True
+    assert _eval_cel(v4, TPU, expr) is False
+    h1, m1 = _cache_deltas()
+    assert m1 - m0 == 1 and h1 - h0 == 1
+
+
+# ---------------------------------------------------------------------------
+# group-commit prepare
+# ---------------------------------------------------------------------------
+
+def test_batch_prepare_exactly_two_checkpoint_writes(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    claims = [_claim(f"u{i}", [f"tpu-{i}"]) for i in range(4)]
+    w0 = CHECKPOINT_WRITES.value
+    res = plugin.prepare_resource_claims(claims)
+    assert all(r.error is None for r in res.values())
+    assert CHECKPOINT_WRITES.value - w0 == 2
+    # and the write count does not scale with batch size: a batch of 1
+    # (after unpreparing) also pays exactly 2
+    plugin.unprepare_resource_claims([f"u{i}" for i in range(4)])
+    w0 = CHECKPOINT_WRITES.value
+    res = plugin.prepare_resource_claims([_claim("solo", ["tpu-0"])])
+    assert res["solo"].error is None
+    assert CHECKPOINT_WRITES.value - w0 == 2
+
+
+def test_batch_error_isolation_peer_claims_complete(tmp_path):
+    """Claim 2 of 3 hitting a PermanentError must not fail or roll back
+    claims 1 and 3; its write-ahead entry stays PrepareStarted for the
+    usual rollback machinery."""
+    plugin, _, _ = _mkplugin(tmp_path)
+    res = plugin.prepare_resource_claims([
+        _claim("u1", ["tpu-0"]),
+        _claim("u2", ["tpu-99"]),          # not in inventory: permanent
+        _claim("u3", ["tpu-1"]),
+    ])
+    assert res["u1"].error is None
+    assert res["u3"].error is None
+    assert res["u2"].permanent and "not in this node's" in res["u2"].error
+    cp = plugin.state.get_checkpoint()
+    assert cp.claims["u1"].state == PREPARE_COMPLETED
+    assert cp.claims["u3"].state == PREPARE_COMPLETED
+    assert cp.claims["u2"].state == PREPARE_STARTED
+    # the failed claim retries cleanly once its allocation is fixable
+    res2 = plugin.prepare_resource_claims([_claim("u2", ["tpu-2"])])
+    assert res2["u2"].error is None
+
+
+def test_batch_in_batch_overlap_matches_serial_semantics(tmp_path):
+    """Two claims in ONE batch allocated the same device: the first
+    wins, the second gets the same PermanentError a serial run would
+    have produced after the first completed."""
+    plugin, _, _ = _mkplugin(tmp_path)
+    res = plugin.prepare_resource_claims([
+        _claim("u1", ["tpu-0"]),
+        _claim("u2", ["tpu-0"]),
+    ])
+    assert res["u1"].error is None
+    assert res["u2"].permanent
+    assert "already prepared for claim u1" in res["u2"].error
+
+
+def test_batch_overlap_loser_succeeds_when_winner_fails(tmp_path,
+                                                        monkeypatch):
+    """Serial equivalence the other way: if the earlier claim of an
+    intra-batch overlap pair FAILS, the later claim must get the device
+    — not a PermanentError for a preparation that never happened."""
+    plugin, _, _ = _mkplugin(tmp_path)
+    state = plugin.state
+    real = state._prepare_devices
+
+    def failing_for_u1(claim):
+        if claim.uid == "u1":
+            raise RuntimeError("injected transient failure")
+        return real(claim)
+
+    monkeypatch.setattr(state, "_prepare_devices", failing_for_u1)
+    res = plugin.prepare_resource_claims([
+        _claim("u1", ["tpu-0"]),
+        _claim("u2", ["tpu-0"]),
+    ])
+    assert "injected transient failure" in res["u1"].error
+    assert not res["u1"].permanent
+    assert res["u2"].error is None
+    cp = plugin.state.get_checkpoint()
+    assert cp.claims["u2"].state == PREPARE_COMPLETED
+    assert cp.claims["u1"].state == PREPARE_STARTED   # rollback pending
+
+
+def test_batch_with_no_completed_claim_skips_commit_write(tmp_path,
+                                                          monkeypatch):
+    """A batch where every admitted claim fails has nothing to finalize:
+    only the write-ahead fsync lands (the failed entries it persisted
+    are exactly what rollback needs), not a byte-identical commit."""
+    plugin, _, _ = _mkplugin(tmp_path)
+
+    def always_failing(claim):
+        raise RuntimeError("injected transient failure")
+
+    monkeypatch.setattr(plugin.state, "_prepare_devices", always_failing)
+    w0 = CHECKPOINT_WRITES.value
+    res = plugin.prepare_resource_claims(
+        [_claim("u1", ["tpu-0"]), _claim("u2", ["tpu-1"])])
+    assert all(r.error is not None for r in res.values())
+    assert CHECKPOINT_WRITES.value - w0 == 1
+
+
+def test_batch_mixes_cached_and_fresh_claims(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    assert plugin.prepare_resource_claims(
+        [_claim("u1", ["tpu-0"])])["u1"].error is None
+    w0 = CHECKPOINT_WRITES.value
+    res = plugin.prepare_resource_claims([
+        _claim("u1", ["tpu-0"]),           # idempotent replay
+        _claim("u2", ["tpu-1"]),           # fresh
+    ])
+    assert [d.canonical_name for d in res["u1"].devices] == ["tpu-0"]
+    assert res["u2"].error is None
+    assert CHECKPOINT_WRITES.value - w0 == 2
+    cached_flags = {t.claim: t.cached for t in list(plugin.state.timings)[-2:]}
+    assert cached_flags["user-ns/claim-u1:u1"] is True
+    assert cached_flags["user-ns/claim-u2:u2"] is False
+
+
+def test_duplicate_uid_in_one_batch_prepares_once(tmp_path):
+    """The same claim appearing twice in one kubelet batch must prepare
+    once and report one clean result — the serial path's second pass
+    would have replayed the completed entry."""
+    plugin, _, _ = _mkplugin(tmp_path)
+    c = _claim("dup", ["tpu-0"])
+    n0 = len(plugin.state.timings)
+    res = plugin.prepare_resource_claims([c, c])
+    assert res["dup"].error is None
+    assert len(plugin.state.timings) - n0 == 1   # one prepare, not two
+    assert plugin.state.get_checkpoint().claims["dup"].state \
+        == PREPARE_COMPLETED
+
+
+def test_crash_between_write_ahead_and_commit_rolls_back_on_restart(
+        tmp_path, monkeypatch):
+    """Simulated crash: the write-ahead fsync lands, the commit never
+    does. The on-disk checkpoint must hold only PrepareStarted entries,
+    and a restarted plugin must roll them back and prepare cleanly —
+    identical to the per-claim write-ahead contract."""
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin, _, _ = _mkplugin(tmp_path, lib=lib)
+    mgr = plugin.state._cp_mgr
+    real_write = mgr.write
+    calls = {"n": 0}
+
+    def crashing_write(cp):
+        calls["n"] += 1
+        if calls["n"] == 2:                # the commit write
+            raise OSError("simulated crash before commit")
+        return real_write(cp)
+
+    monkeypatch.setattr(mgr, "write", crashing_write)
+    res = plugin.prepare_resource_claims(
+        [_claim("u1", ["tpu-0"]), _claim("u2", ["tpu-1"])])
+    assert all(r.error is not None for r in res.values())
+    monkeypatch.undo()
+
+    # on disk: write-ahead only — both entries PrepareStarted
+    on_disk = CheckpointManager(str(tmp_path / "plugin-state")).read()
+    assert {u: e.state for u, e in on_disk.claims.items()} == {
+        "u1": PREPARE_STARTED, "u2": PREPARE_STARTED}
+
+    # "restart": fresh plugin over the same state dir + host state
+    lib2 = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"),
+                      host_state=lib.host_state)
+    plugin2, _, _ = _mkplugin(tmp_path, lib=lib2)
+    res2 = plugin2.prepare_resource_claims(
+        [_claim("u1", ["tpu-0"]), _claim("u2", ["tpu-1"])])
+    assert all(r.error is None for r in res2.values())
+    assert not plugin2.state.timings[-1].cached    # rolled back, not replayed
+    cp = plugin2.state.get_checkpoint()
+    assert all(e.state == PREPARE_COMPLETED for e in cp.claims.values())
+
+
+def test_batch_unprepare_single_write_and_per_uid_errors(
+        tmp_path, monkeypatch):
+    plugin, _, _ = _mkplugin(tmp_path)
+    claims = [_claim(f"u{i}", [f"tpu-{i}"]) for i in range(3)]
+    assert all(r.error is None
+               for r in plugin.prepare_resource_claims(claims).values())
+
+    cdi = plugin.state._cdi
+    real_delete = cdi.delete_claim_spec
+
+    def failing_delete(uid):
+        if uid == "u1":
+            raise RuntimeError("injected teardown failure")
+        return real_delete(uid)
+
+    monkeypatch.setattr(cdi, "delete_claim_spec", failing_delete)
+    w0 = CHECKPOINT_WRITES.value
+    out = plugin.unprepare_resource_claims(["u0", "u1", "u2", "ghost"])
+    assert CHECKPOINT_WRITES.value - w0 == 1     # one write for the batch
+    assert out["u0"] is None and out["u2"] is None
+    assert out["ghost"] is None                  # idempotent no-op
+    assert "injected teardown failure" in out["u1"]
+    # the failed UID keeps its entry for a retry, which then succeeds
+    assert set(plugin.state.get_checkpoint().claims) == {"u1"}
+    monkeypatch.undo()
+    assert plugin.unprepare_resource_claims(["u1"]) == {"u1": None}
+    assert plugin.state.get_checkpoint().claims == {}
+
+
+# ---------------------------------------------------------------------------
+# perf smoke: the fast-path invariants, proven by counters (tier-1/CI)
+# ---------------------------------------------------------------------------
+
+def test_smoke_one_selector_over_64_devices_parses_exactly_once():
+    cel.clear_compile_cache()
+    expr = (f'device.driver == "{TPU}" && '
+            f'device.attributes["{TPU}"].type == "chip"')
+    devices = [
+        {"name": f"d{i}",
+         "attributes": {"type": {"string": "chip" if i % 2 else "subslice"}}}
+        for i in range(64)
+    ]
+    h0, m0 = _cache_deltas()
+    matched = sum(_eval_cel(dev, TPU, expr) for dev in devices)
+    h1, m1 = _cache_deltas()
+    assert matched == 32
+    assert m1 - m0 == 1, "expression must parse exactly once"
+    assert h1 - h0 == 63, "remaining 63 devices must hit the cache"
+
+
+def test_smoke_batched_prepare_fsync_writes_do_not_scale(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path)
+    deltas = {}
+    for size in (1, 4):
+        claims = [_claim(f"s{size}-u{i}", [f"tpu-{i}"]) for i in range(size)]
+        w0 = CHECKPOINT_WRITES.value
+        res = plugin.prepare_resource_claims(claims)
+        assert all(r.error is None for r in res.values())
+        deltas[size] = CHECKPOINT_WRITES.value - w0
+        plugin.unprepare_resource_claims([c["metadata"]["uid"]
+                                          for c in claims])
+    assert deltas == {1: 2, 4: 2}
+
+
+def test_checkpoint_payloads_serialized_once_and_legacy_crc_stable(tmp_path):
+    """The rewritten checkpoint writer splices each version's canonical
+    serialization (the exact bytes it checksummed) into the envelope —
+    so a reader's re-serialization of the parsed payload must reproduce
+    the stored CRC, byte-compatibly with every older reader."""
+    import zlib
+    plugin, _, _ = _mkplugin(tmp_path)
+    assert plugin.prepare_resource_claims(
+        [_claim("u1", ["tpu-0"])])["u1"].error is None
+    raw = json.load(open(plugin.state._cp_mgr.path))
+    for version in ("v1", "v2"):
+        crc = zlib.crc32(
+            json.dumps(raw[version], sort_keys=True).encode())
+        assert crc == raw["checksums"][version]

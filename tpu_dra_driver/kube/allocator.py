@@ -65,8 +65,18 @@ def _eval_cel(dev: Dict, driver: str, expression: str) -> bool:
     """Evaluate a selector with the recursive-descent CEL subset
     (kube/cel.py: ||, &&, !, parentheses, `in`, comparisons). Unsupported
     constructs fail loud — a selector the allocator cannot faithfully
-    evaluate must never silently match or mismatch."""
+    evaluate must never silently match or mismatch.
+
+    Compilation goes through cel.py's bounded LRU cache: the allocator
+    calls this once per (selector, candidate device), so a request
+    scanning N devices parses its expression exactly once — the
+    per-device work is only the resolver walk."""
     from tpu_dra_driver.kube import cel
+
+    try:
+        compiled = cel.compile_selector(expression)
+    except (cel.CelUnsupportedError, cel.CelEvalError) as e:
+        raise AllocationError(f"selector {expression!r}: {e}") from e
 
     def resolver(section: str, domain: str, name: str):
         if section == "driver":
@@ -99,7 +109,7 @@ def _eval_cel(dev: Dict, driver: str, expression: str) -> bool:
         return v
 
     try:
-        return cel.evaluate(expression, resolver)
+        return compiled.evaluate(resolver)
     except (cel.CelUnsupportedError, cel.CelEvalError) as e:
         raise AllocationError(f"selector {expression!r}: {e}") from e
 

@@ -1,4 +1,4 @@
-"""A small recursive-descent CEL evaluator for DRA device selectors.
+"""A small recursive-descent CEL compiler+evaluator for DRA selectors.
 
 The real scheduler evaluates full CEL against each device
 (k8s.io/dynamic-resource-allocation/cel); the in-process allocator (the
@@ -6,6 +6,18 @@ scheduler stand-in for tests, demos, and the sim e2e suite) needs to
 honor the same selectors that ship in `deviceclasses.yaml` and the
 controller's claim templates — plus the shapes users realistically
 write: `||`, `!`, parentheses, `in` over list literals.
+
+Compilation is split from evaluation (the KEP-4381 scheduler-side hot
+loop evaluates one selector against every candidate device):
+``compile_selector(expr)`` tokenizes+parses once into a closure tree
+behind a bounded LRU cache keyed by expression text — compile-time
+errors (syntax, int64 literal overflow, non-RE2 literal patterns,
+macro-variable validation, method arity) are cached *as* errors and
+re-raise identically on every hit — and
+``CompiledSelector.evaluate(resolver)`` walks the compiled form with a
+per-device resolver, preserving the one-pass evaluator's value-dependent
+error surface (missing propagation, type errors, division by zero)
+message-for-message. ``evaluate(expr, resolver)`` composes the two.
 
 Supported grammar (fail-loud `CelUnsupportedError` on anything else, so
 a selector the allocator cannot faithfully evaluate never silently
@@ -83,8 +95,12 @@ overall result means the device does not match.
 from __future__ import annotations
 
 import re
+import threading
+from collections import OrderedDict
 from fractions import Fraction
 from typing import Any, Callable, List, NamedTuple, Optional
+
+from tpu_dra_driver.pkg import metrics as _metrics
 
 # Sentinel for "attribute absent" — the public name is the resolver
 # contract (allocator.py returns it); it behaves like a CEL runtime
@@ -219,24 +235,11 @@ def _cel_size(v: Any) -> Any:
 
 
 def _cel_matches(s: str, pattern: str) -> Any:
-    if _NON_RE2_RE.search(pattern):
-        raise CelUnsupportedError(
-            f"matches() pattern {pattern!r} uses regex constructs RE2 "
-            f"(the real CEL regex engine) rejects — backreferences, "
-            f"lookaround, atomic/conditional groups, or possessive "
-            f"quantifiers")
-    try:
-        compiled = re.compile(pattern)
-    except re.error as e:
-        # Without an RE2 engine we cannot tell invalid-in-both (real
-        # scheduler runtime-errors -> missing) from Python-only rejects
-        # of valid RE2 (e.g. RE2's \z) — guessing either way can
-        # silently diverge, so fail loud like any unsupported construct.
-        raise CelUnsupportedError(
-            f"matches() pattern {pattern!r} does not compile here "
-            f"({e}); cannot faithfully mirror the RE2 verdict") from e
+    """Dynamic-pattern matches(): validates through the same
+    ``_check_re2_pattern`` the compiler uses for literal patterns, so
+    the two paths can never drift apart in messages or verdicts."""
     # CEL matches() is an UNANCHORED partial match (re.search semantics)
-    return compiled.search(s) is not None
+    return _check_re2_pattern(pattern).search(s) is not None
 
 
 def _type_tag(v: Any) -> str:
@@ -314,12 +317,149 @@ def _tokenize(src: str) -> List[_Tok]:
 Resolver = Callable[[str, str, str], Any]
 
 
-class _Parser:
-    def __init__(self, toks: List[_Tok], resolver: Resolver):
+class _Env:
+    """Per-evaluation state threaded through the compiled closure tree:
+    the device resolver plus macro-variable bindings. One fresh instance
+    per ``CompiledSelector.evaluate`` call, so a compiled selector is
+    safe to share across threads and devices."""
+
+    __slots__ = ("resolve", "locals")
+
+    def __init__(self, resolve: Resolver):
+        self.resolve = resolve
+        self.locals: dict = {}
+
+
+def _const(value: Any):
+    """A constant node. The ``const``/``value`` attributes let the
+    compiler see through it (literal-pattern precompilation for
+    ``matches()``, static ``in`` lists)."""
+    def node(env: _Env, _v=value) -> Any:
+        return _v
+    node.const = True
+    node.value = value
+    return node
+
+
+def _boolish(val: Any) -> Any:
+    """True / False / _MISSING; anything else is a type error."""
+    if val is _MISSING or isinstance(val, bool):
+        return val
+    raise CelEvalError(f"expected boolean, got {val!r}")
+
+
+def _compare(op: str, lhs: Any, rhs: Any) -> Any:
+    if lhs is _MISSING or rhs is _MISSING:
+        # a CEL runtime error (missing map key) propagates through
+        # every comparison, != included
+        return _MISSING
+    if op == "==":
+        return _hetero_eq(lhs, rhs)
+    if op == "!=":
+        return not _hetero_eq(lhs, rhs)
+    if isinstance(lhs, Quantity) or isinstance(rhs, Quantity):
+        # the real CEL environment has no ordered-operator overloads
+        # for quantity — matching here and type-erroring on the real
+        # scheduler would be the worst outcome
+        raise CelUnsupportedError(
+            f"ordered operators are not defined on quantities "
+            f"({lhs!r} {op} {rhs!r}); use "
+            f".compareTo(quantity(\"...\")) or .isGreaterThan(...)")
+    int_pair = (isinstance(lhs, int) and not isinstance(lhs, bool)
+                and isinstance(rhs, int) and not isinstance(rhs, bool))
+    str_pair = isinstance(lhs, str) and isinstance(rhs, str)
+    if not (int_pair or str_pair):
+        # CEL defines < <= > >= on int/int and string/string
+        # (lexicographic); a mixed pair is a real-scheduler type error
+        raise CelUnsupportedError(
+            f"ordered comparison needs two ints or two strings, "
+            f"got {lhs!r} {op} {rhs!r}")
+    return {"<": lhs < rhs, "<=": lhs <= rhs,
+            ">": lhs > rhs, ">=": lhs >= rhs}[op]
+
+
+def _arith(op: str, lhs: Any, rhs: Any) -> Any:
+    if lhs is _MISSING or rhs is _MISSING:
+        return _MISSING
+    if op == "+" and isinstance(lhs, str) and isinstance(rhs, str):
+        return lhs + rhs
+    int_pair = (isinstance(lhs, int) and not isinstance(lhs, bool)
+                and isinstance(rhs, int) and not isinstance(rhs, bool))
+    if not int_pair:
+        # the k8s CEL environment defines arithmetic on int/int
+        # (and + on string/string); anything else is a type error
+        raise CelUnsupportedError(
+            f"arithmetic needs two ints (or + on two strings), "
+            f"got {lhs!r} {op} {rhs!r}")
+    if op == "+":
+        return _int64_or_error(lhs + rhs)
+    if op == "-":
+        return _int64_or_error(lhs - rhs)
+    if op == "*":
+        return _int64_or_error(lhs * rhs)
+    if rhs == 0:
+        return _MISSING      # CEL runtime error: division by zero
+    # CEL (Go) semantics: division truncates toward zero and the
+    # modulo's sign follows the dividend — Python's floor division
+    # differs on negatives
+    q = abs(lhs) // abs(rhs)
+    if (lhs < 0) != (rhs < 0):
+        q = -q
+    # -2^63 / -1 overflows int64 (the one division overflow)
+    return _int64_or_error(q if op == "/" else lhs - q * rhs)
+
+
+def _call_method_value(val: Any, method: str, args: List[Any]) -> Any:
+    """Dynamic (value-dependent) half of a method call. Method existence
+    and arity were already validated at compile time; what remains is
+    exactly the checks whose outcome depends on per-device values —
+    their order (missing-propagation BEFORE receiver/argument type
+    checks) is the one-pass evaluator's, preserved bit-for-bit."""
+    if method == "size":               # receiver form: x.size()
+        return _cel_size(val)
+    if val is _MISSING or any(a is _MISSING for a in args):
+        return _MISSING
+    if method in _STR_METHODS:
+        if not isinstance(val, str):
+            raise CelUnsupportedError(
+                f".{method}() is a string method; receiver is {val!r}")
+        if not isinstance(args[0], str):
+            raise CelUnsupportedError(
+                f".{method}() takes a string argument, got {args[0]!r}")
+        if method == "startsWith":
+            return val.startswith(args[0])
+        if method == "endsWith":
+            return val.endswith(args[0])
+        if method == "contains":
+            return args[0] in val
+        return _cel_matches(val, args[0])
+    if not isinstance(val, Quantity):
+        raise CelUnsupportedError(
+            f".{method}() is a quantity method; receiver is {val!r}")
+    return getattr(val, method)(*args)
+
+
+class _Compiler:
+    """Recursive-descent compiler: tokens -> a closure tree.
+
+    The grammar and error surface are the former one-pass evaluator's,
+    split along the compile/evaluate seam: anything value-INDEPENDENT
+    (syntax, int64 literal overflow, quantity() literal parsing, macro
+    variable validation, method existence/arity, literal regex patterns)
+    raises here at compile time, so a bad expression costs one cached
+    error instead of one error per device; anything value-DEPENDENT
+    (missing propagation, receiver/operand type errors, division by
+    zero, arithmetic overflow) lives inside the returned closures and
+    still surfaces per device with identical messages.
+
+    ``scope`` is the compile-time set of macro-bound variable names; at
+    evaluation time the bindings live in ``_Env.locals``.
+    """
+
+    def __init__(self, toks: List[_Tok]):
         self.toks = toks
         self.i = 0
-        self.resolve = resolver
-        self.locals: dict = {}   # macro-bound variables (exists/all)
+        self.scope: set = set()   # macro-bound variables (exists/all)
 
     def peek(self) -> Optional[_Tok]:
         return self.toks[self.i] if self.i < len(self.toks) else None
@@ -338,44 +478,53 @@ class _Parser:
 
     # -- grammar -----------------------------------------------------------
 
-    def parse(self) -> Any:
-        val = self.or_expr()
+    def compile(self):
+        fn = self.or_expr()
         if self.peek() is not None:
             raise CelUnsupportedError(
                 f"trailing tokens from {self.peek().value!r}")
-        return val
+        return fn
 
-    def or_expr(self) -> Any:
-        val = self.and_expr()
+    def or_expr(self):
+        fn = self.and_expr()
         while self._at_op("||"):
             self.next()
-            rhs = self.and_expr()   # evaluation is pure; combine after
-            # CEL's commutative ||: true absorbs an error on either side
-            a, b = self._boolish(val), self._boolish(rhs)
-            if a is True or b is True:
-                val = True
-            elif a is _MISSING or b is _MISSING:
-                val = _MISSING
-            else:
-                val = False
-        return val
+            rhs = self.and_expr()
+            lhs = fn
 
-    def and_expr(self) -> Any:
-        val = self.cmp()
+            # CEL's commutative ||: true absorbs an error on either
+            # side. Both sides evaluate (the one-pass evaluator had no
+            # short-circuit either — a type error on the right must
+            # surface even when the left is true).
+            def node(env: _Env, _l=lhs, _r=rhs) -> Any:
+                a, b = _boolish(_l(env)), _boolish(_r(env))
+                if a is True or b is True:
+                    return True
+                if a is _MISSING or b is _MISSING:
+                    return _MISSING
+                return False
+            fn = node
+        return fn
+
+    def and_expr(self):
+        fn = self.cmp()
         while self._at_op("&&"):
             self.next()
             rhs = self.cmp()
-            # CEL's commutative &&: false absorbs an error on either side
-            a, b = self._boolish(val), self._boolish(rhs)
-            if a is False or b is False:
-                val = False
-            elif a is _MISSING or b is _MISSING:
-                val = _MISSING
-            else:
-                val = True
-        return val
+            lhs = fn
 
-    def cmp(self) -> Any:
+            # CEL's commutative &&: false absorbs an error on either side
+            def node(env: _Env, _l=lhs, _r=rhs) -> Any:
+                a, b = _boolish(_l(env)), _boolish(_r(env))
+                if a is False or b is False:
+                    return False
+                if a is _MISSING or b is _MISSING:
+                    return _MISSING
+                return True
+            fn = node
+        return fn
+
+    def cmp(self):
         # ``!`` lives INSIDE the comparison operands (CEL precedence:
         # ``!a == b`` is ``(!a) == b``, not ``!(a == b)``)
         lhs = self.sum()
@@ -385,39 +534,56 @@ class _Parser:
         if tok.kind == "op" and tok.value in ("==", "!=", ">", "<", ">=", "<="):
             op = self.next().value
             rhs = self.sum()
-            return self._compare(op, lhs, rhs)
+
+            def node(env: _Env, _op=op, _l=lhs, _r=rhs) -> Any:
+                return _compare(_op, _l(env), _r(env))
+            return node
         if tok.kind == "ident" and tok.value == "in":
             self.next()
-            items = self.list_literal()
-            if lhs is _MISSING:
-                return _MISSING
-            return any(_hetero_eq(lhs, item) for item in items)
+            items = self.list_literal()      # static: literals only
+
+            def node(env: _Env, _l=lhs, _items=items) -> Any:
+                v = _l(env)
+                if v is _MISSING:
+                    return _MISSING
+                return any(_hetero_eq(v, item) for item in _items)
+            return node
         return lhs
 
-    def sum(self) -> Any:
+    def sum(self):
         """Additive arithmetic: int+int / int-int, and CEL's string
         concatenation for +. Binds tighter than comparisons, looser
         than * / %."""
-        val = self.term()
+        fn = self.term()
         while self._at_op("+") or self._at_op("-"):
             op = self.next().value
             rhs = self.term()
-            val = self._arith(op, val, rhs)
-        return val
+            fn = self._arith_node(op, fn, rhs)
+        return fn
 
-    def term(self) -> Any:
-        val = self.unary_operand()
+    def term(self):
+        fn = self.unary_operand()
         while self._at_op("*") or self._at_op("/") or self._at_op("%"):
             op = self.next().value
             rhs = self.unary_operand()
-            val = self._arith(op, val, rhs)
-        return val
+            fn = self._arith_node(op, fn, rhs)
+        return fn
 
-    def unary_operand(self) -> Any:
+    @staticmethod
+    def _arith_node(op: str, lhs, rhs):
+        def node(env: _Env, _op=op, _l=lhs, _r=rhs) -> Any:
+            return _arith(_op, _l(env), _r(env))
+        return node
+
+    def unary_operand(self):
         if self._at_op("!"):
             self.next()
-            val = self._boolish(self.unary_operand())
-            return _MISSING if val is _MISSING else not val
+            inner = self.unary_operand()
+
+            def node(env: _Env, _i=inner) -> Any:
+                val = _boolish(_i(env))
+                return _MISSING if val is _MISSING else not val
+            return node
         if self._at_op("-"):
             self.next()
             # cel-go folds the minus into an int literal, which is how
@@ -427,21 +593,25 @@ class _Parser:
             if (nxt is not None and nxt.kind == "int"
                     and nxt.value == -_INT64_MIN):
                 self.next()
-                return _INT64_MIN
-            val = self.unary_operand()
-            if val is _MISSING:
-                return _MISSING
-            if not isinstance(val, int) or isinstance(val, bool):
-                raise CelUnsupportedError(f"unary - needs an int, "
-                                          f"got {val!r}")
-            return _int64_or_error(-val)
+                return _const(_INT64_MIN)
+            inner = self.unary_operand()
+
+            def node(env: _Env, _i=inner) -> Any:
+                val = _i(env)
+                if val is _MISSING:
+                    return _MISSING
+                if not isinstance(val, int) or isinstance(val, bool):
+                    raise CelUnsupportedError(f"unary - needs an int, "
+                                              f"got {val!r}")
+                return _int64_or_error(-val)
+            return node
         return self.postfix()
 
-    def postfix(self) -> Any:
+    def postfix(self):
         """An operand with any trailing ``.method(args)`` calls (the
         quantity/string library surfaces) or ``.exists(v, p)`` /
         ``.all(v, p)`` macros."""
-        val = self.operand()
+        fn = self.operand()
         while (self._at_op(".")
                and self.i + 1 < len(self.toks)
                and self.toks[self.i + 1].kind == "ident"
@@ -451,7 +621,7 @@ class _Parser:
             method = self.next().value       # ident
             self.expect_op("(")
             if method in ("exists", "all"):
-                val = self._macro(method, val)
+                fn = self._macro(method, fn)
                 self.expect_op(")")
                 continue
             args: List[Any] = []
@@ -461,24 +631,59 @@ class _Parser:
                     self.next()
                     args.append(self.or_expr())
             self.expect_op(")")
-            val = self._call_method(val, method, args)
-        return val
+            fn = self._method_node(fn, method, args)
+        return fn
 
-    def _macro(self, name: str, receiver: Any) -> Any:
-        """CEL comprehension macros over list literals: the parser is a
-        one-pass evaluator, so the predicate's token span is re-parsed
-        once per element with the bound variable in ``locals``. CEL
-        aggregation semantics: ``exists`` = logical OR with error
-        absorption (any true wins, else error if any erred), ``all`` =
-        the dual."""
-        if not isinstance(receiver, list):
-            raise CelUnsupportedError(
-                f".{name}() macro needs a list receiver, got {receiver!r}")
+    def _method_node(self, recv, method: str, args: List[Any]):
+        # method existence and arity are value-independent: compile
+        # errors now (identical messages), cached as errors
+        if method == "size":               # receiver form: x.size()
+            if args:
+                raise CelUnsupportedError(".size() takes no arguments")
+        else:
+            arity = _QTY_METHODS.get(method, _STR_METHODS.get(method))
+            if arity is None:
+                raise CelUnsupportedError(f"unsupported method .{method}()")
+            if len(args) != arity:
+                raise CelUnsupportedError(
+                    f".{method}() takes {arity} argument(s), got {len(args)}")
+        if (method == "matches" and getattr(args[0], "const", False)
+                and isinstance(args[0].value, str)):
+            # literal pattern: validate + precompile ONCE at compile
+            # time (a non-RE2 or non-compiling pattern is a cached
+            # compile error, not one error per device) — the compiled
+            # regex is also the per-device evaluation fast path
+            compiled_re = _check_re2_pattern(args[0].value)
+
+            def node(env: _Env, _recv=recv, _re=compiled_re) -> Any:
+                val = _recv(env)
+                if val is _MISSING:
+                    return _MISSING
+                if not isinstance(val, str):
+                    raise CelUnsupportedError(
+                        f".matches() is a string method; receiver is {val!r}")
+                # CEL matches() is an UNANCHORED partial match
+                return _re.search(val) is not None
+            return node
+
+        def node(env: _Env, _recv=recv, _method=method, _args=args) -> Any:
+            return _call_method_value(
+                _recv(env), _method, [a(env) for a in _args])
+        return node
+
+    def _macro(self, name: str, recv):
+        """CEL comprehension macros over list literals: the predicate is
+        compiled ONCE with the variable in compile scope; evaluation
+        binds each element into ``env.locals`` and re-walks the compiled
+        predicate (the former one-pass evaluator re-PARSED the token
+        span per element). CEL aggregation semantics: ``exists`` =
+        logical OR with error absorption (any true wins, else error if
+        any erred), ``all`` = the dual."""
         var = self.next()
         if var.kind != "ident":
             raise CelUnsupportedError(
                 f".{name}() takes a variable name, got {var.value!r}")
-        if var.value in self.locals:
+        if var.value in self.scope:
             raise CelUnsupportedError(
                 f".{name}() variable {var.value!r} shadows an outer "
                 f"macro variable")
@@ -487,119 +692,75 @@ class _Parser:
             raise CelUnsupportedError(
                 f".{name}() variable {var.value!r} shadows a reserved name")
         self.expect_op(",")
-        start = self.i
-        results: List[Any] = []
-        # empty list: the predicate still has to be consumed (never
-        # observed in CEL; a MISSING binding keeps evaluation inert)
-        for elem in (receiver or [_MISSING]):
-            self.i = start
-            self.locals[var.value] = elem
-            try:
-                results.append(self._boolish(self.or_expr()))
-            finally:
-                del self.locals[var.value]
-        if not receiver:
-            return name == "all"
-        if name == "exists":
-            if any(r is True for r in results):
-                return True
-            return _MISSING if any(r is _MISSING for r in results) else False
-        if any(r is False for r in results):
-            return False
-        return _MISSING if any(r is _MISSING for r in results) else True
+        varname = var.value
+        self.scope.add(varname)
+        try:
+            pred = self.or_expr()
+        finally:
+            self.scope.discard(varname)
 
-    @staticmethod
-    def _arith(op: str, lhs: Any, rhs: Any) -> Any:
-        if lhs is _MISSING or rhs is _MISSING:
-            return _MISSING
-        if op == "+" and isinstance(lhs, str) and isinstance(rhs, str):
-            return lhs + rhs
-        int_pair = (isinstance(lhs, int) and not isinstance(lhs, bool)
-                    and isinstance(rhs, int) and not isinstance(rhs, bool))
-        if not int_pair:
-            # the k8s CEL environment defines arithmetic on int/int
-            # (and + on string/string); anything else is a type error
-            raise CelUnsupportedError(
-                f"arithmetic needs two ints (or + on two strings), "
-                f"got {lhs!r} {op} {rhs!r}")
-        if op == "+":
-            return _int64_or_error(lhs + rhs)
-        if op == "-":
-            return _int64_or_error(lhs - rhs)
-        if op == "*":
-            return _int64_or_error(lhs * rhs)
-        if rhs == 0:
-            return _MISSING      # CEL runtime error: division by zero
-        # CEL (Go) semantics: division truncates toward zero and the
-        # modulo's sign follows the dividend — Python's floor division
-        # differs on negatives
-        q = abs(lhs) // abs(rhs)
-        if (lhs < 0) != (rhs < 0):
-            q = -q
-        # -2^63 / -1 overflows int64 (the one division overflow)
-        return _int64_or_error(q if op == "/" else lhs - q * rhs)
-
-    def _call_method(self, val: Any, method: str, args: List[Any]) -> Any:
-        if method == "size":               # receiver form: x.size()
-            if args:
-                raise CelUnsupportedError(".size() takes no arguments")
-            return _cel_size(val)
-        arity = _QTY_METHODS.get(method, _STR_METHODS.get(method))
-        if arity is None:
-            raise CelUnsupportedError(f"unsupported method .{method}()")
-        if len(args) != arity:
-            raise CelUnsupportedError(
-                f".{method}() takes {arity} argument(s), got {len(args)}")
-        if val is _MISSING or any(a is _MISSING for a in args):
-            return _MISSING
-        if method in _STR_METHODS:
-            if not isinstance(val, str):
+        def node(env: _Env, _recv=recv, _name=name, _var=varname,
+                 _pred=pred) -> Any:
+            receiver = _recv(env)
+            if not isinstance(receiver, list):
                 raise CelUnsupportedError(
-                    f".{method}() is a string method; receiver is {val!r}")
-            if not isinstance(args[0], str):
-                raise CelUnsupportedError(
-                    f".{method}() takes a string argument, got {args[0]!r}")
-            if method == "startsWith":
-                return val.startswith(args[0])
-            if method == "endsWith":
-                return val.endswith(args[0])
-            if method == "contains":
-                return args[0] in val
-            return _cel_matches(val, args[0])
-        if not isinstance(val, Quantity):
-            raise CelUnsupportedError(
-                f".{method}() is a quantity method; receiver is {val!r}")
-        return getattr(val, method)(*args)
+                    f".{_name}() macro needs a list receiver, "
+                    f"got {receiver!r}")
+            results: List[Any] = []
+            # empty list: the predicate still evaluates once (matching
+            # the one-pass evaluator, which had to consume its tokens;
+            # a MISSING binding keeps evaluation inert) so its
+            # value-independent type errors surface identically
+            for elem in (receiver or [_MISSING]):
+                env.locals[_var] = elem
+                try:
+                    results.append(_boolish(_pred(env)))
+                finally:
+                    del env.locals[_var]
+            if not receiver:
+                return _name == "all"
+            if _name == "exists":
+                if any(r is True for r in results):
+                    return True
+                return (_MISSING if any(r is _MISSING for r in results)
+                        else False)
+            if any(r is False for r in results):
+                return False
+            return _MISSING if any(r is _MISSING for r in results) else True
+        return node
 
-    def operand(self) -> Any:
+    def operand(self):
         tok = self.peek()
         if tok is None:
             raise CelUnsupportedError("unexpected end of expression")
         if tok.kind == "op" and tok.value == "(":
             self.next()
-            val = self.or_expr()
+            fn = self.or_expr()
             self.expect_op(")")
-            return val
+            return fn
         if tok.kind == "op" and tok.value == "[":
-            return self.list_literal()       # a list operand (macros)
+            return _const(self.list_literal())   # a list operand (macros)
         if tok.kind in ("str", "int"):
             if tok.kind == "int" and tok.value > _INT64_MAX:
                 # int literal overflow is a COMPILE error in cel-go
                 raise CelUnsupportedError(
                     f"int literal {tok.value} exceeds int64")
-            return self.next().value
+            return _const(self.next().value)
         if tok.kind == "ident":
             if tok.value == "true":
                 self.next()
-                return True
+                return _const(True)
             if tok.value == "false":
                 self.next()
-                return False
+                return _const(False)
             if tok.value == "device":
                 return self.device_path()
-            if tok.value in self.locals:
+            if tok.value in self.scope:
                 self.next()
-                return self.locals[tok.value]
+
+                def node(env: _Env, _n=tok.value) -> Any:
+                    return env.locals[_n]
+                return node
             if tok.value == "quantity":
                 self.next()
                 self.expect_op("(")
@@ -609,13 +770,19 @@ class _Parser:
                         f"quantity() takes a string literal, got "
                         f"{arg.value!r}")
                 self.expect_op(")")
-                return Quantity(arg.value)
+                # literal argument: parse at compile time, so an invalid
+                # quantity is a cached compile error (same message the
+                # one-pass evaluator raised mid-parse)
+                return _const(Quantity(arg.value))
             if tok.value == "size":
                 self.next()
                 self.expect_op("(")
                 arg = self.or_expr()
                 self.expect_op(")")
-                return _cel_size(arg)
+
+                def node(env: _Env, _a=arg) -> Any:
+                    return _cel_size(_a(env))
+                return node
             if tok.value == "has":
                 # the cel-spec presence macro: has(device.attributes[d].a)
                 # is the ONE construct where a missing FINAL field yields
@@ -630,15 +797,19 @@ class _Parser:
                         and tok2.value == "device"):
                     raise CelUnsupportedError(
                         "has() takes a device.attributes/capacity path")
-                val = self.device_path(raw=True)
+                path = self.device_path(raw=True)
                 self.expect_op(")")
-                if val is MISSING_DOMAIN:
-                    return _MISSING
-                return val is not _MISSING
+
+                def node(env: _Env, _p=path) -> Any:
+                    val = _p(env)
+                    if val is MISSING_DOMAIN:
+                        return _MISSING
+                    return val is not _MISSING
+                return node
             raise CelUnsupportedError(f"unsupported identifier {tok.value!r}")
         raise CelUnsupportedError(f"unsupported token {tok.value!r}")
 
-    def device_path(self, raw: bool = False) -> Any:
+    def device_path(self, raw: bool = False):
         """``raw=True`` (the has() macro) preserves the MISSING_DOMAIN
         sentinel; normal evaluation collapses it to missing — the two
         only differ under has()."""
@@ -649,7 +820,9 @@ class _Parser:
             raise CelUnsupportedError(f"expected field after device., got "
                                       f"{field.value!r}")
         if field.value == "driver":
-            return self.resolve("driver", "", "")
+            def node(env: _Env) -> Any:
+                return env.resolve("driver", "", "")
+            return node
         if field.value in ("attributes", "capacity"):
             self.expect_op("[")
             domain = self.next()
@@ -663,10 +836,14 @@ class _Parser:
             if name.kind != "ident":
                 raise CelUnsupportedError(
                     f"expected attribute name, got {name.value!r}")
-            val = self.resolve(field.value, domain.value, name.value)
-            if val is MISSING_DOMAIN and not raw:
-                return _MISSING
-            return val
+
+            def node(env: _Env, _s=field.value, _d=domain.value,
+                     _n=name.value, _raw=raw) -> Any:
+                val = env.resolve(_s, _d, _n)
+                if val is MISSING_DOMAIN and not _raw:
+                    return _MISSING
+                return val
+            return node
         raise CelUnsupportedError(f"unsupported device field "
                                   f"{field.value!r}")
 
@@ -710,52 +887,138 @@ class _Parser:
         tok = self.peek()
         return tok is not None and tok.kind == "op" and tok.value == op
 
-    @staticmethod
-    def _boolish(val: Any) -> Any:
-        """True / False / _MISSING; anything else is a type error."""
-        if val is _MISSING or isinstance(val, bool):
-            return val
-        raise CelEvalError(f"expected boolean, got {val!r}")
 
-    @staticmethod
-    def _compare(op: str, lhs: Any, rhs: Any) -> Any:
-        if lhs is _MISSING or rhs is _MISSING:
-            # a CEL runtime error (missing map key) propagates through
-            # every comparison, != included
-            return _MISSING
-        if op == "==":
-            return _hetero_eq(lhs, rhs)
-        if op == "!=":
-            return not _hetero_eq(lhs, rhs)
-        if isinstance(lhs, Quantity) or isinstance(rhs, Quantity):
-            # the real CEL environment has no ordered-operator overloads
-            # for quantity — matching here and type-erroring on the real
-            # scheduler would be the worst outcome
-            raise CelUnsupportedError(
-                f"ordered operators are not defined on quantities "
-                f"({lhs!r} {op} {rhs!r}); use "
-                f".compareTo(quantity(\"...\")) or .isGreaterThan(...)")
-        int_pair = (isinstance(lhs, int) and not isinstance(lhs, bool)
-                    and isinstance(rhs, int) and not isinstance(rhs, bool))
-        str_pair = isinstance(lhs, str) and isinstance(rhs, str)
-        if not (int_pair or str_pair):
-            # CEL defines < <= > >= on int/int and string/string
-            # (lexicographic); a mixed pair is a real-scheduler type error
-            raise CelUnsupportedError(
-                f"ordered comparison needs two ints or two strings, "
-                f"got {lhs!r} {op} {rhs!r}")
-        return {"<": lhs < rhs, "<=": lhs <= rhs,
-                ">": lhs > rhs, ">=": lhs >= rhs}[op]
+def _check_re2_pattern(pattern: str):
+    """The single matches() pattern validator, shared by the compiler
+    (literal patterns: raised once at compile, cached as a compile
+    error) and ``_cel_matches`` (dynamic patterns: raised per device).
+    Returns the compiled regex on success."""
+    if _NON_RE2_RE.search(pattern):
+        raise CelUnsupportedError(
+            f"matches() pattern {pattern!r} uses regex constructs RE2 "
+            f"(the real CEL regex engine) rejects — backreferences, "
+            f"lookaround, atomic/conditional groups, or possessive "
+            f"quantifiers")
+    try:
+        return re.compile(pattern)
+    except re.error as e:
+        # Without an RE2 engine we cannot tell invalid-in-both (real
+        # scheduler runtime-errors -> missing) from Python-only rejects
+        # of valid RE2 (e.g. RE2's \z) — guessing either way can
+        # silently diverge, so fail loud like any unsupported construct.
+        raise CelUnsupportedError(
+            f"matches() pattern {pattern!r} does not compile here "
+            f"({e}); cannot faithfully mirror the RE2 verdict") from e
+
+
+class CompiledSelector:
+    """A selector compiled to a closure tree: parse once, evaluate per
+    device. Stateless across evaluations (every evaluate() gets a fresh
+    ``_Env``), so one instance can serve every device of every request
+    concurrently."""
+
+    __slots__ = ("expression", "_fn")
+
+    def __init__(self, expression: str, fn):
+        self.expression = expression
+        self._fn = fn
+
+    def evaluate(self, resolver: Resolver) -> bool:
+        """Evaluate against one device. Raises CelUnsupportedError
+        (value-dependent construct outside the subset) or CelEvalError
+        (non-boolean result)."""
+        result = self._fn(_Env(resolver))
+        if result is _MISSING:
+            return False
+        if not isinstance(result, bool):
+            raise CelEvalError(
+                f"selector evaluated to non-boolean {result!r}")
+        return result
+
+    def __repr__(self) -> str:
+        return f"CompiledSelector({self.expression!r})"
+
+
+# ---------------------------------------------------------------------------
+# Bounded compile cache. The allocator evaluates the SAME selector text
+# against every candidate device of every request; keying on expression
+# text (the resolver stays per-device, passed at evaluate time) makes
+# the hot loop one parse per expression instead of one per device.
+# Compile errors are cached AS errors: a selector that failed to compile
+# re-raises the same error type/message on every hit without reparsing.
+# ---------------------------------------------------------------------------
+
+COMPILE_CACHE_MAXSIZE = 256
+
+_compile_cache: "OrderedDict[str, Any]" = OrderedDict()
+_compile_cache_mu = threading.Lock()
+
+
+def _compile_uncached(expression: str) -> CompiledSelector:
+    return CompiledSelector(expression,
+                            _Compiler(_tokenize(expression)).compile())
+
+
+def compile_selector(expression: str, cached: bool = True) -> CompiledSelector:
+    """Compile a selector, through the bounded LRU cache by default.
+    Raises CelUnsupportedError/CelEvalError for expressions outside the
+    subset — identically on cache hit and miss. ``cached=False``
+    bypasses the cache entirely (benchmarking the reparse cost)."""
+    if not cached:
+        return _compile_uncached(expression)
+    with _compile_cache_mu:
+        entry = _compile_cache.get(expression)
+        if entry is not None:
+            _compile_cache.move_to_end(expression)
+    if entry is not None:
+        _metrics.CEL_COMPILE_CACHE_HITS.inc()
+        if isinstance(entry, Exception):
+            # a fresh instance (same type, same args => same message):
+            # re-raising the cached object would accrete tracebacks
+            raise type(entry)(*entry.args)
+        return entry
+    _metrics.CEL_COMPILE_CACHE_MISSES.inc()
+    try:
+        compiled: Any = _compile_uncached(expression)
+    except (CelUnsupportedError, CelEvalError) as e:
+        _cache_store(expression, e)
+        raise
+    _cache_store(expression, compiled)
+    return compiled
+
+
+def _cache_store(expression: str, entry: Any) -> None:
+    with _compile_cache_mu:
+        _compile_cache[expression] = entry
+        _compile_cache.move_to_end(expression)
+        while len(_compile_cache) > COMPILE_CACHE_MAXSIZE:
+            _compile_cache.popitem(last=False)
+            _metrics.CEL_COMPILE_CACHE_EVICTIONS.inc()
+
+
+def clear_compile_cache() -> None:
+    """Drop every cached compilation (tests and benchmarks)."""
+    with _compile_cache_mu:
+        _compile_cache.clear()
+
+
+def compile_cache_info() -> dict:
+    """Introspection for tests/benchmarks: current size, bound, and the
+    process-lifetime hit/miss/eviction counter values."""
+    with _compile_cache_mu:
+        size = len(_compile_cache)
+    return {
+        "size": size,
+        "maxsize": COMPILE_CACHE_MAXSIZE,
+        "hits": _metrics.CEL_COMPILE_CACHE_HITS.value,
+        "misses": _metrics.CEL_COMPILE_CACHE_MISSES.value,
+        "evictions": _metrics.CEL_COMPILE_CACHE_EVICTIONS.value,
+    }
 
 
 def evaluate(expression: str, resolver: Resolver) -> bool:
-    """Evaluate a selector expression to a boolean. Raises
-    CelUnsupportedError (construct outside the subset) or CelEvalError
-    (non-boolean result)."""
-    result = _Parser(_tokenize(expression), resolver).parse()
-    if result is _MISSING:
-        return False
-    if not isinstance(result, bool):
-        raise CelEvalError(
-            f"selector evaluated to non-boolean {result!r}")
-    return result
+    """Evaluate a selector expression to a boolean, compiling through
+    the bounded LRU cache. Raises CelUnsupportedError (construct outside
+    the subset) or CelEvalError (non-boolean result) — compile errors
+    identically on cache hit and miss."""
+    return compile_selector(expression).evaluate(resolver)

@@ -325,6 +325,42 @@ class Registry:
 DEFAULT_REGISTRY = Registry()
 
 
+# ---------------------------------------------------------------------------
+# Claim-to-ready fast-path instrumentation. One shared set of families so
+# the CEL compile cache (kube/cel.py), the checkpoint writer
+# (plugin/checkpoint.py), and the group-commit prepare path
+# (plugin/device_state.py, plugin/driver.py) all land in the same scrape —
+# these counters are also the proof surface for the fast-path invariants
+# (1 parse per expression per batch, 2 fsync-bearing checkpoint writes per
+# prepared batch) asserted by tests/test_claim_fast_path.py.
+# ---------------------------------------------------------------------------
+
+CEL_COMPILE_CACHE_HITS = DEFAULT_REGISTRY.counter(
+    "dra_cel_compile_cache_hits_total",
+    "Selector compile-cache hits (expression reused without reparsing)")
+CEL_COMPILE_CACHE_MISSES = DEFAULT_REGISTRY.counter(
+    "dra_cel_compile_cache_misses_total",
+    "Selector compile-cache misses (tokenize+parse actually ran)")
+CEL_COMPILE_CACHE_EVICTIONS = DEFAULT_REGISTRY.counter(
+    "dra_cel_compile_cache_evictions_total",
+    "Compiled selectors evicted from the bounded LRU compile cache")
+CHECKPOINT_WRITES = DEFAULT_REGISTRY.counter(
+    "dra_checkpoint_writes_total",
+    "Checkpoint file writes; each is one fsync-bearing atomic replace")
+PREPARE_BATCH_PHASE_SECONDS = DEFAULT_REGISTRY.histogram(
+    "dra_prepare_batch_phase_seconds",
+    "Group-commit prepare wall time by phase for one kubelet batch",
+    ("phase",))
+PREPARE_BATCH_CLAIMS = DEFAULT_REGISTRY.histogram(
+    "dra_prepare_batch_claims",
+    "Claims per NodePrepareResources group-commit batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+UNPREPARE_BATCH_CLAIMS = DEFAULT_REGISTRY.histogram(
+    "dra_unprepare_batch_claims",
+    "Claims per NodeUnprepareResources batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+
+
 class QueueMetrics:
     """client-go workqueue metric set for one named queue.
 

@@ -29,6 +29,8 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List
 
+from tpu_dra_driver.pkg import metrics as _metrics
+
 # Claim prepare states (reference device_state.go:231-283)
 PREPARE_STARTED = "PrepareStarted"
 PREPARE_COMPLETED = "PrepareCompleted"
@@ -141,8 +143,22 @@ class Checkpoint:
         return out
 
 
+def _canonical(payload) -> str:
+    """The checksum-canonical serialization of a version payload.
+
+    This exact form (sort_keys, default separators) is a compatibility
+    contract: every reader ever shipped — including downgraded ones —
+    verifies a version by re-serializing the parsed payload this way and
+    crc32'ing it. Fully compact separators would shrink the file a bit
+    further but would invalidate every stored checksum for old readers
+    (and vice versa), so the payload bytes stay canonical; the byte win
+    comes from writing each payload flat exactly once instead of
+    pretty-printing the whole envelope with indent=1."""
+    return json.dumps(payload, sort_keys=True)
+
+
 def _crc(payload) -> int:
-    return zlib.crc32(json.dumps(payload, sort_keys=True).encode())
+    return zlib.crc32(_canonical(payload).encode())
 
 
 class CheckpointManager:
@@ -208,10 +224,23 @@ class CheckpointManager:
                 if e.state == PREPARE_COMPLETED
             }
         }
-        raw = {"v1": v1, "v2": v2, "checksums": {"v1": _crc(v1), "v2": _crc(v2)}}
+        # Serialize each version payload exactly ONCE: the same bytes
+        # are checksummed and spliced verbatim into the envelope (the
+        # old path serialized every payload twice — once in _crc, once
+        # inside json.dump — and pretty-printed with indent=1, paying
+        # ~40% more bytes per fsync). The envelope keeps a readable
+        # top level: one line per section.
+        v1_s = _canonical(v1)
+        v2_s = _canonical(v2)
+        checksums = json.dumps(
+            {"v1": zlib.crc32(v1_s.encode()), "v2": zlib.crc32(v2_s.encode())},
+            separators=(",", ":"))
+        body = (f'{{\n"checksums": {checksums},\n'
+                f'"v1": {v1_s},\n"v2": {v2_s}\n}}\n')
         tmp = f"{self._path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump(raw, f, indent=1, sort_keys=True)
+            f.write(body)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
+        _metrics.CHECKPOINT_WRITES.inc()

@@ -74,6 +74,15 @@ class ClaimInfo:
             configs=list(devices.get("config") or []),
         )
 
+    @staticmethod
+    def from_objs(objs: List[Dict],
+                  driver_name: str = DRIVER_NAME) -> List["ClaimInfo"]:
+        """Batch form of :meth:`from_obj`: one kubelet
+        NodePrepareResources call decodes every claim up front, so the
+        group-commit prepare path can take the whole batch under a
+        single lock acquisition."""
+        return [ClaimInfo.from_obj(obj, driver_name) for obj in objs]
+
 
 @dataclass
 class ResolvedConfig:

@@ -2,9 +2,13 @@
 
 Reference analog: cmd/gpu-kubelet-plugin/driver.go — startup order
 (driver.go:66-173), node-global prepare/unprepare flock (``pu.lock``, 10 s
-timeout, driver.go:341), per-claim prepare with timing breadcrumbs
+timeout, driver.go:341), prepare with timing breadcrumbs
 (driver.go:334-386), health-event → republish-without-device
 (driver.go:441-505), and the gRPC healthcheck self-probe (health.go).
+Deliberate divergence: where the reference loops claims serially inside
+NodePrepareResources, this driver group-commits the batch (one flock
+acquisition + two checkpoint fsyncs per batch; see PARITY.md
+"Claim-to-ready fast path").
 
 The kubelet-facing transport (DRA plugin gRPC on ``dra.sock``) is provided
 by :mod:`tpu_dra_driver.plugin.grpc_server`; this class is the
@@ -29,7 +33,7 @@ from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY, Registry
 from tpu_dra_driver.plugin.checkpoint import PreparedDevice
 from tpu_dra_driver.plugin.claims import ClaimInfo
 from tpu_dra_driver.plugin.cleanup import CheckpointCleanupManager
-from tpu_dra_driver.plugin.device_state import DeviceState, PermanentError
+from tpu_dra_driver.plugin.device_state import DeviceState
 from tpu_dra_driver.plugin.health import DeviceHealthMonitor
 from tpu_dra_driver.plugin.resourceslices import (
     LAYOUT_COMBINED,
@@ -240,52 +244,81 @@ class TpuKubeletPlugin:
     # ------------------------------------------------------------------
 
     def prepare_resource_claims(self, claims: List[Dict]) -> Dict[str, PrepareResult]:
-        out: Dict[str, PrepareResult] = {}
-        for obj in claims:
-            info = ClaimInfo.from_obj(obj)
-            out[info.uid] = self._node_prepare_resource(info)
-        return out
-
-    def _node_prepare_resource(self, claim: ClaimInfo) -> PrepareResult:
+        """NodePrepareResources: the whole kubelet batch goes through the
+        group-commit fast path — one pu-lock acquisition and two
+        checkpoint fsyncs per BATCH (DeviceState.prepare_batch), not per
+        claim, with per-claim error isolation. The per-claim duration
+        histogram observes the amortized batch wall time (total / n):
+        the cost kubelet actually pays per claim."""
+        infos = ClaimInfo.from_objs(claims)
+        if not infos:
+            return {}
         t0 = time.perf_counter()
-        result = self._node_prepare_resource_inner(claim, t0)
-        elapsed = time.perf_counter() - t0
-        outcome = ("ok" if result.error is None
-                   else "permanent_error" if result.permanent else "error")
-        self._m_prepare.labels(outcome).observe(elapsed)
-        return result
-
-    def _node_prepare_resource_inner(self, claim: ClaimInfo,
-                                     t0: float) -> PrepareResult:
         try:
             lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
             with lock:
                 t_lock = time.perf_counter() - t0
                 self._m_lock_wait.observe(t_lock)
-                devices = self.state.prepare(claim)
-            log.debug("prepare %s: pu-lock wait %.1fms", claim.canonical, t_lock * 1e3)
-            return PrepareResult(devices=devices)
+                batch = self.state.prepare_batch(infos)
         except FlockTimeoutError as e:
-            return PrepareResult(error=f"prepare lock: {e}", permanent=False)
-        except PermanentError as e:
-            log.error("prepare %s failed permanently: %s", claim.canonical, e)
-            return PrepareResult(error=str(e), permanent=True)
+            return self._prepare_batch_failed(
+                infos, f"prepare lock: {e}", t0)
         except Exception as e:
-            log.exception("prepare %s failed", claim.canonical)
-            return PrepareResult(error=str(e), permanent=False)
+            # batch-wide failure (checkpoint read/corruption): no claim
+            # got anywhere, so every claim reports it
+            log.exception("prepare batch of %d claims failed", len(infos))
+            return self._prepare_batch_failed(infos, str(e), t0)
+        elapsed = time.perf_counter() - t0
+        log.debug("prepare batch of %d: pu-lock wait %.1fms, total %.1fms",
+                  len(infos), t_lock * 1e3, elapsed * 1e3)
+        per_claim = elapsed / len(infos)
+        out: Dict[str, PrepareResult] = {}
+        for info in infos:
+            res = batch[info.uid]
+            outcome = ("ok" if res.error is None
+                       else "permanent_error" if res.permanent else "error")
+            self._m_prepare.labels(outcome).observe(per_claim)
+            out[info.uid] = PrepareResult(devices=res.devices,
+                                          error=res.error,
+                                          permanent=res.permanent)
+        return out
+
+    def _prepare_batch_failed(self, infos: List[ClaimInfo], error: str,
+                              t0: float) -> Dict[str, PrepareResult]:
+        per_claim = (time.perf_counter() - t0) / max(len(infos), 1)
+        out: Dict[str, PrepareResult] = {}
+        for info in infos:
+            self._m_prepare.labels("error").observe(per_claim)
+            out[info.uid] = PrepareResult(error=error, permanent=False)
+        return out
 
     def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[str]]:
-        out: Dict[str, Optional[str]] = {}
-        for uid in claim_uids:
-            t0 = time.perf_counter()
-            try:
-                lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
-                with lock:
-                    self.state.unprepare(uid)
-                out[uid] = None
-                self._m_unprepare.labels("ok").observe(time.perf_counter() - t0)
-            except Exception as e:
-                log.exception("unprepare %s failed", uid)
+        """NodeUnprepareResources, batched like the prepare side: one
+        pu-lock acquisition + one checkpoint read/write for the whole
+        batch (DeviceState.unprepare_batch), per-UID error strings
+        preserved."""
+        if not claim_uids:
+            return {}
+        t0 = time.perf_counter()
+        try:
+            lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
+            with lock:
+                self._m_lock_wait.observe(time.perf_counter() - t0)
+                batch = self.state.unprepare_batch(claim_uids)
+        except Exception as e:
+            log.exception("unprepare batch of %d claims failed",
+                          len(claim_uids))
+            per_claim = (time.perf_counter() - t0) / len(claim_uids)
+            out: Dict[str, Optional[str]] = {}
+            for uid in claim_uids:
+                self._m_unprepare.labels("error").observe(per_claim)
                 out[uid] = str(e)
-                self._m_unprepare.labels("error").observe(time.perf_counter() - t0)
+            return out
+        per_claim = (time.perf_counter() - t0) / len(claim_uids)
+        out = {}
+        for uid in claim_uids:
+            exc = batch[uid]
+            out[uid] = None if exc is None else str(exc)
+            self._m_unprepare.labels(
+                "ok" if exc is None else "error").observe(per_claim)
         return out
