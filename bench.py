@@ -338,42 +338,145 @@ def bench_claim_to_ready_crossproc(n_claims: int = 20):
 
 
 def bench_cd_rendezvous() -> float:
-    from tpu_dra_driver.plugin.claims import build_allocated_claim
+    """Headline 2-host rendezvous at production defaults (event-driven
+    controller + wake-on-event plugin retry)."""
+    ms, _ready_ms, _writes = _cd_rendezvous_once(num_slices=1,
+                                                 event_driven=True)
+    return ms
+
+
+def _drain_watch(sub) -> list:
+    """All queued ((type, obj), pushed_at) off a fake-cluster watch."""
+    evs = []
+    while True:
+        got = sub.next_with_ts(timeout=0.05)
+        if got is None:
+            return evs
+        evs.append(got)
+
+
+def _convergence_writes(cd_events: list, cq_events: list):
+    """Status writes the convergence cost, observed EXTERNALLY via watch
+    events (not the controller's own counters): CD updates whose status
+    block changed, with resourceVersion in (first daemon join, Ready
+    flip]. The event-driven claim is that a burst of N daemon joins
+    coalesces into ONE such write."""
+    def rv(obj):
+        return int(obj["metadata"].get("resourceVersion") or 0)
+
+    join_rv = min((rv(obj) for _, obj in cq_events
+                   if obj.get("daemons")), default=None)
+    if join_rv is None:
+        return None
+    writes = []
+    prev_status = None
+    for _, obj in sorted(cd_events, key=lambda ev: rv(ev[1])):
+        status = obj.get("status")
+        if status != prev_status:
+            if status is not None:
+                writes.append((rv(obj), status))
+            prev_status = status
+    ready_rv = next((r for r, s in writes if s.get("status") == "Ready"),
+                    None)
+    if ready_rv is None:
+        return None
+    return sum(1 for r, _ in writes if join_rv < r <= ready_rv)
+
+
+def _cd_rendezvous_once(num_slices: int, event_driven: bool):
+    """One full rendezvous (CD create -> every host's channel claim
+    released) on a fresh in-process cluster. Returns (wall ms,
+    convergence status writes). The poll arm reproduces the pre-event
+    architecture at the previously committed bench settings (50 ms status
+    poll, fixed-backoff plugin retry) so the arms differ only in
+    architecture, not tick generosity."""
+    import shutil
+
+    from tpu_dra_driver.computedomain.controller.controller import (
+        ControllerConfig,
+    )
     from tpu_dra_driver.testing.harness import ClusterHarness
 
     tmp = tempfile.mkdtemp(prefix="tpu-dra-bench-cd-")
-    h = ClusterHarness(tmp, accelerator_type="v5p-16", prepare_budget=60.0)
+    if event_driven:
+        cfg = ControllerConfig(status_sync_interval=5.0,
+                               orphan_cleanup_interval=3600.0)
+    else:
+        cfg = ControllerConfig(status_sync_interval=0.05,
+                               orphan_cleanup_interval=3600.0,
+                               event_driven=False)
+    h = ClusterHarness(tmp, accelerator_type="v5p-16", prepare_budget=60.0,
+                       num_slices=num_slices, controller_config=cfg,
+                       cd_wake_on_events=event_driven)
     h.start()
     try:
-        t0 = time.perf_counter()
-        h.create_compute_domain("bench-cd", "bench", 2, "wl-rct")
-        uid = h.clients.compute_domains.get("bench-cd", "bench")["metadata"]["uid"]
-        cfgs = [{
-            "source": "FromClaim", "requests": [],
-            "opaque": {"driver": "compute-domain.tpu.google.com", "parameters": {
-                "apiVersion": "resource.tpu.google.com/v1beta1",
-                "kind": "ComputeDomainChannelConfig", "domainID": uid,
-            }},
-        }]
-        results = {}
-
-        def prep(i):
-            claim = build_allocated_claim(
-                f"w{i}", f"wl-{i}", "bench", ["channel-0"], f"host-{i}",
-                configs=cfgs, driver_name="compute-domain.tpu.google.com",
-                request="channel")
-            results[i] = h.host(i).cd_plugin.prepare_resource_claims(
-                [claim])[f"w{i}"]
-
-        ts = [threading.Thread(target=prep, args=(i,)) for i in (0, 1)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join(timeout=120)
-        assert all(results[i].error is None for i in (0, 1)), results
-        return (time.perf_counter() - t0) * 1e3
+        n_hosts = len(h.hosts)
+        sub_cd = h.clients.compute_domains.watch()
+        sub_cq = h.clients.compute_domain_cliques.watch()
+        t0 = time.monotonic()
+        h.create_compute_domain("bench-cd", "bench", n_hosts, "wl-rct",
+                                num_slices=num_slices)
+        uid = h.clients.compute_domains.get(
+            "bench-cd", "bench")["metadata"]["uid"]
+        h.prepare_channel_claims(uid, range(n_hosts), "w",
+                                 namespace="bench", timeout=120.0)
+        ms = (time.monotonic() - t0) * 1e3
+        cd_events = _drain_watch(sub_cd)
+        cq_events = _drain_watch(sub_cq)
+        h.clients.compute_domains.stop_watch(sub_cd)
+        h.clients.compute_domain_cliques.stop_watch(sub_cq)
+        # CD-Ready latency from the watch stream's own push timestamps:
+        # create -> the status update that flipped the CD Ready.
+        ready_ms = min(((ts - t0) * 1e3 for (_, obj), ts in cd_events
+                        if (obj.get("status") or {}).get("status")
+                        == "Ready"), default=None)
+        writes = _convergence_writes([ev for ev, _ in cd_events],
+                                     [ev for ev, _ in cq_events])
+        return ms, ready_ms, writes
     finally:
         h.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_cd_rendezvous_sweep(slice_counts=(1, 2, 4), rounds: int = 3) -> dict:
+    """Event-driven vs poll rendezvous across domain sizes.
+
+    For numSlices in ``slice_counts`` (2 hosts per slice, so 2/4/8-node
+    domains; >1 exercises the MEGASCALE multislice gate too), the full CD
+    create -> all workloads released wall time is measured ``rounds``
+    times per arm on fresh clusters; the median lands in the artifact
+    along with the max convergence status-write count observed on the
+    event arm (the coalescing proof: a burst of N daemon joins must
+    produce ONE status write between first join and the Ready flip)."""
+    out: dict = {}
+    for n_slices in slice_counts:
+        row: dict = {"hosts": 2 * n_slices}
+        for arm in ("event", "poll"):
+            samples, ready, writes = [], [], []
+            for _ in range(rounds):
+                ms, ready_ms, w = _cd_rendezvous_once(n_slices,
+                                                      arm == "event")
+                samples.append(ms)
+                if ready_ms is not None:
+                    ready.append(ready_ms)
+                if w is not None:
+                    writes.append(w)
+            row[f"{arm}_ms"] = round(statistics.median(samples), 1)
+            row[f"{arm}_ready_ms"] = (round(statistics.median(ready), 1)
+                                      if ready else None)
+            if arm == "event":
+                row["event_status_writes_convergence"] = (
+                    max(writes) if writes else None)
+        row["speedup"] = round(row["poll_ms"] / max(row["event_ms"], 1e-9), 1)
+        out[str(n_slices)] = row
+        log(f"  slices={n_slices} ({row['hosts']} hosts): event "
+            f"ready {row['event_ready_ms']} ms / released "
+            f"{row['event_ms']:.0f} ms vs poll ready "
+            f"{row['poll_ready_ms']} ms / released {row['poll_ms']:.0f} ms "
+            f"({row['speedup']:.1f}x, "
+            f"{row['event_status_writes_convergence']} status write(s) "
+            f"per convergence)")
+    return out
 
 
 # substrings that identify a TUNNEL/TRANSPORT failure inside a
@@ -783,6 +886,8 @@ def _bench_spec_real_data(out: dict) -> None:
 # never re-bloat the summary line past the capture tail.
 SUMMARY_KEYS = [
     "crossproc", "inprocess_p50_ms", "grpc_p50_ms", "cd_rendezvous_ms",
+    "cd_rendezvous_event_ms", "cd_rendezvous_poll_ms",
+    "cd_rendezvous_speedup",
     "prep_serial8_ms", "prep_batch8_ms", "prep_batch8_speedup",
     "cel_compile_speedup",
     "backend", "devices",
@@ -883,6 +988,14 @@ def main() -> int:
     rdv_ms = bench_cd_rendezvous()
     log(f"  CD create -> both workloads released: {rdv_ms:.0f} ms")
 
+    log("[bench] ComputeDomain rendezvous sweep (event-driven vs poll, "
+        "1/2/4-slice domains)…")
+    cd_sweep = {}
+    try:
+        cd_sweep = bench_cd_rendezvous_sweep()
+    except Exception as e:  # noqa: BLE001
+        log(f"  rendezvous sweep failed ({type(e).__name__}: {e})")
+
     log("[bench] accelerator microbenchmarks…")
     accel = bench_accelerator()
 
@@ -930,6 +1043,13 @@ def main() -> int:
         "subslice_p50_ms": round(statistics.median(lat_ss), 3),
         "grpc_p50_ms": round(statistics.median(lat_g), 3),
         "cd_rendezvous_ms": round(rdv_ms, 1),
+        # event-driven vs poll rendezvous arms (full sweep evidence under
+        # cd_rendezvous in the detail file)
+        "cd_rendezvous": cd_sweep,
+        **({"cd_rendezvous_event_ms": cd_sweep["1"]["event_ms"],
+            "cd_rendezvous_poll_ms": cd_sweep["1"]["poll_ms"],
+            "cd_rendezvous_speedup": cd_sweep["1"]["speedup"]}
+           if cd_sweep.get("1") else {}),
         # group-commit prepare + compiled-CEL fast path (per-claim ms;
         # full sweep + microbench evidence under prep_batch_sweep /
         # cel_microbench in the detail file)

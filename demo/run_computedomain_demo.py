@@ -69,7 +69,11 @@ def main() -> int:
             assert out.returncode == 0, out.stderr
             payload = json.loads(out.stdout.strip().splitlines()[-1])
             print(f"[3] host-{i} workload: {payload}")
-            assert payload["hostnames"] == "10.0.0.2,10.0.1.2"
+            # index assignment is join-order (daemon pods boot
+            # concurrently): either host may be worker 0, but both see
+            # one consistent index-ordered address list
+            assert sorted(payload["hostnames"].split(",")) == [
+                "10.0.0.2", "10.0.1.2"]
 
         print("[4] ComputeDomain e2e OK")
         return 0

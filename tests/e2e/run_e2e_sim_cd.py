@@ -294,7 +294,9 @@ def _check_worker_env(nodes: List[SimNode], claims: List[Dict]) -> Dict:
 
 def _prepare_with_retry(dra, claim, deadline_s: float = 240.0):
     """kubelet's retry envelope: call NodePrepareResources until success
-    (the CD plugin itself retries within its 45 s budget per call)."""
+    (the CD plugin itself retries within its 45 s budget per call, waking
+    on CD/clique watch events — so the first call normally returns
+    released and this outer loop only covers budget exhaustion)."""
     uid = claim["metadata"]["uid"]
     deadline = time.monotonic() + deadline_s
     last = ""
@@ -304,7 +306,7 @@ def _prepare_with_retry(dra, claim, deadline_s: float = 240.0):
         if not res.error:
             return res
         last = res.error
-        time.sleep(1.0)
+        time.sleep(0.25)
     raise HarnessError(f"prepare {claim['metadata']['name']} never "
                        f"succeeded: {last}")
 

@@ -83,26 +83,34 @@ class KubeletReplay:
         """GetInfo → validate → NotifyRegistrationStatus(registered)."""
         sock = self.discover_socket(driver_name, timeout,
                                     instance_uid=instance_uid)
-        channel = grpc.insecure_channel(f"unix://{sock}")
-        get_info = channel.unary_unary(
-            f"/{REGISTRATION_SERVICE}/GetInfo",
-            request_serializer=reg_pb.InfoRequest.SerializeToString,
-            response_deserializer=reg_pb.PluginInfo.FromString)
-        notify = channel.unary_unary(
-            f"/{REGISTRATION_SERVICE}/NotifyRegistrationStatus",
-            request_serializer=reg_pb.RegistrationStatus.SerializeToString,
-            response_deserializer=reg_pb.RegistrationStatusResponse.FromString)
+        # A FRESH channel per attempt, exactly like kubelet re-dialing: a
+        # long-lived channel created while a dead predecessor's socket
+        # file still occupies the path can wedge on the stale inode and
+        # never reach the rebound server (observed on the crash-restart
+        # phase: every retry timed out before the SETTINGS frame).
         deadline = time.monotonic() + timeout
         last = None
+        channel = None
         while time.monotonic() < deadline:
+            channel = grpc.insecure_channel(f"unix://{sock}")
+            get_info = channel.unary_unary(
+                f"/{REGISTRATION_SERVICE}/GetInfo",
+                request_serializer=reg_pb.InfoRequest.SerializeToString,
+                response_deserializer=reg_pb.PluginInfo.FromString)
             try:
                 info = get_info(reg_pb.InfoRequest(), timeout=5)
                 break
             except grpc.RpcError as e:   # socket exists before serve() — retry
                 last = e
+                channel.close()
+                channel = None
                 time.sleep(0.1)
         else:
             raise HarnessError(f"GetInfo never succeeded: {last}")
+        notify = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/NotifyRegistrationStatus",
+            request_serializer=reg_pb.RegistrationStatus.SerializeToString,
+            response_deserializer=reg_pb.RegistrationStatusResponse.FromString)
         # kubelet's validation (pkg/kubelet/pluginmanager): type, name,
         # endpoint, versions non-empty
         if info.type != "DRAPlugin":
@@ -347,7 +355,10 @@ class SimCluster:
                 "--kubeconfig", self.kubeconfig,
                 "--device-backend", "fake",
                 "--driver-image", "sim-image:e2e",
-                "--status-sync-interval", "0.2",
+                # deliberately SLOW backstop: cross-process convergence
+                # must come from the informer event path over REST watch,
+                # not from a tight poll masking a broken event flow
+                "--status-sync-interval", "5",
                 "-v", "6"] + (extra_args or [])
         p = PluginProcess("cd-controller", argv,
                           os.path.join(log_dir, "cd-controller.log"))

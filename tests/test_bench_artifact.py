@@ -14,6 +14,7 @@ tests pin the two contracts that prevent a recurrence:
 """
 
 import json
+import os
 
 import pytest
 
@@ -123,6 +124,35 @@ def test_summary_line_sheds_keys_rather_than_overflow():
     assert len(line.encode()) <= bench.SUMMARY_LINE_BUDGET
     parsed = json.loads(line)
     assert parsed["value"] == 1.863  # header never shed
+
+
+def test_bench_detail_records_cd_rendezvous_arms():
+    """The committed BENCH_DETAIL.json must carry the event-driven-vs-poll
+    ComputeDomain rendezvous evidence: both arms, all swept domain sizes,
+    and the convergence-write coalescing count — so the perf claim of the
+    event-driven status sync stays falsifiable from the artifact alone."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    with open(path) as f:
+        extra = json.load(f)["extra"]
+    sweep = extra["cd_rendezvous"]
+    assert set(sweep) >= {"1", "2", "4"}, sweep.keys()
+    for size, row in sweep.items():
+        for key in ("event_ms", "poll_ms", "event_ready_ms",
+                    "poll_ready_ms"):
+            assert isinstance(row[key], (int, float)) and row[key] > 0, (
+                size, key, row)
+        assert isinstance(row["event_status_writes_convergence"], int)
+        assert row["hosts"] == 2 * int(size)
+    # the architecture claim: event-driven beats the poll arm end to end
+    # on the headline (single-slice) domain
+    assert sweep["1"]["event_ms"] < sweep["1"]["poll_ms"]
+    # headline scalars mirrored for the summary line
+    assert extra["cd_rendezvous_event_ms"] == sweep["1"]["event_ms"]
+    assert extra["cd_rendezvous_poll_ms"] == sweep["1"]["poll_ms"]
+    for key in ("cd_rendezvous_event_ms", "cd_rendezvous_poll_ms",
+                "cd_rendezvous_speedup"):
+        assert key in bench.SUMMARY_KEYS
 
 
 def test_exactness_verdict_three_states():

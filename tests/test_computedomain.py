@@ -179,9 +179,13 @@ def test_multihost_rendezvous_end_to_end(harness):
     ids = sorted(int(envs[i]["TPU_WORKER_ID"]) for i in (0, 1))
     assert ids == [0, 1]
     # addresses are container-resolvable IPs, identical on both hosts and
-    # ordered by worker index; the stable DNS names ride along separately
+    # ordered by worker index. Index assignment is JOIN-ORDER (gap-filled
+    # at clique join; daemon pods start concurrently), so derive the
+    # expected order from each host's actual worker id instead of
+    # assuming host-0 joined first.
     assert envs[0]["TPU_WORKER_HOSTNAMES"] == envs[1]["TPU_WORKER_HOSTNAMES"]
-    assert envs[0]["TPU_WORKER_HOSTNAMES"] == "10.0.0.2,10.0.1.2"
+    by_index = {int(envs[i]["TPU_WORKER_ID"]): f"10.0.{i}.2" for i in (0, 1)}
+    assert envs[0]["TPU_WORKER_HOSTNAMES"] == f"{by_index[0]},{by_index[1]}"
     assert envs[0]["TPU_WORKER_DNS_NAMES"] == f"{worker_name(0)},{worker_name(1)}"
     assert envs[0]["TPU_ACCELERATOR_TYPE"] == "v5p-16"
     assert envs[0]["TPU_ICI_CHANNEL"] == "0"
@@ -381,9 +385,11 @@ def test_rct_rename_cleans_up_stale_template(harness):
     harness.wait_for(
         lambda: _exists(harness.clients.resource_claim_templates, "rct-a", "user-ns"),
         what="rct-a")
-    cd = harness.clients.compute_domains.get("cd1", "user-ns")
-    cd["spec"]["channel"]["resourceClaimTemplate"]["name"] = "rct-b"
-    harness.clients.compute_domains.update(cd)
+    def rename(obj):
+        obj["spec"]["channel"]["resourceClaimTemplate"]["name"] = "rct-b"
+    # retry_update: the controller's initial status stamp may race a bare
+    # read-modify-write here
+    harness.clients.compute_domains.retry_update("cd1", "user-ns", rename)
     harness.wait_for(
         lambda: _exists(harness.clients.resource_claim_templates, "rct-b", "user-ns")
         and not _exists(harness.clients.resource_claim_templates, "rct-a", "user-ns"),
@@ -711,6 +717,109 @@ def test_ds_controller_reschedules_daemon_with_stable_identity(harness):
     node = harness.clients.nodes.get(victim_node)
     assert (node["metadata"].get("labels") or {}).get(
         COMPUTE_DOMAIN_LABEL_KEY) == uid
+
+
+# ---------------------------------------------------------------------------
+# event-driven status sync (informer-triggered; the 2 s poll is demoted to
+# a resync backstop)
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_converges_with_backstop_disabled(tmp_path):
+    """With the periodic status pass effectively OFF (1 h backstop), the
+    full rendezvous must converge purely from pod/clique watch events —
+    the proof that nothing on the critical path still needs the poll."""
+    from tpu_dra_driver.computedomain.controller.controller import (
+        ComputeDomainController, ControllerConfig)
+    from tpu_dra_driver.pkg.metrics import Registry
+    reg = Registry()  # fresh registry: counters start at zero
+    h = ClusterHarness(str(tmp_path), prepare_budget=15.0)
+    h.controller = ComputeDomainController(
+        h.clients, ControllerConfig(status_sync_interval=3600.0,
+                                    orphan_cleanup_interval=3600.0),
+        registry=reg)
+    h.start()
+    try:
+        h.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+        uid = h.clients.compute_domains.get(
+            "cd1", "user-ns")["metadata"]["uid"]
+        results = _prepare_concurrently(h, uid, [0, 1])
+        assert all(r.error is None for r in results.values()), results
+        status = h.cd_status("cd1", "user-ns")
+        assert status["status"] == STATUS_READY
+        # the convergence was event-triggered: pod/clique sources fired,
+        # and the only resync ticks were the run-once-at-start ones
+        text = reg.render()
+        assert 'dra_cd_status_sync_triggers_total{source="clique"}' in text
+        assert 'dra_cd_status_sync_triggers_total{source="pod"}' in text
+        # at least one real status write + a rendezvous latency sample
+        writes = next(l for l in text.splitlines()
+                      if l.startswith("dra_cd_status_writes_total"))
+        assert float(writes.split()[-1]) >= 1
+        assert "dra_cd_rendezvous_seconds_count 1" in text
+    finally:
+        h.stop()
+
+
+def test_status_debounce_coalesces_event_bursts(tmp_path):
+    """A burst of clique mutations inside the debounce window must fold
+    into ONE status sync write, not one write per event."""
+    from tpu_dra_driver.computedomain.controller.controller import (
+        ComputeDomainController, ControllerConfig)
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.pkg.metrics import Registry
+
+    reg = Registry()
+    clients = ClientSets()
+    ctl = ComputeDomainController(clients, ControllerConfig(
+        status_sync_interval=3600.0, orphan_cleanup_interval=3600.0,
+        status_debounce=0.1), registry=reg)
+    ctl.start()
+    try:
+        clients.compute_domains.create({
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {"name": "cd1", "namespace": "ns", "uid": "u-cd1"},
+            "spec": {"numNodes": 2,
+                     "channel": {"resourceClaimTemplate": {"name": "rct"}}},
+        })
+        # daemon pods exist so _cleanup_cliques keeps the entries
+        for i in (0, 1):
+            clients.pods.create({
+                "metadata": {"name": f"d{i}", "namespace": DRIVER_NAMESPACE,
+                             "labels": {COMPUTE_DOMAIN_LABEL_KEY: "u-cd1"}},
+                "spec": {"nodeName": f"host-{i}"},
+                "status": {"podIP": f"10.0.{i}.2"}})
+        ctl._queue.wait_idle(timeout=5.0)
+        writes0 = ctl._status_writes.value
+        # burst: clique create + two joins + two ready flips, all well
+        # inside the 100 ms debounce window
+        clients.compute_domain_cliques.create({
+            "metadata": {"name": "u-cd1.cq0", "namespace": DRIVER_NAMESPACE},
+            "daemons": []})
+        for daemons in (
+            [{"nodeName": "host-0", "ipAddress": "10.0.0.2", "index": 0,
+              "status": "NotReady"}],
+            [{"nodeName": "host-0", "ipAddress": "10.0.0.2", "index": 0,
+              "status": "Ready"},
+             {"nodeName": "host-1", "ipAddress": "10.0.1.2", "index": 1,
+              "status": "Ready"}],
+        ):
+            def put(obj, daemons=daemons):
+                obj["daemons"] = daemons
+            clients.compute_domain_cliques.retry_update(
+                "u-cd1.cq0", DRIVER_NAMESPACE, put)
+        ctl._queue.wait_idle(timeout=5.0)
+        time.sleep(0.3)  # cover the debounce tail
+        ctl._queue.wait_idle(timeout=5.0)
+        status = (clients.compute_domains.get("cd1", "ns").get("status")
+                  or {})
+        assert status.get("status") == STATUS_READY
+        assert ctl._status_writes.value - writes0 == 1, (
+            f"burst produced {ctl._status_writes.value - writes0} status "
+            f"writes; the debounce must coalesce to one")
+    finally:
+        ctl.stop()
 
 
 def test_label_removal_drains_daemon_and_readd_restores_index(harness):

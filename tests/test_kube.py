@@ -344,6 +344,133 @@ def test_watch_since_rv_replay_respects_selector():
     c.stop_watch("pods", sub)
 
 
+# ---------------------------------------------------------------------------
+# informer indices + resilience (event-driven CD status sync substrate)
+# ---------------------------------------------------------------------------
+
+
+def _uid_indexer(obj):
+    uid = (obj.get("metadata") or {}).get("labels", {}).get("cd")
+    return (uid,) if uid else ()
+
+
+def test_informer_index_tracks_adds_updates_deletes():
+    cs = ClientSets()
+    cs.pods.create(_obj("p1", "ns", labels={"cd": "u1"}))
+    inf = Informer(cs.pods, indexers={"cd-uid": _uid_indexer})
+    inf.start()
+    assert inf.wait_synced()
+    assert [o["metadata"]["name"] for o in inf.by_index("cd-uid", "u1")] == ["p1"]
+
+    cs.pods.create(_obj("p2", "ns", labels={"cd": "u1"}))
+    cs.pods.create(_obj("p3", "ns", labels={"cd": "u2"}))
+
+    def settled():
+        return len(inf.by_index("cd-uid", "u1")) == 2 and \
+            len(inf.by_index("cd-uid", "u2")) == 1
+    _wait(settled)
+    # label move: p1 u1 -> u2 must leave exactly one entry per value
+    obj = cs.pods.get("p1", "ns")
+    obj["metadata"]["labels"]["cd"] = "u2"
+    cs.pods.update(obj)
+    _wait(lambda: {o["metadata"]["name"]
+                   for o in inf.by_index("cd-uid", "u2")} == {"p1", "p3"})
+    assert [o["metadata"]["name"] for o in inf.by_index("cd-uid", "u1")] == ["p2"]
+    cs.pods.delete("p2", "ns")
+    _wait(lambda: inf.by_index("cd-uid", "u1") == [])
+    assert inf.index_values("cd-uid") == ["u2"]
+    inf.stop()
+
+
+def _wait(predicate, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {predicate}")
+
+
+def test_informer_relist_resync_rebuilds_store_and_index():
+    """Watch drop -> RELIST: the store AND every index must converge to
+    the fresh list — synthetic DELETED for vanished objects, their index
+    entries gone, new objects indexed."""
+    from tpu_dra_driver.kube.client import ResourceClient
+    from tpu_dra_driver.kube.fake import RELIST
+
+    cluster = FakeCluster()
+    client = ResourceClient(cluster, "pods")
+    client.create(_obj("keep", "ns", labels={"cd": "u1"}))
+    client.create(_obj("gone", "ns", labels={"cd": "u1"}))
+    inf = Informer(client, indexers={"cd-uid": _uid_indexer})
+    deleted = []
+    inf.add_handlers(on_delete=lambda o: deleted.append(o["metadata"]["name"]))
+    inf.start()
+    assert inf.wait_synced()
+    assert len(inf.by_index("cd-uid", "u1")) == 2
+
+    snapshot = {"items": [
+        client.get("keep", "ns"),
+        {"metadata": {"name": "fresh", "namespace": "ns",
+                      "resourceVersion": "999", "labels": {"cd": "u2"}}},
+    ]}
+    inf._sub.push((RELIST, snapshot))
+    _wait(lambda: "gone" in deleted)
+    assert inf.get("gone", "ns") is None
+    assert [o["metadata"]["name"] for o in inf.by_index("cd-uid", "u1")] == ["keep"]
+    assert [o["metadata"]["name"] for o in inf.by_index("cd-uid", "u2")] == ["fresh"]
+    inf.stop()
+
+
+def test_late_handler_replay_exactly_one_added_under_concurrent_updates():
+    """add_handlers after sync, while writers hammer updates: each object
+    is delivered exactly ONE synthetic ADDED (replay and live dispatch
+    serialize on the informer lock — no duplicate, no miss)."""
+    import collections
+
+    cs = ClientSets()
+    for i in range(8):
+        cs.pods.create(_obj(f"p{i}", "ns", spec={"v": 0}))
+    inf = Informer(cs.pods)
+    inf.start()
+    assert inf.wait_synced()
+
+    stop = threading.Event()
+
+    def hammer():
+        v = 0
+        while not stop.is_set():
+            v += 1
+            for i in range(8):
+                def bump(o, v=v):
+                    o["spec"]["v"] = v
+                try:
+                    cs.pods.retry_update(f"p{i}", "ns", bump)
+                except (NotFoundError, ConflictError):
+                    pass  # contention is the point; keep hammering
+
+    writers = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in writers:
+        t.start()
+    try:
+        time.sleep(0.05)  # let live MODIFIED dispatch be in full flight
+        added = collections.Counter()
+        updated = collections.Counter()
+        inf.add_handlers(
+            on_add=lambda o: added.update([o["metadata"]["name"]]),
+            on_update=lambda old, new: updated.update(
+                [new["metadata"]["name"]]))
+        time.sleep(0.1)  # live updates keep flowing to the new handler
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=5)
+    inf.stop()
+    assert set(added) == {f"p{i}" for i in range(8)}
+    assert all(count == 1 for count in added.values()), added
+    assert sum(updated.values()) > 0  # the handler did go live afterwards
+
+
 def test_watch_since_rv_compacted_raises_gone():
     from tpu_dra_driver.kube.errors import GoneError
 

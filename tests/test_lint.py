@@ -147,3 +147,55 @@ def test_repo_is_clean():
     for path in lint._py_files(lint.TARGETS):
         findings.extend(f for f in lint.lint_file(path) if f[2] == "F821")
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# no-sleep-polling guard for the ComputeDomain reconcile paths
+# ---------------------------------------------------------------------------
+
+# The event-driven rendezvous (informer-triggered status sync, wake-on-
+# event prepare retries, watch-based daemon reads) removed every
+# ``time.sleep``-based poll from the controller/daemon/plugin reconcile
+# paths. This guard keeps them out: blocking a reconcile thread on a fixed
+# sleep reintroduces the latency class this architecture exists to avoid.
+# Legitimate timed waits use ``threading.Event.wait`` / ``Condition.wait``
+# (interruptible, event-cuttable), which the guard permits.
+_NO_SLEEP_DIRS = (
+    os.path.join("tpu_dra_driver", "computedomain", "controller"),
+    os.path.join("tpu_dra_driver", "computedomain", "daemon"),
+    os.path.join("tpu_dra_driver", "computedomain", "plugin"),
+)
+
+
+def _sleep_calls(path):
+    import ast
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        # catches time.sleep, _time.sleep, and any `from time import
+        # sleep` alias spelled `sleep(...)`
+        if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
+            out.append((path, node.lineno))
+        elif isinstance(fn, ast.Name) and fn.id == "sleep":
+            out.append((path, node.lineno))
+    return out
+
+
+def test_no_sleep_polling_in_cd_reconcile_paths():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    offenders = []
+    for rel in _NO_SLEEP_DIRS:
+        root = os.path.join(repo, rel)
+        for dirpath, _, files in os.walk(root):
+            for name in files:
+                if name.endswith(".py"):
+                    offenders.extend(
+                        _sleep_calls(os.path.join(dirpath, name)))
+    assert offenders == [], (
+        "time.sleep-based polling reintroduced in ComputeDomain reconcile "
+        f"paths: {offenders} — use an informer/watch wake or an "
+        "Event.wait with an event that cuts it short")

@@ -35,7 +35,20 @@ def build_parser() -> EnvArgumentParser:
     p.add_argument("--max-nodes-per-domain", env="MAX_NODES_PER_DOMAIN",
                    type=int, default=64)
     p.add_argument("--status-sync-interval", env="STATUS_SYNC_INTERVAL",
-                   type=float, default=2.0)
+                   type=float, default=30.0,
+                   help="status RESYNC BACKSTOP interval; convergence is "
+                        "informer event-driven, this periodic pass only "
+                        "heals missed watch events (was the 2 s poll "
+                        "period before the event-driven rendezvous)")
+    p.add_argument("--status-debounce", env="STATUS_DEBOUNCE",
+                   type=float, default=0.01,
+                   help="trailing debounce before an event-triggered "
+                        "per-CD status sync runs; a burst of daemon joins "
+                        "coalesces into one status write")
+    p.add_argument("--workers", env="CONTROLLER_WORKERS", type=int,
+                   default=2,
+                   help="workqueue worker threads (reconciles and per-CD "
+                        "status syncs for distinct CDs run in parallel)")
     p.add_argument("--leader-election", env="LEADER_ELECTION",
                    action="store_true", default=False)
     p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
@@ -73,6 +86,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     controller = ComputeDomainController(clients, ControllerConfig(
         max_nodes_per_domain=args.max_nodes_per_domain,
         status_sync_interval=args.status_sync_interval,
+        status_debounce=args.status_debounce,
+        workers=args.workers,
         device_backend=args.device_backend,
         daemon_image=args.driver_image,
         daemon_log_verbosity=args.daemon_log_verbosity,

@@ -7,9 +7,13 @@ cleanup.go}. Responsibilities:
 - on CD add/update: add finalizer, stamp the per-CD DaemonSet + daemon
   RCT (driver namespace) + workload RCT (user namespace), enforce the
   max-nodes cap;
-- status sync loop (2 s): copy ComputeDomainClique daemon entries into
-  ``CD.status.nodes`` and flip the global status Ready when >= numNodes
-  nodes are Ready (pruning stale nodes);
+- **event-driven status sync**: shared pod + clique informers (indexed by
+  CD uid, the client-go SharedInformer/lister shape of the reference's
+  cdstatus controller) enqueue a debounced per-CD ``status:<uid>`` key on
+  the keyed workqueue; each sync copies ComputeDomainClique daemon entries
+  into ``CD.status.nodes`` and flips the global status Ready when >=
+  numNodes nodes are Ready (pruning stale nodes). A slow periodic pass
+  (default 30 s) remains only as a resync backstop for missed events;
 - on CD delete: tear down children (DS, RCTs, cliques, node labels), then
   drop the finalizer;
 - periodic orphan cleanup: children whose CD no longer exists.
@@ -51,7 +55,14 @@ from tpu_dra_driver.pkg.workqueue import WorkQueue, default_controller_rate_limi
 
 log = logging.getLogger(__name__)
 
-STATUS_SYNC_INTERVAL = 2.0       # reference cdstatus.go: 2 s loop
+# The reference cdstatus.go ran a 2 s poll; status sync is now informer
+# event-triggered and the interval is only the resync backstop that heals
+# a missed watch event.
+STATUS_SYNC_INTERVAL = 30.0
+# Trailing debounce for per-CD status sync: a burst of daemon joins
+# (events landing closer together than this) coalesces into one sync and
+# at most one status write.
+STATUS_DEBOUNCE = 0.01
 ORPHAN_CLEANUP_INTERVAL = 600.0
 
 
@@ -60,6 +71,16 @@ class ControllerConfig:
     max_nodes_per_domain: int = DEFAULT_MAX_NODES_PER_DOMAIN
     status_sync_interval: float = STATUS_SYNC_INTERVAL
     orphan_cleanup_interval: float = ORPHAN_CLEANUP_INTERVAL
+    # Trailing debounce before an event-triggered per-CD status sync runs;
+    # every further event for the same CD pushes the deadline back.
+    status_debounce: float = STATUS_DEBOUNCE
+    # Workqueue workers. >1 lets independent CDs reconcile/status-sync in
+    # parallel; per-key latest-wins semantics still serialize meaningfully.
+    workers: int = 2
+    # False restores the poll-only architecture (full LISTs on every
+    # status_sync_interval tick, no event triggers) — kept as the
+    # comparison arm for bench.py's rendezvous benchmark.
+    event_driven: bool = True
     # Extra namespaces where the driver may manage CD DaemonSets
     # (reference mnsdaemonset.go + --additional-namespaces): a CD's
     # DaemonSet found in any managed namespace is adopted/updated there;
@@ -93,7 +114,46 @@ class ComputeDomainController:
         self._reconcile_duration = self.registry.histogram(
             "computedomain_reconcile_duration_seconds",
             "Wall time of one ComputeDomain reconcile")
-        self._cd_informer = Informer(clients.compute_domains)
+        self._status_triggers = self.registry.counter(
+            "dra_cd_status_sync_triggers_total",
+            "ComputeDomain status syncs by what triggered them",
+            ("source",))
+        self._status_writes = self.registry.counter(
+            "dra_cd_status_writes_total",
+            "ComputeDomain status updates actually written (unchanged "
+            "syncs abort without an API write)")
+        self._rendezvous_seconds = self.registry.histogram(
+            "dra_cd_rendezvous_seconds",
+            "ComputeDomain rendezvous: first observed daemon join to "
+            "status Ready",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0, 60.0))
+        # CD uid -> monotonic time the first daemon join was observed while
+        # the CD was not Ready (feeds the rendezvous histogram).
+        self._rendezvous_t0: Dict[str, float] = {}
+        def pod_cd_uid(obj: Dict):
+            uid = ((obj.get("metadata") or {}).get("labels") or {}).get(
+                COMPUTE_DOMAIN_LABEL_KEY)
+            return (uid,) if uid else ()
+
+        def clique_cd_uid(obj: Dict):
+            name = (obj.get("metadata") or {}).get("name", "")
+            return (name.split(".", 1)[0],) if name else ()
+
+        self._cd_informer = Informer(
+            clients.compute_domains,
+            indexers={"uid": lambda o: (
+                ((o.get("metadata") or {}).get("uid"),)
+                if (o.get("metadata") or {}).get("uid") else ())})
+        # One pod informer PER managed namespace (the reference's filtered
+        # daemon-pod informers): the store holds daemon-pod candidates
+        # only, not every pod in the cluster.
+        self._pod_informers = [
+            Informer(clients.pods, namespace=ns,
+                     indexers={"cd-uid": pod_cd_uid})
+            for ns in self._managed_namespaces()]
+        self._clique_informer = Informer(clients.compute_domain_cliques,
+                                         indexers={"cd-uid": clique_cd_uid})
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -103,10 +163,32 @@ class ComputeDomainController:
 
     def start(self) -> None:
         self._cd_informer.add_handlers(
-            on_add=self._enqueue, on_update=lambda old, new: self._enqueue(new))
+            on_add=self._on_cd_event,
+            on_update=self._on_cd_update)
+        if self._config.event_driven:
+            # Pod/clique events drive status convergence; the handlers are
+            # registered before start() so the initial ADDED replay warms
+            # every existing CD's status key.
+            for inf in self._pod_informers:
+                inf.add_handlers(
+                    on_add=lambda o: self._enqueue_status_for(o, "pod"),
+                    on_update=lambda old, new: self._enqueue_status_for(
+                        new, "pod", old),
+                    on_delete=lambda o: self._enqueue_status_for(o, "pod"))
+                inf.start()
+            self._clique_informer.add_handlers(
+                on_add=lambda o: self._enqueue_status_for(o, "clique"),
+                on_update=lambda old, new: self._enqueue_status_for(
+                    new, "clique"),
+                on_delete=lambda o: self._enqueue_status_for(o, "clique"))
+            self._clique_informer.start()
         self._cd_informer.start()
+        if self._config.event_driven:
+            for inf in self._pod_informers:
+                inf.wait_synced()
+            self._clique_informer.wait_synced()
         self._cd_informer.wait_synced()
-        self._queue.start(workers=1)
+        self._queue.start(workers=max(1, self._config.workers))
         for name, fn, interval in (
             ("cd-status-sync", self._sync_all_statuses,
              self._config.status_sync_interval),
@@ -117,30 +199,98 @@ class ComputeDomainController:
                                  name=name, daemon=True)
             t.start()
             self._threads.append(t)
-        log.info("compute-domain-controller started")
+        log.info("compute-domain-controller started (%s status sync, "
+                 "%d workers, %.0fs resync backstop)",
+                 "event-driven" if self._config.event_driven else "poll",
+                 max(1, self._config.workers),
+                 self._config.status_sync_interval)
 
     def stop(self) -> None:
         self._stop.set()
         self._queue.shutdown()
         self._cd_informer.stop()
+        if self._config.event_driven:
+            for inf in self._pod_informers:
+                inf.stop()
+            self._clique_informer.stop()
         for t in self._threads:
             t.join(timeout=2.0)
 
     def _loop(self, fn, interval: float) -> None:
-        while not self._stop.wait(interval):
+        # Run once immediately, THEN wait: a freshly started controller
+        # must not sit out a whole interval before its first status sync
+        # (2 s) or orphan sweep (600 s).
+        while True:
             try:
                 fn()
             except Exception:
                 log.exception("periodic task failed")
+            if self._stop.wait(interval):
+                return
 
     # ------------------------------------------------------------------
     # reconcile
     # ------------------------------------------------------------------
 
+    def _on_cd_event(self, obj: Dict) -> None:
+        self._enqueue(obj)
+        uid = obj["metadata"].get("uid", "")
+        if uid and self._config.event_driven:
+            self._enqueue_status(uid, "cd")
+
+    def _on_cd_update(self, old: Dict, new: Dict) -> None:
+        self._enqueue(new)
+        # Only a spec change (generation bump) warrants a status re-sync;
+        # reacting to our own status writes would re-debounce pending
+        # syncs and sync once more just to abort.
+        if (self._config.event_driven
+                and (old.get("metadata") or {}).get("generation")
+                != new["metadata"].get("generation")):
+            uid = new["metadata"].get("uid", "")
+            if uid:
+                self._enqueue_status(uid, "cd")
+
     def _enqueue(self, obj: Dict) -> None:
         meta = obj["metadata"]
         key = f"{meta.get('namespace','')}/{meta['name']}"
         self._queue.enqueue_with_key(key, lambda: self._reconcile(key))
+
+    def _enqueue_status_for(self, obj: Dict, source: str,
+                            old: Optional[Dict] = None) -> None:
+        """Enqueue a status sync for every CD uid the object (and, on
+        label moves, its previous incarnation) maps to."""
+        uids = set()
+        for o in (obj, old):
+            if o is None:
+                continue
+            meta = o.get("metadata") or {}
+            if source == "pod":
+                uid = (meta.get("labels") or {}).get(COMPUTE_DOMAIN_LABEL_KEY)
+            else:
+                uid = meta.get("name", "").split(".", 1)[0]
+            if uid:
+                uids.add(uid)
+        for uid in uids:
+            self._enqueue_status(uid, source)
+
+    def _enqueue_status(self, uid: str, source: str) -> None:
+        """Debounced, coalescing per-CD status sync: the keyed queue keeps
+        only the newest enqueue per ``status:<uid>`` and each re-enqueue
+        pushes the deadline back, so an event burst runs one sync."""
+        self._status_triggers.labels(source).inc()
+        # Rendezvous clock anchor: the first clique event for a not-yet-
+        # Ready CD marks the first daemon join — anchoring at sync time
+        # instead would lose the sample entirely when the whole burst
+        # coalesces into one straight-to-Ready sync.
+        if (source == "clique" and uid not in self._rendezvous_t0
+                and self._cd_informer.synced):
+            cds = self._cd_informer.by_index("uid", uid)
+            if cds and ((cds[0].get("status") or {}).get("status")
+                        != STATUS_READY):
+                self._rendezvous_t0[uid] = time.monotonic()
+        self._queue.enqueue_with_key(
+            f"status:{uid}", lambda: self._sync_cd_status(uid),
+            delay=self._config.status_debounce)
 
     def _reconcile(self, key: str) -> None:
         with self._reconcile_duration.time():
@@ -268,6 +418,7 @@ class ComputeDomainController:
 
     def _teardown(self, cd: ComputeDomain) -> None:
         uid = cd.metadata.uid
+        self._rendezvous_t0.pop(uid, None)
         # DaemonSets may live in any managed namespace (mnsdaemonset.go
         # Delete spans all of them); delete by the CD-uid label so an
         # adopted DS with a non-canonical name is torn down too.
@@ -342,41 +493,82 @@ class ComputeDomainController:
                     cq["metadata"]["name"], cq["metadata"].get("namespace", ""))
 
     # ------------------------------------------------------------------
-    # status sync (reference cdstatus.go:120-260)
+    # status sync (reference cdstatus.go:120-260, informer-triggered)
     # ------------------------------------------------------------------
 
-    def _daemon_pods_by_cd(self) -> Dict[str, List[Dict]]:
-        """Daemon pods grouped by CD uid, across all managed namespaces
-        (reference daemonsetpods.go DaemonSetPodManager.List)."""
-        by_cd: Dict[str, List[Dict]] = {}
+    def _daemon_pods_for(self, cd_uid: str) -> List[Dict]:
+        """Daemon pods for one CD. Event-driven: an O(1) lister lookup on
+        the pod informer's uid index — zero API round-trips (reference
+        daemonsetpods.go DaemonSetPodManager backed by client-go listers).
+        Poll arm: the live per-namespace LISTs the old loop paid."""
+        if self._config.event_driven:
+            out: List[Dict] = []
+            for inf in self._pod_informers:
+                out.extend(inf.by_index("cd-uid", cd_uid))
+            return out
+        return self._daemon_pods_live(cd_uid)
+
+    def _daemon_pods_live(self, cd_uid: str) -> List[Dict]:
+        """One CD's daemon pods via live label-selector LISTs — the
+        authoritative read the prune confirm (and the poll arm) uses."""
+        out: List[Dict] = []
+        for ns in self._managed_namespaces():
+            out.extend(self._clients.pods.list(
+                namespace=ns,
+                label_selector={COMPUTE_DOMAIN_LABEL_KEY: cd_uid}))
+        return out
+
+    def _cliques_for(self, cd_uid: str) -> List[Dict]:
+        """This CD's cliques (name ``<cdUID>.<cliqueID>``) from the clique
+        informer's uid index (or a live filtered LIST in the poll arm)."""
+        if self._config.event_driven:
+            return self._clique_informer.by_index("cd-uid", cd_uid)
+        return [cq for cq in self._clients.compute_domain_cliques.list()
+                if cq["metadata"]["name"].split(".", 1)[0] == cd_uid]
+
+    def _sync_cd_status(self, uid: str) -> None:
+        """One CD's status convergence, served entirely from informer
+        stores. Raising (e.g. conflict retries exhausted) re-enqueues the
+        key with the queue's backoff."""
+        cds = self._cd_informer.by_index("uid", uid)
+        if not cds:
+            return  # CD gone; orphan cleanup owns the leftovers
+        cliques = self._cliques_for(uid)
+        pods = self._daemon_pods_for(uid)
+        try:
+            self._cleanup_cliques(uid, cliques, pods)
+            self._sync_status(ComputeDomain.from_obj(cds[0]))
+        except NotFoundError:
+            pass  # deleted mid-sync; a CD event follows
+
+    def _sync_all_statuses(self) -> None:
+        """The periodic pass. Event-driven: a resync backstop that only
+        re-enqueues per-CD keys (coalescing with any pending event-driven
+        sync). Poll arm: the original full-LIST-and-sync tick."""
+        if self._config.event_driven:
+            for obj in self._cd_informer.list():
+                uid = obj["metadata"].get("uid", "")
+                if uid:
+                    self._enqueue_status(uid, "resync")
+            return
+        pods_by_cd: Dict[str, List[Dict]] = {}
         for ns in self._managed_namespaces():
             for pod in self._clients.pods.list(namespace=ns):
                 uid = (pod["metadata"].get("labels") or {}).get(
                     COMPUTE_DOMAIN_LABEL_KEY)
                 if uid:
-                    by_cd.setdefault(uid, []).append(pod)
-        return by_cd
-
-    def _cliques_by_cd(self) -> Dict[str, List[Dict]]:
-        """One cluster-wide clique LIST per tick, grouped by CD uid (the
-        clique name is ``<cdUID>.<cliqueID>``)."""
-        by_cd: Dict[str, List[Dict]] = {}
+                    pods_by_cd.setdefault(uid, []).append(pod)
+        cliques_by_cd: Dict[str, List[Dict]] = {}
         for cq_obj in self._clients.compute_domain_cliques.list():
             uid = cq_obj["metadata"]["name"].split(".", 1)[0]
-            by_cd.setdefault(uid, []).append(cq_obj)
-        return by_cd
-
-    def _sync_all_statuses(self) -> None:
-        pods_by_cd = self._daemon_pods_by_cd()
-        cliques_by_cd = self._cliques_by_cd()
+            cliques_by_cd.setdefault(uid, []).append(cq_obj)
         for obj in self._clients.compute_domains.list():
             uid = obj["metadata"].get("uid", "")
+            self._status_triggers.labels("poll").inc()
             try:
                 self._cleanup_cliques(uid, cliques_by_cd.get(uid, []),
                                       pods_by_cd.get(uid, []))
-                self._sync_status(ComputeDomain.from_obj(obj),
-                                  cliques_by_cd.get(uid, []),
-                                  pods_by_cd.get(uid, []))
+                self._sync_status(ComputeDomain.from_obj(obj))
             except (ConflictError, NotFoundError):
                 pass  # next tick
 
@@ -392,13 +584,23 @@ class ComputeDomainController:
                      if d.get("nodeName") not in running_nodes]
             if not stale:
                 continue
+            # Pruning is destructive and unrecoverable for the daemon
+            # (join() only runs at its startup), so before evicting,
+            # confirm with ONE live LIST: the pod informer's store can
+            # momentarily lag the clique event that triggered this sync
+            # (independent watch threads), and evicting a just-joined
+            # replacement daemon would strand its node. The live confirm
+            # runs only on this rare heal path — the hot status path
+            # stays lister-only.
+            confirmed_nodes = self._pod_nodes(
+                self._daemon_pods_live(cd_uid))
 
             def prune(obj):
-                # Re-list pods inside the mutate: the tick's snapshot may
-                # predate a replacement daemon's join (DS rolling update),
-                # and evicting a just-joined entry would strand the node —
-                # join() only runs at daemon startup.
-                fresh_nodes = self._pod_nodes(self._daemon_pods_for(cd_uid))
+                # Per-retry re-check from the informer's continuously-
+                # updated store (was a live per-namespace LIST on every
+                # conflict retry), unioned with the one-time live confirm.
+                fresh_nodes = (self._pod_nodes(self._daemon_pods_for(cd_uid))
+                               | confirmed_nodes)
                 daemons = obj.get("daemons") or []
                 kept = [d for d in daemons
                         if d.get("nodeName") in fresh_nodes]
@@ -419,16 +621,11 @@ class ComputeDomainController:
         nodes.discard("")
         return nodes
 
-    def _daemon_pods_for(self, cd_uid: str) -> List[Dict]:
-        out: List[Dict] = []
-        for ns in self._managed_namespaces():
-            out.extend(self._clients.pods.list(
-                namespace=ns,
-                label_selector={COMPUTE_DOMAIN_LABEL_KEY: cd_uid}))
-        return out
-
-    def _sync_status(self, cd: ComputeDomain, cliques: List[Dict],
-                     pods: List[Dict]) -> None:
+    def _compute_status(self, cd: ComputeDomain, uid: str):
+        """Desired (nodes, global_status, any-daemon-joined) from the
+        CURRENT informer stores (or live LISTs in the poll arm)."""
+        cliques = self._cliques_for(uid)
+        pods = self._daemon_pods_for(uid)
         nodes: List[ComputeDomainNodeStatus] = []
         for cq_obj in cliques:
             clique_id = cq_obj["metadata"]["name"].split(".", 1)[1]
@@ -468,13 +665,35 @@ class ComputeDomainController:
         global_status = (STATUS_READY
                          if ready >= cd.spec.num_nodes and slices_ok
                          else STATUS_NOT_READY)
+        has_daemon = any(cq.get("daemons") for cq in cliques)
+        return nodes, global_status, has_daemon
+
+    def _sync_status(self, cd: ComputeDomain) -> None:
+        uid = cd.metadata.uid
+        outcome: Dict[str, object] = {}
 
         def mutate(obj):
+            # Desired state is derived INSIDE the mutate, per attempt:
+            # with N workers a stale sync for this CD can run concurrently
+            # with (or after) a fresher one, and writing a pre-captured
+            # snapshot here would regress the fresher status until the
+            # resync backstop — status writes don't bump generation, so no
+            # event would heal it.
             cur = ComputeDomain.from_obj(obj)
+            nodes, global_status, has_daemon = self._compute_status(cur, uid)
+            outcome["status"] = global_status
+            outcome["has_daemon"] = has_daemon
             new_nodes = [n.__dict__ for n in nodes]
             old_nodes = [n.__dict__ for n in cur.status.nodes]
-            if old_nodes == new_nodes and cur.status.status == global_status:
+            # A CD with no status block yet always gets one stamped (the
+            # from_obj defaults equal the initial computed state, so a
+            # pure no-change compare would leave a fresh CD status-less
+            # until its first daemon appears).
+            if ("status" in obj and old_nodes == new_nodes
+                    and cur.status.status == global_status):
+                outcome.pop("prev_status", None)
                 return ABORT
+            outcome["prev_status"] = cur.status.status
             cur.status.nodes = nodes
             cur.status.status = global_status
             rendered = cur.to_obj()
@@ -483,3 +702,14 @@ class ComputeDomainController:
 
         self._clients.compute_domains.retry_update(
             cd.metadata.name, cd.metadata.namespace, mutate)
+        # Rendezvous clock: starts at the first observed daemon join while
+        # the CD is converging; observed when the Ready flip is written.
+        if outcome.get("status") != STATUS_READY and outcome.get("has_daemon"):
+            self._rendezvous_t0.setdefault(uid, time.monotonic())
+        if "prev_status" in outcome:
+            self._status_writes.inc()
+            if (outcome["status"] == STATUS_READY
+                    and outcome["prev_status"] != STATUS_READY):
+                t0 = self._rendezvous_t0.pop(uid, None)
+                if t0 is not None:
+                    self._rendezvous_seconds.observe(time.monotonic() - t0)

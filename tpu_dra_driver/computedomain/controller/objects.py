@@ -53,6 +53,24 @@ class TemplateError(RuntimeError):
 _SAFE_VALUE = re.compile(r"^[A-Za-z0-9._:/@\-]+$")
 
 
+# Raw template text cached per path (validated by mtime): reconciles
+# re-render on every CD event, and re-reading an unchanged file from disk
+# each time put file-IO latency on the rendezvous critical path. The
+# mtime check keeps edited templates (tests, live chart tweaks) visible.
+_template_cache: Dict[str, tuple] = {}  # path -> (mtime_ns, raw)
+
+
+def _template_text(path: str) -> str:
+    mtime = os.stat(path).st_mtime_ns
+    cached = _template_cache.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    with open(path, "r", encoding="utf-8") as fh:
+        raw = fh.read()
+    _template_cache[path] = (mtime, raw)
+    return raw
+
+
 def render_template(name: str, variables: Dict[str, str]) -> Dict:
     """Substitute ``${VAR}`` placeholders in templates/<name> and parse.
 
@@ -61,8 +79,7 @@ def render_template(name: str, variables: Dict[str, str]) -> Dict:
     structure-altered manifest applied to a cluster is worse than a
     loud failure)."""
     path = os.path.join(templates_dir(), name)
-    with open(path, "r", encoding="utf-8") as fh:
-        raw = fh.read()
+    raw = _template_text(path)
     for key, val in variables.items():
         if not _SAFE_VALUE.match(str(val)):
             raise TemplateError(

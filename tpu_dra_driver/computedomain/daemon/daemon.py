@@ -82,8 +82,16 @@ class ComputeDomainDaemon:
         self._unsub_health = None
         self._mu = threading.Lock()
         self._render_mu = threading.Lock()  # serializes _on_clique_change
+        # Render coalescing: clique watch events mark dirty; one render
+        # thread folds a burst (a multislice CD sees every sibling
+        # clique's churn) into a single re-render of hosts/worker-env +
+        # readiness re-check, instead of one file rewrite per event.
+        self._dirty = threading.Event()
+        self._render_stop = threading.Event()
+        self._render_thread: Optional[threading.Thread] = None
         self._fabric_error: Optional[HealthEvent] = None
         self._num_slices = 1
+        self._last_worker_env: Optional[Dict[str, str]] = None
         self._on_fabric_error_cb = None
         # Set on fatal fabric errors. The production entrypoint waits on
         # this and exits nonzero so Kubernetes restarts the pod — raising
@@ -110,9 +118,13 @@ class ComputeDomainDaemon:
             namespace=DRIVER_NAMESPACE,
             name_filter=name_filter)
         self._informer.add_handlers(
-            on_add=lambda o: self._on_clique_change(),
-            on_update=lambda old, new: self._on_clique_change(),
+            on_add=lambda o: self._dirty.set(),
+            on_update=lambda old, new: self._dirty.set(),
             on_delete=lambda o: None)
+        self._render_thread = threading.Thread(
+            target=self._render_loop, daemon=True,
+            name=f"cd-daemon-render-{self._config.node_name}")
+        self._render_thread.start()
         self._informer.start()
         self._informer.wait_synced()
         self._on_clique_change()
@@ -120,10 +132,14 @@ class ComputeDomainDaemon:
                  self._config.cd_uid, self.clique_id, self.index)
 
     def stop(self) -> None:
+        self._render_stop.set()
+        self._dirty.set()  # unblock the render loop promptly
         if self._unsub_health:
             self._unsub_health()
         if self._informer:
             self._informer.stop()
+        if self._render_thread is not None:
+            self._render_thread.join(timeout=2.0)
         self.membership.leave()
 
     def set_fabric_error_callback(self, cb) -> None:
@@ -149,8 +165,21 @@ class ComputeDomainDaemon:
     # peer-change handling (the IMEX-config-reload analog)
     # ------------------------------------------------------------------
 
+    def _render_loop(self) -> None:
+        """Folds event bursts: however many clique events marked dirty
+        since the last pass, exactly one re-render runs — reading the
+        LATEST membership — before the next wait."""
+        while not self._render_stop.is_set():
+            if not self._dirty.wait(timeout=0.2):
+                continue
+            self._dirty.clear()
+            try:
+                self._on_clique_change()
+            except Exception:
+                log.exception("clique re-render failed")
+
     def _on_clique_change(self) -> None:
-        # Serialized: fires from both start() and the informer watch thread;
+        # Serialized: fires from both start() and the render thread;
         # concurrent runs would race on the (pid-named) tmp files and could
         # install a stale hosts block.
         with self._render_mu:
@@ -189,28 +218,46 @@ class ComputeDomainDaemon:
         }
         if self._num_slices > 1:
             env.update(self._megascale_env())
+        if env == self._last_worker_env:
+            return  # clique churn with no identity change: skip the IO
         path = self._config.worker_env_file
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
             json.dump(env, f, indent=1, sort_keys=True)
         os.replace(tmp, path)
+        self._last_worker_env = env
 
-    def _cd_num_slices(self, attempts: int = 5, delay: float = 0.2) -> int:
-        """numSlices from our ComputeDomain's spec. Retries a transient
-        404 (API lag at daemon start) — silently caching 1 would strip a
-        multislice daemon of its wide clique watch for its whole life."""
+    def _cd_num_slices(self, timeout: float = 2.0) -> int:
+        """numSlices from our ComputeDomain's spec. A transient 404 (API
+        lag at daemon start) is bridged by WATCHING computedomains and
+        re-reading on each event instead of a fixed retry-sleep ladder —
+        silently caching 1 would strip a multislice daemon of its wide
+        clique watch for its whole life."""
         import time as _time
-        for i in range(attempts):
-            try:
-                obj = self._clients.compute_domains.get(
-                    self._config.cd_name, self._config.cd_namespace)
-                return max(1, int((obj.get("spec") or {}).get("numSlices", 1)))
-            except NotFoundError:
-                if i + 1 < attempts:
-                    _time.sleep(delay)
-            except (ValueError, TypeError):
-                break
+        # Watch-before-get closes the create/get race: a CD created after
+        # the failed get lands as an event that wakes the re-read.
+        sub = self._clients.compute_domains.watch()
+        try:
+            deadline = _time.monotonic() + timeout
+            while True:
+                try:
+                    obj = self._clients.compute_domains.get(
+                        self._config.cd_name, self._config.cd_namespace)
+                    return max(1, int((obj.get("spec") or {})
+                                      .get("numSlices", 1)))
+                except NotFoundError:
+                    pass
+                except (ValueError, TypeError):
+                    break
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                # Blocks until a computedomains event or the deadline; any
+                # event (ours or not) triggers a cheap re-read.
+                sub.next(timeout=min(remaining, 0.5))
+        finally:
+            self._clients.compute_domains.stop_watch(sub)
         log.warning("could not read numSlices for cd %s/%s; assuming 1",
                     self._config.cd_namespace, self._config.cd_name)
         return 1

@@ -254,7 +254,7 @@ class DraGrpcServer:
         # instance cannot remove the old one's sockets, and a stale
         # registration socket would keep kubelet dialing a dead endpoint.
         self._socket_paths: List[str] = []
-        self.dra_port = self._server.add_insecure_port(dra_address)
+        self.dra_port = self._bind(self._server, dra_address)
         if dra_address.startswith("unix://"):
             self._socket_paths.append(dra_address[len("unix://"):])
         if registration_address is not None:
@@ -267,11 +267,31 @@ class DraGrpcServer:
                     driver_name, endpoint_path,
                     supported_versions=self.supported_versions),
             ))
-            self.registration_port = self._reg_server.add_insecure_port(
-                registration_address)
+            self.registration_port = self._bind(self._reg_server,
+                                                registration_address)
             if registration_address.startswith("unix://"):
                 self._socket_paths.append(
                     registration_address[len("unix://"):])
+
+    @staticmethod
+    def _bind(server, address: str) -> int:
+        """Bind, unlinking a stale unix socket file first. A SIGKILLed
+        predecessor (crash-restart, the reference's pod-restart path)
+        never ran its unlink-on-stop, and binding over the leftover file
+        fails — worse, grpc reports that failure as port 0 and the server
+        would come up serving NOTHING while kubelet dials a dead socket
+        forever. Socket paths are per-instance (rolling updates use
+        unique-per-pod names), so a file already at OUR path can only be
+        a dead predecessor's."""
+        if address.startswith("unix://"):
+            try:
+                os.unlink(address[len("unix://"):])
+            except OSError:
+                pass
+        port = server.add_insecure_port(address)
+        if port == 0:
+            raise RuntimeError(f"failed to bind gRPC server to {address}")
+        return port
 
     def _plugin_healthy(self) -> bool:
         if hasattr(self._plugin, "healthy"):

@@ -63,17 +63,26 @@ class _WatchSub:
     def __init__(self, selector: Optional[Dict[str, str]]):
         self.selector = selector
         self._cond = threading.Condition()
-        self._events: List[WatchEvent] = []
+        # (event, push-time) pairs; the timestamp feeds the informer's
+        # watch-lag histogram (time an event sat queued before dispatch)
+        self._events: List[Tuple[WatchEvent, float]] = []
         self._closed = False
 
     def push(self, ev: WatchEvent) -> None:
         with self._cond:
             if self._closed:
                 return
-            self._events.append(ev)
+            self._events.append((ev, time.monotonic()))
             self._cond.notify_all()
 
     def next(self, timeout: float = 0.2) -> Optional[WatchEvent]:
+        got = self.next_with_ts(timeout=timeout)
+        return got[0] if got is not None else None
+
+    def next_with_ts(self, timeout: float = 0.2
+                     ) -> Optional[Tuple[WatchEvent, float]]:
+        """Like :meth:`next`, but returns ``(event, pushed_at)`` so the
+        consumer can observe how long the event waited in the queue."""
         with self._cond:
             if not self._events:
                 self._cond.wait(timeout=timeout)

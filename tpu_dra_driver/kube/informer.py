@@ -1,32 +1,51 @@
-"""List+watch informers with local stores and event handlers.
+"""List+watch informers with local stores, indices and event handlers.
 
 Reference analog: the generated informers/listers in pkg/nvidia.com/ plus
 client-go SharedInformer semantics the driver relies on: initial sync
 delivers ADDED for every existing object, then watch events stream; a
-local thread-safe store answers lister queries without API round-trips.
+local thread-safe store answers lister queries without API round-trips;
+named indexers (client-go ``cache.Indexers``) give O(1) grouped lookups
+(e.g. daemon pods by ComputeDomain uid) that a poll loop would otherwise
+pay a full LIST for on every tick.
 """
 
 from __future__ import annotations
 
 import copy
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.kube.fake import ADDED, DELETED, MODIFIED, RELIST, Object
+from tpu_dra_driver.pkg.metrics import (
+    INFORMER_LISTER_HITS,
+    INFORMER_WATCH_LAG,
+)
+
+#: An indexer maps an object to the index values it appears under (zero or
+#: more, client-go IndexFunc). Returning an empty iterable skips the object.
+Indexer = Callable[[Object], Iterable[str]]
+
+_Key = Tuple[str, str]  # (namespace, name)
 
 
 class Informer:
     def __init__(self, client: ResourceClient,
                  namespace: Optional[str] = None,
                  label_selector: Optional[Dict[str, str]] = None,
-                 name_filter: Optional[Callable[[str], bool]] = None):
+                 name_filter: Optional[Callable[[str], bool]] = None,
+                 indexers: Optional[Dict[str, Indexer]] = None):
         self._client = client
         self._namespace = namespace
         self._selector = label_selector
         self._name_filter = name_filter
         self._mu = threading.RLock()
-        self._store: Dict[Tuple[str, str], Object] = {}
+        self._store: Dict[_Key, Object] = {}
+        self._indexers: Dict[str, Indexer] = dict(indexers or {})
+        # index name -> value -> set of store keys
+        self._indices: Dict[str, Dict[str, set]] = {
+            name: {} for name in self._indexers}
         self._handlers: List[Tuple[Optional[Callable], Optional[Callable], Optional[Callable]]] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -51,18 +70,42 @@ class Informer:
 
     def get(self, name: str, namespace: str = "") -> Optional[Object]:
         with self._mu:
+            self._count_lister_hit()
             obj = self._store.get((namespace or "", name))
             return copy.deepcopy(obj) if obj is not None else None
 
-    def list(self, label_selector: Optional[Dict[str, str]] = None) -> List[Object]:
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Object]:
+        """Store snapshot, optionally filtered. The signature matches
+        :meth:`ResourceClient.list`'s keyword surface so an informer can
+        stand in for the live client on read paths (e.g.
+        ``multislice.live_cliques``)."""
         from tpu_dra_driver.kube.fake import match_label_selector
         with self._mu:
+            self._count_lister_hit()
             out = []
-            for obj in self._store.values():
+            for (ns, _), obj in self._store.items():
+                if namespace is not None and ns != namespace:
+                    continue
                 labels = (obj.get("metadata") or {}).get("labels") or {}
                 if match_label_selector(labels, label_selector):
                     out.append(copy.deepcopy(obj))
             return out
+
+    def by_index(self, index_name: str, value: str) -> List[Object]:
+        """Objects whose indexer emitted ``value`` (client-go ByIndex)."""
+        with self._mu:
+            self._count_lister_hit()
+            keys = self._indices[index_name].get(value) or ()
+            return [copy.deepcopy(self._store[k]) for k in sorted(keys)]
+
+    def index_values(self, index_name: str) -> List[str]:
+        """All values currently present in the named index."""
+        with self._mu:
+            return sorted(self._indices[index_name])
+
+    def _count_lister_hit(self) -> None:
+        INFORMER_LISTER_HITS.labels(self._client.resource).inc()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -74,7 +117,8 @@ class Informer:
             for obj in items:
                 if self._accept(obj):
                     meta = obj["metadata"]
-                    self._store[(meta.get("namespace", ""), meta["name"])] = obj
+                    self._store_set(
+                        (meta.get("namespace", ""), meta["name"]), obj)
             for obj in list(self._store.values()):
                 self._dispatch(ADDED, obj, None)
             self._synced.set()
@@ -84,6 +128,10 @@ class Informer:
 
     def wait_synced(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)
+
+    @property
+    def synced(self) -> bool:
+        return self._synced.is_set()
 
     def stop(self) -> None:
         self._stop.set()
@@ -102,9 +150,53 @@ class Informer:
             return False
         return True
 
+    def _store_set(self, key: _Key, obj: Object) -> None:
+        """Call with _mu held: install obj and re-index it."""
+        old = self._store.get(key)
+        self._store[key] = obj
+        for name, fn in self._indexers.items():
+            index = self._indices[name]
+            if old is not None:
+                for v in fn(old) or ():
+                    keys = index.get(v)
+                    if keys is not None:
+                        keys.discard(key)
+                        if not keys:
+                            del index[v]
+            for v in fn(obj) or ():
+                index.setdefault(v, set()).add(key)
+
+    def _store_pop(self, key: _Key) -> Optional[Object]:
+        """Call with _mu held: remove obj and de-index it."""
+        old = self._store.pop(key, None)
+        if old is not None:
+            for name, fn in self._indexers.items():
+                index = self._indices[name]
+                for v in fn(old) or ():
+                    keys = index.get(v)
+                    if keys is not None:
+                        keys.discard(key)
+                        if not keys:
+                            del index[v]
+        return old
+
+    def _next_event(self):
+        """One event off the subscription, observing queue lag when the
+        source exposes push timestamps (fake and REST subs both do)."""
+        next_with_ts = getattr(self._sub, "next_with_ts", None)
+        if next_with_ts is None:
+            return self._sub.next(timeout=0.2)
+        got = next_with_ts(timeout=0.2)
+        if got is None:
+            return None
+        ev, pushed_at = got
+        INFORMER_WATCH_LAG.labels(self._client.resource).observe(
+            time.monotonic() - pushed_at)
+        return ev
+
     def _run(self) -> None:
         while not self._stop.is_set():
-            ev = self._sub.next(timeout=0.2)
+            ev = self._next_event()
             if ev is None:
                 if self._sub.closed:
                     return
@@ -123,9 +215,9 @@ class Informer:
             with self._mu:
                 old = self._store.get(key)
                 if ev_type == DELETED:
-                    self._store.pop(key, None)
+                    self._store_pop(key)
                 else:
-                    self._store[key] = obj
+                    self._store_set(key, obj)
                 self._dispatch(ev_type, obj, old)
 
     def _resync(self, items: List[Object]) -> None:
@@ -133,7 +225,7 @@ class Informer:
         (client-go relist): emits ADDED for new objects, MODIFIED for
         changed resourceVersions, DELETED for objects gone from the list —
         so deletions that happened during the outage are not lost."""
-        fresh: Dict[Tuple[str, str], Object] = {}
+        fresh: Dict[_Key, Object] = {}
         for obj in items:
             if self._accept(obj):
                 meta = obj["metadata"]
@@ -141,14 +233,14 @@ class Informer:
         with self._mu:
             for key, obj in fresh.items():
                 old = self._store.get(key)
-                self._store[key] = obj
+                self._store_set(key, obj)
                 if old is None:
                     self._dispatch(ADDED, obj, None)
                 elif ((old.get("metadata") or {}).get("resourceVersion")
                       != (obj.get("metadata") or {}).get("resourceVersion")):
                     self._dispatch(MODIFIED, obj, old)
             for key in [k for k in self._store if k not in fresh]:
-                gone = self._store.pop(key)
+                gone = self._store_pop(key)
                 self._dispatch(DELETED, gone, None)
 
     def _dispatch(self, ev_type: str, obj: Object, old: Optional[Object]) -> None:

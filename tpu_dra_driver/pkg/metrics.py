@@ -361,6 +361,26 @@ UNPREPARE_BATCH_CLAIMS = DEFAULT_REGISTRY.histogram(
     buckets=(1, 2, 4, 8, 16, 32, 64, 128))
 
 
+# ---------------------------------------------------------------------------
+# Event-driven ComputeDomain rendezvous instrumentation. The controller
+# registers its own families (dra_cd_rendezvous_seconds,
+# dra_cd_status_sync_triggers_total, dra_cd_status_writes_total) on its
+# per-instance registry so tests can observe them in isolation; only the
+# informer-level families live here because informers have no registry
+# handle and always land on the process default.
+# ---------------------------------------------------------------------------
+
+INFORMER_WATCH_LAG = DEFAULT_REGISTRY.histogram(
+    "dra_informer_watch_lag_seconds",
+    "Time a watch event waited between arrival and informer dispatch",
+    ("resource",))
+INFORMER_LISTER_HITS = DEFAULT_REGISTRY.counter(
+    "dra_informer_lister_hits_total",
+    "Lister reads served from informer stores (each replaces an API "
+    "round-trip a poll-based sync would have paid)",
+    ("resource",))
+
+
 class QueueMetrics:
     """client-go workqueue metric set for one named queue.
 

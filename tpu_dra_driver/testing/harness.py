@@ -65,16 +65,21 @@ class ClusterHarness:
                  prepare_budget: float = 45.0,
                  slice_id: Optional[str] = None,
                  num_slices: int = 1,
-                 controller_config: Optional[ControllerConfig] = None):
+                 controller_config: Optional[ControllerConfig] = None,
+                 cd_wake_on_events: bool = True):
         self.clients = ClientSets()
         self.tmp = tmp_dir
         self.gates = gates or fg.FeatureGates()
         self.hosts: List[HostRuntime] = []
+        # The default backstop is deliberately SLOW (5 s): convergence in
+        # tests must come from the informer event path, not from a tight
+        # poll masking a broken event flow.
         self.controller = ComputeDomainController(
             self.clients,
             controller_config or ControllerConfig(
-                status_sync_interval=0.05, orphan_cleanup_interval=3600.0))
+                status_sync_interval=5.0, orphan_cleanup_interval=3600.0))
         self._daemons: Dict[str, ComputeDomainDaemon] = {}   # pod name -> daemon
+        self._boot_threads: Dict[str, threading.Thread] = {}  # pod -> boot
         self._stop = threading.Event()
         self._ds_thread: Optional[threading.Thread] = None
         self._mu = threading.Lock()
@@ -106,7 +111,8 @@ class ClusterHarness:
                 state_dir=os.path.join(tmp_dir, node, "cd-plugin"),
                 cdi_root=os.path.join(tmp_dir, node, "cdi"),
                 hosts_file_dir=hosts_dir,
-                prepare_budget=prepare_budget))
+                prepare_budget=prepare_budget,
+                wake_on_events=cd_wake_on_events))
             self.hosts.append(HostRuntime(node, lib, tpu_plugin, cd_plugin,
                                           hosts_dir))
 
@@ -125,6 +131,13 @@ class ClusterHarness:
         self._stop.set()
         if self._ds_thread:
             self._ds_thread.join(timeout=2.0)
+        # drain in-flight boots before stopping daemons (stop() must not
+        # race a still-running start())
+        with self._mu:
+            boots = list(self._boot_threads.values())
+            self._boot_threads.clear()
+        for t in boots:
+            t.join(timeout=10.0)
         with self._mu:
             for daemon in self._daemons.values():
                 try:
@@ -135,6 +148,7 @@ class ClusterHarness:
         self.controller.stop()
         for h in self.hosts:
             h.tpu_plugin.shutdown()
+            h.cd_plugin.shutdown()
 
     def host(self, i: int) -> HostRuntime:
         return self.hosts[i]
@@ -144,11 +158,37 @@ class ClusterHarness:
     # ------------------------------------------------------------------
 
     def _ds_runner(self) -> None:
-        while not self._stop.wait(0.03):
-            try:
-                self._reconcile_daemon_pods()
-            except Exception:
-                log.exception("ds-runner reconcile failed")
+        # Event-driven like the real DaemonSet controller: node label
+        # changes, DaemonSet stamps, and pod deletions wake the reconcile
+        # immediately (a 200 ms fallback tick heals missed events). The
+        # old fixed 30 ms poll put up to a tick of dead time on the
+        # rendezvous critical path.
+        wake = threading.Event()
+        watched = [(self.clients.nodes, self.clients.nodes.watch()),
+                   (self.clients.daemonsets, self.clients.daemonsets.watch()),
+                   (self.clients.pods, self.clients.pods.watch())]
+
+        def pump(sub) -> None:
+            while not self._stop.is_set():
+                if sub.next(timeout=0.2) is not None:
+                    wake.set()
+
+        pumps = [threading.Thread(target=pump, args=(sub,), daemon=True,
+                                  name="ds-runner-pump")
+                 for _, sub in watched]
+        for t in pumps:
+            t.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._reconcile_daemon_pods()
+                except Exception:
+                    log.exception("ds-runner reconcile failed")
+                wake.wait(timeout=0.2)
+                wake.clear()
+        finally:
+            for client, sub in watched:
+                client.stop_watch(sub)
 
     def _desired_daemon_pods(self) -> Dict[str, tuple]:
         """pod name -> (cd_uid, node_name, host_index)."""
@@ -172,8 +212,14 @@ class ClusterHarness:
 
     def _reconcile_daemon_pods(self) -> None:
         desired = self._desired_daemon_pods()
+        # Reap in two phases: pop under the lock, then join the boot
+        # thread and stop OUTSIDE it — stop() racing a still-running
+        # start() would strand a half-started daemon (leaked informer,
+        # post-leave clique join), and a failed boot's cleanup needs the
+        # lock we would otherwise be holding.
+        reaped: List[tuple] = []
         with self._mu:
-            # stop daemons whose pod was (force-)deleted or is undesired
+            # daemons whose pod was (force-)deleted or is undesired
             for pod_name in list(self._daemons):
                 pod_gone = False
                 try:
@@ -181,15 +227,25 @@ class ClusterHarness:
                 except NotFoundError:
                     pod_gone = True
                 if pod_gone or pod_name not in desired:
-                    daemon = self._daemons.pop(pod_name)
-                    try:
-                        daemon.stop()
-                    except Exception:
-                        pass
-                    if not pod_gone:
-                        self.clients.pods.delete_ignore_missing(
-                            pod_name, DRIVER_NAMESPACE)
-            # start missing daemons
+                    reaped.append((pod_name, self._daemons.pop(pod_name),
+                                   self._boot_threads.pop(pod_name, None),
+                                   pod_gone))
+        for pod_name, daemon, boot_thread, pod_gone in reaped:
+            if boot_thread is not None:
+                boot_thread.join(timeout=30.0)
+            try:
+                daemon.stop()
+            except Exception:
+                pass
+            if not pod_gone:
+                self.clients.pods.delete_ignore_missing(
+                    pod_name, DRIVER_NAMESPACE)
+        with self._mu:
+            # start missing daemons — in PARALLEL across nodes, like real
+            # kubelets bringing up a DaemonSet's pods independently (the
+            # serial version made daemon N's startup gate daemon N+1's,
+            # which no real cluster does and which inflated rendezvous)
+            to_start: List[tuple] = []
             for pod_name, (cd_uid, node_name, host_idx) in desired.items():
                 if pod_name in self._daemons:
                     continue
@@ -220,8 +276,32 @@ class ClusterHarness:
                     worker_env_file=os.path.join(host.hosts_dir, cd_uid,
                                                  "worker-env.json"),
                     gates=self.gates))
-                daemon.start()
+                to_start.append((pod_name, daemon))
+
+            def boot(pod_name: str, daemon: ComputeDomainDaemon) -> None:
+                try:
+                    daemon.start()
+                except Exception:
+                    log.exception("daemon for %s failed to start", pod_name)
+                    with self._mu:
+                        if self._daemons.get(pod_name) is daemon:
+                            del self._daemons[pod_name]
+                    try:
+                        daemon.stop()
+                    except Exception:
+                        pass
+                    # drop the pod so the next tick retries cleanly
+                    self.clients.pods.delete_ignore_missing(
+                        pod_name, DRIVER_NAMESPACE)
+            # Register immediately, boot asynchronously: joining the boot
+            # here would serialize the whole DS runner behind one node's
+            # startup and delay pods for labels that land meanwhile.
+            for pod_name, daemon in to_start:
                 self._daemons[pod_name] = daemon
+                t = threading.Thread(target=boot, args=(pod_name, daemon),
+                                     daemon=True, name=f"boot-{pod_name}")
+                self._boot_threads[pod_name] = t
+                t.start()
 
     # ------------------------------------------------------------------
     # conveniences
