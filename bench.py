@@ -479,6 +479,102 @@ def bench_cd_rendezvous_sweep(slice_counts=(1, 2, 4), rounds: int = 3) -> dict:
     return out
 
 
+def bench_recovery(rounds: int = 3) -> dict:
+    """Crash-recovery latency, the chaos PR's headline arms:
+
+    - **plugin kill**: the kubelet plugin dies between its write-ahead
+      and commit fsyncs (the worst instant, injected via
+      pkg/faultinject); measured = restart -> the SAME claims all
+      prepared again (rollback + re-prepare), i.e. claim-to-ready after
+      a plugin crash.
+    - **daemon kill**: a converged 2-host ComputeDomain loses a daemon
+      pod (force delete); measured = kill -> replacement daemon joined
+      at its old index AND the CD Ready with both nodes again.
+    """
+    import shutil
+
+    from tpu_dra_driver.pkg import faultinject as fi
+    from tpu_dra_driver.plugin.claims import build_allocated_claim
+    from tpu_dra_driver.testing.harness import (
+        ClusterHarness,
+        PluginCrashDrill,
+    )
+
+    plugin_lat = []
+    for r in range(rounds):
+        tmp = tempfile.mkdtemp(prefix="bench-recovery-plugin-")
+        try:
+            drill = PluginCrashDrill(tmp, node_name="bench-node")
+            plugin = drill.start()
+            claims = [build_allocated_claim(
+                f"r{r}u{i}", f"c-r{r}u{i}", "bench", [f"tpu-{i}"],
+                "bench-node") for i in range(4)]
+            fi.arm("plugin.prepare.before_commit",
+                   fi.Rule(mode="crash", nth=1))
+            crashed = plugin.prepare_resource_claims(claims)
+            assert all(res.error is not None for res in crashed.values())
+            t0 = time.monotonic()
+            drill.restart()
+            res = drill.plugin.prepare_resource_claims(claims)
+            assert all(rr.error is None for rr in res.values()), res
+            plugin_lat.append((time.monotonic() - t0) * 1e3)
+        finally:
+            fi.reset()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    daemon_lat = []
+    tmp = tempfile.mkdtemp(prefix="bench-recovery-cd-")
+    h = ClusterHarness(tmp, accelerator_type="v5p-16", prepare_budget=20.0)
+    h.start()
+    try:
+        h.create_compute_domain("cd-bench", "bench", 2, "bench-rct")
+        uid = h.clients.compute_domains.get(
+            "cd-bench", "bench")["metadata"]["uid"]
+        h.prepare_channel_claims(uid, [0, 1], "w", namespace="bench",
+                                 timeout=30.0)
+
+        def cd_ready():
+            st = h.cd_status("cd-bench", "bench")
+            return (st.get("status") == "Ready"
+                    and len(st.get("nodes") or []) == 2
+                    and all(n["status"] == "Ready" for n in st["nodes"]))
+
+        h.wait_for(cd_ready, timeout=20.0, what="initial CD Ready")
+        from tpu_dra_driver.computedomain import DRIVER_NAMESPACE
+        for _ in range(rounds):
+            victim = h.daemon_pod_names()[0]
+            old_uid = h.clients.pods.get(
+                victim, DRIVER_NAMESPACE)["metadata"]["uid"]
+
+            def replaced_and_ready():
+                try:
+                    pod = h.clients.pods.get(victim, DRIVER_NAMESPACE)
+                except Exception:  # noqa: BLE001 — pod gap mid-replace
+                    return False
+                return pod["metadata"]["uid"] != old_uid and cd_ready()
+
+            t0 = time.monotonic()
+            h.kill_daemon_pod(victim)
+            h.wait_for(replaced_and_ready, timeout=30.0,
+                       what="CD re-convergence after daemon kill")
+            daemon_lat.append((time.monotonic() - t0) * 1e3)
+    finally:
+        h.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "plugin_kill_claim_ready_ms": round(
+            statistics.median(plugin_lat), 2),
+        "daemon_kill_reconverge_ms": round(
+            statistics.median(daemon_lat), 1),
+        "rounds": rounds,
+        "note": ("plugin arm: fault-injected crash between write-ahead "
+                 "and commit, restart -> all 4 claims re-prepared; "
+                 "daemon arm: force-deleted daemon pod -> replacement "
+                 "joined + CD Ready (both nodes), in-process harness"),
+    }
+
+
 # substrings that identify a TUNNEL/TRANSPORT failure inside a
 # JaxRuntimeError; anything else (device OOM, a genuine kernel fault)
 # must not be retried — a passing retry would launder it into a clean
@@ -890,6 +986,7 @@ SUMMARY_KEYS = [
     "cd_rendezvous_speedup",
     "prep_serial8_ms", "prep_batch8_ms", "prep_batch8_speedup",
     "cel_compile_speedup",
+    "recovery_plugin_kill_ms", "recovery_daemon_kill_ms",
     "backend", "devices",
     "matmul_tflops_bf16_steady", "matmul_mfu",
     "flash_attn_tflops", "flash_vs_splash",
@@ -996,6 +1093,17 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"  rendezvous sweep failed ({type(e).__name__}: {e})")
 
+    log("[bench] crash-recovery drills (plugin kill, CD daemon kill)…")
+    recovery = {}
+    try:
+        recovery = bench_recovery()
+        log(f"  claim-to-ready after plugin kill: "
+            f"{recovery['plugin_kill_claim_ready_ms']:.1f} ms; CD "
+            f"re-convergence after daemon kill: "
+            f"{recovery['daemon_kill_reconverge_ms']:.0f} ms")
+    except Exception as e:  # noqa: BLE001
+        log(f"  recovery bench failed ({type(e).__name__}: {e})")
+
     log("[bench] accelerator microbenchmarks…")
     accel = bench_accelerator()
 
@@ -1062,6 +1170,13 @@ def main() -> int:
                 / max(row8["batch_per_claim_ms"], 1e-9), 2)}
            if row8 else {}),
         **({"cel_compile_speedup": celb["speedup"]} if celb else {}),
+        # crash-recovery arms (full evidence under the recovery key)
+        "recovery": recovery,
+        **({"recovery_plugin_kill_ms":
+                recovery["plugin_kill_claim_ready_ms"],
+            "recovery_daemon_kill_ms":
+                recovery["daemon_kill_reconverge_ms"]}
+           if recovery else {}),
         "vs_baseline_note": (
             (crossproc_note if xp50 is not None else fallback_note)
             + note_tail),

@@ -303,7 +303,53 @@ def phase_tpu_plugin(cluster: SimCluster, iterations: int) -> dict:
     log(f"perf OK: claim-to-ready p50={results['claim_to_ready_ms']['p50']}ms "
         f"p95={results['claim_to_ready_ms']['p95']}ms over {len(lat)} runs")
 
+    # -- fault drill: scripted hard-crash mid-commit (TPU_DRA_FAULTS) -------
+    # The production binary dies with os._exit(137) — SIGKILL semantics,
+    # no cleanup — BETWEEN its write-ahead and commit fsyncs, the worst
+    # instant; a clean respawn must roll the write-ahead back and serve
+    # the SAME claim (docs/chaos.md scripted-schedule drill).
+    import grpc as _grpc
     proc2.stop()
+    proc3 = node.spawn_tpu_plugin(
+        tag="-fault",
+        faults="plugin.prepare.before_commit=crash:hard@nth:1")
+    info3 = node.kubelet.register(DRIVER_NAME)
+    dra3 = node.kubelet.dra_client(info3)
+    claim_f = cluster.create_and_allocate_claim(
+        "fault-claim", "e2e", [{"name": "tpu", "count": 1,
+                                "deviceClassName": "tpu.google.com",
+                                "selectors": CHIP_SELECTOR}],
+        node_name=node.node_name)
+    uidf = claim_f["metadata"]["uid"]
+    died_mid_rpc = False
+    try:
+        dra3.node_prepare_resources([claim_f])
+    except _grpc.RpcError:
+        died_mid_rpc = True
+    if not died_mid_rpc:
+        raise HarnessError("fault drill: prepare survived a scheduled "
+                           "hard crash at plugin.prepare.before_commit")
+    wait_for(lambda: not proc3.alive, 10, "fault-injected plugin to exit")
+    rc3 = proc3.proc.returncode
+    if rc3 != 137:
+        raise HarnessError(f"fault drill: expected exit 137, got {rc3}")
+    proc4 = node.spawn_tpu_plugin(tag="-fault-restarted")
+    info4 = node.kubelet.register(DRIVER_NAME)
+    dra4 = node.kubelet.dra_client(info4)
+    resp = dra4.node_prepare_resources([claim_f])
+    if resp.claims[uidf].error:
+        raise HarnessError(f"fault drill: prepare after hard crash: "
+                           f"{resp.claims[uidf].error}")
+    _claim_finish(cluster, dra4, claim_f)
+    results["fault_drill"] = {
+        "schedule": "plugin.prepare.before_commit=crash:hard@nth:1",
+        "hard_crash_exit": rc3,
+        "rollback_prepare_after_restart": True,
+    }
+    log("fault drill OK: os._exit(137) between write-ahead and commit, "
+        "restart rolled back and served the same claim")
+
+    proc4.stop()
     results["status"] = "green"
     return results
 

@@ -232,9 +232,14 @@ class SimNode:
 
     def spawn_tpu_plugin(self, extra_args: Optional[List[str]] = None,
                          tag: str = "",
-                         cwd: Optional[str] = None) -> PluginProcess:
+                         cwd: Optional[str] = None,
+                         faults: str = "") -> PluginProcess:
         """``cwd`` selects the source tree to execute (an older checkout
-        for up/downgrade tests); defaults to this repo."""
+        for up/downgrade tests); defaults to this repo. ``faults`` is a
+        TPU_DRA_FAULTS schedule (pkg/faultinject.py) scripted into the
+        production binary — e.g. ``plugin.prepare.before_commit=
+        crash:hard@nth:1`` dies with SIGKILL semantics (os._exit(137))
+        at that exact instant, the real-process crash drill."""
         argv = ["-m", "tpu_dra_driver.cmd.tpu_kubelet_plugin",
                 "--node-name", self.node_name,
                 "--state-dir", self.state_dir,
@@ -246,15 +251,18 @@ class SimNode:
                 "--kubeconfig", self.kubeconfig,
                 "--health-port", "-1",
                 "-v", "6"] + (extra_args or [])
+        env = self.fake_env()
+        if faults:
+            env["TPU_DRA_FAULTS"] = faults
         p = PluginProcess(
             f"tpu-plugin-{self.node_name}{tag}", argv,
             os.path.join(self.log_dir, f"tpu-plugin{tag}.log"),
-            env=self.fake_env(), cwd=cwd)
+            env=env, cwd=cwd)
         self.processes.append(p)
         return p
 
     def spawn_cd_plugin(self, extra_args: Optional[List[str]] = None,
-                        tag: str = "") -> PluginProcess:
+                        tag: str = "", faults: str = "") -> PluginProcess:
         # --hosts-file-dir must be the same node dir the CD daemons use as
         # --run-dir: the plugin reads the daemon-rendered worker-env.json
         # from there (one hostPath shared by both containers on a real node)
@@ -270,10 +278,13 @@ class SimNode:
                 "--kubeconfig", self.kubeconfig,
                 "--health-port", "-1",
                 "-v", "6"] + (extra_args or [])
+        env = self.fake_env()
+        if faults:
+            env["TPU_DRA_FAULTS"] = faults
         p = PluginProcess(
             f"cd-plugin-{self.node_name}{tag}", argv,
             os.path.join(self.log_dir, f"cd-plugin{tag}.log"),
-            env=self.fake_env())
+            env=env)
         self.processes.append(p)
         return p
 

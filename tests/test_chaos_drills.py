@@ -1,0 +1,737 @@
+"""The chaos drill matrix: kill/restart every dangerous instant.
+
+For each registered fault point this suite arms a deterministic fault,
+drives the owning component into it mid-operation, treats the component
+as dead (dropped with NO cleanup — the SIGKILL analog), restarts it over
+the same durable state, and asserts the convergence invariants
+(testing/harness.py PluginCrashDrill docstring): claims reach ready
+after restart, the checkpoint is readable-or-quarantined, no prepared
+devices leak, unprepare is idempotent, and the ComputeDomain status
+converges.
+
+The DRILLED_POINTS list at the bottom is the drill matrix's coverage
+ledger (>= 12 points required by the chaos acceptance criteria).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests
+
+from tpu_dra_driver.grpc_api.server import DraGrpcClient, DraGrpcServer
+from tpu_dra_driver.kube.breaker import (
+    BreakerOpenError,
+    CircuitBreaker,
+    RetryBudget,
+)
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.errors import ApiError, GoneError
+from tpu_dra_driver.kube.fake import RELIST
+from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg.metrics import (
+    CHECKPOINT_QUARANTINED,
+    RETRY_BUDGET_EXHAUSTED,
+    SWALLOWED_ERRORS,
+)
+from tpu_dra_driver.plugin.checkpoint import PREPARE_COMPLETED, PREPARE_STARTED
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.testing.harness import (
+    ClusterHarness,
+    PluginCrashDrill,
+    drill_catalog_coverage,
+)
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+from tpu_dra_driver.tpulib.interface import (
+    HealthEvent,
+    HealthEventKind,
+    TpuLibError,
+)
+
+NODE = "drill-node"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _gates(**over):
+    g = fg.FeatureGates()
+    for k, v in over.items():
+        g.set(k, v)
+    return g
+
+
+def _claims(n=2, prefix="u", device_fmt="tpu-{i}"):
+    return [build_allocated_claim(f"{prefix}{i}", f"claim-{prefix}{i}",
+                                  "user-ns", [device_fmt.format(i=i)], NODE)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# plugin-side crash drills: prepare killed at every checkpoint boundary
+# ---------------------------------------------------------------------------
+
+PREPARE_CRASH_POINTS = [
+    "plugin.prepare.after_write_ahead",
+    "plugin.prepare.before_commit",
+    "checkpoint.write",
+    "checkpoint.fsync",
+    "checkpoint.write.torn",
+]
+
+
+@pytest.mark.parametrize("point", PREPARE_CRASH_POINTS)
+def test_drill_prepare_crash_and_restart(tmp_path, point):
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE)
+    plugin = drill.start()
+    claims = _claims(2)
+    rule = fi.arm(point, fi.Rule(mode="crash", nth=1))
+    res = plugin.prepare_resource_claims(claims)
+    assert rule.fires == 1
+    assert all(r.error is not None for r in res.values()), (
+        f"{point}: the crash must fail the in-flight batch")
+    # the live checkpoint file stayed readable at all times — even the
+    # torn write (fsync'd tmp, no rename) never corrupts the real file
+    cp = drill.plugin.state.get_checkpoint()
+    assert all(e.state == PREPARE_STARTED for e in cp.claims.values())
+    drill.restart()
+    drill.assert_recovered(claims)
+
+
+def test_drill_unprepare_crash_is_idempotent_after_restart(tmp_path):
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_gates(DynamicSubslice=True))
+    plugin = drill.start()
+    claims = _claims(1, device_fmt="tpu-{i}-ss-1c47g-0")
+    assert plugin.prepare_resource_claims(claims)["u0"].error is None
+    assert len(drill.lib.list_subslices()) == 1
+    rule = fi.arm("plugin.unprepare.before_write", fi.Rule(mode="crash", nth=1))
+    out = plugin.unprepare_resource_claims(["u0"])
+    assert rule.fires == 1 and out["u0"] is not None
+    # crash landed AFTER teardown, BEFORE the entry-removing write: the
+    # sub-slice is gone but the checkpoint still lists the claim
+    assert drill.lib.list_subslices() == []
+    assert "u0" in drill.plugin.state.get_checkpoint().claims
+    drill.restart()
+    # idempotent re-unprepare: the already-destroyed sub-slice is a
+    # clean no-op, the entry is removed, and a THIRD call stays clean
+    assert drill.plugin.unprepare_resource_claims(["u0"]) == {"u0": None}
+    assert drill.plugin.state.get_checkpoint().claims == {}
+    assert drill.plugin.unprepare_resource_claims(["u0"]) == {"u0": None}
+
+
+def test_drill_subslice_create_crash_rolls_back(tmp_path):
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_gates(DynamicSubslice=True))
+    plugin = drill.start()
+    claims = _claims(1, device_fmt="tpu-{i}-ss-1c47g-0")
+    rule = fi.arm("tpulib.create_subslice", fi.Rule(mode="crash", nth=1))
+    res = plugin.prepare_resource_claims(claims)
+    assert rule.fires == 1 and res["u0"].error is not None
+    drill.restart()
+    drill.assert_recovered(claims)
+
+
+def test_drill_enumeration_flap_fails_boot_then_recovers(tmp_path):
+    """The device library flaps for the first two enumerations: the
+    component crash-loops (constructor raises, like the real plugin pod)
+    and the THIRD boot converges cleanly."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE)
+    rule = fi.arm("tpulib.enumerate_chips",
+                  fi.Rule(mode="fail", first=2,
+                          error=lambda: TpuLibError("enumeration flap")))
+    for _ in range(2):
+        with pytest.raises(TpuLibError):
+            drill.start()
+    plugin = drill.start()
+    assert rule.fires == 2
+    assert plugin.healthy()
+    drill.assert_recovered(_claims(2))
+
+
+def test_drill_checkpoint_corruption_quarantines_not_crashloops(tmp_path):
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE)
+    plugin = drill.start()
+    claims = _claims(2)
+    assert all(r.error is None
+               for r in plugin.prepare_resource_claims(claims).values())
+    cp_path = plugin.state._cp_mgr.path
+    drill.crash()
+    with open(cp_path, "w") as f:
+        f.write("{this is not json at all")
+    q0 = CHECKPOINT_QUARANTINED.value
+    drill.restart()
+    # the next read quarantines instead of raising — no crash-loop
+    assert drill.plugin.state.get_checkpoint().claims == {}
+    assert CHECKPOINT_QUARANTINED.value - q0 == 1
+    with open(f"{cp_path}.corrupt-1") as f:
+        assert "not json" in f.read()
+    # and the node keeps serving: health ok, fresh prepares succeed
+    assert drill.plugin.healthy()
+    drill.assert_recovered(claims)
+
+
+def test_drill_corrupt_v2_salvages_intact_v1(tmp_path):
+    """Partial corruption: the v2 payload's checksum breaks but the legacy
+    v1 section still verifies — quarantine + salvage must keep every
+    COMPLETED claim (prepared-device history intact) instead of starting
+    empty."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE)
+    plugin = drill.start()
+    claims = _claims(2)
+    assert all(r.error is None
+               for r in plugin.prepare_resource_claims(claims).values())
+    cp_path = plugin.state._cp_mgr.path
+    drill.crash()
+    with open(cp_path) as f:
+        raw = json.load(f)
+    raw["v2"]["claims"]["u0"]["state"] = "Tampered"   # breaks the v2 CRC
+    with open(cp_path, "w") as f:
+        json.dump(raw, f)
+    q0 = CHECKPOINT_QUARANTINED.value
+    drill.restart()
+    cp = drill.plugin.state.get_checkpoint()
+    assert CHECKPOINT_QUARANTINED.value - q0 == 1
+    assert set(cp.claims) == {"u0", "u1"}
+    assert all(e.state == PREPARE_COMPLETED for e in cp.claims.values())
+    assert all(e.prepared_devices for e in cp.claims.values())
+    # idempotent replay returns the salvaged devices without re-preparing
+    res = drill.plugin.prepare_resource_claims(claims)
+    assert [d.canonical_name for d in res["u0"].devices] == ["tpu-0"]
+    drill.assert_recovered(claims)
+
+
+def test_drill_checkpoint_read_corrupt_rule(tmp_path):
+    """Same invariant via the fault point itself (the scripted-schedule
+    path a subprocess drill uses): one read returns mangled bytes."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE)
+    plugin = drill.start()
+    claims = _claims(1)
+    assert plugin.prepare_resource_claims(claims)["u0"].error is None
+    rule = fi.arm("checkpoint.read",
+                  fi.Rule(mode="corrupt", nth=1,
+                          mutate=lambda s: s.replace('"claims"', '"clms"')))
+    q0 = CHECKPOINT_QUARANTINED.value
+    cp = plugin.state.get_checkpoint()       # hits the corrupt read
+    assert rule.fires == 1
+    assert CHECKPOINT_QUARANTINED.value - q0 == 1
+    # every version's CRC failed on the mangled bytes -> quarantine; the
+    # on-disk file was still pristine, so salvage recovered the full
+    # state — and above all the call NEVER raises (no crash-loop)
+    assert set(cp.claims) == {"u0"}
+    assert plugin.healthy()
+    res = plugin.prepare_resource_claims(claims)
+    assert [d.canonical_name for d in res["u0"].devices] == ["tpu-0"]
+
+
+def test_drill_health_event_flood_excludes_then_restart_heals(tmp_path):
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_gates(DeviceHealthCheck=True))
+    plugin = drill.start()
+    chip = drill.lib.enumerate_chips()[0]
+    flood = [HealthEvent(HealthEventKind.HBM_ECC_ERROR, chip.uuid, i, "ecc")
+             for i in range(100)]
+    rule = fi.arm("tpulib.health_event", fi.Rule(mode="latency", seconds=0.0))
+    drill.lib.inject_health_flood(flood)
+    assert rule.calls == 100                 # every event passed the point
+    # the flood coalesced: chip excluded once, plugin alive and healthy
+    names = {d["name"] for s in drill.clients.resource_slices.list()
+             for d in s["spec"]["devices"]}
+    assert "tpu-0" not in names and "tpu-1" in names
+    assert plugin.healthy()
+    unhealthy = [d for d in plugin.device_health() if not d["healthy"]]
+    assert unhealthy and all(d["device"] == "tpu-0" for d in unhealthy)
+    # restart = servicing: the monitor resets and the chip republishes
+    drill.restart()
+    names = {d["name"] for s in drill.clients.resource_slices.list()
+             for d in s["spec"]["devices"]}
+    assert "tpu-0" in names
+    drill.assert_recovered(_claims(2))
+
+
+# ---------------------------------------------------------------------------
+# gRPC boundary drills: the server dies mid-RPC, kubelet redials
+# ---------------------------------------------------------------------------
+
+def _grpc_stack(tmp_path):
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE)
+    plugin = drill.start()
+    claims = _claims(2)
+    for c in claims:
+        drill.clients.resource_claims.create(c)
+    server = DraGrpcServer(plugin, drill.clients.resource_claims,
+                           "tpu.google.com", "localhost:0")
+    server.start()
+    client = DraGrpcClient(f"localhost:{server.dra_port}")
+    return drill, claims, server, client
+
+
+def test_drill_grpc_node_prepare_crash_then_server_restart(tmp_path):
+    import grpc
+    drill, claims, server, client = _grpc_stack(tmp_path)
+    rule = fi.arm("grpc.node_prepare", fi.Rule(mode="crash", nth=1))
+    with pytest.raises(grpc.RpcError):
+        client.node_prepare_resources(claims)
+    assert rule.fires == 1
+    client.close()
+    server.stop(0)                            # the dead pod's server
+    # kubelet redials the restarted plugin's fresh socket
+    server2 = DraGrpcServer(drill.plugin, drill.clients.resource_claims,
+                            "tpu.google.com", "localhost:0")
+    server2.start()
+    client2 = DraGrpcClient(f"localhost:{server2.dra_port}")
+    try:
+        resp = client2.node_prepare_resources(claims)
+        for c in claims:
+            uid = c["metadata"]["uid"]
+            assert not resp.claims[uid].error
+            assert resp.claims[uid].devices
+        drill.assert_no_leaked_devices()
+    finally:
+        client2.close()
+        server2.stop(0)
+
+
+def test_drill_grpc_node_unprepare_crash_then_retry_idempotent(tmp_path):
+    import grpc
+    drill, claims, server, client = _grpc_stack(tmp_path)
+    try:
+        resp = client.node_prepare_resources(claims)
+        assert all(not resp.claims[c["metadata"]["uid"]].error for c in claims)
+        rule = fi.arm("grpc.node_unprepare", fi.Rule(mode="crash", nth=1))
+        refs = [c["metadata"] for c in claims]
+        with pytest.raises(grpc.RpcError):
+            client.node_unprepare_resources(refs)
+        assert rule.fires == 1
+        # kubelet's retry: clean unprepare, then a replay stays clean
+        for _ in range(2):
+            resp = client.node_unprepare_resources(refs)
+            assert all(not resp.claims[c["metadata"]["uid"]].error
+                       for c in claims)
+        assert drill.plugin.state.get_checkpoint().claims == {}
+    finally:
+        client.close()
+        server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# REST-layer drills against a scripted stub API server
+# ---------------------------------------------------------------------------
+
+class _Stub:
+    """Minimal scripted API server for the computedomains resource."""
+
+    def __init__(self):
+        outer = self
+        self.requests = []
+        self.watch_calls = []
+        self.brownout = False
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                outer.requests.append(self.path)
+                if outer.brownout:
+                    body = json.dumps({"kind": "Status", "code": 503}).encode()
+                    self.send_response(503)
+                    self.send_header("Retry-After", "0")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if "watch=true" in self.path:
+                    outer.watch_calls.append(self.path)
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    time.sleep(0.5)
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                    return
+                body = json.dumps({
+                    "kind": "ComputeDomainList",
+                    "metadata": {"resourceVersion": "77"},
+                    "items": [{"metadata": {"name": "cd-fresh",
+                                            "namespace": "ns",
+                                            "resourceVersion": "70"}}],
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    @property
+    def url(self):
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_drill_rest_connection_reset_retries_idempotent_verbs():
+    with _Stub() as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        rule = fi.arm("rest.request",
+                      fi.Rule(mode="fail", first=1,
+                              error=lambda: requests.ConnectionError(
+                                  "connection reset by peer")))
+        items = cluster.list("computedomains")
+        assert rule.fires == 1
+        assert [o["metadata"]["name"] for o in items] == ["cd-fresh"]
+
+
+def test_drill_brownout_opens_breaker_and_health_reports_not_serving():
+    """The acceptance-criterion drill: a scripted API-server brownout
+    opens the breaker (after the retry budget runs dry), requests fail
+    FAST with no network IO, the DRA health service answers NOT_SERVING,
+    and recovery flows through a half-open probe back to SERVING."""
+    with _Stub() as stub:
+        cluster = RestCluster(
+            RestClusterConfig(server=stub.url, verify=False),
+            breaker=CircuitBreaker(failure_threshold=3, reset_timeout=0.3),
+            retry_budget=RetryBudget(capacity=3, refill_per_sec=0.0))
+
+        class _HealthPlugin:                      # the plugin's health seam
+            def healthy(self):
+                return cluster.healthy()
+
+        health_srv = DraGrpcServer(_HealthPlugin(), None, "tpu.google.com",
+                                   "localhost:0")
+        health_srv.start()
+        health_cli = DraGrpcClient(f"localhost:{health_srv.dra_port}")
+        try:
+            assert health_cli.health_check() is True
+            stub.brownout = True
+            b0 = RETRY_BUDGET_EXHAUSTED.labels("GET").value
+            with pytest.raises(ApiError):
+                cluster.list("computedomains")
+            # retries stopped on the budget, not the retry ceiling
+            assert RETRY_BUDGET_EXHAUSTED.labels("GET").value - b0 == 1
+            assert cluster.breaker.state == "open"
+            assert cluster.healthy() is False
+            assert health_cli.health_check() is False   # NOT_SERVING
+            # fail-fast: no request reaches the drowning server
+            n = len(stub.requests)
+            with pytest.raises(BreakerOpenError):
+                cluster.list("computedomains")
+            assert len(stub.requests) == n
+            # server recovers; after the reset timeout ONE half-open
+            # probe goes through and closes the breaker
+            stub.brownout = False
+            time.sleep(0.35)
+            assert cluster.breaker.state == "half_open"
+            assert [o["metadata"]["name"]
+                    for o in cluster.list("computedomains")] == ["cd-fresh"]
+            assert cluster.breaker.state == "closed"
+            assert cluster.healthy() is True
+            assert health_cli.health_check() is True    # SERVING again
+        finally:
+            health_cli.close()
+            health_srv.stop(0)
+
+
+def test_drill_watch_stream_and_relist_faults_converge_via_relist():
+    """Kill the watch stream, then kill the first relist too: the loop
+    must keep retrying the RELIST (never resume the watch around a
+    failed relist — that would silently drop outage-window deletions)
+    until it lands, then push the fresh snapshot."""
+    with _Stub() as stub:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        stream_rule = fi.arm(
+            "rest.watch.stream",
+            fi.Rule(mode="fail", first=1,
+                    error=lambda: GoneError("410: too old")))
+        relist_rule = fi.arm(
+            "rest.watch.relist",
+            fi.Rule(mode="fail", first=1,
+                    error=lambda: ApiError("503 relist brownout")))
+        sub = cluster.watch("computedomains")
+        events = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not events:
+            ev = sub.next(timeout=0.2)
+            if ev is not None:
+                events.append(ev)
+        sub.close()
+        assert stream_rule.fires == 1 and relist_rule.fires == 1
+        assert events, "RELIST never arrived after stream+relist faults"
+        ev_type, obj = events[0]
+        assert ev_type == RELIST
+        assert [o["metadata"]["name"] for o in obj["items"]] == ["cd-fresh"]
+
+
+def test_drill_informer_survives_resync_failure_and_converges():
+    clients = ClientSets()
+    clients.compute_domains.create(
+        {"metadata": {"name": "cd1", "namespace": "ns"}})
+    inf = Informer(clients.compute_domains)
+    inf.start()
+    try:
+        assert inf.wait_synced()
+        rule = fi.arm("informer.resync", fi.Rule(mode="fail", first=1))
+        s0 = SWALLOWED_ERRORS.labels("informer.resync").value
+        fresh = {"items": [{"metadata": {"name": "cd2", "namespace": "ns",
+                                         "resourceVersion": "99"}}]}
+        inf._sub.push((RELIST, dict(fresh)))
+
+        def swallowed():
+            return SWALLOWED_ERRORS.labels("informer.resync").value - s0 == 1
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not swallowed():
+            time.sleep(0.02)
+        assert swallowed(), "failed resync was not absorbed"
+        assert rule.fires == 1
+        # the informer THREAD survived; the next relist converges the store
+        inf._sub.push((RELIST, dict(fresh)))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            names = {o["metadata"]["name"] for o in inf.list()}
+            if names == {"cd2"}:
+                break
+            time.sleep(0.02)
+        assert {o["metadata"]["name"] for o in inf.list()} == {"cd2"}
+    finally:
+        inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# ComputeDomain drills: daemon + CD-plugin kill/restart mid-rendezvous
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def harness(tmp_path):
+    h = ClusterHarness(str(tmp_path), accelerator_type="v5p-16",
+                       prepare_budget=15.0)
+    h.start()
+    yield h
+    h.stop()
+
+
+def _cd_ready(harness, name="cd1", ns="user-ns", nodes=2):
+    st = harness.cd_status(name, ns)
+    return (st.get("status") == "Ready"
+            and len(st.get("nodes") or []) == nodes
+            and all(n["status"] == "Ready" for n in st["nodes"]))
+
+
+def test_drill_daemon_clique_join_crash_reforms_and_converges(harness):
+    """A daemon dies at the clique-join write: the DS runner (kubelet
+    analog) reaps the dead pod, boots a replacement, and the CD still
+    reaches Ready within the prepare budget."""
+    rule = fi.arm("daemon.clique.join", fi.Rule(mode="fail", nth=1))
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get(
+        "cd1", "user-ns")["metadata"]["uid"]
+    t0 = time.monotonic()
+    harness.prepare_channel_claims(uid, [0, 1], "w", namespace="user-ns",
+                                   timeout=30.0)
+    ready_ms = (time.monotonic() - t0) * 1e3
+    assert rule.fires == 1, "the join fault never fired"
+    harness.wait_for(lambda: _cd_ready(harness), timeout=10.0,
+                     what="CD Ready after join crash")
+    st = harness.cd_status("cd1", "user-ns")
+    assert sorted(n["index"] for n in st["nodes"]) == [0, 1]
+    assert ready_ms < 30_000
+
+
+def test_drill_daemon_kill_plus_render_fault_still_heals(harness):
+    """Converge, then kill a daemon pod while its replacement's first
+    render is scripted to fail: the render loop retries (the dirty flag
+    is re-set on failure) and the CD returns to Ready."""
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get(
+        "cd1", "user-ns")["metadata"]["uid"]
+    harness.prepare_channel_claims(uid, [0, 1], "w", namespace="user-ns",
+                                   timeout=30.0)
+    harness.wait_for(lambda: _cd_ready(harness), timeout=10.0,
+                     what="initial CD Ready")
+    rule = fi.arm("daemon.clique.render", fi.Rule(mode="fail", nth=1))
+    victim = harness.daemon_pod_names()[0]
+    t0 = time.monotonic()
+    harness.kill_daemon_pod(victim)
+    # the fault must actually land (the CD status has no observable dip:
+    # the clique keeps both members until the reap runs, so waiting on
+    # Ready alone would race the render) ...
+    harness.wait_for(lambda: rule.fires >= 1, timeout=20.0,
+                     what="render fault to fire after daemon kill")
+    # ... and the system must STILL converge back to Ready despite it
+    harness.wait_for(lambda: _cd_ready(harness), timeout=20.0,
+                     what="CD healed after daemon kill + render fault")
+    st = harness.cd_status("cd1", "user-ns")
+    assert sorted(n["index"] for n in st["nodes"]) == [0, 1]
+    assert (time.monotonic() - t0) < 40.0
+
+
+@pytest.mark.parametrize("point", ["cd.prepare.after_write_ahead",
+                                   "cd.prepare.before_commit"])
+def test_drill_cd_plugin_crash_mid_prepare_then_restart(harness, point):
+    """The CD kubelet plugin dies between its write-ahead and commit:
+    after a plugin restart over the same checkpoint, the claim reaches
+    ready and the write-ahead entry is finalized, never duplicated."""
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get(
+        "cd1", "user-ns")["metadata"]["uid"]
+    rule = fi.arm(point, fi.Rule(mode="crash", nth=1))
+    with pytest.raises(AssertionError):
+        # exactly one host's prepare crashes; the helper surfaces it
+        harness.prepare_channel_claims(uid, [0, 1], "w", namespace="user-ns",
+                                       timeout=30.0)
+    assert rule.fires == 1
+    # find the crashed host: its checkpoint still holds a non-completed
+    # write-ahead entry (after_write_ahead) or a completed-but-uncommitted
+    # one never reached disk (before_commit)
+    crashed = [i for i in (0, 1)
+               if any(e.state != PREPARE_COMPLETED for e in
+                      harness.host(i).cd_plugin.state.get_checkpoint()
+                      .claims.values())
+               or not harness.host(i).cd_plugin.state.get_checkpoint().claims]
+    assert crashed, "no host shows the crash residue"
+    for i in crashed:
+        harness.restart_host_plugins(i)
+    # kubelet re-calls Prepare for every claim; all must go ready now
+    t0 = time.monotonic()
+    harness.prepare_channel_claims(uid, [0, 1], "w", namespace="user-ns",
+                                   timeout=30.0)
+    assert (time.monotonic() - t0) < 30.0
+    harness.wait_for(lambda: _cd_ready(harness), timeout=10.0,
+                     what="CD Ready after CD-plugin restart")
+    for i in (0, 1):
+        cp = harness.host(i).cd_plugin.state.get_checkpoint()
+        states = [e.state for e in cp.claims.values()]
+        assert states == [PREPARE_COMPLETED], (i, states)
+
+
+# ---------------------------------------------------------------------------
+# review-fix regressions
+# ---------------------------------------------------------------------------
+
+def test_breaker_half_open_probe_lease_self_heals():
+    """An admitted probe whose request path dies without ever calling
+    record_success/record_failure must not wedge the breaker: the probe
+    admission is a time-bounded lease that expires after reset_timeout."""
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout=1.0,
+                       clock=lambda: t[0])
+    b.record_failure()
+    assert b.state == "open"
+    t[0] = 1.5
+    assert b.allow()                  # probe admitted... then abandoned
+    assert not b.allow()              # lease held: still fail-fast
+    t[0] = 3.0
+    assert b.allow()                  # lease expired: a NEW probe goes out
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_quarantine_never_loses_live_checkpoint_when_recovery_write_fails(
+        tmp_path):
+    """ENOSPC (or a crash) during the salvaged rewrite must leave the
+    corrupt ORIGINAL at the live path — quarantine is a copy, not a
+    rename — so a later recovery attempt still has the bytes to salvage
+    instead of silently starting from an empty checkpoint."""
+    import os
+
+    from tpu_dra_driver.plugin.checkpoint import (
+        Checkpoint,
+        CheckpointManager,
+        ClaimEntry,
+    )
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(claims={"u1": ClaimEntry(claim_uid="u1",
+                                                  state=PREPARE_COMPLETED)}))
+    with open(mgr.path) as f:
+        raw = json.load(f)
+    raw["v2"]["claims"]["u1"]["state"] = "Tampered"   # v2 CRC broken
+    with open(mgr.path, "w") as f:
+        json.dump(raw, f)
+    original = open(mgr.path).read()
+    # recovery attempt 1: the rewrite hits a full disk
+    fi.arm("checkpoint.write",
+           fi.Rule(mode="fail", first=1,
+                   error=lambda: OSError(28, "No space left on device")))
+    with pytest.raises(OSError):
+        mgr.read_or_quarantine()
+    assert open(mgr.path).read() == original, (
+        "live checkpoint must keep the corrupt original after a failed "
+        "recovery write")
+    assert open(f"{mgr.path}.corrupt-1").read() == original
+    # recovery attempt 2 (disk back): v1 salvage succeeds and persists
+    cp = mgr.read_or_quarantine()
+    assert set(cp.claims) == {"u1"}
+    assert mgr.read().claims["u1"].state == PREPARE_COMPLETED
+
+
+# ---------------------------------------------------------------------------
+# the drill matrix ledger (acceptance: >= 12 points, each drilled)
+# ---------------------------------------------------------------------------
+
+DRILLED_POINTS = [
+    "plugin.prepare.after_write_ahead",
+    "plugin.prepare.before_commit",
+    "plugin.unprepare.before_write",
+    "checkpoint.write",
+    "checkpoint.fsync",
+    "checkpoint.write.torn",
+    "checkpoint.read",
+    "tpulib.create_subslice",
+    "tpulib.enumerate_chips",
+    "tpulib.health_event",
+    "grpc.node_prepare",
+    "grpc.node_unprepare",
+    "rest.request",
+    "rest.watch.stream",
+    "rest.watch.relist",
+    "informer.resync",
+    "daemon.clique.join",
+    "daemon.clique.render",
+    "cd.prepare.after_write_ahead",
+    "cd.prepare.before_commit",
+]
+
+
+def test_drill_matrix_covers_at_least_twelve_registered_points():
+    # import every fire-site module so the catalog is complete
+    import tpu_dra_driver.computedomain.daemon.daemon  # noqa: F401
+    import tpu_dra_driver.computedomain.plugin.device_state  # noqa: F401
+    import tpu_dra_driver.grpc_api.server  # noqa: F401
+    import tpu_dra_driver.kube.informer  # noqa: F401
+    import tpu_dra_driver.kube.rest  # noqa: F401
+    import tpu_dra_driver.plugin.device_state  # noqa: F401
+    import tpu_dra_driver.tpulib.fake  # noqa: F401
+    assert len(DRILLED_POINTS) >= 12
+    unregistered = [p for p in DRILLED_POINTS if p not in fi.catalog()]
+    assert not unregistered, f"drilled but not registered: {unregistered}"
+    # undrilled registered points are reported (tpulib's long tail of op
+    # points is acceptable; the core driver boundaries must all be hit).
+    # Only production namespaces count — unit tests register scratch
+    # points (p.*) that are not part of the matrix.
+    prod = ("rest.", "informer.", "checkpoint.", "plugin.", "cd.",
+            "grpc.", "daemon.", "tpulib.")
+    gap = [p for p in drill_catalog_coverage(DRILLED_POINTS)
+           if p.startswith(prod)]
+    assert all(p.startswith("tpulib.") for p in gap), (
+        f"non-tpulib fault points without a drill: {gap}")

@@ -1,0 +1,126 @@
+"""CheckpointCleanupManager coverage (reference cleanup.go:34-282): the
+orphaned-claim sweep's three prongs — ResourceClaim gone (NotFound),
+deleted-and-recreated under the same name (UID mismatch), and the
+sweep racing a live prepare without ever unpreparing a fresh claim."""
+
+import pytest
+
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.checkpoint import PREPARE_COMPLETED
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.plugin.cleanup import CheckpointCleanupManager
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+NODE = "node-a"
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    p = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name=NODE,
+        state_dir=str(tmp_path / "plugin-state"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=fg.FeatureGates()))
+    p.start()
+    yield p
+    p.shutdown()
+
+
+def _claim(uid, devices, name=None):
+    return build_allocated_claim(uid, name or f"claim-{uid}", "user-ns",
+                                 devices, NODE)
+
+
+def _prepare(plugin, claim):
+    res = plugin.prepare_resource_claims([claim])
+    uid = claim["metadata"]["uid"]
+    assert res[uid].error is None, res[uid].error
+    return uid
+
+
+def test_sweep_unprepares_claim_whose_resourceclaim_is_gone(plugin):
+    """NotFound prong: the checkpointed claim's ResourceClaim no longer
+    exists anywhere — the sweep tears it down."""
+    _prepare(plugin, _claim("gone", ["tpu-0"]))
+    assert "gone" in plugin.state.get_checkpoint().claims
+    cleaned = plugin.cleanup.sweep_once()
+    assert cleaned == ["gone"]
+    assert plugin.state.get_checkpoint().claims == {}
+
+
+def test_sweep_unprepares_uid_mismatch_but_keeps_live_claim(plugin):
+    """UID-mismatch prong: a claim deleted and recreated under the SAME
+    name is a different incarnation — the old prepared state must go;
+    a claim whose live object still matches must stay."""
+    clients = plugin._clients
+    # stale: API object exists under the same name with a DIFFERENT uid
+    stale = _claim("old-uid", ["tpu-0"], name="shared-name")
+    _prepare(plugin, stale)
+    recreated = _claim("new-uid", ["tpu-1"], name="shared-name")
+    clients.resource_claims.create(recreated)
+    # live: API object matches its checkpointed uid
+    live = _claim("live-uid", ["tpu-2"])
+    clients.resource_claims.create(live)
+    _prepare(plugin, live)
+
+    cleaned = plugin.cleanup.sweep_once()
+    assert cleaned == ["old-uid"]
+    cp = plugin.state.get_checkpoint()
+    assert set(cp.claims) == {"live-uid"}
+    assert cp.claims["live-uid"].state == PREPARE_COMPLETED
+
+
+def test_sweep_racing_live_prepare_never_unprepares_fresh_claim(plugin):
+    """The dangerous interleaving: the sweep snapshots the checkpoint
+    with the OLD incarnation's uid, and the fresh incarnation's prepare
+    lands BEFORE the sweep reaches its unprepare. The sweep must tear
+    down only the old uid — the fresh claim's prepared state (and its
+    device) must survive untouched."""
+    import unittest.mock as mock
+
+    clients = plugin._clients
+    _prepare(plugin, _claim("old-uid", ["tpu-0"], name="shared-name"))
+    fresh = _claim("new-uid", ["tpu-1"], name="shared-name")
+    real_get = clients.resource_claims.get
+    raced = {"done": False}
+
+    def get_and_race(name, namespace=""):
+        # the sweep's staleness check runs; before its unprepare, the
+        # recreated claim's create + kubelet prepare land
+        if not raced["done"]:
+            raced["done"] = True
+            clients.resource_claims.create(fresh)
+            _prepare(plugin, fresh)
+        return real_get(name, namespace)
+
+    with mock.patch.object(plugin.cleanup, "_claims") as claims_mock:
+        claims_mock.get.side_effect = get_and_race
+        cleaned = plugin.cleanup.sweep_once()
+
+    # only the old incarnation was swept; the fresh one survived intact
+    assert cleaned == ["old-uid"]
+    cp = plugin.state.get_checkpoint()
+    assert set(cp.claims) == {"new-uid"}
+    assert cp.claims["new-uid"].state == PREPARE_COMPLETED
+    # its device is still prepared: a re-prepare is an idempotent cache
+    # hit, proving the sweep never touched the fresh claim
+    res = plugin.prepare_resource_claims([fresh])
+    assert res["new-uid"].error is None and res["new-uid"].cdi_device_ids
+
+
+def test_sweep_survives_api_errors_and_retries_next_pass(plugin):
+    """A flaky API mid-sweep must not tear anything down spuriously: an
+    unexpected error skips the pass (logged by the run loop), and the
+    next sweep converges."""
+    _prepare(plugin, _claim("gone", ["tpu-0"]))
+    import unittest.mock as mock
+    with mock.patch.object(plugin.cleanup, "_claims") as claims_mock:
+        claims_mock.get.side_effect = RuntimeError("apiserver brownout")
+        with pytest.raises(RuntimeError):
+            plugin.cleanup.sweep_once()
+    assert "gone" in plugin.state.get_checkpoint().claims   # nothing swept
+    assert plugin.cleanup.sweep_once() == ["gone"]

@@ -30,6 +30,8 @@ def test_sim_e2e_tpu_plugin_quick(tmp_path):
     assert tp["status"] == "green"
     assert tp["t1"]["cdi_valid"] and tp["t2"]["idempotent"] and tp["t3"]["distinct"]
     assert tp["crash_recovery"]["unprepare_after_restart"]
+    assert tp["fault_drill"]["hard_crash_exit"] == 137
+    assert tp["fault_drill"]["rollback_prepare_after_restart"]
     assert tp["t5"]["quantity_selector_allocated"]
     assert tp["t6"]["string_selector_allocated"]
     assert tp["claim_to_ready_ms"]["p50"] > 0

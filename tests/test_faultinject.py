@@ -1,0 +1,203 @@
+"""Unit semantics of the deterministic fault-injection subsystem:
+schedule determinism, env scripting, the fired-fault counter, and —
+load-bearing for production — the zero-overhead disabled fast path
+(guarded by a no-lookup assertion AND a generous microbench, per the
+chaos acceptance criteria)."""
+
+import time
+
+import pytest
+
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import FAULT_INJECTIONS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def test_fail_nth_is_deterministic():
+    rule = fi.arm("p.a", fi.Rule(mode="fail", nth=3))
+    fi.fire("p.a")
+    fi.fire("p.a")
+    with pytest.raises(fi.FaultInjected):
+        fi.fire("p.a")
+    fi.fire("p.a")                       # only the 3rd call fires
+    assert rule.calls == 4 and rule.fires == 1
+
+
+def test_fail_first_k_then_recover():
+    rule = fi.arm("p.b", fi.Rule(mode="fail", first=2))
+    for _ in range(2):
+        with pytest.raises(fi.FaultInjected):
+            fi.fire("p.b")
+    fi.fire("p.b")
+    fi.fire("p.b")
+    assert rule.fires == 2
+
+
+def test_every_nth_and_max_fires():
+    rule = fi.arm("p.c", fi.Rule(mode="fail", every=2, max_fires=2))
+    outcomes = []
+    for _ in range(8):
+        try:
+            fi.fire("p.c")
+            outcomes.append("ok")
+        except fi.FaultInjected:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "boom", "ok", "boom", "ok", "ok", "ok", "ok"]
+    assert rule.fires == 2
+
+
+def test_seeded_probability_is_reproducible():
+    seq = []
+    for _ in range(2):
+        fi.reset()
+        fi.arm("p.d", fi.Rule(mode="fail", probability=0.5, seed=42))
+        run = []
+        for _ in range(20):
+            try:
+                fi.fire("p.d")
+                run.append(0)
+            except fi.FaultInjected:
+                run.append(1)
+        seq.append(tuple(run))
+    assert seq[0] == seq[1]
+    assert 0 < sum(seq[0]) < 20          # it does both fire and pass
+
+
+def test_latency_and_corrupt_modes():
+    fi.arm("p.lat", fi.Rule(mode="latency", seconds=0.05, nth=1))
+    t0 = time.monotonic()
+    fi.fire("p.lat")
+    assert time.monotonic() - t0 >= 0.045
+    fi.arm("p.cor", fi.Rule(mode="corrupt", mutate=lambda s: s + "!"))
+    assert fi.fire("p.cor", payload="data") == "data!"
+
+
+def test_crash_mode_raises_crash_injected():
+    fi.arm("p.crash", fi.Rule(mode="crash"))
+    with pytest.raises(fi.CrashInjected):
+        fi.fire("p.crash")
+    assert issubclass(fi.CrashInjected, fi.FaultInjected)
+
+
+def test_custom_error_factory():
+    fi.arm("p.err", fi.Rule(mode="fail", error=lambda: OSError(28, "ENOSPC")))
+    with pytest.raises(OSError, match="ENOSPC"):
+        fi.fire("p.err")
+
+
+def test_fired_faults_counted_per_point_and_mode():
+    before = FAULT_INJECTIONS.labels("p.m", "fail").value
+    fi.arm("p.m", fi.Rule(mode="fail", first=3))
+    for _ in range(3):
+        with pytest.raises(fi.FaultInjected):
+            fi.fire("p.m")
+    fi.fire("p.m")
+    assert FAULT_INJECTIONS.labels("p.m", "fail").value - before == 3
+
+
+def test_register_is_idempotent_and_cataloged():
+    fi.register("p.cat", "first description")
+    fi.register("p.cat")                 # no description loss
+    assert fi.catalog()["p.cat"] == "first description"
+    # production modules register their points at import time
+    import tpu_dra_driver.computedomain.daemon.clique  # noqa: F401
+    import tpu_dra_driver.grpc_api.server  # noqa: F401
+    import tpu_dra_driver.kube.rest  # noqa: F401
+    import tpu_dra_driver.plugin.device_state  # noqa: F401
+    for expected in ("rest.request", "checkpoint.write.torn",
+                     "plugin.prepare.before_commit",
+                     "daemon.clique.join", "grpc.node_prepare"):
+        assert expected in fi.catalog(), expected
+
+
+# ---------------------------------------------------------------------------
+# env scripting (the subprocess-drill seam)
+# ---------------------------------------------------------------------------
+
+def test_parse_rules_full_grammar():
+    rules = fi.parse_rules(
+        "checkpoint.write.torn=crash:hard@nth:2,"
+        "rest.request=fail:conn reset@first:3,"
+        "tpulib.enumerate_chips=latency:0.25@every:5,"
+        "checkpoint.read=corrupt@p:0.5:seed:7")
+    torn = rules["checkpoint.write.torn"]
+    assert torn.mode == "crash" and torn.hard and torn.nth == 2
+    req = rules["rest.request"]
+    assert req.mode == "fail" and req.first == 3
+    assert str(req.error()) == "conn reset"
+    lat = rules["tpulib.enumerate_chips"]
+    assert lat.mode == "latency" and lat.seconds == 0.25 and lat.every == 5
+    cor = rules["checkpoint.read"]
+    assert cor.mode == "corrupt" and cor.probability == 0.5 and cor.seed == 7
+
+
+def test_parse_rules_rejects_typos_loudly():
+    for bad in ("point", "p=explode", "p=latency", "p=fail@sometimes:2"):
+        with pytest.raises(ValueError):
+            fi.parse_rules(bad)
+
+
+def test_arm_from_env_arms_and_counts():
+    n = fi.arm_from_env({fi.ENV_VAR: "p.env=fail@nth:1"})
+    assert n == 1 and fi.armed()
+    with pytest.raises(fi.FaultInjected):
+        fi.fire("p.env")
+    assert fi.arm_from_env({}) == 0
+
+
+def test_default_corruptor_breaks_checksums():
+    assert fi.default_corruptor(b"abc") != b"abc"
+    assert fi.default_corruptor("abc") != "abc"
+    assert fi.default_corruptor("") and fi.default_corruptor(b"")
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead disabled contract (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class _ExplodingPoints(dict):
+    """Any registry access while disabled is a contract violation."""
+
+    def __getitem__(self, k):
+        raise AssertionError("disabled fire() touched the registry")
+
+    def get(self, *a):
+        raise AssertionError("disabled fire() touched the registry")
+
+    def setdefault(self, *a):
+        raise AssertionError("disabled fire() touched the registry")
+
+
+def test_disabled_fire_never_touches_registry(monkeypatch):
+    assert not fi.armed()
+    monkeypatch.setattr(fi, "_POINTS", _ExplodingPoints())
+    payload = object()
+    for _ in range(1000):
+        assert fi.fire("rest.request", payload=payload) is payload
+
+
+def test_disabled_fire_microbench():
+    """Generous absolute bound: 100k disabled fire() calls in well under
+    a second (observed ~20 ms) — a regression that adds locking or dict
+    lookups to the disabled path trips this long before it hurts prod."""
+    assert not fi.armed()
+    t0 = time.monotonic()
+    for _ in range(100_000):
+        fi.fire("plugin.prepare.before_commit")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.0, f"disabled fire() took {elapsed:.3f}s per 100k"
+
+
+def test_disarm_restores_noop():
+    fi.arm("p.off", fi.Rule(mode="fail"))
+    with pytest.raises(fi.FaultInjected):
+        fi.fire("p.off")
+    fi.disarm("p.off")
+    assert not fi.armed()
+    fi.fire("p.off")                     # clean no-op again

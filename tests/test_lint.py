@@ -185,6 +185,81 @@ def _sleep_calls(path):
     return out
 
 
+# ---------------------------------------------------------------------------
+# swallowed-exception guard for the reconcile/prepare paths (chaos PR)
+# ---------------------------------------------------------------------------
+
+# A broad `except Exception` on a reconcile or prepare path is how crash
+# bugs hide: the error is logged once and the system silently stops
+# converging. The chaos drill suite (tests/test_chaos_drills.py) can only
+# assert convergence for failures it can SEE, so every broad handler in
+# these trees must do one of:
+#
+#   1. re-raise (contains a `raise`),
+#   2. count the swallow in a metric (a `.inc(` / `.observe(` call —
+#      dra_swallowed_errors_total is the standard family), or
+#   3. carry an explicit `# chaos-ok: <reason>` on its `except` line,
+#      stating why absorbing the error is correct (e.g. "surfaced to
+#      kubelet per-claim").
+_BROAD_EXCEPT_DIRS = (
+    os.path.join("tpu_dra_driver", "plugin"),
+    os.path.join("tpu_dra_driver", "computedomain"),
+    os.path.join("tpu_dra_driver", "kube"),
+)
+
+
+def _unaccounted_broad_handlers(path):
+    import ast
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught = node.type
+        names = []
+        if isinstance(caught, ast.Name):
+            names = [caught.id]
+        elif isinstance(caught, ast.Tuple):
+            names = [e.id for e in caught.elts if isinstance(e, ast.Name)]
+        elif caught is None:
+            names = ["BaseException"]      # bare except
+        if not ({"Exception", "BaseException"} & set(names)):
+            continue
+        if "# chaos-ok:" in lines[node.lineno - 1]:
+            continue
+        body_ok = False
+        for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(sub, ast.Raise):
+                body_ok = True
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("inc", "observe")):
+                body_ok = True
+        if not body_ok:
+            out.append((path, node.lineno))
+    return out
+
+
+def test_broad_exception_handlers_reraise_count_or_are_annotated():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    offenders = []
+    for rel in _BROAD_EXCEPT_DIRS:
+        root = os.path.join(repo, rel)
+        for dirpath, _, files in os.walk(root):
+            for name in files:
+                if name.endswith(".py"):
+                    offenders.extend(
+                        _unaccounted_broad_handlers(
+                            os.path.join(dirpath, name)))
+    assert offenders == [], (
+        "broad `except Exception` on a reconcile/prepare path must "
+        "re-raise, increment a metric (dra_swallowed_errors_total), or "
+        f"carry `# chaos-ok: <reason>` on the except line: {offenders}")
+
+
 def test_no_sleep_polling_in_cd_reconcile_paths():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     offenders = []

@@ -91,6 +91,103 @@ class StubApiServer:
         self.server.server_close()
 
 
+class Http410StubApiServer:
+    """Watch dial #1 answers HTTP 410 Gone AT THE HTTP LAYER (a stale
+    resourceVersion rejected before any stream starts — distinct from the
+    in-stream ERROR event). Dial #2+ holds the stream open. List returns
+    one fresh item at resourceVersion 60."""
+
+    def __init__(self):
+        outer = self
+        self.watch_calls = []
+        self.list_calls = 0
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if "watch=true" in self.path:
+                    outer.watch_calls.append(self.path)
+                    if len(outer.watch_calls) == 1:
+                        body = json.dumps({
+                            "kind": "Status", "code": 410,
+                            "reason": "Expired",
+                            "message": "too old resource version"}).encode()
+                        self.send_response(410)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    time.sleep(0.5)
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                    return
+                outer.list_calls += 1
+                body = json.dumps({
+                    "kind": "ComputeDomainList",
+                    "metadata": {"resourceVersion": "60"},
+                    "items": [{"metadata": {"name": "cd3", "namespace": "ns",
+                                            "resourceVersion": "55"}}],
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    @property
+    def url(self):
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_watch_http_410_on_dial_relists_instead_of_surfacing():
+    """An HTTP 410 on the watch GET itself (stale resume RV, etcd
+    compacted) must never reach the caller as an error: the loop relists,
+    pushes the RELIST snapshot, and resumes from the list's RV."""
+    stub = Http410StubApiServer()
+    stub.start()
+    try:
+        cluster = RestCluster(RestClusterConfig(server=stub.url, verify=False))
+        sub = cluster.watch("computedomains")
+        events = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not events:
+            ev = sub.next(timeout=0.2)
+            if ev is not None:
+                events.append(ev)
+        while time.monotonic() < deadline and len(stub.watch_calls) < 2:
+            time.sleep(0.05)
+        sub.close()
+
+        assert events and events[0][0] == RELIST
+        assert [o["metadata"]["name"]
+                for o in events[0][1]["items"]] == ["cd3"]
+        assert stub.list_calls == 1
+        # the re-dial resumed from the fresh list RV, not the stale one
+        assert len(stub.watch_calls) >= 2
+        assert "resourceVersion=60" in stub.watch_calls[1]
+    finally:
+        stub.stop()
+
+
 def test_watch_410_triggers_relist_not_error_forwarding():
     stub = StubApiServer()
     stub.start()

@@ -12,6 +12,7 @@ import sys
 import threading
 from typing import List, Optional
 
+from tpu_dra_driver.pkg import faultinject
 from tpu_dra_driver.common import dump_config, install_stack_dump_handler
 from tpu_dra_driver.computedomain.controller.controller import (
     ComputeDomainController,
@@ -79,6 +80,9 @@ def build_parser() -> EnvArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(args.verbosity)
+    # chaos drills script faults into production binaries via
+    # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
+    faultinject.arm_from_env()
     install_stack_dump_handler()
     dump_config("compute-domain-controller", config_dict(args))
 

@@ -18,6 +18,7 @@ import sys
 import threading
 from typing import List, Optional
 
+from tpu_dra_driver.pkg import faultinject
 from tpu_dra_driver.common import dump_config, install_stack_dump_handler
 from tpu_dra_driver.computedomain.daemon.daemon import (
     ComputeDomainDaemon,
@@ -75,6 +76,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0 if os.path.exists(ready_path) else 1
 
     setup_logging(args.verbosity)
+    # chaos drills script faults into production binaries via
+    # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
+    faultinject.arm_from_env()
     install_stack_dump_handler()
     dump_config("compute-domain-daemon", config_dict(args))
     for req in ("compute_domain_uid", "node_name", "pod_ip"):

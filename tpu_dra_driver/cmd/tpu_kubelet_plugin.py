@@ -13,6 +13,7 @@ import sys
 import threading
 from typing import List, Optional
 
+from tpu_dra_driver.pkg import faultinject
 from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.common import dump_config, install_stack_dump_handler
 from tpu_dra_driver.grpc_api.server import DraGrpcServer
@@ -87,6 +88,9 @@ def make_clients(args) -> ClientSets:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(args.verbosity)
+    # chaos drills script faults into production binaries via
+    # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
+    faultinject.arm_from_env()
     install_stack_dump_handler()
     dump_config("tpu-kubelet-plugin", config_dict(args))
     if not args.node_name:

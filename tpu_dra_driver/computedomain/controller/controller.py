@@ -224,7 +224,9 @@ class ComputeDomainController:
             try:
                 fn()
             except Exception:
-                log.exception("periodic task failed")
+                from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+                SWALLOWED_ERRORS.labels("controller.periodic").inc()
+                log.exception("periodic task failed (retried next tick)")
             if self._stop.wait(interval):
                 return
 
@@ -344,6 +346,8 @@ class ComputeDomainController:
                                    "uid": cd.metadata.uid},
             })
         except Exception:
+            from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+            SWALLOWED_ERRORS.labels("controller.emit_event").inc()
             log.exception("failed to emit event for %s", cd.metadata.name)
 
     def _ensure_finalizer(self, cd: ComputeDomain) -> None:

@@ -24,8 +24,14 @@ from tpu_dra_driver.api.types import (
 from tpu_dra_driver.computedomain import DRIVER_NAMESPACE
 from tpu_dra_driver.kube.client import ABORT, ResourceClient
 from tpu_dra_driver.kube.errors import AlreadyExistsError, NotFoundError
+from tpu_dra_driver.pkg import faultinject as fi
 
 log = logging.getLogger(__name__)
+
+fi.register("daemon.clique.join",
+            "the clique join/re-join write (fail = daemon boot dies "
+            "mid-rendezvous; the DS runner/kubelet restarts the pod and "
+            "the clique must re-form with stable indices)")
 
 
 def gap_filled_index(existing: list[int]) -> int:
@@ -61,6 +67,7 @@ class CliqueMembership:
 
     def join(self) -> int:
         """Join (or re-join) the clique; returns the stable index."""
+        fi.fire("daemon.clique.join", payload=self.name)
         self.ensure_clique_exists()
         result: dict = {}
 

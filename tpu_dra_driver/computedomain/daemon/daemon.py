@@ -39,10 +39,17 @@ from tpu_dra_driver.computedomain.daemon.dnsnames import (
 from tpu_dra_driver.kube.client import ABORT, ClientSets
 from tpu_dra_driver.kube.errors import NotFoundError
 from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
 from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind, TpuLib
 
 log = logging.getLogger(__name__)
+
+fi.register("daemon.clique.render",
+            "one hosts/worker-env re-render pass (fail = render dies "
+            "mid-burst; the render loop must retry until the files "
+            "reflect the latest membership)")
 
 CLIQUE_ID_LABEL_KEY = "resource.tpu.google.com/cliqueID"
 
@@ -175,14 +182,21 @@ class ComputeDomainDaemon:
             self._dirty.clear()
             try:
                 self._on_clique_change()
-            except Exception:
-                log.exception("clique re-render failed")
+            except Exception:  # chaos-ok: counted + dirty re-set for retry
+                SWALLOWED_ERRORS.labels("daemon.clique.render").inc()
+                log.exception("clique re-render failed; will retry")
+                # the event that marked dirty is consumed: without a
+                # re-set a failed render would strand stale hosts files
+                # until the NEXT membership change (which may never come)
+                self._render_stop.wait(0.2)    # backoff, stop-interruptible
+                self._dirty.set()
 
     def _on_clique_change(self) -> None:
         # Serialized: fires from both start() and the render thread;
         # concurrent runs would race on the (pid-named) tmp files and could
         # install a stale hosts block.
         with self._render_mu:
+            fi.fire("daemon.clique.render", payload=self._config.cd_uid)
             cq = self.membership.get()
             if cq is None:
                 return

@@ -50,6 +50,7 @@ from tpu_dra_driver.computedomain.plugin.devices import (
 )
 from tpu_dra_driver.kube.client import ABORT, ClientSets
 from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg.flock import Flock, FlockOptions
 from tpu_dra_driver.plugin.checkpoint import (
     Checkpoint,
@@ -69,6 +70,14 @@ from tpu_dra_driver.plugin.device_state import PermanentError
 from tpu_dra_driver.tpulib.interface import TpuLib
 
 log = logging.getLogger(__name__)
+
+fi.register("cd.prepare.after_write_ahead",
+            "between the CD claim's PrepareStarted write-ahead and the "
+            "CDI spec write (crash = write-ahead persisted, no CDI spec; "
+            "restart re-prepares idempotently)")
+fi.register("cd.prepare.before_commit",
+            "between the CDI spec write and the PrepareCompleted commit "
+            "(crash = CDI spec on disk but checkpoint says started)")
 
 
 class RetryableError(Exception):
@@ -107,7 +116,7 @@ class CdDeviceState:
         # checkpoint IO) without a per-attempt flock + read. Seeded from
         # disk once; prepare/unprepare keep it current.
         with self._cp_locked():
-            cp = self._cp_mgr.read()
+            cp = self._cp_mgr.read_or_quarantine()
         self._completed = {uid for uid, e in cp.claims.items()
                            if e.state == PREPARE_COMPLETED}
 
@@ -116,7 +125,7 @@ class CdDeviceState:
 
     def get_checkpoint(self) -> Checkpoint:
         with self._cp_locked():
-            return self._cp_mgr.read()
+            return self._cp_mgr.read_or_quarantine()
 
     def precheck(self, claim: ClaimInfo) -> None:
         """Run the readiness gates alone — informer-store reads plus the
@@ -140,7 +149,7 @@ class CdDeviceState:
 
     def prepare(self, claim: ClaimInfo) -> List[PreparedDevice]:
         with self._mu, self._cp_locked():
-            cp = self._cp_mgr.read()
+            cp = self._cp_mgr.read_or_quarantine()
             entry = cp.claims.get(claim.uid)
             if entry is not None and entry.state == PREPARE_COMPLETED:
                 backfill_pools(entry, claim)
@@ -161,6 +170,7 @@ class CdDeviceState:
                 claim_uid=claim.uid, claim_name=claim.name,
                 namespace=claim.namespace, state=PREPARE_STARTED)
             self._cp_mgr.write(cp)
+            fi.fire("cd.prepare.after_write_ahead")
             qualified = self._cdi.write_claim_spec(claim.uid, cdi_devices,
                                                    extra_common=extra)
             for dev, qname in zip(prepared, qualified):
@@ -169,13 +179,14 @@ class CdDeviceState:
                 claim_uid=claim.uid, claim_name=claim.name,
                 namespace=claim.namespace, state=PREPARE_COMPLETED,
                 prepared_devices=prepared)
+            fi.fire("cd.prepare.before_commit")
             self._cp_mgr.write(cp)
             self._completed.add(claim.uid)
             return prepared
 
     def unprepare(self, claim_uid: str) -> None:
         with self._mu, self._cp_locked():
-            cp = self._cp_mgr.read()
+            cp = self._cp_mgr.read_or_quarantine()
             self._completed.discard(claim_uid)
             if claim_uid not in cp.claims:
                 return

@@ -131,7 +131,7 @@ class CdKubeletPlugin:
             self._lib.slice_id()
             self.state.get_checkpoint()
             return True
-        except Exception:
+        except Exception:  # chaos-ok: health probe converts to NOT_SERVING
             log.exception("healthcheck failed")
             return False
 
@@ -215,7 +215,7 @@ class CdKubeletPlugin:
                     # window per wake re-checks once per cluster instead
                     # of once per event.
                     _PAUSE.wait(timeout=0.003)
-            except Exception as e:
+            except Exception as e:  # chaos-ok: surfaced to kubelet, retried
                 log.exception("prepare %s failed", claim.canonical)
                 return PrepareResult(error=str(e), permanent=False)
 
@@ -225,7 +225,7 @@ class CdKubeletPlugin:
             try:
                 self.state.unprepare(uid)
                 out[uid] = None
-            except Exception as e:
+            except Exception as e:  # chaos-ok: surfaced to kubelet, retried
                 log.exception("unprepare %s failed", uid)
                 out[uid] = str(e)
         return out

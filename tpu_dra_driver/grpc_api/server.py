@@ -33,8 +33,15 @@ from tpu_dra_driver.grpc_api import health_v1_pb2 as health_pb
 from tpu_dra_driver.grpc_api import pluginregistration_v1_pb2 as reg_pb
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.pkg import faultinject as fi
 
 log = logging.getLogger(__name__)
+
+fi.register("grpc.node_prepare",
+            "NodePrepareResources at the gRPC boundary (fail = kubelet "
+            "sees an RPC error and retries the whole batch)")
+fi.register("grpc.node_unprepare",
+            "NodeUnprepareResources at the gRPC boundary")
 
 # Full gRPC service names — the method paths kubelet actually dials
 # (reference vendor k8s.io/kubelet/pkg/apis/dra/{v1,v1beta1}/api.pb.go
@@ -119,6 +126,7 @@ def _dra_handlers(plugin, claims_client: ResourceClient,
     dra_pb = _DRA_PB[api_version]
 
     def node_prepare(request, context):
+        fi.fire("grpc.node_prepare")
         response = dra_pb.NodePrepareResourcesResponse()
         full_claims: List[Dict] = []
         missing: Dict[str, str] = {}
@@ -151,6 +159,7 @@ def _dra_handlers(plugin, claims_client: ResourceClient,
         return response
 
     def node_unprepare(request, context):
+        fi.fire("grpc.node_unprepare")
         response = dra_pb.NodeUnprepareResourcesResponse()
         results = plugin.unprepare_resource_claims(
             [ref.uid for ref in request.claims])

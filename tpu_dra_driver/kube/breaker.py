@@ -1,0 +1,166 @@
+"""API-server circuit breaker + per-verb retry budget for RestCluster.
+
+Reference analog: client-go does not ship a circuit breaker — the
+reference driver rides kubelet's own backoff when the API server browns
+out. At the scale ROADMAP targets, that is not enough: a dead or
+drowning API server must (a) stop being hammered by retries, and (b) be
+*visible* to kubelet so it stops routing NodePrepareResources into a
+backend that cannot resolve claims — the DRA health service reports
+NOT_SERVING while the breaker is open (plugin/driver.py ``healthy()``).
+
+Two cooperating pieces:
+
+- :class:`CircuitBreaker` — CLOSED → OPEN after ``failure_threshold``
+  consecutive request failures; OPEN fails fast (no network) for
+  ``reset_timeout`` seconds; then HALF_OPEN admits exactly one probe
+  request — success closes the breaker, failure re-opens it (and
+  re-arms the timer). State is exported via the
+  ``dra_circuit_breaker_state`` gauge (0/1/2) and transition counter.
+
+- :class:`RetryBudget` — a token bucket per HTTP verb: each retry
+  spends a token; tokens refill at ``refill_per_sec``. When the bucket
+  runs dry, the request path stops retrying (returning the last
+  response) and counts ``dra_retry_budget_exhausted_total{verb}`` —
+  bounded amplification under brownout, where naive per-request retry
+  ladders multiply load exactly when the server can least afford it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from tpu_dra_driver.kube.errors import ApiError
+from tpu_dra_driver.pkg import metrics as _metrics
+
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class BreakerOpenError(ApiError):
+    """Request rejected locally: the breaker is open (no network IO was
+    attempted). Subclasses ApiError so existing retry/relist paths treat
+    it like any other server-side failure."""
+
+    code = 503
+
+
+class CircuitBreaker:
+    def __init__(self, name: str = "apiserver",
+                 failure_threshold: int = 5,
+                 reset_timeout: float = 30.0,
+                 clock=time.monotonic):
+        self.name = name
+        self._threshold = failure_threshold
+        self._reset_timeout = reset_timeout
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        # Half-open probe admission is a time-bounded LEASE, not a latch:
+        # a request path that dies between allow() and its record_* call
+        # (an injected crash, an unexpected non-transport exception) must
+        # not wedge the breaker into permanent fail-fast — after
+        # reset_timeout the lease expires and the next probe is admitted.
+        self._probe_in_flight = False
+        self._probe_started = 0.0
+        self._gauge = _metrics.CIRCUIT_BREAKER_STATE.labels(name)
+        self._gauge.set(0)
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            # surface the timer expiry as half-open even before a probe
+            # arrives, so health checks can report "probing" truthfully
+            if (self._state == OPEN
+                    and self._clock() - self._opened_at >= self._reset_timeout):
+                self._transition(HALF_OPEN)
+            return self._state
+
+    def allow(self) -> bool:
+        """Gate one request. False = fail fast without touching the
+        network. In HALF_OPEN exactly one in-flight probe is admitted."""
+        with self._mu:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at < self._reset_timeout:
+                    return False
+                self._transition(HALF_OPEN)
+            # HALF_OPEN: one probe at a time, lease-bounded (see __init__)
+            if (self._probe_in_flight
+                    and self._clock() - self._probe_started
+                    < self._reset_timeout):
+                return False
+            self._probe_in_flight = True
+            self._probe_started = self._clock()
+            return True
+
+    def record_success(self) -> None:
+        with self._mu:
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._mu:
+            self._probe_in_flight = False
+            if self._state == HALF_OPEN:
+                # failed probe: straight back to open, timer re-armed
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+                return
+            self._consecutive_failures += 1
+            if (self._state == CLOSED
+                    and self._consecutive_failures >= self._threshold):
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+
+    def _transition(self, to: str) -> None:
+        """Call with _mu held."""
+        if self._state == to:
+            return
+        self._state = to
+        self._gauge.set(_STATE_VALUE[to])
+        _metrics.CIRCUIT_BREAKER_TRANSITIONS.labels(self.name, to).inc()
+
+
+class RetryBudget:
+    def __init__(self, capacity: float = 10.0, refill_per_sec: float = 1.0,
+                 clock=time.monotonic):
+        self._capacity = capacity
+        self._refill = refill_per_sec
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._tokens: Dict[str, float] = {}
+        self._stamp: Dict[str, float] = {}
+
+    def try_spend(self, verb: str) -> bool:
+        """One retry wants to happen for ``verb``. True = allowed (a
+        token was spent); False = budget dry (counted in the exhausted
+        metric — the caller must stop retrying)."""
+        now = self._clock()
+        with self._mu:
+            tokens = self._tokens.get(verb, self._capacity)
+            last = self._stamp.get(verb, now)
+            tokens = min(self._capacity, tokens + (now - last) * self._refill)
+            self._stamp[verb] = now
+            if tokens >= 1.0:
+                self._tokens[verb] = tokens - 1.0
+                return True
+            self._tokens[verb] = tokens
+        _metrics.RETRY_BUDGET_EXHAUSTED.labels(verb).inc()
+        return False
+
+    def remaining(self, verb: str) -> float:
+        now = self._clock()
+        with self._mu:
+            tokens = self._tokens.get(verb, self._capacity)
+            last = self._stamp.get(verb, now)
+            return min(self._capacity, tokens + (now - last) * self._refill)

@@ -18,10 +18,17 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.kube.fake import ADDED, DELETED, MODIFIED, RELIST, Object
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg.metrics import (
     INFORMER_LISTER_HITS,
     INFORMER_WATCH_LAG,
+    SWALLOWED_ERRORS,
 )
+
+fi.register("informer.resync",
+            "one RELIST reconciliation pass (fail/latency models resync "
+            "storms hammering a large store; the informer thread must "
+            "survive and converge on the next resync)")
 
 #: An indexer maps an object to the index values it appears under (zero or
 #: more, client-go IndexFunc). Returning an empty iterable skips the object.
@@ -203,7 +210,19 @@ class Informer:
                 continue
             ev_type, obj = ev
             if ev_type == RELIST:
-                self._resync(obj.get("items") or [])
+                # A failed resync must not kill the informer thread: the
+                # store stays at its pre-gap state and the next RELIST
+                # (watch layers relist again after every gap) converges.
+                try:
+                    items = fi.fire("informer.resync",
+                                    payload=obj.get("items"))
+                    self._resync(items or [])
+                except Exception:  # chaos-ok: counted; next RELIST heals
+                    SWALLOWED_ERRORS.labels("informer.resync").inc()
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "informer resync failed (%s); awaiting next relist",
+                        self._client.resource)
                 continue
             if not self._accept(obj):
                 continue
@@ -258,7 +277,8 @@ class Informer:
                         on_add(copy.deepcopy(obj))
                 elif ev_type == DELETED and on_delete:
                     on_delete(copy.deepcopy(obj))
-            except Exception:  # handler errors must not kill the informer
+            except Exception:  # chaos-ok: handler errors must not kill the informer
+                SWALLOWED_ERRORS.labels("informer.handler").inc()
                 import logging
                 logging.getLogger(__name__).exception(
                     "informer handler error (%s %s)", ev_type,

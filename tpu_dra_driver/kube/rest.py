@@ -24,6 +24,12 @@ from typing import Dict, List, Optional, Tuple
 
 import requests
 
+from tpu_dra_driver.kube.breaker import (
+    BreakerOpenError,
+    CircuitBreaker,
+    OPEN,
+    RetryBudget,
+)
 from tpu_dra_driver.kube.errors import (
     AlreadyExistsError,
     ApiError,
@@ -38,8 +44,34 @@ from tpu_dra_driver.kube.resourceversions import (
     from_wire,
     to_wire,
 )
+from tpu_dra_driver.pkg import faultinject as fi
 
 log = logging.getLogger(__name__)
+
+# Chaos fault points on the layers where real clusters break (docs/chaos.md).
+fi.register("rest.request",
+            "one API request attempt (fail=connection reset, latency=slow "
+            "server, corrupt via response mutators in tests)")
+fi.register("rest.watch.stream",
+            "one watch connection attempt (fail with GoneError = 410 "
+            "mid-stream / watch EOF)")
+fi.register("rest.watch.relist",
+            "the relist bridging a watch gap")
+
+
+def _fire_rest(point: str, payload=None):
+    """Fire a REST-layer fault point, mapping a generic injected failure
+    into the transport's exception domain (requests.ConnectionError) so
+    env-armed ``<point>=fail`` schedules model a connection reset that
+    the retry/breaker/relist machinery actually handles. Rules armed
+    with an explicit error factory (GoneError, ApiError, ...) pass
+    through unchanged, and CrashInjected keeps crash semantics."""
+    try:
+        return fi.fire(point, payload=payload)
+    except fi.CrashInjected:
+        raise
+    except fi.FaultInjected as e:
+        raise requests.ConnectionError(str(e)) from e
 
 # resource name -> (api prefix, namespaced). resource.k8s.io prefixes use
 # the {RESOURCE_VERSION} placeholder filled by group discovery (v1 on
@@ -168,10 +200,18 @@ class RestCluster:
     - **401 token refresh**: bound service-account tokens rotate (~1 h);
       a 401 re-reads the projected token file once and retries,
     - **watch bookmarks**: ``allowWatchBookmarks`` keeps the resume
-      resourceVersion fresh so relists after idle periods are cheap.
+      resourceVersion fresh so relists after idle periods are cheap,
+    - **circuit breaker + retry budget** (kube/breaker.py): consecutive
+      5xx/transport failures open the breaker — requests then fail fast
+      locally (BreakerOpenError) until a half-open probe succeeds, and
+      each verb's retries draw from a token bucket so a brownout never
+      triggers unbounded retry amplification. ``breaker.state`` feeds
+      the plugin health service (NOT_SERVING while open).
     """
 
-    def __init__(self, config: RestClusterConfig):
+    def __init__(self, config: RestClusterConfig,
+                 breaker: Optional[CircuitBreaker] = None,
+                 retry_budget: Optional[RetryBudget] = None):
         self._cfg = config
         self._session = requests.Session()
         if config.token:
@@ -184,6 +224,15 @@ class RestCluster:
         self._resource_version_lock = threading.Lock()
         self._resource_version: Optional[str] = None
         self._resource_probe_failed_at: float = 0.0
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.retry_budget = (retry_budget if retry_budget is not None
+                             else RetryBudget())
+
+    def healthy(self) -> bool:
+        """False while the breaker is open: callers (the plugin health
+        service) report NOT_SERVING so kubelet stops routing prepares
+        into a backend that cannot resolve claims."""
+        return self.breaker.state != OPEN
 
     # -- API group discovery ------------------------------------------------
 
@@ -282,22 +331,52 @@ class RestCluster:
         return False
 
     def _request(self, method: str, url: str, **kw) -> requests.Response:
-        """One API call with 429/503 Retry-After backoff and a single
-        401-triggered token refresh."""
+        """One API call with 429/503 Retry-After backoff, connection-reset
+        retry for idempotent verbs, a single 401-triggered token refresh,
+        circuit-breaker accounting, and a per-verb retry budget."""
         import time as _time
 
+        if not self.breaker.allow():
+            raise BreakerOpenError(
+                f"{method} {url}: circuit breaker open (API server "
+                f"presumed down; failing fast)")
         refreshed = False
         backoff = 1.0
-        retryable = (RETRYABLE_IDEMPOTENT if method in ("GET", "HEAD")
-                     else RETRYABLE_ALWAYS)
+        idempotent = method in ("GET", "HEAD")
+        retryable = RETRYABLE_IDEMPOTENT if idempotent else RETRYABLE_ALWAYS
+        resp: Optional[requests.Response] = None
         for attempt in range(MAX_RETRIES + 1):
-            resp = self._session.request(method, url, **kw)
+            try:
+                _fire_rest("rest.request", payload=(method, url))
+                resp = self._session.request(method, url, **kw)
+            except requests.RequestException as e:
+                # connection reset / refused / timeout: the server may not
+                # have seen the request at all — retry only idempotent
+                # verbs (a committed POST must not be replayed)
+                self.breaker.record_failure()
+                if (idempotent and attempt < MAX_RETRIES
+                        and self.retry_budget.try_spend(method)):
+                    log.warning("%s %s: transport error (%s), retrying "
+                                "in %.1fs", method, url, e, backoff)
+                    _time.sleep(backoff)
+                    backoff = min(backoff * 2, 16.0)
+                    continue
+                raise
+            if resp.status_code >= 500:
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
             if resp.status_code == 401 and not refreshed:
                 refreshed = True
                 if self._refresh_token():
                     continue
                 return resp
             if resp.status_code in retryable and attempt < MAX_RETRIES:
+                if not self.retry_budget.try_spend(method):
+                    log.warning("%s %s: HTTP %d and the %s retry budget "
+                                "is exhausted; not retrying",
+                                method, url, resp.status_code, method)
+                    return resp
                 retry_after = resp.headers.get("Retry-After")
                 try:
                     delay = float(retry_after) if retry_after else backoff
@@ -465,6 +544,7 @@ class RestCluster:
                           ) -> Tuple[List[Dict], str]:
         """Fresh full list + the list's resourceVersion (the point a new
         watch can safely resume from)."""
+        _fire_rest("rest.watch.relist", payload=resource)
         return self._paged_list(resource, "", label_selector)
 
     def _watch_loop(self, resource: str,
@@ -489,6 +569,9 @@ class RestCluster:
         while not sub.closed:
             gap = False
             try:
+                # armed with GoneError this models an in-stream 410 /
+                # watch EOF: caught below like any ApiError -> relist
+                _fire_rest("rest.watch.stream", payload=resource)
                 with self._session.get(self._url(resource), params=params,
                                        stream=True, timeout=305) as resp:
                     self._raise_for(resp, f"watch {resource}")
@@ -531,14 +614,23 @@ class RestCluster:
                 gap = True
             if not gap or sub.closed:
                 continue
-            _time.sleep(backoff)
-            backoff = min(backoff * 2, 30.0)
-            try:
-                items, rv = self._relist_for_watch(resource, label_selector)
-            except (requests.RequestException, ApiError) as e:
-                log.warning("relist %s failed (%s); retrying", resource, e)
-                params.pop("resourceVersion", None)
-                continue
+            # The gap is bridged ONLY by a successful relist: resuming the
+            # watch "from now" after a failed relist would silently drop
+            # every deletion that happened during the outage, so keep
+            # retrying the relist (with backoff) until it lands or the
+            # subscription closes.
+            items = rv = None
+            while not sub.closed:
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                try:
+                    items, rv = self._relist_for_watch(resource,
+                                                       label_selector)
+                    break
+                except (requests.RequestException, ApiError) as e:
+                    log.warning("relist %s failed (%s); retrying", resource, e)
+            if items is None:
+                return                    # closed while bridging the gap
             if rv:
                 params["resourceVersion"] = rv
             else:

@@ -1,0 +1,333 @@
+"""Deterministic, process-global fault injection for chaos drills.
+
+Reference analog: the reference driver's crash-safety (checkpoint
+write-ahead, cleanup.go, IMEX daemon restarts) is proven on real clusters
+by killing pods at unlucky moments; this module makes those moments
+*schedulable* so the in-repo drill suite (tests/test_chaos_drills.py,
+testing/harness.py) can kill a component at every dangerous instant and
+assert the system converges.
+
+Design constraints, in priority order:
+
+1. **Zero overhead when disabled.** Production code calls
+   :func:`fire` on hot paths (every checkpoint write, every REST
+   request). Disabled, ``fire`` is one module-global bool check and a
+   return — no dict lookup, no lock, no allocation. Guarded by a
+   call-count assertion in the drill suite.
+2. **Deterministic.** Schedules are counter-based (fail the Nth call,
+   fail the first K then recover, every Nth) or seeded-random — a drill
+   that passes once passes always.
+3. **Scriptable.** Rules are armed in-process (:func:`arm`) or from the
+   environment (:func:`arm_from_env`, ``TPU_DRA_FAULTS``) so subprocess
+   components in the sim-cluster e2e suite can be scripted without code
+   changes.
+4. **Observable.** Every firing increments
+   ``dra_fault_injections_total{point,mode}``.
+
+Fault-point naming: ``<component>.<site>`` (catalog in docs/chaos.md).
+A point is *declared* where it fires via :func:`register` so the drill
+matrix can enumerate the catalog; firing an undeclared name still works
+(it is auto-registered) to keep the seam friction-free.
+
+Actions:
+
+- ``fail``   — raise an exception (factory/instance supplied by the rule;
+  default :class:`FaultInjected`),
+- ``crash``  — raise :class:`CrashInjected`, which drills treat as the
+  component dying at that instant (no cleanup runs past the raise
+  site); with ``hard=True`` the process actually ``os._exit(137)``s —
+  the SIGKILL analog for subprocess drills,
+- ``latency`` — sleep ``seconds`` (timeout/slow-path exercise),
+- ``corrupt`` — pass the payload through the rule's ``mutate`` callable
+  and return the mutated value (torn bytes, flipped fields).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "TPU_DRA_FAULTS"
+
+
+class FaultInjected(Exception):
+    """Default exception raised by a ``fail`` rule."""
+
+
+class CrashInjected(FaultInjected):
+    """The component 'dies' here: drills catch this at the component
+    boundary, discard the component without cleanup, and restart it."""
+
+
+@dataclass
+class Rule:
+    """One armed behavior on a fault point.
+
+    Scheduling (counter-based, 1-indexed on the point's call count at
+    the moment the rule was armed): exactly one of
+
+    - ``nth``   — fire only on call #nth,
+    - ``first`` — fire on calls 1..first, then recover,
+    - ``every`` — fire on every ``every``-th call,
+    - ``probability`` — fire with probability p from a seeded RNG,
+    - none of the above — fire on every call (``always``).
+
+    ``max_fires`` bounds total firings (0 = unbounded).
+    """
+
+    mode: str = "fail"                  # fail | crash | latency | corrupt
+    error: Optional[Callable[[], BaseException]] = None
+    seconds: float = 0.0                # latency mode
+    mutate: Optional[Callable] = None   # corrupt mode
+    hard: bool = False                  # crash mode: os._exit(137)
+    nth: int = 0
+    first: int = 0
+    every: int = 0
+    probability: float = 0.0
+    seed: int = 0
+    max_fires: int = 0
+    # filled in by the registry
+    calls: int = 0
+    fires: int = 0
+    _rng: Optional[random.Random] = field(default=None, repr=False)
+
+    def should_fire(self) -> bool:
+        self.calls += 1
+        if self.max_fires and self.fires >= self.max_fires:
+            return False
+        if self.nth:
+            return self.calls == self.nth
+        if self.first:
+            return self.calls <= self.first
+        if self.every:
+            return self.calls % self.every == 0
+        if self.probability:
+            if self._rng is None:
+                self._rng = random.Random(self.seed)
+            return self._rng.random() < self.probability
+        return True
+
+
+@dataclass
+class _Point:
+    name: str
+    description: str = ""
+    calls: int = 0          # counted only while the subsystem is armed
+    fired: int = 0
+    rules: List[Rule] = field(default_factory=list)
+
+
+#: Module-global fast-path flag: False means fire() returns immediately.
+_ARMED = False
+_LOCK = threading.Lock()
+_POINTS: Dict[str, _Point] = {}
+
+
+def register(name: str, description: str = "") -> None:
+    """Declare a fault point (idempotent). Firing auto-registers too;
+    explicit registration exists so the catalog is enumerable before
+    any call reaches the site."""
+    with _LOCK:
+        p = _POINTS.get(name)
+        if p is None:
+            _POINTS[name] = _Point(name, description)
+        elif description and not p.description:
+            p.description = description
+
+
+def catalog() -> Dict[str, str]:
+    """name -> description for every declared point."""
+    with _LOCK:
+        return {n: p.description for n, p in sorted(_POINTS.items())}
+
+
+def arm(name: str, rule: Rule) -> Rule:
+    """Attach ``rule`` to ``name`` (registering it if needed) and enable
+    the subsystem. Returns the rule so tests can read .calls/.fires."""
+    global _ARMED
+    with _LOCK:
+        p = _POINTS.setdefault(name, _Point(name))
+        rule.calls = 0
+        rule.fires = 0
+        p.rules.append(rule)
+        _ARMED = True
+    log.warning("fault point %s ARMED: %s", name, rule)
+    return rule
+
+
+def disarm(name: str) -> None:
+    global _ARMED
+    with _LOCK:
+        p = _POINTS.get(name)
+        if p is not None:
+            p.rules.clear()
+        _ARMED = any(pt.rules for pt in _POINTS.values())
+
+
+def reset() -> None:
+    """Disarm everything and zero counters (catalog entries survive)."""
+    global _ARMED
+    with _LOCK:
+        for p in _POINTS.values():
+            p.rules.clear()
+            p.calls = 0
+            p.fired = 0
+        _ARMED = False
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def point_stats(name: str) -> Dict[str, int]:
+    with _LOCK:
+        p = _POINTS.get(name)
+        return ({"calls": p.calls, "fired": p.fired} if p is not None
+                else {"calls": 0, "fired": 0})
+
+
+def fire(name: str, payload=None):
+    """The in-code fault point. Returns ``payload`` (possibly mutated by
+    a corrupt rule). Raises whatever an armed fail/crash rule dictates.
+
+    Disabled (the production state), this is ONE global bool check."""
+    if not _ARMED:
+        return payload
+    return _fire_slow(name, payload)
+
+
+def _fire_slow(name: str, payload):
+    with _LOCK:
+        p = _POINTS.setdefault(name, _Point(name))
+        p.calls += 1
+        due: List[Rule] = []
+        for rule in p.rules:
+            if rule.should_fire():
+                rule.fires += 1
+                p.fired += 1
+                due.append(rule)
+    for rule in due:
+        _count_fired(name, rule.mode)
+        log.warning("fault point %s FIRED (%s, fire #%d)",
+                    name, rule.mode, rule.fires)
+        if rule.mode == "latency":
+            time.sleep(rule.seconds)
+        elif rule.mode == "corrupt":
+            if rule.mutate is not None:
+                payload = rule.mutate(payload)
+        elif rule.mode == "crash":
+            if rule.hard:
+                os._exit(137)  # the SIGKILL analog: no cleanup runs
+            raise CrashInjected(f"injected crash at {name}")
+        else:  # fail
+            err = rule.error() if rule.error is not None else None
+            raise err if err is not None else FaultInjected(
+                f"injected failure at {name}")
+    return payload
+
+
+def _count_fired(name: str, mode: str) -> None:
+    # imported lazily: metrics imports nothing from here, but keeping the
+    # disabled path import-free keeps fire() allocation-free too
+    from tpu_dra_driver.pkg import metrics as _metrics
+    _metrics.FAULT_INJECTIONS.labels(name, mode).inc()
+
+
+# ---------------------------------------------------------------------------
+# Environment scripting (subprocess drills in the sim-cluster e2e suite)
+# ---------------------------------------------------------------------------
+#
+# TPU_DRA_FAULTS is a comma-separated list of clauses:
+#
+#     <point>=<mode>[:<arg>][@<when>]
+#
+# mode:  fail[:<message>] | crash[:hard] | latency:<seconds> | corrupt
+# when:  nth:<n> | first:<k> | every:<n> | p:<prob>[:seed:<s>]
+#        (omitted = always)
+#
+# Examples:
+#     checkpoint.write.torn=crash:hard@nth:2
+#     rest.request=fail@first:3,rest.watch.stream=fail@every:5
+#     tpulib.enumerate_chips=latency:0.2@p:0.5:seed:7
+
+
+def parse_rules(spec: str) -> Dict[str, Rule]:
+    """Parse a TPU_DRA_FAULTS spec into {point: Rule}. Raises ValueError
+    on malformed clauses (fail loud: a typo'd drill must not silently
+    run fault-free)."""
+    out: Dict[str, Rule] = {}
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        if "=" not in clause:
+            raise ValueError(f"fault clause {clause!r}: missing '='")
+        point, rest = clause.split("=", 1)
+        when = ""
+        if "@" in rest:
+            rest, when = rest.split("@", 1)
+        parts = rest.split(":")
+        mode = parts[0]
+        rule = Rule(mode=mode)
+        if mode == "fail":
+            if len(parts) > 1:
+                msg = ":".join(parts[1:])
+                rule.error = lambda m=msg: FaultInjected(m)
+        elif mode == "crash":
+            rule.hard = len(parts) > 1 and parts[1] == "hard"
+        elif mode == "latency":
+            if len(parts) < 2:
+                raise ValueError(f"fault clause {clause!r}: "
+                                 f"latency needs seconds")
+            rule.seconds = float(parts[1])
+        elif mode == "corrupt":
+            # env-armed corruption uses the generic byte/str mangler
+            rule.mutate = default_corruptor
+        else:
+            raise ValueError(f"fault clause {clause!r}: unknown mode "
+                             f"{mode!r}")
+        if when:
+            w = when.split(":")
+            if w[0] == "nth":
+                rule.nth = int(w[1])
+            elif w[0] == "first":
+                rule.first = int(w[1])
+            elif w[0] == "every":
+                rule.every = int(w[1])
+            elif w[0] == "p":
+                rule.probability = float(w[1])
+                if len(w) >= 4 and w[2] == "seed":
+                    rule.seed = int(w[3])
+            else:
+                raise ValueError(f"fault clause {clause!r}: unknown "
+                                 f"schedule {w[0]!r}")
+        out[point.strip()] = rule
+    return out
+
+
+def arm_from_env(environ=None) -> int:
+    """Arm rules from TPU_DRA_FAULTS; returns how many were armed.
+    Called by every cmd/* entrypoint at startup so subprocess drills
+    (tests/e2e/simcluster.py) can script faults into production
+    binaries."""
+    spec = (environ or os.environ).get(ENV_VAR, "")
+    if not spec:
+        return 0
+    rules = parse_rules(spec)
+    for point, rule in rules.items():
+        arm(point, rule)
+    return len(rules)
+
+
+def default_corruptor(payload):
+    """Generic payload mangler: good enough to break any checksum."""
+    if isinstance(payload, bytes):
+        return payload[:-1] + bytes([payload[-1] ^ 0xFF]) if payload else b"\xff"
+    if isinstance(payload, str):
+        return payload[:-1] + ("X" if not payload.endswith("X") else "Y") \
+            if payload else "X"
+    return payload

@@ -370,6 +370,42 @@ UNPREPARE_BATCH_CLAIMS = DEFAULT_REGISTRY.histogram(
 # handle and always land on the process default.
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# Chaos-hardening instrumentation (PR 3): the fault-injection subsystem,
+# checkpoint quarantine, the RestCluster circuit breaker / retry budget,
+# and swallowed-error accounting for the reconcile/prepare paths (the
+# test_lint.py except-Exception guard accepts an .inc() on this family as
+# proof an error was observed, not silently dropped).
+# ---------------------------------------------------------------------------
+
+FAULT_INJECTIONS = DEFAULT_REGISTRY.counter(
+    "dra_fault_injections_total",
+    "Scheduled faults actually fired, by fault point and action mode",
+    ("point", "mode"))
+CHECKPOINT_QUARANTINED = DEFAULT_REGISTRY.counter(
+    "dra_checkpoint_quarantined_total",
+    "Corrupt checkpoint files quarantined to <path>.corrupt-<n> "
+    "(the driver restarted from salvaged-or-empty state instead of "
+    "crash-looping)")
+CIRCUIT_BREAKER_STATE = DEFAULT_REGISTRY.gauge(
+    "dra_circuit_breaker_state",
+    "API-server circuit breaker state (0=closed, 1=half-open, 2=open)",
+    ("name",))
+CIRCUIT_BREAKER_TRANSITIONS = DEFAULT_REGISTRY.counter(
+    "dra_circuit_breaker_transitions_total",
+    "Circuit breaker state transitions",
+    ("name", "to"))
+RETRY_BUDGET_EXHAUSTED = DEFAULT_REGISTRY.counter(
+    "dra_retry_budget_exhausted_total",
+    "Retries skipped because the per-verb retry budget ran dry",
+    ("verb",))
+SWALLOWED_ERRORS = DEFAULT_REGISTRY.counter(
+    "dra_swallowed_errors_total",
+    "Exceptions absorbed (logged, not re-raised) on reconcile/prepare "
+    "paths, by site",
+    ("site",))
+
+
 INFORMER_WATCH_LAG = DEFAULT_REGISTRY.histogram(
     "dra_informer_watch_lag_seconds",
     "Time a watch event waited between arrival and informer dispatch",

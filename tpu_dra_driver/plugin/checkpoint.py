@@ -24,12 +24,30 @@ from __future__ import annotations
 
 import copy
 import json
+import logging
 import os
 import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import metrics as _metrics
+
+log = logging.getLogger(__name__)
+
+fi.register("checkpoint.read",
+            "raw checkpoint file contents on read (corrupt=CRC/JSON "
+            "damage, fail=unreadable file)")
+fi.register("checkpoint.write",
+            "checkpoint serialization before the tmp file is written "
+            "(fail with OSError(ENOSPC) models a full disk)")
+fi.register("checkpoint.fsync",
+            "the fsync of the checkpoint tmp file (fail=ENOSPC at "
+            "flush time)")
+fi.register("checkpoint.write.torn",
+            "between the fsync'd tmp file and the atomic rename "
+            "(crash here = a torn write: tmp left behind, the live "
+            "checkpoint must stay intact)")
 
 # Claim prepare states (reference device_state.go:231-283)
 PREPARE_STARTED = "PrepareStarted"
@@ -182,9 +200,12 @@ class CheckpointManager:
     def read(self) -> Checkpoint:
         try:
             with open(self._path) as f:
-                raw = json.load(f)
+                text = f.read()
         except FileNotFoundError:
             return Checkpoint()
+        text = fi.fire("checkpoint.read", payload=text)
+        try:
+            raw = json.loads(text)
         except json.JSONDecodeError as e:
             raise CheckpointCorruptionError(f"{self._path}: invalid JSON: {e}") from e
         checksums = raw.get("checksums") or {}
@@ -196,15 +217,89 @@ class CheckpointManager:
                 raise CheckpointCorruptionError(
                     f"{self._path}: {version} checksum mismatch"
                 )
-            claims = {}
-            for uid, e in (payload.get("claims") or {}).items():
-                entry = ClaimEntry.from_obj(e)
-                if version == "v1" and "state" not in e:
-                    # legacy layout records only completed claims
-                    entry.state = PREPARE_COMPLETED
-                claims[uid] = entry
+            claims = self._claims_from_payload(payload, version)
             return Checkpoint(claims=claims)
         return Checkpoint()
+
+    @staticmethod
+    def _claims_from_payload(payload: Dict, version: str) -> Dict[str, ClaimEntry]:
+        claims: Dict[str, ClaimEntry] = {}
+        for uid, e in (payload.get("claims") or {}).items():
+            entry = ClaimEntry.from_obj(e)
+            if version == "v1" and "state" not in e:
+                # legacy layout records only completed claims
+                entry.state = PREPARE_COMPLETED
+            claims[uid] = entry
+        return claims
+
+    # -- corruption recovery (the no-crash-loop contract) -------------------
+
+    def read_or_quarantine(self) -> Checkpoint:
+        """Read, but never crash-loop on a corrupt file: quarantine it to
+        ``<path>.corrupt-<n>``, log loudly, count it in
+        ``dra_checkpoint_quarantined_total``, and continue from the best
+        salvageable state — a version whose checksum still verifies
+        (readers prefer v2; a damaged v2 falls back to an intact legacy
+        v1, which holds every *completed* claim) — or empty when nothing
+        verifies. The salvaged state is immediately re-written so the
+        next reader sees a healthy file."""
+        try:
+            return self.read()
+        except CheckpointCorruptionError as e:
+            salvaged = self._salvage()
+            # Quarantine is a COPY: the corrupt original must stay at the
+            # live path until the salvaged rewrite's atomic replace lands —
+            # renaming it away first would leave NO checkpoint at all if
+            # the rewrite fails (ENOSPC is one of the very faults drilled
+            # here) or the process dies in the window, silently forgetting
+            # every prepared claim on the next (empty) read.
+            qpath = self._quarantine_copy()
+            _metrics.CHECKPOINT_QUARANTINED.inc()
+            log.error(
+                "CHECKPOINT CORRUPT: %s — quarantined to %s; continuing "
+                "from %s state (prepared-claim history may be incomplete; "
+                "the cleanup sweep and idempotent re-prepare will "
+                "reconverge)", e, qpath,
+                f"salvaged {len(salvaged.claims)}-claim" if salvaged is not None
+                else "empty")
+            cp = salvaged if salvaged is not None else Checkpoint()
+            self.write(cp)
+            return cp
+
+    def _salvage(self) -> Optional[Checkpoint]:
+        """Best-effort recovery of any version whose checksum still
+        verifies (v2 preferred). None when the JSON itself is broken or
+        no version survives."""
+        try:
+            with open(self._path) as f:
+                raw = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        checksums = raw.get("checksums") or {}
+        for version in ("v2", "v1"):
+            payload = raw.get(version)
+            if payload is None or _crc(payload) != checksums.get(version):
+                continue
+            return Checkpoint(
+                claims=self._claims_from_payload(payload, version))
+        return None
+
+    def _quarantine_copy(self) -> str:
+        """Preserve the corrupt bytes for postmortem WITHOUT touching the
+        live path (best-effort: on a full disk the copy may fail, which
+        must not block recovery)."""
+        import shutil
+        n = 1
+        while os.path.exists(f"{self._path}.corrupt-{n}"):
+            n += 1
+        qpath = f"{self._path}.corrupt-{n}"
+        try:
+            shutil.copyfile(self._path, qpath)
+        except OSError:
+            log.warning("could not preserve corrupt checkpoint at %s",
+                        qpath, exc_info=True)
+            return "<copy failed>"
+        return qpath
 
     def write(self, cp: Checkpoint) -> None:
         v2 = {"claims": {uid: e.to_obj() for uid, e in cp.claims.items()}}
@@ -237,10 +332,16 @@ class CheckpointManager:
             separators=(",", ":"))
         body = (f'{{\n"checksums": {checksums},\n'
                 f'"v1": {v1_s},\n"v2": {v2_s}\n}}\n')
+        fi.fire("checkpoint.write", payload=body)
         tmp = f"{self._path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(body)
             f.flush()
+            fi.fire("checkpoint.fsync")
             os.fsync(f.fileno())
+        # a crash here is a TORN write: the fsync'd tmp exists but the
+        # rename never ran — the live checkpoint must remain the previous
+        # intact version (asserted by the torn-write drill)
+        fi.fire("checkpoint.write.torn")
         os.replace(tmp, self._path)
         _metrics.CHECKPOINT_WRITES.inc()

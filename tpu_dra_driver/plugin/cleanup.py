@@ -47,7 +47,10 @@ class CheckpointCleanupManager:
             try:
                 self.sweep_once()
             except Exception:
-                log.exception("checkpoint cleanup sweep failed")
+                from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+                SWALLOWED_ERRORS.labels("cleanup.sweep").inc()
+                log.exception("checkpoint cleanup sweep failed "
+                              "(retried next interval)")
 
     def sweep_once(self) -> list[str]:
         """Unprepare checkpointed claims whose ResourceClaim is gone or has

@@ -40,6 +40,7 @@ from typing import Deque, Dict, Iterable, List, Optional, Set
 from tpu_dra_driver.api.configs import SubsliceConfig, TpuConfig, VfioTpuConfig
 from tpu_dra_driver.api.decoder import STRICT_DECODER, DecodeError
 from tpu_dra_driver.cdi.generator import CdiDevice, CdiHandler, ContainerEdits
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import featuregates as fg
 from tpu_dra_driver.pkg import metrics as _metrics
 from tpu_dra_driver.pkg.flock import Flock, FlockOptions
@@ -80,6 +81,19 @@ from tpu_dra_driver.tpulib.partition import (
 )
 
 log = logging.getLogger(__name__)
+
+fi.register("plugin.prepare.after_write_ahead",
+            "between the PrepareStarted write-ahead fsync and device "
+            "preparation (crash = claims written-ahead but no hardware "
+            "touched; restart must roll them back)")
+fi.register("plugin.prepare.before_commit",
+            "between device preparation and the PrepareCompleted commit "
+            "fsync (crash = devices live but checkpoint says "
+            "PrepareStarted; restart must roll back and re-prepare)")
+fi.register("plugin.unprepare.before_write",
+            "after device teardown, before the checkpoint write removing "
+            "the entries (crash = devices gone but entries persist; "
+            "re-unprepare must be idempotent)")
 
 
 class PermanentError(Exception):
@@ -153,7 +167,7 @@ class DeviceState:
 
     def get_checkpoint(self) -> Checkpoint:
         with self._cp_locked():
-            return self._cp_mgr.read()
+            return self._cp_mgr.read_or_quarantine()
 
     # ------------------------------------------------------------------
     # Prepare
@@ -197,7 +211,7 @@ class DeviceState:
             with self._cp_locked():
                 phase("lock").observe(time.perf_counter() - t_lock0)
                 t_read0 = time.perf_counter()
-                cp = self._cp_mgr.read()
+                cp = self._cp_mgr.read_or_quarantine()
                 t_read = time.perf_counter() - t_read0
                 phase("read").observe(t_read)
 
@@ -254,6 +268,7 @@ class DeviceState:
                 t_wa0 = time.perf_counter()
                 self._cp_mgr.write(cp)
                 phase("write_ahead").observe(time.perf_counter() - t_wa0)
+                fi.fire("plugin.prepare.after_write_ahead")
 
                 t_prep0 = time.perf_counter()
                 for claim in to_prepare:
@@ -269,6 +284,7 @@ class DeviceState:
                 # the commit fsync is skipped (failed entries already
                 # persist for rollback).
                 if any(out[c.uid].exception is None for c in to_prepare):
+                    fi.fire("plugin.prepare.before_commit")
                     t_commit0 = time.perf_counter()
                     self._cp_mgr.write(cp)
                     phase("commit").observe(time.perf_counter() - t_commit0)
@@ -308,7 +324,7 @@ class DeviceState:
         except PermanentError as e:
             log.error("prepare %s failed permanently: %s", claim.canonical, e)
             return BatchClaimResult(exception=e)
-        except Exception as e:
+        except Exception as e:  # chaos-ok: isolated to this claim's result
             log.exception("prepare %s failed", claim.canonical)
             return BatchClaimResult(exception=e)
         for dev, qname in zip(prepared, qualified):
@@ -517,7 +533,7 @@ class DeviceState:
             return out
         _metrics.UNPREPARE_BATCH_CLAIMS.observe(len(claim_uids))
         with self._mu, self._cp_locked():
-            cp = self._cp_mgr.read()
+            cp = self._cp_mgr.read_or_quarantine()
             dirty = False
             for uid in claim_uids:
                 entry = cp.claims.get(uid)
@@ -529,7 +545,7 @@ class DeviceState:
                 try:
                     self._unprepare_devices(entry, best_effort=False)
                     self._cdi.delete_claim_spec(uid)
-                except Exception as e:
+                except Exception as e:  # chaos-ok: kept for retry, error surfaced
                     log.exception("unprepare %s failed", uid)
                     out[uid] = e
                     continue
@@ -538,6 +554,7 @@ class DeviceState:
                 out[uid] = None
                 log.info("unprepare %s: done", uid)
             if dirty:
+                fi.fire("plugin.unprepare.before_write")
                 self._cp_mgr.write(cp)
         return out
 
@@ -594,7 +611,7 @@ class DeviceState:
         device_state.go:287-373 DestroyUnknownMIGDevices)."""
         destroyed = []
         with self._mu, self._cp_locked():
-            cp = self._cp_mgr.read()
+            cp = self._cp_mgr.read_or_quarantine()
             owned: Set[str] = set()
             for entry in cp.claims.values():
                 for dev in entry.prepared_devices:

@@ -151,12 +151,19 @@ class TpuKubeletPlugin:
     def healthy(self) -> bool:
         """gRPC healthcheck analog (reference health.go:121-149 self-probes
         registration + a noop prepare): verify enumeration still answers and
-        the checkpoint file is readable."""
+        the checkpoint file is readable. Additionally NOT_SERVING while the
+        API-server circuit breaker is open — kubelet must stop routing
+        prepares into a backend that cannot resolve claims; serving resumes
+        once a half-open probe succeeds."""
+        cluster_healthy = getattr(self._clients.cluster, "healthy", None)
+        if cluster_healthy is not None and not cluster_healthy():
+            log.warning("healthcheck: API-server circuit breaker open")
+            return False
         try:
             self._lib.enumerate_chips()
             self.state.get_checkpoint()
             return True
-        except Exception:
+        except Exception:  # chaos-ok: health probe converts to NOT_SERVING
             log.exception("healthcheck failed")
             return False
 
@@ -263,7 +270,7 @@ class TpuKubeletPlugin:
         except FlockTimeoutError as e:
             return self._prepare_batch_failed(
                 infos, f"prepare lock: {e}", t0)
-        except Exception as e:
+        except Exception as e:  # chaos-ok: per-claim errors + error histogram
             # batch-wide failure (checkpoint read/corruption): no claim
             # got anywhere, so every claim reports it
             log.exception("prepare batch of %d claims failed", len(infos))
@@ -305,7 +312,7 @@ class TpuKubeletPlugin:
             with lock:
                 self._m_lock_wait.observe(time.perf_counter() - t0)
                 batch = self.state.unprepare_batch(claim_uids)
-        except Exception as e:
+        except Exception as e:  # chaos-ok: per-uid errors + error histogram
             log.exception("unprepare batch of %d claims failed",
                           len(claim_uids))
             per_claim = (time.perf_counter() - t0) / len(claim_uids)

@@ -66,4 +66,6 @@ class DeviceHealthMonitor:
         try:
             self._on_unhealthy(event.chip_uuid)
         except Exception:
+            from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+            SWALLOWED_ERRORS.labels("health.on_unhealthy").inc()
             log.exception("unhealthy-device callback failed for %s", event.chip_uuid)

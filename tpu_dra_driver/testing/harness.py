@@ -44,6 +44,7 @@ from tpu_dra_driver.computedomain.plugin.driver import (
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.kube.errors import AlreadyExistsError, NotFoundError
 from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.checkpoint import PREPARE_COMPLETED
 from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
 from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
 
@@ -57,6 +58,10 @@ class HostRuntime:
     tpu_plugin: TpuKubeletPlugin
     cd_plugin: CdKubeletPlugin
     hosts_dir: str
+    # identity needed to rebuild this host's plugins after a crash drill
+    host_index: int = 0
+    slice_id: Optional[str] = None
+    accelerator_type: str = "v5p-16"
 
 
 class ClusterHarness:
@@ -70,6 +75,8 @@ class ClusterHarness:
         self.clients = ClientSets()
         self.tmp = tmp_dir
         self.gates = gates or fg.FeatureGates()
+        self._prepare_budget = prepare_budget
+        self._cd_wake_on_events = cd_wake_on_events
         self.hosts: List[HostRuntime] = []
         # The default backstop is deliberately SLOW (5 s): convergence in
         # tests must come from the informer event path, not from a tight
@@ -114,7 +121,10 @@ class ClusterHarness:
                 prepare_budget=prepare_budget,
                 wake_on_events=cd_wake_on_events))
             self.hosts.append(HostRuntime(node, lib, tpu_plugin, cd_plugin,
-                                          hosts_dir))
+                                          hosts_dir,
+                                          host_index=h % topo.num_hosts,
+                                          slice_id=sid,
+                                          accelerator_type=accelerator_type))
 
     # ------------------------------------------------------------------
 
@@ -304,6 +314,68 @@ class ClusterHarness:
                 t.start()
 
     # ------------------------------------------------------------------
+    # chaos drills: component kill/restart (tests/test_chaos_drills.py)
+    # ------------------------------------------------------------------
+
+    def crash_host_plugins(self, i: int) -> HostRuntime:
+        """SIGKILL analog for host i's kubelet plugins. A real SIGKILL
+        kills the process's THREADS too — so the old plugins' background
+        loops (checkpoint-cleanup sweeps, health monitor, CD informers)
+        are stopped; none of them flush durable state on stop, so the
+        on-disk checkpoint/CDI state is exactly what a crashed pod leaves
+        behind. Leaving them running would let a zombie cleanup sweep
+        race the restarted plugin over the same state dir.
+        Call :meth:`restart_host_plugins` to bring the node back."""
+        old = self.hosts[i]
+        for plugin in (old.tpu_plugin, old.cd_plugin):
+            try:
+                plugin.shutdown()      # thread stops only; no durable IO
+            except Exception:
+                log.exception("crash drill: stopping old plugin threads")
+        return old
+
+    def restart_host_plugins(self, i: int) -> HostRuntime:
+        """Rebuild host i's plugins over the SAME state dirs with a fresh
+        FakeTpuLib sharing the old one's host state (live sub-slices and
+        vfio bindings survive a plugin restart, like real MIG)."""
+        old = self.crash_host_plugins(i)
+        lib = FakeTpuLib(FakeSystemConfig(
+            accelerator_type=old.accelerator_type,
+            host_index=old.host_index,
+            slice_id=old.slice_id), host_state=old.lib.host_state)
+        node = old.node_name
+        tpu_plugin = TpuKubeletPlugin(self.clients, lib, PluginConfig(
+            node_name=node,
+            state_dir=os.path.join(self.tmp, node, "tpu-plugin"),
+            cdi_root=os.path.join(self.tmp, node, "cdi"),
+            gates=self.gates))
+        cd_plugin = CdKubeletPlugin(self.clients, lib, CdKubeletPluginConfig(
+            node_name=node,
+            state_dir=os.path.join(self.tmp, node, "cd-plugin"),
+            cdi_root=os.path.join(self.tmp, node, "cdi"),
+            hosts_file_dir=old.hosts_dir,
+            prepare_budget=self._prepare_budget,
+            wake_on_events=self._cd_wake_on_events))
+        self.hosts[i] = HostRuntime(node, lib, tpu_plugin, cd_plugin,
+                                    old.hosts_dir,
+                                    host_index=old.host_index,
+                                    slice_id=old.slice_id,
+                                    accelerator_type=old.accelerator_type)
+        tpu_plugin.start()
+        cd_plugin.start()
+        return self.hosts[i]
+
+    def daemon_pod_names(self) -> List[str]:
+        return [p["metadata"]["name"]
+                for p in self.clients.pods.list(namespace=DRIVER_NAMESPACE)]
+
+    def kill_daemon_pod(self, pod_name: str) -> None:
+        """Force-delete a CD daemon pod (the bats failover scenario): the
+        DS runner reaps the dead daemon and boots a replacement, which
+        must re-join its clique at its old index."""
+        self.clients.pods.delete_ignore_missing(pod_name, DRIVER_NAMESPACE)
+
+    # ------------------------------------------------------------------
     # conveniences
     # ------------------------------------------------------------------
 
@@ -379,3 +451,129 @@ class ClusterHarness:
                 raise AssertionError(
                     f"host-{i} prepare failed: {results[i].error}")
         return results
+
+
+# ---------------------------------------------------------------------------
+# Crash-recovery drill runner (the chaos matrix's per-point workhorse)
+# ---------------------------------------------------------------------------
+#
+# A drill is: arm a fault at one registered point, drive the owning
+# component into the fault mid-operation, treat the component as dead
+# (drop it with NO cleanup), restart it over the same durable state, and
+# assert the convergence invariants:
+#
+#   1. claims reach ready after restart (the retried prepare succeeds),
+#   2. the checkpoint is readable-or-quarantined (never a crash-loop),
+#   3. no leaked prepared devices: every live sub-slice is owned by a
+#      PrepareCompleted checkpoint entry,
+#   4. unprepare is idempotent (a second unprepare of the same claim is
+#      a clean no-op),
+#   5. prepared-device bookkeeping is internally consistent (an entry in
+#      PrepareCompleted lists the devices its CDI spec was written for).
+#
+# tests/test_chaos_drills.py parametrizes PluginCrashDrill over the
+# plugin-side fault points; ClusterHarness.kill_daemon_pod +
+# restart_host_plugins cover the CD daemon / CD plugin drills.
+
+
+class PluginCrashDrill:
+    """Kill/restart drill harness around a single TpuKubeletPlugin.
+
+    'Crash' = the plugin object is dropped without shutdown() (no
+    cleanup runs — the SIGKILL analog); 'restart' = a fresh plugin over
+    the SAME state dir with a fresh FakeTpuLib sharing host state (live
+    partitions survive a plugin restart, like real MIG)."""
+
+    def __init__(self, tmp_dir: str, accelerator_type: str = "v5p-8",
+                 gates: Optional[fg.FeatureGates] = None,
+                 node_name: str = "drill-node"):
+        self.tmp = tmp_dir
+        self.accelerator_type = accelerator_type
+        self.gates = gates or fg.FeatureGates()
+        self.node_name = node_name
+        self.clients = ClientSets()
+        self.plugin: Optional[TpuKubeletPlugin] = None
+        self._host_state = None
+
+    def start(self) -> TpuKubeletPlugin:
+        lib = FakeTpuLib(
+            FakeSystemConfig(accelerator_type=self.accelerator_type),
+            host_state=self._host_state)
+        self._host_state = lib.host_state
+        self.plugin = TpuKubeletPlugin(self.clients, lib, PluginConfig(
+            node_name=self.node_name,
+            state_dir=os.path.join(self.tmp, "drill-plugin"),
+            cdi_root=os.path.join(self.tmp, "drill-cdi"),
+            gates=self.gates))
+        self.plugin.start()
+        return self.plugin
+
+    def crash(self) -> None:
+        """Crashed-pod state: background threads die (shutdown() performs
+        no durable IO, so the on-disk state is exactly what SIGKILL
+        leaves), then the object is dropped."""
+        if self.plugin is not None:
+            try:
+                self.plugin.shutdown()
+            except Exception:
+                log.exception("drill crash: stopping plugin threads")
+        self.plugin = None
+
+    def restart(self) -> TpuKubeletPlugin:
+        self.crash()
+        return self.start()
+
+    @property
+    def lib(self) -> FakeTpuLib:
+        return self.plugin._lib  # type: ignore[union-attr]
+
+    # -- invariants ------------------------------------------------------
+
+    def assert_recovered(self, claims: List[Dict]) -> None:
+        """The full post-restart invariant set for ``claims`` (allocated
+        claim objects the drill was preparing when the fault hit)."""
+        plugin = self.plugin
+        assert plugin is not None, "restart() before asserting recovery"
+        # (1) claims reach ready: the retried prepare succeeds cleanly
+        results = plugin.prepare_resource_claims(claims)
+        for uid, res in results.items():
+            assert res.error is None, (
+                f"claim {uid} did not recover after restart: {res.error}")
+        # (2) checkpoint readable (possibly via quarantine, never a raise)
+        cp = plugin.state.get_checkpoint()
+        for c in claims:
+            uid = c["metadata"]["uid"]
+            entry = cp.claims.get(uid)
+            assert entry is not None and entry.state == PREPARE_COMPLETED, (
+                f"claim {uid} not PrepareCompleted after recovery: "
+                f"{entry.state if entry else 'missing'}")
+        self.assert_no_leaked_devices()
+        # (4) unprepare idempotent: twice in a row, both clean
+        uids = [c["metadata"]["uid"] for c in claims]
+        first = plugin.unprepare_resource_claims(uids)
+        assert all(v is None for v in first.values()), first
+        second = plugin.unprepare_resource_claims(uids)
+        assert all(v is None for v in second.values()), second
+        assert not plugin.state.get_checkpoint().claims
+
+    def assert_no_leaked_devices(self) -> None:
+        """(3): every live sub-slice on the 'hardware' is owned by a
+        PrepareCompleted checkpoint entry — nothing leaked by the crash."""
+        plugin = self.plugin
+        cp = plugin.state.get_checkpoint()
+        owned = {d.canonical_name
+                 for e in cp.claims.values()
+                 if e.state == PREPARE_COMPLETED
+                 for d in e.prepared_devices}
+        live = {s.spec_tuple.canonical_name()
+                for s in self.lib.list_subslices()}
+        leaked = live - owned
+        assert not leaked, f"leaked live sub-slices after recovery: {leaked}"
+
+
+def drill_catalog_coverage(drilled_points: List[str]) -> List[str]:
+    """Registered fault points NOT covered by any drill — the matrix
+    completeness check (tests fail listing the gap, so a new fault point
+    cannot land without a drill)."""
+    from tpu_dra_driver.pkg import faultinject as fi
+    return sorted(set(fi.catalog()) - set(drilled_points))

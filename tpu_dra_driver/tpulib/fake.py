@@ -42,6 +42,7 @@ from tpu_dra_driver.tpulib.interface import (
     TpuLib,
     TpuLibError,
 )
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.tpulib.partition import (
     SubsliceLiveTuple,
     SubsliceSpec,
@@ -49,6 +50,22 @@ from tpu_dra_driver.tpulib.partition import (
     parse_profile_id,
 )
 from tpu_dra_driver.tpulib.topology import SliceTopology
+
+# Device-library fault points (enumeration flaps, partition-op failures):
+# every FakeTpuLib op funnels through _op, which also fires the global
+# "tpulib.<op>" point — so the chaos drill matrix scripts hardware
+# misbehavior the same way it scripts REST/checkpoint faults, on top of
+# the per-instance fail_next/set_op_latency seams below.
+for _op_name in ("enumerate_chips", "create_subslice", "destroy_subslice",
+                 "set_timeslice", "set_exclusive_mode",
+                 "allocate_multiprocess_share", "release_multiprocess_share",
+                 "bind_to_vfio", "unbind_from_vfio"):
+    fi.register(f"tpulib.{_op_name}",
+                f"FakeTpuLib {_op_name} (fail=TpuLibError-style flap, "
+                f"latency=slow device runtime)")
+fi.register("tpulib.health_event",
+            "one published health event (corrupt mutates the event; "
+            "drills flood this to model health-event storms)")
 
 
 def _stable_hex(*parts: object, n: int = 8) -> str:
@@ -123,9 +140,17 @@ class FakeTpuLib(TpuLib):
         self._op_latency = seconds
 
     def inject_health_event(self, event: HealthEvent) -> None:
+        event = fi.fire("tpulib.health_event", payload=event)
         self._health.publish(event)
 
+    def inject_health_flood(self, events: List[HealthEvent]) -> None:
+        """Publish a burst back-to-back — the health-event-storm drill
+        (subscribers must coalesce, not amplify, a flood)."""
+        for ev in events:
+            self.inject_health_event(ev)
+
     def _op(self, name: str) -> None:
+        fi.fire(f"tpulib.{name}")
         if self._op_latency:
             time.sleep(self._op_latency)
         err = self._fail_next.pop(name, None)
