@@ -241,6 +241,143 @@ def bench_cel_microbench(n_devices: int = 64, iters: int = 40) -> dict:
     }
 
 
+_SWEEP_DRIVER = "tpu.google.com"
+_SWEEP_TYPES = 16        # distinct chipType values -> index selectivity
+
+
+def _sweep_fleet(n_nodes: int, devices_per_node: int = 8):
+    """A synthetic published fleet: n_nodes slices x devices_per_node
+    chips, chipType spread over _SWEEP_TYPES values so an equality
+    selector keeps 1/_SWEEP_TYPES of the fleet."""
+    from tpu_dra_driver.kube.client import ClientSets
+
+    clients = ClientSets()
+    for n in range(n_nodes):
+        node = f"node-{n:04d}"
+        devices = []
+        for d in range(devices_per_node):
+            idx = n * devices_per_node + d
+            devices.append({
+                "name": f"tpu-{d}",
+                "attributes": {
+                    "type": {"string": "chip"},
+                    "chipType": {"string": f"ct-{idx % _SWEEP_TYPES}"},
+                },
+                "capacity": {"hbm": {"value": str(16 * 2**30)}},
+            })
+        clients.resource_slices.create({
+            "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-{_SWEEP_DRIVER}"},
+            "spec": {"driver": _SWEEP_DRIVER, "nodeName": node,
+                     "pool": {"name": node, "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": devices}})
+    return clients
+
+
+def _sweep_claims(clients, n_claims: int):
+    claims = []
+    for i in range(n_claims):
+        sel = [{"cel": {"expression":
+            f'device.driver == "{_SWEEP_DRIVER}" && '
+            f'device.attributes["{_SWEEP_DRIVER}"].type == "chip" && '
+            f'device.attributes["{_SWEEP_DRIVER}"].chipType == '
+            f'"ct-{i % _SWEEP_TYPES}"'}}]
+        claims.append(clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+            "metadata": {"name": f"sweep-{i}", "namespace": "bench"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "count": 1, "selectors": sel}]}},
+        }))
+    return claims
+
+
+def bench_allocator_sweep(node_counts=(16, 128, 1024),
+                          claim_counts=(1, 64, 512),
+                          devices_per_node: int = 8) -> dict:
+    """Indexed-catalog vs linear-scan allocation across fleet sizes.
+
+    For each (nodes, claims) combo both arms allocate the SAME claim set
+    against the same synthetic fleet on fresh clusters:
+
+    - **indexed**: informer-fed DeviceCatalog + UsageLedger, the whole
+      claim set through ONE ``allocate_batch`` snapshot — candidate sets
+      from attribute-index intersection;
+    - **linear**: the pre-catalog architecture — per-claim ``allocate()``
+      with ``use_index=False`` (full LIST + full fleet scan per claim).
+
+    Records candidates-scanned (from the dra_allocator_candidates_scanned
+    histogram delta) and successful allocations/sec per arm. Combos whose
+    claim count exceeds fleet capacity are skipped (the rate would mix
+    failures into the denominator)."""
+    from tpu_dra_driver.kube.allocator import Allocator
+    from tpu_dra_driver.kube.catalog import DeviceCatalog, UsageLedger
+    from tpu_dra_driver.pkg.metrics import ALLOCATOR_CANDIDATES_SCANNED
+
+    out: dict = {}
+    for n_nodes in node_counts:
+        capacity = n_nodes * devices_per_node
+        for n_claims in claim_counts:
+            if n_claims > capacity:
+                continue
+            row: dict = {"nodes": n_nodes, "claims": n_claims,
+                         "devices": capacity}
+            for arm in ("indexed", "linear"):
+                clients = _sweep_fleet(n_nodes, devices_per_node)
+                claims = _sweep_claims(clients, n_claims)
+                catalog = None
+                if arm == "indexed":
+                    # catalog startup is the controller's one-time cost,
+                    # not a per-batch cost — excluded from the timed
+                    # window like any informer sync
+                    catalog = DeviceCatalog(clients.resource_slices)
+                    catalog.start()
+                    catalog.wait_synced()
+                    ledger = UsageLedger(_SWEEP_DRIVER, catalog.get_device)
+                    allocator = Allocator(clients, _SWEEP_DRIVER,
+                                          catalog=catalog, ledger=ledger)
+                c0 = ALLOCATOR_CANDIDATES_SCANNED.sum
+                t0 = time.perf_counter()
+                if arm == "indexed":
+                    results = allocator.allocate_batch(claims)
+                    errors = [r.error for r in results.values() if r.error]
+                else:
+                    allocator = Allocator(clients, _SWEEP_DRIVER,
+                                          use_index=False)
+                    errors = []
+                    for claim in claims:
+                        try:
+                            allocator.allocate(claim["metadata"]["name"],
+                                               "bench")
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(str(e))
+                wall = time.perf_counter() - t0
+                scanned = ALLOCATOR_CANDIDATES_SCANNED.sum - c0
+                if catalog is not None:
+                    catalog.stop()
+                assert not errors, (arm, n_nodes, n_claims, errors[:3])
+                row[arm] = {
+                    "claims_per_sec": round(n_claims / wall, 1),
+                    "candidates_scanned": int(scanned),
+                    "wall_ms": round(wall * 1e3, 1),
+                }
+            row["speedup"] = round(row["indexed"]["claims_per_sec"]
+                                   / max(row["linear"]["claims_per_sec"],
+                                         1e-9), 1)
+            row["candidates_ratio"] = round(
+                row["linear"]["candidates_scanned"]
+                / max(row["indexed"]["candidates_scanned"], 1), 1)
+            out[f"{n_nodes}x{n_claims}"] = row
+            log(f"  nodes={n_nodes:>4} claims={n_claims:>3}: indexed "
+                f"{row['indexed']['claims_per_sec']:.0f}/s scanning "
+                f"{row['indexed']['candidates_scanned']} candidates vs "
+                f"linear {row['linear']['claims_per_sec']:.0f}/s scanning "
+                f"{row['linear']['candidates_scanned']} "
+                f"({row['speedup']:.1f}x alloc rate, "
+                f"{row['candidates_ratio']:.0f}x fewer candidates)")
+    return out
+
+
 def bench_claim_to_ready_grpc(n_claims: int = 30) -> list:
     """Claim-to-ready through the kubelet TRANSPORT: allocated claim ->
     v1 DRAPlugin NodePrepareResources over a real unix:// dra.sock ->
@@ -986,6 +1123,8 @@ SUMMARY_KEYS = [
     "cd_rendezvous_speedup",
     "prep_serial8_ms", "prep_batch8_ms", "prep_batch8_speedup",
     "cel_compile_speedup",
+    "alloc_speedup_1024x512", "alloc_candidates_ratio_1024x512",
+    "alloc_indexed_per_sec_1024x512",
     "recovery_plugin_kill_ms", "recovery_daemon_kill_ms",
     "backend", "devices",
     "matmul_tflops_bf16_steady", "matmul_mfu",
@@ -1077,6 +1216,14 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"  CEL microbench failed ({type(e).__name__}: {e})")
 
+    log("[bench] allocator sweep (indexed catalog vs linear scan, "
+        "16/128/1024 nodes x 1/64/512 claims)…")
+    alloc_sweep = {}
+    try:
+        alloc_sweep = bench_allocator_sweep()
+    except Exception as e:  # noqa: BLE001
+        log(f"  allocator sweep failed ({type(e).__name__}: {e})")
+
     log("[bench] claim-to-ready over unix-socket gRPC (kubelet transport)…")
     lat_g = bench_claim_to_ready_grpc(n_claims=30)
     log(f"  p50={statistics.median(lat_g):.2f} ms (n={len(lat_g)})")
@@ -1163,6 +1310,16 @@ def main() -> int:
         # cel_microbench in the detail file)
         "prep_batch_sweep": sweep,
         "cel_microbench": celb,
+        # indexed-catalog allocator vs the linear-scan architecture
+        # (full grid under allocator_sweep in the detail file)
+        "allocator_sweep": alloc_sweep,
+        **({"alloc_speedup_1024x512":
+                alloc_sweep["1024x512"]["speedup"],
+            "alloc_candidates_ratio_1024x512":
+                alloc_sweep["1024x512"]["candidates_ratio"],
+            "alloc_indexed_per_sec_1024x512":
+                alloc_sweep["1024x512"]["indexed"]["claims_per_sec"]}
+           if alloc_sweep.get("1024x512") else {}),
         **({"prep_serial8_ms": row8["serial_per_claim_ms"],
             "prep_batch8_ms": row8["batch_per_claim_ms"],
             "prep_batch8_speedup": round(

@@ -176,6 +176,44 @@ def test_bench_detail_records_recovery_arms():
         assert key in bench.SUMMARY_KEYS
 
 
+def test_bench_detail_records_allocator_sweep():
+    """The committed BENCH_DETAIL.json must carry the indexed-vs-linear
+    allocator sweep (scale-out allocator PR): candidates-scanned and
+    allocations/sec for both arms across the fleet grid, with the
+    acceptance thresholds holding — so the index-probe perf claim stays
+    falsifiable from the artifact alone, and the bench can't silently
+    drop the sweep."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    with open(path) as f:
+        extra = json.load(f)["extra"]
+    sweep = extra["allocator_sweep"]
+    # full grid minus capacity-limited combos (claims > fleet devices)
+    assert set(sweep) >= {"16x1", "16x64", "128x1", "128x64", "128x512",
+                          "1024x1", "1024x64", "1024x512"}, sweep.keys()
+    for combo, row in sweep.items():
+        for arm in ("indexed", "linear"):
+            for key in ("claims_per_sec", "candidates_scanned", "wall_ms"):
+                assert isinstance(row[arm][key], (int, float)), (
+                    combo, arm, key, row)
+            assert row[arm]["claims_per_sec"] > 0, (combo, arm)
+        assert row["claims"] <= row["devices"], combo
+    # the acceptance bars: >=10x fewer candidates at 1024 nodes and
+    # >=5x higher allocations/sec at claims=512
+    big = sweep["1024x512"]
+    assert big["candidates_ratio"] >= 10, big
+    assert big["speedup"] >= 5, big
+    # headline scalars mirrored for the summary line
+    assert extra["alloc_speedup_1024x512"] == big["speedup"]
+    assert extra["alloc_candidates_ratio_1024x512"] == \
+        big["candidates_ratio"]
+    assert extra["alloc_indexed_per_sec_1024x512"] == \
+        big["indexed"]["claims_per_sec"]
+    for key in ("alloc_speedup_1024x512", "alloc_candidates_ratio_1024x512",
+                "alloc_indexed_per_sec_1024x512"):
+        assert key in bench.SUMMARY_KEYS
+
+
 def test_exactness_verdict_three_states():
     assert bench._exactness_verdict(
         {"exact_greedy": True, "divergence": None}) == "exact"

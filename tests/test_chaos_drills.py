@@ -626,6 +626,163 @@ def test_drill_cd_plugin_crash_mid_prepare_then_restart(harness, point):
 
 
 # ---------------------------------------------------------------------------
+# scale-out allocator drills: commit conflicts and catalog relists at the
+# worst instants
+# ---------------------------------------------------------------------------
+
+
+def _fleet_clients(n_nodes=2, devices_per_node=2):
+    from tests.test_allocator_scale import make_device, make_slice
+    clients = ClientSets()
+    for n in range(n_nodes):
+        clients.resource_slices.create(make_slice(
+            f"node-{n}", [make_device(f"tpu-{d}", type="chip")
+                          for d in range(devices_per_node)]))
+    return clients
+
+
+def _pending_claim(clients, name):
+    return clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "ns"},
+        "spec": {"devices": {"requests": [
+            {"name": "r", "count": 1,
+             "selectors": [{"attribute": "type", "equals": "chip"}]}]}},
+    })
+
+
+def test_drill_allocation_commit_conflict_retries_cleanly():
+    """A resourceVersion conflict on the allocation status write (a
+    concurrent writer touched the claim) must be absorbed by
+    verify-on-commit: re-read, confirm the picked devices are still
+    free, retry exactly once — the claim ends allocated."""
+    from tpu_dra_driver.kube.allocator import Allocator
+    from tpu_dra_driver.kube.errors import ConflictError
+    from tpu_dra_driver.pkg.metrics import ALLOCATOR_COMMIT_CONFLICTS
+
+    clients = _fleet_clients()
+    _pending_claim(clients, "c0")
+    rule = fi.arm("allocator.commit-conflict",
+                  fi.Rule(mode="fail", nth=1,
+                          error=lambda: ConflictError("injected conflict")))
+    c0 = ALLOCATOR_COMMIT_CONFLICTS.value
+    claim = Allocator(clients, "tpu.google.com").allocate("c0", "ns")
+    assert rule.fires == 1
+    assert ALLOCATOR_COMMIT_CONFLICTS.value - c0 == 1
+    results = claim["status"]["allocation"]["devices"]["results"]
+    assert len(results) == 1
+    # and the write really landed in the cluster
+    assert (clients.resource_claims.get("c0", "ns")
+            ["status"]["allocation"]["devices"]["results"] == results)
+
+
+def test_drill_allocation_double_conflict_fails_loud():
+    """The retry budget is ONE: a second consecutive conflict surfaces
+    as an AllocationError instead of looping."""
+    from tpu_dra_driver.kube.allocator import AllocationError, Allocator
+    from tpu_dra_driver.kube.errors import ConflictError
+
+    clients = _fleet_clients()
+    _pending_claim(clients, "c0")
+    rule = fi.arm("allocator.commit-conflict",
+                  fi.Rule(mode="fail", first=2,
+                          error=lambda: ConflictError("injected conflict")))
+    with pytest.raises(AllocationError, match="conflict"):
+        Allocator(clients, "tpu.google.com").allocate("c0", "ns")
+    assert rule.fires == 2
+    assert not (clients.resource_claims.get("c0", "ns")
+                .get("status") or {}).get("allocation")
+
+
+def test_drill_catalog_relist_mid_batch_never_double_allocates():
+    """A watch RELIST landing mid-batch — including one whose index
+    rebuild DIES (fault point catalog.index-rebuild) — must never lead
+    to a device being allocated twice: the batch allocates against its
+    snapshot, the ledger holds committed claims, and a failed rebuild
+    leaves the previous indexes intact until the next relist heals."""
+    from tpu_dra_driver.kube.allocator import Allocator
+    from tpu_dra_driver.kube.catalog import (
+        DeviceCatalog,
+        UsageLedger,
+        build_snapshot,
+    )
+
+    clients = _fleet_clients(n_nodes=2, devices_per_node=2)
+    catalog = DeviceCatalog(clients.resource_slices)
+    catalog.start()
+    assert catalog.wait_synced()
+    try:
+        ledger = UsageLedger("tpu.google.com", catalog.get_device)
+        allocator = Allocator(clients, "tpu.google.com",
+                              catalog=catalog, ledger=ledger)
+        first = allocator.allocate_batch([_pending_claim(clients, "c0")])
+        assert all(r.error is None for r in first.values())
+
+        # RELIST arrives; its rebuild dies mid-way
+        rule = fi.arm("catalog.index-rebuild", fi.Rule(mode="fail", nth=1))
+        s0 = SWALLOWED_ERRORS.labels("catalog.index-rebuild").value
+        items, _ = clients.cluster.list_with_rv("resourceslices")
+        catalog.informer._sub.push((RELIST, {"items": items}))
+        deadline = time.monotonic() + 5
+        while SWALLOWED_ERRORS.labels(
+                "catalog.index-rebuild").value == s0:
+            assert time.monotonic() < deadline
+        assert rule.fires == 1
+
+        # mid-batch allocation right after the failed rebuild
+        batch = [_pending_claim(clients, f"c{i}") for i in (1, 2, 3)]
+        results = allocator.allocate_batch(batch)
+        assert all(r.error is None for r in results.values()), results
+
+        # across ALL allocated claims: every device at most once
+        allocated = []
+        for c in clients.resource_claims.list():
+            for r in ((c.get("status") or {}).get("allocation") or {}
+                      ).get("devices", {}).get("results", []):
+                allocated.append((r["pool"], r["device"]))
+        assert len(allocated) == 4
+        assert len(set(allocated)) == 4, f"double allocation: {allocated}"
+
+        # the next relist heals: catalog converges to the true fleet
+        catalog.informer._sub.push((RELIST, {"items": items}))
+        truth = build_snapshot(clients.resource_slices.list())
+        deadline = time.monotonic() + 5
+        while sorted(catalog.snapshot().devices) != sorted(truth.devices):
+            assert time.monotonic() < deadline
+    finally:
+        catalog.stop()
+
+
+def test_drill_resourceslice_publish_failure_recovers(tmp_path):
+    """A slice write dying mid-republish leaves a partial pool; the next
+    republish must converge it (and the no-op skip must not mask the
+    needed writes)."""
+    from tpu_dra_driver.pkg import featuregates as fg
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name=NODE, state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi"), gates=fg.FeatureGates(),
+        max_devices_per_slice=2))
+    rule = fi.arm("resourceslice.publish", fi.Rule(mode="fail", nth=2))
+    with pytest.raises(fi.FaultInjected):
+        plugin.start()
+    assert rule.fires == 1
+    # partial pool: fewer slices than desired (4 chips / max 2 -> p0+p1)
+    assert len(clients.resource_slices.list()) < 2
+    fi.disarm("resourceslice.publish")
+    plugin._republish()
+    names = sorted(s["metadata"]["name"]
+                   for s in clients.resource_slices.list())
+    assert names == [f"{NODE}-tpu.google.com-p0",
+                     f"{NODE}-tpu.google.com-p1"]
+    plugin.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # review-fix regressions
 # ---------------------------------------------------------------------------
 
@@ -710,6 +867,9 @@ DRILLED_POINTS = [
     "daemon.clique.render",
     "cd.prepare.after_write_ahead",
     "cd.prepare.before_commit",
+    "allocator.commit-conflict",
+    "catalog.index-rebuild",
+    "resourceslice.publish",
 ]
 
 
@@ -718,9 +878,12 @@ def test_drill_matrix_covers_at_least_twelve_registered_points():
     import tpu_dra_driver.computedomain.daemon.daemon  # noqa: F401
     import tpu_dra_driver.computedomain.plugin.device_state  # noqa: F401
     import tpu_dra_driver.grpc_api.server  # noqa: F401
+    import tpu_dra_driver.kube.allocator  # noqa: F401
+    import tpu_dra_driver.kube.catalog  # noqa: F401
     import tpu_dra_driver.kube.informer  # noqa: F401
     import tpu_dra_driver.kube.rest  # noqa: F401
     import tpu_dra_driver.plugin.device_state  # noqa: F401
+    import tpu_dra_driver.plugin.resourceslices  # noqa: F401
     import tpu_dra_driver.tpulib.fake  # noqa: F401
     assert len(DRILLED_POINTS) >= 12
     unregistered = [p for p in DRILLED_POINTS if p not in fi.catalog()]
@@ -730,7 +893,8 @@ def test_drill_matrix_covers_at_least_twelve_registered_points():
     # Only production namespaces count — unit tests register scratch
     # points (p.*) that are not part of the matrix.
     prod = ("rest.", "informer.", "checkpoint.", "plugin.", "cd.",
-            "grpc.", "daemon.", "tpulib.")
+            "grpc.", "daemon.", "tpulib.", "allocator.", "catalog.",
+            "resourceslice.")
     gap = [p for p in drill_catalog_coverage(DRILLED_POINTS)
            if p.startswith(prod)]
     assert all(p.startswith("tpulib.") for p in gap), (

@@ -166,6 +166,15 @@ _NO_SLEEP_DIRS = (
     os.path.join("tpu_dra_driver", "computedomain", "plugin"),
 )
 
+# The scale-out allocation path is equally sleep-free: candidate pruning,
+# ledger updates, and worker draining all block on condition variables or
+# informer events, never on a fixed sleep.
+_NO_SLEEP_FILES = (
+    os.path.join("tpu_dra_driver", "kube", "allocator.py"),
+    os.path.join("tpu_dra_driver", "kube", "catalog.py"),
+    os.path.join("tpu_dra_driver", "kube", "allocation_controller.py"),
+)
+
 
 def _sleep_calls(path):
     import ast
@@ -270,7 +279,9 @@ def test_no_sleep_polling_in_cd_reconcile_paths():
                 if name.endswith(".py"):
                     offenders.extend(
                         _sleep_calls(os.path.join(dirpath, name)))
+    for rel in _NO_SLEEP_FILES:
+        offenders.extend(_sleep_calls(os.path.join(repo, rel)))
     assert offenders == [], (
-        "time.sleep-based polling reintroduced in ComputeDomain reconcile "
+        "time.sleep-based polling reintroduced in reconcile/allocation "
         f"paths: {offenders} — use an informer/watch wake or an "
         "Event.wait with an event that cuts it short")

@@ -1,0 +1,130 @@
+"""allocation-controller binary: the in-repo scheduler role at scale.
+
+Real clusters let kube-scheduler's structured-parameters allocator place
+claims; hardware-free clusters (the sim e2e suite, kind demo clusters
+without a DRA-aware scheduler build) need the same role as a deployable
+component. This binary runs the event-driven
+:class:`~tpu_dra_driver.kube.allocation_controller.AllocationController`:
+informer-fed device catalog + usage ledger, pending claims drained in
+batches by ``--allocator-workers`` workers through one snapshot per
+batch.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.common import dump_config, install_stack_dump_handler
+from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients
+from tpu_dra_driver.kube.allocation_controller import (
+    AllocationController,
+    AllocationControllerConfig,
+)
+from tpu_dra_driver.kube.catalog import DEFAULT_INDEX_ATTRIBUTES
+from tpu_dra_driver.pkg import faultinject
+from tpu_dra_driver.pkg.flags import (
+    EnvArgumentParser,
+    add_common_flags,
+    config_dict,
+    parse_http_endpoint,
+    setup_logging,
+)
+
+
+def build_parser() -> EnvArgumentParser:
+    p = EnvArgumentParser(prog="allocation-controller")
+    add_common_flags(p)
+    p.add_argument("--driver-name", env="ALLOCATOR_DRIVER_NAME",
+                   default=DRIVER_NAME,
+                   help="DRA driver whose ResourceSlices this allocator "
+                        "serves")
+    p.add_argument("--allocator-workers", env="ALLOCATOR_WORKERS",
+                   type=int, default=2,
+                   help="worker threads draining the pending-claim queue "
+                        "(parallel batches; ledger reservations keep them "
+                        "conflict-free)")
+    p.add_argument("--allocator-batch", env="ALLOCATOR_BATCH",
+                   type=int, default=64,
+                   help="max claims allocated against one catalog+usage "
+                        "snapshot per batch")
+    p.add_argument("--index-attributes", env="ALLOCATOR_INDEX_ATTRIBUTES",
+                   default=",".join(DEFAULT_INDEX_ATTRIBUTES),
+                   help="comma-separated attribute names the device "
+                        "catalog maintains equality indexes over")
+    p.add_argument("--http-endpoint", env="HTTP_ENDPOINT", default="",
+                   help="host:port for /metrics (dra_allocator_*, "
+                        "dra_allocation_seconds), /healthz and "
+                        "/debug/threads; empty disables")
+    p.add_argument("--leader-election", env="LEADER_ELECTION",
+                   action="store_true", default=False,
+                   help="lease-based leader election; REQUIRED when "
+                        "running more than one replica — the ledger's "
+                        "reservations only coordinate workers inside one "
+                        "process, and verify-on-commit only catches "
+                        "conflicting writers of the SAME claim, so two "
+                        "concurrent allocators could hand one device to "
+                        "two different claims")
+    p.add_argument("--leader-election-namespace",
+                   env="LEADER_ELECTION_NAMESPACE", default="tpu-dra-driver")
+    p.add_argument("--identity", env="POD_NAME", default="allocator")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.verbosity)
+    faultinject.arm_from_env()
+    install_stack_dump_handler()
+    dump_config("allocation-controller", config_dict(args))
+
+    clients = make_clients(args)
+    index_attributes = tuple(
+        a.strip() for a in args.index_attributes.split(",") if a.strip())
+    controller = AllocationController(clients, AllocationControllerConfig(
+        driver_name=args.driver_name,
+        workers=args.allocator_workers,
+        batch_max=args.allocator_batch,
+        index_attributes=index_attributes))
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    debug_server = None
+    address = parse_http_endpoint(args.http_endpoint)
+    if address is not None:
+        from tpu_dra_driver.pkg.metrics import DebugHTTPServer
+        debug_server = DebugHTTPServer(
+            address, ready_check=lambda: controller.claim_informer.synced)
+        debug_server.start()
+
+    if args.leader_election:
+        from tpu_dra_driver.kube.leaderelection import (
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+        elector = LeaderElector(
+            clients.leases,
+            LeaderElectionConfig(identity=args.identity,
+                                 namespace=args.leader_election_namespace,
+                                 lease_name="allocation-controller"),
+            on_started_leading=controller.start,
+            on_stopped_leading=controller.stop)
+        elector.start()
+        stop.wait()
+        elector.stop()
+    else:
+        controller.start()
+        stop.wait()
+        controller.stop()
+    if debug_server is not None:
+        debug_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,12 @@ def build_parser() -> EnvArgumentParser:
     p.add_argument("--driver-root", env="DRIVER_ROOT", default="/")
     p.add_argument("--slice-layout", env="SLICE_LAYOUT", default="combined",
                    choices=["combined", "split"])
+    p.add_argument("--max-devices-per-slice", env="MAX_DEVICES_PER_SLICE",
+                   type=int, default=0,
+                   help="split combined-layout device lists over multiple "
+                        "slices above this many devices (stable slice-name "
+                        "assignment keeps a one-device change local to one "
+                        "slice); 0 publishes one combined slice")
     p.add_argument("--plugin-registry", env="PLUGIN_REGISTRY",
                    default="/var/lib/kubelet/plugins_registry")
     p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
@@ -102,7 +108,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
         node_name=args.node_name, state_dir=args.state_dir,
         cdi_root=args.cdi_root, driver_root=args.driver_root,
-        slice_layout=args.slice_layout, gates=parse_gates(args)))
+        slice_layout=args.slice_layout, gates=parse_gates(args),
+        max_devices_per_slice=args.max_devices_per_slice))
     plugin.start()
 
     # Rolling update: unique-per-instance socket names (dra-<uid>.sock /

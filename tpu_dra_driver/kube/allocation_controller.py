@@ -1,0 +1,256 @@
+"""The allocation controller: the scheduler role, event-driven at scale.
+
+Reference analog: kube-scheduler's DRA plugin — pending ResourceClaims
+are discovered by informer, allocated against the structured-parameters
+device model, and the allocation is committed to claim status. The
+in-repo equivalent drains pending claims through
+:meth:`Allocator.allocate_batch` so N claims share ONE catalog+usage
+snapshot, with ``--allocator-workers`` worker threads for parallel
+batches. Ledger reservations keep concurrent workers conflict-free
+WITHIN one process; across replicas run the binary with
+``--leader-election`` — verify-on-commit only catches conflicting
+writers of the SAME claim object, so two live allocators could hand one
+device to two different claims.
+
+Wiring:
+
+- a :class:`DeviceCatalog` (ResourceSlice informer, attribute indexes),
+- a claim informer feeding both the pending queue and the
+  :class:`UsageLedger` (allocate/deallocate deltas, deduped by UID),
+- unsatisfiable claims are PARKED and retried when the fleet changes
+  (any ResourceSlice event re-queues them) or on the retry backstop —
+  no sleep-polling anywhere, workers block on a condition variable.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.kube import catalog as catalog_mod
+from tpu_dra_driver.kube.allocator import Allocator
+from tpu_dra_driver.kube.catalog import DeviceCatalog, UsageLedger
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+
+log = logging.getLogger(__name__)
+
+_Key = Tuple[str, str]  # (namespace, name)
+
+
+@dataclass
+class AllocationControllerConfig:
+    driver_name: str = DRIVER_NAME
+    #: worker threads draining the pending queue (parallel batches)
+    workers: int = 2
+    #: max claims allocated against one snapshot per batch
+    batch_max: int = 64
+    #: attribute equality keys the catalog indexes
+    index_attributes: Tuple[str, ...] = field(
+        default=catalog_mod.DEFAULT_INDEX_ATTRIBUTES)
+    #: backstop interval for retrying parked (unsatisfiable) claims —
+    #: slice events retry them immediately; this heals missed events
+    retry_interval: float = 5.0
+
+
+class AllocationController:
+    """Drains pending ResourceClaims through batched, indexed allocation."""
+
+    def __init__(self, clients: ClientSets,
+                 config: Optional[AllocationControllerConfig] = None):
+        self._clients = clients
+        self._config = config or AllocationControllerConfig()
+        self.catalog = DeviceCatalog(
+            clients.resource_slices,
+            index_attributes=self._config.index_attributes)
+        self.claim_informer = Informer(clients.resource_claims)
+        self.ledger = UsageLedger(self._config.driver_name,
+                                  self.catalog.get_device)
+        self.allocator = Allocator(
+            clients, self._config.driver_name,
+            catalog=self.catalog, ledger=self.ledger,
+            index_attributes=self._config.index_attributes)
+        self._cond = threading.Condition()
+        self._pending: Dict[_Key, None] = {}       # ordered dedupe
+        self._parked: Dict[_Key, None] = {}
+        self._inflight = 0
+        # set by slice events, consumed by a worker before its next
+        # batch: an event storm (fleet-wide republish) coalesces into
+        # ONE ledger counter recompute instead of one per event
+        self._fleet_dirty = False
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        # ledger + queue feed from the same claim informer; handlers are
+        # registered before start() so the initial ADDED replay seeds both
+        self.ledger.attach(self.claim_informer)
+        self.claim_informer.add_handlers(
+            on_add=self._on_claim,
+            on_update=lambda old, new: self._on_claim(new),
+            on_delete=self._on_claim_deleted)
+        # fleet changes retry parked claims and refresh ledger counters
+        # for devices whose definitions arrived late
+        self.catalog.informer.add_handlers(
+            on_add=lambda obj: self._on_fleet_change(),
+            on_update=lambda old, new: self._on_fleet_change(),
+            on_delete=lambda obj: self._on_fleet_change())
+        self.catalog.start()
+        self.claim_informer.start()
+        self.catalog.wait_synced()
+        self.claim_informer.wait_synced()
+        for i in range(max(1, self._config.workers)):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"allocator-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        log.info("allocation controller started (%d workers, batch<=%d, "
+                 "indexes=%s)", self._config.workers, self._config.batch_max,
+                 ",".join(self._config.index_attributes))
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.claim_informer.stop()
+        self.catalog.stop()
+
+    # -- informer handlers -------------------------------------------------
+
+    def _on_claim(self, obj: Dict) -> None:
+        meta = obj.get("metadata") or {}
+        key = (meta.get("namespace", ""), meta.get("name", ""))
+        if (obj.get("status") or {}).get("allocation"):
+            with self._cond:
+                self._pending.pop(key, None)
+                self._parked.pop(key, None)
+            return
+        with self._cond:
+            self._parked.pop(key, None)
+            self._pending[key] = None
+            self._cond.notify()
+
+    def _on_claim_deleted(self, obj: Dict) -> None:
+        meta = obj.get("metadata") or {}
+        key = (meta.get("namespace", ""), meta.get("name", ""))
+        with self._cond:
+            self._pending.pop(key, None)
+            self._parked.pop(key, None)
+
+    def _on_fleet_change(self) -> None:
+        """Slice event: mark the ledger's counter view stale and retry
+        parked claims. The recompute itself runs on a worker thread
+        right before its next batch (coalesced — a republish wave across
+        the fleet costs one recompute, and the informer dispatch thread
+        never blocks on O(claims) work)."""
+        with self._cond:
+            self._fleet_dirty = True
+        self._requeue_parked()
+
+    def _requeue_parked(self) -> None:
+        with self._cond:
+            if not self._parked:
+                return
+            for key in self._parked:
+                self._pending.setdefault(key, None)
+            self._parked.clear()
+            self._cond.notify_all()
+
+    # -- workers -----------------------------------------------------------
+
+    def _take_batch(self) -> List[_Key]:
+        """Block until work or stop; pop up to batch_max pending keys.
+        The timed wait doubles as the parked-claim retry backstop."""
+        with self._cond:
+            while not self._pending and not self._stop.is_set():
+                timed_out = not self._cond.wait(
+                    timeout=self._config.retry_interval)
+                if timed_out and self._parked:
+                    for key in self._parked:
+                        self._pending.setdefault(key, None)
+                    self._parked.clear()
+            keys = list(self._pending)[:self._config.batch_max]
+            for key in keys:
+                del self._pending[key]
+            if keys:
+                self._inflight += 1
+            return keys
+
+    def _finish_batch(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            keys = self._take_batch()
+            if not keys:
+                continue
+            try:
+                self._run_batch(keys)
+            finally:
+                self._finish_batch()
+
+    def _run_batch(self, keys: List[_Key]) -> None:
+        with self._cond:
+            fleet_dirty = self._fleet_dirty
+            self._fleet_dirty = False
+        if fleet_dirty:
+            self.ledger.recompute_counters()
+        claims = []
+        for ns, name in keys:
+            obj = self.claim_informer.get(name, ns)
+            if obj is None or (obj.get("status") or {}).get("allocation"):
+                continue
+            claims.append(obj)
+        if not claims:
+            return
+        try:
+            results = self.allocator.allocate_batch(claims)
+        except Exception:  # chaos-ok: counted; claims re-park for retry
+            SWALLOWED_ERRORS.labels("allocation_controller.batch").inc()
+            log.exception("allocation batch of %d failed wholesale",
+                          len(claims))
+            with self._cond:
+                for claim in claims:
+                    meta = claim["metadata"]
+                    self._parked[(meta.get("namespace", ""),
+                                  meta["name"])] = None
+            return
+        for claim in claims:
+            meta = claim["metadata"]
+            key = (meta.get("namespace", ""), meta["name"])
+            res = results.get(meta["uid"])
+            if res is not None and res.error is not None:
+                log.info("claim %s/%s not allocatable yet: %s",
+                         key[0], key[1], res.error)
+                with self._cond:
+                    self._parked[key] = None
+
+    # -- introspection -----------------------------------------------------
+
+    def queue_depths(self) -> Tuple[int, int]:
+        with self._cond:
+            return len(self._pending), len(self._parked)
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Test helper: wait until no pending or in-flight claims remain
+        (parked claims — unsatisfiable until the fleet changes — don't
+        count). Bounded condition waits, no sleep-polling."""
+        import time as _time
+        end = _time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._inflight:
+                left = end - _time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=min(left, 0.05))
+            return True

@@ -1,4 +1,4 @@
-"""A minimal DRA allocator — the kube-scheduler role for tests/demos.
+"""A scale-out DRA allocator — the kube-scheduler role for tests/demos.
 
 The reference relies on the real scheduler's DRA allocator; hardware-free
 testing here needs the same behavior in-process: satisfy ResourceClaim
@@ -17,6 +17,29 @@ device requests against published ResourceSlices, honoring
   after all existing allocations (this is what makes a full chip and an
   overlapping sub-slice mutually exclusive).
 
+Scale architecture (the kube-scheduler snapshot/indexed-lister shape;
+see docs/allocator.md):
+
+- Candidate devices come from **index intersection** over a
+  :class:`~tpu_dra_driver.kube.catalog.CatalogSnapshot` — the selector's
+  compiled form yields an index probe plan
+  (``CompiledSelector.index_constraints``), and only when nothing is
+  extractable does the allocator fall back to scanning the full
+  driver/node candidate set. Probes prune, they never decide: the full
+  selector still evaluates on every candidate, so indexed and linear
+  paths pick identical winners.
+- Cluster usage comes from a **snapshot**, not a per-call LIST: a live
+  :class:`~tpu_dra_driver.kube.catalog.UsageLedger` (claim-informer-fed,
+  deduped by claim UID) when the allocator runs inside the allocation
+  controller, or a one-shot LIST-derived equivalent for the standalone
+  path tests and demos use.
+- :meth:`Allocator.allocate_batch` allocates N pending claims against
+  ONE snapshot with per-claim error isolation (mirroring the kubelet
+  plugin's ``prepare_batch`` semantics), and commits each allocation
+  with resourceVersion verify-on-commit plus one retry on conflict (the
+  ``allocator.commit-conflict`` fault point fires before every commit
+  write).
+
 Selector format (per request)::
 
     {"attribute": "type", "equals": "chip"}
@@ -24,14 +47,43 @@ Selector format (per request)::
 
 Counter values are k8s quantities (parsed exactly — "16Gi" and plain
 integer strings both work); arithmetic happens on exact integer byte
-counts.
+counts, scoped per pool so same-named counter sets on different nodes
+never conflate.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+import copy
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from tpu_dra_driver.kube import catalog as catalog_mod
+from tpu_dra_driver.kube.catalog import (
+    CatalogSnapshot,
+    CounterKey,
+    DeviceCatalog,
+    DeviceEntry,
+    DeviceKey,
+    UsageLedger,
+    claim_allocated_keys,
+    device_counter_consumption,
+)
 from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.errors import ConflictError, NotFoundError
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import (
+    ALLOCATION_SECONDS,
+    ALLOCATOR_CANDIDATES_SCANNED,
+    ALLOCATOR_COMMIT_CONFLICTS,
+    ALLOCATOR_INDEX_HITS,
+)
+
+fi.register("allocator.commit-conflict",
+            "before each allocation status write (fail with a "
+            "ConflictError models a concurrent writer bumping the "
+            "claim's resourceVersion; the allocator must verify and "
+            "retry exactly once)")
 
 
 class AllocationError(RuntimeError):
@@ -42,23 +94,14 @@ def _qty_int(value) -> int:
     """Counter/capacity value -> exact int. Accepts plain ints and any
     k8s quantity string ("8", "16Gi", "1500m" is rejected as
     non-integral — counters are whole units)."""
-    from tpu_dra_driver.kube import cel
-    if isinstance(value, int):
-        return value
-    q = cel.Quantity(str(value))
-    if not q.isInteger():
-        raise AllocationError(f"counter value {value!r} is not integral")
-    return q.asInteger()
+    try:
+        return catalog_mod.qty_int(value)
+    except ValueError as e:
+        raise AllocationError(str(e)) from e
 
 
 def _attr_value(dev: Dict, name: str):
-    a = (dev.get("attributes") or {}).get(name)
-    if a is None:
-        return None
-    for k in ("string", "int", "bool", "version"):
-        if k in a:
-            return a[k]
-    return None
+    return catalog_mod.attr_value(dev, name)
 
 
 def _eval_cel(dev: Dict, driver: str, expression: str) -> bool:
@@ -131,52 +174,109 @@ def _matches(dev: Dict, selectors: List[Dict], driver: str = "") -> bool:
     return True
 
 
-def _counter_usage(slices: List[Dict], allocated: List[Tuple[str, str]]
-                   ) -> Dict[Tuple[str, str], int]:
-    """(counterSet, counter) -> already-consumed amount, over the devices in
-    ``allocated`` [(pool, device-name)]."""
-    device_index: Dict[Tuple[str, str], Dict] = {}
-    for s in slices:
-        pool = s["spec"]["pool"]["name"]
-        for d in s["spec"].get("devices") or []:
-            device_index[(pool, d["name"])] = d
-    usage: Dict[Tuple[str, str], int] = {}
-    for key in allocated:
-        dev = device_index.get(key)
-        if not dev:
-            continue
-        for cc in dev.get("consumesCounters") or []:
-            cs = cc["counterSet"]
-            for cname, cval in (cc.get("counters") or {}).items():
-                usage[(cs, cname)] = (usage.get((cs, cname), 0)
-                                      + _qty_int(cval["value"]))
-    return usage
+def _index_constraints(selectors: List[Dict], driver: str):
+    """The merged index probe plan for one request: the selector list is
+    conjunctive, so constraints from every selector combine. Compile
+    errors surface here exactly as they would during evaluation (same
+    cached error via the compile LRU)."""
+    from tpu_dra_driver.kube import cel
+
+    out: List[cel.IndexConstraint] = []
+    for sel in selectors or []:
+        if "cel" in sel:
+            expr = (sel["cel"] or {}).get("expression", "")
+            try:
+                out.extend(cel.compile_selector(expr).index_constraints())
+            except (cel.CelUnsupportedError, cel.CelEvalError) as e:
+                raise AllocationError(f"selector {expr!r}: {e}") from e
+        elif "equals" in sel and isinstance(sel["equals"], str):
+            # legacy matcher: a direct attribute equality (domain-free).
+            # STRING values only — the legacy matcher compares with
+            # Python ==, where True equals 1, so a bool probe could
+            # exclude an int-attributed device the linear path accepts
+            # (CEL probes are safe: _hetero_eq keeps bool != int)
+            out.append(cel.IndexConstraint(
+                "attr", "", sel.get("attribute", ""), sel["equals"]))
+    return tuple(out)
 
 
-def _counter_capacity(slices: List[Dict]) -> Dict[Tuple[str, str], int]:
-    cap: Dict[Tuple[str, str], int] = {}
-    for s in slices:
-        for cs in s["spec"].get("sharedCounters") or []:
-            for cname, cval in (cs.get("counters") or {}).items():
-                cap[(cs["name"], cname)] = _qty_int(cval["value"])
-    return cap
+@dataclass
+class AllocationResult:
+    """Per-claim outcome of :meth:`Allocator.allocate_batch`."""
+
+    claim: Optional[Dict] = None        # the updated (allocated) claim
+    error: Optional[str] = None
+
+
+class _BatchState:
+    """Mutable per-batch view: the snapshot's usage evolves as the batch
+    commits claims, so claim N sees claim N-1's devices as taken."""
+
+    __slots__ = ("taken", "usage")
+
+    def __init__(self, taken: Set[DeviceKey], usage: Dict[CounterKey, int]):
+        self.taken = taken
+        self.usage = usage
 
 
 class Allocator:
-    """Allocates pending ResourceClaims against the slices in the cluster."""
+    """Allocates pending ResourceClaims against the slices in the cluster.
 
-    def __init__(self, clients: ClientSets, driver_name: str = "tpu.google.com"):
+    Standalone (``Allocator(clients)``) it builds a one-shot snapshot
+    per call — the historical behavior, now routed through the same
+    indexed-candidate machinery. Handed a live :class:`DeviceCatalog`
+    and :class:`UsageLedger` (the allocation controller wiring), the
+    per-call LISTs disappear entirely and concurrent workers coordinate
+    through ledger reservations."""
+
+    def __init__(self, clients: ClientSets,
+                 driver_name: str = "tpu.google.com",
+                 catalog: Optional[DeviceCatalog] = None,
+                 ledger: Optional[UsageLedger] = None,
+                 use_index: bool = True,
+                 index_attributes: Iterable[str]
+                 = catalog_mod.DEFAULT_INDEX_ATTRIBUTES):
         self._clients = clients
         self._driver = driver_name
+        self._catalog = catalog
+        self._ledger = ledger
+        self._use_index = use_index
+        self._index_attributes = tuple(index_attributes)
 
-    def _allocated_devices(self) -> List[Tuple[str, str]]:
-        out = []
+    # ------------------------------------------------------------------
+    # snapshots
+    # ------------------------------------------------------------------
+
+    def _catalog_snapshot(self) -> CatalogSnapshot:
+        if self._catalog is not None:
+            return self._catalog.snapshot()
+        return catalog_mod.build_snapshot(
+            self._clients.resource_slices.list(),
+            index_attributes=self._index_attributes)
+
+    def _usage_snapshot(self, snap: CatalogSnapshot) -> _BatchState:
+        if self._ledger is not None:
+            taken, usage = self._ledger.snapshot()
+            return _BatchState(taken, usage)
+        # one-shot LIST path: derive usage from live claims, deduped by
+        # claim UID via claim_allocated_keys (a claim whose allocation
+        # was removed contributes nothing, no matter what stale
+        # reservedFor entries its status still carries)
+        taken: Set[DeviceKey] = set()
+        usage: Dict[CounterKey, int] = {}
         for c in self._clients.resource_claims.list():
-            alloc = ((c.get("status") or {}).get("allocation") or {})
-            for r in (alloc.get("devices") or {}).get("results") or []:
-                if r.get("driver") == self._driver and not r.get("adminAccess"):
-                    out.append((r.get("pool", ""), r.get("device", "")))
-        return out
+            for key in claim_allocated_keys(c, self._driver):
+                taken.add(key)
+                dev = snap.get_device(key)
+                if dev is not None:
+                    for ck, amount in device_counter_consumption(
+                            dev, key[0]).items():
+                        usage[ck] = usage.get(ck, 0) + amount
+        return _BatchState(taken, usage)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
 
     def allocate(self, claim_name: str, namespace: str,
                  node_name: Optional[str] = None) -> Dict:
@@ -185,83 +285,276 @@ class Allocator:
         claim = self._clients.resource_claims.get(claim_name, namespace)
         if (claim.get("status") or {}).get("allocation"):
             return claim  # already allocated
+        uid = claim["metadata"]["uid"]
+        res = self.allocate_batch([claim], node_name=node_name)[uid]
+        if res.error is not None:
+            raise AllocationError(res.error)
+        return res.claim
 
-        slices = [s for s in self._clients.resource_slices.list()
-                  if s["spec"].get("driver") == self._driver
-                  and (node_name is None or s["spec"].get("nodeName") == node_name)]
-        if not slices:
-            raise AllocationError(f"no ResourceSlices published by {self._driver}")
+    def allocate_batch(self, claims: List[Dict],
+                       node_name: Optional[str] = None
+                       ) -> Dict[str, AllocationResult]:
+        """Allocate N pending claims against ONE catalog+usage snapshot.
 
-        capacity = _counter_capacity(slices)
-        allocated = self._allocated_devices()
-        usage = _counter_usage(slices, allocated)
-        taken = set(allocated)
+        Per-claim error isolation (``prepare_batch`` semantics): one
+        unsatisfiable claim records its error and the rest of the batch
+        proceeds. Already-allocated claims pass through untouched.
+        Returns {claim uid: AllocationResult}."""
+        snap = self._catalog_snapshot()
+        state = self._usage_snapshot(snap)
+        out: Dict[str, AllocationResult] = {}
+        for claim in claims:
+            uid = claim["metadata"]["uid"]
+            t0 = time.perf_counter()
+            try:
+                out[uid] = AllocationResult(
+                    claim=self._allocate_one(claim, snap, state, node_name))
+            except AllocationError as e:
+                out[uid] = AllocationResult(error=str(e))
+            except Exception as e:  # chaos-ok: per-claim isolation, surfaced in the result
+                out[uid] = AllocationResult(
+                    error=f"{type(e).__name__}: {e}")
+            ALLOCATION_SECONDS.observe(time.perf_counter() - t0)
+        return out
 
-        results = []
-        for req in ((claim.get("spec") or {}).get("devices") or {}).get("requests") or []:
+    # ------------------------------------------------------------------
+    # single-claim allocation against a snapshot
+    # ------------------------------------------------------------------
+
+    def _allocate_one(self, claim: Dict, snap: CatalogSnapshot,
+                      state: _BatchState,
+                      node_name: Optional[str]) -> Dict:
+        if (claim.get("status") or {}).get("allocation"):
+            return claim  # already allocated
+        if not snap.has_driver(self._driver):
+            raise AllocationError(
+                f"no ResourceSlices published by {self._driver}")
+
+        uid = claim["metadata"]["uid"]
+        results: List[Dict] = []
+        picked_entries: List[DeviceEntry] = []
+        try:
+            self._pick_requests(claim, snap, state, node_name, results,
+                                picked_entries)
+        except Exception:
+            # ANY mid-claim failure (unsatisfiable request, selector
+            # compile/eval error, malformed counter value) must release
+            # what this claim already consumed, or the rest of the batch
+            # sees phantom taken devices (_unwind is idempotent)
+            self._unwind(picked_entries, state)
+            raise
+
+        if self._ledger is not None and picked_entries:
+            if not self._ledger.reserve(uid, picked_entries,
+                                        snap.counter_caps):
+                # raced a concurrent worker between snapshot and pick:
+                # the snapshot was stale for these devices
+                self._unwind(picked_entries, state)
+                raise AllocationError(
+                    "allocation raced a concurrent claim; devices no "
+                    "longer free")
+        try:
+            updated = self._commit(claim, results)
+        except Exception:
+            self._unwind(picked_entries, state)
+            if self._ledger is not None:
+                self._ledger.release(uid)
+            raise
+        self._reconcile_batch_state(updated, snap, state, picked_entries)
+        return updated
+
+    def _pick_requests(self, claim: Dict, snap: CatalogSnapshot,
+                       state: _BatchState, node_name: Optional[str],
+                       results: List[Dict],
+                       picked_entries: List[DeviceEntry]) -> None:
+        for req in ((claim.get("spec") or {}).get("devices") or {}
+                    ).get("requests") or []:
             rname = req.get("name", "device")
             count = req.get("count", 1)
             selectors = req.get("selectors") or []
             admin = bool(req.get("adminAccess", False))
+            entries = self._candidates(snap, selectors, node_name)
             picked = 0
-            for s in slices:
-                pool = s["spec"]["pool"]["name"]
-                node = s["spec"].get("nodeName", "")
-                for dev in s["spec"].get("devices") or []:
-                    if picked >= count:
-                        break
-                    key = (pool, dev["name"])
-                    if not admin and key in taken:
-                        continue
-                    if not _matches(dev, selectors,
-                                    driver=s["spec"].get("driver",
-                                                         self._driver)):
-                        continue
-                    if not admin and not self._counters_fit(dev, capacity, usage):
-                        continue
-                    # commit
-                    if not admin:
-                        taken.add(key)
-                        self._consume(dev, usage)
-                    results.append({
-                        "request": rname, "driver": self._driver,
-                        "pool": pool, "device": dev["name"],
-                        "nodeName": node,
-                        **({"adminAccess": True} if admin else {}),
-                    })
-                    picked += 1
+            for entry in entries:
+                if picked >= count:
+                    break
+                dev = entry.device
+                if not admin and entry.key in state.taken:
+                    continue
+                if not _matches(dev, selectors, driver=entry.driver):
+                    continue
+                if not admin and not self._counters_fit(
+                        entry, snap.counter_caps, state.usage):
+                    continue
+                # commit into the batch state
+                if not admin:
+                    state.taken.add(entry.key)
+                    self._consume(entry, state.usage)
+                    picked_entries.append(entry)
+                results.append({
+                    "request": rname, "driver": self._driver,
+                    "pool": entry.pool, "device": entry.key[1],
+                    "nodeName": entry.node,
+                    **({"adminAccess": True} if admin else {}),
+                })
+                picked += 1
             if picked < count:
                 raise AllocationError(
                     f"request {rname!r}: only {picked}/{count} devices "
                     f"available matching selectors"
                 )
 
+    def _reconcile_batch_state(self, updated: Dict, snap: CatalogSnapshot,
+                               state: _BatchState,
+                               picked_entries: List[DeviceEntry]) -> None:
+        """After commit: if a CONCURRENT allocator won the claim (theirs
+        returned from _commit), the batch state still holds OUR picks —
+        swap them for the winner's actual devices so the rest of the
+        batch neither skips free devices nor reuses the winner's."""
+        got = {(r["pool"], r["device"])
+               for r in ((updated.get("status") or {}).get("allocation")
+                         or {}).get("devices", {}).get("results", [])
+               if not r.get("adminAccess")}
+        ours = {e.key for e in picked_entries}
+        if got == ours:
+            return
+        self._unwind(picked_entries, state)
+        for key in got:
+            state.taken.add(key)
+            dev = snap.get_device(key)
+            if dev is not None:
+                for ck, amount in device_counter_consumption(
+                        dev, key[0]).items():
+                    state.usage[ck] = state.usage.get(ck, 0) + amount
+
+    def _candidates(self, snap: CatalogSnapshot, selectors: List[Dict],
+                    node_name: Optional[str]) -> List[DeviceEntry]:
+        if self._use_index:
+            constraints = _index_constraints(selectors, self._driver)
+            entries, used_index = snap.candidates(self._driver, node_name,
+                                                  constraints)
+        else:
+            entries = snap.all_candidates(self._driver, node_name)
+            used_index = False
+        ALLOCATOR_CANDIDATES_SCANNED.observe(len(entries))
+        ALLOCATOR_INDEX_HITS.labels(
+            "index" if used_index else "fallback").inc()
+        return entries
+
+    @staticmethod
+    def _unwind(picked: List[DeviceEntry], state: _BatchState) -> None:
+        """Back out a failed claim's in-batch consumption so the rest of
+        the batch sees a clean state (per-claim isolation)."""
+        for entry in picked:
+            state.taken.discard(entry.key)
+            for ck, amount in device_counter_consumption(
+                    entry.device, entry.pool).items():
+                left = state.usage.get(ck, 0) - amount
+                if left > 0:
+                    state.usage[ck] = left
+                else:
+                    state.usage.pop(ck, None)
+        picked.clear()
+
+    # ------------------------------------------------------------------
+    # commit: verify-on-commit with one retry on conflict
+    # ------------------------------------------------------------------
+
+    def _build_allocation(self, claim: Dict, results: List[Dict]) -> Dict:
         node = results[0].get("nodeName", "") if results else ""
         configs = []
-        for req_cfg in ((claim.get("spec") or {}).get("devices") or {}).get("config") or []:
+        for req_cfg in ((claim.get("spec") or {}).get("devices") or {}
+                        ).get("config") or []:
             configs.append({**req_cfg, "source": "FromClaim"})
-        claim.setdefault("status", {})["allocation"] = {
+        return {
             "devices": {"results": results, "config": configs},
             "nodeSelector": {"kubernetes.io/hostname": node} if node else None,
         }
-        return self._clients.resource_claims.update(claim)
+
+    def _commit(self, claim: Dict, results: List[Dict]) -> Dict:
+        """Write status.allocation with the claim's resourceVersion as
+        the optimistic-concurrency guard. On conflict: re-read; if a
+        concurrent writer already allocated the claim, theirs wins; else
+        verify our devices are still free and retry exactly once."""
+        name = claim["metadata"]["name"]
+        namespace = claim["metadata"].get("namespace", "")
+        obj = copy.deepcopy(claim)
+        obj.setdefault("status", {})["allocation"] = \
+            self._build_allocation(claim, results)
+        try:
+            fi.fire("allocator.commit-conflict")
+            updated = self._clients.resource_claims.update(obj)
+        except ConflictError:
+            ALLOCATOR_COMMIT_CONFLICTS.inc()
+            try:
+                fresh = self._clients.resource_claims.get(name, namespace)
+            except NotFoundError as e:
+                raise AllocationError(
+                    f"claim {namespace}/{name} deleted mid-allocation"
+                ) from e
+            if (fresh.get("status") or {}).get("allocation"):
+                # a concurrent allocator won; ours is redundant
+                if self._ledger is not None:
+                    self._ledger.release(claim["metadata"]["uid"])
+                    self._ledger.observe_claim(fresh)
+                return fresh
+            if not self._devices_still_free(fresh, results):
+                raise AllocationError(
+                    "commit conflict: picked devices were allocated "
+                    "concurrently")
+            fresh.setdefault("status", {})["allocation"] = \
+                self._build_allocation(fresh, results)
+            try:
+                fi.fire("allocator.commit-conflict")
+                updated = self._clients.resource_claims.update(fresh)
+            except ConflictError as e:
+                raise AllocationError(
+                    f"allocation commit conflicted twice for "
+                    f"{namespace}/{name}: {e}") from e
+        if self._ledger is not None:
+            # the reservation graduates into the claim's ledger entry
+            self._ledger.observe_claim(updated)
+        return updated
+
+    def _devices_still_free(self, fresh_claim: Dict,
+                            results: List[Dict]) -> bool:
+        """Verify-on-commit: after a conflict, our picked devices must
+        still be unallocated in current cluster state (minus our own
+        reservation) before the one retry is allowed."""
+        uid = fresh_claim["metadata"]["uid"]
+        picked = {(r["pool"], r["device"]) for r in results
+                  if not r.get("adminAccess")}
+        if not picked:
+            return True
+        if self._ledger is not None:
+            # our own reservation still holds these keys; the question
+            # is whether any OTHER claim or reservation also does
+            return not self._ledger.held_by_other(picked, uid)
+        for c in self._clients.resource_claims.list():
+            if c["metadata"]["uid"] == uid:
+                continue
+            if picked & set(claim_allocated_keys(c, self._driver)):
+                return False
+        return True
+
+    # ------------------------------------------------------------------
+    # counter arithmetic
+    # ------------------------------------------------------------------
 
     @staticmethod
-    def _counters_fit(dev: Dict, capacity: Dict, usage: Dict) -> bool:
-        for cc in dev.get("consumesCounters") or []:
-            cs = cc["counterSet"]
-            for cname, cval in (cc.get("counters") or {}).items():
-                cap = capacity.get((cs, cname))
-                if cap is None:
-                    return False
-                if usage.get((cs, cname), 0) + _qty_int(cval["value"]) > cap:
-                    return False
+    def _counters_fit(entry: DeviceEntry, capacity: Dict[CounterKey, int],
+                      usage: Dict[CounterKey, int]) -> bool:
+        for ck, amount in device_counter_consumption(
+                entry.device, entry.pool).items():
+            cap = capacity.get(ck)
+            if cap is None:
+                return False
+            if usage.get(ck, 0) + amount > cap:
+                return False
         return True
 
     @staticmethod
-    def _consume(dev: Dict, usage: Dict) -> None:
-        for cc in dev.get("consumesCounters") or []:
-            cs = cc["counterSet"]
-            for cname, cval in (cc.get("counters") or {}).items():
-                usage[(cs, cname)] = (usage.get((cs, cname), 0)
-                                      + _qty_int(cval["value"]))
+    def _consume(entry: DeviceEntry, usage: Dict[CounterKey, int]) -> None:
+        for ck, amount in device_counter_consumption(
+                entry.device, entry.pool).items():
+            usage[ck] = usage.get(ck, 0) + amount

@@ -1,0 +1,615 @@
+"""Indexed device catalog + incremental usage ledger for the allocator.
+
+Reference analog: the structured-parameters allocator in kube-scheduler
+(k8s.io/dynamic-resource-allocation/structured) walks every device in
+every ResourceSlice per pending claim and re-derives cluster usage from a
+full claim LIST — O(nodes x devices x claims). client-go's answer at
+scale is indexed listers over shared-informer stores plus a scheduler
+snapshot; this module is that shape for the in-repo allocator:
+
+- :class:`DeviceCatalog`: a shared-informer-fed cache of every published
+  device keyed ``(pool, device)``, maintaining secondary indexes over
+  driver name, node, pool, and a configurable set of string/bool
+  attribute equality keys. Watch events update the indexes incrementally
+  (one slice's devices are re-indexed, nothing else is touched); a watch
+  RELIST rebuilds them from the informer store in one pass (the
+  ``catalog.index-rebuild`` fault point fires there).
+- :class:`CatalogSnapshot`: an immutable per-allocation-batch view —
+  candidate sets come from index intersection
+  (:meth:`CatalogSnapshot.candidates`) instead of a fleet scan, with the
+  full set as fallback when a selector has no extractable constraint.
+  Probes are PRUNING hints: the full selector still evaluates on every
+  survivor, so index and linear paths pick identical winners.
+- :class:`UsageLedger`: allocated-device + counter usage fed by the
+  claim informer (allocate/deallocate deltas keyed by claim UID — a
+  claim observed twice counts once, and a claim whose allocation was
+  removed stops counting even while stale ``reservedFor`` entries linger
+  in its status), with in-flight reservations so parallel allocation
+  workers under one process can never double-commit a device or
+  oversubscribe a shared counter.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from tpu_dra_driver.kube import cel
+from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+
+fi.register("catalog.index-rebuild",
+            "one full index rebuild after a watch RELIST (fail models a "
+            "rebuild dying mid-way: indexes must stay at their pre-gap "
+            "state and the next relist must converge)")
+
+DeviceKey = Tuple[str, str]          # (pool name, device name)
+# Counter usage/capacity is scoped by pool: the reference publisher names
+# counter sets per chip INDEX ("tpu-0-counter-set"), so an unscoped key
+# would conflate chip 0 of every node in the fleet.
+CounterKey = Tuple[str, str, str]    # (pool, counterSet name, counter name)
+
+#: Attribute names indexed by default — the equality keys real claim
+#: selectors discriminate on (chip type/generation, sub-slice shape).
+DEFAULT_INDEX_ATTRIBUTES = ("type", "chipType", "subsliceShape",
+                            "generation")
+
+
+def attr_value(dev: Dict, name: str):
+    """A device attribute's wire value (string/int/bool/version box)."""
+    a = (dev.get("attributes") or {}).get(name)
+    if a is None:
+        return None
+    for k in ("string", "int", "bool", "version"):
+        if k in a:
+            return a[k]
+    return None
+
+
+def qty_int(value) -> int:
+    """Counter/capacity value -> exact int; raises ValueError on
+    non-integral quantities (counters are whole units)."""
+    if isinstance(value, int):
+        return value
+    q = cel.Quantity(str(value))
+    if not q.isInteger():
+        raise ValueError(f"counter value {value!r} is not integral")
+    return q.asInteger()
+
+
+def device_counter_consumption(dev: Dict, pool: str) -> Dict[CounterKey, int]:
+    """(pool, counterSet, counter) -> amount this device consumes."""
+    out: Dict[CounterKey, int] = {}
+    for cc in dev.get("consumesCounters") or []:
+        cs = cc["counterSet"]
+        for cname, cval in (cc.get("counters") or {}).items():
+            ck = (pool, cs, cname)
+            out[ck] = out.get(ck, 0) + qty_int(cval["value"])
+    return out
+
+
+def sum_counter_consumption(pairs: "Iterable[Tuple[Optional[Dict], str]]"
+                            ) -> Dict[CounterKey, int]:
+    """Aggregate (device dict or None, pool) pairs into one pool-scoped
+    usage dict — the single accumulation used by committed claims,
+    recomputes, and reservations, so counter scoping can never
+    desynchronize between them."""
+    out: Dict[CounterKey, int] = {}
+    for dev, pool in pairs:
+        if dev is None:
+            continue
+        for ck, amount in device_counter_consumption(dev, pool).items():
+            out[ck] = out.get(ck, 0) + amount
+    return out
+
+
+class DeviceEntry:
+    """One published device plus the slice context allocation needs."""
+
+    __slots__ = ("key", "device", "driver", "node", "pool", "slice_name",
+                 "order")
+
+    def __init__(self, key: DeviceKey, device: Dict, driver: str, node: str,
+                 pool: str, slice_name: str, order: Tuple[str, int]):
+        self.key = key
+        self.device = device
+        self.driver = driver
+        self.node = node
+        self.pool = pool
+        self.slice_name = slice_name
+        # canonical scan order (slice name, position in slice): index and
+        # linear candidate walks sort by this, so both pick the same
+        # winners for the same fleet
+        self.order = order
+
+
+class _IndexState:
+    """The mutable device-level index set. NOT thread-safe — the catalog
+    serializes access under its own lock; the static snapshot path uses a
+    private instance."""
+
+    def __init__(self, index_attributes: Iterable[str]):
+        self.index_attributes = frozenset(index_attributes)
+        self.devices: Dict[DeviceKey, DeviceEntry] = {}
+        self.by_driver: Dict[str, Set[DeviceKey]] = {}
+        self.by_node: Dict[str, Set[DeviceKey]] = {}
+        self.by_pool: Dict[str, Set[DeviceKey]] = {}
+        self.by_attr: Dict[Tuple[str, object], Set[DeviceKey]] = {}
+        self.counter_caps: Dict[CounterKey, int] = {}
+        # per-slice contributions, for clean incremental removal
+        self._slice_keys: Dict[str, List[DeviceKey]] = {}
+        self._slice_caps: Dict[str, Dict[CounterKey, int]] = {}
+        self.version = 0
+
+    # -- mutation ----------------------------------------------------------
+
+    def add_slice(self, obj: Dict) -> None:
+        name = obj["metadata"]["name"]
+        self.remove_slice(name)
+        spec = obj.get("spec") or {}
+        driver = spec.get("driver", "")
+        node = spec.get("nodeName", "")
+        pool = (spec.get("pool") or {}).get("name", "")
+        keys: List[DeviceKey] = []
+        for i, dev in enumerate(spec.get("devices") or []):
+            key = (pool, dev["name"])
+            entry = DeviceEntry(key, dev, driver, node, pool, name,
+                                (name, i))
+            # a later slice claiming an existing key replaces it (the
+            # API server enforces pool/device uniqueness; last-writer
+            # wins here keeps the cache converging regardless)
+            if key in self.devices:
+                self._deindex(self.devices[key])
+            self.devices[key] = entry
+            self._index(entry)
+            keys.append(key)
+        caps: Dict[CounterKey, int] = {}
+        for cs in spec.get("sharedCounters") or []:
+            for cname, cval in (cs.get("counters") or {}).items():
+                ck = (pool, cs["name"], cname)
+                caps[ck] = caps.get(ck, 0) + qty_int(cval["value"])
+        for ck, amount in caps.items():
+            self.counter_caps[ck] = self.counter_caps.get(ck, 0) + amount
+        self._slice_keys[name] = keys
+        self._slice_caps[name] = caps
+        self.version += 1
+
+    def remove_slice(self, name: str) -> None:
+        keys = self._slice_keys.pop(name, None)
+        if keys is None:
+            return
+        for key in keys:
+            entry = self.devices.get(key)
+            if entry is not None and entry.slice_name == name:
+                self._deindex(entry)
+                del self.devices[key]
+        for ck, amount in self._slice_caps.pop(name, {}).items():
+            left = self.counter_caps.get(ck, 0) - amount
+            if left > 0:
+                self.counter_caps[ck] = left
+            else:
+                self.counter_caps.pop(ck, None)
+        self.version += 1
+
+    def rebuild(self, slices: Iterable[Dict]) -> None:
+        """Full rebuild (watch RELIST): throw the indexes away and
+        re-derive from a fresh slice list."""
+        self.devices.clear()
+        self.by_driver.clear()
+        self.by_node.clear()
+        self.by_pool.clear()
+        self.by_attr.clear()
+        self.counter_caps.clear()
+        self._slice_keys.clear()
+        self._slice_caps.clear()
+        for obj in sorted(slices, key=lambda o: o["metadata"]["name"]):
+            self.add_slice(obj)
+        self.version += 1
+
+    def _index(self, entry: DeviceEntry) -> None:
+        self.by_driver.setdefault(entry.driver, set()).add(entry.key)
+        if entry.node:
+            self.by_node.setdefault(entry.node, set()).add(entry.key)
+        self.by_pool.setdefault(entry.pool, set()).add(entry.key)
+        for name in self.index_attributes:
+            v = attr_value(entry.device, name)
+            if isinstance(v, (str, bool)):
+                self.by_attr.setdefault((name, v), set()).add(entry.key)
+
+    def _deindex(self, entry: DeviceEntry) -> None:
+        for index, value in ((self.by_driver, entry.driver),
+                             (self.by_node, entry.node),
+                             (self.by_pool, entry.pool)):
+            keys = index.get(value)
+            if keys is not None:
+                keys.discard(entry.key)
+                if not keys:
+                    del index[value]
+        for name in self.index_attributes:
+            v = attr_value(entry.device, name)
+            if isinstance(v, (str, bool)):
+                keys = self.by_attr.get((name, v))
+                if keys is not None:
+                    keys.discard(entry.key)
+                    if not keys:
+                        del self.by_attr[(name, v)]
+
+    # -- read --------------------------------------------------------------
+
+    def snapshot(self) -> "CatalogSnapshot":
+        return CatalogSnapshot(
+            devices=dict(self.devices),
+            by_driver={k: set(v) for k, v in self.by_driver.items()},
+            by_node={k: set(v) for k, v in self.by_node.items()},
+            by_attr={k: set(v) for k, v in self.by_attr.items()},
+            counter_caps=dict(self.counter_caps),
+            index_attributes=self.index_attributes,
+            version=self.version,
+        )
+
+
+class CatalogSnapshot:
+    """An immutable view of the catalog for one allocation batch.
+
+    Everything is copied at construction; concurrent catalog updates
+    never mutate a snapshot, so a batch allocates against one consistent
+    fleet state."""
+
+    __slots__ = ("devices", "by_driver", "by_node", "by_attr",
+                 "counter_caps", "index_attributes", "version")
+
+    def __init__(self, devices, by_driver, by_node, by_attr, counter_caps,
+                 index_attributes, version):
+        self.devices: Dict[DeviceKey, DeviceEntry] = devices
+        self.by_driver = by_driver
+        self.by_node = by_node
+        self.by_attr = by_attr
+        self.counter_caps: Dict[CounterKey, int] = counter_caps
+        self.index_attributes = index_attributes
+        self.version = version
+
+    def has_driver(self, driver: str) -> bool:
+        return bool(self.by_driver.get(driver))
+
+    def candidates(self, driver: str, node_name: Optional[str],
+                   constraints: Tuple[cel.IndexConstraint, ...]
+                   ) -> Tuple[List[DeviceEntry], bool]:
+        """Candidate devices for one request, in canonical scan order.
+
+        Returns ``(entries, used_index)``: ``used_index`` is True when at
+        least one constraint pruned through an index (or proved the set
+        empty). The result is a SUPERSET of the true matches — the
+        caller still evaluates the full selector per candidate."""
+        base = self.by_driver.get(driver)
+        if not base:
+            return [], False
+        sets: List[Set[DeviceKey]] = [base]
+        if node_name is not None:
+            sets.append(self.by_node.get(node_name) or set())
+        used_index = False
+        for c in constraints:
+            if c.kind == "driver":
+                if c.value != driver:
+                    # device.driver == <other driver> can never match a
+                    # device this driver published
+                    return [], True
+                used_index = True
+            elif c.kind == "attr":
+                if c.domain and c.domain != driver:
+                    # a qualified domain that is not the publishing
+                    # driver's resolves to missing on every device ->
+                    # the equality conjunct can never hold
+                    return [], True
+                if c.name in self.index_attributes:
+                    sets.append(self.by_attr.get((c.name, c.value)) or set())
+                    used_index = True
+        sets.sort(key=len)
+        keys = sets[0]
+        for s in sets[1:]:
+            keys = keys & s
+            if not keys:
+                break
+        entries = [self.devices[k] for k in keys]
+        entries.sort(key=lambda e: e.order)
+        return entries, used_index
+
+    def all_candidates(self, driver: str, node_name: Optional[str]
+                       ) -> List[DeviceEntry]:
+        """The linear-fallback candidate set (driver + node filter only)."""
+        entries, _ = self.candidates(driver, node_name, ())
+        return entries
+
+    def get_device(self, key: DeviceKey) -> Optional[Dict]:
+        entry = self.devices.get(key)
+        return entry.device if entry is not None else None
+
+
+def build_snapshot(slices: Iterable[Dict],
+                   index_attributes: Iterable[str] = DEFAULT_INDEX_ATTRIBUTES
+                   ) -> CatalogSnapshot:
+    """One-shot snapshot from a plain slice list — the catalog-less
+    path (tests, demos, the linear bench arm) shares the exact index and
+    ordering semantics of the live informer-fed catalog."""
+    state = _IndexState(index_attributes)
+    for obj in slices:
+        state.add_slice(obj)
+    return state.snapshot()
+
+
+class _CatalogInformer(Informer):
+    """Informer whose RELIST reconciliation additionally triggers a full
+    catalog index rebuild (client-go's indexers are rebuilt the same way
+    on relist). The diff-dispatch to handlers still runs — the catalog
+    ignores those per-object events for a pass it already rebuilt."""
+
+    def __init__(self, *args, on_relist: Callable[[List[Dict]], None],
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self._on_relist = on_relist
+
+    def _resync(self, items: List[Dict]) -> None:
+        super()._resync(items)
+        self._on_relist(items)
+
+
+class DeviceCatalog:
+    """Shared-informer-fed device cache with attribute indexes.
+
+    ``start()`` lists+watches ResourceSlices; every watch event
+    re-indexes exactly the touched slice's devices. ``snapshot()`` hands
+    the allocator an immutable per-batch view."""
+
+    def __init__(self, client: ResourceClient,
+                 index_attributes: Iterable[str] = DEFAULT_INDEX_ATTRIBUTES):
+        self._client = client
+        self._mu = threading.Lock()
+        self._state = _IndexState(index_attributes)
+        self.informer = _CatalogInformer(client, on_relist=self._on_relist)
+        self.informer.add_handlers(on_add=self._on_upsert,
+                                   on_update=lambda old, new:
+                                   self._on_upsert(new),
+                                   on_delete=self._on_delete)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.informer.start()
+
+    def stop(self) -> None:
+        self.informer.stop()
+
+    def wait_synced(self, timeout: float = 5.0) -> bool:
+        return self.informer.wait_synced(timeout)
+
+    # -- event handlers ----------------------------------------------------
+
+    def _on_upsert(self, obj: Dict) -> None:
+        with self._mu:
+            self._state.add_slice(obj)
+
+    def _on_delete(self, obj: Dict) -> None:
+        with self._mu:
+            self._state.remove_slice(obj["metadata"]["name"])
+
+    def _on_relist(self, items: List[Dict]) -> None:
+        """Full rebuild after a watch gap. A rebuild that dies mid-way
+        (the ``catalog.index-rebuild`` fault point) must leave the
+        PREVIOUS indexes intact — a fresh state swaps in atomically only
+        on success; the informer's next relist converges."""
+        try:
+            items = fi.fire("catalog.index-rebuild", payload=items)
+            fresh = _IndexState(self._state.index_attributes)
+            fresh.rebuild(items or [])
+        except Exception:  # chaos-ok: counted; next RELIST heals
+            SWALLOWED_ERRORS.labels("catalog.index-rebuild").inc()
+            import logging
+            logging.getLogger(__name__).exception(
+                "catalog index rebuild failed; keeping previous indexes "
+                "until the next relist")
+            return
+        with self._mu:
+            fresh.version = self._state.version + 1
+            self._state = fresh
+
+    # -- read --------------------------------------------------------------
+
+    def snapshot(self) -> CatalogSnapshot:
+        with self._mu:
+            return self._state.snapshot()
+
+    def get_device(self, key: DeviceKey) -> Optional[Dict]:
+        with self._mu:
+            entry = self._state.devices.get(key)
+            return entry.device if entry is not None else None
+
+    @property
+    def version(self) -> int:
+        with self._mu:
+            return self._state.version
+
+
+# ---------------------------------------------------------------------------
+# Incremental usage ledger
+# ---------------------------------------------------------------------------
+
+
+class _ClaimRecord:
+    __slots__ = ("keys", "counters")
+
+    def __init__(self, keys: Tuple[DeviceKey, ...],
+                 counters: Dict[CounterKey, int]):
+        self.keys = keys
+        self.counters = counters
+
+
+def claim_allocated_keys(claim: Dict, driver: str) -> Tuple[DeviceKey, ...]:
+    """Device keys a claim holds: from ``status.allocation`` ONLY —
+    never from ``reservedFor`` (consumer references are not device
+    ownership; a deallocated claim with stale reservedFor entries holds
+    nothing) — deduplicated, adminAccess results excluded."""
+    alloc = ((claim.get("status") or {}).get("allocation") or {})
+    seen: Dict[DeviceKey, None] = {}
+    for r in (alloc.get("devices") or {}).get("results") or []:
+        if r.get("driver") == driver and not r.get("adminAccess"):
+            seen.setdefault((r.get("pool", ""), r.get("device", "")))
+    return tuple(seen)
+
+
+class UsageLedger:
+    """Cluster usage maintained from claim deltas instead of per-call
+    LISTs. Keyed by claim UID: re-observing a claim (informer MODIFIED,
+    a RELIST replay, or the allocator's own commit) replaces its prior
+    contribution instead of double-counting."""
+
+    def __init__(self, driver_name: str,
+                 device_lookup: Callable[[DeviceKey], Optional[Dict]]):
+        self._driver = driver_name
+        self._lookup = device_lookup
+        self._mu = threading.Lock()
+        self._claims: Dict[str, _ClaimRecord] = {}
+        self._taken: Dict[DeviceKey, int] = {}
+        self._usage: Dict[CounterKey, int] = {}
+        # in-flight reservations by an allocation worker that has picked
+        # devices but not yet committed: uid -> record
+        self._reserved: Dict[str, _ClaimRecord] = {}
+        self._reserved_keys: Dict[DeviceKey, str] = {}
+
+    # -- informer feed -----------------------------------------------------
+
+    def attach(self, informer: Informer) -> None:
+        informer.add_handlers(on_add=self.observe_claim,
+                              on_update=lambda old, new:
+                              self.observe_claim(new),
+                              on_delete=self.forget_claim)
+
+    def observe_claim(self, claim: Dict) -> None:
+        uid = (claim.get("metadata") or {}).get("uid", "")
+        if not uid:
+            return
+        keys = claim_allocated_keys(claim, self._driver)
+        if not keys:
+            self._forget(uid)
+            return
+        counters = sum_counter_consumption(
+            (self._lookup(key), key[0]) for key in keys)
+        with self._mu:
+            self._remove_locked(uid)
+            self._release_locked(uid)
+            rec = _ClaimRecord(keys, counters)
+            self._claims[uid] = rec
+            self._apply_locked(rec, +1)
+
+    def forget_claim(self, claim: Dict) -> None:
+        uid = (claim.get("metadata") or {}).get("uid", "")
+        if uid:
+            self._forget(uid)
+
+    def recompute_counters(self) -> None:
+        """Re-derive counter usage for every held claim through the
+        device lookup — called after a catalog rebuild or slice churn so
+        usage tracks device definitions that arrived late."""
+        with self._mu:
+            uids = {uid: rec.keys for uid, rec in self._claims.items()}
+        for uid, keys in uids.items():
+            counters = sum_counter_consumption(
+                (self._lookup(key), key[0]) for key in keys)
+            with self._mu:
+                rec = self._claims.get(uid)
+                if rec is not None and rec.keys == keys:
+                    self._apply_locked(rec, -1)
+                    rec.counters = counters
+                    self._apply_locked(rec, +1)
+
+    # -- allocation-side reservations -------------------------------------
+
+    def reserve(self, uid: str, entries: List[DeviceEntry],
+                caps: Dict[CounterKey, int]) -> bool:
+        """Atomically reserve devices an allocation worker picked, IF
+        they are all still free and their counters still fit under
+        ``caps`` given current usage + other reservations. False means
+        the worker raced another claim and must re-pick."""
+        keys = tuple(e.key for e in entries)
+        counters = sum_counter_consumption(
+            (e.device, e.pool) for e in entries)
+        with self._mu:
+            self._release_locked(uid)
+            for key in keys:
+                if self._taken.get(key) or key in self._reserved_keys:
+                    return False
+            for ck, amount in counters.items():
+                cap = caps.get(ck)
+                if cap is None or self._usage.get(ck, 0) + amount > cap:
+                    return False
+            rec = _ClaimRecord(keys, counters)
+            self._reserved[uid] = rec
+            for key in keys:
+                self._reserved_keys[key] = uid
+            self._apply_locked(rec, +1)
+            return True
+
+    def release(self, uid: str) -> None:
+        """Drop an in-flight reservation (commit failed or abandoned)."""
+        with self._mu:
+            self._release_locked(uid)
+
+    # -- reads -------------------------------------------------------------
+
+    def snapshot(self) -> Tuple[Set[DeviceKey], Dict[CounterKey, int]]:
+        """(taken device keys, counter usage) including reservations."""
+        with self._mu:
+            return (set(self._taken), dict(self._usage))
+
+    def holdings(self, uid: str) -> Tuple[DeviceKey, ...]:
+        with self._mu:
+            rec = self._claims.get(uid)
+            return rec.keys if rec is not None else ()
+
+    def held_by_other(self, keys: Iterable[DeviceKey], uid: str) -> bool:
+        """True if any of ``keys`` is held (committed claim or in-flight
+        reservation) by a claim other than ``uid`` — the verify-on-commit
+        question."""
+        wanted = set(keys)
+        with self._mu:
+            for other_uid, rec in self._claims.items():
+                if other_uid != uid and wanted.intersection(rec.keys):
+                    return True
+            for other_uid, rec in self._reserved.items():
+                if other_uid != uid and wanted.intersection(rec.keys):
+                    return True
+            return False
+
+    # -- internals (call with _mu held) ------------------------------------
+
+    def _forget(self, uid: str) -> None:
+        with self._mu:
+            self._remove_locked(uid)
+            self._release_locked(uid)
+
+    def _remove_locked(self, uid: str) -> None:
+        rec = self._claims.pop(uid, None)
+        if rec is not None:
+            self._apply_locked(rec, -1)
+
+    def _release_locked(self, uid: str) -> None:
+        rec = self._reserved.pop(uid, None)
+        if rec is not None:
+            for key in rec.keys:
+                if self._reserved_keys.get(key) == uid:
+                    del self._reserved_keys[key]
+            self._apply_locked(rec, -1)
+
+    def _apply_locked(self, rec: _ClaimRecord, sign: int) -> None:
+        for key in rec.keys:
+            n = self._taken.get(key, 0) + sign
+            if n > 0:
+                self._taken[key] = n
+            else:
+                self._taken.pop(key, None)
+        for ck, amount in rec.counters.items():
+            n = self._usage.get(ck, 0) + sign * amount
+            if n > 0:
+                self._usage[ck] = n
+            else:
+                self._usage.pop(ck, None)

@@ -521,6 +521,9 @@ class _Compiler:
                 if a is _MISSING or b is _MISSING:
                     return _MISSING
                 return True
+            # pre-analysis seam: the index-probe planner flattens the
+            # conjunction tree through this attribute
+            node.conjuncts = (lhs, rhs)
             fn = node
         return fn
 
@@ -537,6 +540,10 @@ class _Compiler:
 
             def node(env: _Env, _op=op, _l=lhs, _r=rhs) -> Any:
                 return _compare(_op, _l(env), _r(env))
+            if op == "==":
+                # pre-analysis seam: equality over a device path and a
+                # constant is an index-probe candidate
+                node.eq_operands = (lhs, rhs)
             return node
         if tok.kind == "ident" and tok.value == "in":
             self.next()
@@ -822,6 +829,7 @@ class _Compiler:
         if field.value == "driver":
             def node(env: _Env) -> Any:
                 return env.resolve("driver", "", "")
+            node.device_path = ("driver", "", "")
             return node
         if field.value in ("attributes", "capacity"):
             self.expect_op("[")
@@ -843,6 +851,7 @@ class _Compiler:
                 if val is MISSING_DOMAIN and not _raw:
                     return _MISSING
                 return val
+            node.device_path = (field.value, domain.value, name.value)
             return node
         raise CelUnsupportedError(f"unsupported device field "
                                   f"{field.value!r}")
@@ -911,17 +920,85 @@ def _check_re2_pattern(pattern: str):
             f"({e}); cannot faithfully mirror the RE2 verdict") from e
 
 
+class IndexConstraint(NamedTuple):
+    """One conjunctive equality constraint extracted from a compiled
+    selector — the unit of an index probe plan.
+
+    ``kind`` is ``"driver"`` (``device.driver == value``) or ``"attr"``
+    (``device.attributes[domain].name == value``). Probes are PRUNING
+    hints only: every device that matches the full selector necessarily
+    satisfies each top-level conjunct, so intersecting index buckets can
+    never exclude a true match — the full evaluation still runs on the
+    survivors."""
+
+    kind: str       # "driver" | "attr"
+    domain: str     # attribute domain ("" for driver)
+    name: str       # attribute name ("" for driver)
+    value: Any      # str | bool
+
+
+def _flatten_conjuncts(fn, out: List) -> None:
+    conj = getattr(fn, "conjuncts", None)
+    if conj is None:
+        out.append(fn)
+        return
+    _flatten_conjuncts(conj[0], out)
+    _flatten_conjuncts(conj[1], out)
+
+
+def _extract_index_constraints(fn) -> "tuple[IndexConstraint, ...]":
+    """Walk a compiled closure tree: top-level ``&&`` conjuncts that are
+    ``<device path> == <str/bool literal>`` (either operand order) become
+    probe constraints; everything else (||, !, ranges, method calls,
+    capacity paths) is ignored — the probe plan is a subset of the
+    selector's meaning, never a replacement for it."""
+    terms: List = []
+    _flatten_conjuncts(fn, terms)
+    out: List[IndexConstraint] = []
+    for term in terms:
+        ops = getattr(term, "eq_operands", None)
+        if ops is None:
+            continue
+        for side, other in (ops, ops[::-1]):
+            path = getattr(side, "device_path", None)
+            if path is None or not getattr(other, "const", False):
+                continue
+            value = other.value
+            if not isinstance(value, (str, bool)):
+                continue          # indexes cover string/bool equality keys
+            section, domain, name = path
+            if section == "driver" and isinstance(value, str):
+                out.append(IndexConstraint("driver", "", "", value))
+            elif section == "attributes":
+                out.append(IndexConstraint("attr", domain, name, value))
+            break
+    return tuple(out)
+
+
 class CompiledSelector:
     """A selector compiled to a closure tree: parse once, evaluate per
     device. Stateless across evaluations (every evaluate() gets a fresh
     ``_Env``), so one instance can serve every device of every request
     concurrently."""
 
-    __slots__ = ("expression", "_fn")
+    __slots__ = ("expression", "_fn", "_index_constraints")
 
     def __init__(self, expression: str, fn):
         self.expression = expression
         self._fn = fn
+        self._index_constraints: Optional[tuple] = None
+
+    def index_constraints(self) -> "tuple[IndexConstraint, ...]":
+        """The selector's index probe plan: top-level conjunctive
+        equality constraints over device.driver / device.attributes.
+        Computed lazily and memoized on the instance — compiled
+        selectors live in the bounded LRU, so the plan is cached
+        alongside the compiled expression. Empty tuple = nothing
+        extractable; callers must fall back to the full candidate
+        set."""
+        if self._index_constraints is None:
+            self._index_constraints = _extract_index_constraints(self._fn)
+        return self._index_constraints
 
     def evaluate(self, resolver: Resolver) -> bool:
         """Evaluate against one device. Raises CelUnsupportedError

@@ -243,6 +243,24 @@ class Histogram(_Metric):
             raise ValueError(f"{self.name} has labels; use .labels(...)")
         self._children[()].observe(v)
 
+    def _self_child(self) -> _HistogramChild:
+        if self.label_names:
+            raise ValueError(f"{self.name} has labels; use .labels(...)")
+        return self._children[()]
+
+    @property
+    def sum(self) -> float:
+        """Sum of observed values (unlabeled family) — lets benches and
+        tests read deltas (e.g. candidates scanned) without scraping."""
+        _, total, _ = self._self_child().snapshot()
+        return total
+
+    @property
+    def count(self) -> int:
+        """Number of observations (unlabeled family)."""
+        _, _, count = self._self_child().snapshot()
+        return count
+
     def time(self):
         """Context manager observing the elapsed wall time in seconds."""
         return _Timer(self)
@@ -404,6 +422,41 @@ SWALLOWED_ERRORS = DEFAULT_REGISTRY.counter(
     "Exceptions absorbed (logged, not re-raised) on reconcile/prepare "
     "paths, by site",
     ("site",))
+
+
+# ---------------------------------------------------------------------------
+# Scale-out allocator instrumentation (indexed device catalog + incremental
+# usage ledger + churn-free slice publishing). The candidates histogram is
+# the proof surface for the index-probe claim: an indexed request observes
+# the post-intersection candidate count, a fallback request the full fleet.
+# ---------------------------------------------------------------------------
+
+ALLOCATOR_CANDIDATES_SCANNED = DEFAULT_REGISTRY.histogram(
+    "dra_allocator_candidates_scanned",
+    "Candidate devices examined per device request (after index "
+    "intersection when a probe plan applied, the full fleet otherwise)",
+    buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384))
+ALLOCATOR_INDEX_HITS = DEFAULT_REGISTRY.counter(
+    "dra_allocator_index_hits_total",
+    "Device requests whose candidate set came from catalog index "
+    "intersection (outcome=index) vs the linear full-scan fallback "
+    "(outcome=fallback)",
+    ("outcome",))
+ALLOCATION_SECONDS = DEFAULT_REGISTRY.histogram(
+    "dra_allocation_seconds",
+    "Wall time to allocate one ResourceClaim (snapshot scan + commit)")
+ALLOCATOR_COMMIT_CONFLICTS = DEFAULT_REGISTRY.counter(
+    "dra_allocator_commit_conflicts_total",
+    "Allocation status writes that hit a resourceVersion conflict and "
+    "went through verify-on-commit")
+RESOURCESLICE_PUBLISHES = DEFAULT_REGISTRY.counter(
+    "dra_resourceslice_publishes_total",
+    "ResourceSlice API writes actually performed by republish()",
+    ("op",))
+RESOURCESLICE_PUBLISHES_SKIPPED = DEFAULT_REGISTRY.counter(
+    "dra_resourceslice_publishes_skipped_total",
+    "ResourceSlice writes skipped because the published content was "
+    "already identical (churn-free republish)")
 
 
 INFORMER_WATCH_LAG = DEFAULT_REGISTRY.histogram(

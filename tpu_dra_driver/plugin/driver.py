@@ -55,6 +55,9 @@ class PluginConfig:
     slice_layout: str = LAYOUT_COMBINED
     gates: fg.FeatureGates = field(default_factory=fg.FeatureGates)
     cleanup_interval: float = 600.0
+    #: combined-layout slices holding more devices than this are split
+    #: over multiple slices with stable name assignment (0 = unlimited)
+    max_devices_per_slice: int = 0
 
 
 @dataclass
@@ -84,7 +87,8 @@ class TpuKubeletPlugin:
         self.state = DeviceState(lib, config.gates, cdi, config.state_dir)
         self.publisher = ResourceSlicePublisher(
             clients.resource_slices, config.node_name,
-            layout=config.slice_layout)
+            layout=config.slice_layout,
+            max_devices_per_slice=config.max_devices_per_slice)
         # republish after vfio driver flips so sibling personalities
         # (chip vs vfio) are hidden/shown consistently (reference
         # driver.go:361-368,392-397)

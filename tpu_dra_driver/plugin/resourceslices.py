@@ -10,8 +10,23 @@ layouts depending on the API server's KEP-4815 maturity:
   one slice per chip holding that chip's devices (keeps slice churn local
   to a chip when health events hide devices).
 
-Slices live in a per-node pool named after the node; the pool generation
-bumps on every republish so the scheduler discards stale slices.
+Slices live in a per-node pool named after the node. Publishing is
+**churn-free** at scale:
+
+- ``republish()`` content-compares each desired slice against what the
+  API server already holds and SKIPS no-op writes (counted in
+  ``dra_resourceslice_publishes_skipped_total``) — a republish that
+  changes nothing performs zero API writes;
+- the pool generation bumps only when the slice COMPOSITION changes
+  (names or count — which forces every slice to be rewritten under the
+  new generation, since the scheduler discards slices below the pool's
+  max generation); a content-only change keeps the generation and
+  rewrites just the changed slice;
+- above ``max_devices_per_slice`` the combined layout splits its device
+  list over multiple slices with STABLE name assignment: devices are
+  bucketed by their position in the full (pre-exclusion) inventory, so
+  hiding one unhealthy device rewrites that device's slice, not the
+  whole pool.
 """
 
 from __future__ import annotations
@@ -21,10 +36,20 @@ from typing import Dict, List, Optional, Set
 
 from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import (
+    RESOURCESLICE_PUBLISHES,
+    RESOURCESLICE_PUBLISHES_SKIPPED,
+)
 from tpu_dra_driver.plugin.allocatable import (
     AllocatableDevice,
     chip_counter_set,
 )
+
+fi.register("resourceslice.publish",
+            "each ResourceSlice API write (create/update/delete) in "
+            "republish() (fail models the API server rejecting a slice "
+            "write mid-republish; the next republish must converge)")
 
 LAYOUT_COMBINED = "combined"
 LAYOUT_SPLIT = "split"
@@ -49,13 +74,17 @@ def build_resource_slices(node_name: str,
                           layout: str = LAYOUT_COMBINED,
                           generation: int = 1,
                           exclude: Optional[Set[str]] = None,
-                          partitionable: bool = True) -> List[Dict]:
+                          partitionable: bool = True,
+                          max_devices_per_slice: int = 0) -> List[Dict]:
     """Render slices for the given allocatable devices.
 
     ``exclude`` removes devices (unhealthy, or hidden vfio siblings) without
     touching the rest. Counter sets are emitted only when ``partitionable``
     (i.e. DynamicSubslice active) — whole-chip-only inventories don't need
-    the counter machinery.
+    the counter machinery. ``max_devices_per_slice`` > 0 chunks the
+    combined layout's device list over multiple slices; bucket assignment
+    uses the FULL inventory order (exclusions leave a hole in their own
+    bucket instead of shifting every later device into a different slice).
     """
     exclude = exclude or set()
     visible = {n: d for n, d in devices.items() if n not in exclude}
@@ -85,6 +114,27 @@ def build_resource_slices(node_name: str,
 
     ordered = [visible[k] for k in sorted(visible)]
     if layout == LAYOUT_COMBINED or not partitionable:
+        limit = max_devices_per_slice
+        if limit and len(devices) > limit:
+            # stable chunking over the FULL inventory: bucket i holds the
+            # devices at positions [i*limit, (i+1)*limit) of the sorted
+            # complete device list, minus exclusions — so a health event
+            # on one device dirties exactly one slice. The counters slice
+            # exists only when there are counter sets to carry.
+            all_names = sorted(devices)
+            buckets = [all_names[i:i + limit]
+                       for i in range(0, len(all_names), limit)]
+            count = (1 if counter_sets else 0) + len(buckets)
+            out = []
+            if counter_sets:
+                out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-counters",
+                                     [], counter_sets, count))
+            for i, bucket in enumerate(buckets):
+                devs = [_device_entry(devices[n], partitionable)
+                        for n in bucket if n in visible]
+                out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-p{i}",
+                                     devs, [], count))
+            return out
         return [slice_obj(
             f"{node_name}-{DRIVER_NAME}",
             [_device_entry(d, partitionable) for d in ordered],
@@ -105,41 +155,74 @@ def build_resource_slices(node_name: str,
 
 class ResourceSlicePublisher:
     """Owns this node's slice pool in the API server: republish() diffs the
-    desired set against what exists (create/update/delete by name) under a
-    bumped pool generation — the kubeletplugin.PublishResources analog."""
+    desired set against what exists (create/update/delete by name) — the
+    kubeletplugin.PublishResources analog — skipping writes whose content
+    is already published and bumping the pool generation only when the
+    slice composition changes."""
 
     def __init__(self, client: ResourceClient, node_name: str,
-                 layout: str = LAYOUT_COMBINED):
+                 layout: str = LAYOUT_COMBINED,
+                 max_devices_per_slice: int = 0):
         self._client = client
         self._node = node_name
         self._layout = layout
+        self._max_devices_per_slice = max_devices_per_slice
         self._mu = threading.Lock()
         self._generation = 0
+
+    def _existing(self) -> Dict[str, Dict]:
+        return {
+            o["metadata"]["name"]: o
+            for o in self._client.list()
+            if o["spec"].get("nodeName") == self._node
+            and o["spec"].get("driver") == DRIVER_NAME
+        }
 
     def republish(self, devices: Dict[str, AllocatableDevice],
                   exclude: Optional[Set[str]] = None,
                   partitionable: bool = True) -> List[Dict]:
         with self._mu:
-            self._generation += 1
+            existing = self._existing()
+            if self._generation == 0:
+                # adopt the live pool's generation across restarts so the
+                # first republish after a content-only change stays
+                # churn-free
+                self._generation = max(
+                    (o["spec"].get("pool", {}).get("generation", 0)
+                     for o in existing.values()), default=0) or 1
+
             desired = build_resource_slices(
                 self._node, devices, layout=self._layout,
                 generation=self._generation, exclude=exclude,
                 partitionable=partitionable,
+                max_devices_per_slice=self._max_devices_per_slice,
             )
-            existing = {
-                o["metadata"]["name"]: o
-                for o in self._client.list()
-                if o["spec"].get("nodeName") == self._node
-                and o["spec"].get("driver") == DRIVER_NAME
-            }
+            # composition change (slice names appearing/disappearing)
+            # invalidates the whole pool: bump the generation — the
+            # scheduler ignores slices below the pool max, so EVERY slice
+            # must be rewritten under the new generation
+            if {o["metadata"]["name"] for o in desired} != set(existing):
+                self._generation += 1
+                for obj in desired:
+                    obj["spec"]["pool"]["generation"] = self._generation
+
             for obj in desired:
                 name = obj["metadata"]["name"]
                 if name in existing:
                     cur = existing.pop(name)
+                    if cur.get("spec") == obj["spec"]:
+                        RESOURCESLICE_PUBLISHES_SKIPPED.inc()
+                        continue
+                    fi.fire("resourceslice.publish")
                     cur["spec"] = obj["spec"]
                     self._client.update(cur)
+                    RESOURCESLICE_PUBLISHES.labels("update").inc()
                 else:
+                    fi.fire("resourceslice.publish")
                     self._client.create(obj)
+                    RESOURCESLICE_PUBLISHES.labels("create").inc()
             for leftover in existing:
+                fi.fire("resourceslice.publish")
                 self._client.delete_ignore_missing(leftover)
+                RESOURCESLICE_PUBLISHES.labels("delete").inc()
             return desired
