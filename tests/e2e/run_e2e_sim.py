@@ -37,7 +37,9 @@ from simcluster import (  # noqa: E402
     PluginProcess,
     SimCluster,
     SimNode,
+    free_port,
     percentile,
+    try_fetch_trace,
     wait_for,
 )
 
@@ -349,7 +351,101 @@ def phase_tpu_plugin(cluster: SimCluster, iterations: int) -> dict:
     log("fault drill OK: os._exit(137) between write-ahead and commit, "
         "restart rolled back and served the same claim")
 
+    # -- tracing: ONE claim trace across a real process boundary ------------
+    # The harness (this process) runs the allocator with tracing always:
+    # the root span's context is stamped into the claim annotation. The
+    # production plugin subprocess runs --trace-mode always and picks the
+    # annotation up in NodePrepareResources — its spans join the SAME
+    # trace, retrieved as JSON from its /debug/traces/<trace-id>.
+    from tpu_dra_driver.pkg import tracing as _tracing
     proc4.stop()
+    trace_port = free_port()
+    proc5 = node.spawn_tpu_plugin(
+        tag="-traced",
+        extra_args=["--http-endpoint", f"127.0.0.1:{trace_port}",
+                    "--trace-mode", "always", "--log-format", "json"])
+    info5 = node.kubelet.register(DRIVER_NAME)
+    dra5 = node.kubelet.dra_client(info5)
+    _tracing.configure("always", service="e2e-harness")
+    try:
+        claim_t = cluster.create_and_allocate_claim(
+            "traced-claim", "e2e",
+            [{"name": "tpu", "count": 1,
+              "deviceClassName": "tpu.google.com",
+              "selectors": CHIP_SELECTOR}],
+            node_name=node.node_name)
+        wire = (claim_t["metadata"].get("annotations") or {}).get(
+            _tracing.TRACEPARENT_ANNOTATION)
+        ctx = _tracing.parse_traceparent(wire)
+        if ctx is None:
+            raise HarnessError(f"allocator did not stamp a valid "
+                               f"traceparent annotation: {wire!r}")
+        resp = dra5.node_prepare_resources([claim_t])
+        uid_t = claim_t["metadata"]["uid"]
+        if resp.claims[uid_t].error:
+            raise HarnessError(f"traced prepare: {resp.claims[uid_t].error}")
+        # the subprocess half of the trace, over its debug HTTP endpoint
+        doc = wait_for(
+            lambda: try_fetch_trace(trace_port, ctx.trace_id), 10,
+            "plugin flight recorder to serve the claim trace")
+        sub_names = {s["name"] for s in doc["spans"]}
+        required = {"kubelet.prepare", "prepare.write_ahead",
+                    "prepare.devices", "prepare.cdi", "prepare.commit"}
+        if not required <= sub_names:
+            raise HarnessError(f"plugin trace missing spans: "
+                               f"{required - sub_names} (got {sub_names})")
+        if any(s["trace_id"] != ctx.trace_id for s in doc["spans"]):
+            raise HarnessError("span with foreign trace id in trace doc")
+        kp = next(s for s in doc["spans"] if s["name"] == "kubelet.prepare")
+        if kp["process"] != "tpu-kubelet-plugin":
+            raise HarnessError(f"kubelet.prepare recorded by "
+                               f"{kp['process']!r}, not the plugin process")
+        # the harness half: the allocation root span, same trace id
+        local_names = {s["name"]
+                       for s in _tracing.recorder().trace(ctx.trace_id)}
+        if "allocator.allocate" not in local_names:
+            raise HarnessError(f"allocator root span missing locally: "
+                               f"{local_names}")
+        # the claim's Events are on the API server (kubectl-describe
+        # surface): Allocated from the harness allocator, Prepared from
+        # the plugin subprocess over REST
+        def claim_reasons():
+            return {e["reason"] for e in cluster.clients.events.list()
+                    if (e.get("involvedObject") or {}).get("uid") == uid_t}
+        wait_for(lambda: {"Allocated", "Prepared"} <= claim_reasons(), 10,
+                 f"Allocated+Prepared events on traced-claim "
+                 f"(have {claim_reasons()})")
+        # exemplars: the plugin's latency histograms link back to traces
+        # on the OPT-IN render (a default scrape stays classic 0.0.4)
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{trace_port}/metrics?exemplars=1",
+                timeout=5) as r:
+            metrics_text = r.read().decode()
+        exemplar_ok = ' # {' in metrics_text and "trace_id=" in metrics_text
+        if not exemplar_ok:
+            raise HarnessError("no trace exemplar in the plugin's "
+                               "/metrics?exemplars=1")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{trace_port}/metrics", timeout=5) as r:
+            if " # {" in r.read().decode():
+                raise HarnessError("exemplar leaked into the DEFAULT "
+                                   "/metrics render (breaks 0.0.4 parsers)")
+        _claim_finish(cluster, dra5, claim_t)
+        results["tracing"] = {
+            "trace_id": ctx.trace_id,
+            "crossproc_spans": sorted(required),
+            "allocator_span_local": True,
+            "claim_events": sorted(claim_reasons() | {"Allocated",
+                                                      "Prepared"}),
+            "exemplar_in_metrics": True,
+        }
+        log(f"tracing OK: trace {ctx.trace_id[:8]}… spans "
+            f"allocation(harness) -> kubelet prepare phases(subprocess), "
+            f"Events visible, exemplars in /metrics")
+    finally:
+        _tracing.reset()
+        proc5.stop()
     results["status"] = "green"
     return results
 

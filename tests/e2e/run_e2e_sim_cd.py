@@ -47,6 +47,8 @@ from simcluster import (  # noqa: E402
     SimCluster,
     SimNode,
     claim_from_template,
+    free_port,
+    try_fetch_trace,
     wait_for,
 )
 
@@ -229,17 +231,21 @@ def _workload_env(node: SimNode, uid: str) -> Dict[str, str]:
 
 
 def _setup_cd_nodes(cluster: SimCluster, n_nodes: int, prefix: str,
-                    slice_id: str):
+                    slice_id: str,
+                    controller_extra_args: Optional[List[str]] = None,
+                    plugin_extra_args_by_index: Optional[Dict[int, List[str]]]
+                    = None):
     """Shared bring-up for CD phases: n sim nodes, the controller, one CD
     plugin per node registered with the kubelet, ResourceSlices up.
     Returns (nodes, dra-client-by-node-name)."""
     nodes = [cluster.add_node(f"{prefix}-{i}", accelerator_type="v5p-16",
                               host_index=i, slice_id=slice_id)
              for i in range(n_nodes)]
-    cluster.spawn_controller()
+    cluster.spawn_controller(extra_args=controller_extra_args)
     dra: Dict[str, object] = {}
-    for node in nodes:
-        node.spawn_cd_plugin()
+    for i, node in enumerate(nodes):
+        node.spawn_cd_plugin(
+            extra_args=(plugin_extra_args_by_index or {}).get(i))
         info = node.kubelet.register(CD_DRIVER)
         dra[node.node_name] = node.kubelet.dra_client(info)
         cluster.wait_resource_slices(CD_DRIVER, node.node_name)
@@ -312,6 +318,7 @@ def _prepare_with_retry(dra, claim, deadline_s: float = 240.0):
 
 
 def phase_compute_domain(root: str) -> dict:
+    from tpu_dra_driver.pkg import tracing as _tracing
     results: dict = {}
     cluster = SimCluster(root)
     try:
@@ -321,11 +328,26 @@ def phase_compute_domain(root: str) -> dict:
         log(cluster.dump_logs())
         raise
     finally:
+        _tracing.reset()
         cluster.teardown()
 
 
 def _phase(cluster: SimCluster, results: dict) -> dict:
-    nodes, dra = _setup_cd_nodes(cluster, 2, "sim-node", "sim-slice-a")
+    from tpu_dra_driver.pkg import tracing as _tracing
+    # Tracing across ALL actors: harness allocator (root spans +
+    # annotations), controller + node-0 CD plugin with --trace-mode
+    # always and debug HTTP endpoints so their halves of the traces are
+    # retrievable from the outside.
+    _tracing.configure("always", service="e2e-cd-harness")
+    ctl_port = free_port()
+    plugin0_port = free_port()
+    trace_args = ["--trace-mode", "always"]
+    nodes, dra = _setup_cd_nodes(
+        cluster, 2, "sim-node", "sim-slice-a",
+        controller_extra_args=trace_args + [
+            "--http-endpoint", f"127.0.0.1:{ctl_port}"],
+        plugin_extra_args_by_index={0: trace_args + [
+            "--http-endpoint", f"127.0.0.1:{plugin0_port}"]})
     log("both CD plugins registered; ResourceSlices up (2048 channels + "
         "daemon device per node)")
     results["plugins_registered"] = 2
@@ -380,6 +402,73 @@ def _phase(cluster: SimCluster, results: dict) -> dict:
         wait_for(cd_ready, 60, "CD status Ready with 2 Ready nodes")
         results["cd_status_ready"] = True
         log("CD.status: Ready, 2 nodes Ready")
+
+        # -- tracing: the acceptance trace — allocation (harness) ->
+        # kubelet prepare + CD-ready wait (CD plugin subprocess), ONE
+        # trace id, retrievable as JSON from /debug/traces/<id> --------
+        wire = (claims[0]["metadata"].get("annotations") or {}).get(
+            _tracing.TRACEPARENT_ANNOTATION)
+        ctx = _tracing.parse_traceparent(wire)
+        if ctx is None:
+            raise HarnessError(f"workload claim missing traceparent "
+                               f"annotation: {wire!r}")
+        doc = wait_for(
+            lambda: try_fetch_trace(plugin0_port, ctx.trace_id), 15,
+            "node-0 CD plugin flight recorder to serve the claim trace")
+        span_names = {s["name"] for s in doc["spans"]}
+        required = {"cd.prepare", "cd.await_ready", "cd.commit"}
+        if not required <= span_names:
+            raise HarnessError(f"CD plugin trace missing spans: "
+                               f"{required - span_names} "
+                               f"(got {span_names})")
+        waitspan = next(s for s in doc["spans"]
+                        if s["name"] == "cd.await_ready")
+        if not waitspan["events"]:
+            raise HarnessError("cd.await_ready recorded no retry events")
+        local = {s["name"] for s in _tracing.recorder().trace(ctx.trace_id)}
+        if "allocator.allocate" not in local:
+            raise HarnessError(f"allocation root span missing in the "
+                               f"harness recorder: {local}")
+        # the CD's OWN trace: stamped by the controller at first
+        # reconcile; its rendezvous span (first join -> Ready flip)
+        # lives in the controller subprocess
+        cd_obj = cluster.clients.compute_domains.get("cd-e2e", CHANNEL_NS)
+        cd_wire = (cd_obj["metadata"].get("annotations") or {}).get(
+            _tracing.TRACEPARENT_ANNOTATION)
+        cd_ctx = _tracing.parse_traceparent(cd_wire)
+        if cd_ctx is None:
+            raise HarnessError(f"controller did not stamp the CD "
+                               f"traceparent: {cd_wire!r}")
+        cd_doc = wait_for(
+            lambda: try_fetch_trace(ctl_port, cd_ctx.trace_id), 15,
+            "controller flight recorder to serve the CD trace")
+        cd_span_names = {s["name"] for s in cd_doc["spans"]}
+        if "cd.rendezvous" not in cd_span_names:
+            raise HarnessError(f"controller CD trace missing "
+                               f"cd.rendezvous: {cd_span_names}")
+        # Events on the kubectl-describe surface: the claim's and the
+        # CD's (CDReady from the controller subprocess over REST)
+        def reasons_for(uid):
+            return {e["reason"] for e in cluster.clients.events.list()
+                    if (e.get("involvedObject") or {}).get("uid") == uid}
+        wl_uid = claims[0]["metadata"]["uid"]
+        wait_for(lambda: {"Allocated", "Prepared"} <= reasons_for(wl_uid),
+                 10, f"claim events (have {reasons_for(wl_uid)})")
+        wait_for(lambda: "CDReady" in reasons_for(cd_uid), 10,
+                 f"CDReady event on the CD (have {reasons_for(cd_uid)})")
+        results["tracing"] = {
+            "claim_trace_id": ctx.trace_id,
+            "claim_spans_crossproc": sorted(required),
+            "await_ready_retries": len(waitspan["events"]),
+            "cd_trace_id": cd_ctx.trace_id,
+            "cd_rendezvous_span": True,
+            "claim_events": sorted(reasons_for(wl_uid)),
+            "cd_events": sorted(reasons_for(cd_uid)),
+        }
+        log(f"tracing OK: claim trace {ctx.trace_id[:8]}… covers "
+            f"allocation(harness) -> cd.prepare/cd.await_ready"
+            f"(plugin subprocess); CD trace {cd_ctx.trace_id[:8]}… has "
+            f"cd.rendezvous(controller subprocess); CDReady event on CD")
 
         indices_before = _clique_indices(cluster, cd_uid)
         if sorted(indices_before.values()) != [0, 1]:

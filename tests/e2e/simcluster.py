@@ -48,6 +48,35 @@ class HarnessError(AssertionError):
     pass
 
 
+def free_port() -> int:
+    """A currently-free TCP port for a subprocess's --http-endpoint (the
+    subprocess binds it after spawn; a tiny race window is acceptable in
+    the single-tenant e2e sandbox)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_get_json(url: str, timeout: float = 5.0):
+    import json
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def try_fetch_trace(port: int, trace_id: str):
+    """One /debug/traces/<trace-id> fetch against a subprocess's debug
+    endpoint; falsy on 404/conn-refused so wait_for can poll it."""
+    try:
+        return http_get_json(
+            f"http://127.0.0.1:{port}/debug/traces/{trace_id}", timeout=2)
+    except Exception:  # noqa: BLE001 — endpoint not up yet
+        return None
+
+
 def wait_for(predicate, timeout: float, what: str, interval: float = 0.05):
     """Poll until predicate() is truthy; returns its value."""
     deadline = time.monotonic() + timeout

@@ -16,7 +16,6 @@ import random
 import pytest
 
 from tpu_dra_driver.kube import cel
-from tpu_dra_driver.kube import catalog as catalog_mod
 from tpu_dra_driver.kube.allocation_controller import (
     AllocationController,
     AllocationControllerConfig,

@@ -810,8 +810,6 @@ def test_quarantine_never_loses_live_checkpoint_when_recovery_write_fails(
     corrupt ORIGINAL at the live path — quarantine is a copy, not a
     rename — so a later recovery attempt still has the bytes to salvage
     instead of silently starting from an empty checkpoint."""
-    import os
-
     from tpu_dra_driver.plugin.checkpoint import (
         Checkpoint,
         CheckpointManager,

@@ -35,6 +35,17 @@ def test_sim_e2e_tpu_plugin_quick(tmp_path):
     assert tp["t5"]["quantity_selector_allocated"]
     assert tp["t6"]["string_selector_allocated"]
     assert tp["claim_to_ready_ms"]["p50"] > 0
+    # observability acceptance: one claim trace across a real process
+    # boundary (allocation in the harness, prepare phases in the
+    # production plugin subprocess, fetched from /debug/traces/<id>),
+    # Events on the claim, exemplars in the plugin's /metrics
+    tr = tp["tracing"]
+    assert len(tr["trace_id"]) == 32
+    assert {"kubelet.prepare", "prepare.write_ahead", "prepare.commit",
+            "prepare.devices", "prepare.cdi"} <= set(tr["crossproc_spans"])
+    assert tr["allocator_span_local"]
+    assert {"Allocated", "Prepared"} <= set(tr["claim_events"])
+    assert tr["exemplar_in_metrics"]
 
 
 def test_sim_e2e_collective_bench_spec(tmp_path):
@@ -59,3 +70,15 @@ def test_sim_e2e_compute_domain(tmp_path):
     assert cd["failover_observed_degradation"] and cd["index_stability"]
     assert cd["failover_heal_s"] <= 300
     assert cd["teardown_clean"]
+    # observability acceptance: the workload claim's trace covers
+    # allocation (harness) -> cd.prepare + the CD-ready rendezvous wait
+    # (CD plugin subprocess) in ONE trace id; the CD's own trace carries
+    # the controller's cd.rendezvous span; CDReady event on the CD
+    tr = cd["tracing"]
+    assert len(tr["claim_trace_id"]) == 32
+    assert {"cd.prepare", "cd.await_ready", "cd.commit"} <= \
+        set(tr["claim_spans_crossproc"])
+    assert tr["await_ready_retries"] >= 1
+    assert tr["cd_rendezvous_span"]
+    assert {"Allocated", "Prepared"} <= set(tr["claim_events"])
+    assert "CDReady" in tr["cd_events"]

@@ -269,6 +269,95 @@ def test_broad_exception_handlers_reraise_count_or_are_annotated():
         f"carry `# chaos-ok: <reason>` on the except line: {offenders}")
 
 
+# ---------------------------------------------------------------------------
+# observability guards: no bare print() on library paths, and every dra_*
+# metric family registered exactly once and documented
+# ---------------------------------------------------------------------------
+
+# Library code must log (pkg/logging.py gives every binary structured,
+# correlated records) — a bare print() bypasses verbosity, format, and
+# correlation entirely and is invisible in json mode. cmd/ keeps its
+# argv-validation prints (stderr before logging is even configured).
+_NO_PRINT_DIRS = (
+    os.path.join("tpu_dra_driver", "kube"),
+    os.path.join("tpu_dra_driver", "plugin"),
+    os.path.join("tpu_dra_driver", "computedomain"),
+    os.path.join("tpu_dra_driver", "pkg"),
+)
+
+
+def _print_calls(path):
+    import ast
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    return [(path, node.lineno) for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"]
+
+
+def test_no_bare_print_in_library_code():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    offenders = []
+    for rel in _NO_PRINT_DIRS:
+        root = os.path.join(repo, rel)
+        for dirpath, _, files in os.walk(root):
+            for name in files:
+                if name.endswith(".py"):
+                    offenders.extend(
+                        _print_calls(os.path.join(dirpath, name)))
+    assert offenders == [], (
+        f"bare print() in library code: {offenders} — use the module "
+        "logger so --log-format json / verbosity apply")
+
+
+def _dra_metric_registrations():
+    """name -> [file:line] for every dra_* family registration
+    (.counter/.gauge/.histogram with a literal dra_* name) under
+    tpu_dra_driver/."""
+    import ast
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = {}
+    for dirpath, _, files in os.walk(os.path.join(repo, "tpu_dra_driver")):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("counter", "gauge",
+                                               "histogram")
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.startswith("dra_")):
+                    out.setdefault(node.args[0].value, []).append(
+                        f"{os.path.relpath(path, repo)}:{node.lineno}")
+    return out
+
+
+def test_dra_metric_families_registered_once_and_documented():
+    """Every dra_* family has exactly ONE registration site (a second
+    .counter() with different help/labels would either alias or raise at
+    import, depending on order) and a line in docs/observability.md —
+    the scrape surface stays documented by construction."""
+    regs = _dra_metric_registrations()
+    assert regs, "no dra_* registrations found — scanner broken?"
+    dupes = {n: sites for n, sites in regs.items() if len(sites) > 1}
+    assert dupes == {}, f"dra_* families registered more than once: {dupes}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "docs", "observability.md"),
+              encoding="utf-8") as fh:
+        doc = fh.read()
+    undocumented = sorted(n for n in regs if n not in doc)
+    assert undocumented == [], (
+        f"dra_* families missing from docs/observability.md: "
+        f"{undocumented}")
+
+
 def test_no_sleep_polling_in_cd_reconcile_paths():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     offenders = []

@@ -1,0 +1,111 @@
+"""Structured logging (pkg/logging.py): JSON schema, correlation fields,
+trace-id injection, and the --log-format flag plumbing."""
+
+import json
+import logging
+
+import pytest
+
+from tpu_dra_driver.pkg import logging as dralog
+from tpu_dra_driver.pkg import tracing
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    tracing.reset()
+    dralog._STATIC.clear()
+    yield
+    tracing.reset()
+    dralog._STATIC.clear()
+    logging.getLogger().handlers[:] = []
+
+
+def _record(msg="hello", exc_info=None, args=()):
+    return logging.LogRecord("tpu_dra_driver.test", logging.INFO,
+                             "f.py", 1, msg, args, exc_info)
+
+
+def test_json_formatter_schema():
+    dralog.set_static(component="tpu-kubelet-plugin", node="n1")
+    out = json.loads(dralog.JsonFormatter().format(_record("prep %d",
+                                                           args=(7,))))
+    assert out["msg"] == "prep 7"
+    assert out["level"] == "INFO"
+    assert out["logger"] == "tpu_dra_driver.test"
+    assert out["component"] == "tpu-kubelet-plugin"
+    assert out["node"] == "n1"
+    assert out["time"].endswith("Z")
+    assert isinstance(out["ts"], float)
+
+
+def test_json_formatter_scoped_fields_and_trace_correlation():
+    tracing.configure("always")
+    span = tracing.start_span("root")
+    with tracing.use_span(span):
+        with dralog.fields(claim="ns/c", claim_uid="u1"):
+            out = json.loads(dralog.JsonFormatter().format(_record()))
+    span.end()
+    assert out["claim"] == "ns/c"
+    assert out["claim_uid"] == "u1"
+    assert out["trace_id"] == span.context.trace_id
+    assert out["span_id"] == span.context.span_id
+    # fields are scoped: gone outside the context
+    out2 = json.loads(dralog.JsonFormatter().format(_record()))
+    assert "claim" not in out2 and "trace_id" not in out2
+
+
+def test_json_formatter_exception_and_unserializable():
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError:
+        import sys
+        out = json.loads(dralog.JsonFormatter().format(
+            _record(exc_info=sys.exc_info())))
+    assert "RuntimeError: boom" in out["exc"]
+    # an unserializable arg degrades to repr, never drops the record
+    out2 = json.loads(dralog.JsonFormatter().format(
+        _record("obj %s", args=(object(),))))
+    assert "object" in out2["msg"]
+
+
+def test_setup_switches_formats_and_rejects_unknown():
+    dralog.setup(4, "json", component="c")
+    [handler] = logging.getLogger().handlers
+    assert isinstance(handler.formatter, dralog.JsonFormatter)
+    dralog.setup(6, "text")
+    [handler] = logging.getLogger().handlers
+    assert not isinstance(handler.formatter, dralog.JsonFormatter)
+    assert logging.getLogger().level == logging.DEBUG
+    with pytest.raises(SystemExit):
+        dralog.setup(4, "yaml")
+
+
+def test_common_flags_carry_log_format_and_trace_mode(monkeypatch):
+    from tpu_dra_driver.cmd.tpu_kubelet_plugin import build_parser
+    args = build_parser().parse_args(["--log-format=json",
+                                      "--trace-mode=sampled",
+                                      "--trace-sample-ratio=0.5"])
+    assert args.log_format == "json"
+    assert args.trace_mode == "sampled"
+    assert args.trace_sample_ratio == 0.5
+    monkeypatch.setenv("LOG_FORMAT", "json")
+    monkeypatch.setenv("TRACE_MODE", "always")
+    args = build_parser().parse_args([])
+    assert args.log_format == "json" and args.trace_mode == "always"
+
+
+def test_setup_observability_configures_tracing():
+    from tpu_dra_driver.pkg.flags import setup_observability
+
+    class Args:
+        verbosity = 4
+        log_format = "json"
+        trace_mode = "always"
+        trace_sample_ratio = 0.01
+        node_name = "n9"
+
+    setup_observability(Args(), "test-binary")
+    assert tracing.enabled() and tracing.mode() == "always"
+    out = json.loads(dralog.JsonFormatter().format(_record()))
+    assert out["component"] == "test-binary"
+    assert out["node"] == "n9"

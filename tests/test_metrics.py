@@ -132,6 +132,92 @@ def test_dump_thread_stacks_mentions_this_function():
     assert "test_dump_thread_stacks_mentions_this_function" in dump_thread_stacks()
 
 
+# ---------------------------------------------------------------------------
+# Prometheus exposition edge cases (observability PR): label-value
+# escaping, +Inf rendering, the versioned content-type, and /readyz
+# following the API-server circuit breaker.
+# ---------------------------------------------------------------------------
+
+def test_label_value_escaping():
+    reg = Registry()
+    c = reg.counter("esc_total", "escapes", ("path",))
+    c.labels('with"quote').inc()
+    c.labels("with\\backslash").inc()
+    c.labels("with\nnewline").inc()
+    text = reg.render()
+    assert 'esc_total{path="with\\"quote"} 1' in text
+    assert 'esc_total{path="with\\\\backslash"} 1' in text
+    assert 'esc_total{path="with\\nnewline"} 1' in text
+    # the rendered output stays line-oriented: no raw newline leaked
+    # into a sample line (every line is comment, blank, or name-first)
+    for line in text.splitlines():
+        assert line == "" or line.startswith("#") or line[0].isalpha()
+
+
+def test_plus_inf_bucket_rendering():
+    reg = Registry()
+    h = reg.histogram("inf_seconds", "inf", buckets=(1.0,))
+    h.observe(0.5)
+    h.observe(float("inf"))   # literal +Inf observation
+    h.observe(2.0)
+    text = reg.render()
+    assert 'inf_seconds_bucket{le="1"} 1' in text
+    assert 'inf_seconds_bucket{le="+Inf"} 3' in text
+    assert 'inf_seconds_count 3' in text
+    assert "inf_seconds_sum inf" in text
+    # a gauge can legitimately hold +Inf; it renders in Prometheus form
+    g = reg.gauge("inf_gauge", "g")
+    g.set(float("inf"))
+    assert "inf_gauge +Inf" in reg.render()
+
+
+def test_metrics_content_type_header():
+    reg = Registry()
+    reg.counter("x_total", "x").inc()
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=reg)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics") as resp:
+            assert resp.headers["Content-Type"] == \
+                "text/plain; version=0.0.4; charset=utf-8"
+            assert resp.headers["Content-Length"] == \
+                str(len(resp.read()))
+    finally:
+        srv.stop()
+
+
+def test_readyz_follows_circuit_breaker():
+    """The kubelet-plugin wiring: /readyz is the breaker-aware healthy()
+    check, so an open API-server breaker flips readiness to 503 and a
+    half-open probe success flips it back."""
+    from tpu_dra_driver.kube.breaker import CircuitBreaker
+
+    clock = [0.0]
+    br = CircuitBreaker(name="readyz-test", failure_threshold=2,
+                        reset_timeout=10.0, clock=lambda: clock[0])
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry(),
+                          ready_check=lambda: br.state != "open")
+    srv.start()
+    try:
+        status, _ = fetch(srv.port, "/readyz")
+        assert status == 200
+        br.record_failure()
+        br.record_failure()          # threshold reached: breaker opens
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/readyz")
+            assert False, "expected 503 while the breaker is open"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        clock[0] = 11.0              # reset timeout elapses: half-open
+        assert br.allow()            # the probe is admitted
+        br.record_success()          # probe succeeds: closed again
+        status, _ = fetch(srv.port, "/readyz")
+        assert status == 200
+    finally:
+        srv.stop()
+
+
 def test_controller_exports_reconcile_metrics():
     from tpu_dra_driver.computedomain.controller.controller import (
         ComputeDomainController, ControllerConfig)

@@ -1,0 +1,334 @@
+"""Claim-lifecycle tracing (pkg/tracing.py): W3C-style context, the
+bounded flight recorder, /debug/traces export, exemplars, and — the
+acceptance criterion — the zero-overhead disabled fast path, pinned the
+same way faultinject's is (no-allocation assertion + generous
+microbench)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg import tracing
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracing():
+    tracing.reset()
+    yield
+    tracing.reset()
+    fi.reset()
+
+
+# ---------------------------------------------------------------------------
+# context + wire format
+# ---------------------------------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = tracing.SpanContext("ab" * 16, "cd" * 8, sampled=True)
+    wire = ctx.traceparent()
+    assert wire == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    back = tracing.parse_traceparent(wire)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled
+
+
+def test_parse_traceparent_rejects_malformed():
+    for bad in (None, "", "garbage", "00-xyz-abc-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace id
+                "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # zero span id
+                "00-" + "1" * 31 + "-" + "2" * 16 + "-01",   # short trace
+                "00-" + "g" * 32 + "-" + "2" * 16 + "-01"):  # non-hex
+        assert tracing.parse_traceparent(bad) is None, bad
+
+
+def test_unsampled_flag_round_trip():
+    ctx = tracing.SpanContext("1" * 32, "2" * 16, sampled=False)
+    assert ctx.traceparent().endswith("-00")
+    assert tracing.parse_traceparent(ctx.traceparent()).sampled is False
+
+
+def test_annotate_and_from_object():
+    tracing.configure("always")
+    span = tracing.start_span("root")
+    obj = {"metadata": {"name": "c"}}
+    tracing.annotate(obj, span.context)
+    got = tracing.from_object(obj)
+    assert got.trace_id == span.context.trace_id
+    # None context leaves the object untouched
+    obj2 = {"metadata": {}}
+    tracing.annotate(obj2, None)
+    assert "annotations" not in obj2["metadata"]
+
+
+# ---------------------------------------------------------------------------
+# recording semantics
+# ---------------------------------------------------------------------------
+
+def test_always_mode_records_span_tree():
+    tracing.configure("always", service="test-proc")
+    root = tracing.start_span("root", attributes={"claim": "ns/c"})
+    with tracing.use_span(root):
+        with tracing.span("child") as child:
+            assert child.recording
+            assert child.context.trace_id == root.context.trace_id
+            child.add_event("hello", detail=1)
+    root.end()
+    spans = tracing.recorder().trace(root.context.trace_id)
+    assert [s["name"] for s in spans] == ["child", "root"]
+    child_d, root_d = spans
+    assert child_d["parent_span_id"] == root.context.span_id
+    assert root_d["parent_span_id"] is None
+    assert root_d["attributes"]["claim"] == "ns/c"
+    assert child_d["events"][0]["name"] == "hello"
+    assert root_d["process"] == "test-proc"
+    assert root_d["duration_ms"] >= 0
+
+
+def test_span_context_manager_marks_errors():
+    tracing.configure("always")
+    with pytest.raises(ValueError):
+        with tracing.span("failing", root=True):
+            raise ValueError("boom")
+    summaries = tracing.recorder().traces()
+    assert summaries[0]["errors"] == 1
+
+
+def test_child_without_current_span_is_noop_unless_root():
+    tracing.configure("always")
+    with tracing.span("orphan") as s:
+        assert not s.recording
+    with tracing.span("explicit-root", root=True) as s:
+        assert s.recording
+
+
+def test_sampled_mode_child_inherits_parent_decision():
+    tracing.configure("sampled", sample_ratio=0.0)
+    assert not tracing.start_span("root").recording  # ratio 0: nothing
+    tracing.configure("sampled", sample_ratio=1.0)
+    root = tracing.start_span("root")
+    assert root.recording
+    # an unsampled remote parent suppresses the child in sampled mode
+    remote = tracing.SpanContext("3" * 32, "4" * 16, sampled=False)
+    assert not tracing.start_span("child", parent=remote).recording
+    # ...but not in always mode
+    tracing.configure("always")
+    assert tracing.start_span("child", parent=remote).recording
+
+
+def test_events_capped_per_span():
+    tracing.configure("always")
+    span = tracing.start_span("chatty")
+    for i in range(tracing.MAX_EVENTS_PER_SPAN + 50):
+        span.add_event("retry", attempt=i)
+    span.end()
+    assert len(span.events) == tracing.MAX_EVENTS_PER_SPAN + 1
+    assert span.events[-1]["name"] == "truncated"
+
+
+def test_flight_recorder_bounded():
+    tracing.configure("always", capacity=16)
+    for i in range(50):
+        tracing.start_span(f"s{i}").end()
+    assert len(tracing.recorder()) == 16
+
+
+def test_fault_firing_lands_as_span_event():
+    tracing.configure("always")
+    fi.arm("trace.point", fi.Rule(mode="latency", seconds=0.0))
+    root = tracing.start_span("root")
+    with tracing.use_span(root):
+        fi.fire("trace.point")
+    root.end()
+    [span] = tracing.recorder().trace(root.context.trace_id)
+    assert span["events"][0]["name"] == "fault.injected"
+    assert span["events"][0]["attributes"] == {"point": "trace.point",
+                                               "mode": "latency"}
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead disabled contract (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_disabled_returns_shared_noop_and_records_nothing():
+    assert not tracing.enabled()
+    s1 = tracing.start_span("a")
+    s2 = tracing.start_span("b", attributes={"x": 1})
+    assert s1 is s2 is tracing.NOOP_SPAN          # no allocation
+    with tracing.span("c") as s3:
+        assert s3 is tracing.NOOP_SPAN
+    tracing.add_event("nothing", k="v")
+    assert tracing.exemplar() is None
+    assert tracing.current_span() is None
+    s1.end()
+    assert len(tracing.recorder()) == 0
+
+
+def test_disabled_span_microbench():
+    """Generous absolute bound, mirroring faultinject's: 100k disabled
+    span() + start_span() + add_event() rounds in well under a second —
+    a regression that adds locking/contextvar traffic to the disabled
+    path trips this long before it hurts the prepare hot path."""
+    assert not tracing.enabled()
+    t0 = time.monotonic()
+    for _ in range(100_000):
+        with tracing.span("hot"):
+            pass
+        tracing.add_event("e")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.0, f"disabled tracing took {elapsed:.3f}s per 100k"
+
+
+# ---------------------------------------------------------------------------
+# /debug/traces export + exemplars
+# ---------------------------------------------------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode(), r.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, "", ""
+
+
+def test_debug_traces_endpoints():
+    from tpu_dra_driver.pkg.metrics import DebugHTTPServer, Registry
+    tracing.configure("always")
+    root = tracing.start_span("e2e-claim")
+    with tracing.use_span(root):
+        with tracing.span("phase"):
+            pass
+    root.end()
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry())
+    srv.start()
+    try:
+        status, body, ctype = _get(srv.port, "/debug/traces")
+        assert status == 200 and ctype.startswith("application/json")
+        summaries = json.loads(body)
+        row = next(r for r in summaries
+                   if r["trace_id"] == root.context.trace_id)
+        assert row["spans"] == 2 and row["root"] == "e2e-claim"
+        status, body, _ = _get(srv.port,
+                               f"/debug/traces/{root.context.trace_id}")
+        assert status == 200
+        doc = json.loads(body)
+        assert {s["name"] for s in doc["spans"]} == {"e2e-claim", "phase"}
+        status, _, _ = _get(srv.port, "/debug/traces/deadbeef")
+        assert status == 404
+    finally:
+        srv.stop()
+
+
+def test_histogram_exemplar_rendered_only_on_request():
+    from tpu_dra_driver.pkg.metrics import Registry
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar={"trace_id": "abc123"})
+    h.observe(5.0)   # +Inf bucket, no exemplar
+    # default render: classic text-format 0.0.4 — NO exemplar suffixes
+    # (the 0.0.4 parser reads trailing tokens as a timestamp and fails
+    # the whole scrape)
+    plain = reg.render()
+    assert "abc123" not in plain and " # {" not in plain
+    assert 'lat_seconds_bucket{le="0.1"} 1' in plain
+    # opt-in render carries the exemplar on the bucket it fell into
+    text = reg.render(exemplars=True)
+    assert 'lat_seconds_bucket{le="0.1"} 1 # {trace_id="abc123"} 0.05' \
+        in text
+    # plain line shape preserved for the exemplar-free bucket
+    assert 'lat_seconds_bucket{le="+Inf"} 2\n' in text or \
+        text.endswith('lat_seconds_bucket{le="+Inf"} 2')
+
+
+def test_allocator_to_plugin_trace_spans_one_trace(tmp_path):
+    """In-process version of the cross-process acceptance flow: the
+    allocator opens the root span and stamps the claim annotation; the
+    kubelet plugin picks the annotation up and its prepare spans land in
+    the SAME trace."""
+    from tpu_dra_driver.kube.allocator import Allocator
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    tracing.configure("always")
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="n1", state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi")))
+    plugin.start()
+    clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": "traced", "namespace": "t"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "count": 1,
+             "selectors": [{"attribute": "type", "equals": "chip"}]}]}},
+    })
+    claim = Allocator(clients).allocate("traced", "t")
+    wire = claim["metadata"]["annotations"][tracing.TRACEPARENT_ANNOTATION]
+    ctx = tracing.parse_traceparent(wire)
+    assert ctx is not None
+    res = plugin.prepare_resource_claims([claim])
+    assert res[claim["metadata"]["uid"]].error is None
+    plugin.shutdown()
+    spans = tracing.recorder().trace(ctx.trace_id)
+    names = {s["name"] for s in spans}
+    assert {"allocator.allocate", "kubelet.prepare",
+            "prepare.write_ahead", "prepare.devices", "prepare.cdi",
+            "prepare.commit"} <= names, names
+    # the annotation carries the ROOT span's context (not a short-lived
+    # phase child): kubelet.prepare parents directly on allocator.allocate
+    root_span = next(s for s in spans if s["name"] == "allocator.allocate")
+    kubelet_span = next(s for s in spans if s["name"] == "kubelet.prepare")
+    assert kubelet_span["parent_span_id"] == root_span["span_id"]
+    assert root_span["parent_span_id"] is None
+    # the claim's Events are on the API server too (kubectl describe);
+    # emission is async, so poll briefly
+    deadline = time.monotonic() + 5
+    reasons = set()
+    while time.monotonic() < deadline:
+        reasons = {e["reason"] for e in clients.events.list()}
+        if {"Allocated", "Prepared"} <= reasons:
+            break
+        time.sleep(0.02)
+    assert {"Allocated", "Prepared"} <= reasons
+
+
+def test_multi_claim_batch_phases_land_in_each_claims_trace(tmp_path):
+    """A 2-claim kubelet batch: EACH claim's trace carries its own
+    prepare.devices/prepare.cdi spans (not all piled onto the first
+    claim's trace), while the shared write-ahead/commit fsync spans ride
+    the batch span."""
+    from tpu_dra_driver.kube.allocator import Allocator
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    tracing.configure("always")
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="n1", state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi")))
+    plugin.start()
+    claims = []
+    for i in range(2):
+        clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+            "metadata": {"name": f"b{i}", "namespace": "t"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "count": 1,
+                 "selectors": [{"attribute": "type", "equals": "chip"}]}]}},
+        })
+        claims.append(Allocator(clients).allocate(f"b{i}", "t"))
+    res = plugin.prepare_resource_claims(claims)
+    assert all(r.error is None for r in res.values())
+    plugin.shutdown()
+    for claim in claims:
+        ctx = tracing.from_object(claim)
+        names = {s["name"] for s in tracing.recorder().trace(ctx.trace_id)}
+        assert {"kubelet.prepare", "prepare.devices", "prepare.cdi"} \
+            <= names, (claim["metadata"]["name"], names)

@@ -31,7 +31,7 @@ from tpu_dra_driver.pkg.flags import (
     add_common_flags,
     config_dict,
     parse_http_endpoint,
-    setup_logging,
+    setup_observability,
 )
 
 
@@ -76,7 +76,7 @@ def build_parser() -> EnvArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    setup_logging(args.verbosity)
+    setup_observability(args, "allocation-controller")
     faultinject.arm_from_env()
     install_stack_dump_handler()
     dump_config("allocation-controller", config_dict(args))

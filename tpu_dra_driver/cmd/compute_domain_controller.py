@@ -25,7 +25,7 @@ from tpu_dra_driver.pkg.flags import (
     add_common_flags,
     config_dict,
     parse_http_endpoint,
-    setup_logging,
+    setup_observability,
 )
 from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients
 
@@ -62,6 +62,15 @@ def build_parser() -> EnvArgumentParser:
                    type=int, default=4,
                    help="verbosity plumbed into stamped CD daemon pods "
                         "(reference daemonset.go:206-217)")
+    p.add_argument("--daemon-log-format", env="DAEMON_LOG_FORMAT",
+                   default="text", choices=["text", "json"],
+                   help="log format plumbed into stamped CD daemon pods")
+    p.add_argument("--daemon-http-endpoint", env="DAEMON_HTTP_ENDPOINT",
+                   default="",
+                   help="--http-endpoint plumbed into stamped CD daemon "
+                        "pods so their /metrics + /debug/traces are "
+                        "scrapeable (hostNetwork: pick the port cluster-"
+                        "wide); empty keeps it disabled")
     p.add_argument("--additional-namespaces", env="ADDITIONAL_NAMESPACES",
                    default="",
                    help="comma-separated extra namespaces where the driver "
@@ -79,7 +88,7 @@ def build_parser() -> EnvArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    setup_logging(args.verbosity)
+    setup_observability(args, "compute-domain-controller")
     # chaos drills script faults into production binaries via
     # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
     faultinject.arm_from_env()
@@ -95,6 +104,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         device_backend=args.device_backend,
         daemon_image=args.driver_image,
         daemon_log_verbosity=args.daemon_log_verbosity,
+        daemon_log_format=args.daemon_log_format,
+        daemon_http_endpoint=args.daemon_http_endpoint,
         additional_namespaces=[ns.strip() for ns in
                                args.additional_namespaces.split(",")
                                if ns.strip()]))

@@ -29,7 +29,8 @@ from tpu_dra_driver.pkg.flags import (
     add_common_flags,
     config_dict,
     parse_gates,
-    setup_logging,
+    parse_http_endpoint,
+    setup_observability,
 )
 from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients, make_lib
 
@@ -63,6 +64,12 @@ def build_parser() -> EnvArgumentParser:
     p.add_argument("--device-backend", env="DEVICE_BACKEND", default="native",
                    choices=["native", "fake"])
     p.add_argument("--accelerator-type", env="TPU_ACCELERATOR_TYPE", default="")
+    p.add_argument("--http-endpoint", env="HTTP_ENDPOINT", default="",
+                   help="host:port for /metrics (informer/watch families, "
+                        "dra_swallowed_errors_total), /healthz, /readyz "
+                        "(clique readiness), /debug/threads and "
+                        "/debug/traces; empty disables — without it the "
+                        "daemon's metrics are unscrapeable")
     return p
 
 
@@ -75,7 +82,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             cd_run_dir(args.run_dir, args.compute_domain_uid), READY_FILE)
         return 0 if os.path.exists(ready_path) else 1
 
-    setup_logging(args.verbosity)
+    setup_observability(args, "compute-domain-daemon")
     # chaos drills script faults into production binaries via
     # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
     faultinject.arm_from_env()
@@ -107,6 +114,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         gates=parse_gates(args)))
     daemon.start()
 
+    debug_server = None
+    address = parse_http_endpoint(args.http_endpoint)
+    if address is not None:
+        from tpu_dra_driver.pkg.metrics import DebugHTTPServer
+        debug_server = DebugHTTPServer(address, ready_check=daemon.check)
+        debug_server.start()
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -129,6 +143,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     while not stop.is_set():
         if daemon.fatal.wait(timeout=0.5):
             daemon.stop()
+            if debug_server is not None:
+                debug_server.stop()
             try:
                 os.remove(ready_path)
             except OSError:
@@ -137,6 +153,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 1
     daemon.stop()
+    if debug_server is not None:
+        debug_server.stop()
     try:
         os.remove(ready_path)
     except OSError:

@@ -20,7 +20,8 @@ from tpu_dra_driver.pkg.flags import (
     EnvArgumentParser,
     add_common_flags,
     config_dict,
-    setup_logging,
+    parse_http_endpoint,
+    setup_observability,
 )
 from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients, make_lib
 
@@ -46,12 +47,15 @@ def build_parser() -> EnvArgumentParser:
                    help="pod UID (downward API); unique-per-instance "
                         "socket names for gap-free DaemonSet rolling "
                         "updates (kubelet >= 1.33)")
+    p.add_argument("--http-endpoint", env="HTTP_ENDPOINT", default="",
+                   help="host:port for /metrics, /healthz, /readyz, "
+                        "/debug/threads and /debug/traces; empty disables")
     return p
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    setup_logging(args.verbosity)
+    setup_observability(args, "compute-domain-kubelet-plugin")
     # chaos drills script faults into production binaries via
     # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
     faultinject.arm_from_env()
@@ -90,10 +94,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             healthy_fn=getattr(plugin, "healthy", None))
         healthcheck.start()
 
+    debug_server = None
+    address = parse_http_endpoint(args.http_endpoint)
+    if address is not None:
+        from tpu_dra_driver.pkg.metrics import DebugHTTPServer
+        debug_server = DebugHTTPServer(address, ready_check=plugin.healthy)
+        debug_server.start()
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if debug_server is not None:
+        debug_server.stop()
     if healthcheck is not None:
         healthcheck.stop()
     server.stop()

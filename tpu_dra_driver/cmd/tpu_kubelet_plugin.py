@@ -23,7 +23,7 @@ from tpu_dra_driver.pkg.flags import (
     add_common_flags,
     config_dict,
     parse_gates,
-    setup_logging,
+    setup_observability,
 )
 from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
 
@@ -93,7 +93,7 @@ def make_clients(args) -> ClientSets:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    setup_logging(args.verbosity)
+    setup_observability(args, "tpu-kubelet-plugin")
     # chaos drills script faults into production binaries via
     # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
     faultinject.arm_from_env()

@@ -13,7 +13,8 @@ from tpu_dra_driver.pkg.flags import (
     EnvArgumentParser,
     add_common_flags,
     config_dict,
-    setup_logging,
+    parse_http_endpoint,
+    setup_observability,
 )
 from tpu_dra_driver.webhook.server import WebhookServer
 
@@ -25,12 +26,16 @@ def build_parser() -> EnvArgumentParser:
     p.add_argument("--port", env="WEBHOOK_PORT", type=int, default=8443)
     p.add_argument("--tls-cert", env="WEBHOOK_TLS_CERT", default="")
     p.add_argument("--tls-key", env="WEBHOOK_TLS_KEY", default="")
+    p.add_argument("--http-endpoint", env="HTTP_ENDPOINT", default="",
+                   help="host:port for the plaintext /metrics, /healthz, "
+                        "/readyz and /debug/threads endpoint (separate "
+                        "from the HTTPS admission port); empty disables")
     return p
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    setup_logging(args.verbosity)
+    setup_observability(args, "tpu-dra-webhook")
     # chaos drills script faults into production binaries via
     # TPU_DRA_FAULTS (see docs/chaos.md); a no-op when unset
     faultinject.arm_from_env()
@@ -40,10 +45,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                            cert_file=args.tls_cert or None,
                            key_file=args.tls_key or None)
     server.start()
+    debug_server = None
+    address = parse_http_endpoint(args.http_endpoint)
+    if address is not None:
+        from tpu_dra_driver.pkg.metrics import DebugHTTPServer
+        debug_server = DebugHTTPServer(address)
+        debug_server.start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if debug_server is not None:
+        debug_server.stop()
     server.stop()
     return 0
 

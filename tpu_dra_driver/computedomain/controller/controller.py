@@ -49,7 +49,14 @@ from tpu_dra_driver.computedomain.controller.objects import (
 )
 from tpu_dra_driver.kube.client import ABORT, ClientSets
 from tpu_dra_driver.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
+from tpu_dra_driver.kube.events import (
+    REASON_CD_READY,
+    REASON_VALIDATION_FAILED,
+    EventRecorder,
+    object_ref,
+)
 from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY, QueueMetrics, Registry
 from tpu_dra_driver.pkg.workqueue import WorkQueue, default_controller_rate_limiter
 
@@ -95,6 +102,11 @@ class ControllerConfig:
     # the DaemonSet template, daemonset.go:206-217)
     daemon_image: str = ""
     daemon_log_verbosity: int = 4
+    # observability plumbed into stamped CD daemon pods: log format and
+    # the daemon's own --http-endpoint ("" keeps it disabled; the daemon
+    # runs hostNetwork so the port is a cluster-wide choice)
+    daemon_log_format: str = "text"
+    daemon_http_endpoint: str = ""
 
 
 class ComputeDomainController:
@@ -131,6 +143,12 @@ class ComputeDomainController:
         # CD uid -> monotonic time the first daemon join was observed while
         # the CD was not Ready (feeds the rendezvous histogram).
         self._rendezvous_t0: Dict[str, float] = {}
+        # CD uid -> open ``cd.rendezvous`` span (keyed by the CD's own
+        # trace — the traceparent annotation stamped at first reconcile);
+        # ended when the Ready flip is written.
+        self._rendezvous_spans: Dict[str, object] = {}
+        self._events_rec = EventRecorder(
+            clients.events, component="compute-domain-controller")
         def pod_cd_uid(obj: Dict):
             uid = ((obj.get("metadata") or {}).get("labels") or {}).get(
                 COMPUTE_DOMAIN_LABEL_KEY)
@@ -290,9 +308,28 @@ class ComputeDomainController:
             if cds and ((cds[0].get("status") or {}).get("status")
                         != STATUS_READY):
                 self._rendezvous_t0[uid] = time.monotonic()
+                self._start_rendezvous_span(uid, cds[0])
         self._queue.enqueue_with_key(
             f"status:{uid}", lambda: self._sync_cd_status(uid),
             delay=self._config.status_debounce)
+
+    def _start_rendezvous_span(self, uid: str, cd_obj) -> None:
+        """Open the ``cd.rendezvous`` span (first daemon join → Ready
+        flip) on the CD's own trace — the traceparent annotation stamped
+        at first reconcile — so the daemon's clique-render spans from a
+        different process land in the same trace."""
+        if not tracing.enabled() or uid in self._rendezvous_spans:
+            return
+        if isinstance(cd_obj, dict):
+            ctx = tracing.from_object(cd_obj)
+        else:
+            ctx = tracing.parse_traceparent(
+                (cd_obj.metadata.annotations or {}).get(
+                    tracing.TRACEPARENT_ANNOTATION))
+        span = tracing.start_span("cd.rendezvous", parent=ctx,
+                                  attributes={"cd_uid": uid})
+        if span.recording:
+            self._rendezvous_spans[uid] = span
 
     def _reconcile(self, key: str) -> None:
         with self._reconcile_duration.time():
@@ -325,39 +362,58 @@ class ComputeDomainController:
                 )
         except ValueError as e:
             log.error("ComputeDomain %s rejected: %s", key, e)
-            self._emit_event(cd, "ValidationFailed", str(e))
+            self._emit_event(cd, REASON_VALIDATION_FAILED, str(e))
             return
         self._ensure_finalizer(cd)
         self._ensure_children(cd)
 
+    def _cd_ref(self, cd: ComputeDomain) -> Dict[str, str]:
+        return object_ref("ComputeDomain", cd.metadata.name,
+                          cd.metadata.namespace, cd.metadata.uid)
+
     def _emit_event(self, cd: ComputeDomain, reason: str, message: str) -> None:
-        try:
-            self._clients.events.create({
-                "apiVersion": "v1",
-                "kind": "Event",
-                "metadata": {"generateName": f"{cd.metadata.name}.",
-                             "namespace": cd.metadata.namespace or "default"},
-                "type": "Warning",
-                "reason": reason,
-                "message": message,
-                "involvedObject": {"kind": "ComputeDomain",
-                                   "name": cd.metadata.name,
-                                   "namespace": cd.metadata.namespace,
-                                   "uid": cd.metadata.uid},
-            })
-        except Exception:
-            from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
-            SWALLOWED_ERRORS.labels("controller.emit_event").inc()
-            log.exception("failed to emit event for %s", cd.metadata.name)
+        """Warning event on the CD (deduped/rate-limited; kube/events.py
+        swallows API failures by contract)."""
+        self._events_rec.warning(self._cd_ref(cd), reason, message)
 
     def _ensure_finalizer(self, cd: ComputeDomain) -> None:
+        # The CD's trace is born here: a fresh root context stamped once,
+        # alongside the finalizer, so the daemon's clique renders and
+        # this controller's rendezvous span (different processes) all key
+        # off one trace id. ONE marker span is created lazily outside the
+        # mutate (which retry_update may run several times on conflicts)
+        # and recorded only if OUR trace id actually landed on the object
+        # — otherwise the recorder would fill with phantom one-span
+        # traces nothing can ever join.
+        marker = [None]
+
         def mutate(obj):
             fins = obj["metadata"].setdefault("finalizers", [])
-            if COMPUTE_DOMAIN_FINALIZER in fins:
+            changed = False
+            if COMPUTE_DOMAIN_FINALIZER not in fins:
+                fins.append(COMPUTE_DOMAIN_FINALIZER)
+                changed = True
+            if tracing.enabled() and tracing.from_object(obj) is None:
+                if marker[0] is None:
+                    marker[0] = tracing.start_span(
+                        "cd.created",
+                        attributes={"cd": f"{cd.metadata.namespace}/"
+                                          f"{cd.metadata.name}",
+                                    "cd_uid": cd.metadata.uid})
+                if marker[0].recording:
+                    tracing.annotate(obj, marker[0].context)
+                    changed = True
+            if not changed:
                 return ABORT
-            fins.append(COMPUTE_DOMAIN_FINALIZER)
-        self._clients.compute_domains.retry_update(
+        final = self._clients.compute_domains.retry_update(
             cd.metadata.name, cd.metadata.namespace, mutate)
+        span = marker[0]
+        if span is not None and span.recording:
+            got = tracing.from_object(final)
+            if got is not None and got.trace_id == span.context.trace_id:
+                span.end()   # our context won: record the trace root
+            # else: never ended -> never recorded (a concurrent replica
+            # stamped its own, or the write never happened)
 
     def _managed_namespaces(self) -> List[str]:
         """Driver namespace + additional namespaces, deduplicated
@@ -383,7 +439,9 @@ class ComputeDomainController:
         desired_ds = build_daemonset(
             cd, image=self._config.daemon_image,
             log_verbosity=self._config.daemon_log_verbosity,
-            device_backend=self._config.device_backend)
+            device_backend=self._config.device_backend,
+            log_format=self._config.daemon_log_format,
+            http_endpoint=self._config.daemon_http_endpoint)
         existing_ds = self._find_daemonset(cd.metadata.uid)
         if existing_ds is not None:
             # adopt wherever it lives (possibly an additional namespace)
@@ -423,6 +481,9 @@ class ComputeDomainController:
     def _teardown(self, cd: ComputeDomain) -> None:
         uid = cd.metadata.uid
         self._rendezvous_t0.pop(uid, None)
+        span = self._rendezvous_spans.pop(uid, None)
+        if span is not None:
+            span.end(status="error")  # CD deleted before reaching Ready
         # DaemonSets may live in any managed namespace (mnsdaemonset.go
         # Delete spans all of them); delete by the CD-uid label so an
         # adopted DS with a non-canonical name is torn down too.
@@ -709,11 +770,22 @@ class ComputeDomainController:
         # Rendezvous clock: starts at the first observed daemon join while
         # the CD is converging; observed when the Ready flip is written.
         if outcome.get("status") != STATUS_READY and outcome.get("has_daemon"):
-            self._rendezvous_t0.setdefault(uid, time.monotonic())
+            if uid not in self._rendezvous_t0:
+                self._rendezvous_t0[uid] = time.monotonic()
+                self._start_rendezvous_span(uid, cd)
         if "prev_status" in outcome:
             self._status_writes.inc()
             if (outcome["status"] == STATUS_READY
                     and outcome["prev_status"] != STATUS_READY):
+                span = self._rendezvous_spans.pop(uid, None)
                 t0 = self._rendezvous_t0.pop(uid, None)
                 if t0 is not None:
-                    self._rendezvous_seconds.observe(time.monotonic() - t0)
+                    self._rendezvous_seconds.observe(
+                        time.monotonic() - t0,
+                        exemplar=tracing.exemplar(span))
+                if span is not None:
+                    span.end()
+                self._events_rec.normal(
+                    self._cd_ref(cd), REASON_CD_READY,
+                    f"ComputeDomain Ready "
+                    f"({cd.spec.num_nodes} node(s) requested)")

@@ -49,8 +49,13 @@ class TemplateError(RuntimeError):
 # names/uids, image refs incl. registries/digests, group/version paths)
 # and excludes quotes, whitespace and newlines — the YAML-injection
 # characters. User-controlled names that fail this never reach the
-# cluster half-rendered; they fail loudly at reconcile.
+# cluster half-rendered; they fail loudly at reconcile. Required values
+# must be NON-empty too (an empty IMAGE or CD_UID rendering as "" would
+# surface as a confusing downstream API rejection instead of a loud
+# TemplateError here); keys whose emptiness legitimately means
+# "disabled" are listed explicitly.
 _SAFE_VALUE = re.compile(r"^[A-Za-z0-9._:/@\-]+$")
+_MAY_BE_EMPTY = frozenset({"DAEMON_HTTP_ENDPOINT"})
 
 
 # Raw template text cached per path (validated by mtime): reconciles
@@ -81,10 +86,12 @@ def render_template(name: str, variables: Dict[str, str]) -> Dict:
     path = os.path.join(templates_dir(), name)
     raw = _template_text(path)
     for key, val in variables.items():
+        if str(val) == "" and key in _MAY_BE_EMPTY:
+            continue
         if not _SAFE_VALUE.match(str(val)):
             raise TemplateError(
                 f"{name}: value for ${{{key}}} contains characters unsafe "
-                f"for YAML substitution: {val!r}")
+                f"for YAML substitution (or is empty): {val!r}")
     try:
         rendered = string.Template(raw).substitute(variables)
     except KeyError as exc:
@@ -121,7 +128,9 @@ def _common_vars(cd: ComputeDomain) -> Dict[str, str]:
 
 def build_daemonset(cd: ComputeDomain, image: str = "",
                     log_verbosity: int = 4,
-                    device_backend: str = "native") -> Dict:
+                    device_backend: str = "native",
+                    log_format: str = "text",
+                    http_endpoint: str = "") -> Dict:
     """The per-CD DaemonSet. Node targeting: only nodes labeled with this
     CD's uid (the CD kubelet plugin adds the label when a workload pod's
     claim first hits the node — reference daemonset.go:206-250).
@@ -139,6 +148,11 @@ def build_daemonset(cd: ComputeDomain, image: str = "",
         "IMAGE": image,
         "LOG_VERBOSITY": str(log_verbosity),
         "DEVICE_BACKEND": device_backend,
+        "LOG_FORMAT": log_format,
+        # "" disables the DebugHTTPServer; non-empty makes the daemon's
+        # metrics/traces scrapeable (it runs hostNetwork, so the port
+        # must be chosen cluster-wide)
+        "DAEMON_HTTP_ENDPOINT": http_endpoint,
     })
     ds = render_template("compute-domain-daemon.tmpl.yaml", vars_)
     assert ds["metadata"]["labels"][COMPUTE_DOMAIN_LABEL_KEY] == cd.metadata.uid

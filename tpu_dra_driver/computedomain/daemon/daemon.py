@@ -27,6 +27,7 @@ import json
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -41,6 +42,7 @@ from tpu_dra_driver.kube.errors import NotFoundError
 from tpu_dra_driver.kube.informer import Informer
 from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
 from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind, TpuLib
 
@@ -100,6 +102,11 @@ class ComputeDomainDaemon:
         self._num_slices = 1
         self._last_worker_env: Optional[Dict[str, str]] = None
         self._on_fabric_error_cb = None
+        # The CD's trace context (traceparent annotation stamped by the
+        # controller), captured when the CD is first read: clique
+        # join/render spans from this process land in the same trace as
+        # the controller's cd.rendezvous span.
+        self._trace_ctx = None
         # Set on fatal fabric errors. The production entrypoint waits on
         # this and exits nonzero so Kubernetes restarts the pod — raising
         # from a health-callback thread could never kill the process.
@@ -109,8 +116,20 @@ class ComputeDomainDaemon:
 
     def start(self) -> None:
         self._label_pod()
+        t_join0 = time.monotonic()
         self.index = self.membership.join()
         self._num_slices = self._cd_num_slices()
+        # marker span: this daemon joined its clique (the trace context
+        # only becomes known with the CD read above, so the join is
+        # recorded retroactively with its measured duration)
+        join_span = tracing.start_span(
+            "daemon.join", parent=self._trace_ctx,
+            attributes={"node": self._config.node_name,
+                        "clique": self.clique_id,
+                        "index": self.index,
+                        "join_ms": round(
+                            (time.monotonic() - t_join0) * 1e3, 3)})
+        join_span.end()
         self._unsub_health = self._lib.subscribe_health(self._on_health)
         # name-filtered clique informer (reference controller.go:95-133);
         # a multislice CD watches all sibling cliques (the coordinator
@@ -196,25 +215,37 @@ class ComputeDomainDaemon:
         # concurrent runs would race on the (pid-named) tmp files and could
         # install a stale hosts block.
         with self._render_mu:
-            fi.fire("daemon.clique.render", payload=self._config.cd_uid)
-            cq = self.membership.get()
-            if cq is None:
-                return
-            mapping: Dict[int, str] = {d.index: d.ip_address for d in cq.daemons
-                                       if d.index >= 0 and d.ip_address}
-            changed = update_hosts_file(self._config.hosts_file, mapping)
-            self._write_worker_env(mapping)
-            if changed:
-                log.info("hosts mapping updated: %s",
-                         {worker_name(i): ip for i, ip in mapping.items()})
-            # readiness is not a one-way latch: report NotReady again when
-            # the check regresses (e.g. fabric error, peer inconsistency) so
-            # the controller stops releasing workloads onto this node
-            if self.check():
-                self.membership.set_ready()
-            else:
-                from tpu_dra_driver.api.types import STATUS_NOT_READY
-                self.membership.set_status(STATUS_NOT_READY)
+            span = tracing.start_span(
+                "daemon.clique_render", parent=self._trace_ctx,
+                attributes={"node": self._config.node_name,
+                            "clique": self.clique_id})
+            with tracing.use_span(span), span:
+                fi.fire("daemon.clique.render", payload=self._config.cd_uid)
+                cq = self.membership.get()
+                if cq is None:
+                    span.set_attribute("result", "clique-missing")
+                    return
+                mapping: Dict[int, str] = {d.index: d.ip_address
+                                           for d in cq.daemons
+                                           if d.index >= 0 and d.ip_address}
+                changed = update_hosts_file(self._config.hosts_file, mapping)
+                self._write_worker_env(mapping)
+                if changed:
+                    log.info("hosts mapping updated: %s",
+                             {worker_name(i): ip
+                              for i, ip in mapping.items()})
+                # readiness is not a one-way latch: report NotReady again
+                # when the check regresses (e.g. fabric error, peer
+                # inconsistency) so the controller stops releasing
+                # workloads onto this node
+                ready = self.check()
+                span.set_attribute("members", len(mapping))
+                span.set_attribute("ready", ready)
+                if ready:
+                    self.membership.set_ready()
+                else:
+                    from tpu_dra_driver.api.types import STATUS_NOT_READY
+                    self.membership.set_status(STATUS_NOT_READY)
 
     def _write_worker_env(self, mapping: Dict[int, str]) -> None:
         """Render the worker-identity snapshot (debugging + the CD plugin's
@@ -258,6 +289,7 @@ class ComputeDomainDaemon:
                 try:
                     obj = self._clients.compute_domains.get(
                         self._config.cd_name, self._config.cd_namespace)
+                    self._trace_ctx = tracing.from_object(obj)
                     return max(1, int((obj.get("spec") or {})
                                       .get("numSlices", 1)))
                 except NotFoundError:

@@ -51,6 +51,7 @@ from tpu_dra_driver.computedomain.plugin.devices import (
 from tpu_dra_driver.kube.client import ABORT, ClientSets
 from tpu_dra_driver.kube.errors import NotFoundError
 from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.flock import Flock, FlockOptions
 from tpu_dra_driver.plugin.checkpoint import (
     Checkpoint,
@@ -169,10 +170,13 @@ class CdDeviceState:
             cp.claims[claim.uid] = ClaimEntry(
                 claim_uid=claim.uid, claim_name=claim.name,
                 namespace=claim.namespace, state=PREPARE_STARTED)
-            self._cp_mgr.write(cp)
+            with tracing.span("cd.write_ahead"):
+                self._cp_mgr.write(cp)
             fi.fire("cd.prepare.after_write_ahead")
-            qualified = self._cdi.write_claim_spec(claim.uid, cdi_devices,
-                                                   extra_common=extra)
+            with tracing.span("cd.cdi_write",
+                              attributes={"claim": claim.canonical}):
+                qualified = self._cdi.write_claim_spec(
+                    claim.uid, cdi_devices, extra_common=extra)
             for dev, qname in zip(prepared, qualified):
                 dev.cdi_device_ids = [qname]
             cp.claims[claim.uid] = ClaimEntry(
@@ -180,7 +184,8 @@ class CdDeviceState:
                 namespace=claim.namespace, state=PREPARE_COMPLETED,
                 prepared_devices=prepared)
             fi.fire("cd.prepare.before_commit")
-            self._cp_mgr.write(cp)
+            with tracing.span("cd.commit"):
+                self._cp_mgr.write(cp)
             self._completed.add(claim.uid)
             return prepared
 

@@ -29,7 +29,13 @@ from tpu_dra_driver.computedomain.plugin.device_state import (
 from tpu_dra_driver.computedomain.plugin.devices import build_cd_resource_slice
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.kube.errors import AlreadyExistsError
+from tpu_dra_driver.kube.events import (
+    EventRecorder,
+    emit_claim_event,
+    normalize_claim_refs,
+)
 from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.workqueue import prep_unprep_rate_limiter
 from tpu_dra_driver.plugin.claims import ClaimInfo
 from tpu_dra_driver.plugin.device_state import PermanentError
@@ -88,6 +94,9 @@ class CdKubeletPlugin:
             hosts_file_dir=config.hosts_file_dir),
             cd_lister=self._cd_informer,
             clique_lister=self._clique_informer)
+        self._events = EventRecorder(
+            clients.events, component="compute-domain-kubelet-plugin",
+            host=config.node_name)
 
     def _notify_waiters(self) -> None:
         with self._waiters_mu:
@@ -141,7 +150,24 @@ class CdKubeletPlugin:
         out: Dict[str, PrepareResult] = {}
         for obj in claims:
             info = ClaimInfo.from_obj(obj, driver_name=COMPUTE_DOMAIN_DRIVER_NAME)
-            out[info.uid] = self._prepare_with_retry(info)
+            # cross-process trace pickup: the allocator's root span rides
+            # the claim annotation; the whole retry envelope (including
+            # the CD-ready rendezvous wait) nests under it
+            span = tracing.start_span(
+                "cd.prepare", parent=tracing.from_object(obj),
+                attributes={"claim": info.canonical,
+                            "node": self._config.node_name})
+            with tracing.use_span(span):
+                res = self._prepare_with_retry(info)
+            span.set_attribute("result",
+                               "ok" if res.error is None else "error")
+            span.end(status="ok" if res.error is None else "error")
+            emit_claim_event(
+                self._events, self._config.node_name,
+                {"uid": info.uid, "name": info.name,
+                 "namespace": info.namespace},
+                "released", error=res.error, permanent=res.permanent)
+            out[info.uid] = res
         return out
 
     def _prepare_with_retry(self, claim: ClaimInfo) -> PrepareResult:
@@ -172,6 +198,11 @@ class CdKubeletPlugin:
                           waiter: threading.Event) -> PrepareResult:
         deadline = time.monotonic() + self._config.prepare_budget
         attempt = 0
+        # Opened at the first transient failure; covers the whole
+        # rendezvous wait (retry events ride on it) and ends when the CD
+        # releases this node or the budget runs dry — the span that
+        # answers "how long did THIS claim wait for CD-ready, and why".
+        wait_span = None
         while True:
             attempt += 1
             # Arm before reading cluster state: an event landing during
@@ -187,17 +218,31 @@ class CdKubeletPlugin:
                 if not self.state.likely_completed(claim.uid):
                     self.state.precheck(claim)
                 devices = self.state.prepare(claim)
+                if wait_span is not None:
+                    wait_span.set_attribute("attempts", attempt)
+                    wait_span.end()
                 if attempt > 1:
                     log.info("prepare %s succeeded on attempt %d",
                              claim.canonical, attempt)
                 return PrepareResult(devices=devices)
             except PermanentError as e:
+                if wait_span is not None:
+                    wait_span.end(status="error")
                 log.error("prepare %s failed permanently: %s", claim.canonical, e)
                 return PrepareResult(error=str(e), permanent=True)
             except RetryableError as e:
+                if wait_span is None:
+                    wait_span = tracing.start_span(
+                        "cd.await_ready", parent=tracing.current_span(),
+                        attributes={"claim": claim.canonical,
+                                    "node": self._config.node_name})
+                wait_span.add_event("retry", attempt=attempt,
+                                    reason=str(e)[:200])
                 delay = limiter.when(claim.uid)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    wait_span.set_attribute("attempts", attempt)
+                    wait_span.end(status="error")
                     log.warning("prepare %s: retry budget exhausted after "
                                 "%d attempts: %s", claim.canonical, attempt, e)
                     return PrepareResult(error=str(e), permanent=False)
@@ -216,16 +261,23 @@ class CdKubeletPlugin:
                     # of once per event.
                     _PAUSE.wait(timeout=0.003)
             except Exception as e:  # chaos-ok: surfaced to kubelet, retried
+                if wait_span is not None:
+                    wait_span.end(status="error")
                 log.exception("prepare %s failed", claim.canonical)
                 return PrepareResult(error=str(e), permanent=False)
 
-    def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[str]]:
+    def unprepare_resource_claims(self, claim_refs: List) -> Dict[str, Optional[str]]:
+        """``claim_refs`` entries are bare uid strings or
+        ``{"uid", "name", "namespace"}`` dicts (the gRPC layer passes
+        full kubelet refs so Events can name the claim)."""
         out: Dict[str, Optional[str]] = {}
-        for uid in claim_uids:
+        for uid, ref in normalize_claim_refs(claim_refs).items():
             try:
                 self.state.unprepare(uid)
                 out[uid] = None
             except Exception as e:  # chaos-ok: surfaced to kubelet, retried
                 log.exception("unprepare %s failed", uid)
                 out[uid] = str(e)
+            emit_claim_event(self._events, self._config.node_name, ref,
+                             "unprepared", error=out[uid])
         return out

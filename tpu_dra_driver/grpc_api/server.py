@@ -161,8 +161,11 @@ def _dra_handlers(plugin, claims_client: ResourceClient,
     def node_unprepare(request, context):
         fi.fire("grpc.node_unprepare")
         response = dra_pb.NodeUnprepareResourcesResponse()
+        # full refs (not bare uids) so the plugin can emit Unprepared
+        # Events against the named claim
         results = plugin.unprepare_resource_claims(
-            [ref.uid for ref in request.claims])
+            [{"uid": ref.uid, "name": ref.name, "namespace": ref.namespace}
+             for ref in request.claims])
         for uid, err in results.items():
             if err is not None:
                 response.claims[uid].error = err

@@ -56,7 +56,7 @@ from __future__ import annotations
 import copy
 import time
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set
 
 from tpu_dra_driver.kube import catalog as catalog_mod
 from tpu_dra_driver.kube.catalog import (
@@ -71,7 +71,14 @@ from tpu_dra_driver.kube.catalog import (
 )
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.kube.errors import ConflictError, NotFoundError
+from tpu_dra_driver.kube.events import (
+    REASON_ALLOCATED,
+    REASON_ALLOCATION_FAILED,
+    EventRecorder,
+    object_ref,
+)
 from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.metrics import (
     ALLOCATION_SECONDS,
     ALLOCATOR_CANDIDATES_SCANNED,
@@ -206,6 +213,10 @@ class AllocationResult:
 
     claim: Optional[Dict] = None        # the updated (allocated) claim
     error: Optional[str] = None
+    #: True iff THIS allocator wrote the allocation (False for
+    #: already-allocated pass-throughs and lost commit races, whose
+    #: allocation belongs to someone else — no Allocated event then)
+    committed: bool = False
 
 
 class _BatchState:
@@ -242,6 +253,11 @@ class Allocator:
         self._ledger = ledger
         self._use_index = use_index
         self._index_attributes = tuple(index_attributes)
+        # Allocated/AllocationFailed land on the claim so `kubectl
+        # describe resourceclaim` finally shows the scheduler role's
+        # verdict (deduped + rate-limited; see kube/events.py)
+        self._recorder = EventRecorder(clients.events,
+                                       component="allocation-controller")
 
     # ------------------------------------------------------------------
     # snapshots
@@ -304,17 +320,54 @@ class Allocator:
         state = self._usage_snapshot(snap)
         out: Dict[str, AllocationResult] = {}
         for claim in claims:
-            uid = claim["metadata"]["uid"]
+            meta = claim["metadata"]
+            uid = meta["uid"]
+            # The ROOT span of the claim's lifecycle trace: its context is
+            # stamped onto the committed claim as the traceparent
+            # annotation, so the kubelet plugin (a different process)
+            # attaches its prepare spans to the same trace.
+            root = tracing.start_span(
+                "allocator.allocate",
+                parent=tracing.from_object(claim),
+                attributes={
+                    "claim": f"{meta.get('namespace', '')}/"
+                             f"{meta.get('name', '')}",
+                    "claim_uid": uid, "driver": self._driver})
             t0 = time.perf_counter()
-            try:
-                out[uid] = AllocationResult(
-                    claim=self._allocate_one(claim, snap, state, node_name))
-            except AllocationError as e:
-                out[uid] = AllocationResult(error=str(e))
-            except Exception as e:  # chaos-ok: per-claim isolation, surfaced in the result
-                out[uid] = AllocationResult(
-                    error=f"{type(e).__name__}: {e}")
-            ALLOCATION_SECONDS.observe(time.perf_counter() - t0)
+            with tracing.use_span(root):
+                try:
+                    updated, committed = self._allocate_one(
+                        claim, snap, state, node_name)
+                    out[uid] = AllocationResult(claim=updated,
+                                                committed=committed)
+                except AllocationError as e:
+                    out[uid] = AllocationResult(error=str(e))
+                except Exception as e:  # chaos-ok: per-claim isolation, surfaced in the result
+                    out[uid] = AllocationResult(
+                        error=f"{type(e).__name__}: {e}")
+            res = out[uid]
+            ALLOCATION_SECONDS.observe(time.perf_counter() - t0,
+                                       exemplar=tracing.exemplar(root))
+            root.set_attribute("result",
+                               "ok" if res.error is None else "error")
+            root.end(status="ok" if res.error is None else "error")
+            # explicit kind: claims from an informer LIST carry no
+            # per-item "kind", and an empty involvedObject.kind would
+            # hide the Event from kubectl describe's field selector
+            claim_ref = object_ref("ResourceClaim", meta.get("name", ""),
+                                   meta.get("namespace", ""), uid)
+            if res.error is not None:
+                self._recorder.warning(claim_ref, REASON_ALLOCATION_FAILED,
+                                       res.error)
+            elif res.committed:
+                # only the allocator that actually WROTE the allocation
+                # announces it — a lost commit race belongs to the winner
+                n_devices = len((((res.claim.get("status") or {})
+                                  .get("allocation") or {})
+                                 .get("devices") or {}).get("results") or [])
+                self._recorder.normal(
+                    claim_ref, REASON_ALLOCATED,
+                    f"allocated {n_devices} device(s) from {self._driver}")
         return out
 
     # ------------------------------------------------------------------
@@ -323,19 +376,28 @@ class Allocator:
 
     def _allocate_one(self, claim: Dict, snap: CatalogSnapshot,
                       state: _BatchState,
-                      node_name: Optional[str]) -> Dict:
+                      node_name: Optional[str]):
+        """Returns ``(claim, committed)`` — committed False when the
+        claim was already allocated or a concurrent allocator won the
+        commit race (the allocation is not ours to announce)."""
         if (claim.get("status") or {}).get("allocation"):
-            return claim  # already allocated
+            return claim, False  # already allocated
         if not snap.has_driver(self._driver):
             raise AllocationError(
                 f"no ResourceSlices published by {self._driver}")
 
         uid = claim["metadata"]["uid"]
+        # the claim's ROOT context (allocate_batch installed the root
+        # span as current): captured here, BEFORE child phase spans are
+        # opened, so the cross-process annotation parents downstream
+        # spans on the root — not on a short-lived commit child
+        trace_root = tracing.current_context()
         results: List[Dict] = []
         picked_entries: List[DeviceEntry] = []
         try:
-            self._pick_requests(claim, snap, state, node_name, results,
-                                picked_entries)
+            with tracing.span("allocator.pick"):
+                self._pick_requests(claim, snap, state, node_name, results,
+                                    picked_entries)
         except Exception:
             # ANY mid-claim failure (unsatisfiable request, selector
             # compile/eval error, malformed counter value) must release
@@ -354,14 +416,16 @@ class Allocator:
                     "allocation raced a concurrent claim; devices no "
                     "longer free")
         try:
-            updated = self._commit(claim, results)
+            with tracing.span("allocator.commit"):
+                updated, committed = self._commit(claim, results,
+                                                  trace_ctx=trace_root)
         except Exception:
             self._unwind(picked_entries, state)
             if self._ledger is not None:
                 self._ledger.release(uid)
             raise
         self._reconcile_batch_state(updated, snap, state, picked_entries)
-        return updated
+        return updated, committed
 
     def _pick_requests(self, claim: Dict, snap: CatalogSnapshot,
                        state: _BatchState, node_name: Optional[str],
@@ -471,16 +535,26 @@ class Allocator:
             "nodeSelector": {"kubernetes.io/hostname": node} if node else None,
         }
 
-    def _commit(self, claim: Dict, results: List[Dict]) -> Dict:
+    def _commit(self, claim: Dict, results: List[Dict],
+                trace_ctx=None):
         """Write status.allocation with the claim's resourceVersion as
         the optimistic-concurrency guard. On conflict: re-read; if a
         concurrent writer already allocated the claim, theirs wins; else
-        verify our devices are still free and retry exactly once."""
+        verify our devices are still free and retry exactly once.
+        Returns ``(updated, committed)`` — committed False when the
+        concurrent winner's allocation was adopted instead of ours."""
         name = claim["metadata"]["name"]
         namespace = claim["metadata"].get("namespace", "")
         obj = copy.deepcopy(claim)
         obj.setdefault("status", {})["allocation"] = \
             self._build_allocation(claim, results)
+        # Propagate the claim's trace across the process boundary: the
+        # kubelet plugin parses this annotation in NodePrepareResources
+        # and parents its spans on the allocation ROOT span (the context
+        # captured before the phase child spans opened). Stamped only
+        # while a span is actually recording — tracing disabled leaves
+        # the object byte-identical to before.
+        tracing.annotate(obj, trace_ctx)
         try:
             fi.fire("allocator.commit-conflict")
             updated = self._clients.resource_claims.update(obj)
@@ -497,13 +571,14 @@ class Allocator:
                 if self._ledger is not None:
                     self._ledger.release(claim["metadata"]["uid"])
                     self._ledger.observe_claim(fresh)
-                return fresh
+                return fresh, False
             if not self._devices_still_free(fresh, results):
                 raise AllocationError(
                     "commit conflict: picked devices were allocated "
                     "concurrently")
             fresh.setdefault("status", {})["allocation"] = \
                 self._build_allocation(fresh, results)
+            tracing.annotate(fresh, trace_ctx)
             try:
                 fi.fire("allocator.commit-conflict")
                 updated = self._clients.resource_claims.update(fresh)
@@ -514,7 +589,7 @@ class Allocator:
         if self._ledger is not None:
             # the reservation graduates into the claim's ledger entry
             self._ledger.observe_claim(updated)
-        return updated
+        return updated, True
 
     def _devices_still_free(self, fresh_claim: Dict,
                             results: List[Dict]) -> bool:

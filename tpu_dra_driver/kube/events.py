@@ -1,0 +1,328 @@
+"""Kubernetes Event recorder: async, deduped, rate-limited, never-raising.
+
+Reference analog: client-go's ``record.EventRecorder`` (an async
+broadcaster) + the aggregation/spam-filter ``EventCorrelator``. The
+reference driver never emits Events — a stuck claim shows nothing under
+``kubectl describe resourceclaim``; this recorder closes that gap for
+both driver names.
+
+Semantics modeled on client-go where the driver depends on them:
+
+- **Async emission**: :meth:`EventRecorder.event` only enqueues; a
+  background worker performs the API writes. The prepare/allocate hot
+  paths never block on the API server for an advisory Event (a slow
+  apiserver must not push NodePrepareResources past kubelet's call
+  timeout). Queue overflow drops (counted), bounded memory. Tests call
+  :meth:`flush`.
+- **Dedupe/aggregate**: a repeat of the same (object uid, reason,
+  message, type) within ``dedupe_window`` bumps ``count`` +
+  ``lastTimestamp`` (RFC3339 — a real API server rejects numeric
+  metav1.Time) on the existing Event object instead of creating a new
+  one (the correlator's aggregation).
+- **Rate limit**: a token bucket PER INVOLVED OBJECT (burst 25, refill
+  0.25/s — client-go's EventSourceObjectSpamFilter is keyed per
+  source+object the same way), so one crash-looping claim cannot starve
+  every other object's events. Over-budget emissions are *dropped*,
+  counted in ``dra_events_emitted_total{outcome="dropped"}``.
+- **Never raise**: event emission is advisory; an API failure is
+  counted (``outcome="error"``) and logged at debug, never propagated
+  into the reconcile/prepare path that emitted it.
+
+Backed by any :class:`~tpu_dra_driver.kube.client.ResourceClient` over
+the ``events`` core resource — the in-memory FakeCluster and the REST
+cluster both serve it, so the recorder works identically in unit tests,
+the sim e2e harness, and a real cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict
+
+from tpu_dra_driver.kube.client import ResourceClient
+from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.pkg import metrics as _metrics
+
+log = logging.getLogger(__name__)
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+# Event reasons emitted by the driver (the catalog documented in
+# docs/observability.md; tests pin the load-bearing ones).
+REASON_ALLOCATED = "Allocated"
+REASON_ALLOCATION_FAILED = "AllocationFailed"
+REASON_PREPARED = "Prepared"
+REASON_PREPARE_FAILED = "PrepareFailed"
+REASON_UNPREPARED = "Unprepared"
+REASON_UNPREPARE_FAILED = "UnprepareFailed"
+REASON_CD_READY = "CDReady"
+REASON_VALIDATION_FAILED = "ValidationFailed"
+
+#: Worker threads exit after this long idle and respawn on demand, so
+#: short-lived recorders (benches, tests) don't accumulate parked threads.
+_WORKER_IDLE_EXIT = 30.0
+
+
+def _rfc3339(ts: float) -> str:
+    """metav1.Time wire form — a real API server rejects numeric
+    timestamps (400, cannot unmarshal number into v1.Time), and the
+    recorder's never-raise contract would swallow that into silence.
+    Seconds precision, UTC, lexicographically ordered."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def object_ref(kind: str, name: str, namespace: str = "",
+               uid: str = "") -> Dict[str, str]:
+    ref = {"kind": kind, "name": name}
+    if namespace:
+        ref["namespace"] = namespace
+    if uid:
+        ref["uid"] = uid
+    return ref
+
+
+def ref_from_obj(obj: Dict, kind: str = "") -> Dict[str, str]:
+    """involvedObject ref from a full k8s object dict."""
+    meta = obj.get("metadata") or {}
+    return object_ref(kind or obj.get("kind", ""), meta.get("name", ""),
+                      meta.get("namespace", ""), meta.get("uid", ""))
+
+
+def normalize_claim_refs(claim_refs) -> Dict[str, Dict[str, str]]:
+    """uid → ``{"uid", "name", "namespace"}`` from the two shapes the
+    plugin unprepare APIs accept: bare uid strings (unit tests, older
+    callers) or full ref dicts (the gRPC layer, which has kubelet's
+    name/namespace and passes them so Events can name the claim)."""
+    out: Dict[str, Dict[str, str]] = {}
+    for r in claim_refs:
+        if isinstance(r, dict):
+            out[r["uid"]] = {"uid": r["uid"], "name": r.get("name", ""),
+                             "namespace": r.get("namespace", "")}
+        else:
+            out[r] = {"uid": r, "name": "", "namespace": ""}
+    return out
+
+
+def emit_claim_event(recorder: "EventRecorder", node_name: str,
+                     ref: Dict[str, str], action: str,
+                     error=None, permanent: bool = False) -> None:
+    """The one claim-lifecycle Event shape both kubelet plugins emit.
+    ``action``: "prepared" | "released" (the CD plugin's spelling) |
+    "unprepared". Nameless refs (bare-uid callers) have nothing for
+    kubectl describe to find — skipped."""
+    name = ref.get("name", "")
+    if not name:
+        return
+    obj = object_ref("ResourceClaim", name, ref.get("namespace", ""),
+                     ref.get("uid", ""))
+    if action == "unprepared":
+        if error is None:
+            recorder.normal(obj, REASON_UNPREPARED,
+                            f"unprepared on node {node_name}")
+        else:
+            recorder.warning(obj, REASON_UNPREPARE_FAILED,
+                             f"unprepare failed on node {node_name}: "
+                             f"{error}")
+        return
+    if error is None:
+        recorder.normal(obj, REASON_PREPARED,
+                        f"{action} on node {node_name}")
+    else:
+        recorder.warning(obj, REASON_PREPARE_FAILED,
+                         f"prepare {'permanently ' if permanent else ''}"
+                         f"failed on node {node_name}: {error}")
+
+
+class EventRecorder:
+    def __init__(self, events: ResourceClient,
+                 component: str = "tpu-dra-driver",
+                 host: str = "",
+                 dedupe_window: float = 600.0,
+                 burst: int = 25,
+                 refill_per_sec: float = 0.25,
+                 cache_max: int = 512,
+                 queue_max: int = 512):
+        self._events = events
+        self._component = component
+        self._host = host
+        self._window = dedupe_window
+        self._mu = threading.Lock()
+        # dedupe key -> {"name": event object name, "namespace": ns,
+        #                "count": n, "last": monotonic ts}
+        self._cache: "OrderedDict[tuple, Dict]" = OrderedDict()
+        self._cache_max = cache_max
+        # PER-OBJECT token buckets (client-go spam-filter keying): one
+        # noisy object exhausts only its own budget. LRU-bounded.
+        self._burst = float(burst)
+        self._refill = refill_per_sec
+        self._buckets: "OrderedDict[str, list]" = OrderedDict()
+        # async emission: event() enqueues, one lazy worker drains
+        self._qcond = threading.Condition()
+        self._queue: deque = deque()
+        self._queue_max = queue_max
+        self._inflight = 0
+        self._worker = None
+
+    # ------------------------------------------------------------------
+    # enqueue side (the hot path: no API IO, no lock beyond the queue)
+    # ------------------------------------------------------------------
+
+    def event(self, involved: Dict, type_: str, reason: str,
+              message: str) -> None:
+        """Queue one Event against ``involved`` (a full object dict or an
+        involvedObject-shaped ref) for async emission. Never raises, never
+        blocks on the API server."""
+        try:
+            ref = (ref_from_obj(involved) if "metadata" in involved
+                   else dict(involved))
+        except Exception:  # chaos-ok: events are advisory, counted
+            _metrics.EVENTS_EMITTED.labels(reason, "error").inc()
+            return
+        with self._qcond:
+            if len(self._queue) >= self._queue_max:
+                _metrics.EVENTS_EMITTED.labels(reason, "dropped").inc()
+                return
+            self._queue.append((ref, type_, reason, message))
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, daemon=True,
+                    name=f"event-recorder-{self._component}")
+                self._worker.start()
+            self._qcond.notify_all()
+
+    def normal(self, involved: Dict, reason: str, message: str) -> None:
+        self.event(involved, NORMAL, reason, message)
+
+    def warning(self, involved: Dict, reason: str, message: str) -> None:
+        self.event(involved, WARNING, reason, message)
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every queued event is emitted (tests and orderly
+        shutdown); True when the queue fully drained in time."""
+        deadline = time.monotonic() + timeout
+        with self._qcond:
+            while self._queue or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._qcond.wait(timeout=min(left, 0.05))
+            return True
+
+    # ------------------------------------------------------------------
+    # worker side
+    # ------------------------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            with self._qcond:
+                if not self._queue:
+                    self._qcond.wait(timeout=_WORKER_IDLE_EXIT)
+                    if not self._queue:
+                        self._worker = None   # idle: exit, respawn on demand
+                        return
+                item = self._queue.popleft()
+                self._inflight += 1
+            try:
+                self._emit(*item)
+            except Exception:  # chaos-ok: events are advisory, counted
+                _metrics.EVENTS_EMITTED.labels(item[2], "error").inc()
+                log.debug("event %s emission failed", item[2], exc_info=True)
+            finally:
+                with self._qcond:
+                    self._inflight -= 1
+                    self._qcond.notify_all()
+
+    def _take_token(self, obj_key: str) -> bool:
+        """One token from ``obj_key``'s bucket (created full on first
+        use; LRU-bounded alongside the dedupe cache)."""
+        now = time.monotonic()
+        with self._mu:
+            bucket = self._buckets.get(obj_key)
+            if bucket is None:
+                bucket = [self._burst, now]
+                self._buckets[obj_key] = bucket
+            tokens, last = bucket
+            tokens = min(self._burst, tokens + (now - last) * self._refill)
+            if tokens < 1.0:
+                bucket[0], bucket[1] = tokens, now
+                return False
+            bucket[0], bucket[1] = tokens - 1.0, now
+            self._buckets.move_to_end(obj_key)
+            while len(self._buckets) > self._cache_max:
+                self._buckets.popitem(last=False)
+            return True
+
+    def _emit(self, ref: Dict, type_: str, reason: str,
+              message: str) -> None:
+        namespace = ref.get("namespace") or "default"
+        obj_key = ref.get("uid") or f"{namespace}/{ref.get('name', '')}"
+        key = (obj_key, ref.get("kind", ""), type_, reason, message)
+        now = time.monotonic()
+        with self._mu:
+            cached = self._cache.get(key)
+            dedupe_target = (dict(cached) if cached is not None
+                             and now - cached["last"] <= self._window
+                             else None)
+        if not self._take_token(obj_key):
+            _metrics.EVENTS_EMITTED.labels(reason, "dropped").inc()
+            return
+
+        if dedupe_target is not None:
+            if self._bump(dedupe_target, key, now):
+                return
+            # the aggregated Event object is gone (GC'd): recreate below
+
+        wall = _rfc3339(time.time())
+        obj = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"generateName": f"{ref.get('name') or 'object'}.",
+                         "namespace": namespace},
+            "type": type_,
+            "reason": reason,
+            "message": message,
+            "count": 1,
+            "firstTimestamp": wall,
+            "lastTimestamp": wall,
+            "involvedObject": ref,
+            "source": {"component": self._component,
+                       **({"host": self._host} if self._host else {})},
+            "reportingComponent": self._component,
+            "reportingInstance": self._host or self._component,
+        }
+        created = self._events.create(obj)
+        with self._mu:
+            self._cache[key] = {
+                "name": created["metadata"]["name"],
+                "namespace": namespace, "count": 1, "last": now,
+            }
+            self._cache.move_to_end(key)
+            while len(self._cache) > self._cache_max:
+                self._cache.popitem(last=False)
+        _metrics.EVENTS_EMITTED.labels(reason, "created").inc()
+
+    def _bump(self, cached: Dict, key: tuple, now: float) -> bool:
+        """Aggregate a repeat onto the existing Event object; False when
+        that object no longer exists."""
+        def mutate(obj):
+            obj["count"] = int(obj.get("count") or 1) + 1
+            obj["lastTimestamp"] = _rfc3339(time.time())
+        try:
+            self._events.retry_update(cached["name"], cached["namespace"],
+                                      mutate)
+        except NotFoundError:
+            with self._mu:
+                self._cache.pop(key, None)
+            return False
+        with self._mu:
+            entry = self._cache.get(key)
+            if entry is not None:
+                entry["count"] += 1
+                entry["last"] = now
+                self._cache.move_to_end(key)
+        _metrics.EVENTS_EMITTED.labels(key[3], "deduped").inc()
+        return True

@@ -215,6 +215,7 @@ def _fire_slow(name: str, payload):
                 due.append(rule)
     for rule in due:
         _count_fired(name, rule.mode)
+        _record_span_event(name, rule.mode)
         log.warning("fault point %s FIRED (%s, fire #%d)",
                     name, rule.mode, rule.fires)
         if rule.mode == "latency":
@@ -238,6 +239,14 @@ def _count_fired(name: str, mode: str) -> None:
     # disabled path import-free keeps fire() allocation-free too
     from tpu_dra_driver.pkg import metrics as _metrics
     _metrics.FAULT_INJECTIONS.labels(name, mode).inc()
+
+
+def _record_span_event(name: str, mode: str) -> None:
+    """A firing inside a traced claim shows up as a span event, so the
+    flight recorder answers 'was that slow prepare a drill?'. No-op (one
+    bool check inside tracing) when tracing is off."""
+    from tpu_dra_driver.pkg import tracing as _tracing
+    _tracing.add_event("fault.injected", point=name, mode=mode)
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,21 @@ def add_common_flags(parser: EnvArgumentParser) -> None:
                         help="comma-separated Gate=true|false overrides")
     parser.add_argument("-v", "--verbosity", env="LOG_VERBOSITY", type=int,
                         default=4, help="log verbosity (klog-style 0-7)")
+    parser.add_argument("--log-format", env="LOG_FORMAT", default="text",
+                        choices=["text", "json"],
+                        help="text = klog-style one-liners; json = one "
+                             "JSON object per line with trace/claim/node "
+                             "correlation fields (pkg/logging.py)")
+    parser.add_argument("--trace-mode", env="TRACE_MODE", default="disabled",
+                        choices=["disabled", "sampled", "always"],
+                        help="claim-lifecycle tracing (pkg/tracing.py): "
+                             "spans land in the in-process flight "
+                             "recorder served at /debug/traces; disabled "
+                             "costs one bool check per span site")
+    parser.add_argument("--trace-sample-ratio", env="TRACE_SAMPLE_RATIO",
+                        type=float, default=0.01,
+                        help="root-span sampling probability for "
+                             "--trace-mode=sampled")
     parser.add_argument("--kube-api-qps", env="KUBE_API_QPS", type=float,
                         default=50.0)
     parser.add_argument("--kubeconfig", env="KUBECONFIG", default="",
@@ -56,13 +71,26 @@ def parse_gates(args: argparse.Namespace) -> FeatureGates:
     return from_env_spec(getattr(args, "feature_gates", "") or None)
 
 
-def setup_logging(verbosity: int) -> None:
-    import logging
-    level = (logging.DEBUG if verbosity >= 6
-             else logging.INFO if verbosity >= 2 else logging.WARNING)
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s %(levelname).1s %(name)s] %(message)s")
+def setup_logging(verbosity: int, log_format: str = "text",
+                  component: str = "", node: str = "") -> None:
+    from tpu_dra_driver.pkg import logging as dralog
+    dralog.setup(verbosity, log_format=log_format, component=component,
+                 node=node)
+
+
+def setup_observability(args: argparse.Namespace, component: str) -> None:
+    """The one call every cmd/* entrypoint makes after parsing flags:
+    structured logging (--log-format/-v) + claim-lifecycle tracing
+    (--trace-mode/--trace-sample-ratio), both wired to the common flag
+    set from :func:`add_common_flags`."""
+    setup_logging(getattr(args, "verbosity", 4),
+                  getattr(args, "log_format", "text"),
+                  component=component,
+                  node=getattr(args, "node_name", ""))
+    from tpu_dra_driver.pkg import tracing
+    tracing.configure(getattr(args, "trace_mode", "disabled"),
+                      sample_ratio=getattr(args, "trace_sample_ratio", 0.01),
+                      service=component)
 
 
 def config_dict(args: argparse.Namespace) -> Dict[str, Any]:

@@ -1,0 +1,118 @@
+"""Structured, correlated logging for every driver binary.
+
+Reference analog: klog's ``-v`` verbosity plus the JSON logging format
+of component-base (``--logging-format=json``). All five ``cmd/*``
+entrypoints route through :func:`setup` (via
+``pkg/flags.setup_logging``), so one ``--log-format {text,json}`` flag
+switches the whole process.
+
+JSON records carry correlation fields so one ``jq`` filter follows one
+claim across binaries:
+
+- static process identity (``component``, ``node``) set once at startup;
+- per-scope fields (``claim``, ``claim_uid``, ``cd``) pushed with
+  :func:`fields` around a unit of work (contextvar-scoped, so concurrent
+  gRPC handler threads never bleed into each other);
+- ``trace_id``/``span_id`` of the current tracing span
+  (:mod:`tpu_dra_driver.pkg.tracing`) whenever a span is active — the
+  log line and the flight-recorder trace share a key.
+
+Text mode keeps the historical klog-ish one-liner format unchanged.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging as _logging
+import sys
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+TEXT_FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
+
+#: process-wide identity merged into every JSON record
+_STATIC: Dict[str, str] = {}
+
+_FIELDS: contextvars.ContextVar = contextvars.ContextVar(
+    "tpu_dra_log_fields", default=None)
+
+
+class JsonFormatter(_logging.Formatter):
+    """One JSON object per line: ts/level/logger/msg + correlation."""
+
+    def format(self, record: _logging.LogRecord) -> str:
+        out: Dict[str, object] = {
+            "ts": round(record.created, 3),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                  time.gmtime(record.created))
+                    + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        out.update(_STATIC)
+        scoped = _FIELDS.get()
+        if scoped:
+            out.update(scoped)
+        from tpu_dra_driver.pkg import tracing
+        span = tracing.current_span()
+        if span is not None:
+            out["trace_id"] = span.context.trace_id
+            out["span_id"] = span.context.span_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        try:
+            return json.dumps(out, default=str)
+        except (TypeError, ValueError):  # unserializable arg: degrade, never drop
+            out["msg"] = repr(out.get("msg"))
+            return json.dumps({k: str(v) for k, v in out.items()})
+
+
+def level_for(verbosity: int) -> int:
+    """klog-style ``-v`` 0-7 → stdlib level (same mapping the repo has
+    always used)."""
+    if verbosity >= 6:
+        return _logging.DEBUG
+    if verbosity >= 2:
+        return _logging.INFO
+    return _logging.WARNING
+
+
+def setup(verbosity: int, log_format: str = "text", component: str = "",
+          node: str = "") -> None:
+    """(Re)configure the root logger. ``log_format``: text | json."""
+    if log_format not in ("text", "json"):
+        raise SystemExit(
+            f"--log-format: expected text or json, got {log_format!r}")
+    if component:
+        _STATIC["component"] = component
+    if node:
+        _STATIC["node"] = node
+    handler = _logging.StreamHandler(sys.stderr)
+    if log_format == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(_logging.Formatter(TEXT_FORMAT))
+    root = _logging.getLogger()
+    root.setLevel(level_for(verbosity))
+    root.handlers[:] = [handler]
+
+
+def set_static(**kw: str) -> None:
+    """Merge process-identity fields (e.g. node name learned after flag
+    parsing) into every subsequent JSON record."""
+    _STATIC.update({k: v for k, v in kw.items() if v})
+
+
+@contextmanager
+def fields(**kw):
+    """Scope correlation fields (claim, cd, ...) over a unit of work;
+    contextvar-backed so concurrent handler threads stay isolated."""
+    current = _FIELDS.get() or {}
+    token = _FIELDS.set({**current, **kw})
+    try:
+        yield
+    finally:
+        _FIELDS.reset(token)

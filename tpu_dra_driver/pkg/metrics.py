@@ -16,6 +16,7 @@ payload as the SIGUSR2 handler in :mod:`tpu_dra_driver.common.debug`).
 
 from __future__ import annotations
 
+import json
 import sys
 import threading
 import time
@@ -53,6 +54,18 @@ def _format_value(v: float) -> str:
     return repr(v)
 
 
+def _format_exemplar(ex: Optional[Tuple[Dict[str, str], float, float]]) -> str:
+    """OpenMetrics exemplar suffix: `` # {trace_id="..."} value ts``.
+    Empty string when the bucket has none — plain Prometheus scrapers
+    that split on ``#`` still parse the sample unchanged."""
+    if not ex:
+        return ""
+    labels, value, ts = ex
+    inner = ",".join(f'{k}="{_escape(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return f" # {{{inner}}} {_format_value(value)} {round(ts, 3)}"
+
+
 class _Metric:
     """Base: a named family with fixed label names and per-labelset children."""
 
@@ -86,7 +99,7 @@ class _Metric:
             items = list(self._children.items())
         return items
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         raise NotImplementedError
 
 
@@ -129,7 +142,7 @@ class Counter(_Metric):
     def value(self) -> float:
         return self._children[()].value
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         for key, child in self._iter_children():
@@ -192,7 +205,7 @@ class Gauge(_Metric):
     def value(self) -> float:
         return self._self_child().value
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         for key, child in self._iter_children():
@@ -202,7 +215,8 @@ class Gauge(_Metric):
 
 
 class _HistogramChild:
-    __slots__ = ("_buckets", "_counts", "_sum", "_count", "_mu")
+    __slots__ = ("_buckets", "_counts", "_sum", "_count", "_mu",
+                 "_exemplars")
 
     def __init__(self, buckets: Sequence[float]):
         self._buckets = buckets
@@ -210,19 +224,32 @@ class _HistogramChild:
         self._sum = 0.0
         self._count = 0
         self._mu = threading.Lock()
+        # bucket index (len(_buckets) = +Inf) -> (labels, value, unix ts):
+        # the LAST exemplar per bucket, OpenMetrics semantics — a latency
+        # bucket links back to one concrete trace (pkg/tracing.py)
+        self._exemplars: Dict[int, Tuple[Dict[str, str], float, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         with self._mu:
             self._sum += v
             self._count += 1
+            idx = len(self._buckets)
             for i, bound in enumerate(self._buckets):
                 if v <= bound:
                     self._counts[i] += 1
+                    idx = i
                     break
+            if exemplar:
+                self._exemplars[idx] = (dict(exemplar), v, time.time())
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._mu:
             return list(self._counts), self._sum, self._count
+
+    def exemplars(self) -> Dict[int, Tuple[Dict[str, str], float, float]]:
+        with self._mu:
+            return dict(self._exemplars)
 
 
 class Histogram(_Metric):
@@ -238,10 +265,11 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistogramChild(self._buckets)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         if self.label_names:
             raise ValueError(f"{self.name} has labels; use .labels(...)")
-        self._children[()].observe(v)
+        self._children[()].observe(v, exemplar=exemplar)
 
     def _self_child(self) -> _HistogramChild:
         if self.label_names:
@@ -265,19 +293,27 @@ class Histogram(_Metric):
         """Context manager observing the elapsed wall time in seconds."""
         return _Timer(self)
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         for key, child in self._iter_children():
             counts, total, count = child.snapshot()
+            # Exemplar suffixes are OpenMetrics syntax; the classic
+            # text-format 0.0.4 parser reads tokens after the value as a
+            # timestamp and would fail the WHOLE scrape. They are
+            # therefore rendered only on request (the /metrics?exemplars=1
+            # / Accept: openmetrics path), never on a default scrape.
+            ex = child.exemplars() if exemplars else {}
             cumulative = 0
-            for bound, c in zip(self._buckets, counts):
+            for i, (bound, c) in enumerate(zip(self._buckets, counts)):
                 cumulative += c
                 le = _format_labels(self.label_names, key,
                                     extra=[("le", _format_value(bound))])
-                lines.append(f"{self.name}_bucket{le} {cumulative}")
+                lines.append(f"{self.name}_bucket{le} {cumulative}"
+                             f"{_format_exemplar(ex.get(i))}")
             le = _format_labels(self.label_names, key, extra=[("le", "+Inf")])
-            lines.append(f"{self.name}_bucket{le} {count}")
+            lines.append(f"{self.name}_bucket{le} {count}"
+                         f"{_format_exemplar(ex.get(len(self._buckets)))}")
             plain = _format_labels(self.label_names, key)
             lines.append(f"{self.name}_sum{plain} {repr(total)}")
             lines.append(f"{self.name}_count{plain} {count}")
@@ -330,12 +366,12 @@ class Registry:
                   buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
         return self._register(Histogram(name, help_text, label_names, buckets))  # type: ignore[return-value]
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         with self._mu:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         out: List[str] = []
         for m in metrics:
-            out.extend(m.render())
+            out.extend(m.render(exemplars=exemplars))
         return "\n".join(out) + "\n"
 
 
@@ -459,6 +495,26 @@ RESOURCESLICE_PUBLISHES_SKIPPED = DEFAULT_REGISTRY.counter(
     "already identical (churn-free republish)")
 
 
+# ---------------------------------------------------------------------------
+# Observability instrumentation (claim-lifecycle tracing + Kubernetes
+# Events): the flight recorder counts every span it retains, and the
+# Event recorder (kube/events.py) accounts for every emission outcome so
+# dropped/deduplicated events stay visible even though they never reach
+# the API server.
+# ---------------------------------------------------------------------------
+
+TRACE_SPANS_RECORDED = DEFAULT_REGISTRY.counter(
+    "dra_trace_spans_recorded_total",
+    "Finished spans retained by the in-process trace flight recorder "
+    "(served at /debug/traces)")
+EVENTS_EMITTED = DEFAULT_REGISTRY.counter(
+    "dra_events_emitted_total",
+    "Kubernetes Events by emission outcome: created (new Event object), "
+    "deduped (count bumped on an existing Event), dropped (rate "
+    "limited), error (API write failed, swallowed)",
+    ("reason", "outcome"))
+
+
 INFORMER_WATCH_LAG = DEFAULT_REGISTRY.histogram(
     "dra_informer_watch_lag_seconds",
     "Time a watch event waited between arrival and informer dispatch",
@@ -512,7 +568,9 @@ def dump_thread_stacks() -> str:
 
 class DebugHTTPServer:
     """``--http-endpoint`` server: /metrics, /healthz, /readyz,
-    /debug/threads (the net/http/pprof analog)."""
+    /debug/threads (the net/http/pprof analog), and the trace flight
+    recorder at /debug/traces + /debug/traces/<trace-id>
+    (pkg/tracing.py; empty JSON when tracing is disabled)."""
 
     def __init__(self, address: Tuple[str, int],
                  registry: Optional[Registry] = None,
@@ -536,9 +594,22 @@ class DebugHTTPServer:
                 self.wfile.write(payload)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
-                    self._send(200, outer._registry.render(),
+                    # Exemplars only on the EXPLICIT ?exemplars=1 opt-in:
+                    # the classic 0.0.4 text parser chokes on OpenMetrics
+                    # exemplar suffixes, and scrapers pick their parser
+                    # from our declared Content-Type — which stays 0.0.4.
+                    # (Deliberately NOT keyed on the Accept header: stock
+                    # Prometheus advertises openmetrics-text on every
+                    # scrape, and honoring it without actually speaking
+                    # OpenMetrics — # EOF framing, its content type —
+                    # would fail every real scrape the moment one
+                    # exemplar exists.)
+                    want_exemplars = "exemplars=1" in query.split("&")
+                    self._send(200,
+                               outer._registry.render(
+                                   exemplars=want_exemplars),
                                "text/plain; version=0.0.4; charset=utf-8")
                 elif path == "/healthz":
                     self._send(200, "ok")
@@ -551,6 +622,23 @@ class DebugHTTPServer:
                     self._send(200 if ok else 503, "ok" if ok else "not ready")
                 elif path == "/debug/threads":
                     self._send(200, dump_thread_stacks())
+                elif path == "/debug/traces" or path == "/debug/traces/":
+                    from tpu_dra_driver.pkg import tracing
+                    self._send(200,
+                               json.dumps(tracing.recorder().traces(),
+                                          indent=1),
+                               "application/json")
+                elif path.startswith("/debug/traces/"):
+                    from tpu_dra_driver.pkg import tracing
+                    trace_id = path[len("/debug/traces/"):]
+                    spans = tracing.recorder().trace(trace_id)
+                    if spans:
+                        self._send(200,
+                                   json.dumps({"trace_id": trace_id,
+                                               "spans": spans}, indent=1),
+                                   "application/json")
+                    else:
+                        self._send(404, "trace not found")
                 else:
                     self._send(404, "not found")
 

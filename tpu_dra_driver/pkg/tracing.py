@@ -1,0 +1,504 @@
+"""Dependency-free distributed tracing for the claim lifecycle.
+
+The BASELINE north-star metric — ResourceClaim-to-ready latency — is only
+an aggregate histogram (``dra_allocation_seconds``,
+``dra_prepare_batch_phase_seconds``); when one claim out of 512 is slow
+there is no way to see *which* phase ate the time. The reference driver
+answers that question with klog V(6) breadcrumbs plus component-base
+pprof (cmd/compute-domain-controller/main.go:372-419); this module
+answers it with an end-to-end, cross-process trace of every claim:
+OpenTelemetry-style spans, W3C-``traceparent``-style context propagated
+through a claim annotation, and a bounded in-memory flight recorder
+exported as JSON at ``/debug/traces`` on the existing
+:class:`~tpu_dra_driver.pkg.metrics.DebugHTTPServer`.
+
+Design constraints, in priority order (mirroring
+:mod:`tpu_dra_driver.pkg.faultinject`):
+
+1. **Zero overhead when disabled.** Production code calls
+   :func:`start_span` / :func:`span` / :func:`add_event` on hot paths
+   (every prepare, every allocation). Disabled, each is ONE
+   module-global bool check and a return of a shared no-op singleton —
+   no allocation, no contextvar touch, no lock. Pinned by a microbench
+   assertion in tests/test_tracing.py and recorded by bench.py under
+   the ``observability`` key.
+2. **Cross-process.** A :class:`SpanContext` serializes to the W3C
+   ``traceparent`` wire form (``00-<trace_id>-<span_id>-<flags>``) and
+   rides the ``resource.tpu.google.com/traceparent`` claim/CD
+   annotation: the allocation controller opens the root span and stamps
+   the annotation; the kubelet plugins parse it back and attach their
+   spans to the same trace in a different process.
+3. **Bounded.** Finished spans land in a :class:`FlightRecorder` — a
+   capped deque; old traces fall off, the recorder can never grow
+   without bound. Span events are capped per span.
+4. **Modes.** ``disabled`` (default), ``sampled`` (root spans sampled
+   at ``sample_ratio``; children inherit the parent's decision via the
+   traceparent flags byte), ``always``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+#: Annotation carrying the trace context across process boundaries
+#: (claims are stamped by the allocator at commit; ComputeDomains by the
+#: controller alongside the finalizer).
+TRACEPARENT_ANNOTATION = "resource.tpu.google.com/traceparent"
+
+#: W3C traceparent version byte; flags 01 = sampled.
+_VERSION = "00"
+
+#: Cap on events recorded per span (retry loops can attempt hundreds of
+#: times against a slow rendezvous; the first N tell the story).
+MAX_EVENTS_PER_SPAN = 64
+
+_TRACE_RNG = random.Random()
+
+
+class SpanContext:
+    """Immutable (trace_id, span_id, sampled) triple — the wire identity."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def traceparent(self) -> str:
+        flags = "01" if self.sampled else "00"
+        return f"{_VERSION}-{self.trace_id}-{self.span_id}-{flags}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"SpanContext({self.traceparent()})"
+
+
+def _new_trace_id() -> str:
+    return f"{_TRACE_RNG.getrandbits(128):032x}"
+
+
+def _new_span_id() -> str:
+    return f"{_TRACE_RNG.getrandbits(64):016x}"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
+    """``00-<32 hex>-<16 hex>-<2 hex>`` → SpanContext, or None on any
+    malformed input (propagation is best-effort: a mangled annotation
+    must never break a prepare)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+        flag_bits = int(flags, 16)
+    except ValueError:
+        return None
+    if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+        return None
+    return SpanContext(trace_id, span_id, sampled=bool(flag_bits & 0x01))
+
+
+def from_object(obj: Optional[Dict]) -> Optional[SpanContext]:
+    """Read the traceparent annotation off a k8s object dict."""
+    if not obj:
+        return None
+    annotations = ((obj.get("metadata") or {}).get("annotations") or {})
+    return parse_traceparent(annotations.get(TRACEPARENT_ANNOTATION))
+
+
+def annotate(obj: Dict, ctx: Optional[SpanContext]) -> None:
+    """Stamp ``ctx`` onto a k8s object dict (no-op for a None context)."""
+    if ctx is None:
+        return
+    meta = obj.setdefault("metadata", {})
+    annotations = meta.setdefault("annotations", {})
+    annotations[TRACEPARENT_ANNOTATION] = ctx.traceparent()
+
+
+class Span:
+    """One recorded operation. Context-manager: exceptions mark the span
+    failed and propagate. ``end()`` is idempotent and hands the span to
+    the process flight recorder."""
+
+    __slots__ = ("name", "context", "parent_span_id", "start_unix",
+                 "end_unix", "attributes", "events", "status", "_t0",
+                 "_ended")
+
+    recording = True
+
+    def __init__(self, name: str, context: SpanContext,
+                 parent_span_id: Optional[str] = None,
+                 attributes: Optional[Dict] = None):
+        self.name = name
+        self.context = context
+        self.parent_span_id = parent_span_id
+        self.start_unix = time.time()
+        self.end_unix: Optional[float] = None
+        self.attributes: Dict = dict(attributes or {})
+        self.events: List[Dict] = []
+        self.status = "unset"
+        self._t0 = time.perf_counter()
+        self._ended = False
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes) -> None:
+        if len(self.events) >= MAX_EVENTS_PER_SPAN:
+            if len(self.events) == MAX_EVENTS_PER_SPAN:
+                self.events.append({"ts": time.time(), "name": "truncated",
+                                    "attributes": {}})
+            return
+        self.events.append({"ts": time.time(), "name": name,
+                            "attributes": attributes})
+
+    def end(self, status: Optional[str] = None) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        if status is not None:
+            self.status = status
+        elif self.status == "unset":
+            self.status = "ok"
+        self.end_unix = self.start_unix + (time.perf_counter() - self._t0)
+        _RECORDER.record(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.set_attribute("error", f"{exc_type.__name__}: {exc}")
+            self.end(status="error")
+        else:
+            self.end()
+        return False
+
+    def to_dict(self) -> Dict:
+        return {
+            "name": self.name,
+            "trace_id": self.context.trace_id,
+            "span_id": self.context.span_id,
+            "parent_span_id": self.parent_span_id,
+            "start_unix": round(self.start_unix, 6),
+            "end_unix": (round(self.end_unix, 6)
+                         if self.end_unix is not None else None),
+            "duration_ms": (round((self.end_unix - self.start_unix) * 1e3, 3)
+                            if self.end_unix is not None else None),
+            "status": self.status,
+            "attributes": self.attributes,
+            "events": self.events,
+            "process": _SERVICE,
+        }
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled/unsampled fast path returns
+    this singleton so hot paths never allocate."""
+
+    __slots__ = ()
+    recording = False
+    context = None
+    name = ""
+
+    def set_attribute(self, key, value):
+        pass
+
+    def add_event(self, name, **attributes):
+        pass
+
+    def end(self, status=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class FlightRecorder:
+    """Bounded in-memory store of finished spans, queryable by trace."""
+
+    def __init__(self, capacity: int = 2048):
+        self._mu = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)
+
+    def record(self, span: Span) -> None:
+        with self._mu:
+            self._spans.append(span)
+        _count_recorded()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._spans)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._spans.clear()
+
+    def trace(self, trace_id: str) -> List[Dict]:
+        """Every retained finished span of one trace, oldest first."""
+        with self._mu:
+            return [s.to_dict() for s in self._spans
+                    if s.context.trace_id == trace_id]
+
+    def traces(self) -> List[Dict]:
+        """Per-trace summaries, most recent first."""
+        with self._mu:
+            spans = list(self._spans)
+        by_trace: Dict[str, Dict] = {}
+        for s in spans:
+            tid = s.context.trace_id
+            row = by_trace.setdefault(tid, {
+                "trace_id": tid, "spans": 0, "root": None,
+                "start_unix": s.start_unix, "end_unix": s.end_unix,
+                "errors": 0,
+            })
+            row["spans"] += 1
+            row["start_unix"] = min(row["start_unix"], s.start_unix)
+            if s.end_unix is not None:
+                row["end_unix"] = max(row["end_unix"] or 0, s.end_unix)
+            if s.parent_span_id is None:
+                row["root"] = s.name
+            if s.status == "error":
+                row["errors"] += 1
+        out = []
+        for row in by_trace.values():
+            if row["end_unix"] is not None:
+                row["duration_ms"] = round(
+                    (row["end_unix"] - row["start_unix"]) * 1e3, 3)
+            out.append(row)
+        out.sort(key=lambda r: r["start_unix"], reverse=True)
+        return out
+
+
+#: Module-global fast-path flag: False means every API here returns
+#: immediately (the production default — tracing is opt-in via
+#: ``--trace-mode``).
+_ENABLED = False
+_MODE = "disabled"
+_RATIO = 0.01
+_SERVICE = ""
+_RECORDER = FlightRecorder()
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "tpu_dra_current_span", default=None)
+
+
+def configure(mode: str = "disabled", sample_ratio: float = 0.01,
+              service: str = "", capacity: Optional[int] = None) -> None:
+    """Arm the subsystem. ``mode``: disabled | sampled | always."""
+    global _ENABLED, _MODE, _RATIO, _SERVICE, _RECORDER
+    if mode not in ("disabled", "sampled", "always"):
+        raise ValueError(f"trace mode {mode!r}: expected disabled|sampled|"
+                         f"always")
+    _MODE = mode
+    _RATIO = max(0.0, min(1.0, sample_ratio))
+    if service:
+        _SERVICE = service
+    if capacity is not None:
+        _RECORDER = FlightRecorder(capacity)
+    _ENABLED = mode != "disabled"
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def mode() -> str:
+    return _MODE
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def reset() -> None:
+    """Test helper: disable and drop recorded spans."""
+    global _ENABLED, _MODE, _SERVICE
+    _ENABLED = False
+    _MODE = "disabled"
+    _SERVICE = ""
+    _RECORDER.clear()
+    _CURRENT.set(None)
+
+
+def _sample_root() -> bool:
+    if _MODE == "always":
+        return True
+    if _MODE == "sampled":
+        return _TRACE_RNG.random() < _RATIO
+    return False
+
+
+def start_span(name: str, parent=None, attributes: Optional[Dict] = None):
+    """Open a span. ``parent`` is a Span, SpanContext, or None (a new
+    root). Returns :data:`NOOP_SPAN` when tracing is disabled or the
+    sampling decision (root: by mode; child: inherited from the parent)
+    says no."""
+    if not _ENABLED:
+        return NOOP_SPAN
+    parent_ctx: Optional[SpanContext]
+    if parent is None:
+        parent_ctx = None
+    elif isinstance(parent, SpanContext):
+        parent_ctx = parent
+    elif isinstance(parent, Span):
+        parent_ctx = parent.context
+    else:
+        parent_ctx = None
+    if parent_ctx is not None:
+        if not parent_ctx.sampled and _MODE != "always":
+            return NOOP_SPAN
+        ctx = SpanContext(parent_ctx.trace_id, _new_span_id(), sampled=True)
+        return Span(name, ctx, parent_span_id=parent_ctx.span_id,
+                    attributes=attributes)
+    if not _sample_root():
+        return NOOP_SPAN
+    ctx = SpanContext(_new_trace_id(), _new_span_id(), sampled=True)
+    return Span(name, ctx, parent_span_id=None, attributes=attributes)
+
+
+class _UseSpan:
+    """Context manager installing a span as the implicit current span
+    (the parent for :func:`span` children and the source of log/exemplar
+    correlation). Accepts None / non-recording spans as a no-op."""
+
+    __slots__ = ("_span", "_token")
+
+    def __init__(self, span):
+        self._span = span
+        self._token = None
+
+    def __enter__(self):
+        if self._span is not None and self._span.recording:
+            self._token = _CURRENT.set(self._span)
+        return self._span
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+        return False
+
+
+def use_span(span) -> _UseSpan:
+    return _USE_NOOP if not _ENABLED else _UseSpan(span)
+
+
+_USE_NOOP = _UseSpan(None)
+
+
+class _ChildScope:
+    """``with tracing.span("phase"):`` — a child of the current span that
+    is also installed as current for its duration."""
+
+    __slots__ = ("_span", "_token")
+
+    def __init__(self, span):
+        self._span = span
+        self._token = None
+
+    def __enter__(self):
+        if self._span.recording:
+            self._token = _CURRENT.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+        self._span.__exit__(exc_type, exc, tb)
+        return False
+
+
+class _NoopScope:
+    __slots__ = ()
+
+    def __enter__(self):
+        return NOOP_SPAN
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SCOPE = _NoopScope()
+
+
+def span(name: str, attributes: Optional[Dict] = None,
+         root: bool = False):
+    """Child-of-current span scope. Without a recording current span this
+    is a no-op unless ``root=True`` (which opens a fresh root trace,
+    subject to the sampling mode)."""
+    if not _ENABLED:
+        return _NOOP_SCOPE
+    cur = _CURRENT.get()
+    if cur is None or not cur.recording:
+        if not root:
+            return _NOOP_SCOPE
+        s = start_span(name, parent=None, attributes=attributes)
+    else:
+        s = start_span(name, parent=cur, attributes=attributes)
+    if not s.recording:
+        return _NOOP_SCOPE
+    return _ChildScope(s)
+
+
+def current_span():
+    """The innermost recording span, or None."""
+    if not _ENABLED:
+        return None
+    cur = _CURRENT.get()
+    return cur if (cur is not None and cur.recording) else None
+
+
+def current_context() -> Optional[SpanContext]:
+    cur = current_span()
+    return cur.context if cur is not None else None
+
+
+def add_event(name: str, **attributes) -> None:
+    """Record an event on the current span (used by e.g. the
+    fault-injection subsystem so every injected fault shows up inside
+    the trace of the claim it hit). Disabled: one bool check."""
+    if not _ENABLED:
+        return
+    cur = _CURRENT.get()
+    if cur is not None and cur.recording:
+        cur.add_event(name, **attributes)
+
+
+def exemplar(span_or_ctx=None) -> Optional[Dict[str, str]]:
+    """Prometheus exemplar labels for a span/context (default: the
+    current span) — attach to histogram observations so a latency bucket
+    links back to a concrete trace. None when not tracing."""
+    if not _ENABLED:
+        return None
+    if span_or_ctx is None:
+        ctx = current_context()
+    elif isinstance(span_or_ctx, SpanContext):
+        ctx = span_or_ctx
+    else:
+        ctx = getattr(span_or_ctx, "context", None)
+    if ctx is None:
+        return None
+    return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+
+
+def _count_recorded() -> None:
+    # lazy import mirrors faultinject._count_fired: the disabled path
+    # stays import-free, and metrics never imports tracing at module load
+    from tpu_dra_driver.pkg import metrics as _metrics
+    _metrics.TRACE_SPANS_RECORDED.inc()

@@ -43,6 +43,7 @@ from tpu_dra_driver.cdi.generator import CdiDevice, CdiHandler, ContainerEdits
 from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import featuregates as fg
 from tpu_dra_driver.pkg import metrics as _metrics
+from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.flock import Flock, FlockOptions
 from tpu_dra_driver.plugin.allocatable import (
     AllocatableDevice,
@@ -183,7 +184,8 @@ class DeviceState:
             raise res.exception
         return res.devices
 
-    def prepare_batch(self, claims: List[ClaimInfo]
+    def prepare_batch(self, claims: List[ClaimInfo],
+                      spans: Optional[Dict[str, object]] = None
                       ) -> Dict[str, BatchClaimResult]:
         """Group-commit prepare for one kubelet batch.
 
@@ -197,9 +199,16 @@ class DeviceState:
         write-ahead and commit) is rolled back by the next prepare
         attempt / startup sweep, exactly as before.
 
+        ``spans`` (optional, from the driver's trace pickup) maps claim
+        uid → its ``kubelet.prepare`` span: each claim's device/CDI
+        phase spans parent on ITS OWN trace, while the genuinely
+        batch-wide fsync spans (read/write-ahead/commit) stay on the
+        ambient batch span.
+
         Batch-wide failures (cp-lock timeout, checkpoint corruption)
         raise; everything per-claim is reported in the result map.
         """
+        spans = spans or {}
         out: Dict[str, BatchClaimResult] = {}
         if not claims:
             return out
@@ -211,7 +220,8 @@ class DeviceState:
             with self._cp_locked():
                 phase("lock").observe(time.perf_counter() - t_lock0)
                 t_read0 = time.perf_counter()
-                cp = self._cp_mgr.read_or_quarantine()
+                with tracing.span("prepare.read_checkpoint"):
+                    cp = self._cp_mgr.read_or_quarantine()
                 t_read = time.perf_counter() - t_read0
                 phase("read").observe(t_read)
 
@@ -266,15 +276,24 @@ class DeviceState:
                         namespace=claim.namespace, state=PREPARE_STARTED,
                     )
                 t_wa0 = time.perf_counter()
-                self._cp_mgr.write(cp)
-                phase("write_ahead").observe(time.perf_counter() - t_wa0)
+                with tracing.span("prepare.write_ahead",
+                                  attributes={"claims": len(to_prepare)}):
+                    self._cp_mgr.write(cp)
+                phase("write_ahead").observe(
+                    time.perf_counter() - t_wa0,
+                    exemplar=tracing.exemplar())
                 fi.fire("plugin.prepare.after_write_ahead")
 
                 t_prep0 = time.perf_counter()
                 for claim in to_prepare:
-                    out[claim.uid] = self._prepare_one_in_batch(claim, cp,
-                                                               t_read)
-                phase("prepare").observe(time.perf_counter() - t_prep0)
+                    # per-claim phases land in the CLAIM's own trace;
+                    # use_span(None) keeps the ambient batch span for
+                    # untraced claims
+                    with tracing.use_span(spans.get(claim.uid)):
+                        out[claim.uid] = self._prepare_one_in_batch(
+                            claim, cp, t_read)
+                phase("prepare").observe(time.perf_counter() - t_prep0,
+                                         exemplar=tracing.exemplar())
 
                 # commit: one fsync finalizes every successful claim.
                 # Failed peers keep their PrepareStarted write-ahead
@@ -286,8 +305,10 @@ class DeviceState:
                 if any(out[c.uid].exception is None for c in to_prepare):
                     fi.fire("plugin.prepare.before_commit")
                     t_commit0 = time.perf_counter()
-                    self._cp_mgr.write(cp)
-                    phase("commit").observe(time.perf_counter() - t_commit0)
+                    with tracing.span("prepare.commit"):
+                        self._cp_mgr.write(cp)
+                    phase("commit").observe(time.perf_counter() - t_commit0,
+                                            exemplar=tracing.exemplar())
         log.debug("prepare batch: %d claim(s) in %.1fms",
                   len(claims), (time.perf_counter() - t0) * 1e3)
         return out
@@ -314,12 +335,17 @@ class DeviceState:
             # failed, this claim proceeds, just as it would serially.
             self._validate_no_overlap(cp, claim)
             t_core0 = time.perf_counter()
-            prepared, cdi_devices, extra_common = self._prepare_devices(claim)
+            with tracing.span("prepare.devices",
+                              attributes={"claim": claim.canonical}):
+                prepared, cdi_devices, extra_common = \
+                    self._prepare_devices(claim)
             timing.t_core = time.perf_counter() - t_core0
 
             t_cdi0 = time.perf_counter()
-            qualified = self._cdi.write_claim_spec(claim.uid, cdi_devices,
-                                                   extra_common=extra_common)
+            with tracing.span("prepare.cdi",
+                              attributes={"claim": claim.canonical}):
+                qualified = self._cdi.write_claim_spec(
+                    claim.uid, cdi_devices, extra_common=extra_common)
             timing.t_cdi = time.perf_counter() - t_cdi0
         except PermanentError as e:
             log.error("prepare %s failed permanently: %s", claim.canonical, e)
@@ -469,14 +495,17 @@ class DeviceState:
         assert dev.profile is not None
         spec = SubsliceSpec(dev.chip.index, dev.chip.uuid, dev.profile,
                             dev.placement_start)
-        try:
-            live = self._lib.create_subslice(spec)
-        except SubsliceAlreadyExistsError:
-            # Leftover from an earlier crashed attempt of *this* claim
-            # (other owners were excluded by the overlap guard): recreate
-            # for a clean slate.
-            self._lib.destroy_subslice(spec.tuple)
-            live = self._lib.create_subslice(spec)
+        with tracing.span("prepare.subslice",
+                          attributes={"profile": dev.profile.id,
+                                      "chip": dev.chip.index}):
+            try:
+                live = self._lib.create_subslice(spec)
+            except SubsliceAlreadyExistsError:
+                # Leftover from an earlier crashed attempt of *this* claim
+                # (other owners were excluded by the overlap guard):
+                # recreate for a clean slate.
+                self._lib.destroy_subslice(spec.tuple)
+                live = self._lib.create_subslice(spec)
         edits = ContainerEdits(
             device_nodes=[{"path": live.devfs_path}],
             env={

@@ -27,7 +27,13 @@ from typing import Dict, List, Optional, Set
 
 from tpu_dra_driver.cdi.generator import CdiHandler, DEFAULT_CDI_ROOT
 from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.events import (
+    EventRecorder,
+    emit_claim_event,
+    normalize_claim_refs,
+)
 from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.flock import Flock, FlockOptions, FlockTimeoutError
 from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY, Registry
 from tpu_dra_driver.plugin.checkpoint import PreparedDevice
@@ -99,6 +105,12 @@ class TpuKubeletPlugin:
         self.cleanup = CheckpointCleanupManager(
             self.state, clients.resource_claims,
             interval=config.cleanup_interval)
+        # Prepared/Unprepared/Failed events on claims: `kubectl describe
+        # resourceclaim` shows what this node actually did (the reference
+        # only logs V(6) breadcrumbs)
+        self._events = EventRecorder(clients.events,
+                                     component="tpu-kubelet-plugin",
+                                     host=config.node_name)
         self._started = False
         # device-health stream state (kubelet's v1alpha1.DRAResourceHealth
         # service reads these; KEP-4680): a monotonically bumped version +
@@ -254,6 +266,30 @@ class TpuKubeletPlugin:
     # DRA entrypoints (reference driver.go:298-397)
     # ------------------------------------------------------------------
 
+    def _claim_spans(self, claims: List[Dict]) -> Dict[str, object]:
+        """One ``kubelet.prepare`` span per traced claim, parented on the
+        traceparent annotation the allocator stamped — the cross-process
+        pickup. Empty when tracing is disabled (the fast path)."""
+        spans: Dict[str, object] = {}
+        if not tracing.enabled():
+            return spans
+        for obj in claims:
+            meta = obj.get("metadata") or {}
+            uid = meta.get("uid", "")
+            if not uid or uid in spans:
+                continue
+            span = tracing.start_span(
+                "kubelet.prepare",
+                parent=tracing.from_object(obj),
+                attributes={
+                    "claim": f"{meta.get('namespace', '')}/"
+                             f"{meta.get('name', '')}",
+                    "claim_uid": uid,
+                    "node": self._config.node_name})
+            if span.recording:
+                spans[uid] = span
+        return spans
+
     def prepare_resource_claims(self, claims: List[Dict]) -> Dict[str, PrepareResult]:
         """NodePrepareResources: the whole kubelet batch goes through the
         group-commit fast path — one pu-lock acquisition and two
@@ -264,21 +300,27 @@ class TpuKubeletPlugin:
         infos = ClaimInfo.from_objs(claims)
         if not infos:
             return {}
+        spans = self._claim_spans(claims)
+        # Batch-wide phase spans (write-ahead/commit fsyncs are shared
+        # by the whole batch) nest under the first traced claim's span;
+        # the claim attribute on per-claim child spans disambiguates.
+        batch_span = next(iter(spans.values()), None)
         t0 = time.perf_counter()
         try:
             lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
             with lock:
                 t_lock = time.perf_counter() - t0
                 self._m_lock_wait.observe(t_lock)
-                batch = self.state.prepare_batch(infos)
+                with tracing.use_span(batch_span):
+                    batch = self.state.prepare_batch(infos, spans=spans)
         except FlockTimeoutError as e:
             return self._prepare_batch_failed(
-                infos, f"prepare lock: {e}", t0)
+                infos, f"prepare lock: {e}", t0, spans)
         except Exception as e:  # chaos-ok: per-claim errors + error histogram
             # batch-wide failure (checkpoint read/corruption): no claim
             # got anywhere, so every claim reports it
             log.exception("prepare batch of %d claims failed", len(infos))
-            return self._prepare_batch_failed(infos, str(e), t0)
+            return self._prepare_batch_failed(infos, str(e), t0, spans)
         elapsed = time.perf_counter() - t0
         log.debug("prepare batch of %d: pu-lock wait %.1fms, total %.1fms",
                   len(infos), t_lock * 1e3, elapsed * 1e3)
@@ -288,26 +330,53 @@ class TpuKubeletPlugin:
             res = batch[info.uid]
             outcome = ("ok" if res.error is None
                        else "permanent_error" if res.permanent else "error")
-            self._m_prepare.labels(outcome).observe(per_claim)
+            span = spans.get(info.uid)
+            self._m_prepare.labels(outcome).observe(
+                per_claim, exemplar=tracing.exemplar(span))
+            if span is not None:
+                span.set_attribute("result", outcome)
+                span.set_attribute("cached", res.cached)
+                span.end(status="ok" if res.error is None else "error")
+            emit_claim_event(self._events, self._config.node_name,
+                             self._claim_ref(info), "prepared",
+                             error=res.error, permanent=res.permanent)
             out[info.uid] = PrepareResult(devices=res.devices,
                                           error=res.error,
                                           permanent=res.permanent)
         return out
 
+    @staticmethod
+    def _claim_ref(info: ClaimInfo) -> Dict[str, str]:
+        return {"uid": info.uid, "name": info.name,
+                "namespace": info.namespace}
+
     def _prepare_batch_failed(self, infos: List[ClaimInfo], error: str,
-                              t0: float) -> Dict[str, PrepareResult]:
+                              t0: float,
+                              spans: Optional[Dict[str, object]] = None
+                              ) -> Dict[str, PrepareResult]:
         per_claim = (time.perf_counter() - t0) / max(len(infos), 1)
         out: Dict[str, PrepareResult] = {}
         for info in infos:
-            self._m_prepare.labels("error").observe(per_claim)
+            span = (spans or {}).get(info.uid)
+            self._m_prepare.labels("error").observe(
+                per_claim, exemplar=tracing.exemplar(span))
+            if span is not None:
+                span.set_attribute("error", error)
+                span.end(status="error")
+            emit_claim_event(self._events, self._config.node_name,
+                             self._claim_ref(info), "prepared", error=error)
             out[info.uid] = PrepareResult(error=error, permanent=False)
         return out
 
-    def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[str]]:
+    def unprepare_resource_claims(self, claim_refs: List) -> Dict[str, Optional[str]]:
         """NodeUnprepareResources, batched like the prepare side: one
         pu-lock acquisition + one checkpoint read/write for the whole
         batch (DeviceState.unprepare_batch), per-UID error strings
-        preserved."""
+        preserved. ``claim_refs`` entries are bare uid strings or
+        ``{"uid", "name", "namespace"}`` dicts (the gRPC layer passes the
+        full kubelet refs so Events can name the claim)."""
+        refs = normalize_claim_refs(claim_refs)
+        claim_uids = list(refs)
         if not claim_uids:
             return {}
         t0 = time.perf_counter()
@@ -323,6 +392,8 @@ class TpuKubeletPlugin:
             out: Dict[str, Optional[str]] = {}
             for uid in claim_uids:
                 self._m_unprepare.labels("error").observe(per_claim)
+                emit_claim_event(self._events, self._config.node_name,
+                                 refs[uid], "unprepared", error=str(e))
                 out[uid] = str(e)
             return out
         per_claim = (time.perf_counter() - t0) / len(claim_uids)
@@ -332,4 +403,6 @@ class TpuKubeletPlugin:
             out[uid] = None if exc is None else str(exc)
             self._m_unprepare.labels(
                 "ok" if exc is None else "error").observe(per_claim)
+            emit_claim_event(self._events, self._config.node_name,
+                             refs[uid], "unprepared", error=out[uid])
         return out
