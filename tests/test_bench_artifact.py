@@ -214,6 +214,47 @@ def test_bench_detail_records_allocator_sweep():
         assert key in bench.SUMMARY_KEYS
 
 
+def test_bench_detail_records_shard_sweep():
+    """The trajectory gate for the sharded control plane (ISSUE 6): the
+    committed BENCH_DETAIL.json must carry the shard sweep with the
+    acceptance bars holding — 4-shard aggregate ≥ 4,000 claims/s at
+    1024×4096 AND ≥ 4× the single-leader arm on the same shape — plus
+    the 10k-node watch fan-out evidence (≤ 8 mux threads, recorded p99
+    event-to-handler lag). A bench regression now fails tier-1 instead
+    of rotting silently in the artifact."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    with open(path) as f:
+        extra = json.load(f)["extra"]
+    sweep = extra["shard_sweep"]
+    assert set(sweep) >= {"1024x512", "1024x4096"}, sweep.keys()
+    for shape, row in sweep.items():
+        assert row["single"]["claims_per_sec"] > 0, shape
+        for n in (1, 2, 4, 8):
+            arm = row[f"shards_{n}"]
+            assert arm["agg_claims_per_sec"] > 0, (shape, n)
+            assert isinstance(arm["speedup_vs_single"], (int, float))
+    # the acceptance bars, on the headline shape
+    big = sweep["1024x4096"]["shards_4"]
+    assert big["agg_claims_per_sec"] >= 4000, big
+    assert big["speedup_vs_single"] >= 4.0, big
+    # watch fan-out: 10k simulated nodes from one process, ≤ 8 mux
+    # threads, p99 event-to-handler lag recorded
+    fanout = extra["watch_fanout"]
+    assert fanout["nodes"] >= 10_000, fanout
+    assert fanout["delivered"] == fanout["events"] > 0, fanout
+    assert fanout["mux_threads"] <= 8, fanout
+    assert fanout["p99_lag_ms"] > 0, fanout
+    # headline scalars mirrored for the summary line
+    assert extra["shard_agg_4x1024x4096"] == big["agg_claims_per_sec"]
+    assert extra["shard_speedup_4x1024x4096"] == big["speedup_vs_single"]
+    assert extra["watch_fanout_p99_ms"] == fanout["p99_lag_ms"]
+    assert extra["watch_mux_threads"] == fanout["mux_threads"]
+    for key in ("shard_agg_4x1024x4096", "shard_speedup_4x1024x4096",
+                "watch_fanout_p99_ms", "watch_mux_threads"):
+        assert key in bench.SUMMARY_KEYS
+
+
 def test_bench_detail_records_observability():
     """The committed BENCH_DETAIL.json must carry the observability
     overhead evidence (tracing PR): per-span-site cost in all three

@@ -12,6 +12,8 @@ the detections.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
 
@@ -118,14 +120,27 @@ def counter():
         innermost()
         return nonlocal_target
     return inner()
-
-
-def type_params[T](x: T) -> T:        # PEP 695
-    return x
 """
     assert _f821(src) == []
 
 
+# PEP 695 syntax (`def f[T](...)`, `type Alias = ...`) only PARSES on
+# Python >= 3.12 — ast.parse on the 3.10 interpreter this image ships
+# raises SyntaxError before the checker ever runs, which failed these
+# fixtures at seed ("fail at seed too" in every PR since PR 3). The
+# checker logic itself is version-independent; gate the fixtures on the
+# interpreter actually being able to read them.
+_PEP695 = pytest.mark.skipif(
+    sys.version_info < (3, 12),
+    reason="PEP 695 syntax requires Python >= 3.12 to parse")
+
+
+@_PEP695
+def test_pep695_type_params_function():
+    assert _f821("def type_params[T](x: T) -> T:\n    return x\n") == []
+
+
+@_PEP695
 def test_pep695_type_alias_statement():
     assert _f821("type Alias[T] = list[T]\nx: Alias[int] = []\n") == []
     assert _f821("type Bad = list[Missing]\n") == [
@@ -168,11 +183,19 @@ _NO_SLEEP_DIRS = (
 
 # The scale-out allocation path is equally sleep-free: candidate pruning,
 # ledger updates, and worker draining all block on condition variables or
-# informer events, never on a fixed sleep.
+# informer events, never on a fixed sleep. The sharded control plane and
+# the watch mux (ISSUE 6) join the guard: shard routing, cross-shard
+# reserves, and mux dispatch wake on events/conditions only — the one
+# legitimate timed wait in kube/aio.py is the ASYNC relist backoff
+# (asyncio.sleep parks a coroutine, not a thread; the AST guard below
+# matches `.sleep` attribute calls, so asyncio.sleep is explicitly
+# exempted by the allowlist).
 _NO_SLEEP_FILES = (
     os.path.join("tpu_dra_driver", "kube", "allocator.py"),
     os.path.join("tpu_dra_driver", "kube", "catalog.py"),
     os.path.join("tpu_dra_driver", "kube", "allocation_controller.py"),
+    os.path.join("tpu_dra_driver", "kube", "sharding.py"),
+    os.path.join("tpu_dra_driver", "kube", "aio.py"),
 )
 
 
@@ -186,8 +209,14 @@ def _sleep_calls(path):
             continue
         fn = node.func
         # catches time.sleep, _time.sleep, and any `from time import
-        # sleep` alias spelled `sleep(...)`
+        # sleep` alias spelled `sleep(...)`. asyncio.sleep is exempt:
+        # it parks a coroutine on the shared event loop, not a thread —
+        # the exact opposite of the thread-blocking poll this guard
+        # exists to keep out.
         if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
+            if (isinstance(fn.value, ast.Name)
+                    and fn.value.id == "asyncio"):
+                continue
             out.append((path, node.lineno))
         elif isinstance(fn, ast.Name) and fn.id == "sleep":
             out.append((path, node.lineno))

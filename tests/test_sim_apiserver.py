@@ -119,25 +119,30 @@ def test_finalizer_aware_delete(sim):
         rc.get("computedomains", "cd", "ns")
 
 
-def test_list_and_watch_bridges_list_to_watch_gap(sim):
+@pytest.mark.parametrize("async_watch", [False, True],
+                         ids=["thread", "async"])
+def test_list_and_watch_bridges_list_to_watch_gap(sim, async_watch):
     """Deterministically create an object INSIDE the list→watch window:
-    list_and_watch lists synchronously, then starts the watch thread —
-    wrapping _watch_loop injects a create after the list response but
+    list_and_watch lists synchronously, then starts the watch stream —
+    wrapping _start_stream injects a create after the list response but
     before the watch request is dialed. The ADDED event must still
     arrive, because the watch resumes from the list's resourceVersion
-    (the round-3 flake: rv="" dropped it ~1 in 4)."""
-    srv, rc = sim
+    (the round-3 flake: rv="" dropped it ~1 in 4). Both the legacy
+    thread-per-stream path and the asyncio mux path (kube/aio.py) must
+    honor this."""
+    srv, rc_default = sim
+    rc = RestCluster(RestClusterConfig(srv.url), async_watch=async_watch)
     rc.create("resourceclaims", _claim("pre"))
-    orig = rc._watch_loop
+    orig = rc._start_stream
 
-    def delayed_watch_loop(*args, **kwargs):
+    def delayed_start_stream(*args, **kwargs):
         srv.cluster.create("resourceclaims", {
             "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
             "metadata": {"name": "mid-gap", "namespace": "default"},
             "spec": {}})
         orig(*args, **kwargs)
 
-    rc._watch_loop = delayed_watch_loop
+    rc._start_stream = delayed_start_stream
     items, sub = rc.list_and_watch("resourceclaims")
     assert [o["metadata"]["name"] for o in items] == ["pre"]
     ev = sub.next(timeout=5)

@@ -1,0 +1,345 @@
+"""The multiplexed watch layer (ISSUE 6): WatchMux semantics, the
+informer facade over it, and the asyncio REST watch streams.
+
+The contract: the synchronous Informer API is unchanged, per-
+subscription event ORDER is preserved, a subscription is serviced by at
+most one worker at a time, and N subscriptions cost a FIXED worker pool
+(≤ kube/aio.py MAX_WORKERS threads) instead of a thread each — for the
+fake backend via push listeners, for REST via coroutines on one shared
+event loop.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra_driver.kube import aio
+from tpu_dra_driver.kube.aio import MAX_WORKERS, WatchMux
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+from tpu_dra_driver.testing.apiserver import SimApiServer
+
+
+def _pod(name, ns="ns", labels=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         **({"labels": labels} if labels else {})}}
+
+
+# ---------------------------------------------------------------------------
+# WatchMux core semantics
+# ---------------------------------------------------------------------------
+
+
+def test_mux_preserves_per_sub_order_and_serialization():
+    clients = ClientSets()
+    mux = WatchMux(workers=4, name="t-mux")
+    sub = clients.cluster.watch("pods")
+    seen = []
+    active = [0]
+    max_active = [0]
+    lock = threading.Lock()
+
+    def dispatch(ev, pushed_at):
+        with lock:
+            active[0] += 1
+            max_active[0] = max(max_active[0], active[0])
+        seen.append(ev[1]["metadata"]["name"])
+        time.sleep(0.001)
+        with lock:
+            active[0] -= 1
+
+    mux.add(sub, dispatch)
+    for i in range(50):
+        clients.pods.create(_pod(f"p-{i:03d}"))
+    deadline = time.monotonic() + 10.0
+    while len(seen) < 50 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen == [f"p-{i:03d}" for i in range(50)]
+    assert max_active[0] == 1          # never two workers on one sub
+    sub.close()
+    mux.remove(sub)
+    mux.shutdown()
+
+
+def test_mux_many_subs_fixed_threads():
+    clients = ClientSets()
+    mux = WatchMux(name="t-mux2")
+    hits = []
+    subs = []
+    for i in range(500):
+        sub = clients.cluster.watch("pods",
+                                    label_selector={"n": str(i)})
+        mux.add(sub, lambda ev, ts, i=i: hits.append(i))
+        subs.append(sub)
+    assert mux.thread_count() <= MAX_WORKERS
+    clients.pods.create(_pod("x", labels={"n": "123"}))
+    deadline = time.monotonic() + 5.0
+    while not hits and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert hits == [123]
+    for sub in subs:
+        sub.close()
+    mux.shutdown()
+
+
+def test_mux_pre_listener_backlog_not_stranded():
+    """Events pushed BEFORE mux.add must still dispatch (the listener
+    fires immediately on registration when events are queued)."""
+    clients = ClientSets()
+    sub = clients.cluster.watch("pods")
+    clients.pods.create(_pod("early"))
+    mux = WatchMux(workers=2, name="t-mux3")
+    got = []
+    mux.add(sub, lambda ev, ts: got.append(ev[1]["metadata"]["name"]))
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got == ["early"]
+    sub.close()
+    mux.shutdown()
+
+
+def test_mux_remove_quiesces_dispatch():
+    clients = ClientSets()
+    mux = WatchMux(workers=2, name="t-mux4")
+    sub = clients.cluster.watch("pods")
+    got = []
+    mux.add(sub, lambda ev, ts: got.append(1))
+    clients.pods.create(_pod("a"))
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sub.close()
+    mux.remove(sub, wait=True)
+    n = len(got)
+    # further pushes are impossible (closed) and the entry is gone;
+    # nothing may dispatch after remove() returned
+    time.sleep(0.05)
+    assert len(got) == n
+    mux.shutdown()
+
+
+def test_mux_dispatch_error_does_not_wedge_stream():
+    from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+
+    clients = ClientSets()
+    mux = WatchMux(workers=2, name="t-mux5")
+    sub = clients.cluster.watch("pods")
+    got = []
+
+    def dispatch(ev, ts):
+        if ev[1]["metadata"]["name"] == "bad":
+            raise RuntimeError("handler bug")
+        got.append(ev[1]["metadata"]["name"])
+
+    before = SWALLOWED_ERRORS.labels("watch_mux.dispatch").value
+    mux.add(sub, dispatch)
+    clients.pods.create(_pod("bad"))
+    clients.pods.create(_pod("good"))
+    deadline = time.monotonic() + 5.0
+    while "good" not in got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got == ["good"]
+    assert SWALLOWED_ERRORS.labels("watch_mux.dispatch").value \
+        == before + 1
+    sub.close()
+    mux.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Informer facade (mux mode is the default)
+# ---------------------------------------------------------------------------
+
+
+def test_informer_on_mux_keeps_full_semantics():
+    clients = ClientSets()
+    clients.pods.create(_pod("pre"))
+    inf = Informer(clients.pods)
+    added, updated, deleted = [], [], []
+    inf.add_handlers(
+        on_add=lambda o: added.append(o["metadata"]["name"]),
+        on_update=lambda o, n: updated.append(n["metadata"]["name"]),
+        on_delete=lambda o: deleted.append(o["metadata"]["name"]))
+    inf.start()
+    assert inf.wait_synced(5.0)
+    assert added == ["pre"]
+    clients.pods.create(_pod("live"))
+    pod = clients.pods.get("live", "ns")
+    pod["metadata"]["labels"] = {"x": "1"}
+    clients.pods.update(pod)
+    clients.pods.delete("pre", "ns")
+    deadline = time.monotonic() + 5.0
+    while (len(added) < 2 or not updated or not deleted) \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert added == ["pre", "live"]
+    assert updated == ["live"]
+    assert deleted == ["pre"]
+    assert inf.get("live", "ns") is not None
+    inf.stop()
+
+
+def test_informers_share_the_default_mux_no_thread_each():
+    clients = ClientSets()
+    before = threading.active_count()
+    informers = []
+    for i in range(20):
+        inf = Informer(clients.pods,
+                       label_selector={"shard": str(i)})
+        inf.start()
+        informers.append(inf)
+    # 20 informers must NOT add 20 threads — the shared mux pool
+    # services all of them (first-ever informer may lazily spawn the
+    # pool itself)
+    assert threading.active_count() - before <= MAX_WORKERS
+    for inf in informers:
+        inf.stop()
+
+
+def test_informer_thread_mode_opt_out(monkeypatch):
+    monkeypatch.setenv("TPU_DRA_WATCH_MUX", "0")
+    clients = ClientSets()
+    inf = Informer(clients.pods)
+    got = []
+    inf.add_handlers(on_add=lambda o: got.append(o["metadata"]["name"]))
+    inf.start()
+    assert inf._thread is not None and inf._mux is None
+    clients.pods.create(_pod("t"))
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got == ["t"]
+    inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# asyncio REST watch streams
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sim():
+    srv = SimApiServer().start()
+    yield srv
+    srv.stop()
+
+
+def _claim(name, ns="default"):
+    return {"apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": ns}, "spec": {}}
+
+
+def test_async_rest_watch_streams_events(sim):
+    rc = RestCluster(RestClusterConfig(sim.url), async_watch=True)
+    sub = rc.watch("resourceclaims")
+    rc.create("resourceclaims", _claim("a"))
+    ev = sub.next(timeout=5)
+    assert ev is not None and ev[0] == "ADDED"
+    assert ev[1]["metadata"]["name"] == "a"
+    rc.stop_watch("resourceclaims", sub)
+
+
+def test_async_rest_watch_no_thread_per_stream(sim):
+    rc = RestCluster(RestClusterConfig(sim.url), async_watch=True)
+    subs = [rc.watch("resourceclaims") for _ in range(25)]
+    # 25 streams, ZERO client-side watch threads: the legacy path would
+    # have spawned one "watch-resourceclaims" thread per stream (the
+    # sim SERVER still spends a handler thread per connection — those
+    # live in this process too, so count by name, not in aggregate)
+    client_watch_threads = [t.name for t in threading.enumerate()
+                            if t.name.startswith("watch-resourceclaims")]
+    assert client_watch_threads == []
+    assert any(t.name == "watch-aio-loop" for t in threading.enumerate())
+    rc.create("resourceclaims", _claim("fanout"))
+    for sub in subs:
+        ev = sub.next(timeout=5)
+        assert ev is not None and ev[1]["metadata"]["name"] == "fanout"
+    for sub in subs:
+        rc.stop_watch("resourceclaims", sub)
+
+
+def test_async_rest_watch_compacted_rv_relists(sim):
+    """An in-stream 410 (compacted resourceVersion) must bridge via
+    RELIST, exactly like the threaded path."""
+    from tpu_dra_driver.kube.fake import RELIST
+
+    rc = RestCluster(RestClusterConfig(sim.url), async_watch=True)
+    for i in range(4):
+        rc.create("resourceclaims", _claim(f"pre-{i}"))
+    # compact the journal: tiny journal limit forces trims
+    sim.cluster._journal_limit = 2
+    for i in range(6):
+        rc.create("resourceclaims", _claim(f"churn-{i}"))
+    from tpu_dra_driver.kube.fake import _WatchSub
+    watch_sub = _WatchSub(None)
+    rc._start_stream("resourceclaims", None, watch_sub, "1")  # ancient rv
+    deadline = time.monotonic() + 10.0
+    got_relist = None
+    while time.monotonic() < deadline:
+        ev = watch_sub.next(timeout=0.5)
+        if ev is not None and ev[0] == RELIST:
+            got_relist = ev
+            break
+    assert got_relist is not None
+    names = {o["metadata"]["name"] for o in got_relist[1]["items"]}
+    assert "churn-5" in names
+    watch_sub.close()
+
+
+def test_async_rest_list_and_watch_resumes_from_list_rv(sim):
+    rc = RestCluster(RestClusterConfig(sim.url), async_watch=True)
+    rc.create("resourceclaims", _claim("pre"))
+    items, sub = rc.list_and_watch("resourceclaims")
+    assert [o["metadata"]["name"] for o in items] == ["pre"]
+    rc.create("resourceclaims", _claim("post"))
+    ev = sub.next(timeout=5)
+    assert ev is not None and ev[1]["metadata"]["name"] == "post"
+    rc.stop_watch("resourceclaims", sub)
+
+
+def test_async_rest_watch_close_cancels_stream(sim):
+    from tpu_dra_driver.pkg.metrics import WATCH_STREAMS_ACTIVE
+
+    rc = RestCluster(RestClusterConfig(sim.url), async_watch=True)
+    sub = rc.watch("resourceclaims")
+    gauge = WATCH_STREAMS_ACTIVE.labels("rest-async")
+    assert gauge.value >= 1
+    before = gauge.value
+    rc.stop_watch("resourceclaims", sub)
+    deadline = time.monotonic() + 5.0
+    while gauge.value >= before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert gauge.value == before - 1
+
+
+def test_informer_over_async_rest_end_to_end(sim):
+    """The whole stack: Informer (mux dispatch) over RestCluster (async
+    stream) over real HTTP — the production wiring of a 10k-stream
+    process."""
+    rc = RestCluster(RestClusterConfig(sim.url), async_watch=True)
+
+    class _Client:
+        resource = "resourceclaims"
+
+        def list_and_watch(self, namespace=None, label_selector=None):
+            return rc.list_and_watch("resourceclaims",
+                                     label_selector=label_selector)
+
+        def stop_watch(self, sub):
+            rc.stop_watch("resourceclaims", sub)
+
+    rc.create("resourceclaims", _claim("seed"))
+    inf = Informer(_Client())
+    got = []
+    inf.add_handlers(on_add=lambda o: got.append(o["metadata"]["name"]))
+    inf.start()
+    assert inf.wait_synced(5.0)
+    rc.create("resourceclaims", _claim("streamed"))
+    deadline = time.monotonic() + 5.0
+    while "streamed" not in got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got == ["seed", "streamed"]
+    inf.stop()

@@ -62,12 +62,26 @@ def build_parser() -> EnvArgumentParser:
     p.add_argument("--leader-election", env="LEADER_ELECTION",
                    action="store_true", default=False,
                    help="lease-based leader election; REQUIRED when "
-                        "running more than one replica — the ledger's "
-                        "reservations only coordinate workers inside one "
-                        "process, and verify-on-commit only catches "
-                        "conflicting writers of the SAME claim, so two "
-                        "concurrent allocators could hand one device to "
-                        "two different claims")
+                        "running more than one UNSHARDED replica — the "
+                        "ledger's reservations only coordinate workers "
+                        "inside one process, and verify-on-commit only "
+                        "catches conflicting writers of the SAME claim, "
+                        "so two concurrent allocators could hand one "
+                        "device to two different claims. With "
+                        "--allocator-shards, per-slot leases replace "
+                        "this global lease")
+    p.add_argument("--allocator-shards", env="ALLOCATOR_SHARDS",
+                   type=int, default=0,
+                   help="shard the control plane over N consistent-hash "
+                        "slots (0 = unsharded). Replicas compete for a "
+                        "lease PER SLOT and drain only claims whose "
+                        "candidate pools hash to slots they own — "
+                        "conflict-free scale-out instead of one global "
+                        "leader (docs/allocator.md)")
+    p.add_argument("--shard-ring-seed", env="SHARD_RING_SEED",
+                   type=int, default=0,
+                   help="seed of the rendezvous hash ring; MUST be "
+                        "identical across all replicas")
     p.add_argument("--leader-election-namespace",
                    env="LEADER_ELECTION_NAMESPACE", default="tpu-dra-driver")
     p.add_argument("--identity", env="POD_NAME", default="allocator")
@@ -84,11 +98,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     clients = make_clients(args)
     index_attributes = tuple(
         a.strip() for a in args.index_attributes.split(",") if a.strip())
-    controller = AllocationController(clients, AllocationControllerConfig(
+    config = AllocationControllerConfig(
         driver_name=args.driver_name,
         workers=args.allocator_workers,
         batch_max=args.allocator_batch,
-        index_attributes=index_attributes))
+        index_attributes=index_attributes)
+    shard_wiring = None
+    if args.allocator_shards > 0:
+        from tpu_dra_driver.kube.sharding import ShardRing, shard_slots
+        from tpu_dra_driver.kube.allocation_controller import ShardWiring
+        shard_wiring = ShardWiring(
+            ShardRing(shard_slots(args.allocator_shards),
+                      seed=args.shard_ring_seed),
+            owned=set())
+    controller = AllocationController(clients, config, shard=shard_wiring)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -102,7 +125,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             address, ready_check=lambda: controller.claim_informer.synced)
         debug_server.start()
 
-    if args.leader_election:
+    from tpu_dra_driver.kube.events import EventRecorder
+    recorder = EventRecorder(clients.events,
+                             component="allocation-controller",
+                             host=args.identity)
+    if shard_wiring is not None:
+        # One leader PER SHARD SLOT: the controller starts with nothing
+        # owned and drains whatever slots its leases win; a replica
+        # death expires its slots and survivors take over (hand-off).
+        from tpu_dra_driver.kube.sharding import (
+            ShardLeaseConfig,
+            ShardLeaseManager,
+        )
+        controller.start()
+        manager = ShardLeaseManager(
+            clients.leases, shard_wiring.ring.members,
+            ShardLeaseConfig(namespace=args.leader_election_namespace,
+                             identity=args.identity),
+            on_slots_changed=controller.set_owned_slots,
+            recorder=recorder)
+        manager.start()
+        stop.wait()
+        manager.stop()
+        controller.stop()
+    elif args.leader_election:
         from tpu_dra_driver.kube.leaderelection import (
             LeaderElectionConfig,
             LeaderElector,
@@ -113,7 +159,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  namespace=args.leader_election_namespace,
                                  lease_name="allocation-controller"),
             on_started_leading=controller.start,
-            on_stopped_leading=controller.stop)
+            on_stopped_leading=controller.stop,
+            recorder=recorder)
         elector.start()
         stop.wait()
         elector.stop()

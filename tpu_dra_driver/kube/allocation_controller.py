@@ -27,15 +27,22 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.kube import catalog as catalog_mod
+from tpu_dra_driver.kube import sharding
 from tpu_dra_driver.kube.allocator import Allocator
 from tpu_dra_driver.kube.catalog import DeviceCatalog, UsageLedger
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.kube.informer import Informer
-from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+from tpu_dra_driver.kube.sharding import (
+    CrossShardLedger,
+    ShardRing,
+    ShardRoute,
+)
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import SHARD_OWNED_POOLS, SWALLOWED_ERRORS
 
 log = logging.getLogger(__name__)
 
@@ -57,19 +64,52 @@ class AllocationControllerConfig:
     retry_interval: float = 5.0
 
 
+class ShardWiring:
+    """One controller's view of the sharded control plane: the ring,
+    the slots it currently owns, and a resolver from any slot to the
+    pool-filtered ledger of whoever owns it in this process (the
+    cross-shard reserve's phase-1 targets). ``ledger_for`` defaults to
+    "my own slots only" — :class:`ShardGroup` rewires it across the
+    group's controllers."""
+
+    def __init__(self, ring: ShardRing, owned=(),
+                 ledger_for: Optional[Callable] = None):
+        self.ring = ring
+        self.owned = set(owned)
+        self.ledger_for = ledger_for
+
+
 class AllocationController:
-    """Drains pending ResourceClaims through batched, indexed allocation."""
+    """Drains pending ResourceClaims through batched, indexed allocation.
+
+    Unsharded (``shard=None``) this is the single leader-elected
+    scheduler role. With :class:`ShardWiring` it becomes one shard of a
+    partitioned control plane: only claims whose consistent-hash home is
+    an owned slot are drained, single-shard claims commit conflict-free
+    by construction (their devices' pools all route here), and
+    cross-shard claims run the two-phase reserve in UID order."""
 
     def __init__(self, clients: ClientSets,
-                 config: Optional[AllocationControllerConfig] = None):
+                 config: Optional[AllocationControllerConfig] = None,
+                 shard: Optional[ShardWiring] = None):
         self._clients = clients
         self._config = config or AllocationControllerConfig()
+        self._shard = shard
         self.catalog = DeviceCatalog(
             clients.resource_slices,
             index_attributes=self._config.index_attributes)
         self.claim_informer = Informer(clients.resource_claims)
+        pool_filter = None
+        if shard is not None:
+            if shard.ledger_for is None:
+                shard.ledger_for = self._own_ledger_for
+            # reads shard.owned LIVE, so a slot hand-off changes what
+            # this ledger accounts for (set_owned_slots re-derives)
+            pool_filter = (lambda pool:
+                           self._shard.ring.owner(pool) in self._shard.owned)
         self.ledger = UsageLedger(self._config.driver_name,
-                                  self.catalog.get_device)
+                                  self.catalog.get_device,
+                                  pool_filter=pool_filter)
         self.allocator = Allocator(
             clients, self._config.driver_name,
             catalog=self.catalog, ledger=self.ledger,
@@ -77,13 +117,25 @@ class AllocationController:
         self._cond = threading.Condition()
         self._pending: Dict[_Key, None] = {}       # ordered dedupe
         self._parked: Dict[_Key, None] = {}
+        #: cross-shard routes for pending/parked claims, by key
+        self._cross_routes: Dict[_Key, ShardRoute] = {}
+        self._cross_allocators: Dict[Tuple[str, ...], Allocator] = {}
+        self._published_slots: Set[str] = set()
+        # route cache: reused until the catalog version moves
+        self._route_snap = None
         self._inflight = 0
         # set by slice events, consumed by a worker before its next
         # batch: an event storm (fleet-wide republish) coalesces into
         # ONE ledger counter recompute instead of one per event
         self._fleet_dirty = False
+        # sharded analog: slice events can shift ring ownership, so the
+        # whole store re-routes — coalesced the same way
+        self._routes_dirty = False
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+
+    def _own_ledger_for(self, slot: str):
+        return self.ledger if slot in self._shard.owned else None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -105,14 +157,23 @@ class AllocationController:
         self.claim_informer.start()
         self.catalog.wait_synced()
         self.claim_informer.wait_synced()
+        self._publish_owned_pools()
         for i in range(max(1, self._config.workers)):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"allocator-worker-{i}")
             t.start()
             self._threads.append(t)
-        log.info("allocation controller started (%d workers, batch<=%d, "
-                 "indexes=%s)", self._config.workers, self._config.batch_max,
-                 ",".join(self._config.index_attributes))
+        if self._shard is not None:
+            log.info("allocation controller started (shard slots %s of "
+                     "ring %s, %d workers, batch<=%d)",
+                     sorted(self._shard.owned),
+                     list(self._shard.ring.members),
+                     self._config.workers, self._config.batch_max)
+        else:
+            log.info("allocation controller started (%d workers, "
+                     "batch<=%d, indexes=%s)",
+                     self._config.workers, self._config.batch_max,
+                     ",".join(self._config.index_attributes))
 
     def stop(self) -> None:
         self._stop.set()
@@ -123,6 +184,67 @@ class AllocationController:
         self.claim_informer.stop()
         self.catalog.stop()
 
+    # -- shard routing -----------------------------------------------------
+
+    def _route(self, obj: Dict) -> Optional[ShardRoute]:
+        """Where this claim belongs on the ring (None when unsharded).
+        The routing snapshot is cached per catalog version — one index
+        copy per fleet change, not one per claim event."""
+        if self._shard is None:
+            return None
+        snap = self._route_snap
+        if snap is None or snap.version != self.catalog.version:
+            snap = self._route_snap = self.catalog.snapshot()
+        return sharding.route_claim(obj, snap, self._config.driver_name,
+                                    self._shard.ring)
+
+    def set_owned_slots(self, slots: Set[str]) -> None:
+        """Shard hand-off: adopt a new owned-slot set (driven by the
+        ShardLeaseManager, or directly in drills). Re-derives the
+        ledger's pool accounting and re-scans the claim store so claims
+        that now route here get drained — the claims a LOST slot strips
+        away simply stop matching in _on_claim and fall out of the
+        queues at batch time."""
+        if self._shard is None:
+            raise RuntimeError("controller is not sharded")
+        before = set(self._shard.owned)
+        self._shard.owned = set(slots)
+        self._cross_allocators.clear()
+        # same closure, fresh aggregates: the filter reads shard.owned
+        self.ledger.set_pool_filter(
+            lambda pool: self._shard.ring.owner(pool) in self._shard.owned)
+        self._publish_owned_pools()
+        if self.claim_informer.synced:
+            self._rescan_claims()
+        log.info("shard slots changed: %s -> %s",
+                 sorted(before), sorted(slots))
+
+    def _rescan_claims(self) -> None:
+        """Re-route every unallocated claim in the informer store —
+        the reconcile pass after a hand-off or a fleet change that can
+        shift ring ownership of candidate pools."""
+        for obj in self.claim_informer.list():
+            if not (obj.get("status") or {}).get("allocation"):
+                self._on_claim(obj)
+
+    def _publish_owned_pools(self) -> None:
+        if self._shard is None:
+            return
+        snap = self._route_snap
+        if snap is None or snap.version != self.catalog.version:
+            snap = self._route_snap = self.catalog.snapshot()
+        # slots owned before but not anymore must drop to 0, or an
+        # ex-owner keeps exporting stale pool counts after a hand-off
+        counts: Dict[str, int] = {
+            s: 0 for s in self._shard.owned | self._published_slots}
+        for pool in {e.pool for e in snap.devices.values()}:
+            slot = self._shard.ring.owner(pool)
+            if slot in counts and slot in self._shard.owned:
+                counts[slot] += 1
+        for slot, n in counts.items():
+            SHARD_OWNED_POOLS.labels(slot).set(n)
+        self._published_slots = set(self._shard.owned)
+
     # -- informer handlers -------------------------------------------------
 
     def _on_claim(self, obj: Dict) -> None:
@@ -132,8 +254,22 @@ class AllocationController:
             with self._cond:
                 self._pending.pop(key, None)
                 self._parked.pop(key, None)
+                self._cross_routes.pop(key, None)
+            return
+        route = self._route(obj)
+        if route is not None and route.home not in self._shard.owned:
+            # another shard's claim: drop any queue residue (a fleet
+            # change may have re-routed it away from us mid-park)
+            with self._cond:
+                self._pending.pop(key, None)
+                self._parked.pop(key, None)
+                self._cross_routes.pop(key, None)
             return
         with self._cond:
+            if route is not None and route.cross_shard:
+                self._cross_routes[key] = route
+            else:
+                self._cross_routes.pop(key, None)
             self._parked.pop(key, None)
             self._pending[key] = None
             self._cond.notify()
@@ -144,16 +280,40 @@ class AllocationController:
         with self._cond:
             self._pending.pop(key, None)
             self._parked.pop(key, None)
+            self._cross_routes.pop(key, None)
 
     def _on_fleet_change(self) -> None:
         """Slice event: mark the ledger's counter view stale and retry
         parked claims. The recompute itself runs on a worker thread
         right before its next batch (coalesced — a republish wave across
         the fleet costs one recompute, and the informer dispatch thread
-        never blocks on O(claims) work)."""
+        never blocks on O(claims) work). Sharded controllers additionally
+        re-route the whole store (new pools can shift a claim's ring
+        owners) — equally coalesced onto a worker via _routes_dirty,
+        since with the shared watch mux a dispatch-thread stall would
+        delay every informer in the process."""
+        self._route_snap = None
         with self._cond:
             self._fleet_dirty = True
+            if self._shard is not None:
+                self._routes_dirty = True
+                self._cond.notify_all()
+                return
         self._requeue_parked()
+
+    def _maybe_rescan(self) -> None:
+        """Worker-side: one coalesced re-route + gauge refresh for any
+        number of slice events since the last pass."""
+        if self._shard is None:
+            return
+        with self._cond:
+            dirty = self._routes_dirty
+            self._routes_dirty = False
+        if not dirty:
+            return
+        self._publish_owned_pools()
+        if self.claim_informer.synced:
+            self._rescan_claims()
 
     def _requeue_parked(self) -> None:
         with self._cond:
@@ -168,9 +328,12 @@ class AllocationController:
 
     def _take_batch(self) -> List[_Key]:
         """Block until work or stop; pop up to batch_max pending keys.
-        The timed wait doubles as the parked-claim retry backstop."""
+        The timed wait doubles as the parked-claim retry backstop. A
+        pending re-route (_routes_dirty) also ends the wait so the
+        worker loop can run its coalesced rescan."""
         with self._cond:
-            while not self._pending and not self._stop.is_set():
+            while not self._pending and not self._stop.is_set() \
+                    and not self._routes_dirty:
                 timed_out = not self._cond.wait(
                     timeout=self._config.retry_interval)
                 if timed_out and self._parked:
@@ -191,6 +354,7 @@ class AllocationController:
 
     def _worker(self) -> None:
         while not self._stop.is_set():
+            self._maybe_rescan()
             keys = self._take_batch()
             if not keys:
                 continue
@@ -200,17 +364,27 @@ class AllocationController:
                 self._finish_batch()
 
     def _run_batch(self, keys: List[_Key]) -> None:
+        fi.fire("sharding.shard-crash")
         with self._cond:
             fleet_dirty = self._fleet_dirty
             self._fleet_dirty = False
+            cross_keys = {k: self._cross_routes[k]
+                          for k in keys if k in self._cross_routes}
         if fleet_dirty:
             self.ledger.recompute_counters()
         claims = []
+        cross_claims: List[Tuple[Dict, ShardRoute]] = []
         for ns, name in keys:
             obj = self.claim_informer.get(name, ns)
             if obj is None or (obj.get("status") or {}).get("allocation"):
                 continue
-            claims.append(obj)
+            route = cross_keys.get((ns, name))
+            if route is not None:
+                cross_claims.append((obj, route))
+            else:
+                claims.append(obj)
+        if cross_claims:
+            self._run_cross_shard(cross_claims)
         if not claims:
             return
         try:
@@ -225,6 +399,9 @@ class AllocationController:
                     self._parked[(meta.get("namespace", ""),
                                   meta["name"])] = None
             return
+        self._settle_results(claims, results)
+
+    def _settle_results(self, claims: List[Dict], results: Dict) -> None:
         for claim in claims:
             meta = claim["metadata"]
             key = (meta.get("namespace", ""), meta["name"])
@@ -234,6 +411,65 @@ class AllocationController:
                          key[0], key[1], res.error)
                 with self._cond:
                     self._parked[key] = None
+
+    # -- cross-shard lane --------------------------------------------------
+
+    def _cross_allocator(self, route: ShardRoute) -> Optional[Allocator]:
+        """An allocator whose ledger is the two-phase merged view over
+        the route's slots. None when some involved slot's ledger is not
+        reachable in this process (its owner is another replica) — the
+        claim parks and retries after the next hand-off or fleet change."""
+        cached = self._cross_allocators.get(route.slots)
+        if cached is not None:
+            return cached
+        ledgers = {}
+        for slot in route.slots:
+            led = self._shard.ledger_for(slot)
+            if led is None:
+                return None
+            ledgers[slot] = led
+        xledger = CrossShardLedger(ledgers,
+                                   owner_of_pool=self._shard.ring.owner)
+        alloc = Allocator(self._clients, self._config.driver_name,
+                          catalog=self.catalog, ledger=xledger,
+                          index_attributes=self._config.index_attributes)
+        self._cross_allocators[route.slots] = alloc
+        return alloc
+
+    def _run_cross_shard(self,
+                         cross: List[Tuple[Dict, ShardRoute]]) -> None:
+        """Drain cross-shard claims in claim-UID order (deterministic
+        contention outcomes) through per-route merged-ledger allocators."""
+        cross.sort(key=lambda pair: pair[0]["metadata"]["uid"])
+        for claim, route in cross:
+            meta = claim["metadata"]
+            key = (meta.get("namespace", ""), meta["name"])
+            alloc = self._cross_allocator(route)
+            if alloc is None:
+                log.info(
+                    "cross-shard claim %s/%s spans slots %s not all owned "
+                    "in-process; parked until ownership converges",
+                    key[0], key[1], list(route.slots))
+                with self._cond:
+                    self._parked[key] = None
+                    self._cross_routes[key] = route
+                continue
+            try:
+                results = alloc.allocate_batch([claim])
+            except Exception:  # chaos-ok: counted; claim re-parks for retry
+                SWALLOWED_ERRORS.labels(
+                    "allocation_controller.cross_shard").inc()
+                log.exception("cross-shard allocation of %s/%s failed",
+                              key[0], key[1])
+                with self._cond:
+                    self._parked[key] = None
+                    self._cross_routes[key] = route
+                continue
+            self._settle_results([claim], results)
+            res = results.get(meta["uid"])
+            if res is not None and res.error is not None:
+                with self._cond:
+                    self._cross_routes[key] = route
 
     # -- introspection -----------------------------------------------------
 
@@ -254,3 +490,72 @@ class AllocationController:
                     return False
                 self._cond.wait(timeout=min(left, 0.05))
             return True
+
+
+class ShardGroup:
+    """N shard controllers over one cluster, wired for cross-shard
+    reserves — the in-process shape of the sharded control plane (the
+    bench, the property/drill tests, and a single-replica deployment
+    that still wants per-shard queues all use it). Production replicas
+    run one controller each and acquire slots through the
+    :class:`~tpu_dra_driver.kube.sharding.ShardLeaseManager` instead."""
+
+    def __init__(self, clients: ClientSets, n_shards: int,
+                 config: Optional[AllocationControllerConfig] = None,
+                 ring_seed: int = sharding.DEFAULT_RING_SEED):
+        self.ring = ShardRing(sharding.shard_slots(n_shards),
+                              seed=ring_seed)
+        self.controllers: Dict[str, AllocationController] = {}
+        for slot in self.ring.members:
+            wiring = ShardWiring(self.ring, owned={slot},
+                                 ledger_for=self._ledger_for)
+            self.controllers[slot] = AllocationController(
+                clients, config, shard=wiring)
+
+    def _ledger_for(self, slot: str):
+        for ctrl in self.controllers.values():
+            if slot in ctrl._shard.owned:
+                return ctrl.ledger
+        return None
+
+    def controller_for(self, slot: str) -> AllocationController:
+        return self.controllers[slot]
+
+    def start(self) -> None:
+        for ctrl in self.controllers.values():
+            ctrl.start()
+
+    def stop(self) -> None:
+        for ctrl in self.controllers.values():
+            ctrl.stop()
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        import time as _time
+        end = _time.monotonic() + timeout
+        return all(ctrl.wait_idle(max(0.01, end - _time.monotonic()))
+                   for ctrl in self.controllers.values())
+
+    def queue_depths(self) -> Tuple[int, int]:
+        pending = parked = 0
+        for ctrl in self.controllers.values():
+            p, k = ctrl.queue_depths()
+            pending += p
+            parked += k
+        return pending, parked
+
+    def hand_off(self, dead_slot: str, to_slot: str) -> None:
+        """Drill helper: move a dead shard's slot to a survivor (what
+        the lease manager does via lease expiry in production). The dead
+        controller must already be stopped; its in-flight reservations
+        die with it — only committed claims (visible via the API server)
+        survive into the new owner's ledger, exactly like a process
+        death."""
+        self.controllers[dead_slot]._shard.owned.discard(dead_slot)
+        survivor = self.controllers[to_slot]
+        survivor.set_owned_slots(survivor._shard.owned | {dead_slot})
+        # EVERY controller's cached cross-shard allocators may hold
+        # merged ledgers bound to the dead controller's (now-empty)
+        # ledger — drop them so the next cross-shard claim rebuilds
+        # against the survivor's via ledger_for
+        for ctrl in self.controllers.values():
+            ctrl._cross_allocators.clear()

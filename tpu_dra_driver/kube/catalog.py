@@ -351,7 +351,8 @@ class _CatalogInformer(Informer):
 
     def _resync(self, items: List[Dict]) -> None:
         super()._resync(items)
-        self._on_relist(items)
+        # the rebuild must see the same filtered view the store keeps
+        self._on_relist([o for o in items if self._accept(o)])
 
 
 class DeviceCatalog:
@@ -362,11 +363,16 @@ class DeviceCatalog:
     the allocator an immutable per-batch view."""
 
     def __init__(self, client: ResourceClient,
-                 index_attributes: Iterable[str] = DEFAULT_INDEX_ATTRIBUTES):
+                 index_attributes: Iterable[str] = DEFAULT_INDEX_ATTRIBUTES,
+                 slice_filter: Optional[Callable[[Dict], bool]] = None):
         self._client = client
         self._mu = threading.Lock()
         self._state = _IndexState(index_attributes)
-        self.informer = _CatalogInformer(client, on_relist=self._on_relist)
+        # A shard replica can scope its catalog to the slices whose pools
+        # it owns (slice_filter on the informer): snapshots, indexes, and
+        # RELIST rebuilds then cost O(owned fleet), not O(whole fleet).
+        self.informer = _CatalogInformer(client, on_relist=self._on_relist,
+                                         object_filter=slice_filter)
         self.informer.add_handlers(on_add=self._on_upsert,
                                    on_update=lambda old, new:
                                    self._on_upsert(new),
@@ -436,12 +442,18 @@ class DeviceCatalog:
 
 
 class _ClaimRecord:
-    __slots__ = ("keys", "counters")
+    __slots__ = ("keys", "counters", "all_keys")
 
     def __init__(self, keys: Tuple[DeviceKey, ...],
-                 counters: Dict[CounterKey, int]):
+                 counters: Dict[CounterKey, int],
+                 all_keys: Optional[Tuple[DeviceKey, ...]] = None):
+        #: keys this ledger ACCOUNTS for (pool-filtered under sharding)
         self.keys = keys
         self.counters = counters
+        #: every key the claim holds, unfiltered — conflict checks
+        #: (held_by_other) look here so a foreign-pool device held by
+        #: another claim is still a conflict
+        self.all_keys = keys if all_keys is None else all_keys
 
 
 def claim_allocated_keys(claim: Dict, driver: str) -> Tuple[DeviceKey, ...]:
@@ -464,9 +476,16 @@ class UsageLedger:
     contribution instead of double-counting."""
 
     def __init__(self, driver_name: str,
-                 device_lookup: Callable[[DeviceKey], Optional[Dict]]):
+                 device_lookup: Callable[[DeviceKey], Optional[Dict]],
+                 pool_filter: Optional[Callable[[str], bool]] = None):
         self._driver = driver_name
         self._lookup = device_lookup
+        # Sharding hook: when set, only devices in pools the filter
+        # accepts count toward this ledger's taken/usage aggregates —
+        # each shard's ledger is then the single serialization point for
+        # its own pools, and a cross-shard merged view can sum ledgers
+        # without double counting (kube/sharding.py).
+        self._pool_filter = pool_filter
         self._mu = threading.Lock()
         self._claims: Dict[str, _ClaimRecord] = {}
         self._taken: Dict[DeviceKey, int] = {}
@@ -484,20 +503,27 @@ class UsageLedger:
                               self.observe_claim(new),
                               on_delete=self.forget_claim)
 
+    def _filter_keys(self, keys: Tuple[DeviceKey, ...]
+                     ) -> Tuple[DeviceKey, ...]:
+        if self._pool_filter is None:
+            return keys
+        return tuple(k for k in keys if self._pool_filter(k[0]))
+
     def observe_claim(self, claim: Dict) -> None:
         uid = (claim.get("metadata") or {}).get("uid", "")
         if not uid:
             return
-        keys = claim_allocated_keys(claim, self._driver)
-        if not keys:
+        all_keys = claim_allocated_keys(claim, self._driver)
+        if not all_keys:
             self._forget(uid)
             return
+        keys = self._filter_keys(all_keys)
         counters = sum_counter_consumption(
             (self._lookup(key), key[0]) for key in keys)
         with self._mu:
             self._remove_locked(uid)
             self._release_locked(uid)
-            rec = _ClaimRecord(keys, counters)
+            rec = _ClaimRecord(keys, counters, all_keys=all_keys)
             self._claims[uid] = rec
             self._apply_locked(rec, +1)
 
@@ -522,6 +548,27 @@ class UsageLedger:
                     rec.counters = counters
                     self._apply_locked(rec, +1)
 
+    def set_pool_filter(self,
+                        pool_filter: Optional[Callable[[str], bool]]
+                        ) -> None:
+        """Swap the pool filter and re-derive every claim's accounted
+        contribution (the shard hand-off path: a controller that just
+        acquired a slot starts accounting for its pools)."""
+        with self._mu:
+            self._pool_filter = pool_filter
+            uids = {uid: rec.all_keys for uid, rec in self._claims.items()}
+        for uid, all_keys in uids.items():
+            keys = self._filter_keys(all_keys)
+            counters = sum_counter_consumption(
+                (self._lookup(key), key[0]) for key in keys)
+            with self._mu:
+                rec = self._claims.get(uid)
+                if rec is not None and rec.all_keys == all_keys:
+                    self._apply_locked(rec, -1)
+                    rec.keys = keys
+                    rec.counters = counters
+                    self._apply_locked(rec, +1)
+
     # -- allocation-side reservations -------------------------------------
 
     def reserve(self, uid: str, entries: List[DeviceEntry],
@@ -530,6 +577,12 @@ class UsageLedger:
         they are all still free and their counters still fit under
         ``caps`` given current usage + other reservations. False means
         the worker raced another claim and must re-pick."""
+        if self._pool_filter is not None and any(
+                not self._pool_filter(e.pool) for e in entries):
+            # not this ledger's pool: reservations must serialize through
+            # the OWNING slot's ledger (stale routing re-parks and
+            # re-routes on the next fleet change)
+            return False
         keys = tuple(e.key for e in entries)
         counters = sum_counter_consumption(
             (e.device, e.pool) for e in entries)
@@ -573,7 +626,7 @@ class UsageLedger:
         wanted = set(keys)
         with self._mu:
             for other_uid, rec in self._claims.items():
-                if other_uid != uid and wanted.intersection(rec.keys):
+                if other_uid != uid and wanted.intersection(rec.all_keys):
                     return True
             for other_uid, rec in self._reserved.items():
                 if other_uid != uid and wanted.intersection(rec.keys):

@@ -1025,7 +1025,12 @@ class CompiledSelector:
 # re-raises the same error type/message on every hit without reparsing.
 # ---------------------------------------------------------------------------
 
-COMPILE_CACHE_MAXSIZE = 256
+# Sized for fleet scale: a 1024-node fleet of node-pinned claim
+# selectors is ~1024 distinct hot expressions, and a bound below the
+# working set turns the LRU into a 100%-miss cycle (every allocation
+# re-parses). Compiled closure trees are a few KB, so 4096 entries is
+# single-digit MBs.
+COMPILE_CACHE_MAXSIZE = 4096
 
 _compile_cache: "OrderedDict[str, Any]" = OrderedDict()
 _compile_cache_mu = threading.Lock()

@@ -26,7 +26,7 @@ import threading
 import time
 import uuid as uuidlib
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from tpu_dra_driver.kube.errors import (
     AlreadyExistsError,
@@ -38,6 +38,28 @@ from tpu_dra_driver.kube.errors import (
 
 Object = Dict
 WatchEvent = Tuple[str, Object]  # ("ADDED"|"MODIFIED"|"DELETED", obj)
+
+
+def deep_copy_obj(obj):
+    """Deep copy for JSON-shaped API objects (dict/list/scalar trees).
+
+    ``copy.deepcopy`` pays per-node memo/dispatch machinery for cycle
+    and exotic-type support k8s objects never need; this specialized
+    walk is several times faster and sits on the hottest paths in the
+    control-plane sim — every fake API write, watch push, and informer
+    handler dispatch copies through here. Non-JSON values (a test
+    stashing a tuple or custom object) fall back to copy.deepcopy, so
+    behavior is identical for anything unusual."""
+    cls = obj.__class__
+    if cls is dict:
+        return {k: deep_copy_obj(v) for k, v in obj.items()}
+    if cls is list:
+        return [deep_copy_obj(v) for v in obj]
+    if cls is str or cls is int or cls is float or cls is bool \
+            or obj is None:
+        return obj
+    return copy.deepcopy(obj)
+
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -67,6 +89,33 @@ class _WatchSub:
         # watch-lag histogram (time an event sat queued before dispatch)
         self._events: List[Tuple[WatchEvent, float]] = []
         self._closed = False
+        # Optional wakeup hooks: the watch mux (kube/aio.py) registers a
+        # listener so it can schedule dispatch instead of a consumer
+        # thread blocking in next(); the async REST engine registers one
+        # to cancel its stream task on close. Called on every push and
+        # on close, outside the queue lock — listeners only enqueue or
+        # cancel, never block.
+        self._listeners: List[Callable[[], None]] = []
+
+    def add_listener(self, listener: Callable[[], None]) -> None:
+        """Install a wakeup callback (push/close notification). Fires
+        once immediately when events are already queued (or the sub is
+        already closed), so a late-registering mux never strands a
+        pre-listener backlog."""
+        with self._cond:
+            self._listeners.append(listener)
+            pending = bool(self._events) or self._closed
+        if pending:
+            listener()
+
+    def remove_listener(self, listener: Callable[[], None]) -> None:
+        with self._cond:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def _notify_listeners(self) -> None:
+        for listener in list(self._listeners):
+            listener()
 
     def push(self, ev: WatchEvent) -> None:
         with self._cond:
@@ -74,6 +123,7 @@ class _WatchSub:
                 return
             self._events.append((ev, time.monotonic()))
             self._cond.notify_all()
+        self._notify_listeners()
 
     def next(self, timeout: float = 0.2) -> Optional[WatchEvent]:
         got = self.next_with_ts(timeout=timeout)
@@ -90,10 +140,22 @@ class _WatchSub:
                 return self._events.pop(0)
             return None
 
+    def try_next_with_ts(self) -> Optional[Tuple[WatchEvent, float]]:
+        """Non-blocking pop — the mux worker's drain primitive."""
+        with self._cond:
+            if self._events:
+                return self._events.pop(0)
+            return None
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._events)
+
     def close(self) -> None:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        self._notify_listeners()
 
     @property
     def closed(self) -> bool:
@@ -137,7 +199,7 @@ class FakeCluster:
     def _notify(self, resource: str, ev_type: str, obj: Object) -> None:
         rv = int((obj.get("metadata") or {}).get("resourceVersion") or 0)
         journal = self._journals.setdefault(resource, deque())
-        journal.append((rv, ev_type, copy.deepcopy(obj)))
+        journal.append((rv, ev_type, deep_copy_obj(obj)))
         while len(journal) > self._journal_limit:
             evicted_rv, _, _ = journal.popleft()
             self._journal_trim_rv[resource] = max(
@@ -145,13 +207,13 @@ class FakeCluster:
         labels = (obj.get("metadata") or {}).get("labels") or {}
         for sub in self._subs.get(resource, []):
             if match_label_selector(labels, sub.selector):
-                sub.push((ev_type, copy.deepcopy(obj)))
+                sub.push((ev_type, deep_copy_obj(obj)))
 
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, resource: str, obj: Object) -> Object:
         with self._mu:
-            obj = copy.deepcopy(obj)
+            obj = deep_copy_obj(obj)
             meta = obj.setdefault("metadata", {})
             name = meta.get("name", "")
             if not name:
@@ -171,14 +233,14 @@ class FakeCluster:
             meta.setdefault("generation", 1)
             table[k] = obj
             self._notify(resource, ADDED, obj)
-            return copy.deepcopy(obj)
+            return deep_copy_obj(obj)
 
     def get(self, resource: str, name: str, namespace: str = "") -> Object:
         with self._mu:
             obj = self._table(resource).get(_key(namespace, name))
             if obj is None:
                 raise NotFoundError(f"{resource} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return deep_copy_obj(obj)
 
     def list(self, resource: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None,
@@ -193,7 +255,7 @@ class FakeCluster:
                     continue
                 if name_pattern and not fnmatch.fnmatch(name, name_pattern):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(deep_copy_obj(obj))
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
                                     o["metadata"]["name"]))
             return out
@@ -212,7 +274,7 @@ class FakeCluster:
 
     def update(self, resource: str, obj: Object) -> Object:
         with self._mu:
-            obj = copy.deepcopy(obj)
+            obj = deep_copy_obj(obj)
             meta = obj.get("metadata") or {}
             ns, name = meta.get("namespace", ""), meta.get("name", "")
             k = _key(ns, name)
@@ -242,10 +304,10 @@ class FakeCluster:
             if meta.get("deletionTimestamp") is not None and not meta.get("finalizers"):
                 del table[k]
                 self._notify(resource, DELETED, obj)
-                return copy.deepcopy(obj)
+                return deep_copy_obj(obj)
             table[k] = obj
             self._notify(resource, MODIFIED, obj)
-            return copy.deepcopy(obj)
+            return deep_copy_obj(obj)
 
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
         with self._mu:
@@ -297,7 +359,7 @@ class FakeCluster:
                         continue
                     labels = (obj.get("metadata") or {}).get("labels") or {}
                     if match_label_selector(labels, label_selector):
-                        sub.push((ev_type, copy.deepcopy(obj)))
+                        sub.push((ev_type, deep_copy_obj(obj)))
             self._subs.setdefault(resource, []).append(sub)
             return sub
 

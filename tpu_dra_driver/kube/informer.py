@@ -17,7 +17,14 @@ import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from tpu_dra_driver.kube.client import ResourceClient
-from tpu_dra_driver.kube.fake import ADDED, DELETED, MODIFIED, RELIST, Object
+from tpu_dra_driver.kube.fake import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    RELIST,
+    Object,
+    deep_copy_obj,
+)
 from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg.metrics import (
     INFORMER_LISTER_HITS,
@@ -42,11 +49,16 @@ class Informer:
                  namespace: Optional[str] = None,
                  label_selector: Optional[Dict[str, str]] = None,
                  name_filter: Optional[Callable[[str], bool]] = None,
-                 indexers: Optional[Dict[str, Indexer]] = None):
+                 indexers: Optional[Dict[str, Indexer]] = None,
+                 object_filter: Optional[Callable[[Object], bool]] = None):
         self._client = client
         self._namespace = namespace
         self._selector = label_selector
         self._name_filter = name_filter
+        # content-based accept predicate (e.g. a shard keeping only its
+        # ring-owned pools' slices in store) — client-go gets this from
+        # field selectors; the fake streams everything, so filter here
+        self._object_filter = object_filter
         self._mu = threading.RLock()
         self._store: Dict[_Key, Object] = {}
         self._indexers: Dict[str, Indexer] = dict(indexers or {})
@@ -55,6 +67,7 @@ class Informer:
             name: {} for name in self._indexers}
         self._handlers: List[Tuple[Optional[Callable], Optional[Callable], Optional[Callable]]] = []
         self._thread: Optional[threading.Thread] = None
+        self._mux = None
         self._stop = threading.Event()
         self._sub = None
         self._synced = threading.Event()
@@ -71,7 +84,7 @@ class Informer:
             self._handlers.append((on_add, on_update, on_delete))
             if self._synced.is_set() and on_add:
                 for obj in list(self._store.values()):
-                    on_add(copy.deepcopy(obj))
+                    on_add(deep_copy_obj(obj))
 
     # -- lister -------------------------------------------------------------
 
@@ -79,7 +92,7 @@ class Informer:
         with self._mu:
             self._count_lister_hit()
             obj = self._store.get((namespace or "", name))
-            return copy.deepcopy(obj) if obj is not None else None
+            return deep_copy_obj(obj) if obj is not None else None
 
     def list(self, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None) -> List[Object]:
@@ -96,7 +109,7 @@ class Informer:
                     continue
                 labels = (obj.get("metadata") or {}).get("labels") or {}
                 if match_label_selector(labels, label_selector):
-                    out.append(copy.deepcopy(obj))
+                    out.append(deep_copy_obj(obj))
             return out
 
     def by_index(self, index_name: str, value: str) -> List[Object]:
@@ -104,7 +117,7 @@ class Informer:
         with self._mu:
             self._count_lister_hit()
             keys = self._indices[index_name].get(value) or ()
-            return [copy.deepcopy(self._store[k]) for k in sorted(keys)]
+            return [deep_copy_obj(self._store[k]) for k in sorted(keys)]
 
     def index_values(self, index_name: str) -> List[str]:
         """All values currently present in the named index."""
@@ -129,9 +142,19 @@ class Informer:
             for obj in list(self._store.values()):
                 self._dispatch(ADDED, obj, None)
             self._synced.set()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"informer-{self._client.resource}")
-        self._thread.start()
+        # Event delivery: by default the shared watch mux services this
+        # subscription from its fixed worker pool (N informers ≅ 4
+        # threads, kube/aio.py); TPU_DRA_WATCH_MUX=0 restores the
+        # historical thread-per-informer loop.
+        from tpu_dra_driver.kube import aio
+        if aio.mux_enabled():
+            self._mux = aio.watch_mux()
+            self._mux.add(sub, self._mux_dispatch)
+        else:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"informer-{self._client.resource}")
+            self._thread.start()
 
     def wait_synced(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)
@@ -146,6 +169,9 @@ class Informer:
             self._client.stop_watch(self._sub)
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        if self._mux is not None:
+            self._mux.remove(self._sub, wait=True)
+            self._mux = None
 
     # -- internals ----------------------------------------------------------
 
@@ -154,6 +180,8 @@ class Informer:
         if self._namespace is not None and meta.get("namespace", "") != self._namespace:
             return False
         if self._name_filter is not None and not self._name_filter(meta.get("name", "")):
+            return False
+        if self._object_filter is not None and not self._object_filter(obj):
             return False
         return True
 
@@ -208,36 +236,49 @@ class Informer:
                 if self._sub.closed:
                     return
                 continue
-            ev_type, obj = ev
-            if ev_type == RELIST:
-                # A failed resync must not kill the informer thread: the
-                # store stays at its pre-gap state and the next RELIST
-                # (watch layers relist again after every gap) converges.
-                try:
-                    items = fi.fire("informer.resync",
-                                    payload=obj.get("items"))
-                    self._resync(items or [])
-                except Exception:  # chaos-ok: counted; next RELIST heals
-                    SWALLOWED_ERRORS.labels("informer.resync").inc()
-                    import logging
-                    logging.getLogger(__name__).exception(
-                        "informer resync failed (%s); awaiting next relist",
-                        self._client.resource)
-                continue
-            if not self._accept(obj):
-                continue
-            meta = obj["metadata"]
-            key = (meta.get("namespace", ""), meta["name"])
-            # Store update + dispatch happen under one lock acquisition so
-            # late handler registration (which replays the store under the
-            # same lock) can't interleave and double-deliver.
-            with self._mu:
-                old = self._store.get(key)
-                if ev_type == DELETED:
-                    self._store_pop(key)
-                else:
-                    self._store_set(key, obj)
-                self._dispatch(ev_type, obj, old)
+            self._handle_event(ev)
+
+    def _mux_dispatch(self, ev, pushed_at: float) -> None:
+        """Mux-worker entry point: one event, same semantics as the
+        dedicated-thread loop (the mux serializes per subscription, so
+        the one-event-at-a-time invariant holds here too)."""
+        if self._stop.is_set():
+            return
+        INFORMER_WATCH_LAG.labels(self._client.resource).observe(
+            time.monotonic() - pushed_at)
+        self._handle_event(ev)
+
+    def _handle_event(self, ev) -> None:
+        ev_type, obj = ev
+        if ev_type == RELIST:
+            # A failed resync must not kill the informer: the store
+            # stays at its pre-gap state and the next RELIST (watch
+            # layers relist again after every gap) converges.
+            try:
+                items = fi.fire("informer.resync",
+                                payload=obj.get("items"))
+                self._resync(items or [])
+            except Exception:  # chaos-ok: counted; next RELIST heals
+                SWALLOWED_ERRORS.labels("informer.resync").inc()
+                import logging
+                logging.getLogger(__name__).exception(
+                    "informer resync failed (%s); awaiting next relist",
+                    self._client.resource)
+            return
+        if not self._accept(obj):
+            return
+        meta = obj["metadata"]
+        key = (meta.get("namespace", ""), meta["name"])
+        # Store update + dispatch happen under one lock acquisition so
+        # late handler registration (which replays the store under the
+        # same lock) can't interleave and double-deliver.
+        with self._mu:
+            old = self._store.get(key)
+            if ev_type == DELETED:
+                self._store_pop(key)
+            else:
+                self._store_set(key, obj)
+            self._dispatch(ev_type, obj, old)
 
     def _resync(self, items: List[Object]) -> None:
         """Reconcile the store against a fresh full list after a watch gap
@@ -268,15 +309,15 @@ class Informer:
         for on_add, on_update, on_delete in list(self._handlers):
             try:
                 if ev_type == ADDED and on_add:
-                    on_add(copy.deepcopy(obj))
+                    on_add(deep_copy_obj(obj))
                 elif ev_type == MODIFIED:
                     if on_update:
-                        on_update(copy.deepcopy(old) if old is not None
-                                  else copy.deepcopy(obj), copy.deepcopy(obj))
+                        on_update(deep_copy_obj(old) if old is not None
+                                  else deep_copy_obj(obj), deep_copy_obj(obj))
                     elif on_add:
-                        on_add(copy.deepcopy(obj))
+                        on_add(deep_copy_obj(obj))
                 elif ev_type == DELETED and on_delete:
-                    on_delete(copy.deepcopy(obj))
+                    on_delete(deep_copy_obj(obj))
             except Exception:  # chaos-ok: handler errors must not kill the informer
                 SWALLOWED_ERRORS.labels("informer.handler").inc()
                 import logging

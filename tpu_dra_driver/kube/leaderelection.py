@@ -14,6 +14,13 @@ from typing import Callable, Optional
 
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
+from tpu_dra_driver.pkg.metrics import LEADER_TRANSITIONS
+
+#: Event reasons for lease transitions (client-go's leaderelection
+#: resourcelock emits LeaderElection events the same way) — shard
+#: hand-offs surface in `kubectl get events` through these.
+REASON_LEADER_ELECTED = "LeaderElected"
+REASON_LEADER_LOST = "LeaderLost"
 
 
 @dataclass
@@ -27,22 +34,51 @@ class LeaderElectionConfig:
 
 
 class LeaderElector:
-    """Acquire/renew a Lease object; run callbacks on gain/loss."""
+    """Acquire/renew a Lease object; run callbacks on gain/loss.
+
+    Every transition ticks ``dra_leader_transitions_total`` and, when an
+    event recorder is wired (:meth:`set_recorder`), lands a Kubernetes
+    Event on the Lease object — so a shard hand-off is observable from
+    `kubectl describe lease` without reading any process's logs."""
 
     def __init__(self, leases: ResourceClient, config: LeaderElectionConfig,
                  on_started_leading: Callable[[], None],
-                 on_stopped_leading: Callable[[], None]):
+                 on_stopped_leading: Callable[[], None],
+                 recorder=None):
         self._leases = leases
         self._cfg = config
         self._on_start = on_started_leading
         self._on_stop = on_stopped_leading
+        self._recorder = recorder
         self._stop = threading.Event()
         self._leading = False
         self._thread: Optional[threading.Thread] = None
 
+    def set_recorder(self, recorder) -> None:
+        """Wire an :class:`~tpu_dra_driver.kube.events.EventRecorder`
+        (kept optional so bare test electors stay dependency-free)."""
+        self._recorder = recorder
+
     @property
     def is_leader(self) -> bool:
         return self._leading
+
+    def _transition(self, direction: str) -> None:
+        LEADER_TRANSITIONS.labels(self._cfg.lease_name, direction).inc()
+        if self._recorder is None:
+            return
+        from tpu_dra_driver.kube.events import object_ref
+        ref = object_ref("Lease", self._cfg.lease_name, self._cfg.namespace)
+        if direction == "acquired":
+            self._recorder.normal(
+                ref, REASON_LEADER_ELECTED,
+                f"{self._cfg.identity or 'unknown'} became leader of "
+                f"{self._cfg.lease_name}")
+        else:
+            self._recorder.warning(
+                ref, REASON_LEADER_LOST,
+                f"{self._cfg.identity or 'unknown'} lost leadership of "
+                f"{self._cfg.lease_name}")
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -56,6 +92,7 @@ class LeaderElector:
         if self._leading:
             self._leading = False
             self._release()
+            self._transition("lost")
             self._on_stop()
 
     def _run(self) -> None:
@@ -65,6 +102,7 @@ class LeaderElector:
                 last_renew = time.monotonic()
                 if not self._leading:
                     self._leading = True
+                    self._transition("acquired")
                     self._on_start()
             elif self._leading:
                 # Transient renewal failures (e.g. a resourceVersion conflict
@@ -73,6 +111,7 @@ class LeaderElector:
                 # without a successful renewal (client-go semantics).
                 if time.monotonic() - last_renew > self._cfg.renew_deadline:
                     self._leading = False
+                    self._transition("lost")
                     self._on_stop()
             self._stop.wait(self._cfg.retry_period)
 

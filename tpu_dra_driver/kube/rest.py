@@ -211,8 +211,16 @@ class RestCluster:
 
     def __init__(self, config: RestClusterConfig,
                  breaker: Optional[CircuitBreaker] = None,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 async_watch: Optional[bool] = None):
         self._cfg = config
+        # Watch streams run as coroutines on the shared asyncio loop by
+        # default (no thread per stream, kube/aio.py); pass False or set
+        # TPU_DRA_ASYNC_WATCH=0 for the legacy thread-per-stream loop.
+        if async_watch is None:
+            from tpu_dra_driver.kube import aio
+            async_watch = aio.async_watch_enabled()
+        self._async_watch = async_watch
         self._session = requests.Session()
         if config.token:
             self._session.headers["Authorization"] = f"Bearer {config.token}"
@@ -510,12 +518,24 @@ class RestCluster:
         :meth:`list_and_watch`, which resumes from the list's
         resourceVersion (client-go Reflector semantics)."""
         sub = _WatchSub(label_selector)
-        t = threading.Thread(target=self._watch_loop,
-                             args=(resource, label_selector, sub),
+        self._start_stream(resource, label_selector, sub, "")
+        return sub
+
+    def _start_stream(self, resource: str,
+                      label_selector: Optional[Dict[str, str]],
+                      sub: _WatchSub, resource_version: str) -> None:
+        if self._async_watch:
+            from tpu_dra_driver.kube import aio
+            aio.start_rest_watch(self, resource, label_selector, sub,
+                                 resource_version)
+            return
+        args = (resource, label_selector, sub)
+        if resource_version:
+            args = args + (resource_version,)
+        t = threading.Thread(target=self._watch_loop, args=args,
                              daemon=True, name=f"watch-{resource}")
         t.start()
         self._watch_threads.append(t)
-        return sub
 
     def list_and_watch(self, resource: str, namespace: Optional[str] = None,
                        label_selector: Optional[Dict[str, str]] = None):
@@ -529,11 +549,7 @@ class RestCluster:
         items, rv = self._paged_list(resource, namespace or "",
                                      label_selector)
         sub = _WatchSub(label_selector)
-        t = threading.Thread(target=self._watch_loop,
-                             args=(resource, label_selector, sub, rv),
-                             daemon=True, name=f"watch-{resource}")
-        t.start()
-        self._watch_threads.append(t)
+        self._start_stream(resource, label_selector, sub, rv)
         return items, sub
 
     def stop_watch(self, resource: str, sub: _WatchSub) -> None:
@@ -556,6 +572,18 @@ class RestCluster:
         **relist** — a RELIST event carrying the fresh item set is pushed
         for the informer to diff — and the watch resumes from the list's
         resourceVersion, so deletions during the outage are never lost."""
+        from tpu_dra_driver.pkg.metrics import WATCH_STREAMS_ACTIVE
+        WATCH_STREAMS_ACTIVE.labels("rest-thread").inc()
+        try:
+            self._watch_loop_inner(resource, label_selector, sub,
+                                   resource_version)
+        finally:
+            WATCH_STREAMS_ACTIVE.labels("rest-thread").dec()
+
+    def _watch_loop_inner(self, resource: str,
+                          label_selector: Optional[Dict[str, str]],
+                          sub: _WatchSub,
+                          resource_version: str = "") -> None:
         import time as _time
 
         params: Dict[str, str] = {"watch": "true",

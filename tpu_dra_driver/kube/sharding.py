@@ -1,0 +1,391 @@
+"""Consistent-hash sharding for the allocation control plane.
+
+Reference analog: upstream Kubernetes scales its DRA scheduler the way
+it scales everything — one leader-elected process per controller. A
+fleet serving millions of users needs horizontal allocator scale-out
+(ROADMAP item 4): this module partitions the device fleet over N
+**shard slots** with rendezvous (highest-random-weight) hashing of pool
+names, so
+
+- every pool belongs to exactly one slot, deterministically, in every
+  process (the hash is seeded blake2b — no PYTHONHASHSEED dependence);
+- a claim whose candidate pools all live on one slot routes to that
+  slot and commits conflict-free **by construction** (no other shard
+  will ever touch those devices);
+- membership changes are minimal-disruption: adding or removing one
+  slot only moves the pools that slot wins/loses — rendezvous hashing's
+  defining property — so a resize never triggers a fleet-wide
+  reshuffle;
+- slot → process assignment is dynamic, via a **lease per slot** in the
+  existing leader-election machinery (:class:`ShardLeaseManager`): a
+  shard process death expires its slots' leases and survivors acquire
+  them (hand-off), demoting "one global leader" to "one leader per
+  shard".
+
+Cross-shard claims — selectors whose candidate pools span slots — fall
+back to a claim-UID-ordered two-phase reserve across the owning slots'
+:class:`~tpu_dra_driver.kube.catalog.UsageLedger` instances
+(:class:`CrossShardLedger`): phase 1 reserves each slot's devices in
+ascending slot order (all-or-nothing, rolled back on any failure),
+phase 2 commits the allocation and graduates the reservations. Each
+ledger is pool-filtered, so a device's reservations always serialize
+through its owning slot's ledger — two shards can never double-commit
+one device. Claims are drained in UID order on the cross-shard lane,
+which makes contention outcomes deterministic (the property test pins
+sharded winners == single-allocator winners).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tpu_dra_driver.kube.catalog import (
+    CatalogSnapshot,
+    CounterKey,
+    DeviceEntry,
+    DeviceKey,
+)
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import SHARD_REBALANCES
+
+fi.register("sharding.shard-crash",
+            "one shard's batch drain (crash models a shard process dying "
+            "mid-batch; the rebalance drill asserts its claims re-route "
+            "through lease hand-off with no double-allocation and no "
+            "lost claim)")
+
+DEFAULT_RING_SEED = 0
+
+
+def shard_slots(n: int) -> Tuple[str, ...]:
+    """The canonical slot names for an N-shard ring. Slots are the STABLE
+    ring members; processes come and go via leases."""
+    return tuple(f"shard-{i}" for i in range(n))
+
+
+def _score(member: str, key: str, seed: int) -> int:
+    """Rendezvous weight of ``member`` for ``key`` — seeded blake2b, so
+    identical across processes, interpreters, and restarts."""
+    h = hashlib.blake2b(f"{member}\x00{key}".encode(),
+                        digest_size=8,
+                        salt=seed.to_bytes(8, "little", signed=False))
+    return int.from_bytes(h.digest(), "big")
+
+
+class ShardRing:
+    """Deterministic rendezvous-hash ring over shard slot names.
+
+    ``owner(key)`` is a pure function of (members, seed, key): every
+    process computing it over the same membership agrees, with no shared
+    state and no coordination. Minimal disruption is structural — a
+    key's owner changes only if the new/removed member wins/held that
+    specific key."""
+
+    #: owner() memo bound — pool names are bounded by fleet size, but a
+    #: hostile key stream (claim UIDs also route through here) must not
+    #: grow the memo without limit
+    MEMO_MAX = 65536
+
+    def __init__(self, members: Sequence[str],
+                 seed: int = DEFAULT_RING_SEED):
+        if not members:
+            raise ValueError("ShardRing needs at least one member")
+        if len(set(members)) != len(members):
+            raise ValueError(f"duplicate ring members: {members}")
+        self.members: Tuple[str, ...] = tuple(sorted(members))
+        self.seed = seed
+        # memo: owner() sits on hot paths (per-claim routing, the
+        # ledger's pool filter on every observe/reserve) and keys repeat
+        # heavily — membership is immutable per ring instance, so
+        # entries never invalidate
+        self._memo: Dict[str, str] = {}
+
+    def owner(self, key: str) -> str:
+        """The member that owns ``key`` (highest rendezvous weight; the
+        lexicographically smallest member breaks the astronomically
+        unlikely tie, keeping the function total and deterministic)."""
+        got = self._memo.get(key)
+        if got is not None:
+            return got
+        winner = max(self.members,
+                     key=lambda m: (_score(m, key, self.seed), m))
+        if len(self._memo) < self.MEMO_MAX:
+            self._memo[key] = winner
+        return winner
+
+    def owners(self, keys: Iterable[str]) -> Set[str]:
+        return {self.owner(k) for k in keys}
+
+    def assignment(self, keys: Iterable[str]) -> Dict[str, str]:
+        return {k: self.owner(k) for k in keys}
+
+    def spread(self, keys: Iterable[str]) -> Dict[str, int]:
+        """member -> number of keys it owns (balance introspection)."""
+        out = {m: 0 for m in self.members}
+        for k in keys:
+            out[self.owner(k)] += 1
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Claim routing
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ShardRoute:
+    """Where one claim goes: ``home`` drains it; ``slots`` are every
+    slot whose pools its selectors can reach (len > 1 == cross-shard)."""
+
+    home: str
+    slots: Tuple[str, ...]
+
+    @property
+    def cross_shard(self) -> bool:
+        return len(self.slots) > 1
+
+
+def claim_candidate_pools(claim: Dict, snap: CatalogSnapshot,
+                          driver: str) -> Set[str]:
+    """Every pool a claim's requests could draw devices from, via the
+    same index-probe plan the allocator prunes candidates with — so
+    routing and allocation see the same reachable set. Selector compile
+    errors degrade to the full candidate set (the claim then routes as
+    maximally-cross-shard and its error surfaces at allocation time,
+    once, on exactly one shard)."""
+    from tpu_dra_driver.kube import allocator as allocator_mod
+
+    pools: Set[str] = set()
+    for req in ((claim.get("spec") or {}).get("devices") or {}
+                ).get("requests") or []:
+        selectors = req.get("selectors") or []
+        try:
+            constraints = allocator_mod._index_constraints(selectors, driver)
+        except allocator_mod.AllocationError:
+            constraints = ()
+        entries, _ = snap.candidates(driver, None, constraints)
+        pools.update(e.pool for e in entries)
+    return pools
+
+
+def route_claim(claim: Dict, snap: CatalogSnapshot, driver: str,
+                ring: ShardRing) -> ShardRoute:
+    """Deterministic routing: single-owner claims go to that slot;
+    cross-shard claims get a home picked by rendezvous-hashing the claim
+    UID over the involved slots (so exactly one shard drains it, and
+    every process agrees which). A claim with no reachable pools at all
+    is homed by UID over the full ring — SOME shard must park it and
+    retry when the fleet changes."""
+    pools = claim_candidate_pools(claim, snap, driver)
+    owners = tuple(sorted(ring.owners(pools)))
+    uid = (claim.get("metadata") or {}).get("uid", "")
+    if not owners:
+        return ShardRoute(home=ring.owner(uid), slots=())
+    if len(owners) == 1:
+        return ShardRoute(home=owners[0], slots=owners)
+    sub_ring = ShardRing(owners, seed=ring.seed)
+    return ShardRoute(home=sub_ring.owner(uid), slots=owners)
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard two-phase reserve
+# ---------------------------------------------------------------------------
+
+
+class CrossShardLedger:
+    """A merged usage view over the owning slots' pool-filtered ledgers.
+
+    Implements the ledger protocol the allocator speaks (`snapshot`,
+    `reserve`, `release`, `observe_claim`, `held_by_other`) by fanning
+    out to each slot's :class:`UsageLedger`:
+
+    - ``snapshot`` unions taken-device sets and sums counter usage —
+      correct without double counting because each ledger only accounts
+      pools its filter accepts (disjoint by construction);
+    - ``reserve`` is phase 1 of the two-phase protocol: entries are
+      grouped by owning slot and reserved in ascending slot order,
+      all-or-nothing — any slot's refusal rolls back the slots already
+      reserved. Each device therefore serializes through its owning
+      slot's ledger no matter which shard is allocating;
+    - ``observe_claim`` (phase 2, called by the allocator's commit)
+      graduates the reservations into every ledger's committed record.
+
+    Acquisition order is fixed (slot order) and reserves never block,
+    so there is no deadlock; contention between two cross-shard claims
+    resolves by whoever's phase 1 lands first, with the loser re-parked
+    for retry — and the cross-shard drain lane processes claims in UID
+    order, which makes that outcome deterministic."""
+
+    def __init__(self, ledgers_by_slot: Dict[str, object],
+                 owner_of_pool: Callable[[str], str]):
+        # slot order IS the lock order; dedupe ledgers shared between
+        # slots (one controller owning several slots has one ledger)
+        self._slots = tuple(sorted(ledgers_by_slot))
+        self._ledgers_by_slot = dict(ledgers_by_slot)
+        self._owner_of_pool = owner_of_pool
+        seen: List[object] = []
+        for slot in self._slots:
+            led = self._ledgers_by_slot[slot]
+            if all(led is not s for s in seen):
+                seen.append(led)
+        self._unique_ledgers = tuple(seen)
+
+    # -- reads -------------------------------------------------------------
+
+    def snapshot(self) -> Tuple[Set[DeviceKey], Dict[CounterKey, int]]:
+        taken: Set[DeviceKey] = set()
+        usage: Dict[CounterKey, int] = {}
+        for led in self._unique_ledgers:
+            t, u = led.snapshot()
+            taken |= t
+            for ck, amount in u.items():
+                usage[ck] = usage.get(ck, 0) + amount
+        return taken, usage
+
+    def held_by_other(self, keys: Iterable[DeviceKey], uid: str) -> bool:
+        wanted = list(keys)
+        return any(led.held_by_other(wanted, uid)
+                   for led in self._unique_ledgers)
+
+    # -- two-phase reserve -------------------------------------------------
+
+    def _split(self, entries: List[DeviceEntry]
+               ) -> List[Tuple[object, List[DeviceEntry]]]:
+        by_slot: Dict[str, List[DeviceEntry]] = {}
+        for e in entries:
+            by_slot.setdefault(self._owner_of_pool(e.pool), []).append(e)
+        out: List[Tuple[object, List[DeviceEntry]]] = []
+        for slot in sorted(by_slot):
+            led = self._ledgers_by_slot.get(slot)
+            if led is None:
+                # a slot this process doesn't own: phase 1 cannot reach
+                # its serialization point — refuse, the claim re-parks
+                return []
+            for existing, batch in out:
+                if existing is led:
+                    batch.extend(by_slot[slot])
+                    break
+            else:
+                out.append((led, list(by_slot[slot])))
+        return out
+
+    def reserve(self, uid: str, entries: List[DeviceEntry],
+                caps: Dict[CounterKey, int]) -> bool:
+        groups = self._split(entries)
+        if not groups and entries:
+            return False
+        reserved: List[object] = []
+        for led, batch in groups:
+            if not led.reserve(uid, batch, caps):
+                for done in reserved:
+                    done.release(uid)
+                return False
+            reserved.append(led)
+        return True
+
+    def release(self, uid: str) -> None:
+        for led in self._unique_ledgers:
+            led.release(uid)
+
+    def observe_claim(self, claim: Dict) -> None:
+        # phase 2: every involved ledger observes the committed claim
+        # (its pool filter keeps only its own share); observe_claim
+        # also clears that ledger's reservation for the uid
+        for led in self._unique_ledgers:
+            led.observe_claim(claim)
+
+
+# ---------------------------------------------------------------------------
+# Lease-per-slot membership
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ShardLeaseConfig:
+    lease_prefix: str = "allocation-controller"
+    namespace: str = "tpu-dra-driver"
+    identity: str = ""
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+
+class ShardLeaseManager:
+    """Competes for every shard slot's lease; owned slots feed the
+    controller's routing set.
+
+    One elector per slot (the existing
+    :class:`~tpu_dra_driver.kube.leaderelection.LeaderElector`, lease
+    name ``<prefix>-<slot>``). A healthy N-replica deployment converges
+    to each replica holding some subset of slots; a replica's death
+    expires its leases within ``lease_duration`` and the survivors'
+    electors acquire them — the hand-off is just leader election, per
+    shard. Every acquisition/loss ticks ``dra_shard_rebalances_total``
+    and invokes ``on_slots_changed`` with the new owned set."""
+
+    def __init__(self, leases, slots: Sequence[str],
+                 config: Optional[ShardLeaseConfig] = None,
+                 on_slots_changed: Optional[Callable[[Set[str]], None]]
+                 = None,
+                 recorder=None):
+        from tpu_dra_driver.kube.leaderelection import (
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+        self._cfg = config or ShardLeaseConfig()
+        self._on_changed = on_slots_changed
+        # reentrant: the slots-changed callback runs under this lock
+        # (ordering guarantee) and may read owned_slots()
+        self._mu = threading.RLock()
+        self._owned: Set[str] = set()
+        self._electors: Dict[str, LeaderElector] = {}
+        for slot in slots:
+            lease_cfg = LeaderElectionConfig(
+                lease_name=f"{self._cfg.lease_prefix}-{slot}",
+                namespace=self._cfg.namespace,
+                identity=self._cfg.identity,
+                lease_duration=self._cfg.lease_duration,
+                renew_deadline=self._cfg.renew_deadline,
+                retry_period=self._cfg.retry_period)
+            self._electors[slot] = LeaderElector(
+                leases, lease_cfg,
+                on_started_leading=lambda s=slot: self._gained(s),
+                on_stopped_leading=lambda s=slot: self._lost(s),
+                recorder=recorder)
+
+    def _transition(self, slot: str, direction: str) -> None:
+        """Mutate + notify under ONE lock so concurrent per-slot elector
+        threads can't deliver owned-set snapshots out of order (a stale
+        snapshot arriving last would leave the controller not draining
+        a slot whose lease this process holds and renews). The callback
+        (set_owned_slots) never calls back into the manager, so holding
+        the lock across it is safe."""
+        with self._mu:
+            if direction == "acquired":
+                self._owned.add(slot)
+            else:
+                self._owned.discard(slot)
+            SHARD_REBALANCES.labels(slot, direction).inc()
+            if self._on_changed is not None:
+                self._on_changed(set(self._owned))
+
+    def _gained(self, slot: str) -> None:
+        self._transition(slot, "acquired")
+
+    def _lost(self, slot: str) -> None:
+        self._transition(slot, "lost")
+
+    def owned_slots(self) -> Set[str]:
+        with self._mu:
+            return set(self._owned)
+
+    def start(self) -> None:
+        for elector in self._electors.values():
+            elector.start()
+
+    def stop(self) -> None:
+        for elector in self._electors.values():
+            elector.stop()

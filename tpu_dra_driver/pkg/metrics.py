@@ -519,6 +519,46 @@ INFORMER_WATCH_LAG = DEFAULT_REGISTRY.histogram(
     "dra_informer_watch_lag_seconds",
     "Time a watch event waited between arrival and informer dispatch",
     ("resource",))
+
+
+# ---------------------------------------------------------------------------
+# Sharded control plane + multiplexed watch layer (consistent-hash
+# allocator shards, kube/sharding.py; selector/asyncio watch mux,
+# kube/aio.py). The shard gauges are the hand-off proof surface: a
+# rebalance drill asserts ownership moved by watching
+# dra_shard_rebalances_total tick while dra_shard_owned_pools converges
+# on the survivor.
+# ---------------------------------------------------------------------------
+
+SHARD_OWNED_POOLS = DEFAULT_REGISTRY.gauge(
+    "dra_shard_owned_pools",
+    "Device pools currently routed to this process by the consistent-"
+    "hash ring, by owned shard slot",
+    ("slot",))
+SHARD_REBALANCES = DEFAULT_REGISTRY.counter(
+    "dra_shard_rebalances_total",
+    "Shard-slot ownership transitions observed by this process "
+    "(direction=acquired when a slot lease was won, lost when "
+    "leadership lapsed or was handed off)",
+    ("slot", "direction"))
+LEADER_TRANSITIONS = DEFAULT_REGISTRY.counter(
+    "dra_leader_transitions_total",
+    "Lease-based leadership transitions, by lease name and direction "
+    "(acquired/lost) — shard hand-offs and controller fail-overs both "
+    "land here",
+    ("lease", "direction"))
+WATCH_STREAMS_ACTIVE = DEFAULT_REGISTRY.gauge(
+    "dra_watch_streams_active",
+    "Watch subscriptions currently open, by transport: mux (fake/REST "
+    "subs serviced by the shared watch mux), rest-thread (legacy "
+    "thread-per-stream REST watches), rest-async (asyncio REST "
+    "streams on the shared event loop)",
+    ("transport",))
+WATCH_MUX_LAG = DEFAULT_REGISTRY.histogram(
+    "dra_watch_mux_lag_seconds",
+    "Time from a watch event being pushed onto its subscription queue "
+    "to the mux worker handing it to the informer (the event-to-handler "
+    "window the thread-per-stream architecture paid a thread to bound)")
 INFORMER_LISTER_HITS = DEFAULT_REGISTRY.counter(
     "dra_informer_lister_hits_total",
     "Lister reads served from informer stores (each replaces an API "
