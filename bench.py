@@ -1038,6 +1038,82 @@ def bench_recovery(rounds: int = 3) -> dict:
     }
 
 
+def bench_fleet_scenarios() -> dict:
+    """Fleet-lifecycle scenarios at fleet scale (ISSUE 8): the four
+    whole-fleet lifecycle drills — node drain choreography, health-event
+    storm, rolling driver upgrade under live traffic, autoscaler churn
+    with a shard hand-off — each run with convergence invariants
+    asserted at every step boundary (no double-allocated device, no
+    leaked sub-slice, no lost claim, health/CDs re-converged, no watcher
+    leak). Recorded per scenario: step timings, convergence latencies,
+    and the claim-to-ready p50/p99 of the traffic that kept flowing
+    through the event. tests/test_bench_artifact.py gates the committed
+    figures so a recovery-latency regression fails tier-1."""
+    import shutil
+
+    from tpu_dra_driver.testing.scenarios import (
+        scenario_autoscaler_churn,
+        scenario_health_storm,
+        scenario_node_drain,
+    )
+
+    out = {}
+    tmp = tempfile.mkdtemp(prefix="bench-fleet-drain-")
+    try:
+        out["node_drain"] = scenario_node_drain(tmp)
+        log(f"  node_drain: CD re-converged in "
+            f"{_step_ms(out['node_drain'], 'cd_reconverged'):.0f} ms, "
+            f"traffic p99 {out['node_drain']['traffic']['p99_ms']:.1f} ms")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    tmp = tempfile.mkdtemp(prefix="bench-fleet-storm-")
+    try:
+        out["health_storm"] = scenario_health_storm(
+            tmp, n_nodes=8, storm_nodes=4,
+            resident_claims=12, burst_claims=19)
+        log(f"  health_storm: parked drained in "
+            f"{_step_ms(out['health_storm'], 'parked_drained'):.0f} ms "
+            f"({out['health_storm']['burst_parked_during_storm']} parked "
+            f"at peak)")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    out["autoscaler_churn"] = scenario_autoscaler_churn(
+        n_base_nodes=200, wave_size=100, n_waves=3, n_shards=4,
+        claims_per_wave=128, min_traffic_claims=32)
+    worst = max(w["settle_ms"] for w in out["autoscaler_churn"]["waves"])
+    log(f"  autoscaler_churn: 3 waves of ±100 nodes, worst settle "
+        f"{worst:.0f} ms, traffic p99 "
+        f"{out['autoscaler_churn']['traffic']['p99_ms']:.1f} ms")
+
+    # rolling upgrade runs production subprocesses from the previous
+    # commit's git-archived tree (tests/e2e/fleet.py)
+    e2e_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tests", "e2e")
+    if e2e_dir not in sys.path:
+        sys.path.insert(0, e2e_dir)
+    from fleet import scenario_rolling_upgrade
+    root = tempfile.mkdtemp(prefix="bflt-", dir="/tmp")
+    try:
+        out["rolling_upgrade"] = scenario_rolling_upgrade(root, n_nodes=2)
+        log(f"  rolling_upgrade ({out['rolling_upgrade']['old_ref']} -> "
+            f"HEAD): {out['rolling_upgrade']['traffic']['claims']} claims "
+            f"served, {out['rolling_upgrade']['traffic']['failures']} "
+            f"prepare gaps, handoffs "
+            f"{out['rolling_upgrade']['handoff_ms']} ms")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def _step_ms(report: dict, step: str) -> float:
+    for row in report.get("steps", []):
+        if row["step"] == step:
+            return row["ms"]
+    return float("nan")
+
+
 def bench_observability(n_iters: int = 200_000,
                         render_iters: int = 50) -> dict:
     """Tracing overhead per span site (disabled / sampled-1% / always)
@@ -1520,6 +1596,8 @@ SUMMARY_KEYS = [
     "shard_agg_4x1024x4096", "shard_speedup_4x1024x4096",
     "watch_fanout_p99_ms", "watch_mux_threads",
     "recovery_plugin_kill_ms", "recovery_daemon_kill_ms",
+    "fleet_drain_reconverge_ms", "fleet_storm_clear_ms",
+    "fleet_upgrade_gap_failures", "fleet_churn_p99_ms",
     "trace_disabled_ns", "metrics_render_ms",
     "backend", "devices",
     "matmul_tflops_bf16_steady", "matmul_mfu",
@@ -1665,6 +1743,14 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"  recovery bench failed ({type(e).__name__}: {e})")
 
+    log("[bench] fleet-lifecycle scenarios (drain, health storm, rolling "
+        "upgrade under traffic, autoscaler churn)…")
+    fleet = {}
+    try:
+        fleet = bench_fleet_scenarios()
+    except Exception as e:  # noqa: BLE001
+        log(f"  fleet scenario bench failed ({type(e).__name__}: {e})")
+
     log("[bench] observability overhead (tracing disabled/sampled/always, "
         "/metrics render)…")
     obs = {}
@@ -1779,6 +1865,18 @@ def main() -> int:
             "recovery_daemon_kill_ms":
                 recovery["daemon_kill_reconverge_ms"]}
            if recovery else {}),
+        # fleet-lifecycle scenarios (full step/convergence evidence under
+        # the fleet_scenarios key)
+        "fleet_scenarios": fleet,
+        **({"fleet_drain_reconverge_ms":
+                _step_ms(fleet["node_drain"], "cd_reconverged"),
+            "fleet_storm_clear_ms":
+                _step_ms(fleet["health_storm"], "parked_drained"),
+            "fleet_upgrade_gap_failures":
+                fleet["rolling_upgrade"]["traffic"]["failures"],
+            "fleet_churn_p99_ms":
+                fleet["autoscaler_churn"]["traffic"]["p99_ms"]}
+           if len(fleet) == 4 else {}),
         "vs_baseline_note": (
             (crossproc_note if xp50 is not None else fallback_note)
             + note_tail),

@@ -1,0 +1,253 @@
+"""Rolling driver upgrade under live traffic (fleet scenario 3).
+
+Composes the rolling-update mechanics (tests/test_rolling_update.py:
+unique-per-pod socket names, shared plugin dir, statelessness via the
+shared checkpoint) with the up/downgrade substrate (tests/
+test_updowngrade.py: the previous commit's tree via git-archive executed
+as the OLD production binary over the same state dir) — and keeps claim
+allocate/prepare/release traffic flowing on EVERY node while the fleet
+rolls node by node. The acceptance property is a **zero prepare-gap
+across the whole fleet**: at every instant, the instance kubelet routes
+to serves successfully; not one claim fails to prepare or unprepare
+during any handoff.
+
+Reports through the same :class:`ScenarioRun` contract as the in-process
+scenarios (tpu_dra_driver/testing/scenarios.py); consumed by
+tests/test_fleet_scenarios.py (small) and bench.py
+``bench_fleet_scenarios`` (recorded in BENCH_DETAIL.json).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Tuple
+
+E2E_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(E2E_DIR))
+for p in (E2E_DIR, REPO_ROOT):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+from simcluster import SimCluster, wait_for  # noqa: E402
+
+from tpu_dra_driver import DRIVER_NAME  # noqa: E402
+from tpu_dra_driver.testing.scenarios import (  # noqa: E402
+    InvariantViolation,
+    ScenarioRun,
+    percentile,
+)
+
+CHIP_SELECTOR = [{"cel": {"expression":
+    'device.driver == "tpu.google.com" && '
+    'device.attributes["tpu.google.com"].type == "chip"'}}]
+
+
+def resolve_old_tree(dest_root: str,
+                     refs: Tuple[str, ...] = ("HEAD~1", "HEAD")
+                     ) -> Tuple[str, str]:
+    """Materialize the 'last stable release' tree: the previous commit
+    via git-archive (falling back to HEAD, then to this checkout when
+    git is unavailable — a same-version roll still proves the zero-gap
+    handoff, just not cross-version checkpoint compat)."""
+    for ref in refs:
+        dest = os.path.join(dest_root, f"old-{ref.replace('~', '_')}")
+        os.makedirs(dest, exist_ok=True)
+        try:
+            proc = subprocess.run(
+                f"git archive {ref} | tar -x -C {dest}",
+                shell=True, cwd=REPO_ROOT, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError):
+            continue
+        if proc.returncode == 0 and os.path.isdir(
+                os.path.join(dest, "tpu_dra_driver")):
+            return dest, ref
+    return REPO_ROOT, "worktree"
+
+
+class _NodeHammer:
+    """Per-node claim churn through whatever instance kubelet currently
+    routes to — the 'live traffic' that must never see a prepare gap."""
+
+    def __init__(self, cluster: SimCluster, node, dra_client):
+        self.cluster = cluster
+        self.node = node
+        self.current = [dra_client]      # swapped at handoff, like kubelet
+        self.stop_event = threading.Event()
+        self.failures: List[str] = []
+        self.latencies_ms: List[float] = []
+        self.served = 0
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"hammer-{node.node_name}")
+
+    def _loop(self) -> None:
+        i = 0
+        while not self.stop_event.is_set():
+            name = f"load-{self.node.node_name}-{i}"
+            i += 1
+            try:
+                t0 = time.monotonic()
+                c = self.cluster.create_and_allocate_claim(
+                    name, "ns", [{"name": "t", "count": 1,
+                                  "selectors": CHIP_SELECTOR}],
+                    node_name=self.node.node_name)
+                uid = c["metadata"]["uid"]
+                resp = self.current[0].node_prepare_resources([c])
+                if resp.claims[uid].error:
+                    self.failures.append(
+                        f"{name}: prepare: {resp.claims[uid].error}")
+                    continue
+                self.latencies_ms.append((time.monotonic() - t0) * 1e3)
+                resp = self.current[0].node_unprepare_resources([
+                    {"uid": uid, "namespace": "ns", "name": name}])
+                if resp.claims[uid].error:
+                    self.failures.append(
+                        f"{name}: unprepare: {resp.claims[uid].error}")
+                    continue
+                self.served += 1
+            except Exception as e:  # noqa: BLE001 — a gap IS the finding
+                self.failures.append(f"{name}: {type(e).__name__}: {e}")
+            finally:
+                self.cluster.clients.resource_claims.delete_ignore_missing(
+                    name, "ns")
+
+
+def scenario_rolling_upgrade(root: str, n_nodes: int = 2,
+                             overlap_s: float = 0.4,
+                             min_claims_per_node: int = 3,
+                             old_refs: Tuple[str, ...] = ("HEAD~1", "HEAD")
+                             ) -> Dict:
+    """Roll every node of a sim-cluster fleet from the previous commit's
+    binary to HEAD's, one node at a time, under continuous per-node
+    claim traffic. Zero prepare-gap + cross-version claim continuity."""
+    run = ScenarioRun("rolling_upgrade")
+    old_tree, old_ref = resolve_old_tree(root)
+    run.extra["old_ref"] = old_ref
+    cluster = SimCluster(os.path.join(root, "cluster"))
+    hammers: List[_NodeHammer] = []
+    survivors: Dict[str, Tuple[str, str, List]] = {}
+    try:
+        with run.step("boot_old_fleet"):
+            old_procs = []
+            for i in range(n_nodes):
+                node = cluster.add_node(f"node-{i}", slice_id=f"s-{i}")
+                proc = node.spawn_tpu_plugin(
+                    extra_args=["--rolling-update-uid", f"old-{i}"],
+                    tag="-old", cwd=old_tree)
+                info = node.kubelet.register(DRIVER_NAME,
+                                             instance_uid=f"old-{i}")
+                cluster.wait_resource_slices(DRIVER_NAME, node.node_name)
+                old_procs.append(proc)
+                hammers.append(_NodeHammer(cluster, node,
+                                           node.kubelet.dra_client(info)))
+        with run.step("pin_survivor_claims"):
+            # one long-lived claim per node, prepared by the OLD binary;
+            # the NEW binary must serve its idempotent re-prepare with
+            # identical devices (cross-version checkpoint continuity)
+            for i, node in enumerate(cluster.nodes):
+                name = f"survivor-{i}"
+                claim = cluster.create_and_allocate_claim(
+                    name, "ns", [{"name": "t", "count": 1,
+                                  "selectors": CHIP_SELECTOR}],
+                    node_name=node.node_name)
+                uid = claim["metadata"]["uid"]
+                resp = hammers[i].current[0].node_prepare_resources([claim])
+                if resp.claims[uid].error:
+                    raise InvariantViolation(
+                        f"{name}: old-binary prepare failed: "
+                        f"{resp.claims[uid].error}")
+                survivors[node.node_name] = (
+                    name, uid,
+                    [(d.pool_name, d.device_name)
+                     for d in resp.claims[uid].devices])
+        for h in hammers:
+            h.thread.start()
+        time.sleep(overlap_s)
+
+        handoffs = []
+        for i, node in enumerate(cluster.nodes):
+            with run.step(f"roll_{node.node_name}"):
+                t0 = time.monotonic()
+                node.spawn_tpu_plugin(
+                    extra_args=["--rolling-update-uid", f"new-{i}"],
+                    tag="-new")
+                info = node.kubelet.register(DRIVER_NAME,
+                                             instance_uid=f"new-{i}")
+                new_client = node.kubelet.dra_client(info)
+                # kubelet routes to the newest registration from here on
+                hammers[i].current[0] = new_client
+                time.sleep(overlap_s)     # both instances serving
+                rc = old_procs[i].stop()
+                if rc != 0:
+                    raise InvariantViolation(
+                        f"{node.node_name}: old instance exit rc={rc}")
+                handoffs.append(round((time.monotonic() - t0) * 1e3, 1))
+                # the old pod removed its own sockets on clean shutdown
+                socks = set(os.listdir(node.registry_dir))
+                if f"{DRIVER_NAME}-old-{i}-reg.sock" in socks:
+                    raise InvariantViolation(
+                        f"{node.node_name}: stale old registration socket "
+                        f"survived the roll")
+        run.extra["handoff_ms"] = handoffs
+
+        with run.step("drain_traffic"):
+            deadline = time.monotonic() + 60
+            while any(h.served < min_claims_per_node for h in hammers):
+                if time.monotonic() > deadline:
+                    raise InvariantViolation(
+                        "traffic never reached the per-node minimum "
+                        f"({[h.served for h in hammers]})")
+                time.sleep(0.05)
+            for h in hammers:
+                h.stop_event.set()
+            for h in hammers:
+                h.thread.join(timeout=30)
+
+        with run.step("cross_version_continuity"):
+            for i, node in enumerate(cluster.nodes):
+                name, uid, old_devices = survivors[node.node_name]
+                claim_now = cluster.clients.resource_claims.get(name, "ns")
+                resp = hammers[i].current[0].node_prepare_resources(
+                    [claim_now])
+                if resp.claims[uid].error:
+                    raise InvariantViolation(
+                        f"{name}: re-prepare on the NEW binary failed: "
+                        f"{resp.claims[uid].error}")
+                new_devices = [(d.pool_name, d.device_name)
+                               for d in resp.claims[uid].devices]
+                if new_devices != old_devices:
+                    raise InvariantViolation(
+                        f"{name}: devices changed across the upgrade: "
+                        f"{old_devices} -> {new_devices}")
+                resp = hammers[i].current[0].node_unprepare_resources([
+                    {"uid": uid, "namespace": "ns", "name": name}])
+                if resp.claims[uid].error:
+                    raise InvariantViolation(
+                        f"{name}: unprepare via NEW binary failed: "
+                        f"{resp.claims[uid].error}")
+                wait_for(lambda n=node, u=uid:
+                         not any(u in f for f in os.listdir(n.cdi_root)),
+                         10, "CDI spec removed after cross-version "
+                         "unprepare")
+
+        gap_failures = [f for h in hammers for f in h.failures]
+        latencies = [ms for h in hammers for ms in h.latencies_ms]
+        run.extra["traffic"] = {
+            "claims": sum(h.served for h in hammers),
+            "failures": len(gap_failures),
+            "failure_samples": gap_failures[:3],
+            "p50_ms": round(percentile(latencies, 50), 2),
+            "p99_ms": round(percentile(latencies, 99), 2),
+        }
+        if gap_failures:
+            raise InvariantViolation(
+                f"prepare gap during rolling upgrade: {gap_failures[:3]}")
+    finally:
+        for h in hammers:
+            h.stop_event.set()
+        cluster.teardown()
+    return run.report()

@@ -255,6 +255,73 @@ def test_bench_detail_records_shard_sweep():
         assert key in bench.SUMMARY_KEYS
 
 
+def test_bench_detail_records_fleet_scenarios():
+    """The committed BENCH_DETAIL.json must carry the fleet-lifecycle
+    scenario evidence (ISSUE 8): all four scenarios — node drain, health
+    storm, rolling upgrade under traffic, autoscaler churn — with their
+    step timings, convergence latencies, and the traffic that kept
+    flowing. The bounds are the regression gates: a recovery-latency
+    regression (or any traffic failure, i.e. a prepare gap / lost claim)
+    now fails tier-1 instead of rotting silently in the artifact."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    with open(path) as f:
+        extra = json.load(f)["extra"]
+    fs = extra["fleet_scenarios"]
+    assert set(fs) == {"node_drain", "health_storm", "rolling_upgrade",
+                       "autoscaler_churn"}, fs.keys()
+    for name, rep in fs.items():
+        assert rep["scenario"] == name
+        assert rep["steps"], name
+        assert rep["traffic"]["claims"] > 0, name
+
+    def step_ms(rep, step):
+        for row in rep["steps"]:
+            if row["step"] == step:
+                return row["ms"]
+        raise AssertionError(f"{rep['scenario']}: step {step!r} missing")
+
+    drain = fs["node_drain"]
+    # the full choreography with recorded convergence at every boundary
+    for step in ("drain", "drain_settled", "migrant_replaced",
+                 "cd_reconverged", "parked_drained_after_undrain"):
+        assert step_ms(drain, step) >= 0
+    assert step_ms(drain, "cd_reconverged") < 30_000
+    assert drain["traffic"]["failures"] == 0, drain["traffic"]
+
+    storm = fs["health_storm"]
+    assert storm["burst_parked_during_storm"] >= 1       # overflow parked
+    assert storm["burst_allocated_during_storm"] >= 1    # routed around
+    assert step_ms(storm, "parked_drained") < 30_000     # storm recovery
+    assert step_ms(storm, "parked_events_cleared") >= 0
+    assert storm["traffic"]["failures"] == 0, storm["traffic"]
+
+    upgrade = fs["rolling_upgrade"]
+    # the acceptance property: ZERO prepare-gap across the whole fleet
+    assert upgrade["traffic"]["failures"] == 0, upgrade["traffic"]
+    assert upgrade["traffic"]["claims"] >= 10
+    assert upgrade["handoff_ms"] and all(
+        ms > 0 for ms in upgrade["handoff_ms"])
+    assert step_ms(upgrade, "cross_version_continuity") >= 0
+
+    churn = fs["autoscaler_churn"]
+    assert len(churn["waves"]) >= 3
+    assert all(w["settle_ms"] < 30_000 for w in churn["waves"])
+    # claim-to-ready stays bounded under ±100-node waves + hand-off
+    assert 0 < churn["traffic"]["p99_ms"] < 10_000, churn["traffic"]
+    assert churn["traffic"]["failures"] == 0, churn["traffic"]
+
+    # headline scalars mirrored for the summary line
+    assert extra["fleet_drain_reconverge_ms"] == \
+        step_ms(drain, "cd_reconverged")
+    assert extra["fleet_storm_clear_ms"] == step_ms(storm, "parked_drained")
+    assert extra["fleet_upgrade_gap_failures"] == 0
+    assert extra["fleet_churn_p99_ms"] == churn["traffic"]["p99_ms"]
+    for key in ("fleet_drain_reconverge_ms", "fleet_storm_clear_ms",
+                "fleet_upgrade_gap_failures", "fleet_churn_p99_ms"):
+        assert key in bench.SUMMARY_KEYS
+
+
 def test_bench_detail_records_observability():
     """The committed BENCH_DETAIL.json must carry the observability
     overhead evidence (tracing PR): per-span-site cost in all three

@@ -518,6 +518,75 @@ def test_drill_informer_survives_resync_failure_and_converges():
         inf.stop()
 
 
+def test_drill_sustained_health_flood_brownout_breaker_cycle(tmp_path):
+    """Breaker behavior under a SUSTAINED health flood (fleet scenario
+    satellite): the flood drives republish traffic into a browning-out
+    API server; asserted from the gRPC health endpoint, not internals —
+    SERVING → (flood + brownout) → breaker OPEN → NOT_SERVING →
+    half-open probe on the servicing republish → SERVING again."""
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.testing.apiserver import SimApiServer
+
+    api = SimApiServer().start()
+    try:
+        cluster = RestCluster(
+            RestClusterConfig(server=api.url, verify=False),
+            breaker=CircuitBreaker(failure_threshold=3, reset_timeout=0.3),
+            retry_budget=RetryBudget(capacity=2, refill_per_sec=0.0))
+        clients = ClientSets(cluster=cluster)
+        lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+        plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+            node_name=NODE, state_dir=str(tmp_path / "state"),
+            cdi_root=str(tmp_path / "cdi"),
+            gates=_gates(DeviceHealthCheck=True)))
+        plugin.start()
+        health_srv = DraGrpcServer(plugin, None, "tpu.google.com",
+                                   "localhost:0")
+        health_srv.start()
+        health_cli = DraGrpcClient(f"localhost:{health_srv.dra_port}")
+        try:
+            assert health_cli.health_check() is True      # SERVING
+            # the API server browns out; THEN the health storm hits —
+            # every exclusion republish slams into connection resets
+            fi.arm("rest.request", fi.Rule(mode="fail", first=50))
+            chips = lib.enumerate_chips()
+            for seq, chip in enumerate(chips):
+                lib.inject_health_flood([
+                    HealthEvent(HealthEventKind.HBM_ECC_ERROR, chip.uuid,
+                                i, "storm") for i in range(25)])
+            # the flood coalesced (one republish attempt per chip), the
+            # budget ran dry, the breaker opened: NOT_SERVING end-to-end
+            assert cluster.breaker.state == "open"
+            assert health_cli.health_check() is False     # NOT_SERVING
+            # the plugin survived the storm (no crash-loop): the monitor
+            # holds every chip unhealthy even though publishing failed
+            unhealthy = {d["device"] for d in plugin.device_health()
+                         if not d["healthy"]}
+            assert len(unhealthy) == len(chips)
+            # brownout clears; after the reset timeout ONE half-open
+            # probe (the servicing republish) closes the breaker
+            fi.disarm("rest.request")
+            time.sleep(0.35)
+            assert cluster.breaker.state == "half_open"
+            plugin._republish()
+            assert cluster.breaker.state == "closed"
+            assert health_cli.health_check() is True      # SERVING again
+            # and the republish actually converged: the unhealthy pool
+            # is withdrawn from the scheduler
+            assert all(not s["spec"]["devices"]
+                       for s in clients.resource_slices.list()
+                       if s["spec"].get("nodeName") == NODE
+                       and s["spec"].get("driver") == "tpu.google.com")
+        finally:
+            health_cli.close()
+            health_srv.stop(0)
+            plugin.shutdown()
+    finally:
+        api.stop()
+
+
 # ---------------------------------------------------------------------------
 # ComputeDomain drills: daemon + CD-plugin kill/restart mid-rendezvous
 # ---------------------------------------------------------------------------

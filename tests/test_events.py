@@ -79,6 +79,36 @@ def test_dedupe_recreates_when_aggregated_event_deleted(clients):
     assert obj2["count"] == 1
 
 
+def test_clear_deletes_state_shaped_events_and_allows_reemission(clients):
+    """clear(ref, reason) deletes every matching Event (state-shaped
+    events like AllocationParked must stop showing once the condition
+    drains) and purges the dedupe cache so a re-park emits a FRESH
+    Event with count 1 — while other reasons on the same object and the
+    same reason on other objects are untouched."""
+    rec = ev.EventRecorder(clients.events)
+    for _ in range(3):
+        rec.warning(_claim_ref(), ev.REASON_ALLOCATION_PARKED, "parked")
+    rec.normal(_claim_ref(), ev.REASON_ALLOCATED, "allocated 1 device(s)")
+    other = {"kind": "ResourceClaim", "name": "c2", "namespace": "ns",
+             "uid": "uid-2"}
+    rec.warning(other, ev.REASON_ALLOCATION_PARKED, "parked")
+    assert rec.flush()
+    assert len(clients.events.list()) == 3
+    rec.clear(_claim_ref(), ev.REASON_ALLOCATION_PARKED)
+    assert rec.flush()
+    left = clients.events.list()
+    assert sorted((e["reason"], e["involvedObject"]["uid"])
+                  for e in left) == [("Allocated", "uid-1"),
+                                     ("AllocationParked", "uid-2")]
+    # re-park: a fresh Event, not a count bump on a deleted object
+    rec.warning(_claim_ref(), ev.REASON_ALLOCATION_PARKED, "parked")
+    assert rec.flush()
+    reparked = [e for e in clients.events.list()
+                if e["reason"] == "AllocationParked"
+                and e["involvedObject"]["uid"] == "uid-1"]
+    assert len(reparked) == 1 and reparked[0]["count"] == 1
+
+
 def test_rate_limit_is_per_object(clients):
     """One noisy object drains only ITS bucket (client-go spam-filter
     keying): varying messages defeat dedupe, the per-object budget caps

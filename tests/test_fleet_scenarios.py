@@ -1,0 +1,369 @@
+"""Fleet-lifecycle scenarios in tier-1 (ISSUE 8, ROADMAP item 5).
+
+Each test runs one whole-fleet scenario from the engine
+(tpu_dra_driver/testing/scenarios.py + tests/e2e/fleet.py) at a small,
+deterministic size, with the convergence invariants asserted INSIDE the
+scenario at every step boundary: no double-allocated device, no leaked
+sub-slice, no lost claim (Allocated or parked-with-Event), CDs and
+health endpoints re-converged, and no orphaned watcher threads or mux
+subscriptions. The tests here assert the report shape and the
+scenario-specific outcomes; a violated invariant raises
+InvariantViolation from within the run.
+
+The full-size sweep (hundreds of nodes, multi-wave churn) runs in
+bench.py ``bench_fleet_scenarios`` and is gated via BENCH_DETAIL.json
+by tests/test_bench_artifact.py; the in-between variant is
+@pytest.mark.slow.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tests", "e2e"))
+
+from tpu_dra_driver.kube.allocation_controller import (  # noqa: E402
+    AllocationController,
+    AllocationControllerConfig,
+)
+from tpu_dra_driver.kube.client import ClientSets  # noqa: E402
+from tpu_dra_driver.kube.events import (  # noqa: E402
+    REASON_ALLOCATION_PARKED,
+)
+from tpu_dra_driver.kube.informer import Informer  # noqa: E402
+from tpu_dra_driver.pkg.metrics import (  # noqa: E402
+    ALLOCATOR_PARKED_CLAIMS,
+)
+from tpu_dra_driver.testing.harness import (  # noqa: E402
+    watcher_snapshot,
+    wait_watchers_settled,
+)
+from tpu_dra_driver.testing.scenarios import (  # noqa: E402
+    CHIP_REQUEST,
+    scenario_autoscaler_churn,
+    scenario_health_storm,
+    scenario_node_drain,
+    synthetic_slice,
+)
+
+
+def _steps(report):
+    return {row["step"]: row for row in report["steps"]}
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: node drain choreography
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_node_drain(tmp_path):
+    report = scenario_node_drain(str(tmp_path))
+    steps = _steps(report)
+    # the full choreography ran: cordon+migrate, settle, reschedule,
+    # un-drain, CD re-convergence — each with a recorded latency
+    for required in ("drain", "drain_settled", "migrate",
+                     "migrant_replaced", "undrain", "cd_reconverged",
+                     "parked_drained_after_undrain"):
+        assert required in steps, (required, report)
+    assert steps["drain_settled"]["converge"]
+    assert steps["cd_reconverged"]["ms"] >= 0
+    # both node-pinned workloads were drained off the node (>=: an
+    # in-flight traffic claim prepared on host-1 at the drain instant
+    # legitimately joins the migrated set)
+    assert report["drained_claims"] >= 2
+    # live traffic never saw a failure across the whole drain cycle
+    assert report["traffic"]["failures"] == 0
+    assert report["traffic"]["claims"] > 0
+    assert report["traffic"]["p99_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: health-event storm
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_health_storm(tmp_path):
+    report = scenario_health_storm(str(tmp_path))
+    steps = _steps(report)
+    for required in ("storm", "pools_withdrawn", "storm_routed",
+                     "service_stormed_nodes", "pools_restored",
+                     "parked_drained", "parked_events_cleared"):
+        assert required in steps, (required, report)
+    # the storm actually exceeded healthy capacity: some claims routed
+    # around the unhealthy nodes, the overflow parked operator-visibly
+    assert report["burst_allocated_during_storm"] >= 1
+    assert report["burst_parked_during_storm"] >= 1
+    assert report["storm_events"] >= 100
+    assert report["traffic"]["failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: autoscaler churn (small deterministic tier-1 shape)
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_autoscaler_churn_small(tmp_path):
+    report = scenario_autoscaler_churn(
+        n_base_nodes=12, wave_size=6, n_waves=2, n_shards=2,
+        claims_per_wave=10, min_traffic_claims=8)
+    steps = _steps(report)
+    assert "wave_0_shard_handoff" in steps, report
+    assert len(report["waves"]) == 2
+    for wave in report["waves"]:
+        assert wave["added"] == 6 and wave["removed"] == 6
+        assert wave["settle_ms"] >= 0
+    assert report["traffic"]["claims"] >= 8
+    assert report["traffic"]["failures"] == 0
+    assert report["traffic"]["p99_ms"] > 0
+
+
+@pytest.mark.slow
+def test_scenario_autoscaler_churn_multiwave(tmp_path):
+    """The fuller sweep: more waves, a larger fleet, higher claim load.
+    Slow tier only — tier-1 keeps the fast deterministic subset above;
+    the full-size (hundreds of nodes) variant runs in bench.py."""
+    report = scenario_autoscaler_churn(
+        n_base_nodes=48, wave_size=16, n_waves=4, n_shards=4,
+        claims_per_wave=32, min_traffic_claims=24)
+    assert len(report["waves"]) == 4
+    assert report["traffic"]["failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: rolling driver upgrade under live traffic
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_rolling_upgrade_under_traffic():
+    import shutil
+    import tempfile
+
+    from fleet import scenario_rolling_upgrade
+
+    # short root: unix socket paths cap at ~108 bytes
+    root = tempfile.mkdtemp(prefix="flt-")
+    try:
+        report = scenario_rolling_upgrade(root, n_nodes=2)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    steps = _steps(report)
+    for required in ("boot_old_fleet", "roll_node-0", "roll_node-1",
+                     "cross_version_continuity"):
+        assert required in steps, report
+    # the acceptance property: ZERO prepare-gap across the whole fleet
+    assert report["traffic"]["failures"] == 0, report["traffic"]
+    assert report["traffic"]["claims"] >= 6
+    assert len(report["handoff_ms"]) == 2
+    assert all(ms > 0 for ms in report["handoff_ms"])
+
+
+# ---------------------------------------------------------------------------
+# parked-claim visibility (satellite): Event + gauge, cleared on drain
+# ---------------------------------------------------------------------------
+
+
+def _controller_fleet(devices_per_node=1):
+    clients = ClientSets()
+    clients.resource_slices.create(synthetic_slice("vis-0",
+                                                   devices_per_node))
+    ctrl = AllocationController(
+        clients, AllocationControllerConfig(workers=1, retry_interval=0.3))
+    return clients, ctrl
+
+
+def _claim(clients, name, request=None, namespace="ns"):
+    return clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"devices": {"requests": list(request or CHIP_REQUEST)}},
+    })
+
+
+def _wait(predicate, timeout=10.0, what=""):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        assert time.monotonic() < deadline, f"timed out: {what}"
+        time.sleep(0.01)
+
+
+def test_parked_claim_emits_event_and_gauge_until_fleet_change(tmp_path):
+    """An unsatisfiable claim parks VISIBLY: one deduped
+    AllocationParked Event + the dra_allocator_parked_claims gauge; when
+    capacity arrives and the claim allocates, the Event is deleted and
+    the gauge released."""
+    clients, ctrl = _controller_fleet(devices_per_node=1)
+    g0 = ALLOCATOR_PARKED_CLAIMS.value
+    ctrl.start()
+    try:
+        _claim(clients, "fits")          # takes the only device
+        _claim(clients, "overflow")      # must park
+        _wait(lambda: ctrl.parked_claims() == [("ns", "overflow")],
+              what="overflow parked")
+        assert ALLOCATOR_PARKED_CLAIMS.value - g0 == 1
+
+        def parked_event():
+            ctrl.events.flush(timeout=2.0)
+            return [ev for ev in clients.events.list()
+                    if ev.get("reason") == REASON_ALLOCATION_PARKED]
+        _wait(lambda: len(parked_event()) == 1, what="AllocationParked")
+        ev = parked_event()[0]
+        assert ev["involvedObject"]["name"] == "overflow"
+        assert ev["type"] == "Warning"
+        assert "parked" in ev["message"]
+
+        # retries (the backstop requeues parked claims) must DEDUPE, not
+        # spam: still at most one Event object after several cycles
+        time.sleep(0.8)
+        assert len(parked_event()) == 1
+
+        # the fleet grows; the claim drains -> gauge back, Event deleted
+        clients.resource_slices.create(synthetic_slice("vis-1", 1))
+        _wait(lambda: not ctrl.parked_claims(), what="overflow drained")
+        assert ALLOCATOR_PARKED_CLAIMS.value - g0 == 0
+        _wait(lambda: not parked_event(), what="parked Event cleared")
+    finally:
+        ctrl.stop()
+
+
+def test_parked_claim_deleted_clears_event_and_gauge():
+    clients, ctrl = _controller_fleet(devices_per_node=1)
+    g0 = ALLOCATOR_PARKED_CLAIMS.value
+    ctrl.start()
+    try:
+        _claim(clients, "fits")
+        _claim(clients, "doomed")
+        _wait(lambda: ctrl.parked_claims() == [("ns", "doomed")],
+              what="doomed parked")
+        assert ALLOCATOR_PARKED_CLAIMS.value - g0 == 1
+        clients.resource_claims.delete("doomed", "ns")
+        _wait(lambda: not ctrl.parked_claims(), what="park entry dropped")
+        assert ALLOCATOR_PARKED_CLAIMS.value - g0 == 0
+
+        def parked_events():
+            ctrl.events.flush(timeout=2.0)
+            return [ev for ev in clients.events.list()
+                    if ev.get("reason") == REASON_ALLOCATION_PARKED]
+        _wait(lambda: not parked_events(), what="Event cleared on delete")
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# watcher-leak accounting (satellite): the helper catches planted leaks
+# ---------------------------------------------------------------------------
+
+
+def test_watcher_snapshot_counts_and_settles():
+    clients = ClientSets()
+    baseline = watcher_snapshot(clients)
+    inf = Informer(clients.resource_claims)
+    inf.start()
+    assert inf.wait_synced()
+    grown = watcher_snapshot(clients)
+    assert grown != baseline, "an informer must be visible in the snapshot"
+    inf.stop()
+    wait_watchers_settled(clients, baseline, timeout=5.0,
+                          what="informer stop")
+
+
+def test_wait_watchers_settled_catches_planted_leak():
+    """The negative control: an informer that is never stopped (the
+    orphaned-watcher bug class) must FAIL the settle check, with the
+    leaked counts in the message."""
+    clients = ClientSets()
+    baseline = watcher_snapshot(clients)
+    inf = Informer(clients.resource_claims)
+    inf.start()
+    try:
+        with pytest.raises(AssertionError, match="watcher leak"):
+            wait_watchers_settled(clients, baseline, timeout=0.3,
+                                  what="planted leak")
+    finally:
+        inf.stop()
+
+
+def test_kill_daemon_pod_asserts_watcher_release(tmp_path):
+    """ClusterHarness.kill_daemon_pod now proves the reaped daemon
+    released every watcher before returning (satellite: the leak check
+    is built into the drill primitive every scenario reuses)."""
+    from tpu_dra_driver.testing.harness import ClusterHarness
+
+    h = ClusterHarness(str(tmp_path), accelerator_type="v5p-16",
+                       prepare_budget=15.0)
+    h.start()
+    try:
+        h.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+        uid = h.clients.compute_domains.get(
+            "cd1", "user-ns")["metadata"]["uid"]
+        h.prepare_channel_claims(uid, [0, 1], "w", namespace="user-ns",
+                                 timeout=30.0)
+
+        def cd_ready():
+            st = h.cd_status("cd1", "user-ns")
+            return (st.get("status") == "Ready"
+                    and len(st.get("nodes") or []) == 2)
+        h.wait_for(cd_ready, timeout=15.0, what="CD Ready")
+        victim = h.daemon_pod_names()[0]
+        # the kill itself asserts: replacement booted AND watcher counts
+        # returned exactly to the pre-kill snapshot
+        h.kill_daemon_pod(victim)
+        h.wait_for(cd_ready, timeout=20.0, what="CD Ready after kill")
+    finally:
+        h.stop()
+
+
+# ---------------------------------------------------------------------------
+# node attribute on published devices (drain/churn pinning surface)
+# ---------------------------------------------------------------------------
+
+
+def test_published_devices_carry_node_attribute(tmp_path):
+    from tpu_dra_driver.pkg import featuregates as fg
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="attr-node", state_dir=str(tmp_path / "s"),
+        cdi_root=str(tmp_path / "c"), gates=fg.FeatureGates()))
+    plugin.start()
+    try:
+        devices = [d for s in clients.resource_slices.list()
+                   for d in s["spec"]["devices"]]
+        assert devices
+        for d in devices:
+            assert d["attributes"]["node"] == {"string": "attr-node"}, d
+    finally:
+        plugin.shutdown()
+
+
+def test_cordon_withdraws_and_restores_pool(tmp_path):
+    from tpu_dra_driver.pkg import featuregates as fg
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="cdn", state_dir=str(tmp_path / "s"),
+        cdi_root=str(tmp_path / "c"), gates=fg.FeatureGates()))
+    plugin.start()
+    try:
+        def published():
+            return [d for s in clients.resource_slices.list()
+                    for d in s["spec"]["devices"]]
+        n_full = len(published())
+        assert n_full > 0
+        plugin.set_cordoned(True)
+        assert published() == []
+        assert plugin.cordoned
+        # cordon ≠ unhealthy: the node still serves (health + prepares)
+        assert plugin.healthy()
+        plugin.set_cordoned(False)
+        assert len(published()) == n_full
+    finally:
+        plugin.shutdown()

@@ -387,6 +387,79 @@ def test_dra_metric_families_registered_once_and_documented():
         f"{undocumented}")
 
 
+# ---------------------------------------------------------------------------
+# drill-coverage lint (fleet-scenario PR): every registered fault point is
+# exercised by at least one drill or scenario, or explicitly allowlisted
+# ---------------------------------------------------------------------------
+
+# Fault points drilled OUTSIDE tests/test_chaos_drills.py's ledger.
+_EXTRA_DRILLED = [
+    # tests/test_sharding.py: the shard-crash rebalance drill (kill a
+    # shard mid-batch -> lease hand-off -> survivor allocates all)
+    "sharding.shard-crash",
+]
+
+# Intentional gaps, each with a reason. A point listed here that gains a
+# drill (or disappears from the registry) FAILS the stale check below —
+# the allowlist cannot rot into a blanket waiver.
+_DRILL_ALLOWLIST = {
+    # tpulib long-tail ops: failure surfaces as a per-claim prepare
+    # error through the same TpuLibError path create_subslice drills
+    # end-to-end; a dedicated kill/restart drill per sharing/vfio verb
+    # would re-test identical checkpoint machinery.
+    "tpulib.destroy_subslice",
+    "tpulib.set_timeslice",
+    "tpulib.set_exclusive_mode",
+    "tpulib.allocate_multiprocess_share",
+    "tpulib.release_multiprocess_share",
+    "tpulib.bind_to_vfio",
+    "tpulib.unbind_from_vfio",
+}
+
+
+def test_drill_catalog_coverage_enforced():
+    """Promoted from advisory helper to an enforced gate: a fault point
+    cannot be registered without either a drill/scenario exercising it
+    or an explicit allowlist entry stating why not."""
+    # import every fire-site module so the registry is complete
+    import tpu_dra_driver.computedomain.daemon.daemon  # noqa: F401
+    import tpu_dra_driver.computedomain.plugin.device_state  # noqa: F401
+    import tpu_dra_driver.grpc_api.server  # noqa: F401
+    import tpu_dra_driver.kube.allocator  # noqa: F401
+    import tpu_dra_driver.kube.catalog  # noqa: F401
+    import tpu_dra_driver.kube.informer  # noqa: F401
+    import tpu_dra_driver.kube.rest  # noqa: F401
+    import tpu_dra_driver.kube.sharding  # noqa: F401
+    import tpu_dra_driver.plugin.device_state  # noqa: F401
+    import tpu_dra_driver.plugin.resourceslices  # noqa: F401
+    import tpu_dra_driver.tpulib.fake  # noqa: F401
+    from tpu_dra_driver.pkg import faultinject as fi
+    from tpu_dra_driver.testing.harness import drill_catalog_coverage
+
+    from tests.test_chaos_drills import DRILLED_POINTS
+
+    drilled = list(DRILLED_POINTS) + _EXTRA_DRILLED
+    registered = set(fi.catalog())
+    # scratch points armed by unit tests (p.* etc.) are not production
+    # fault points; the production namespaces are what the gate covers
+    prod = ("rest.", "informer.", "checkpoint.", "plugin.", "cd.",
+            "grpc.", "daemon.", "tpulib.", "allocator.", "catalog.",
+            "resourceslice.", "sharding.")
+    gap = [p for p in drill_catalog_coverage(drilled)
+           if p.startswith(prod)]
+    unaccounted = sorted(set(gap) - _DRILL_ALLOWLIST)
+    assert unaccounted == [], (
+        f"registered fault points with neither a drill nor an allowlist "
+        f"entry: {unaccounted} — add a drill to tests/test_chaos_drills"
+        f".py (and DRILLED_POINTS) or justify the gap in "
+        f"_DRILL_ALLOWLIST")
+    # the allowlist must stay truthful: no entry for a point that is
+    # unregistered or that meanwhile gained a drill
+    stale = sorted(p for p in _DRILL_ALLOWLIST
+                   if p not in registered or p in drilled)
+    assert stale == [], f"stale _DRILL_ALLOWLIST entries: {stale}"
+
+
 def test_no_sleep_polling_in_cd_reconcile_paths():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     offenders = []

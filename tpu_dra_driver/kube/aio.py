@@ -165,6 +165,13 @@ class WatchMux:
     def thread_count(self) -> int:
         return len([t for t in self._threads if t.is_alive()])
 
+    def subscription_count(self) -> int:
+        """Registered subscriptions — the mux half of the watcher-leak
+        invariant (an informer that stopped without remove() leaks its
+        entry here forever)."""
+        with self._cond:
+            return len(self._entries)
+
     def shutdown(self) -> None:
         with self._cond:
             self._stop = True

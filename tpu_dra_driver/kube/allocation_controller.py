@@ -35,6 +35,10 @@ from tpu_dra_driver.kube import sharding
 from tpu_dra_driver.kube.allocator import Allocator
 from tpu_dra_driver.kube.catalog import DeviceCatalog, UsageLedger
 from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.events import (
+    REASON_ALLOCATION_PARKED,
+    EventRecorder,
+)
 from tpu_dra_driver.kube.informer import Informer
 from tpu_dra_driver.kube.sharding import (
     CrossShardLedger,
@@ -42,7 +46,11 @@ from tpu_dra_driver.kube.sharding import (
     ShardRoute,
 )
 from tpu_dra_driver.pkg import faultinject as fi
-from tpu_dra_driver.pkg.metrics import SHARD_OWNED_POOLS, SWALLOWED_ERRORS
+from tpu_dra_driver.pkg.metrics import (
+    ALLOCATOR_PARKED_CLAIMS,
+    SHARD_OWNED_POOLS,
+    SWALLOWED_ERRORS,
+)
 
 log = logging.getLogger(__name__)
 
@@ -114,9 +122,21 @@ class AllocationController:
             clients, self._config.driver_name,
             catalog=self.catalog, ledger=self.ledger,
             index_attributes=self._config.index_attributes)
+        # Parked-claim visibility: an operator must be able to SEE an
+        # unsatisfiable claim from the outside (`kubectl describe` + the
+        # dra_allocator_parked_claims gauge), not just from this
+        # process's queues. One deduped AllocationParked Event per
+        # parked claim, cleared (Event deleted, gauge decremented) when
+        # the claim drains — allocated, deleted, or re-routed away.
+        self.events = EventRecorder(clients.events,
+                                    component="allocation-controller")
         self._cond = threading.Condition()
         self._pending: Dict[_Key, None] = {}       # ordered dedupe
         self._parked: Dict[_Key, None] = {}
+        #: claims in the parked lifecycle (Event emitted, gauge counted);
+        #: unlike _parked this survives retry requeues and only empties
+        #: when the claim actually drains
+        self._parked_refs: Dict[_Key, Dict[str, str]] = {}
         #: cross-shard routes for pending/parked claims, by key
         self._cross_routes: Dict[_Key, ShardRoute] = {}
         self._cross_allocators: Dict[Tuple[str, ...], Allocator] = {}
@@ -183,6 +203,16 @@ class AllocationController:
             t.join(timeout=2.0)
         self.claim_informer.stop()
         self.catalog.stop()
+        # release this controller's share of the process-global parked
+        # gauge (the claims are still parked cluster-wide — their Events
+        # stay; a successor controller re-parks and re-counts them).
+        # Without this, a stopped shard's increments inflate the gauge
+        # forever after a hand-off.
+        with self._cond:
+            for _ in self._parked_refs:
+                ALLOCATOR_PARKED_CLAIMS.dec()
+            self._parked_refs.clear()
+        self.events.flush(timeout=1.0)
 
     # -- shard routing -----------------------------------------------------
 
@@ -208,11 +238,18 @@ class AllocationController:
         if self._shard is None:
             raise RuntimeError("controller is not sharded")
         before = set(self._shard.owned)
-        self._shard.owned = set(slots)
-        self._cross_allocators.clear()
-        # same closure, fresh aggregates: the filter reads shard.owned
-        self.ledger.set_pool_filter(
-            lambda pool: self._shard.ring.owner(pool) in self._shard.owned)
+        # reservations pause across the WHOLE adoption: the live filter
+        # closure reads shard.owned, so the instant `owned` flips the
+        # ledger accepts the acquired pools — but their committed claims
+        # are only accounted once the re-derive below lands. A reserve
+        # slipping into that gap saw committed devices as free.
+        with self.ledger.reservations_paused():
+            self._shard.owned = set(slots)
+            self._cross_allocators.clear()
+            # same closure, fresh aggregates: the filter reads shard.owned
+            self.ledger.set_pool_filter(
+                lambda pool:
+                self._shard.ring.owner(pool) in self._shard.owned)
         self._publish_owned_pools()
         if self.claim_informer.synced:
             self._rescan_claims()
@@ -245,6 +282,39 @@ class AllocationController:
             SHARD_OWNED_POOLS.labels(slot).set(n)
         self._published_slots = set(self._shard.owned)
 
+    # -- parked-claim visibility -------------------------------------------
+
+    def _mark_parked_locked(self, key: _Key, claim: Dict, why: str) -> None:
+        """Call with _cond held: park ``key`` and, on first entry into
+        the parked lifecycle, emit the deduped AllocationParked Event and
+        bump the gauge. Event emission only enqueues (never blocks)."""
+        self._parked[key] = None
+        if key in self._parked_refs:
+            return
+        meta = claim.get("metadata") or {}
+        ref = {"kind": "ResourceClaim", "name": meta.get("name", ""),
+               "namespace": meta.get("namespace", ""),
+               "uid": meta.get("uid", "")}
+        self._parked_refs[key] = ref
+        ALLOCATOR_PARKED_CLAIMS.inc()
+        self.events.warning(ref, REASON_ALLOCATION_PARKED,
+                            f"allocation parked: {why[:240]}")
+
+    def _clear_parked_locked(self, key: _Key) -> None:
+        """Call with _cond held: the claim drained (allocated, deleted,
+        or re-routed to another shard) — delete its AllocationParked
+        Event and release the gauge."""
+        ref = self._parked_refs.pop(key, None)
+        if ref is not None:
+            ALLOCATOR_PARKED_CLAIMS.dec()
+            self.events.clear(ref, REASON_ALLOCATION_PARKED)
+
+    def parked_claims(self) -> List[_Key]:
+        """Claims currently in the parked lifecycle (operator surface;
+        the scenario invariants use it to prove no claim is lost)."""
+        with self._cond:
+            return list(self._parked_refs)
+
     # -- informer handlers -------------------------------------------------
 
     def _on_claim(self, obj: Dict) -> None:
@@ -255,6 +325,7 @@ class AllocationController:
                 self._pending.pop(key, None)
                 self._parked.pop(key, None)
                 self._cross_routes.pop(key, None)
+                self._clear_parked_locked(key)
             return
         route = self._route(obj)
         if route is not None and route.home not in self._shard.owned:
@@ -264,6 +335,7 @@ class AllocationController:
                 self._pending.pop(key, None)
                 self._parked.pop(key, None)
                 self._cross_routes.pop(key, None)
+                self._clear_parked_locked(key)
             return
         with self._cond:
             if route is not None and route.cross_shard:
@@ -272,7 +344,12 @@ class AllocationController:
                 self._cross_routes.pop(key, None)
             self._parked.pop(key, None)
             self._pending[key] = None
-            self._cond.notify()
+            # notify_all, NOT notify: wait_idle() (tests, drain hooks)
+            # waits on this same condition, and a single notify can wake
+            # IT instead of a worker — the claim then sits queued until
+            # the retry backstop. Under the fleet scenarios' sustained
+            # churn that lost wakeup compounded into multi-second stalls.
+            self._cond.notify_all()
 
     def _on_claim_deleted(self, obj: Dict) -> None:
         meta = obj.get("metadata") or {}
@@ -281,6 +358,7 @@ class AllocationController:
             self._pending.pop(key, None)
             self._parked.pop(key, None)
             self._cross_routes.pop(key, None)
+            self._clear_parked_locked(key)
 
     def _on_fleet_change(self) -> None:
         """Slice event: mark the ledger's counter view stale and retry
@@ -396,8 +474,9 @@ class AllocationController:
             with self._cond:
                 for claim in claims:
                     meta = claim["metadata"]
-                    self._parked[(meta.get("namespace", ""),
-                                  meta["name"])] = None
+                    self._mark_parked_locked(
+                        (meta.get("namespace", ""), meta["name"]),
+                        claim, "allocation batch failed; retrying")
             return
         self._settle_results(claims, results)
 
@@ -410,7 +489,7 @@ class AllocationController:
                 log.info("claim %s/%s not allocatable yet: %s",
                          key[0], key[1], res.error)
                 with self._cond:
-                    self._parked[key] = None
+                    self._mark_parked_locked(key, claim, str(res.error))
 
     # -- cross-shard lane --------------------------------------------------
 
@@ -451,7 +530,10 @@ class AllocationController:
                     "in-process; parked until ownership converges",
                     key[0], key[1], list(route.slots))
                 with self._cond:
-                    self._parked[key] = None
+                    self._mark_parked_locked(
+                        key, claim,
+                        f"cross-shard slots {sorted(route.slots)} not all "
+                        f"owned in-process")
                     self._cross_routes[key] = route
                 continue
             try:
@@ -462,7 +544,8 @@ class AllocationController:
                 log.exception("cross-shard allocation of %s/%s failed",
                               key[0], key[1])
                 with self._cond:
-                    self._parked[key] = None
+                    self._mark_parked_locked(
+                        key, claim, "cross-shard allocation failed; retrying")
                     self._cross_routes[key] = route
                 continue
             self._settle_results([claim], results)
@@ -476,6 +559,21 @@ class AllocationController:
     def queue_depths(self) -> Tuple[int, int]:
         with self._cond:
             return len(self._pending), len(self._parked)
+
+    def drain_inflight(self, timeout: float = 5.0) -> bool:
+        """Wait until no batch is mid-flight (pending claims may remain
+        queued). The hand-off fence uses this: a batch started before a
+        slot transfer may still serialize through the pre-transfer
+        merged ledger, and ownership must not move under it."""
+        import time as _time
+        end = _time.monotonic() + timeout
+        with self._cond:
+            while self._inflight:
+                left = end - _time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=min(left, 0.05))
+            return True
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
         """Test helper: wait until no pending or in-flight claims remain
@@ -549,13 +647,71 @@ class ShardGroup:
         controller must already be stopped; its in-flight reservations
         die with it — only committed claims (visible via the API server)
         survive into the new owner's ledger, exactly like a process
-        death."""
-        self.controllers[dead_slot]._shard.owned.discard(dead_slot)
-        survivor = self.controllers[to_slot]
-        survivor.set_owned_slots(survivor._shard.owned | {dead_slot})
+        death.
+
+        Ownership moves behind a FENCE: first the dead slot is revoked
+        and every controller's cached cross-shard allocators dropped
+        (new lookups PARK — ledger_for resolves nobody for the slot),
+        then in-flight batches drain, and only then does the survivor
+        adopt. Without the fence, a batch still running on a THIRD
+        controller kept serializing the slot's pools through the dead
+        controller's ledger while the survivor opened a second
+        serialization point for the same pools — two claims could each
+        win a 'free' reserve for one device and double-allocate it (the
+        fleet churn scenario caught exactly that)."""
+        dead = self.controllers[dead_slot]
+        dead._shard.owned.discard(dead_slot)
         # EVERY controller's cached cross-shard allocators may hold
-        # merged ledgers bound to the dead controller's (now-empty)
-        # ledger — drop them so the next cross-shard claim rebuilds
-        # against the survivor's via ledger_for
+        # merged ledgers bound to the dead controller's ledger — drop
+        # them; until the survivor adopts, ledger_for(dead_slot) is None
+        # and affected claims park ("ownership converges")
         for ctrl in self.controllers.values():
             ctrl._cross_allocators.clear()
+        for ctrl in self.controllers.values():
+            if ctrl is not dead and not ctrl.drain_inflight():
+                # proceeding with a batch still in flight would reopen
+                # the un-fenced window this fence exists to close —
+                # fail the hand-off loudly instead of corrupting
+                raise RuntimeError(
+                    "hand_off fence: in-flight batches did not drain; "
+                    "slot ownership NOT transferred")
+        # second sweep: a batch that was mid-_cross_allocator when the
+        # first sweep ran may have re-cached a pre-revocation allocator
+        for ctrl in self.controllers.values():
+            ctrl._cross_allocators.clear()
+        survivor = self.controllers[to_slot]
+        # adoption barrier: the survivor's ledger becomes the acquired
+        # pools' serialization point the moment set_owned_slots flips —
+        # it must first have OBSERVED every committed allocation, or a
+        # commit that landed just before the hand-off (its MODIFIED
+        # event still queued on the survivor's informer) is invisible
+        # and its devices look free. Production replicas get this
+        # barrier for free from lease-expiry delay; in-process the
+        # hand-off is instant, so wait explicitly.
+        self._await_claims_current(survivor)
+        survivor.set_owned_slots(survivor._shard.owned | {dead_slot})
+
+    @staticmethod
+    def _await_claims_current(ctrl: AllocationController,
+                              timeout: float = 10.0) -> bool:
+        pause = threading.Event()
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            lagging = False
+            for obj in ctrl._clients.resource_claims.list():
+                if not (obj.get("status") or {}).get("allocation"):
+                    continue
+                meta = obj["metadata"]
+                seen = ctrl.claim_informer.get(meta["name"],
+                                               meta.get("namespace", ""))
+                if seen is None or not (seen.get("status") or {}).get(
+                        "allocation"):
+                    lagging = True
+                    break
+            if not lagging:
+                return True
+            pause.wait(0.01)
+        log.warning("hand-off adoption barrier timed out; survivor's "
+                    "claim informer still lags the cluster")
+        return False

@@ -32,6 +32,8 @@ snapshot; this module is that shape for the in-repo allocator:
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from tpu_dra_driver.kube import cel
@@ -52,9 +54,11 @@ DeviceKey = Tuple[str, str]          # (pool name, device name)
 CounterKey = Tuple[str, str, str]    # (pool, counterSet name, counter name)
 
 #: Attribute names indexed by default — the equality keys real claim
-#: selectors discriminate on (chip type/generation, sub-slice shape).
+#: selectors discriminate on (chip type/generation, sub-slice shape, and
+#: node identity: the publisher stamps every device with its node's name,
+#: so scheduler-pinned claims resolve to one pool via an index probe).
 DEFAULT_INDEX_ATTRIBUTES = ("type", "chipType", "subsliceShape",
-                            "generation")
+                            "generation", "node")
 
 
 def attr_value(dev: Dict, name: str):
@@ -442,11 +446,12 @@ class DeviceCatalog:
 
 
 class _ClaimRecord:
-    __slots__ = ("keys", "counters", "all_keys")
+    __slots__ = ("keys", "counters", "all_keys", "rv")
 
     def __init__(self, keys: Tuple[DeviceKey, ...],
                  counters: Dict[CounterKey, int],
-                 all_keys: Optional[Tuple[DeviceKey, ...]] = None):
+                 all_keys: Optional[Tuple[DeviceKey, ...]] = None,
+                 rv: int = -1):
         #: keys this ledger ACCOUNTS for (pool-filtered under sharding)
         self.keys = keys
         self.counters = counters
@@ -454,6 +459,21 @@ class _ClaimRecord:
         #: (held_by_other) look here so a foreign-pool device held by
         #: another claim is still a conflict
         self.all_keys = keys if all_keys is None else all_keys
+        #: resourceVersion of the observation that produced this record
+        #: (-1 = unknown): an OLDER observation of the same claim must
+        #: never overwrite a newer one — the ledger hears about a claim
+        #: from two racing sources (the allocator's commit-side observe
+        #: and the informer dispatch queue), and the informer's stale
+        #: pre-allocation event arriving after the commit used to erase
+        #: the committed record and double-allocate the device
+        self.rv = rv
+
+
+def _claim_rv(claim: Dict) -> int:
+    try:
+        return int((claim.get("metadata") or {}).get("resourceVersion"))
+    except (TypeError, ValueError):
+        return -1
 
 
 def claim_allocated_keys(claim: Dict, driver: str) -> Tuple[DeviceKey, ...]:
@@ -494,6 +514,19 @@ class UsageLedger:
         # devices but not yet committed: uid -> record
         self._reserved: Dict[str, _ClaimRecord] = {}
         self._reserved_keys: Dict[DeviceKey, str] = {}
+        # >0 while reservations are paused (set_pool_filter's re-derive,
+        # or a controller's whole slot-adoption sequence): committed
+        # devices in newly-acquired pools are not all in _taken yet, so
+        # reserve() must fail safe (claims re-park and retry) instead of
+        # treating them as free
+        self._pause_reservations = 0
+        # uids of DELETED claims (bounded FIFO): claim uids are never
+        # reused, so any observation arriving after the delete is stale
+        # by definition. Without this, a descheduled worker's commit-side
+        # observe_claim could land AFTER the informer processed the
+        # claim's DELETED event and resurrect a record for a claim that
+        # no longer exists — a permanently leaked device holding.
+        self._tombstones: "OrderedDict[str, None]" = OrderedDict()
 
     # -- informer feed -----------------------------------------------------
 
@@ -513,19 +546,57 @@ class UsageLedger:
         uid = (claim.get("metadata") or {}).get("uid", "")
         if not uid:
             return
+        with self._mu:
+            if uid in self._tombstones:
+                return      # deleted claim: any later observation is stale
+        rv = _claim_rv(claim)
         all_keys = claim_allocated_keys(claim, self._driver)
         if not all_keys:
-            self._forget(uid)
+            # Unallocated observation: drop any committed contribution
+            # (deallocation) but KEEP an in-flight reservation — the
+            # reservation is allocation-side state owned by the worker
+            # between reserve() and commit, and a stale pre-allocation
+            # event replayed by an informer (another shard's claim
+            # informer, a RELIST resync) must not wipe it. Wiping it
+            # here let a concurrent claim reserve the same device and
+            # DOUBLE-ALLOCATE (caught by the fleet-scenario invariant).
+            # Only forget_claim (a real DELETE) releases reservations.
+            with self._mu:
+                if self._stale_locked(uid, rv):
+                    return
+                self._remove_locked(uid)
+                if rv >= 0:
+                    # keep an empty-keyed marker carrying the
+                    # deallocation's rv: without it, a LATE commit-side
+                    # observe with an older rv (worker descheduled
+                    # across the deallocation) finds no record to
+                    # compare against and resurrects the stale holdings
+                    self._claims[uid] = _ClaimRecord((), {}, all_keys=(),
+                                                     rv=rv)
             return
         keys = self._filter_keys(all_keys)
         counters = sum_counter_consumption(
             (self._lookup(key), key[0]) for key in keys)
         with self._mu:
+            # re-check the tombstone: the claim may have been DELETED
+            # between the entry check and here (the counter lookups run
+            # unlocked) — recording now would resurrect a dead claim's
+            # holdings forever
+            if uid in self._tombstones or self._stale_locked(uid, rv):
+                return
             self._remove_locked(uid)
             self._release_locked(uid)
-            rec = _ClaimRecord(keys, counters, all_keys=all_keys)
+            rec = _ClaimRecord(keys, counters, all_keys=all_keys, rv=rv)
             self._claims[uid] = rec
             self._apply_locked(rec, +1)
+
+    def _stale_locked(self, uid: str, rv: int) -> bool:
+        """True when a recorded observation of ``uid`` is NEWER than
+        ``rv`` — the incoming event is a stale replay and must not win.
+        Unknown versions (-1) are never treated as stale."""
+        existing = self._claims.get(uid)
+        return (existing is not None and rv >= 0
+                and existing.rv >= 0 and rv < existing.rv)
 
     def forget_claim(self, claim: Dict) -> None:
         uid = (claim.get("metadata") or {}).get("uid", "")
@@ -553,21 +624,44 @@ class UsageLedger:
                         ) -> None:
         """Swap the pool filter and re-derive every claim's accounted
         contribution (the shard hand-off path: a controller that just
-        acquired a slot starts accounting for its pools)."""
-        with self._mu:
-            self._pool_filter = pool_filter
-            uids = {uid: rec.all_keys for uid, rec in self._claims.items()}
-        for uid, all_keys in uids.items():
-            keys = self._filter_keys(all_keys)
-            counters = sum_counter_consumption(
-                (self._lookup(key), key[0]) for key in keys)
+        acquired a slot starts accounting for its pools). Reservations
+        are REFUSED for the duration: until the re-derive lands, a
+        device committed in a newly-acquired pool is absent from _taken
+        and would look free — the churn scenario double-allocated
+        through exactly that window. (The derive itself cannot run under
+        _mu: counter lookups take the catalog informer's lock, which
+        dispatch threads hold while calling into this ledger.)"""
+        with self.reservations_paused():
             with self._mu:
-                rec = self._claims.get(uid)
-                if rec is not None and rec.all_keys == all_keys:
-                    self._apply_locked(rec, -1)
-                    rec.keys = keys
-                    rec.counters = counters
-                    self._apply_locked(rec, +1)
+                self._pool_filter = pool_filter
+                uids = {uid: rec.all_keys
+                        for uid, rec in self._claims.items()}
+            for uid, all_keys in uids.items():
+                keys = self._filter_keys(all_keys)
+                counters = sum_counter_consumption(
+                    (self._lookup(key), key[0]) for key in keys)
+                with self._mu:
+                    rec = self._claims.get(uid)
+                    if rec is not None and rec.all_keys == all_keys:
+                        self._apply_locked(rec, -1)
+                        rec.keys = keys
+                        rec.counters = counters
+                        self._apply_locked(rec, +1)
+
+    @contextmanager
+    def reservations_paused(self):
+        """Refuse new reservations for the duration (reentrant): the
+        slot-adoption path wraps its WHOLE sequence — flipping the owned
+        set, dropping cached cross-shard allocators, re-deriving the
+        accounted keys — so no reserve can slip through a half-adopted
+        view and double-allocate a device."""
+        with self._mu:
+            self._pause_reservations += 1
+        try:
+            yield
+        finally:
+            with self._mu:
+                self._pause_reservations -= 1
 
     # -- allocation-side reservations -------------------------------------
 
@@ -587,7 +681,24 @@ class UsageLedger:
         counters = sum_counter_consumption(
             (e.device, e.pool) for e in entries)
         with self._mu:
-            self._release_locked(uid)
+            if self._pause_reservations:
+                # mid-hand-off re-derive: _taken is incomplete for the
+                # acquired pools — fail safe, the claim re-parks
+                return False
+            if uid in self._reserved:
+                # a CONCURRENT allocation attempt for this claim already
+                # holds a reservation (two controllers can briefly both
+                # route a claim home while their catalogs skew during
+                # fleet churn). Releasing-and-replacing here would free
+                # the first attempt's devices WHILE ITS COMMIT IS IN
+                # FLIGHT — a third claim could then reserve one of them
+                # and double-allocate (the churn scenario caught this).
+                # Refuse instead: this attempt fails cleanly, the claim
+                # parks, and the winner's committed allocation re-routes
+                # it out of every queue. Every reserve is paired with a
+                # release/graduation on all code paths, so a refused
+                # attempt can never wedge the claim permanently.
+                return False
             for key in keys:
                 if self._taken.get(key) or key in self._reserved_keys:
                     return False
@@ -619,6 +730,14 @@ class UsageLedger:
             rec = self._claims.get(uid)
             return rec.keys if rec is not None else ()
 
+    def committed_keys(self) -> Set[DeviceKey]:
+        """Device keys held by COMMITTED claims only (no in-flight
+        reservations) — the consistency-invariant surface: committed
+        holdings must exactly mirror the API server's allocated claims,
+        while reservations are transient by design."""
+        with self._mu:
+            return {k for rec in self._claims.values() for k in rec.keys}
+
     def held_by_other(self, keys: Iterable[DeviceKey], uid: str) -> bool:
         """True if any of ``keys`` is held (committed claim or in-flight
         reservation) by a claim other than ``uid`` — the verify-on-commit
@@ -639,6 +758,9 @@ class UsageLedger:
         with self._mu:
             self._remove_locked(uid)
             self._release_locked(uid)
+            self._tombstones[uid] = None
+            while len(self._tombstones) > 4096:
+                self._tombstones.popitem(last=False)
 
     def _remove_locked(self, uid: str) -> None:
         rec = self._claims.pop(uid, None)

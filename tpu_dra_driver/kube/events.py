@@ -55,6 +55,7 @@ WARNING = "Warning"
 # docs/observability.md; tests pin the load-bearing ones).
 REASON_ALLOCATED = "Allocated"
 REASON_ALLOCATION_FAILED = "AllocationFailed"
+REASON_ALLOCATION_PARKED = "AllocationParked"
 REASON_PREPARED = "Prepared"
 REASON_PREPARE_FAILED = "PrepareFailed"
 REASON_UNPREPARED = "Unprepared"
@@ -65,6 +66,10 @@ REASON_VALIDATION_FAILED = "ValidationFailed"
 #: Worker threads exit after this long idle and respawn on demand, so
 #: short-lived recorders (benches, tests) don't accumulate parked threads.
 _WORKER_IDLE_EXIT = 30.0
+
+#: Queue sentinel marking a clear() request (delete emitted Events for an
+#: object+reason) rather than an emission.
+_CLEAR = object()
 
 
 def _rfc3339(ts: float) -> str:
@@ -200,6 +205,30 @@ class EventRecorder:
     def warning(self, involved: Dict, reason: str, message: str) -> None:
         self.event(involved, WARNING, reason, message)
 
+    def clear(self, involved: Dict, reason: str) -> None:
+        """Queue deletion of every Event previously emitted against
+        ``involved`` with ``reason`` — for *state-shaped* events
+        (AllocationParked) whose condition has drained: the Event must
+        stop being what ``kubectl describe`` shows. Async, never raises,
+        never blocks; a later re-emission recreates the Event."""
+        try:
+            ref = (ref_from_obj(involved) if "metadata" in involved
+                   else dict(involved))
+        except Exception:  # chaos-ok: events are advisory, counted
+            _metrics.EVENTS_EMITTED.labels(reason, "error").inc()
+            return
+        with self._qcond:
+            if len(self._queue) >= self._queue_max:
+                _metrics.EVENTS_EMITTED.labels(reason, "dropped").inc()
+                return
+            self._queue.append((_CLEAR, ref, reason))
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, daemon=True,
+                    name=f"event-recorder-{self._component}")
+                self._worker.start()
+            self._qcond.notify_all()
+
     def flush(self, timeout: float = 5.0) -> bool:
         """Block until every queued event is emitted (tests and orderly
         shutdown); True when the queue fully drained in time."""
@@ -227,7 +256,10 @@ class EventRecorder:
                 item = self._queue.popleft()
                 self._inflight += 1
             try:
-                self._emit(*item)
+                if item[0] is _CLEAR:
+                    self._clear_emitted(item[1], item[2])
+                else:
+                    self._emit(*item)
             except Exception:  # chaos-ok: events are advisory, counted
                 _metrics.EVENTS_EMITTED.labels(item[2], "error").inc()
                 log.debug("event %s emission failed", item[2], exc_info=True)
@@ -304,6 +336,32 @@ class EventRecorder:
             while len(self._cache) > self._cache_max:
                 self._cache.popitem(last=False)
         _metrics.EVENTS_EMITTED.labels(reason, "created").inc()
+
+    def _clear_emitted(self, ref: Dict, reason: str) -> None:
+        """Worker side of :meth:`clear`: delete matching Event objects and
+        forget their dedupe entries so a re-park emits fresh."""
+        namespace = ref.get("namespace") or "default"
+        obj_key = ref.get("uid") or f"{namespace}/{ref.get('name', '')}"
+        removed = 0
+        for ev in self._events.list(namespace=namespace):
+            if ev.get("reason") != reason:
+                continue
+            inv = ev.get("involvedObject") or {}
+            match = (inv.get("uid") == ref["uid"] if ref.get("uid")
+                     and inv.get("uid")
+                     else inv.get("name") == ref.get("name")
+                     and inv.get("namespace", "") == ref.get("namespace", ""))
+            if not match:
+                continue
+            self._events.delete_ignore_missing(
+                ev["metadata"]["name"], namespace)
+            removed += 1
+        with self._mu:
+            for key in [k for k in self._cache
+                        if k[0] == obj_key and k[3] == reason]:
+                del self._cache[key]
+        if removed:
+            _metrics.EVENTS_EMITTED.labels(reason, "cleared").inc(removed)
 
     def _bump(self, cached: Dict, key: tuple, now: float) -> bool:
         """Aggregate a repeat onto the existing Event object; False when

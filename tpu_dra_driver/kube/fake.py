@@ -386,6 +386,14 @@ class FakeCluster:
         with self._mu:
             return self._rv
 
+    def active_watch_count(self) -> Dict[str, int]:
+        """Open watch subscriptions by resource — the watcher-leak proof
+        surface: a crashed component's subs must be gone after its
+        restart (testing/harness.py watcher_snapshot / the fleet
+        scenario engine's leak invariant)."""
+        with self._mu:
+            return {r: len(subs) for r, subs in self._subs.items() if subs}
+
     def dump(self) -> Dict[str, List[Object]]:
         with self._mu:
             return {r: self.list(r) for r in self._tables}

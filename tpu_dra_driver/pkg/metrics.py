@@ -485,6 +485,11 @@ ALLOCATOR_COMMIT_CONFLICTS = DEFAULT_REGISTRY.counter(
     "dra_allocator_commit_conflicts_total",
     "Allocation status writes that hit a resourceVersion conflict and "
     "went through verify-on-commit")
+ALLOCATOR_PARKED_CLAIMS = DEFAULT_REGISTRY.gauge(
+    "dra_allocator_parked_claims",
+    "ResourceClaims currently parked as unsatisfiable (no capacity or "
+    "cross-shard ownership not converged), awaiting a fleet change; "
+    "each parked claim also carries an AllocationParked Event")
 RESOURCESLICE_PUBLISHES = DEFAULT_REGISTRY.counter(
     "dra_resourceslice_publishes_total",
     "ResourceSlice API writes actually performed by republish()",
@@ -511,7 +516,8 @@ EVENTS_EMITTED = DEFAULT_REGISTRY.counter(
     "dra_events_emitted_total",
     "Kubernetes Events by emission outcome: created (new Event object), "
     "deduped (count bumped on an existing Event), dropped (rate "
-    "limited), error (API write failed, swallowed)",
+    "limited), cleared (state-shaped Event deleted after its condition "
+    "drained), error (API write failed, swallowed)",
     ("reason", "outcome"))
 
 

@@ -112,6 +112,12 @@ class TpuKubeletPlugin:
                                      component="tpu-kubelet-plugin",
                                      host=config.node_name)
         self._started = False
+        # Drain choreography: a cordoned node withdraws its ENTIRE pool
+        # from the scheduler (republish with every device excluded) while
+        # live claims keep being served — the DRA-level analog of
+        # `kubectl cordon` for device capacity, flipped by the fleet
+        # scenario engine / an operator before migrating claims away.
+        self._cordoned = False
         # device-health stream state (kubelet's v1alpha1.DRAResourceHealth
         # service reads these; KEP-4680): a monotonically bumped version +
         # condvar so watchers wake exactly on changes
@@ -204,13 +210,35 @@ class TpuKubeletPlugin:
         """Devices hidden from the scheduler: all personalities of unhealthy
         chips, plus consistency rules around live vfio bindings (a bound
         chip's runtime personality disappears; enumerate_allocatable already
-        models that, so here only health)."""
+        models that, so here only health). A cordoned node hides its whole
+        pool."""
+        if self._cordoned:
+            return set(self.state.allocatable)
         exclude: Set[str] = set()
         unhealthy = self.health.unhealthy_uuids if self.health else set()
         for name, dev in self.state.allocatable.items():
             if dev.chip.uuid in unhealthy:
                 exclude.add(name)
         return exclude
+
+    @property
+    def cordoned(self) -> bool:
+        return self._cordoned
+
+    def set_cordoned(self, cordoned: bool) -> None:
+        """Flip drain state and republish: cordoned hides every device
+        (new claims route to other nodes; the allocator's catalog sees
+        an empty pool), uncordoned restores the full inventory. Already-
+        prepared claims are untouched — draining them is the scenario
+        choreography's job (unprepare + deallocate), not the publisher's."""
+        if self._cordoned == cordoned:
+            return
+        self._cordoned = cordoned
+        log.warning("node %s %s: republishing %s",
+                    self._config.node_name,
+                    "cordoned" if cordoned else "uncordoned",
+                    "empty pool" if cordoned else "full inventory")
+        self._republish()
 
     def _on_unhealthy(self, chip_uuid: str) -> None:
         log.warning("republishing slices without unhealthy chip %s", chip_uuid)

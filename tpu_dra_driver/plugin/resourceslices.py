@@ -55,12 +55,21 @@ LAYOUT_COMBINED = "combined"
 LAYOUT_SPLIT = "split"
 
 
-def _device_entry(dev: AllocatableDevice, with_counters: bool) -> Dict:
+def _device_entry(dev: AllocatableDevice, with_counters: bool,
+                  node_name: str = "") -> Dict:
     entry: Dict = {
         "name": dev.canonical_name,
         "attributes": dev.attributes(),
         "capacity": dev.capacity(),
     }
+    if node_name:
+        # node identity as a selectable attribute: DRA CEL selectors
+        # cannot reach spec.pool/nodeName, so node-targeted claims (the
+        # drain/churn scenarios, operators pinning diagnostics jobs)
+        # need it ON the device — and the catalog indexes it, making
+        # node-pinned claims an O(own-devices) index probe
+        entry["attributes"] = {**entry["attributes"],
+                               "node": {"string": node_name}}
     if with_counters:
         entry["consumesCounters"] = [{
             "counterSet": dev.counter_set_name(),
@@ -130,14 +139,15 @@ def build_resource_slices(node_name: str,
                 out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-counters",
                                      [], counter_sets, count))
             for i, bucket in enumerate(buckets):
-                devs = [_device_entry(devices[n], partitionable)
+                devs = [_device_entry(devices[n], partitionable,
+                                              node_name)
                         for n in bucket if n in visible]
                 out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-p{i}",
                                      devs, [], count))
             return out
         return [slice_obj(
             f"{node_name}-{DRIVER_NAME}",
-            [_device_entry(d, partitionable) for d in ordered],
+            [_device_entry(d, partitionable, node_name) for d in ordered],
             counter_sets, 1,
         )]
 
@@ -147,7 +157,8 @@ def build_resource_slices(node_name: str,
     out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-counters", [],
                          counter_sets, count))
     for chip_idx, _ in chips:
-        devs = [_device_entry(d, True) for d in ordered if d.chip.index == chip_idx]
+        devs = [_device_entry(d, True, node_name)
+                for d in ordered if d.chip.index == chip_idx]
         out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-chip{chip_idx}",
                              devs, [], count))
     return out

@@ -51,6 +51,43 @@ from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
 log = logging.getLogger(__name__)
 
 
+def watcher_snapshot(clients: ClientSets) -> Dict[str, int]:
+    """Process-wide watcher accounting: open watch subscriptions on the
+    (fake) API server, registered watch-mux entries, and legacy
+    per-informer threads. The watcher-leak invariant every chaos drill
+    and fleet scenario asserts is 'after a component kill + replace,
+    this snapshot returns exactly to its pre-kill value' — a crashed
+    component whose informers outlive it shows up as a count that never
+    settles."""
+    from tpu_dra_driver.kube import aio
+    out = {"mux_subscriptions": 0, "informer_threads": 0}
+    count_fn = getattr(clients.cluster, "active_watch_count", None)
+    out["cluster_watches"] = (sum(count_fn().values())
+                              if count_fn is not None else 0)
+    if aio.mux_enabled():
+        out["mux_subscriptions"] = aio.watch_mux().subscription_count()
+    out["informer_threads"] = len(
+        [t for t in threading.enumerate()
+         if t.is_alive() and t.name.startswith("informer-")])
+    return out
+
+
+def wait_watchers_settled(clients: ClientSets, baseline: Dict[str, int],
+                          timeout: float = 15.0, what: str = "") -> None:
+    """Poll until :func:`watcher_snapshot` equals ``baseline``; raise
+    AssertionError (with the diff) if it never settles — an orphaned
+    watcher thread or mux subscription leaked across a kill/restart."""
+    deadline = time.monotonic() + timeout
+    snap = watcher_snapshot(clients)
+    while snap != baseline:
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"watcher leak after {what or 'component restart'}: "
+                f"baseline {baseline} != settled {snap}")
+        time.sleep(0.02)
+        snap = watcher_snapshot(clients)
+
+
 @dataclass
 class HostRuntime:
     node_name: str
@@ -90,6 +127,8 @@ class ClusterHarness:
         self._stop = threading.Event()
         self._ds_thread: Optional[threading.Thread] = None
         self._mu = threading.Lock()
+        #: host index -> pre-crash watcher snapshot (leak accounting)
+        self._crash_baselines: Dict[int, Dict[str, int]] = {}
 
         from tpu_dra_driver.tpulib.topology import SliceTopology
         topo = SliceTopology.from_accelerator_type(accelerator_type)
@@ -325,8 +364,14 @@ class ClusterHarness:
         on-disk checkpoint/CDI state is exactly what a crashed pod leaves
         behind. Leaving them running would let a zombie cleanup sweep
         race the restarted plugin over the same state dir.
-        Call :meth:`restart_host_plugins` to bring the node back."""
+        Call :meth:`restart_host_plugins` to bring the node back — which
+        also asserts the dead plugins' watchers were fully released (no
+        orphaned informer threads or mux subscriptions)."""
         old = self.hosts[i]
+        # pre-crash watcher baseline: restart_host_plugins asserts the
+        # process settles back to exactly this once the replacement
+        # plugins re-open their subscriptions
+        self._crash_baselines.setdefault(i, watcher_snapshot(self.clients))
         for plugin in (old.tpu_plugin, old.cd_plugin):
             try:
                 plugin.shutdown()      # thread stops only; no durable IO
@@ -363,17 +408,148 @@ class ClusterHarness:
                                     accelerator_type=old.accelerator_type)
         tpu_plugin.start()
         cd_plugin.start()
+        baseline = self._crash_baselines.pop(i, None)
+        if baseline is not None:
+            wait_watchers_settled(
+                self.clients, baseline,
+                what=f"host {node} plugin crash/restart")
         return self.hosts[i]
 
     def daemon_pod_names(self) -> List[str]:
         return [p["metadata"]["name"]
                 for p in self.clients.pods.list(namespace=DRIVER_NAMESPACE)]
 
-    def kill_daemon_pod(self, pod_name: str) -> None:
+    def kill_daemon_pod(self, pod_name: str,
+                        assert_no_leaks: bool = True,
+                        leak_timeout: float = 15.0) -> None:
         """Force-delete a CD daemon pod (the bats failover scenario): the
         DS runner reaps the dead daemon and boots a replacement, which
-        must re-join its clique at its old index."""
+        must re-join its clique at its old index.
+
+        With ``assert_no_leaks`` (the default) the kill also proves the
+        dead daemon released every watcher: the replacement re-opens the
+        same subscriptions, so within ``leak_timeout`` the process-wide
+        watch/mux counts must return EXACTLY to the pre-kill snapshot —
+        an orphaned informer or mux entry from the reaped daemon fails
+        here instead of accumulating silently across drills."""
+        baseline = watcher_snapshot(self.clients) if assert_no_leaks else None
+        try:
+            old_uid = self.clients.pods.get(
+                pod_name, DRIVER_NAMESPACE)["metadata"].get("uid")
+        except NotFoundError:
+            old_uid = None
         self.clients.pods.delete_ignore_missing(pod_name, DRIVER_NAMESPACE)
+        if baseline is None:
+            return
+        # the check is only meaningful once the DS runner actually reaped
+        # the dead daemon and booted its replacement — wait for the
+        # recreated pod object (same name, new uid) before requiring the
+        # watcher counts to settle back to the baseline
+        deadline = time.monotonic() + leak_timeout
+
+        def replaced() -> bool:
+            try:
+                pod = self.clients.pods.get(pod_name, DRIVER_NAMESPACE)
+            except NotFoundError:
+                return False
+            return pod["metadata"].get("uid") != old_uid
+        while not replaced():
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"daemon pod {pod_name} was never replaced after kill")
+            time.sleep(0.02)
+        wait_watchers_settled(
+            self.clients, baseline,
+            timeout=max(0.1, deadline - time.monotonic()),
+            what=f"daemon pod {pod_name} kill/replace")
+
+    # ------------------------------------------------------------------
+    # watcher-leak accounting (reused by every fleet scenario)
+    # ------------------------------------------------------------------
+
+    def watcher_snapshot(self) -> Dict[str, int]:
+        return watcher_snapshot(self.clients)
+
+    def assert_watchers_settled(self, baseline: Dict[str, int],
+                                timeout: float = 15.0,
+                                what: str = "") -> None:
+        wait_watchers_settled(self.clients, baseline, timeout=timeout,
+                              what=what)
+
+    # ------------------------------------------------------------------
+    # node drain choreography (the kubectl-drain analog; scenario engine)
+    # ------------------------------------------------------------------
+
+    def drain_host(self, i: int) -> Dict:
+        """Drain node ``i``: cordon it (Node.spec.unschedulable + the
+        device pool withdrawn from the scheduler), gracefully release
+        every claim prepared on it (unprepare + deallocate in the API so
+        the allocation controller can migrate them to surviving nodes,
+        or park them with an AllocationParked Event when no capacity
+        remains), and remove the node's ComputeDomain membership (the
+        channel claim is unprepared and the CD label dropped, so the DS
+        runner reaps the daemon pod and the clique shrinks). The node's
+        plugins stay ALIVE — a drain is administrative, not a crash.
+        Call :meth:`undrain_host` to bring the node back."""
+        host = self.hosts[i]
+
+        def cordon(obj):
+            obj.setdefault("spec", {})["unschedulable"] = True
+        self.clients.nodes.retry_update(host.node_name, "", cordon)
+        host.tpu_plugin.set_cordoned(True)
+
+        # migrate workload claims: release node-local state first, then
+        # deallocate in the API — the scheduler re-places or parks them
+        migrated = list(host.tpu_plugin.state.get_checkpoint().claims)
+        if migrated:
+            host.tpu_plugin.unprepare_resource_claims(migrated)
+            by_uid = {c["metadata"].get("uid"): c
+                      for c in self.clients.resource_claims.list()}
+            for uid in migrated:
+                obj = by_uid.get(uid)
+                if obj is None:
+                    continue
+
+                def deallocate(o):
+                    (o.get("status") or {}).pop("allocation", None)
+                try:
+                    self.clients.resource_claims.retry_update(
+                        obj["metadata"]["name"],
+                        obj["metadata"].get("namespace", ""), deallocate)
+                except NotFoundError:
+                    pass       # released claim deleted concurrently
+
+        # ComputeDomain membership: release the channel claim(s) and
+        # drop the CD label — the DS runner reaps the daemon pod and the
+        # controller converges the domain on the surviving members
+        cd_released = list(host.cd_plugin.state.get_checkpoint().claims)
+        if cd_released:
+            host.cd_plugin.unprepare_resource_claims(cd_released)
+
+        def strip_label(obj):
+            labels = obj["metadata"].get("labels") or {}
+            if COMPUTE_DOMAIN_LABEL_KEY not in labels:
+                from tpu_dra_driver.kube.client import ABORT
+                return ABORT
+            del labels[COMPUTE_DOMAIN_LABEL_KEY]
+        self.clients.nodes.retry_update(host.node_name, "", strip_label)
+        log.info("drained %s: %d workload claims migrated, %d CD claims "
+                 "released", host.node_name, len(migrated), len(cd_released))
+        return {"node": host.node_name, "migrated_claims": migrated,
+                "cd_claims_released": cd_released}
+
+    def undrain_host(self, i: int) -> None:
+        """Uncordon node ``i``: republish the full device pool and clear
+        Node.spec.unschedulable. CD membership returns when a workload's
+        channel claim is prepared on the node again (the label is
+        re-added by the CD plugin's prepare path, exactly like a pod
+        landing on the node)."""
+        host = self.hosts[i]
+
+        def uncordon(obj):
+            (obj.get("spec") or {}).pop("unschedulable", None)
+        self.clients.nodes.retry_update(host.node_name, "", uncordon)
+        host.tpu_plugin.set_cordoned(False)
 
     # ------------------------------------------------------------------
     # conveniences

@@ -1,0 +1,1018 @@
+"""Fleet-lifecycle scenario engine: multi-step, multi-component drills.
+
+Every primitive the chaos PRs built — fault points, crash drills,
+breaker/health states, Events, traces, shard hand-off — kills ONE
+component at a time. Production clusters don't fail that politely: a
+node drain cordons, migrates and un-drains while workloads keep
+arriving; a health storm blankets half the fleet; an autoscaler adds
+and removes nodes in waves while shard slots rebalance. This module
+composes the existing substrates (:class:`~tpu_dra_driver.testing
+.harness.ClusterHarness`, the allocation controller, the synthetic
+slice fleet) into whole-fleet scenarios with a single convergence
+contract asserted at every step boundary:
+
+- **no double-allocated device** — across every claim in the cluster,
+  each (pool, device) appears at most once;
+- **no leaked sub-slice** — every live partition on every host is owned
+  by a PrepareCompleted checkpoint entry;
+- **no lost claim** — every claim is Allocated, queued for allocation,
+  or parked-with-an-``AllocationParked``-Event (operator-visible);
+- **health re-converges** — every live plugin answers healthy/SERVING;
+- **no watcher leak** — the process-wide watch/mux accounting returns
+  exactly to its baseline once the fleet is restored.
+
+Scenarios run at two sizes: tier-1 tests use small deterministic
+fleets (tests/test_fleet_scenarios.py); ``bench.py
+bench_fleet_scenarios`` runs the same code at fleet scale and records
+step timings + convergence latencies into the ``fleet_scenarios``
+section of BENCH_DETAIL.json, gated by tests/test_bench_artifact.py.
+The rolling-upgrade-under-traffic scenario lives in
+``tests/e2e/fleet.py`` (it needs real subprocess binaries from a
+git-archived older tree); it reports through the same
+:class:`ScenarioRun` contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.kube.allocation_controller import AllocationController
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.kube.events import REASON_ALLOCATION_PARKED
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.checkpoint import PREPARE_COMPLETED
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.testing.harness import (
+    ClusterHarness,
+    watcher_snapshot,
+)
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+from tpu_dra_driver.tpulib.interface import HealthEvent, HealthEventKind
+
+log = logging.getLogger(__name__)
+
+#: The standard one-chip workload request the traffic driver churns.
+CHIP_REQUEST = [{"name": "tpu", "count": 1,
+                 "selectors": [{"attribute": "type", "equals": "chip"}]}]
+SUBSLICE_REQUEST = [{"name": "tpu", "count": 1,
+                     "selectors": [{"attribute": "type",
+                                    "equals": "subslice"}]}]
+
+
+def node_pinned_request(node: str, type_: str = "subslice") -> List[Dict]:
+    """A scheduler-pinned request: the publisher stamps every device
+    with its node's name, so pinning is an indexed equality selector."""
+    return [{"name": "tpu", "count": 1,
+             "selectors": [{"attribute": "type", "equals": type_},
+                           {"attribute": "node", "equals": node}]}]
+
+
+class InvariantViolation(AssertionError):
+    """A convergence invariant failed at a scenario step boundary."""
+
+
+def percentile(values: Sequence[float], pct: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(pct / 100.0 * (len(vals) - 1))))
+    return vals[idx]
+
+
+# ---------------------------------------------------------------------------
+# the run recorder: step timings + convergence latencies, one report shape
+# ---------------------------------------------------------------------------
+
+
+class ScenarioRun:
+    """Records a scenario's step timings and convergence latencies into
+    the report shape both the tier-1 tests and the bench emit."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.steps: List[Dict] = []
+        self.extra: Dict = {}
+        self._t0 = time.monotonic()
+
+    @contextmanager
+    def step(self, name: str):
+        t0 = time.monotonic()
+        yield
+        self.steps.append(
+            {"step": name, "ms": round((time.monotonic() - t0) * 1e3, 1)})
+
+    def converge(self, name: str, predicate: Callable[[], bool],
+                 timeout: float, interval: float = 0.01) -> float:
+        """Wait for ``predicate`` and record the convergence latency; a
+        timeout is an invariant violation (the fleet never re-converged),
+        not a silent shrug."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while not predicate():
+            if time.monotonic() > deadline:
+                raise InvariantViolation(
+                    f"{self.name}: convergence {name!r} not reached "
+                    f"within {timeout}s")
+            time.sleep(interval)
+        ms = round((time.monotonic() - t0) * 1e3, 1)
+        self.steps.append({"step": name, "ms": ms, "converge": True})
+        return ms
+
+    def step_ms(self, name: str) -> Optional[float]:
+        for row in self.steps:
+            if row["step"] == name:
+                return row["ms"]
+        return None
+
+    def report(self) -> Dict:
+        return {"scenario": self.name,
+                "total_ms": round((time.monotonic() - self._t0) * 1e3, 1),
+                "steps": self.steps, **self.extra}
+
+
+# ---------------------------------------------------------------------------
+# the convergence invariants (asserted at every step boundary)
+# ---------------------------------------------------------------------------
+
+
+def allocated_device_map(clients: ClientSets) -> Dict[Tuple[str, str], str]:
+    """(pool, device) -> claim uid across every allocated claim; raises
+    on the first device held by two claims."""
+    seen: Dict[Tuple[str, str], str] = {}
+    for claim in clients.resource_claims.list():
+        uid = claim["metadata"].get("uid", "?")
+        alloc = (claim.get("status") or {}).get("allocation") or {}
+        for r in (alloc.get("devices") or {}).get("results", []):
+            key = (r["pool"], r["device"])
+            if key in seen and seen[key] != uid:
+                raise InvariantViolation(
+                    f"device {key} double-allocated: claims {seen[key]} "
+                    f"and {uid}")
+            seen[key] = uid
+    return seen
+
+
+def check_no_double_alloc(clients: ClientSets) -> int:
+    return len(allocated_device_map(clients))
+
+
+def check_no_leaked_subslices(hosts: Iterable) -> None:
+    """Every live sub-slice on every host is owned by a PrepareCompleted
+    checkpoint entry (the chaos drill invariant, fleet-wide). ``hosts``
+    yields objects with ``.lib`` and ``.tpu_plugin`` (HostRuntime or
+    MiniFleet nodes)."""
+    for h in hosts:
+        cp = h.tpu_plugin.state.get_checkpoint()
+        owned = {d.canonical_name
+                 for e in cp.claims.values()
+                 if e.state == PREPARE_COMPLETED
+                 for d in e.prepared_devices}
+        live = {s.spec_tuple.canonical_name()
+                for s in h.lib.list_subslices()}
+        leaked = live - owned
+        if leaked:
+            raise InvariantViolation(
+                f"host {getattr(h, 'node_name', h)}: leaked live "
+                f"sub-slices {sorted(leaked)}")
+
+
+def check_no_lost_claims(clients: ClientSets,
+                         controllers: Sequence[AllocationController],
+                         require_parked_events: bool = True,
+                         grace: float = 10.0) -> Dict[str, int]:
+    """Every claim ends Allocated or parked-with-Event: an unallocated
+    claim must be visible in some live controller's queues, and a parked
+    claim must carry an ``AllocationParked`` Event an operator can see.
+    A claim mid-batch (popped from pending, not yet settled) is given
+    ``grace`` to land somewhere; a claim no queue EVER re-admits is the
+    lost-claim bug this invariant exists for.
+    Returns {"allocated": n, "parked": n, "pending": n}."""
+    deadline = time.monotonic() + grace
+    while True:
+        parked_keys = set()
+        pending_keys = set()
+        for ctrl in controllers:
+            parked_keys.update(ctrl.parked_claims())
+            with ctrl._cond:
+                pending_keys.update(ctrl._pending)
+        out = {"allocated": 0, "parked": 0, "pending": 0}
+        lost = []
+        parked_uids = []
+        for claim in clients.resource_claims.list():
+            meta = claim["metadata"]
+            key = (meta.get("namespace", ""), meta.get("name", ""))
+            if (claim.get("status") or {}).get("allocation"):
+                out["allocated"] += 1
+            elif key in parked_keys:
+                out["parked"] += 1
+                parked_uids.append(meta.get("uid", ""))
+            elif key in pending_keys:
+                out["pending"] += 1
+            else:
+                lost.append(key)
+        if not lost:
+            break
+        if time.monotonic() > deadline:
+            raise InvariantViolation(
+                f"claims neither Allocated nor queued/parked (LOST): "
+                f"{lost}")
+        time.sleep(0.02)
+    if require_parked_events and parked_uids:
+        for ctrl in controllers:
+            ctrl.events.flush(timeout=5.0)
+        evented = {(ev.get("involvedObject") or {}).get("uid")
+                   for ev in clients.events.list()
+                   if ev.get("reason") == REASON_ALLOCATION_PARKED}
+        missing = [u for u in parked_uids if u not in evented]
+        if missing:
+            raise InvariantViolation(
+                f"parked claims without an AllocationParked Event "
+                f"(invisible to operators): {missing}")
+    return out
+
+
+def check_health_serving(plugins: Iterable) -> None:
+    for p in plugins:
+        if not p.healthy():
+            raise InvariantViolation(
+                f"plugin on {p._config.node_name} reports NOT_SERVING "
+                f"after the fleet settled")
+
+
+def check_no_watcher_growth(clients: ClientSets,
+                            baseline: Dict[str, int]) -> None:
+    """Mid-scenario (components legitimately down) the watcher counts may
+    sit BELOW the baseline, but growth above it is a leak."""
+    snap = watcher_snapshot(clients)
+    grown = {k: (baseline.get(k, 0), v) for k, v in snap.items()
+             if v > baseline.get(k, 0)}
+    if grown:
+        raise InvariantViolation(
+            f"watcher counts grew past baseline mid-scenario "
+            f"(leak): {grown}")
+
+
+# ---------------------------------------------------------------------------
+# workload traffic: claim allocate/(prepare/unprepare)/release churn
+# ---------------------------------------------------------------------------
+
+
+class ClaimTraffic:
+    """Background claim churn that keeps flowing WHILE lifecycle events
+    hit the fleet — the 'live traffic' half of every scenario.
+
+    Each cycle: create a claim → wait for the allocation controller to
+    allocate it → (optionally) prepare it on the owning node's kubelet
+    plugin → unprepare → delete. Latencies are create→ready wall time;
+    any prepare/unprepare error or allocation timeout is recorded as a
+    failure (scenarios assert the count — zero for drains/upgrades,
+    bounded for storms)."""
+
+    def __init__(self, clients: ClientSets,
+                 namespace: str = "traffic",
+                 prefix: str = "load",
+                 request: Optional[List[Dict]] = None,
+                 prepare_for: Optional[Callable[[str], Optional[object]]]
+                 = None,
+                 alloc_timeout: float = 30.0,
+                 max_claims: Optional[int] = None,
+                 pause_between: float = 0.0):
+        self._clients = clients
+        self._namespace = namespace
+        self._prefix = prefix
+        self._request = request or CHIP_REQUEST
+        self._prepare_for = prepare_for
+        self._alloc_timeout = alloc_timeout
+        self._max = max_claims
+        self._pause = pause_between
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.latencies_ms: List[float] = []
+        self.failures: List[str] = []
+        self.served = 0
+
+    def start(self) -> "ClaimTraffic":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"claim-traffic-{self._prefix}")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> Dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                self.failures.append("traffic thread failed to stop")
+        return self.report()
+
+    def report(self) -> Dict:
+        return {
+            "claims": self.served,
+            "failures": len(self.failures),
+            "failure_samples": self.failures[:3],
+            "p50_ms": round(percentile(self.latencies_ms, 50), 2),
+            "p99_ms": round(percentile(self.latencies_ms, 99), 2),
+        }
+
+    # -- internals ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        i = 0
+        while not self._stop.is_set():
+            if self._max is not None and i >= self._max:
+                break
+            try:
+                self._one(i)
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                self.failures.append(f"{type(e).__name__}: {e}")
+            i += 1
+            if self._pause:
+                self._stop.wait(self._pause)
+
+    def _one(self, i: int) -> None:
+        name = f"{self._prefix}-{i}"
+        try:
+            t0 = time.monotonic()
+            self._clients.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": self._namespace},
+                "spec": {"devices": {"requests": list(self._request)}},
+            })
+            obj = self._await_allocation(name, t0)
+            if obj is None:
+                return
+            uid = obj["metadata"]["uid"]
+            if self._prepare_for is not None:
+                pool = (obj["status"]["allocation"]["devices"]
+                        ["results"][0]["pool"])
+                plugin = self._prepare_for(pool)
+                if plugin is not None:
+                    res = plugin.prepare_resource_claims([obj])[uid]
+                    if res.error is not None:
+                        self.failures.append(
+                            f"{name}: prepare failed: {res.error}")
+                        return
+                    self.latencies_ms.append(
+                        (time.monotonic() - t0) * 1e3)
+                    err = plugin.unprepare_resource_claims(
+                        [{"uid": uid, "name": name,
+                          "namespace": self._namespace}])[uid]
+                    if err is not None:
+                        self.failures.append(
+                            f"{name}: unprepare failed: {err}")
+                        return
+                else:
+                    self.latencies_ms.append((time.monotonic() - t0) * 1e3)
+            else:
+                self.latencies_ms.append((time.monotonic() - t0) * 1e3)
+            self.served += 1
+        finally:
+            self._clients.resource_claims.delete_ignore_missing(
+                name, self._namespace)
+
+    def _await_allocation(self, name: str, t0: float) -> Optional[Dict]:
+        deadline = t0 + self._alloc_timeout
+        while True:
+            try:
+                obj = self._clients.resource_claims.get(name,
+                                                        self._namespace)
+            except NotFoundError:
+                obj = None
+            if obj is not None and (obj.get("status") or {}).get(
+                    "allocation"):
+                return obj
+            if self._stop.is_set():
+                return None
+            if time.monotonic() > deadline:
+                self.failures.append(
+                    f"{name}: not allocated within "
+                    f"{self._alloc_timeout}s")
+                return None
+            time.sleep(0.005)
+
+
+# ---------------------------------------------------------------------------
+# mini fleet: N independent kubelet-plugin nodes (no ComputeDomain layer)
+# ---------------------------------------------------------------------------
+
+
+class MiniFleet:
+    """N single-host TpuKubeletPlugin nodes over one ClientSets — the
+    lightweight substrate for allocator-facing scenarios (health storms)
+    where the ComputeDomain machinery isn't part of the story.
+    ``restart_node`` models servicing: a fresh plugin over the same state
+    dir and hardware state, which resets the health monitor exactly like
+    the reference's restart-after-servicing contract."""
+
+    def __init__(self, tmp_dir: str, n_nodes: int,
+                 accelerator_type: str = "v5p-8",
+                 gates: Optional[fg.FeatureGates] = None):
+        self.tmp = tmp_dir
+        self.accelerator_type = accelerator_type
+        self.gates = gates or fg.FeatureGates()
+        self.clients = ClientSets()
+        self.nodes: Dict[str, "MiniFleet._Node"] = {}
+        for n in range(n_nodes):
+            name = f"fleet-{n}"
+            self.clients.nodes.create({"metadata": {"name": name}})
+            self.nodes[name] = self._build(name, host_state=None)
+
+    class _Node:
+        def __init__(self, node_name: str, lib: FakeTpuLib,
+                     plugin: TpuKubeletPlugin):
+            self.node_name = node_name
+            self.lib = lib
+            self.tpu_plugin = plugin
+
+    def _build(self, name: str, host_state) -> "MiniFleet._Node":
+        lib = FakeTpuLib(
+            FakeSystemConfig(accelerator_type=self.accelerator_type,
+                             slice_id=f"slice-{name}"),
+            host_state=host_state)
+        plugin = TpuKubeletPlugin(self.clients, lib, PluginConfig(
+            node_name=name,
+            state_dir=os.path.join(self.tmp, name, "tpu-plugin"),
+            cdi_root=os.path.join(self.tmp, name, "cdi"),
+            gates=self.gates))
+        return MiniFleet._Node(name, lib, plugin)
+
+    def start(self) -> "MiniFleet":
+        for node in self.nodes.values():
+            node.tpu_plugin.start()
+        return self
+
+    def stop(self) -> None:
+        for node in self.nodes.values():
+            node.tpu_plugin.shutdown()
+
+    def plugin(self, name: str) -> TpuKubeletPlugin:
+        return self.nodes[name].tpu_plugin
+
+    def restart_node(self, name: str) -> None:
+        old = self.nodes[name]
+        old.tpu_plugin.shutdown()
+        self.nodes[name] = self._build(name, host_state=old.lib.host_state)
+        self.nodes[name].tpu_plugin.start()
+
+    def storm(self, names: Iterable[str], events_per_chip: int = 25) -> int:
+        """Blanket the named nodes with fatal health events (the
+        health-event storm). Returns the number of events injected."""
+        injected = 0
+        for name in names:
+            lib = self.nodes[name].lib
+            for chip in lib.enumerate_chips():
+                lib.inject_health_flood([
+                    HealthEvent(HealthEventKind.HBM_ECC_ERROR, chip.uuid,
+                                seq, "storm")
+                    for seq in range(events_per_chip)])
+                injected += events_per_chip
+        return injected
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: node drain choreography (cordon → migrate → un-drain)
+# ---------------------------------------------------------------------------
+
+
+def scenario_node_drain(tmp_dir: str,
+                        prepare_budget: float = 20.0,
+                        converge_timeout: float = 45.0) -> Dict:
+    """Drain one node of a 2-host ComputeDomain fleet under live claim
+    traffic: cordon → migrate/gracefully-fail its sub-slice claims and
+    CD member → un-drain → full re-convergence, invariants at every
+    boundary.
+
+    Two node-pinned sub-slice claims live on the drained node: on drain
+    both are unprepared + deallocated and PARK (the graceful-fail leg —
+    operator-visible via AllocationParked). One is then re-pinned to the
+    survivor (the reschedule, i.e. the migrate leg) and must re-prepare
+    there; the other stays parked until the un-drain restores its node."""
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationControllerConfig,
+    )
+
+    gates = fg.FeatureGates()
+    gates.set(fg.DYNAMIC_SUBSLICE, True)
+    run = ScenarioRun("node_drain")
+    harness = ClusterHarness(tmp_dir, accelerator_type="v5p-16",
+                             gates=gates, prepare_budget=prepare_budget)
+    controller = AllocationController(
+        harness.clients,
+        AllocationControllerConfig(workers=2, retry_interval=0.5))
+    clients = harness.clients
+    by_node = {h.node_name: h for h in harness.hosts}
+    traffic = ClaimTraffic(
+        clients, prefix="drain-load",
+        prepare_for=lambda pool: (by_node[pool].tpu_plugin
+                                  if pool in by_node else None))
+    try:
+        with run.step("setup"):
+            harness.start()
+            controller.start()
+            run.converge(
+                "fleet_published",
+                lambda: {s["spec"].get("nodeName")
+                         for s in clients.resource_slices.list()}
+                >= {"host-0", "host-1"},
+                timeout=10.0)
+        with run.step("cd_rendezvous"):
+            harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+            cd_uid = clients.compute_domains.get(
+                "cd1", "user-ns")["metadata"]["uid"]
+            harness.prepare_channel_claims(cd_uid, [0, 1], "w",
+                                           namespace="user-ns",
+                                           timeout=30.0)
+            run.converge("cd_ready",
+                         lambda: _cd_nodes_ready(harness, 2),
+                         timeout=15.0)
+        with run.step("pin_subslice_claims"):
+            # two sub-slice workloads pinned to the node about to drain
+            pinned = []
+            for i, name in enumerate(("migrant", "parker")):
+                clients.resource_claims.create({
+                    "apiVersion": "resource.k8s.io/v1beta1",
+                    "kind": "ResourceClaim",
+                    "metadata": {"name": name, "namespace": "work"},
+                    "spec": {"devices": {
+                        "requests": node_pinned_request("host-1")}},
+                })
+                pinned.append(name)
+            run.converge(
+                "pinned_allocated",
+                lambda: all(_allocation(clients, n, "work") for n in pinned),
+                timeout=15.0)
+            _prepare_on_owner(clients, pinned, "work", by_node)
+        baseline = harness.watcher_snapshot()
+        traffic.start()
+
+        with run.step("drain"):
+            drained = harness.drain_host(1)
+        run.extra["drained_claims"] = len(drained["migrated_claims"])
+
+        def drain_settled() -> bool:
+            # host-1's TPU pool withdrawn (the CD driver's channel slice
+            # stays — channels are not schedulable capacity), its CD
+            # member gone, both pinned claims gracefully failed into the
+            # parked lifecycle
+            if any(s["spec"]["devices"]
+                   for s in clients.resource_slices.list()
+                   if s["spec"].get("nodeName") == "host-1"
+                   and s["spec"].get("driver") == DRIVER_NAME):
+                return False
+            st = harness.cd_status("cd1", "user-ns")
+            if [n for n in (st.get("nodes") or [])
+                    if n.get("name") == "host-1"]:
+                return False
+            parked = set(controller.parked_claims())
+            return all(("work", n) in parked for n in pinned)
+        run.converge("drain_settled", drain_settled,
+                     timeout=converge_timeout)
+        # boundary invariants, drained state: nothing lost, nothing
+        # double-allocated, nothing leaked, no watcher growth
+        check_no_double_alloc(clients)
+        check_no_leaked_subslices(harness.hosts)
+        check_no_lost_claims(clients, [controller])
+        check_health_serving([h.tpu_plugin for h in harness.hosts])
+        check_no_watcher_growth(clients, baseline)
+
+        with run.step("migrate"):
+            # the reschedule: the evicted workload lands on the survivor
+            # (its fresh claim pins host-0) and must prepare there
+            def repin(obj):
+                obj["spec"]["devices"]["requests"] = \
+                    node_pinned_request("host-0")
+            clients.resource_claims.retry_update("migrant", "work", repin)
+        run.converge(
+            "migrant_replaced",
+            lambda: bool(_allocation(clients, "migrant", "work")),
+            timeout=converge_timeout)
+        alloc = _allocation(clients, "migrant", "work")
+        if any(r["pool"] != "host-0" for r in alloc["devices"]["results"]):
+            raise InvariantViolation(
+                f"migrant re-placed onto the drained node: {alloc}")
+        _prepare_on_owner(clients, ["migrant"], "work", by_node)
+        check_no_double_alloc(clients)
+        check_no_lost_claims(clients, [controller])
+
+        with run.step("undrain"):
+            harness.undrain_host(1)
+            # a workload lands on the node again: its channel claim
+            # re-prepares, which re-labels the node and re-admits the
+            # CD daemon
+            harness.prepare_channel_claims(cd_uid, [1], "w-back",
+                                           namespace="user-ns",
+                                           timeout=30.0)
+        run.converge("cd_reconverged",
+                     lambda: _cd_nodes_ready(harness, 2),
+                     timeout=converge_timeout)
+        run.converge(
+            "parked_drained_after_undrain",
+            lambda: bool(_allocation(clients, "parker", "work"))
+            and not controller.parked_claims(),
+            timeout=converge_timeout)
+        _prepare_on_owner(clients, ["parker"], "work", by_node)
+    finally:
+        run.extra["traffic"] = traffic.stop()
+        controller.stop()
+        harness.stop()
+    if run.extra["traffic"]["failures"]:
+        raise InvariantViolation(
+            f"traffic failed during drain: "
+            f"{run.extra['traffic']['failure_samples']}")
+    # final boundary: the restored fleet is exactly as accountable as
+    # the pre-drain fleet
+    check_no_double_alloc(clients)
+    check_no_leaked_subslices(harness.hosts)
+    return run.report()
+
+
+def _cd_nodes_ready(harness: ClusterHarness, nodes: int,
+                    name: str = "cd1", ns: str = "user-ns") -> bool:
+    st = harness.cd_status(name, ns)
+    return (st.get("status") == "Ready"
+            and len(st.get("nodes") or []) == nodes
+            and all(n["status"] == "Ready" for n in st["nodes"]))
+
+
+def _create_claims(clients: ClientSets, prefix: str, n: int,
+                   request: List[Dict], namespace: str) -> List[str]:
+    names = []
+    for i in range(n):
+        name = f"{prefix}-{i}"
+        clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"devices": {"requests": list(request)}},
+        })
+        names.append(name)
+    return names
+
+
+def _allocation(clients: ClientSets, name: str,
+                namespace: str) -> Optional[Dict]:
+    try:
+        obj = clients.resource_claims.get(name, namespace)
+    except NotFoundError:
+        return None
+    return (obj.get("status") or {}).get("allocation")
+
+
+def _prepare_on_owner(clients: ClientSets, names: List[str],
+                      namespace: str, by_node: Dict) -> None:
+    """Prepare each allocated claim on the node that owns its devices
+    (the kubelet role for scenario-pinned claims)."""
+    for name in names:
+        obj = clients.resource_claims.get(name, namespace)
+        alloc = (obj.get("status") or {}).get("allocation")
+        if not alloc:
+            continue
+        pool = alloc["devices"]["results"][0]["pool"]
+        host = by_node.get(pool)
+        if host is None:
+            raise InvariantViolation(
+                f"claim {name} allocated to unknown pool {pool}")
+        res = host.tpu_plugin.prepare_resource_claims([obj])
+        uid = obj["metadata"]["uid"]
+        if res[uid].error is not None:
+            raise InvariantViolation(
+                f"claim {name} failed to prepare on {pool}: "
+                f"{res[uid].error}")
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: health-event storm across a fleet fraction
+# ---------------------------------------------------------------------------
+
+
+def scenario_health_storm(tmp_dir: str,
+                          n_nodes: int = 4,
+                          storm_nodes: int = 2,
+                          resident_claims: int = 6,
+                          burst_claims: int = 9,
+                          converge_timeout: float = 45.0) -> Dict:
+    """Blanket ``storm_nodes`` of an ``n_nodes`` fleet with fatal health
+    events while claim traffic keeps flowing: the publishers withdraw the
+    unhealthy pools, the allocation controller routes new claims around
+    them and PARKS the overflow (operator-visible via Event + gauge),
+    and servicing the stormed nodes drains every parked claim."""
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationControllerConfig,
+    )
+    from tpu_dra_driver.pkg.metrics import ALLOCATOR_PARKED_CLAIMS
+
+    gates = fg.FeatureGates()
+    gates.set(fg.DEVICE_HEALTH_CHECK, True)
+    run = ScenarioRun("health_storm")
+    fleet = MiniFleet(tmp_dir, n_nodes, gates=gates)
+    clients = fleet.clients
+    controller = AllocationController(
+        clients, AllocationControllerConfig(workers=2, retry_interval=0.5))
+    stormed = sorted(fleet.nodes)[:storm_nodes]
+    healthy = [n for n in fleet.nodes if n not in stormed]
+    traffic = ClaimTraffic(
+        clients, prefix="storm-load", alloc_timeout=converge_timeout,
+        prepare_for=lambda pool: (fleet.nodes[pool].tpu_plugin
+                                  if pool in fleet.nodes else None))
+    parked_gauge_0 = ALLOCATOR_PARKED_CLAIMS.value
+    try:
+        with run.step("setup"):
+            fleet.start()
+            controller.start()
+            run.converge(
+                "fleet_published",
+                lambda: {s["spec"].get("nodeName")
+                         for s in clients.resource_slices.list()}
+                >= set(fleet.nodes),
+                timeout=10.0)
+        with run.step("resident_load"):
+            residents = _create_claims(clients, "resident",
+                                       resident_claims, CHIP_REQUEST,
+                                       namespace="work")
+            run.converge(
+                "residents_allocated",
+                lambda: all(_allocation(clients, n, "work")
+                            for n in residents),
+                timeout=15.0)
+        baseline = watcher_snapshot(clients)
+        traffic.start()
+
+        with run.step("storm"):
+            run.extra["storm_events"] = fleet.storm(stormed)
+        run.converge(
+            "pools_withdrawn",
+            lambda: not any(s["spec"]["devices"]
+                            for s in clients.resource_slices.list()
+                            if s["spec"].get("nodeName") in stormed),
+            timeout=converge_timeout)
+
+        with run.step("burst_during_storm"):
+            burst = _create_claims(clients, "burst", burst_claims,
+                                   CHIP_REQUEST, namespace="work")
+
+        def storm_routed() -> bool:
+            parked = set(controller.parked_claims())
+            for n in burst:
+                alloc = _allocation(clients, n, "work")
+                if alloc:
+                    if any(r["pool"] in stormed
+                           for r in alloc["devices"]["results"]):
+                        raise InvariantViolation(
+                            f"claim {n} allocated onto stormed node "
+                            f"{alloc['devices']['results']}")
+                elif ("work", n) not in parked:
+                    return False
+            return True
+        run.converge("storm_routed", storm_routed, timeout=converge_timeout)
+        allocated = [n for n in burst if _allocation(clients, n, "work")]
+        parked = [n for n in burst if n not in allocated]
+        run.extra["burst_allocated_during_storm"] = len(allocated)
+        run.extra["burst_parked_during_storm"] = len(parked)
+        if not parked:
+            raise InvariantViolation(
+                "storm never exhausted healthy capacity — the parked "
+                "path went unexercised (resize the scenario)")
+        # parked overflow is operator-visible: Events + gauge
+        check_no_lost_claims(clients, [controller])
+        if ALLOCATOR_PARKED_CLAIMS.value - parked_gauge_0 < len(parked):
+            raise InvariantViolation(
+                "dra_allocator_parked_claims gauge does not cover the "
+                "parked burst")
+        # a health storm is a device event, not an API-server event: the
+        # stormed nodes still answer SERVING and nothing leaked
+        check_no_double_alloc(clients)
+        check_health_serving(fleet.plugin(n) for n in fleet.nodes)
+        check_no_watcher_growth(clients, baseline)
+
+        with run.step("service_stormed_nodes"):
+            for name in stormed:
+                fleet.restart_node(name)
+        def pools_restored() -> bool:
+            published = {s["spec"].get("nodeName")
+                         for s in clients.resource_slices.list()
+                         if s["spec"]["devices"]}
+            return published >= set(fleet.nodes)
+        run.converge("pools_restored", pools_restored,
+                     timeout=converge_timeout)
+        run.converge(
+            "parked_drained",
+            lambda: all(_allocation(clients, n, "work") for n in burst)
+            and not controller.parked_claims(),
+            timeout=converge_timeout)
+
+        def parked_events_cleared() -> bool:
+            controller.events.flush(timeout=1.0)
+            return not [ev for ev in clients.events.list()
+                        if ev.get("reason") == REASON_ALLOCATION_PARKED]
+        run.converge("parked_events_cleared", parked_events_cleared,
+                     timeout=10.0)
+        if ALLOCATOR_PARKED_CLAIMS.value - parked_gauge_0 != 0:
+            raise InvariantViolation(
+                "dra_allocator_parked_claims gauge did not return to "
+                "baseline after the storm cleared")
+    finally:
+        run.extra["traffic"] = traffic.stop()
+        controller.stop()
+        fleet.stop()
+    check_no_double_alloc(clients)
+    check_no_leaked_subslices(fleet.nodes.values())
+    check_no_lost_claims(clients, [], require_parked_events=False)
+    return run.report()
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: autoscaler churn — node waves while shard slots rebalance
+# ---------------------------------------------------------------------------
+
+
+def synthetic_slice(node: str, devices_per_node: int = 4) -> Dict:
+    """One published ResourceSlice for a synthetic node (the autoscaler
+    scenario's unit of scale — no plugin process behind it)."""
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-slice"},
+        "spec": {
+            "driver": DRIVER_NAME,
+            "nodeName": node,
+            "pool": {"name": node, "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": [
+                {"name": f"tpu-{d}",
+                 "attributes": {"type": {"string": "chip"},
+                                "node": {"string": node}}}
+                for d in range(devices_per_node)],
+        },
+    }
+
+
+def scenario_autoscaler_churn(n_base_nodes: int = 12,
+                              wave_size: int = 6,
+                              n_waves: int = 2,
+                              n_shards: int = 2,
+                              devices_per_node: int = 4,
+                              claims_per_wave: int = 10,
+                              hand_off_wave: Optional[int] = 0,
+                              min_traffic_claims: int = 8,
+                              converge_timeout: float = 60.0) -> Dict:
+    """Add/remove nodes in waves against a sharded control plane while
+    claim traffic flows, with a shard-slot hand-off mid-churn. After
+    every wave: controllers idle, ledger/catalog exactly consistent with
+    the cluster truth, no claim lost, no device double-allocated."""
+    from tpu_dra_driver.kube import catalog as catalog_mod
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationControllerConfig,
+        ShardGroup,
+    )
+
+    run = ScenarioRun("autoscaler_churn")
+    clients = ClientSets()
+    for i in range(n_base_nodes):
+        clients.resource_slices.create(
+            synthetic_slice(f"churn-{i}", devices_per_node))
+    group = ShardGroup(clients, n_shards,
+                       AllocationControllerConfig(workers=2, batch_max=32,
+                                                  retry_interval=0.5))
+    live = dict(group.controllers)          # slot -> live controller
+    traffic = ClaimTraffic(clients, prefix="churn-load",
+                           alloc_timeout=converge_timeout)
+    next_node = [n_base_nodes]
+    wave_claims: List[Tuple[str, str]] = []   # (name, namespace)
+
+    def add_nodes(k: int) -> List[str]:
+        names = []
+        for _ in range(k):
+            name = f"churn-{next_node[0]}"
+            next_node[0] += 1
+            clients.resource_slices.create(
+                synthetic_slice(name, devices_per_node))
+            names.append(name)
+        return names
+
+    def removable_nodes(k: int) -> List[str]:
+        held_pools = {pool for pool, _ in allocated_device_map(clients)}
+        victims = []
+        for s in clients.resource_slices.list():
+            node = s["spec"].get("nodeName", "")
+            if node not in held_pools:
+                victims.append(node)
+            if len(victims) == k:
+                break
+        return victims
+
+    def settled() -> bool:
+        if not all(c.wait_idle(timeout=0.05) for c in live.values()):
+            return False
+        parked = set()
+        for c in live.values():
+            parked.update(c.parked_claims())
+        for name, ns in wave_claims:
+            if not _allocation(clients, name, ns) \
+                    and (ns, name) not in parked:
+                return False
+        return True
+
+    def assert_catalog_ledger_consistent() -> None:
+        """Each live controller's catalog == the cluster truth filtered
+        to its owned slots, and its ledger holds exactly the devices of
+        allocated claims within those slots."""
+        slices = clients.resource_slices.list()
+        for slot, ctrl in live.items():
+            owned = ctrl._shard.owned
+            truth = set()
+            for s in slices:
+                pool = s["spec"]["pool"]["name"]
+                if group.ring.owner(pool) not in owned:
+                    continue
+                for d in s["spec"]["devices"]:
+                    truth.add((pool, d["name"]))
+            # the in-process ShardGroup catalog is unfiltered (one fake
+            # cluster); compare the slice of it this shard allocates
+            # from — stale retention of removed nodes still shows up
+            snap_keys = {k for k in ctrl.catalog.snapshot().devices
+                         if group.ring.owner(k[0]) in owned}
+            if snap_keys != truth:
+                raise InvariantViolation(
+                    f"shard {slot}: catalog diverged from cluster truth "
+                    f"(extra={sorted(snap_keys - truth)[:5]}, "
+                    f"missing={sorted(truth - snap_keys)[:5]})")
+            expected_held = set()
+            for claim in clients.resource_claims.list():
+                for key in catalog_mod.claim_allocated_keys(
+                        claim, DRIVER_NAME):
+                    if group.ring.owner(key[0]) in owned:
+                        expected_held.add(key)
+            # committed holdings only: in-flight traffic reservations
+            # are transient by design and not part of this invariant
+            held = ctrl.ledger.committed_keys()
+            if held != expected_held:
+                raise InvariantViolation(
+                    f"shard {slot}: ledger holdings diverged "
+                    f"(extra={sorted(held - expected_held)[:5]}, "
+                    f"missing={sorted(expected_held - held)[:5]})")
+
+    try:
+        with run.step("setup"):
+            group.start()
+        traffic.start()
+        waves = []
+        for w in range(n_waves):
+            with run.step(f"wave_{w}_scale"):
+                added = add_nodes(wave_size)
+                removed = removable_nodes(wave_size)
+                for node in removed:
+                    clients.resource_slices.delete_ignore_missing(
+                        f"{node}-slice")
+                names = _create_claims(clients, f"wave{w}",
+                                       claims_per_wave, CHIP_REQUEST,
+                                       namespace="churn")
+                wave_claims.extend((n, "churn") for n in names)
+            if hand_off_wave == w and len(live) > 1:
+                with run.step(f"wave_{w}_shard_handoff"):
+                    dead_slot = sorted(live)[0]
+                    to_slot = sorted(live)[1]
+                    live.pop(dead_slot).stop()
+                    group.hand_off(dead_slot, to_slot)
+            ms = run.converge(f"wave_{w}_settled", settled,
+                              timeout=converge_timeout)
+            waves.append({"wave": w, "added": len(added),
+                          "removed": len(removed),
+                          "settle_ms": ms})
+            check_no_double_alloc(clients)
+            check_no_lost_claims(clients, list(live.values()))
+            # the catalog/ledger converge on watch events — bounded
+            # wait, then the REAL divergence (a leak never converges)
+            consistency_deadline = time.monotonic() + 15.0
+            while True:
+                try:
+                    assert_catalog_ledger_consistent()
+                    break
+                except InvariantViolation:
+                    if time.monotonic() > consistency_deadline:
+                        raise
+                    time.sleep(0.02)
+        # the traffic must actually have FLOWED through the churn for
+        # the claim-to-ready p99 to mean anything
+        run.converge("traffic_flowing",
+                     lambda: traffic.served >= min_traffic_claims,
+                     timeout=converge_timeout)
+        run.extra["waves"] = waves
+        run.extra["final_nodes"] = len(clients.resource_slices.list())
+    finally:
+        run.extra["traffic"] = traffic.stop()
+        for ctrl in live.values():
+            ctrl.stop()
+    if run.extra["traffic"]["failures"]:
+        raise InvariantViolation(
+            f"churn traffic failed: "
+            f"{run.extra['traffic']['failure_samples']}")
+    check_no_double_alloc(clients)
+    return run.report()
