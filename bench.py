@@ -1180,6 +1180,91 @@ def bench_observability(n_iters: int = 200_000,
     return out
 
 
+def bench_slo_overhead(n_iters: int = 200_000, eval_rounds: int = 50,
+                       walk_iters: int = 2_000) -> dict:
+    """SLO-engine evaluation and critical-path-walk cost, plus the
+    acceptance proof that the metric HOT PATH pays nothing for either:
+    the engine only reads snapshots on its own thread and the analyzer
+    only walks finished traces, so a histogram observe with the engine
+    armed must cost the same ns/op as without it — pinned like the
+    tracing/faultinject disabled paths."""
+    from tpu_dra_driver.pkg import criticalpath, slo, tracing
+    from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY
+
+    child = DEFAULT_REGISTRY.histogram(
+        "dra_claim_prepare_duration_seconds",
+        "NodePrepareResources wall time per claim by result",
+        ("result",)).labels("ok")
+
+    def observe_loop():
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            child.observe(0.003)
+        return (time.perf_counter() - t0) / n_iters * 1e9  # ns/op
+
+    out = {}
+    out["observe_ns_engine_off"] = round(
+        min(observe_loop() for _ in range(3)), 1)
+    engine = slo.SLOEngine(tick=0.05)
+    slo.configure(engine)
+    engine.start()
+    try:
+        out["observe_ns_engine_on"] = round(
+            min(observe_loop() for _ in range(3)), 1)
+        evals = []
+        for _ in range(eval_rounds):
+            t0 = time.perf_counter()
+            engine.evaluate_once()
+            evals.append((time.perf_counter() - t0) * 1e3)
+        out["slo_eval_ms"] = round(statistics.median(evals), 3)
+    finally:
+        slo.configure(None)
+    out["observe_overhead_ns"] = round(
+        out["observe_ns_engine_on"] - out["observe_ns_engine_off"], 1)
+
+    # critical-path walk over a realistic claim trace (allocation root
+    # + pick/commit + kubelet prepare with its six phases + CD wait)
+    tracing.configure("always", capacity=8192)
+    try:
+        root = tracing.start_span("allocator.allocate")
+        with tracing.use_span(root):
+            with tracing.span("allocator.pick"):
+                pass
+            with tracing.span("allocator.commit"):
+                tracing.add_event("commit-conflict")
+        root.end()
+        prep = tracing.start_span("kubelet.prepare", parent=root.context)
+        with tracing.use_span(prep):
+            for phase in ("read_checkpoint", "write_ahead", "devices",
+                          "subslice", "cdi", "commit"):
+                with tracing.span(f"prepare.{phase}"):
+                    pass
+        prep.end()
+        cd = tracing.start_span("cd.prepare", parent=root.context)
+        with tracing.use_span(cd):
+            wait = tracing.start_span("cd.await_ready",
+                                      parent=tracing.current_span())
+            wait.add_event("retry", attempt=1)
+            wait.end()
+        cd.end()
+        spans = tracing.recorder().trace(root.context.trace_id)
+        t0 = time.perf_counter()
+        for _ in range(walk_iters):
+            attribution = criticalpath.analyze(spans)
+        out["criticalpath_walk_us"] = round(
+            (time.perf_counter() - t0) / walk_iters * 1e6, 2)
+        out["criticalpath_segments"] = len(attribution["segments_ms"])
+        t0 = time.perf_counter()
+        report = criticalpath.aggregate_report(tracing.recorder())
+        out["criticalpath_aggregate_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        out["criticalpath_traces"] = report["traces_analyzed"]
+    finally:
+        tracing.reset()
+    out["n_iters"] = n_iters
+    return out
+
+
 # substrings that identify a TUNNEL/TRANSPORT failure inside a
 # JaxRuntimeError; anything else (device OOM, a genuine kernel fault)
 # must not be retried — a passing retry would launder it into a clean
@@ -1599,6 +1684,7 @@ SUMMARY_KEYS = [
     "fleet_drain_reconverge_ms", "fleet_storm_clear_ms",
     "fleet_upgrade_gap_failures", "fleet_churn_p99_ms",
     "trace_disabled_ns", "metrics_render_ms",
+    "slo_eval_ms", "criticalpath_walk_us",
     "backend", "devices",
     "matmul_tflops_bf16_steady", "matmul_mfu",
     "flash_attn_tflops", "flash_vs_splash",
@@ -1764,6 +1850,20 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"  observability bench failed ({type(e).__name__}: {e})")
 
+    log("[bench] SLO engine + critical-path analyzer overhead…")
+    slo_bench = {}
+    try:
+        slo_bench = bench_slo_overhead()
+        log(f"  observe ns/op: engine off "
+            f"{slo_bench['observe_ns_engine_off']:.0f} / on "
+            f"{slo_bench['observe_ns_engine_on']:.0f} "
+            f"(delta {slo_bench['observe_overhead_ns']:.0f}); "
+            f"engine eval {slo_bench['slo_eval_ms']:.2f} ms; "
+            f"critical-path walk "
+            f"{slo_bench['criticalpath_walk_us']:.0f} us/trace")
+    except Exception as e:  # noqa: BLE001
+        log(f"  slo overhead bench failed ({type(e).__name__}: {e})")
+
     log("[bench] accelerator microbenchmarks…")
     accel = bench_accelerator()
 
@@ -1858,6 +1958,12 @@ def main() -> int:
         **({"trace_disabled_ns": obs["disabled_ns_per_span"],
             "metrics_render_ms": obs["metrics_render_ms"]}
            if obs else {}),
+        # SLO engine + critical-path analyzer cost (hot-path delta is
+        # the "interpretation layer is free to the data plane" proof)
+        "slo_overhead": slo_bench,
+        **({"slo_eval_ms": slo_bench["slo_eval_ms"],
+            "criticalpath_walk_us": slo_bench["criticalpath_walk_us"]}
+           if slo_bench else {}),
         # crash-recovery arms (full evidence under the recovery key)
         "recovery": recovery,
         **({"recovery_plugin_kill_ms":

@@ -38,6 +38,7 @@ from simcluster import (  # noqa: E402
     SimCluster,
     SimNode,
     free_port,
+    http_get_json,
     percentile,
     try_fetch_trace,
     wait_for,
@@ -450,13 +451,249 @@ def phase_tpu_plugin(cluster: SimCluster, iterations: int) -> dict:
     return results
 
 
+def phase_doctor(root: str) -> dict:
+    """The SLO/critical-path/doctor acceptance loop (observability PR):
+    a fault-injected latency on kubelet prepare drives the
+    claim-prepare-latency SLO into burn inside the production plugin
+    subprocess → SLOBurnRate Event lands on the Node → the guilty
+    prepare segment dominates /debug/criticalpath → tpu-dra-doctor run
+    against the same cluster flags SLO_BURNING, PARKED_CLAIMS (a real
+    allocation-controller subprocess with an unsatisfiable claim) and
+    BREAKER_OPEN (an in-process RestCluster driven into brownout) in
+    its triage summary."""
+    import tarfile
+
+    from tpu_dra_driver.kube.breaker import CircuitBreaker
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.kube.rest import RestCluster, RestClusterConfig
+    from tpu_dra_driver.pkg import faultinject as fi
+    from tpu_dra_driver.pkg.metrics import DebugHTTPServer
+
+    results: dict = {}
+    cluster = SimCluster(root)
+    ac_proc = None
+    harness_srv = None
+    try:
+        node = cluster.add_node("doc-node-0")
+        plugin_port = free_port()
+        # short burn windows so the in-process SLO engine reacts within
+        # the harness's patience; latency 0.8s > the 0.5s SLO threshold
+        proc = node.spawn_tpu_plugin(
+            tag="-doctor",
+            extra_args=["--http-endpoint", f"127.0.0.1:{plugin_port}",
+                        "--trace-mode", "always",
+                        "--slo-tick", "0.25",
+                        "--slo-windows", "fast:120/30:2"],
+            faults="plugin.prepare.before_commit=latency:0.8")
+        info = node.kubelet.register(DRIVER_NAME)
+        dra = node.kubelet.dra_client(info)
+
+        # a real allocation-controller subprocess: its /debug/allocator
+        # is the parked-claim surface the doctor collects
+        ac_port = free_port()
+        log_dir = os.path.join(cluster.root, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        ac_proc = PluginProcess(
+            "allocation-controller",
+            ["-m", "tpu_dra_driver.cmd.allocation_controller",
+             "--kube-backend", "rest", "--kubeconfig", cluster.kubeconfig,
+             "--http-endpoint", f"127.0.0.1:{ac_port}", "-v", "5"],
+            os.path.join(log_dir, "allocation-controller.log"))
+        # unsatisfiable: no device publishes this type — the controller
+        # parks it (AllocationParked Event + gauge + /debug/allocator)
+        cluster.clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "unsatisfiable", "namespace": "e2e"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "count": 1,
+                 "selectors": [{"attribute": "type",
+                                "equals": "no-such-type"}]}]}},
+        })
+
+        # drive slow prepares: every claim succeeds but takes ~0.8s,
+        # blowing the 500ms claim-prepare-latency SLO threshold
+        n_slow = 6
+        for i in range(n_slow):
+            claim = _prepare(cluster, node, dra, f"slow-{i}")
+            _claim_finish(cluster, dra, claim)
+        log(f"{n_slow} fault-slowed prepares done; waiting for the SLO "
+            f"engine to flag the burn")
+
+        def burning_row():
+            try:
+                rep = http_get_json(
+                    f"http://127.0.0.1:{plugin_port}/debug/slo", timeout=2)
+            except Exception:  # noqa: BLE001 — engine not up yet
+                return None
+            row = (rep.get("slos") or {}).get("claim-prepare-latency") or {}
+            return row if row.get("burning") else None
+        row = wait_for(burning_row, 20, "claim-prepare-latency SLO burn")
+        results["slo_burning"] = {
+            "slo": "claim-prepare-latency",
+            "burning_windows": row["burning_windows"],
+            "budget_remaining": row["budget_remaining"],
+        }
+        log(f"SLO burning OK: windows {row['burning_windows']}, budget "
+            f"remaining {row['budget_remaining']}")
+
+        # the deduped SLOBurnRate Warning on the Node, over REST
+        def slo_events():
+            return [e for e in cluster.clients.events.list()
+                    if e.get("reason") == "SLOBurnRate"]
+        evs = wait_for(slo_events, 15, "SLOBurnRate Event on the API server")
+        inv = evs[0].get("involvedObject") or {}
+        if inv.get("kind") != "Node" or inv.get("name") != node.node_name:
+            raise HarnessError(f"SLOBurnRate hung off {inv}, not the Node")
+        results["slo_event"] = {"count": len(evs),
+                                "involved": inv,
+                                "type": evs[0].get("type")}
+        log(f"SLOBurnRate Event OK on Node/{inv.get('name')}")
+
+        # the guilty segment dominates the plugin's critical path
+        cp = http_get_json(
+            f"http://127.0.0.1:{plugin_port}/debug/criticalpath", timeout=5)
+        segs = cp.get("segments") or {}
+        if not segs:
+            raise HarnessError("no critical-path segments recorded")
+        dominant = max(segs, key=lambda s: segs[s]["mean_ms"])
+        if not dominant.startswith("prepare"):
+            raise HarnessError(
+                f"expected a prepare segment to dominate, got "
+                f"{dominant}: {segs}")
+        if segs[dominant]["mean_ms"] < 500:
+            raise HarnessError(
+                f"dominant segment {dominant} mean "
+                f"{segs[dominant]['mean_ms']}ms does not show the "
+                f"injected 800ms latency")
+        results["criticalpath"] = {
+            "dominant": dominant,
+            "dominant_mean_ms": segs[dominant]["mean_ms"],
+            "traces_analyzed": cp["traces_analyzed"],
+            "coverage_complete": cp["coverage"]["complete"],
+        }
+        log(f"critical path OK: {dominant} dominates at "
+            f"{segs[dominant]['mean_ms']:.0f}ms mean over "
+            f"{cp['traces_analyzed']} traces")
+
+        # parked claim visible on the allocation controller's surface
+        def parked():
+            try:
+                state = http_get_json(
+                    f"http://127.0.0.1:{ac_port}/debug/allocator",
+                    timeout=2)
+            except Exception:  # noqa: BLE001 — controller still booting
+                return None
+            return state if state.get("parked_claims") else None
+        state = wait_for(parked, 30, "parked claim on /debug/allocator")
+        results["parked"] = {"claims": state["parked_claims"]}
+        log(f"parked OK: {state['parked_claims']}")
+
+        # brownout drill: an in-process RestCluster (this harness is a
+        # component too) driven into an OPEN breaker via fault injection
+        harness_srv = DebugHTTPServer(("127.0.0.1", 0))
+        harness_srv.start()
+        rest = RestCluster(
+            RestClusterConfig.from_kubeconfig(cluster.kubeconfig),
+            breaker=CircuitBreaker("e2e-apiserver", failure_threshold=3))
+        bclients = ClientSets(cluster=rest)
+        fi.arm("rest.request", fi.Rule(mode="fail", first=100))
+        try:
+            for i in range(5):
+                try:
+                    bclients.events.create({
+                        "apiVersion": "v1", "kind": "Event",
+                        "metadata": {"generateName": "doc.",
+                                     "namespace": "default"},
+                        "reason": "DoctorDrill", "type": "Normal",
+                        "message": "brownout probe",
+                        "involvedObject": {"kind": "Node",
+                                           "name": node.node_name}})
+                except Exception:  # noqa: BLE001 — the drill IS the failure
+                    pass
+        finally:
+            fi.disarm("rest.request")
+        if rest.healthy():
+            raise HarnessError("breaker did not open under the brownout")
+        results["breaker_open"] = True
+        log("breaker OK: e2e-apiserver breaker OPEN after brownout")
+
+        # one more slow prepare right before collection: the later
+        # waits above (controller boot, breaker drill) may have eaten
+        # into the 30s short burn window, and the doctor must collect
+        # the SLO while it is still burning (the window would honestly
+        # drain to not-burning once bad traffic ages out — by design)
+        refresh = _prepare(cluster, node, dra, "slow-refresh")
+        _claim_finish(cluster, dra, refresh)
+        wait_for(burning_row, 10, "SLO still burning before collection")
+
+        # the doctor run: all three components + checkpoint state dir
+        from tpu_dra_driver.cmd import doctor as doctor_cmd
+        bundle_path = os.path.join(cluster.root, "doctor-bundle.tar.gz")
+        rc = doctor_cmd.main([
+            "--endpoint", f"tpu-plugin=127.0.0.1:{plugin_port}",
+            "--endpoint", f"allocation-controller=127.0.0.1:{ac_port}",
+            "--endpoint", f"e2e-harness=127.0.0.1:{harness_srv.port}",
+            "--state-dir", f"doc-node-0={node.state_dir}",
+            "--collect-events",
+            "--kube-backend", "rest", "--kubeconfig", cluster.kubeconfig,
+            "--output", bundle_path,
+        ])
+        if rc != 0:
+            raise HarnessError(f"tpu-dra-doctor exited {rc}")
+        with tarfile.open(bundle_path) as tar:
+            members = sorted(tar.getnames())
+            findings = json.loads(
+                tar.extractfile("findings.json").read().decode())
+            summary = tar.extractfile("summary.txt").read().decode()
+        by_code = {}
+        for f in findings:
+            by_code.setdefault(f["code"], []).append(f["component"])
+        for code, component in (("SLO_BURNING", "tpu-plugin"),
+                                ("PARKED_CLAIMS", "allocation-controller"),
+                                ("BREAKER_OPEN", "e2e-harness")):
+            if component not in by_code.get(code, []):
+                raise HarnessError(
+                    f"doctor finding {code} missing for {component}: "
+                    f"{by_code}\n{summary}")
+            if code not in summary:
+                raise HarnessError(f"{code} absent from triage summary")
+        for member in ("tpu-plugin/metrics.txt", "tpu-plugin/slo.json",
+                       "tpu-plugin/criticalpath.json",
+                       "tpu-plugin/vars.json",
+                       "allocation-controller/allocator.json",
+                       "e2e-harness/metrics.txt", "events.json",
+                       "state_dirs.json", "findings.json", "summary.txt"):
+            if member not in members:
+                raise HarnessError(f"bundle member {member} missing: "
+                                   f"{members}")
+        results["doctor"] = {
+            "findings": sorted(by_code),
+            "bundle_members": len(members),
+            "bundle": os.path.basename(bundle_path),
+        }
+        log(f"doctor OK: findings {sorted(by_code)} over {len(members)} "
+            f"bundle members")
+        proc.stop()
+        results["status"] = "green"
+        return results
+    finally:
+        fi.reset()
+        if harness_srv is not None:
+            harness_srv.stop()
+        if ac_proc is not None:
+            ac_proc.stop()
+        cluster.teardown()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="fewer perf iterations (CI mode)")
     ap.add_argument("--keep-root", action="store_true")
     ap.add_argument("--phases",
-                    default="tpu-plugin,compute-domain,collective-bench",
+                    default="tpu-plugin,compute-domain,collective-bench,"
+                            "doctor",
                     help="comma-separated phase list")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT,
                                                   "E2E_RESULTS.json"))
@@ -501,6 +738,13 @@ def main() -> int:
             log(f"FAIL collective-bench: {e}")
             results["collective_bench_spec"] = {"status": "failed",
                                                 "error": str(e)}
+            rc = 1
+    if "doctor" in phases:
+        try:
+            results["doctor"] = phase_doctor(os.path.join(root, "doctor"))
+        except Exception as e:  # noqa: BLE001
+            log(f"FAIL doctor: {e}")
+            results["doctor"] = {"status": "failed", "error": str(e)}
             rc = 1
 
     with open(args.out, "w") as f:

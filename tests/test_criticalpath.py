@@ -1,0 +1,237 @@
+"""Critical-path analyzer (pkg/criticalpath.py): self-time attribution
+from synthetic span trees (overlapping children, retry events, missing
+CD phases, cross-process halves), aggregate p50/p99 reports,
+eviction-aware coverage (the dra_traces_evicted_total bugfix), and the
+/debug/criticalpath endpoints.
+"""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from tpu_dra_driver.pkg import criticalpath, tracing
+from tpu_dra_driver.pkg.metrics import (
+    DebugHTTPServer,
+    Registry,
+    TRACES_EVICTED,
+)
+
+TRACE = "ab" * 16
+
+
+def span(name, sid, parent=None, start=0.0, end=1.0, events=(),
+         status="ok", trace=TRACE):
+    return {
+        "name": name, "trace_id": trace, "span_id": sid,
+        "parent_span_id": parent,
+        "start_unix": start, "end_unix": end,
+        "duration_ms": round((end - start) * 1e3, 3),
+        "status": status, "attributes": {},
+        "events": [{"ts": start, "name": e, "attributes": {}}
+                   for e in events],
+        "process": "t",
+    }
+
+
+def test_self_time_with_overlapping_children():
+    """Parent 0..10 with children 1..5 and 3..8: merged coverage is 7s,
+    parent self-time 3s — overlap must not be double-subtracted."""
+    spans = [
+        span("kubelet.prepare", "p", start=0, end=10),
+        span("prepare.devices", "c1", parent="p", start=1, end=5),
+        span("prepare.cdi", "c2", parent="p", start=3, end=8),
+    ]
+    a = criticalpath.analyze(spans)
+    assert a["segments_ms"]["prepare"] == pytest.approx(3000.0)
+    assert a["segments_ms"]["prepare.devices"] == pytest.approx(4000.0)
+    assert a["segments_ms"]["prepare.cdi"] == pytest.approx(5000.0)
+    assert a["e2e_ms"] == pytest.approx(10_000.0)
+    assert a["dominant"] == "prepare.cdi"
+
+
+def test_child_outside_parent_interval_contributes_nothing():
+    """The cross-process shape: kubelet.prepare is a CHILD of the
+    allocation root by span id but runs after the root ended — the
+    root's self-time must not go negative."""
+    spans = [
+        span("allocator.allocate", "r", start=0, end=1),
+        span("kubelet.prepare", "k", parent="r", start=3, end=5),
+    ]
+    a = criticalpath.analyze(spans)
+    assert a["segments_ms"]["allocation"] == pytest.approx(1000.0)
+    assert a["segments_ms"]["prepare"] == pytest.approx(2000.0)
+    # the scheduler/kubelet gap between commit and prepare
+    assert a["segments_ms"]["queue.wait"] == pytest.approx(2000.0)
+    assert a["e2e_ms"] == pytest.approx(5000.0)
+    assert sum(a["segments_ms"].values()) == pytest.approx(a["e2e_ms"])
+
+
+def test_retry_events_counted_per_segment():
+    spans = [
+        span("cd.prepare", "p", start=0, end=10),
+        span("cd.await_ready", "w", parent="p", start=0, end=9,
+             events=("retry", "retry", "retry")),
+        span("allocator.commit", "c", start=0, end=0.5,
+             events=("commit-conflict",)),
+    ]
+    a = criticalpath.analyze(spans)
+    assert a["retries"] == {"cd.await_ready": 3, "allocation.commit": 1}
+    assert a["dominant"] == "cd.await_ready"
+
+
+def test_missing_cd_phase_and_orphan_parent_tolerated():
+    """One process's half of a trace: a kubelet.prepare whose parent
+    span id points at a span this recorder never saw, no CD spans at
+    all — still analyzable."""
+    spans = [
+        span("kubelet.prepare", "k", parent="not-retained",
+             start=0, end=2),
+        span("prepare.commit", "c", parent="k", start=1.5, end=2),
+    ]
+    a = criticalpath.analyze(spans)
+    assert a["root"] == "kubelet.prepare"
+    assert a["segments_ms"]["prepare"] == pytest.approx(1500.0)
+    assert "cd.await_ready" not in a["segments_ms"]
+    assert a["errors"] == 0
+
+
+def test_unknown_span_names_fall_through_to_themselves():
+    a = criticalpath.analyze([span("mystery.phase", "m", start=0, end=1)])
+    assert a["segments_ms"] == {"mystery.phase": pytest.approx(1000.0)}
+
+
+def test_empty_trace():
+    a = criticalpath.analyze([])
+    assert a["spans"] == 0 and a["segments_ms"] == {}
+    assert a["dominant"] is None
+
+
+def test_attribution_property_nested_trees():
+    """Seeded property: for sequential (non-overlapping-sibling) span
+    trees the attribution is CONSERVATIVE — every segment >= 0 and the
+    segment sum equals the end-to-end wall time exactly."""
+    rng = random.Random(7)
+    for round_ in range(40):
+        spans = []
+        counter = [0]
+
+        def build(parent_id, start, end, depth):
+            counter[0] += 1
+            sid = f"s{counter[0]}"
+            spans.append(span(f"seg.{depth}.{counter[0]}", sid,
+                              parent=parent_id, start=start, end=end))
+            if depth >= 3:
+                return
+            # carve non-overlapping child windows inside (start, end)
+            cursor = start
+            for _ in range(rng.randrange(0, 3)):
+                span_len = (end - cursor) * rng.uniform(0.1, 0.4)
+                gap = (end - cursor) * rng.uniform(0.0, 0.2)
+                c0 = cursor + gap
+                c1 = min(end, c0 + span_len)
+                if c1 <= c0:
+                    continue
+                build(sid, c0, c1, depth + 1)
+                cursor = c1
+
+        total = rng.uniform(0.5, 20.0)
+        build(None, 0.0, total, 0)
+        a = criticalpath.analyze(spans)
+        assert all(v >= 0 for v in a["segments_ms"].values()), (round_, a)
+        # segments are rounded to 3 decimals each; allow that to stack
+        assert sum(a["segments_ms"].values()) == pytest.approx(
+            a["e2e_ms"], abs=0.5), (round_, a)
+
+
+def test_aggregate_percentiles_and_domination():
+    analyses = [criticalpath.analyze([
+        span("kubelet.prepare", "p", start=0, end=0.01 * (i + 1)),
+    ]) for i in range(10)]
+    rep = criticalpath.aggregate(analyses)
+    assert rep["traces_analyzed"] == 10
+    seg = rep["segments"]["prepare"]
+    assert seg["n"] == 10
+    assert seg["p50_ms"] <= seg["p99_ms"] <= seg["max_ms"]
+    assert rep["dominated_by"] == {"prepare": 10}
+    assert rep["e2e_ms"]["p99"] >= rep["e2e_ms"]["p50"]
+
+
+def test_flight_recorder_eviction_counted_and_reported():
+    """The bugfix: eviction is no longer silent — the counter ticks
+    (in TRACE units, as the family name says) and the aggregate's
+    coverage says the window is partial."""
+    evicted_before = TRACES_EVICTED.value
+    tracing.configure("always", capacity=4)
+    try:
+        rec = tracing.recorder()
+        for i in range(7):
+            tracing.start_span(f"s{i}").end()   # 7 single-span traces
+        assert len(rec) == 4
+        assert rec.evicted == 3
+        assert rec.evicted_traces == 3
+        assert TRACES_EVICTED.value - evicted_before == 3
+        rep = criticalpath.aggregate_report(rec)
+        assert rep["coverage"] == {"spans_retained": 4,
+                                   "spans_evicted": 3,
+                                   "traces_evicted": 3,
+                                   "complete": False}
+        assert rep["traces_analyzed"] == 4
+    finally:
+        tracing.reset()
+
+
+def test_eviction_counts_traces_not_spans():
+    """A multi-span trace counts ONCE in dra_traces_evicted_total —
+    when its last retained span leaves — while span-level eviction
+    keeps the raw figure for coverage."""
+    evicted_before = TRACES_EVICTED.value
+    tracing.configure("always", capacity=4)
+    try:
+        rec = tracing.recorder()
+        root = tracing.start_span("multi")      # one trace, 4 spans
+        for _ in range(3):
+            tracing.start_span("child", parent=root).end()
+        root.end()
+        # four more single-span traces push all 4 spans of the first out
+        for i in range(4):
+            tracing.start_span(f"later{i}").end()
+        assert rec.evicted == 4                 # spans
+        assert rec.evicted_traces == 1          # ONE trace gone
+        assert TRACES_EVICTED.value - evicted_before == 1
+    finally:
+        tracing.reset()
+
+
+def test_debug_criticalpath_endpoints():
+    tracing.configure("always", capacity=256)
+    try:
+        root = tracing.start_span("allocator.allocate")
+        with tracing.use_span(root):
+            with tracing.span("allocator.pick"):
+                pass
+        root.end()
+        trace_id = root.context.trace_id
+        srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry())
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/debug/criticalpath",
+                                        timeout=5) as r:
+                agg = json.loads(r.read().decode())
+            assert agg["traces_analyzed"] >= 1
+            assert "allocation" in agg["segments"]
+            assert agg["coverage"]["complete"] is True
+            with urllib.request.urlopen(
+                    f"{base}/debug/criticalpath/{trace_id}", timeout=5) as r:
+                one = json.loads(r.read().decode())
+            assert one["trace_id"] == trace_id
+            assert "allocation.pick" in one["segments_ms"]
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{base}/debug/criticalpath/{'0' * 32}", timeout=5)
+        finally:
+            srv.stop()
+    finally:
+        tracing.reset()

@@ -1,0 +1,274 @@
+"""tpu-dra-doctor (tools/doctor.py + cmd/doctor.py): metrics text
+parsing, the findings catalog (breaker open, SLO burning, parked
+claims, shard imbalance, watch-mux lag, quarantined checkpoints,
+evicted traces), bundle collection against a live DebugHTTPServer, the
+tarball layout, and the CLI.
+"""
+
+import json
+import os
+import tarfile
+
+import pytest
+
+from tpu_dra_driver.pkg.metrics import DebugHTTPServer, Registry
+from tpu_dra_driver.tools import doctor
+
+
+# ---------------------------------------------------------------------------
+# the offline Prometheus text reader
+# ---------------------------------------------------------------------------
+
+
+def test_parse_metrics_text_roundtrip_with_escapes():
+    reg = Registry()
+    c = reg.counter("t_escape_total", "t", ("label",))
+    c.labels('we"ird\\v\nalue').inc(3)
+    g = reg.gauge("t_plain", "t")
+    g.set(1.5)
+    h = reg.histogram("t_hist_seconds", "t", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    samples = doctor.parse_metrics_text(reg.render())
+    assert samples["t_plain"] == [({}, 1.5)]
+    labels, value = samples["t_escape_total"][0]
+    assert labels == {"label": 'we"ird\\v\nalue'} and value == 3.0
+    assert doctor.metric_value(samples, "t_hist_seconds_count") == 1.0
+    bucket_bounds = {ls["le"] for ls, _ in samples["t_hist_seconds_bucket"]}
+    assert bucket_bounds == {"0.1", "1", "+Inf"}
+
+
+def test_metric_value_label_filter_and_quantile():
+    reg = Registry()
+    c = reg.counter("t_outcomes_total", "t", ("result",))
+    c.labels("ok").inc(7)
+    c.labels("error").inc(3)
+    h = reg.histogram("t_lag_seconds", "t", buckets=(0.01, 0.1, 1.0, 5.0))
+    for _ in range(99):
+        h.observe(0.005)
+    h.observe(4.0)
+    samples = doctor.parse_metrics_text(reg.render())
+    assert doctor.metric_value(samples, "t_outcomes_total") == 10.0
+    assert doctor.metric_value(samples, "t_outcomes_total",
+                               {"result": "error"}) == 3.0
+    assert doctor.histogram_quantile(samples, "t_lag_seconds", 0.5) == 0.01
+    assert doctor.histogram_quantile(samples, "t_lag_seconds", 0.999) == 5.0
+    assert doctor.histogram_quantile(samples, "t_absent_seconds", 0.99) \
+        is None
+
+
+# ---------------------------------------------------------------------------
+# findings catalog over synthetic bundles
+# ---------------------------------------------------------------------------
+
+
+def _metrics_text(**families) -> str:
+    """Render a registry holding exactly the given planted samples."""
+    reg = Registry()
+    for name, entries in families.items():
+        if not entries:
+            continue
+        label_names = tuple(entries[0][0])
+        if name.endswith("_total"):
+            fam = reg.counter(name, "t", label_names)
+            for labels, value in entries:
+                (fam.labels(*labels.values()) if labels else fam).inc(value)
+        else:
+            fam = reg.gauge(name, "t", label_names)
+            for labels, value in entries:
+                (fam.labels(*labels.values()) if labels else fam).set(value)
+    return reg.render()
+
+
+def _codes(findings):
+    return [(f.severity, f.code) for f in findings]
+
+
+def test_finding_breaker_open_is_critical():
+    bundle = {"components": {"plugin": {"metrics": _metrics_text(
+        dra_circuit_breaker_state=[({"name": "apiserver"}, 2)])}}}
+    codes = _codes(doctor.run_findings(bundle))
+    assert (doctor.CRITICAL, "BREAKER_OPEN") in codes
+
+
+def test_finding_slo_burning_from_debug_slo():
+    bundle = {"components": {"ctrl": {
+        "metrics": "",
+        "slo": {"slos": {"claim-prepare-latency": {
+            "burning": True, "burning_windows": ["fast"],
+            "budget_remaining": -3.0,
+            "windows": {"fast": {"long": {"burn_rate": 40.0}}},
+            "description": "d"}}},
+    }}}
+    findings = doctor.run_findings(bundle)
+    f = next(f for f in findings if f.code == "SLO_BURNING")
+    assert f.severity == doctor.CRITICAL
+    assert "claim-prepare-latency" in f.message
+
+
+def test_finding_parked_claims_with_uids():
+    bundle = {"components": {"alloc": {
+        "metrics": _metrics_text(
+            dra_allocator_parked_claims=[({}, 2)]),
+        "allocator": {"parked_claims": [
+            {"namespace": "ns", "name": "a", "uid": "u1"},
+            {"namespace": "ns", "name": "b", "uid": "u2"}]},
+    }}}
+    f = next(f for f in doctor.run_findings(bundle)
+             if f.code == "PARKED_CLAIMS")
+    assert f.severity == doctor.WARNING
+    assert f.details["uids"] == ["u1", "u2"]
+
+
+def test_finding_shard_imbalance_threshold():
+    balanced = {"components": {"a": {"metrics": _metrics_text(
+        dra_shard_owned_pools=[({"slot": "s0"}, 10),
+                               ({"slot": "s1"}, 12)])}}}
+    assert not [f for f in doctor.run_findings(balanced)
+                if f.code == "SHARD_IMBALANCE"]
+    skewed = {"components": {"a": {"metrics": _metrics_text(
+        dra_shard_owned_pools=[({"slot": "s0"}, 50),
+                               ({"slot": "s1"}, 2),
+                               ({"slot": "s2"}, 2)])}}}
+    f = next(f for f in doctor.run_findings(skewed)
+             if f.code == "SHARD_IMBALANCE")
+    assert "s0" in f.message
+
+
+def test_finding_watch_mux_lag_from_histogram():
+    reg = Registry()
+    h = reg.histogram("dra_watch_mux_lag_seconds", "t",
+                      buckets=(0.01, 0.1, 1.0, 5.0))
+    for _ in range(100):
+        h.observe(4.0)
+    bundle = {"components": {"c": {"metrics": reg.render()}}}
+    f = next(f for f in doctor.run_findings(bundle)
+             if f.code == "WATCH_MUX_LAG")
+    assert f.severity == doctor.WARNING
+
+
+def test_finding_quarantined_evicted_and_faults_armed():
+    bundle = {"components": {"p": {
+        "metrics": _metrics_text(
+            dra_checkpoint_quarantined_total=[({}, 1)],
+            dra_traces_evicted_total=[({}, 9)]),
+        "vars": {"faults_armed": True,
+                 "fault_points_armed": {"rest.request": ["fail"]}},
+    }}}
+    codes = _codes(doctor.run_findings(bundle))
+    assert (doctor.WARNING, "CHECKPOINT_QUARANTINED") in codes
+    assert (doctor.INFO, "TRACES_EVICTED") in codes
+    assert (doctor.INFO, "FAULTS_ARMED") in codes
+
+
+def test_finding_state_dir_quarantine_and_warning_events():
+    bundle = {
+        "components": {},
+        "state_dirs": {"node0": {
+            "path": "/x", "checkpoints": [],
+            "quarantined": [{"file": "checkpoint.json.corrupt-1",
+                             "bytes": 10}]}},
+        "events": [{"type": "Warning", "reason": "PrepareFailed"},
+                   {"type": "Warning", "reason": "PrepareFailed"},
+                   {"type": "Normal", "reason": "Prepared"}],
+    }
+    findings = doctor.run_findings(bundle)
+    codes = _codes(findings)
+    assert (doctor.WARNING, "CHECKPOINT_QUARANTINE_FILES") in codes
+    ev = next(f for f in findings if f.code == "WARNING_EVENTS")
+    assert "'PrepareFailed': 2" in ev.message
+
+
+def test_findings_sorted_most_severe_first():
+    bundle = {"components": {"p": {
+        "metrics": _metrics_text(
+            dra_circuit_breaker_state=[({"name": "b"}, 2)],
+            dra_traces_evicted_total=[({}, 1)],
+            dra_allocator_parked_claims=[({}, 1)]),
+    }}}
+    sev = [f.severity for f in doctor.run_findings(bundle)]
+    assert sev == sorted(sev, key=lambda s: doctor._SEVERITY_ORDER[s])
+
+
+# ---------------------------------------------------------------------------
+# live collection + bundle tarball + CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sick_endpoint():
+    reg = Registry()
+    reg.gauge("dra_circuit_breaker_state", "t", ("name",)) \
+        .labels("apiserver").set(2)
+    srv = DebugHTTPServer(
+        ("127.0.0.1", 0), registry=reg,
+        json_endpoints={"/debug/vars": lambda: {
+            "component": "t", "faults_armed": False}})
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_collect_write_bundle_and_summary(sick_endpoint, tmp_path):
+    state = tmp_path / "state"
+    state.mkdir()
+    (state / "checkpoint.json").write_text("{}")
+    (state / "checkpoint.json.corrupt-1").write_text("xx")
+    bundle = doctor.collect(
+        {"plugin": f"127.0.0.1:{sick_endpoint.port}"},
+        state_dirs={"node0": str(state)})
+    art = bundle["components"]["plugin"]
+    assert "dra_circuit_breaker_state" in art["metrics"]
+    assert art["vars"]["component"] == "t"
+    assert [q["file"] for q in
+            bundle["state_dirs"]["node0"]["quarantined"]] == \
+        ["checkpoint.json.corrupt-1"]
+    findings = doctor.run_findings(bundle)
+    codes = {f.code for f in findings}
+    assert {"BREAKER_OPEN", "CHECKPOINT_QUARANTINE_FILES"} <= codes
+    # a 404'd optional surface (no /debug/allocator here) is not a finding
+    assert "SURFACE_UNAVAILABLE" not in codes
+
+    out = str(tmp_path / "bundle.tar.gz")
+    doctor.write_bundle(bundle, findings, out)
+    with tarfile.open(out) as tar:
+        names = set(tar.getnames())
+        assert {"plugin/metrics.txt", "plugin/vars.json",
+                "plugin/criticalpath.json", "plugin/slo.json",
+                "plugin/traces.json", "state_dirs.json",
+                "findings.json", "summary.txt"} <= names
+        listed = json.loads(
+            tar.extractfile("findings.json").read().decode())
+        assert listed[0]["code"] == "BREAKER_OPEN"
+        summary = tar.extractfile("summary.txt").read().decode()
+    assert "BREAKER_OPEN" in summary and "[CRITICAL" in summary
+
+
+def test_collect_unreachable_endpoint_degrades():
+    bundle = doctor.collect({"gone": "127.0.0.1:1"}, timeout=0.5)
+    art = bundle["components"]["gone"]
+    assert set(art["errors"]) == set(doctor.ENDPOINT_PATHS)
+    findings = doctor.run_findings(bundle)
+    assert all(f.code == "SURFACE_UNAVAILABLE" for f in findings)
+
+
+def test_cli_main_end_to_end(sick_endpoint, tmp_path, capsys):
+    from tpu_dra_driver.cmd import doctor as doctor_cmd
+    out = str(tmp_path / "cli-bundle.tar.gz")
+    rc = doctor_cmd.main([
+        "--endpoint", f"plugin=127.0.0.1:{sick_endpoint.port}",
+        "--output", out])
+    assert rc == 0
+    assert os.path.exists(out)
+    printed = capsys.readouterr().out
+    assert "BREAKER_OPEN" in printed and "bundle written" in printed
+    # scripted health-gate mode: critical findings flip the exit code
+    rc = doctor_cmd.main([
+        "--endpoint", f"plugin=127.0.0.1:{sick_endpoint.port}",
+        "--output", str(tmp_path / "cli-bundle2.tar.gz"),
+        "--fail-on", "critical"])
+    assert rc == 1
+
+
+def test_cli_requires_a_target(capsys):
+    from tpu_dra_driver.cmd import doctor as doctor_cmd
+    assert doctor_cmd.main(["--output", "/tmp/never.tar.gz"]) == 2

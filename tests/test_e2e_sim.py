@@ -62,6 +62,30 @@ def test_sim_e2e_collective_bench_spec(tmp_path):
     assert cb["teardown_clean"]
 
 
+def test_sim_e2e_doctor(tmp_path):
+    """Observability-interpretation acceptance (SLO/doctor PR): a
+    fault-injected latency on kubelet prepare drives the
+    claim-prepare-latency SLO into burn inside the production plugin
+    subprocess, the SLOBurnRate Event lands on the Node, the guilty
+    prepare segment dominates /debug/criticalpath, and tpu-dra-doctor
+    flags the burning SLO + parked-claim + open-breaker findings in
+    its triage summary over the same cluster."""
+    doc = _run_phase(tmp_path, "doctor")["doctor"]
+    assert doc["status"] == "green"
+    assert doc["slo_burning"]["slo"] == "claim-prepare-latency"
+    assert doc["slo_burning"]["budget_remaining"] < 0
+    assert doc["slo_event"]["involved"]["kind"] == "Node"
+    assert doc["slo_event"]["type"] == "Warning"
+    assert doc["criticalpath"]["dominant"].startswith("prepare")
+    assert doc["criticalpath"]["dominant_mean_ms"] >= 500
+    assert doc["criticalpath"]["traces_analyzed"] >= 1
+    assert doc["parked"]["claims"], doc["parked"]
+    assert doc["breaker_open"] is True
+    assert {"SLO_BURNING", "PARKED_CLAIMS", "BREAKER_OPEN"} <= \
+        set(doc["doctor"]["findings"])
+    assert doc["doctor"]["bundle_members"] >= 10
+
+
 def test_sim_e2e_compute_domain(tmp_path):
     cd = _run_phase(tmp_path, "compute-domain")["compute_domain"]
     assert cd["status"] == "green"

@@ -388,6 +388,75 @@ def test_dra_metric_families_registered_once_and_documented():
 
 
 # ---------------------------------------------------------------------------
+# SLO coverage lint (observability PR): every latency histogram family is
+# either interpreted by an SLO spec or explicitly exempted with a reason
+# ---------------------------------------------------------------------------
+
+# A latency family nobody interprets is a dashboard nobody looks at: the
+# SLO engine (pkg/slo.py) must reference it, or this list must say why
+# not. An entry that becomes covered (or whose family disappears) FAILS
+# the stale check — the exemption list cannot rot into a blanket waiver.
+_SLO_EXEMPT = {
+    "dra_prepare_batch_phase_seconds":
+        "phase-level breakdown of the prepare path; the per-claim "
+        "dra_claim_prepare_duration_seconds carries the SLO and the "
+        "critical-path analyzer attributes the phases",
+    "dra_claim_unprepare_duration_seconds":
+        "teardown path — not on the claim-to-ready journey users wait on",
+    "dra_prepare_lock_wait_seconds":
+        "a component of prepare latency already covered by the per-claim "
+        "prepare SLO; alerting on it separately would double-count",
+    "dra_informer_watch_lag_seconds":
+        "control-plane internals; surfaced through the tpu-dra-doctor "
+        "WATCH_MUX_LAG-style triage rather than a user-facing SLO",
+    "dra_watch_mux_lag_seconds":
+        "covered by the tpu-dra-doctor WATCH_MUX_LAG finding (p99 "
+        "threshold), which is the operational consumer of this family",
+}
+
+
+def _dra_latency_histograms():
+    """dra_*_seconds families registered via .histogram() with a
+    literal name anywhere under tpu_dra_driver/."""
+    import ast
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = set()
+    for dirpath, _, files in os.walk(os.path.join(repo, "tpu_dra_driver")):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "histogram"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.startswith("dra_")
+                        and node.args[0].value.endswith("_seconds")):
+                    out.add(node.args[0].value)
+    return out
+
+
+def test_latency_histograms_covered_by_slo_or_exempt():
+    from tpu_dra_driver.pkg.slo import DEFAULT_SPECS
+    latency = _dra_latency_histograms()
+    assert latency, "no dra_*_seconds histograms found — scanner broken?"
+    covered = {spec.family for spec in DEFAULT_SPECS}
+    unaccounted = sorted(latency - covered - set(_SLO_EXEMPT))
+    assert unaccounted == [], (
+        f"latency histogram families with neither an SLO spec "
+        f"(pkg/slo.py DEFAULT_SPECS) nor an exemption reason: "
+        f"{unaccounted}")
+    stale = sorted(f for f in _SLO_EXEMPT
+                   if f in covered or f not in latency)
+    assert stale == [], f"stale _SLO_EXEMPT entries: {stale}"
+
+
+# ---------------------------------------------------------------------------
 # drill-coverage lint (fleet-scenario PR): every registered fault point is
 # exercised by at least one drill or scenario, or explicitly allowlisted
 # ---------------------------------------------------------------------------

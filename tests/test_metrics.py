@@ -132,6 +132,53 @@ def test_dump_thread_stacks_mentions_this_function():
     assert "test_dump_thread_stacks_mentions_this_function" in dump_thread_stacks()
 
 
+def test_debug_vars_endpoint_via_json_endpoints():
+    """The /debug/vars satellite: every binary wires
+    flags.debug_vars_fn through json_endpoints — build info, uptime,
+    parsed flags, trace mode, fault arm state."""
+    import argparse
+    import json as _json
+
+    from tpu_dra_driver.pkg.flags import debug_vars_fn
+    args = argparse.Namespace(node_name="n0", verbosity=4)
+    srv = DebugHTTPServer(
+        ("127.0.0.1", 0), registry=Registry(),
+        json_endpoints={"/debug/vars": debug_vars_fn(args, "test-comp")})
+    srv.start()
+    try:
+        status, body = fetch(srv.port, "/debug/vars")
+        assert status == 200
+        doc = _json.loads(body)
+        assert doc["component"] == "test-comp"
+        assert doc["flags"]["node_name"] == "n0"
+        assert doc["uptime_s"] >= 0
+        assert doc["trace_mode"] in ("disabled", "sampled", "always")
+        assert doc["faults_armed"] in (True, False)
+        assert isinstance(doc["fault_points_armed"], dict)
+        assert doc["version"]
+    finally:
+        srv.stop()
+
+
+def test_json_endpoint_error_answers_500_not_crash():
+    def boom():
+        raise RuntimeError("kaput")
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry(),
+                          json_endpoints={"/debug/boom": boom})
+    srv.start()
+    try:
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/debug/boom")
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+        # the server survives and still answers other paths
+        status, _ = fetch(srv.port, "/healthz")
+        assert status == 200
+    finally:
+        srv.stop()
+
+
 # ---------------------------------------------------------------------------
 # Prometheus exposition edge cases (observability PR): label-value
 # escaping, +Inf rendering, the versioned content-type, and /readyz

@@ -1,0 +1,371 @@
+"""SLO engine (pkg/slo.py): snapshot/delta accessors, burn-rate math
+(property-tested: window ratios, zero-traffic windows, error-budget
+exhaustion exactly at the threshold), multi-window alerting with
+deterministic clocks, SLOBurnRate Events, and the /debug/slo surface.
+"""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.events import REASON_SLO_BURN_RATE, EventRecorder
+from tpu_dra_driver.pkg import slo
+from tpu_dra_driver.pkg.flags import parse_slo_windows
+from tpu_dra_driver.pkg.metrics import (
+    DEFAULT_REGISTRY,
+    DebugHTTPServer,
+    Registry,
+)
+
+
+# ---------------------------------------------------------------------------
+# Histogram snapshot/delta (the satellite: no engine-side subtraction hacks)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_snapshot_and_delta():
+    reg = Registry()
+    h = reg.histogram("t_snap_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.3, 2.0):
+        h.observe(v)
+    s1 = h.snapshot()
+    assert s1.count == 3 and s1.counts == (1, 1, 0)
+    assert s1.count_le(0.5) == 2
+    h.observe(0.4)
+    h.observe(0.05)
+    s2 = h.snapshot()
+    d = s2.delta(s1)
+    assert d.count == 2
+    assert d.counts == (1, 1, 0)
+    assert d.sum == pytest.approx(0.45)
+    # delta against None = everything so far
+    assert s2.delta(None).count == 5
+
+
+def test_histogram_delta_counter_reset_across_restart():
+    """A process restart re-registers the family from zero; the delta
+    must be the post-restart traffic, never negative."""
+    reg1 = Registry()
+    h1 = reg1.histogram("t_reset_seconds", "t", buckets=(0.1, 1.0))
+    for _ in range(10):
+        h1.observe(0.05)
+    before = h1.snapshot()
+    # "restart": a brand-new registry + family with less traffic
+    reg2 = Registry()
+    h2 = reg2.histogram("t_reset_seconds", "t", buckets=(0.1, 1.0))
+    for _ in range(3):
+        h2.observe(0.05)
+    after = h2.snapshot()
+    d = after.delta(before)
+    assert d.count == 3 and d.counts == (3, 0)
+    assert d.sum == pytest.approx(after.sum)
+
+
+def test_labeled_snapshots_and_counter_values():
+    reg = Registry()
+    h = reg.histogram("t_lab_seconds", "t", ("result",), buckets=(0.1, 1.0))
+    h.labels("ok").observe(0.05)
+    h.labels("ok").observe(0.5)
+    h.labels("error").observe(0.05)
+    snaps = h.snapshots()
+    assert set(snaps) == {("ok",), ("error",)}
+    assert snaps[("ok",)].count == 2
+    c = reg.counter("t_total", "t", ("result",))
+    c.labels("ok").inc(4)
+    c.labels("error").inc()
+    assert c.values() == {("ok",): 4.0, ("error",): 1.0}
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math properties
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_zero_traffic_is_perfect():
+    burn, sli = slo.burn_rate(0, 0, 0.99)
+    assert (burn, sli) == (0.0, 1.0)
+
+
+def test_burn_rate_exactly_on_budget_is_one():
+    # objective 0.99 → 1% budget; exactly 1% bad → burn exactly 1.0
+    burn, sli = slo.burn_rate(99, 100, 0.99)
+    assert burn == pytest.approx(1.0)
+    assert sli == pytest.approx(0.99)
+
+
+def test_burn_rate_property_sweep():
+    """Seeded property sweep: burn = (1-sli)/budget, sli ∈ [0,1],
+    burn >= 0, all-good → 0, all-bad → 1/budget."""
+    rng = random.Random(42)
+    for _ in range(500):
+        total = rng.randrange(0, 1000)
+        good = rng.randrange(0, total + 1)
+        objective = rng.choice((0.9, 0.99, 0.999, 0.9999))
+        burn, sli = slo.burn_rate(good, total, objective)
+        assert 0.0 <= sli <= 1.0
+        assert burn >= 0.0
+        if total:
+            assert sli == pytest.approx(good / total)
+            assert burn == pytest.approx((1 - sli) / (1 - objective))
+        if total and good == total:
+            assert burn == 0.0
+        if total and good == 0:
+            assert burn == pytest.approx(1.0 / (1 - objective))
+
+
+# ---------------------------------------------------------------------------
+# engine: deterministic clock, multi-window semantics
+# ---------------------------------------------------------------------------
+
+
+def _engine(reg, name="t-lat", objective=0.99, threshold=0.5,
+            windows=(slo.BurnWindow("fast", 100.0, 10.0, 2.0),),
+            **kwargs):
+    clock = [0.0]
+    spec = slo.SLOSpec(name, "t_eng_seconds", objective, slo.LATENCY,
+                       threshold=threshold)
+    eng = slo.SLOEngine(registries=[reg], specs=(spec,), windows=windows,
+                        tick=1.0, now_fn=lambda: clock[0], **kwargs)
+    return eng, clock, spec
+
+
+def test_engine_burning_and_short_window_recovery():
+    """The multi-window contract: bad traffic burns; once the SHORT
+    window sees only good traffic the alert clears even though the
+    long window is still scarred."""
+    reg = Registry()
+    h = reg.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng, clock, _ = _engine(reg)
+    eng.sample()                      # t=0 baseline
+    for _ in range(100):
+        h.observe(0.9)                # all bad vs 0.5s threshold
+    clock[0] = 95.0
+    eng.sample()
+    clock[0] = 100.0
+    rep = eng.evaluate()
+    row = rep["slos"]["t-lat"]
+    assert row["burning"] is True
+    assert row["burning_windows"] == ["fast"]
+    assert row["windows"]["fast"]["long"]["burn_rate"] >= 2.0
+    assert row["budget_remaining"] < 0          # overspent
+    # recovery: the short window turns all-good
+    for _ in range(1000):
+        h.observe(0.05)
+    clock[0] = 150.0
+    eng.sample()
+    clock[0] = 155.0
+    rep = eng.evaluate()
+    row = rep["slos"]["t-lat"]
+    assert row["burning"] is False, row
+
+
+def test_engine_budget_exhaustion_exactly_at_threshold_burns():
+    """Boundary property: burn rate landing EXACTLY on the window
+    threshold alerts (>=, not >) — budget exhaustion at the edge is
+    still exhaustion."""
+    reg = Registry()
+    h = reg.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng, clock, _ = _engine(reg, objective=0.99,
+                            windows=(slo.BurnWindow("w", 100.0, 100.0,
+                                                    2.0),))
+    eng.sample()
+    # 2% bad of 0.01 budget = burn exactly 2.0 == threshold
+    for _ in range(98):
+        h.observe(0.05)
+    for _ in range(2):
+        h.observe(0.9)
+    clock[0] = 99.0
+    eng.sample()
+    clock[0] = 100.0
+    rep = eng.evaluate()
+    row = rep["slos"]["t-lat"]
+    assert row["windows"]["w"]["long"]["burn_rate"] == pytest.approx(2.0)
+    assert row["burning"] is True
+
+
+def test_engine_zero_traffic_never_burns():
+    reg = Registry()
+    reg.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng, clock, _ = _engine(reg)
+    eng.sample()
+    clock[0] = 100.0
+    rep = eng.evaluate_once()
+    row = rep["slos"]["t-lat"]
+    assert row["burning"] is False
+    assert row["windows"]["fast"]["long"]["sli"] == 1.0
+    assert row["budget_remaining"] == 1.0
+
+
+def test_engine_counter_reset_degrades_to_restart_window():
+    """A family reset (restart) must read as 'window starts at the
+    restart', never as negative traffic."""
+    reg = Registry()
+    h = reg.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng, clock, _ = _engine(reg)
+    for _ in range(50):
+        h.observe(0.05)
+    eng.sample()                       # cumulative (50, 50)
+    # restart: swap the family for a fresh one with less, all-bad data
+    reg2 = Registry()
+    h2 = reg2.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng.add_registry(reg2)
+    eng._registries.remove(reg)
+    for _ in range(10):
+        h2.observe(0.9)
+    clock[0] = 50.0
+    rep = eng.evaluate_once()
+    arm = rep["slos"]["t-lat"]["windows"]["fast"]["long"]
+    assert arm["total"] == 10.0        # post-restart traffic only
+    assert arm["good"] == 0.0
+
+
+def test_engine_availability_spec_over_counter():
+    reg = Registry()
+    c = reg.counter("t_avail_total", "t", ("result",))
+    spec = slo.SLOSpec("t-avail", "t_avail_total", 0.9, slo.AVAILABILITY,
+                       good_label_values=("ok",))
+    clock = [0.0]
+    eng = slo.SLOEngine(registries=[reg], specs=(spec,),
+                        windows=(slo.BurnWindow("w", 100.0, 10.0, 2.0),),
+                        tick=1.0, now_fn=lambda: clock[0])
+    eng.sample()
+    c.labels("ok").inc(5)
+    c.labels("error").inc(5)
+    clock[0] = 99.0
+    rep = eng.evaluate_once()
+    arm = rep["slos"]["t-avail"]["windows"]["w"]["long"]
+    assert arm["sli"] == pytest.approx(0.5)
+    assert rep["slos"]["t-avail"]["burning"] is True
+
+
+def test_latency_spec_scopes_to_label_values():
+    """Fast FAILURES must not read as good latency: a result-labeled
+    latency spec restricted to ok children ignores 1ms error returns
+    (those are the availability spec's problem)."""
+    reg = Registry()
+    h = reg.histogram("t_scope_seconds", "t", ("result",),
+                      buckets=(0.1, 0.5, 1.0))
+    # an outage: every prepare fails fast
+    for _ in range(100):
+        h.labels("error").observe(0.001)
+    # the two slow successes that DID happen
+    h.labels("ok").observe(0.9)
+    h.labels("ok").observe(0.9)
+    scoped = slo.SLOSpec("t-scoped", "t_scope_seconds", 0.99, slo.LATENCY,
+                         threshold=0.5, label_values=("ok",))
+    good, total = slo.sample_spec(scoped, [reg])
+    assert (good, total) == (0.0, 2.0)     # only successes count; all slow
+    unscoped = slo.SLOSpec("t-all", "t_scope_seconds", 0.99, slo.LATENCY,
+                           threshold=0.5)
+    good, total = slo.sample_spec(unscoped, [reg])
+    assert (good, total) == (100.0, 102.0)  # the masking the scope fixes
+    # the default catalog scopes the result-labeled prepare family
+    prepare = next(s for s in slo.DEFAULT_SPECS
+                   if s.name == "claim-prepare-latency")
+    assert prepare.label_values == ("ok",)
+
+
+def test_sample_spec_missing_family_is_zero_traffic():
+    spec = slo.SLOSpec("ghost", "t_nowhere_seconds", 0.99, slo.LATENCY,
+                       threshold=0.5)
+    assert slo.sample_spec(spec, [Registry()]) == (0.0, 0.0)
+
+
+def test_engine_gauges_updated():
+    reg = Registry()
+    h = reg.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng, clock, _ = _engine(reg, name="t-gauges")
+    eng.sample()
+    for _ in range(10):
+        h.observe(0.9)
+    clock[0] = 50.0
+    eng.evaluate_once()
+    assert slo.SLO_BURNING.labels("t-gauges").value == 1.0
+    assert slo.SLO_BURN_RATE.labels("t-gauges", "fast_long").value >= 2.0
+    assert slo.SLO_BUDGET_REMAINING.labels("t-gauges").value < 0
+
+
+def test_engine_emits_deduped_slo_burn_rate_event():
+    clients = ClientSets()
+    recorder = EventRecorder(clients.events, component="t-slo")
+    reg = Registry()
+    h = reg.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng, clock, _ = _engine(reg, name="t-event")
+    eng.set_recorder(recorder, {"kind": "Node", "name": "node-1"})
+    eng.sample()
+    for _ in range(10):
+        h.observe(0.9)
+    clock[0] = 50.0
+    eng.evaluate_once()
+    # keep burning but with a DRIFTED burn rate: the Event message must
+    # stay dedupe-stable (live numbers belong on /debug/slo, not in the
+    # message — a rate-bearing message would mint a fresh Event per tick)
+    for _ in range(7):
+        h.observe(0.9)
+    h.observe(0.05)
+    clock[0] = 60.0
+    eng.evaluate_once()
+    assert recorder.flush(timeout=5.0)
+    events = [e for e in clients.events.list()
+              if e.get("reason") == REASON_SLO_BURN_RATE]
+    assert len(events) == 1, events
+    ev = events[0]
+    assert ev["type"] == "Warning"
+    assert ev["involvedObject"] == {"kind": "Node", "name": "node-1"}
+    assert "t-event" in ev["message"] and "burn rate" in ev["message"]
+    assert ev["count"] == 2
+
+
+def test_debug_slo_endpoint_serves_engine_report():
+    reg = Registry()
+    h = reg.histogram("t_eng_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    eng, clock, _ = _engine(reg, name="t-http")
+    try:
+        slo.configure(eng)
+        eng.sample()
+        for _ in range(10):
+            h.observe(0.9)
+        clock[0] = 50.0
+        eng.evaluate_once()
+        srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry())
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/slo", timeout=5) as r:
+                assert r.headers["Content-Type"] == "application/json"
+                doc = json.loads(r.read().decode())
+            assert doc["slos"]["t-http"]["burning"] is True
+        finally:
+            srv.stop()
+    finally:
+        slo.reset()
+    assert slo.report() == {}      # disarmed → empty payload
+
+
+def test_default_specs_resolve_against_default_registry():
+    """Every default spec's family either exists on the process
+    registry (importing the fire-site modules registers them) or is
+    per-instance (cd rendezvous) — and sampling never raises."""
+    import tpu_dra_driver.kube.allocator  # noqa: F401  (registers families)
+    for spec in slo.DEFAULT_SPECS:
+        good, total = slo.sample_spec(spec, [DEFAULT_REGISTRY])
+        assert good >= 0 and total >= good or total == 0
+
+
+# ---------------------------------------------------------------------------
+# flag grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slo_windows_grammar():
+    wins = parse_slo_windows("fast:300/60:14.4,slow:3600/300:6")
+    assert [w.name for w in wins] == ["fast", "slow"]
+    assert wins[0].long_s == 300.0 and wins[0].short_s == 60.0
+    assert wins[0].threshold == 14.4
+    assert parse_slo_windows("") == slo.DEFAULT_WINDOWS
+    for bad in ("fast:300:2", "fast:10/20:2", "x", "fast:a/b:c"):
+        with pytest.raises(SystemExit):
+            parse_slo_windows(bad)

@@ -120,15 +120,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     debug_server = None
     address = parse_http_endpoint(args.http_endpoint)
     if address is not None:
+        from tpu_dra_driver.pkg.flags import debug_vars_fn
         from tpu_dra_driver.pkg.metrics import DebugHTTPServer
         debug_server = DebugHTTPServer(
-            address, ready_check=lambda: controller.claim_informer.synced)
+            address, ready_check=lambda: controller.claim_informer.synced,
+            json_endpoints={
+                "/debug/vars": debug_vars_fn(args, "allocation-controller"),
+                # parked-claim UIDs + owned shard slots for the doctor
+                "/debug/allocator": controller.debug_state,
+            })
         debug_server.start()
 
     from tpu_dra_driver.kube.events import EventRecorder
     recorder = EventRecorder(clients.events,
                              component="allocation-controller",
                              host=args.identity)
+    from tpu_dra_driver.pkg import slo
+    slo.attach_recorder(recorder,
+                        {"kind": "Pod", "name": args.identity,
+                         "namespace": args.leader_election_namespace})
     if shard_wiring is not None:
         # One leader PER SHARD SLOT: the controller starts with nothing
         # owned and drains whatever slots its leases win; a replica

@@ -110,6 +110,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                                args.additional_namespaces.split(",")
                                if ns.strip()]))
 
+    # the CD controller's per-instance registry carries
+    # dra_cd_rendezvous_seconds — make it visible to the SLO engine's
+    # cd-rendezvous-latency spec, and wire SLOBurnRate Events
+    from tpu_dra_driver.pkg import slo
+    slo.add_registry(controller.registry)
+    slo.attach_recorder(controller.event_recorder,
+                        {"kind": "Pod", "name": args.identity,
+                         "namespace": args.leader_election_namespace})
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -117,7 +126,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     debug_server = None
     address = parse_http_endpoint(args.http_endpoint)
     if address is not None:
-        debug_server = DebugHTTPServer(address, registry=controller.registry)
+        from tpu_dra_driver.pkg.flags import debug_vars_fn
+        debug_server = DebugHTTPServer(
+            address, registry=controller.registry,
+            json_endpoints={"/debug/vars": debug_vars_fn(
+                args, "compute-domain-controller")})
         debug_server.start()
 
     if args.leader_election:

@@ -117,8 +117,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     debug_server = None
     address = parse_http_endpoint(args.http_endpoint)
     if address is not None:
+        from tpu_dra_driver.pkg.flags import debug_vars_fn
         from tpu_dra_driver.pkg.metrics import DebugHTTPServer
-        debug_server = DebugHTTPServer(address, ready_check=daemon.check)
+        debug_server = DebugHTTPServer(
+            address, ready_check=daemon.check,
+            json_endpoints={"/debug/vars": debug_vars_fn(
+                args, "compute-domain-daemon")})
         debug_server.start()
 
     stop = threading.Event()

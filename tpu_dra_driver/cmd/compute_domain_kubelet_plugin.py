@@ -94,11 +94,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             healthy_fn=getattr(plugin, "healthy", None))
         healthcheck.start()
 
+    from tpu_dra_driver.pkg import slo
+    slo.attach_recorder(plugin.event_recorder,
+                        {"kind": "Node", "name": args.node_name})
+
     debug_server = None
     address = parse_http_endpoint(args.http_endpoint)
     if address is not None:
+        from tpu_dra_driver.pkg.flags import debug_vars_fn
         from tpu_dra_driver.pkg.metrics import DebugHTTPServer
-        debug_server = DebugHTTPServer(address, ready_check=plugin.healthy)
+        debug_server = DebugHTTPServer(
+            address, ready_check=plugin.healthy,
+            json_endpoints={"/debug/vars": debug_vars_fn(
+                args, "compute-domain-kubelet-plugin")})
         debug_server.start()
 
     stop = threading.Event()

@@ -1,0 +1,104 @@
+"""tpu-dra-doctor binary: one-command cluster diagnostics bundle.
+
+The ``nvidia-bug-report.sh``/must-gather analog for this driver: point
+it at every component's ``--http-endpoint`` (and optionally a
+kubeconfig + plugin state dirs), and it collects all debug surfaces
+into one tarball, runs automated findings (breaker open, SLO burning,
+parked claims, shard imbalance, watch-mux lag, quarantined
+checkpoints), and prints a severity-sorted triage summary.
+
+    tpu-dra-doctor \\
+        --endpoint allocation-controller=10.0.0.1:8080 \\
+        --endpoint tpu-plugin-node0=10.0.1.2:8080 \\
+        --state-dir node0=/var/lib/kubelet/plugins/tpu.google.com \\
+        --kubeconfig ~/.kube/config \\
+        --output /tmp/tpu-dra-doctor.tar.gz
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra_driver.cmd.tpu_kubelet_plugin import make_clients
+from tpu_dra_driver.pkg.flags import (
+    EnvArgumentParser,
+    add_common_flags,
+    setup_observability,
+)
+from tpu_dra_driver.tools import doctor
+
+
+def _parse_pairs(values: List[str], flag: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for item in values or []:
+        name, sep, value = item.partition("=")
+        if not sep or not name or not value:
+            raise SystemExit(f"{flag}: expected NAME=VALUE, got {item!r}")
+        out[name] = value
+    return out
+
+
+def build_parser() -> EnvArgumentParser:
+    p = EnvArgumentParser(prog="tpu-dra-doctor")
+    add_common_flags(p)
+    p.add_argument("--endpoint", action="append", default=[],
+                   metavar="NAME=HOST:PORT",
+                   help="a component's --http-endpoint to collect from "
+                        "(repeatable)")
+    p.add_argument("--state-dir", action="append", default=[],
+                   metavar="NAME=PATH",
+                   help="a plugin state dir to inventory for checkpoints "
+                        "and quarantined corpses (repeatable)")
+    p.add_argument("--collect-events", action="store_true", default=False,
+                   help="also collect recent Events through the API "
+                        "server (--kubeconfig / in-cluster config)")
+    p.add_argument("--output", env="DOCTOR_OUTPUT", default="",
+                   help="bundle tarball path (default "
+                        "./tpu-dra-doctor-<unix>.tar.gz)")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="per-surface HTTP timeout in seconds")
+    p.add_argument("--fail-on", default="never",
+                   choices=["never", "critical", "warning"],
+                   help="exit nonzero when findings at/above this "
+                        "severity exist (for scripted health gates)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    # a diagnostics CLI must not itself spawn an SLO engine thread
+    args.slo_tick = 0.0
+    setup_observability(args, "tpu-dra-doctor")
+
+    endpoints = _parse_pairs(args.endpoint, "--endpoint")
+    state_dirs = _parse_pairs(args.state_dir, "--state-dir")
+    if not endpoints and not state_dirs:
+        print("nothing to collect: pass at least one --endpoint or "
+              "--state-dir", file=sys.stderr)
+        return 2
+
+    clients = None
+    if args.collect_events:
+        clients = make_clients(args)
+
+    bundle = doctor.collect(endpoints, state_dirs=state_dirs,
+                            clients=clients, timeout=args.timeout)
+    findings = doctor.run_findings(bundle)
+    out_path = args.output or f"tpu-dra-doctor-{int(time.time())}.tar.gz"
+    doctor.write_bundle(bundle, findings, out_path)
+
+    print(doctor.summary_text(findings, bundle), end="")
+    print(f"bundle written to {out_path}")
+
+    if args.fail_on != "never":
+        levels = {"critical": (doctor.CRITICAL,),
+                  "warning": (doctor.CRITICAL, doctor.WARNING)}[args.fail_on]
+        if any(f.severity in levels for f in findings):
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,12 +140,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             port=args.health_port, healthy_fn=plugin.healthy)
         healthcheck.start()
 
+    # SLOBurnRate Events ride the plugin's existing recorder, hung off
+    # this Node (the object an operator describes when a node is slow)
+    from tpu_dra_driver.pkg import slo
+    slo.attach_recorder(plugin.event_recorder,
+                        {"kind": "Node", "name": args.node_name})
+
     debug_server = None
-    from tpu_dra_driver.pkg.flags import parse_http_endpoint
+    from tpu_dra_driver.pkg.flags import debug_vars_fn, parse_http_endpoint
     address = parse_http_endpoint(args.http_endpoint)
     if address is not None:
         from tpu_dra_driver.pkg.metrics import DebugHTTPServer
-        debug_server = DebugHTTPServer(address, ready_check=plugin.healthy)
+        debug_server = DebugHTTPServer(
+            address, ready_check=plugin.healthy,
+            json_endpoints={
+                "/debug/vars": debug_vars_fn(args, "tpu-kubelet-plugin")})
         debug_server.start()
 
     stop = threading.Event()

@@ -149,6 +149,7 @@ class ComputeDomainController:
         self._rendezvous_spans: Dict[str, object] = {}
         self._events_rec = EventRecorder(
             clients.events, component="compute-domain-controller")
+
         def pod_cd_uid(obj: Dict):
             uid = ((obj.get("metadata") or {}).get("labels") or {}).get(
                 COMPUTE_DOMAIN_LABEL_KEY)
@@ -178,6 +179,12 @@ class ComputeDomainController:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
+
+    @property
+    def event_recorder(self) -> EventRecorder:
+        """The controller's Event sink — shared with the SLO engine so
+        SLOBurnRate Warnings ride the same deduped async pipeline."""
+        return self._events_rec
 
     def start(self) -> None:
         self._cd_informer.add_handlers(

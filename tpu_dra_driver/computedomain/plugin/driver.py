@@ -98,6 +98,12 @@ class CdKubeletPlugin:
             clients.events, component="compute-domain-kubelet-plugin",
             host=config.node_name)
 
+    @property
+    def event_recorder(self) -> EventRecorder:
+        """The plugin's Event sink — shared with the SLO engine so
+        SLOBurnRate Warnings ride the same deduped async pipeline."""
+        return self._events
+
     def _notify_waiters(self) -> None:
         with self._waiters_mu:
             for ev in self._waiters:

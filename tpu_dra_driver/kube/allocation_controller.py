@@ -560,6 +560,35 @@ class AllocationController:
         with self._cond:
             return len(self._pending), len(self._parked)
 
+    def debug_state(self) -> Dict:
+        """The ``/debug/allocator`` payload: parked-claim identities
+        (with UIDs — what ``kubectl describe`` cross-references), queue
+        depths, and shard-slot ownership; collected verbatim into the
+        tpu-dra-doctor bundle."""
+        with self._cond:
+            parked = [{"namespace": key[0], "name": key[1],
+                       "uid": ref.get("uid", "")}
+                      for key, ref in self._parked_refs.items()]
+            pending = len(self._pending)
+            cross = len(self._cross_routes)
+            inflight = self._inflight
+        out: Dict = {
+            "pending": pending,
+            "inflight_batches": inflight,
+            "parked_claims": parked,
+            "cross_shard_routes": cross,
+            "catalog_version": self.catalog.version,
+            "workers": self._config.workers,
+            "batch_max": self._config.batch_max,
+        }
+        if self._shard is not None:
+            out["sharded"] = True
+            out["owned_slots"] = sorted(self._shard.owned)
+            out["ring_slots"] = list(self._shard.ring.members)
+        else:
+            out["sharded"] = False
+        return out
+
     def drain_inflight(self, timeout: float = 5.0) -> bool:
         """Wait until no batch is mid-flight (pending claims may remain
         queued). The hand-off fence uses this: a batch started before a

@@ -80,6 +80,7 @@ from tpu_dra_driver.kube.events import (
 from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.metrics import (
+    ALLOCATION_RESULTS,
     ALLOCATION_SECONDS,
     ALLOCATOR_CANDIDATES_SCANNED,
     ALLOCATOR_COMMIT_CONFLICTS,
@@ -348,6 +349,9 @@ class Allocator:
             res = out[uid]
             ALLOCATION_SECONDS.observe(time.perf_counter() - t0,
                                        exemplar=tracing.exemplar(root))
+            # the allocation-availability SLO's good/total source
+            ALLOCATION_RESULTS.labels(
+                "ok" if res.error is None else "error").inc()
             root.set_attribute("result",
                                "ok" if res.error is None else "error")
             root.end(status="ok" if res.error is None else "error")
@@ -560,6 +564,9 @@ class Allocator:
             updated = self._clients.resource_claims.update(obj)
         except ConflictError:
             ALLOCATOR_COMMIT_CONFLICTS.inc()
+            # rides the allocator.commit span so the critical-path
+            # analyzer counts verify-on-commit retries per trace
+            tracing.add_event("commit-conflict")
             try:
                 fresh = self._clients.resource_claims.get(name, namespace)
             except NotFoundError as e:

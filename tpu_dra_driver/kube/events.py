@@ -62,6 +62,7 @@ REASON_UNPREPARED = "Unprepared"
 REASON_UNPREPARE_FAILED = "UnprepareFailed"
 REASON_CD_READY = "CDReady"
 REASON_VALIDATION_FAILED = "ValidationFailed"
+REASON_SLO_BURN_RATE = "SLOBurnRate"
 
 #: Worker threads exit after this long idle and respawn on demand, so
 #: short-lived recorders (benches, tests) don't accumulate parked threads.

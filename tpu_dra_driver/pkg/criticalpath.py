@@ -1,0 +1,221 @@
+"""Critical-path analysis: attribute claim latency to named segments.
+
+The flight recorder (pkg/tracing.py) answers "what happened to THIS
+claim" as raw spans; this module answers the operator question one
+level up: **where did the time go?** Each finished trace is walked into
+a per-segment attribution — allocation pick/commit, commit-conflict
+retries, each kubelet prepare phase, the cd.await_ready rendezvous
+wait, the scheduler/kubelet gap between allocation and prepare — and
+rolling per-segment p50/p99 aggregates are served at
+``/debug/criticalpath`` (per-trace attribution at
+``/debug/criticalpath/<trace-id>``) on every
+:class:`~tpu_dra_driver.pkg.metrics.DebugHTTPServer`.
+
+Attribution model: a span's segment is charged its **self time** —
+wall duration minus the union of its children's intervals (children
+clipped to the parent, overlapping children merged, so a parent that
+runs two children concurrently is not charged negative time). Gaps the
+spans don't cover are reported honestly: ``queue.wait`` (allocation
+root end → first prepare span start: the scheduler/kubelet window the
+driver does not control) and ``unattributed`` (end-to-end minus
+everything accounted). Coverage is equally honest: the aggregate
+report carries the flight recorder's eviction count
+(``dra_traces_evicted_total``) so attribution over a recorder that
+dropped traces says so instead of silently narrowing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: span name -> segment name. Unknown span names fall through to their
+#: own name, so new instrumentation shows up without a mapping edit.
+SEGMENT_BY_SPAN = {
+    "allocator.allocate": "allocation",
+    "allocator.pick": "allocation.pick",
+    "allocator.commit": "allocation.commit",
+    "kubelet.prepare": "prepare",
+    "prepare.read_checkpoint": "prepare.read_checkpoint",
+    "prepare.write_ahead": "prepare.write_ahead",
+    "prepare.devices": "prepare.devices",
+    "prepare.subslice": "prepare.subslice",
+    "prepare.cdi": "prepare.cdi",
+    "prepare.commit": "prepare.commit",
+    "cd.prepare": "cd.prepare",
+    "cd.await_ready": "cd.await_ready",
+    "cd.write_ahead": "cd.write_ahead",
+    "cd.cdi_write": "cd.cdi_write",
+    "cd.commit": "cd.commit",
+    "cd.rendezvous": "cd.rendezvous",
+    "daemon.join": "daemon.join",
+    "daemon.clique_render": "daemon.clique_render",
+}
+
+#: Span event names that mean "one retry happened here": cd.await_ready
+#: retry attempts and allocator verify-on-commit conflicts.
+RETRY_EVENT_NAMES = ("retry", "commit-conflict")
+
+#: Spans whose START marks the end of the scheduler/kubelet queue wait.
+_PREPARE_ROOTS = ("kubelet.prepare", "cd.prepare")
+
+
+def _merged_intervals(ivs: List[Tuple[float, float]]
+                      ) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    for start, end in sorted(ivs):
+        if out and start <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], end))
+        else:
+            out.append((start, end))
+    return out
+
+
+def _children_coverage(parent: Dict, children: List[Dict]) -> float:
+    """Seconds of ``parent``'s interval covered by its children
+    (children clipped to the parent; overlaps merged — two concurrent
+    children cover a window once, not twice)."""
+    p0, p1 = parent["start_unix"], parent["end_unix"]
+    clipped = []
+    for c in children:
+        c0, c1 = max(c["start_unix"], p0), min(c["end_unix"], p1)
+        if c1 > c0:
+            clipped.append((c0, c1))
+    return sum(e - s for s, e in _merged_intervals(clipped))
+
+
+def analyze(spans: Sequence[Dict]) -> Dict:
+    """Per-trace latency attribution from one trace's finished spans
+    (the ``/debug/traces/<id>`` span dict shape). Tolerates partial
+    traces — one process's half, missing CD phases, orphaned parents —
+    because that is what a single component's recorder actually holds."""
+    finished = [s for s in spans
+                if s.get("end_unix") is not None
+                and s.get("start_unix") is not None]
+    if not finished:
+        return {"trace_id": None, "spans": 0, "errors": 0, "e2e_ms": 0.0,
+                "segments_ms": {}, "retries": {}, "dominant": None}
+    by_id = {s["span_id"]: s for s in finished}
+    children: Dict[str, List[Dict]] = {}
+    for s in finished:
+        parent = s.get("parent_span_id")
+        if parent:
+            children.setdefault(parent, []).append(s)
+
+    t_min = min(s["start_unix"] for s in finished)
+    t_max = max(s["end_unix"] for s in finished)
+    e2e_s = t_max - t_min
+
+    segments: Dict[str, float] = {}
+    retries: Dict[str, int] = {}
+    errors = 0
+    for s in finished:
+        if s.get("status") == "error":
+            errors += 1
+        segment = SEGMENT_BY_SPAN.get(s["name"], s["name"])
+        self_s = (s["end_unix"] - s["start_unix"]) \
+            - _children_coverage(s, children.get(s["span_id"], []))
+        segments[segment] = segments.get(segment, 0.0) + max(0.0, self_s)
+        n_retries = sum(1 for ev in s.get("events") or []
+                        if ev.get("name") in RETRY_EVENT_NAMES)
+        if n_retries:
+            retries[segment] = retries.get(segment, 0) + n_retries
+
+    # the scheduler/kubelet gap: allocation root committed, prepare not
+    # yet called — time the driver does not control but operators see
+    root = next((s for s in finished
+                 if s["name"] == "allocator.allocate"), None)
+    prepare_starts = [s["start_unix"] for s in finished
+                      if s["name"] in _PREPARE_ROOTS]
+    if root is not None and prepare_starts:
+        gap = min(prepare_starts) - root["end_unix"]
+        if gap > 0:
+            segments["queue.wait"] = segments.get("queue.wait", 0.0) + gap
+
+    attributed = sum(segments.values())
+    if e2e_s - attributed > 1e-9:
+        segments["unattributed"] = e2e_s - attributed
+
+    segments_ms = {k: round(v * 1e3, 3) for k, v in segments.items()}
+    dominant = max(segments_ms, key=segments_ms.get) if segments_ms else None
+    root_span = next((s for s in finished
+                      if not s.get("parent_span_id")
+                      or s["parent_span_id"] not in by_id), finished[0])
+    return {
+        "trace_id": finished[0].get("trace_id"),
+        "root": root_span["name"],
+        "spans": len(finished),
+        "errors": errors,
+        "e2e_ms": round(e2e_s * 1e3, 3),
+        "segments_ms": segments_ms,
+        "retries": retries,
+        "dominant": dominant,
+    }
+
+
+def _percentile(values: List[float], pct: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(pct / 100.0 * (len(vals) - 1))))
+    return vals[idx]
+
+
+def aggregate(analyses: Sequence[Dict],
+              coverage: Optional[Dict] = None) -> Dict:
+    """Rolling per-segment aggregates over many per-trace analyses:
+    p50/p99/mean/max per segment, end-to-end distribution, total retry
+    counts, and the share of traces each segment dominated."""
+    seg_values: Dict[str, List[float]] = {}
+    retries: Dict[str, int] = {}
+    dominated: Dict[str, int] = {}
+    e2e: List[float] = []
+    for a in analyses:
+        if not a.get("spans"):
+            continue
+        e2e.append(a["e2e_ms"])
+        for seg, ms in a["segments_ms"].items():
+            seg_values.setdefault(seg, []).append(ms)
+        for seg, n in (a.get("retries") or {}).items():
+            retries[seg] = retries.get(seg, 0) + n
+        if a.get("dominant"):
+            dominated[a["dominant"]] = dominated.get(a["dominant"], 0) + 1
+    segments = {
+        seg: {"p50_ms": round(_percentile(vals, 50), 3),
+              "p99_ms": round(_percentile(vals, 99), 3),
+              "mean_ms": round(sum(vals) / len(vals), 3),
+              "max_ms": round(max(vals), 3),
+              "n": len(vals)}
+        for seg, vals in seg_values.items()}
+    report = {
+        "traces_analyzed": len(e2e),
+        "e2e_ms": {"p50": round(_percentile(e2e, 50), 3),
+                   "p99": round(_percentile(e2e, 99), 3),
+                   "mean": round(sum(e2e) / len(e2e), 3) if e2e else 0.0,
+                   "n": len(e2e)},
+        "segments": segments,
+        "retries": retries,
+        "dominated_by": dominated,
+    }
+    if coverage is not None:
+        report["coverage"] = coverage
+    return report
+
+
+def aggregate_report(recorder) -> Dict:
+    """The ``/debug/criticalpath`` payload: analyze every complete
+    trace currently retained by ``recorder`` (a
+    :class:`~tpu_dra_driver.pkg.tracing.FlightRecorder`) and aggregate,
+    with eviction-aware coverage so the numbers are never silently
+    partial."""
+    by_trace: Dict[str, List[Dict]] = {}
+    spans = recorder.all_spans()
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    analyses = [analyze(trace_spans) for trace_spans in by_trace.values()]
+    evicted = getattr(recorder, "evicted", 0)
+    return aggregate(analyses, coverage={
+        "spans_retained": len(spans),
+        "spans_evicted": evicted,
+        "traces_evicted": getattr(recorder, "evicted_traces", 0),
+        "complete": evicted == 0,
+    })

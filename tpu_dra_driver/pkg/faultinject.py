@@ -186,6 +186,15 @@ def armed() -> bool:
     return _ARMED
 
 
+def armed_points() -> Dict[str, List[str]]:
+    """point -> armed rule modes, for points with at least one rule —
+    the ``/debug/vars`` arm-state surface: a doctor bundle must show
+    whether a slow prepare was a drill."""
+    with _LOCK:
+        return {n: [r.mode for r in p.rules]
+                for n, p in sorted(_POINTS.items()) if p.rules}
+
+
 def point_stats(name: str) -> Dict[str, int]:
     with _LOCK:
         p = _POINTS.get(name)

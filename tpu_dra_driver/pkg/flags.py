@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import time
 from typing import Any, Dict, Optional
 
 from tpu_dra_driver.pkg.featuregates import FeatureGates, from_env_spec
@@ -53,6 +54,16 @@ def add_common_flags(parser: EnvArgumentParser) -> None:
                         type=float, default=0.01,
                         help="root-span sampling probability for "
                              "--trace-mode=sampled")
+    parser.add_argument("--slo-tick", env="SLO_TICK", type=float,
+                        default=10.0,
+                        help="SLO engine evaluation interval in seconds "
+                             "(pkg/slo.py: burn-rate gauges, /debug/slo, "
+                             "SLOBurnRate Events); 0 disables the engine")
+    parser.add_argument("--slo-windows", env="SLO_WINDOWS", default="",
+                        help="burn-rate windows as "
+                             "name:long/short:threshold[,...] in seconds "
+                             "(e.g. fast:3600/300:14.4,slow:21600/1800:6); "
+                             "empty = the Google-SRE-style defaults")
     parser.add_argument("--kube-api-qps", env="KUBE_API_QPS", type=float,
                         default=50.0)
     parser.add_argument("--kubeconfig", env="KUBECONFIG", default="",
@@ -78,11 +89,40 @@ def setup_logging(verbosity: int, log_format: str = "text",
                  node=node)
 
 
+def parse_slo_windows(spec: str):
+    """``name:long/short:threshold[,...]`` → tuple of
+    :class:`~tpu_dra_driver.pkg.slo.BurnWindow`; '' → the defaults.
+    Raises SystemExit with the offending clause on malformed input (a
+    typo'd window must not silently fall back to defaults)."""
+    from tpu_dra_driver.pkg.slo import DEFAULT_WINDOWS, BurnWindow
+    if not spec.strip():
+        return DEFAULT_WINDOWS
+    out = []
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        try:
+            name, ranges, threshold = clause.split(":")
+            long_s, short_s = ranges.split("/")
+            window = BurnWindow(name, float(long_s), float(short_s),
+                                float(threshold))
+            if window.long_s <= 0 or window.short_s <= 0 \
+                    or window.short_s > window.long_s:
+                raise ValueError("short must be 0 < short <= long")
+        except ValueError as e:
+            raise SystemExit(
+                f"--slo-windows: clause {clause!r}: expected "
+                f"name:long/short:threshold ({e})")
+        out.append(window)
+    return tuple(out)
+
+
 def setup_observability(args: argparse.Namespace, component: str) -> None:
     """The one call every cmd/* entrypoint makes after parsing flags:
-    structured logging (--log-format/-v) + claim-lifecycle tracing
-    (--trace-mode/--trace-sample-ratio), both wired to the common flag
-    set from :func:`add_common_flags`."""
+    structured logging (--log-format/-v), claim-lifecycle tracing
+    (--trace-mode/--trace-sample-ratio), and the SLO engine
+    (--slo-tick/--slo-windows: dra_slo_* gauges + /debug/slo; binaries
+    attach their EventRecorder later via ``slo.attach_recorder`` once
+    API clients exist), all wired to the common flag set from
+    :func:`add_common_flags`."""
     setup_logging(getattr(args, "verbosity", 4),
                   getattr(args, "log_format", "text"),
                   component=component,
@@ -91,6 +131,45 @@ def setup_observability(args: argparse.Namespace, component: str) -> None:
     tracing.configure(getattr(args, "trace_mode", "disabled"),
                       sample_ratio=getattr(args, "trace_sample_ratio", 0.01),
                       service=component)
+    from tpu_dra_driver.pkg import slo
+    # absent attribute = the caller never opted in (bare test Namespaces,
+    # library embedders): NO engine thread. The cmd binaries always have
+    # the flag (default 10.0), so production still gets the engine.
+    tick = getattr(args, "slo_tick", 0.0)
+    if tick and tick > 0:
+        engine = slo.SLOEngine(
+            windows=parse_slo_windows(getattr(args, "slo_windows", "")),
+            tick=tick, component=component)
+        slo.configure(engine)
+        engine.start()
+    else:
+        slo.configure(None)
+
+
+_PROCESS_START_UNIX = time.time()
+
+
+def debug_vars_fn(args: argparse.Namespace, component: str):
+    """The ``/debug/vars`` provider every binary hands its
+    DebugHTTPServer: build info, uptime, the parsed flag set, trace
+    mode, and fault-point arm state — the first page of a doctor
+    bundle."""
+
+    def vars_() -> Dict[str, Any]:
+        from tpu_dra_driver import __version__
+        from tpu_dra_driver.pkg import faultinject, tracing
+        return {
+            "component": component,
+            "version": __version__,
+            "pid": os.getpid(),
+            "start_unix": round(_PROCESS_START_UNIX, 3),
+            "uptime_s": round(time.time() - _PROCESS_START_UNIX, 3),
+            "flags": config_dict(args),
+            "trace_mode": tracing.mode(),
+            "faults_armed": faultinject.armed(),
+            "fault_points_armed": faultinject.armed_points(),
+        }
+    return vars_
 
 
 def config_dict(args: argparse.Namespace) -> Dict[str, Any]:

@@ -142,6 +142,13 @@ class Counter(_Metric):
     def value(self) -> float:
         return self._children[()].value
 
+    def values(self) -> Dict[Tuple[str, ...], float]:
+        """Per-labelset cumulative values — the counter analog of
+        :meth:`Histogram.snapshots` for the SLO engine's availability
+        specs (reset handling is the caller's: a smaller value than the
+        previous sample means restart, use the new value whole)."""
+        return {key: child.value for key, child in self._iter_children()}
+
     def render(self, exemplars: bool = False) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
@@ -212,6 +219,58 @@ class Gauge(_Metric):
             lines.append(f"{self.name}{_format_labels(self.label_names, key)}"
                          f" {_format_value(child.value)}")
         return lines
+
+
+class HistogramSnapshot:
+    """A cheap point-in-time copy of one histogram child: per-bucket
+    (non-cumulative) counts, sum, count.
+
+    The SLO engine (pkg/slo.py) samples through :meth:`Histogram
+    .snapshots` + :meth:`count_le` and keeps scalar cumulative
+    (good, total) pairs in its window ring; :meth:`delta` is the
+    bucket-level form of the same windowing for consumers that need
+    full distributions between two points in time (benches, tooling).
+    Both apply the SAME reset rule — a cumulative count that went
+    BACKWARDS means the process restarted, and the current value IS
+    the window's traffic, never a negative delta. :meth:`delta` is the
+    canonical, unit-tested statement of that rule (tests/test_slo.py
+    pins it across a simulated restart); ``SLOEngine._delta_since``
+    mirrors it at scalar level."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...], counts: Sequence[int],
+                 total: float, count: int):
+        self.buckets = buckets
+        self.counts = tuple(counts)
+        self.sum = total
+        self.count = count
+
+    def count_le(self, threshold: float) -> int:
+        """Observations in buckets whose upper bound is <= threshold —
+        the 'good events' count for a latency SLO whose threshold sits
+        on a bucket boundary (conservative for thresholds between
+        bounds: only fully-below buckets count as good)."""
+        good = 0
+        for bound, c in zip(self.buckets, self.counts):
+            if bound <= threshold:
+                good += c
+        return good
+
+    def delta(self, prev: Optional["HistogramSnapshot"]
+              ) -> "HistogramSnapshot":
+        """Observations between ``prev`` and this snapshot. A counter
+        reset (this.count < prev.count, i.e. the process restarted and
+        the family started over) yields this snapshot whole — the
+        post-restart traffic is the only truth available, never a
+        negative delta."""
+        if prev is None or self.count < prev.count \
+                or prev.buckets != self.buckets:
+            return self
+        return HistogramSnapshot(
+            self.buckets,
+            [c - p for c, p in zip(self.counts, prev.counts)],
+            self.sum - prev.sum, self.count - prev.count)
 
 
 class _HistogramChild:
@@ -289,6 +348,21 @@ class Histogram(_Metric):
         _, _, count = self._self_child().snapshot()
         return count
 
+    def snapshot(self) -> HistogramSnapshot:
+        """Point-in-time snapshot of the unlabeled family."""
+        counts, total, count = self._self_child().snapshot()
+        return HistogramSnapshot(self._buckets, counts, total, count)
+
+    def snapshots(self) -> Dict[Tuple[str, ...], HistogramSnapshot]:
+        """Per-labelset snapshots (all children); the windowed-delta
+        accessor the SLO engine consumes — see
+        :class:`HistogramSnapshot`."""
+        out: Dict[Tuple[str, ...], HistogramSnapshot] = {}
+        for key, child in self._iter_children():
+            counts, total, count = child.snapshot()
+            out[key] = HistogramSnapshot(self._buckets, counts, total, count)
+        return out
+
     def time(self):
         """Context manager observing the elapsed wall time in seconds."""
         return _Timer(self)
@@ -365,6 +439,15 @@ class Registry:
                   label_names: Sequence[str] = (),
                   buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
         return self._register(Histogram(name, help_text, label_names, buckets))  # type: ignore[return-value]
+
+    def get(self, name: str) -> Optional[_Metric]:
+        """The registered family named ``name``, or None — the SLO
+        engine resolves its spec's family references through this so a
+        spec naming a family another component registers (e.g. the CD
+        controller's per-instance registry) simply reports no traffic
+        here instead of raising."""
+        with self._mu:
+            return self._metrics.get(name)
 
     def render(self, exemplars: bool = False) -> str:
         with self._mu:
@@ -481,6 +564,11 @@ ALLOCATOR_INDEX_HITS = DEFAULT_REGISTRY.counter(
 ALLOCATION_SECONDS = DEFAULT_REGISTRY.histogram(
     "dra_allocation_seconds",
     "Wall time to allocate one ResourceClaim (snapshot scan + commit)")
+ALLOCATION_RESULTS = DEFAULT_REGISTRY.counter(
+    "dra_allocation_results_total",
+    "Allocation attempts by outcome (ok / error); the allocation "
+    "error-rate SLO's good/total source",
+    ("result",))
 ALLOCATOR_COMMIT_CONFLICTS = DEFAULT_REGISTRY.counter(
     "dra_allocator_commit_conflicts_total",
     "Allocation status writes that hit a resourceVersion conflict and "
@@ -512,6 +600,12 @@ TRACE_SPANS_RECORDED = DEFAULT_REGISTRY.counter(
     "dra_trace_spans_recorded_total",
     "Finished spans retained by the in-process trace flight recorder "
     "(served at /debug/traces)")
+TRACES_EVICTED = DEFAULT_REGISTRY.counter(
+    "dra_traces_evicted_total",
+    "Traces fully evicted from the bounded flight recorder (the last "
+    "retained span pushed out to make room for newer ones); the "
+    "critical-path aggregator reports this — plus span-level eviction "
+    "— as coverage so latency attribution is never silently partial")
 EVENTS_EMITTED = DEFAULT_REGISTRY.counter(
     "dra_events_emitted_total",
     "Kubernetes Events by emission outcome: created (new Event object), "
@@ -614,15 +708,27 @@ def dump_thread_stacks() -> str:
 
 class DebugHTTPServer:
     """``--http-endpoint`` server: /metrics, /healthz, /readyz,
-    /debug/threads (the net/http/pprof analog), and the trace flight
+    /debug/threads (the net/http/pprof analog), the trace flight
     recorder at /debug/traces + /debug/traces/<trace-id>
-    (pkg/tracing.py; empty JSON when tracing is disabled)."""
+    (pkg/tracing.py; empty JSON when tracing is disabled), the SLO
+    engine at /debug/slo (pkg/slo.py), latency attribution at
+    /debug/criticalpath[/<trace-id>] (pkg/criticalpath.py), and
+    process vars at /debug/vars (``json_endpoints`` — build info,
+    uptime, parsed flags, trace mode, fault-point arm state; the
+    ``tpu-dra-doctor`` must-gather collects all of these).
+
+    ``json_endpoints`` maps extra paths (e.g. ``/debug/vars``,
+    ``/debug/allocator``) to zero-arg callables returning a
+    JSON-serializable object; a callable that raises answers 500
+    without taking the server down."""
 
     def __init__(self, address: Tuple[str, int],
                  registry: Optional[Registry] = None,
-                 ready_check=None):
+                 ready_check=None,
+                 json_endpoints: Optional[Dict[str, object]] = None):
         self._registry = registry or DEFAULT_REGISTRY
         self._ready_check = ready_check or (lambda: True)
+        self._json_endpoints = dict(json_endpoints or {})
 
         outer = self
 
@@ -685,6 +791,38 @@ class DebugHTTPServer:
                                    "application/json")
                     else:
                         self._send(404, "trace not found")
+                elif path == "/debug/slo" or path == "/debug/slo/":
+                    # the process-global SLO engine's current evaluation
+                    # ({} until flags.setup_observability armed one)
+                    from tpu_dra_driver.pkg import slo
+                    self._send(200, json.dumps(slo.report(), indent=1),
+                               "application/json")
+                elif path == "/debug/criticalpath" \
+                        or path == "/debug/criticalpath/":
+                    from tpu_dra_driver.pkg import criticalpath, tracing
+                    self._send(200,
+                               json.dumps(criticalpath.aggregate_report(
+                                   tracing.recorder()), indent=1),
+                               "application/json")
+                elif path.startswith("/debug/criticalpath/"):
+                    from tpu_dra_driver.pkg import criticalpath, tracing
+                    trace_id = path[len("/debug/criticalpath/"):]
+                    spans = tracing.recorder().trace(trace_id)
+                    if spans:
+                        self._send(200,
+                                   json.dumps(criticalpath.analyze(spans),
+                                              indent=1),
+                                   "application/json")
+                    else:
+                        self._send(404, "trace not found")
+                elif path in outer._json_endpoints:
+                    try:
+                        body = json.dumps(outer._json_endpoints[path](),
+                                          indent=1, default=str)
+                    except Exception as e:  # noqa: BLE001 — debug surface
+                        self._send(500, f"{type(e).__name__}: {e}")
+                        return
+                    self._send(200, body, "application/json")
                 else:
                     self._send(404, "not found")
 

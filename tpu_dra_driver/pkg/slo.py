@@ -1,0 +1,482 @@
+"""Declarative SLOs with multi-window burn-rate alerting, in-process.
+
+PR 5 gave the driver raw telemetry (histograms, counters, traces,
+Events); nothing *interpreted* it — an operator watching a fleet
+scenario had to eyeball ``/metrics`` to decide whether claim-to-ready
+was healthy. This module closes that gap the way Google-SRE-style
+monitoring does (SRE workbook ch. 5, "alerting on SLOs"): each
+:class:`SLOSpec` declares an objective over an existing metric family,
+and the :class:`SLOEngine` evaluates it over sliding windows from
+cheap snapshot accessors (:meth:`~tpu_dra_driver.pkg.metrics.Histogram
+.snapshots` / :meth:`~tpu_dra_driver.pkg.metrics.Counter.values`; the
+engine rings scalar cumulative (good, total) samples and applies the
+counter-reset rule :class:`~tpu_dra_driver.pkg.metrics
+.HistogramSnapshot.delta` pins at bucket level), computing the
+**burn rate**:
+
+    burn = (1 - SLI) / (1 - objective)
+
+i.e. how many times faster than "exactly on budget" the error budget is
+being spent. An SLO is *burning* when the burn rate exceeds a window's
+threshold over BOTH its long and short range (the multi-window
+multi-burn-rate pattern: the long window proves the problem is real,
+the short window proves it is still happening — so alerts neither
+flap on blips nor linger after recovery).
+
+Surfaces:
+
+- ``dra_slo_*`` gauge families on the default registry (scrapeable),
+- ``/debug/slo`` JSON on every
+  :class:`~tpu_dra_driver.pkg.metrics.DebugHTTPServer`,
+- a deduped ``SLOBurnRate`` Kubernetes Event through the existing
+  :class:`~tpu_dra_driver.kube.events.EventRecorder` while burning,
+- the per-step SLI reports the fleet-scenario engine records
+  (testing/scenarios.py) and the ``tpu-dra-doctor`` findings.
+
+The engine only READS metric snapshots on its own thread — the observe
+hot paths pay nothing for it (pinned by ``bench_slo_overhead``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from tpu_dra_driver.pkg import metrics as _metrics
+from tpu_dra_driver.pkg.metrics import (
+    Counter,
+    DEFAULT_REGISTRY,
+    Histogram,
+    Registry,
+)
+
+LATENCY = "latency"
+AVAILABILITY = "availability"
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """One service-level objective over one metric family.
+
+    ``latency`` kind: good events are observations whose histogram
+    bucket bound is <= ``threshold`` (thresholds should sit on bucket
+    boundaries; between bounds the accounting is conservative). When
+    the family is labeled, ``label_values`` restricts which children
+    count as latency traffic at all — a result-labeled family must
+    scope its latency SLO to successful requests, or an outage of
+    FAST failures (1 ms validation errors) reads as perfect latency
+    while zero claims actually become ready. Failures belong to the
+    ``availability`` kind: children of a one-label family are
+    classified by their label value — good when it is in
+    ``good_label_values`` — and event counts come from counter values
+    or histogram counts."""
+
+    name: str
+    family: str
+    objective: float                      # e.g. 0.99 = "99% good"
+    kind: str = LATENCY
+    threshold: float = 0.0                # latency: good iff <= threshold
+    #: latency kind, labeled families: only children whose first label
+    #: value is in this set count (empty = all children)
+    label_values: Tuple[str, ...] = ()
+    good_label_values: Tuple[str, ...] = ()
+    description: str = ""
+
+
+@dataclass(frozen=True)
+class BurnWindow:
+    """One multi-window alert arm: burning when the burn rate is >=
+    ``threshold`` over BOTH the long and the short range."""
+
+    name: str
+    long_s: float
+    short_s: float
+    threshold: float
+
+
+#: The Google SRE workbook's recommended pairs: page-worthy fast burn
+#: (2% of a 30d budget in 1h) and ticket-worthy slow burn, scaled to
+#: the windows an in-process ring buffer can afford to remember.
+DEFAULT_WINDOWS: Tuple[BurnWindow, ...] = (
+    BurnWindow("fast", 3600.0, 300.0, 14.4),
+    BurnWindow("slow", 21600.0, 1800.0, 6.0),
+)
+
+#: The driver's SLO catalog (docs/observability.md "SLOs & diagnostics").
+#: Latency thresholds sit on DEFAULT_TIME_BUCKETS boundaries.
+DEFAULT_SPECS: Tuple[SLOSpec, ...] = (
+    SLOSpec("claim-prepare-latency", "dra_claim_prepare_duration_seconds",
+            0.99, LATENCY, threshold=0.5, label_values=("ok",),
+            description="99% of SUCCESSFUL NodePrepareResources claims "
+                        "ready in <= 500ms (the claim-to-ready p99 "
+                        "proxy on the kubelet side; failures are "
+                        "prepare-availability's problem — counting "
+                        "their fast error returns here would mask a "
+                        "latency burn)"),
+    SLOSpec("allocation-latency", "dra_allocation_seconds",
+            0.99, LATENCY, threshold=0.25,
+            description="99% of ResourceClaim allocations committed in "
+                        "<= 250ms"),
+    SLOSpec("cd-rendezvous-latency", "dra_cd_rendezvous_seconds",
+            0.99, LATENCY, threshold=2.5,
+            description="99% of ComputeDomain rendezvous (first daemon "
+                        "join to Ready) in <= 2.5s"),
+    SLOSpec("allocation-availability", "dra_allocation_results_total",
+            0.999, AVAILABILITY, good_label_values=("ok",),
+            description="99.9% of allocation attempts succeed"),
+    SLOSpec("prepare-availability", "dra_claim_prepare_duration_seconds",
+            0.999, AVAILABILITY, good_label_values=("ok",),
+            description="99.9% of claim prepares succeed (result label "
+                        "of the prepare duration histogram)"),
+)
+
+
+# ---------------------------------------------------------------------------
+# scrape surface (registered once; the lint gate keys on these sites)
+# ---------------------------------------------------------------------------
+
+SLO_SLI = DEFAULT_REGISTRY.gauge(
+    "dra_slo_sli",
+    "Measured service-level indicator (good/total) per SLO and "
+    "evaluation window (window label: <burn-window>_long/_short); 1.0 "
+    "on zero-traffic windows",
+    ("slo", "window"))
+SLO_BURN_RATE = DEFAULT_REGISTRY.gauge(
+    "dra_slo_burn_rate",
+    "Error-budget burn rate (bad fraction / allowed bad fraction) per "
+    "SLO and window; 1.0 = spending exactly on budget",
+    ("slo", "window"))
+SLO_BUDGET_REMAINING = DEFAULT_REGISTRY.gauge(
+    "dra_slo_error_budget_remaining",
+    "Fraction of the error budget left over the longest configured "
+    "window (1.0 = untouched, 0 = exhausted, negative = overspent)",
+    ("slo",))
+SLO_BURNING = DEFAULT_REGISTRY.gauge(
+    "dra_slo_burning",
+    "1 while the SLO's multi-window burn-rate alert condition holds "
+    "(some window pair's long AND short burn rates >= its threshold); "
+    "mirrored as a deduped SLOBurnRate Kubernetes Event",
+    ("slo",))
+
+
+def sample_spec(spec: SLOSpec,
+                registries: Sequence[Registry]) -> Tuple[float, float]:
+    """Cumulative ``(good, total)`` event counts for ``spec`` right now,
+    resolved against the first registry that has the family. A family
+    nobody registered (or of the wrong shape) reports zero traffic —
+    a spec must never crash the component it observes."""
+    fam = None
+    for reg in registries:
+        fam = reg.get(spec.family)
+        if fam is not None:
+            break
+    if fam is None:
+        return 0.0, 0.0
+    if spec.kind == LATENCY and isinstance(fam, Histogram):
+        good = total = 0
+        for key, snap in fam.snapshots().items():
+            if spec.label_values and (not key
+                                      or key[0] not in spec.label_values):
+                continue
+            good += snap.count_le(spec.threshold)
+            total += snap.count
+        return float(good), float(total)
+    if spec.kind == AVAILABILITY:
+        if isinstance(fam, Counter):
+            values = fam.values()
+        elif isinstance(fam, Histogram):
+            values = {k: float(s.count) for k, s in fam.snapshots().items()}
+        else:
+            return 0.0, 0.0
+        good = total = 0.0
+        for key, v in values.items():
+            total += v
+            if key and key[0] in spec.good_label_values:
+                good += v
+        return good, total
+    return 0.0, 0.0
+
+
+def burn_rate(good_delta: float, total_delta: float,
+              objective: float) -> Tuple[float, float]:
+    """``(burn, sli)`` for one window's worth of traffic. Zero traffic
+    is a PERFECT window (sli 1.0, burn 0): no evidence of badness must
+    never page — the property tests pin this."""
+    if total_delta <= 0:
+        return 0.0, 1.0
+    sli = min(1.0, max(0.0, good_delta / total_delta))
+    budget = max(1e-9, 1.0 - objective)
+    return (1.0 - sli) / budget, sli
+
+
+class SLOEngine:
+    """Samples spec families on a tick, keeps a bounded ring of
+    timestamped cumulative counts, and evaluates burn rates over the
+    configured windows. Everything is snapshot-delta based, so process
+    restarts (counter resets) degrade to "window starts at restart"
+    instead of negative traffic."""
+
+    def __init__(self, registries: Optional[Sequence[Registry]] = None,
+                 specs: Sequence[SLOSpec] = DEFAULT_SPECS,
+                 windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
+                 tick: float = 10.0,
+                 component: str = "",
+                 recorder=None,
+                 involved: Optional[Dict[str, str]] = None,
+                 now_fn=time.monotonic):
+        self._registries: List[Registry] = list(
+            registries if registries is not None else [DEFAULT_REGISTRY])
+        self.specs = tuple(specs)
+        self.windows = tuple(windows)
+        self.tick = tick
+        self.component = component
+        self._recorder = recorder
+        self._involved = involved
+        self._now = now_fn
+        self._mu = threading.Lock()
+        # spec name -> deque of (ts, good_cumulative, total_cumulative)
+        self._samples: Dict[str, Deque[Tuple[float, float, float]]] = {
+            s.name: deque() for s in self.specs}
+        self._max_age = max((w.long_s for w in self.windows), default=0.0) \
+            + 2 * max(tick, 1.0)
+        self._last_report: Dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_registry(self, registry: Registry) -> None:
+        """Components with per-instance registries (the CD controller's
+        ``dra_cd_rendezvous_seconds``) make their families visible to
+        the engine here."""
+        with self._mu:
+            if registry not in self._registries:
+                self._registries.append(registry)
+
+    def set_recorder(self, recorder, involved: Dict[str, str]) -> None:
+        """Arm SLOBurnRate Event emission: ``recorder`` is the
+        component's existing EventRecorder, ``involved`` the object the
+        Event hangs off (the Node for kubelet plugins, the component
+        identity for controllers)."""
+        with self._mu:
+            self._recorder = recorder
+            self._involved = dict(involved)
+
+    # -- sampling / evaluation ---------------------------------------------
+
+    def sample(self) -> None:
+        now = self._now()
+        with self._mu:
+            registries = list(self._registries)
+        for spec in self.specs:
+            good, total = sample_spec(spec, registries)
+            with self._mu:
+                ring = self._samples[spec.name]
+                ring.append((now, good, total))
+                # keep ONE sample older than the longest window so the
+                # full-length delta stays computable; prune the rest
+                while len(ring) > 2 and ring[1][0] <= now - self._max_age:
+                    ring.popleft()
+
+    def _delta_since(self, spec: SLOSpec, now: float,
+                     seconds: float) -> Tuple[float, float]:
+        """(good, total) observed over the trailing ``seconds``. The
+        base is the newest sample at/before the window start (or the
+        oldest retained — a young process reports over its lifetime).
+        A cumulative count that went BACKWARDS means the family reset
+        (restart): the current cumulative IS the window's traffic."""
+        with self._mu:
+            ring = self._samples[spec.name]
+            if not ring:
+                return 0.0, 0.0
+            _, cur_good, cur_total = ring[-1]
+            base = ring[0]
+            target = now - seconds
+            for s in ring:
+                if s[0] <= target:
+                    base = s
+                else:
+                    break
+        _, base_good, base_total = base
+        if cur_total < base_total or cur_good < base_good:
+            return cur_good, cur_total
+        return cur_good - base_good, cur_total - base_total
+
+    def evaluate(self) -> Dict:
+        """One evaluation pass over the current ring: updates the
+        ``dra_slo_*`` gauges, emits/refreshes SLOBurnRate Events, and
+        returns (and caches, for /debug/slo) the report."""
+        now = self._now()
+        longest = max((w.long_s for w in self.windows), default=0.0)
+        slos: Dict[str, Dict] = {}
+        for spec in self.specs:
+            spec_row: Dict = {
+                "family": spec.family, "kind": spec.kind,
+                "objective": spec.objective,
+                "description": spec.description,
+                "windows": {},
+            }
+            if spec.kind == LATENCY:
+                spec_row["threshold_s"] = spec.threshold
+            burning_pairs: List[str] = []
+            for w in self.windows:
+                arms = {}
+                for arm, seconds in (("long", w.long_s),
+                                     ("short", w.short_s)):
+                    good, total = self._delta_since(spec, now, seconds)
+                    burn, sli = burn_rate(good, total, spec.objective)
+                    arms[arm] = {"sli": round(sli, 6),
+                                 "burn_rate": round(burn, 3),
+                                 "good": good, "total": total}
+                    SLO_SLI.labels(spec.name, f"{w.name}_{arm}").set(sli)
+                    SLO_BURN_RATE.labels(
+                        spec.name, f"{w.name}_{arm}").set(burn)
+                # >= threshold on BOTH arms, with real traffic on the
+                # short arm: budget exhaustion exactly at the threshold
+                # IS burning (the property tests pin the boundary)
+                pair_burning = (
+                    arms["long"]["burn_rate"] >= w.threshold
+                    and arms["short"]["burn_rate"] >= w.threshold
+                    and arms["short"]["total"] > 0)
+                arms_row = dict(arms)
+                arms_row["threshold"] = w.threshold
+                arms_row["burning"] = pair_burning
+                spec_row["windows"][w.name] = arms_row
+                if pair_burning:
+                    burning_pairs.append(w.name)
+            good_l, total_l = self._delta_since(spec, now, longest)
+            _, sli_l = burn_rate(good_l, total_l, spec.objective)
+            budget = max(1e-9, 1.0 - spec.objective)
+            remaining = 1.0 - (1.0 - sli_l) / budget
+            burning = bool(burning_pairs)
+            spec_row["burning"] = burning
+            spec_row["burning_windows"] = burning_pairs
+            spec_row["budget_remaining"] = round(remaining, 4)
+            SLO_BUDGET_REMAINING.labels(spec.name).set(remaining)
+            SLO_BURNING.labels(spec.name).set(1.0 if burning else 0.0)
+            self._emit_event(spec, spec_row)
+            slos[spec.name] = spec_row
+        report = {
+            "component": self.component,
+            "generated_unix": round(time.time(), 3),
+            "tick_s": self.tick,
+            "windows": [{"name": w.name, "long_s": w.long_s,
+                         "short_s": w.short_s, "threshold": w.threshold}
+                        for w in self.windows],
+            "slos": slos,
+        }
+        with self._mu:
+            self._last_report = report
+        return report
+
+    def evaluate_once(self) -> Dict:
+        self.sample()
+        return self.evaluate()
+
+    def _emit_event(self, spec: SLOSpec, row: Dict) -> None:
+        """While burning, (re-)emit the deduped Warning — the recorder
+        aggregates repeats onto one Event object, so `kubectl describe`
+        shows one SLOBurnRate with a climbing count, not a flood.
+
+        The message must be DEDUPE-STABLE: the recorder keys its
+        aggregation on the full (object, reason, message) tuple, so
+        embedding the live burn rate would mint a fresh Event every
+        tick as traffic drifts — flooding the object and draining its
+        per-object token bucket. Live numbers live on /debug/slo and
+        the dra_slo_* gauges; the Event names the condition and its
+        static parameters only."""
+        if not row["burning"] or self._recorder is None:
+            return
+        wname = row["burning_windows"][0]
+        from tpu_dra_driver.kube.events import REASON_SLO_BURN_RATE
+        involved = self._involved or {
+            "kind": "Pod", "name": self.component or "tpu-dra-driver",
+            "namespace": "tpu-dra-driver"}
+        self._recorder.warning(
+            involved, REASON_SLO_BURN_RATE,
+            f"SLO {spec.name} burning: {wname}-window burn rate >= "
+            f"{row['windows'][wname]['threshold']:g}x its error budget "
+            f"of {1.0 - spec.objective:.4g} ({spec.family}; live rates "
+            f"on /debug/slo and dra_slo_burn_rate)")
+
+    def report(self) -> Dict:
+        with self._mu:
+            return dict(self._last_report)
+
+    def burning(self) -> List[str]:
+        """Names of SLOs currently burning (doctor/scenario surface)."""
+        with self._mu:
+            report = self._last_report
+        return sorted(n for n, row in (report.get("slos") or {}).items()
+                      if row.get("burning"))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.sample()      # seed the ring so the first window has a base
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="slo-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.tick):
+            try:
+                self.evaluate_once()
+            except Exception:  # noqa: BLE001 — observer must never die
+                _metrics.SWALLOWED_ERRORS.labels("slo.evaluate").inc()
+
+
+# ---------------------------------------------------------------------------
+# process-global engine (armed by flags.setup_observability)
+# ---------------------------------------------------------------------------
+
+_ENGINE: Optional[SLOEngine] = None
+
+
+def configure(engine: Optional[SLOEngine]) -> Optional[SLOEngine]:
+    """Install (and return) the process-global engine, stopping any
+    predecessor; None disarms."""
+    global _ENGINE
+    if _ENGINE is not None:
+        _ENGINE.stop()
+    _ENGINE = engine
+    return engine
+
+
+def engine() -> Optional[SLOEngine]:
+    return _ENGINE
+
+
+def report() -> Dict:
+    """The /debug/slo payload: the last evaluation, or {} when no
+    engine is armed."""
+    return _ENGINE.report() if _ENGINE is not None else {}
+
+
+def attach_recorder(recorder, involved: Dict[str, str]) -> None:
+    """Wire SLOBurnRate Events once a binary has its EventRecorder
+    (recorders need API clients, which exist only after flag parsing)."""
+    if _ENGINE is not None:
+        _ENGINE.set_recorder(recorder, involved)
+
+
+def add_registry(registry: Registry) -> None:
+    if _ENGINE is not None:
+        _ENGINE.add_registry(registry)
+
+
+def reset() -> None:
+    """Test helper: stop and drop the global engine."""
+    configure(None)

@@ -232,16 +232,43 @@ NOOP_SPAN = _NoopSpan()
 
 
 class FlightRecorder:
-    """Bounded in-memory store of finished spans, queryable by trace."""
+    """Bounded in-memory store of finished spans, queryable by trace.
+
+    Eviction is NOT silent: every span pushed out of the full deque
+    counts in :attr:`evicted`, a trace whose LAST retained span is
+    pushed out counts in :attr:`evicted_traces` and in the
+    ``dra_traces_evicted_total`` metric (trace units, as the name
+    says), and the critical-path aggregator (pkg/criticalpath.py)
+    reports both as coverage — attribution computed over a recorder
+    that quietly dropped half its traffic must say so."""
 
     def __init__(self, capacity: int = 2048):
         self._mu = threading.Lock()
         self._spans: deque = deque(maxlen=capacity)
+        #: spans pushed out of the full deque
+        self.evicted = 0
+        #: traces whose every span has been pushed out
+        self.evicted_traces = 0
+        # trace_id -> retained span count (drops to 0 = trace evicted)
+        self._trace_counts: Dict[str, int] = {}
 
     def record(self, span: Span) -> None:
+        trace_evicted = False
         with self._mu:
+            if self._spans.maxlen and len(self._spans) == self._spans.maxlen:
+                old_tid = self._spans[0].context.trace_id
+                self.evicted += 1
+                left = self._trace_counts.get(old_tid, 1) - 1
+                if left <= 0:
+                    self._trace_counts.pop(old_tid, None)
+                    self.evicted_traces += 1
+                    trace_evicted = True
+                else:
+                    self._trace_counts[old_tid] = left
+            tid = span.context.trace_id
+            self._trace_counts[tid] = self._trace_counts.get(tid, 0) + 1
             self._spans.append(span)
-        _count_recorded()
+        _count_recorded(evicted_trace=trace_evicted)
 
     def __len__(self) -> int:
         with self._mu:
@@ -250,12 +277,22 @@ class FlightRecorder:
     def clear(self) -> None:
         with self._mu:
             self._spans.clear()
+            self._trace_counts.clear()
+            self.evicted = 0
+            self.evicted_traces = 0
 
     def trace(self, trace_id: str) -> List[Dict]:
         """Every retained finished span of one trace, oldest first."""
         with self._mu:
             return [s.to_dict() for s in self._spans
                     if s.context.trace_id == trace_id]
+
+    def all_spans(self) -> List[Dict]:
+        """Every retained finished span, oldest first — one pass for
+        the critical-path aggregator (grouping per-trace through
+        :meth:`trace` would rescan the deque per trace)."""
+        with self._mu:
+            return [s.to_dict() for s in self._spans]
 
     def traces(self) -> List[Dict]:
         """Per-trace summaries, most recent first."""
@@ -497,8 +534,10 @@ def exemplar(span_or_ctx=None) -> Optional[Dict[str, str]]:
     return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
 
 
-def _count_recorded() -> None:
+def _count_recorded(evicted_trace: bool = False) -> None:
     # lazy import mirrors faultinject._count_fired: the disabled path
     # stays import-free, and metrics never imports tracing at module load
     from tpu_dra_driver.pkg import metrics as _metrics
     _metrics.TRACE_SPANS_RECORDED.inc()
+    if evicted_trace:
+        _metrics.TRACES_EVICTED.inc()

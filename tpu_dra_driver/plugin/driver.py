@@ -145,6 +145,12 @@ class TpuKubeletPlugin:
     # lifecycle (reference driver.go:66-173)
     # ------------------------------------------------------------------
 
+    @property
+    def event_recorder(self) -> EventRecorder:
+        """The plugin's Event sink — shared with the SLO engine so
+        SLOBurnRate Warnings ride the same deduped async pipeline."""
+        return self._events
+
     def start(self) -> None:
         if self._config.gates.enabled(fg.DYNAMIC_SUBSLICE):
             destroyed = self.state.destroy_unknown_subslices()

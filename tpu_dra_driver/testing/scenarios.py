@@ -46,7 +46,11 @@ from tpu_dra_driver.kube.allocation_controller import AllocationController
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.kube.errors import NotFoundError
 from tpu_dra_driver.kube.events import REASON_ALLOCATION_PARKED
+from tpu_dra_driver.pkg import criticalpath
 from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg import slo as slo_mod
+from tpu_dra_driver.pkg import tracing
+from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY
 from tpu_dra_driver.plugin.checkpoint import PREPARE_COMPLETED
 from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
 from tpu_dra_driver.testing.harness import (
@@ -104,9 +108,13 @@ class ScenarioRun:
     @contextmanager
     def step(self, name: str):
         t0 = time.monotonic()
+        base = self._sample_specs()
         yield
-        self.steps.append(
-            {"step": name, "ms": round((time.monotonic() - t0) * 1e3, 1)})
+        row = {"step": name, "ms": round((time.monotonic() - t0) * 1e3, 1)}
+        sli = self._sli_delta(base)
+        if sli:
+            row["slo"] = sli
+        self.steps.append(row)
 
     def converge(self, name: str, predicate: Callable[[], bool],
                  timeout: float, interval: float = 0.01) -> float:
@@ -130,6 +138,60 @@ class ScenarioRun:
             if row["step"] == name:
                 return row["ms"]
         return None
+
+    # -- per-run SLI + latency attribution (observability PR) -------------
+
+    def _sample_specs(self) -> Optional[Dict]:
+        if not hasattr(self, "_obs_specs"):
+            return None
+        return {s.name: slo_mod.sample_spec(s, self._obs_registries)
+                for s in self._obs_specs}
+
+    def _sli_delta(self, base: Optional[Dict]) -> Dict[str, Dict]:
+        """Per-spec SLI over the traffic observed since ``base`` —
+        the per-step SLI report (specs with no traffic in the window
+        are omitted so step rows stay compact)."""
+        if base is None:
+            return {}
+        out: Dict[str, Dict] = {}
+        for s in self._obs_specs:
+            good0, total0 = base[s.name]
+            good1, total1 = slo_mod.sample_spec(s, self._obs_registries)
+            d_good, d_total = good1 - good0, total1 - total0
+            if d_total <= 0:
+                continue
+            burn, sli_v = slo_mod.burn_rate(d_good, d_total, s.objective)
+            out[s.name] = {"sli": round(sli_v, 6), "good": d_good,
+                           "total": d_total, "burn_rate": round(burn, 3),
+                           "objective": s.objective}
+        return out
+
+    def begin_observability(self,
+                            specs: Sequence = slo_mod.DEFAULT_SPECS) -> None:
+        """Arm full tracing for the scenario and snapshot the SLO spec
+        families, so :meth:`finish_observability` can report the run's
+        SLIs and a critical-path latency attribution alongside the step
+        timings — BENCH_DETAIL.json's ``fleet_scenarios`` carries both."""
+        self._obs_specs = tuple(specs)
+        self._obs_registries = [DEFAULT_REGISTRY]
+        tracing.configure("always", service=f"scenario-{self.name}",
+                          capacity=16384)
+        tracing.recorder().clear()
+        self._obs_base = {s.name: slo_mod.sample_spec(s,
+                                                      self._obs_registries)
+                          for s in self._obs_specs}
+
+    def finish_observability(self) -> None:
+        """Record ``latency_attribution`` (per-segment p50/p99 over
+        every trace the run produced, eviction-aware coverage) and
+        ``slo`` (per-spec SLI/burn over exactly this run's traffic)
+        into the report, then disarm tracing."""
+        if not hasattr(self, "_obs_specs"):
+            return
+        self.extra["latency_attribution"] = \
+            criticalpath.aggregate_report(tracing.recorder())
+        self.extra["slo"] = self._sli_delta(self._obs_base)
+        tracing.reset()
 
     def report(self) -> Dict:
         return {"scenario": self.name,
@@ -502,6 +564,7 @@ def scenario_node_drain(tmp_dir: str,
     gates = fg.FeatureGates()
     gates.set(fg.DYNAMIC_SUBSLICE, True)
     run = ScenarioRun("node_drain")
+    run.begin_observability()
     harness = ClusterHarness(tmp_dir, accelerator_type="v5p-16",
                              gates=gates, prepare_budget=prepare_budget)
     controller = AllocationController(
@@ -621,6 +684,7 @@ def scenario_node_drain(tmp_dir: str,
         _prepare_on_owner(clients, ["parker"], "work", by_node)
     finally:
         run.extra["traffic"] = traffic.stop()
+        run.finish_observability()
         controller.stop()
         harness.stop()
     if run.extra["traffic"]["failures"]:
@@ -712,6 +776,7 @@ def scenario_health_storm(tmp_dir: str,
     gates = fg.FeatureGates()
     gates.set(fg.DEVICE_HEALTH_CHECK, True)
     run = ScenarioRun("health_storm")
+    run.begin_observability()
     fleet = MiniFleet(tmp_dir, n_nodes, gates=gates)
     clients = fleet.clients
     controller = AllocationController(
@@ -820,6 +885,7 @@ def scenario_health_storm(tmp_dir: str,
                 "baseline after the storm cleared")
     finally:
         run.extra["traffic"] = traffic.stop()
+        run.finish_observability()
         controller.stop()
         fleet.stop()
     check_no_double_alloc(clients)
@@ -874,6 +940,7 @@ def scenario_autoscaler_churn(n_base_nodes: int = 12,
     )
 
     run = ScenarioRun("autoscaler_churn")
+    run.begin_observability()
     clients = ClientSets()
     for i in range(n_base_nodes):
         clients.resource_slices.create(
@@ -1008,6 +1075,7 @@ def scenario_autoscaler_churn(n_base_nodes: int = 12,
         run.extra["final_nodes"] = len(clients.resource_slices.list())
     finally:
         run.extra["traffic"] = traffic.stop()
+        run.finish_observability()
         for ctrl in live.values():
             ctrl.stop()
     if run.extra["traffic"]["failures"]:

@@ -1,0 +1,3 @@
+"""Operator tooling built on the driver's debug surfaces — currently
+the ``tpu-dra-doctor`` must-gather/triage library (doctor.py), driven
+by the :mod:`tpu_dra_driver.cmd.doctor` CLI."""

@@ -1,0 +1,419 @@
+"""tpu-dra-doctor: one-command cluster diagnostics bundle + triage.
+
+Reference analog: ``nvidia-bug-report.sh`` / the k8s ``must-gather``
+pattern — when a fleet misbehaves, the first ask is always "collect
+everything and send it over". This module is the collection AND the
+first read: it pulls every observability surface this driver exposes
+(``/metrics``, ``/debug/traces``, ``/debug/slo``,
+``/debug/criticalpath``, ``/debug/vars``, ``/debug/allocator``) from
+every component endpoint, plus checkpoint state dirs and recent
+Kubernetes Events, into one tarball — then runs automated findings
+over the bundle (breaker open, SLO burning, parked claims, shard
+imbalance, watch-mux lag, quarantined checkpoints, evicted traces) and
+prints a severity-sorted triage summary, so the operator starts from
+"here is what is wrong" instead of from raw text exposition.
+
+The CLI lives in :mod:`tpu_dra_driver.cmd.doctor`; the sim e2e suite
+(tests/e2e/run_e2e_sim.py, phase ``doctor``) exercises the whole loop
+against production subprocesses.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: debug paths collected per component endpoint (artifact key -> path).
+ENDPOINT_PATHS = {
+    "metrics": "/metrics",
+    "slo": "/debug/slo",
+    "traces": "/debug/traces",
+    "criticalpath": "/debug/criticalpath",
+    "vars": "/debug/vars",
+    "allocator": "/debug/allocator",
+}
+
+CRITICAL = "critical"
+WARNING = "warning"
+INFO = "info"
+_SEVERITY_ORDER = {CRITICAL: 0, WARNING: 1, INFO: 2}
+
+#: watch-mux p99 lag beyond this is an event-plane health finding.
+MUX_LAG_P99_THRESHOLD_S = 1.0
+
+
+@dataclass
+class Finding:
+    severity: str
+    code: str
+    component: str
+    message: str
+    details: Dict = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        return {"severity": self.severity, "code": self.code,
+                "component": self.component, "message": self.message,
+                "details": self.details}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text parsing (the doctor reads scrapes offline, so it needs
+# its own reader for the 0.0.4 format pkg/metrics.py writes)
+# ---------------------------------------------------------------------------
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    key = ""
+    i = 0
+    n = len(body)
+    while i < n:
+        j = body.index("=", i)
+        key = body[i:j]
+        assert body[j + 1] == '"'
+        k = j + 2
+        val = []
+        while body[k] != '"':
+            if body[k] == "\\":
+                nxt = body[k + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                k += 2
+            else:
+                val.append(body[k])
+                k += 1
+        out[key] = "".join(val)
+        i = k + 1
+        if i < n and body[i] == ",":
+            i += 1
+    return out
+
+
+def parse_metrics_text(text: str
+                       ) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """``name -> [(labels, value), ...]`` from a 0.0.4 text scrape.
+    Histogram series keep their ``_bucket``/``_sum``/``_count``
+    suffixed names. Malformed lines are skipped — a doctor must read
+    what it can, not crash on what it can't."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                body, tail = rest.rsplit("}", 1)
+                labels = _parse_labels(body)
+                value = float(tail.split()[0])
+            else:
+                parts = line.split()
+                name, labels, value = parts[0], {}, float(parts[1])
+        except (ValueError, IndexError, AssertionError):
+            continue
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def metric_value(samples: Dict, name: str,
+                 labels: Optional[Dict[str, str]] = None) -> float:
+    """Sum of a family's samples matching the given label subset."""
+    total = 0.0
+    for sample_labels, value in samples.get(name, []):
+        if labels and any(sample_labels.get(k) != v
+                          for k, v in labels.items()):
+            continue
+        total += value
+    return total
+
+
+def histogram_quantile(samples: Dict, family: str, q: float
+                       ) -> Optional[float]:
+    """Upper-bound estimate of quantile ``q`` from ``family``'s
+    cumulative buckets (summed across label sets): the smallest bucket
+    bound holding at least q of the observations. None without data."""
+    total = metric_value(samples, f"{family}_count")
+    if total <= 0:
+        return None
+    cum: Dict[float, float] = {}
+    for labels, value in samples.get(f"{family}_bucket", []):
+        le = labels.get("le", "")
+        bound = float("inf") if le == "+Inf" else float(le)
+        cum[bound] = cum.get(bound, 0.0) + value
+    for bound in sorted(cum):
+        if cum[bound] >= q * total:
+            return bound
+    return float("inf")
+
+
+# ---------------------------------------------------------------------------
+# collection
+# ---------------------------------------------------------------------------
+
+
+def _http_get(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def collect_endpoint(host_port: str, timeout: float = 3.0) -> Dict:
+    """Every debug surface of one component. Unreachable/absent paths
+    land under ``errors`` instead of failing the whole gather — a
+    must-gather that dies on the sickest component is useless."""
+    art: Dict = {"endpoint": host_port, "errors": {}}
+    for key, path in ENDPOINT_PATHS.items():
+        try:
+            body = _http_get(f"http://{host_port}{path}", timeout)
+            art[key] = body if key == "metrics" else json.loads(body)
+        except Exception as e:  # noqa: BLE001 — recorded per-surface
+            art["errors"][key] = f"{type(e).__name__}: {e}"
+    return art
+
+
+def collect_state_dir(path: str) -> Dict:
+    """Checkpoint files and quarantined corpses under one plugin state
+    dir (the ``<checkpoint>.corrupt-<n>`` quarantine convention)."""
+    out: Dict = {"path": path, "checkpoints": [], "quarantined": []}
+    if not os.path.isdir(path):
+        out["error"] = "not a directory"
+        return out
+    for dirpath, _, files in os.walk(path):
+        for name in files:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, path)
+            try:
+                size = os.path.getsize(full)
+            except OSError:
+                size = -1
+            if ".corrupt-" in name:
+                out["quarantined"].append({"file": rel, "bytes": size})
+            elif name.endswith((".json", ".chk")) or "checkpoint" in name:
+                out["checkpoints"].append({"file": rel, "bytes": size})
+    return out
+
+
+def collect_events(clients, limit: int = 200) -> List[Dict]:
+    """Recent Events across namespaces, newest last (best-effort)."""
+    try:
+        events = list(clients.events.list())
+    except Exception:  # noqa: BLE001 — API may be the sick part
+        return []
+    events.sort(key=lambda e: e.get("lastTimestamp") or "")
+    return events[-limit:]
+
+
+def collect(endpoints: Dict[str, str],
+            state_dirs: Optional[Dict[str, str]] = None,
+            clients=None,
+            timeout: float = 3.0) -> Dict:
+    """The whole bundle: per-component debug surfaces + checkpoint
+    state + recent Events."""
+    bundle: Dict = {
+        "generated_unix": round(time.time(), 3),
+        "components": {name: collect_endpoint(hp, timeout=timeout)
+                       for name, hp in endpoints.items()},
+        "state_dirs": {name: collect_state_dir(p)
+                       for name, p in (state_dirs or {}).items()},
+    }
+    if clients is not None:
+        bundle["events"] = collect_events(clients)
+    return bundle
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+def _component_findings(name: str, art: Dict) -> List[Finding]:
+    out: List[Finding] = []
+    samples = parse_metrics_text(art["metrics"]) if "metrics" in art else {}
+
+    for labels, value in samples.get("dra_circuit_breaker_state", []):
+        if value >= 2:
+            out.append(Finding(
+                CRITICAL, "BREAKER_OPEN", name,
+                f"API-server circuit breaker {labels.get('name', '?')!r} "
+                f"is OPEN: requests fail fast, kubelet sees NOT_SERVING",
+                {"breaker": labels.get("name", "")}))
+        elif value >= 1:
+            out.append(Finding(
+                WARNING, "BREAKER_HALF_OPEN", name,
+                f"circuit breaker {labels.get('name', '?')!r} is "
+                f"half-open (probing after an outage)"))
+
+    slo_report = art.get("slo") or {}
+    for slo_name, row in (slo_report.get("slos") or {}).items():
+        if not row.get("burning"):
+            continue
+        windows = row.get("burning_windows") or []
+        wname = windows[0] if windows else "?"
+        arms = (row.get("windows") or {}).get(wname, {})
+        out.append(Finding(
+            CRITICAL, "SLO_BURNING", name,
+            f"SLO {slo_name!r} is burning its error budget "
+            f"({wname} window, long burn "
+            f"{((arms.get('long') or {}).get('burn_rate', 0)):.1f}x, "
+            f"budget remaining {row.get('budget_remaining')}): "
+            f"{row.get('description', '')}",
+            {"slo": slo_name, "windows": windows,
+             "budget_remaining": row.get("budget_remaining")}))
+
+    parked = metric_value(samples, "dra_allocator_parked_claims")
+    if parked > 0:
+        uids = [c.get("uid", "") for c in
+                (art.get("allocator") or {}).get("parked_claims") or []]
+        out.append(Finding(
+            WARNING, "PARKED_CLAIMS", name,
+            f"{int(parked)} ResourceClaim(s) parked as unsatisfiable "
+            f"(each carries an AllocationParked Event)",
+            {"count": int(parked), "uids": uids}))
+
+    owned = [(labels.get("slot", ""), value) for labels, value in
+             samples.get("dra_shard_owned_pools", []) if value > 0]
+    if len(owned) >= 2:
+        counts = [v for _, v in owned]
+        mean = sum(counts) / len(counts)
+        worst = max(owned, key=lambda kv: kv[1])
+        if mean > 0 and worst[1] > 2.0 * mean:
+            out.append(Finding(
+                WARNING, "SHARD_IMBALANCE", name,
+                f"shard slot {worst[0]!r} owns {int(worst[1])} pools vs "
+                f"a {mean:.1f} mean across {len(owned)} slots "
+                f"(>2x — check ring seed/slot leases)",
+                {"slots": dict(owned)}))
+
+    lag_p99 = histogram_quantile(samples, "dra_watch_mux_lag_seconds", 0.99)
+    if lag_p99 is not None and lag_p99 > MUX_LAG_P99_THRESHOLD_S:
+        out.append(Finding(
+            WARNING, "WATCH_MUX_LAG", name,
+            f"watch-mux event-to-handler lag p99 >= {lag_p99}s "
+            f"(threshold {MUX_LAG_P99_THRESHOLD_S}s): informers are "
+            f"falling behind the watch streams",
+            {"p99_upper_bound_s": lag_p99}))
+
+    quarantined = metric_value(samples, "dra_checkpoint_quarantined_total")
+    if quarantined > 0:
+        out.append(Finding(
+            WARNING, "CHECKPOINT_QUARANTINED", name,
+            f"{int(quarantined)} corrupt checkpoint(s) quarantined "
+            f"(driver restarted from salvaged-or-empty state)"))
+
+    evicted = metric_value(samples, "dra_traces_evicted_total")
+    if evicted > 0:
+        out.append(Finding(
+            INFO, "TRACES_EVICTED", name,
+            f"{int(evicted)} trace(s) evicted from the flight recorder: "
+            f"/debug/criticalpath attribution covers a partial window"))
+
+    vars_ = art.get("vars") or {}
+    if vars_.get("faults_armed"):
+        out.append(Finding(
+            INFO, "FAULTS_ARMED", name,
+            f"fault injection is ARMED: "
+            f"{vars_.get('fault_points_armed')} — slow/failed paths may "
+            f"be drills, not production faults"))
+
+    for surface, err in (art.get("errors") or {}).items():
+        if "404" in err:
+            # absent surface (e.g. /debug/allocator on a kubelet
+            # plugin) is the normal shape, not a finding
+            continue
+        out.append(Finding(
+            INFO, "SURFACE_UNAVAILABLE", name,
+            f"debug surface {surface!r} not collected: {err}"))
+    return out
+
+
+def run_findings(bundle: Dict) -> List[Finding]:
+    """Automated triage over a collected bundle, most severe first."""
+    findings: List[Finding] = []
+    for name, art in (bundle.get("components") or {}).items():
+        findings.extend(_component_findings(name, art))
+    for name, state in (bundle.get("state_dirs") or {}).items():
+        if state.get("quarantined"):
+            findings.append(Finding(
+                WARNING, "CHECKPOINT_QUARANTINE_FILES", name,
+                f"{len(state['quarantined'])} quarantined checkpoint "
+                f"file(s) on disk under {state['path']}",
+                {"files": [q["file"] for q in state["quarantined"]]}))
+    warnings = [e for e in bundle.get("events") or []
+                if e.get("type") == "Warning"]
+    if warnings:
+        by_reason: Dict[str, int] = {}
+        for e in warnings:
+            by_reason[e.get("reason", "?")] = \
+                by_reason.get(e.get("reason", "?"), 0) + 1
+        findings.append(Finding(
+            INFO, "WARNING_EVENTS", "cluster",
+            f"{len(warnings)} Warning Event(s) in the recent window: "
+            f"{dict(sorted(by_reason.items()))}"))
+    findings.sort(key=lambda f: (_SEVERITY_ORDER.get(f.severity, 9),
+                                 f.component, f.code))
+    return findings
+
+
+def summary_text(findings: List[Finding], bundle: Dict) -> str:
+    """The triage summary the CLI prints (and the tarball embeds)."""
+    lines = [
+        "tpu-dra-doctor triage summary",
+        f"collected {len(bundle.get('components') or {})} component(s), "
+        f"{len(bundle.get('state_dirs') or {})} state dir(s), "
+        f"{len(bundle.get('events') or [])} recent event(s)",
+        "",
+    ]
+    if not findings:
+        lines.append("no findings: all collected surfaces look healthy")
+    for f in findings:
+        lines.append(f"[{f.severity.upper():8s}] {f.component}: "
+                     f"{f.code}: {f.message}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# bundle tarball
+# ---------------------------------------------------------------------------
+
+
+def _add_member(tar: tarfile.TarFile, name: str, text: str) -> None:
+    data = text.encode()
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = int(time.time())
+    tar.addfile(info, io.BytesIO(data))
+
+
+def write_bundle(bundle: Dict, findings: List[Finding],
+                 out_path: str) -> str:
+    """Write the must-gather tarball: per-component artifacts, events,
+    state-dir inventory, machine-readable findings, and the human
+    summary. Returns ``out_path``."""
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, art in (bundle.get("components") or {}).items():
+            for key in ENDPOINT_PATHS:
+                if key not in art:
+                    continue
+                if key == "metrics":
+                    _add_member(tar, f"{name}/metrics.txt", art[key])
+                else:
+                    _add_member(tar, f"{name}/{key}.json",
+                                json.dumps(art[key], indent=1))
+            if art.get("errors"):
+                _add_member(tar, f"{name}/errors.json",
+                            json.dumps(art["errors"], indent=1))
+        if bundle.get("events") is not None:
+            _add_member(tar, "events.json",
+                        json.dumps(bundle["events"], indent=1))
+        if bundle.get("state_dirs"):
+            _add_member(tar, "state_dirs.json",
+                        json.dumps(bundle["state_dirs"], indent=1))
+        _add_member(tar, "findings.json",
+                    json.dumps([f.to_dict() for f in findings], indent=1))
+        _add_member(tar, "summary.txt", summary_text(findings, bundle))
+    return out_path
