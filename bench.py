@@ -1114,6 +1114,146 @@ def _step_ms(report: dict, step: str) -> float:
     return float("nan")
 
 
+def bench_fencing(n_cross_claims: int = 32,
+                  nodes_per_slot: int = 24) -> dict:
+    """Split-brain hardening figures (ISSUE 10):
+
+    - **recovery latency** — the pause-past-expiry drill's stale-holder
+      cycle: wake → fenced rejection → demote (resign every lease) →
+      rejoin → first successful fenced commit, in ms;
+    - **multi-replica cross-shard throughput** — N wide claims whose
+      candidate pools span TWO separate controller replicas, committed
+      through the epoch-fenced DeviceReservation protocol, vs the PR 6
+      park-baseline (remote_reserves=False) where every one of them
+      parks."""
+    import logging as _logging
+
+    from tpu_dra_driver.kube import fencing as fencing_mod
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        AllocationControllerConfig,
+        ShardWiring,
+    )
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.kube.fake import FakeCluster
+    from tpu_dra_driver.kube.fencing import FencingTokens
+    from tpu_dra_driver.kube.sharding import ShardRing, shard_slots
+    from tpu_dra_driver.testing.scenarios import (
+        _gen_slice,
+        scenario_pause_past_expiry_mid_batch,
+    )
+
+    _logging.disable(_logging.ERROR)
+    try:
+        drill = scenario_pause_past_expiry_mid_batch()
+    finally:
+        _logging.disable(_logging.NOTSET)
+    out = {
+        "recovery_ms": drill["recovery_ms"],
+        "adoption_ms": drill["adoption_ms"],
+        "demote_ms": drill["demote_ms"],
+        "fencing_rejections": drill["fencing_rejections"],
+    }
+
+    def crossshard_arm(remote_reserves: bool) -> dict:
+        cluster = FakeCluster()
+        fencing_mod.install_admission(cluster)
+        obs = ClientSets(cluster=cluster)
+        ring = ShardRing(shard_slots(2))
+        # spread pools until both slots have nodes_per_slot single-
+        # device pools (rendezvous placement is uneven on small counts)
+        per_slot = {s: 0 for s in ring.members}
+        i = 0
+        while min(per_slot.values()) < nodes_per_slot:
+            node = f"bf-{i}"
+            i += 1
+            slot = ring.owner(node)
+            if per_slot[slot] >= nodes_per_slot:
+                continue
+            per_slot[slot] += 1
+            obs.resource_slices.create(_gen_slice(node))
+        for slot in ring.members:
+            obs.leases.create({
+                "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": f"allocation-controller-{slot}",
+                             "namespace": "tpu-dra-driver"},
+                "spec": {"holderIdentity": f"r-{slot}",
+                         "renewTime": time.time(),
+                         "leaseDurationSeconds": 15.0,
+                         "leaseTransitions": 1}})
+        cfg = AllocationControllerConfig(
+            workers=4, batch_max=32, retry_interval=0.5,
+            reserve_grant_timeout=3.0, remote_reserves=remote_reserves)
+        controllers = []
+        for slot in ring.members:
+            ctrl = AllocationController(
+                ClientSets(cluster=cluster), cfg,
+                shard=ShardWiring(ring, owned={slot}),
+                identity=f"bench-{slot}")
+            ctrl.set_fencing(FencingTokens(
+                ring, (lambda s, mine=slot: 1 if s == mine else None)))
+            controllers.append(ctrl)
+        for ctrl in controllers:
+            ctrl.start()
+        try:
+            t0 = time.perf_counter()
+            for k in range(n_cross_claims):
+                obs.resource_claims.create({
+                    "apiVersion": "resource.k8s.io/v1beta1",
+                    "kind": "ResourceClaim",
+                    "metadata": {"name": f"xb-{k}", "namespace": "bench",
+                                 "uid": f"xb-uid-{k:04d}"},
+                    "spec": {"devices": {"requests": [
+                        {"name": "tpu", "count": 1,
+                         "selectors": [{"attribute": "type",
+                                        "equals": "chip"}]}]}}})
+
+            def allocated() -> int:
+                return sum(1 for c in obs.resource_claims.list()
+                           if (c.get("status") or {}).get("allocation"))
+
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if allocated() >= n_cross_claims:
+                    break
+                if not remote_reserves:
+                    # the baseline converges to "everything parked"
+                    parked = sum(len(c.parked_claims())
+                                 for c in controllers)
+                    if parked >= n_cross_claims:
+                        break
+                time.sleep(0.01)
+            wall = time.perf_counter() - t0
+            done = allocated()
+            # double-alloc audit
+            seen = set()
+            for c in obs.resource_claims.list():
+                for r in (((c.get("status") or {}).get("allocation")
+                           or {}).get("devices") or {}).get("results", []):
+                    key = (r["pool"], r["device"])
+                    assert key not in seen, f"double alloc {key}"
+                    seen.add(key)
+            return {"allocated": done,
+                    "parked": sum(len(c.parked_claims())
+                                  for c in controllers),
+                    "wall_s": round(wall, 3),
+                    "claims_per_sec": round(done / wall, 1) if wall else 0.0}
+        finally:
+            for ctrl in controllers:
+                ctrl.stop()
+
+    reserves = crossshard_arm(remote_reserves=True)
+    baseline = crossshard_arm(remote_reserves=False)
+    assert reserves["allocated"] == n_cross_claims, reserves
+    assert baseline["allocated"] == 0, (
+        "park-baseline unexpectedly allocated cross-replica claims "
+        f"{baseline}")
+    out["crossshard_multireplica"] = reserves
+    out["crossshard_park_baseline"] = baseline
+    out["crossshard_claims_per_sec"] = reserves["claims_per_sec"]
+    return out
+
+
 def bench_observability(n_iters: int = 200_000,
                         render_iters: int = 50) -> dict:
     """Tracing overhead per span site (disabled / sampled-1% / always)
@@ -1683,6 +1823,7 @@ SUMMARY_KEYS = [
     "recovery_plugin_kill_ms", "recovery_daemon_kill_ms",
     "fleet_drain_reconverge_ms", "fleet_storm_clear_ms",
     "fleet_upgrade_gap_failures", "fleet_churn_p99_ms",
+    "fencing_recovery_ms", "crossshard_multireplica_per_sec",
     "trace_disabled_ns", "metrics_render_ms",
     "slo_eval_ms", "criticalpath_walk_us",
     "backend", "devices",
@@ -1837,6 +1978,19 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"  fleet scenario bench failed ({type(e).__name__}: {e})")
 
+    log("[bench] split-brain fencing (stale-holder recovery, "
+        "multi-replica cross-shard reserves vs park-baseline)…")
+    fencing = {}
+    try:
+        fencing = bench_fencing()
+        log(f"  recovery (wake->demote->rejoin->commit): "
+            f"{fencing['recovery_ms']:.0f} ms; cross-replica "
+            f"{fencing['crossshard_claims_per_sec']:.1f} claims/s "
+            f"(park-baseline allocated "
+            f"{fencing['crossshard_park_baseline']['allocated']})")
+    except Exception as e:  # noqa: BLE001
+        log(f"  fencing bench failed ({type(e).__name__}: {e})")
+
     log("[bench] observability overhead (tracing disabled/sampled/always, "
         "/metrics render)…")
     obs = {}
@@ -1983,6 +2137,12 @@ def main() -> int:
             "fleet_churn_p99_ms":
                 fleet["autoscaler_churn"]["traffic"]["p99_ms"]}
            if len(fleet) == 4 else {}),
+        # split-brain fencing (full evidence under the fencing key)
+        "fencing": fencing,
+        **({"fencing_recovery_ms": fencing["recovery_ms"],
+            "crossshard_multireplica_per_sec":
+                fencing["crossshard_claims_per_sec"]}
+           if fencing else {}),
         "vs_baseline_note": (
             (crossproc_note if xp50 is not None else fallback_note)
             + note_tail),

@@ -439,3 +439,45 @@ def test_exactness_verdict_three_states():
     ) == "exact_up_to_bf16_ties"
     with pytest.raises(AssertionError, match="diverged"):
         bench._exactness_verdict({"exact_greedy": False, "divergence": None})
+
+
+def test_bench_detail_records_fencing():
+    """The committed BENCH_DETAIL.json must carry the split-brain
+    fencing evidence (ISSUE 10): the stale-holder recovery cycle
+    (wake → fenced rejection → demote → rejoin → first successful
+    commit) bounded, and the multi-replica cross-shard reservation lane
+    actually committing claims the PR 6 park-baseline cannot (baseline
+    allocated MUST be 0 — if it ever allocates, the baseline arm is no
+    longer the baseline)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    with open(path) as f:
+        extra = json.load(f)["extra"]
+    fencing = extra["fencing"]
+    assert fencing["fencing_rejections"] >= 1
+    assert 0 < fencing["recovery_ms"] < 10_000
+    assert fencing["crossshard_multireplica"]["allocated"] > 0
+    assert fencing["crossshard_multireplica"]["claims_per_sec"] > 1.0
+    assert fencing["crossshard_park_baseline"]["allocated"] == 0
+    assert fencing["crossshard_park_baseline"]["parked"] > 0
+    assert extra["fencing_recovery_ms"] == fencing["recovery_ms"]
+    assert extra["crossshard_multireplica_per_sec"] == \
+        fencing["crossshard_claims_per_sec"]
+    for key in ("fencing_recovery_ms", "crossshard_multireplica_per_sec"):
+        assert key in bench.SUMMARY_KEYS
+
+
+def test_fencing_bench_runs_live():
+    """The bench function itself stays runnable: a small-iteration run
+    produces the full key set, the reservation arm allocates everything
+    and the park-baseline nothing, and no fault rules stay armed."""
+    from tpu_dra_driver.pkg import faultinject as fi
+
+    out = bench.bench_fencing(n_cross_claims=6, nodes_per_slot=4)
+    assert {"recovery_ms", "adoption_ms", "demote_ms",
+            "fencing_rejections", "crossshard_multireplica",
+            "crossshard_park_baseline",
+            "crossshard_claims_per_sec"} <= set(out)
+    assert out["crossshard_multireplica"]["allocated"] == 6
+    assert out["crossshard_park_baseline"]["allocated"] == 0
+    assert not fi.armed()

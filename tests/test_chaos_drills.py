@@ -910,6 +910,48 @@ def test_quarantine_never_loses_live_checkpoint_when_recovery_write_fails(
 
 
 # ---------------------------------------------------------------------------
+# split-brain fault points (ISSUE 10): the composed drills live in
+# tests/test_fleet_scenarios.py; this matrix-level drill pins the
+# pre-commit point's failure isolation on its own
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_pre_commit_failure_isolates_and_recovers():
+    """A fault at allocator.pre-commit (between pick and the status
+    write) is isolated per claim — the batch records the error, the
+    in-batch picks unwind, and a retry after disarm allocates the SAME
+    devices (nothing leaked into the ledger or batch state)."""
+    from tpu_dra_driver.kube.allocator import Allocator
+
+    clients = ClientSets()
+    clients.resource_slices.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceSlice",
+        "metadata": {"name": "pc-slice"},
+        "spec": {"driver": "tpu.google.com", "nodeName": "pc-node",
+                 "pool": {"name": "pc-node", "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": [{"name": "tpu-0", "attributes": {
+                     "type": {"string": "chip"}}}]}})
+    claim = clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": "pc-claim", "namespace": "ns", "uid": "pc-u"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "count": 1,
+             "selectors": [{"attribute": "type", "equals": "chip"}]}]}}})
+    allocator = Allocator(clients, "tpu.google.com")
+    fi.arm("allocator.pre-commit", fi.Rule(mode="fail", first=1))
+    res = allocator.allocate_batch([claim])["pc-u"]
+    assert res.error is not None
+    assert not (clients.resource_claims.get("pc-claim", "ns")
+                .get("status") or {}).get("allocation")
+    fi.disarm("allocator.pre-commit")
+    res = allocator.allocate_batch([claim])["pc-u"]
+    assert res.error is None
+    assert res.claim["status"]["allocation"]["devices"]["results"][0][
+        "device"] == "tpu-0"
+
+
+# ---------------------------------------------------------------------------
 # the drill matrix ledger (acceptance: >= 12 points, each drilled)
 # ---------------------------------------------------------------------------
 
@@ -935,6 +977,7 @@ DRILLED_POINTS = [
     "cd.prepare.after_write_ahead",
     "cd.prepare.before_commit",
     "allocator.commit-conflict",
+    "allocator.pre-commit",
     "catalog.index-rebuild",
     "resourceslice.publish",
 ]

@@ -272,3 +272,53 @@ def test_cli_main_end_to_end(sick_endpoint, tmp_path, capsys):
 def test_cli_requires_a_target(capsys):
     from tpu_dra_driver.cmd import doctor as doctor_cmd
     assert doctor_cmd.main(["--output", "/tmp/never.tar.gz"]) == 2
+
+
+def test_finding_fencing_rejections_warning_with_sites():
+    bundle = {"components": {"alloc": {"metrics": _metrics_text(
+        dra_fencing_rejections_total=[({"site": "allocator.commit"}, 2),
+                                      ({"site": "reserve.grant"}, 1)])}}}
+    f = next(f for f in doctor.run_findings(bundle)
+             if f.code == "FENCING_REJECTIONS")
+    assert f.severity == doctor.WARNING
+    assert f.details["by_site"] == {"allocator.commit": 2.0,
+                                    "reserve.grant": 1.0}
+    assert "split-brain" in f.message
+
+
+def test_finding_lease_flapping_from_resample_delta():
+    """With a resample window, the finding keys on transitions CLIMBING
+    within it — a stable fleet (same counts in both samples) stays
+    quiet no matter its lifetime total."""
+    first = _metrics_text(dra_leader_transitions_total=[
+        ({"lease": "s0", "direction": "acquired"}, 50)])
+    climbing = _metrics_text(dra_leader_transitions_total=[
+        ({"lease": "s0", "direction": "acquired"}, 53),
+        ({"lease": "s0", "direction": "lost"}, 3)])
+    flapping = {"components": {"ctrl": {
+        "metrics": first, "metrics_resample": climbing}}}
+    f = next(f for f in doctor.run_findings(flapping)
+             if f.code == "LEASE_FLAPPING")
+    assert f.severity == doctor.WARNING
+    assert f.details["delta_in_window"] == 6
+    stable = {"components": {"ctrl": {
+        "metrics": first, "metrics_resample": first}}}
+    assert not [f for f in doctor.run_findings(stable)
+                if f.code == "LEASE_FLAPPING"]
+
+
+def test_finding_lease_flapping_absolute_fallback():
+    """Without a resample, only an egregious lifetime total flags (and
+    the message says how to confirm)."""
+    quiet = {"components": {"ctrl": {"metrics": _metrics_text(
+        dra_leader_transitions_total=[
+            ({"lease": "s0", "direction": "acquired"}, 3)])}}}
+    assert not [f for f in doctor.run_findings(quiet)
+                if f.code == "LEASE_FLAPPING"]
+    noisy = {"components": {"ctrl": {"metrics": _metrics_text(
+        dra_leader_transitions_total=[
+            ({"lease": "s0", "direction": "acquired"}, 15),
+            ({"lease": "s0", "direction": "lost"}, 15)])}}}
+    f = next(f for f in doctor.run_findings(noisy)
+             if f.code == "LEASE_FLAPPING")
+    assert "--resample" in f.message

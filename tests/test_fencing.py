@@ -1,0 +1,633 @@
+"""Split-brain fencing: lease epochs, observer-local expiry, admission
+rejection, fenced allocator commits, pause-mode fault injection, and
+the cross-replica reservation primitives (ISSUE 10).
+
+The composed end-to-end drills (paused holder past lease expiry,
+asymmetric partition) live in tests/test_fleet_scenarios.py; this file
+pins every layer in isolation so a drill failure localizes.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra_driver import DRIVER_NAME
+from tpu_dra_driver.kube import catalog as catalog_mod
+from tpu_dra_driver.kube import fencing as fencing_mod
+from tpu_dra_driver.kube.allocator import AllocationError, Allocator
+from tpu_dra_driver.kube.catalog import UsageLedger, build_snapshot
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.kube.errors import StaleEpochError
+from tpu_dra_driver.kube.fake import FakeCluster
+from tpu_dra_driver.kube.fencing import (
+    FencingTokens,
+    StaleWriterError,
+    install_admission,
+)
+from tpu_dra_driver.kube.leaderelection import (
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from tpu_dra_driver.kube.reservations import (
+    PHASE_DENIED,
+    PHASE_GRANTED,
+    RESERVATION_NAMESPACE,
+    ReservationGranter,
+    ReserveCoordinator,
+    build_reservation,
+)
+from tpu_dra_driver.kube.sharding import ShardRing, shard_slots
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import FENCING_REJECTIONS
+
+LEASE_NS = "tpu-dra-driver"
+PREFIX = "allocation-controller"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _elector(cs, identity, on_start=None, on_stop=None, clock=time.time,
+             lease_duration=0.3, renew_deadline=0.2, name="t-lease"):
+    return LeaderElector(
+        cs.leases,
+        LeaderElectionConfig(lease_name=name, namespace=LEASE_NS,
+                             identity=identity,
+                             lease_duration=lease_duration,
+                             renew_deadline=renew_deadline,
+                             retry_period=0.05),
+        on_started_leading=on_start or (lambda: None),
+        on_stopped_leading=on_stop or (lambda: None),
+        clock=clock)
+
+
+def _await(pred, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out awaiting {what}")
+
+
+def _lease_transitions(cs, name="t-lease"):
+    lease = cs.leases.get(name, LEASE_NS)
+    return int((lease.get("spec") or {}).get("leaseTransitions", 0) or 0)
+
+
+# ---------------------------------------------------------------------------
+# lease epochs
+# ---------------------------------------------------------------------------
+
+
+def test_first_acquisition_is_epoch_one_and_renew_preserves_it():
+    cs = ClientSets()
+    el = _elector(cs, "a")
+    el.start()
+    _await(lambda: el.is_leader, what="acquisition")
+    assert el.epoch == 1
+    assert _lease_transitions(cs) == 1
+    time.sleep(0.2)     # several renews
+    assert el.epoch == 1
+    assert _lease_transitions(cs) == 1
+    el.stop()
+
+
+def test_adoption_after_expiry_bumps_epoch():
+    cs = ClientSets()
+    a, b = _elector(cs, "a"), _elector(cs, "b")
+    a.start()
+    _await(lambda: a.is_leader, what="a leading")
+    a._stop.set()       # a dies without releasing
+    b.start()
+    _await(lambda: b.is_leader, what="b adopting", timeout=5.0)
+    assert b.epoch == 2
+    assert _lease_transitions(cs) == 2
+    b.stop()
+
+
+def test_release_then_reacquire_bumps_epoch():
+    """The satellite edge case: an orderly release() clears the holder,
+    so the SAME identity re-acquiring gets a new epoch — any write
+    stamped under the pre-release tenure is rejectable."""
+    cs = ClientSets()
+    el = _elector(cs, "a")
+    el.start()
+    _await(lambda: el.is_leader, what="first acquisition")
+    assert el.epoch == 1
+    el.stop()           # releases: holderIdentity cleared
+    lease = cs.leases.get("t-lease", LEASE_NS)
+    assert lease["spec"]["holderIdentity"] == ""
+    el.start()
+    _await(lambda: el.is_leader, what="re-acquisition")
+    assert el.epoch == 2
+    el.stop()
+
+
+def test_two_candidates_adopt_expired_lease_exactly_one_wins():
+    """Both candidates observe the same expired lease and race the
+    update with the same resourceVersion: optimistic concurrency lets
+    exactly one through; the loser stays follower (and the winner's
+    epoch is bumped exactly once)."""
+    cs = ClientSets()
+    dead = _elector(cs, "dead")
+    dead.start()
+    _await(lambda: dead.is_leader, what="initial holder")
+    dead._stop.set()    # dies without releasing
+
+    a, b = _elector(cs, "a"), _elector(cs, "b")
+    # pre-observe the stale pair so both consider it expired at t0
+    for el in (a, b):
+        el._observed_pair = ("dead", cs.leases.get(
+            "t-lease", LEASE_NS)["spec"]["renewTime"])
+        el._observed_at = time.monotonic() - 10.0
+    winners = []
+    barrier = threading.Barrier(2)
+
+    def race(el):
+        barrier.wait()
+        if el._try_acquire_or_renew():
+            winners.append(el._cfg.identity)
+
+    t1 = threading.Thread(target=race, args=(a,))
+    t2 = threading.Thread(target=race, args=(b,))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert len(winners) == 1, winners
+    assert _lease_transitions(cs) == 2
+
+
+def test_renew_conflict_during_rv_race_holds_leadership():
+    """A transient resourceVersion conflict (a rival's failed takeover
+    bumping the lease rv mid-renew) must NOT demote the leader: it
+    retries within renew_deadline and stays leader at the same epoch."""
+    cs = ClientSets()
+    el = _elector(cs, "a", lease_duration=1.0, renew_deadline=0.8)
+    el.start()
+    _await(lambda: el.is_leader, what="acquisition")
+    # simulate the rival's rv bump: touch the lease between el's
+    # get and update by bumping rv out from under ONE renew cycle
+    lease = cs.leases.get("t-lease", LEASE_NS)
+    cs.leases.update(lease)     # rv moves; holder/renewTime unchanged
+    time.sleep(0.2)             # several retry periods
+    assert el.is_leader
+    assert el.epoch == 1
+    el.stop()
+
+
+# ---------------------------------------------------------------------------
+# observer-local expiry (the clock-skew fix)
+# ---------------------------------------------------------------------------
+
+
+def test_skewed_holder_clock_cannot_mislead_rival_expiry():
+    """Holder writes renewTime from a clock an hour BEHIND: under the
+    old local-wall-clock comparison the rival would adopt instantly;
+    observer-local expiry keeps the actively-renewing holder safe."""
+    cs = ClientSets()
+    behind = _elector(cs, "behind", clock=lambda: time.time() - 3600.0,
+                      lease_duration=0.4)
+    rival = _elector(cs, "rival", lease_duration=0.4)
+    behind.start()
+    _await(lambda: behind.is_leader, what="skewed holder leading")
+    rival.start()
+    time.sleep(0.8)     # two full lease durations
+    assert behind.is_leader and not rival.is_leader
+    behind.stop()
+    rival.stop()
+
+
+def test_future_renew_time_does_not_immortalize_a_dead_holder():
+    """Holder writes renewTime from a clock an hour AHEAD, then dies:
+    the old math saw it perpetually fresh; observer-local expiry adopts
+    after lease_duration of locally-observed silence."""
+    cs = ClientSets()
+    ahead = _elector(cs, "ahead", clock=lambda: time.time() + 3600.0,
+                     lease_duration=0.3)
+    ahead.start()
+    _await(lambda: ahead.is_leader, what="ahead holder leading")
+    ahead._stop.set()   # dies; its last renewTime is an hour in the future
+    rival = _elector(cs, "rival", lease_duration=0.3)
+    rival.start()
+    _await(lambda: rival.is_leader, timeout=5.0,
+           what="rival adopting the dead future-stamped lease")
+    assert rival.epoch == 2
+    rival.stop()
+
+
+def test_clock_fault_point_skews_writes_without_breaking_the_holder():
+    """The leaderelection.clock corrupt hook shifts what the holder
+    WRITES; its own tenure must be unaffected (nothing reads the value
+    for expiry)."""
+    cs = ClientSets()
+    fi.arm("leaderelection.clock",
+           fi.Rule(mode="corrupt", mutate=lambda t: t + 1800.0))
+    el = _elector(cs, "a")
+    el.start()
+    _await(lambda: el.is_leader, what="acquisition under skew")
+    written = cs.leases.get("t-lease", LEASE_NS)["spec"]["renewTime"]
+    assert written > time.time() + 1000.0
+    time.sleep(0.15)
+    assert el.is_leader
+    el.stop()
+
+
+# ---------------------------------------------------------------------------
+# pause-mode fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_pause_rule_blocks_until_resumed_and_match_filters():
+    gate = fi.PauseGate()
+    gate.pause()
+    fi.arm("p.pause-test", fi.Rule(mode="pause", gate=gate, seconds=10.0,
+                                   match=lambda p: p == "victim"))
+    fi.fire("p.pause-test", payload="bystander")     # no block
+
+    released = threading.Event()
+
+    def victim():
+        fi.fire("p.pause-test", payload="victim")
+        released.set()
+
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not released.is_set()        # blocked on the gate
+    gate.resume()
+    assert released.wait(2.0)
+    t.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# fencing admission + tokens
+# ---------------------------------------------------------------------------
+
+
+def _mk_lease(cs, slot, epoch, holder="h"):
+    cs.leases.create({
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": {"name": f"{PREFIX}-{slot}", "namespace": LEASE_NS},
+        "spec": {"holderIdentity": holder, "renewTime": time.time(),
+                 "leaseDurationSeconds": 15.0,
+                 "leaseTransitions": epoch}})
+
+
+def _bump_lease(cs, slot):
+    lease = cs.leases.get(f"{PREFIX}-{slot}", LEASE_NS)
+    lease["spec"]["leaseTransitions"] += 1
+    cs.leases.update(lease)
+
+
+def _claim(cs, name="c1", uid="u1"):
+    return cs.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "ns", "uid": uid},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "count": 1,
+             "selectors": [{"attribute": "type", "equals": "chip"}]}]}}})
+
+
+def test_admission_rejects_stale_epoch_and_records_it():
+    cluster = FakeCluster()
+    handle = install_admission(cluster)
+    cs = ClientSets(cluster=cluster)
+    _mk_lease(cs, "shard-0", 2)
+    claim = _claim(cs)
+    # unstamped write passes (unfenced writers keep working)
+    claim["status"] = {"allocation": {"devices": {"results": []}}}
+    claim = cs.resource_claims.update(claim)
+    # stale stamp rejected BEFORE the rv check
+    claim["metadata"].setdefault("annotations", {})[
+        fencing_mod.FENCING_ANNOTATION] = "shard-0=1"
+    claim["metadata"]["resourceVersion"] = "999999"   # would also conflict
+    with pytest.raises(StaleEpochError):
+        cs.resource_claims.update(claim)
+    assert handle.rejections and handle.rejections[0]["slot"] == "shard-0"
+    # current-epoch stamp passes
+    fresh = cs.resource_claims.get("c1", "ns")
+    fresh["metadata"].setdefault("annotations", {})[
+        fencing_mod.FENCING_ANNOTATION] = "shard-0=2"
+    cs.resource_claims.update(fresh)
+
+
+def test_tokens_refuse_unheld_slot_and_client_side_verify():
+    cs = ClientSets()
+    ring = ShardRing(shard_slots(2))
+    held = {"shard-0": 3}
+    tokens = FencingTokens(ring, held.get, leases=cs.leases,
+                           verify_reads=True)
+    assert tokens.epoch_for("shard-0") == 3
+    with pytest.raises(StaleWriterError):
+        tokens.epoch_for("shard-1")
+    # verify: lease ahead of the held epoch -> stale writer
+    _mk_lease(cs, "shard-0", 4)
+    with pytest.raises(StaleWriterError):
+        tokens.verify({"shard-0": 3})
+    tokens.verify({"shard-0": 4})       # current epoch passes
+
+
+def _fleet_slice(cs, node, n=2):
+    cs.resource_slices.create({
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-slice"},
+        "spec": {"driver": DRIVER_NAME, "nodeName": node,
+                 "pool": {"name": node, "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": [{"name": f"tpu-{i}",
+                              "attributes": {"type": {"string": "chip"}}}
+                             for i in range(n)]}})
+
+
+def test_fenced_allocator_commit_rejected_after_epoch_moves():
+    """The allocator-level acceptance unit: pick under a held epoch,
+    the slot's lease moves on (survivor adoption), the commit is
+    rejected -> dra_fencing_rejections_total ticks and StaleWriterError
+    escapes the per-claim isolation."""
+    cluster = FakeCluster()
+    install_admission(cluster)
+    cs = ClientSets(cluster=cluster)
+    _fleet_slice(cs, "n0")
+    _mk_lease(cs, "shard-0", 1)
+    _mk_lease(cs, "shard-1", 1)
+    ring = ShardRing(shard_slots(2))
+    stale_epochs = {s: 1 for s in ring.members}
+    allocator = Allocator(cs, DRIVER_NAME,
+                          fencing=FencingTokens(ring, stale_epochs.get))
+    claim = _claim(cs)
+    before = FENCING_REJECTIONS.labels("allocator.commit").value
+    for slot in ring.members:
+        _bump_lease(cs, slot)      # the survivor's adoptions
+    with pytest.raises(StaleWriterError):
+        allocator.allocate_batch([claim])
+    assert FENCING_REJECTIONS.labels("allocator.commit").value == before + 1
+    assert not (cs.resource_claims.get("c1", "ns").get("status") or {}
+                ).get("allocation")
+
+
+def test_fenced_commit_at_current_epoch_lands_with_stamp():
+    cluster = FakeCluster()
+    install_admission(cluster)
+    cs = ClientSets(cluster=cluster)
+    _fleet_slice(cs, "n0")
+    _mk_lease(cs, "shard-0", 5)
+    _mk_lease(cs, "shard-1", 5)
+    ring = ShardRing(shard_slots(2))
+    allocator = Allocator(cs, DRIVER_NAME,
+                          fencing=FencingTokens(ring, {s: 5 for s in
+                                                       ring.members}.get))
+    claim = _claim(cs)
+    res = allocator.allocate_batch([claim])["u1"]
+    assert res.error is None and res.committed
+    stamped = fencing_mod.stamped_epochs(res.claim)
+    assert stamped == {ring.owner("n0"): 5}
+
+
+# ---------------------------------------------------------------------------
+# reservation primitives (grant / deny / extend / reap)
+# ---------------------------------------------------------------------------
+
+
+def _granter_env(owned_slot="shard-0", epoch=1):
+    cluster = FakeCluster()
+    install_admission(cluster)
+    cs = ClientSets(cluster=cluster)
+    for node in ("g0", "g1"):
+        _fleet_slice(cs, node, n=2)
+    _mk_lease(cs, "shard-0", epoch)
+    _mk_lease(cs, "shard-1", epoch)
+    ring = ShardRing(shard_slots(2))
+    ledger = UsageLedger(DRIVER_NAME, lambda key: None)
+    snap = lambda: build_snapshot(cs.resource_slices.list())  # noqa: E731
+    tokens = FencingTokens(ring, {owned_slot: epoch}.get)
+    granter = ReservationGranter(
+        cs.device_reservations, cs.resource_claims, ledger, snap,
+        lambda: {owned_slot}, DRIVER_NAME,
+        fencing=tokens, leases=cs.leases, reserve_ttl=60.0, identity="g")
+    return cs, ring, ledger, granter, snap
+
+
+def _entries_for(snap, node):
+    return [e for k, e in snap().devices.items() if k[0] == node]
+
+
+def test_granter_grants_then_denies_conflicting_request():
+    cs, ring, ledger, granter, snap = _granter_env(
+        owned_slot=ShardRing(shard_slots(2)).owner("g0"))
+    slot = ring.owner("g0")
+    entries = _entries_for(snap, "g0")
+    rec = build_reservation("c-a", "ns", "uid-a", slot, entries,
+                            "r-b", home_slot="shard-1", home_epoch=1)
+    cs.device_reservations.create(rec)
+    granter.process(rec["metadata"]["name"])
+    got = cs.device_reservations.get(rec["metadata"]["name"],
+                                     RESERVATION_NAMESPACE)
+    assert got["status"]["phase"] == PHASE_GRANTED
+    assert got["status"]["epoch"] == 1
+    # the grant holds the devices in the owner's ledger
+    taken, _ = ledger.snapshot()
+    assert {e.key for e in entries} <= taken
+    # a rival claim for the same devices is denied
+    rec2 = build_reservation("c-b", "ns", "uid-b", slot, entries,
+                             "r-c", home_slot="shard-1", home_epoch=1)
+    cs.device_reservations.create(rec2)
+    granter.process(rec2["metadata"]["name"])
+    got2 = cs.device_reservations.get(rec2["metadata"]["name"],
+                                      RESERVATION_NAMESPACE)
+    assert got2["status"]["phase"] == PHASE_DENIED
+
+
+def test_two_slot_records_for_one_claim_extend_not_refuse():
+    """A claim spanning two slots of ONE owner arrives as two records;
+    the second must widen the reservation (the extend path), not be
+    refused as a same-uid conflict."""
+    cluster = FakeCluster()
+    cs = ClientSets(cluster=cluster)
+    for node in ("g0", "g1"):
+        _fleet_slice(cs, node, n=1)
+    ring = ShardRing(shard_slots(2))
+    slot_a, slot_b = ring.owner("g0"), ring.owner("g1")
+    assert slot_a != slot_b     # the fixture depends on the split
+    ledger = UsageLedger(DRIVER_NAME, lambda key: None)
+    snap = lambda: build_snapshot(cs.resource_slices.list())  # noqa: E731
+    granter = ReservationGranter(
+        cs.device_reservations, cs.resource_claims, ledger, snap,
+        lambda: {slot_a, slot_b}, DRIVER_NAME, identity="g")
+    for slot, node in ((slot_a, "g0"), (slot_b, "g1")):
+        rec = build_reservation("c", "ns", "uid-x", slot,
+                                _entries_for(snap, node), "r",
+                                home_slot=slot_a, home_epoch=None)
+        cs.device_reservations.create(rec)
+        granter.process(rec["metadata"]["name"])
+        got = cs.device_reservations.get(rec["metadata"]["name"],
+                                         RESERVATION_NAMESPACE)
+        assert got["status"]["phase"] == PHASE_GRANTED, got["status"]
+    taken, _ = ledger.snapshot()
+    assert taken == {("g0", "tpu-0"), ("g1", "tpu-0")}
+
+
+def test_reap_by_home_epoch_comparison():
+    """A record whose home slot's lease epoch moved past the stamped
+    homeEpoch has no live coordinator: the owner reaps it and the
+    deletion path releases the ledger reservation."""
+    owned = ShardRing(shard_slots(2)).owner("g0")
+    cs, ring, ledger, granter, snap = _granter_env(owned_slot=owned)
+    entries = _entries_for(snap, "g0")
+    rec = build_reservation("c-a", "ns", "uid-a", owned, entries,
+                            "r-b", home_slot="shard-1", home_epoch=1)
+    cs.device_reservations.create(rec)
+    granter.process(rec["metadata"]["name"])
+    assert ledger.snapshot()[0]
+    # the coordinator's home slot changes hands (epoch 1 -> 2)
+    _bump_lease(cs, "shard-1")
+    reaped = granter.reap_stale(cs.device_reservations.list())
+    assert reaped == 1
+    assert cs.device_reservations.list() == []
+    # the DELETED event normally routes through record_deleted; drive
+    # it directly here (no informer in this unit)
+    granter.record_deleted(rec)
+    assert ledger.snapshot()[0] == set()
+
+
+def test_record_deleted_graduates_committed_claim_instead_of_releasing():
+    """The deletion-vs-commit race: when the record vanishes AFTER the
+    claim committed, the owner must graduate (authoritative read), not
+    release — releasing would open the double-alloc window."""
+    owned = ShardRing(shard_slots(2)).owner("g0")
+    cs, ring, ledger, granter, snap = _granter_env(owned_slot=owned)
+    entries = _entries_for(snap, "g0")[:1]
+    rec = build_reservation("c-a", "ns", "uid-a", owned, entries,
+                            "r-b", home_slot="shard-1", home_epoch=1)
+    cs.device_reservations.create(rec)
+    granter.process(rec["metadata"]["name"])
+    # the claim commits with those devices
+    claim = _claim(cs, name="c-a", uid="uid-a")
+    claim["status"] = {"allocation": {"devices": {"results": [
+        {"request": "tpu", "driver": DRIVER_NAME, "pool": e.pool,
+         "device": e.key[1], "nodeName": e.node} for e in entries]}}}
+    cs.resource_claims.update(claim)
+    granter.record_deleted(rec)
+    taken, _ = ledger.snapshot()
+    assert {e.key for e in entries} <= taken     # still held (committed)
+    assert ledger.committed_keys() == {e.key for e in entries}
+
+
+def test_usage_ledger_extend_rejects_taken_keys():
+    cs = ClientSets()
+    _fleet_slice(cs, "g0", n=2)
+    snap = build_snapshot(cs.resource_slices.list())
+    entries = sorted((e for e in snap.devices.values()),
+                     key=lambda e: e.key)
+    ledger = UsageLedger(DRIVER_NAME, lambda key: None)
+    assert ledger.reserve("u1", entries[:1], snap.counter_caps)
+    # extend with a free key widens
+    assert ledger.reserve("u1", entries[1:], snap.counter_caps,
+                          extend=True)
+    # a rival holding the key blocks the widen
+    ledger.release("u1")
+    assert ledger.reserve("rival", entries[1:], snap.counter_caps)
+    assert ledger.reserve("u1", entries[:1], snap.counter_caps)
+    assert not ledger.reserve("u1", entries[1:], snap.counter_caps,
+                              extend=True)
+
+
+def test_await_grants_pump_resolves_without_informers():
+    """The coordinator's await loop re-reads the API and runs the pump
+    each round — a synchronous granter (no informers anywhere) resolves
+    it."""
+    owned = ShardRing(shard_slots(2)).owner("g0")
+    cs, ring, ledger, granter, snap = _granter_env(owned_slot=owned)
+    coord = ReserveCoordinator(cs.device_reservations, identity="init")
+    entries = _entries_for(snap, "g0")
+    name = coord.request("c-a", "ns", "uid-a", owned, entries,
+                         home_slot="shard-1", home_epoch=1)
+
+    def pump():
+        for rec in cs.device_reservations.list():
+            granter.process(rec["metadata"]["name"])
+
+    results = coord.await_grants([name], timeout=5.0, pump=pump)
+    assert results[name]["phase"] == PHASE_GRANTED
+    coord.withdraw("uid-a", [owned])
+    assert cs.device_reservations.list() == []
+
+
+def test_grant_rollback_shrinks_only_its_own_record_keys():
+    """Review regression: when the SECOND record of a two-slot claim
+    fails its fenced grant write, rollback must drop only that record's
+    keys — the first record is already Granted and its devices must
+    stay reserved (releasing them opened a double-alloc window)."""
+    cluster = FakeCluster()
+    cs = ClientSets(cluster=cluster)
+    for node in ("g0", "g1"):
+        _fleet_slice(cs, node, n=1)
+    ring = ShardRing(shard_slots(2))
+    slot_a, slot_b = ring.owner("g0"), ring.owner("g1")
+    assert slot_a != slot_b
+    ledger = UsageLedger(DRIVER_NAME, lambda key: None)
+    snap = lambda: build_snapshot(cs.resource_slices.list())  # noqa: E731
+    owned = {slot_a, slot_b}
+    epochs = {slot_a: 1, slot_b: 1}
+    granter = ReservationGranter(
+        cs.device_reservations, cs.resource_claims, ledger, snap,
+        lambda: set(owned), DRIVER_NAME,
+        fencing=FencingTokens(ring, epochs.get), leases=cs.leases,
+        identity="g")
+    rec_a = build_reservation("c", "ns", "uid-x", slot_a,
+                              _entries_for(snap, "g0"), "r",
+                              home_slot=slot_a, home_epoch=None)
+    cs.device_reservations.create(rec_a)
+    granter.process(rec_a["metadata"]["name"])
+    assert ledger.snapshot()[0] == {("g0", "tpu-0")}
+    # record 2 reserves (extend) but the granter loses slot_b before
+    # the fenced status write -> rollback of THIS record only
+    rec_b = build_reservation("c", "ns", "uid-x", slot_b,
+                              _entries_for(snap, "g1"), "r",
+                              home_slot=slot_a, home_epoch=None)
+    cs.device_reservations.create(rec_b)
+    epochs.pop(slot_b)      # epoch_for(slot_b) now raises
+    granter.process(rec_b["metadata"]["name"])
+    taken, _ = ledger.snapshot()
+    assert taken == {("g0", "tpu-0")}, (
+        "record-2 rollback must not free record-1's granted keys")
+    # and the shrink path releases the whole reservation when the last
+    # keys go
+    ledger.shrink_reservation("uid-x", _entries_for(snap, "g0"))
+    assert ledger.snapshot()[0] == set()
+
+
+
+def test_record_deleted_shrinks_only_that_records_keys():
+    """Review regression (round 3): with a two-slot-same-owner claim
+    held as ONE reservation behind two Granted records, deleting one
+    record (partial withdraw) must free only ITS devices — the sibling
+    record is still Granted and its keys must stay reserved."""
+    cluster = FakeCluster()
+    cs = ClientSets(cluster=cluster)
+    for node in ("g0", "g1"):
+        _fleet_slice(cs, node, n=1)
+    ring = ShardRing(shard_slots(2))
+    slot_a, slot_b = ring.owner("g0"), ring.owner("g1")
+    ledger = UsageLedger(DRIVER_NAME, lambda key: None)
+    snap = lambda: build_snapshot(cs.resource_slices.list())  # noqa: E731
+    granter = ReservationGranter(
+        cs.device_reservations, cs.resource_claims, ledger, snap,
+        lambda: {slot_a, slot_b}, DRIVER_NAME, identity="g")
+    recs = {}
+    for slot, node in ((slot_a, "g0"), (slot_b, "g1")):
+        rec = build_reservation("c", "ns", "uid-x", slot,
+                                _entries_for(snap, node), "r",
+                                home_slot=slot_a, home_epoch=None)
+        cs.device_reservations.create(rec)
+        granter.process(rec["metadata"]["name"])
+        recs[slot] = rec
+    assert ledger.snapshot()[0] == {("g0", "tpu-0"), ("g1", "tpu-0")}
+    # record A deleted (claim NOT committed) -> only g0's key released
+    granter.record_deleted(recs[slot_a])
+    assert ledger.snapshot()[0] == {("g1", "tpu-0")}
+    granter.record_deleted(recs[slot_b])
+    assert ledger.snapshot()[0] == set()

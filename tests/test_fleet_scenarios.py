@@ -367,3 +367,73 @@ def test_cordon_withdraws_and_restores_pool(tmp_path):
         assert len(published()) == n_full
     finally:
         plugin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# split-brain scenarios (ISSUE 10): fenced leases under pause + partition
+# ---------------------------------------------------------------------------
+
+
+def _assert_split_brain_contract(report):
+    """The acceptance contract shared by both split-brain drills: the
+    survivor adopted under a bumped epoch, the stale commit was rejected
+    (never landed), the stale holder demoted and rejoined under a
+    further-bumped epoch — and the scenario's internal invariants
+    (zero double-allocs, zero stale-epoch commits, no lost claim)
+    already ran at the step boundaries."""
+    steps = _steps(report)
+    for step in ("a_owns_fleet", "stale_pick_parked_mid_batch",
+                 "holder_stalled", "survivor_adopts_slot",
+                 "survivor_commits_same_device", "stale_commit_rejected",
+                 "stale_holder_demoted", "invariants",
+                 "demoted_replica_rejoins", "first_commit_after_rejoin"):
+        assert step in steps, (step, sorted(steps))
+    assert report["fencing_rejections"] >= 1
+    assert report["epoch_after"] > report["epoch_before"]
+    assert report["adoption_ms"] >= 0
+    assert report["demote_ms"] >= 0
+    assert report["recovery_ms"] > 0
+
+
+def test_scenario_pause_past_expiry_mid_batch():
+    """The ISSUE 10 acceptance drill: a shard holder paused past
+    lease_duration mid-batch; the survivor adopts the slot and commits
+    the contested device; the woken holder's stale commit is rejected
+    by epoch fencing (dra_fencing_rejections_total > 0, zero
+    double-allocs); the stale holder demotes and rejoins."""
+    from tpu_dra_driver.testing.scenarios import (
+        scenario_pause_past_expiry_mid_batch,
+    )
+    report = scenario_pause_past_expiry_mid_batch()
+    assert report["scenario"] == "pause_past_expiry_mid_batch"
+    _assert_split_brain_contract(report)
+
+
+def test_scenario_partitioned_holder_wakes():
+    """Asymmetric partition: only the holder's `leases` client is
+    severed while its data plane stays live, under the hostile
+    renew_deadline > lease_duration misconfiguration — the holder keeps
+    believing and writing long after the survivor adopted; fencing
+    rejects the stale commit; healing the partition lets it rejoin."""
+    from tpu_dra_driver.testing.scenarios import (
+        scenario_partitioned_holder_wakes,
+    )
+    report = scenario_partitioned_holder_wakes()
+    assert report["scenario"] == "partitioned_holder_wakes"
+    _assert_split_brain_contract(report)
+
+
+@pytest.mark.slow
+def test_partition_soak_repeated_pause_cycles_under_traffic():
+    """The @slow soak: alternating pause/resume cycles of whichever
+    replica currently holds the fleet, with claim traffic flowing the
+    whole time — every hand-off converges, lease transitions climb
+    monotonically, traffic never fails, and zero stale-epoch commits."""
+    from tpu_dra_driver.testing.scenarios import scenario_lease_flap_soak
+    report = scenario_lease_flap_soak(cycles=4)
+    assert report["scenario"] == "lease_flap_soak"
+    assert len(report["flaps"]) == 4
+    assert report["traffic"]["claims"] >= 4
+    assert report["traffic"]["failures"] == 0
+    transitions = [f["transitions"] for f in report["flaps"]]
+    assert transitions == sorted(transitions)

@@ -466,6 +466,16 @@ _EXTRA_DRILLED = [
     # tests/test_sharding.py: the shard-crash rebalance drill (kill a
     # shard mid-batch -> lease hand-off -> survivor allocates all)
     "sharding.shard-crash",
+    # tests/test_fleet_scenarios.py split-brain drills: a pause rule
+    # stalls one replica's renew loop past lease expiry (and the @slow
+    # lease-flap soak cycles it under traffic)
+    "leaderelection.renew",
+    # tests/test_fencing.py: corrupt-mode skew on the written renewTime
+    # (observer-local expiry keeps holder and rivals correct)
+    "leaderelection.clock",
+    # tests/test_fleet_scenarios.py partitioned-holder-wakes: the
+    # severed client fires it on every blocked call
+    "substrate.partition",
 ]
 
 # Intentional gaps, each with a reason. A point listed here that gains a
@@ -497,10 +507,12 @@ def test_drill_catalog_coverage_enforced():
     import tpu_dra_driver.kube.allocator  # noqa: F401
     import tpu_dra_driver.kube.catalog  # noqa: F401
     import tpu_dra_driver.kube.informer  # noqa: F401
+    import tpu_dra_driver.kube.leaderelection  # noqa: F401
     import tpu_dra_driver.kube.rest  # noqa: F401
     import tpu_dra_driver.kube.sharding  # noqa: F401
     import tpu_dra_driver.plugin.device_state  # noqa: F401
     import tpu_dra_driver.plugin.resourceslices  # noqa: F401
+    import tpu_dra_driver.testing.scenarios  # noqa: F401
     import tpu_dra_driver.tpulib.fake  # noqa: F401
     from tpu_dra_driver.pkg import faultinject as fi
     from tpu_dra_driver.testing.harness import drill_catalog_coverage
@@ -513,7 +525,8 @@ def test_drill_catalog_coverage_enforced():
     # fault points; the production namespaces are what the gate covers
     prod = ("rest.", "informer.", "checkpoint.", "plugin.", "cd.",
             "grpc.", "daemon.", "tpulib.", "allocator.", "catalog.",
-            "resourceslice.", "sharding.")
+            "resourceslice.", "sharding.", "leaderelection.",
+            "substrate.")
     gap = [p for p in drill_catalog_coverage(drilled)
            if p.startswith(prod)]
     unaccounted = sorted(set(gap) - _DRILL_ALLOWLIST)

@@ -642,3 +642,182 @@ def test_shard_group_allocates_mixed_claims():
     winners = allocated_devices(clients)
     assert len(winners) == 9, sorted(winners)
     group.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-REPLICA cross-shard reserves (ISSUE 10): winner parity + no-park
+# ---------------------------------------------------------------------------
+
+
+def _replica_wirings(clients, ring):
+    """Separate replica wirings: each owns ONE slot with its own
+    pool-filtered ledger + complement shadow + reservation coordinator
+    and granter — NO ledger is shared across replicas, so every
+    cross-shard claim must go through the API reservation protocol."""
+    from types import SimpleNamespace
+
+    from tpu_dra_driver.kube.reservations import (
+        ReservationGranter,
+        ReserveCoordinator,
+    )
+    lookup = _snapshot(clients).get_device
+    reps = {}
+    for slot in ring.members:
+        own = UsageLedger(
+            DRIVER, lookup,
+            pool_filter=lambda pool, s=slot: ring.owner(pool) == s)
+        shadow = UsageLedger(
+            DRIVER, lookup,
+            pool_filter=lambda pool, s=slot: ring.owner(pool) != s)
+        coord = ReserveCoordinator(clients.device_reservations,
+                                   identity=f"rep-{slot}")
+        granter = ReservationGranter(
+            clients.device_reservations, clients.resource_claims, own,
+            lambda: _snapshot(clients), lambda s=slot: {s}, DRIVER,
+            identity=f"rep-{slot}")
+        reps[slot] = SimpleNamespace(slot=slot, ledger=own, shadow=shadow,
+                                     coord=coord, granter=granter)
+    return reps
+
+
+def _run_multireplica(world, n_shards):
+    """Same fleet, same global claim order as _run_single/_run_sharded,
+    but cross-shard claims are committed cooperatively by separate
+    replicas through DeviceReservation records (the synchronous pump
+    stands in for the other replica's worker loop)."""
+    from tpu_dra_driver.kube.reservations import RemoteCrossShardLedger
+
+    clients, claims = _populate(world)
+    ring = ShardRing(shard_slots(n_shards))
+    reps = _replica_wirings(clients, ring)
+
+    def pump():
+        for rec in clients.device_reservations.list():
+            for rep in reps.values():
+                rep.granter.process(rec["metadata"]["name"])
+
+    outcomes = {}
+    for claim in claims:                    # same global order
+        uid = claim["metadata"]["uid"]
+        snap = _snapshot(clients)
+        route = route_claim(claim, snap, DRIVER, ring)
+        rep = reps[route.home]
+        if route.cross_shard:
+            xledger = RemoteCrossShardLedger(
+                route, ring, {route.home: rep.ledger}, rep.shadow,
+                rep.coord, home_epoch=lambda: None, grant_timeout=5.0)
+            xledger.pump = pump
+            rep.coord.register_claim(claim, route)
+            allocator = Allocator(clients, DRIVER, ledger=xledger,
+                                  index_attributes=INDEX_ATTRS)
+        else:
+            allocator = Allocator(clients, DRIVER, ledger=rep.ledger,
+                                  index_attributes=INDEX_ATTRS)
+        res = allocator.allocate_batch([claim])[uid]
+        outcomes[claim["metadata"]["name"]] = res.error is None
+        rep.coord.unregister_claim(uid)
+        if res.error is None:
+            # every replica's informer would observe the commit; feed
+            # ledgers AND shadows synchronously (filters keep shares)
+            for other in reps.values():
+                other.ledger.observe_claim(res.claim)
+                other.shadow.observe_claim(res.claim)
+    # phase-1 records never linger: withdrawn on commit or rollback
+    assert clients.device_reservations.list() == [], \
+        clients.device_reservations.list()
+    return allocated_devices(clients), outcomes
+
+
+def test_multireplica_winners_match_single_allocator_property():
+    """The ISSUE 10 parity pin: cross-shard claims committed by TWO
+    separate replicas through the epoch-fenced reservation protocol
+    pick byte-identical winners to the single allocator — the remote
+    lane changes WHO serializes a slot, never WHAT is allocated."""
+    cross_seen = 0
+    for seed in range(N_COMBOS):
+        world = _build_world(seed)
+        single_winners, single_ok = _run_single(world)
+        multi_winners, multi_ok = _run_multireplica(world, 2)
+        assert multi_winners == single_winners, f"seed {seed}"
+        assert multi_ok == single_ok, f"seed {seed}"
+        clients, claims = _populate(world)
+        ring = ShardRing(shard_slots(2))
+        snap = _snapshot(clients)
+        if any(route_claim(c, snap, DRIVER, ring).cross_shard
+               for c in claims):
+            cross_seen += 1
+    assert cross_seen >= 50, cross_seen
+
+
+def test_cross_replica_claim_commits_without_parking_live():
+    """Two LIVE sharded controllers (separate processes' wiring: no
+    shared ledger_for), fencing armed, one wide claim spanning both
+    replicas' slots: it must COMMIT — stamped with both epochs, records
+    cleaned up, nothing parked. This is exactly the claim PR 6 had to
+    park ('cross-shard slots not all owned in-process')."""
+    import time as _time
+
+    from tpu_dra_driver.kube import fencing as fencing_mod
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        ShardWiring,
+    )
+    from tpu_dra_driver.kube.fake import FakeCluster
+    from tpu_dra_driver.kube.fencing import FencingTokens
+
+    cluster = FakeCluster()
+    fencing_mod.install_admission(cluster)
+    obs = ClientSets(cluster=cluster)
+    ring = ShardRing(shard_slots(2))
+    make_fleet(obs, 6, devices_per_node=1)
+    pools_by_slot = {}
+    for i in range(6):
+        pools_by_slot.setdefault(ring.owner(f"node-{i}"), []).append(i)
+    assert len(pools_by_slot) == 2      # the fixture spans both slots
+    for slot in ring.members:
+        obs.leases.create({
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": f"allocation-controller-{slot}",
+                         "namespace": "tpu-dra-driver"},
+            "spec": {"holderIdentity": f"r-{slot}",
+                     "renewTime": _time.time(),
+                     "leaseDurationSeconds": 15.0,
+                     "leaseTransitions": 1}})
+    cfg = AllocationControllerConfig(workers=2, retry_interval=0.2,
+                                     reserve_grant_timeout=2.0)
+    controllers = []
+    for slot in ring.members:
+        ctrl = AllocationController(
+            ClientSets(cluster=cluster), cfg,
+            shard=ShardWiring(ring, owned={slot}), identity=f"r-{slot}")
+        ctrl.set_fencing(FencingTokens(
+            ring, (lambda s, mine=slot: 1 if s == mine else None)))
+        controllers.append(ctrl)
+    for ctrl in controllers:
+        ctrl.start()
+    try:
+        wide_claim(obs, "span-all", count=6, uid="span-uid")
+        deadline = _time.monotonic() + 15.0
+        alloc = None
+        while _time.monotonic() < deadline:
+            c = obs.resource_claims.get("span-all", "t")
+            alloc = (c.get("status") or {}).get("allocation")
+            if alloc:
+                break
+            _time.sleep(0.05)
+        assert alloc, "cross-replica claim never committed (parked?)"
+        assert len(alloc["devices"]["results"]) == 6
+        stamped = fencing_mod.stamped_epochs(
+            obs.resource_claims.get("span-all", "t"))
+        assert stamped == {s: 1 for s in ring.members}, stamped
+        for ctrl in controllers:
+            assert ctrl.parked_claims() == []
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline \
+                and obs.device_reservations.list():
+            _time.sleep(0.05)
+        assert obs.device_reservations.list() == []
+        allocated_devices(obs)      # double-alloc check
+    finally:
+        for ctrl in controllers:
+            ctrl.stop()

@@ -111,7 +111,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             ShardRing(shard_slots(args.allocator_shards),
                       seed=args.shard_ring_seed),
             owned=set())
-    controller = AllocationController(clients, config, shard=shard_wiring)
+    controller = AllocationController(clients, config, shard=shard_wiring,
+                                      identity=args.identity)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -143,17 +144,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         # One leader PER SHARD SLOT: the controller starts with nothing
         # owned and drains whatever slots its leases win; a replica
         # death expires its slots and survivors take over (hand-off).
+        from tpu_dra_driver.kube.fencing import FencingTokens
         from tpu_dra_driver.kube.sharding import (
             ShardLeaseConfig,
             ShardLeaseManager,
         )
-        controller.start()
         manager = ShardLeaseManager(
             clients.leases, shard_wiring.ring.members,
             ShardLeaseConfig(namespace=args.leader_election_namespace,
                              identity=args.identity),
             on_slots_changed=controller.set_owned_slots,
             recorder=recorder)
+        # Epoch fencing: stamp every allocation-plane write with the
+        # held slot epochs; the pre-commit lease re-read (verify_reads)
+        # is the client-side guard for clusters without the fake's
+        # fencing admission hook. A rejected write demotes this replica
+        # (resign every lease, rejoin) instead of double-allocating.
+        controller.set_fencing(
+            FencingTokens(shard_wiring.ring, manager.slot_epoch,
+                          leases=clients.leases,
+                          namespace=args.leader_election_namespace,
+                          verify_reads=True),
+            on_stale_writer=lambda reason: manager.resign_all())
+        controller.start()
         manager.start()
         stop.wait()
         manager.stop()

@@ -59,6 +59,11 @@ def build_parser() -> EnvArgumentParser:
                         "./tpu-dra-doctor-<unix>.tar.gz)")
     p.add_argument("--timeout", type=float, default=3.0,
                    help="per-surface HTTP timeout in seconds")
+    p.add_argument("--resample", type=float, default=0.0,
+                   help="seconds between two /metrics samples per "
+                        "component (0 disables); arms rate-shaped "
+                        "findings like LEASE_FLAPPING to distinguish "
+                        "ongoing churn from lifetime totals")
     p.add_argument("--fail-on", default="never",
                    choices=["never", "critical", "warning"],
                    help="exit nonzero when findings at/above this "
@@ -84,7 +89,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         clients = make_clients(args)
 
     bundle = doctor.collect(endpoints, state_dirs=state_dirs,
-                            clients=clients, timeout=args.timeout)
+                            clients=clients, timeout=args.timeout,
+                            resample_after=args.resample)
     findings = doctor.run_findings(bundle)
     out_path = args.output or f"tpu-dra-doctor-{int(time.time())}.tar.gz"
     doctor.write_bundle(bundle, findings, out_path)

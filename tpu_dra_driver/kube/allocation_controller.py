@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.kube import catalog as catalog_mod
+from tpu_dra_driver.kube import reservations as reservations_mod
 from tpu_dra_driver.kube import sharding
 from tpu_dra_driver.kube.allocator import Allocator
 from tpu_dra_driver.kube.catalog import DeviceCatalog, UsageLedger
@@ -39,7 +40,15 @@ from tpu_dra_driver.kube.events import (
     REASON_ALLOCATION_PARKED,
     EventRecorder,
 )
+from tpu_dra_driver.kube.fencing import StaleWriterError
 from tpu_dra_driver.kube.informer import Informer
+from tpu_dra_driver.kube.reservations import (
+    RESERVATION_NAMESPACE,
+    ReservationFencing,
+    ReservationGranter,
+    ReserveCoordinator,
+    RemoteCrossShardLedger,
+)
 from tpu_dra_driver.kube.sharding import (
     CrossShardLedger,
     ShardRing,
@@ -70,6 +79,22 @@ class AllocationControllerConfig:
     #: backstop interval for retrying parked (unsatisfiable) claims —
     #: slice events retry them immediately; this heals missed events
     retry_interval: float = 5.0
+    #: how long a cross-replica reserve waits for remote slot owners to
+    #: grant its DeviceReservation records before rolling back + parking
+    #: (kept below the hand-off fence's drain_inflight window: a reserve
+    #: awaiting a grant from a slot that is mid-hand-off must time out
+    #: and re-park before the fence gives up on draining the batch)
+    reserve_grant_timeout: float = 2.0
+    #: reap reservation records whose coordinator is provably gone
+    #: (home-slot epoch moved) — and, as a fencing-disabled backstop,
+    #: records older than this TTL
+    reserve_ttl: float = 60.0
+    #: how often an owner sweeps its slots' records for abandonment
+    reserve_reap_interval: float = 5.0
+    #: False restores the PR 6 behavior (cross-shard claims PARK unless
+    #: one process owns every involved slot) — the bench's baseline arm
+    #: and an operational escape hatch
+    remote_reserves: bool = True
 
 
 class ShardWiring:
@@ -99,10 +124,12 @@ class AllocationController:
 
     def __init__(self, clients: ClientSets,
                  config: Optional[AllocationControllerConfig] = None,
-                 shard: Optional[ShardWiring] = None):
+                 shard: Optional[ShardWiring] = None,
+                 identity: str = ""):
         self._clients = clients
         self._config = config or AllocationControllerConfig()
         self._shard = shard
+        self._identity = identity
         self.catalog = DeviceCatalog(
             clients.resource_slices,
             index_attributes=self._config.index_attributes)
@@ -122,6 +149,45 @@ class AllocationController:
             clients, self._config.driver_name,
             catalog=self.catalog, ledger=self.ledger,
             index_attributes=self._config.index_attributes)
+        # Split-brain hardening state (sharded only): the fencing epoch
+        # source (set_fencing), the cross-REPLICA reserve machinery —
+        # a complement "shadow" ledger accounting committed usage of
+        # pools this process does NOT own (disjoint from self.ledger by
+        # construction, so merged snapshots never double count), the
+        # DeviceReservation informer + coordinator (initiator side) +
+        # granter (owner side).
+        self._fencing = None
+        self._on_stale_writer: Optional[Callable[[str], None]] = None
+        self._demoting = False
+        self._shadow_ledger: Optional[UsageLedger] = None
+        self.reservation_informer: Optional[Informer] = None
+        self._reserve_coord: Optional[ReserveCoordinator] = None
+        self._granter: Optional[ReservationGranter] = None
+        self._pending_grants: Dict[str, None] = {}
+        #: record name -> monotonic retry time for grants whose
+        #: servicing hit a transient error (drained on worker wakes)
+        self._grant_retries: Dict[str, float] = {}
+        self._deleted_records: List[Dict] = []
+        self._reap_at = 0.0
+        if shard is not None:
+            self._shadow_ledger = UsageLedger(
+                self._config.driver_name, self.catalog.get_device,
+                pool_filter=(lambda pool: self._shard.ring.owner(pool)
+                             not in self._shard.owned))
+            self.reservation_informer = Informer(
+                clients.device_reservations)
+            self._reserve_coord = ReserveCoordinator(
+                clients.device_reservations, identity=identity,
+                store_get=(lambda name: self.reservation_informer.get(
+                    name, RESERVATION_NAMESPACE)))
+            self._granter = ReservationGranter(
+                clients.device_reservations, clients.resource_claims,
+                self.ledger, self.catalog.snapshot,
+                lambda: set(self._shard.owned),
+                self._config.driver_name,
+                leases=clients.leases,
+                reserve_ttl=self._config.reserve_ttl,
+                identity=identity)
         # Parked-claim visibility: an operator must be able to SEE an
         # unsatisfiable claim from the outside (`kubectl describe` + the
         # dra_allocator_parked_claims gauge), not just from this
@@ -129,7 +195,8 @@ class AllocationController:
         # parked claim, cleared (Event deleted, gauge decremented) when
         # the claim drains — allocated, deleted, or re-routed away.
         self.events = EventRecorder(clients.events,
-                                    component="allocation-controller")
+                                    component="allocation-controller",
+                                    host=identity)
         self._cond = threading.Condition()
         self._pending: Dict[_Key, None] = {}       # ordered dedupe
         self._parked: Dict[_Key, None] = {}
@@ -157,16 +224,42 @@ class AllocationController:
     def _own_ledger_for(self, slot: str):
         return self.ledger if slot in self._shard.owned else None
 
+    def set_fencing(self, fencing,
+                    on_stale_writer: Optional[Callable[[str], None]]
+                    = None) -> None:
+        """Arm epoch fencing (kube/fencing.py): every allocation-plane
+        write this controller makes is stamped with the involved slots'
+        held epochs; a rejected (stale) write triggers
+        :meth:`_demote` — ``on_stale_writer`` is the production hook
+        (``ShardLeaseManager.resign_all``: release leases, rejoin).
+        Wire before :meth:`start`."""
+        self._fencing = fencing
+        self._on_stale_writer = on_stale_writer
+        self.allocator.set_fencing(fencing)
+        if self._granter is not None:
+            self._granter.set_fencing(fencing)
+        self._cross_allocators.clear()
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         # ledger + queue feed from the same claim informer; handlers are
         # registered before start() so the initial ADDED replay seeds both
         self.ledger.attach(self.claim_informer)
+        if self._shadow_ledger is not None:
+            # the complement view rides the SAME informer: committed
+            # usage of non-owned pools, for cross-replica picks
+            self._shadow_ledger.attach(self.claim_informer)
         self.claim_informer.add_handlers(
             on_add=self._on_claim,
             on_update=lambda old, new: self._on_claim(new),
             on_delete=self._on_claim_deleted)
+        if self.reservation_informer is not None:
+            self.reservation_informer.add_handlers(
+                on_add=self._on_reservation,
+                on_update=lambda old, new: self._on_reservation(new),
+                on_delete=self._on_reservation_deleted)
+            self.reservation_informer.start()
         # fleet changes retry parked claims and refresh ledger counters
         # for devices whose definitions arrived late
         self.catalog.informer.add_handlers(
@@ -177,6 +270,8 @@ class AllocationController:
         self.claim_informer.start()
         self.catalog.wait_synced()
         self.claim_informer.wait_synced()
+        if self.reservation_informer is not None:
+            self.reservation_informer.wait_synced()
         self._publish_owned_pools()
         for i in range(max(1, self._config.workers)):
             t = threading.Thread(target=self._worker, daemon=True,
@@ -202,6 +297,8 @@ class AllocationController:
         for t in self._threads:
             t.join(timeout=2.0)
         self.claim_informer.stop()
+        if self.reservation_informer is not None:
+            self.reservation_informer.stop()
         self.catalog.stop()
         # release this controller's share of the process-global parked
         # gauge (the claims are still parked cluster-wide — their Events
@@ -250,6 +347,13 @@ class AllocationController:
             self.ledger.set_pool_filter(
                 lambda pool:
                 self._shard.ring.owner(pool) in self._shard.owned)
+            if self._shadow_ledger is not None:
+                # the complement re-derives under the SAME pause, so no
+                # merged snapshot can see a pool in neither (or both)
+                # ledgers mid-flip
+                self._shadow_ledger.set_pool_filter(
+                    lambda pool:
+                    self._shard.ring.owner(pool) not in self._shard.owned)
         self._publish_owned_pools()
         if self.claim_informer.synced:
             self._rescan_claims()
@@ -393,6 +497,120 @@ class AllocationController:
         if self.claim_informer.synced:
             self._rescan_claims()
 
+    # -- cross-replica reservation records ---------------------------------
+
+    def _on_reservation(self, obj: Dict) -> None:
+        """Reservation informer event: wake any coordinator waiter, and
+        queue Requested records for OUR slots onto the workers (the
+        grant decision writes to the API — never on a dispatch thread)."""
+        self._reserve_coord.note_event(obj)
+        spec = obj.get("spec") or {}
+        phase = (obj.get("status") or {}).get("phase",
+                                              reservations_mod.PHASE_REQUESTED)
+        if phase == reservations_mod.PHASE_REQUESTED \
+                and spec.get("slot", "") in self._shard.owned:
+            name = (obj.get("metadata") or {}).get("name", "")
+            with self._cond:
+                self._pending_grants[name] = None
+                self._cond.notify_all()
+
+    def _on_reservation_deleted(self, obj: Dict) -> None:
+        self._reserve_coord.note_event(obj)
+        spec = obj.get("spec") or {}
+        if spec.get("slot", "") in self._shard.owned:
+            with self._cond:
+                self._deleted_records.append(obj)
+                self._cond.notify_all()
+
+    def _service_grants(self) -> None:
+        """Resolve queued Requested records for our slots (also runs as
+        the coordinator's pump while OUR reserves await remote grants,
+        so two replicas waiting on each other's grants cannot starve).
+        StaleWriterError propagates (demotion); ANY other error is
+        counted and the record deferred to the retry backstop — a
+        transient API flap must never kill a worker thread."""
+        if self._granter is None:
+            return
+        import time as _time
+        with self._cond:
+            names = list(self._pending_grants)
+            self._pending_grants.clear()
+            now = _time.monotonic()
+            due = [n for n, at in self._grant_retries.items() if at <= now]
+            for n in due:
+                del self._grant_retries[n]
+            names.extend(n for n in due if n not in names)
+        for name in names:
+            try:
+                self._granter.process(name)
+            except StaleWriterError:
+                raise
+            except Exception:  # chaos-ok: counted; deferred retry below
+                SWALLOWED_ERRORS.labels("reserve.grant_service").inc()
+                log.exception("grant servicing of %s failed; deferring",
+                              name)
+                if self.reservation_informer.get(
+                        name, RESERVATION_NAMESPACE) is not None:
+                    with self._cond:
+                        self._grant_retries[name] = \
+                            _time.monotonic() + 1.0
+
+    def _service_reservations(self) -> None:
+        """Worker-side reservation housekeeping: grants, deferred
+        record-deletion resolution, and the periodic abandonment reap."""
+        if self._granter is None:
+            return
+        try:
+            self._service_grants()
+            with self._cond:
+                deleted = self._deleted_records[:]
+                self._deleted_records.clear()
+            for obj in deleted:
+                try:
+                    self._granter.record_deleted(obj)
+                except Exception:  # chaos-ok: counted; the epoch/TTL
+                    # reaper heals a missed release — never a dead worker
+                    SWALLOWED_ERRORS.labels("reserve.record_deleted").inc()
+                    log.exception("record-deletion handling failed")
+            import time as _time
+            now = _time.monotonic()
+            if now >= self._reap_at:
+                self._reap_at = now + self._config.reserve_reap_interval
+                try:
+                    self._granter.reap_stale(
+                        self.reservation_informer.list())
+                except Exception:  # chaos-ok: counted; next sweep retries
+                    SWALLOWED_ERRORS.labels("reserve.reap").inc()
+                    log.exception("reservation reap sweep failed")
+        except StaleWriterError as e:
+            self._demote(str(e))
+
+    # -- stale-writer demotion ---------------------------------------------
+
+    def _demote(self, reason: str) -> None:
+        """A fencing rejection proved this process wrote under a lease
+        tenure that ended: drop every owned slot, clear caches, and
+        rejoin through the lease manager (``on_stale_writer`` —
+        production wires ``ShardLeaseManager.resign_all``). Idempotent
+        per incident; queued claims re-route to the real owners."""
+        with self._cond:
+            if self._demoting:
+                return
+            self._demoting = True
+        try:
+            log.warning("FENCED OUT (%s): demoting — dropping owned "
+                        "slots %s, clearing caches, rejoining",
+                        reason,
+                        sorted(self._shard.owned)
+                        if self._shard is not None else [])
+            if self._on_stale_writer is not None:
+                self._on_stale_writer(reason)
+            elif self._shard is not None:
+                self.set_owned_slots(set())
+        finally:
+            with self._cond:
+                self._demoting = False
+
     def _requeue_parked(self) -> None:
         with self._cond:
             if not self._parked:
@@ -411,7 +629,9 @@ class AllocationController:
         worker loop can run its coalesced rescan."""
         with self._cond:
             while not self._pending and not self._stop.is_set() \
-                    and not self._routes_dirty:
+                    and not self._routes_dirty \
+                    and not self._pending_grants \
+                    and not self._deleted_records:
                 timed_out = not self._cond.wait(
                     timeout=self._config.retry_interval)
                 if timed_out and self._parked:
@@ -433,6 +653,7 @@ class AllocationController:
     def _worker(self) -> None:
         while not self._stop.is_set():
             self._maybe_rescan()
+            self._service_reservations()
             keys = self._take_batch()
             if not keys:
                 continue
@@ -467,6 +688,19 @@ class AllocationController:
             return
         try:
             results = self.allocator.allocate_batch(claims)
+        except StaleWriterError as e:
+            # a commit was REJECTED by epoch fencing: this process's
+            # tenure over some slot ended without it noticing (pause,
+            # partition, clock trouble). Re-park the batch (the real
+            # owners re-route it) and demote wholesale.
+            with self._cond:
+                for claim in claims:
+                    meta = claim["metadata"]
+                    self._mark_parked_locked(
+                        (meta.get("namespace", ""), meta["name"]),
+                        claim, f"fenced out: {e}")
+            self._demote(str(e))
+            return
         except Exception:  # chaos-ok: counted; claims re-park for retry
             SWALLOWED_ERRORS.labels("allocation_controller.batch").inc()
             log.exception("allocation batch of %d failed wholesale",
@@ -495,9 +729,14 @@ class AllocationController:
 
     def _cross_allocator(self, route: ShardRoute) -> Optional[Allocator]:
         """An allocator whose ledger is the two-phase merged view over
-        the route's slots. None when some involved slot's ledger is not
-        reachable in this process (its owner is another replica) — the
-        claim parks and retries after the next hand-off or fleet change."""
+        the route's slots. When every involved slot's ledger is
+        reachable in this process, that is the in-process
+        :class:`CrossShardLedger` (unchanged); otherwise the
+        cross-REPLICA lane: local slots through our ledgers, remote
+        slots through epoch-fenced API reservation records
+        (kube/reservations.py). None only when the machinery is absent
+        (no coordinator) or we own nothing involved — the claim then
+        parks and retries after the next hand-off or fleet change."""
         cached = self._cross_allocators.get(route.slots)
         if cached is not None:
             return cached
@@ -505,14 +744,67 @@ class AllocationController:
         for slot in route.slots:
             led = self._shard.ledger_for(slot)
             if led is None:
-                return None
+                return self._remote_cross_allocator(route)
             ledgers[slot] = led
         xledger = CrossShardLedger(ledgers,
                                    owner_of_pool=self._shard.ring.owner)
         alloc = Allocator(self._clients, self._config.driver_name,
                           catalog=self.catalog, ledger=xledger,
-                          index_attributes=self._config.index_attributes)
+                          index_attributes=self._config.index_attributes,
+                          fencing=self._fencing)
         self._cross_allocators[route.slots] = alloc
+        return alloc
+
+    def _remote_cross_allocator(self, route: ShardRoute
+                                ) -> Optional[Allocator]:
+        """The multi-replica lane: some involved slot is owned by
+        ANOTHER process. Requires at least our own slots' ledgers (the
+        route homes the claim on an owner, so normally ours is among
+        them) and the reservation coordinator."""
+        if self._reserve_coord is None or self._shadow_ledger is None \
+                or not self._config.remote_reserves:
+            return None
+        # keyed on the HOME too: two claims can share route.slots with
+        # different rendezvous homes (and this controller may drain
+        # both when it owns several involved slots) — the ledger bakes
+        # route.home into its records' homeSlot/homeEpoch, so a
+        # slots-only key would stamp the wrong coordinator identity
+        cache_key = ("remote", route.home, route.slots)
+        cached = self._cross_allocators.get(cache_key)
+        if cached is not None:
+            return cached
+        local = {}
+        for slot in route.slots:
+            led = self._shard.ledger_for(slot)
+            if led is not None:
+                local[slot] = led
+        if not local:
+            return None
+        def home_epoch(tokens=self._fencing, slot=route.home):
+            if tokens is None:
+                return None
+            try:
+                return tokens.epoch_for(slot)
+            except StaleWriterError:
+                return None     # record falls back to TTL reaping
+
+        fencing = None
+        xledger = RemoteCrossShardLedger(
+            route, self._shard.ring, local, self._shadow_ledger,
+            self._reserve_coord, home_epoch,
+            grant_timeout=self._config.reserve_grant_timeout)
+        # while our reserve awaits remote grants, keep serving THEIR
+        # grant requests (mutual cross-claims must not starve)
+        xledger.pump = self._service_grants
+        if self._fencing is not None:
+            fencing = ReservationFencing(
+                self._fencing, set(local), self._shard.ring,
+                xledger.granted_epochs)
+        alloc = Allocator(self._clients, self._config.driver_name,
+                          catalog=self.catalog, ledger=xledger,
+                          index_attributes=self._config.index_attributes,
+                          fencing=fencing)
+        self._cross_allocators[cache_key] = alloc
         return alloc
 
     def _run_cross_shard(self,
@@ -536,8 +828,19 @@ class AllocationController:
                         f"owned in-process")
                     self._cross_routes[key] = route
                 continue
+            if self._reserve_coord is not None:
+                # the remote lane's reserve() only sees (uid, entries);
+                # records need the claim's identity + route
+                self._reserve_coord.register_claim(claim, route)
             try:
                 results = alloc.allocate_batch([claim])
+            except StaleWriterError as e:
+                with self._cond:
+                    self._mark_parked_locked(key, claim,
+                                             f"fenced out: {e}")
+                    self._cross_routes[key] = route
+                self._demote(str(e))
+                return
             except Exception:  # chaos-ok: counted; claim re-parks for retry
                 SWALLOWED_ERRORS.labels(
                     "allocation_controller.cross_shard").inc()
@@ -548,6 +851,9 @@ class AllocationController:
                         key, claim, "cross-shard allocation failed; retrying")
                     self._cross_routes[key] = route
                 continue
+            finally:
+                if self._reserve_coord is not None:
+                    self._reserve_coord.unregister_claim(meta["uid"])
             self._settle_results([claim], results)
             res = results.get(meta["uid"])
             if res is not None and res.error is not None:
@@ -585,6 +891,15 @@ class AllocationController:
             out["sharded"] = True
             out["owned_slots"] = sorted(self._shard.owned)
             out["ring_slots"] = list(self._shard.ring.members)
+            out["fencing"] = self._fencing is not None
+            if self._fencing is not None:
+                epochs = {}
+                for slot in sorted(self._shard.owned):
+                    try:
+                        epochs[slot] = self._fencing.epoch_for(slot)
+                    except StaleWriterError:
+                        epochs[slot] = None
+                out["held_epochs"] = epochs
         else:
             out["sharded"] = False
         return out
@@ -637,7 +952,7 @@ class ShardGroup:
             wiring = ShardWiring(self.ring, owned={slot},
                                  ledger_for=self._ledger_for)
             self.controllers[slot] = AllocationController(
-                clients, config, shard=wiring)
+                clients, config, shard=wiring, identity=f"group-{slot}")
 
     def _ledger_for(self, slot: str):
         for ctrl in self.controllers.values():

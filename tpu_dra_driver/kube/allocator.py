@@ -69,8 +69,10 @@ from tpu_dra_driver.kube.catalog import (
     claim_allocated_keys,
     device_counter_consumption,
 )
+from tpu_dra_driver.kube import fencing as fencing_mod
 from tpu_dra_driver.kube.client import ClientSets
-from tpu_dra_driver.kube.errors import ConflictError, NotFoundError
+from tpu_dra_driver.kube.errors import ConflictError, NotFoundError, StaleEpochError
+from tpu_dra_driver.kube.fencing import StaleWriterError
 from tpu_dra_driver.kube.events import (
     REASON_ALLOCATED,
     REASON_ALLOCATION_FAILED,
@@ -85,6 +87,7 @@ from tpu_dra_driver.pkg.metrics import (
     ALLOCATOR_CANDIDATES_SCANNED,
     ALLOCATOR_COMMIT_CONFLICTS,
     ALLOCATOR_INDEX_HITS,
+    FENCING_REJECTIONS,
 )
 
 fi.register("allocator.commit-conflict",
@@ -92,6 +95,13 @@ fi.register("allocator.commit-conflict",
             "ConflictError models a concurrent writer bumping the "
             "claim's resourceVersion; the allocator must verify and "
             "retry exactly once)")
+fi.register("allocator.pre-commit",
+            "between pick and the allocation status write (payload: the "
+            "claim's uid). A pause rule stalls the committing worker "
+            "mid-batch — the split-brain drills park a shard holder "
+            "here past lease expiry, let a survivor adopt its slot and "
+            "commit, then resume: the stale commit must be rejected by "
+            "epoch fencing, never land")
 
 
 class AllocationError(RuntimeError):
@@ -247,18 +257,30 @@ class Allocator:
                  ledger: Optional[UsageLedger] = None,
                  use_index: bool = True,
                  index_attributes: Iterable[str]
-                 = catalog_mod.DEFAULT_INDEX_ATTRIBUTES):
+                 = catalog_mod.DEFAULT_INDEX_ATTRIBUTES,
+                 fencing=None):
         self._clients = clients
         self._driver = driver_name
         self._catalog = catalog
         self._ledger = ledger
         self._use_index = use_index
         self._index_attributes = tuple(index_attributes)
+        # Epoch source for fenced commits (kube/fencing.py): when set,
+        # every allocation write is stamped with the involved slots'
+        # held epochs, and a rejection (stale tenure) surfaces as
+        # StaleWriterError PAST the per-claim isolation — the caller
+        # must demote, not retry.
+        self._fencing = fencing
         # Allocated/AllocationFailed land on the claim so `kubectl
         # describe resourceclaim` finally shows the scheduler role's
         # verdict (deduped + rate-limited; see kube/events.py)
         self._recorder = EventRecorder(clients.events,
                                        component="allocation-controller")
+
+    def set_fencing(self, fencing) -> None:
+        """Arm (or swap) the epoch source — the controller wires this
+        after its lease manager exists (they reference each other)."""
+        self._fencing = fencing
 
     # ------------------------------------------------------------------
     # snapshots
@@ -341,6 +363,12 @@ class Allocator:
                         claim, snap, state, node_name)
                     out[uid] = AllocationResult(claim=updated,
                                                 committed=committed)
+                except StaleWriterError:
+                    # fenced out: NOT a per-claim error — this process's
+                    # lease tenure ended and everything it believes is
+                    # suspect; the controller must demote wholesale
+                    root.end(status="error")
+                    raise
                 except AllocationError as e:
                     out[uid] = AllocationResult(error=str(e))
                 except Exception as e:  # chaos-ok: per-claim isolation, surfaced in the result
@@ -549,6 +577,7 @@ class Allocator:
         concurrent winner's allocation was adopted instead of ours."""
         name = claim["metadata"]["name"]
         namespace = claim["metadata"].get("namespace", "")
+        uid = claim["metadata"]["uid"]
         obj = copy.deepcopy(claim)
         obj.setdefault("status", {})["allocation"] = \
             self._build_allocation(claim, results)
@@ -559,9 +588,22 @@ class Allocator:
         # while a span is actually recording — tracing disabled leaves
         # the object byte-identical to before.
         tracing.annotate(obj, trace_ctx)
+        epochs = None
+        if self._fencing is not None:
+            try:
+                epochs = self._fencing.epochs(
+                    uid, {r["pool"] for r in results})
+            except StaleWriterError as e:
+                # refusing to WRITE is not a fenced-out write: the slot
+                # was lost through the normal hand-off machinery and
+                # local state already knows — park the claim, it
+                # re-routes on the next pass
+                raise AllocationError(f"fencing: {e}") from e
+            fencing_mod.stamp(obj, epochs)
         try:
             fi.fire("allocator.commit-conflict")
-            updated = self._clients.resource_claims.update(obj)
+            fi.fire("allocator.pre-commit", payload=uid)
+            updated = self._fenced_update(obj, epochs)
         except ConflictError:
             ALLOCATOR_COMMIT_CONFLICTS.inc()
             # rides the allocator.commit span so the critical-path
@@ -586,9 +628,11 @@ class Allocator:
             fresh.setdefault("status", {})["allocation"] = \
                 self._build_allocation(fresh, results)
             tracing.annotate(fresh, trace_ctx)
+            fencing_mod.stamp(fresh, epochs)
             try:
                 fi.fire("allocator.commit-conflict")
-                updated = self._clients.resource_claims.update(fresh)
+                fi.fire("allocator.pre-commit", payload=uid)
+                updated = self._fenced_update(fresh, epochs)
             except ConflictError as e:
                 raise AllocationError(
                     f"allocation commit conflicted twice for "
@@ -597,6 +641,25 @@ class Allocator:
             # the reservation graduates into the claim's ledger entry
             self._ledger.observe_claim(updated)
         return updated, True
+
+    def _fenced_update(self, obj: Dict, epochs) -> Dict:
+        """One claim status write under fencing: the client-side epoch
+        re-read runs first (REST clusters, where no admission hook
+        exists), then the write — a :class:`StaleEpochError` from the
+        fake's admission hook means a survivor bumped the slot epoch
+        after our re-read or belief: count it and escalate to
+        :class:`StaleWriterError` so the controller demotes."""
+        if epochs:
+            try:
+                self._fencing.verify(epochs)
+            except StaleWriterError:
+                FENCING_REJECTIONS.labels("allocator.verify").inc()
+                raise
+        try:
+            return self._clients.resource_claims.update(obj)
+        except StaleEpochError as e:
+            FENCING_REJECTIONS.labels("allocator.commit").inc()
+            raise StaleWriterError(str(e)) from e
 
     def _devices_still_free(self, fresh_claim: Dict,
                             results: List[Dict]) -> bool:

@@ -666,11 +666,18 @@ class UsageLedger:
     # -- allocation-side reservations -------------------------------------
 
     def reserve(self, uid: str, entries: List[DeviceEntry],
-                caps: Dict[CounterKey, int]) -> bool:
+                caps: Dict[CounterKey, int],
+                extend: bool = False) -> bool:
         """Atomically reserve devices an allocation worker picked, IF
         they are all still free and their counters still fit under
         ``caps`` given current usage + other reservations. False means
-        the worker raced another claim and must re-pick."""
+        the worker raced another claim and must re-pick.
+
+        ``extend=True`` widens an existing same-uid reservation instead
+        of refusing it — the reservation granter's case: a cross-replica
+        claim spanning two slots of ONE owner arrives as two records,
+        and the second must join the first (the new keys are still
+        checked free/fitting; any other caller keeps the refusal)."""
         if self._pool_filter is not None and any(
                 not self._pool_filter(e.pool) for e in entries):
             # not this ledger's pool: reservations must serialize through
@@ -685,6 +692,8 @@ class UsageLedger:
                 # mid-hand-off re-derive: _taken is incomplete for the
                 # acquired pools — fail safe, the claim re-parks
                 return False
+            if extend and uid in self._reserved:
+                return self._extend_reservation_locked(uid, entries, caps)
             if uid in self._reserved:
                 # a CONCURRENT allocation attempt for this claim already
                 # holds a reservation (two controllers can briefly both
@@ -713,10 +722,77 @@ class UsageLedger:
             self._apply_locked(rec, +1)
             return True
 
+    def _extend_reservation_locked(self, uid: str,
+                                   entries: List[DeviceEntry],
+                                   caps: Dict[CounterKey, int]) -> bool:
+        """Widen uid's existing reservation by ``entries`` (idempotent
+        for keys it already holds — counters counted for genuinely new
+        keys only). Call with _mu held."""
+        rec = self._reserved[uid]
+        new_entries = [e for e in entries
+                       if self._reserved_keys.get(e.key) != uid]
+        if not new_entries:
+            return True
+        new_keys = tuple(e.key for e in new_entries)
+        for key in new_keys:
+            if self._taken.get(key) or key in self._reserved_keys:
+                return False
+        new_counters = sum_counter_consumption(
+            (e.device, e.pool) for e in new_entries)
+        for ck, amount in new_counters.items():
+            cap = caps.get(ck)
+            if cap is None or self._usage.get(ck, 0) + amount > cap:
+                return False
+        self._apply_locked(rec, -1)
+        rec.keys = rec.keys + new_keys
+        rec.all_keys = rec.keys
+        for ck, amount in new_counters.items():
+            rec.counters[ck] = rec.counters.get(ck, 0) + amount
+        for key in new_keys:
+            self._reserved_keys[key] = uid
+        self._apply_locked(rec, +1)
+        return True
+
     def release(self, uid: str) -> None:
         """Drop an in-flight reservation (commit failed or abandoned)."""
         with self._mu:
             self._release_locked(uid)
+
+    def shrink_reservation(self, uid: str,
+                           entries: List[DeviceEntry]) -> None:
+        """Remove ONLY ``entries``' keys from uid's reservation (the
+        reverse of an ``extend``): the granter's per-record rollback —
+        a failed grant for one record of a two-slot claim must not free
+        the keys a previously-GRANTED record still holds. Dropping the
+        last key releases the whole reservation."""
+        with self._mu:
+            rec = self._reserved.get(uid)
+            if rec is None:
+                return
+            held = set(rec.keys)
+            removed = [e for e in entries if e.key in held]
+            if not removed:
+                return
+            drop = {e.key for e in removed}
+            keep = tuple(k for k in rec.keys if k not in drop)
+            if not keep:
+                self._release_locked(uid)
+                return
+            removed_counters = sum_counter_consumption(
+                (e.device, e.pool) for e in removed)
+            self._apply_locked(rec, -1)
+            rec.keys = keep
+            rec.all_keys = keep
+            for ck, amount in removed_counters.items():
+                left = rec.counters.get(ck, 0) - amount
+                if left > 0:
+                    rec.counters[ck] = left
+                else:
+                    rec.counters.pop(ck, None)
+            for key in drop:
+                if self._reserved_keys.get(key) == uid:
+                    del self._reserved_keys[key]
+            self._apply_locked(rec, +1)
 
     # -- reads -------------------------------------------------------------
 

@@ -32,6 +32,11 @@ RESOURCE_CLAIM_TEMPLATES = "resourceclaimtemplates"
 DEVICE_CLASSES = "deviceclasses"
 COMPUTE_DOMAINS = "computedomains"
 COMPUTE_DOMAIN_CLIQUES = "computedomaincliques"
+# Cross-replica phase-1 reservation records for the epoch-fenced
+# two-phase reserve (kube/reservations.py): a replica reserving devices
+# on a shard slot ANOTHER replica owns writes one of these and waits
+# for the owner to grant it.
+DEVICE_RESERVATIONS = "devicereservations"
 
 # Sentinel a retry_update mutate callback returns to skip the write.
 ABORT = object()
@@ -130,3 +135,5 @@ class ClientSets:
     def compute_domains(self) -> ResourceClient: return self[COMPUTE_DOMAINS]
     @property
     def compute_domain_cliques(self) -> ResourceClient: return self[COMPUTE_DOMAIN_CLIQUES]
+    @property
+    def device_reservations(self) -> ResourceClient: return self[DEVICE_RESERVATIONS]

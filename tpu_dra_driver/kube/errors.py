@@ -23,6 +23,24 @@ class InvalidError(ApiError):
     code = 422
 
 
+class StaleEpochError(ApiError):
+    """A fenced write carried a lease epoch behind the slot's current
+    one (HTTP 412 Precondition Failed analog).
+
+    Raised by the fake API server's fencing admission hook
+    (:func:`tpu_dra_driver.kube.fencing.install_admission`) when an
+    allocation-plane write is stamped with a
+    ``resource.tpu.google.com/fencing-epochs`` annotation whose epoch
+    for some shard slot is lower than that slot's current Lease
+    ``leaseTransitions`` — the writer lost the lease (GC pause,
+    partition, clock skew) and a survivor has since adopted the slot.
+    Deliberately NOT a :class:`ConflictError`: optimistic-concurrency
+    retry loops must not re-drive a stale writer's commit; the writer
+    must demote instead."""
+
+    code = 412
+
+
 class GoneError(ApiError):
     """Watch resourceVersion too old (HTTP 410 / reason Expired).
 

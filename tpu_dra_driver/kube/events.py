@@ -340,12 +340,22 @@ class EventRecorder:
 
     def _clear_emitted(self, ref: Dict, reason: str) -> None:
         """Worker side of :meth:`clear`: delete matching Event objects and
-        forget their dedupe entries so a re-park emits fresh."""
+        forget their dedupe entries so a re-park emits fresh.
+
+        Scoped to THIS recorder's ``reportingInstance``: in a
+        multi-replica control plane two controllers can independently
+        track one claim's parked state (e.g. a re-route mid-park, or a
+        demoted stale holder clearing its queues while the survivor
+        still parks the claim) — deleting a RIVAL's Event would blind
+        operators to a condition that very much still exists."""
         namespace = ref.get("namespace") or "default"
         obj_key = ref.get("uid") or f"{namespace}/{ref.get('name', '')}"
+        instance = self._host or self._component
         removed = 0
         for ev in self._events.list(namespace=namespace):
             if ev.get("reason") != reason:
+                continue
+            if ev.get("reportingInstance", instance) != instance:
                 continue
             inv = ev.get("involvedObject") or {}
             match = (inv.get("uid") == ref["uid"] if ref.get("uid")

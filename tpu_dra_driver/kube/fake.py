@@ -172,6 +172,15 @@ class FakeCluster:
     def __init__(self, journal_limit: Optional[int] = None):
         self._mu = threading.RLock()
         self._rv = 0
+        # resource -> [hook(old_or_None, new)]: admission webhooks. A
+        # hook that raises REJECTS the write (nothing is stored, no
+        # event fires) — the fencing admission
+        # (kube/fencing.py install_admission) rejects stale-epoch
+        # allocation commits apiserver-side, exactly where a real
+        # ValidatingAdmissionPolicy would. Hooks run under the cluster
+        # lock (reads back into the cluster are fine — RLock) and must
+        # not mutate either object.
+        self._admission: Dict[str, List[Callable]] = {}
         # resource -> {(ns, name) -> obj}
         self._tables: Dict[str, Dict[Tuple[str, str], Object]] = {}
         # resource -> [subs]
@@ -191,6 +200,19 @@ class FakeCluster:
 
     def _table(self, resource: str) -> Dict[Tuple[str, str], Object]:
         return self._tables.setdefault(resource, {})
+
+    def add_admission_hook(self, resource: str,
+                           hook: Callable[[Optional[Object], Object], None]
+                           ) -> None:
+        """Install an admission hook on ``resource`` writes; raising
+        rejects the write before it lands."""
+        with self._mu:
+            self._admission.setdefault(resource, []).append(hook)
+
+    def _admit(self, resource: str, old: Optional[Object],
+               new: Object) -> None:
+        for hook in self._admission.get(resource, []):
+            hook(old, new)
 
     def _next_rv(self) -> str:
         self._rv += 1
@@ -227,6 +249,7 @@ class FakeCluster:
             table = self._table(resource)
             if k in table:
                 raise AlreadyExistsError(f"{resource} {ns}/{name} already exists")
+            self._admit(resource, None, obj)
             meta.setdefault("uid", str(uuidlib.uuid4()))
             meta.setdefault("creationTimestamp", time.time())
             meta["resourceVersion"] = self._next_rv()
@@ -282,6 +305,11 @@ class FakeCluster:
             cur = table.get(k)
             if cur is None:
                 raise NotFoundError(f"{resource} {ns}/{name} not found")
+            # admission runs BEFORE the optimistic-concurrency check:
+            # a fenced-out writer is reported as fenced (StaleEpochError)
+            # even when its resourceVersion also happens to conflict —
+            # the staleness verdict must be deterministic, not racy
+            self._admit(resource, cur, obj)
             cur_meta = cur["metadata"]
             supplied_rv = meta.get("resourceVersion")
             if supplied_rv and supplied_rv != cur_meta["resourceVersion"]:

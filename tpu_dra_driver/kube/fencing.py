@@ -1,0 +1,235 @@
+"""Fencing tokens for allocation-plane writes (split-brain hardening).
+
+Lease ownership alone is *advisory*: a GC-paused or partitioned shard
+holder that wakes after its lease expired can still reach the API server
+and commit allocations for a slot a survivor has already adopted — the
+classic fencing problem (Kleppmann's "how to do distributed locking",
+and exactly the ambiguity the reference driver's ComputeDomain/IMEX
+orchestration must survive across node partitions). This module makes
+ownership *enforceable*:
+
+- every shard-slot Lease carries a monotonically increasing
+  ``leaseTransitions`` epoch, bumped on every ownership change
+  (kube/leaderelection.py);
+- every allocation-plane write — allocation commits, cross-shard
+  phase-1 reservation requests and grants — is stamped with the epochs
+  of the involved slots (the ``resource.tpu.google.com/fencing-epochs``
+  annotation);
+- a write whose stamped epoch is behind a slot's current epoch is
+  REJECTED: apiserver-side in the fake via an admission hook
+  (:func:`install_admission`), client-side for REST via a pre-commit
+  epoch re-read (:meth:`FencingTokens.verify` with
+  ``verify_reads=True`` — a real API server has no fencing admission,
+  so the re-read narrows the race window to one RTT, which the
+  per-device reservation serialization then closes);
+- every rejection ticks ``dra_fencing_rejections_total{site}`` and the
+  stale writer demotes itself (drops owned slots, clears caches,
+  rejoins through the lease manager — see
+  AllocationController._demote).
+
+Annotation wire format: ``slot=epoch`` pairs, comma-separated, sorted
+by slot (``shard-0=3,shard-2=7``) — human-readable in `kubectl get -o
+yaml` and trivially diffable across writes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Iterable, List, Optional
+
+from tpu_dra_driver.kube.errors import NotFoundError, StaleEpochError
+
+log = logging.getLogger(__name__)
+
+#: Stamped on every fenced allocation-plane write.
+FENCING_ANNOTATION = "resource.tpu.google.com/fencing-epochs"
+
+
+class StaleWriterError(RuntimeError):
+    """This process wrote (or was about to write) under a lease epoch
+    that is no longer current — it has been fenced out and MUST demote:
+    its beliefs about slot ownership, its caches, and its in-flight
+    picks are all suspect. Raised past the per-claim error isolation in
+    the allocator so the controller sees it wholesale."""
+
+
+def format_epochs(epochs: Dict[str, int]) -> str:
+    return ",".join(f"{slot}={epochs[slot]}" for slot in sorted(epochs))
+
+
+def parse_epochs(text: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for pair in filter(None, (p.strip() for p in (text or "").split(","))):
+        slot, _, epoch = pair.partition("=")
+        try:
+            out[slot] = int(epoch)
+        except ValueError:
+            # a mangled stamp fails CLOSED at the admission hook (an
+            # unparseable epoch is treated as epoch below everything)
+            out[slot] = -1
+    return out
+
+
+def stamp(obj: Dict, epochs: Optional[Dict[str, int]]) -> None:
+    """Stamp ``epochs`` onto ``obj``'s fencing annotation (no-op when
+    fencing is not armed, leaving the object byte-identical)."""
+    if not epochs:
+        return
+    obj.setdefault("metadata", {}).setdefault("annotations", {})[
+        FENCING_ANNOTATION] = format_epochs(epochs)
+
+
+def stamped_epochs(obj: Dict) -> Dict[str, int]:
+    ann = ((obj.get("metadata") or {}).get("annotations") or {})
+    return parse_epochs(ann.get(FENCING_ANNOTATION, ""))
+
+
+def lease_name(lease_prefix: str, slot: str) -> str:
+    """The Lease a slot's epoch lives on (ShardLeaseManager naming)."""
+    return f"{lease_prefix}-{slot}"
+
+
+def current_epoch(leases, lease_prefix: str, namespace: str,
+                  slot: str) -> Optional[int]:
+    """The slot's CURRENT fencing epoch from its Lease, or None when the
+    Lease does not exist — the single definition every enforcement site
+    (client-side verify, admission hook, abandonment reaper, scenario
+    invariants) reads through, so the field/naming can never drift
+    between them."""
+    try:
+        lease = leases.get(lease_name(lease_prefix, slot), namespace)
+    except NotFoundError:
+        return None
+    return int((lease.get("spec") or {}).get("leaseTransitions", 0) or 0)
+
+
+class FencingTokens:
+    """The epoch source a writer stamps allocation-plane writes with.
+
+    ``epoch_of_slot`` reads this process's CURRENT held epoch for a
+    slot (``ShardLeaseManager.slot_epoch``, or a static dict's ``.get``
+    in drills); asking for a slot the process does not hold raises
+    :class:`StaleWriterError` — a writer that cannot prove tenure must
+    not write at all.
+
+    ``verify_reads=True`` arms the client-side pre-commit re-read for
+    REST-backed clusters: before each fenced write, every involved
+    slot's Lease is re-fetched and its ``leaseTransitions`` compared to
+    the stamped epoch. The fake cluster instead enforces apiserver-side
+    via :func:`install_admission` (strictly stronger: no window at
+    all), so the default is off there.
+    """
+
+    def __init__(self, ring,
+                 epoch_of_slot: Callable[[str], Optional[int]],
+                 leases=None,
+                 lease_prefix: str = "allocation-controller",
+                 namespace: str = "tpu-dra-driver",
+                 verify_reads: bool = False):
+        self.ring = ring
+        self._epoch_of_slot = epoch_of_slot
+        self._leases = leases
+        self.lease_prefix = lease_prefix
+        self.namespace = namespace
+        self._verify_reads = verify_reads and leases is not None
+
+    def epoch_for(self, slot: str) -> int:
+        epoch = self._epoch_of_slot(slot)
+        if epoch is None:
+            raise StaleWriterError(
+                f"slot {slot} is not held by this process; refusing to "
+                f"write for its pools")
+        return epoch
+
+    def epochs_for(self, pools: Iterable[str]) -> Dict[str, int]:
+        """Held epochs for every slot owning one of ``pools``."""
+        return {slot: self.epoch_for(slot)
+                for slot in {self.ring.owner(p) for p in pools}}
+
+    def epochs(self, uid: str, pools: Iterable[str]) -> Dict[str, int]:
+        """The per-commit stamping hook the allocator calls (``uid`` is
+        unused here; the remote cross-shard lane's composite source
+        overlays granted epochs per claim — kube/reservations.py)."""
+        return self.epochs_for(pools)
+
+    def verify(self, epochs: Dict[str, int]) -> None:
+        """Client-side fencing for clusters without the admission hook:
+        re-read each involved Lease and fail if its epoch moved past
+        what we are about to stamp."""
+        if not self._verify_reads:
+            return
+        for slot, epoch in epochs.items():
+            current = current_epoch(self._leases, self.lease_prefix,
+                                    self.namespace, slot)
+            if current is not None and current > epoch:
+                raise StaleWriterError(
+                    f"slot {slot}: held epoch {epoch} is behind the "
+                    f"lease's current epoch {current} (ownership moved)")
+
+
+class AdmissionHandle:
+    """Returned by :func:`install_admission`; records every rejection
+    so drills can assert the exact stale writes that were refused."""
+
+    def __init__(self, lease_prefix: str, namespace: str):
+        self.lease_prefix = lease_prefix
+        self.namespace = namespace
+        #: [{"resource", "name", "slot", "stamped", "current"}] —
+        #: appended under the cluster lock, read by scenario invariants
+        self.rejections: List[Dict] = []
+
+
+def install_admission(cluster,
+                      lease_prefix: str = "allocation-controller",
+                      namespace: str = "tpu-dra-driver"
+                      ) -> AdmissionHandle:
+    """Install the fencing admission hook on a FakeCluster: any write to
+    ``resourceclaims`` or ``devicereservations`` carrying the fencing
+    annotation is checked against the involved slots' CURRENT Lease
+    epochs, and rejected with :class:`StaleEpochError` when behind.
+
+    Writes without the annotation pass untouched (unfenced writers —
+    single-replica deployments, tests — keep working); a missing Lease
+    passes too (nothing to fence against). The check runs inside the
+    cluster lock, so "current epoch" is exact, not racy — the fake is
+    deliberately STRICTER than a real API server so the drills prove
+    the protocol, not the window."""
+    from tpu_dra_driver.kube.client import DEVICE_RESERVATIONS, LEASES, RESOURCE_CLAIMS
+
+    handle = AdmissionHandle(lease_prefix, namespace)
+
+    class _ClusterLeases:
+        """The cluster-table read shaped like a ResourceClient (the
+        hook runs under the cluster lock; reads are reentrant)."""
+
+        @staticmethod
+        def get(name, ns):
+            return cluster.get(LEASES, name, ns)
+
+    def hook(old, new, resource):
+        epochs = stamped_epochs(new)
+        if not epochs:
+            return
+        for slot, stamped_epoch in epochs.items():
+            current = current_epoch(_ClusterLeases, lease_prefix,
+                                    namespace, slot)
+            if current is None:
+                continue
+            if current > stamped_epoch:
+                name = (new.get("metadata") or {}).get("name", "")
+                handle.rejections.append({
+                    "resource": resource, "name": name, "slot": slot,
+                    "stamped": stamped_epoch, "current": current})
+                log.warning(
+                    "fencing admission REJECTED %s %s: slot %s stamped "
+                    "epoch %d behind current %d",
+                    resource, name, slot, stamped_epoch, current)
+                raise StaleEpochError(
+                    f"{resource} {name}: fencing epoch {stamped_epoch} "
+                    f"for slot {slot} is behind the current epoch "
+                    f"{current} — the writer's lease tenure ended")
+
+    for resource in (RESOURCE_CLAIMS, DEVICE_RESERVATIONS):
+        cluster.add_admission_hook(
+            resource, lambda old, new, r=resource: hook(old, new, r))
+    return handle

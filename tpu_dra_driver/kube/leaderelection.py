@@ -1,20 +1,59 @@
-"""Lease-based leader election.
+"""Lease-based leader election with fencing epochs.
 
 Reference analog: cmd/compute-domain-controller/main.go:269-370 — optional
 leader election via client-go leaderelection (15s lease, 10s renew
 deadline, 2s retry period) so exactly one controller replica reconciles.
+
+Two hardening properties beyond the basic protocol (docs/chaos.md
+"Partitions & split-brain"):
+
+- **Observer-local expiry** (the client-go semantics): whether a rival's
+  lease has expired is decided by how long *this process* has observed
+  the current ``(holderIdentity, renewTime)`` pair unchanged — never by
+  comparing the holder-written ``renewTime`` against the local wall
+  clock. A holder whose clock runs minutes ahead used to look
+  perpetually fresh (nobody could adopt its dead lease); a holder whose
+  clock ran behind could be "expired" the instant it renewed. Both are
+  now impossible by construction: wall-clock values written by OTHER
+  processes never enter the expiry comparison.
+- **Fencing epochs**: the Lease carries ``leaseTransitions`` (the real
+  coordination.k8s.io field), bumped every time ownership changes
+  hands. The elector surfaces the epoch under which it currently holds
+  the lease (:attr:`LeaderElector.epoch`); allocation-plane writes are
+  stamped with it (kube/fencing.py) and a write behind the slot's
+  current epoch is rejected — so a GC-paused or partitioned ex-holder
+  that wakes after a survivor adopted its slot *cannot* commit, no
+  matter what it still believes about its leadership.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
-from tpu_dra_driver.pkg.metrics import LEADER_TRANSITIONS
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import LEADER_TRANSITIONS, LEASE_EPOCH, SWALLOWED_ERRORS
+
+log = logging.getLogger(__name__)
+
+fi.register("leaderelection.renew",
+            "one acquire-or-renew pass of a LeaderElector (payload: the "
+            "elector's identity). fail models a severed coordination "
+            "plane; a pause rule stalls the holder's renew loop — the "
+            "GC-pause half of the split-brain drills: the lease expires "
+            "under the stalled holder and a survivor adopts its slot "
+            "with a bumped fencing epoch")
+fi.register("leaderelection.clock",
+            "the wall-clock read feeding a renewTime write (payload: "
+            "the timestamp; corrupt-mutate shifts it). Skews what this "
+            "process WRITES — observer-local expiry means a skewed "
+            "holder can mislead nobody's expiry math, which the skew "
+            "regression tests pin")
 
 #: Event reasons for lease transitions (client-go's leaderelection
 #: resourcelock emits LeaderElection events the same way) — shard
@@ -39,20 +78,37 @@ class LeaderElector:
     Every transition ticks ``dra_leader_transitions_total`` and, when an
     event recorder is wired (:meth:`set_recorder`), lands a Kubernetes
     Event on the Lease object — so a shard hand-off is observable from
-    `kubectl describe lease` without reading any process's logs."""
+    `kubectl describe lease` without reading any process's logs.
+
+    Restartable: after :meth:`stop` (which releases the lease), a later
+    :meth:`start` rejoins the competition — a demoted stale writer
+    rejoins through exactly this path (ShardLeaseManager.resign_all).
+
+    ``clock`` injects the wall-clock source used for renewTime WRITES
+    (skew drills give one elector a lying clock); expiry never reads
+    it — see the module docstring."""
 
     def __init__(self, leases: ResourceClient, config: LeaderElectionConfig,
                  on_started_leading: Callable[[], None],
                  on_stopped_leading: Callable[[], None],
-                 recorder=None):
+                 recorder=None,
+                 clock: Callable[[], float] = time.time):
         self._leases = leases
         self._cfg = config
         self._on_start = on_started_leading
         self._on_stop = on_stopped_leading
         self._recorder = recorder
+        self._clock = clock
         self._stop = threading.Event()
         self._leading = False
         self._thread: Optional[threading.Thread] = None
+        #: leaseTransitions under which this process holds the lease —
+        #: the fencing token. Meaningful only while :attr:`is_leader`.
+        self._epoch = 0
+        # observer-local expiry state: the (holder, renewTime) pair we
+        # last saw and WHEN (local monotonic) we first saw it unchanged
+        self._observed_pair: Optional[Tuple[str, float]] = None
+        self._observed_at = 0.0
 
     def set_recorder(self, recorder) -> None:
         """Wire an :class:`~tpu_dra_driver.kube.events.EventRecorder`
@@ -63,8 +119,18 @@ class LeaderElector:
     def is_leader(self) -> bool:
         return self._leading
 
+    @property
+    def epoch(self) -> int:
+        """The fencing epoch (Lease ``leaseTransitions``) under which
+        this process currently holds the lease. Stamp it on every write
+        whose validity depends on holding the lease; valid only while
+        :attr:`is_leader`."""
+        return self._epoch
+
     def _transition(self, direction: str) -> None:
         LEADER_TRANSITIONS.labels(self._cfg.lease_name, direction).inc()
+        LEASE_EPOCH.labels(self._cfg.lease_name).set(
+            self._epoch if direction == "acquired" else 0)
         if self._recorder is None:
             return
         from tpu_dra_driver.kube.events import object_ref
@@ -73,7 +139,7 @@ class LeaderElector:
             self._recorder.normal(
                 ref, REASON_LEADER_ELECTED,
                 f"{self._cfg.identity or 'unknown'} became leader of "
-                f"{self._cfg.lease_name}")
+                f"{self._cfg.lease_name} (epoch {self._epoch})")
         else:
             self._recorder.warning(
                 ref, REASON_LEADER_LOST,
@@ -81,14 +147,22 @@ class LeaderElector:
                 f"{self._cfg.lease_name}")
 
     def start(self) -> None:
+        # fresh Event per run: a previous stop() left the old one set,
+        # and an old thread still draining its join timeout must keep
+        # seeing ITS stop signal
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="leader-elector")
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 2.0) -> None:
+        """``join_timeout`` bounds the wait for the elector thread; a
+        thread stalled inside a pause drill (or a hung API call) is
+        abandoned — it observes its own (old) stop event whenever it
+        wakes, and a subsequent start() runs on a fresh one."""
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2.0)
+            self._thread.join(timeout=join_timeout)
         if self._leading:
             self._leading = False
             self._release()
@@ -96,9 +170,43 @@ class LeaderElector:
             self._on_stop()
 
     def _run(self) -> None:
+        # capture THIS run's stop event: stop()+start() swaps self._stop
+        # for a fresh one, and an abandoned thread (short join_timeout
+        # during a demotion, a wedged API call) reading the attribute
+        # would latch onto the NEW event and never exit — two threads
+        # then race the same lease and double-fire the callbacks
+        stop = self._stop
         last_renew = 0.0
-        while not self._stop.is_set():
-            if self._try_acquire_or_renew():
+        failing_since: Optional[float] = None
+        while not stop.is_set():
+            try:
+                renewed = self._try_acquire_or_renew()
+            except Exception:  # chaos-ok: counted; a severed or faulted
+                # coordination plane is a FAILED renewal, not elector
+                # death — the partition drills depend on the loop
+                # surviving to demote (and later rejoin)
+                SWALLOWED_ERRORS.labels("leaderelection.renew").inc()
+                if failing_since is None:
+                    failing_since = time.monotonic()
+                    log.exception("lease %s: acquire/renew attempt "
+                                  "failed (logging once per streak)",
+                                  self._cfg.lease_name)
+                renewed = False
+            else:
+                if failing_since is not None:
+                    log.warning("lease %s: coordination plane reachable "
+                                "again after %.1fs of failures",
+                                self._cfg.lease_name,
+                                time.monotonic() - failing_since)
+                failing_since = None
+            if stop.is_set():
+                # stopped while the pass was in flight (an abandoned
+                # thread waking from a pause/hang after resign_all):
+                # acting on the result would let a ZOMBIE thread demote
+                # or re-promote the replacement thread's live tenure —
+                # exit without touching shared state
+                break
+            if renewed:
                 last_renew = time.monotonic()
                 if not self._leading:
                     self._leading = True
@@ -113,11 +221,27 @@ class LeaderElector:
                     self._leading = False
                     self._transition("lost")
                     self._on_stop()
-            self._stop.wait(self._cfg.retry_period)
+            stop.wait(self._cfg.retry_period)
+
+    def _observed_expired(self, holder: str, renew: float) -> bool:
+        """Observer-local expiry: the current (holder, renewTime) pair
+        must have sat unchanged for a full lease_duration of THIS
+        process's monotonic time. The holder-written renewTime is only
+        an opaque freshness nonce — its VALUE never meets our clock."""
+        if not holder:
+            return True     # released lease: free for immediate adoption
+        pair = (holder, renew)
+        if pair != self._observed_pair:
+            self._observed_pair = pair
+            self._observed_at = time.monotonic()
+            return False
+        return (time.monotonic() - self._observed_at
+                > self._cfg.lease_duration)
 
     def _try_acquire_or_renew(self) -> bool:
-        now = time.time()
         cfg = self._cfg
+        fi.fire("leaderelection.renew", payload=cfg.identity)
+        now = float(fi.fire("leaderelection.clock", payload=self._clock()))
         try:
             lease = self._leases.get(cfg.lease_name, cfg.namespace)
         except NotFoundError:
@@ -127,21 +251,34 @@ class LeaderElector:
                     "kind": "Lease",
                     "metadata": {"name": cfg.lease_name, "namespace": cfg.namespace},
                     "spec": {"holderIdentity": cfg.identity, "renewTime": now,
-                             "leaseDurationSeconds": cfg.lease_duration},
+                             "leaseDurationSeconds": cfg.lease_duration,
+                             "leaseTransitions": 1},
                 })
+                self._epoch = 1
                 return True
             except AlreadyExistsError:
                 return False
         spec = lease.get("spec") or {}
         holder = spec.get("holderIdentity", "")
-        renew = spec.get("renewTime", 0.0)
-        expired = now - renew > cfg.lease_duration
-        if holder != cfg.identity and not expired:
+        if holder != cfg.identity and not self._observed_expired(
+                holder, spec.get("renewTime", 0.0)):
             return False
+        transitions = int(spec.get("leaseTransitions", 0) or 0)
+        if holder != cfg.identity:
+            # ownership changes hands (expired rival, or a released
+            # lease — including our own after resign): bump the fencing
+            # epoch, so every write stamped under the PREVIOUS tenure
+            # is rejectable from this instant on
+            transitions += 1
         lease["spec"] = {"holderIdentity": cfg.identity, "renewTime": now,
-                         "leaseDurationSeconds": cfg.lease_duration}
+                         "leaseDurationSeconds": cfg.lease_duration,
+                         "leaseTransitions": transitions}
         try:
             self._leases.update(lease)
+            self._epoch = transitions
+            if self._leading:
+                # keep the gauge fresh across epoch-preserving renews
+                LEASE_EPOCH.labels(cfg.lease_name).set(transitions)
             return True
         except (ConflictError, NotFoundError):
             return False
@@ -151,7 +288,13 @@ class LeaderElector:
         try:
             lease = self._leases.get(cfg.lease_name, cfg.namespace)
             if (lease.get("spec") or {}).get("holderIdentity") == cfg.identity:
+                # clearing the holder frees the lease for immediate
+                # adoption AND guarantees the successor bumps the epoch
+                # (holder "" != successor identity)
+                lease["spec"]["holderIdentity"] = ""
                 lease["spec"]["renewTime"] = 0.0
                 self._leases.update(lease)
-        except (NotFoundError, ConflictError):
-            pass
+        except Exception:  # chaos-ok: counted; a release that cannot
+            # reach the API (partitioned resign) degrades to lease
+            # expiry — the successor still adopts, just slower
+            SWALLOWED_ERRORS.labels("leaderelection.release").inc()

@@ -1,0 +1,647 @@
+"""API-backed, epoch-fenced cross-replica device reservations.
+
+PR 6's cross-shard lane could only commit a claim when ONE process owned
+every involved slot's ledger — otherwise the claim parked ("cross-shard
+slots not all owned in-process"), the headroom ROADMAP item 4 left open.
+This module closes it: two (or more) controller replicas cooperatively
+commit a claim spanning their slots through per-slot **DeviceReservation
+records** on the API server, an epoch-fenced two-phase reserve:
+
+- **Phase 1, local**: the claim's *home* replica reserves the entries
+  of slots it owns through its own in-process ledger (unchanged).
+- **Phase 1, remote**: for each involved slot owned elsewhere it
+  creates a DeviceReservation record (``spec``: claim identity, slot,
+  device list, the home slot + the initiator's *home-slot epoch*;
+  fenced — a stale initiator cannot even open phase 1) and waits. The
+  slot's owner observes the record, tries the devices against ITS
+  ledger — the slot's single serialization point, in-flight local
+  reservations included — and writes ``status.phase`` Granted (stamped
+  with its own epoch) or Denied. Any denial or timeout rolls the whole
+  phase back (locals released, records withdrawn); the claim re-parks.
+- **Phase 2**: the home replica commits the claim allocation, stamped
+  with its own slots' epochs PLUS the granted epochs — so if any
+  granter lost its slot between grant and commit, the commit is
+  rejected by fencing and rolls back. Graduation is then event-driven:
+  every owner's claim informer observes the committed allocation and
+  graduates its in-flight reservation, exactly like the single-process
+  lane.
+- **Abandoned phase-1 reserves are reaped by epoch comparison**: a
+  record whose home slot's CURRENT lease epoch is ahead of the stamped
+  ``homeEpoch`` has no live coordinator (the home slot changed hands —
+  the initiator died or was fenced out), so its owner releases the
+  ledger reservation and deletes the record. A TTL backstop covers
+  fencing-disabled deployments.
+
+Deadlock-freedom: local reserves are non-blocking try-locks with
+all-or-nothing rollback; remote requests block only on the *owner's
+decision*, which is itself a non-blocking ledger try — so waits can
+time out (re-park + retry) but never cycle.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from tpu_dra_driver.kube import fencing as fencing_mod
+from tpu_dra_driver.kube.catalog import CounterKey, DeviceEntry, DeviceKey
+from tpu_dra_driver.kube.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    StaleEpochError,
+)
+from tpu_dra_driver.kube.fencing import StaleWriterError
+from tpu_dra_driver.pkg.metrics import FENCING_REJECTIONS, SWALLOWED_ERRORS
+
+log = logging.getLogger(__name__)
+
+#: Reservation records live beside the shard leases.
+RESERVATION_NAMESPACE = "tpu-dra-driver"
+
+PHASE_REQUESTED = "Requested"
+PHASE_GRANTED = "Granted"
+PHASE_DENIED = "Denied"
+
+
+def reservation_name(uid: str, slot: str) -> str:
+    return f"rsv-{uid}-{slot}"
+
+
+def build_reservation(claim_name: str, claim_namespace: str, uid: str,
+                      slot: str, entries: List[DeviceEntry],
+                      requester: str, home_slot: str,
+                      home_epoch: Optional[int]) -> Dict:
+    obj = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "DeviceReservation",
+        "metadata": {"name": reservation_name(uid, slot),
+                     "namespace": RESERVATION_NAMESPACE,
+                     "labels": {"tpu.google.com/slot": slot}},
+        "spec": {
+            "claimUID": uid,
+            "claimName": claim_name,
+            "claimNamespace": claim_namespace,
+            "slot": slot,
+            "requester": requester,
+            "homeSlot": home_slot,
+            **({"homeEpoch": home_epoch} if home_epoch is not None else {}),
+            "devices": [{"pool": e.pool, "device": e.key[1]}
+                        for e in entries],
+        },
+        "status": {"phase": PHASE_REQUESTED},
+    }
+    if home_epoch is not None:
+        # fence the REQUEST itself: a stale initiator cannot open phase 1
+        fencing_mod.stamp(obj, {home_slot: home_epoch})
+    return obj
+
+
+class ReserveCoordinator:
+    """Initiator side of the remote reserve: creates records, awaits
+    grants, withdraws on failure. One per controller."""
+
+    def __init__(self, reservations, identity: str = "",
+                 store_get: Optional[Callable[[str], Optional[Dict]]]
+                 = None):
+        self._reservations = reservations
+        self.identity = identity
+        #: informer-store reader (name -> record or None): await loops
+        #: read grant phases from memory instead of issuing one API GET
+        #: per pending record per wake; absent (or not-yet-synced) they
+        #: fall back to the API
+        self._store_get = store_get
+        self._cond = threading.Condition()
+        # uid -> (claim metadata, route) registered by the controller
+        # around each cross-shard allocate_batch, so reserve() — which
+        # only sees (uid, entries) — can build full records
+        self._claims: Dict[str, Tuple[Dict, object]] = {}
+
+    # -- controller wiring -------------------------------------------------
+
+    def register_claim(self, claim: Dict, route) -> None:
+        meta = claim.get("metadata") or {}
+        with self._cond:
+            self._claims[meta.get("uid", "")] = (dict(meta), route)
+
+    def unregister_claim(self, uid: str) -> None:
+        with self._cond:
+            self._claims.pop(uid, None)
+
+    def claim_info(self, uid: str) -> Optional[Tuple[Dict, object]]:
+        with self._cond:
+            return self._claims.get(uid)
+
+    def note_event(self, obj: Dict) -> None:
+        """Any reservation informer event wakes waiting reserves."""
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- the remote phase 1 ------------------------------------------------
+
+    def request(self, claim_name: str, claim_namespace: str, uid: str,
+                slot: str, entries: List[DeviceEntry], home_slot: str,
+                home_epoch: Optional[int]) -> str:
+        obj = build_reservation(claim_name, claim_namespace, uid, slot,
+                                entries, self.identity, home_slot,
+                                home_epoch)
+        try:
+            self._reservations.create(obj)
+        except AlreadyExistsError:
+            # residue of a previous attempt for the same claim+slot
+            # (a withdraw that failed or raced a retry). Adopt it ONLY
+            # if it asks for the SAME devices — a fleet change between
+            # attempts can shift the pick, and adopting a mismatched
+            # (possibly Granted) record would leave the devices we
+            # actually commit unreserved at the owner. Otherwise delete
+            # and recreate; a create that races again propagates and
+            # phase 1 rolls back + re-parks.
+            try:
+                existing = self._reservations.get(obj["metadata"]["name"],
+                                                  RESERVATION_NAMESPACE)
+            except NotFoundError:
+                existing = None
+            spec = (existing or {}).get("spec") or {}
+            if existing is None or spec.get("devices") != \
+                    obj["spec"]["devices"] or spec.get("claimUID") != uid:
+                self._reservations.delete_ignore_missing(
+                    obj["metadata"]["name"], RESERVATION_NAMESPACE)
+                self._reservations.create(obj)
+        except StaleEpochError as e:
+            FENCING_REJECTIONS.labels("reserve.request").inc()
+            raise StaleWriterError(str(e)) from e
+        return obj["metadata"]["name"]
+
+    def await_grants(self, names: Iterable[str], timeout: float,
+                     pump: Optional[Callable[[], None]] = None
+                     ) -> Dict[str, Dict]:
+        """Block until every record in ``names`` is resolved (Granted or
+        Denied) or ``timeout`` elapses. Returns {name: status}; an
+        unresolved record reports phase Requested. ``pump`` (the
+        controller's own grant servicing) runs each round so two
+        replicas awaiting each OTHER's grants cannot starve when all
+        their workers are parked here."""
+        pending = set(names)
+        out: Dict[str, Dict] = {}
+        deadline = time.monotonic() + timeout
+        while pending:
+            if pump is not None:
+                try:
+                    pump()
+                except StaleWriterError:
+                    raise
+                except Exception:  # chaos-ok: counted; the pump is a
+                    # courtesy — grant servicing also runs on workers
+                    SWALLOWED_ERRORS.labels("reserve.pump").inc()
+            for name in list(pending):
+                obj = (self._store_get(name)
+                       if self._store_get is not None else None)
+                if obj is None:
+                    # store miss (no informer, not synced, or deleted):
+                    # the API is authoritative
+                    try:
+                        obj = self._reservations.get(
+                            name, RESERVATION_NAMESPACE)
+                    except NotFoundError:
+                        out[name] = {"phase": PHASE_DENIED,
+                                     "reason": "record vanished (reaped?)"}
+                        pending.discard(name)
+                        continue
+                    except Exception:  # chaos-ok: counted; a flaky read
+                        # retries until the deadline re-parks the claim
+                        SWALLOWED_ERRORS.labels("reserve.await").inc()
+                        continue
+                status = obj.get("status") or {}
+                if status.get("phase") in (PHASE_GRANTED, PHASE_DENIED):
+                    out[name] = status
+                    pending.discard(name)
+            if not pending or time.monotonic() >= deadline:
+                break
+            # note_event notifies on every reservation informer event,
+            # so the wait is normally cut short by the grant itself; the
+            # 0.25 s ceiling is only the no-informer (pump-driven) and
+            # missed-event cadence — NOT a 50 Hz poll of the API server
+            with self._cond:
+                self._cond.wait(
+                    timeout=min(0.25, max(0.01,
+                                          deadline - time.monotonic())))
+        for name in pending:
+            out[name] = {"phase": PHASE_REQUESTED, "reason": "grant timeout"}
+        return out
+
+    def withdraw(self, uid: str, slots: Iterable[str]) -> None:
+        for slot in slots:
+            try:
+                self._reservations.delete_ignore_missing(
+                    reservation_name(uid, slot), RESERVATION_NAMESPACE)
+            except Exception:  # chaos-ok: counted; an unreachable delete
+                # degrades to the owner's epoch/TTL reaper
+                SWALLOWED_ERRORS.labels("reserve.withdraw").inc()
+
+
+class ReservationGranter:
+    """Owner side: resolves Requested records for slots this process
+    owns against its ledger (the slot's single serialization point),
+    with fenced status writes; reaps abandoned records."""
+
+    def __init__(self, reservations, resource_claims, ledger,
+                 snapshot_fn: Callable, owned_fn: Callable[[], Set[str]],
+                 driver_name: str,
+                 fencing=None, leases=None,
+                 reserve_ttl: float = 60.0,
+                 identity: str = ""):
+        self._reservations = reservations
+        self._resource_claims = resource_claims
+        self._ledger = ledger
+        self._snapshot_fn = snapshot_fn
+        self._owned_fn = owned_fn
+        self._driver = driver_name
+        self._fencing = fencing
+        self._leases = leases
+        self._reserve_ttl = reserve_ttl
+        self.identity = identity
+        # records being processed RIGHT NOW: a duplicate delivery (watch
+        # gap relist) must not race a second worker through the same
+        # record — the loser's conflict rollback would shrink the
+        # reservation backing the winner's landed grant
+        self._mu = threading.Lock()
+        self._processing: Set[str] = set()
+
+    def set_fencing(self, fencing) -> None:
+        self._fencing = fencing
+
+    def process(self, name: str) -> None:
+        """Resolve one record (idempotent; safe to re-deliver)."""
+        with self._mu:
+            if name in self._processing:
+                return      # a concurrent delivery is already on it
+            self._processing.add(name)
+        try:
+            self._process(name)
+        finally:
+            with self._mu:
+                self._processing.discard(name)
+
+    def _process(self, name: str) -> None:
+        try:
+            obj = self._reservations.get(name, RESERVATION_NAMESPACE)
+        except NotFoundError:
+            return
+        spec = obj.get("spec") or {}
+        slot = spec.get("slot", "")
+        if slot not in self._owned_fn():
+            return
+        if (obj.get("status") or {}).get("phase") != PHASE_REQUESTED:
+            return
+        uid = spec.get("claimUID", "")
+        snap = self._snapshot_fn()
+        entries: List[DeviceEntry] = []
+        ok, reason = True, ""
+        for d in spec.get("devices") or []:
+            entry = snap.devices.get((d.get("pool", ""),
+                                      d.get("device", "")))
+            if entry is None:
+                ok, reason = False, (f"device {d.get('pool')}/"
+                                     f"{d.get('device')} not in catalog")
+                break
+            entries.append(entry)
+        if ok:
+            # extend=True: a claim spanning TWO of our slots arrives as
+            # two records; the second must widen the first's
+            # reservation, not be refused as a same-uid conflict
+            ok = self._ledger.reserve(uid, entries, snap.counter_caps,
+                                      extend=True)
+            if not ok:
+                reason = "devices not free on owning shard"
+        epoch: Optional[int] = None
+        if self._fencing is not None:
+            try:
+                epoch = self._fencing.epoch_for(slot)
+            except StaleWriterError:
+                # lost the slot between the owned_fn check and here —
+                # leave the record for the new owner; back out ONLY this
+                # record's keys (a two-slot claim's other record may
+                # already be Granted and must keep its share)
+                if ok:
+                    self._ledger.shrink_reservation(uid, entries)
+                return
+        obj["status"] = {"phase": PHASE_GRANTED if ok else PHASE_DENIED,
+                         **({"epoch": epoch} if epoch is not None else {}),
+                         **({"reason": reason} if reason else {}),
+                         "granter": self.identity}
+        if epoch is not None:
+            fencing_mod.stamp(obj, {slot: epoch})
+        try:
+            self._reservations.update(obj)
+        except (ConflictError, NotFoundError):
+            # a concurrent write moved the record. Re-read before
+            # rolling back: if what landed is a GRANT (a racing
+            # delivery path that shares our ledger), the reservation
+            # now backs that grant and must stand; only a
+            # withdraw/reap/deny means our keys should go
+            if ok and not self._record_granted(name):
+                self._ledger.shrink_reservation(uid, entries)
+        except StaleEpochError as e:
+            FENCING_REJECTIONS.labels("reserve.grant").inc()
+            if ok:
+                self._ledger.shrink_reservation(uid, entries)
+            raise StaleWriterError(str(e)) from e
+
+    def _record_granted(self, name: str) -> bool:
+        try:
+            fresh = self._reservations.get(name, RESERVATION_NAMESPACE)
+        except NotFoundError:
+            return False
+        except Exception:  # chaos-ok: counted; fail SAFE — keep the
+            # reservation rather than risk freeing a granted record's
+            # devices; the reaper heals a leak
+            SWALLOWED_ERRORS.labels("reserve.grant").inc()
+            return True
+        return (fresh.get("status") or {}).get("phase") == PHASE_GRANTED
+
+    def record_deleted(self, obj: Dict) -> None:
+        """A record for one of our slots disappeared. If its claim
+        committed, graduate the in-flight reservation via an
+        authoritative read (the claim MODIFIED event may still be queued
+        behind this DELETE — releasing first would open a double-alloc
+        window); otherwise release."""
+        spec = obj.get("spec") or {}
+        if spec.get("slot", "") not in self._owned_fn():
+            return
+        uid = spec.get("claimUID", "")
+        try:
+            claim = self._resource_claims.get(spec.get("claimName", ""),
+                                              spec.get("claimNamespace", ""))
+        except NotFoundError:
+            claim = None
+        except Exception:  # chaos-ok: counted; fail SAFE — keep the
+            # reservation (devices stay unavailable) rather than risk
+            # freeing a committed claim's devices; the reaper retries
+            SWALLOWED_ERRORS.labels("reserve.record_deleted").inc()
+            return
+        if claim is not None and (claim.get("status") or {}
+                                  ).get("allocation"):
+            self._ledger.observe_claim(claim)   # graduation
+        else:
+            # back out ONLY this record's devices: a two-slot-same-owner
+            # claim holds ONE ledger reservation for two records, and a
+            # partially-failed withdraw can delete one record while its
+            # sibling stays Granted — releasing the whole uid would free
+            # the sibling's keys (shrink releases fully when the last
+            # key goes, so the single-record case is unchanged)
+            self._ledger.shrink_reservation(
+                uid, self._record_entries(spec))
+
+    def _record_entries(self, spec: Dict) -> List[DeviceEntry]:
+        """The record's devices as catalog entries (counter-accurate
+        when still cataloged; a vanished device shrinks by key with no
+        counter contribution — the release path's safe direction)."""
+        from types import SimpleNamespace
+
+        snap = self._snapshot_fn()
+        out: List[DeviceEntry] = []
+        for d in spec.get("devices") or []:
+            key = (d.get("pool", ""), d.get("device", ""))
+            entry = snap.devices.get(key)
+            if entry is None:
+                entry = SimpleNamespace(key=key, device={}, pool=key[0])
+            out.append(entry)
+        return out
+
+    def reap_stale(self, records: List[Dict]) -> int:
+        """Epoch-comparison reaping of abandoned phase-1 records (plus a
+        TTL backstop): returns how many were reaped."""
+        reaped = 0
+        owned = self._owned_fn()
+        for obj in records:
+            spec = obj.get("spec") or {}
+            if spec.get("slot", "") not in owned:
+                continue
+            if not self._is_abandoned(spec, obj):
+                continue
+            name = (obj.get("metadata") or {}).get("name", "")
+            log.warning("reaping abandoned reservation %s (home slot %s "
+                        "epoch moved or TTL expired)", name,
+                        spec.get("homeSlot"))
+            try:
+                self._reservations.delete_ignore_missing(
+                    name, RESERVATION_NAMESPACE)
+            except Exception:  # chaos-ok: counted; retried next sweep
+                SWALLOWED_ERRORS.labels("reserve.reap").inc()
+                continue
+            # the DELETED informer event routes through record_deleted,
+            # which graduates-or-releases via the authoritative read
+            reaped += 1
+        return reaped
+
+    def _is_abandoned(self, spec: Dict, obj: Dict) -> bool:
+        home_epoch = spec.get("homeEpoch")
+        if home_epoch is not None and self._leases is not None \
+                and self._fencing is not None:
+            try:
+                current = fencing_mod.current_epoch(
+                    self._leases, self._fencing.lease_prefix,
+                    self._fencing.namespace, spec.get("homeSlot", ""))
+                if current is not None and current > int(home_epoch):
+                    return True     # the coordinator's tenure ended
+            except Exception:  # chaos-ok: counted; fall through to TTL
+                SWALLOWED_ERRORS.labels("reserve.reap").inc()
+        created = (obj.get("metadata") or {}).get("creationTimestamp")
+        if isinstance(created, (int, float)):
+            return (time.time() - created) > self._reserve_ttl
+        return False
+
+
+class ReservationFencing:
+    """Per-claim epoch source for the remote cross-shard lane's commits:
+    own slots from the base :class:`FencingTokens`, remote slots from
+    the epochs their owners stamped on the grants — so the commit is
+    rejected if ANY participant's tenure ended in the meantime."""
+
+    def __init__(self, base, local_slots: Set[str], ring,
+                 granted_epochs: Callable[[str], Dict[str, int]]):
+        self._base = base
+        self._local = set(local_slots)
+        self._ring = ring
+        self._granted = granted_epochs
+
+    def epochs(self, uid: str, pools: Iterable[str]) -> Dict[str, int]:
+        granted = self._granted(uid)
+        out: Dict[str, int] = {}
+        for slot in {self._ring.owner(p) for p in pools}:
+            if slot in self._local:
+                out[slot] = self._base.epoch_for(slot)
+            elif slot in granted:
+                out[slot] = granted[slot]
+            else:
+                raise StaleWriterError(
+                    f"slot {slot}: no held epoch and no grant epoch for "
+                    f"claim {uid} — cannot prove tenure")
+        return out
+
+    def verify(self, epochs: Dict[str, int]) -> None:
+        self._base.verify(epochs)
+
+
+class RemoteCrossShardLedger:
+    """The ledger protocol over a route whose slots span replicas:
+    local slots through this process's own (deduped) ledgers, remote
+    slots through the API reservation protocol, committed usage of
+    remote pools through the complement *shadow* ledger (claim-informer
+    fed, pools NOT owned by this process — disjoint from the local
+    ledgers by construction, so unions never double count)."""
+
+    def __init__(self, route, ring, local_ledgers: Dict[str, object],
+                 shadow, coordinator: ReserveCoordinator,
+                 home_epoch: Callable[[], Optional[int]],
+                 grant_timeout: float = 10.0):
+        self._route = route
+        self._ring = ring
+        self._local_by_slot = dict(local_ledgers)
+        self._shadow = shadow
+        self._coord = coordinator
+        self._home_epoch = home_epoch
+        self._grant_timeout = grant_timeout
+        #: grant servicing hook (the controller's) run while awaiting
+        self.pump: Optional[Callable[[], None]] = None
+        seen: List[object] = []
+        for slot in sorted(self._local_by_slot):
+            led = self._local_by_slot[slot]
+            if all(led is not s for s in seen):
+                seen.append(led)
+        self._unique_local = tuple(seen)
+        self._mu = threading.Lock()
+        # uid -> {slot: granted epoch} for in-flight remote reserves
+        self._granted: Dict[str, Dict[str, int]] = {}
+        # uid -> remote slots holding records we created
+        self._requested: Dict[str, Set[str]] = {}
+
+    def granted_epochs(self, uid: str) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._granted.get(uid, {}))
+
+    # -- reads -------------------------------------------------------------
+
+    def snapshot(self) -> Tuple[Set[DeviceKey], Dict[CounterKey, int]]:
+        taken: Set[DeviceKey] = set()
+        usage: Dict[CounterKey, int] = {}
+        for led in self._unique_local + (self._shadow,):
+            t, u = led.snapshot()
+            taken |= t
+            for ck, amount in u.items():
+                usage[ck] = usage.get(ck, 0) + amount
+        return taken, usage
+
+    def held_by_other(self, keys: Iterable[DeviceKey], uid: str) -> bool:
+        wanted = list(keys)
+        return any(led.held_by_other(wanted, uid)
+                   for led in self._unique_local + (self._shadow,))
+
+    # -- two-phase reserve -------------------------------------------------
+
+    def reserve(self, uid: str, entries: List[DeviceEntry],
+                caps: Dict[CounterKey, int]) -> bool:
+        by_slot: Dict[str, List[DeviceEntry]] = {}
+        for e in entries:
+            by_slot.setdefault(self._ring.owner(e.pool), []).append(e)
+        local_entries: List[DeviceEntry] = []
+        remote: Dict[str, List[DeviceEntry]] = {}
+        for slot, batch in by_slot.items():
+            if slot in self._local_by_slot:
+                local_entries.extend(batch)
+            else:
+                remote[slot] = batch
+        # phase 1a: local slots, grouped per unique ledger (one
+        # controller owning several involved slots has ONE ledger —
+        # a second same-uid reserve on it would be refused)
+        reserved_local: List[object] = []
+        groups: List[Tuple[object, List[DeviceEntry]]] = []
+        for e in local_entries:
+            led = self._local_by_slot[self._ring.owner(e.pool)]
+            for existing, batch in groups:
+                if existing is led:
+                    batch.append(e)
+                    break
+            else:
+                groups.append((led, [e]))
+        for led, batch in groups:
+            if not led.reserve(uid, batch, caps):
+                for done in reserved_local:
+                    done.release(uid)
+                return False
+            reserved_local.append(led)
+        if not remote:
+            return True
+        # phase 1b: remote slots, ascending slot order, via API records
+        info = self._coord.claim_info(uid)
+        claim_meta = info[0] if info else {}
+        names: List[str] = []
+        try:
+            for slot in sorted(remote):
+                names.append(self._coord.request(
+                    claim_meta.get("name", ""),
+                    claim_meta.get("namespace", ""),
+                    uid, slot, remote[slot],
+                    home_slot=self._route.home,
+                    home_epoch=self._home_epoch()))
+            with self._mu:
+                self._requested[uid] = set(remote)
+            results = self._coord.await_grants(names, self._grant_timeout,
+                                               pump=self.pump)
+        except StaleWriterError:
+            self._rollback(uid, reserved_local, set(remote))
+            raise
+        except Exception:  # chaos-ok: counted; phase 1 rolls back and
+            # the claim re-parks for retry
+            SWALLOWED_ERRORS.labels("reserve.phase1").inc()
+            self._rollback(uid, reserved_local, set(remote))
+            return False
+        granted: Dict[str, int] = {}
+        all_granted = True
+        for slot, name in zip(sorted(remote), names):
+            status = results.get(name) or {}
+            if status.get("phase") != PHASE_GRANTED:
+                all_granted = False
+            elif "epoch" in status:
+                granted[slot] = int(status["epoch"])
+        if not all_granted:
+            self._rollback(uid, reserved_local, set(remote))
+            return False
+        with self._mu:
+            self._granted[uid] = granted
+        return True
+
+    def _rollback(self, uid: str, reserved_local: List[object],
+                  remote_slots: Set[str]) -> None:
+        for led in reserved_local:
+            led.release(uid)
+        self._coord.withdraw(uid, remote_slots)
+        with self._mu:
+            self._granted.pop(uid, None)
+            self._requested.pop(uid, None)
+
+    def release(self, uid: str) -> None:
+        for led in self._unique_local:
+            led.release(uid)
+        with self._mu:
+            remote_slots = self._requested.pop(uid, set())
+            self._granted.pop(uid, None)
+        if remote_slots:
+            self._coord.withdraw(uid, remote_slots)
+
+    def observe_claim(self, claim: Dict) -> None:
+        # phase 2 graduation: local ledgers + the shadow record their
+        # shares (each filter keeps only its own pools); the remote
+        # owners graduate through their own claim informers — their
+        # records are withdrawn AFTER the commit is visible, and
+        # record_deleted double-checks the claim before releasing
+        for led in self._unique_local + (self._shadow,):
+            led.observe_claim(claim)
+        uid = (claim.get("metadata") or {}).get("uid", "")
+        with self._mu:
+            remote_slots = self._requested.pop(uid, set())
+            self._granted.pop(uid, None)
+        if remote_slots and (claim.get("status") or {}).get("allocation"):
+            self._coord.withdraw(uid, remote_slots)

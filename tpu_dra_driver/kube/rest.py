@@ -91,6 +91,7 @@ _RESOURCE_MAP: Dict[str, Tuple[str, bool]] = {
     "deviceclasses": ("/apis/resource.k8s.io/{RESOURCE_VERSION}", False),
     "computedomains": ("/apis/resource.tpu.google.com/v1beta1", True),
     "computedomaincliques": ("/apis/resource.tpu.google.com/v1beta1", True),
+    "devicereservations": ("/apis/resource.tpu.google.com/v1beta1", True),
 }
 
 # Group-versions this client can speak, most preferred first.

@@ -38,6 +38,7 @@ sharded winners == single-allocator winners).
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -50,6 +51,8 @@ from tpu_dra_driver.kube.catalog import (
 )
 from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg.metrics import SHARD_REBALANCES
+
+log = logging.getLogger(__name__)
 
 fi.register("sharding.shard-crash",
             "one shard's batch drain (crash models a shard process dying "
@@ -382,6 +385,17 @@ class ShardLeaseManager:
         with self._mu:
             return set(self._owned)
 
+    def slot_epoch(self, slot: str) -> Optional[int]:
+        """The fencing epoch under which this process holds ``slot``'s
+        lease, or None when it does not hold it — the epoch source
+        behind :class:`~tpu_dra_driver.kube.fencing.FencingTokens`:
+        every allocation-plane write for the slot's pools is stamped
+        with this value."""
+        elector = self._electors.get(slot)
+        if elector is None or not elector.is_leader:
+            return None
+        return elector.epoch
+
     def start(self) -> None:
         for elector in self._electors.values():
             elector.start()
@@ -389,3 +403,27 @@ class ShardLeaseManager:
     def stop(self) -> None:
         for elector in self._electors.values():
             elector.stop()
+
+    def resign_all(self, rejoin: bool = True) -> None:
+        """Demote: release every held slot lease (survivors adopt them,
+        each adoption bumping the slot's fencing epoch) and — by
+        default — restart the electors so this process rejoins the
+        competition with a clean slate.
+
+        This is the stale-writer recovery path: a fencing rejection
+        proves this process acted on a lease it no longer holds, so
+        EVERYTHING it believes about slot ownership is suspect. Each
+        elector's stop() fires on_stopped_leading, which empties the
+        owned set through the normal transition machinery (the
+        controller's set_owned_slots drops queues and caches)."""
+        log.warning("resigning all shard leases (%s)%s",
+                    sorted(self.owned_slots()) or "none held",
+                    " and rejoining" if rejoin else "")
+        for elector in self._electors.values():
+            # short join: a demotion often finds the elector thread
+            # STALLED (that is why we are demoting) — recovery latency
+            # must not pay a full join timeout per slot for it
+            elector.stop(join_timeout=0.2)
+        if rejoin:
+            for elector in self._electors.values():
+                elector.start()

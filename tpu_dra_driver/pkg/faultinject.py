@@ -39,7 +39,18 @@ Actions:
   the SIGKILL analog for subprocess drills,
 - ``latency`` — sleep ``seconds`` (timeout/slow-path exercise),
 - ``corrupt`` — pass the payload through the rule's ``mutate`` callable
-  and return the mutated value (torn bytes, flipped fields).
+  and return the mutated value (torn bytes, flipped fields),
+- ``pause``  — block on the rule's :class:`PauseGate` until a test
+  resumes it (bounded by ``seconds``, default 120): the GC-pause /
+  SIGSTOP analog. Unlike ``latency`` the stall is *externally
+  controlled* — the split-brain drills park a lease holder's renew loop
+  and commit path here, let a survivor adopt the slot, then resume the
+  stale holder mid-write.
+
+A rule may also carry a ``match`` predicate over the fire-site payload
+(e.g. a claim UID or a lease identity) so one process-global point can
+target a single victim — the pause drills stall exactly one replica's
+elector while its rival keeps renewing through the same code path.
 """
 
 from __future__ import annotations
@@ -66,6 +77,31 @@ class CrashInjected(FaultInjected):
     boundary, discard the component without cleanup, and restart it."""
 
 
+class PauseGate:
+    """Externally-controlled stall for ``pause`` rules (the GC-pause /
+    SIGSTOP analog). Starts RUNNING: an armed pause rule costs nothing
+    until a drill calls :meth:`pause`; every thread that then hits the
+    fire site blocks until :meth:`resume` (or the rule's ``seconds``
+    ceiling, so a leaked gate can never wedge a suite)."""
+
+    def __init__(self):
+        self._running = threading.Event()
+        self._running.set()
+
+    def pause(self) -> None:
+        self._running.clear()
+
+    def resume(self) -> None:
+        self._running.set()
+
+    @property
+    def paused(self) -> bool:
+        return not self._running.is_set()
+
+    def wait(self, timeout: float) -> bool:
+        return self._running.wait(timeout)
+
+
 @dataclass
 class Rule:
     """One armed behavior on a fault point.
@@ -82,11 +118,16 @@ class Rule:
     ``max_fires`` bounds total firings (0 = unbounded).
     """
 
-    mode: str = "fail"                  # fail | crash | latency | corrupt
+    mode: str = "fail"                  # fail | crash | latency | corrupt | pause
     error: Optional[Callable[[], BaseException]] = None
-    seconds: float = 0.0                # latency mode
+    seconds: float = 0.0                # latency mode; pause-mode ceiling
     mutate: Optional[Callable] = None   # corrupt mode
     hard: bool = False                  # crash mode: os._exit(137)
+    gate: Optional[PauseGate] = None    # pause mode
+    #: payload predicate: when set, the rule only considers calls whose
+    #: fire-site payload it accepts (checked before schedule counting,
+    #: so nth/first/every count only the victim's calls)
+    match: Optional[Callable] = None
     nth: int = 0
     first: int = 0
     every: int = 0
@@ -218,6 +259,8 @@ def _fire_slow(name: str, payload):
         p.calls += 1
         due: List[Rule] = []
         for rule in p.rules:
+            if rule.match is not None and not rule.match(payload):
+                continue
             if rule.should_fire():
                 rule.fires += 1
                 p.fired += 1
@@ -229,6 +272,11 @@ def _fire_slow(name: str, payload):
                     name, rule.mode, rule.fires)
         if rule.mode == "latency":
             time.sleep(rule.seconds)
+        elif rule.mode == "pause":
+            # block until the drill resumes the gate (bounded: a gate
+            # nobody resumes must not hang the suite forever)
+            gate = rule.gate if rule.gate is not None else PauseGate()
+            gate.wait(rule.seconds or 120.0)
         elif rule.mode == "corrupt":
             if rule.mutate is not None:
                 payload = rule.mutate(payload)
@@ -269,6 +317,9 @@ def _record_span_event(name: str, mode: str) -> None:
 # mode:  fail[:<message>] | crash[:hard] | latency:<seconds> | corrupt
 # when:  nth:<n> | first:<k> | every:<n> | p:<prob>[:seed:<s>]
 #        (omitted = always)
+# (pause is deliberately NOT env-scriptable: it needs an in-process
+# PauseGate a drill can resume — a subprocess nobody can resume would
+# just be a crash with extra steps)
 #
 # Examples:
 #     checkpoint.write.torn=crash:hard@nth:2

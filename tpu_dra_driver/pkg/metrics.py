@@ -647,6 +647,21 @@ LEADER_TRANSITIONS = DEFAULT_REGISTRY.counter(
     "(acquired/lost) — shard hand-offs and controller fail-overs both "
     "land here",
     ("lease", "direction"))
+LEASE_EPOCH = DEFAULT_REGISTRY.gauge(
+    "dra_lease_epoch",
+    "Fencing epoch (Lease leaseTransitions) under which this process "
+    "currently holds the named lease — every allocation-plane write is "
+    "stamped with it, and a write behind the slot's current epoch is "
+    "rejected (split-brain fencing, docs/chaos.md)",
+    ("lease",))
+FENCING_REJECTIONS = DEFAULT_REGISTRY.counter(
+    "dra_fencing_rejections_total",
+    "Allocation-plane writes rejected because their stamped lease epoch "
+    "was behind the slot's current one (a paused/partitioned holder "
+    "woke after a survivor adopted its slot), by rejection site — "
+    "any nonzero value means fencing just prevented a split-brain "
+    "double-allocation",
+    ("site",))
 WATCH_STREAMS_ACTIVE = DEFAULT_REGISTRY.gauge(
     "dra_watch_streams_active",
     "Watch subscriptions currently open, by transport: mux (fake/REST "

@@ -65,6 +65,8 @@ _LIST_KINDS = {
     "deviceclasses": "DeviceClassList",
     "computedomains": "ComputeDomainList",
     "computedomaincliques": "ComputeDomainCliqueList",
+    # cross-replica phase-1 reservation records (kube/reservations.py)
+    "devicereservations": "DeviceReservationList",
 }
 
 _KNOWN_RESOURCES = frozenset(_LIST_KINDS)

@@ -43,10 +43,11 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.kube.allocation_controller import AllocationController
-from tpu_dra_driver.kube.client import ClientSets
-from tpu_dra_driver.kube.errors import NotFoundError
+from tpu_dra_driver.kube.client import ClientSets, ResourceClient
+from tpu_dra_driver.kube.errors import ApiError, NotFoundError
 from tpu_dra_driver.kube.events import REASON_ALLOCATION_PARKED
 from tpu_dra_driver.pkg import criticalpath
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import featuregates as fg
 from tpu_dra_driver.pkg import slo as slo_mod
 from tpu_dra_driver.pkg import tracing
@@ -1083,4 +1084,562 @@ def scenario_autoscaler_churn(n_base_nodes: int = 12,
             f"churn traffic failed: "
             f"{run.extra['traffic']['failure_samples']}")
     check_no_double_alloc(clients)
+    return run.report()
+
+
+# ---------------------------------------------------------------------------
+# hostile substrate: asymmetric partitions, pause/skew composition
+# ---------------------------------------------------------------------------
+
+fi.register("substrate.partition",
+            "a severed client's API call (payload: (client, resource)). "
+            "PartitionableClients gives each replica its own view of "
+            "the apiserver with per-resource severable links — sever "
+            "only a holder's `leases` client and it keeps allocating on "
+            "stale lease beliefs while its renewals black-hole, the "
+            "asymmetric-partition half of the split-brain drills")
+
+
+class PartitionedError(ApiError):
+    """The client's link to the apiserver is severed (scenario-injected)."""
+
+
+class PartitionableClients(ClientSets):
+    """One replica's view of a shared FakeCluster with severable,
+    per-resource links — the asymmetric-partition substrate: replica A
+    can lose exactly its coordination plane (``sever("leases")``) while
+    its data plane keeps working, or lose everything (``sever("*")``),
+    while every other replica's view stays healthy.
+
+    Severing gates NEW calls (CRUD + new watches); watch subscriptions
+    established before the cut keep streaming — sever before the
+    informer starts to model a cold partition, or accept the live
+    streams as the (realistic) case of a partition that bisects the
+    request path but not yet-open streamed responses."""
+
+    def __init__(self, cluster, name: str = "client"):
+        super().__init__(cluster=cluster)
+        self.name = name
+        self._severed: set = set()
+        self._part_mu = threading.Lock()
+        #: calls refused while severed (the drill's evidence surface)
+        self.blocked_calls = 0
+
+    def sever(self, *resources: str) -> None:
+        """Cut the named resources' links ("*" = the whole apiserver)."""
+        with self._part_mu:
+            self._severed.update(resources or ("*",))
+        log.warning("client %s PARTITIONED from %s", self.name,
+                    sorted(self._severed))
+
+    def heal(self, *resources: str) -> None:
+        with self._part_mu:
+            if resources:
+                self._severed.difference_update(resources)
+            else:
+                self._severed.clear()
+        log.warning("client %s partition healed (remaining: %s)",
+                    self.name, sorted(self._severed))
+
+    def is_severed(self, resource: str) -> bool:
+        with self._part_mu:
+            return "*" in self._severed or resource in self._severed
+
+    def check(self, resource: str) -> None:
+        if not self.is_severed(resource):
+            return
+        with self._part_mu:
+            self.blocked_calls += 1
+        fi.fire("substrate.partition", payload=(self.name, resource))
+        raise PartitionedError(
+            f"client {self.name}: apiserver unreachable for {resource} "
+            f"(injected partition)")
+
+    def __getitem__(self, resource: str):
+        return _PartitionedClient(self, resource)
+
+
+class _PartitionedClient(ResourceClient):
+    def __init__(self, gate: PartitionableClients, resource: str):
+        super().__init__(gate.cluster, resource)
+        self._gate = gate
+
+    def create(self, obj):
+        self._gate.check(self.resource)
+        return super().create(obj)
+
+    def get(self, name, namespace=""):
+        self._gate.check(self.resource)
+        return super().get(name, namespace)
+
+    def list(self, namespace=None, label_selector=None, name_pattern=None):
+        self._gate.check(self.resource)
+        return super().list(namespace=namespace,
+                            label_selector=label_selector,
+                            name_pattern=name_pattern)
+
+    def update(self, obj):
+        self._gate.check(self.resource)
+        return super().update(obj)
+
+    def delete(self, name, namespace=""):
+        self._gate.check(self.resource)
+        return super().delete(name, namespace)
+
+    def delete_ignore_missing(self, name, namespace=""):
+        self._gate.check(self.resource)
+        return super().delete_ignore_missing(name, namespace)
+
+    def watch(self, label_selector=None):
+        self._gate.check(self.resource)
+        return super().watch(label_selector)
+
+    def list_and_watch(self, namespace=None, label_selector=None):
+        self._gate.check(self.resource)
+        return super().list_and_watch(namespace=namespace,
+                                      label_selector=label_selector)
+
+
+def check_no_stale_epoch_commits(clients: ClientSets, handle) -> int:
+    """The split-brain invariant: ZERO committed writes carrying a stale
+    epoch. For every allocated claim with a fencing stamp, each stamped
+    slot epoch must be at-or-below that slot's CURRENT lease epoch (a
+    stamp from the future would mean the admission check is broken) —
+    and for every rejection the admission hook recorded, the committed
+    claim (if any) must NOT be the rejected write: its stamp must be
+    strictly newer than the rejected one. Returns how many stamped
+    commits were checked."""
+    from tpu_dra_driver.kube import fencing as fencing_mod
+
+    def current_epoch(slot: str) -> Optional[int]:
+        return fencing_mod.current_epoch(
+            clients.leases, handle.lease_prefix, handle.namespace, slot)
+
+    checked = 0
+    by_name: Dict[str, Dict] = {}
+    for claim in clients.resource_claims.list():
+        by_name[claim["metadata"].get("name", "")] = claim
+        if not (claim.get("status") or {}).get("allocation"):
+            continue
+        epochs = fencing_mod.stamped_epochs(claim)
+        if not epochs:
+            continue
+        checked += 1
+        for slot, stamped in epochs.items():
+            current = current_epoch(slot)
+            if current is not None and stamped > current:
+                raise InvariantViolation(
+                    f"claim {claim['metadata'].get('name')}: stamped "
+                    f"epoch {stamped} for {slot} is AHEAD of the "
+                    f"lease's {current} — fencing bookkeeping broken")
+    for rej in handle.rejections:
+        if rej["resource"] != "resourceclaims":
+            continue
+        claim = by_name.get(rej["name"])
+        if claim is None or not (claim.get("status") or {}
+                                 ).get("allocation"):
+            continue
+        stamped = fencing_mod.stamped_epochs(claim).get(rej["slot"])
+        if stamped is not None and stamped <= rej["stamped"]:
+            raise InvariantViolation(
+                f"claim {rej['name']}: a write rejected at epoch "
+                f"{rej['stamped']} appears to have LANDED (committed "
+                f"stamp {stamped})")
+    return checked
+
+
+# ---------------------------------------------------------------------------
+# split-brain scenarios: fenced shard leases under pause and partition
+# ---------------------------------------------------------------------------
+
+
+class _Replica:
+    """One controller replica over a shared cluster: its own (severable)
+    client view, a sharded AllocationController, a per-slot lease
+    manager, and fencing tokens wired for demote-on-stale."""
+
+    def __init__(self, cluster, name: str, ring,
+                 lease_duration: float, renew_deadline: float,
+                 retry_period: float = 0.05,
+                 config: Optional["AllocationControllerConfig"] = None):
+        from tpu_dra_driver.kube.allocation_controller import (
+            AllocationControllerConfig,
+            ShardWiring,
+        )
+        from tpu_dra_driver.kube.fencing import FencingTokens
+        from tpu_dra_driver.kube.sharding import (
+            ShardLeaseConfig,
+            ShardLeaseManager,
+        )
+
+        self.name = name
+        self.clients = PartitionableClients(cluster, name=name)
+        self.controller = AllocationController(
+            self.clients,
+            config or AllocationControllerConfig(workers=2,
+                                                 retry_interval=0.2,
+                                                 reserve_grant_timeout=1.0),
+            shard=ShardWiring(ring, owned=set()),
+            identity=name)
+        self.manager = ShardLeaseManager(
+            self.clients.leases, ring.members,
+            ShardLeaseConfig(identity=name,
+                             lease_duration=lease_duration,
+                             renew_deadline=renew_deadline,
+                             retry_period=retry_period),
+            on_slots_changed=self.controller.set_owned_slots)
+        self.tokens = FencingTokens(ring, self.manager.slot_epoch,
+                                    leases=self.clients.leases)
+        self.controller.set_fencing(
+            self.tokens,
+            on_stale_writer=lambda reason: self.manager.resign_all())
+
+    def start(self) -> "_Replica":
+        self.controller.start()
+        self.manager.start()
+        return self
+
+    def stop(self) -> None:
+        self.manager.stop()
+        self.controller.stop()
+
+    def owned(self) -> set:
+        return set(self.controller._shard.owned)
+
+
+def _gen_slice(node: str, gen: str = "a") -> Dict:
+    """A one-device pool whose device carries a flippable ``gen``
+    attribute — the determinism lever of the split-brain drills: the
+    stale holder picks under gen=a, the scenario flips to gen=b, and
+    the survivor can only satisfy gen=b claims, so the stale claim's
+    object is never touched by the survivor (its rv stays put) and the
+    stale commit meets FENCING, not a resourceVersion conflict."""
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-slice"},
+        "spec": {
+            "driver": DRIVER_NAME,
+            "nodeName": node,
+            "pool": {"name": node, "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": [{"name": "tpu-0",
+                         "attributes": {"type": {"string": "chip"},
+                                        "gen": {"string": gen},
+                                        "node": {"string": node}}}],
+        },
+    }
+
+
+def _pinned_gen_claim(clients: ClientSets, name: str, node: str,
+                      gen: str, uid: str) -> Dict:
+    return clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "splitbrain", "uid": uid},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "count": 1,
+             "selectors": [{"attribute": "type", "equals": "chip"},
+                           {"attribute": "gen", "equals": gen},
+                           {"attribute": "node", "equals": node}]}]}},
+    })
+
+
+def _await(predicate: Callable[[], bool], timeout: float,
+           what: str) -> float:
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return (time.monotonic() - t0) * 1e3
+        time.sleep(0.02)
+    raise InvariantViolation(f"timed out awaiting {what}")
+
+
+def _split_brain_drill(run: ScenarioRun, stall_a: Callable[["_Replica"], None],
+                       unstall_a: Callable[["_Replica"], None],
+                       lease_duration: float = 0.6,
+                       renew_deadline_a: float = 0.45,
+                       converge_timeout: float = 30.0) -> Dict:
+    """The shared choreography of both split-brain scenarios: replica A
+    owns every slot and gets stalled (pause or partition — ``stall_a``)
+    with a commit for claim ``stale-1`` parked between pick and write;
+    B adopts A's slots past lease expiry (epoch bump), the fleet's
+    ``gen`` attribute flips so B parks stale-1 and commits fresh-2 onto
+    the SAME device; A's stalled commit then resumes and must be
+    rejected by epoch fencing — zero double-allocs, the rejection
+    counted, A demoted and rejoined."""
+    from tpu_dra_driver.kube import fencing as fencing_mod
+    from tpu_dra_driver.kube.fake import FakeCluster
+    from tpu_dra_driver.kube.sharding import ShardRing, shard_slots
+    from tpu_dra_driver.pkg.metrics import FENCING_REJECTIONS
+
+    cluster = FakeCluster()
+    handle = fencing_mod.install_admission(cluster)
+    observer = ClientSets(cluster=cluster)
+    ring = ShardRing(shard_slots(2))
+    victim_node = "sb-0"
+    for node in (victim_node, "sb-1", "sb-2"):
+        observer.resource_slices.create(_gen_slice(node, gen="a"))
+    victim_slot = ring.owner(victim_node)
+
+    a = _Replica(cluster, "replica-a", ring,
+                 lease_duration=lease_duration,
+                 renew_deadline=renew_deadline_a)
+    b = _Replica(cluster, "replica-b", ring,
+                 lease_duration=lease_duration,
+                 renew_deadline=min(0.45, lease_duration * 0.75))
+    commit_gate = fi.PauseGate()
+    victim_uid = "stale-claim-uid-1"
+    rejections_before = FENCING_REJECTIONS.labels("allocator.commit").value
+    try:
+        with run.step("a_owns_fleet"):
+            a.start()
+            _await(lambda: a.owned() == set(ring.members), converge_timeout,
+                   "replica A owning every slot")
+            b.start()
+        with run.step("stale_pick_parked_mid_batch"):
+            # park A's commit of the victim claim between pick and write
+            commit_gate.pause()
+            fi.arm("allocator.pre-commit",
+                   fi.Rule(mode="pause", gate=commit_gate, seconds=30.0,
+                           match=lambda uid: uid == victim_uid))
+            pre_commit = fi.point_stats("allocator.pre-commit")["fired"]
+            _pinned_gen_claim(observer, "stale-1", victim_node, "a",
+                              victim_uid)
+            _await(lambda: fi.point_stats("allocator.pre-commit")["fired"]
+                   > pre_commit, converge_timeout,
+                   "replica A reaching the fenced commit")
+            epoch_before = a.tokens.epoch_for(victim_slot)
+        with run.step("holder_stalled"):
+            stall_a(a)
+            # the stale holder's belief is now frozen; flip the fleet so
+            # the survivor can never touch the stale claim's object
+            sl = observer.resource_slices.get(f"{victim_node}-slice")
+            sl["spec"]["devices"][0]["attributes"]["gen"]["string"] = "b"
+            observer.resource_slices.update(sl)
+        adoption_ms = run.converge(
+            "survivor_adopts_slot",
+            lambda: victim_slot in b.owned(), timeout=converge_timeout)
+        with run.step("survivor_commits_same_device"):
+            _pinned_gen_claim(observer, "fresh-2", victim_node, "b",
+                              "fresh-claim-uid-2")
+            _await(lambda: (_allocation(observer, "fresh-2", "splitbrain")
+                            is not None), converge_timeout,
+                   "survivor committing the contested device")
+        with run.step("stale_commit_rejected"):
+            wake_t0 = time.monotonic()
+            commit_gate.resume()
+            _await(lambda: FENCING_REJECTIONS.labels(
+                       "allocator.commit").value > rejections_before,
+                   converge_timeout, "the stale commit's rejection")
+        demote_ms = run.converge("stale_holder_demoted",
+                                 lambda: not a.owned(),
+                                 timeout=converge_timeout)
+        with run.step("stale_holder_heals"):
+            unstall_a(a)
+        with run.step("invariants"):
+            # the contested device belongs to the survivor's claim ONLY
+            held = allocated_device_map(observer)
+            assert held.get((victim_node, "tpu-0")) == \
+                "fresh-claim-uid-2", held
+            if _allocation(observer, "stale-1", "splitbrain") is not None:
+                raise InvariantViolation(
+                    "the fenced-out stale commit LANDED")
+            assert handle.rejections, "admission recorded no rejection"
+            check_no_stale_epoch_commits(observer, handle)
+            check_no_double_alloc(observer)
+            check_no_lost_claims(observer, [a.controller, b.controller])
+        # rejoin proof: the demoted replica is back in the competition —
+        # stop the survivor's manager and A must adopt every slot under
+        # a bumped epoch
+        with run.step("demoted_replica_rejoins"):
+            b.manager.stop()
+            _await(lambda: a.owned() == set(ring.members), converge_timeout,
+                   "demoted replica re-adopting after survivor exit")
+            assert a.tokens.epoch_for(victim_slot) > epoch_before
+        with run.step("first_commit_after_rejoin"):
+            # the bench's recovery figure: stale wake -> rejection ->
+            # demote -> rejoin -> first successful fenced commit
+            _pinned_gen_claim(observer, "post-1", "sb-1", "a",
+                              "post-rejoin-uid")
+            _await(lambda: (_allocation(observer, "post-1", "splitbrain")
+                            is not None), converge_timeout,
+                   "rejoined replica's first commit")
+            run.extra["recovery_ms"] = round(
+                (time.monotonic() - wake_t0) * 1e3, 1)
+        run.extra["epoch_before"] = epoch_before
+        run.extra["epoch_after"] = a.tokens.epoch_for(victim_slot)
+        run.extra["fencing_rejections"] = len(handle.rejections)
+        run.extra["adoption_ms"] = adoption_ms
+        run.extra["demote_ms"] = demote_ms
+    finally:
+        commit_gate.resume()
+        fi.disarm("allocator.pre-commit")
+        for rep in (a, b):
+            try:
+                rep.clients.heal()
+                rep.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.exception("split-brain teardown: %s", rep.name)
+    check_no_double_alloc(observer)
+    return run.report()
+
+
+def scenario_pause_past_expiry_mid_batch(
+        converge_timeout: float = 30.0) -> Dict:
+    """A shard holder is PAUSED (GC-pause/SIGSTOP analog) past
+    lease_duration mid-batch: its renew loop and its commit both stall
+    on pause gates, a survivor adopts the slot and commits, and the
+    woken holder's stale commit is rejected by epoch fencing."""
+    run = ScenarioRun("pause_past_expiry_mid_batch")
+    renew_gate = fi.PauseGate()
+
+    def stall(a: "_Replica") -> None:
+        renew_gate.pause()
+        fi.arm("leaderelection.renew",
+               fi.Rule(mode="pause", gate=renew_gate, seconds=30.0,
+                       match=lambda identity: identity == a.name))
+
+    def unstall(a: "_Replica") -> None:
+        renew_gate.resume()
+        fi.disarm("leaderelection.renew")
+
+    try:
+        return _split_brain_drill(run, stall, unstall,
+                                  converge_timeout=converge_timeout)
+    finally:
+        renew_gate.resume()
+        fi.disarm("leaderelection.renew")
+
+
+def scenario_partitioned_holder_wakes(
+        converge_timeout: float = 30.0) -> Dict:
+    """An ASYMMETRIC partition severs only the holder's coordination
+    plane (its `leases` client) while its data plane stays live, and
+    the holder carries the misconfiguration fencing exists to survive:
+    renew_deadline LONGER than lease_duration, so it keeps believing
+    (and writing) long after the survivor adopted its slots. The stale
+    commit is rejected by epoch fencing; healing the partition lets the
+    demoted holder rejoin."""
+    run = ScenarioRun("partitioned_holder_wakes")
+
+    def stall(a: "_Replica") -> None:
+        a.clients.sever("leases")
+
+    def unstall(a: "_Replica") -> None:
+        a.clients.heal("leases")
+
+    report = _split_brain_drill(run, stall, unstall,
+                                # the hostile misconfig: A self-demotes
+                                # only after 30s without a renewal —
+                                # far past B's adoption
+                                renew_deadline_a=30.0,
+                                converge_timeout=converge_timeout)
+    return report
+
+
+def scenario_lease_flap_soak(cycles: int = 4,
+                             converge_timeout: float = 30.0) -> Dict:
+    """The lease-flapping storm soak: two replicas over one fleet with
+    live claim traffic, alternating pause/resume of the current
+    holder's renew loop each cycle — every hand-off must converge
+    (survivor owns everything, traffic keeps flowing, zero
+    double-allocs), lease transitions must climb monotonically, and the
+    final state must satisfy the whole convergence contract."""
+    from tpu_dra_driver.kube import fencing as fencing_mod
+    from tpu_dra_driver.kube.fake import FakeCluster
+    from tpu_dra_driver.kube.sharding import ShardRing, shard_slots
+
+    run = ScenarioRun("lease_flap_soak")
+    cluster = FakeCluster()
+    handle = fencing_mod.install_admission(cluster)
+    observer = ClientSets(cluster=cluster)
+    ring = ShardRing(shard_slots(2))
+    for i in range(4):
+        observer.resource_slices.create(_gen_slice(f"flap-{i}"))
+
+    def transitions_total() -> int:
+        total = 0
+        for slot in ring.members:
+            epoch = fencing_mod.current_epoch(
+                observer.leases, handle.lease_prefix, handle.namespace,
+                slot)
+            total += epoch or 0
+        return total
+
+    a = _Replica(cluster, "flap-a", ring,
+                 lease_duration=0.5, renew_deadline=0.35)
+    b = _Replica(cluster, "flap-b", ring,
+                 lease_duration=0.5, renew_deadline=0.35)
+    replicas = {"flap-a": a, "flap-b": b}
+    traffic = ClaimTraffic(observer, prefix="flap-load",
+                           alloc_timeout=converge_timeout,
+                           pause_between=0.02)
+    gates: Dict[str, fi.PauseGate] = {}
+
+    def pause_renew(name: str) -> None:
+        gate = gates.get(name)
+        if gate is None:
+            gate = gates[name] = fi.PauseGate()
+            fi.arm("leaderelection.renew",
+                   fi.Rule(mode="pause", gate=gate, seconds=30.0,
+                           match=lambda identity, n=name: identity == n))
+        gate.pause()
+
+    try:
+        with run.step("setup"):
+            a.start()
+            _await(lambda: a.owned() == set(ring.members),
+                   converge_timeout, "initial ownership")
+            b.start()
+            traffic.start()
+        flaps = []
+        for cycle in range(cycles):
+            victim = max(replicas.values(), key=lambda r: len(r.owned()))
+            survivor = next(r for r in replicas.values()
+                            if r is not victim)
+            before = transitions_total()
+            with run.step(f"cycle_{cycle}_pause_{victim.name}"):
+                pause_renew(victim.name)
+            ms = run.converge(
+                f"cycle_{cycle}_survivor_owns_all",
+                lambda: survivor.owned() == set(ring.members),
+                timeout=converge_timeout)
+            with run.step(f"cycle_{cycle}_resume"):
+                gates[victim.name].resume()
+                # the woken victim notices B's tenure and self-demotes
+                _await(lambda: not victim.owned(), converge_timeout,
+                       f"{victim.name} demoting after resume")
+            after = transitions_total()
+            if after <= before:
+                raise InvariantViolation(
+                    f"cycle {cycle}: lease transitions did not climb "
+                    f"({before} -> {after}) across a hand-off")
+            check_no_double_alloc(observer)
+            check_no_stale_epoch_commits(observer, handle)
+            flaps.append({"cycle": cycle, "victim": victim.name,
+                          "handoff_ms": ms,
+                          "transitions": after})
+        run.converge("traffic_flowing",
+                     lambda: traffic.served >= cycles,
+                     timeout=converge_timeout)
+        run.extra["flaps"] = flaps
+        run.extra["lease_transitions_total"] = transitions_total()
+    finally:
+        for gate in gates.values():
+            gate.resume()
+        fi.disarm("leaderelection.renew")
+        run.extra["traffic"] = traffic.stop()
+        for rep in replicas.values():
+            try:
+                rep.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.exception("flap soak teardown: %s", rep.name)
+    if run.extra["traffic"]["failures"]:
+        raise InvariantViolation(
+            f"soak traffic failed: "
+            f"{run.extra['traffic']['failure_samples']}")
+    check_no_double_alloc(observer)
+    check_no_stale_epoch_commits(observer, handle)
     return run.report()

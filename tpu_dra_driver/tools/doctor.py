@@ -47,6 +47,12 @@ _SEVERITY_ORDER = {CRITICAL: 0, WARNING: 1, INFO: 2}
 #: watch-mux p99 lag beyond this is an event-plane health finding.
 MUX_LAG_P99_THRESHOLD_S = 1.0
 
+#: leadership transitions at-or-above this within the resample window
+#: (or, without a resample, in the whole scrape) flag LEASE_FLAPPING —
+#: a healthy fleet transitions once per hand-off, not continuously.
+LEASE_FLAP_DELTA_THRESHOLD = 4
+LEASE_FLAP_ABSOLUTE_THRESHOLD = 20
+
 
 @dataclass
 class Finding:
@@ -175,6 +181,21 @@ def collect_endpoint(host_port: str, timeout: float = 3.0) -> Dict:
     return art
 
 
+def resample_metrics(host_port: str, art: Dict, timeout: float) -> None:
+    """Take the second /metrics sample (``metrics_resample``) for an
+    already-collected component artifact, so rate-shaped findings
+    (LEASE_FLAPPING) can distinguish ongoing churn from lifetime
+    totals. :func:`collect` sleeps ONCE across the whole fleet and then
+    resamples everyone — one shared wall-clock delta window."""
+    if "metrics" not in art:
+        return
+    try:
+        art["metrics_resample"] = _http_get(
+            f"http://{host_port}/metrics", timeout)
+    except Exception as e:  # noqa: BLE001 — recorded per-surface
+        art["errors"]["metrics_resample"] = f"{type(e).__name__}: {e}"
+
+
 def collect_state_dir(path: str) -> Dict:
     """Checkpoint files and quarantined corpses under one plugin state
     dir (the ``<checkpoint>.corrupt-<n>`` quarantine convention)."""
@@ -210,13 +231,22 @@ def collect_events(clients, limit: int = 200) -> List[Dict]:
 def collect(endpoints: Dict[str, str],
             state_dirs: Optional[Dict[str, str]] = None,
             clients=None,
-            timeout: float = 3.0) -> Dict:
+            timeout: float = 3.0,
+            resample_after: float = 0.0) -> Dict:
     """The whole bundle: per-component debug surfaces + checkpoint
     state + recent Events."""
+    # one shared resample window for the WHOLE fleet: sample everyone,
+    # sleep once, resample everyone — collection time stays O(sleep),
+    # and every component's delta covers the same wall-clock interval
+    components = {name: collect_endpoint(hp, timeout=timeout)
+                  for name, hp in endpoints.items()}
+    if resample_after > 0:
+        time.sleep(resample_after)
+        for name, hp in endpoints.items():
+            resample_metrics(hp, components[name], timeout)
     bundle: Dict = {
         "generated_unix": round(time.time(), 3),
-        "components": {name: collect_endpoint(hp, timeout=timeout)
-                       for name, hp in endpoints.items()},
+        "components": components,
         "state_dirs": {name: collect_state_dir(p)
                        for name, p in (state_dirs or {}).items()},
     }
@@ -296,6 +326,41 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
             f"(threshold {MUX_LAG_P99_THRESHOLD_S}s): informers are "
             f"falling behind the watch streams",
             {"p99_upper_bound_s": lag_p99}))
+
+    rejections = metric_value(samples, "dra_fencing_rejections_total")
+    if rejections > 0:
+        by_site = {labels.get("site", "?"): value for labels, value in
+                   samples.get("dra_fencing_rejections_total", [])}
+        out.append(Finding(
+            WARNING, "FENCING_REJECTIONS", name,
+            f"{int(rejections)} allocation-plane write(s) were rejected "
+            f"by epoch fencing: a paused/partitioned replica acted on a "
+            f"lease it no longer held (each rejection PREVENTED a "
+            f"split-brain double-allocation; check why the holder "
+            f"stalled)",
+            {"by_site": by_site}))
+
+    flap_now = metric_value(samples, "dra_leader_transitions_total")
+    resample = (parse_metrics_text(art["metrics_resample"])
+                if "metrics_resample" in art else None)
+    if resample is not None:
+        delta = metric_value(resample,
+                             "dra_leader_transitions_total") - flap_now
+        if delta >= LEASE_FLAP_DELTA_THRESHOLD:
+            out.append(Finding(
+                WARNING, "LEASE_FLAPPING", name,
+                f"{int(delta)} leadership transition(s) within the "
+                f"bundle's resample window: leases are flapping "
+                f"(renewals racing expiry — look for clock trouble, "
+                f"API latency, or overloaded holders)",
+                {"delta_in_window": int(delta)}))
+    elif flap_now >= LEASE_FLAP_ABSOLUTE_THRESHOLD:
+        out.append(Finding(
+            WARNING, "LEASE_FLAPPING", name,
+            f"{int(flap_now)} lifetime leadership transitions on this "
+            f"process: likely lease flapping (collect with --resample "
+            f"to confirm it is ongoing)",
+            {"total": int(flap_now)}))
 
     quarantined = metric_value(samples, "dra_checkpoint_quarantined_total")
     if quarantined > 0:
