@@ -2,7 +2,7 @@
 
 PYTHON ?= python3
 
-.PHONY: all native test test-fast lint typecheck bench demo e2e e2e-kind e2e-sim clean protos
+.PHONY: all native test test-fast lint typecheck bench soak demo e2e e2e-kind e2e-sim clean protos
 
 all: native
 
@@ -32,6 +32,13 @@ test-fast: native lint typecheck
 
 bench: native
 	$(PYTHON) bench.py
+
+# Compressed-week endurance soak: 10k nodes, composed adversity tape,
+# SLO-gated with leak sentinels (docs/chaos.md "Endurance soak").
+# Exits nonzero on any exhausted budget / leaking sentinel / violated
+# invariant; the report JSON lands on stdout.
+soak: native
+	$(PYTHON) -m tpu_dra_driver.testing.soak
 
 # Full e2e against a real kind cluster (docker+kind+helm+kubectl needed;
 # fake TPU backend — no hardware). Reference bar: make bats.

@@ -189,3 +189,75 @@ def test_hbm_quantity_capacity_selector_allocates(tmp_path):
          '.isGreaterThan(quantity("100Ti"))'}}]}])
     with pytest.raises(AllocationError):
         Allocator(clients).allocate("cq2", "ns")
+
+
+# ---------------------------------------------------------------------------
+# aborted attempts (endurance-soak regression, seed 20260804): no
+# availability verdict, no latency sample, no Warning Event
+# ---------------------------------------------------------------------------
+
+
+def _result_counts():
+    from tpu_dra_driver.pkg.metrics import ALLOCATION_RESULTS
+    return {k[0]: v for k, v in ALLOCATION_RESULTS.values().items()}
+
+
+def test_claim_vanished_mid_allocation_is_aborted_not_error(tmp_path):
+    """Regression from the 10k-node compressed-week soak (seed
+    20260804): informer stores lag DELETE dispatch for seconds at fleet
+    scale, so the retry backstop re-admits already-deleted claims and
+    every attempt counted as an availability error (~8% of attempts)
+    and emitted an AllocationFailed Warning on a dead object. A
+    vanished claim is now result=aborted — outside the availability
+    SLO's traffic, no latency sample, no Event."""
+    from tpu_dra_driver.kube.events import EventRecorder
+    from tpu_dra_driver.pkg.metrics import ALLOCATION_SECONDS
+
+    clients, _ = _cluster(tmp_path)
+    _mkclaim(clients, "ghost", [{"name": "t", "count": 1}])
+    stale = clients.resource_claims.get("ghost", "ns")
+    clients.resource_claims.delete("ghost", "ns")
+
+    recorder = EventRecorder(clients.events)
+    before = _result_counts()
+    lat_before = sum(s.count
+                     for s in ALLOCATION_SECONDS.snapshots().values())
+    a = Allocator(clients, recorder=recorder)
+    res = a.allocate_batch([stale])[stale["metadata"]["uid"]]
+    assert res.aborted, res
+    assert res.error and "vanished" in res.error
+    after = _result_counts()
+    assert after.get("aborted", 0) == before.get("aborted", 0) + 1
+    assert after.get("error", 0) == before.get("error", 0)
+    assert sum(s.count for s in ALLOCATION_SECONDS.snapshots().values()) \
+        == lat_before
+    recorder.stop()
+    assert not [e for e in clients.events.list()
+                if e.get("reason") == "AllocationFailed"]
+
+
+def test_stale_route_refusal_is_aborted_not_error(tmp_path):
+    """The sibling false positive: a replica allocating a claim whose
+    routed slot it no longer holds refuses pre-commit (fencing). The
+    rightful owner's retry is the attempt availability judges; this
+    side's refusal is a redirect — result=aborted, and the claim still
+    parks for re-route (error set)."""
+    from tpu_dra_driver.kube.fencing import StaleWriterError
+
+    class _UnheldFencing:
+        def epochs(self, uid, pools):
+            raise StaleWriterError(
+                "slot shard-0 is not held by this process; refusing "
+                "to write for its pools")
+
+    clients, _ = _cluster(tmp_path)
+    _mkclaim(clients, "c1", [{"name": "t", "count": 1}])
+    claim = clients.resource_claims.get("c1", "ns")
+    before = _result_counts()
+    res = Allocator(clients, fencing=_UnheldFencing()) \
+        .allocate_batch([claim])[claim["metadata"]["uid"]]
+    assert res.aborted, res
+    assert res.error and "fencing" in res.error
+    after = _result_counts()
+    assert after.get("aborted", 0) == before.get("aborted", 0) + 1
+    assert after.get("error", 0) == before.get("error", 0)

@@ -750,3 +750,59 @@ def test_same_counter_set_name_on_two_nodes_does_not_conflate():
     got = {winners(a.allocate("c0", "ns"))[0],
            winners(a.allocate("c1", "ns"))[0]}
     assert got == {("node-0", "tpu-0"), ("node-1", "tpu-0")}
+
+
+# ---------------------------------------------------------------------------
+# reserve-refusal re-pick (ISSUE 11): a lost race takes the next free
+# device instead of surfacing an attempt error
+# ---------------------------------------------------------------------------
+
+
+def test_reserve_refusal_repicks_next_free_device():
+    """Regression from the 10k-node endurance soak (seed 20260804):
+    with canonical pick order, every concurrent allocator contends on
+    the FIRST free device, and surfacing the lost race as an error
+    (park + backstop retry) re-races the identical pick — ~35% of
+    attempts burned as availability errors at fleet scale. A refused
+    reservation must instead refresh the usage view and re-pick: the
+    loser takes the next free device and the claim allocates."""
+    from tpu_dra_driver.kube.allocator import _BatchState
+
+    clients = ClientSets()
+    clients.resource_slices.create(make_slice(
+        "race-0", [make_device(f"tpu-{d}", type="chip")
+                   for d in range(3)]))
+    snap = build_snapshot(clients.resource_slices.list())
+    ledger = UsageLedger(DRIVER, snap.get_device)
+    alloc = Allocator(clients, DRIVER, ledger=ledger)
+    # a rival (another worker / another replica via the grant lane)
+    # holds the canonical-first device...
+    assert ledger.reserve("rival-uid",
+                          [snap.devices[("race-0", "tpu-0")]],
+                          snap.counter_caps)
+    # ...but OUR batch state predates that reservation (the stale
+    # window between snapshot and reserve)
+    stale_state = _BatchState(set(), {})
+    claim = make_claim(clients, "loser", [
+        {"name": "tpu", "count": 1,
+         "selectors": [{"attribute": "type", "equals": "chip"}]}])
+    updated, committed = alloc._allocate_one(claim, snap, stale_state,
+                                             None)
+    assert committed
+    picked = [(r["pool"], r["device"]) for r in
+              updated["status"]["allocation"]["devices"]["results"]]
+    assert picked == [("race-0", "tpu-1")], (
+        "the loser must re-pick the next free device, not error out")
+    # bounded: when the rivals hold EVERYTHING, the claim still errors
+    # (and parks) rather than spinning
+    ledger2 = UsageLedger(DRIVER, snap.get_device)
+    for d in range(3):
+        assert ledger2.reserve(f"rival-{d}",
+                               [snap.devices[("race-0", f"tpu-{d}")]],
+                               snap.counter_caps)
+    alloc2 = Allocator(clients, DRIVER, ledger=ledger2)
+    claim2 = make_claim(clients, "doomed", [
+        {"name": "tpu", "count": 1,
+         "selectors": [{"attribute": "type", "equals": "chip"}]}])
+    with pytest.raises(AllocationError):
+        alloc2._allocate_one(claim2, snap, _BatchState(set(), {}), None)

@@ -263,6 +263,32 @@ def test_teardown_on_delete(harness):
     assert not harness.clients.compute_domain_cliques.list()
 
 
+def test_teardown_removes_per_cd_run_dir(harness):
+    """Regression: the 10k-node compressed-week soak (seed 20260804)
+    failed its checkpoint_bytes leak sentinel — monotone ~930 bytes per
+    epoch across all 7 epochs — because a CD teardown left every member
+    node's per-CD run dir (hosts + worker-env.json) behind: the hostPath
+    outlives the pod, so a long-lived node accumulates one corpse dir
+    per ComputeDomain ever scheduled on it. A graceful daemon stop must
+    remove its own run dir."""
+    harness.create_compute_domain("cd1", "user-ns", 2, "wl-rct")
+    uid = harness.clients.compute_domains.get(
+        "cd1", "user-ns")["metadata"]["uid"]
+    results = _prepare_concurrently(harness, uid, [0, 1])
+    assert all(r.error is None for r in results.values()), results
+    # the daemons rendered their per-CD run dirs
+    dirs = [os.path.join(harness.host(i).hosts_dir, uid) for i in (0, 1)]
+    assert all(os.path.isdir(d) for d in dirs), dirs
+    for i in (0, 1):
+        harness.host(i).cd_plugin.unprepare_resource_claims([f"w{i}"])
+    harness.clients.compute_domains.delete("cd1", "user-ns")
+    harness.wait_for(
+        lambda: not harness.clients.pods.list(namespace=DRIVER_NAMESPACE),
+        what="daemon pods stopped")
+    harness.wait_for(lambda: not any(os.path.exists(d) for d in dirs),
+                     what="per-CD run dirs removed")
+
+
 def _exists(client, name, ns):
     try:
         client.get(name, ns)

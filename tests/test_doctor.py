@@ -322,3 +322,152 @@ def test_finding_lease_flapping_absolute_fallback():
     f = next(f for f in doctor.run_findings(noisy)
              if f.code == "LEASE_FLAPPING")
     assert "--resample" in f.message
+
+
+def test_finding_ledger_residue_from_allocator_surface():
+    """The residue audit rides /debug/allocator (the same surface the
+    soak's residue sentinel reads): any extra/missing device flags
+    LEDGER_RESIDUE with the per-slot breakdown; a clean audit stays
+    quiet."""
+    dirty = {"components": {"alloc": {
+        "metrics": "",
+        "allocator": {"residue": {
+            "committed": 5, "api_allocated": 4,
+            "extra_count": 2, "missing_count": 1,
+            "extra": [["pool-a", "tpu-0"], ["pool-b", "tpu-1"]],
+            "missing": [["pool-c", "tpu-2"]],
+            "by_slot": {"shard-0": {"extra": 2, "missing": 0},
+                        "shard-1": {"extra": 0, "missing": 1}}}},
+    }}}
+    f = next(f for f in doctor.run_findings(dirty)
+             if f.code == "LEDGER_RESIDUE")
+    assert f.severity == doctor.WARNING
+    assert f.details["extra_count"] == 2
+    assert f.details["by_slot"]["shard-1"]["missing"] == 1
+    assert "ledger" in f.message
+    clean = {"components": {"alloc": {
+        "metrics": "",
+        "allocator": {"residue": {"committed": 5, "api_allocated": 5,
+                                  "extra_count": 0, "missing_count": 0,
+                                  "extra": [], "missing": []}},
+    }}}
+    assert not [f for f in doctor.run_findings(clean)
+                if f.code == "LEDGER_RESIDUE"]
+
+
+def test_finding_leak_suspected_from_gauge_resample_deltas():
+    """Monotone growth of the leak-shaped gauges within the resample
+    window flags LEAK_SUSPECTED; a flat fleet stays quiet no matter its
+    absolute counts."""
+    first = _metrics_text(
+        dra_watch_streams_active=[({"transport": "async"}, 40)],
+        dra_allocator_parked_claims=[({}, 3)])
+    grown = _metrics_text(
+        dra_watch_streams_active=[({"transport": "async"}, 44)],
+        dra_allocator_parked_claims=[({}, 3)])
+    flagged = {"components": {"ctrl": {
+        "metrics": first, "metrics_resample": grown}}}
+    f = next(f for f in doctor.run_findings(flagged)
+             if f.code == "LEAK_SUSPECTED")
+    assert f.severity == doctor.WARNING
+    assert f.details["grew"] == {"dra_watch_streams_active": 4.0}
+    stable = {"components": {"ctrl": {
+        "metrics": first, "metrics_resample": first}}}
+    assert not [f for f in doctor.run_findings(stable)
+                if f.code == "LEAK_SUSPECTED"]
+
+
+def test_finding_leak_suspected_from_state_dir_growth():
+    """Checkpoint-dir byte growth across the resample window is the
+    disk half of the leak sentinel: past the floor flags the dir; the
+    normal jitter of one in-flight prepare does not."""
+    def dir_state(n_bytes):
+        return {"node0": {"path": "/var/lib/x", "quarantined": [],
+                          "checkpoints": [{"file": "checkpoint.json",
+                                           "bytes": n_bytes}]}}
+    grown = {"components": {},
+             "state_dirs": dir_state(1000),
+             "state_dirs_resample": dir_state(
+                 1000 + doctor.LEAK_STATE_DIR_BYTES_THRESHOLD)}
+    f = next(f for f in doctor.run_findings(grown)
+             if f.code == "LEAK_SUSPECTED")
+    assert f.component == "node0"
+    assert f.details["bytes_grown"] == doctor.LEAK_STATE_DIR_BYTES_THRESHOLD
+    jitter = {"components": {},
+              "state_dirs": dir_state(1000),
+              "state_dirs_resample": dir_state(1200)}
+    assert not [f for f in doctor.run_findings(jitter)
+                if f.code == "LEAK_SUSPECTED"]
+
+
+def test_collect_resamples_state_dirs_and_bundles_them(tmp_path):
+    """collect(resample_after=...) snapshots state dirs on BOTH sides
+    of the shared window and the tarball carries the resample."""
+    state = tmp_path / "state"
+    state.mkdir()
+    cp = state / "checkpoint.json"
+    cp.write_text("{}")
+    bundle = doctor.collect({}, state_dirs={"node0": str(state)},
+                            resample_after=0.01)
+    assert "state_dirs_resample" in bundle
+    assert bundle["state_dirs_resample"]["node0"]["checkpoints"]
+    out = str(tmp_path / "b.tar.gz")
+    doctor.write_bundle(bundle, doctor.run_findings(bundle), out)
+    import tarfile
+    with tarfile.open(out) as tar:
+        assert "state_dirs_resample.json" in tar.getnames()
+
+
+def test_live_debug_allocator_residue_matches_ledger(tmp_path):
+    """End to end over a real controller: /debug/allocator's residue
+    audit reports zero for a settled fleet and flags a planted ledger
+    orphan (the leak direction) — committed keys vs the informer's view
+    of live API allocations."""
+    import time as _time
+
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        AllocationControllerConfig,
+    )
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.testing.scenarios import synthetic_slice
+
+    clients = ClientSets()
+    clients.resource_slices.create(synthetic_slice("res-0", 2))
+    ctrl = AllocationController(
+        clients, AllocationControllerConfig(workers=1))
+    ctrl.start()
+    try:
+        clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c1", "namespace": "ns"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "count": 1,
+                 "selectors": [{"attribute": "type",
+                                "equals": "chip"}]}]}},
+        })
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            res = ctrl.ledger_residue()
+            if res["committed"] == 1 and res["extra_count"] == 0 \
+                    and res["missing_count"] == 0:
+                break
+            _time.sleep(0.02)
+        state = ctrl.debug_state()
+        assert state["residue"]["committed"] == 1
+        assert state["residue"]["extra_count"] == 0
+        assert state["residue"]["missing_count"] == 0
+        # plant a ledger orphan: a committed record the API never saw
+        ctrl.ledger.observe_claim({
+            "metadata": {"name": "ghost", "namespace": "ns",
+                         "uid": "ghost-uid", "resourceVersion": "999"},
+            "status": {"allocation": {"devices": {"results": [
+                {"driver": ctrl._config.driver_name, "pool": "res-0",
+                 "device": "tpu-1"}]}}},
+        })
+        res = ctrl.ledger_residue()
+        assert res["extra_count"] == 1
+        assert res["extra"] == [["res-0", "tpu-1"]]
+    finally:
+        ctrl.stop()

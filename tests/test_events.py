@@ -228,3 +228,78 @@ def test_cd_controller_emits_cdready_event():
         assert "CDReady" in reasons, reasons
     finally:
         ctl.stop()
+
+
+# ---------------------------------------------------------------------------
+# recorder lifecycle (ISSUE 11): the endurance soak's thread sentinel
+# caught event-recorder workers stranded by in-process restarts
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_stop_reaps_worker_promptly_and_drops_after():
+    """Regression for the leak the compressed-week soak flushed out
+    (seed 11, threads sentinel monotone 42 -> 49 across epochs 3-6):
+    every stranded thread was an ``event-recorder-*`` worker, because
+    nothing stopped a shut-down component's recorder — the worker
+    lingered for the full 30 s idle-exit per restart cycle.
+    ``stop()`` must flush, reap the worker within its bounded timeout
+    (not 30 s), and drop (counted) anything enqueued afterwards."""
+    import threading
+    import time
+
+    clients = ClientSets()
+    rec = ev.EventRecorder(clients.events, component="stop-test")
+    ref = {"kind": "Node", "name": "n0", "namespace": ""}
+    rec.warning(ref, "PrepareFailed", "pre-stop event")
+    assert rec.flush(timeout=5.0)
+    worker = rec._worker
+    assert worker is not None and worker.is_alive()
+    t0 = time.monotonic()
+    rec.stop(timeout=2.0)
+    assert time.monotonic() - t0 < 5.0          # not the 30 s idle exit
+    deadline = time.monotonic() + 2.0
+    while worker.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not worker.is_alive()
+    assert not [t for t in threading.enumerate()
+                if t.name == "event-recorder-stop-test" and t.is_alive()]
+    # the pre-stop event landed; post-stop enqueues are dropped and
+    # never respawn a worker
+    assert len(clients.events.list()) == 1
+    rec.warning(ref, "PrepareFailed", "post-stop event")
+    assert rec._worker is None
+    assert len(clients.events.list()) == 1
+
+
+def test_plugin_shutdown_stops_its_recorder(tmp_path):
+    """The wiring half of the regression: a kubelet plugin's shutdown
+    closes its recorder, so MiniFleet.restart_node / upgrade cycles
+    cannot accumulate one worker per plugin generation."""
+    from tpu_dra_driver.pkg import featuregates as fg
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    clients = ClientSets()
+    plugin = TpuKubeletPlugin(
+        clients, FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8")),
+        PluginConfig(node_name="rec-node", state_dir=str(tmp_path / "s"),
+                     cdi_root=str(tmp_path / "c"),
+                     gates=fg.FeatureGates()))
+    plugin.start()
+    plugin.shutdown()
+    assert plugin._events._closed
+
+
+def test_cross_shard_allocators_share_the_controller_recorder():
+    """Cross-shard allocators are rebuilt on every hand-off/demote; a
+    private recorder per rebuild re-opens the worker leak. They must
+    share the controller's recorder object."""
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationControllerConfig,
+        ShardGroup,
+    )
+
+    group = ShardGroup(ClientSets(), 2,
+                       AllocationControllerConfig(workers=1))
+    for ctrl in group.controllers.values():
+        assert ctrl.allocator._recorder is ctrl.events

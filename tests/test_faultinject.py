@@ -201,3 +201,23 @@ def test_disarm_restores_noop():
     fi.disarm("p.off")
     assert not fi.armed()
     fi.fire("p.off")                     # clean no-op again
+
+
+def test_remove_rule_is_surgical():
+    """A bounded adversity window (the soak's weather) must end WITHOUT
+    disturbing other rules armed on the same point — disarm() clears
+    the whole point, remove_rule() detaches exactly one."""
+    keeper = fi.arm("p.surgical", fi.Rule(mode="latency", seconds=0.0))
+    weather = fi.arm("p.surgical", fi.Rule(mode="fail"))
+    with pytest.raises(fi.FaultInjected):
+        fi.fire("p.surgical")
+    assert fi.remove_rule("p.surgical", weather) is True
+    fi.fire("p.surgical")                 # keeper (0s latency) survives
+    assert keeper.calls >= 1
+    assert fi.armed()                     # still armed: keeper remains
+    # removing the last rule disarms the subsystem fast path
+    assert fi.remove_rule("p.surgical", keeper) is True
+    assert not fi.armed()
+    # idempotent / unknown rule or point
+    assert fi.remove_rule("p.surgical", weather) is False
+    assert fi.remove_rule("p.never-registered-here", weather) is False

@@ -437,3 +437,123 @@ def test_partition_soak_repeated_pause_cycles_under_traffic():
     assert report["traffic"]["failures"] == 0
     transitions = [f["transitions"] for f in report["flaps"]]
     assert transitions == sorted(transitions)
+
+
+# ---------------------------------------------------------------------------
+# endurance soak (ISSUE 11): the tier-1 smoke runs the SAME SoakEngine
+# code path as the 10k-node compressed week in bench.py — virtual-time
+# compression, not a separate implementation
+# ---------------------------------------------------------------------------
+
+
+def test_soak_smoke_tier1():
+    """A deterministic two-virtual-day soak over a small fleet: the
+    full tape (drains, storms, upgrades, churn, lease flaps/partitions,
+    weather, CD cycles) over continuous mixed traffic, with the SLO
+    engine as the pass/fail authority, the invariant sweep at every
+    epoch boundary, and every leak sentinel flat. run_soak RAISES on
+    any violated invariant, exhausted budget, or leaking sentinel — the
+    assertions here pin the report shape."""
+    from tpu_dra_driver.testing.soak import SoakConfig, run_soak
+
+    cfg = SoakConfig.smoke(seed=11)
+    report = run_soak(cfg)
+    assert report["epochs_completed"] == cfg.epochs
+    assert report["budget_exhaustions"] == []
+    assert report["invariant_violations"] == 0
+    assert all(r["verdict"] == "flat"
+               for r in report["sentinels"].values()), report["sentinels"]
+    # every epoch row names its dominant critical-path segment and
+    # carries per-SLO budget remaining + sentinel samples
+    assert len(report["epochs"]) == cfg.epochs
+    for row in report["epochs"]:
+        assert row["traces_analyzed"] > 0
+        assert row["dominant_segment"], row
+        assert set(row["slo"]) == {s
+                                   for s in report["slo_cumulative"]}
+        assert row["sentinels"]
+    # the week's adversity actually happened: every source on the tape
+    # executed at least once, and traffic flowed throughout
+    for kind in ("drain", "undrain", "storm", "service", "upgrade",
+                 "churn", "weather", "cd_cycle"):
+        assert report["events_executed"].get(kind, 0) >= 1, kind
+    stalls = (report["events_executed"].get("flap", 0)
+              + report["events_executed"].get("partition", 0))
+    assert stalls >= 2
+    for kind in ("chip", "sub"):
+        claims = sum(t["claims"] for p, t in report["traffic"].items()
+                     if p.startswith(kind))
+        assert claims > 10, (kind, report["traffic"])
+    assert report["traffic_totals"]["claims"] > 20
+    # every SLO kept budget over the whole run (the smoke injects
+    # latency weather but no failures)
+    for name, row in report["slo_cumulative"].items():
+        assert row["budget_remaining"] > 0, (name, row)
+
+
+@pytest.mark.slow
+def test_soak_full_compressed_week_small_fleet():
+    """The @slow tier: the compressed-week config (7 virtual days, 7
+    epochs, fail-mode weather armed) at a reduced node count so the
+    full-fat judgment path — availability budgets absorbing REAL
+    injected prepare failures — runs in CI without the 10k fleet the
+    bench carries."""
+    from tpu_dra_driver.testing.soak import SoakConfig, run_soak
+
+    cfg = SoakConfig.compressed_week(seed=11)
+    cfg.n_synthetic_nodes = 64
+    cfg.epoch_wall_s = 3.0
+    report = run_soak(cfg)
+    assert report["epochs_completed"] == 7
+    assert report["budget_exhaustions"] == []
+    assert all(r["verdict"] == "flat"
+               for r in report["sentinels"].values())
+    assert report["events_executed"].get("weather", 0) >= 7
+
+
+def test_park_after_delete_cannot_orphan_refs():
+    """Fifth 10k-soak finding (seed 20260804): a claim DELETED while
+    its batch was in flight got re-parked by the batch's error path
+    AFTER its DELETE event had already been processed — an orphaned
+    parked ref (Event + gauge) that no future event clears. The soak's
+    parked-claims sentinel measured the drift: 9 → 48 refs, monotone,
+    over one compressed week. Two layers now close it: _park checks
+    the informer store before marking, and the worker backstop prunes
+    any ref whose claim no longer exists."""
+    clients, ctrl = _controller_fleet(devices_per_node=1)
+    g0 = ALLOCATOR_PARKED_CLAIMS.value
+    ctrl.start()
+    try:
+        _claim(clients, "fits")
+        _claim(clients, "victim")
+        _wait(lambda: ctrl.parked_claims() == [("ns", "victim")],
+              what="victim parked")
+        # the claim disappears; its DELETE event drains normally
+        clients.resource_claims.delete("victim", "ns")
+        _wait(lambda: not ctrl.parked_claims(), what="ref cleared")
+
+        # layer 1: the park-after-delete race itself — the batch's
+        # error path tries to park a claim whose DELETE was already
+        # processed; the store check must refuse
+        ctrl._park(("ns", "victim"),
+                   {"metadata": {"name": "victim", "namespace": "ns",
+                                 "uid": "stale-uid"}},
+                   "late batch error")
+        assert ctrl.parked_claims() == []
+        assert ALLOCATOR_PARKED_CLAIMS.value - g0 == 0
+
+        # layer 2: an orphan planted past the store check (the residual
+        # delete-between-check-and-mark window) is pruned by the
+        # worker backstop within ~a retry interval
+        with ctrl._cond:
+            ctrl._mark_parked_locked(
+                ("ns", "victim"),
+                {"metadata": {"name": "victim", "namespace": "ns",
+                              "uid": "stale-uid"}},
+                "planted orphan")
+        assert ctrl.parked_claims() == [("ns", "victim")]
+        _wait(lambda: not ctrl.parked_claims(), timeout=5.0,
+              what="backstop pruned the orphan")
+        assert ALLOCATOR_PARKED_CLAIMS.value - g0 == 0
+    finally:
+        ctrl.stop()

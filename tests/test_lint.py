@@ -558,3 +558,66 @@ def test_no_sleep_polling_in_cd_reconcile_paths():
         "time.sleep-based polling reintroduced in reconcile/allocation "
         f"paths: {offenders} — use an informer/watch wake or an "
         "Event.wait with an event that cuts it short")
+
+
+# ---------------------------------------------------------------------------
+# adversity-source coverage (endurance-soak PR): every source in the
+# soak scheduler's catalog grounds in a DRILLED fault point or a real
+# scenario/harness primitive — the soak must compose proven machinery,
+# not invent untested hostility
+# ---------------------------------------------------------------------------
+
+
+def test_adversity_sources_map_to_drilled_primitives():
+    # import every fire-site module so the fault registry is complete
+    import tpu_dra_driver.kube.leaderelection  # noqa: F401
+    import tpu_dra_driver.plugin.device_state  # noqa: F401
+    import tpu_dra_driver.testing.scenarios as scenarios  # noqa: F401
+    import tpu_dra_driver.testing.harness as harness  # noqa: F401
+    import tpu_dra_driver.tpulib.fake  # noqa: F401
+    from tpu_dra_driver.pkg import faultinject as fi
+    from tpu_dra_driver.testing.soak import (
+        ADVERSITY_SOURCES,
+        KIND_SOURCE,
+        SoakEngine,
+    )
+
+    from tests.test_chaos_drills import DRILLED_POINTS
+
+    drilled = set(DRILLED_POINTS) | set(_EXTRA_DRILLED)
+    registered = set(fi.catalog())
+    modules = {"scenarios": scenarios, "harness": harness}
+    for name, src in ADVERSITY_SOURCES.items():
+        kind, *refs = src.primitive
+        assert refs, name
+        if kind == "fault":
+            for point in refs:
+                assert point in registered, (
+                    f"adversity source {name!r} grounds in unregistered "
+                    f"fault point {point!r}")
+                assert point in drilled, (
+                    f"adversity source {name!r} grounds in UNDRILLED "
+                    f"fault point {point!r} — drill it first")
+        elif kind == "scenario":
+            for ref in refs:
+                mod_name, _, attr_path = ref.partition(":")
+                obj = modules[mod_name]
+                for attr in attr_path.split("."):
+                    obj = getattr(obj, attr, None)
+                    assert obj is not None, (
+                        f"adversity source {name!r}: stale scenario "
+                        f"primitive {ref!r} (attr {attr!r} gone)")
+                assert callable(obj), (name, ref)
+        else:
+            raise AssertionError(
+                f"adversity source {name!r}: unknown primitive kind "
+                f"{kind!r}")
+    # stale-entry checks: the tape kinds, executor dispatch table and
+    # source catalog must cover each other exactly — an orphaned entry
+    # in any of the three fails
+    assert set(KIND_SOURCE) == set(SoakEngine.EXECUTORS), (
+        "tape kinds and executors diverged")
+    assert set(KIND_SOURCE.values()) == set(ADVERSITY_SOURCES), (
+        "source catalog and tape kinds diverged")
+    for kind, method in SoakEngine.EXECUTORS.items():
+        assert callable(getattr(SoakEngine, method, None)), (kind, method)

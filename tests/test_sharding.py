@@ -821,3 +821,213 @@ def test_cross_replica_claim_commits_without_parking_live():
     finally:
         for ctrl in controllers:
             ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# lease-driven adoption barrier (ISSUE 11): the endurance soak's
+# double-allocation, reduced to its mechanism
+# ---------------------------------------------------------------------------
+
+
+def test_adoption_reconciles_ledger_from_authoritative_api_list():
+    """Regression for the bug the 10k-node compressed-week soak caught
+    (seed 20260804, epoch 0: device ('soak-node-2','tpu-0') held by
+    two claims): lease-driven slot adoption re-derived the adopter's
+    ledger from its claim INFORMER's view only. At fleet scale,
+    informer dispatch (starved behind 40k-device snapshot copies) lags
+    past lease expiry, so a device the previous owner committed
+    moments before the flip was invisible to the adopter, looked free,
+    and was handed to a second claim — both commits under valid
+    tenures, which epoch fencing by design does not reject. (The
+    in-process drill helper ShardGroup.hand_off always carried an
+    explicit informer-currency barrier and documented the production
+    assumption this test now retires.)
+
+    The lagging informer is modeled exactly: the controller is built
+    but NOT started, so its informer has delivered nothing, while the
+    API already holds the previous owner's committed allocation.
+    Adoption must pick the allocation up from the authoritative LIST."""
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        ShardWiring,
+    )
+
+    clients = ClientSets()
+    clients.resource_slices.create({
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": "adopt-0-slice"},
+        "spec": {"driver": DRIVER, "nodeName": "adopt-0",
+                 "pool": {"name": "adopt-0", "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": [{"name": "tpu-0",
+                              "attributes": {"type": {"string": "chip"}}}]},
+    })
+    # the previous owner's commit, already in the API
+    clients.resource_claims.create({
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "committed-by-predecessor",
+                     "namespace": "ns", "uid": "prior-uid"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "count": 1,
+             "selectors": [{"attribute": "type", "equals": "chip"}]}]}},
+        "status": {"allocation": {"devices": {"results": [
+            {"driver": DRIVER, "pool": "adopt-0", "device": "tpu-0",
+             "request": "tpu"}]}}},
+    })
+    ring = ShardRing(shard_slots(2))
+    slot = ring.owner("adopt-0")
+    ctrl = AllocationController(
+        clients, AllocationControllerConfig(workers=1),
+        shard=ShardWiring(ring, owned=set()), identity="adopter")
+    # informer never started == informer infinitely lagged
+    assert ctrl.ledger.committed_keys() == set()
+    ctrl.set_owned_slots({slot})
+    assert ("adopt-0", "tpu-0") in ctrl.ledger.committed_keys(), (
+        "adoption must reconcile against the authoritative API list, "
+        "not the informer's (possibly stale) view")
+    # and the adopted holding refuses a conflicting reservation
+    snap = build_snapshot(clients.resource_slices.list(),
+                          index_attributes=INDEX_ATTRS)
+    entry = snap.devices[("adopt-0", "tpu-0")]
+    assert ctrl.ledger.reserve("rival-uid", [entry], {}) is False
+
+
+def test_remote_grant_denial_steers_repicks_away_for_a_ttl():
+    """The third 10k-soak finding (seed 20260804): a remote grant
+    denial means a RIVAL replica's in-flight reservation holds the
+    device — invisible here, because the shadow ledger carries only
+    COMMITTED remote usage. The allocator's reserve-refusal re-pick
+    refreshed its view, still saw the device free, picked it again and
+    burned its bounded retries on the identical loss. A denial (or
+    grant timeout) must make the contested keys read as TAKEN in
+    snapshot() for a bounded TTL — steering re-picks to other devices
+    — and must expire so the device is not blacklisted forever."""
+    import time as _time
+    from types import SimpleNamespace
+
+    from tpu_dra_driver.kube.reservations import RemoteCrossShardLedger
+
+    clients = ClientSets()
+    ring = ShardRing(shard_slots(2))
+    # find two pools owned by DIFFERENT slots
+    pools = {}
+    i = 0
+    while len(pools) < 2:
+        pools.setdefault(ring.owner(f"pd-{i}"), f"pd-{i}")
+        i += 1
+    home_slot, remote_slot = sorted(pools)
+    for pool in pools.values():
+        clients.resource_slices.create({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": f"{pool}-slice"},
+            "spec": {"driver": DRIVER, "nodeName": pool,
+                     "pool": {"name": pool, "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": [{"name": "tpu-0", "attributes": {
+                         "type": {"string": "chip"}}}]},
+        })
+    snap = build_snapshot(clients.resource_slices.list(),
+                          index_attributes=INDEX_ATTRS)
+    lookup = snap.get_device
+    local = UsageLedger(DRIVER, lookup,
+                        pool_filter=lambda p: ring.owner(p) == home_slot)
+    shadow = UsageLedger(DRIVER, lookup,
+                         pool_filter=lambda p: ring.owner(p) != home_slot)
+    denier = SimpleNamespace(
+        claim_info=lambda uid: ({"name": "c", "namespace": "ns"}, None),
+        request=lambda *a, **kw: "rec-0",
+        await_grants=lambda names, timeout, pump=None: {
+            n: {"phase": "Denied"} for n in names},
+        withdraw=lambda uid, slots: None)
+    route = SimpleNamespace(home=home_slot,
+                            slots=(home_slot, remote_slot),
+                            cross_shard=True)
+    xledger = RemoteCrossShardLedger(
+        route, ring, {home_slot: local}, shadow, denier,
+        home_epoch=lambda: None, grant_timeout=0.5, denied_ttl=0.15)
+    remote_pool = pools[remote_slot]
+    remote_entry = snap.devices[(remote_pool, "tpu-0")]
+    assert xledger.reserve("u1", [remote_entry], {}) is False
+    # the contested key now reads TAKEN: a re-pick scatters elsewhere
+    taken, _usage = xledger.snapshot()
+    assert (remote_pool, "tpu-0") in taken
+    # ...but only for the TTL (not a permanent blacklist)
+    _time.sleep(0.2)
+    taken, _usage = xledger.snapshot()
+    assert (remote_pool, "tpu-0") not in taken
+    # the denial is pick-steering only: counters were never touched
+    assert _usage == {}
+
+
+def test_backstop_rescan_heals_claim_dropped_during_ownership_flip():
+    """Fourth 10k-soak finding (seed 20260804): a claim whose informer
+    event is dispatched DURING an ownership flip is dropped as
+    "another shard's claim", and the adopter's set_owned_slots rescan
+    can race past it (its informer store not yet holding the claim) —
+    after which NOTHING re-admitted it until some future fleet event:
+    the soak saw claims neither Allocated nor queued/parked for 30+ s
+    on an idle, fully-owned control plane. The retry backstop now
+    re-scans the store, so any dropped claim heals within one
+    retry_interval. The lost rescan race is modeled by suppressing the
+    adoption-time rescan outright."""
+    import time as _time
+
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        ShardWiring,
+    )
+
+    clients = ClientSets()
+    clients.resource_slices.create({
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": "bs-0-slice"},
+        "spec": {"driver": DRIVER, "nodeName": "bs-0",
+                 "pool": {"name": "bs-0", "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": [{"name": "tpu-0",
+                              "attributes": {"type": {"string": "chip"}}}]},
+    })
+    ring = ShardRing(shard_slots(2))
+    ctrl = AllocationController(
+        clients,
+        AllocationControllerConfig(workers=1, retry_interval=0.2),
+        shard=ShardWiring(ring, owned=set()), identity="backstop")
+    ctrl.start()
+    try:
+        claim = clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "dropped", "namespace": "ns"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "count": 1,
+                 "selectors": [{"attribute": "type",
+                                "equals": "chip"}]}]}},
+        })
+        # the event lands while NO slot is owned: dropped everywhere
+        _time.sleep(0.3)
+        assert not (clients.resource_claims.get("dropped", "ns")
+                    .get("status") or {}).get("allocation")
+        # adopt with the adoption-time rescan LOSING the race
+        real_rescan = ctrl._rescan_claims
+        ctrl._rescan_claims = lambda: None
+        try:
+            ctrl.set_owned_slots(set(ring.members))
+        finally:
+            ctrl._rescan_claims = real_rescan
+        # the backstop rescan must heal it within ~a retry interval
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if (clients.resource_claims.get("dropped", "ns")
+                    .get("status") or {}).get("allocation"):
+                break
+            _time.sleep(0.02)
+        alloc = (clients.resource_claims.get("dropped", "ns")
+                 .get("status") or {}).get("allocation")
+        assert alloc, "backstop rescan never re-admitted the dropped claim"
+        del claim
+    finally:
+        ctrl.stop()

@@ -268,6 +268,36 @@ def test_latency_spec_scopes_to_label_values():
     assert prepare.label_values == ("ok",)
 
 
+def test_availability_spec_scopes_to_label_values():
+    """Regression from the 10k-node compressed-week soak (seed
+    20260804): verdict-free allocation attempts — claims deleted
+    mid-allocation re-admitted by lagging informer stores, stale-route
+    redirects the rightful owner retried — were counted as availability
+    errors and burned ~11% of the budget while the claim traffic had
+    ZERO user-visible failures. An availability spec's label_values now
+    scopes its traffic; the aborted label is outside it."""
+    reg = Registry()
+    c = reg.counter("t_avail_total", "t", ("result",))
+    for _ in range(90):
+        c.labels("ok").inc()
+    for _ in range(10):
+        c.labels("error").inc()
+    for _ in range(40):
+        c.labels("aborted").inc()
+    scoped = slo.SLOSpec("t-avail", "t_avail_total", 0.9, slo.AVAILABILITY,
+                         good_label_values=("ok",),
+                         label_values=("ok", "error"))
+    assert slo.sample_spec(scoped, [reg]) == (90.0, 100.0)
+    unscoped = slo.SLOSpec("t-all", "t_avail_total", 0.9,
+                           slo.AVAILABILITY, good_label_values=("ok",))
+    # the distortion the scope fixes: aborted attempts read as errors
+    assert slo.sample_spec(unscoped, [reg]) == (90.0, 140.0)
+    # the default catalog scopes allocation-availability to ok+error
+    alloc = next(s for s in slo.DEFAULT_SPECS
+                 if s.name == "allocation-availability")
+    assert alloc.label_values == ("ok", "error")
+
+
 def test_sample_spec_missing_family_is_zero_traffic():
     spec = slo.SLOSpec("ghost", "t_nowhere_seconds", 0.99, slo.LATENCY,
                        threshold=0.5)
@@ -369,3 +399,158 @@ def test_parse_slo_windows_grammar():
     for bad in ("fast:300:2", "fast:10/20:2", "x", "fast:a/b:c"):
         with pytest.raises(SystemExit):
             parse_slo_windows(bad)
+
+
+# ---------------------------------------------------------------------------
+# cumulative-budget mode (the endurance-soak judge): error budgets must
+# survive component restarts instead of silently re-opening
+# ---------------------------------------------------------------------------
+
+
+def test_cumulative_budget_survives_restart_mid_burn():
+    """The satellite regression: a kubelet plugin restarting mid-burn
+    resets its dra_claim_prepare_duration_seconds family, which makes
+    the sliding-window view re-open the budget ('window starts at
+    restart'). Cumulative mode stitches across the reset: the burn
+    continues from where it left off and still EXHAUSTS."""
+    spec = slo.SLOSpec("prep", "dra_claim_prepare_duration_seconds",
+                       0.99, slo.LATENCY, threshold=0.5)
+    clock = [0.0]
+    reg = Registry()
+    h = reg.histogram("dra_claim_prepare_duration_seconds", "t",
+                      buckets=(0.1, 0.5, 1.0))
+    eng = slo.SLOEngine(registries=[reg], specs=(spec,),
+                        windows=(slo.BurnWindow("w", 100.0, 10.0, 2.0),),
+                        tick=1.0, now_fn=lambda: clock[0],
+                        cumulative=True)
+    eng.sample()                           # baseline
+    # first half of the burn: 50 bad of 100
+    for _ in range(50):
+        h.observe(0.9)
+    for _ in range(50):
+        h.observe(0.05)
+    clock[0] = 10.0
+    eng.sample()
+    # the plugin restarts: a brand-new registry, families from zero
+    reg2 = Registry()
+    h2 = reg2.histogram("dra_claim_prepare_duration_seconds", "t",
+                        buckets=(0.1, 0.5, 1.0))
+    eng.set_registries([reg2])
+    # second half of the burn, post-restart (asymmetric on purpose: a
+    # reset to EXACTLY the pre-restart counts is indistinguishable from
+    # no traffic — the inherent counter-stitch blind spot a short tick
+    # makes vanishingly narrow)
+    for _ in range(40):
+        h2.observe(0.9)
+    for _ in range(10):
+        h2.observe(0.05)
+    clock[0] = 20.0
+    rep = eng.evaluate_once()
+    cum = eng.cumulative_budget("prep")
+    # both halves accounted: 150 events, 90 bad
+    assert cum["total"] == 150.0
+    assert cum["good"] == 60.0
+    assert cum["sli"] == pytest.approx(0.4)
+    assert cum["budget_remaining"] < 0      # exhausted, despite restart
+    assert eng.exhausted() == ["prep"]
+    # the naive sliding view re-opened (post-restart window only) —
+    # exactly the hole cumulative mode closes; both are reported
+    assert rep["slos"]["prep"]["cumulative"]["budget_remaining"] < 0
+
+
+def test_cumulative_baseline_excludes_preexisting_counts():
+    """Process-global families carry counts from before the engine
+    existed (earlier bench phases, other tests): the FIRST sample is
+    the baseline, not traffic."""
+    spec = slo.SLOSpec("prep", "t_cum_seconds", 0.99, slo.LATENCY,
+                       threshold=0.5)
+    reg = Registry()
+    h = reg.histogram("t_cum_seconds", "t", buckets=(0.1, 0.5, 1.0))
+    for _ in range(500):
+        h.observe(0.9)                      # pre-engine garbage
+    eng = slo.SLOEngine(registries=[reg], specs=(spec,),
+                        windows=(slo.BurnWindow("w", 100.0, 10.0, 2.0),),
+                        tick=1.0, cumulative=True)
+    eng.sample()
+    cum = eng.cumulative_budget("prep")
+    assert cum["total"] == 0.0 and cum["budget_remaining"] == 1.0
+    for _ in range(10):
+        h.observe(0.05)
+    eng.sample()
+    cum = eng.cumulative_budget("prep")
+    assert cum["total"] == 10.0 and cum["good"] == 10.0
+    assert eng.exhausted() == []
+
+
+def test_cumulative_mode_requires_opt_in():
+    reg = Registry()
+    eng, _, _ = _engine(reg)
+    with pytest.raises(RuntimeError, match="cumulative"):
+        eng.cumulative_budget("t-lat")
+
+
+def test_cumulative_late_family_seeds_baseline_not_traffic():
+    """A spec whose family only materializes later — add_registry()
+    bringing a registry whose counts predate this engine — must seed
+    the baseline at first PRESENCE, not at the (0, 0) an absent family
+    samples as: otherwise the family's whole pre-existing history
+    counts as this run's traffic on arrival."""
+    spec = slo.SLOSpec("late", "t_late_total", 0.9, slo.AVAILABILITY,
+                       good_label_values=("ok",))
+    eng = slo.SLOEngine(registries=[Registry()], specs=(spec,),
+                        windows=(slo.BurnWindow("w", 100.0, 10.0, 2.0),),
+                        tick=1.0, cumulative=True)
+    eng.sample()                            # family absent: no baseline
+    late = Registry()
+    c = late.counter("t_late_total", "t", ("result",))
+    for _ in range(300):
+        c.labels("error").inc()             # pre-engine history
+    eng.add_registry(late)
+    eng.sample()                            # first PRESENT sample seeds
+    cum = eng.cumulative_budget("late")
+    assert cum["total"] == 0.0 and cum["budget_remaining"] == 1.0, cum
+    for _ in range(10):
+        c.labels("ok").inc()
+    eng.sample()
+    cum = eng.cumulative_budget("late")
+    assert (cum["good"], cum["total"]) == (10.0, 10.0), cum
+
+
+def test_cumulative_concurrent_samples_never_double_count():
+    """sample() passes are serialized: the family reads happen outside
+    the data lock, and two interleaved passes could misread sampling
+    lag as a counter reset (the pass holding OLDER counts stitches
+    after a newer pass landed, its total looks like it went backwards,
+    and the reset branch re-adds the whole cumulative history). With
+    the soak's tick thread and epoch boundaries both calling
+    evaluate_once(), that double-count corrupts the binding verdict.
+    Hammer sample() from many threads against a live counter: the
+    cumulative total must equal the true final count exactly."""
+    import threading
+
+    spec = slo.SLOSpec("avail", "t_race_total", 0.9, slo.AVAILABILITY,
+                       good_label_values=("ok",))
+    reg = Registry()
+    c = reg.counter("t_race_total", "t", ("result",))
+    eng = slo.SLOEngine(registries=[reg], specs=(spec,),
+                        windows=(slo.BurnWindow("w", 100.0, 10.0, 2.0),),
+                        tick=1.0, cumulative=True)
+    eng.sample()                            # baseline at zero
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            eng.sample()
+
+    threads = [threading.Thread(target=sampler) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(2000):
+        c.labels("ok").inc()
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    eng.sample()                            # fold in the final counts
+    cum = eng.cumulative_budget("avail")
+    assert cum["total"] == 2000.0, cum
+    assert cum["good"] == 2000.0, cum

@@ -1,0 +1,204 @@
+"""The endurance-soak adversity scheduler and leak sentinels
+(tpu_dra_driver/testing/soak.py).
+
+The scheduler is the soak's determinism anchor: the same (config,
+seed) must yield a byte-identical event tape in ANY process (like the
+ShardRing cross-process pin), every event must land inside its epoch
+(the boundary is the judged instant), and the exclusion rules — never
+upgrade or storm a node mid-drain, at most one replica stalled at a
+time — are property-tested over many seeds by replaying the tape as an
+interval machine. The soak itself runs in tests/test_fleet_scenarios.py
+(tier-1 smoke + @slow) and at 10k-node scale in bench.py.
+"""
+
+import subprocess
+import sys
+from collections import Counter
+
+from tpu_dra_driver.testing.soak import (
+    ADVERSITY_SOURCES,
+    AdversityScheduler,
+    KIND_SOURCE,
+    LeakSentinel,
+    SoakConfig,
+    SoakEngine,
+    soak_specs,
+)
+
+
+# ---------------------------------------------------------------------------
+# tape determinism
+# ---------------------------------------------------------------------------
+
+
+def test_tape_identical_across_processes():
+    """Same (config, seed) ⇒ the same tape digest in a fresh
+    interpreter — no PYTHONHASHSEED or import-order dependence (the
+    ShardRing determinism pin, applied to the adversity schedule)."""
+    ours = AdversityScheduler(SoakConfig.smoke(seed=7)).digest()
+    script = (
+        "from tpu_dra_driver.testing.soak import (AdversityScheduler, "
+        "SoakConfig)\n"
+        "print(AdversityScheduler(SoakConfig.smoke(seed=7)).digest())\n")
+    theirs = subprocess.run([sys.executable, "-c", script],
+                            capture_output=True, text=True, check=True)
+    assert theirs.stdout.strip() == ours
+
+
+def test_tape_seed_and_config_sensitivity():
+    base = AdversityScheduler(SoakConfig.smoke(seed=7)).digest()
+    assert AdversityScheduler(SoakConfig.smoke(seed=8)).digest() != base
+    cfg = SoakConfig.smoke(seed=7)
+    cfg.drains_per_epoch = 2
+    assert AdversityScheduler(cfg).digest() != base
+    # repeated calls on one scheduler are cached + stable
+    s = AdversityScheduler(SoakConfig.smoke(seed=7))
+    assert s.digest() == s.digest() == base
+
+
+# ---------------------------------------------------------------------------
+# bounds + epoch alignment
+# ---------------------------------------------------------------------------
+
+
+def test_tape_bounds_counts_and_pairing():
+    for seed in range(6):
+        cfg = SoakConfig.smoke(seed=seed)
+        tape = AdversityScheduler(cfg).tape()
+        E = cfg.epoch_virtual_s
+        for ev in tape:
+            assert 0.0 <= ev.at <= cfg.virtual_horizon_s, ev
+            # epoch alignment: every event (including window ENDS)
+            # lands strictly inside its epoch
+            assert ev.epoch * E <= ev.at < (ev.epoch + 1) * E, ev
+            assert ev.kind in KIND_SOURCE, ev
+        counts = Counter(ev.kind for ev in tape)
+        assert counts["drain"] <= cfg.drains_per_epoch * cfg.epochs
+        assert counts["storm"] <= cfg.storms_per_epoch * cfg.epochs
+        assert counts["upgrade"] <= cfg.upgrades_per_epoch * cfg.epochs
+        # paired windows: every begin has its end
+        for begin, end in (("drain", "undrain"), ("storm", "service"),
+                           ("flap", "flap_end"), ("partition", "heal"),
+                           ("weather", "weather_end")):
+            assert counts[begin] == counts[end], (seed, begin)
+        # the tape is time-sorted
+        ats = [ev.at for ev in tape]
+        assert ats == sorted(ats)
+
+
+def test_exclusion_rules_property():
+    """Replay the tape as an interval machine over 30 seeds: node
+    windows (drain/storm) never overlap on one node, an upgrade never
+    fires inside one, and at most ONE replica is stalled (flapped or
+    partitioned) at any moment — a survivor always exists."""
+    for seed in range(30):
+        cfg = SoakConfig.smoke(seed=seed)
+        open_node = {}          # node -> "drain" | "storm"
+        open_stall = None       # (kind, replica) | None
+        for ev in AdversityScheduler(cfg).tape():
+            if ev.kind in ("drain", "storm"):
+                assert ev.target not in open_node, (seed, ev)
+                open_node[ev.target] = ev.kind
+            elif ev.kind == "undrain":
+                assert open_node.pop(ev.target) == "drain", (seed, ev)
+            elif ev.kind == "service":
+                assert open_node.pop(ev.target) == "storm", (seed, ev)
+            elif ev.kind == "upgrade":
+                assert ev.target not in open_node, (seed, ev)
+            elif ev.kind in ("flap", "partition"):
+                assert open_stall is None, (seed, ev, open_stall)
+                open_stall = (ev.kind, ev.target)
+            elif ev.kind == "flap_end":
+                assert open_stall == ("flap", ev.target), (seed, ev)
+                open_stall = None
+            elif ev.kind == "heal":
+                assert open_stall == ("partition", ev.target), (seed, ev)
+                open_stall = None
+        # every window closed by end of tape (epoch alignment implies it)
+        assert not open_node and open_stall is None, seed
+
+
+def test_weather_fail_recipe_gated_on_config():
+    """weather_fail_p == 0 (the smoke) must never put a fail-mode
+    weather window on the tape; > 0 (the week) may."""
+    for seed in range(10):
+        cfg = SoakConfig.smoke(seed=seed)
+        assert cfg.weather_fail_p == 0.0
+        for ev in AdversityScheduler(cfg).tape():
+            if ev.kind == "weather":
+                assert ev.param_dict()["mode"] != "fail", (seed, ev)
+    week = SoakConfig.compressed_week(seed=3)
+    modes = {ev.param_dict()["mode"]
+             for ev in AdversityScheduler(week).tape()
+             if ev.kind == "weather"}
+    assert modes <= {"latency", "fail"}
+
+
+# ---------------------------------------------------------------------------
+# catalog / dispatch coherence (mirrored as a lint gate in test_lint.py)
+# ---------------------------------------------------------------------------
+
+
+def test_every_tape_kind_has_an_executor_and_a_source():
+    assert set(KIND_SOURCE) == set(SoakEngine.EXECUTORS)
+    assert set(KIND_SOURCE.values()) == set(ADVERSITY_SOURCES)
+    for kind, method in SoakEngine.EXECUTORS.items():
+        assert callable(getattr(SoakEngine, method)), (kind, method)
+
+
+def test_soak_specs_relax_availability_and_allocation_threshold():
+    cfg = SoakConfig.smoke()
+    specs = {s.name: s for s in soak_specs(cfg)}
+    assert specs["allocation-availability"].objective == \
+        cfg.availability_objective
+    assert specs["prepare-availability"].objective == \
+        cfg.availability_objective
+    assert specs["allocation-latency"].threshold == \
+        cfg.allocation_latency_threshold_s
+    # the latency SLOs keep their production shape
+    assert specs["claim-prepare-latency"].threshold == 0.5
+    assert specs["cd-rendezvous-latency"].objective == 0.99
+
+
+# ---------------------------------------------------------------------------
+# leak sentinels
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_flat_series_passes():
+    s = LeakSentinel("x", tolerance=2)
+    for v in (5, 5, 5, 5):
+        s.sample(v)
+    assert not s.leaking
+    assert s.report()["verdict"] == "flat"
+
+
+def test_sentinel_monotone_growth_past_tolerance_fails():
+    s = LeakSentinel("x", tolerance=2)
+    for v in (5, 6, 8, 9):
+        s.sample(v)
+    assert s.leaking
+    rep = s.report()
+    assert rep["verdict"] == "leaking" and rep["growth"] == 4
+
+
+def test_sentinel_dip_resets_suspicion():
+    """Real leaks never shrink: any dip clears the monotone verdict
+    even when total growth exceeds the tolerance."""
+    s = LeakSentinel("x", tolerance=2)
+    for v in (5, 9, 8, 12):
+        s.sample(v)
+    assert not s.leaking
+
+
+def test_sentinel_growth_within_tolerance_passes():
+    s = LeakSentinel("x", tolerance=5)
+    for v in (5, 6, 8, 9):
+        s.sample(v)
+    assert not s.leaking
+
+
+def test_sentinel_needs_two_samples():
+    s = LeakSentinel("x", tolerance=0)
+    s.sample(100)
+    assert not s.leaking

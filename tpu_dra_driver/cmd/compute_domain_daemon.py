@@ -111,6 +111,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         node_name=args.node_name, pod_name=args.pod_name, pod_ip=args.pod_ip,
         hosts_file=os.path.join(run_dir, "hosts"),
         worker_env_file=os.path.join(run_dir, "worker-env.json"),
+        # graceful stop removes the whole per-CD dir (the hostPath
+        # outlives the pod; see DaemonConfig.run_dir). run_dir here is
+        # always CD-scoped: --compute-domain-uid is required above, so
+        # cd_run_dir returned base/<uid>, never the shared base.
+        run_dir=run_dir,
         gates=parse_gates(args)))
     daemon.start()
 

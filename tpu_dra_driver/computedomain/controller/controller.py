@@ -240,6 +240,7 @@ class ComputeDomainController:
             self._clique_informer.stop()
         for t in self._threads:
             t.join(timeout=2.0)
+        self._events_rec.stop(timeout=2.0)
 
     def _loop(self, fn, interval: float) -> None:
         # Run once immediately, THEN wait: a freshly started controller

@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import shutil
 import threading
 import time
 from dataclasses import dataclass, field
@@ -69,6 +70,14 @@ class DaemonConfig:
     # daemon pod's own /etc/hosts, which workloads never see.
     hosts_file: str = "/run/tpu-dra/hosts"
     worker_env_file: str = "/run/tpu-dra/worker-env.json"
+    #: the per-CD run directory THIS daemon owns (cmd cd_run_dir). When
+    #: set, a graceful stop removes it: the hostPath outlives the pod,
+    #: so a CD teardown that leaves hosts/worker-env behind accumulates
+    #: one corpse dir per CD ever scheduled on the node — the 10k-node
+    #: compressed-week soak's checkpoint_bytes sentinel measured the
+    #: drift (seed 20260804: +~930 bytes/epoch, monotone across all 7
+    #: epochs). Empty = unscoped legacy layout, never deleted.
+    run_dir: str = ""
     gates: fg.FeatureGates = field(default_factory=fg.FeatureGates)
 
 
@@ -167,6 +176,19 @@ class ComputeDomainDaemon:
         if self._render_thread is not None:
             self._render_thread.join(timeout=2.0)
         self.membership.leave()
+        self._cleanup_run_dir()
+
+    def _cleanup_run_dir(self) -> None:
+        """Remove the per-CD run dir on graceful stop (CD teardown /
+        SIGTERM). Only the rendered derivatives this daemon owns live
+        there (hosts, worker-env, ready marker) — all recreated from
+        the clique on the next start, so deletion is always safe; a
+        crash (SIGKILL) never runs this and the replacement daemon
+        reuses the surviving dir."""
+        run_dir = self._config.run_dir
+        if not run_dir:
+            return
+        shutil.rmtree(run_dir, ignore_errors=True)
 
     def set_fabric_error_callback(self, cb) -> None:
         self._on_fabric_error_cb = cb

@@ -138,6 +138,7 @@ class CdKubeletPlugin:
     def shutdown(self) -> None:
         self._cd_informer.stop()
         self._clique_informer.stop()
+        self._events.stop(timeout=2.0)
 
     def healthy(self) -> bool:
         """gRPC healthcheck analog (reference health.go:121-149): verify

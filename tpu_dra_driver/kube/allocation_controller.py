@@ -145,10 +145,23 @@ class AllocationController:
         self.ledger = UsageLedger(self._config.driver_name,
                                   self.catalog.get_device,
                                   pool_filter=pool_filter)
+        # Parked-claim visibility: an operator must be able to SEE an
+        # unsatisfiable claim from the outside (`kubectl describe` + the
+        # dra_allocator_parked_claims gauge), not just from this
+        # process's queues. One deduped AllocationParked Event per
+        # parked claim, cleared (Event deleted, gauge decremented) when
+        # the claim drains — allocated, deleted, or re-routed away.
+        # Built BEFORE the allocators: every allocator this controller
+        # creates (including rebuilt cross-shard ones) shares it, so a
+        # rebuild never strands another recorder worker thread.
+        self.events = EventRecorder(clients.events,
+                                    component="allocation-controller",
+                                    host=identity)
         self.allocator = Allocator(
             clients, self._config.driver_name,
             catalog=self.catalog, ledger=self.ledger,
-            index_attributes=self._config.index_attributes)
+            index_attributes=self._config.index_attributes,
+            recorder=self.events)
         # Split-brain hardening state (sharded only): the fencing epoch
         # source (set_fencing), the cross-REPLICA reserve machinery —
         # a complement "shadow" ledger accounting committed usage of
@@ -188,15 +201,6 @@ class AllocationController:
                 leases=clients.leases,
                 reserve_ttl=self._config.reserve_ttl,
                 identity=identity)
-        # Parked-claim visibility: an operator must be able to SEE an
-        # unsatisfiable claim from the outside (`kubectl describe` + the
-        # dra_allocator_parked_claims gauge), not just from this
-        # process's queues. One deduped AllocationParked Event per
-        # parked claim, cleared (Event deleted, gauge decremented) when
-        # the claim drains — allocated, deleted, or re-routed away.
-        self.events = EventRecorder(clients.events,
-                                    component="allocation-controller",
-                                    host=identity)
         self._cond = threading.Condition()
         self._pending: Dict[_Key, None] = {}       # ordered dedupe
         self._parked: Dict[_Key, None] = {}
@@ -211,10 +215,22 @@ class AllocationController:
         # route cache: reused until the catalog version moves
         self._route_snap = None
         self._inflight = 0
+        #: keys popped into a running batch: neither pending nor parked,
+        #: but NOT lost — a cross-shard batch full of remote reserves
+        #: can run for tens of seconds, and the no-lost-claims invariant
+        #: must be able to see its members (soak finding)
+        self._inflight_keys: Dict[_Key, None] = {}
         # set by slice events, consumed by a worker before its next
         # batch: an event storm (fleet-wide republish) coalesces into
         # ONE ledger counter recompute instead of one per event
         self._fleet_dirty = False
+        #: next monotonic instant the orphaned-parked-ref pruner runs
+        self._parked_prune_due = 0.0
+        #: next monotonic instant the backstop may trigger a full
+        #: re-route rescan (rate-limited: a rescan can cost a catalog
+        #: snapshot when the fleet version moved, and doing that every
+        #: retry tick starved 10k-node allocation throughput)
+        self._backstop_rescan_due = 0.0
         # sharded analog: slice events can shift ring ownership, so the
         # whole store re-routes — coalesced the same way
         self._routes_dirty = False
@@ -309,7 +325,7 @@ class AllocationController:
             for _ in self._parked_refs:
                 ALLOCATOR_PARKED_CLAIMS.dec()
             self._parked_refs.clear()
-        self.events.flush(timeout=1.0)
+        self.events.stop(timeout=2.0)
 
     # -- shard routing -----------------------------------------------------
 
@@ -354,11 +370,51 @@ class AllocationController:
                 self._shadow_ledger.set_pool_filter(
                     lambda pool:
                     self._shard.ring.owner(pool) not in self._shard.owned)
+            if set(slots) - before:
+                # ADOPTION BARRIER for lease-driven hand-offs: the
+                # re-derive above only re-filters claims the INFORMER
+                # has delivered. The in-process drill helper
+                # (ShardGroup.hand_off) always waited for informer
+                # currency, assuming production "gets the barrier for
+                # free from lease-expiry delay" — the 10k-node
+                # endurance soak disproved that (seed 20260804, epoch
+                # 0): informer dispatch starved behind fleet-scale
+                # snapshot copies lagged PAST lease expiry, so a device
+                # the previous owner committed moments before the flip
+                # was invisible here, looked free, and double-allocated
+                # — with both commits under valid tenures, which epoch
+                # fencing by design does not reject. Reconcile against
+                # an authoritative API LIST instead of waiting: the
+                # observes are rv- and tombstone-gated, so late
+                # informer replays of older state cannot clobber them,
+                # and the elector callback thread never blocks on
+                # watch delivery.
+                self._reconcile_ledgers_from_api()
         self._publish_owned_pools()
         if self.claim_informer.synced:
             self._rescan_claims()
         log.info("shard slots changed: %s -> %s",
                  sorted(before), sorted(slots))
+
+    def _reconcile_ledgers_from_api(self) -> None:
+        """Feed every allocated claim the API server knows about into
+        this controller's ledgers (main + shadow; the pool filters
+        route each key to the right one). Called on slot adoption with
+        reservations paused; a failed LIST degrades to the pre-fix
+        behavior (informer-only view) and is counted."""
+        try:
+            claims = self._clients.resource_claims.list()
+        except Exception:  # chaos-ok: counted; informer eventually heals
+            SWALLOWED_ERRORS.labels(
+                "allocation_controller.adopt_sync").inc()
+            log.exception("adoption barrier: authoritative claim LIST "
+                          "failed; ledger rides the informer view")
+            return
+        for obj in claims:
+            if (obj.get("status") or {}).get("allocation"):
+                self.ledger.observe_claim(obj)
+                if self._shadow_ledger is not None:
+                    self._shadow_ledger.observe_claim(obj)
 
     def _rescan_claims(self) -> None:
         """Re-route every unallocated claim in the informer store —
@@ -418,6 +474,55 @@ class AllocationController:
         the scenario invariants use it to prove no claim is lost)."""
         with self._cond:
             return list(self._parked_refs)
+
+    def _park(self, key: _Key, claim: Dict, why: str,
+              route: Optional[ShardRoute] = None) -> None:
+        """Park ``key`` UNLESS the claim was deleted while its batch
+        was in flight: its DELETE event has already been processed, so
+        parking now would resurrect a ref no future event clears — the
+        endurance soak's parked-claims sentinel caught exactly that
+        drift (monotone 9 → 48 refs over a compressed week of traffic
+        deleting claims mid-batch). The store read happens OUTSIDE
+        ``_cond``: informer dispatch holds the store lock while calling
+        handlers that take ``_cond``, so the reverse order would
+        deadlock."""
+        deleted = self.claim_informer.synced and \
+            self.claim_informer.get(key[1], key[0]) is None
+        with self._cond:
+            if deleted:
+                self._parked.pop(key, None)
+                self._cross_routes.pop(key, None)
+                self._clear_parked_locked(key)
+                return
+            self._mark_parked_locked(key, claim, why)
+            if route is not None:
+                self._cross_routes[key] = route
+
+    def _maybe_prune_parked(self) -> None:
+        """Worker-side backstop for the rare park-after-delete race
+        :meth:`_park`'s store check cannot close (DELETE processed
+        between the check and the mark): periodically clear parked refs
+        whose claims no longer exist. A same-name recreation re-admits
+        itself through its own ADDED event, so clearing is safe."""
+        import time as _time
+        now = _time.monotonic()
+        if now < self._parked_prune_due:
+            return
+        self._parked_prune_due = now + max(1.0,
+                                           self._config.retry_interval)
+        if not self.claim_informer.synced:
+            return
+        with self._cond:
+            keys = list(self._parked_refs)
+        gone = [k for k in keys
+                if self.claim_informer.get(k[1], k[0]) is None]
+        if not gone:
+            return
+        with self._cond:
+            for key in gone:
+                self._parked.pop(key, None)
+                self._cross_routes.pop(key, None)
+                self._clear_parked_locked(key)
 
     # -- informer handlers -------------------------------------------------
 
@@ -634,33 +739,70 @@ class AllocationController:
                     and not self._deleted_records:
                 timed_out = not self._cond.wait(
                     timeout=self._config.retry_interval)
-                if timed_out and self._parked:
-                    for key in self._parked:
-                        self._pending.setdefault(key, None)
-                    self._parked.clear()
+                if timed_out:
+                    if self._parked:
+                        for key in self._parked:
+                            self._pending.setdefault(key, None)
+                        self._parked.clear()
+                    if self._shard is not None:
+                        # backstop RESCAN, not just parked-retry: a
+                        # claim whose ADDED event was dispatched mid-
+                        # ownership-flip is dropped as "another shard's
+                        # claim", and the adopter's own rescan can race
+                        # past it (the event not yet in its store) —
+                        # after which nothing re-admits the claim until
+                        # some future fleet event. The 10k-node soak
+                        # caught exactly that: claims neither Allocated
+                        # nor queued/parked for 30+ s on an idle,
+                        # fully-owned control plane. RATE-LIMITED: a
+                        # rescan costs a catalog snapshot whenever the
+                        # fleet version moved, and triggering one per
+                        # retry tick starved 10k-node throughput.
+                        import time as _time
+                        now = _time.monotonic()
+                        if now >= self._backstop_rescan_due:
+                            self._backstop_rescan_due = now + max(
+                                2.0, self._config.retry_interval)
+                            self._routes_dirty = True
+                    # yield to the worker loop even with nothing to
+                    # batch, so the timed housekeeping (backstop
+                    # rescan, reservation sweeps, orphaned-parked-ref
+                    # pruning) runs on IDLE controllers too — the
+                    # pruner otherwise never fires without traffic
+                    break
             keys = list(self._pending)[:self._config.batch_max]
             for key in keys:
                 del self._pending[key]
+                self._inflight_keys[key] = None
             if keys:
                 self._inflight += 1
             return keys
 
-    def _finish_batch(self) -> None:
+    def _finish_batch(self, keys: List[_Key]) -> None:
         with self._cond:
+            for key in keys:
+                self._inflight_keys.pop(key, None)
             self._inflight -= 1
             self._cond.notify_all()
+
+    def inflight_claims(self) -> List[_Key]:
+        """Keys currently inside a running batch (the no-lost-claims
+        invariant counts them as queued)."""
+        with self._cond:
+            return list(self._inflight_keys)
 
     def _worker(self) -> None:
         while not self._stop.is_set():
             self._maybe_rescan()
             self._service_reservations()
+            self._maybe_prune_parked()
             keys = self._take_batch()
             if not keys:
                 continue
             try:
                 self._run_batch(keys)
             finally:
-                self._finish_batch()
+                self._finish_batch(keys)
 
     def _run_batch(self, keys: List[_Key]) -> None:
         fi.fire("sharding.shard-crash")
@@ -693,24 +835,20 @@ class AllocationController:
             # tenure over some slot ended without it noticing (pause,
             # partition, clock trouble). Re-park the batch (the real
             # owners re-route it) and demote wholesale.
-            with self._cond:
-                for claim in claims:
-                    meta = claim["metadata"]
-                    self._mark_parked_locked(
-                        (meta.get("namespace", ""), meta["name"]),
-                        claim, f"fenced out: {e}")
+            for claim in claims:
+                meta = claim["metadata"]
+                self._park((meta.get("namespace", ""), meta["name"]),
+                           claim, f"fenced out: {e}")
             self._demote(str(e))
             return
         except Exception:  # chaos-ok: counted; claims re-park for retry
             SWALLOWED_ERRORS.labels("allocation_controller.batch").inc()
             log.exception("allocation batch of %d failed wholesale",
                           len(claims))
-            with self._cond:
-                for claim in claims:
-                    meta = claim["metadata"]
-                    self._mark_parked_locked(
-                        (meta.get("namespace", ""), meta["name"]),
-                        claim, "allocation batch failed; retrying")
+            for claim in claims:
+                meta = claim["metadata"]
+                self._park((meta.get("namespace", ""), meta["name"]),
+                           claim, "allocation batch failed; retrying")
             return
         self._settle_results(claims, results)
 
@@ -722,8 +860,7 @@ class AllocationController:
             if res is not None and res.error is not None:
                 log.info("claim %s/%s not allocatable yet: %s",
                          key[0], key[1], res.error)
-                with self._cond:
-                    self._mark_parked_locked(key, claim, str(res.error))
+                self._park(key, claim, str(res.error))
 
     # -- cross-shard lane --------------------------------------------------
 
@@ -751,7 +888,8 @@ class AllocationController:
         alloc = Allocator(self._clients, self._config.driver_name,
                           catalog=self.catalog, ledger=xledger,
                           index_attributes=self._config.index_attributes,
-                          fencing=self._fencing)
+                          fencing=self._fencing,
+                          recorder=self.events)
         self._cross_allocators[route.slots] = alloc
         return alloc
 
@@ -803,7 +941,8 @@ class AllocationController:
         alloc = Allocator(self._clients, self._config.driver_name,
                           catalog=self.catalog, ledger=xledger,
                           index_attributes=self._config.index_attributes,
-                          fencing=fencing)
+                          fencing=fencing,
+                          recorder=self.events)
         self._cross_allocators[cache_key] = alloc
         return alloc
 
@@ -821,12 +960,9 @@ class AllocationController:
                     "cross-shard claim %s/%s spans slots %s not all owned "
                     "in-process; parked until ownership converges",
                     key[0], key[1], list(route.slots))
-                with self._cond:
-                    self._mark_parked_locked(
-                        key, claim,
-                        f"cross-shard slots {sorted(route.slots)} not all "
-                        f"owned in-process")
-                    self._cross_routes[key] = route
+                self._park(key, claim,
+                           f"cross-shard slots {sorted(route.slots)} not "
+                           f"all owned in-process", route=route)
                 continue
             if self._reserve_coord is not None:
                 # the remote lane's reserve() only sees (uid, entries);
@@ -835,10 +971,7 @@ class AllocationController:
             try:
                 results = alloc.allocate_batch([claim])
             except StaleWriterError as e:
-                with self._cond:
-                    self._mark_parked_locked(key, claim,
-                                             f"fenced out: {e}")
-                    self._cross_routes[key] = route
+                self._park(key, claim, f"fenced out: {e}", route=route)
                 self._demote(str(e))
                 return
             except Exception:  # chaos-ok: counted; claim re-parks for retry
@@ -846,10 +979,9 @@ class AllocationController:
                     "allocation_controller.cross_shard").inc()
                 log.exception("cross-shard allocation of %s/%s failed",
                               key[0], key[1])
-                with self._cond:
-                    self._mark_parked_locked(
-                        key, claim, "cross-shard allocation failed; retrying")
-                    self._cross_routes[key] = route
+                self._park(key, claim,
+                           "cross-shard allocation failed; retrying",
+                           route=route)
                 continue
             finally:
                 if self._reserve_coord is not None:
@@ -858,7 +990,10 @@ class AllocationController:
             res = results.get(meta["uid"])
             if res is not None and res.error is not None:
                 with self._cond:
-                    self._cross_routes[key] = route
+                    # only if the settle actually parked it: a claim
+                    # deleted mid-batch must not leave route residue
+                    if key in self._parked_refs:
+                        self._cross_routes[key] = route
 
     # -- introspection -----------------------------------------------------
 
@@ -866,11 +1001,53 @@ class AllocationController:
         with self._cond:
             return len(self._pending), len(self._parked)
 
+    def ledger_residue(self) -> Dict:
+        """The ledger-vs-API residue audit: committed ledger keys vs
+        the claim informer's view of live API allocations, scoped to
+        this controller's owned pools and broken out per shard slot.
+        A healthy settled controller reports zero both ways; ``extra``
+        (ledger holds a device no live claim carries) is the leak
+        direction — residue accumulating over a long horizon means
+        releases are being missed. In-flight commits and
+        informer-delivery lag can show a TRANSIENT entry; a residue
+        that persists across samples is the finding. Served at
+        ``/debug/allocator`` so the doctor's LEDGER_RESIDUE finding and
+        the soak's residue sentinel read the same surface."""
+        committed = self.ledger.committed_keys()
+        expected: Set[Tuple[str, str]] = set()
+        if self.claim_informer.synced:
+            for obj in self.claim_informer.list():
+                for key in catalog_mod.claim_allocated_keys(
+                        obj, self._config.driver_name):
+                    if self._shard is None or \
+                            self._shard.ring.owner(key[0]) \
+                            in self._shard.owned:
+                        expected.add(key)
+        extra = committed - expected
+        missing = expected - committed
+        out: Dict = {
+            "committed": len(committed),
+            "api_allocated": len(expected),
+            "extra_count": len(extra),
+            "missing_count": len(missing),
+            "extra": [list(k) for k in sorted(extra)[:16]],
+            "missing": [list(k) for k in sorted(missing)[:16]],
+        }
+        if self._shard is not None:
+            by_slot: Dict[str, Dict[str, int]] = {}
+            for label, keys in (("extra", extra), ("missing", missing)):
+                for pool, _ in keys:
+                    slot = self._shard.ring.owner(pool)
+                    by_slot.setdefault(slot, {"extra": 0, "missing": 0})
+                    by_slot[slot][label] += 1
+            out["by_slot"] = by_slot
+        return out
+
     def debug_state(self) -> Dict:
         """The ``/debug/allocator`` payload: parked-claim identities
         (with UIDs — what ``kubectl describe`` cross-references), queue
-        depths, and shard-slot ownership; collected verbatim into the
-        tpu-dra-doctor bundle."""
+        depths, the ledger-vs-API residue audit, and shard-slot
+        ownership; collected verbatim into the tpu-dra-doctor bundle."""
         with self._cond:
             parked = [{"namespace": key[0], "name": key[1],
                        "uid": ref.get("uid", "")}
@@ -886,6 +1063,7 @@ class AllocationController:
             "catalog_version": self.catalog.version,
             "workers": self._config.workers,
             "batch_max": self._config.batch_max,
+            "residue": self.ledger_residue(),
         }
         if self._shard is not None:
             out["sharded"] = True

@@ -493,10 +493,21 @@ class RemoteCrossShardLedger:
     fed, pools NOT owned by this process — disjoint from the local
     ledgers by construction, so unions never double count)."""
 
+    #: how long a remotely-denied device steers re-picks away before a
+    #: claim may try it again: a denial means the remote owner granted
+    #: the device to a RIVAL's in-flight reservation, which this
+    #: process cannot see (the shadow ledger carries only COMMITTED
+    #: remote usage) — without this memory, the allocator's
+    #: reserve-refusal re-pick refreshed its view, still saw the device
+    #: free, picked it again, and burned its bounded retries on the
+    #: identical loss (the 10k-node soak's residual error storm)
+    DENIED_TTL = 5.0
+
     def __init__(self, route, ring, local_ledgers: Dict[str, object],
                  shadow, coordinator: ReserveCoordinator,
                  home_epoch: Callable[[], Optional[int]],
-                 grant_timeout: float = 10.0):
+                 grant_timeout: float = 10.0,
+                 denied_ttl: Optional[float] = None):
         self._route = route
         self._ring = ring
         self._local_by_slot = dict(local_ledgers)
@@ -504,6 +515,10 @@ class RemoteCrossShardLedger:
         self._coord = coordinator
         self._home_epoch = home_epoch
         self._grant_timeout = grant_timeout
+        self._denied_ttl = (denied_ttl if denied_ttl is not None
+                            else self.DENIED_TTL)
+        #: device key -> monotonic expiry of its denial memory
+        self._denied: Dict[DeviceKey, float] = {}
         #: grant servicing hook (the controller's) run while awaiting
         self.pump: Optional[Callable[[], None]] = None
         seen: List[object] = []
@@ -532,7 +547,26 @@ class RemoteCrossShardLedger:
             taken |= t
             for ck, amount in u.items():
                 usage[ck] = usage.get(ck, 0) + amount
+        # recently-denied remote devices read as taken, so a re-pick
+        # scatters to the next free candidate instead of re-losing the
+        # same race (counters deliberately untouched: the denial is a
+        # pick-steering hint, not accounted usage)
+        taken |= self._denied_keys()
         return taken, usage
+
+    def _note_denied(self, entries: List[DeviceEntry]) -> None:
+        expiry = time.monotonic() + self._denied_ttl
+        with self._mu:
+            for e in entries:
+                self._denied[e.key] = expiry
+
+    def _denied_keys(self) -> Set[DeviceKey]:
+        now = time.monotonic()
+        with self._mu:
+            expired = [k for k, exp in self._denied.items() if exp <= now]
+            for k in expired:
+                del self._denied[k]
+            return set(self._denied)
 
     def held_by_other(self, keys: Iterable[DeviceKey], uid: str) -> bool:
         wanted = list(keys)
@@ -604,6 +638,9 @@ class RemoteCrossShardLedger:
             status = results.get(name) or {}
             if status.get("phase") != PHASE_GRANTED:
                 all_granted = False
+                # remember the contested devices (denial AND timeout:
+                # either way a rival likely holds them invisibly)
+                self._note_denied(remote[slot])
             elif "epoch" in status:
                 granted[slot] = int(status["epoch"])
         if not all_granted:

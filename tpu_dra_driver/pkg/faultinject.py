@@ -212,6 +212,22 @@ def disarm(name: str) -> None:
         _ARMED = any(pt.rules for pt in _POINTS.values())
 
 
+def remove_rule(name: str, rule: Rule) -> bool:
+    """Surgically detach ONE rule from a point, leaving any other armed
+    rules in place — how a bounded adversity window (the soak's fault
+    'weather') ends without disturbing a drill that holds its own rule
+    on the same point. Returns whether the rule was attached."""
+    global _ARMED
+    with _LOCK:
+        p = _POINTS.get(name)
+        removed = False
+        if p is not None and rule in p.rules:
+            p.rules.remove(rule)
+            removed = True
+        _ARMED = any(pt.rules for pt in _POINTS.values())
+        return removed
+
+
 def reset() -> None:
     """Disarm everything and zero counters (catalog entries survive)."""
     global _ARMED

@@ -566,8 +566,10 @@ ALLOCATION_SECONDS = DEFAULT_REGISTRY.histogram(
     "Wall time to allocate one ResourceClaim (snapshot scan + commit)")
 ALLOCATION_RESULTS = DEFAULT_REGISTRY.counter(
     "dra_allocation_results_total",
-    "Allocation attempts by outcome (ok / error); the allocation "
-    "error-rate SLO's good/total source",
+    "Allocation attempts by outcome (ok / error / aborted — aborted "
+    "= no availability verdict: claim vanished mid-allocation or "
+    "stale-route redirect); the allocation error-rate SLO reads "
+    "good=ok over total=ok+error",
     ("result",))
 ALLOCATOR_COMMIT_CONFLICTS = DEFAULT_REGISTRY.counter(
     "dra_allocator_commit_conflicts_total",

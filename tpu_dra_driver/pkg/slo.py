@@ -72,7 +72,11 @@ class SLOSpec:
     ``availability`` kind: children of a one-label family are
     classified by their label value — good when it is in
     ``good_label_values`` — and event counts come from counter values
-    or histogram counts."""
+    or histogram counts. ``label_values`` scopes availability traffic
+    the same way: label values outside it (e.g. allocation attempts
+    ``aborted`` because the claim vanished or the route went stale)
+    are no attempts at all for the SLI — the 10k-node soak burned
+    budget on exactly those false positives before this filter."""
 
     name: str
     family: str
@@ -126,7 +130,11 @@ DEFAULT_SPECS: Tuple[SLOSpec, ...] = (
                         "join to Ready) in <= 2.5s"),
     SLOSpec("allocation-availability", "dra_allocation_results_total",
             0.999, AVAILABILITY, good_label_values=("ok",),
-            description="99.9% of allocation attempts succeed"),
+            label_values=("ok", "error"),
+            description="99.9% of allocation attempts succeed "
+                        "(result=aborted attempts — claim vanished "
+                        "mid-allocation, stale-route redirects — carry "
+                        "no availability verdict and are excluded)"),
     SLOSpec("prepare-availability", "dra_claim_prepare_duration_seconds",
             0.999, AVAILABILITY, good_label_values=("ok",),
             description="99.9% of claim prepares succeed (result label "
@@ -193,6 +201,9 @@ def sample_spec(spec: SLOSpec,
             return 0.0, 0.0
         good = total = 0.0
         for key, v in values.items():
+            if spec.label_values and (not key
+                                      or key[0] not in spec.label_values):
+                continue  # outside the SLO's traffic (e.g. "aborted")
             total += v
             if key and key[0] in spec.good_label_values:
                 good += v
@@ -226,7 +237,8 @@ class SLOEngine:
                  component: str = "",
                  recorder=None,
                  involved: Optional[Dict[str, str]] = None,
-                 now_fn=time.monotonic):
+                 now_fn=time.monotonic,
+                 cumulative: bool = False):
         self._registries: List[Registry] = list(
             registries if registries is not None else [DEFAULT_REGISTRY])
         self.specs = tuple(specs)
@@ -237,9 +249,35 @@ class SLOEngine:
         self._involved = involved
         self._now = now_fn
         self._mu = threading.Lock()
+        # serializes whole sample() passes: the family reads happen
+        # outside _mu, and two interleaved passes can misread sampling
+        # lag as a counter reset (pass B reads newer counts and lands
+        # its stitch first; pass A's older total then looks like it
+        # went backwards and the reset branch re-adds the WHOLE
+        # cumulative history) — corrupting the budgets the soak's
+        # verdict rides on
+        self._sample_mu = threading.Lock()
         # spec name -> deque of (ts, good_cumulative, total_cumulative)
         self._samples: Dict[str, Deque[Tuple[float, float, float]]] = {
             s.name: deque() for s in self.specs}
+        # Cumulative-budget mode (the endurance-soak judge): the sliding
+        # windows above silently RE-OPEN the error budget whenever a
+        # component restarts (counter reset => "window starts at
+        # restart"), which is correct for paging but wrong for a
+        # whole-run verdict. When armed, every sample() also stitches
+        # (good, total) across resets into monotone accumulators, so a
+        # plugin that restarts mid-burn still exhausts its budget.
+        # (Blind spot, shared with any counter-reset heuristic: a reset
+        # landing on EXACTLY the pre-restart counts is invisible for
+        # one sample — a short tick makes that window negligible.)
+        self._cumulative = cumulative
+        # spec name -> [acc_good, acc_total, last_good, last_total]
+        self._cum: Dict[str, List[float]] = {
+            s.name: [0.0, 0.0, 0.0, 0.0] for s in self.specs}
+        # the FIRST sample is the baseline: process-global families may
+        # carry counts from before this engine existed (earlier bench
+        # phases, other tests) — they are not this run's traffic
+        self._cum_seeded: set = set()
         self._max_age = max((w.long_s for w in self.windows), default=0.0) \
             + 2 * max(tick, 1.0)
         self._last_report: Dict = {}
@@ -256,6 +294,15 @@ class SLOEngine:
             if registry not in self._registries:
                 self._registries.append(registry)
 
+    def set_registries(self, registries: Sequence[Registry]) -> None:
+        """Replace the registry set wholesale — how a restart is modeled
+        in-process (the restarted component's families come back as
+        fresh objects) and how tests swap in a post-restart registry.
+        Cumulative accumulators survive: the next sample sees the reset
+        and stitches."""
+        with self._mu:
+            self._registries = list(registries)
+
     def set_recorder(self, recorder, involved: Dict[str, str]) -> None:
         """Arm SLOBurnRate Event emission: ``recorder`` is the
         component's existing EventRecorder, ``involved`` the object the
@@ -268,11 +315,17 @@ class SLOEngine:
     # -- sampling / evaluation ---------------------------------------------
 
     def sample(self) -> None:
+        with self._sample_mu:
+            self._sample_locked()
+
+    def _sample_locked(self) -> None:
         now = self._now()
         with self._mu:
             registries = list(self._registries)
         for spec in self.specs:
             good, total = sample_spec(spec, registries)
+            present = any(reg.get(spec.family) is not None
+                          for reg in registries)
             with self._mu:
                 ring = self._samples[spec.name]
                 ring.append((now, good, total))
@@ -280,6 +333,31 @@ class SLOEngine:
                 # full-length delta stays computable; prune the rest
                 while len(ring) > 2 and ring[1][0] <= now - self._max_age:
                     ring.popleft()
+                if self._cumulative:
+                    acc = self._cum[spec.name]
+                    # the baseline must come from a PRESENT family: a
+                    # spec whose family only materializes later (an
+                    # add_registry() bringing counts from before this
+                    # engine existed) seeds then, not at (0, 0) — else
+                    # its pre-existing history would read as traffic.
+                    # Limitation: family resolution MOVING between
+                    # registries (first-match wins in sample_spec) is
+                    # outside the restart model, which assumes the
+                    # restarted component's families come back fresh.
+                    if spec.name not in self._cum_seeded:
+                        if present:
+                            self._cum_seeded.add(spec.name)
+                    # a cumulative count that went backwards is a counter
+                    # reset (restart): the current cumulative is all new
+                    # traffic. good and total reset together, so either
+                    # going backwards means both restarted.
+                    elif total < acc[3] or good < acc[2]:
+                        acc[0] += good
+                        acc[1] += total
+                    else:
+                        acc[0] += good - acc[2]
+                        acc[1] += total - acc[3]
+                    acc[2], acc[3] = good, total
 
     def _delta_since(self, spec: SLOSpec, now: float,
                      seconds: float) -> Tuple[float, float]:
@@ -355,6 +433,8 @@ class SLOEngine:
             spec_row["burning"] = burning
             spec_row["burning_windows"] = burning_pairs
             spec_row["budget_remaining"] = round(remaining, 4)
+            if self._cumulative:
+                spec_row["cumulative"] = self.cumulative_budget(spec.name)
             SLO_BUDGET_REMAINING.labels(spec.name).set(remaining)
             SLO_BURNING.labels(spec.name).set(1.0 if burning else 0.0)
             self._emit_event(spec, spec_row)
@@ -412,6 +492,35 @@ class SLOEngine:
             report = self._last_report
         return sorted(n for n, row in (report.get("slos") or {}).items()
                       if row.get("burning"))
+
+    # -- cumulative budget (restart-stitched, whole-run accounting) --------
+
+    def cumulative_budget(self, name: str) -> Dict:
+        """The restart-stitched whole-run budget for one spec: total
+        traffic, SLI, and the fraction of the error budget left
+        (negative = overspent, i.e. EXHAUSTED). Requires
+        ``cumulative=True``; zero-traffic runs report a full budget."""
+        if not self._cumulative:
+            raise RuntimeError("engine not in cumulative mode")
+        spec = next(s for s in self.specs if s.name == name)
+        with self._mu:
+            good, total = self._cum[name][0], self._cum[name][1]
+        _, sli = burn_rate(good, total, spec.objective)
+        budget = max(1e-9, 1.0 - spec.objective)
+        return {"good": good, "total": total,
+                "sli": round(sli, 6),
+                "objective": spec.objective,
+                "budget_remaining": round(1.0 - (1.0 - sli) / budget, 4)}
+
+    def cumulative_report(self) -> Dict[str, Dict]:
+        """Per-spec :meth:`cumulative_budget` — the soak's pass/fail
+        surface (exhaustion = any ``budget_remaining`` <= 0)."""
+        return {s.name: self.cumulative_budget(s.name) for s in self.specs}
+
+    def exhausted(self) -> List[str]:
+        """Specs whose restart-stitched whole-run budget is spent."""
+        return sorted(n for n, row in self.cumulative_report().items()
+                      if row["total"] > 0 and row["budget_remaining"] <= 0)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -169,6 +169,10 @@ class TpuKubeletPlugin:
         self.cleanup.stop()
         if self.health is not None:
             self.health.stop()
+        # close the async Event worker promptly: an in-process restart
+        # (drills, fleet servicing) must not strand one worker thread
+        # per plugin generation (endurance-soak thread sentinel)
+        self._events.stop(timeout=2.0)
         self._started = False
         # wake any device-health stream watchers parked in cond.wait so
         # SIGTERM exit isn't held hostage for up to the 30s poll period

@@ -108,8 +108,11 @@ class ClusterHarness:
                  slice_id: Optional[str] = None,
                  num_slices: int = 1,
                  controller_config: Optional[ControllerConfig] = None,
-                 cd_wake_on_events: bool = True):
-        self.clients = ClientSets()
+                 cd_wake_on_events: bool = True,
+                 clients: Optional[ClientSets] = None):
+        # an external ClientSets composes this harness with other
+        # substrates over one shared fake cluster (the endurance soak)
+        self.clients = clients if clients is not None else ClientSets()
         self.tmp = tmp_dir
         self.gates = gates or fg.FeatureGates()
         self._prepare_budget = prepare_budget
@@ -324,6 +327,7 @@ class ClusterHarness:
                     hosts_file=os.path.join(host.hosts_dir, cd_uid, "hosts"),
                     worker_env_file=os.path.join(host.hosts_dir, cd_uid,
                                                  "worker-env.json"),
+                    run_dir=os.path.join(host.hosts_dir, cd_uid),
                     gates=self.gates))
                 to_start.append((pod_name, daemon))
 

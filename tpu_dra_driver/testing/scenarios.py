@@ -265,6 +265,11 @@ def check_no_lost_claims(clients: ClientSets,
             parked_keys.update(ctrl.parked_claims())
             with ctrl._cond:
                 pending_keys.update(ctrl._pending)
+                # members of a RUNNING batch are queued work, not lost:
+                # a cross-shard batch of remote reserves can run for
+                # tens of seconds (the 10k soak tripped this as a false
+                # LOST verdict before controllers tracked them)
+                pending_keys.update(ctrl._inflight_keys)
         out = {"allocated": 0, "parked": 0, "pending": 0}
         lost = []
         parked_uids = []
@@ -477,14 +482,19 @@ class MiniFleet:
 
     def __init__(self, tmp_dir: str, n_nodes: int,
                  accelerator_type: str = "v5p-8",
-                 gates: Optional[fg.FeatureGates] = None):
+                 gates: Optional[fg.FeatureGates] = None,
+                 clients: Optional[ClientSets] = None,
+                 node_prefix: str = "fleet"):
         self.tmp = tmp_dir
         self.accelerator_type = accelerator_type
         self.gates = gates or fg.FeatureGates()
-        self.clients = ClientSets()
+        # an external ClientSets shares one fake cluster with other
+        # substrates (the soak composes MiniFleet + ClusterHarness +
+        # synthetic slices + a sharded control plane over ONE apiserver)
+        self.clients = clients if clients is not None else ClientSets()
         self.nodes: Dict[str, "MiniFleet._Node"] = {}
         for n in range(n_nodes):
-            name = f"fleet-{n}"
+            name = f"{node_prefix}-{n}"
             self.clients.nodes.create({"metadata": {"name": name}})
             self.nodes[name] = self._build(name, host_state=None)
 
@@ -524,6 +534,45 @@ class MiniFleet:
         old.tpu_plugin.shutdown()
         self.nodes[name] = self._build(name, host_state=old.lib.host_state)
         self.nodes[name].tpu_plugin.start()
+
+    def drain_node(self, name: str) -> List[str]:
+        """The kubectl-drain analog for a MiniFleet node: cordon (Node
+        unschedulable + the pool withdrawn from the scheduler), then
+        gracefully release every claim prepared on the node — unprepare
+        locally and deallocate in the API so the allocation controller
+        can migrate (or park) them. The plugin stays ALIVE: a drain is
+        administrative, not a crash. Returns the released claim uids."""
+        node = self.nodes[name]
+
+        def cordon(obj):
+            obj.setdefault("spec", {})["unschedulable"] = True
+        self.clients.nodes.retry_update(name, "", cordon)
+        node.tpu_plugin.set_cordoned(True)
+        migrated = list(node.tpu_plugin.state.get_checkpoint().claims)
+        if migrated:
+            node.tpu_plugin.unprepare_resource_claims(migrated)
+            by_uid = {c["metadata"].get("uid"): c
+                      for c in self.clients.resource_claims.list()}
+            for uid in migrated:
+                obj = by_uid.get(uid)
+                if obj is None:
+                    continue
+
+                def deallocate(o):
+                    (o.get("status") or {}).pop("allocation", None)
+                try:
+                    self.clients.resource_claims.retry_update(
+                        obj["metadata"]["name"],
+                        obj["metadata"].get("namespace", ""), deallocate)
+                except NotFoundError:
+                    pass       # released claim deleted concurrently
+        return migrated
+
+    def undrain_node(self, name: str) -> None:
+        def uncordon(obj):
+            (obj.get("spec") or {}).pop("unschedulable", None)
+        self.clients.nodes.retry_update(name, "", uncordon)
+        self.nodes[name].tpu_plugin.set_cordoned(False)
 
     def storm(self, names: Iterable[str], events_per_chip: int = 25) -> int:
         """Blanket the named nodes with fatal health events (the

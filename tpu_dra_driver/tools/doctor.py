@@ -53,6 +53,16 @@ MUX_LAG_P99_THRESHOLD_S = 1.0
 LEASE_FLAP_DELTA_THRESHOLD = 4
 LEASE_FLAP_ABSOLUTE_THRESHOLD = 20
 
+#: per-dimension growth within the --resample window at-or-above which
+#: LEAK_SUSPECTED fires — long-horizon decay one-shot scrapes can't see
+#: (the gauge families; checkpoint-dir byte growth has its own floor
+#: because one in-flight prepare legitimately grows the file a little).
+LEAK_GAUGE_DELTAS = {
+    "dra_watch_streams_active": 2.0,
+    "dra_allocator_parked_claims": 2.0,
+}
+LEAK_STATE_DIR_BYTES_THRESHOLD = 4096
+
 
 @dataclass
 class Finding:
@@ -240,16 +250,22 @@ def collect(endpoints: Dict[str, str],
     # and every component's delta covers the same wall-clock interval
     components = {name: collect_endpoint(hp, timeout=timeout)
                   for name, hp in endpoints.items()}
+    first_state = {name: collect_state_dir(p)
+                   for name, p in (state_dirs or {}).items()}
+    bundle: Dict = {
+        "generated_unix": round(time.time(), 3),
+        "components": components,
+        "state_dirs": first_state,
+    }
     if resample_after > 0:
         time.sleep(resample_after)
         for name, hp in endpoints.items():
             resample_metrics(hp, components[name], timeout)
-    bundle: Dict = {
-        "generated_unix": round(time.time(), 3),
-        "components": components,
-        "state_dirs": {name: collect_state_dir(p)
-                       for name, p in (state_dirs or {}).items()},
-    }
+        # state dirs resample too: checkpoint-dir byte growth within
+        # the same shared window feeds LEAK_SUSPECTED
+        bundle["state_dirs_resample"] = {
+            name: collect_state_dir(p)
+            for name, p in (state_dirs or {}).items()}
     if clients is not None:
         bundle["events"] = collect_events(clients)
     return bundle
@@ -303,6 +319,25 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
             f"{int(parked)} ResourceClaim(s) parked as unsatisfiable "
             f"(each carries an AllocationParked Event)",
             {"count": int(parked), "uids": uids}))
+
+    residue = (art.get("allocator") or {}).get("residue") or {}
+    residue_total = (residue.get("extra_count", 0)
+                     + residue.get("missing_count", 0))
+    if residue_total > 0:
+        out.append(Finding(
+            WARNING, "LEDGER_RESIDUE", name,
+            f"allocator ledger diverges from the API's live allocations: "
+            f"{residue.get('extra_count', 0)} device(s) held by the "
+            f"ledger with no live claim (the leak direction), "
+            f"{residue.get('missing_count', 0)} allocated in the API but "
+            f"unaccounted. A transient entry can be an in-flight commit; "
+            f"residue that persists across bundles means releases are "
+            f"being missed",
+            {"extra_count": residue.get("extra_count", 0),
+             "missing_count": residue.get("missing_count", 0),
+             "extra": residue.get("extra") or [],
+             "missing": residue.get("missing") or [],
+             "by_slot": residue.get("by_slot") or {}}))
 
     owned = [(labels.get("slot", ""), value) for labels, value in
              samples.get("dra_shard_owned_pools", []) if value > 0]
@@ -362,6 +397,23 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
             f"to confirm it is ongoing)",
             {"total": int(flap_now)}))
 
+    if resample is not None:
+        grew = {}
+        for family, threshold in LEAK_GAUGE_DELTAS.items():
+            delta = metric_value(resample, family) \
+                - metric_value(samples, family)
+            if delta >= threshold:
+                grew[family] = delta
+        if grew:
+            out.append(Finding(
+                WARNING, "LEAK_SUSPECTED", name,
+                f"monotone growth within the resample window: "
+                f"{ {k: int(v) for k, v in grew.items()} } — long-horizon "
+                f"decay a one-shot scrape cannot see (watchers that are "
+                f"never released / parked claims that never drain); "
+                f"re-collect with a longer --resample to confirm",
+                {"grew": grew}))
+
     quarantined = metric_value(samples, "dra_checkpoint_quarantined_total")
     if quarantined > 0:
         out.append(Finding(
@@ -407,6 +459,26 @@ def run_findings(bundle: Dict) -> List[Finding]:
                 f"{len(state['quarantined'])} quarantined checkpoint "
                 f"file(s) on disk under {state['path']}",
                 {"files": [q["file"] for q in state["quarantined"]]}))
+
+    def _dir_bytes(state: Dict) -> int:
+        return sum(max(0, f.get("bytes", 0))
+                   for key in ("checkpoints", "quarantined")
+                   for f in state.get(key) or [])
+
+    for name, after in (bundle.get("state_dirs_resample") or {}).items():
+        before = (bundle.get("state_dirs") or {}).get(name)
+        if before is None or before.get("error") or after.get("error"):
+            continue
+        growth = _dir_bytes(after) - _dir_bytes(before)
+        if growth >= LEAK_STATE_DIR_BYTES_THRESHOLD:
+            findings.append(Finding(
+                WARNING, "LEAK_SUSPECTED", name,
+                f"checkpoint state dir grew {growth} bytes within the "
+                f"resample window ({before['path']}): entries are being "
+                f"written faster than they are released — a prepare "
+                f"path that never unprepares, or quarantine corpses "
+                f"accumulating",
+                {"bytes_grown": growth, "path": before["path"]}))
     warnings = [e for e in bundle.get("events") or []
                 if e.get("type") == "Warning"]
     if warnings:
@@ -478,6 +550,9 @@ def write_bundle(bundle: Dict, findings: List[Finding],
         if bundle.get("state_dirs"):
             _add_member(tar, "state_dirs.json",
                         json.dumps(bundle["state_dirs"], indent=1))
+        if bundle.get("state_dirs_resample"):
+            _add_member(tar, "state_dirs_resample.json",
+                        json.dumps(bundle["state_dirs_resample"], indent=1))
         _add_member(tar, "findings.json",
                     json.dumps([f.to_dict() for f in findings], indent=1))
         _add_member(tar, "summary.txt", summary_text(findings, bundle))
