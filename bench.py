@@ -378,6 +378,158 @@ def bench_allocator_sweep(node_counts=(16, 128, 1024),
     return out
 
 
+def bench_snapshot_cost(n_nodes: int = 10_000,
+                        devices_per_node: int = 4,
+                        churn_rounds: int = 30,
+                        copy_rounds: int = 5,
+                        sort_nodes: int = 1024,
+                        sort_iters: int = 50) -> dict:
+    """Per-batch snapshot cost: copy-on-write generation pins vs the
+    eager full-copy baseline, on one 10k-node index state (ISSUE 12).
+
+    The COW arm measures the WORST case for structural sharing — one
+    slice churn event lands between every pair of snapshot pins, so
+    each pin pays a fresh generation's top-level copies plus the
+    touched buckets' clones. The copying arm is ``copy_snapshot()``,
+    the historical cost profile (every family deep-copied per batch).
+    The ledger arm does the same over a :class:`UsageLedger` carrying
+    committed claims with one claim churn between pins.
+
+    ``candidates_sort`` is the satellite microbench at 1024-node scale:
+    the legacy per-request materialize+sort of the full candidate list
+    vs the bucket-sorted-once merge path (memo cleared per call, so the
+    figure is the sort amortization, not the memo)."""
+    from tpu_dra_driver import DRIVER_NAME
+    from tpu_dra_driver.kube.catalog import (
+        DEFAULT_INDEX_ATTRIBUTES,
+        UsageLedger,
+        _IndexState,
+    )
+    from tpu_dra_driver.testing.scenarios import synthetic_slice
+
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    for i in range(n_nodes):
+        state.add_slice(synthetic_slice(f"sn-{i:05d}", devices_per_node))
+
+    # catalog arm, both sides paying the same per-batch pattern (one
+    # slice churn event + one consistent view): cow = mutation's lazy
+    # clones + O(1) pin; copy = mutation + full deep copy
+    state.snapshot()    # settle: first pin after the build
+    t0 = time.perf_counter()
+    for i in range(churn_rounds):
+        state.add_slice(synthetic_slice(f"sn-{i:05d}", devices_per_node))
+        state.snapshot()
+    cow_ms = (time.perf_counter() - t0) / churn_rounds * 1e3
+    t0 = time.perf_counter()
+    for i in range(copy_rounds):
+        state.add_slice(synthetic_slice(f"sn-{i:05d}", devices_per_node))
+        state.copy_snapshot()
+    copy_ms = (time.perf_counter() - t0) / copy_rounds * 1e3
+    state.snapshot()
+    t0 = time.perf_counter()
+    pin_iters = 500
+    for _ in range(pin_iters):
+        state.snapshot()
+    pin_us = (time.perf_counter() - t0) / pin_iters * 1e6
+
+    # ledger arm: committed claims, one claim churn between pins
+    def lookup(key):
+        sub = state.pools.get(key[0])
+        entry = sub.get(key[1]) if sub is not None else None
+        return entry.device if entry is not None else None
+
+    ledger = UsageLedger(DRIVER_NAME, lookup)
+    n_claims = min(512, n_nodes)
+    for i in range(n_claims):
+        ledger.observe_claim({
+            "metadata": {"name": f"c{i}", "namespace": "bench",
+                         "uid": f"u{i}", "resourceVersion": "1"},
+            "status": {"allocation": {"devices": {"results": [
+                {"driver": DRIVER_NAME, "pool": f"sn-{i:05d}",
+                 "device": "tpu-0"}]}}}})
+    # The real batch pattern has MANY pins per mutation (every batch,
+    # every repick refresh, every cross-shard fan-out member) — the pin
+    # is what must be free; a mutation while pinned pays one O(held)
+    # clone, measured separately.
+    ledger.snapshot()
+    reps = 500
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ledger.snapshot()
+    ledger_pin_us = (time.perf_counter() - t0) / reps * 1e6
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ledger.copy_snapshot()
+    ledger_copy_us = (time.perf_counter() - t0) / reps * 1e6
+    t0 = time.perf_counter()
+    for i in range(reps):
+        ledger.observe_claim({     # churn: one claim re-observed,
+                                   # paying the pinned-generation clone
+            "metadata": {"name": "c0", "namespace": "bench", "uid": "u0",
+                         "resourceVersion": str(2 + i)},
+            "status": {"allocation": {"devices": {"results": [
+                {"driver": DRIVER_NAME, "pool": "sn-00000",
+                 "device": "tpu-0"}]}}}})
+        ledger.snapshot()
+    ledger_churn_us = (time.perf_counter() - t0) / reps * 1e6
+
+    # candidates: sort-once-per-bucket merge vs legacy per-request sort
+    sstate = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    for i in range(sort_nodes):
+        sstate.add_slice(synthetic_slice(f"sb-{i:04d}", 8))
+    snap = sstate.snapshot()
+    snap.candidates(DRIVER_NAME, None, ())    # warm the bucket sort
+    t0 = time.perf_counter()
+    for _ in range(sort_iters):
+        snap._memo.clear()
+        entries, _used = snap.candidates(DRIVER_NAME, None, ())
+    cand_cow_us = (time.perf_counter() - t0) / sort_iters * 1e6
+    n_entries = len(entries)
+    t0 = time.perf_counter()
+    for _ in range(sort_iters):
+        # the legacy path: materialize the key set, resolve entries,
+        # sort the full result per request
+        keys = set(snap.by_driver[DRIVER_NAME])
+        legacy = [snap.devices[k] for k in keys]
+        legacy.sort(key=lambda e: e.order)
+    cand_legacy_us = (time.perf_counter() - t0) / sort_iters * 1e6
+    assert [e.key for e in legacy] == [e.key for e in entries]
+
+    out = {
+        "nodes": n_nodes,
+        "devices": n_nodes * devices_per_node,
+        "catalog": {
+            "cow_ms": round(cow_ms, 3),
+            "copy_ms": round(copy_ms, 2),
+            "ratio": round(copy_ms / max(cow_ms, 1e-9), 1),
+            "pin_us": round(pin_us, 1),
+        },
+        "ledger": {
+            "claims": n_claims,
+            "pin_us": round(ledger_pin_us, 2),
+            "churn_pin_us": round(ledger_churn_us, 2),
+            "copy_us": round(ledger_copy_us, 2),
+            "ratio": round(ledger_copy_us / max(ledger_pin_us, 1e-9), 1),
+        },
+        "candidates_sort": {
+            "nodes": sort_nodes,
+            "entries": n_entries,
+            "cow_us": round(cand_cow_us, 1),
+            "legacy_us": round(cand_legacy_us, 1),
+            "speedup": round(cand_legacy_us / max(cand_cow_us, 1e-9), 1),
+        },
+    }
+    log(f"  catalog @ {n_nodes} nodes: cow churn+pin {cow_ms:.2f} ms "
+        f"(pure pin {pin_us:.0f} us) vs copy {copy_ms:.0f} ms = "
+        f"{out['catalog']['ratio']:.0f}x; ledger pin "
+        f"{ledger_pin_us:.1f} us vs copy {ledger_copy_us:.0f} us = "
+        f"{out['ledger']['ratio']:.0f}x; "
+        f"candidates @ {sort_nodes} nodes: sorted-bucket merge "
+        f"{cand_cow_us:.0f} us vs per-request sort {cand_legacy_us:.0f} "
+        f"us = {out['candidates_sort']['speedup']:.0f}x")
+    return out
+
+
 _SHARD_INDEX_ATTRS = ("type", "chipType", "node")
 
 
@@ -1280,6 +1432,10 @@ def bench_soak() -> dict:
     log(f"  budget remaining: { {n: round(v, 3) for n, v in budgets.items()} }"
         f"; sentinels all "
         f"{set(r['verdict'] for r in report['sentinels'].values())}")
+    burst = report.get("allocation_burst") or {}
+    if burst:
+        log(f"  allocation burst: {burst['claims']} node-pinned claims "
+            f"in {burst['wall_s']:.2f}s = {burst['per_sec']:.0f}/s")
     return report
 
 
@@ -1847,6 +2003,8 @@ SUMMARY_KEYS = [
     "cel_compile_speedup",
     "alloc_speedup_1024x512", "alloc_candidates_ratio_1024x512",
     "alloc_indexed_per_sec_1024x512",
+    "snapshot_cost_ratio_10k", "snapshot_cow_ms_10k",
+    "candidates_sort_speedup_1024",
     "shard_agg_4x1024x4096", "shard_speedup_4x1024x4096",
     "watch_fanout_p99_ms", "watch_mux_threads",
     "recovery_plugin_kill_ms", "recovery_daemon_kill_ms",
@@ -1854,6 +2012,7 @@ SUMMARY_KEYS = [
     "fleet_upgrade_gap_failures", "fleet_churn_p99_ms",
     "fencing_recovery_ms", "crossshard_multireplica_per_sec",
     "soak_nodes", "soak_epochs", "soak_budget_min", "soak_claims",
+    "soak_alloc_burst_per_sec",
     "trace_disabled_ns", "metrics_render_ms",
     "slo_eval_ms", "criticalpath_walk_us",
     "backend", "devices",
@@ -1953,6 +2112,14 @@ def main() -> int:
         alloc_sweep = bench_allocator_sweep()
     except Exception as e:  # noqa: BLE001
         log(f"  allocator sweep failed ({type(e).__name__}: {e})")
+
+    log("[bench] snapshot cost (copy-on-write pins vs copying baseline, "
+        "10k nodes; candidates sort microbench at 1024)…")
+    snap_cost = {}
+    try:
+        snap_cost = bench_snapshot_cost()
+    except Exception as e:  # noqa: BLE001
+        log(f"  snapshot cost bench failed ({type(e).__name__}: {e})")
 
     log("[bench] shard sweep (consistent-hash shards vs single-leader "
         "control plane, 1/2/4/8 shards x 1024 nodes x 512/4096 claims)…")
@@ -2125,6 +2292,14 @@ def main() -> int:
             "alloc_indexed_per_sec_1024x512":
                 alloc_sweep["1024x512"]["indexed"]["claims_per_sec"]}
            if alloc_sweep.get("1024x512") else {}),
+        # copy-on-write snapshot cost vs the copying baseline (full
+        # arms under snapshot_cost in the detail file)
+        "snapshot_cost": snap_cost,
+        **({"snapshot_cost_ratio_10k": snap_cost["catalog"]["ratio"],
+            "snapshot_cow_ms_10k": snap_cost["catalog"]["cow_ms"],
+            "candidates_sort_speedup_1024":
+                snap_cost["candidates_sort"]["speedup"]}
+           if snap_cost else {}),
         # sharded control plane vs single leader (full grid under
         # shard_sweep; the 10k-node watch fan-out under watch_fanout)
         "shard_sweep": shard_sweep,
@@ -2189,7 +2364,9 @@ def main() -> int:
             "soak_budget_min": min(
                 row["budget_remaining"]
                 for row in soak_report["slo_cumulative"].values()),
-            "soak_claims": soak_report["traffic_totals"]["claims"]}
+            "soak_claims": soak_report["traffic_totals"]["claims"],
+            "soak_alloc_burst_per_sec":
+                soak_report.get("allocation_burst", {}).get("per_sec")}
            if soak_report else {}),
         "vs_baseline_note": (
             (crossproc_note if xp50 is not None else fallback_note)

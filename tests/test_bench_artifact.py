@@ -214,6 +214,49 @@ def test_bench_detail_records_allocator_sweep():
         assert key in bench.SUMMARY_KEYS
 
 
+def test_bench_detail_records_snapshot_cost():
+    """The copy-on-write snapshot gate (ISSUE 12): the committed
+    BENCH_DETAIL.json must carry the snapshot_cost arms measured in the
+    SAME run — the per-batch COW churn+pin at 10k nodes must be at
+    least 20x cheaper than the copying baseline, the ledger pin must
+    beat the ledger copy, and the candidates bucket-sorted merge must
+    beat the legacy per-request sort at 1024-node scale."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    with open(path) as f:
+        extra = json.load(f)["extra"]
+    sc = extra["snapshot_cost"]
+    assert sc["nodes"] >= 10_000
+    assert sc["devices"] >= 40_000
+    cat = sc["catalog"]
+    assert cat["ratio"] >= 20, cat
+    assert cat["cow_ms"] * 20 <= cat["copy_ms"], cat
+    assert cat["pin_us"] < 1_000, cat       # the pin itself is near-free
+    led = sc["ledger"]
+    assert led["ratio"] >= 2, led
+    cs = sc["candidates_sort"]
+    assert cs["nodes"] >= 1024
+    assert cs["speedup"] >= 5, cs
+    # headline scalars mirrored for the summary line
+    assert extra["snapshot_cost_ratio_10k"] == cat["ratio"]
+    assert extra["snapshot_cow_ms_10k"] == cat["cow_ms"]
+    assert extra["candidates_sort_speedup_1024"] == cs["speedup"]
+    for key in ("snapshot_cost_ratio_10k", "snapshot_cow_ms_10k",
+                "candidates_sort_speedup_1024"):
+        assert key in bench.SUMMARY_KEYS
+
+
+def test_snapshot_cost_bench_runs_live():
+    """The bench function itself stays runnable: a reduced-scale run
+    produces the full key set and the COW arm still wins."""
+    sc = bench.bench_snapshot_cost(n_nodes=256, churn_rounds=5,
+                                   copy_rounds=3, sort_nodes=128,
+                                   sort_iters=10)
+    assert {"catalog", "ledger", "candidates_sort"} <= set(sc)
+    assert sc["catalog"]["ratio"] > 1
+    assert sc["candidates_sort"]["speedup"] > 1
+
+
 def test_bench_detail_records_shard_sweep():
     """The trajectory gate for the sharded control plane (ISSUE 6): the
     committed BENCH_DETAIL.json must carry the shard sweep with the
@@ -510,6 +553,22 @@ def test_bench_detail_records_soak():
                      if p.startswith(kind))
         assert claims > 100, (kind, soak["traffic"])
     assert soak["traffic_totals"]["claims"] > 300
+    # ISSUE 12: snapshot cost unbound from fleet size. The direct
+    # allocation-throughput probe (node-pinned burst through the live
+    # control plane after the binding verdict) must beat PR 11's
+    # snapshot-bound recording by >= 10x — that run completed 378
+    # claims over 195.5 s wall (~1.93 claims/s) with every allocation
+    # paying an O(40k-device) snapshot copy.
+    pr11_claims_per_s = 378 / 195.5
+    burst = soak["allocation_burst"]
+    assert burst["claims"] >= 200, burst
+    assert burst["per_sec"] >= 10 * pr11_claims_per_s, burst
+    # and no epoch is snapshot-bound anymore: allocation.pick may still
+    # dominate a fast profile, but never again at snapshot-copy cost
+    for row in soak["epochs"]:
+        assert not (row["dominant_segment"] == "allocation.pick"
+                    and row.get("dominant_p50_ms", 0.0) > 250.0), (
+            "epoch still snapshot-bound", row)
     # headline scalars mirrored for the summary line
     assert extra["soak_nodes"] == soak["nodes"]
     assert extra["soak_epochs"] == soak["epochs_completed"]
@@ -517,8 +576,9 @@ def test_bench_detail_records_soak():
         row["budget_remaining"]
         for row in soak["slo_cumulative"].values())
     assert extra["soak_claims"] == soak["traffic_totals"]["claims"]
+    assert extra["soak_alloc_burst_per_sec"] == burst["per_sec"]
     for key in ("soak_nodes", "soak_epochs", "soak_budget_min",
-                "soak_claims"):
+                "soak_claims", "soak_alloc_burst_per_sec"):
         assert key in bench.SUMMARY_KEYS
 
 

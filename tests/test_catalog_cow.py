@@ -1,0 +1,555 @@
+"""Copy-on-write catalog & ledger snapshots (ISSUE 12).
+
+The load-bearing invariants:
+
+- **Frozen pins**: a pinned :class:`CatalogSnapshot` / ledger snapshot
+  never changes, no matter what add/remove/RELIST/usage churn hits the
+  live state afterwards — structural sharing must clone before
+  mutating, every time (the churn property interleaves all of it over
+  ≥30 seeds and re-checks every pin at the end).
+- **Pin correctness**: every pinned snapshot equals a from-scratch
+  ``build_snapshot`` of the slice list at pin time.
+- **Winner parity**: an allocator reading COW pins picks byte-identical
+  winners to one reading the eager-copy baseline
+  (``copy_snapshots=True``) across random fleets/selectors, including a
+  RELIST landing mid-batch and a ledger ``set_pool_filter`` re-derive
+  (the shard hand-off path).
+- **One atomic generation per RELIST**: ``rebuild`` bumps ``version``
+  exactly once (it used to bump per slice + once more, churning the
+  allocation controller's version-keyed route cache N+1 times).
+"""
+
+import random
+
+from tpu_dra_driver.kube import cel
+from tpu_dra_driver.kube.allocator import AllocationError, Allocator
+from tpu_dra_driver.kube.catalog import (
+    DEFAULT_INDEX_ATTRIBUTES,
+    DeviceCatalog,
+    UsageLedger,
+    _IndexState,
+    build_snapshot,
+)
+from tpu_dra_driver.kube.client import ClientSets
+
+DRIVER = "tpu.google.com"
+
+
+def make_device(name, **attrs):
+    wire = {}
+    for k, v in attrs.items():
+        if isinstance(v, bool):
+            wire[k] = {"bool": v}
+        elif isinstance(v, int):
+            wire[k] = {"int": v}
+        else:
+            wire[k] = {"string": v}
+    return {"name": name, "attributes": wire}
+
+
+def make_slice(node, devices, driver=DRIVER, pool=None, name=None,
+               shared_counters=None):
+    spec = {"driver": driver, "nodeName": node,
+            "pool": {"name": pool or node, "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": devices}
+    if shared_counters:
+        spec["sharedCounters"] = shared_counters
+    return {"apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceSlice",
+            "metadata": {"name": name or f"{node}-{driver}"},
+            "spec": spec}
+
+
+def random_slice(rng, serial):
+    node = f"node-{serial}"
+    devices = []
+    for d in range(rng.randint(1, 4)):
+        devices.append(make_device(
+            f"tpu-{d}",
+            type=rng.choice(("chip", "subslice")),
+            chipType=rng.choice(("v5p", "v5e", "v6e")),
+            node=node,
+            healthy=rng.choice((True, False)),
+        ))
+    counters = None
+    if rng.random() < 0.3:
+        counters = [{"name": "cs0",
+                     "counters": {"cores": {"value": str(rng.randint(1, 4))}}}]
+    return make_slice(node, devices, shared_counters=counters)
+
+
+def snapshot_view(snap):
+    """Canonical, comparison-stable rendering of a snapshot's full
+    content — devices, every index bucket, caps, and a few candidate
+    probes (order included)."""
+    probes = []
+    for cons in ((),
+                 (cel.IndexConstraint("attr", "", "type", "chip"),),
+                 (cel.IndexConstraint("attr", "", "chipType", "v6e"),
+                  cel.IndexConstraint("attr", "", "type", "chip"))):
+        entries, used = snap.candidates(DRIVER, None, cons)
+        probes.append(([e.key for e in entries], used))
+    return {
+        "devices": sorted(snap.devices),
+        "by_driver": {k: sorted(b) for k, b in snap.by_driver.items()},
+        "by_node": {k: sorted(b) for k, b in snap.by_node.items()},
+        "by_attr": {k: sorted(b) for k, b in snap.by_attr.items()},
+        "caps": dict(snap.counter_caps),
+        "version_independent_probes": probes,
+    }
+
+
+# ---------------------------------------------------------------------------
+# churn property: pinned snapshots stay frozen and correct, 30+ seeds
+# ---------------------------------------------------------------------------
+
+
+def test_churn_property_pinned_snapshots_stay_frozen_30_seeds():
+    rng = random.Random(20260804)
+    for seed in [rng.randint(0, 10**9) for _ in range(32)]:
+        sub = random.Random(seed)
+        state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+        live = {}          # name -> slice obj currently applied
+        serial = 0
+        pins = []          # (snapshot, expected view at pin time)
+        for _ in range(sub.randint(10, 25)):
+            roll = sub.random()
+            if roll < 0.45 or not live:
+                obj = random_slice(sub, serial)
+                serial += 1
+                live[obj["metadata"]["name"]] = obj
+                state.add_slice(obj)
+            elif roll < 0.6:
+                name = sub.choice(sorted(live))
+                del live[name]
+                state.remove_slice(name)
+            elif roll < 0.7:
+                # RELIST against a slightly perturbed list
+                if live and sub.random() < 0.5:
+                    del live[sub.choice(sorted(live))]
+                obj = random_slice(sub, serial)
+                serial += 1
+                live[obj["metadata"]["name"]] = obj
+                state.rebuild(list(live.values()))
+            else:
+                snap = state.snapshot()
+                pins.append((snap, snapshot_view(
+                    build_snapshot(list(live.values())))))
+        # final pin too, then verify EVERY pin against the state of the
+        # world when it was taken — mutations since must not have leaked
+        pins.append((state.snapshot(),
+                     snapshot_view(build_snapshot(list(live.values())))))
+        for i, (snap, expected) in enumerate(pins):
+            got = snapshot_view(snap)
+            assert got == expected, (
+                f"seed {seed}: pin #{i} drifted after later mutations")
+
+
+def test_pinned_snapshot_is_frozen_across_all_mutation_kinds():
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    s0 = make_slice("n0", [make_device("tpu-0", type="chip", node="n0")],
+                    shared_counters=[{"name": "cs0",
+                                      "counters": {"cores": {"value": "2"}}}])
+    state.add_slice(s0)
+    snap = state.snapshot()
+    before = snapshot_view(snap)
+    first = snap.candidates(
+        DRIVER, None, (cel.IndexConstraint("attr", "", "type", "chip"),))
+    # every mutation kind lands on the live state…
+    state.add_slice(make_slice(
+        "n1", [make_device("tpu-0", type="chip", node="n1"),
+               make_device("tpu-1", type="subslice", node="n1")]))
+    state.add_slice(make_slice(
+        "n0", [make_device("tpu-0", type="subslice", node="n0")]))
+    state.remove_slice(f"n1-{DRIVER}")
+    state.rebuild([make_slice(
+        "n9", [make_device("tpu-0", type="chip", node="n9")])])
+    # …and the pin does not move (including its memoized candidates)
+    assert snapshot_view(snap) == before
+    assert snap.candidates(
+        DRIVER, None,
+        (cel.IndexConstraint("attr", "", "type", "chip"),)) is first
+    # while a fresh pin sees the rebuilt world
+    assert sorted(state.snapshot().devices) == [("n9", "tpu-0")]
+
+
+def test_unmutated_generation_is_shared_and_mutation_clones_lazily():
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    for i in range(4):
+        state.add_slice(make_slice(
+            f"n{i}", [make_device("tpu-0", type="chip", node=f"n{i}")]))
+    s1 = state.snapshot()
+    s2 = state.snapshot()
+    # no mutation between pins: the generation is literally shared
+    assert s1.by_driver is s2.by_driver
+    assert s1.devices._pools is s2.devices._pools
+    state.add_slice(make_slice(
+        "n0", [make_device("tpu-0", type="chip", node="n0")]))
+    s3 = state.snapshot()
+    # the touched structures were cloned for the new generation…
+    assert s3.by_driver is not s1.by_driver
+    assert s3.by_node["n0"] is not s1.by_node["n0"]
+    # …while untouched buckets and pool sub-maps stay shared
+    assert s3.by_node["n2"] is s1.by_node["n2"]
+    assert s3.devices._pools["n3"] is s1.devices._pools["n3"]
+
+
+def test_rebuild_adopts_ownership_no_redundant_clones():
+    """rebuild() adopts fresh's private structures AND their ownership
+    tokens: with no pin since the RELIST, the next mutation must write
+    in place instead of re-cloning already-private buckets/sub-maps."""
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    state.rebuild([make_slice(f"n{i}", [make_device("tpu-0", type="chip")])
+                   for i in range(4)])
+    by_driver = state.by_driver
+    bucket = by_driver[DRIVER]
+    sub = state.pools["n0"]
+    # a SECOND slice into an existing pool: every structure it touches
+    # is already private, so the write must land in place
+    state.add_slice(make_slice(
+        "n0", [make_device("tpu-1", type="chip")], pool="n0", name="n0-b"))
+    assert state.by_driver is by_driver
+    assert state.by_driver[DRIVER] is bucket
+    assert state.pools["n0"] is sub
+    assert set(sub) == {"tpu-0", "tpu-1"}
+
+
+def test_device_map_keys_is_reusable_view():
+    """dict.keys() contract: the view survives repeated iteration and
+    mixing iteration with membership tests (a one-shot iterator would
+    silently go empty on second use)."""
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    for i in range(3):
+        state.add_slice(make_slice(
+            f"n{i}", [make_device("tpu-0", type="chip")]))
+    ks = state.snapshot().devices.keys()
+    first = sorted(ks)
+    assert first and sorted(ks) == first
+    assert all(k in ks for k in first)
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: one atomic generation step per RELIST
+# ---------------------------------------------------------------------------
+
+
+def test_rebuild_bumps_version_exactly_once():
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    for i in range(3):
+        state.add_slice(make_slice(
+            f"n{i}", [make_device("tpu-0", type="chip")]))
+    v0 = state.version
+    state.rebuild([make_slice(f"m{i}", [make_device("tpu-0", type="chip")])
+                   for i in range(7)])
+    assert state.version == v0 + 1, (
+        "rebuild must be ONE atomic generation step — version-keyed "
+        "caches (route snapshots) churn once per RELIST, not N+1 times")
+
+
+def test_catalog_relist_bumps_version_exactly_once():
+    clients = ClientSets()
+    clients.resource_slices.create(make_slice(
+        "n0", [make_device("tpu-0", type="chip")]))
+    cat = DeviceCatalog(clients.resource_slices)
+    cat._on_upsert(clients.resource_slices.list()[0])
+    v0 = cat.version
+    cat._on_relist([make_slice(f"r{i}", [make_device("tpu-0", type="chip")])
+                    for i in range(5)])
+    assert cat.version == v0 + 1
+
+
+# ---------------------------------------------------------------------------
+# ledger copy-on-write
+# ---------------------------------------------------------------------------
+
+
+def _claim(uid, keys, rv="1"):
+    return {
+        "metadata": {"name": f"c-{uid}", "namespace": "ns", "uid": uid,
+                     "resourceVersion": rv},
+        "status": {"allocation": {"devices": {"results": [
+            {"driver": DRIVER, "pool": p, "device": d}
+            for p, d in keys]}}},
+    }
+
+
+def test_ledger_snapshot_pin_stays_frozen():
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    for i in range(3):
+        state.add_slice(make_slice(
+            f"n{i}", [make_device("tpu-0", type="chip", node=f"n{i}")]))
+    snap = state.snapshot()
+    ledger = UsageLedger(DRIVER, snap.get_device)
+    ledger.observe_claim(_claim("u0", [("n0", "tpu-0")]))
+    taken, usage = ledger.snapshot()
+    frozen_taken, frozen_usage = set(taken), dict(usage)
+    assert frozen_taken == {("n0", "tpu-0")}
+    # mutate through every ledger path: observe, reserve, release,
+    # forget — the pinned views must not move
+    ledger.observe_claim(_claim("u1", [("n1", "tpu-0")]))
+    entry = snap.devices[("n2", "tpu-0")]
+    assert ledger.reserve("u2", [entry], snap.counter_caps)
+    ledger.release("u2")
+    ledger.forget_claim(_claim("u0", [("n0", "tpu-0")]))
+    assert set(taken) == frozen_taken
+    assert dict(usage) == frozen_usage
+    # a fresh pin sees the mutations, and equals the eager copy
+    taken2, usage2 = ledger.snapshot()
+    copy_taken, copy_usage = ledger.copy_snapshot()
+    assert set(taken2) == copy_taken == {("n1", "tpu-0")}
+    assert dict(usage2) == copy_usage
+
+
+def test_ledger_snapshot_keysview_supports_set_comparisons():
+    ledger = UsageLedger(DRIVER, lambda key: None)
+    assert ledger.snapshot() == (set(), {})
+    ledger.observe_claim(_claim("u0", [("p", "d")]))
+    taken, _ = ledger.snapshot()
+    assert taken == {("p", "d")}
+    merged = set()
+    merged.update(taken)
+    assert ("p", "d") in merged
+
+
+# ---------------------------------------------------------------------------
+# winner parity: COW pins vs the eager-copy baseline, 200 combos
+# ---------------------------------------------------------------------------
+
+
+def random_selectors(rng):
+    sels = []
+    for _ in range(rng.randint(1, 2)):
+        roll = rng.random()
+        if roll < 0.3:
+            sels.append({"attribute": rng.choice(("type", "chipType")),
+                         "equals": rng.choice(("chip", "subslice", "v6e"))})
+            continue
+        terms = []
+        for _ in range(rng.randint(1, 2)):
+            attr = rng.choice(("type", "chipType", "healthy"))
+            if attr == "healthy":
+                terms.append(f'device.attributes["{DRIVER}"].healthy == '
+                             f'{rng.choice(("true", "false"))}')
+            else:
+                val = rng.choice(("chip", "subslice", "v5p", "v5e", "v6e"))
+                terms.append(
+                    f'device.attributes["{DRIVER}"].{attr} == "{val}"')
+        expr = " && ".join(terms)
+        if rng.random() < 0.25:
+            expr = (f'({expr}) || '
+                    f'device.attributes["{DRIVER}"].type == "chip"')
+        sels.append({"cel": {"expression": expr}})
+    return sels
+
+
+def _run_parity_arm(seed, copy_snapshots):
+    """One arm of a combo: a catalog+ledger-backed allocator over a
+    random fleet with slice churn and a mid-batch RELIST interleaved.
+    Catalog events are fed synchronously (no informer threads), so both
+    arms see byte-identical sequences."""
+    rng = random.Random(seed)
+    clients = ClientSets()
+    cat = DeviceCatalog(clients.resource_slices)
+    ledger = UsageLedger(DRIVER, cat.get_device)
+    alloc = Allocator(clients, DRIVER, catalog=cat, ledger=ledger,
+                      copy_snapshots=copy_snapshots)
+    live = {}
+    serial = 0
+    for _ in range(rng.randint(2, 5)):
+        obj = random_slice(rng, serial)
+        serial += 1
+        live[obj["metadata"]["name"]] = obj
+        clients.resource_slices.create(obj)
+        cat._on_upsert(obj)
+    outcome = []
+    relist_claim = rng.randint(0, 2)
+    for i in range(rng.randint(1, 3)):
+        if rng.random() < 0.35:
+            obj = random_slice(rng, serial)
+            serial += 1
+            live[obj["metadata"]["name"]] = obj
+            cat._on_upsert(obj)
+        if rng.random() < 0.2 and live:
+            name = rng.choice(sorted(live))
+            del live[name]
+            cat._on_delete({"metadata": {"name": name}})
+        claim = clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": f"c{i}", "namespace": "ns"},
+            "spec": {"devices": {"requests": [{
+                "name": "r", "count": rng.randint(1, 2),
+                "selectors": random_selectors(rng)}]}}})
+        if i == relist_claim:
+            # mid-batch RELIST: fire a full rebuild while this claim's
+            # batch runs — the pinned snapshot must keep the batch on
+            # pre-relist state in BOTH arms
+            orig_pick = alloc._pick_requests
+            fired = []
+
+            def relist_then_pick(*args, **kwargs):
+                if not fired:
+                    fired.append(True)
+                    cat._on_relist(list(live.values()))
+                return orig_pick(*args, **kwargs)
+
+            alloc._pick_requests = relist_then_pick
+            try:
+                res = alloc.allocate_batch([claim])
+            finally:
+                alloc._pick_requests = orig_pick
+        else:
+            res = alloc.allocate_batch([claim])
+        r = res[claim["metadata"]["uid"]]
+        if r.error is not None:
+            outcome.append(("err", r.error))
+        else:
+            outcome.append(("ok", [
+                (x["pool"], x["device"])
+                for x in r.claim["status"]["allocation"]["devices"]
+                ["results"]]))
+    # final consistency: the live catalog equals a from-scratch build
+    assert snapshot_view(cat.snapshot()) == snapshot_view(
+        build_snapshot(list(live.values())))
+    return outcome
+
+
+def test_cow_vs_copying_winner_parity_200_random_combos():
+    rng = random.Random(20260804)
+    for combo in range(200):
+        seed = rng.randint(0, 10**9)
+        cow = _run_parity_arm(seed, copy_snapshots=False)
+        copying = _run_parity_arm(seed, copy_snapshots=True)
+        assert cow == copying, (
+            f"combo {combo} (seed {seed}): cow arm {cow} != "
+            f"copying arm {copying}")
+
+
+def test_parity_across_set_pool_filter_rederive():
+    """The shard hand-off path: a ledger re-deriving its pool filter
+    mid-sequence must leave COW and copying allocators picking the same
+    winners, and a snapshot pinned BEFORE the re-derive stays frozen."""
+    for copy_snapshots in (False, True):
+        clients = ClientSets()
+        cat = DeviceCatalog(clients.resource_slices)
+        accept = {"n0", "n1", "n2", "n3"}
+        ledger = UsageLedger(DRIVER, cat.get_device,
+                             pool_filter=lambda pool: pool in accept)
+        alloc = Allocator(clients, DRIVER, catalog=cat, ledger=ledger,
+                          copy_snapshots=copy_snapshots)
+        for i in range(4):
+            obj = make_slice(
+                f"n{i}", [make_device("tpu-0", type="chip", node=f"n{i}")])
+            clients.resource_slices.create(obj)
+            cat._on_upsert(obj)
+
+        def pinned_claim(i, node):
+            return clients.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": f"c{i}", "namespace": "ns"},
+                "spec": {"devices": {"requests": [{
+                    "name": "r", "count": 1,
+                    "selectors": [{"attribute": "node",
+                                   "equals": node}]}]}}})
+
+        r0 = alloc.allocate_batch([pinned_claim(0, "n0")])
+        assert all(r.error is None for r in r0.values())
+        pre_taken, pre_usage = ledger.snapshot()
+        frozen = set(pre_taken)
+        # hand-off: the filter narrows and every record re-derives
+        accept_new = {"n0", "n1"}
+        ledger.set_pool_filter(lambda pool: pool in accept_new)
+        assert set(pre_taken) == frozen, \
+            "snapshot pinned before set_pool_filter drifted"
+        r1 = alloc.allocate_batch([pinned_claim(1, "n1")])
+        assert all(r.error is None for r in r1.values())
+        taken, _ = ledger.snapshot()
+        assert set(taken) == {("n0", "tpu-0"), ("n1", "tpu-0")}
+        # a claim for a pool the filter now rejects cannot reserve here
+        entry = cat.snapshot().devices[("n3", "tpu-0")]
+        assert not ledger.reserve("foreign", [entry],
+                                  cat.snapshot().counter_caps)
+
+
+# ---------------------------------------------------------------------------
+# candidates: canonical order, memoization, bucket-sorted merge
+# ---------------------------------------------------------------------------
+
+
+def test_candidates_memoized_per_snapshot_and_canonically_ordered():
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    rng = random.Random(7)
+    for i in rng.sample(range(30), 30):
+        state.add_slice(make_slice(
+            f"n{i:02d}", [make_device(f"tpu-{d}", type="chip",
+                                      node=f"n{i:02d}")
+                          for d in range(3)]))
+    snap = state.snapshot()
+    cons = (cel.IndexConstraint("attr", "", "type", "chip"),)
+    entries, used = snap.candidates(DRIVER, None, cons)
+    assert used
+    assert [e.order for e in entries] == sorted(e.order for e in entries)
+    # memo: the identical probe returns the same list object
+    again, _ = snap.candidates(DRIVER, None, cons)
+    assert again is entries
+    # and equals the unconstrained walk (every device is a chip here)
+    assert [e.key for e in snap.all_candidates(DRIVER, None)] == \
+        [e.key for e in entries]
+
+
+def test_empty_and_missing_buckets_prune_like_before():
+    state = _IndexState(DEFAULT_INDEX_ATTRIBUTES)
+    state.add_slice(make_slice(
+        "n0", [make_device("tpu-0", type="chip", node="n0")]))
+    snap = state.snapshot()
+    # unknown driver: no index verdict at all
+    assert snap.candidates("other.example.com", None, ()) == ([], False)
+    # known driver, missing attr bucket: pruned-to-empty via the index
+    entries, used = snap.candidates(
+        DRIVER, None, (cel.IndexConstraint("attr", "", "type", "nope"),))
+    assert entries == [] and used
+    # node filter with no such node
+    assert snap.candidates(DRIVER, "ghost", ()) == ([], False)
+    # foreign qualified domain can never match
+    entries, used = snap.candidates(
+        DRIVER, None,
+        (cel.IndexConstraint("attr", "other.example.com", "type", "chip"),))
+    assert entries == [] and used
+
+
+def test_standalone_allocator_still_matches_linear(
+        ):
+    """Belt and braces on top of the existing 200-combo property: the
+    rebuilt candidates path through build_snapshot agrees with the
+    linear arm on a small mixed fleet."""
+    clients = ClientSets()
+    clients.resource_slices.create(make_slice(
+        "n0", [make_device("tpu-0", type="chip", chipType="v5p"),
+               make_device("tpu-1", type="subslice", chipType="v5p")]))
+    clients.resource_slices.create(make_slice(
+        "n1", [make_device("tpu-0", type="chip", chipType="v6e")]))
+    for i, sel in enumerate((
+            [{"attribute": "type", "equals": "chip"}],
+            [{"cel": {"expression":
+                      f'device.attributes["{DRIVER}"].chipType == "v6e"'}}],
+    )):
+        winners = []
+        for use_index in (True, False):
+            c = ClientSets()
+            for s in clients.resource_slices.list():
+                s = {k: v for k, v in s.items()}
+                s["metadata"] = {"name": s["metadata"]["name"]}
+                c.resource_slices.create(s)
+            c.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": "c", "namespace": "ns"},
+                "spec": {"devices": {"requests": [{
+                    "name": "r", "count": 1, "selectors": sel}]}}})
+            claim = Allocator(c, DRIVER, use_index=use_index).allocate(
+                "c", "ns")
+            winners.append([
+                (r["pool"], r["device"]) for r in
+                claim["status"]["allocation"]["devices"]["results"]])
+        assert winners[0] == winners[1], (i, winners)

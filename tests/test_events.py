@@ -127,6 +127,119 @@ def test_rate_limit_is_per_object(clients):
     assert len(quiet) == 1     # never starved by the noisy neighbor
 
 
+def test_state_shaped_reasons_survive_park_clear_thrash(clients):
+    """ASSURED_REASONS bypass the token bucket: a park/clear cycle per
+    route flap burns a token per cycle, and once the COW snapshots made
+    retries cheap the 10k soak drained a claim's bucket mid-flap — the
+    FINAL park's AllocationParked Warning was rate-limited away,
+    leaving a live parked claim invisible to operators. The condition's
+    Event must land no matter how many cycles preceded it; a
+    non-assured reason under the same thrash still rate-limits."""
+    rec = ev.EventRecorder(clients.events, burst=5, refill_per_sec=0.0)
+    ref = _claim_ref(uid="thrash")
+    # drain the object's bucket dry with ordinary (non-assured) spam
+    for i in range(20):
+        rec.warning(ref, ev.REASON_ALLOCATION_FAILED, f"spam {i}")
+    for i in range(40):       # park/clear thrash, far past any budget
+        rec.warning(ref, ev.REASON_ALLOCATION_PARKED,
+                    f"allocation parked: route flap {i}")
+        rec.clear(ref, ev.REASON_ALLOCATION_PARKED)
+    rec.warning(ref, ev.REASON_ALLOCATION_PARKED,
+                "allocation parked: final, must be visible")
+    rec.warning(ref, ev.REASON_ALLOCATION_FAILED, "still rate-limited")
+    assert rec.flush()
+    parked = [e for e in clients.events.list()
+              if e.get("reason") == ev.REASON_ALLOCATION_PARKED]
+    assert len(parked) == 1   # every cycle emitted despite the dry bucket
+    assert parked[0]["message"].endswith("must be visible")
+    failed = [e for e in clients.events.list()
+              if e.get("reason") == ev.REASON_ALLOCATION_FAILED]
+    assert len(failed) == 5   # the burst cap still guards ordinary reasons
+
+
+def test_assure_recreates_only_lost_events(clients):
+    """assure() is an existence check, not a blind re-emission: an
+    Event that survived costs no API write and no duplicate (even when
+    its dedupe-cache entry was evicted — the capacity-crunch case where
+    O(parked) blind re-emits used to mint a fresh Event per tick), an
+    Event that was lost is recreated, and the recreated object is
+    re-adopted by the dedupe cache so later emissions aggregate."""
+    rec = ev.EventRecorder(clients.events)
+    ref = _claim_ref(uid="assure-1")
+    msg = "allocation parked: no devices"
+    rec.warning(ref, ev.REASON_ALLOCATION_PARKED, msg)
+    assert rec.flush()
+
+    def parked():
+        return [e for e in clients.events.list()
+                if e.get("reason") == ev.REASON_ALLOCATION_PARKED]
+
+    # surviving Event + evicted dedupe entry: still exactly one object
+    with rec._mu:
+        rec._cache.clear()
+    for _ in range(3):
+        rec.assure("ns", ev.REASON_ALLOCATION_PARKED, [(ref, msg)])
+    assert rec.flush()
+    assert len(parked()) == 1
+    first_name = parked()[0]["metadata"]["name"]
+
+    # and the cache was re-seeded: a repeat emission aggregates onto
+    # the surviving object instead of creating a second one
+    rec.warning(ref, ev.REASON_ALLOCATION_PARKED, msg)
+    assert rec.flush()
+    assert [e["metadata"]["name"] for e in parked()] == [first_name]
+    assert parked()[0]["count"] >= 2
+
+    # lost Event: assure recreates it
+    clients.events.delete(first_name, "ns")
+    rec.assure("ns", ev.REASON_ALLOCATION_PARKED, [(ref, msg)])
+    assert rec.flush()
+    assert len(parked()) == 1
+    assert parked()[0]["message"] == msg
+
+
+def test_assure_then_clear_cannot_resurrect_a_drained_condition(clients):
+    """FIFO contract the controller's re-assert relies on: an assure
+    enqueued while the condition was live, followed by the drain's
+    clear(), must end with NO Event — the clear wins. (The controller
+    enqueues both under its own lock, so this ordering is exactly what
+    a claim draining mid-re-assert produces.)"""
+    rec = ev.EventRecorder(clients.events)
+    ref = _claim_ref(uid="drain-race")
+    msg = "allocation parked: racing"
+    rec.warning(ref, ev.REASON_ALLOCATION_PARKED, msg)
+    assert rec.flush()
+    # the Event vanishes (stand-in for a lost emission), then the claim
+    # drains right as the re-assert tick fires: assure first, clear after
+    for e in list(clients.events.list()):
+        clients.events.delete(e["metadata"]["name"],
+                              e["metadata"].get("namespace", "default"))
+    rec.assure("ns", ev.REASON_ALLOCATION_PARKED, [(ref, msg)])
+    rec.clear(ref, ev.REASON_ALLOCATION_PARKED)
+    assert rec.flush()
+    assert [e for e in clients.events.list()
+            if e.get("reason") == ev.REASON_ALLOCATION_PARKED] == []
+
+
+def test_assure_scoped_to_own_reporting_instance(clients):
+    """A rival replica's Event does not satisfy ours: each recorder
+    maintains its own instance-scoped Event (mirroring clear()'s
+    scoping — a demoting replica deleting its Event must not blind the
+    survivor's view, so the survivor must hold its own)."""
+    rec_a = ev.EventRecorder(clients.events, host="replica-a")
+    rec_b = ev.EventRecorder(clients.events, host="replica-b")
+    ref = _claim_ref(uid="dual")
+    msg = "allocation parked: cross-replica"
+    rec_b.warning(ref, ev.REASON_ALLOCATION_PARKED, msg)
+    assert rec_b.flush()
+    rec_a.assure("ns", ev.REASON_ALLOCATION_PARKED, [(ref, msg)])
+    assert rec_a.flush()
+    parked = [e for e in clients.events.list()
+              if e.get("reason") == ev.REASON_ALLOCATION_PARKED]
+    assert sorted(e["reportingInstance"] for e in parked) == [
+        "replica-a", "replica-b"]
+
+
 def test_queue_overflow_drops_not_blocks(clients):
     class Slow:
         def create(self, obj):

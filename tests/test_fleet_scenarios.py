@@ -170,7 +170,8 @@ def _controller_fleet(devices_per_node=1):
     clients.resource_slices.create(synthetic_slice("vis-0",
                                                    devices_per_node))
     ctrl = AllocationController(
-        clients, AllocationControllerConfig(workers=1, retry_interval=0.3))
+        clients, AllocationControllerConfig(workers=1, retry_interval=0.3,
+                                            parked_reassert_interval=1.0))
     return clients, ctrl
 
 
@@ -224,6 +225,42 @@ def test_parked_claim_emits_event_and_gauge_until_fleet_change(tmp_path):
         _wait(lambda: not ctrl.parked_claims(), what="overflow drained")
         assert ALLOCATOR_PARKED_CLAIMS.value - g0 == 0
         _wait(lambda: not parked_event(), what="parked Event cleared")
+    finally:
+        ctrl.stop()
+
+
+def test_parked_event_reasserted_after_loss(tmp_path):
+    """Park visibility is self-healing: a park Warning lost in flight
+    (recorder queue overflow under an event storm — the 10k COW soak
+    hit this once throughput and event volume rose 10x) or deleted out
+    from under a still-parked claim is re-asserted by the worker-side
+    pruner tick, because _mark_parked_locked only emits on FIRST entry
+    into the parked lifecycle and a single lost emission used to leave
+    the claim invisible to operators forever."""
+    clients, ctrl = _controller_fleet(devices_per_node=1)
+    ctrl.start()
+    try:
+        _claim(clients, "fits")
+        _claim(clients, "overflow")
+        _wait(lambda: ctrl.parked_claims() == [("ns", "overflow")],
+              what="overflow parked")
+
+        def parked_events():
+            ctrl.events.flush(timeout=2.0)
+            return [ev for ev in clients.events.list()
+                    if ev.get("reason") == REASON_ALLOCATION_PARKED]
+        _wait(lambda: len(parked_events()) == 1, what="AllocationParked")
+        # the Event vanishes while the claim is still parked (stand-in
+        # for a dropped emission)
+        for ev in parked_events():
+            clients.events.delete(ev["metadata"]["name"],
+                                  ev["metadata"].get("namespace",
+                                                     "default"))
+        assert parked_events() == []
+        # the pruner's re-assert brings it back without any fleet event
+        _wait(lambda: len(parked_events()) == 1, timeout=15.0,
+              what="AllocationParked re-asserted")
+        assert parked_events()[0]["involvedObject"]["name"] == "overflow"
     finally:
         ctrl.stop()
 

@@ -79,6 +79,16 @@ class AllocationControllerConfig:
     #: backstop interval for retrying parked (unsatisfiable) claims —
     #: slice events retry them immediately; this heals missed events
     retry_interval: float = 5.0
+    #: cadence for re-asserting live parked refs' AllocationParked
+    #: Events (a Warning lost to recorder queue overflow under an event
+    #: storm would otherwise leave a parked claim invisible forever —
+    #: _mark_parked_locked emits only on first entry into the parked
+    #: lifecycle). The re-assert is a worker-side EXISTENCE CHECK
+    #: (events.assure): one Event LIST per namespace per tick, writes
+    #: only for genuinely lost Events — so this runs slower than the
+    #: prune tick and stays bounded no matter how many claims park
+    #: during a capacity crunch.
+    parked_reassert_interval: float = 10.0
     #: how long a cross-replica reserve waits for remote slot owners to
     #: grant its DeviceReservation records before rolling back + parking
     #: (kept below the hand-off fence's drain_inflight window: a reserve
@@ -208,6 +218,10 @@ class AllocationController:
         #: unlike _parked this survives retry requeues and only empties
         #: when the claim actually drains
         self._parked_refs: Dict[_Key, Dict[str, str]] = {}
+        #: last park reason per parked ref — the periodic re-assert
+        #: (_maybe_prune_parked) re-emits it verbatim so the recorder's
+        #: dedupe bumps the existing Event instead of multiplying them
+        self._parked_why: Dict[_Key, str] = {}
         #: cross-shard routes for pending/parked claims, by key
         self._cross_routes: Dict[_Key, ShardRoute] = {}
         self._cross_allocators: Dict[Tuple[str, ...], Allocator] = {}
@@ -226,6 +240,7 @@ class AllocationController:
         self._fleet_dirty = False
         #: next monotonic instant the orphaned-parked-ref pruner runs
         self._parked_prune_due = 0.0
+        self._parked_reassert_due = 0.0
         #: next monotonic instant the backstop may trigger a full
         #: re-route rescan (rate-limited: a rescan can cost a catalog
         #: snapshot when the fleet version moved, and doing that every
@@ -325,6 +340,7 @@ class AllocationController:
             for _ in self._parked_refs:
                 ALLOCATOR_PARKED_CLAIMS.dec()
             self._parked_refs.clear()
+            self._parked_why.clear()
         self.events.stop(timeout=2.0)
 
     # -- shard routing -----------------------------------------------------
@@ -434,7 +450,7 @@ class AllocationController:
         # ex-owner keeps exporting stale pool counts after a hand-off
         counts: Dict[str, int] = {
             s: 0 for s in self._shard.owned | self._published_slots}
-        for pool in {e.pool for e in snap.devices.values()}:
+        for pool in snap.pool_names():
             slot = self._shard.ring.owner(pool)
             if slot in counts and slot in self._shard.owned:
                 counts[slot] += 1
@@ -456,15 +472,17 @@ class AllocationController:
                "namespace": meta.get("namespace", ""),
                "uid": meta.get("uid", "")}
         self._parked_refs[key] = ref
+        self._parked_why[key] = f"allocation parked: {why[:240]}"
         ALLOCATOR_PARKED_CLAIMS.inc()
         self.events.warning(ref, REASON_ALLOCATION_PARKED,
-                            f"allocation parked: {why[:240]}")
+                            self._parked_why[key])
 
     def _clear_parked_locked(self, key: _Key) -> None:
         """Call with _cond held: the claim drained (allocated, deleted,
         or re-routed to another shard) — delete its AllocationParked
         Event and release the gauge."""
         ref = self._parked_refs.pop(key, None)
+        self._parked_why.pop(key, None)
         if ref is not None:
             ALLOCATOR_PARKED_CLAIMS.dec()
             self.events.clear(ref, REASON_ALLOCATION_PARKED)
@@ -512,17 +530,45 @@ class AllocationController:
                                            self._config.retry_interval)
         if not self.claim_informer.synced:
             return
+        reassert = now >= self._parked_reassert_due
+        if reassert:
+            self._parked_reassert_due = (
+                now + self._config.parked_reassert_interval)
         with self._cond:
             keys = list(self._parked_refs)
-        gone = [k for k in keys
-                if self.claim_informer.get(k[1], k[0]) is None]
-        if not gone:
-            return
+        gone = {k for k in keys
+                if self.claim_informer.get(k[1], k[0]) is None}
         with self._cond:
             for key in gone:
                 self._parked.pop(key, None)
                 self._cross_routes.pop(key, None)
                 self._clear_parked_locked(key)
+            # RE-ASSERT the surviving parked refs' Events on their own
+            # (slower) cadence: a park Warning can be lost transiently
+            # (recorder queue overflow under event storms, an
+            # upgrade-restart clearing the dedupe cache), and because
+            # _mark_parked_locked emits only on first entry into the
+            # parked lifecycle, a single lost emission used to leave
+            # the claim invisible to operators FOREVER — the 10k COW
+            # soak caught exactly that once throughput (and with it
+            # event volume) rose 10x. The assure is ENQUEUED under
+            # _cond: a claim draining concurrently pops its ref and
+            # enqueues its clear() under this same lock, so the clear
+            # always lands AFTER the assure in the recorder's FIFO and
+            # wins — a re-assert can never resurrect an Event for a
+            # claim that just drained. Worker-side, events.assure is an
+            # existence check (one Event LIST per namespace, writes
+            # only for genuinely lost Events), bounded regardless of
+            # how many claims are parked.
+            if reassert and self._parked_refs:
+                by_ns: Dict[str, List] = {}
+                for key, ref in self._parked_refs.items():
+                    by_ns.setdefault(ref.get("namespace", ""), []).append(
+                        (dict(ref),
+                         self._parked_why.get(key) or "allocation parked"))
+                for ns, entries in by_ns.items():
+                    self.events.assure(ns, REASON_ALLOCATION_PARKED,
+                                       entries)
 
     # -- informer handlers -------------------------------------------------
 

@@ -255,13 +255,59 @@ class AllocationResult:
 
 class _BatchState:
     """Mutable per-batch view: the snapshot's usage evolves as the batch
-    commits claims, so claim N sees claim N-1's devices as taken."""
+    commits claims, so claim N sees claim N-1's devices as taken.
 
-    __slots__ = ("taken", "usage")
+    The base views come from the ledger's copy-on-write snapshot and
+    are READ-ONLY (structurally shared with the live generation —
+    mutating them would corrupt the ledger); the batch's own
+    consumption lives in a delta overlay on top. The delta only ever
+    ADDS relative to the base: picks are recorded here and unwinds
+    remove only what this batch added, so base entries never need
+    removal."""
 
-    def __init__(self, taken: Set[DeviceKey], usage: Dict[CounterKey, int]):
-        self.taken = taken
-        self.usage = usage
+    __slots__ = ("base_taken", "base_usage", "taken_delta", "usage_delta")
+
+    def __init__(self, taken, usage: Dict[CounterKey, int]):
+        #: set-like view of taken device keys at snapshot time (a dict
+        #: keys-view from the ledger, or a plain set on one-shot paths)
+        self.base_taken = taken
+        self.base_usage: Dict[CounterKey, int] = usage
+        self.taken_delta: Set[DeviceKey] = set()
+        self.usage_delta: Dict[CounterKey, int] = {}
+
+    def is_taken(self, key: DeviceKey) -> bool:
+        return key in self.taken_delta or key in self.base_taken
+
+    def take(self, key: DeviceKey) -> None:
+        self.taken_delta.add(key)
+
+    def untake(self, key: DeviceKey) -> None:
+        self.taken_delta.discard(key)
+
+    def usage_of(self, ck: CounterKey) -> int:
+        return (self.base_usage.get(ck, 0)
+                + self.usage_delta.get(ck, 0))
+
+    def add_usage(self, ck: CounterKey, amount: int) -> None:
+        self.usage_delta[ck] = self.usage_delta.get(ck, 0) + amount
+
+    def sub_usage(self, ck: CounterKey, amount: int) -> None:
+        left = self.usage_delta.get(ck, 0) - amount
+        if left > 0:
+            self.usage_delta[ck] = left
+        else:
+            self.usage_delta.pop(ck, None)
+
+    def reset(self, taken, usage: Dict[CounterKey, int]) -> None:
+        """Replace the whole view with a fresh snapshot (the bounded
+        re-pick path): earlier in-batch commits are already visible in
+        the refreshed base (committed or reserved in the ledger), so
+        the delta starts empty again — exactly the historical
+        wholesale replacement semantics."""
+        self.base_taken = taken
+        self.base_usage = usage
+        self.taken_delta = set()
+        self.usage_delta = {}
 
 
 class Allocator:
@@ -282,13 +328,19 @@ class Allocator:
                  index_attributes: Iterable[str]
                  = catalog_mod.DEFAULT_INDEX_ATTRIBUTES,
                  fencing=None,
-                 recorder: Optional[EventRecorder] = None):
+                 recorder: Optional[EventRecorder] = None,
+                 copy_snapshots: bool = False):
         self._clients = clients
         self._driver = driver_name
         self._catalog = catalog
         self._ledger = ledger
         self._use_index = use_index
         self._index_attributes = tuple(index_attributes)
+        # True = per-batch views come from the eager full-copy baseline
+        # instead of the copy-on-write pin — the bench's comparison arm
+        # and the winner-parity property's reference arm (winners must
+        # be byte-identical either way)
+        self._copy_snapshots = copy_snapshots
         # Epoch source for fenced commits (kube/fencing.py): when set,
         # every allocation write is stamped with the involved slots'
         # held epochs, and a rejection (stale tenure) surfaces as
@@ -316,14 +368,26 @@ class Allocator:
 
     def _catalog_snapshot(self) -> CatalogSnapshot:
         if self._catalog is not None:
+            if self._copy_snapshots:
+                return self._catalog.copy_snapshot()
             return self._catalog.snapshot()
         return catalog_mod.build_snapshot(
             self._clients.resource_slices.list(),
             index_attributes=self._index_attributes)
 
+    def _ledger_snapshot(self):
+        """The ledger's consistent view — the COW pin by default, the
+        eager copy on the comparison arm (merged cross-shard ledgers
+        may not implement copy_snapshot; they already materialize)."""
+        if self._copy_snapshots:
+            fn = getattr(self._ledger, "copy_snapshot", None)
+            if fn is not None:
+                return fn()
+        return self._ledger.snapshot()
+
     def _usage_snapshot(self, snap: CatalogSnapshot) -> _BatchState:
         if self._ledger is not None:
-            taken, usage = self._ledger.snapshot()
+            taken, usage = self._ledger_snapshot()
             return _BatchState(taken, usage)
         # one-shot LIST path: derive usage from live claims, deduped by
         # claim UID via claim_allocated_keys (a claim whose allocation
@@ -508,9 +572,7 @@ class Allocator:
                     "allocation raced a concurrent claim; devices no "
                     "longer free")
             tracing.add_event("reserve-repick", attempt=repicks)
-            taken, usage = self._ledger.snapshot()
-            state.taken = taken
-            state.usage = usage
+            state.reset(*self._ledger_snapshot())
         try:
             with tracing.span("allocator.commit"):
                 updated, committed = self._commit(claim, results,
@@ -539,17 +601,17 @@ class Allocator:
                 if picked >= count:
                     break
                 dev = entry.device
-                if not admin and entry.key in state.taken:
+                if not admin and state.is_taken(entry.key):
                     continue
                 if not _matches(dev, selectors, driver=entry.driver):
                     continue
                 if not admin and not self._counters_fit(
-                        entry, snap.counter_caps, state.usage):
+                        entry, snap.counter_caps, state):
                     continue
                 # commit into the batch state
                 if not admin:
-                    state.taken.add(entry.key)
-                    self._consume(entry, state.usage)
+                    state.take(entry.key)
+                    self._consume(entry, state)
                     picked_entries.append(entry)
                 results.append({
                     "request": rname, "driver": self._driver,
@@ -580,12 +642,12 @@ class Allocator:
             return
         self._unwind(picked_entries, state)
         for key in got:
-            state.taken.add(key)
+            state.take(key)
             dev = snap.get_device(key)
             if dev is not None:
                 for ck, amount in device_counter_consumption(
                         dev, key[0]).items():
-                    state.usage[ck] = state.usage.get(ck, 0) + amount
+                    state.add_usage(ck, amount)
 
     def _candidates(self, snap: CatalogSnapshot, selectors: List[Dict],
                     node_name: Optional[str]) -> List[DeviceEntry]:
@@ -604,16 +666,14 @@ class Allocator:
     @staticmethod
     def _unwind(picked: List[DeviceEntry], state: _BatchState) -> None:
         """Back out a failed claim's in-batch consumption so the rest of
-        the batch sees a clean state (per-claim isolation)."""
+        the batch sees a clean state (per-claim isolation). Only the
+        batch's own delta is touched — the shared base views never
+        mutate."""
         for entry in picked:
-            state.taken.discard(entry.key)
+            state.untake(entry.key)
             for ck, amount in device_counter_consumption(
                     entry.device, entry.pool).items():
-                left = state.usage.get(ck, 0) - amount
-                if left > 0:
-                    state.usage[ck] = left
-                else:
-                    state.usage.pop(ck, None)
+                state.sub_usage(ck, amount)
         picked.clear()
 
     # ------------------------------------------------------------------
@@ -753,18 +813,18 @@ class Allocator:
 
     @staticmethod
     def _counters_fit(entry: DeviceEntry, capacity: Dict[CounterKey, int],
-                      usage: Dict[CounterKey, int]) -> bool:
+                      state: _BatchState) -> bool:
         for ck, amount in device_counter_consumption(
                 entry.device, entry.pool).items():
             cap = capacity.get(ck)
             if cap is None:
                 return False
-            if usage.get(ck, 0) + amount > cap:
+            if state.usage_of(ck) + amount > cap:
                 return False
         return True
 
     @staticmethod
-    def _consume(entry: DeviceEntry, usage: Dict[CounterKey, int]) -> None:
+    def _consume(entry: DeviceEntry, state: _BatchState) -> None:
         for ck, amount in device_counter_consumption(
                 entry.device, entry.pool).items():
-            usage[ck] = usage.get(ck, 0) + amount
+            state.add_usage(ck, amount)

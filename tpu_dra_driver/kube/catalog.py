@@ -14,8 +14,11 @@ snapshot; this module is that shape for the in-repo allocator:
   (one slice's devices are re-indexed, nothing else is touched); a watch
   RELIST rebuilds them from the informer store in one pass (the
   ``catalog.index-rebuild`` fault point fires there).
-- :class:`CatalogSnapshot`: an immutable per-allocation-batch view —
-  candidate sets come from index intersection
+- :class:`CatalogSnapshot`: an immutable per-allocation-batch view,
+  obtained as a near-O(1) copy-on-write *pin* of the catalog's current
+  generation (structural sharing via :mod:`tpu_dra_driver.kube.cow`;
+  slice events pay for the delta, snapshots pay nothing) — candidate
+  sets come from index intersection
   (:meth:`CatalogSnapshot.candidates`) instead of a fleet scan, with the
   full set as fallback when a selector has no extractable constraint.
   Probes are PRUNING hints: the full selector still evaluates on every
@@ -32,15 +35,34 @@ snapshot; this module is that shape for the in-repo allocator:
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from tpu_dra_driver.kube import cel
+from tpu_dra_driver.kube import cow
 from tpu_dra_driver.kube.client import ResourceClient
 from tpu_dra_driver.kube.informer import Informer
 from tpu_dra_driver.pkg import faultinject as fi
-from tpu_dra_driver.pkg.metrics import SWALLOWED_ERRORS
+from tpu_dra_driver.pkg.metrics import (
+    CATALOG_BUCKET_CLONES,
+    CATALOG_GENERATIONS,
+    CATALOG_SNAPSHOT_SECONDS,
+    SWALLOWED_ERRORS,
+)
+
+# Pre-bound metric children: the COW bookkeeping sits on the slice-event
+# path and the snapshot pin sits on every batch — no .labels() dict
+# lookup per call.
+_CLONES = {f: CATALOG_BUCKET_CLONES.labels(f)
+           for f in ("toplevel", "pool", "driver", "node", "attr",
+                     "ledger")}
+_GEN_CATALOG = CATALOG_GENERATIONS.labels("catalog")
+_GEN_LEDGER = CATALOG_GENERATIONS.labels("ledger")
+_SNAP_SECONDS = {s: CATALOG_SNAPSHOT_SECONDS.labels(s)
+                 for s in ("catalog", "catalog-copy", "ledger",
+                           "ledger-copy")}
 
 fi.register("catalog.index-rebuild",
             "one full index rebuild after a watch RELIST (fail models a "
@@ -130,43 +152,124 @@ class DeviceEntry:
 
 
 class _IndexState:
-    """The mutable device-level index set. NOT thread-safe — the catalog
-    serializes access under its own lock; the static snapshot path uses a
-    private instance."""
+    """The mutable device-level index set, copy-on-write. NOT
+    thread-safe — the catalog serializes access under its own lock; the
+    static snapshot path uses a private instance.
+
+    Structure: devices live in per-pool sub-maps (``pools``), secondary
+    indexes are :class:`~tpu_dra_driver.kube.cow.Bucket` instances
+    (per-pool sub-maps themselves). :meth:`snapshot` *pins* the current
+    generation in O(1) — nothing is copied; the first mutation after a
+    pin shallow-copies the top-level dicts and then clones only the
+    buckets/sub-maps it actually touches (``_owned`` tracks what this
+    generation already owns), so slice events pay O(their delta) and a
+    pinned snapshot stays frozen forever."""
 
     def __init__(self, index_attributes: Iterable[str]):
         self.index_attributes = frozenset(index_attributes)
-        self.devices: Dict[DeviceKey, DeviceEntry] = {}
-        self.by_driver: Dict[str, Set[DeviceKey]] = {}
-        self.by_node: Dict[str, Set[DeviceKey]] = {}
-        self.by_pool: Dict[str, Set[DeviceKey]] = {}
-        self.by_attr: Dict[Tuple[str, object], Set[DeviceKey]] = {}
+        #: pool name -> {device name -> DeviceEntry} (the device store)
+        self.pools: Dict[str, Dict[str, DeviceEntry]] = {}
+        self.n_devices = 0
+        self.by_driver: Dict[str, cow.Bucket] = {}
+        self.by_node: Dict[str, cow.Bucket] = {}
+        self.by_attr: Dict[Tuple[str, object], cow.Bucket] = {}
         self.counter_caps: Dict[CounterKey, int] = {}
-        # per-slice contributions, for clean incremental removal
+        # per-slice contributions, for clean incremental removal —
+        # mutation bookkeeping only, never referenced by snapshots
         self._slice_keys: Dict[str, List[DeviceKey]] = {}
         self._slice_caps: Dict[str, Dict[CounterKey, int]] = {}
         self.version = 0
+        #: True while a snapshot pins the current structures
+        self._shared = False
+        #: buckets/sub-maps cloned (hence privately owned) since the
+        #: last pin — tokens ("pool", p) / (family, bkey[, pool])
+        self._owned: Set[Tuple] = set()
+
+    # -- copy-on-write bookkeeping ----------------------------------------
+
+    def _prepare_write(self) -> None:
+        """First mutation after a snapshot pin: shallow-copy the
+        top-level dicts (pointer copies) so the pinned generation keeps
+        the originals; inner buckets/sub-maps stay shared until
+        individually touched."""
+        if not self._shared:
+            return
+        self._shared = False
+        self._owned.clear()
+        self.pools = dict(self.pools)
+        self.by_driver = dict(self.by_driver)
+        self.by_node = dict(self.by_node)
+        self.by_attr = dict(self.by_attr)
+        self.counter_caps = dict(self.counter_caps)
+        _CLONES["toplevel"].inc()
+
+    def _pool_map(self, pool: str) -> Dict[str, DeviceEntry]:
+        """The writable device sub-map for ``pool`` (cloned lazily on
+        first touch per generation)."""
+        sub = self.pools.get(pool)
+        token = ("pool", pool)
+        if sub is None:
+            sub = self.pools[pool] = {}
+            self._owned.add(token)
+        elif token not in self._owned:
+            sub = self.pools[pool] = dict(sub)
+            self._owned.add(token)
+            _CLONES["pool"].inc()
+        return sub
+
+    def _bucket(self, family: str, index: Dict, bkey) -> cow.Bucket:
+        """The writable bucket ``index[bkey]`` (cloned lazily)."""
+        b = index.get(bkey)
+        token = (family, bkey)
+        if b is None:
+            b = index[bkey] = cow.Bucket()
+            self._owned.add(token)
+        elif token not in self._owned:
+            b = index[bkey] = b.clone()
+            self._owned.add(token)
+            _CLONES[family].inc()
+        return b
+
+    def _bucket_pool(self, family: str, bkey, b: cow.Bucket,
+                     pool: str) -> Dict[str, DeviceEntry]:
+        """The writable per-pool sub-map of an owned bucket."""
+        sub = b.pools.get(pool)
+        token = (family, bkey, pool)
+        if sub is None:
+            sub = b.pools[pool] = {}
+            self._owned.add(token)
+        elif token not in self._owned:
+            sub = b.pools[pool] = dict(sub)
+            self._owned.add(token)
+            _CLONES[family].inc()
+        return sub
 
     # -- mutation ----------------------------------------------------------
 
     def add_slice(self, obj: Dict) -> None:
+        self._prepare_write()
         name = obj["metadata"]["name"]
-        self.remove_slice(name)
+        self._remove_slice_impl(name)
         spec = obj.get("spec") or {}
         driver = spec.get("driver", "")
         node = spec.get("nodeName", "")
         pool = (spec.get("pool") or {}).get("name", "")
         keys: List[DeviceKey] = []
-        for i, dev in enumerate(spec.get("devices") or []):
+        devices = spec.get("devices") or []
+        sub = self._pool_map(pool) if devices else None
+        for i, dev in enumerate(devices):
             key = (pool, dev["name"])
             entry = DeviceEntry(key, dev, driver, node, pool, name,
                                 (name, i))
             # a later slice claiming an existing key replaces it (the
             # API server enforces pool/device uniqueness; last-writer
             # wins here keeps the cache converging regardless)
-            if key in self.devices:
-                self._deindex(self.devices[key])
-            self.devices[key] = entry
+            old = sub.get(dev["name"])
+            if old is not None:
+                self._deindex(old)
+            else:
+                self.n_devices += 1
+            sub[dev["name"]] = entry
             self._index(entry)
             keys.append(key)
         caps: Dict[CounterKey, int] = {}
@@ -181,73 +284,138 @@ class _IndexState:
         self.version += 1
 
     def remove_slice(self, name: str) -> None:
+        if name not in self._slice_keys:
+            return
+        self._prepare_write()
+        self._remove_slice_impl(name)
+        self.version += 1
+
+    def _remove_slice_impl(self, name: str) -> None:
         keys = self._slice_keys.pop(name, None)
         if keys is None:
             return
+        by_pool: Dict[str, List[DeviceKey]] = {}
         for key in keys:
-            entry = self.devices.get(key)
-            if entry is not None and entry.slice_name == name:
-                self._deindex(entry)
-                del self.devices[key]
+            by_pool.setdefault(key[0], []).append(key)
+        for pool, pkeys in by_pool.items():
+            if pool not in self.pools:
+                continue
+            sub = self._pool_map(pool)
+            for key in pkeys:
+                entry = sub.get(key[1])
+                if entry is not None and entry.slice_name == name:
+                    self._deindex(entry)
+                    del sub[key[1]]
+                    self.n_devices -= 1
+            if not sub:
+                del self.pools[pool]
         for ck, amount in self._slice_caps.pop(name, {}).items():
             left = self.counter_caps.get(ck, 0) - amount
             if left > 0:
                 self.counter_caps[ck] = left
             else:
                 self.counter_caps.pop(ck, None)
-        self.version += 1
 
     def rebuild(self, slices: Iterable[Dict]) -> None:
-        """Full rebuild (watch RELIST): throw the indexes away and
-        re-derive from a fresh slice list."""
-        self.devices.clear()
-        self.by_driver.clear()
-        self.by_node.clear()
-        self.by_pool.clear()
-        self.by_attr.clear()
-        self.counter_caps.clear()
-        self._slice_keys.clear()
-        self._slice_caps.clear()
+        """Full rebuild (watch RELIST): re-derive everything from a
+        fresh slice list into private structures, then adopt them
+        wholesale — ONE atomic generation step. ``version`` bumps
+        exactly once per rebuild (it used to bump once per slice PLUS
+        once at the end, churning version-keyed caches — the allocation
+        controller's route snapshots — N+1 times per resync)."""
+        fresh = _IndexState(self.index_attributes)
         for obj in sorted(slices, key=lambda o: o["metadata"]["name"]):
-            self.add_slice(obj)
+            fresh.add_slice(obj)
+        self.pools = fresh.pools
+        self.n_devices = fresh.n_devices
+        self.by_driver = fresh.by_driver
+        self.by_node = fresh.by_node
+        self.by_attr = fresh.by_attr
+        self.counter_caps = fresh.counter_caps
+        self._slice_keys = fresh._slice_keys
+        self._slice_caps = fresh._slice_caps
+        # the adopted structures are private to this state; anything a
+        # snapshot pinned before stays frozen in that snapshot. Adopt
+        # fresh's ownership tokens too (same format — fresh built
+        # everything through the same helpers): clearing them instead
+        # would make the first post-RELIST touch of every bucket/
+        # sub-map pay a clone of an already-private structure.
+        self._shared = False
+        self._owned = fresh._owned
         self.version += 1
 
     def _index(self, entry: DeviceEntry) -> None:
-        self.by_driver.setdefault(entry.driver, set()).add(entry.key)
+        self._bucket_insert("driver", self.by_driver, entry.driver, entry)
         if entry.node:
-            self.by_node.setdefault(entry.node, set()).add(entry.key)
-        self.by_pool.setdefault(entry.pool, set()).add(entry.key)
+            self._bucket_insert("node", self.by_node, entry.node, entry)
         for name in self.index_attributes:
             v = attr_value(entry.device, name)
             if isinstance(v, (str, bool)):
-                self.by_attr.setdefault((name, v), set()).add(entry.key)
+                self._bucket_insert("attr", self.by_attr, (name, v), entry)
+
+    def _bucket_insert(self, family: str, index: Dict, bkey,
+                       entry: DeviceEntry) -> None:
+        b = self._bucket(family, index, bkey)
+        sub = self._bucket_pool(family, bkey, b, entry.pool)
+        name = entry.key[1]
+        if name not in sub:
+            b.count += 1
+        sub[name] = entry
+        b._sorted = None
 
     def _deindex(self, entry: DeviceEntry) -> None:
-        for index, value in ((self.by_driver, entry.driver),
-                             (self.by_node, entry.node),
-                             (self.by_pool, entry.pool)):
-            keys = index.get(value)
-            if keys is not None:
-                keys.discard(entry.key)
-                if not keys:
-                    del index[value]
+        self._bucket_remove("driver", self.by_driver, entry.driver, entry)
+        if entry.node:
+            self._bucket_remove("node", self.by_node, entry.node, entry)
         for name in self.index_attributes:
             v = attr_value(entry.device, name)
             if isinstance(v, (str, bool)):
-                keys = self.by_attr.get((name, v))
-                if keys is not None:
-                    keys.discard(entry.key)
-                    if not keys:
-                        del self.by_attr[(name, v)]
+                self._bucket_remove("attr", self.by_attr, (name, v), entry)
+
+    def _bucket_remove(self, family: str, index: Dict, bkey,
+                       entry: DeviceEntry) -> None:
+        existing = index.get(bkey)
+        if existing is None or not existing.contains(entry.key):
+            return
+        b = self._bucket(family, index, bkey)
+        sub = self._bucket_pool(family, bkey, b, entry.pool)
+        if entry.key[1] in sub:
+            del sub[entry.key[1]]
+            b.count -= 1
+            b._sorted = None
+        if not sub:
+            del b.pools[entry.pool]
+        if b.count == 0:
+            del index[bkey]
 
     # -- read --------------------------------------------------------------
 
     def snapshot(self) -> "CatalogSnapshot":
+        """Pin the current generation — O(1), nothing copied."""
+        self._shared = True
         return CatalogSnapshot(
-            devices=dict(self.devices),
-            by_driver={k: set(v) for k, v in self.by_driver.items()},
-            by_node={k: set(v) for k, v in self.by_node.items()},
-            by_attr={k: set(v) for k, v in self.by_attr.items()},
+            pools=self.pools,
+            n_devices=self.n_devices,
+            by_driver=self.by_driver,
+            by_node=self.by_node,
+            by_attr=self.by_attr,
+            counter_caps=self.counter_caps,
+            index_attributes=self.index_attributes,
+            version=self.version,
+        )
+
+    def copy_snapshot(self) -> "CatalogSnapshot":
+        """The copying-baseline arm: every family deep-copied eagerly —
+        the historical per-batch cost profile, kept for the bench's
+        comparison arm and the winner-parity property (COW and copying
+        snapshots must pick byte-identical winners)."""
+        return CatalogSnapshot(
+            pools={p: dict(sub) for p, sub in self.pools.items()},
+            n_devices=self.n_devices,
+            by_driver={k: b.deep_clone()
+                       for k, b in self.by_driver.items()},
+            by_node={k: b.deep_clone() for k, b in self.by_node.items()},
+            by_attr={k: b.deep_clone() for k, b in self.by_attr.items()},
             counter_caps=dict(self.counter_caps),
             index_attributes=self.index_attributes,
             version=self.version,
@@ -255,27 +423,47 @@ class _IndexState:
 
 
 class CatalogSnapshot:
-    """An immutable view of the catalog for one allocation batch.
+    """An immutable, structurally-shared view of the catalog for one
+    allocation batch.
 
-    Everything is copied at construction; concurrent catalog updates
-    never mutate a snapshot, so a batch allocates against one consistent
-    fleet state."""
+    Construction is a near-O(1) *pin* of the catalog's current
+    generation — nothing is copied. The catalog clones whatever a later
+    mutation touches (kube/cow.py), so concurrent updates never mutate
+    a pinned snapshot and a batch allocates against one consistent
+    fleet state. Candidate lists are memoized per (driver, node, probe
+    plan): a batch of claims sharing one selector materializes and
+    orders its candidate set exactly once. Callers must treat returned
+    entry lists as read-only."""
 
-    __slots__ = ("devices", "by_driver", "by_node", "by_attr",
-                 "counter_caps", "index_attributes", "version")
+    __slots__ = ("_pools", "devices", "by_driver", "by_node", "by_attr",
+                 "counter_caps", "index_attributes", "version", "_memo")
 
-    def __init__(self, devices, by_driver, by_node, by_attr, counter_caps,
-                 index_attributes, version):
-        self.devices: Dict[DeviceKey, DeviceEntry] = devices
-        self.by_driver = by_driver
-        self.by_node = by_node
-        self.by_attr = by_attr
+    #: bound on the per-snapshot candidates memo (a snapshot lives for
+    #: one batch; distinct probe plans per batch are few)
+    MEMO_MAX = 4096
+
+    def __init__(self, pools, n_devices, by_driver, by_node, by_attr,
+                 counter_caps, index_attributes, version):
+        self._pools: Dict[str, Dict[str, DeviceEntry]] = pools
+        #: flat (pool, device) -> entry mapping view (shared storage)
+        self.devices = cow.DeviceMap(pools, n_devices)
+        self.by_driver: Dict[str, cow.Bucket] = by_driver
+        self.by_node: Dict[str, cow.Bucket] = by_node
+        self.by_attr: Dict[Tuple[str, object], cow.Bucket] = by_attr
         self.counter_caps: Dict[CounterKey, int] = counter_caps
         self.index_attributes = index_attributes
         self.version = version
+        # per-snapshot candidates memo; benign GIL-atomic races only
+        self._memo: Dict[Tuple, Tuple[List[DeviceEntry], bool]] = {}
 
     def has_driver(self, driver: str) -> bool:
-        return bool(self.by_driver.get(driver))
+        b = self.by_driver.get(driver)
+        return b is not None and b.count > 0
+
+    def pool_names(self):
+        """Names of every pool with at least one published device —
+        O(pools), no device iteration (the shard-gauge path)."""
+        return self._pools.keys()
 
     def candidates(self, driver: str, node_name: Optional[str],
                    constraints: Tuple[cel.IndexConstraint, ...]
@@ -285,13 +473,25 @@ class CatalogSnapshot:
         Returns ``(entries, used_index)``: ``used_index`` is True when at
         least one constraint pruned through an index (or proved the set
         empty). The result is a SUPERSET of the true matches — the
-        caller still evaluates the full selector per candidate."""
+        caller still evaluates the full selector per candidate — and is
+        memoized per probe plan for the snapshot's lifetime."""
+        memo_key = (driver, node_name, constraints)
+        got = self._memo.get(memo_key)
+        if got is None:
+            got = self._candidates(driver, node_name, constraints)
+            if len(self._memo) < self.MEMO_MAX:
+                self._memo[memo_key] = got
+        return got
+
+    def _candidates(self, driver: str, node_name: Optional[str],
+                    constraints: Tuple[cel.IndexConstraint, ...]
+                    ) -> Tuple[List[DeviceEntry], bool]:
         base = self.by_driver.get(driver)
-        if not base:
+        if base is None or not base.count:
             return [], False
-        sets: List[Set[DeviceKey]] = [base]
+        buckets: List[cow.Bucket] = [base]
         if node_name is not None:
-            sets.append(self.by_node.get(node_name) or set())
+            buckets.append(self.by_node.get(node_name) or cow.EMPTY_BUCKET)
         used_index = False
         for c in constraints:
             if c.kind == "driver":
@@ -307,16 +507,21 @@ class CatalogSnapshot:
                     # the equality conjunct can never hold
                     return [], True
                 if c.name in self.index_attributes:
-                    sets.append(self.by_attr.get((c.name, c.value)) or set())
+                    buckets.append(self.by_attr.get((c.name, c.value))
+                                   or cow.EMPTY_BUCKET)
                     used_index = True
-        sets.sort(key=len)
-        keys = sets[0]
-        for s in sets[1:]:
-            keys = keys & s
-            if not keys:
-                break
-        entries = [self.devices[k] for k in keys]
-        entries.sort(key=lambda e: e.order)
+        # iterate the smallest bucket's pre-sorted entries (sorted once
+        # per bucket generation) and filter by membership in the rest —
+        # no per-request sort of the merged result
+        smallest = min(buckets, key=len)
+        if not smallest.count:
+            return [], used_index
+        others = [b for b in buckets if b is not smallest]
+        if others:
+            entries = [e for e in smallest.sorted_entries()
+                       if all(b.contains(e.key) for b in others)]
+        else:
+            entries = list(smallest.sorted_entries())
         return entries, used_index
 
     def all_candidates(self, driver: str, node_name: Optional[str]
@@ -326,7 +531,10 @@ class CatalogSnapshot:
         return entries
 
     def get_device(self, key: DeviceKey) -> Optional[Dict]:
-        entry = self.devices.get(key)
+        sub = self._pools.get(key[0])
+        if sub is None:
+            return None
+        entry = sub.get(key[1])
         return entry.device if entry is not None else None
 
 
@@ -426,12 +634,28 @@ class DeviceCatalog:
     # -- read --------------------------------------------------------------
 
     def snapshot(self) -> CatalogSnapshot:
+        t0 = time.perf_counter()
         with self._mu:
-            return self._state.snapshot()
+            fresh_generation = not self._state._shared
+            snap = self._state.snapshot()
+        if fresh_generation:
+            _GEN_CATALOG.inc()
+        _SNAP_SECONDS["catalog"].observe(time.perf_counter() - t0)
+        return snap
+
+    def copy_snapshot(self) -> CatalogSnapshot:
+        """The copying-baseline arm (bench comparison + parity tests):
+        a full eager copy of every index family."""
+        t0 = time.perf_counter()
+        with self._mu:
+            snap = self._state.copy_snapshot()
+        _SNAP_SECONDS["catalog-copy"].observe(time.perf_counter() - t0)
+        return snap
 
     def get_device(self, key: DeviceKey) -> Optional[Dict]:
         with self._mu:
-            entry = self._state.devices.get(key)
+            sub = self._state.pools.get(key[0])
+            entry = sub.get(key[1]) if sub is not None else None
             return entry.device if entry is not None else None
 
     @property
@@ -500,6 +724,10 @@ class UsageLedger:
                  pool_filter: Optional[Callable[[str], bool]] = None):
         self._driver = driver_name
         self._lookup = device_lookup
+        #: True while a snapshot pins _taken/_usage (copy-on-write:
+        #: the next mutation clones both dicts, the pinned views stay
+        #: frozen — see snapshot())
+        self._snap_shared = False
         # Sharding hook: when set, only devices in pools the filter
         # accepts count toward this ledger's taken/usage aggregates —
         # each shard's ledger is then the single serialization point for
@@ -797,9 +1025,32 @@ class UsageLedger:
     # -- reads -------------------------------------------------------------
 
     def snapshot(self) -> Tuple[Set[DeviceKey], Dict[CounterKey, int]]:
-        """(taken device keys, counter usage) including reservations."""
+        """(taken device keys, counter usage) including reservations.
+
+        Copy-on-write: this is an O(1) *pin* — the returned views
+        reference the live dicts, and the next ledger mutation clones
+        them first (``_apply_locked``), so what a caller holds is
+        frozen at pin time. Both views are READ-ONLY for the caller;
+        the allocator's batch state overlays its own in-batch
+        consumption instead of mutating them. The taken view is a dict
+        keys-view (set-comparable, O(1) membership)."""
+        t0 = time.perf_counter()
         with self._mu:
-            return (set(self._taken), dict(self._usage))
+            if not self._snap_shared:
+                self._snap_shared = True
+                _GEN_LEDGER.inc()
+            taken, usage = self._taken.keys(), self._usage
+        _SNAP_SECONDS["ledger"].observe(time.perf_counter() - t0)
+        return taken, usage
+
+    def copy_snapshot(self) -> Tuple[Set[DeviceKey], Dict[CounterKey, int]]:
+        """The historical copying snapshot (bench comparison arm +
+        winner-parity tests): independent mutable copies."""
+        t0 = time.perf_counter()
+        with self._mu:
+            taken, usage = set(self._taken), dict(self._usage)
+        _SNAP_SECONDS["ledger-copy"].observe(time.perf_counter() - t0)
+        return taken, usage
 
     def holdings(self, uid: str) -> Tuple[DeviceKey, ...]:
         with self._mu:
@@ -852,6 +1103,14 @@ class UsageLedger:
             self._apply_locked(rec, -1)
 
     def _apply_locked(self, rec: _ClaimRecord, sign: int) -> None:
+        if self._snap_shared:
+            # a snapshot pins the current dicts: clone before the first
+            # mutation (O(held devices), not O(fleet)) so the pinned
+            # views stay frozen
+            self._taken = dict(self._taken)
+            self._usage = dict(self._usage)
+            self._snap_shared = False
+            _CLONES["ledger"].inc()
         for key in rec.keys:
             n = self._taken.get(key, 0) + sign
             if n > 0:

@@ -1,0 +1,180 @@
+"""Copy-on-write snapshot primitives for the device catalog.
+
+Reference analog: the informer-fed caches behind client-go listers never
+pay a full copy per read — readers share the store's structures and
+writers replace objects wholesale. The in-repo catalog historically did
+the opposite: every ``snapshot()`` copied every device entry and every
+index set, so at 10k nodes (O(40k) devices) a single allocation batch
+spent its critical path cloning dictionaries (the compressed-week soak
+measured ``allocation.pick`` as the dominant segment fleet-wide, and the
+root cause was exactly this copy — ROADMAP item 4).
+
+This module is the structural-sharing answer:
+
+- :class:`Bucket` — one secondary-index bucket (all devices with
+  ``chipType == "v6e"``, all devices on ``node-0017``, …) held as
+  **per-pool sub-maps** (pool name → device name → entry). A slice event
+  touches one pool, so the index clones only that bucket's outer pointer
+  map plus the touched pool's sub-map; every other pool's sub-map is
+  shared with the pinned generation untouched. Each bucket lazily caches
+  its entries sorted in canonical ``(slice, position)`` order — computed
+  at most once per bucket *generation* (any mutation clones the bucket
+  and drops the cache), so a batch of claims probing the same bucket
+  sorts it once instead of re-sorting the full candidate list per
+  request.
+- :class:`DeviceMap` — a read-only flat ``(pool, device) → entry``
+  mapping view over the catalog's per-pool device store, so snapshot
+  consumers keep the historical ``snapshot.devices[key]`` interface
+  while the underlying storage stays structurally shared.
+
+The ownership protocol lives in ``catalog._IndexState``: a snapshot
+*pins* the current generation (every top-level dict, bucket, and
+sub-map becomes shared); the first mutation after a pin shallow-copies
+the top-level dicts and then clones buckets/sub-maps lazily, only for
+the keys it actually touches. Pinned structures are therefore immutable
+for the snapshot's lifetime — the only post-pin write is the benign
+lazy fill of a bucket's sorted cache (idempotent, atomic slot
+assignment), which is safe under concurrent readers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+#: (pool name, device name) — mirrors catalog.DeviceKey (kept local to
+#: avoid an import cycle; catalog.py re-exports these primitives)
+_Key = Tuple[str, str]
+
+
+def _entry_order(entry) -> Tuple[str, int]:
+    return entry.order
+
+
+class Bucket:
+    """One index bucket: device entries grouped by pool, with a lazily
+    built canonical-order cache.
+
+    Iteration yields device keys (so ``sorted(bucket)`` reads like the
+    old ``Set[DeviceKey]`` representation); ``len()`` is the total
+    device count across pools. NOT generally thread-safe for writes —
+    the catalog clones before mutating once a snapshot pins it, which
+    is what makes concurrent snapshot readers safe."""
+
+    __slots__ = ("pools", "count", "_sorted")
+
+    def __init__(self, pools: Optional[Dict[str, Dict[str, object]]] = None,
+                 count: int = 0):
+        #: pool name -> {device name -> DeviceEntry}
+        self.pools = {} if pools is None else pools
+        self.count = count
+        #: canonical-order entry tuple, built lazily at most once per
+        #: bucket generation (cleared by any mutation/clone)
+        self._sorted: Optional[tuple] = None
+
+    def clone(self) -> "Bucket":
+        """Shallow clone for copy-on-write: the outer pool map is
+        copied (pointer copy), the per-pool sub-maps stay shared until
+        individually touched, the sorted cache is dropped."""
+        return Bucket(dict(self.pools), self.count)
+
+    def deep_clone(self) -> "Bucket":
+        """Full clone — the copying-baseline arm's cost profile."""
+        return Bucket({p: dict(sub) for p, sub in self.pools.items()},
+                      self.count)
+
+    # -- reads -------------------------------------------------------------
+
+    def contains(self, key: _Key) -> bool:
+        sub = self.pools.get(key[0])
+        return sub is not None and key[1] in sub
+
+    def get(self, key: _Key):
+        sub = self.pools.get(key[0])
+        return None if sub is None else sub.get(key[1])
+
+    def entries(self) -> Iterator:
+        for sub in self.pools.values():
+            yield from sub.values()
+
+    def sorted_entries(self) -> tuple:
+        """Entries in canonical ``(slice name, position)`` order. Built
+        once per bucket generation; concurrent first callers may race
+        the build, which is benign (same value, atomic assignment)."""
+        got = self._sorted
+        if got is None:
+            got = tuple(sorted(self.entries(), key=_entry_order))
+            self._sorted = got
+        return got
+
+    def __iter__(self) -> Iterator[_Key]:
+        for pool, sub in self.pools.items():
+            for name in sub:
+                yield (pool, name)
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"Bucket({self.count} over {len(self.pools)} pools)"
+
+
+#: shared empty bucket — the "index bucket absent" sentinel candidate
+#: intersection uses. Read-only BY CONVENTION: the catalog's mutation
+#: helpers never hand it out as a writable bucket (they create a fresh
+#: Bucket for an absent index key), and nothing else writes buckets.
+EMPTY_BUCKET = Bucket()
+
+
+class DeviceMap:
+    """Read-only ``(pool, device) → DeviceEntry`` mapping view over the
+    catalog's per-pool store. Supports the mapping surface snapshot
+    consumers historically used (``[]``/``get``/``in``/iteration over
+    keys/``values``/``items``/``len``) without flattening anything."""
+
+    __slots__ = ("_pools", "_len")
+
+    def __init__(self, pools: Dict[str, Dict[str, object]], length: int):
+        self._pools = pools
+        self._len = length
+
+    def __getitem__(self, key: _Key):
+        sub = self._pools.get(key[0])
+        if sub is None or key[1] not in sub:
+            raise KeyError(key)
+        return sub[key[1]]
+
+    def get(self, key: _Key, default=None):
+        sub = self._pools.get(key[0])
+        if sub is None:
+            return default
+        return sub.get(key[1], default)
+
+    def __contains__(self, key: _Key) -> bool:
+        sub = self._pools.get(key[0])
+        return sub is not None and key[1] in sub
+
+    def __iter__(self) -> Iterator[_Key]:
+        for pool, sub in self._pools.items():
+            for name in sub:
+                yield (pool, name)
+
+    def keys(self) -> "DeviceMap":
+        """Reusable view, like dict.keys(): iterating it twice (or
+        mixing iteration with ``in``) must keep working — the map
+        itself already iterates keys and answers membership."""
+        return self
+
+    def values(self) -> Iterator:
+        for sub in self._pools.values():
+            yield from sub.values()
+
+    def items(self) -> Iterator[Tuple[_Key, object]]:
+        for pool, sub in self._pools.items():
+            for name, entry in sub.items():
+                yield (pool, name), entry
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"DeviceMap({self._len} over {len(self._pools)} pools)"

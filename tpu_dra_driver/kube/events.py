@@ -24,6 +24,9 @@ Semantics modeled on client-go where the driver depends on them:
   source+object the same way), so one crash-looping claim cannot starve
   every other object's events. Over-budget emissions are *dropped*,
   counted in ``dra_events_emitted_total{outcome="dropped"}``.
+  State-shaped reasons (:data:`ASSURED_REASONS`) bypass the bucket:
+  their emitters dedupe to one Event per condition entry, and dropping
+  one leaves a live condition with no Event an operator can see.
 - **Never raise**: event emission is advisory; an API failure is
   counted (``outcome="error"``) and logged at debug, never propagated
   into the reconcile/prepare path that emitted it.
@@ -64,6 +67,21 @@ REASON_CD_READY = "CDReady"
 REASON_VALIDATION_FAILED = "ValidationFailed"
 REASON_SLO_BURN_RATE = "SLOBurnRate"
 
+#: STATE-SHAPED reasons exempt from the per-object token bucket. Their
+#: emitters already dedupe to one Event per condition ENTRY (the
+#: allocation controller emits AllocationParked once per parked
+#: lifecycle and clears it when the claim drains), so their volume is
+#: bounded by condition transitions — not by a crash loop — and a
+#: DROPPED one breaks an operator-visibility invariant: the condition
+#: exists with no Event saying so. The 10k-node COW soak (ISSUE 12,
+#: seed 20260804) caught exactly that: once snapshots stopped costing
+#: O(fleet), route flapping during a 30 s lease-flap window cycled
+#: park/clear fast enough to drain the claim's bucket, and the FINAL
+#: park's Warning was rate-limited away — a live parked claim with no
+#: AllocationParked Event. The bucket is per involved object, so this
+#: exemption cannot let one object starve another's events.
+ASSURED_REASONS = frozenset({REASON_ALLOCATION_PARKED})
+
 #: Worker threads exit after this long idle and respawn on demand, so
 #: short-lived recorders (benches, tests) don't accumulate parked threads.
 _WORKER_IDLE_EXIT = 30.0
@@ -71,6 +89,11 @@ _WORKER_IDLE_EXIT = 30.0
 #: Queue sentinel marking a clear() request (delete emitted Events for an
 #: object+reason) rather than an emission.
 _CLEAR = object()
+
+#: Queue sentinel marking an assure() request (verify state-shaped
+#: Events still exist; recreate only the lost ones) rather than an
+#: emission.
+_ASSURE = object()
 
 
 def _rfc3339(ts: float) -> str:
@@ -151,7 +174,7 @@ class EventRecorder:
                  burst: int = 25,
                  refill_per_sec: float = 0.25,
                  cache_max: int = 512,
-                 queue_max: int = 512):
+                 queue_max: int = 2048):
         self._events = events
         self._component = component
         self._host = host
@@ -231,6 +254,33 @@ class EventRecorder:
                 self._worker.start()
             self._qcond.notify_all()
 
+    def assure(self, namespace: str, reason: str, entries) -> None:
+        """Queue an existence check for state-shaped Events: for each
+        ``(involvedObject ref, message)`` in ``entries`` (one shared
+        ``namespace``), verify an Event with ``reason`` from THIS
+        reportingInstance still exists, and recreate it only if it was
+        lost (queue overflow under an event storm once dropped a park
+        Warning whose emitter fires only on first entry into the
+        condition — the 10k COW soak's finding). Worker-side this costs
+        one Event LIST per call plus an API write per *genuinely
+        missing* Event, so callers may re-assert every live condition
+        on a periodic tick without O(conditions) write amplification —
+        and without minting duplicates when a dedupe-cache entry was
+        LRU-evicted while the Event object survived. Async, never
+        raises, never blocks."""
+        with self._qcond:
+            if self._closed or len(self._queue) >= self._queue_max:
+                _metrics.EVENTS_EMITTED.labels(reason, "dropped").inc()
+                return
+            self._queue.append((_ASSURE, namespace, reason,
+                                tuple((dict(r), m) for r, m in entries)))
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, daemon=True,
+                    name=f"event-recorder-{self._component}")
+                self._worker.start()
+            self._qcond.notify_all()
+
     def queue_depth(self) -> int:
         """Queued-plus-inflight emissions right now — the leak-sentinel
         surface: a recorder whose queue depth grows monotonically across
@@ -290,6 +340,8 @@ class EventRecorder:
             try:
                 if item[0] is _CLEAR:
                     self._clear_emitted(item[1], item[2])
+                elif item[0] is _ASSURE:
+                    self._assure_emitted(item[1], item[2], item[3])
                 else:
                     self._emit(*item)
             except Exception:  # chaos-ok: events are advisory, counted
@@ -331,7 +383,7 @@ class EventRecorder:
             dedupe_target = (dict(cached) if cached is not None
                              and now - cached["last"] <= self._window
                              else None)
-        if not self._take_token(obj_key):
+        if reason not in ASSURED_REASONS and not self._take_token(obj_key):
             _metrics.EVENTS_EMITTED.labels(reason, "dropped").inc()
             return
 
@@ -404,6 +456,68 @@ class EventRecorder:
                 del self._cache[key]
         if removed:
             _metrics.EVENTS_EMITTED.labels(reason, "cleared").inc(removed)
+
+    def _assure_emitted(self, namespace: str, reason: str,
+                        entries) -> None:
+        """Worker side of :meth:`assure`: one LIST, then per entry —
+        found: re-seed the dedupe cache (so the next emission
+        aggregates onto the surviving object) and write nothing;
+        missing: recreate through the normal emit path. Instance-scoped
+        like :meth:`_clear_emitted` — a rival replica's Event does not
+        count as ours existing."""
+        ns = namespace or "default"
+        instance = self._host or self._component
+        # index the candidates once: a capacity crunch can park
+        # thousands of claims, and a per-entry linear scan would stall
+        # the (single) recorder worker for the whole tick
+        by_uid: Dict[str, Dict] = {}
+        by_name: Dict[tuple, Dict] = {}         # any event, for uid-less refs
+        by_name_nouid: Dict[tuple, Dict] = {}   # uid-less events only — a
+        # uid-bearing ref must NOT adopt a same-name event for a
+        # different uid (stale event of a deleted+recreated claim)
+        for ev_obj in self._events.list(namespace=ns):
+            if ev_obj.get("reason") != reason:
+                continue
+            if ev_obj.get("reportingInstance", instance) != instance:
+                continue
+            inv = ev_obj.get("involvedObject") or {}
+            nkey = (inv.get("name", ""), inv.get("namespace", ""))
+            by_name.setdefault(nkey, ev_obj)
+            if inv.get("uid"):
+                by_uid.setdefault(inv["uid"], ev_obj)
+            else:
+                by_name_nouid.setdefault(nkey, ev_obj)
+        for ref, message in entries:
+            nkey = (ref.get("name", ""), ref.get("namespace", ""))
+            if ref.get("uid"):
+                found = by_uid.get(ref["uid"]) or by_name_nouid.get(nkey)
+            else:
+                found = by_name.get(nkey)
+            obj_key = ref.get("uid") or f"{ns}/{ref.get('name', '')}"
+            if found is not None:
+                key = (obj_key, ref.get("kind", ""), WARNING, reason,
+                       message)
+                with self._mu:
+                    if key not in self._cache:
+                        self._cache[key] = {
+                            "name": found["metadata"]["name"],
+                            "namespace": ns,
+                            "count": int(found.get("count") or 1),
+                            "last": time.monotonic(),
+                        }
+                        self._cache.move_to_end(key)
+                        while len(self._cache) > self._cache_max:
+                            self._cache.popitem(last=False)
+                continue
+            # the Event is gone while its condition lives: drop stale
+            # dedupe entries (they name the deleted object) and recreate
+            with self._mu:
+                for k in [k for k in self._cache
+                          if k[0] == obj_key and k[3] == reason]:
+                    del self._cache[k]
+            log.info("re-asserting lost %s Event for %s/%s", reason,
+                     ref.get("namespace", ""), ref.get("name", ""))
+            self._emit(ref, WARNING, reason, message)
 
     def _bump(self, cached: Dict, key: tuple, now: float) -> bool:
         """Aggregate a repeat onto the existing Event object; False when

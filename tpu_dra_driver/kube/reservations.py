@@ -544,7 +544,7 @@ class RemoteCrossShardLedger:
         usage: Dict[CounterKey, int] = {}
         for led in self._unique_local + (self._shadow,):
             t, u = led.snapshot()
-            taken |= t
+            taken.update(t)
             for ck, amount in u.items():
                 usage[ck] = usage.get(ck, 0) + amount
         # recently-denied remote devices read as taken, so a re-pick

@@ -239,11 +239,14 @@ class CrossShardLedger:
     # -- reads -------------------------------------------------------------
 
     def snapshot(self) -> Tuple[Set[DeviceKey], Dict[CounterKey, int]]:
+        # each member snapshot is a COW pin (read-only shared views);
+        # the merge materializes fresh structures, so the result is
+        # independently safe to hold across the batch
         taken: Set[DeviceKey] = set()
         usage: Dict[CounterKey, int] = {}
         for led in self._unique_ledgers:
             t, u = led.snapshot()
-            taken |= t
+            taken.update(t)
             for ck, amount in u.items():
                 usage[ck] = usage.get(ck, 0) + amount
         return taken, usage

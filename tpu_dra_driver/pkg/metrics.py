@@ -580,6 +580,28 @@ ALLOCATOR_PARKED_CLAIMS = DEFAULT_REGISTRY.gauge(
     "ResourceClaims currently parked as unsatisfiable (no capacity or "
     "cross-shard ownership not converged), awaiting a fleet change; "
     "each parked claim also carries an AllocationParked Event")
+CATALOG_SNAPSHOT_SECONDS = DEFAULT_REGISTRY.histogram(
+    "dra_catalog_snapshot_seconds",
+    "Wall time to obtain one consistent per-batch view, by source: "
+    "catalog/ledger are the copy-on-write generation pins the allocator "
+    "uses (near-O(1) by design), catalog-copy/ledger-copy the eager "
+    "full-copy baseline arms kept for the bench comparison",
+    ("source",),
+    buckets=(1e-6, 1e-5, 1e-4, 1e-3, 0.01, 0.1, 1.0))
+CATALOG_GENERATIONS = DEFAULT_REGISTRY.counter(
+    "dra_catalog_generations_total",
+    "Copy-on-write snapshot generations pinned (a pin of an "
+    "already-pinned, unmutated generation does not count), by source "
+    "(catalog = device indexes, ledger = usage)",
+    ("source",))
+CATALOG_BUCKET_CLONES = DEFAULT_REGISTRY.counter(
+    "dra_catalog_bucket_clones_total",
+    "Structures lazily cloned by catalog/ledger copy-on-write — the "
+    "O(delta) work slice events and usage changes pay so pinned "
+    "snapshots stay frozen — by family (toplevel = the per-generation "
+    "shallow top-level dict copies, pool = device-store sub-maps, "
+    "driver/node/attr = index buckets, ledger = the usage dict pair)",
+    ("family",))
 RESOURCESLICE_PUBLISHES = DEFAULT_REGISTRY.counter(
     "dra_resourceslice_publishes_total",
     "ResourceSlice API writes actually performed by republish()",

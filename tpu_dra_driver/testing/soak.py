@@ -92,6 +92,7 @@ from tpu_dra_driver.testing.scenarios import (
     check_no_leaked_subslices,
     check_no_lost_claims,
     check_no_stale_epoch_commits,
+    node_pinned_request,
     synthetic_slice,
 )
 
@@ -230,6 +231,10 @@ class SoakConfig:
     #: catalog snapshot per BATCH instead of per claim
     chip_traffic_arms: int = 1
     sub_traffic_arms: int = 1
+    #: node-pinned claims pushed through the quiesced control plane
+    #: AFTER the binding SLO verdict — the PR-over-PR comparable
+    #: allocation-throughput probe (claims/s) the bench artifact gates
+    burst_claims: int = 64
 
     # controller shape (per replica)
     controller_batch_max: int = 64
@@ -282,6 +287,7 @@ class SoakConfig:
                    n_real_nodes=4, n_synthetic_nodes=12,
                    n_slots=2, n_replicas=2,
                    resident_chip_claims=4,
+                   burst_claims=16,
                    churn_wave_size=2,
                    weather_fail_p=0.0,
                    # a slow CI box multiplies parked-claim retry
@@ -322,6 +328,7 @@ class SoakConfig:
                    n_real_nodes=6, n_synthetic_nodes=10_000,
                    n_slots=4, n_replicas=2,
                    resident_chip_claims=24,
+                   burst_claims=256,
                    traffic_pause_s=0.0,
                    chip_traffic_arms=3, sub_traffic_arms=2,
                    churn_wave_size=50,
@@ -735,6 +742,9 @@ class SoakEngine:
                 config=AllocationControllerConfig(
                     workers=2, batch_max=cfg.controller_batch_max,
                     retry_interval=0.3,
+                    # heal a lost park Event well inside the lost-claims
+                    # invariant's 10s grace window
+                    parked_reassert_interval=2.0,
                     reserve_grant_timeout=cfg.reserve_grant_timeout_s))
             self.replicas[name].start()
         self._await(lambda: self._owned_union() == set(self.ring.members),
@@ -1016,6 +1026,7 @@ class SoakEngine:
         att = criticalpath.aggregate_report(tracing.recorder())
         dominated = att.get("dominated_by") or {}
         dominant = max(dominated, key=dominated.get) if dominated else None
+        dominant_stats = (att.get("segments") or {}).get(dominant) or {}
         tracing.recorder().clear()
         # 6. leak sentinels
         self._sample_sentinels()
@@ -1023,6 +1034,11 @@ class SoakEngine:
             "epoch": epoch,
             "boundary_ms": round((time.monotonic() - t0) * 1e3, 1),
             "dominant_segment": dominant,
+            # the dominant segment's own p50: "dominant" is relative,
+            # this says whether it dominates because it is SLOW (the
+            # snapshot-bound symptom this figure exists to gate) or
+            # merely because everything else got fast
+            "dominant_p50_ms": dominant_stats.get("p50_ms", 0.0),
             "traces_analyzed": att.get("traces_analyzed", 0),
             "slo": {n: row["budget_remaining"]
                     for n, row in cumulative.items()},
@@ -1082,6 +1098,11 @@ class SoakEngine:
                 "failures": sum(len(t.failures) for t in self.traffic),
                 "p99_ms": max((t.report()["p99_ms"]
                                for t in self.traffic), default=0.0),
+                # claims completed per wall second over the whole judged
+                # horizon — the coarse cross-PR throughput trend line
+                "claims_per_wall_s": round(
+                    sum(t.served for t in self.traffic)
+                    / max(wall_s, 1e-9), 2),
             },
             "dominant_segments": [row["dominant_segment"]
                                   for row in self.epoch_rows],
@@ -1099,7 +1120,49 @@ class SoakEngine:
                     f"{ {n: self.sentinels[n].report() for n in leaking} }")
             raise SoakFailure(
                 f"soak FAILED (seed {cfg.seed}): " + "; ".join(problems))
+        # AFTER the binding verdict (so its successes can never inflate
+        # the judged budgets), with traffic stopped: the direct
+        # allocation-throughput probe the bench artifact gates
+        report["allocation_burst"] = self._allocation_burst()
         return report
+
+    def _allocation_burst(self) -> Dict:
+        """Push ``burst_claims`` node-pinned claims through the live
+        sharded control plane on the quiesced fleet and measure
+        create-to-allocated claims/s. Node-pinned over synthetic pools:
+        pure allocation-plane work (snapshot + pick + commit), no
+        prepare — the figure that collapses when per-batch snapshots
+        cost O(fleet) (PR 11 recorded ~2 claims/s equivalent at 10k
+        nodes). Claims are deleted afterwards."""
+        cfg = self.config
+        n = cfg.burst_claims
+        if n <= 0 or not self._synthetic:
+            return {"claims": 0, "wall_s": 0.0, "per_sec": 0.0}
+        # start mid-fleet: canonical pick parks the resident claims on
+        # the canonically-first pools, whose devices may be full
+        base = len(self._synthetic) // 2
+        names = []
+        t0 = time.monotonic()
+        for i in range(n):
+            node = self._synthetic[(base + i) % len(self._synthetic)]
+            name = f"burst-{i}"
+            self.observer.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "soak"},
+                "spec": {"devices": {"requests":
+                                     node_pinned_request(node,
+                                                         type_="chip")}},
+            })
+            names.append(name)
+        self._await(
+            lambda: all(self._allocated(nm, "soak") for nm in names),
+            cfg.converge_timeout, "allocation burst drained")
+        wall = time.monotonic() - t0
+        for nm in names:
+            self.observer.resource_claims.delete_ignore_missing(nm, "soak")
+        return {"claims": n, "wall_s": round(wall, 3),
+                "per_sec": round(n / max(wall, 1e-9), 1)}
 
     # ------------------------------------------------------------------
     # helpers
