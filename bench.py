@@ -1406,6 +1406,101 @@ def bench_fencing(n_cross_claims: int = 32,
     return out
 
 
+def bench_repartition() -> dict:
+    """Dynamic repartitioning at fleet scale (ISSUE 13): the
+    repartition-storm scenario — waves of creatable-profile claims
+    reshaping every node's chips on demand UNDER live claim-per-request
+    serving traffic, with a kill between partition create and
+    checkpoint commit mid-run. Recorded: reshape p50/p99 (claim create
+    → partition live), crash-recovery time (restart → reconcile →
+    claim re-prepared), and the serving tier's loss-free completion
+    with its per-client HBM budget proven to bind. Gated by
+    tests/test_bench_artifact.py."""
+    import shutil
+
+    from tpu_dra_driver.testing.scenarios import scenario_repartition_storm
+
+    tmp = tempfile.mkdtemp(prefix="bench-repartition-")
+    try:
+        report = scenario_repartition_storm(
+            tmp, n_nodes=4, serving_requests=32,
+            storm_waves=3, claims_per_wave=4)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    out = {
+        "reshapes": report["reshapes"],
+        "reshape_p50_ms": report["reshape_p50_ms"],
+        "reshape_p99_ms": report["reshape_p99_ms"],
+        "recovery_ms": report["recovery_ms"],
+        "serving": report["serving"],
+        "scenario": report,
+    }
+    log(f"  {out['reshapes']} reshapes: p50 {out['reshape_p50_ms']:.0f} ms "
+        f"/ p99 {out['reshape_p99_ms']:.0f} ms; kill-mid-reshape recovery "
+        f"{out['recovery_ms']:.0f} ms; serving {report['serving']['requests']} "
+        f"requests, {report['serving']['failures']} failures, budgets "
+        f"enforced={report['serving']['budget_enforced']}")
+    return out
+
+
+def bench_serving_density(requests: int = 64) -> dict:
+    """Claim-per-request serving density (ISSUE 13): the continuous-
+    batching serving workload as traffic generator over shared-chip
+    client seats — every request one small ResourceClaim with an
+    enforced per-client HBM budget. Measured: end-to-end requests/s
+    through the full claim lifecycle (create → allocate → prepare/seat
+    → engine admission → decode → release) and the claims-per-chip
+    density the ROADMAP names as what 'millions of users' means for a
+    device driver. Gated by tests/test_bench_artifact.py."""
+    import shutil
+
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        AllocationControllerConfig,
+    )
+    from tpu_dra_driver.testing.scenarios import (
+        MiniFleet,
+        ServingTraffic,
+        check_no_residual_shares,
+        repartition_gates,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench-serving-density-")
+    fleet = MiniFleet(tmp, 1, gates=repartition_gates())
+    controller = AllocationController(
+        fleet.clients,
+        AllocationControllerConfig(workers=2, retry_interval=0.5))
+    try:
+        fleet.start()
+        controller.start()
+        serving = ServingTraffic(
+            fleet.clients,
+            plugin_for=lambda pool: (fleet.nodes[pool].tpu_plugin
+                                     if pool in fleet.nodes else None),
+            total_requests=requests, alloc_timeout=60.0)
+        t0 = time.monotonic()
+        serving.start()
+        report = serving.stop(timeout=600.0)
+        wall = time.monotonic() - t0
+        check_no_residual_shares(fleet.nodes.values())
+    finally:
+        controller.stop()
+        fleet.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    out = {
+        **report,
+        "wall_s": round(wall, 2),
+        "requests_per_sec": round(report["requests"] / max(wall, 1e-9), 2),
+    }
+    log(f"  {out['requests']} requests in {out['wall_s']:.1f}s = "
+        f"{out['requests_per_sec']:.1f} req/s; density "
+        f"{out['claims_per_chip_served']} claims served on the densest "
+        f"chip ({out['claims_per_chip_concurrent']} concurrent), "
+        f"{out['failures']} failures, budget "
+        f"enforced={out['budget_enforced']}")
+    return out
+
+
 def bench_soak() -> dict:
     """10k-node compressed-week endurance soak (ISSUE 11): the scale
     machinery, adversity primitives and judges finally run TOGETHER,
@@ -2011,6 +2106,8 @@ SUMMARY_KEYS = [
     "fleet_drain_reconverge_ms", "fleet_storm_clear_ms",
     "fleet_upgrade_gap_failures", "fleet_churn_p99_ms",
     "fencing_recovery_ms", "crossshard_multireplica_per_sec",
+    "repartition_reshape_p99_ms", "repartition_recovery_ms",
+    "serving_claims_per_chip", "serving_density_req_per_sec",
     "soak_nodes", "soak_epochs", "soak_budget_min", "soak_claims",
     "soak_alloc_burst_per_sec",
     "trace_disabled_ns", "metrics_render_ms",
@@ -2188,6 +2285,22 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"  fencing bench failed ({type(e).__name__}: {e})")
 
+    log("[bench] dynamic repartitioning (reshape storm + kill-mid-reshape "
+        "under serving traffic)…")
+    repartition = {}
+    try:
+        repartition = bench_repartition()
+    except Exception as e:  # noqa: BLE001
+        log(f"  repartition bench failed ({type(e).__name__}: {e})")
+
+    log("[bench] claim-per-request serving density (shared-chip seats, "
+        "continuous-batching traffic generator)…")
+    serving_density = {}
+    try:
+        serving_density = bench_serving_density()
+    except Exception as e:  # noqa: BLE001
+        log(f"  serving-density bench failed ({type(e).__name__}: {e})")
+
     log("[bench] endurance soak (10k nodes, compressed week, composed "
         "adversity, SLO-gated)…")
     soak_report = {}
@@ -2356,6 +2469,18 @@ def main() -> int:
             "crossshard_multireplica_per_sec":
                 fencing["crossshard_claims_per_sec"]}
            if fencing else {}),
+        # dynamic repartitioning + claim-per-request serving density
+        # (full scenario evidence under the repartition key)
+        "repartition": repartition,
+        **({"repartition_reshape_p99_ms": repartition["reshape_p99_ms"],
+            "repartition_recovery_ms": repartition["recovery_ms"]}
+           if repartition else {}),
+        "serving_density": serving_density,
+        **({"serving_claims_per_chip":
+                serving_density["claims_per_chip_served"],
+            "serving_density_req_per_sec":
+                serving_density["requests_per_sec"]}
+           if serving_density else {}),
         # compressed-week endurance soak (full per-epoch evidence,
         # sentinel series and cumulative budgets under the soak key)
         "soak": soak_report,

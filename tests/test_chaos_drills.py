@@ -14,6 +14,7 @@ ledger (>= 12 points required by the chaos acceptance criteria).
 """
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -852,6 +853,273 @@ def test_drill_resourceslice_publish_failure_recovers(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# dynamic repartitioning drills (ISSUE 13): kill the reshape state
+# machine at every dangerous instant — between write-ahead and create,
+# between create and commit, at the pick, at reclaim, mid-reconcile, and
+# at the capacity-advertising republish — and prove the PR-3 invariant
+# contract holds after restart (no leaked sub-slices, readable
+# checkpoint, idempotent unprepare).
+# ---------------------------------------------------------------------------
+
+
+def _repartition_gates():
+    return _gates(DynamicSubslice=True, DynamicRepartition=True)
+
+
+def _profile_claims(n=1, chip_base=0):
+    return [build_allocated_claim(
+        f"u{i}", f"claim-u{i}", "user-ns",
+        [f"tpu-{chip_base + i}-prof-1c47g-0"], NODE)
+        for i in range(n)]
+
+
+def test_drill_repartition_create_crash_between_writeahead_and_create(
+        tmp_path):
+    """Kill between the PrepareStarted write-ahead and the partition
+    create: nothing was created, the entry rolls back, a retried prepare
+    places cleanly."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_repartition_gates())
+    plugin = drill.start()
+    claims = _profile_claims(2)
+    rule = fi.arm("repartition.create", fi.Rule(mode="crash", nth=1))
+    res = plugin.prepare_resource_claims(claims)
+    assert rule.fires == 1
+    assert res["u0"].error is not None
+    # the crash landed BEFORE any hardware mutation on the crashed claim
+    # and per-claim isolation let the peer proceed
+    assert res["u1"].error is None
+    assert len(drill.lib.list_subslices()) == 1
+    cp = drill.plugin.state.get_checkpoint()
+    assert cp.claims["u0"].state == PREPARE_STARTED
+    drill.restart()
+    drill.assert_recovered(claims)
+    assert drill.lib.list_subslices() == []
+
+
+def test_drill_repartition_created_crash_between_create_and_commit(
+        tmp_path):
+    """The worst instant: the partition is LIVE but the checkpoint only
+    holds the write-ahead. The restarted plugin's reconcile must destroy
+    the orphan, and the retried claim re-places cleanly."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_repartition_gates())
+    plugin = drill.start()
+    claims = _profile_claims(1)
+    rule = fi.arm("repartition.created", fi.Rule(mode="crash", nth=1))
+    res = plugin.prepare_resource_claims(claims)
+    assert rule.fires == 1 and res["u0"].error is not None
+    # live orphan + PrepareStarted: exactly the crash residue
+    assert len(drill.lib.list_subslices()) == 1
+    assert drill.plugin.state.get_checkpoint().claims["u0"].state \
+        == PREPARE_STARTED
+    drill.restart()
+    # startup reconcile destroyed the orphan before serving anything
+    assert drill.lib.list_subslices() == []
+    drill.assert_recovered(claims)
+
+
+def test_drill_repartition_place_fail_and_corrupt_pick(tmp_path):
+    """A failed pick is isolated to the claim; a CORRUPTED pick (the
+    picker returning an illegal placement) must fail loudly before any
+    partition is created under a name the checkpoint would then
+    mis-record."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_repartition_gates())
+    plugin = drill.start()
+    claims = _profile_claims(1)
+    rule = fi.arm("repartition.place", fi.Rule(mode="fail", nth=1))
+    assert plugin.prepare_resource_claims(claims)["u0"].error is not None
+    assert rule.fires == 1
+    assert drill.lib.list_subslices() == []
+    fi.disarm("repartition.place")
+    fi.arm("repartition.place",
+           fi.Rule(mode="corrupt", nth=1, mutate=lambda start: 99))
+    res = plugin.prepare_resource_claims(claims)["u0"]
+    assert res.error is not None and "not a free" in res.error
+    assert drill.lib.list_subslices() == []
+    fi.disarm("repartition.place")
+    drill.assert_recovered(claims)
+
+
+def test_drill_repartition_latency_lands_in_reshape_histogram(tmp_path):
+    """Latency mode on the create path: the reshape actually slows and
+    the dra_subslice_reshape_seconds histogram records it — the
+    observability the reshape p99 bench reads."""
+    from tpu_dra_driver.pkg.metrics import SUBSLICE_RESHAPE_SECONDS
+
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_repartition_gates())
+    plugin = drill.start()
+    child = SUBSLICE_RESHAPE_SECONDS.labels("create")
+    _, s0, n0 = child.snapshot()
+    fi.arm("repartition.create", fi.Rule(mode="latency", seconds=0.05))
+    assert plugin.prepare_resource_claims(
+        _profile_claims(1))["u0"].error is None
+    _, s1, n1 = child.snapshot()
+    assert n1 - n0 == 1
+    assert s1 - s0 >= 0.05
+
+
+def test_drill_repartition_reclaim_fail_then_idempotent_retry(tmp_path):
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_repartition_gates())
+    plugin = drill.start()
+    claims = _profile_claims(1)
+    assert plugin.prepare_resource_claims(claims)["u0"].error is None
+    assert len(drill.lib.list_subslices()) == 1
+    rule = fi.arm("repartition.reclaim", fi.Rule(mode="fail", nth=1))
+    out = plugin.unprepare_resource_claims(["u0"])
+    assert rule.fires == 1 and out["u0"] is not None
+    # teardown failed BEFORE the destroy: partition live, entry kept
+    assert len(drill.lib.list_subslices()) == 1
+    assert "u0" in drill.plugin.state.get_checkpoint().claims
+    # retry completes; a third call stays clean (idempotent)
+    assert plugin.unprepare_resource_claims(["u0"]) == {"u0": None}
+    assert drill.lib.list_subslices() == []
+    assert plugin.unprepare_resource_claims(["u0"]) == {"u0": None}
+
+
+def test_drill_repartition_reconcile_crash_mid_sweep_is_idempotent(
+        tmp_path):
+    """The recovery sweep itself dies mid-way (after destroying one of
+    two orphans): a re-run finishes the job — reconcile reads hardware +
+    checkpoint truth each pass and never journals its own progress."""
+    from tpu_dra_driver.tpulib.partition import SubsliceProfile, SubsliceSpec
+
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_repartition_gates())
+    plugin = drill.start()
+    # two orphans no checkpoint entry owns (a crashed foreign writer)
+    chips = drill.lib.enumerate_chips()
+    for chip in chips[:2]:
+        prof = SubsliceProfile(chip.generation, 1)
+        drill.lib.create_subslice(SubsliceSpec(chip.index, chip.uuid,
+                                               prof, 0))
+    assert len(drill.lib.list_subslices()) == 2
+    rule = fi.arm("repartition.reconcile", fi.Rule(mode="crash", nth=2))
+    with pytest.raises(fi.CrashInjected):
+        drill.restart()           # dies after destroying the first orphan
+    assert rule.calls == 2 and rule.fires == 1
+    assert len(drill.lib.list_subslices()) == 1
+    fi.disarm("repartition.reconcile")
+    drill.restart()
+    assert drill.lib.list_subslices() == []
+    drill.assert_recovered(_profile_claims(2))
+
+
+def test_drill_repartition_advertise_failure_keeps_dirty_and_converges(
+        tmp_path):
+    """A failed capacity republish must not fail the claim: the error is
+    counted, the dirty flag survives, and the NEXT reshape's republish
+    converges the advertised capacity."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=_repartition_gates())
+    plugin = drill.start()
+
+    def published_names():
+        return {d["name"] for s in drill.clients.resource_slices.list()
+                for d in s["spec"]["devices"]}
+
+    assert "tpu-0-ss-1c47g-0" in published_names()
+    s0 = SWALLOWED_ERRORS.labels("repartition.advertise").value
+    rule = fi.arm("repartition.advertise", fi.Rule(mode="fail", nth=1))
+    claims = _profile_claims(1)
+    res = plugin.prepare_resource_claims(claims)["u0"]
+    assert res.error is None, "advertise failure must not fail the claim"
+    assert rule.fires == 1
+    assert SWALLOWED_ERRORS.labels("repartition.advertise").value - s0 == 1
+    placed = res.devices[0].canonical_name
+    assert placed.startswith("tpu-0-ss-")
+    # stale: the overlapped placement is still advertised this round
+    assert placed in published_names()
+    fi.disarm("repartition.advertise")
+    # the next reshape (a second claim) retries the republish: BOTH
+    # chips' remaining capacity now reflected
+    res2 = plugin.prepare_resource_claims(
+        _profile_claims(1, chip_base=1))["u0"]
+    assert res2.error is None
+    names = published_names()
+    assert placed not in names
+    assert res2.devices[0].canonical_name not in names
+    # reclaim restores the full creatable inventory
+    plugin.unprepare_resource_claims(["u0"])
+    assert "tpu-0-ss-1c47g-0" in published_names()
+
+
+def test_drill_repartition_hard_kill_137_across_process_boundary(tmp_path):
+    """crash:hard between partition create and checkpoint commit in a
+    REAL subprocess (armed via the TPU_DRA_FAULTS env grammar, exit code
+    137): the on-disk checkpoint holds the write-ahead only, and a fresh
+    plugin over the same state dir rolls the attempt back and re-serves
+    the claim cleanly."""
+    import subprocess
+    import sys
+
+    state = tmp_path / "state"
+    cdi = tmp_path / "cdi"
+    script = (
+        "import json, sys\n"
+        "from tpu_dra_driver.pkg import faultinject as fi\n"
+        "from tpu_dra_driver.kube.client import ClientSets\n"
+        "from tpu_dra_driver.pkg import featuregates as fg\n"
+        "from tpu_dra_driver.plugin.driver import PluginConfig, "
+        "TpuKubeletPlugin\n"
+        "from tpu_dra_driver.plugin.claims import build_allocated_claim\n"
+        "from tpu_dra_driver.tpulib.fake import FakeSystemConfig, "
+        "FakeTpuLib\n"
+        "assert fi.arm_from_env() == 1\n"
+        "gates = fg.FeatureGates()\n"
+        "gates.set(fg.DYNAMIC_SUBSLICE, True)\n"
+        "gates.set(fg.DYNAMIC_REPARTITION, True)\n"
+        "lib = FakeTpuLib(FakeSystemConfig(accelerator_type='v5p-8'))\n"
+        f"plugin = TpuKubeletPlugin(ClientSets(), lib, PluginConfig(\n"
+        f"    node_name='subproc-node', state_dir={str(state)!r},\n"
+        f"    cdi_root={str(cdi)!r}, gates=gates))\n"
+        "plugin.start()\n"
+        "claim = build_allocated_claim('hk-u0', 'hk-claim', 'ns',\n"
+        "                              ['tpu-0-prof-1c47g-0'],\n"
+        "                              'subproc-node')\n"
+        "plugin.prepare_resource_claims([claim])\n"
+        "print('UNREACHABLE'); sys.exit(0)\n")
+    env = dict(os.environ,
+               TPU_DRA_FAULTS="repartition.created=crash:hard@nth:1")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 137, (proc.returncode, proc.stderr[-2000:])
+    assert "UNREACHABLE" not in proc.stdout
+    # the fsync'd write-ahead survived the SIGKILL-equivalent exit
+    from tpu_dra_driver.plugin.checkpoint import CheckpointManager
+    cp = CheckpointManager(str(state)).read()
+    assert cp.claims["hk-u0"].state == PREPARE_STARTED
+    # a fresh plugin over the same state dir (the replacement pod): the
+    # stale write-ahead rolls back and the claim prepares cleanly
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.plugin.claims import build_allocated_claim
+    from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+    from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+
+    gates = _repartition_gates()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(ClientSets(), lib, PluginConfig(
+        node_name="subproc-node", state_dir=str(state),
+        cdi_root=str(cdi), gates=gates))
+    plugin.start()
+    try:
+        claim = build_allocated_claim("hk-u0", "hk-claim", "ns",
+                                      ["tpu-0-prof-1c47g-0"],
+                                      "subproc-node")
+        res = plugin.prepare_resource_claims([claim])["hk-u0"]
+        assert res.error is None
+        assert len(lib.list_subslices()) == 1
+        assert plugin.unprepare_resource_claims(
+            ["hk-u0"]) == {"hk-u0": None}
+        assert lib.list_subslices() == []
+    finally:
+        plugin.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # review-fix regressions
 # ---------------------------------------------------------------------------
 
@@ -980,6 +1248,12 @@ DRILLED_POINTS = [
     "allocator.pre-commit",
     "catalog.index-rebuild",
     "resourceslice.publish",
+    "repartition.place",
+    "repartition.create",
+    "repartition.created",
+    "repartition.reclaim",
+    "repartition.advertise",
+    "repartition.reconcile",
 ]
 
 
@@ -1004,7 +1278,7 @@ def test_drill_matrix_covers_at_least_twelve_registered_points():
     # points (p.*) that are not part of the matrix.
     prod = ("rest.", "informer.", "checkpoint.", "plugin.", "cd.",
             "grpc.", "daemon.", "tpulib.", "allocator.", "catalog.",
-            "resourceslice.")
+            "resourceslice.", "repartition.")
     gap = [p for p in drill_catalog_coverage(DRILLED_POINTS)
            if p.startswith(prod)]
     assert all(p.startswith("tpulib.") for p in gap), (

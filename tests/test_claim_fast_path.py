@@ -211,10 +211,10 @@ def test_batch_overlap_loser_succeeds_when_winner_fails(tmp_path,
     state = plugin.state
     real = state._prepare_devices
 
-    def failing_for_u1(claim):
+    def failing_for_u1(claim, cp):
         if claim.uid == "u1":
             raise RuntimeError("injected transient failure")
-        return real(claim)
+        return real(claim, cp)
 
     monkeypatch.setattr(state, "_prepare_devices", failing_for_u1)
     res = plugin.prepare_resource_claims([
@@ -236,7 +236,7 @@ def test_batch_with_no_completed_claim_skips_commit_write(tmp_path,
     are exactly what rollback needs), not a byte-identical commit."""
     plugin, _, _ = _mkplugin(tmp_path)
 
-    def always_failing(claim):
+    def always_failing(claim, cp):
         raise RuntimeError("injected transient failure")
 
     monkeypatch.setattr(plugin.state, "_prepare_devices", always_failing)

@@ -471,3 +471,117 @@ def test_live_debug_allocator_residue_matches_ledger(tmp_path):
         assert res["extra"] == [["res-0", "tpu-1"]]
     finally:
         ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# SUBSLICE_ORPHANS: the live-partition manifest vs checkpoint intent
+# (ISSUE 13) — collected offline from the state dir alone
+# ---------------------------------------------------------------------------
+
+
+def _write_repartition_state(state, owned, live):
+    """A plugin state dir with a checkpoint owning ``owned`` devices and
+    a partitions.json manifest listing ``live`` partitions."""
+    from tpu_dra_driver.plugin.checkpoint import (
+        Checkpoint,
+        CheckpointManager,
+        ClaimEntry,
+        PreparedDevice,
+        PREPARE_COMPLETED,
+    )
+    mgr = CheckpointManager(str(state))
+    cp = Checkpoint(claims={
+        f"uid-{i}": ClaimEntry(
+            claim_uid=f"uid-{i}", state=PREPARE_COMPLETED,
+            prepared_devices=[PreparedDevice(canonical_name=name,
+                                             request="r")])
+        for i, name in enumerate(owned)})
+    mgr.write(cp)
+    with open(os.path.join(str(state), "partitions.json"), "w") as f:
+        json.dump({"updated_unix": 1.0, "partitions": live}, f)
+
+
+def test_collect_state_dir_computes_subslice_orphans(tmp_path):
+    state = tmp_path / "plugin-state"
+    state.mkdir()
+    _write_repartition_state(
+        state, owned=["tpu-0-ss-1c47g-0"],
+        live=["tpu-0-ss-1c47g-0", "tpu-1-ss-1c47g-1"])
+    out = doctor.collect_state_dir(str(state))
+    assert out["partitions"]["live"] == ["tpu-0-ss-1c47g-0",
+                                         "tpu-1-ss-1c47g-1"]
+    assert out["subslice_orphans"] == ["tpu-1-ss-1c47g-1"]
+
+
+def test_finding_subslice_orphans_warning(tmp_path):
+    state = tmp_path / "plugin-state"
+    state.mkdir()
+    _write_repartition_state(
+        state, owned=["tpu-0-ss-1c47g-0"],
+        live=["tpu-0-ss-1c47g-0", "tpu-1-ss-1c47g-1"])
+    bundle = {"components": {},
+              "state_dirs": {"node0": doctor.collect_state_dir(str(state))}}
+    findings = doctor.run_findings(bundle)
+    orphan = [f for f in findings if f.code == "SUBSLICE_ORPHANS"]
+    assert len(orphan) == 1
+    assert orphan[0].severity == doctor.WARNING
+    assert orphan[0].component == "node0"
+    assert orphan[0].details["partitions"] == ["tpu-1-ss-1c47g-1"]
+
+
+def test_no_subslice_orphans_when_manifest_matches_or_absent(tmp_path):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    _write_repartition_state(clean, owned=["tpu-0-ss-1c47g-0"],
+                             live=["tpu-0-ss-1c47g-0"])
+    no_manifest = tmp_path / "nomanifest"
+    no_manifest.mkdir()
+    from tpu_dra_driver.plugin.checkpoint import Checkpoint, CheckpointManager
+    CheckpointManager(str(no_manifest)).write(Checkpoint())
+    for name, state in (("clean", clean), ("nomanifest", no_manifest)):
+        bundle = {"components": {},
+                  "state_dirs": {name: doctor.collect_state_dir(str(state))}}
+        assert not [f for f in doctor.run_findings(bundle)
+                    if f.code == "SUBSLICE_ORPHANS"], name
+
+
+def test_subslice_orphans_end_to_end_from_live_plugin(tmp_path):
+    """The whole surface against a REAL plugin state dir: a crash between
+    partition create and checkpoint commit leaves a live orphan whose
+    manifest entry the doctor flags; the restarted plugin's reconcile
+    clears it and the next bundle is clean."""
+    from tpu_dra_driver.pkg import faultinject as fi
+    from tpu_dra_driver.plugin.claims import build_allocated_claim
+    from tpu_dra_driver.testing.harness import PluginCrashDrill
+    from tpu_dra_driver.pkg import featuregates as fg
+
+    gates = fg.FeatureGates()
+    gates.set(fg.DYNAMIC_SUBSLICE, True)
+    gates.set(fg.DYNAMIC_REPARTITION, True)
+    drill = PluginCrashDrill(str(tmp_path), node_name="doc-node",
+                             gates=gates)
+    plugin = drill.start()
+    state_dir = os.path.join(str(tmp_path), "drill-plugin")
+    try:
+        claim = build_allocated_claim("u0", "c0", "ns",
+                                      ["tpu-0-prof-1c47g-0"], "doc-node")
+        fi.arm("repartition.created", fi.Rule(mode="crash", nth=1))
+        assert plugin.prepare_resource_claims(
+            [claim])["u0"].error is not None
+        fi.disarm("repartition.created")
+        # the manifest records the live orphan the checkpoint never
+        # committed — exactly what the doctor must flag
+        bundle = {"components": {},
+                  "state_dirs": {"doc-node":
+                                 doctor.collect_state_dir(state_dir)}}
+        codes = [(f.severity, f.code) for f in doctor.run_findings(bundle)]
+        assert (doctor.WARNING, "SUBSLICE_ORPHANS") in codes
+        drill.restart()        # reconcile destroys the orphan
+        bundle = {"components": {},
+                  "state_dirs": {"doc-node":
+                                 doctor.collect_state_dir(state_dir)}}
+        assert not [f for f in doctor.run_findings(bundle)
+                    if f.code == "SUBSLICE_ORPHANS"]
+    finally:
+        fi.reset()
+        drill.crash()

@@ -101,6 +101,41 @@ def test_scenario_health_storm(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# scenario 5: dynamic repartitioning storm under inference-density traffic
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_repartition_storm(tmp_path):
+    """The tier-1 shape of the reshape-storm acceptance scenario: waves
+    of creatable-profile claims reshape every node's chips under live
+    claim-per-request serving traffic, with a kill between partition
+    create and checkpoint commit mid-run — zero leaked sub-slices, zero
+    residual seats, the restarted plugin reconciles the orphan, the
+    serving tier finishes loss-free and the per-client HBM budget
+    provably binds."""
+    from tpu_dra_driver.testing.scenarios import scenario_repartition_storm
+
+    report = scenario_repartition_storm(
+        str(tmp_path), n_nodes=2, serving_requests=8,
+        storm_waves=2, claims_per_wave=2)
+    steps = _steps(report)
+    for required in ("reshape_wave_0", "reshape_wave_1",
+                     "kill_mid_reshape", "serving_complete"):
+        assert required in steps, (required, report)
+    assert report["reshapes"] == 2 * 2 * 2       # waves x nodes x claims
+    assert report["reshape_p50_ms"] > 0
+    assert report["reshape_p99_ms"] >= report["reshape_p50_ms"]
+    assert 0 < report["recovery_ms"] < 30_000
+    serving = report["serving"]
+    assert serving["requests"] == 8
+    assert serving["failures"] == 0
+    assert serving["budget_enforced"] is True
+    assert serving["claims_per_chip_served"] >= 2
+    assert serving["claims_per_chip_concurrent"] >= 1
+    assert serving["p99_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
 # scenario 4: autoscaler churn (small deterministic tier-1 shape)
 # ---------------------------------------------------------------------------
 
@@ -512,8 +547,10 @@ def test_soak_smoke_tier1():
     # the week's adversity actually happened: every source on the tape
     # executed at least once, and traffic flowed throughout
     for kind in ("drain", "undrain", "storm", "service", "upgrade",
-                 "churn", "weather", "cd_cycle"):
+                 "churn", "weather", "cd_cycle", "reshape"):
         assert report["events_executed"].get(kind, 0) >= 1, kind
+    # the reshape source's leak sentinel stayed flat at zero
+    assert report["sentinels"]["partition_residue"]["samples"][-1] == 0
     stalls = (report["events_executed"].get("flap", 0)
               + report["events_executed"].get("partition", 0))
     assert stalls >= 2

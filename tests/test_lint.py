@@ -418,6 +418,11 @@ _SLO_EXEMPT = {
         "design); the user-facing allocation-latency SLO already "
         "interprets the path this family decomposes — it exists so the "
         "bench's snapshot_cost arms and regressions are scrapeable",
+    "dra_subslice_reshape_seconds":
+        "a component of prepare latency (partition create/destroy "
+        "inside NodePrepareResources) already covered by the per-claim "
+        "prepare SLO; it exists so the bench's reshape p50/p99 and the "
+        "repartition-storm scenario regressions are scrapeable",
 }
 
 
@@ -497,6 +502,8 @@ _DRILL_ALLOWLIST = {
     "tpulib.set_exclusive_mode",
     "tpulib.allocate_multiprocess_share",
     "tpulib.release_multiprocess_share",
+    "tpulib.attach_multiprocess_seat",
+    "tpulib.detach_multiprocess_seat",
     "tpulib.bind_to_vfio",
     "tpulib.unbind_from_vfio",
 }
@@ -532,7 +539,7 @@ def test_drill_catalog_coverage_enforced():
     prod = ("rest.", "informer.", "checkpoint.", "plugin.", "cd.",
             "grpc.", "daemon.", "tpulib.", "allocator.", "catalog.",
             "resourceslice.", "sharding.", "leaderelection.",
-            "substrate.")
+            "substrate.", "repartition.")
     gap = [p for p in drill_catalog_coverage(drilled)
            if p.startswith(prod)]
     unaccounted = sorted(set(gap) - _DRILL_ALLOWLIST)

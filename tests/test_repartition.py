@@ -1,0 +1,470 @@
+"""The dynamic repartitioning state machine (plugin/repartition.py) and
+its canonical-name recovery contract.
+
+The crash drills live in tests/test_chaos_drills.py; this module pins
+the pure mechanics: ``parse_canonical_name`` round-trips EVERY name the
+dynamic placement picker can generate (all profiles x starts x slots x
+seats x generations — the recovery contract), placement picking honors
+live partitions / checkpoint intent / client seats, capacity advertising
+hides exactly the consumed inventory, the live-partition manifest lands
+next to the checkpoint, and the checkpoint's ``sourceDevice`` field
+survives a write/read cycle.
+"""
+
+import json
+
+import pytest
+
+from tpu_dra_driver.api.configs import MAX_MULTI_PROCESS_CLIENTS
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.plugin.allocatable import (
+    DeviceType,
+    SEAT_HBM_PERCENT,
+    enumerate_allocatable,
+)
+from tpu_dra_driver.plugin.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    ClaimEntry,
+    PreparedDevice,
+    PREPARE_COMPLETED,
+)
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.plugin.driver import PluginConfig, TpuKubeletPlugin
+from tpu_dra_driver.plugin.repartition import RepartitionManager
+from tpu_dra_driver.tpulib.fake import FakeSystemConfig, FakeTpuLib
+from tpu_dra_driver.tpulib.interface import TpuLibError
+from tpu_dra_driver.tpulib.partition import (
+    SEAT_COUNT,
+    ParsedChip,
+    ParsedProfile,
+    ParsedShared,
+    ParsedSubslice,
+    ParsedVfio,
+    SubsliceSpec,
+    canonical_chip_name,
+    canonical_profile_name,
+    canonical_shared_name,
+    canonical_subslice_name,
+    canonical_vfio_name,
+    parse_canonical_name,
+    profiles_for,
+    seat_core,
+)
+from tpu_dra_driver.tpulib.topology import GENERATIONS
+
+
+def _gates(**over):
+    g = fg.FeatureGates()
+    for k, v in over.items():
+        g.set(k, v)
+    return g
+
+
+def _repartition_gates():
+    return _gates(DynamicSubslice=True, DynamicRepartition=True,
+                  SharedChipServing=True)
+
+
+# ---------------------------------------------------------------------------
+# the recovery contract: parse round-trips the whole dynamic name space
+# ---------------------------------------------------------------------------
+
+
+def test_parse_canonical_name_roundtrips_every_pickable_name():
+    """Property: for every generation, every profile, every placement
+    start the picker can choose, every anonymous slot and every client
+    seat — the canonical name parses back to exactly its identity. This
+    is what lets a restarted plugin recover teardown targets from the
+    checkpoint alone."""
+    checked = 0
+    for gen in GENERATIONS.values():
+        for chip_index in (0, 3, 17):
+            name = canonical_chip_name(chip_index)
+            assert parse_canonical_name(name) == ParsedChip(chip_index)
+            name = canonical_vfio_name(chip_index)
+            assert parse_canonical_name(name) == ParsedVfio(chip_index)
+            for prof in profiles_for(gen):
+                for start in prof.placements():
+                    name = canonical_subslice_name(chip_index, prof, start)
+                    parsed = parse_canonical_name(name)
+                    assert isinstance(parsed, ParsedSubslice), name
+                    assert parsed.tuple.parent_index == chip_index
+                    assert parsed.tuple.profile_id == prof.id
+                    assert parsed.tuple.placement_start == start
+                    assert parsed.tuple.canonical_name() == name
+                    checked += 1
+                for slot in range(len(prof.placements())):
+                    name = canonical_profile_name(chip_index, prof, slot)
+                    parsed = parse_canonical_name(name)
+                    assert parsed == ParsedProfile(chip_index, prof.id,
+                                                   slot), name
+                    checked += 1
+            for seat in range(SEAT_COUNT):
+                name = canonical_shared_name(chip_index, seat)
+                assert parse_canonical_name(name) == \
+                    ParsedShared(chip_index, seat)
+                checked += 1
+    assert checked > 100      # the sweep actually covered the space
+    # junk never parses
+    for bad in ("tpu-", "tpu-0-ss-1c47g", "tpu-0-prof-1c47g",
+                "tpu-0-mp-", "gpu-0", "tpu-0-ss-1c47g-0-extra"):
+        assert parse_canonical_name(bad) is None, bad
+
+
+def test_seat_count_matches_multiprocess_client_bound():
+    """The device library's seat geometry and the API's multi-process
+    client bound are one constant, defined in two layers (tpulib cannot
+    import the api layer) — this pin keeps them from drifting."""
+    assert SEAT_COUNT == MAX_MULTI_PROCESS_CLIENTS
+    assert SEAT_HBM_PERCENT * SEAT_COUNT <= 100
+
+
+# ---------------------------------------------------------------------------
+# inventory: profile slots and seats advertised under their gates
+# ---------------------------------------------------------------------------
+
+
+def test_enumerate_allocatable_profiles_and_seats():
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    devs = enumerate_allocatable(lib, _repartition_gates())
+    # 4 chips x (1 chip + 2 pre-cut + 2 profile slots + 16 seats)
+    assert len(devs) == 4 * (1 + 2 + 2 + SEAT_COUNT)
+    assert "tpu-0-prof-1c47g-0" in devs
+    assert "tpu-0-prof-1c47g-1" in devs
+    assert f"tpu-0-mp-{SEAT_COUNT - 1}" in devs
+    prof = devs["tpu-0-prof-1c47g-0"]
+    assert prof.type == DeviceType.PROFILE
+    # a profile slot consumes cores + hbm but no specific memory slice
+    cc = prof.counter_consumption(8)
+    assert cc["tensorcores"]["value"] == "1"
+    assert not any(k.startswith("memory-slice") for k in cc)
+    seat = devs["tpu-0-mp-0"]
+    assert seat.type == DeviceType.SHARED
+    sc = seat.counter_consumption(8)
+    assert "tensorcores" not in sc
+    assert sc[f"memory-slice-{seat_core(0, 2)}"]["value"] == "1"
+    # a core-owning device consumes its slices at FULL granularity so it
+    # excludes every seat on those cores
+    full = devs["tpu-0"].counter_consumption(8)
+    assert full["memory-slice-0"]["value"] == "8"
+
+
+# ---------------------------------------------------------------------------
+# placement picking
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def lib():
+    return FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+
+
+def _chip(lib, i=0):
+    return lib.enumerate_chips()[i]
+
+
+def _profile(chip):
+    return [p for p in profiles_for(chip.generation)
+            if p.cores < chip.generation.cores_per_chip][0]
+
+
+def test_place_picks_highest_free_and_avoids_live(tmp_path, lib):
+    mgr = RepartitionManager(lib, str(tmp_path))
+    chip = _chip(lib)
+    prof = _profile(chip)
+    cp = Checkpoint()
+    spec1, live1 = mgr.place(chip, prof, cp)
+    assert spec1.placement_start == prof.placements()[-1]
+    # journal it the way device_state would, so the next place sees it
+    cp.claims["u1"] = ClaimEntry(
+        claim_uid="u1", state=PREPARE_COMPLETED,
+        prepared_devices=[PreparedDevice(
+            canonical_name=spec1.canonical_name(), request="r")])
+    spec2, _ = mgr.place(chip, prof, cp)
+    assert spec2.placement_start != spec1.placement_start
+    cp.claims["u2"] = ClaimEntry(
+        claim_uid="u2", state=PREPARE_COMPLETED,
+        prepared_devices=[PreparedDevice(
+            canonical_name=spec2.canonical_name(), request="r")])
+    # chip full (both placements journaled): transient no-free error
+    with pytest.raises(TpuLibError, match="no free"):
+        mgr.place(chip, prof, cp)
+    # an UNJOURNALED live partition is a crashed attempt's residue by
+    # definition — place() rolls it back rather than wedging the chip
+    del cp.claims["u2"]
+    spec3, _ = mgr.place(chip, prof, cp)
+    assert spec3.placement_start == spec2.placement_start
+
+
+def test_place_rolls_back_unowned_orphan_first(tmp_path, lib):
+    """A live partition the checkpoint does not own (a crashed attempt's
+    residue) is torn down in place, so one crashed claim cannot wedge
+    the chip until the next restart."""
+    mgr = RepartitionManager(lib, str(tmp_path))
+    chip = _chip(lib)
+    prof = _profile(chip)
+    # orphan occupying the HIGHEST placement (the picker's first choice)
+    orphan = SubsliceSpec(chip.index, chip.uuid, prof,
+                          prof.placements()[-1])
+    lib.create_subslice(orphan)
+    spec, _ = mgr.place(chip, prof, Checkpoint())
+    live = [s.spec_tuple.canonical_name() for s in lib.list_subslices()]
+    assert live == [spec.canonical_name()]
+
+
+def test_place_avoids_cores_with_client_seats(tmp_path, lib):
+    mgr = RepartitionManager(lib, str(tmp_path))
+    chip = _chip(lib)
+    prof = _profile(chip)
+    # a seat whose core is the highest placement's core
+    high_seat = SEAT_COUNT - 1
+    assert seat_core(high_seat, chip.cores) == prof.placements()[-1]
+    lib.attach_multiprocess_seat(chip.uuid, "claim-a", high_seat,
+                                 SEAT_HBM_PERCENT)
+    spec, _ = mgr.place(chip, prof, Checkpoint())
+    assert spec.placement_start != prof.placements()[-1]
+    # the remaining core carries the seat: no second placement exists
+    with_seat_cp = Checkpoint()
+    with_seat_cp.claims["u1"] = ClaimEntry(
+        claim_uid="u1", state=PREPARE_COMPLETED,
+        prepared_devices=[PreparedDevice(
+            canonical_name=spec.canonical_name(), request="r")])
+    with pytest.raises(TpuLibError, match="no free"):
+        mgr.place(chip, prof, with_seat_cp)
+
+
+def test_reconcile_adopts_owned_and_destroys_orphans(tmp_path, lib):
+    mgr = RepartitionManager(lib, str(tmp_path))
+    chip = _chip(lib)
+    prof = _profile(chip)
+    owned_spec = SubsliceSpec(chip.index, chip.uuid, prof, 0)
+    lib.create_subslice(owned_spec)
+    orphan_spec = SubsliceSpec(chip.index, chip.uuid, prof,
+                               prof.placements()[-1])
+    lib.create_subslice(orphan_spec)
+    cp = Checkpoint()
+    cp.claims["u1"] = ClaimEntry(
+        claim_uid="u1", state=PREPARE_COMPLETED,
+        prepared_devices=[PreparedDevice(
+            canonical_name=owned_spec.canonical_name(), request="r")])
+    destroyed = mgr.reconcile(cp)
+    assert destroyed == [orphan_spec.canonical_name()]
+    live = [s.spec_tuple.canonical_name() for s in lib.list_subslices()]
+    assert live == [owned_spec.canonical_name()]
+    # idempotent: a second pass is a no-op
+    assert mgr.reconcile(cp) == []
+
+
+def test_exclusions_reflect_remaining_creatable_capacity(tmp_path, lib):
+    mgr = RepartitionManager(lib, str(tmp_path))
+    devs = enumerate_allocatable(lib, _repartition_gates())
+    assert mgr.exclusions(devs) == set()
+    chip = _chip(lib)
+    prof = _profile(chip)
+    lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof, 0))
+    excl = mgr.exclusions(devs)
+    # the overlapped pre-cut placement, ONE profile slot (capacity 2->1),
+    # the partitioned core's seats, and the whole-chip personality
+    assert canonical_subslice_name(chip.index, prof, 0) in excl
+    assert canonical_profile_name(chip.index, prof, 1) in excl
+    assert canonical_profile_name(chip.index, prof, 0) not in excl
+    assert canonical_chip_name(chip.index) in excl
+    for seat in range(SEAT_COUNT):
+        name = canonical_shared_name(chip.index, seat)
+        assert (name in excl) == (seat_core(seat, chip.cores) == 0)
+    # other chips untouched
+    assert not any(n.startswith("tpu-1") for n in excl)
+
+
+def test_manifest_written_and_tracks_live_partitions(tmp_path, lib):
+    mgr = RepartitionManager(lib, str(tmp_path))
+    chip = _chip(lib)
+    prof = _profile(chip)
+    spec, _ = mgr.place(chip, prof, Checkpoint())
+    data = json.load(open(mgr.manifest_path))
+    assert data["partitions"] == [spec.canonical_name()]
+    assert data["updated_unix"] > 0
+    mgr.reclaim(spec.tuple)
+    assert json.load(open(mgr.manifest_path))["partitions"] == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: profile claims through the plugin, checkpoint schema
+# ---------------------------------------------------------------------------
+
+
+def _mkplugin(tmp_path, gates):
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    plugin = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="rp-node", state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi"), gates=gates))
+    plugin.start()
+    return plugin, clients, lib
+
+
+def test_profile_claim_prepares_with_placed_identity(tmp_path):
+    plugin, clients, lib = _mkplugin(tmp_path, _repartition_gates())
+    gen0 = {s["metadata"]["name"]: s["spec"]["pool"]["generation"]
+            for s in clients.resource_slices.list()}
+    claim = build_allocated_claim("u1", "c1", "ns",
+                                  ["tpu-0-prof-1c47g-0"], "rp-node")
+    res = plugin.prepare_resource_claims([claim])["u1"]
+    assert res.error is None
+    pd = res.devices[0]
+    # the checkpoint journals the PLACED identity; the allocated slot
+    # name rides along in sourceDevice
+    assert pd.canonical_name.startswith("tpu-0-ss-1c47g-")
+    assert pd.source_device == "tpu-0-prof-1c47g-0"
+    assert pd.device_type == "subslice"
+    assert len(lib.list_subslices()) == 1
+    # the capacity republish hid the consumed inventory WITHOUT a pool
+    # generation bump (content-only rewrite, no slice-name churn)
+    slices = clients.resource_slices.list()
+    assert {s["metadata"]["name"]: s["spec"]["pool"]["generation"]
+            for s in slices} == gen0
+    names = {d["name"] for s in slices for d in s["spec"]["devices"]}
+    assert pd.canonical_name not in names
+    assert "tpu-0" not in names
+    # schema round-trip through the on-disk checkpoint
+    cp = CheckpointManager(str(tmp_path / "state")).read()
+    stored = cp.claims["u1"].prepared_devices[0]
+    assert stored.source_device == "tpu-0-prof-1c47g-0"
+    assert stored.canonical_name == pd.canonical_name
+    # unprepare reclaims and restores the advertised inventory
+    assert plugin.unprepare_resource_claims(["u1"]) == {"u1": None}
+    assert lib.list_subslices() == []
+    names = {d["name"] for s in clients.resource_slices.list()
+             for d in s["spec"]["devices"]}
+    assert "tpu-0" in names
+    plugin.shutdown()
+
+
+def test_profile_claim_rejected_when_gate_off(tmp_path):
+    plugin, _, _ = _mkplugin(tmp_path, _gates(DynamicSubslice=True))
+    claim = build_allocated_claim("u1", "c1", "ns",
+                                  ["tpu-0-prof-1c47g-0"], "rp-node")
+    # the device is not even in the inventory without the gate
+    res = plugin.prepare_resource_claims([claim])["u1"]
+    assert res.error is not None and res.permanent
+    plugin.shutdown()
+
+
+def test_prepared_device_source_device_optional_in_checkpoint():
+    pd = PreparedDevice(canonical_name="tpu-0", request="r")
+    assert "sourceDevice" not in pd.to_obj()
+    assert PreparedDevice.from_obj(pd.to_obj()).source_device == ""
+    pd2 = PreparedDevice(canonical_name="tpu-0-ss-1c47g-1", request="r",
+                         source_device="tpu-0-prof-1c47g-0")
+    assert pd2.to_obj()["sourceDevice"] == "tpu-0-prof-1c47g-0"
+    assert PreparedDevice.from_obj(
+        pd2.to_obj()).source_device == "tpu-0-prof-1c47g-0"
+
+
+# ---------------------------------------------------------------------------
+# review-fix regressions
+# ---------------------------------------------------------------------------
+
+
+def test_precut_claim_racing_dynamic_placement_is_transient(tmp_path):
+    """A pre-cut -ss- claim admitted during the republish-lag window for
+    a placement a PROFILE claim dynamically occupies must fail
+    TRANSIENTLY (the placement will be reclaimed / the claim re-placed),
+    not permanently — and succeed once the dynamic claim releases."""
+    plugin, clients, lib = _mkplugin(tmp_path, _repartition_gates())
+    prof_claim = build_allocated_claim("u-dyn", "c-dyn", "ns",
+                                       ["tpu-0-prof-1c47g-0"], "rp-node")
+    res = plugin.prepare_resource_claims([prof_claim])["u-dyn"]
+    assert res.error is None
+    placed = res.devices[0].canonical_name
+    rival = build_allocated_claim("u-pre", "c-pre", "ns", [placed],
+                                  "rp-node")
+    res = plugin.prepare_resource_claims([rival])["u-pre"]
+    assert res.error is not None
+    assert not res.permanent, "dynamic-placement conflict must be transient"
+    assert "dynamic placement" in res.error
+    # the dynamic claim releases; the retried pre-cut claim succeeds
+    assert plugin.unprepare_resource_claims(["u-dyn"]) == {"u-dyn": None}
+    res = plugin.prepare_resource_claims([rival])["u-pre"]
+    assert res.error is None
+    plugin.unprepare_resource_claims(["u-pre"])
+    plugin.shutdown()
+
+
+def test_seat_of_failed_prepare_rolls_back_on_unprepare(tmp_path):
+    """A claim whose prepare attached its seat and THEN failed (entry
+    stays PrepareStarted with no recorded devices) must not leak the
+    seat: unprepare's write-ahead-only sweep detaches it and the density
+    gauge returns to baseline."""
+    from tpu_dra_driver.pkg.metrics import SHARED_CHIP_CLIENTS
+
+    plugin, clients, lib = _mkplugin(tmp_path, _repartition_gates())
+    g0 = SHARED_CHIP_CLIENTS.value
+    # seat first (attaches), bogus device second (fails the claim)
+    claim = build_allocated_claim("u-half", "c-half", "ns",
+                                  ["tpu-0-mp-0", "tpu-99"], "rp-node")
+    res = plugin.prepare_resource_claims([claim])["u-half"]
+    assert res.error is not None
+    chip = lib.enumerate_chips()[0]
+    assert set(lib.list_multiprocess_seats(chip.uuid)) == {0}
+    entry = plugin.state.get_checkpoint().claims["u-half"]
+    assert entry.prepared_devices == []
+    # unprepare of the write-ahead-only entry sweeps the seat
+    assert plugin.unprepare_resource_claims(
+        ["u-half"]) == {"u-half": None}
+    assert lib.list_multiprocess_seats(chip.uuid) == {}
+    assert lib.get_exclusive_mode(chip.uuid) is True
+    assert SHARED_CHIP_CLIENTS.value == g0
+    # and a fresh claim can take the seat again
+    ok = build_allocated_claim("u-ok", "c-ok", "ns", ["tpu-0-mp-0"],
+                               "rp-node")
+    assert plugin.prepare_resource_claims([ok])["u-ok"].error is None
+    plugin.unprepare_resource_claims(["u-ok"])
+    plugin.shutdown()
+
+
+def test_startup_reconcile_detaches_ghost_seats_and_reseeds_gauge(
+        tmp_path):
+    """Seats persist across plugin restarts; a seat whose claim the
+    checkpoint no longer knows (the crashed-writer residue) is detached
+    by the startup sweep and the gauge re-seeds from hardware truth."""
+    from tpu_dra_driver.pkg.metrics import SHARED_CHIP_CLIENTS
+
+    plugin, clients, lib = _mkplugin(tmp_path, _repartition_gates())
+    live = build_allocated_claim("u-live", "c-live", "ns", ["tpu-1-mp-3"],
+                                 "rp-node")
+    assert plugin.prepare_resource_claims([live])["u-live"].error is None
+    ghost_chip = lib.enumerate_chips()[0]
+    lib.attach_multiprocess_seat(ghost_chip.uuid, "ghost-uid", 5, 6)
+    plugin.shutdown()
+    # restarted plugin over the same state dir + host state
+    lib2 = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"),
+                      host_state=lib.host_state)
+    plugin2 = TpuKubeletPlugin(clients, lib2, PluginConfig(
+        node_name="rp-node", state_dir=str(tmp_path / "state"),
+        cdi_root=str(tmp_path / "cdi"), gates=_repartition_gates()))
+    plugin2.start()
+    assert lib2.list_multiprocess_seats(ghost_chip.uuid) == {}
+    assert lib2.get_exclusive_mode(ghost_chip.uuid) is True
+    live_chip = lib2.enumerate_chips()[1]
+    assert set(lib2.list_multiprocess_seats(live_chip.uuid)) == {3}
+    assert SHARED_CHIP_CLIENTS.value == 1   # re-seeded from truth
+    plugin2.unprepare_resource_claims(["u-live"])
+    assert SHARED_CHIP_CLIENTS.value == 0
+    plugin2.shutdown()
+
+
+def test_gauge_not_inflated_by_idempotent_seat_reattach(tmp_path, lib):
+    from tpu_dra_driver.pkg.metrics import SHARED_CHIP_CLIENTS
+    from tpu_dra_driver.plugin.sharing import MultiProcessManager
+
+    mgr = MultiProcessManager(lib)
+    chip = lib.enumerate_chips()[0]
+    g0 = SHARED_CHIP_CLIENTS.value
+    mgr.attach_seat(chip.uuid, 0, owner="u1", hbm_limit_percent=6)
+    mgr.attach_seat(chip.uuid, 0, owner="u1", hbm_limit_percent=6)
+    assert SHARED_CHIP_CLIENTS.value - g0 == 1
+    mgr.detach_seat(chip.uuid, owner="u1")
+    assert SHARED_CHIP_CLIENTS.value - g0 == 0

@@ -153,6 +153,73 @@ def test_unprepare_releases_share_and_restores_exclusive(plugin):
     lib.allocate_multiprocess_share(chip.uuid, "uid-2", 2, 50)
 
 
+def test_timeslicing_reset_restores_exclusive_mode(tmp_path):
+    """Regression (ISSUE 13 satellite): TimeSlicingManager.reset used to
+    restore only the interval — ``apply`` had flipped the chip
+    non-exclusive and nothing flipped it back, so a later exclusive
+    claim on the same chip silently ran shared."""
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    gates = fg.FeatureGates()
+    gates.set(fg.TIME_SLICING_SETTINGS, True)
+    p = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="node-a", state_dir=str(tmp_path / "s"),
+        cdi_root=str(tmp_path / "cdi"), gates=gates))
+    p.start()
+    try:
+        claim = build_allocated_claim(
+            "uid-ts", "c-ts", "ns", ["tpu-0"], "node-a",
+            configs=[{"source": "FromClaim", "requests": [],
+                      "opaque": {"driver": "tpu.google.com",
+                                 "parameters": {
+                                     "apiVersion":
+                                         "resource.tpu.google.com/v1beta1",
+                                     "kind": "TpuConfig",
+                                     "sharing": {
+                                         "strategy": "TimeSlicing",
+                                         "timeSlicing": {
+                                             "interval": "Long"}}}}}])
+        assert p.prepare_resource_claims([claim])["uid-ts"].error is None
+        chip = lib.enumerate_chips()[0]
+        assert lib.get_exclusive_mode(chip.uuid) is False
+        assert lib.get_timeslice(chip.uuid).value == "Long"
+        assert p.unprepare_resource_claims(["uid-ts"]) == {"uid-ts": None}
+        # BOTH the interval and exclusive mode restored
+        assert lib.get_timeslice(chip.uuid).value == "Default"
+        assert lib.get_exclusive_mode(chip.uuid) is True
+    finally:
+        p.shutdown()
+
+
+def test_single_client_budget_exactly_chip_hbm(plugin):
+    """Edge: clients=1 at 100% — the budget is EXACTLY the chip, usable
+    to the last byte and not one more."""
+    p, lib, clients = plugin
+    claim = _mp_claim("uid-1", "c1", "tpu-0", max_clients=1, pct=100)
+    assert p.prepare_resource_claims([claim])["uid-1"].error is None
+    chip = lib.enumerate_chips()[0]
+    share = lib.get_multiprocess_share(chip.uuid)
+    assert share.client_hbm_bytes == chip.hbm_bytes
+    c1 = lib.connect_multiprocess_client(chip.uuid)
+    lib.client_allocate_hbm(chip.uuid, c1, chip.hbm_bytes - 1)
+    lib.client_allocate_hbm(chip.uuid, c1, 1)
+    with pytest.raises(SharingExhaustedError):
+        lib.client_allocate_hbm(chip.uuid, c1, 1)
+
+
+def test_zero_hbm_limit_rejected_as_permanent(plugin):
+    """hbmLimitPercent: 0 is a config error, not a zero-budget grant:
+    prepare fails PERMANENTLY (retrying without a config change cannot
+    succeed) and nothing is granted."""
+    p, lib, clients = plugin
+    claim = _mp_claim("uid-1", "c1", "tpu-0", max_clients=2, pct=0)
+    res = p.prepare_resource_claims([claim])["uid-1"]
+    assert res.error is not None and res.permanent
+    assert "hbmLimitPercent" in res.error
+    chip = lib.enumerate_chips()[0]
+    assert lib.get_multiprocess_share(chip.uuid) is None
+
+
 def test_env_carries_per_client_budget(plugin):
     p, lib, clients = plugin
     claim = _mp_claim("uid-1", "c1", "tpu-0", max_clients=2, pct=50)
@@ -177,3 +244,118 @@ def test_env_carries_per_client_budget(plugin):
     assert env.get("TPU_MAX_CLIENTS") == "2"
     assert env.get("TPU_HBM_LIMIT_PERCENT") == "50"
     assert int(env.get("TPU_HBM_LIMIT_BYTES")) == chip.hbm_bytes // 2
+
+
+# ---------------------------------------------------------------------------
+# claim-per-request client seats (SharedChipServing, ISSUE 13): many
+# claims share one chip, each claim one bounded client
+# ---------------------------------------------------------------------------
+
+
+def _seat_claim(uid, name, device):
+    return build_allocated_claim(uid, name, "ns", [device], "node-a")
+
+
+@pytest.fixture
+def seat_plugin(tmp_path):
+    clients = ClientSets()
+    lib = FakeTpuLib(FakeSystemConfig(accelerator_type="v5p-8"))
+    gates = fg.FeatureGates()
+    gates.set(fg.SHARED_CHIP_SERVING, True)
+    p = TpuKubeletPlugin(clients, lib, PluginConfig(
+        node_name="node-a", state_dir=str(tmp_path / "s"),
+        cdi_root=str(tmp_path / "cdi"), gates=gates))
+    p.start()
+    yield p, lib, clients
+    p.shutdown()
+
+
+def test_two_claims_hold_disjoint_seats_on_one_chip(seat_plugin):
+    from tpu_dra_driver.pkg.metrics import SHARED_CHIP_CLIENTS
+
+    p, lib, clients = seat_plugin
+    g0 = SHARED_CHIP_CLIENTS.value
+    a = _seat_claim("uid-a", "ca", "tpu-0-mp-0")
+    b = _seat_claim("uid-b", "cb", "tpu-0-mp-1")
+    assert p.prepare_resource_claims([a, b])["uid-a"].error is None
+    chip = lib.enumerate_chips()[0]
+    seats = lib.list_multiprocess_seats(chip.uuid)
+    assert {s.owner for s in seats.values()} == {"uid-a", "uid-b"}
+    assert lib.get_exclusive_mode(chip.uuid) is False
+    assert SHARED_CHIP_CLIENTS.value - g0 == 2
+    # each claim's client gets its own bounded budget
+    ca = lib.connect_multiprocess_client(chip.uuid, owner="uid-a")
+    cb = lib.connect_multiprocess_client(chip.uuid, owner="uid-b")
+    budget = seats[0].client_hbm_bytes
+    lib.client_allocate_hbm(chip.uuid, ca, budget)
+    with pytest.raises(SharingExhaustedError):
+        lib.client_allocate_hbm(chip.uuid, ca, 1)
+    lib.client_allocate_hbm(chip.uuid, cb, budget)
+    # first unprepare detaches ONLY its seat; the chip stays shared
+    assert p.unprepare_resource_claims(["uid-a"]) == {"uid-a": None}
+    assert set(lib.list_multiprocess_seats(chip.uuid)) == {1}
+    assert lib.get_exclusive_mode(chip.uuid) is False
+    assert SHARED_CHIP_CLIENTS.value - g0 == 1
+    # the LAST seat's unprepare restores exclusive scheduling
+    assert p.unprepare_resource_claims(["uid-b"]) == {"uid-b": None}
+    assert lib.list_multiprocess_seats(chip.uuid) == {}
+    assert lib.get_exclusive_mode(chip.uuid) is True
+    assert SHARED_CHIP_CLIENTS.value - g0 == 0
+
+
+def test_seat_conflict_is_permanent_and_isolated(seat_plugin):
+    p, lib, clients = seat_plugin
+    a = _seat_claim("uid-a", "ca", "tpu-0-mp-0")
+    assert p.prepare_resource_claims([a])["uid-a"].error is None
+    # a second claim on the SAME seat (a scheduler bug) fails permanently
+    rival = _seat_claim("uid-r", "cr", "tpu-0-mp-0")
+    res = p.prepare_resource_claims([rival])["uid-r"]
+    assert res.error is not None and res.permanent
+    # the checkpoint overlap guard catches the double-book first; the
+    # seat ledger is the backstop for cross-process raced grants
+    assert "uid-a" in res.error
+    # seat grants are idempotent for the owner (kubelet re-prepare)
+    again = p.prepare_resource_claims([a])["uid-a"]
+    assert again.error is None
+    assert p.state.timings[-1].cached
+
+
+def test_seats_and_whole_chip_share_are_mutually_exclusive(seat_plugin):
+    p, lib, clients = seat_plugin
+    a = _seat_claim("uid-a", "ca", "tpu-0-mp-0")
+    assert p.prepare_resource_claims([a])["uid-a"].error is None
+    chip = lib.enumerate_chips()[0]
+    with pytest.raises(SharingExhaustedError):
+        lib.allocate_multiprocess_share(chip.uuid, "uid-x", 2, 50)
+    # and the other direction: a whole-chip share blocks seats
+    other = lib.enumerate_chips()[1]
+    lib.allocate_multiprocess_share(other.uuid, "uid-x", 2, 50)
+    with pytest.raises(SharingExhaustedError):
+        lib.attach_multiprocess_seat(other.uuid, "uid-y", 0, 6)
+
+
+def test_seat_on_partitioned_core_refused_and_vice_versa(seat_plugin):
+    from tpu_dra_driver.tpulib.partition import (
+        SubsliceSpec,
+        profiles_for,
+        seat_core,
+    )
+    from tpu_dra_driver.tpulib.interface import TpuLibError
+
+    p, lib, clients = seat_plugin
+    chip = lib.enumerate_chips()[0]
+    prof = [x for x in profiles_for(chip.generation)
+            if x.cores < chip.generation.cores_per_chip][0]
+    lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof, 0))
+    covered = [k for k in range(16) if seat_core(k, chip.cores) == 0]
+    free = [k for k in range(16) if seat_core(k, chip.cores) != 0]
+    # a TRANSIENT refusal (TpuLibError, not SharingExhausted): the
+    # partition will be reclaimed, so kubelet may retry this claim
+    with pytest.raises(TpuLibError, match="is partitioned"):
+        lib.attach_multiprocess_seat(chip.uuid, "uid-a", covered[0], 6)
+    # a seat on the UNpartitioned core composes fine...
+    lib.attach_multiprocess_seat(chip.uuid, "uid-a", free[0], 6)
+    # ...and that core can no longer be partitioned under it
+    with pytest.raises(TpuLibError, match="carries multi-process seat"):
+        lib.create_subslice(SubsliceSpec(chip.index, chip.uuid, prof,
+                                         seat_core(free[0], chip.cores)))

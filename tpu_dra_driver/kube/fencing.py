@@ -219,7 +219,16 @@ def install_admission(cluster,
                 name = (new.get("metadata") or {}).get("name", "")
                 handle.rejections.append({
                     "resource": resource, "name": name, "slot": slot,
-                    "stamped": stamped_epoch, "current": current})
+                    "stamped": stamped_epoch, "current": current,
+                    # the object ALREADY carried an allocation: the
+                    # rejected write is a late duplicate/re-write of a
+                    # commit that landed legitimately under an earlier
+                    # tenure — invariant checks must not read the
+                    # pre-existing allocation as "the rejected write
+                    # landed" (observed under flap + re-dispatch churn)
+                    "old_allocated": bool(
+                        ((old or {}).get("status") or {}).get(
+                            "allocation"))})
                 log.warning(
                     "fencing admission REJECTED %s %s: slot %s stamped "
                     "epoch %d behind current %d",

@@ -50,6 +50,18 @@ DEVICE_HEALTH_CHECK = "DeviceHealthCheck"
 DYNAMIC_SUBSLICE = "DynamicSubslice"
 COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
 CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
+#: advertise *creatable* sub-slice profile slots (placement picked by the
+#: kubelet plugin at prepare time — the DynamicMIG profile-advertising
+#: model); requires DynamicSubslice for the partition machinery.
+DYNAMIC_REPARTITION = "DynamicRepartition"
+#: advertise per-chip multi-process client SEATS as allocatable devices —
+#: the claim-per-request serving tier (one small claim = one bounded
+#: client on a shared chip). Unlike MultiProcessSharing (one claim whose
+#: own processes share its chip), seats admit MANY claims per chip, so
+#: this gate composes with DynamicRepartition: per-chip exclusion between
+#: seats and partitions is enforced dynamically by the repartition state
+#: machine and the KEP-4815 counter model, not by a static gate conflict.
+SHARED_CHIP_SERVING = "SharedChipServing"
 
 _SPECS: tuple[FeatureSpec, ...] = (
     FeatureSpec(TIME_SLICING_SETTINGS, False, Stage.ALPHA),
@@ -60,6 +72,8 @@ _SPECS: tuple[FeatureSpec, ...] = (
     FeatureSpec(DYNAMIC_SUBSLICE, False, Stage.ALPHA),
     FeatureSpec(COMPUTE_DOMAIN_CLIQUES, True, Stage.BETA),
     FeatureSpec(CRASH_ON_ICI_FABRIC_ERRORS, True, Stage.BETA),
+    FeatureSpec(DYNAMIC_REPARTITION, False, Stage.ALPHA),
+    FeatureSpec(SHARED_CHIP_SERVING, False, Stage.ALPHA),
 )
 
 # Mutual exclusions (reference featuregates.go:170-189): dynamic

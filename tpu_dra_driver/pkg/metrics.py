@@ -613,6 +613,33 @@ RESOURCESLICE_PUBLISHES_SKIPPED = DEFAULT_REGISTRY.counter(
 
 
 # ---------------------------------------------------------------------------
+# Dynamic sub-slice repartitioning + shared-chip serving (plugin/
+# repartition.py, plugin/sharing.py): the create/reclaim/rollback/adopt
+# transitions of the crash-safe reshape state machine, the hardware cost
+# of each reshape, and the live client-seat density on shared chips.
+# ---------------------------------------------------------------------------
+
+SUBSLICE_REPARTITIONS = DEFAULT_REGISTRY.counter(
+    "dra_subslice_repartitions_total",
+    "Dynamic sub-slice repartition state-machine transitions by "
+    "operation (create = placement picked + partition created on "
+    "prepare, reclaim = partition destroyed on unprepare, rollback = "
+    "half-created placement torn down, adopt = committed claim's live "
+    "partition adopted by recovery) and outcome",
+    ("op", "outcome"))
+SUBSLICE_RESHAPE_SECONDS = DEFAULT_REGISTRY.histogram(
+    "dra_subslice_reshape_seconds",
+    "Wall time of one chip reshape: the device-library partition "
+    "create (op=create) or destroy (op=reclaim) a dynamic sub-slice "
+    "claim paid, placement pick included",
+    ("op",))
+SHARED_CHIP_CLIENTS = DEFAULT_REGISTRY.gauge(
+    "dra_shared_chip_clients",
+    "Multi-process client seats currently attached across this node's "
+    "shared chips (claim-per-request serving density)")
+
+
+# ---------------------------------------------------------------------------
 # Observability instrumentation (claim-lifecycle tracing + Kubernetes
 # Events): the flight recorder counts every span it retains, and the
 # Event recorder (kube/events.py) accounts for every emission outcome so

@@ -8,11 +8,21 @@ union (Gpu | MigDynamic | MigStatic | Vfio) keyed by canonical name. Here:
 - ``SUBSLICE``  — an *abstract* dynamically-creatable sub-slice
   (``tpu-<index>-ss-<profile>-<start>``): advertised always, created only
   when a claim lands (the DynamicMIG model),
+- ``PROFILE``   — a *creatable profile slot* (``tpu-<index>-prof-<id>-<k>``,
+  DynamicRepartition): the scheduler picks a slot, the kubelet plugin picks
+  the concrete placement at prepare time and creates the partition on
+  demand — the reference's DynamicMIG profile advertising, one step more
+  abstract than pre-cut placements,
+- ``SHARED``    — one multi-process client seat on a shared chip
+  (``tpu-<index>-mp-<k>``, SharedChipServing): the claim-per-request
+  serving unit with a fixed per-seat HBM budget,
 - ``VFIO``      — a chip offered for passthrough (``tpu-vfio-<index>``).
 
 Each device renders to a DRA device entry with typed attributes, capacity,
 and (for KEP-4815 layouts) counter consumption against its chip's
-CounterSet.
+CounterSet. With SharedChipServing the per-core ``memory-slice`` counters
+are sub-divided into ``SEAT_COUNT/cores`` units per core so seats and
+partitions exclude each other *per core* while distinct cores compose.
 """
 
 from __future__ import annotations
@@ -24,17 +34,28 @@ from typing import Dict, Optional
 from tpu_dra_driver.pkg import featuregates as fg
 from tpu_dra_driver.tpulib.interface import ChipInfo, TpuLib
 from tpu_dra_driver.tpulib.partition import (
+    SEAT_COUNT,
     SubsliceProfile,
     canonical_chip_name,
+    canonical_profile_name,
+    canonical_shared_name,
     canonical_subslice_name,
     canonical_vfio_name,
     profiles_for,
+    seat_core,
+    seats_per_core,
 )
+
+#: each seat's fixed HBM budget, in percent of the chip (the published
+#: capacity is a static contract; claims do not negotiate it upward).
+SEAT_HBM_PERCENT = 100 // SEAT_COUNT
 
 
 class DeviceType(Enum):
     CHIP = "chip"
     SUBSLICE = "subslice"
+    PROFILE = "profile"
+    SHARED = "shared"
     VFIO = "vfio"
 
 
@@ -42,8 +63,9 @@ class DeviceType(Enum):
 class AllocatableDevice:
     type: DeviceType
     chip: ChipInfo
-    profile: Optional[SubsliceProfile] = None    # SUBSLICE only
+    profile: Optional[SubsliceProfile] = None    # SUBSLICE / PROFILE only
     placement_start: int = 0                     # SUBSLICE only
+    slot: int = 0                                # PROFILE / SHARED only
 
     @property
     def canonical_name(self) -> str:
@@ -53,6 +75,12 @@ class AllocatableDevice:
             assert self.profile is not None
             return canonical_subslice_name(self.chip.index, self.profile,
                                            self.placement_start)
+        if self.type == DeviceType.PROFILE:
+            assert self.profile is not None
+            return canonical_profile_name(self.chip.index, self.profile,
+                                          self.slot)
+        if self.type == DeviceType.SHARED:
+            return canonical_shared_name(self.chip.index, self.slot)
         return canonical_vfio_name(self.chip.index)
 
     # -- DRA rendering ------------------------------------------------------
@@ -82,15 +110,31 @@ class AllocatableDevice:
             assert self.profile is not None
             attrs["profile"] = {"string": self.profile.id}
             attrs["placementStart"] = {"int": self.placement_start}
+        if self.type == DeviceType.PROFILE:
+            assert self.profile is not None
+            attrs["profile"] = {"string": self.profile.id}
+            attrs["slot"] = {"int": self.slot}
+        if self.type == DeviceType.SHARED:
+            attrs["seat"] = {"int": self.slot}
+            attrs["seatCore"] = {"int": seat_core(self.slot,
+                                                  self.chip.cores)}
         if self.type == DeviceType.VFIO:
             attrs["vfio"] = {"bool": True}
         return attrs
 
+    @property
+    def seat_hbm_bytes(self) -> int:
+        """One seat's fixed HBM budget (SHARED only)."""
+        return self.chip.hbm_bytes * SEAT_HBM_PERCENT // 100
+
     def capacity(self) -> Dict[str, Dict]:
-        if self.type == DeviceType.SUBSLICE:
+        if self.type in (DeviceType.SUBSLICE, DeviceType.PROFILE):
             assert self.profile is not None
             cores = self.profile.cores
             hbm = self.profile.hbm_bytes
+        elif self.type == DeviceType.SHARED:
+            # a seat owns no core — it is one bounded client's HBM share
+            return {"hbm": {"value": str(self.seat_hbm_bytes)}}
         else:
             cores = self.chip.cores
             hbm = self.chip.hbm_bytes
@@ -99,17 +143,35 @@ class AllocatableDevice:
             "hbm": {"value": str(hbm)},
         }
 
-    def counter_consumption(self) -> Dict[str, Dict]:
+    def counter_consumption(self, granularity: int = 1) -> Dict[str, Dict]:
         """KEP-4815: counters this device consumes from its chip's
         CounterSet. The full chip consumes *everything*, a sub-slice its
         cores + per-core memory slices — making chip and overlapping
         sub-slice allocations mutually exclusive for the scheduler
-        (reference partitions.go:27-215)."""
-        if self.type == DeviceType.SUBSLICE:
+        (reference partitions.go:27-215).
+
+        ``granularity`` is the per-core memory-slice counter resolution
+        (SharedChipServing sub-divides each core's counter into
+        ``seats_per_core`` units): core-owning devices consume the FULL
+        granularity of every covered slice, a SHARED seat consumes one
+        unit of its core's slice — so seats and partitions exclude each
+        other per core while distinct cores compose. A PROFILE slot
+        consumes cores + HBM but no specific slice (its placement is
+        picked at prepare time); the repartition placement picker honors
+        the per-core occupancy the counters admitted."""
+        if self.type == DeviceType.SHARED:
+            return {
+                "hbm": {"value": str(self.seat_hbm_bytes)},
+                f"memory-slice-{seat_core(self.slot, self.chip.cores)}":
+                    {"value": "1"},
+            }
+        if self.type in (DeviceType.SUBSLICE, DeviceType.PROFILE):
             assert self.profile is not None
             cores = self.profile.cores
             hbm = self.profile.hbm_bytes
-            slices = range(self.placement_start, self.placement_start + cores)
+            slices = (range(self.placement_start,
+                            self.placement_start + cores)
+                      if self.type == DeviceType.SUBSLICE else ())
         else:
             cores = self.chip.cores
             hbm = self.chip.hbm_bytes
@@ -119,7 +181,7 @@ class AllocatableDevice:
             "hbm": {"value": str(hbm)},
         }
         for s in slices:
-            counters[f"memory-slice-{s}"] = {"value": "1"}
+            counters[f"memory-slice-{s}"] = {"value": str(granularity)}
         return counters
 
     def counter_set_name(self) -> str:
@@ -130,16 +192,17 @@ def chip_counter_set_name(chip_index: int) -> str:
     return f"tpu-{chip_index}-counter-set"
 
 
-def chip_counter_set(chip: ChipInfo) -> Dict:
+def chip_counter_set(chip: ChipInfo, granularity: int = 1) -> Dict:
     """The shared CounterSet for one chip (reference partitions.go: one
     CounterSet per GPU with capacity counters + one memory-slice counter
-    per slice)."""
+    per slice). ``granularity`` sub-divides each core's memory-slice
+    counter (SharedChipServing seat units)."""
     counters: Dict[str, Dict] = {
         "tensorcores": {"value": str(chip.cores)},
         "hbm": {"value": str(chip.hbm_bytes)},
     }
     for s in range(chip.cores):
-        counters[f"memory-slice-{s}"] = {"value": "1"}
+        counters[f"memory-slice-{s}"] = {"value": str(granularity)}
     return {"name": chip_counter_set_name(chip.index), "counters": counters}
 
 
@@ -156,6 +219,8 @@ def enumerate_allocatable(lib: TpuLib, gates: fg.FeatureGates
     out: Dict[str, AllocatableDevice] = {}
     passthrough = gates.enabled(fg.PASSTHROUGH_SUPPORT)
     dynamic = gates.enabled(fg.DYNAMIC_SUBSLICE)
+    repartition = gates.enabled(fg.DYNAMIC_REPARTITION)
+    shared = gates.enabled(fg.SHARED_CHIP_SERVING)
     for chip in lib.enumerate_chips():
         if chip.vfio_group is not None:
             # already flipped to vfio: only the passthrough personality
@@ -172,6 +237,21 @@ def enumerate_allocatable(lib: TpuLib, gates: fg.FeatureGates
                     ss = AllocatableDevice(DeviceType.SUBSLICE, chip,
                                            profile=prof, placement_start=start)
                     out[ss.canonical_name] = ss
+        if repartition:
+            # creatable profile slots: one anonymous slot per possible
+            # concurrent placement of the profile — the scheduler admits
+            # capacity, the plugin picks WHERE at prepare time
+            for prof in profiles_for(chip.generation):
+                if prof.cores == chip.generation.cores_per_chip:
+                    continue
+                for k in range(len(prof.placements())):
+                    ps = AllocatableDevice(DeviceType.PROFILE, chip,
+                                           profile=prof, slot=k)
+                    out[ps.canonical_name] = ps
+        if shared:
+            for k in range(SEAT_COUNT):
+                seat = AllocatableDevice(DeviceType.SHARED, chip, slot=k)
+                out[seat.canonical_name] = seat
         if passthrough:
             vf = AllocatableDevice(DeviceType.VFIO, chip)
             out[vf.canonical_name] = vf

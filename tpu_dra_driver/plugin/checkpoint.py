@@ -69,14 +69,22 @@ class PreparedDevice:
     canonical_name: str
     request: str                     # DRA request name this satisfied
     cdi_device_ids: List[str] = field(default_factory=list)
-    device_type: str = "chip"        # chip | subslice | vfio | channel | daemon
+    device_type: str = "chip"        # chip | subslice | shared | vfio |
+                                     # channel | daemon
     live_uuid: str = ""              # live sub-slice uuid (informational)
     devfs_path: str = ""
     pool: str = ""                   # allocation result's pool, echoed to
                                      # kubelet (reference device_state.go:738)
+    #: the ALLOCATED device name when it differs from the canonical
+    #: identity actually created — a dynamic PROFILE claim allocates
+    #: ``tpu-i-prof-<id>-<k>`` but the checkpoint journals the placed
+    #: ``tpu-i-ss-<id>-<start>`` partition (the one parser recovery
+    #: needs); this field preserves the allocation-side name for
+    #: kubelet echo and diagnostics. "" = same as canonical_name.
+    source_device: str = ""
 
     def to_obj(self) -> Dict:
-        return {
+        out = {
             "canonicalName": self.canonical_name,
             "request": self.request,
             "cdiDeviceIDs": list(self.cdi_device_ids),
@@ -85,6 +93,12 @@ class PreparedDevice:
             "devfsPath": self.devfs_path,
             "pool": self.pool,
         }
+        if self.source_device:
+            # written only when set: checkpoints without dynamic claims
+            # stay byte-identical to the previous writer's layout (and a
+            # downgraded nonstrict reader simply ignores the key)
+            out["sourceDevice"] = self.source_device
+        return out
 
     @staticmethod
     def from_obj(d: Dict) -> "PreparedDevice":
@@ -96,6 +110,7 @@ class PreparedDevice:
             live_uuid=d.get("liveUUID", ""),
             devfs_path=d.get("devfsPath", ""),
             pool=d.get("pool", ""),
+            source_device=d.get("sourceDevice", ""),
         )
 
 

@@ -37,7 +37,12 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Iterable, List, Optional, Set
 
-from tpu_dra_driver.api.configs import SubsliceConfig, TpuConfig, VfioTpuConfig
+from tpu_dra_driver.api.configs import (
+    SubsliceConfig,
+    TpuConfig,
+    ValidationError,
+    VfioTpuConfig,
+)
 from tpu_dra_driver.api.decoder import STRICT_DECODER, DecodeError
 from tpu_dra_driver.cdi.generator import CdiDevice, CdiHandler, ContainerEdits
 from tpu_dra_driver.pkg import faultinject as fi
@@ -64,17 +69,19 @@ from tpu_dra_driver.plugin.claims import (
     config_for_result,
     resolve_opaque_configs,
 )
+from tpu_dra_driver.plugin.allocatable import SEAT_HBM_PERCENT
+from tpu_dra_driver.plugin.repartition import RepartitionManager
 from tpu_dra_driver.plugin.sharing import MultiProcessManager, TimeSlicingManager
 from tpu_dra_driver.plugin.vfio import VfioPciManager
 from tpu_dra_driver.tpulib.interface import (
     SharingExhaustedError,
     SubsliceAlreadyExistsError,
-    SubsliceNotFoundError,
     TpuLib,
     TpuLibError,
 )
 from tpu_dra_driver.tpulib.partition import (
     ParsedChip,
+    ParsedShared,
     ParsedSubslice,
     ParsedVfio,
     SubsliceSpec,
@@ -147,6 +154,7 @@ class DeviceState:
         self._cp_mgr.ensure_exists()
         self._timeslicing = TimeSlicingManager(lib)
         self._multiprocess = MultiProcessManager(lib)
+        self.repartition = RepartitionManager(lib, state_dir)
         self.vfio = VfioPciManager(lib)
         self.allocatable: Dict[str, AllocatableDevice] = enumerate_allocatable(lib, gates)
         # bounded: one entry per recent prepare (benchmark/diagnostic data,
@@ -252,9 +260,12 @@ class DeviceState:
                         # with a batch peer is decided in the prepare
                         # loop below, after the peer's actual outcome
                         self._validate_no_overlap(cp, claim)
-                    except PermanentError as e:
-                        log.error("prepare %s failed permanently: %s",
-                                  claim.canonical, e)
+                    except (PermanentError, TpuLibError) as e:
+                        # TpuLibError = the transient dynamic-placement
+                        # conflict: still isolated to this claim, but
+                        # retriable
+                        log.error("prepare %s failed (%s): %s",
+                                  claim.canonical, type(e).__name__, e)
                         out[claim.uid] = BatchClaimResult(exception=e)
                         continue
                     if entry is not None and entry.state == PREPARE_STARTED:
@@ -338,7 +349,7 @@ class DeviceState:
             with tracing.span("prepare.devices",
                               attributes={"claim": claim.canonical}):
                 prepared, cdi_devices, extra_common = \
-                    self._prepare_devices(claim)
+                    self._prepare_devices(claim, cp)
             timing.t_core = time.perf_counter() - t_core0
 
             t_cdi0 = time.perf_counter()
@@ -374,17 +385,38 @@ class DeviceState:
                 continue  # admin-access claims may observe busy devices
             owner = owners.get(r.device)
             if owner is not None and owner != claim.uid:
+                entry = cp.claims.get(owner)
+                dynamically_placed = entry is not None and any(
+                    d.canonical_name == r.device and d.source_device
+                    for d in entry.prepared_devices)
+                if dynamically_placed:
+                    # the busy device is a DYNAMIC placement (a PROFILE
+                    # claim journaled this -ss- name; the pre-cut device
+                    # was admitted during the republish-lag window):
+                    # transient — the placement will be reclaimed or the
+                    # claim re-placed, so kubelet may retry
+                    raise TpuLibError(
+                        f"device {r.device} is occupied by claim "
+                        f"{owner}'s dynamic placement (transient: "
+                        f"retry after reclaim or re-placement)"
+                    )
                 raise PermanentError(
                     f"device {r.device} is already prepared for claim {owner}"
                 )
 
     # ------------------------------------------------------------------
 
-    def _prepare_devices(self, claim: ClaimInfo):
+    def _prepare_devices(self, claim: ClaimInfo, cp: Checkpoint):
         try:
             configs = resolve_opaque_configs(claim, STRICT_DECODER)
         except DecodeError as e:
             raise PermanentError(f"bad opaque config: {e}") from e
+        except ValidationError as e:
+            # normalize()/validate() failures are the same class of bad
+            # user input as a decode error: retrying without a config
+            # change cannot succeed (previously these surfaced as
+            # transient errors and kubelet retried them forever)
+            raise PermanentError(str(e)) from e
 
         if not claim.results:
             raise PermanentError(
@@ -414,6 +446,11 @@ class DeviceState:
                     visible_chips.append(dev.chip.index)
             elif dev.type == DeviceType.SUBSLICE:
                 pd, cd = self._prepare_subslice(claim, result.request, dev)
+            elif dev.type == DeviceType.PROFILE:
+                pd, cd = self._prepare_profile(claim, result.request, dev,
+                                               cp)
+            elif dev.type == DeviceType.SHARED:
+                pd, cd = self._prepare_shared(claim, result.request, dev)
             else:
                 pd, cd = self._prepare_vfio(claim, result.request, dev)
             pd.pool = result.pool
@@ -439,9 +476,18 @@ class DeviceState:
     def _check_config_type(self, dev: AllocatableDevice, cfg, name: str) -> None:
         if cfg is None:
             return
+        if dev.type == DeviceType.SHARED:
+            # a seat's budget is a fixed published contract (capacity +
+            # counters were rendered from it); a per-claim config cannot
+            # renegotiate it
+            raise PermanentError(
+                f"shared-seat device {name} accepts no per-claim config "
+                f"(seat budgets are fixed at publish time)"
+            )
         ok = (
             (dev.type == DeviceType.CHIP and isinstance(cfg, TpuConfig))
-            or (dev.type == DeviceType.SUBSLICE and isinstance(cfg, SubsliceConfig))
+            or (dev.type in (DeviceType.SUBSLICE, DeviceType.PROFILE)
+                and isinstance(cfg, SubsliceConfig))
             or (dev.type == DeviceType.VFIO and isinstance(cfg, VfioTpuConfig))
         )
         if not ok:
@@ -521,6 +567,69 @@ class DeviceState:
         )
         return pd, CdiDevice(name=name, edits=edits)
 
+    def _prepare_profile(self, claim: ClaimInfo, request: str,
+                         dev: AllocatableDevice, cp: Checkpoint):
+        """Create-on-prepare for a *creatable profile slot*: the claim
+        allocated a shape, this node picks the placement. The checkpoint
+        records the CONCRETE placed ``-ss-`` canonical name (the
+        recovery contract needs exactly one parser) with the allocated
+        slot name in ``source_device``."""
+        if not self._gates.enabled(fg.DYNAMIC_REPARTITION):
+            raise PermanentError(
+                "profile-slot device allocated but DynamicRepartition "
+                "gate is off"
+            )
+        assert dev.profile is not None
+        with tracing.span("prepare.subslice",
+                          attributes={"profile": dev.profile.id,
+                                      "chip": dev.chip.index,
+                                      "dynamic": True}):
+            spec, live = self.repartition.place(dev.chip, dev.profile, cp)
+        placed_name = spec.canonical_name()
+        edits = ContainerEdits(
+            device_nodes=[{"path": live.devfs_path}],
+            env={
+                "TPU_SUBSLICE_PROFILE": dev.profile.id,
+                "TPU_SUBSLICE_START_CORE": str(spec.placement_start),
+            },
+        )
+        name = self._cdi.claim_device_name(claim.uid, placed_name)
+        pd = PreparedDevice(
+            canonical_name=placed_name, request=request,
+            device_type="subslice", live_uuid=live.uuid,
+            devfs_path=live.devfs_path, source_device=dev.canonical_name,
+        )
+        return pd, CdiDevice(name=name, edits=edits)
+
+    def _prepare_shared(self, claim: ClaimInfo, request: str,
+                        dev: AllocatableDevice):
+        """Attach one multi-process client seat (claim-per-request
+        serving): the chip's device node plus the bounded-client env the
+        runtime allocator reads."""
+        if not self._gates.enabled(fg.SHARED_CHIP_SERVING):
+            raise PermanentError(
+                "shared-seat device allocated but SharedChipServing "
+                "gate is off"
+            )
+        try:
+            edits = self._multiprocess.attach_seat(
+                dev.chip.uuid, dev.slot, owner=claim.uid,
+                hbm_limit_percent=SEAT_HBM_PERCENT)
+        except SharingExhaustedError as e:
+            raise PermanentError(str(e)) from e
+        # seat density changes the chip's advertisable personalities
+        # (whole-chip hidden while seats live) — trigger the advertise step
+        self.repartition.mark_dirty()
+        edits = edits.merge(ContainerEdits(
+            device_nodes=[{"path": dev.chip.devfs_path}]))
+        name = self._cdi.claim_device_name(claim.uid, dev.canonical_name)
+        pd = PreparedDevice(
+            canonical_name=dev.canonical_name, request=request,
+            device_type="shared", live_uuid=dev.chip.uuid,
+            devfs_path=dev.chip.devfs_path,
+        )
+        return pd, CdiDevice(name=name, edits=edits)
+
     def _prepare_vfio(self, claim: ClaimInfo, request: str,
                       dev: AllocatableDevice):
         if not self._gates.enabled(fg.PASSTHROUGH_SUPPORT):
@@ -591,17 +700,36 @@ class DeviceState:
         """Tear down by canonical name alone — works even when the entry
         was written by a process that died before recording live handles.
         (A PrepareStarted entry has no recorded devices; its partial
-        hardware state is recovered instead by the idempotent per-type
-        prepare paths and the startup destroy_unknown_subslices sweep.)"""
+        hardware state is recovered by the idempotent per-type prepare
+        paths, the startup destroy_unknown_subslices sweep, and the seat
+        sweep below.)"""
+        if not entry.prepared_devices:
+            # write-ahead-only entry: a crashed/failed attempt may have
+            # attached a client seat before dying (seats precede the CDI
+            # write and carry the claim uid in the device-library ledger)
+            # — detach whatever this claim still holds so rollback cannot
+            # leak a seat that would poison its index forever
+            for chip in self._lib.enumerate_chips():
+                if self._lib.list_multiprocess_seats(chip.uuid):
+                    self._multiprocess.detach_seat(chip.uuid,
+                                                   owner=entry.claim_uid)
+                    self.repartition.mark_dirty()
+            return
         for dev in entry.prepared_devices:
             parsed = parse_canonical_name(dev.canonical_name)
             try:
                 if isinstance(parsed, ParsedSubslice):
-                    try:
-                        self._lib.destroy_subslice(parsed.tuple)
-                    except SubsliceNotFoundError:
-                        pass  # never created or already gone
+                    # idempotent reclaim: an already-destroyed partition
+                    # (crashed teardown, retried unprepare) is a clean
+                    # no-op inside the repartition state machine
+                    self.repartition.reclaim(parsed.tuple)
                     self._reset_chip_sharing(parsed.tuple.parent_index)
+                elif isinstance(parsed, ParsedShared):
+                    chip = self._chip_by_index(parsed.parent_index)
+                    if chip is not None:
+                        self._multiprocess.detach_seat(
+                            chip.uuid, owner=entry.claim_uid)
+                        self.repartition.mark_dirty()
                 elif isinstance(parsed, ParsedVfio):
                     chip = self._chip_by_index(parsed.index)
                     if chip is not None:
@@ -621,6 +749,11 @@ class DeviceState:
         chip = self._chip_by_index(chip_index)
         if chip is None:
             return
+        if self._lib.list_multiprocess_seats(chip.uuid):
+            # seat claims own the chip's sharing state (a partition and
+            # seats can coexist on distinct cores): flipping the chip
+            # back to exclusive here would cut live seat clients off
+            return
         self._multiprocess.release([chip.uuid])
         self._timeslicing.reset([chip.uuid])
 
@@ -635,23 +768,40 @@ class DeviceState:
     # ------------------------------------------------------------------
 
     def destroy_unknown_subslices(self) -> List[str]:
-        """Startup sweep (DynamicSubslice only): destroy live sub-slices not
-        referenced by any checkpointed claim (reference
-        device_state.go:287-373 DestroyUnknownMIGDevices)."""
-        destroyed = []
+        """Startup sweep: reconcile live partitions (re-derived from
+        canonical names) against checkpoint intent — committed claims'
+        partitions adopted, orphans and half-created placements torn
+        down, idempotent on re-crash (reference device_state.go:287-373
+        DestroyUnknownMIGDevices; the state machine lives in
+        plugin/repartition.py). Client SEATS get the same verdicting:
+        a seat whose owning claim the checkpoint no longer knows is
+        detached, and the density gauge re-seeds from hardware truth
+        (seats persist across plugin restarts, the in-process gauge
+        does not)."""
         with self._mu, self._cp_locked():
             cp = self._cp_mgr.read_or_quarantine()
-            owned: Set[str] = set()
-            for entry in cp.claims.values():
-                for dev in entry.prepared_devices:
-                    owned.add(dev.canonical_name)
-            for live in self._lib.list_subslices():
-                name = live.spec_tuple.canonical_name()
-                if name not in owned:
-                    log.warning("destroying unknown live sub-slice %s", name)
-                    try:
-                        self._lib.destroy_subslice(live.spec_tuple)
-                        destroyed.append(name)
-                    except SubsliceNotFoundError:
-                        pass
-        return destroyed
+            destroyed = self.repartition.reconcile(cp)
+            self._reconcile_seats(cp)
+            return destroyed
+
+    def _reconcile_seats(self, cp: Checkpoint) -> None:
+        known = set(cp.claims)
+        total = 0
+        for chip in self._lib.enumerate_chips():
+            seats = self._lib.list_multiprocess_seats(chip.uuid)
+            orphans = [s for s in seats.values() if s.owner not in known]
+            for share in orphans:
+                log.warning("reconcile: detaching orphan seat %d on chip "
+                            "%d (claim %s unknown to the checkpoint)",
+                            share.seat, chip.index, share.owner)
+                self._lib.detach_multiprocess_seat(chip.uuid,
+                                                   owner=share.owner)
+                _metrics.SUBSLICE_REPARTITIONS.labels("rollback",
+                                                      "ok").inc()
+                self.repartition.mark_dirty()
+            remaining = (self._lib.list_multiprocess_seats(chip.uuid)
+                         if seats else {})
+            if orphans and not remaining:
+                self._lib.set_exclusive_mode(chip.uuid, True)
+            total += len(remaining)
+        _metrics.SHARED_CHIP_CLIENTS.set(total)

@@ -32,10 +32,11 @@ from tpu_dra_driver.kube.events import (
     emit_claim_event,
     normalize_claim_refs,
 )
+from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.pkg import featuregates as fg
 from tpu_dra_driver.pkg import tracing
 from tpu_dra_driver.pkg.flock import Flock, FlockOptions, FlockTimeoutError
-from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY, Registry
+from tpu_dra_driver.pkg.metrics import DEFAULT_REGISTRY, Registry, SWALLOWED_ERRORS
 from tpu_dra_driver.plugin.checkpoint import PreparedDevice
 from tpu_dra_driver.plugin.claims import ClaimInfo
 from tpu_dra_driver.plugin.cleanup import CheckpointCleanupManager
@@ -152,7 +153,8 @@ class TpuKubeletPlugin:
         return self._events
 
     def start(self) -> None:
-        if self._config.gates.enabled(fg.DYNAMIC_SUBSLICE):
+        if (self._config.gates.enabled(fg.DYNAMIC_SUBSLICE)
+                or self._config.gates.enabled(fg.DYNAMIC_REPARTITION)):
             destroyed = self.state.destroy_unknown_subslices()
             if destroyed:
                 log.warning("startup: destroyed %d unknown sub-slices: %s",
@@ -211,6 +213,8 @@ class TpuKubeletPlugin:
         # the scheduler could hand the same physical chip to two claims.
         gates = self._config.gates
         partitionable = (gates.enabled(fg.DYNAMIC_SUBSLICE)
+                         or gates.enabled(fg.DYNAMIC_REPARTITION)
+                         or gates.enabled(fg.SHARED_CHIP_SERVING)
                          or gates.enabled(fg.PASSTHROUGH_SUPPORT))
         self.publisher.republish(
             self.state.allocatable, exclude=exclude,
@@ -229,6 +233,16 @@ class TpuKubeletPlugin:
         for name, dev in self.state.allocatable.items():
             if dev.chip.uuid in unhealthy:
                 exclude.add(name)
+        gates = self._config.gates
+        if (gates.enabled(fg.DYNAMIC_REPARTITION)
+                or gates.enabled(fg.SHARED_CHIP_SERVING)):
+            # remaining-creatable-capacity reflection: placements a live
+            # partition overlaps, profile slots beyond free capacity,
+            # seats on partitioned cores (repartition.py keeps the dirty
+            # flag so every reshape triggers this republish; when these
+            # gates are off the publisher's behavior is untouched)
+            exclude |= self.state.repartition.exclusions(
+                self.state.allocatable)
         return exclude
 
     @property
@@ -249,6 +263,30 @@ class TpuKubeletPlugin:
                     "cordoned" if cordoned else "uncordoned",
                     "empty pool" if cordoned else "full inventory")
         self._republish()
+
+    def _maybe_reshape_republish(self) -> None:
+        """The advertise step of the repartition state machine: after a
+        batch that reshaped a chip (partition created/reclaimed, seat
+        attached/detached), republish so the slices reflect the REMAINING
+        creatable capacity. Content-only rewrites — slice names never
+        change — so the pool generation stays put (no churn). Best
+        effort: a failed republish keeps the dirty flag, counted in
+        dra_swallowed_errors_total, and the next reshape or periodic
+        republish converges it."""
+        gates = self._config.gates
+        if not (gates.enabled(fg.DYNAMIC_REPARTITION)
+                or gates.enabled(fg.SHARED_CHIP_SERVING)):
+            return
+        if not self.state.repartition.take_dirty():
+            return
+        try:
+            fi.fire("repartition.advertise")
+            self._republish()
+        except Exception:  # chaos-ok: counted, dirty restored for retry
+            SWALLOWED_ERRORS.labels("repartition.advertise").inc()
+            self.state.repartition.mark_dirty()
+            log.warning("reshape republish failed; capacity advertising "
+                        "is stale until the next republish", exc_info=True)
 
     def _on_unhealthy(self, chip_uuid: str) -> None:
         log.warning("republishing slices without unhealthy chip %s", chip_uuid)
@@ -381,6 +419,9 @@ class TpuKubeletPlugin:
             out[info.uid] = PrepareResult(devices=res.devices,
                                           error=res.error,
                                           permanent=res.permanent)
+        # the repartition advertise step runs OUTSIDE the pu-lock: the
+        # batch already committed, this only refreshes published capacity
+        self._maybe_reshape_republish()
         return out
 
     @staticmethod
@@ -443,4 +484,5 @@ class TpuKubeletPlugin:
                 "ok" if exc is None else "error").observe(per_claim)
             emit_claim_event(self._events, self._config.node_name,
                              refs[uid], "unprepared", error=out[uid])
+        self._maybe_reshape_republish()
         return out

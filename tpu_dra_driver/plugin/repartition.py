@@ -1,0 +1,383 @@
+"""The dynamic repartitioning lifecycle: a crash-safe reshape state machine.
+
+Reference analog: the DynamicMIG story in cmd/gpu-kubelet-plugin —
+partitions are created on ``NodePrepareResources`` and reclaimed on
+unprepare, and a plugin crash at ANY instant must not leak hardware
+(mig.go's abstract-name recovery contract). This module owns every
+transition of that lifecycle for TPU sub-slices:
+
+- **place** — a PROFILE claim names a *creatable shape*, not a placement:
+  the manager picks a free placement (live partitions, checkpoint intent
+  and shared-chip client seats all honored), rolls back any half-created
+  leftover from an earlier crashed attempt of ANY claim on that chip, and
+  creates the megacore partition through the TpuLib seam;
+- **reclaim** — unprepare destroys the partition by its abstract identity
+  (parsed back from the canonical ``-ss-`` name alone — no live handle);
+- **reconcile** — after a crash, live partitions (re-derived from
+  canonical names via ``parse_canonical_name``) are reconciled against
+  checkpoint intent: committed claims' partitions are ADOPTED, everything
+  else (orphans, half-created placements) is torn down. Idempotent on
+  re-crash: a reconcile that dies mid-sweep re-runs from the same truth;
+- **advertise** — every transition marks the inventory dirty so the
+  driver republishes the chip's REMAINING creatable capacity (overlapped
+  placements and out-of-capacity profile slots hidden) without pool
+  generation churn — content-only slice rewrites keep the generation.
+
+Journaling rides the existing write-ahead/commit checkpoint: the placed
+partition's canonical name is recorded in the claim's PrepareCompleted
+entry (with the allocated profile-slot name in ``source_device``), so the
+checkpoint IS the intent log and crash recovery needs exactly one parser.
+
+Every transition is faultinject-instrumented (the ``repartition.*``
+points below) and kill-drilled in tests/test_chaos_drills.py with the
+PR-3 invariant contract: no leaked sub-slices, readable-or-quarantined
+checkpoint, idempotent unprepare.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg.metrics import (
+    SUBSLICE_REPARTITIONS,
+    SUBSLICE_RESHAPE_SECONDS,
+)
+from tpu_dra_driver.plugin.allocatable import AllocatableDevice, DeviceType
+from tpu_dra_driver.plugin.checkpoint import Checkpoint
+from tpu_dra_driver.tpulib.interface import (
+    ChipInfo,
+    SubsliceAlreadyExistsError,
+    SubsliceLiveTuple,
+    SubsliceNotFoundError,
+    TpuLib,
+    TpuLibError,
+)
+from tpu_dra_driver.tpulib.partition import (
+    SubsliceProfile,
+    SubsliceSpec,
+    SubsliceSpecTuple,
+    parse_profile_id,
+    seat_core,
+)
+
+log = logging.getLogger(__name__)
+
+fi.register("repartition.place",
+            "the placement pick for a dynamic profile claim (payload: the "
+            "picked start core — corrupt models a broken picker, which "
+            "the post-pick validation must catch; fail = pick error)")
+fi.register("repartition.create",
+            "between the claim's write-ahead and the partition create "
+            "(crash = claim written-ahead, NO partition on the chip; "
+            "restart rolls the attempt back and a retry re-places)")
+fi.register("repartition.created",
+            "between the partition create and the checkpoint commit "
+            "(crash = LIVE partition the checkpoint only knows as "
+            "PrepareStarted; restart must tear the orphan down)")
+fi.register("repartition.reclaim",
+            "the partition destroy on unprepare (fail = teardown error "
+            "surfaced to kubelet, entry kept; the retry must be "
+            "idempotent)")
+fi.register("repartition.advertise",
+            "the capacity-reflecting ResourceSlice republish after a "
+            "reshape (fail = stale advertised capacity this round; the "
+            "dirty flag survives so the next republish converges)")
+fi.register("repartition.reconcile",
+            "fired once per orphan live partition the recovery sweep "
+            "tears down (crash mid-sweep = partial cleanup; re-running "
+            "the sweep must be idempotent)")
+
+MANIFEST_FILENAME = "partitions.json"
+
+
+def checkpoint_owned_names(cp: Checkpoint) -> Set[str]:
+    """Canonical device names any checkpoint entry claims. PrepareStarted
+    entries carry no devices (the write-ahead records intent, not
+    hardware), so this is effectively the committed set plus the current
+    batch's in-flight completions."""
+    return {d.canonical_name
+            for e in cp.claims.values()
+            for d in e.prepared_devices}
+
+
+class RepartitionManager:
+    """Owns the reshape state machine for one node's chips. All mutating
+    entry points are called under DeviceState's lock + cp flock — this
+    class adds no locking of its own beyond the dirty flag."""
+
+    def __init__(self, lib: TpuLib, state_dir: str):
+        self._lib = lib
+        self._state_dir = state_dir
+        self._dirty = False
+        self._dirty_mu = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # dirty flag (the advertise step's trigger)
+    # ------------------------------------------------------------------
+
+    def mark_dirty(self) -> None:
+        with self._dirty_mu:
+            self._dirty = True
+
+    def take_dirty(self) -> bool:
+        with self._dirty_mu:
+            was = self._dirty
+            self._dirty = False
+            return was
+
+    # ------------------------------------------------------------------
+    # occupancy
+    # ------------------------------------------------------------------
+
+    def _live_on_chip(self, chip_index: int) -> List[SubsliceSpecTuple]:
+        return [s.spec_tuple for s in self._lib.list_subslices()
+                if s.spec_tuple.parent_index == chip_index]
+
+    @staticmethod
+    def _span(tup: SubsliceSpecTuple) -> Tuple[int, int]:
+        cores, _ = parse_profile_id(tup.profile_id)
+        return tup.placement_start, tup.placement_start + cores
+
+    def _seat_cores(self, chip: ChipInfo) -> Set[int]:
+        return {seat_core(k, chip.cores)
+                for k in self._lib.list_multiprocess_seats(chip.uuid)}
+
+    def free_placements(self, chip: ChipInfo, profile: SubsliceProfile,
+                        occupied: Optional[List[Tuple[int, int]]] = None
+                        ) -> List[int]:
+        """Placement starts of ``profile`` on ``chip`` that overlap no
+        live partition and cover no core carrying a client seat."""
+        if occupied is None:
+            occupied = [self._span(t)
+                        for t in self._live_on_chip(chip.index)]
+        seats = self._seat_cores(chip)
+        out = []
+        for start in profile.placements():
+            lo, hi = start, start + profile.cores
+            if any(lo < ohi and olo < hi for olo, ohi in occupied):
+                continue
+            if any(lo <= c < hi for c in seats):
+                continue
+            out.append(start)
+        return out
+
+    # ------------------------------------------------------------------
+    # place: the create-on-prepare transition
+    # ------------------------------------------------------------------
+
+    def place(self, chip: ChipInfo, profile: SubsliceProfile,
+              cp: Checkpoint) -> Tuple[SubsliceSpec, SubsliceLiveTuple]:
+        """Pick a free placement for ``profile`` on ``chip`` and create
+        the partition. Half-created leftovers on the chip (live
+        partitions no checkpoint entry owns — an earlier crashed attempt)
+        are rolled back first, so a retry after any failure starts from a
+        clean chip."""
+        t0 = time.perf_counter()
+        owned = checkpoint_owned_names(cp)
+        occupied: List[Tuple[int, int]] = []
+        for tup in self._live_on_chip(chip.index):
+            if tup.canonical_name() in owned:
+                occupied.append(self._span(tup))
+                continue
+            # a live partition no claim owns: the half-created residue of
+            # a crashed attempt — roll it back in place (the same cleanup
+            # the startup reconcile performs, done lazily here so one
+            # crashed claim cannot wedge the chip until the next restart)
+            log.warning("place: rolling back orphan sub-slice %s",
+                        tup.canonical_name())
+            try:
+                self._lib.destroy_subslice(tup)
+                SUBSLICE_REPARTITIONS.labels("rollback", "ok").inc()
+            except SubsliceNotFoundError:
+                pass
+            except TpuLibError:
+                SUBSLICE_REPARTITIONS.labels("rollback", "error").inc()
+                raise
+        free = self.free_placements(chip, profile, occupied)
+        if not free:
+            # transient by design: capacity frees when a peer unprepares;
+            # the scheduler's counter model admitted this slot, so the
+            # usual cause is an in-flight reclaim racing the retry
+            SUBSLICE_REPARTITIONS.labels("create", "error").inc()
+            raise TpuLibError(
+                f"no free {profile.id} placement on chip {chip.index} "
+                f"(live: {[t.canonical_name() for t in self._live_on_chip(chip.index)]})")
+        # highest free start: pre-cut -ss- placements allocate in
+        # canonical (lowest-first) order, so dynamic picks grow from the
+        # top and the two families meet in the middle instead of racing
+        start = fi.fire("repartition.place", payload=free[-1])
+        if start not in free:
+            # a corrupt-mode fault (or a broken picker) handed back an
+            # illegal placement: fail loudly, never create a misplaced
+            # partition the checkpoint would then misname
+            SUBSLICE_REPARTITIONS.labels("create", "error").inc()
+            raise TpuLibError(
+                f"picked placement {start!r} is not a free {profile.id} "
+                f"placement on chip {chip.index} (free: {free})")
+        spec = SubsliceSpec(chip.index, chip.uuid, profile, start)
+        fi.fire("repartition.create")
+        try:
+            try:
+                live = self._lib.create_subslice(spec)
+            except SubsliceAlreadyExistsError:
+                # raced residue the occupancy scan missed: recreate for a
+                # clean slate (mirrors the pre-cut path's handling)
+                self._lib.destroy_subslice(spec.tuple)
+                live = self._lib.create_subslice(spec)
+        except Exception:
+            SUBSLICE_REPARTITIONS.labels("create", "error").inc()
+            raise
+        # manifest + dirty flag the instant the HARDWARE changed: a crash
+        # between here and the checkpoint commit leaves a manifest that
+        # truthfully lists the orphan (the doctor's SUBSLICE_ORPHANS
+        # evidence), not a stale pre-reshape inventory
+        self.mark_dirty()
+        self.write_manifest()
+        fi.fire("repartition.created")
+        SUBSLICE_REPARTITIONS.labels("create", "ok").inc()
+        SUBSLICE_RESHAPE_SECONDS.labels("create").observe(
+            time.perf_counter() - t0)
+        return spec, live
+
+    # ------------------------------------------------------------------
+    # reclaim: the destroy-on-unprepare transition
+    # ------------------------------------------------------------------
+
+    def reclaim(self, tup: SubsliceSpecTuple) -> bool:
+        """Destroy by abstract identity. Returns False when the partition
+        is already gone (idempotent retry / crashed teardown)."""
+        t0 = time.perf_counter()
+        fi.fire("repartition.reclaim")
+        try:
+            self._lib.destroy_subslice(tup)
+        except SubsliceNotFoundError:
+            return False
+        except TpuLibError:
+            SUBSLICE_REPARTITIONS.labels("reclaim", "error").inc()
+            raise
+        SUBSLICE_REPARTITIONS.labels("reclaim", "ok").inc()
+        SUBSLICE_RESHAPE_SECONDS.labels("reclaim").observe(
+            time.perf_counter() - t0)
+        self.mark_dirty()
+        self.write_manifest()
+        return True
+
+    # ------------------------------------------------------------------
+    # reconcile: crash recovery (live partitions vs checkpoint intent)
+    # ------------------------------------------------------------------
+
+    def reconcile(self, cp: Checkpoint) -> List[str]:
+        """The startup sweep (DestroyUnknownMIGDevices analog, state-
+        machine edition): every live partition is re-derived from its
+        canonical name and reconciled against checkpoint intent —
+        committed claims' partitions adopted, orphans and half-created
+        placements torn down. Idempotent on re-crash: the sweep reads
+        hardware + checkpoint truth each run and never journals its own
+        progress."""
+        owned = checkpoint_owned_names(cp)
+        destroyed: List[str] = []
+        for live in self._lib.list_subslices():
+            name = live.spec_tuple.canonical_name()
+            if name in owned:
+                SUBSLICE_REPARTITIONS.labels("adopt", "ok").inc()
+                continue
+            log.warning("reconcile: destroying unknown live sub-slice %s",
+                        name)
+            fi.fire("repartition.reconcile", payload=name)
+            try:
+                self._lib.destroy_subslice(live.spec_tuple)
+                destroyed.append(name)
+                SUBSLICE_REPARTITIONS.labels("rollback", "ok").inc()
+            except SubsliceNotFoundError:
+                pass
+        if destroyed:
+            self.mark_dirty()
+        self.write_manifest()
+        return destroyed
+
+    # ------------------------------------------------------------------
+    # advertise: remaining creatable capacity
+    # ------------------------------------------------------------------
+
+    def exclusions(self, allocatable: Dict[str, AllocatableDevice]
+                   ) -> Set[str]:
+        """Devices to hide from the scheduler so the published inventory
+        reflects the chip's REMAINING creatable capacity after reshapes:
+
+        - pre-cut ``-ss-`` placements overlapping a live partition,
+        - profile slots beyond the count of still-free placements (slots
+          are anonymous, so the highest indices hide first),
+        - client seats whose core a live partition covers,
+        - the whole-chip personality of any chip carrying partitions or
+          seats (its counters already exclude it; hiding keeps the
+          advertised inventory honest).
+        """
+        live_by_chip: Dict[int, List[Tuple[int, int]]] = {}
+        for s in self._lib.list_subslices():
+            live_by_chip.setdefault(s.spec_tuple.parent_index, []).append(
+                self._span(s.spec_tuple))
+        seat_cores_cache: Dict[int, Set[int]] = {}
+
+        def seats_for(dev: AllocatableDevice) -> Set[int]:
+            idx = dev.chip.index
+            if idx not in seat_cores_cache:
+                seat_cores_cache[idx] = self._seat_cores(dev.chip)
+            return seat_cores_cache[idx]
+
+        out: Set[str] = set()
+        free_count: Dict[Tuple[int, str], int] = {}
+        for name, dev in allocatable.items():
+            occupied = live_by_chip.get(dev.chip.index, [])
+            if dev.type == DeviceType.SUBSLICE:
+                lo = dev.placement_start
+                hi = lo + dev.profile.cores
+                if any(lo < ohi and olo < hi for olo, ohi in occupied):
+                    out.add(name)
+            elif dev.type == DeviceType.PROFILE:
+                key = (dev.chip.index, dev.profile.id)
+                if key not in free_count:
+                    free_count[key] = len(self.free_placements(
+                        dev.chip, dev.profile, occupied))
+                if dev.slot >= free_count[key]:
+                    out.add(name)
+            elif dev.type == DeviceType.SHARED:
+                core = seat_core(dev.slot, dev.chip.cores)
+                if any(olo <= core < ohi for olo, ohi in occupied):
+                    out.add(name)
+            elif dev.type == DeviceType.CHIP:
+                if occupied or seats_for(dev):
+                    out.add(name)
+        return out
+
+    # ------------------------------------------------------------------
+    # the live-partition manifest (must-gather surface)
+    # ------------------------------------------------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self._state_dir, MANIFEST_FILENAME)
+
+    def write_manifest(self) -> None:
+        """Best-effort diagnostic inventory of live partitions, dropped
+        next to the checkpoint so tpu-dra-doctor's state-dir collection
+        can cross-check live hardware against checkpoint intent (the
+        SUBSLICE_ORPHANS finding) without reaching the device library.
+        Diagnostic only — hardware + checkpoint stay the truth; a failed
+        write must never fail the reshape that triggered it."""
+        try:
+            names = [s.spec_tuple.canonical_name()
+                     for s in self._lib.list_subslices()]
+            body = json.dumps({"updated_unix": round(time.time(), 3),
+                               "partitions": names}, indent=1)
+            tmp = f"{self.manifest_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(body + "\n")
+            os.replace(tmp, self.manifest_path)
+        except Exception:  # chaos-ok: diagnostic artifact, reshape already landed
+            log.warning("could not write partition manifest", exc_info=True)

@@ -43,7 +43,9 @@ from tpu_dra_driver.pkg.metrics import (
 )
 from tpu_dra_driver.plugin.allocatable import (
     AllocatableDevice,
+    DeviceType,
     chip_counter_set,
+    seats_per_core,
 )
 
 fi.register("resourceslice.publish",
@@ -56,7 +58,7 @@ LAYOUT_SPLIT = "split"
 
 
 def _device_entry(dev: AllocatableDevice, with_counters: bool,
-                  node_name: str = "") -> Dict:
+                  node_name: str = "", granularity: int = 1) -> Dict:
     entry: Dict = {
         "name": dev.canonical_name,
         "attributes": dev.attributes(),
@@ -73,9 +75,26 @@ def _device_entry(dev: AllocatableDevice, with_counters: bool,
     if with_counters:
         entry["consumesCounters"] = [{
             "counterSet": dev.counter_set_name(),
-            "counters": dev.counter_consumption(),
+            "counters": dev.counter_consumption(granularity),
         }]
     return entry
+
+
+def _chip_granularities(devices: Dict[str, AllocatableDevice]
+                        ) -> Dict[int, int]:
+    """Per-chip memory-slice counter resolution: chips advertising SHARED
+    seats sub-divide each core's counter into seat units so seats and
+    core-owning devices exclude per core; everyone else stays at 1. Uses
+    the FULL inventory (not the visible subset) so exclusions cannot flip
+    a chip's counter granularity mid-lifecycle."""
+    out: Dict[int, int] = {}
+    for d in devices.values():
+        idx = d.chip.index
+        if d.type == DeviceType.SHARED:
+            out[idx] = seats_per_core(d.chip.cores)
+        else:
+            out.setdefault(idx, 1)
+    return out
 
 
 def build_resource_slices(node_name: str,
@@ -98,7 +117,9 @@ def build_resource_slices(node_name: str,
     exclude = exclude or set()
     visible = {n: d for n, d in devices.items() if n not in exclude}
     chips = sorted({d.chip.index: d.chip for d in visible.values()}.items())
-    counter_sets = [chip_counter_set(chip) for _, chip in chips] if partitionable else []
+    grans = _chip_granularities(devices)
+    counter_sets = ([chip_counter_set(chip, grans.get(idx, 1))
+                     for idx, chip in chips] if partitionable else [])
 
     def slice_obj(name: str, devs: List[Dict], shared: List[Dict],
                   count: int) -> Dict:
@@ -139,15 +160,16 @@ def build_resource_slices(node_name: str,
                 out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-counters",
                                      [], counter_sets, count))
             for i, bucket in enumerate(buckets):
-                devs = [_device_entry(devices[n], partitionable,
-                                              node_name)
+                devs = [_device_entry(devices[n], partitionable, node_name,
+                                      grans.get(devices[n].chip.index, 1))
                         for n in bucket if n in visible]
                 out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-p{i}",
                                      devs, [], count))
             return out
         return [slice_obj(
             f"{node_name}-{DRIVER_NAME}",
-            [_device_entry(d, partitionable, node_name) for d in ordered],
+            [_device_entry(d, partitionable, node_name,
+                           grans.get(d.chip.index, 1)) for d in ordered],
             counter_sets, 1,
         )]
 
@@ -157,7 +179,8 @@ def build_resource_slices(node_name: str,
     out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-counters", [],
                          counter_sets, count))
     for chip_idx, _ in chips:
-        devs = [_device_entry(d, True, node_name)
+        devs = [_device_entry(d, True, node_name,
+                              grans.get(chip_idx, 1))
                 for d in ordered if d.chip.index == chip_idx]
         out.append(slice_obj(f"{node_name}-{DRIVER_NAME}-chip{chip_idx}",
                              devs, [], count))

@@ -32,6 +32,7 @@ from typing import Dict, List
 
 from tpu_dra_driver.api.configs import MultiProcessConfig, TimeSlicingConfig
 from tpu_dra_driver.cdi.generator import ContainerEdits
+from tpu_dra_driver.pkg.metrics import SHARED_CHIP_CLIENTS
 from tpu_dra_driver.tpulib.interface import TimesliceInterval, TpuLib
 
 
@@ -52,11 +53,16 @@ class TimeSlicingManager:
         })
 
     def reset(self, chip_uuids: List[str]) -> None:
-        """Restore the default interval on unprepare so sharing settings
-        cannot leak into the next claim on the same chip."""
+        """Restore default scheduling on unprepare so sharing settings
+        cannot leak into the next claim on the same chip — BOTH the
+        interval and exclusive mode: ``apply`` flipped the chip
+        non-exclusive, so a reset that only restored the interval left a
+        later exclusive claim silently running shared (the sharing-mode
+        leak this method's regression test pins)."""
         with self._mu:
             for uuid in chip_uuids:
                 self._lib.set_timeslice(uuid, TimesliceInterval.DEFAULT)
+                self._lib.set_exclusive_mode(uuid, True)
 
 
 class MultiProcessManager:
@@ -102,3 +108,43 @@ class MultiProcessManager:
             for uuid in chip_uuids:
                 self._lib.release_multiprocess_share(uuid)
                 self._lib.set_exclusive_mode(uuid, True)
+
+    # -- per-claim client seats (SharedChipServing) ------------------------
+
+    def attach_seat(self, chip_uuid: str, seat: int, owner: str,
+                    hbm_limit_percent: int) -> ContainerEdits:
+        """Attach ONE client seat on a shared chip for ``owner`` (the
+        claim-per-request serving unit) and inject the bounded-client
+        env. Raises SharingExhaustedError for seat conflicts /
+        over-subscription / a partitioned core — a permanent failure for
+        this claim."""
+        with self._mu:
+            before = len(self._lib.list_multiprocess_seats(chip_uuid))
+            share = self._lib.attach_multiprocess_seat(
+                chip_uuid, owner, seat, hbm_limit_percent)
+            self._lib.set_exclusive_mode(chip_uuid, False)
+            after = len(self._lib.list_multiprocess_seats(chip_uuid))
+            # delta, not unconditional: an idempotent re-attach (kubelet
+            # retrying a partially-failed prepare) returns the existing
+            # share and must not inflate the density gauge
+            if after > before:
+                SHARED_CHIP_CLIENTS.inc(after - before)
+        return ContainerEdits(env={
+            "TPU_MULTI_PROCESS": "1",
+            "TPU_MP_SEAT": str(seat),
+            "TPU_HBM_LIMIT_PERCENT": str(share.hbm_limit_percent),
+            "TPU_HBM_LIMIT_BYTES": str(share.client_hbm_bytes),
+        })
+
+    def detach_seat(self, chip_uuid: str, owner: str) -> None:
+        """Detach the claim's seat(s) on unprepare; the chip returns to
+        exclusive scheduling only once its LAST seat detaches (other
+        claims' clients keep running)."""
+        with self._mu:
+            before = len(self._lib.list_multiprocess_seats(chip_uuid))
+            self._lib.detach_multiprocess_seat(chip_uuid, owner=owner)
+            after = len(self._lib.list_multiprocess_seats(chip_uuid))
+            if before > after:
+                SHARED_CHIP_CLIENTS.dec(before - after)
+            if after == 0:
+                self._lib.set_exclusive_mode(chip_uuid, True)

@@ -69,6 +69,16 @@ CHIP_REQUEST = [{"name": "tpu", "count": 1,
 SUBSLICE_REQUEST = [{"name": "tpu", "count": 1,
                      "selectors": [{"attribute": "type",
                                     "equals": "subslice"}]}]
+#: A creatable profile slot (DynamicRepartition): the plugin picks the
+#: placement at prepare time.
+PROFILE_REQUEST = [{"name": "tpu", "count": 1,
+                    "selectors": [{"attribute": "type",
+                                   "equals": "profile"}]}]
+#: One multi-process client seat on a shared chip (SharedChipServing) —
+#: the claim-per-request serving unit.
+SHARED_REQUEST = [{"name": "tpu", "count": 1,
+                   "selectors": [{"attribute": "type",
+                                  "equals": "shared"}]}]
 
 
 def node_pinned_request(node: str, type_: str = "subslice") -> List[Dict]:
@@ -1307,6 +1317,12 @@ def check_no_stale_epoch_commits(clients: ClientSets, handle) -> int:
     for rej in handle.rejections:
         if rej["resource"] != "resourceclaims":
             continue
+        if rej.get("old_allocated"):
+            # the claim was committed BEFORE this write was rejected:
+            # the rejected write is a late duplicate (event re-dispatch,
+            # backstop rescan) racing an epoch bump — the pre-existing
+            # allocation is not the rejected write having landed
+            continue
         claim = by_name.get(rej["name"])
         if claim is None or not (claim.get("status") or {}
                                  ).get("allocation"):
@@ -1714,4 +1730,537 @@ def scenario_lease_flap_soak(cycles: int = 4,
             f"{run.extra['traffic']['failure_samples']}")
     check_no_double_alloc(observer)
     check_no_stale_epoch_commits(observer, handle)
+    return run.report()
+
+
+# ---------------------------------------------------------------------------
+# scenario 5: dynamic repartitioning storm under inference-density traffic
+# ---------------------------------------------------------------------------
+
+
+def repartition_gates() -> fg.FeatureGates:
+    """The gate set the dynamic-repartitioning scenarios run under:
+    pre-cut placements + creatable profile slots + shared client seats."""
+    gates = fg.FeatureGates()
+    gates.set(fg.DYNAMIC_SUBSLICE, True)
+    gates.set(fg.DYNAMIC_REPARTITION, True)
+    gates.set(fg.SHARED_CHIP_SERVING, True)
+    return gates
+
+
+def check_no_residual_shares(hosts: Iterable) -> None:
+    """Every attached multi-process seat on every host belongs to a
+    checkpointed claim — the partition-residue sentinel's sharing half:
+    a seat surviving its claim would silently bound a FUTURE claim's
+    clients (the sharing-mode leak class)."""
+    for h in hosts:
+        cp = h.tpu_plugin.state.get_checkpoint()
+        claim_uids = set(cp.claims)
+        for chip in h.lib.enumerate_chips():
+            for seat, share in h.lib.list_multiprocess_seats(
+                    chip.uuid).items():
+                if share.owner not in claim_uids:
+                    raise InvariantViolation(
+                        f"host {getattr(h, 'node_name', h)}: seat {seat} "
+                        f"on chip {chip.index} held by claim "
+                        f"{share.owner} which the checkpoint no longer "
+                        f"knows (residual share)")
+
+
+def _deallocate(clients: ClientSets, name: str, namespace: str) -> None:
+    """Clear a claim's allocation so the controller re-places it — the
+    reschedule a higher-level orchestrator performs when prepare fails
+    transiently (e.g. the allocator admitted a profile slot onto a chip
+    whose cores seat claims occupy, before the capacity republish
+    reached its informer)."""
+    def clear(o):
+        (o.get("status") or {}).pop("allocation", None)
+    try:
+        clients.resource_claims.retry_update(name, namespace, clear)
+    except NotFoundError:
+        pass
+
+
+def _prepare_with_replace(clients: ClientSets, plugin, name: str,
+                          namespace: str, deadline: float):
+    """Await allocation and prepare, deallocating + re-awaiting on
+    TRANSIENT prepare failures until ``deadline`` (permanent failures
+    and deadline exhaustion raise). Returns the claim's (uid, result)."""
+    while True:
+        while not _allocation(clients, name, namespace):
+            if time.monotonic() > deadline:
+                raise InvariantViolation(
+                    f"claim {name} not allocated before deadline")
+            time.sleep(0.005)
+        obj = clients.resource_claims.get(name, namespace)
+        uid = obj["metadata"]["uid"]
+        res = plugin.prepare_resource_claims([obj])[uid]
+        if res.error is None:
+            return uid, res
+        if res.permanent:
+            raise InvariantViolation(
+                f"claim {name} failed permanently: {res.error}")
+        if time.monotonic() > deadline:
+            raise InvariantViolation(
+                f"claim {name} never prepared before deadline "
+                f"(last transient error: {res.error})")
+        _deallocate(clients, name, namespace)
+        time.sleep(0.02)
+
+
+def repartition_burst(clients: ClientSets, plugin, node: str,
+                      n: int = 4, namespace: str = "reshape",
+                      prefix: str = "burst",
+                      alloc_timeout: float = 30.0) -> List[float]:
+    """One reshape wave: N dynamic PROFILE claims pinned to ``node`` go
+    create → allocate → prepare (placement picked + partition created on
+    demand) → unprepare (partition reclaimed) → delete. Returns the
+    per-claim reshape latencies (create → partition live) in ms — the
+    figure the bench records as reshape p50/p99. Transient placement
+    conflicts (a chip fully seated by serving claims before the
+    capacity republish converged) are rescheduled via deallocation, the
+    same way a real orchestrator reacts; any permanent failure or
+    deadline raises InvariantViolation (reshape storms are loss-free)."""
+    lat: List[float] = []
+    names = [f"{prefix}-{i}" for i in range(n)]
+    created: List[str] = []
+    prepared: List[Tuple[str, str]] = []     # (uid, name)
+    try:
+        t0s: Dict[str, float] = {}
+        for name in names:
+            t0s[name] = time.monotonic()
+            clients.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": {"devices": {
+                    "requests": node_pinned_request(node,
+                                                    type_="profile")}},
+            })
+            created.append(name)
+        for name in names:
+            uid, _ = _prepare_with_replace(
+                clients, plugin, name, namespace,
+                deadline=t0s[name] + alloc_timeout)
+            lat.append((time.monotonic() - t0s[name]) * 1e3)
+            prepared.append((uid, name))
+    finally:
+        for uid, name in prepared:
+            err = plugin.unprepare_resource_claims(
+                [{"uid": uid, "name": name, "namespace": namespace}])[uid]
+            if err is not None:
+                raise InvariantViolation(
+                    f"reshape claim {name} failed to unprepare: {err}")
+        for name in created:
+            clients.resource_claims.delete_ignore_missing(name, namespace)
+    return lat
+
+
+class ServingTraffic:
+    """The claim-per-request serving tier: a real continuous-batching
+    :class:`~tpu_dra_driver.workloads.models.serving.ServingEngine` is
+    the traffic generator, and every admitted request is gated on its
+    OWN small ResourceClaim for one shared-chip client seat — thousands
+    of users means thousands of little claims, each with an enforced
+    per-client HBM budget the fake device library binds.
+
+    Per request: create claim (``type=shared``) → allocation → prepare
+    on the owning node (seat attached, bounded-client env rendered) →
+    connect the client and charge its KV bytes against the seat budget →
+    admit the prompt into the shared engine; on completion the client
+    disconnects, the claim unprepares and is deleted. The engine batch
+    runs continuously while claims churn — requests join and leave
+    mid-flight exactly like the serving workload's own execution model.
+    """
+
+    def __init__(self, clients: ClientSets,
+                 plugin_for: Callable[[str], Optional[object]],
+                 namespace: str = "serving", prefix: str = "req",
+                 total_requests: int = 16,
+                 prompt_len: int = 6, max_new_tokens: int = 8,
+                 alloc_timeout: float = 30.0, seed: int = 0):
+        import jax
+        import numpy as np
+
+        from tpu_dra_driver.workloads.models import (
+            ModelConfig,
+            ServingEngine,
+            init_params,
+        )
+        import jax.numpy as jnp
+
+        self._clients = clients
+        self._plugin_for = plugin_for
+        self._namespace = namespace
+        self._prefix = prefix
+        self._alloc_timeout = alloc_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        cfg = ModelConfig(vocab=128, d_model=64, n_heads=4, n_kv_heads=2,
+                          n_layers=2, d_ff=128, max_seq=256, use_rope=True,
+                          dtype=jnp.float32)
+        self._cfg = cfg
+        self._eng = ServingEngine(init_params(cfg, jax.random.PRNGKey(seed)),
+                                  cfg, n_blocks=24, block_t=8, max_batch=4,
+                                  max_blocks_per_seq=8)
+        rng = np.random.RandomState(seed)
+        self._prompts = [[int(t) for t in rng.randint(0, cfg.vocab,
+                                                      prompt_len)]
+                         for _ in range(total_requests)]
+        self._max_new = max_new_tokens
+        # per-request KV footprint the client charges against its seat
+        # budget: blocks x block_t x 2(K+V) x kv_heads x head_dim x
+        # 4B(f32) x layers
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        hd = cfg.d_model // cfg.n_heads
+        blocks = -(-(prompt_len + max_new_tokens) // 8)
+        self.kv_bytes_per_request = blocks * 8 * 2 * n_kv * hd * 4 * cfg.n_layers
+        # results
+        self.latencies_ms: List[float] = []
+        self.failures: List[str] = []
+        self.served = 0
+        self.budget_enforced: Optional[bool] = None
+        self.claims_by_chip: Dict[str, int] = {}
+        self._live_by_chip: Dict[str, int] = {}
+        self.max_concurrent_per_chip = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingTraffic":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"serving-{self._prefix}")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 120.0) -> Dict:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                self._stop.set()
+                self._thread.join(timeout=10.0)
+                self.failures.append("serving thread failed to finish")
+        return self.report()
+
+    def report(self) -> Dict:
+        return {
+            "requests": self.served,
+            "failures": len(self.failures),
+            "failure_samples": self.failures[:3],
+            "p50_ms": round(percentile(self.latencies_ms, 50), 2),
+            "p99_ms": round(percentile(self.latencies_ms, 99), 2),
+            "budget_enforced": self.budget_enforced,
+            "kv_bytes_per_request": self.kv_bytes_per_request,
+            "chips_used": len(self.claims_by_chip),
+            "claims_per_chip_served": max(self.claims_by_chip.values(),
+                                          default=0),
+            "claims_per_chip_concurrent": self.max_concurrent_per_chip,
+        }
+
+    # -- internals ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        from tpu_dra_driver.tpulib.interface import SharingExhaustedError
+
+        pending = list(enumerate(self._prompts))
+        active: Dict[int, Dict] = {}       # rid -> request bookkeeping
+        while (pending or active) and not self._stop.is_set():
+            admitted = False
+            while pending and len(active) < 4:
+                i, prompt = pending[0]
+                info = self._admit(i, prompt, SharingExhaustedError)
+                if info is None:
+                    pending.pop(0)          # failed — recorded, dropped
+                    continue
+                if info == "full":
+                    break                   # engine capacity; decode first
+                pending.pop(0)
+                active[info["rid"]] = info
+                admitted = True
+            stepped = self._eng.step_chunk(max_steps=8)
+            for rid in [r for r in list(active)
+                        if r in self._eng.finished]:
+                self._release(active.pop(rid))
+            if not stepped and not admitted and pending and not active:
+                self.failures.append("serving tier stalled")
+                return
+
+    def _admit(self, i: int, prompt: List[int], exhausted_exc):
+        name = f"{self._prefix}-{i}"
+        t0 = time.monotonic()
+        try:
+            self._clients.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": self._namespace},
+                "spec": {"devices": {"requests": list(SHARED_REQUEST)}},
+            })
+            deadline = t0 + self._alloc_timeout
+            while True:
+                while not _allocation(self._clients, name,
+                                      self._namespace):
+                    if time.monotonic() > deadline or self._stop.is_set():
+                        self.failures.append(f"{name}: allocation timeout")
+                        self._clients.resource_claims.delete_ignore_missing(
+                            name, self._namespace)
+                        return None
+                    time.sleep(0.005)
+                obj = self._clients.resource_claims.get(name,
+                                                        self._namespace)
+                uid = obj["metadata"]["uid"]
+                result = obj["status"]["allocation"]["devices"]["results"][0]
+                plugin = self._plugin_for(result["pool"])
+                if plugin is None:
+                    self.failures.append(
+                        f"{name}: no plugin for pool {result['pool']}")
+                    self._clients.resource_claims.delete_ignore_missing(
+                        name, self._namespace)
+                    return None
+                res = plugin.prepare_resource_claims([obj])[uid]
+                if res.error is None:
+                    break
+                if res.permanent or time.monotonic() > deadline:
+                    self.failures.append(f"{name}: prepare: {res.error}")
+                    self._clients.resource_claims.delete_ignore_missing(
+                        name, self._namespace)
+                    return None
+                # transient (a reshape raced this seat's core): clear the
+                # allocation so the controller re-places the request
+                # against the refreshed capacity exclusions
+                _deallocate(self._clients, name, self._namespace)
+                time.sleep(0.02)
+            dev = plugin.state.allocatable[result["device"]]
+            lib = plugin.state._lib
+            chip_uuid = dev.chip.uuid
+            cid = lib.connect_multiprocess_client(chip_uuid, owner=uid)
+            if self.budget_enforced is None:
+                # the budgets-bind probe: one byte past the seat budget
+                # must refuse (the enforcement half of the reference's
+                # MPS control daemon)
+                budget = lib.list_multiprocess_seats(chip_uuid)[
+                    dev.slot].client_hbm_bytes
+                try:
+                    lib.client_allocate_hbm(chip_uuid, cid, budget + 1)
+                    self.budget_enforced = False
+                except exhausted_exc:
+                    self.budget_enforced = True
+            lib.client_allocate_hbm(chip_uuid, cid,
+                                    self.kv_bytes_per_request)
+            try:
+                rid = self._eng.add(prompt, self._max_new)
+            except RuntimeError:
+                # engine at capacity: release the seat, retry later
+                lib.disconnect_multiprocess_client(chip_uuid, cid)
+                plugin.unprepare_resource_claims(
+                    [{"uid": uid, "name": name,
+                      "namespace": self._namespace}])
+                self._clients.resource_claims.delete_ignore_missing(
+                    name, self._namespace)
+                return "full"
+            self._live_by_chip[chip_uuid] = \
+                self._live_by_chip.get(chip_uuid, 0) + 1
+            self.max_concurrent_per_chip = max(
+                self.max_concurrent_per_chip,
+                self._live_by_chip[chip_uuid])
+            self.claims_by_chip[chip_uuid] = \
+                self.claims_by_chip.get(chip_uuid, 0) + 1
+            return {"rid": rid, "name": name, "uid": uid, "t0": t0,
+                    "chip": chip_uuid, "cid": cid,
+                    "pool": result["pool"]}
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            self.failures.append(f"{name}: {type(e).__name__}: {e}")
+            self._clients.resource_claims.delete_ignore_missing(
+                name, self._namespace)
+            return None
+
+    def _release(self, info: Dict) -> None:
+        try:
+            plugin = self._plugin_for(info["pool"])
+            if plugin is not None:
+                plugin.state._lib.disconnect_multiprocess_client(
+                    info["chip"], info["cid"])
+                err = plugin.unprepare_resource_claims(
+                    [{"uid": info["uid"], "name": info["name"],
+                      "namespace": self._namespace}])[info["uid"]]
+                if err is not None:
+                    self.failures.append(
+                        f"{info['name']}: unprepare: {err}")
+                    return
+            self._live_by_chip[info["chip"]] = max(
+                0, self._live_by_chip.get(info["chip"], 1) - 1)
+            self.latencies_ms.append(
+                (time.monotonic() - info["t0"]) * 1e3)
+            self.served += 1
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            self.failures.append(
+                f"{info['name']}: release: {type(e).__name__}: {e}")
+        finally:
+            self._clients.resource_claims.delete_ignore_missing(
+                info["name"], self._namespace)
+
+
+def scenario_repartition_storm(tmp_dir: str,
+                               n_nodes: int = 2,
+                               serving_requests: int = 10,
+                               storm_waves: int = 2,
+                               claims_per_wave: int = 3,
+                               kill_mid_reshape: bool = True,
+                               converge_timeout: float = 45.0) -> Dict:
+    """The dynamic-repartitioning acceptance scenario: a reshape storm
+    (waves of creatable-profile claims reshaping every node's chips on
+    demand) runs UNDER live inference-density serving traffic
+    (claim-per-request client seats fed by the continuous-batching
+    engine), with a kill-mid-reshape crash drill in the middle and the
+    partition-residue sentinel asserted at every wave boundary:
+
+    - every reshape claim is loss-free (allocate → place → create →
+      reclaim), latencies recorded as reshape p50/p99;
+    - a plugin killed between partition create and checkpoint commit
+      leaves a live orphan that the RESTARTED plugin's reconcile sweep
+      tears down, and the claim then prepares cleanly (recovery timed);
+    - at every boundary: no leaked sub-slice, no residual seat, no
+      double-alloc; at the end the serving tier finished every request
+      with zero failures and the per-client HBM budget provably bound.
+    """
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationControllerConfig,
+    )
+
+    run = ScenarioRun("repartition_storm")
+    run.begin_observability()
+    fleet = MiniFleet(tmp_dir, n_nodes, gates=repartition_gates())
+    clients = fleet.clients
+    controller = AllocationController(
+        clients, AllocationControllerConfig(workers=2, retry_interval=0.5))
+    serving = ServingTraffic(
+        clients,
+        plugin_for=lambda pool: (fleet.nodes[pool].tpu_plugin
+                                 if pool in fleet.nodes else None),
+        total_requests=serving_requests, alloc_timeout=converge_timeout)
+    reshape_ms: List[float] = []
+    try:
+        with run.step("setup"):
+            fleet.start()
+            controller.start()
+            run.converge(
+                "fleet_published",
+                lambda: {s["spec"].get("nodeName")
+                         for s in clients.resource_slices.list()}
+                >= set(fleet.nodes),
+                timeout=10.0)
+        baseline = watcher_snapshot(clients)
+        serving.start()
+
+        for w in range(storm_waves):
+            with run.step(f"reshape_wave_{w}"):
+                for node in sorted(fleet.nodes):
+                    reshape_ms.extend(repartition_burst(
+                        clients, fleet.plugin(node), node,
+                        n=claims_per_wave, namespace="reshape",
+                        prefix=f"rs{w}-{node}",
+                        alloc_timeout=converge_timeout))
+            # the partition-residue sentinel, every wave boundary
+            check_no_leaked_subslices(fleet.nodes.values())
+            check_no_residual_shares(fleet.nodes.values())
+            check_no_double_alloc(clients)
+
+        if kill_mid_reshape:
+            with run.step("kill_mid_reshape"):
+                # the LAST node: serving seats concentrate on the
+                # canonically-first pools, keeping this drill's chip
+                # geometry deterministic
+                victim = sorted(fleet.nodes)[-1]
+                rule = fi.arm("repartition.created",
+                              fi.Rule(mode="crash", nth=1))
+                name = "kill-reshape"
+                clients.resource_claims.create({
+                    "apiVersion": "resource.k8s.io/v1beta1",
+                    "kind": "ResourceClaim",
+                    "metadata": {"name": name, "namespace": "reshape"},
+                    "spec": {"devices": {"requests":
+                                         node_pinned_request(
+                                             victim, type_="profile")}},
+                })
+                drill_deadline = time.monotonic() + converge_timeout
+                while True:
+                    _await(lambda: bool(_allocation(clients, name,
+                                                    "reshape")),
+                           converge_timeout, "kill-drill claim allocation")
+                    obj = clients.resource_claims.get(name, "reshape")
+                    uid = obj["metadata"]["uid"]
+                    res = fleet.plugin(victim).prepare_resource_claims(
+                        [obj])[uid]
+                    if rule.fires >= 1:
+                        if res.error is None:
+                            raise InvariantViolation(
+                                "claim prepared despite the armed crash")
+                        break
+                    # the fault never fired: a transient placement
+                    # conflict failed the attempt before create —
+                    # re-place and retry the drill
+                    if res.permanent or time.monotonic() > drill_deadline:
+                        raise InvariantViolation(
+                            f"kill-mid-reshape fault did not land "
+                            f"(fires={rule.fires}, error={res.error})")
+                    _deallocate(clients, name, "reshape")
+                    time.sleep(0.02)
+                fi.disarm("repartition.created")
+                # the partition is LIVE but the checkpoint only holds a
+                # PrepareStarted write-ahead: the orphan the restarted
+                # plugin's reconcile must destroy
+                node_obj = fleet.nodes[victim]
+                cp = node_obj.tpu_plugin.state.get_checkpoint()
+                owned = {d.canonical_name
+                         for e in cp.claims.values()
+                         for d in e.prepared_devices}
+                orphans = [s.spec_tuple.canonical_name()
+                           for s in node_obj.lib.list_subslices()
+                           if s.spec_tuple.canonical_name() not in owned]
+                if not orphans:
+                    raise InvariantViolation(
+                        "kill-mid-reshape left no live orphan — the "
+                        "drill missed its instant")
+                t0 = time.monotonic()
+                fleet.restart_node(victim)
+                node_obj = fleet.nodes[victim]
+                still = {s.spec_tuple.canonical_name()
+                         for s in node_obj.lib.list_subslices()}
+                if any(o in still for o in orphans):
+                    raise InvariantViolation(
+                        f"restart did not reconcile orphans {orphans}")
+                uid, _ = _prepare_with_replace(
+                    clients, node_obj.tpu_plugin, name, "reshape",
+                    deadline=time.monotonic() + converge_timeout)
+                run.extra["recovery_ms"] = round(
+                    (time.monotonic() - t0) * 1e3, 1)
+                node_obj.tpu_plugin.unprepare_resource_claims(
+                    [{"uid": uid, "name": name, "namespace": "reshape"}])
+                clients.resource_claims.delete_ignore_missing(
+                    name, "reshape")
+            check_no_leaked_subslices(fleet.nodes.values())
+
+        run.converge("serving_complete",
+                     lambda: serving.served + len(serving.failures)
+                     >= serving_requests,
+                     timeout=max(converge_timeout, 120.0))
+    finally:
+        fi.disarm("repartition.created")
+        run.extra["serving"] = serving.stop()
+        run.finish_observability()
+        controller.stop()
+        fleet.stop()
+    if run.extra["serving"]["failures"]:
+        raise InvariantViolation(
+            f"serving tier failed during the storm: "
+            f"{run.extra['serving']['failure_samples']}")
+    if run.extra["serving"]["budget_enforced"] is not True:
+        raise InvariantViolation(
+            "per-client HBM budget was never proven to bind")
+    check_no_double_alloc(clients)
+    check_no_leaked_subslices(fleet.nodes.values())
+    check_no_residual_shares(fleet.nodes.values())
+    check_no_lost_claims(clients, [], require_parked_events=False)
+    check_no_watcher_growth(clients, baseline)
+    run.extra["reshapes"] = len(reshape_ms)
+    run.extra["reshape_p50_ms"] = round(percentile(reshape_ms, 50), 2)
+    run.extra["reshape_p99_ms"] = round(percentile(reshape_ms, 99), 2)
     return run.report()

@@ -91,8 +91,10 @@ from tpu_dra_driver.testing.scenarios import (
     check_no_double_alloc,
     check_no_leaked_subslices,
     check_no_lost_claims,
+    check_no_residual_shares,
     check_no_stale_epoch_commits,
     node_pinned_request,
+    repartition_burst,
     synthetic_slice,
 )
 
@@ -166,6 +168,13 @@ ADVERSITY_SOURCES: Dict[str, AdversitySource] = {
         "on every member, daemons rendezvous to Ready, teardown reaps "
         "the daemons (instant; the long-lived-daemon churn arm)",
         ("scenario", "harness:ClusterHarness.prepare_channel_claims")),
+    "reshape": AdversitySource(
+        "a dynamic repartition burst on one real node: creatable-profile "
+        "claims allocate, the plugin picks placements and creates the "
+        "partitions on demand, then reclaims them — chip reshaping as "
+        "background fleet life (instant, node-exclusive window so a "
+        "drain/storm never opens mid-reshape)",
+        ("scenario", "scenarios:repartition_burst")),
 }
 
 #: event-tape kind -> catalog source (paired end events share their
@@ -180,6 +189,7 @@ KIND_SOURCE: Dict[str, str] = {
     "partition": "partition", "heal": "partition",
     "weather": "weather", "weather_end": "weather",
     "cd_cycle": "cd_cycle",
+    "reshape": "reshape",
 }
 
 #: weather recipes: (point, mode). Latency recipes are always eligible;
@@ -252,6 +262,8 @@ class SoakConfig:
     stalls_per_epoch: int = 1
     weather_per_epoch: int = 1
     cd_cycles_per_epoch: int = 1
+    reshapes_per_epoch: int = 1
+    reshape_claims: int = 2
 
     # weather severity
     weather_latency_s: float = 0.08
@@ -312,13 +324,15 @@ class SoakConfig:
         traffic arms with no pause so the controllers batch deeply
         (one snapshot per batch), (b) rides out stall windows in the
         reserve path instead of erroring (grant timeout > stall
-        window), and (c) judges with week-scale objectives: 85%
+        window), and (c) judges with week-scale objectives: 80%
         attempt-level availability / 95% latency over the whole
         horizon — with aborted attempts (claim vanished, stale-route
         redirects) excluded from the availability traffic, the
-        remaining error rate is genuine canonical-pick contention,
-        ~8-10% of attempts on this substrate, so the bar is bounded
-        decay and exhaustion is still a hard failure. The allocation
+        remaining error rate is genuine canonical-pick contention
+        (~10% of attempts before the repartition arm; ~17% with chip,
+        sub-slice AND profile-reshape families all contending for the
+        real-node chips since ISSUE 13), so the bar is bounded decay
+        and exhaustion is still a hard failure. The allocation
         latency threshold sits at the 5 s bucket because the week
         DELIBERATELY rides stall windows: an attempt that eats a full
         reserve-grant stall (<= 2.5 s by config) plus a 10k-node
@@ -334,7 +348,15 @@ class SoakConfig:
                    churn_wave_size=50,
                    weather_fail_p=0.03,
                    reserve_grant_timeout_s=2.5,
-                   availability_objective=0.85,
+                   # 0.85 before ISSUE 13; the dynamic-repartition arm
+                   # adds a THIRD claim family (profile reshapes, plus
+                   # residents moving off real chips) contending for the
+                   # same real-node devices as the chip and sub-slice
+                   # arms, so attempt-level canonical-pick contention
+                   # rose from ~10% to ~17% of attempts — retries, not
+                   # user-visible loss (the traffic completes loss-free;
+                   # exhaustion is still a hard failure)
+                   availability_objective=0.80,
                    latency_objective=0.95,
                    allocation_latency_threshold_s=5.0,
                    # prepare pays the same GIL the 40k-device snapshot
@@ -512,6 +534,20 @@ class AdversityScheduler:
                         emit(epoch, at, "upgrade", target)
                         break
 
+            for _ in range(cfg.reshapes_per_epoch):
+                # a reshape burst is instant but claims a small node
+                # window: a drain/storm/upgrade must not open on the
+                # node while its chips are mid-reshape
+                for _ in range(self.MAX_PLACE_ATTEMPTS):
+                    at = rng.uniform(lo, win_hi)
+                    end = min(at + 0.02 * E, win_hi)
+                    target = rng.choice(nodes)
+                    if self._free(node_busy[target], at, end):
+                        node_busy[target].append((at, end))
+                        emit(epoch, at, "reshape", target,
+                             params={"claims": cfg.reshape_claims})
+                        break
+
             for _ in range(cfg.churn_waves_per_epoch):
                 emit(epoch, rng.uniform(lo, win_hi), "churn",
                      params={"add": cfg.churn_wave_size,
@@ -582,6 +618,11 @@ DEFAULT_SENTINELS: Dict[str, Tuple[float, str]] = {
     "trace_evictions": (64, "flight-recorder evictions per epoch (a "
                             "growing rate means attribution coverage "
                             "is decaying)"),
+    "partition_residue": (0, "live sub-slice partitions not owned by a "
+                             "PrepareCompleted checkpoint entry, plus "
+                             "multi-process seats owned by unknown "
+                             "claims, across every real node (the "
+                             "dynamic-repartition leak direction)"),
 }
 
 
@@ -642,6 +683,7 @@ class SoakEngine:
         "partition": "_ev_partition", "heal": "_ev_heal",
         "weather": "_ev_weather", "weather_end": "_ev_weather_end",
         "cd_cycle": "_ev_cd_cycle",
+        "reshape": "_ev_reshape",
     }
 
     def __init__(self, config: SoakConfig, tmp_dir: Optional[str] = None):
@@ -666,6 +708,7 @@ class SoakEngine:
         self._synth_next = [0]
         self._synthetic: List[str] = []
         self._cd_serial = [0]
+        self._reshape_serial = [0]
         self._last_evicted = 0.0
         # judges / report
         self.sentinels: Dict[str, LeakSentinel] = {}
@@ -709,6 +752,10 @@ class SoakEngine:
         gates = fg.FeatureGates()
         gates.set(fg.DYNAMIC_SUBSLICE, True)
         gates.set(fg.DEVICE_HEALTH_CHECK, True)
+        # the dynamic-repartitioning arm: creatable profile slots on
+        # every real node, reshaped on demand by the reshape adversity
+        # source while sub-slice/chip traffic flows
+        gates.set(fg.DYNAMIC_REPARTITION, True)
         self.cluster = FakeCluster()
         self.handle = fencing_mod.install_admission(self.cluster)
         self.observer = ClientSets(cluster=self.cluster)
@@ -758,15 +805,21 @@ class SoakEngine:
                 max(1.0, cfg.epoch_wall_s / 4.0), 14.4),),
             tick=cfg.slo_tick_s, component="soak", cumulative=True)
         # resident claims: standing allocations the residue audit and
-        # churn-removability checks run against for the whole soak
+        # churn-removability checks run against for the whole soak.
+        # Pinned to SYNTHETIC pools: unpinned residents allocate in
+        # canonical order, which at week scale (24 residents) blankets
+        # every REAL chip with whole-chip holdings — counter-excluding
+        # the sub-slice and reshape traffic those chips exist for
         residents = []
         for i in range(cfg.resident_chip_claims):
             name = f"resident-{i}"
+            node = self._synthetic[i % len(self._synthetic)]
             self.observer.resource_claims.create({
                 "apiVersion": "resource.k8s.io/v1beta1",
                 "kind": "ResourceClaim",
                 "metadata": {"name": name, "namespace": "soak"},
-                "spec": {"devices": {"requests": list(CHIP_REQUEST)}},
+                "spec": {"devices": {
+                    "requests": node_pinned_request(node, type_="chip")}},
             })
             residents.append(name)
         self._await(
@@ -942,6 +995,17 @@ class SoakEngine:
         if entry is not None:
             fi.remove_rule(entry[0], entry[1])
 
+    def _ev_reshape(self, ev: SoakEvent) -> None:
+        # a dynamic repartition burst on one real node: profile claims
+        # reshape its chips on demand, then reclaim — mid-traffic
+        i = self._reshape_serial[0]
+        self._reshape_serial[0] += 1
+        repartition_burst(
+            self.observer, self.fleet.plugin(ev.target), ev.target,
+            n=ev.param_dict().get("claims", 2), namespace="soak-reshape",
+            prefix=f"reshape-{i}",
+            alloc_timeout=self.config.converge_timeout)
+
     def _ev_cd_cycle(self, ev: SoakEvent) -> None:
         if self.harness is None:
             return
@@ -991,6 +1055,7 @@ class SoakEngine:
         # 2. the full invariant sweep — every boundary, not just the end
         check_no_double_alloc(self.observer)
         check_no_leaked_subslices(self._all_hosts())
+        check_no_residual_shares(self._all_hosts())
         # the grace must cover fleet-scale informer dispatch lag: a
         # claim the traffic created seconds ago may not have reached
         # any controller's informer store yet
@@ -1064,6 +1129,33 @@ class SoakEngine:
         self.sentinels["trace_evictions"].sample(
             evicted - self._last_evicted)
         self._last_evicted = evicted
+        self.sentinels["partition_residue"].sample(
+            self._partition_residue())
+
+    def _partition_residue(self) -> int:
+        """Live partitions no PrepareCompleted entry owns + seats whose
+        owner the checkpoint no longer knows, across every real node —
+        the reshape-storm leak sentinel (the boundary sweep's
+        check_no_leaked_subslices/check_no_residual_shares raise on the
+        same condition; this series documents its flat line)."""
+        from tpu_dra_driver.plugin.checkpoint import PREPARE_COMPLETED
+        residue = 0
+        for h in self._all_hosts():
+            cp = h.tpu_plugin.state.get_checkpoint()
+            owned = {d.canonical_name
+                     for e in cp.claims.values()
+                     if e.state == PREPARE_COMPLETED
+                     for d in e.prepared_devices}
+            residue += sum(
+                1 for s in h.lib.list_subslices()
+                if s.spec_tuple.canonical_name() not in owned)
+            claim_uids = set(cp.claims)
+            for chip in h.lib.enumerate_chips():
+                residue += sum(
+                    1 for share in
+                    h.lib.list_multiprocess_seats(chip.uuid).values()
+                    if share.owner not in claim_uids)
+        return residue
 
     # ------------------------------------------------------------------
     # the final verdict
@@ -1135,16 +1227,21 @@ class SoakEngine:
         cost O(fleet) (PR 11 recorded ~2 claims/s equivalent at 10k
         nodes). Claims are deleted afterwards."""
         cfg = self.config
-        n = cfg.burst_claims
-        if n <= 0 or not self._synthetic:
+        # pin only to synthetic nodes holding NO allocations (residents
+        # occupy a device on theirs — on a shrunken test fleet the burst
+        # would otherwise oversubscribe those pools and park), capped to
+        # the free fleet's capacity
+        held = {pool for pool, _dev in allocated_device_map(self.observer)}
+        free_nodes = [m for m in self._synthetic if m not in held]
+        n = min(cfg.burst_claims,
+                len(free_nodes) * cfg.devices_per_synthetic)
+        if n <= 0 or not free_nodes:
             return {"claims": 0, "wall_s": 0.0, "per_sec": 0.0}
-        # start mid-fleet: canonical pick parks the resident claims on
-        # the canonically-first pools, whose devices may be full
-        base = len(self._synthetic) // 2
+        base = len(free_nodes) // 2
         names = []
         t0 = time.monotonic()
         for i in range(n):
-            node = self._synthetic[(base + i) % len(self._synthetic)]
+            node = free_nodes[(base + i) % len(free_nodes)]
             name = f"burst-{i}"
             self.observer.resource_claims.create({
                 "apiVersion": "resource.k8s.io/v1beta1",

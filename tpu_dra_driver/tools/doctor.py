@@ -206,13 +206,49 @@ def resample_metrics(host_port: str, art: Dict, timeout: float) -> None:
         art["errors"]["metrics_resample"] = f"{type(e).__name__}: {e}"
 
 
+def _read_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — best-effort offline read
+        return None
+
+
+def _checkpoint_owned_devices(obj: Dict) -> Optional[List[str]]:
+    """Canonical device names PrepareCompleted entries own, from a raw
+    checkpoint envelope (v2 preferred, v1 fallback; checksums are NOT
+    verified — the doctor reads what it can). None when no version
+    parses."""
+    for version in ("v2", "v1"):
+        payload = obj.get(version)
+        if not isinstance(payload, dict):
+            continue
+        names: List[str] = []
+        for entry in (payload.get("claims") or {}).values():
+            if not isinstance(entry, dict):
+                continue
+            # v1 records only completed claims (no state field)
+            if entry.get("state", "PrepareCompleted") != "PrepareCompleted":
+                continue
+            for dev in entry.get("preparedDevices") or []:
+                if isinstance(dev, dict) and dev.get("canonicalName"):
+                    names.append(dev["canonicalName"])
+        return names
+    return None
+
+
 def collect_state_dir(path: str) -> Dict:
     """Checkpoint files and quarantined corpses under one plugin state
-    dir (the ``<checkpoint>.corrupt-<n>`` quarantine convention)."""
+    dir (the ``<checkpoint>.corrupt-<n>`` quarantine convention), plus
+    the repartition manager's live-partition manifest
+    (``partitions.json``) cross-checked against checkpoint intent — the
+    offline half of the SUBSLICE_ORPHANS finding."""
     out: Dict = {"path": path, "checkpoints": [], "quarantined": []}
     if not os.path.isdir(path):
         out["error"] = "not a directory"
         return out
+    manifest_partitions: Optional[List[str]] = None
+    owned_devices: Optional[List[str]] = None
     for dirpath, _, files in os.walk(path):
         for name in files:
             full = os.path.join(dirpath, name)
@@ -223,8 +259,29 @@ def collect_state_dir(path: str) -> Dict:
                 size = -1
             if ".corrupt-" in name:
                 out["quarantined"].append({"file": rel, "bytes": size})
-            elif name.endswith((".json", ".chk")) or "checkpoint" in name:
+            elif name == "partitions.json":
+                raw = _read_json(full)
+                if raw is not None:
+                    manifest_partitions = [str(p) for p in
+                                           raw.get("partitions") or []]
+                    out["partitions"] = {
+                        "file": rel,
+                        "updated_unix": raw.get("updated_unix"),
+                        "live": manifest_partitions,
+                    }
                 out["checkpoints"].append({"file": rel, "bytes": size})
+            elif name.endswith((".json", ".chk")) or "checkpoint" in name:
+                if name == "checkpoint.json":
+                    raw = _read_json(full)
+                    if raw is not None:
+                        parsed = _checkpoint_owned_devices(raw)
+                        if parsed is not None:
+                            owned_devices = (owned_devices or []) + parsed
+                out["checkpoints"].append({"file": rel, "bytes": size})
+    if manifest_partitions is not None:
+        owned = set(owned_devices or [])
+        out["subslice_orphans"] = sorted(
+            p for p in manifest_partitions if p not in owned)
     return out
 
 
@@ -459,6 +516,18 @@ def run_findings(bundle: Dict) -> List[Finding]:
                 f"{len(state['quarantined'])} quarantined checkpoint "
                 f"file(s) on disk under {state['path']}",
                 {"files": [q["file"] for q in state["quarantined"]]}))
+        orphans = state.get("subslice_orphans") or []
+        if orphans:
+            findings.append(Finding(
+                WARNING, "SUBSLICE_ORPHANS", name,
+                f"{len(orphans)} live sub-slice partition(s) on the node "
+                f"with no committed claim in the checkpoint "
+                f"({state['path']}): a transient entry can be an "
+                f"in-flight prepare; orphans that persist across bundles "
+                f"mean the crash-recovery reconcile never ran — restart "
+                f"the plugin (its startup sweep tears them down) and "
+                f"check dra_subslice_repartitions_total{{op=\"rollback\"}}",
+                {"partitions": orphans}))
 
     def _dir_bytes(state: Dict) -> int:
         return sum(max(0, f.get("bytes", 0))

@@ -44,10 +44,12 @@ from tpu_dra_driver.tpulib.interface import (
 )
 from tpu_dra_driver.pkg import faultinject as fi
 from tpu_dra_driver.tpulib.partition import (
+    SEAT_COUNT,
     SubsliceLiveTuple,
     SubsliceSpec,
     SubsliceSpecTuple,
     parse_profile_id,
+    seat_core,
 )
 from tpu_dra_driver.tpulib.topology import SliceTopology
 
@@ -59,6 +61,7 @@ from tpu_dra_driver.tpulib.topology import SliceTopology
 for _op_name in ("enumerate_chips", "create_subslice", "destroy_subslice",
                  "set_timeslice", "set_exclusive_mode",
                  "allocate_multiprocess_share", "release_multiprocess_share",
+                 "attach_multiprocess_seat", "detach_multiprocess_seat",
                  "bind_to_vfio", "unbind_from_vfio"):
     fi.register(f"tpulib.{_op_name}",
                 f"FakeTpuLib {_op_name} (fail=TpuLibError-style flap, "
@@ -106,6 +109,10 @@ class _HostState:
     mp_shares: Dict[str, MultiProcessShare] = field(default_factory=dict)
     mp_clients: Dict[str, Dict[int, int]] = field(default_factory=dict)
     mp_next_client: int = 1
+    # multi-owner client seats (claim-per-request serving): chip uuid ->
+    # seat index -> per-claim share; client cid -> owning seat's owner
+    mp_seats: Dict[str, Dict[int, MultiProcessShare]] = field(default_factory=dict)
+    mp_client_owner: Dict[str, Dict[int, str]] = field(default_factory=dict)
 
 
 class FakeTpuLib(TpuLib):
@@ -229,6 +236,16 @@ class FakeTpuLib(TpuLib):
             # occupancy check: any live sub-slice overlapping the core range
             lo = spec.placement_start
             hi = lo + spec.profile.cores
+            # a core hosting multi-process client seats cannot also be
+            # partitioned (the per-core exclusion the counter model and
+            # the repartition placement picker both honor)
+            for seat, share in self._state.mp_seats.get(chip.uuid,
+                                                        {}).items():
+                core = seat_core(seat, chip.cores)
+                if lo <= core < hi:
+                    raise TpuLibError(
+                        f"core {core} of chip {spec.parent_index} carries "
+                        f"multi-process seat {seat} (owner {share.owner})")
             for other in self._state.subslices:
                 if other.parent_index != spec.parent_index:
                     continue
@@ -296,6 +313,10 @@ class FakeTpuLib(TpuLib):
         with self._mu:
             self._op("allocate_multiprocess_share")
             chip = self._assert_chip(chip_uuid)
+            if self._state.mp_seats.get(chip_uuid):
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid} carries per-claim client seats; a "
+                    f"whole-chip share cannot coexist with them")
             existing = self._state.mp_shares.get(chip_uuid)
             if existing is not None:
                 if existing.owner == owner:
@@ -333,14 +354,111 @@ class FakeTpuLib(TpuLib):
         with self._mu:
             return self._state.mp_shares.get(chip_uuid)
 
+    # -- multi-owner client seats (claim-per-request serving) ---------------
+
+    def attach_multiprocess_seat(self, chip_uuid: str, owner: str,
+                                 seat: int,
+                                 hbm_limit_percent: int) -> MultiProcessShare:
+        with self._mu:
+            self._op("attach_multiprocess_seat")
+            chip = self._assert_chip(chip_uuid)
+            if not (0 <= seat < SEAT_COUNT):
+                raise TpuLibError(f"seat {seat} outside [0, {SEAT_COUNT})")
+            if self._state.mp_shares.get(chip_uuid) is not None:
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid} carries a whole-chip share; seats "
+                    f"cannot coexist with it")
+            seats = self._state.mp_seats.setdefault(chip_uuid, {})
+            existing = seats.get(seat)
+            if existing is not None:
+                if existing.owner == owner:
+                    return existing      # idempotent re-prepare
+                raise SharingExhaustedError(
+                    f"seat {seat} on chip {chip_uuid} held by claim "
+                    f"{existing.owner}")
+            total_pct = sum(s.hbm_limit_percent for s in seats.values())
+            if total_pct + hbm_limit_percent > 100:
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid}: aggregate seat HBM "
+                    f"{total_pct + hbm_limit_percent}% exceeds the chip")
+            core = seat_core(seat, chip.cores)
+            for tup in self._state.subslices:
+                if tup.parent_index != chip.index:
+                    continue
+                try:
+                    ocores, _ = parse_profile_id(tup.profile_id)
+                except ValueError as e:
+                    raise TpuLibError(str(e)) from e
+                if tup.placement_start <= core < tup.placement_start + ocores:
+                    # TRANSIENT, not SharingExhausted: the partition will
+                    # be reclaimed (and the republish hides this seat
+                    # meanwhile) — a re-placed claim succeeds without any
+                    # config change
+                    raise TpuLibError(
+                        f"core {core} of chip {chip.index} is partitioned "
+                        f"({tup.canonical_name()}); seat {seat} cannot "
+                        f"attach")
+            share = MultiProcessShare(
+                chip_uuid=chip_uuid, owner=owner, max_clients=1,
+                hbm_limit_percent=hbm_limit_percent,
+                client_hbm_bytes=chip.hbm_bytes * hbm_limit_percent // 100,
+                seat=seat)
+            seats[seat] = share
+            return share
+
+    def detach_multiprocess_seat(self, chip_uuid: str,
+                                 owner: Optional[str] = None,
+                                 seat: Optional[int] = None) -> None:
+        with self._mu:
+            self._op("detach_multiprocess_seat")
+            seats = self._state.mp_seats.get(chip_uuid, {})
+            victims = [k for k, s in seats.items()
+                       if (owner is None or s.owner == owner)
+                       and (seat is None or k == seat)]
+            for k in victims:
+                gone = seats.pop(k)
+                owners = self._state.mp_client_owner.get(chip_uuid, {})
+                for cid in [c for c, o in owners.items()
+                            if o == gone.owner]:
+                    owners.pop(cid, None)
+                    self._state.mp_clients.get(chip_uuid, {}).pop(cid, None)
+            if not seats:
+                self._state.mp_seats.pop(chip_uuid, None)
+
+    def list_multiprocess_seats(self, chip_uuid: str
+                                ) -> Dict[int, MultiProcessShare]:
+        with self._mu:
+            return dict(self._state.mp_seats.get(chip_uuid, {}))
+
     # what the runtime (libtpu) does with the grant — modeled so tests
     # can prove the limits bind (the reference's MPS daemon enforcement,
     # sharing.go:151-436):
 
-    def connect_multiprocess_client(self, chip_uuid: str) -> int:
+    def connect_multiprocess_client(self, chip_uuid: str,
+                                    owner: Optional[str] = None) -> int:
         """A workload process attaches to the shared chip. Fails once
-        max_clients are connected."""
+        max_clients are connected. With ``owner``, the process attaches
+        AS that claim's seat client (SharedChipServing: one client per
+        seat, budgeted by the seat's share)."""
         with self._mu:
+            if owner is not None:
+                seats = self._state.mp_seats.get(chip_uuid, {})
+                share = next((s for s in seats.values()
+                              if s.owner == owner), None)
+                if share is None:
+                    raise TpuLibError(
+                        f"claim {owner} holds no seat on {chip_uuid}")
+                owners = self._state.mp_client_owner.setdefault(
+                    chip_uuid, {})
+                if owner in owners.values():
+                    raise SharingExhaustedError(
+                        f"seat of claim {owner} on {chip_uuid} already "
+                        f"has its client connected")
+                cid = self._state.mp_next_client
+                self._state.mp_next_client += 1
+                self._state.mp_clients.setdefault(chip_uuid, {})[cid] = 0
+                owners[cid] = owner
+                return cid
             share = self._state.mp_shares.get(chip_uuid)
             if share is None:
                 raise TpuLibError(f"chip {chip_uuid} is not shared")
@@ -357,21 +475,32 @@ class FakeTpuLib(TpuLib):
     def disconnect_multiprocess_client(self, chip_uuid: str, cid: int) -> None:
         with self._mu:
             self._state.mp_clients.get(chip_uuid, {}).pop(cid, None)
+            self._state.mp_client_owner.get(chip_uuid, {}).pop(cid, None)
+
+    def _client_budget_locked(self, chip_uuid: str, cid: int) -> Optional[int]:
+        owner = self._state.mp_client_owner.get(chip_uuid, {}).get(cid)
+        if owner is not None:
+            seats = self._state.mp_seats.get(chip_uuid, {})
+            share = next((s for s in seats.values()
+                          if s.owner == owner), None)
+            return None if share is None else share.client_hbm_bytes
+        share = self._state.mp_shares.get(chip_uuid)
+        return None if share is None else share.client_hbm_bytes
 
     def client_allocate_hbm(self, chip_uuid: str, cid: int, nbytes: int) -> None:
         """Model a client's HBM allocation: bounded by its per-client
         budget AND the physical chip (so even conspiring clients cannot
         exceed the hardware)."""
         with self._mu:
-            share = self._state.mp_shares.get(chip_uuid)
+            budget = self._client_budget_locked(chip_uuid, cid)
             clients = self._state.mp_clients.get(chip_uuid, {})
-            if share is None or cid not in clients:
+            if budget is None or cid not in clients:
                 raise TpuLibError(f"client {cid} not connected to {chip_uuid}")
             chip = self._assert_chip(chip_uuid)
-            if clients[cid] + nbytes > share.client_hbm_bytes:
+            if clients[cid] + nbytes > budget:
                 raise SharingExhaustedError(
                     f"client {cid} exceeds its "
-                    f"{share.client_hbm_bytes}-byte HBM budget")
+                    f"{budget}-byte HBM budget")
             if sum(clients.values()) + nbytes > chip.hbm_bytes:
                 raise SharingExhaustedError(
                     f"chip {chip_uuid} HBM exhausted")

@@ -60,6 +60,10 @@ class MultiProcessShare:
     max_clients: int
     hbm_limit_percent: int
     client_hbm_bytes: int
+    #: seat index for the claim-per-request SEAT model (SharedChipServing:
+    #: one share per claim, many claims per chip); -1 for the legacy
+    #: whole-chip single-owner share.
+    seat: int = -1
 
 
 @dataclass(frozen=True)
@@ -202,6 +206,35 @@ class TpuLib(abc.ABC):
 
     @abc.abstractmethod
     def get_multiprocess_share(self, chip_uuid: str) -> Optional[MultiProcessShare]: ...
+
+    # -- multi-owner client seats (claim-per-request serving) ---------------
+
+    @abc.abstractmethod
+    def attach_multiprocess_seat(self, chip_uuid: str, owner: str,
+                                 seat: int,
+                                 hbm_limit_percent: int) -> MultiProcessShare:
+        """Grant ONE client seat on a shared chip to ``owner`` (a claim
+        uid). Unlike :meth:`allocate_multiprocess_share` (one owner whose
+        own processes share the chip), seats admit many owners per chip —
+        the claim-per-request serving model. Raises SharingExhaustedError
+        (permanent) when the seat is held by another owner, the chip
+        carries a legacy whole-chip share, or the aggregate HBM percent
+        would exceed the chip; raises plain TpuLibError (transient —
+        retriable after re-placement) when the seat's core hosts a live
+        sub-slice partition. Idempotent for the same (owner, seat)."""
+
+    @abc.abstractmethod
+    def detach_multiprocess_seat(self, chip_uuid: str,
+                                 owner: Optional[str] = None,
+                                 seat: Optional[int] = None) -> None:
+        """Release seats matching ``owner`` and/or ``seat`` (both None =
+        every seat — the unprepare-sweep shape). No-op when none match;
+        connected clients of a released seat are disconnected."""
+
+    @abc.abstractmethod
+    def list_multiprocess_seats(self, chip_uuid: str
+                                ) -> Dict[int, MultiProcessShare]:
+        """Live seats on the chip, by seat index."""
 
     # -- health -------------------------------------------------------------
 

@@ -468,6 +468,76 @@ class NativeTpuLib(TpuLib):
             hbm_limit_percent=entry["hbm_limit_percent"],
             client_hbm_bytes=entry["client_hbm_bytes"])
 
+    # -- multi-owner client seats (persisted like the whole-chip share:
+    # a crashed plugin's seats survive and unprepare detaches them) --------
+
+    @staticmethod
+    def _seat_share(chip_uuid: str, seat: int, entry: Dict
+                    ) -> MultiProcessShare:
+        return MultiProcessShare(
+            chip_uuid=chip_uuid, owner=entry.get("owner", ""),
+            max_clients=1,
+            hbm_limit_percent=entry["hbm_limit_percent"],
+            client_hbm_bytes=entry["client_hbm_bytes"], seat=seat)
+
+    def attach_multiprocess_seat(self, chip_uuid: str, owner: str,
+                                 seat: int,
+                                 hbm_limit_percent: int) -> MultiProcessShare:
+        from tpu_dra_driver.tpulib.partition import SEAT_COUNT
+        with self._mu:
+            chip = self._assert_chip(chip_uuid)
+            if not (0 <= seat < SEAT_COUNT):
+                raise TpuLibError(f"seat {seat} outside [0, {SEAT_COUNT})")
+            sched = self._load_sched()
+            if sched.get(chip_uuid, {}).get("mp_share") is not None:
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid} carries a whole-chip share; seats "
+                    f"cannot coexist with it")
+            seats = sched.setdefault(chip_uuid, {}).setdefault(
+                "mp_seats", {})
+            existing = seats.get(str(seat))
+            if existing is not None:
+                if existing.get("owner") == owner:
+                    return self._seat_share(chip_uuid, seat, existing)
+                raise SharingExhaustedError(
+                    f"seat {seat} on chip {chip_uuid} held by claim "
+                    f"{existing.get('owner')}")
+            total_pct = sum(e["hbm_limit_percent"] for e in seats.values())
+            if total_pct + hbm_limit_percent > 100:
+                raise SharingExhaustedError(
+                    f"chip {chip_uuid}: aggregate seat HBM "
+                    f"{total_pct + hbm_limit_percent}% exceeds the chip")
+            entry = {"owner": owner,
+                     "hbm_limit_percent": hbm_limit_percent,
+                     "client_hbm_bytes":
+                         chip.hbm_bytes * hbm_limit_percent // 100}
+            seats[str(seat)] = entry
+            self._store_sched(sched)
+            return self._seat_share(chip_uuid, seat, entry)
+
+    def detach_multiprocess_seat(self, chip_uuid: str,
+                                 owner: Optional[str] = None,
+                                 seat: Optional[int] = None) -> None:
+        with self._mu:
+            sched = self._load_sched()
+            seats = sched.get(chip_uuid, {}).get("mp_seats")
+            if not seats:
+                return
+            victims = [k for k, e in seats.items()
+                       if (owner is None or e.get("owner") == owner)
+                       and (seat is None or int(k) == seat)]
+            for k in victims:
+                del seats[k]
+            if not seats:
+                sched[chip_uuid].pop("mp_seats", None)
+            self._store_sched(sched)
+
+    def list_multiprocess_seats(self, chip_uuid: str
+                                ) -> Dict[int, MultiProcessShare]:
+        seats = self._load_sched().get(chip_uuid, {}).get("mp_seats") or {}
+        return {int(k): self._seat_share(chip_uuid, int(k), e)
+                for k, e in seats.items()}
+
     def _assert_chip(self, chip_uuid: str) -> ChipInfo:
         for c in self.enumerate_chips():
             if c.uuid == chip_uuid:

@@ -15,12 +15,20 @@ contiguous run of TensorCores on one chip with a proportional HBM share
 
 - full chip:  ``tpu-<index>``                              (gpu-<minor>)
 - sub-slice:  ``tpu-<index>-ss-<profile>-<start>``         (gpu-…-mig-…)
+- profile slot: ``tpu-<index>-prof-<profile>-<slot>``      (DynamicMIG
+  profile advertising: a *creatable* shape whose placement the kubelet
+  plugin picks at prepare time; ``<slot>`` is an anonymous capacity index,
+  NOT a placement start — the concrete placed identity recorded in the
+  checkpoint is always a ``-ss-`` name, so crash recovery has one parser)
+- shared seat: ``tpu-<index>-mp-<seat>``                   (one multi-process
+  client seat on a shared chip — the claim-per-request serving unit)
 - passthrough: ``tpu-vfio-<index>``                        (gpu-vfio-<idx>)
 
 where ``<profile>`` is ``<cores>c<hbmGiB>g`` (e.g. ``1c47g`` on v5p) and
 ``<start>`` is the first core index of the placement. The name regex is the
 recovery contract: ``parse_canonical_name`` must round-trip every name this
-module can generate (tested in tests/test_partition.py).
+module can generate (tested in tests/test_partition.py and, for the full
+dynamic-picker name space, tests/test_repartition.py).
 """
 
 from __future__ import annotations
@@ -31,12 +39,35 @@ from typing import List, Optional, Union
 
 from tpu_dra_driver.tpulib.topology import GIB, Generation
 
+#: multi-process client seats per shared chip — the claim-per-request
+#: serving unit count. Kept equal to api.configs.MAX_MULTI_PROCESS_CLIENTS
+#: (pinned by tests/test_repartition.py; defined here so the device
+#: library's seat ledger needs no plugin-layer import).
+SEAT_COUNT = 16
+
+
+def seat_core(seat: int, cores: int) -> int:
+    """The core a seat's clients run against. Deterministic — the
+    repartition placement picker, the ResourceSlice counter model, and
+    the device-library seat ledger must all agree on which core a seat
+    occupies."""
+    return seat * cores // SEAT_COUNT
+
+
+def seats_per_core(cores: int) -> int:
+    return SEAT_COUNT // cores
+
+
 PROFILE_ID_RE = re.compile(r"^(?P<cores>[0-9]+)c(?P<hbm>[0-9]+)g$")
 CHIP_NAME_RE = re.compile(r"^tpu-(?P<index>[0-9]+)$")
 SUBSLICE_NAME_RE = re.compile(
     r"^tpu-(?P<index>[0-9]+)-ss-(?P<cores>[0-9]+)c(?P<hbm>[0-9]+)g-(?P<start>[0-9]+)$"
 )
 VFIO_NAME_RE = re.compile(r"^tpu-vfio-(?P<index>[0-9]+)$")
+PROFILE_NAME_RE = re.compile(
+    r"^tpu-(?P<index>[0-9]+)-prof-(?P<cores>[0-9]+)c(?P<hbm>[0-9]+)g-(?P<slot>[0-9]+)$"
+)
+SHARED_NAME_RE = re.compile(r"^tpu-(?P<index>[0-9]+)-mp-(?P<seat>[0-9]+)$")
 
 
 @dataclass(frozen=True)
@@ -138,7 +169,8 @@ class SubsliceLiveTuple:
     devfs_path: str       # device node the container gets
 
 
-ParsedName = Union["ParsedChip", "ParsedSubslice", "ParsedVfio"]
+ParsedName = Union["ParsedChip", "ParsedSubslice", "ParsedVfio",
+                   "ParsedProfile", "ParsedShared"]
 
 
 @dataclass(frozen=True)
@@ -156,12 +188,41 @@ class ParsedVfio:
     index: int
 
 
+@dataclass(frozen=True)
+class ParsedProfile:
+    """An advertised *creatable* profile slot. Carries no placement — the
+    concrete placed sub-slice a claim ends up with is recorded in the
+    checkpoint under its ``-ss-`` canonical name, so this parse result
+    only ever appears for allocation-result names, never for recovery."""
+
+    parent_index: int
+    profile_id: str       # e.g. "1c47g"
+    slot: int             # anonymous capacity index, not a core start
+
+
+@dataclass(frozen=True)
+class ParsedShared:
+    """A multi-process client seat on a shared chip."""
+
+    parent_index: int
+    seat: int
+
+
 def canonical_chip_name(index: int) -> str:
     return f"tpu-{index}"
 
 
 def canonical_vfio_name(index: int) -> str:
     return f"tpu-vfio-{index}"
+
+
+def canonical_profile_name(parent_index: int, profile: SubsliceProfile,
+                           slot: int) -> str:
+    return f"tpu-{parent_index}-prof-{profile.id}-{slot}"
+
+
+def canonical_shared_name(parent_index: int, seat: int) -> str:
+    return f"tpu-{parent_index}-mp-{seat}"
 
 
 def canonical_subslice_name(parent_index: int, profile: SubsliceProfile,
@@ -201,4 +262,12 @@ def parse_canonical_name(name: str) -> Optional[ParsedName]:
     m = VFIO_NAME_RE.match(name)
     if m:
         return ParsedVfio(int(m.group("index")))
+    m = PROFILE_NAME_RE.match(name)
+    if m:
+        profile_id = f"{int(m.group('cores'))}c{int(m.group('hbm'))}g"
+        return ParsedProfile(int(m.group("index")), profile_id,
+                             int(m.group("slot")))
+    m = SHARED_NAME_RE.match(name)
+    if m:
+        return ParsedShared(int(m.group("index")), int(m.group("seat")))
     return None

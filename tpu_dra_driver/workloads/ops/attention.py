@@ -39,6 +39,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax-version compat: pallas renamed TPUCompilerParams -> CompilerParams
+# upstream; accept whichever this jax ships so the kernels (and their
+# interpret-mode CPU tests) run on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+
 NEG_INF = -1e30
 # Softmax runs in base-2 inside the kernels: the VPU has a native pow2,
 # so exp(x) is computed as exp2(x * log2(e)) with the log2(e) folded
@@ -363,7 +370,7 @@ def _compiler_params(semantics=("parallel", "parallel", "parallel",
                                 "arbitrary")):
     # superblock axes carry accumulation state ("arbitrary" = sequential);
     # bh/group/q-block axes are parallel
-    return {"compiler_params": pltpu.CompilerParams(
+    return {"compiler_params": _CompilerParams(
         dimension_semantics=semantics)}
 
 

@@ -51,6 +51,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax-version compat: pallas renamed TPUCompilerParams -> CompilerParams
+# upstream; accept whichever this jax ships so the kernels (and their
+# interpret-mode CPU tests) run on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+
 NEG_INF = -1e30
 
 # minimum cache-block width the TPU lowering can tile; init_kv_cache pads
@@ -210,7 +217,7 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h_kv, rep, hd), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )(jnp.atleast_1d(pos).astype(jnp.int32), *args)
     return out.reshape(b, h, 1, hd)

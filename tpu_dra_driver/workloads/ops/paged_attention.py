@@ -40,6 +40,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax-version compat: pallas renamed TPUCompilerParams -> CompilerParams
+# upstream; accept whichever this jax ships so the kernels (and their
+# interpret-mode CPU tests) run on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+
 NEG_INF = -1e30
 
 
@@ -228,7 +235,7 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h_kv, rep, hd), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )(table.astype(jnp.int32), lens.astype(jnp.int32), qf,
       pool_k, pool_v)
