@@ -1231,6 +1231,9 @@ DRILLED_POINTS = [
     "checkpoint.fsync",
     "checkpoint.write.torn",
     "checkpoint.read",
+    # journal checkpoint (tests/test_journal.py kill-drills)
+    "journal.append",
+    "journal.compact",
     "tpulib.create_subslice",
     "tpulib.enumerate_chips",
     "tpulib.health_event",
@@ -1276,8 +1279,8 @@ def test_drill_matrix_covers_at_least_twelve_registered_points():
     # points is acceptable; the core driver boundaries must all be hit).
     # Only production namespaces count — unit tests register scratch
     # points (p.*) that are not part of the matrix.
-    prod = ("rest.", "informer.", "checkpoint.", "plugin.", "cd.",
-            "grpc.", "daemon.", "tpulib.", "allocator.", "catalog.",
+    prod = ("rest.", "informer.", "checkpoint.", "journal.", "plugin.",
+            "cd.", "grpc.", "daemon.", "tpulib.", "allocator.", "catalog.",
             "resourceslice.", "repartition.")
     gap = [p for p in drill_catalog_coverage(DRILLED_POINTS)
            if p.startswith(prod)]

@@ -1,0 +1,512 @@
+"""Journal checkpoint + group commit: crash drills and format parity.
+
+The append-only journal (``JournalCheckpoint`` gate) moves the prepare
+path's durability from two full-file fsync'd rewrites per batch to
+appended CRC-framed records coalesced across batches. These tests pin
+the claims that make that safe:
+
+- every crash boundary (append torn-tail, mid-compaction, the
+  compact-rename/truncate window) recovers to the same claim set, and
+  recovery is idempotent under re-crash;
+- recovery's compacted base is byte-identical to what the rewrite-format
+  manager persists for the same claims (format migration is a no-op);
+- group commit really coalesces: N concurrent batches, one journal
+  fsync.
+"""
+
+import json
+import os
+import re
+import threading
+
+import pytest
+
+from tpu_dra_driver.pkg import faultinject as fi
+from tpu_dra_driver.pkg import featuregates as fg
+from tpu_dra_driver.pkg.metrics import (
+    CDI_SPECS_RESTORED,
+    CHECKPOINT_FSYNCS,
+    CHECKPOINT_QUARANTINED,
+)
+from tpu_dra_driver.plugin.checkpoint import (
+    JOURNAL_OP_DEL,
+    JOURNAL_OP_PUT,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+    ClaimEntry,
+    GroupCommitWriter,
+    JournalCheckpointManager,
+    JournalDecodeError,
+    JournalRecord,
+    PreparedDevice,
+    decode_journal_record,
+    encode_journal_record,
+    fold_journal_into_base,
+    scan_journal,
+)
+from tpu_dra_driver.plugin.claims import build_allocated_claim
+from tpu_dra_driver.testing.harness import PluginCrashDrill
+
+NODE = "journal-node"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _gates():
+    g = fg.FeatureGates()
+    g.set(fg.JOURNAL_CHECKPOINT, True)
+    return g
+
+
+def _claims(n=2, prefix="u"):
+    return [build_allocated_claim(f"{prefix}{i}", f"claim-{prefix}{i}",
+                                  "user-ns", [f"tpu-{i}"], NODE)
+            for i in range(n)]
+
+
+def _entry(uid, state=PREPARE_COMPLETED, dev="tpu-0"):
+    return ClaimEntry(
+        claim_uid=uid, claim_name=f"claim-{uid}", namespace="ns",
+        state=state,
+        prepared_devices=[] if state == PREPARE_STARTED else [
+            PreparedDevice(canonical_name=dev, request="r",
+                           cdi_device_ids=[f"tpu.google.com/device={dev}"],
+                           device_type="chip", devfs_path="/dev/accel0",
+                           pool=NODE)])
+
+
+def _fsyncs(target):
+    return CHECKPOINT_FSYNCS.labels(target).value
+
+
+# ---------------------------------------------------------------------------
+# record framing
+# ---------------------------------------------------------------------------
+
+
+def test_record_roundtrip_and_crc_rejects_damage():
+    rec = JournalRecord(gen=3, seq=7, op=JOURNAL_OP_PUT, uid="u1",
+                        entry=_entry("u1").to_obj())
+    line = encode_journal_record(rec)
+    assert line.endswith("\n")
+    back = decode_journal_record(line)
+    assert (back.gen, back.seq, back.op, back.uid) == (3, 7, "put", "u1")
+    assert back.entry == rec.entry
+    # CRC catches any body mutation
+    with pytest.raises(JournalDecodeError):
+        decode_journal_record(line.replace('"seq": 7', '"seq": 8'))
+    # a record without its newline is BY DEFINITION torn (the frame is
+    # the line)
+    with pytest.raises(JournalDecodeError):
+        decode_journal_record(line[:-1])
+
+
+def test_scan_journal_stops_at_first_bad_record(tmp_path):
+    p = str(tmp_path / "j")
+    good = [encode_journal_record(
+        JournalRecord(gen=1, seq=i, op=JOURNAL_OP_DEL, uid=f"u{i}"))
+        for i in range(3)]
+    with open(p, "w") as f:
+        f.write(good[0] + good[1] + good[2][: len(good[2]) // 2])
+    records, good_bytes, bad_index = scan_journal(p)
+    assert [r.uid for r in records] == ["u0", "u1"]
+    assert good_bytes == len(good[0]) + len(good[1])
+    assert bad_index == 2
+
+
+# ---------------------------------------------------------------------------
+# crash drills: the append boundary (plugin-level, gate on)
+# ---------------------------------------------------------------------------
+
+
+def test_drill_journal_append_crash_before_durable(tmp_path):
+    """Die before the write-ahead records hit disk: the batch fails, the
+    committer was never acked, and recovery owes it nothing."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE, gates=_gates())
+    plugin = drill.start()
+    claims = _claims(2)
+    rule = fi.arm("journal.append", fi.Rule(mode="crash", nth=1))
+    res = plugin.prepare_resource_claims(claims)
+    assert rule.fires == 1
+    assert all(r.error is not None for r in res.values())
+    fi.disarm("journal.append")
+    drill.restart()
+    drill.assert_recovered(claims)
+
+
+def test_drill_journal_append_torn_tail_truncate_and_forget(tmp_path):
+    """Power cut mid-append: half the commit record reaches disk. The
+    torn tail is truncated silently on restart — NOT quarantined (the
+    committer's batch already saw the append fail) — and the claim rolls
+    back to PrepareStarted for a clean re-prepare."""
+    q0 = CHECKPOINT_QUARANTINED.value
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE, gates=_gates())
+    plugin = drill.start()
+    claims = _claims(1)
+    # nth=2: let the write-ahead append through intact, tear the COMMIT
+    rule = fi.arm("journal.append", fi.Rule(
+        mode="corrupt", mutate=fi.torn_tail_corruptor, nth=2))
+    res = plugin.prepare_resource_claims(claims)
+    assert rule.calls == 2 and rule.fires == 1
+    # the fsync 'succeeded' in-process; the tear models what disk kept
+    assert res["u0"].error is None
+    jpath = plugin.state._jcp_mgr.journal_path
+    records, _, bad_index = scan_journal(jpath)
+    assert bad_index is not None, "the torn commit record must scan bad"
+    assert [r.uid for r in records] == ["u0"]      # intact write-ahead
+    assert records[0].entry["state"] == PREPARE_STARTED
+    fi.disarm("journal.append")
+    drill.restart()
+    # recovery truncated the tail: no quarantine corpse, no counter bump
+    assert CHECKPOINT_QUARANTINED.value == q0
+    assert not [n for n in os.listdir(str(tmp_path / "drill-plugin"))
+                if ".corrupt-" in n]
+    cp = drill.plugin.state.get_checkpoint()
+    assert cp.claims["u0"].state == PREPARE_STARTED
+    drill.assert_recovered(claims)
+
+
+def test_drill_journal_append_enospc_fails_batch_not_process(tmp_path):
+    """A failed append (ENOSPC) errors the in-flight batch; the writer
+    thread survives and the next batch retries cleanly — no restart."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE, gates=_gates())
+    plugin = drill.start()
+    claims = _claims(2)
+    fi.arm("journal.append", fi.Rule(mode="fail", nth=1))
+    res = plugin.prepare_resource_claims(claims)
+    assert all(r.error is not None for r in res.values())
+    fi.disarm("journal.append")
+    res = plugin.prepare_resource_claims(claims)
+    assert all(r.error is None for r in res.values())
+    assert all(e.state == PREPARE_COMPLETED for e in
+               plugin.state.get_checkpoint().claims.values())
+    drill.crash()
+
+
+def test_drill_journal_mid_file_corruption_quarantines(tmp_path):
+    """Damage BEFORE intact records cannot be a torn append — recovery
+    quarantines the journal for postmortem and replays the intact
+    prefix only."""
+    q0 = CHECKPOINT_QUARANTINED.value
+    d = str(tmp_path)
+    mgr = JournalCheckpointManager(d)
+    mgr.recover()
+    mgr.append([(JOURNAL_OP_PUT, "u1", _entry("u1").to_obj())])
+    mgr.append([(JOURNAL_OP_PUT, "u2", _entry("u2", dev="tpu-1").to_obj())])
+    mgr.close()
+    with open(mgr.journal_path, "r+") as f:
+        body = f.read()
+        f.seek(body.index("u1"))
+        f.write("XX")                     # mangle record 1, record 2 intact
+    mgr2 = JournalCheckpointManager(d)
+    cp = mgr2.recover()
+    mgr2.close()
+    assert CHECKPOINT_QUARANTINED.value == q0 + 1
+    assert [n for n in os.listdir(d) if n.startswith("checkpoint.journal"
+                                                     ".corrupt-")]
+    # intact prefix = nothing before the damage; u2 sits AFTER the
+    # mangled record and is deliberately dropped (causal completeness)
+    assert set(cp.claims) == set()
+
+
+# ---------------------------------------------------------------------------
+# crash drills: compaction boundaries (manager-level)
+# ---------------------------------------------------------------------------
+
+
+def _seeded_dir(tmp_path):
+    """A state dir with base gen 1 (empty) + a 2-record gen-1 journal."""
+    d = str(tmp_path)
+    mgr = JournalCheckpointManager(d)
+    mgr.recover()
+    mgr.append([(JOURNAL_OP_PUT, "u1", _entry("u1").to_obj())])
+    mgr.append([(JOURNAL_OP_PUT, "u2", _entry("u2", dev="tpu-1").to_obj())])
+    mgr.close()
+    return d
+
+
+def test_drill_mid_compaction_crash_is_idempotent(tmp_path):
+    """Die between the fsync'd compacted tmp and its rename (inside
+    recovery's own compact): the old base and the full journal are both
+    still live, so recovery — even after re-crashing — converges to the
+    same claim set."""
+    d = _seeded_dir(tmp_path)
+    for _ in range(2):                       # crash, then re-crash
+        fi.arm("checkpoint.write.torn", fi.Rule(mode="crash", nth=1))
+        mgr = JournalCheckpointManager(d)
+        with pytest.raises(fi.CrashInjected):
+            mgr.recover()
+        mgr.close()
+        fi.disarm("checkpoint.write.torn")
+        # the journal was never truncated; the base never advanced
+        records, _, bad = scan_journal(os.path.join(d, "checkpoint.journal"))
+        assert bad is None and [r.uid for r in records] == ["u1", "u2"]
+    mgr = JournalCheckpointManager(d)
+    cp = mgr.recover()
+    mgr.close()
+    assert set(cp.claims) == {"u1", "u2"}
+    assert cp.claims["u1"].state == PREPARE_COMPLETED
+
+
+def test_drill_compact_rename_to_truncate_window(tmp_path):
+    """Die AFTER the compacted base (gen+1) lands but BEFORE the journal
+    truncate: the journal is full of now-stale generation records, and
+    replay must skip every one instead of double-applying them."""
+    d = _seeded_dir(tmp_path)
+    fi.arm("journal.compact", fi.Rule(mode="crash", nth=1))
+    mgr = JournalCheckpointManager(d)
+    with pytest.raises(fi.CrashInjected):
+        mgr.recover()
+    mgr.close()
+    fi.disarm("journal.compact")
+    # new base landed with the claims folded in; stale journal remains
+    raw = json.load(open(os.path.join(d, "checkpoint.json")))
+    assert set(raw["v2"]["claims"]) == {"u1", "u2"}
+    base_gen = raw["journal"]["gen"]
+    records, _, _ = scan_journal(os.path.join(d, "checkpoint.journal"))
+    assert records and all(r.gen < base_gen for r in records)
+    # re-crash in the same window: still converges
+    fi.arm("journal.compact", fi.Rule(mode="crash", nth=1))
+    mgr = JournalCheckpointManager(d)
+    with pytest.raises(fi.CrashInjected):
+        mgr.recover()
+    mgr.close()
+    fi.disarm("journal.compact")
+    mgr = JournalCheckpointManager(d)
+    cp = mgr.recover()
+    assert set(cp.claims) == {"u1", "u2"}
+    # steady state: empty journal, claims exactly once
+    assert scan_journal(mgr.journal_path)[0] == []
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# format parity + migration
+# ---------------------------------------------------------------------------
+
+
+def _intent_checkpoint():
+    cp = Checkpoint()
+    cp.claims["u1"] = _entry("u1")
+    cp.claims["u2"] = _entry("u2", state=PREPARE_STARTED)
+    return cp
+
+
+def test_journal_recovery_base_byte_identical_to_rewrite_format(tmp_path):
+    """Same claim history, both formats: the journal recovery's
+    compacted base must match the rewrite manager's file byte for byte
+    once the (checksum-exempt) journal-generation line is removed."""
+    ja = str(tmp_path / "a")
+    os.makedirs(ja)
+    mgr = JournalCheckpointManager(ja)
+    mgr.recover()
+    mgr.append([(JOURNAL_OP_PUT, "u1", _entry("u1").to_obj())])
+    mgr.append([(JOURNAL_OP_PUT, "gone", _entry("gone").to_obj())])
+    mgr.append([(JOURNAL_OP_PUT, "u2",
+                 _entry("u2", state=PREPARE_STARTED).to_obj())])
+    mgr.append([(JOURNAL_OP_DEL, "gone", None)])
+    mgr.close()
+    mgr = JournalCheckpointManager(ja)
+    mgr.recover()                            # compacts the replayed state
+    mgr.close()
+    rb = str(tmp_path / "b")
+    os.makedirs(rb)
+    CheckpointManager(rb).write(_intent_checkpoint())
+    a = open(os.path.join(ja, "checkpoint.json")).read()
+    b = open(os.path.join(rb, "checkpoint.json")).read()
+    a_stripped = re.sub(r'"journal": \{"gen": \d+\},\n', "", a, count=1)
+    assert a_stripped == b
+    assert a != a_stripped, "journal base must carry its generation line"
+
+
+def test_fold_journal_into_base_on_downgrade(tmp_path):
+    """Gate turned off after running journaled: the journal folds into
+    one healthy checkpoint.json any pre-journal reader understands."""
+    d = str(tmp_path)
+    mgr = JournalCheckpointManager(d)
+    mgr.recover()
+    mgr.append([(JOURNAL_OP_PUT, "u1", _entry("u1").to_obj())])
+    mgr.close()
+    assert fold_journal_into_base(d) is True
+    assert not os.path.exists(os.path.join(d, "checkpoint.journal"))
+    cp = CheckpointManager(d).read()
+    assert set(cp.claims) == {"u1"}
+    assert fold_journal_into_base(d) is False       # idempotent
+
+
+def test_journal_mode_reads_plain_rewrite_base(tmp_path):
+    """Upgrade path: a pre-journal checkpoint.json (no journal line,
+    gen 0) recovers cleanly under the journal manager."""
+    d = str(tmp_path)
+    CheckpointManager(d).write(_intent_checkpoint())
+    mgr = JournalCheckpointManager(d)
+    cp = mgr.recover()
+    mgr.close()
+    assert set(cp.claims) == {"u1", "u2"}
+    assert mgr.generation >= 1
+
+
+# ---------------------------------------------------------------------------
+# group commit
+# ---------------------------------------------------------------------------
+
+
+def test_group_commit_coalesces_concurrent_batches(tmp_path):
+    """Four committers enqueue while the writer is held: one fsync
+    makes all four durable (the whole point of the journal)."""
+    mgr = JournalCheckpointManager(str(tmp_path))
+    cp = mgr.recover()
+    w = GroupCommitWriter(mgr, snapshot=lambda: cp)
+    j0 = _fsyncs("journal")
+    w.hold()
+    tickets = []
+    for i in range(4):
+        w.batch_begin()
+        tickets.append(w.enqueue(
+            [(JOURNAL_OP_PUT, f"u{i}", _entry(f"u{i}").to_obj())]))
+    w.release()
+    for t in tickets:
+        t.wait(10.0)
+    for _ in range(4):
+        w.batch_end()
+    assert _fsyncs("journal") - j0 == 1
+    records, _, bad = scan_journal(mgr.journal_path)
+    assert bad is None
+    assert {r.uid for r in records} == {"u0", "u1", "u2", "u3"}
+    # FIFO: journal order is enqueue order
+    assert [r.seq for r in records] == sorted(r.seq for r in records)
+    w.stop()
+    mgr.close()
+
+
+def test_group_commit_error_reaches_every_rider(tmp_path):
+    mgr = JournalCheckpointManager(str(tmp_path))
+    cp = mgr.recover()
+    w = GroupCommitWriter(mgr, snapshot=lambda: cp)
+    fi.arm("journal.append", fi.Rule(mode="fail", nth=1))
+    w.hold()
+    w.batch_begin()
+    w.batch_begin()
+    t1 = w.enqueue([(JOURNAL_OP_PUT, "a", _entry("a").to_obj())])
+    t2 = w.enqueue([(JOURNAL_OP_PUT, "b", _entry("b").to_obj())])
+    w.release()
+    for t in (t1, t2):
+        with pytest.raises(fi.FaultInjected):
+            t.wait(10.0)
+    w.batch_end()
+    w.batch_end()
+    w.stop()
+    mgr.close()
+
+
+def test_concurrent_plugin_prepares_share_fsyncs(tmp_path):
+    """End-to-end: N concurrent kubelet batches through the journaled
+    plugin cost far fewer than the rewrite mode's 2 fsyncs per batch."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE, gates=_gates())
+    plugin = drill.start()
+    batches = [[build_allocated_claim(f"b{i}", f"claim-b{i}", "user-ns",
+                                      [f"tpu-{i}"], NODE)]
+               for i in range(4)]
+    j0 = _fsyncs("journal")
+    errs = []
+
+    def run(b):
+        res = plugin.prepare_resource_claims(b)
+        errs.extend(r.error for r in res.values() if r.error is not None)
+
+    threads = [threading.Thread(target=run, args=(b,)) for b in batches]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    spent = _fsyncs("journal") - j0
+    # 4 rewrite-mode batches would pay 8 full-file fsyncs; the journal
+    # pays at most 2 per batch worst-case (zero coalescing) and far
+    # fewer when batches overlap — assert the hard ceiling here, the
+    # coalescing ratio is asserted by the held-writer test above
+    assert 2 <= spent <= 8
+    cp = plugin.state.get_checkpoint()
+    assert len(cp.claims) == 4
+    assert all(e.state == PREPARE_COMPLETED for e in cp.claims.values())
+    drill.crash()
+
+def test_crash_restores_cdi_spec_from_journal_record(tmp_path):
+    """Journal mode writes CDI spec files WITHOUT their own fsync (the
+    rendered body rides the fsynced journal record). A crash that loses
+    the spec file — the window the deferred durability opens — must be
+    healed at recovery by rewriting the file from the checkpoint entry,
+    byte-identical to what the prepare wrote."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE, gates=_gates())
+    plugin = drill.start()
+    claim = build_allocated_claim("s1", "claim-s1", "user-ns",
+                                  ["tpu-0"], NODE)
+    res = plugin.prepare_resource_claims([claim])
+    assert res["s1"].error is None
+    cdi = plugin.state._cdi
+    spec_path = cdi.claim_spec_path("s1")
+    with open(spec_path) as f:
+        written = f.read()
+    entry = plugin.state.get_checkpoint().claims["s1"]
+    assert entry.cdi_spec == written  # the record carries the exact body
+
+    drill.crash()
+    os.remove(spec_path)  # power loss before the page cache flushed
+    restored0 = CDI_SPECS_RESTORED.value
+    plugin = drill.start()
+    with open(spec_path) as f:
+        assert f.read() == written
+    assert CDI_SPECS_RESTORED.value == restored0 + 1
+
+    # torn variant: a divergent (half-written) spec is also healed
+    drill.crash()
+    with open(spec_path, "w") as f:
+        f.write(written[:len(written) // 2])
+    plugin = drill.start()
+    with open(spec_path) as f:
+        assert f.read() == written
+    assert CDI_SPECS_RESTORED.value == restored0 + 2
+
+    # intact spec on a clean restart is left alone (no rewrite churn)
+    plugin = drill.restart()
+    assert CDI_SPECS_RESTORED.value == restored0 + 2
+    drill.assert_recovered([claim])
+    assert not os.path.exists(spec_path)  # unprepare removed it
+    drill.crash()
+
+
+def test_rewrite_mode_keeps_per_spec_fsync_and_no_body_in_entry(tmp_path):
+    """The rewrite format's contract is unchanged: spec files carry
+    their own durability (fsync before rename) and entries do not grow
+    a cdiSpec payload."""
+    drill = PluginCrashDrill(str(tmp_path), node_name=NODE,
+                             gates=fg.FeatureGates())
+    plugin = drill.start()
+    claim = build_allocated_claim("r1", "claim-r1", "user-ns",
+                                  ["tpu-0"], NODE)
+    fsyncs = []
+    real_fsync = os.fsync
+
+    def counting_fsync(fd):
+        fsyncs.append(fd)
+        return real_fsync(fd)
+
+    try:
+        os.fsync = counting_fsync
+        res = plugin.prepare_resource_claims([claim])
+    finally:
+        os.fsync = real_fsync
+    assert res["r1"].error is None
+    entry = plugin.state.get_checkpoint().claims["r1"]
+    assert entry.cdi_spec == ""
+    assert "cdiSpec" not in json.dumps(entry.to_obj())
+    # 2 checkpoint writes (file+dir each) + the CDI spec file = at least 5
+    assert len(fsyncs) >= 5
+    drill.crash()

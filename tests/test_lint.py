@@ -423,6 +423,14 @@ _SLO_EXEMPT = {
         "inside NodePrepareResources) already covered by the per-claim "
         "prepare SLO; it exists so the bench's reshape p50/p99 and the "
         "repartition-storm scenario regressions are scrapeable",
+    "dra_journal_append_seconds":
+        "the group-commit fsync wait inside the prepare path, already "
+        "covered by the per-claim prepare SLO; it exists so the bench "
+        "can attribute the fsync tax separately from actuation",
+    "dra_journal_compaction_seconds":
+        "background maintenance off the claim-to-ready journey (the "
+        "writer thread compacts after acking tickets); surfaced through "
+        "the tpu-dra-doctor JOURNAL_BLOAT finding rather than an SLO",
 }
 
 

@@ -25,12 +25,16 @@ Prepare hot path allocation-free).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+from tpu_dra_driver.pkg import metrics as _metrics
 
 CDI_VERSION = "0.6.0"
 DEFAULT_CDI_ROOT = "/var/run/cdi"
@@ -172,6 +176,13 @@ class CdiHandler:
         self._ttl = common_edits_ttl
         self._mu = threading.Lock()
         self._common_cache: Optional[tuple[float, ContainerEdits]] = None
+        # content-keyed render cache: claims with the same device SHAPE
+        # (device set + edits + merged common edits) differ only by the
+        # claim UID woven into device names, so the rendered JSON is
+        # cached once as a UID-placeholder template and re-stamped per
+        # claim — identical shapes skip serialization entirely
+        self._render_cache: OrderedDict[str, str] = OrderedDict()
+        self._render_cache_max = 256
 
     # -- common edits -------------------------------------------------------
 
@@ -209,6 +220,9 @@ class CdiHandler:
     def invalidate_cache(self) -> None:
         with self._mu:
             self._common_cache = None
+            # common edits feed every rendered claim spec: a stale
+            # template must not outlive the inputs it rendered from
+            self._render_cache.clear()
 
     # -- claim specs --------------------------------------------------------
 
@@ -223,22 +237,93 @@ class CdiHandler:
                          extra_common: Optional[ContainerEdits] = None) -> List[str]:
         """Write the per-claim transient spec atomically; returns the
         qualified CDI ids kubelet passes to the runtime."""
+        body, qualified = self.render_claim_spec(claim_uid, devices,
+                                                 extra_common=extra_common)
+        self.write_claim_spec_body(claim_uid, body)
+        return qualified
+
+    def render_claim_spec(self, claim_uid: str, devices: List[CdiDevice],
+                          extra_common: Optional[ContainerEdits] = None):
+        """Render (via the shape-keyed cache) without touching disk;
+        returns ``(body, qualified_ids)`` so a caller can choose its own
+        durability contract for the file write."""
         common = self.get_common_edits()
         if extra_common is not None:
             common = common.merge(extra_common)
-        devices = [CdiDevice(name=d.name, edits=d.edits, kind=self.kind)
-                   for d in devices]
-        spec = CdiSpec(devices=devices, common_edits=common, kind=self.kind)
+        body = self._render_body(claim_uid, devices, common)
+        return body, [f"{self.kind}={d.name}" for d in devices]
+
+    def write_claim_spec_body(self, claim_uid: str, body: str,
+                              durable: bool = True) -> None:
+        """Atomic (tmp + rename) spec-file write. ``durable=False`` skips
+        the per-file fsync — only valid when the caller persists ``body``
+        through its own fsynced store (the journal checkpoint) and
+        restores the file from it on recovery, so the spec survives power
+        loss without paying a per-claim fsync on the prepare path."""
         os.makedirs(self._cdi_root, exist_ok=True)
         path = self.claim_spec_path(claim_uid)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump(spec.to_obj(), f, indent=2, sort_keys=True)
-            f.write("\n")
+            f.write(body)
             f.flush()
-            os.fsync(f.fileno())
+            if durable:
+                os.fsync(f.fileno())
         os.replace(tmp, path)
-        return [d.qualified_name for d in devices]
+
+    def restore_claim_spec(self, claim_uid: str, body: str) -> bool:
+        """Recovery-side companion of the non-durable write: if the
+        on-disk spec file is missing or diverges from the checkpointed
+        body (torn by power loss before the page cache flushed), rewrite
+        it durably. Returns True when a rewrite happened."""
+        try:
+            with open(self.claim_spec_path(claim_uid)) as f:
+                if f.read() == body:
+                    return False
+        except OSError:
+            pass
+        self.write_claim_spec_body(claim_uid, body, durable=True)
+        _metrics.CDI_SPECS_RESTORED.inc()
+        return True
+
+    #: placeholder the render cache stores instead of the claim UID (a
+    #: template is shape-keyed, so it must be UID-free to be reusable)
+    _UID_TOKEN = "__CLAIM_UID__"
+
+    def _render_body(self, claim_uid: str, devices: List[CdiDevice],
+                     common: ContainerEdits) -> str:
+        """Serialize the claim spec, via the content-keyed render cache:
+        the key digests (device set, per-device edits, merged common
+        edits) with the claim UID normalized out, so identical shapes —
+        e.g. a serving tier preparing hundreds of one-seat claims —
+        reuse one rendered template and pay only a UID re-stamp."""
+        shape = json.dumps({
+            "devices": [{"name": d.name.replace(claim_uid, self._UID_TOKEN),
+                         "edits": d.edits.to_obj()} for d in devices],
+            "common": common.to_obj(),
+            "kind": self.kind,
+        }, sort_keys=True)
+        key = hashlib.sha256(shape.encode()).hexdigest()
+        with self._mu:
+            template = self._render_cache.get(key)
+            if template is not None:
+                self._render_cache.move_to_end(key)
+        if template is None:
+            _metrics.CDI_RENDER_CACHE_MISSES.inc()
+            spec = CdiSpec(
+                devices=[CdiDevice(name=d.name, edits=d.edits,
+                                   kind=self.kind) for d in devices],
+                common_edits=common, kind=self.kind)
+            rendered = json.dumps(spec.to_obj(), indent=2,
+                                  sort_keys=True) + "\n"
+            template = rendered.replace(claim_uid, self._UID_TOKEN)
+            with self._mu:
+                self._render_cache[key] = template
+                self._render_cache.move_to_end(key)
+                while len(self._render_cache) > self._render_cache_max:
+                    self._render_cache.popitem(last=False)
+        else:
+            _metrics.CDI_RENDER_CACHE_HITS.inc()
+        return template.replace(self._UID_TOKEN, claim_uid)
 
     def delete_claim_spec(self, claim_uid: str) -> None:
         try:

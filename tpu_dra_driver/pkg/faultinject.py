@@ -408,6 +408,19 @@ def arm_from_env(environ=None) -> int:
     return len(rules)
 
 
+def torn_tail_corruptor(payload):
+    """Drop the second half of a payload: models an append that tore
+    mid-write (power cut with a partial final record on disk). Unlike
+    :func:`default_corruptor` (which flips bytes — CRC damage anywhere),
+    this produces exactly the torn-tail shape journal recovery must
+    truncate-and-forget."""
+    if isinstance(payload, bytes):
+        return payload[: max(1, len(payload) // 2)]
+    if isinstance(payload, str):
+        return payload[: max(1, len(payload) // 2)]
+    return payload
+
+
 def default_corruptor(payload):
     """Generic payload mangler: good enough to break any checksum."""
     if isinstance(payload, bytes):

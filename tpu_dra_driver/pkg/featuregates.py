@@ -62,6 +62,13 @@ DYNAMIC_REPARTITION = "DynamicRepartition"
 #: seats and partitions is enforced dynamically by the repartition state
 #: machine and the KEP-4815 counter model, not by a static gate conflict.
 SHARED_CHIP_SERVING = "SharedChipServing"
+#: persist prepared-claim state as an append-only CRC-framed journal over
+#: a compacted base instead of rewriting the whole checkpoint file per
+#: transition, with a single group-commit writer thread coalescing fsyncs
+#: across concurrent NodePrepareResources batches. Off = the rewrite
+#: (dual-version envelope) format; the two formats migrate in both
+#: directions at manager construction, so the gate can flip per restart.
+JOURNAL_CHECKPOINT = "JournalCheckpoint"
 
 _SPECS: tuple[FeatureSpec, ...] = (
     FeatureSpec(TIME_SLICING_SETTINGS, False, Stage.ALPHA),
@@ -74,6 +81,7 @@ _SPECS: tuple[FeatureSpec, ...] = (
     FeatureSpec(CRASH_ON_ICI_FABRIC_ERRORS, True, Stage.BETA),
     FeatureSpec(DYNAMIC_REPARTITION, False, Stage.ALPHA),
     FeatureSpec(SHARED_CHIP_SERVING, False, Stage.ALPHA),
+    FeatureSpec(JOURNAL_CHECKPOINT, False, Stage.ALPHA),
 )
 
 # Mutual exclusions (reference featuregates.go:170-189): dynamic

@@ -484,6 +484,42 @@ CEL_COMPILE_CACHE_EVICTIONS = DEFAULT_REGISTRY.counter(
 CHECKPOINT_WRITES = DEFAULT_REGISTRY.counter(
     "dra_checkpoint_writes_total",
     "Checkpoint file writes; each is one fsync-bearing atomic replace")
+CHECKPOINT_FSYNCS = DEFAULT_REGISTRY.counter(
+    "dra_checkpoint_fsyncs_total",
+    "fsync(2) calls issued by checkpoint persistence, by target: "
+    "file=checkpoint tmp file, dir=state directory after an atomic "
+    "rename (rename durability), journal=append-only journal group "
+    "commit",
+    ("target",))
+JOURNAL_APPEND_SECONDS = DEFAULT_REGISTRY.histogram(
+    "dra_journal_append_seconds",
+    "Wall time a committer waits for its journal records to become "
+    "durable (enqueue to group-commit fsync completion)")
+JOURNAL_GROUP_COMMIT_RECORDS = DEFAULT_REGISTRY.histogram(
+    "dra_journal_group_commit_records",
+    "Records coalesced into one journal fsync by the group-commit "
+    "writer (batch size 1 = no cross-batch coalescing happened)")
+JOURNAL_COMPACTION_SECONDS = DEFAULT_REGISTRY.histogram(
+    "dra_journal_compaction_seconds",
+    "Journal compaction duration (rewrite base atomically + truncate "
+    "journal)")
+JOURNAL_RECORDS = DEFAULT_REGISTRY.gauge(
+    "dra_journal_records",
+    "Records currently in the append-only checkpoint journal since "
+    "the last compaction")
+CDI_RENDER_CACHE_HITS = DEFAULT_REGISTRY.counter(
+    "dra_cdi_render_cache_hits_total",
+    "Claim CDI spec renders served from the content-keyed render "
+    "cache (identical device shape re-used a prior render)")
+CDI_RENDER_CACHE_MISSES = DEFAULT_REGISTRY.counter(
+    "dra_cdi_render_cache_misses_total",
+    "Claim CDI spec renders that actually built the spec object "
+    "(first sighting of this device shape, or cache invalidated)")
+CDI_SPECS_RESTORED = DEFAULT_REGISTRY.counter(
+    "dra_cdi_specs_restored_total",
+    "Claim CDI spec files rewritten at recovery from the checkpointed "
+    "body (file missing or torn; journal mode defers the per-spec "
+    "fsync to the group-committed journal record)")
 PREPARE_BATCH_PHASE_SECONDS = DEFAULT_REGISTRY.histogram(
     "dra_prepare_batch_phase_seconds",
     "Group-commit prepare wall time by phase for one kubelet batch",

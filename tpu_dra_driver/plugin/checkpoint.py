@@ -26,6 +26,8 @@ import copy
 import json
 import logging
 import os
+import threading
+import time as _time
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -48,6 +50,16 @@ fi.register("checkpoint.write.torn",
             "between the fsync'd tmp file and the atomic rename "
             "(crash here = a torn write: tmp left behind, the live "
             "checkpoint must stay intact)")
+fi.register("journal.append",
+            "encoded journal record lines just before the append write "
+            "(corrupt=torn/mangled tail, fail=ENOSPC on append, "
+            "crash=die before the records become durable — the "
+            "committer never acked, so recovery owes it nothing)")
+fi.register("journal.compact",
+            "between the compacted base landing (atomic rename + dir "
+            "fsync) and the journal truncate (crash here = new base "
+            "generation with a stale-generation journal; replay must "
+            "skip every stale record)")
 
 # Claim prepare states (reference device_state.go:231-283)
 PREPARE_STARTED = "PrepareStarted"
@@ -133,15 +145,23 @@ class ClaimEntry:
     namespace: str = ""
     state: str = PREPARE_STARTED
     prepared_devices: List[PreparedDevice] = field(default_factory=list)
+    #: journal mode only: the rendered CDI claim-spec body rides the
+    #: fsynced journal record, so the spec FILE can be written without
+    #: its own fsync and restored from here on recovery (empty = the
+    #: spec file carries its own durability, the rewrite-mode contract)
+    cdi_spec: str = ""
 
     def to_obj(self) -> Dict:
-        return {
+        obj = {
             "claimUID": self.claim_uid,
             "claimName": self.claim_name,
             "namespace": self.namespace,
             "state": self.state,
             "preparedDevices": [d.to_obj() for d in self.prepared_devices],
         }
+        if self.cdi_spec:
+            obj["cdiSpec"] = self.cdi_spec
+        return obj
 
     @staticmethod
     def from_obj(d: Dict) -> "ClaimEntry":
@@ -152,6 +172,7 @@ class ClaimEntry:
             state=d.get("state", PREPARE_STARTED),
             prepared_devices=[PreparedDevice.from_obj(x)
                               for x in d.get("preparedDevices") or []],
+            cdi_spec=d.get("cdiSpec", ""),
         )
 
 
@@ -201,8 +222,13 @@ class CheckpointManager:
     FILENAME = "checkpoint.json"
 
     def __init__(self, state_dir: str):
+        self._state_dir = state_dir
         self._path = os.path.join(state_dir, self.FILENAME)
         os.makedirs(state_dir, exist_ok=True)
+        #: journal generation recorded in the last file this manager read
+        #: or wrote (0 = no journal field: a pure rewrite-format file).
+        #: The journal manager layers on this to pair base and journal.
+        self.last_journal_gen = 0
 
     @property
     def path(self) -> str:
@@ -217,12 +243,14 @@ class CheckpointManager:
             with open(self._path) as f:
                 text = f.read()
         except FileNotFoundError:
+            self.last_journal_gen = 0
             return Checkpoint()
         text = fi.fire("checkpoint.read", payload=text)
         try:
             raw = json.loads(text)
         except json.JSONDecodeError as e:
             raise CheckpointCorruptionError(f"{self._path}: invalid JSON: {e}") from e
+        self.last_journal_gen = _journal_gen_of(raw)
         checksums = raw.get("checksums") or {}
         for version in ("v2", "v1"):
             payload = raw.get(version)
@@ -278,18 +306,23 @@ class CheckpointManager:
                 f"salvaged {len(salvaged.claims)}-claim" if salvaged is not None
                 else "empty")
             cp = salvaged if salvaged is not None else Checkpoint()
-            self.write(cp)
+            # preserve the journal pairing on the salvaged rewrite: losing
+            # the generation here would orphan (or worse, mis-apply) every
+            # record in a live journal paired with this base
+            self.write(cp, journal_gen=self.last_journal_gen)
             return cp
 
     def _salvage(self) -> Optional[Checkpoint]:
         """Best-effort recovery of any version whose checksum still
         verifies (v2 preferred). None when the JSON itself is broken or
         no version survives."""
+        self.last_journal_gen = 0
         try:
             with open(self._path) as f:
                 raw = json.load(f)
         except (OSError, json.JSONDecodeError):
             return None
+        self.last_journal_gen = _journal_gen_of(raw)
         checksums = raw.get("checksums") or {}
         for version in ("v2", "v1"):
             payload = raw.get(version)
@@ -316,7 +349,7 @@ class CheckpointManager:
             return "<copy failed>"
         return qpath
 
-    def write(self, cp: Checkpoint) -> None:
+    def write(self, cp: Checkpoint, journal_gen: Optional[int] = None) -> None:
         v2 = {"claims": {uid: e.to_obj() for uid, e in cp.claims.items()}}
         # V1 (legacy layout): no state machine — only *completed* claims
         # with their device names, the shape a pre-state-machine downgrade
@@ -345,7 +378,13 @@ class CheckpointManager:
         checksums = json.dumps(
             {"v1": zlib.crc32(v1_s.encode()), "v2": zlib.crc32(v2_s.encode())},
             separators=(",", ":"))
-        body = (f'{{\n"checksums": {checksums},\n'
+        # the journal line sits OUTSIDE the per-version checksums (old
+        # nonstrict readers ignore unknown top-level keys, so a downgrade
+        # still reads v1/v2); a mangled gen at worst orphans journal
+        # records, which replay treats as stale — never mis-applies them
+        journal_line = (f'"journal": {{"gen": {int(journal_gen)}}},\n'
+                        if journal_gen is not None else "")
+        body = (f'{{\n"checksums": {checksums},\n{journal_line}'
                 f'"v1": {v1_s},\n"v2": {v2_s}\n}}\n')
         fi.fire("checkpoint.write", payload=body)
         tmp = f"{self._path}.tmp.{os.getpid()}"
@@ -354,9 +393,522 @@ class CheckpointManager:
             f.flush()
             fi.fire("checkpoint.fsync")
             os.fsync(f.fileno())
+            _metrics.CHECKPOINT_FSYNCS.labels("file").inc()
         # a crash here is a TORN write: the fsync'd tmp exists but the
         # rename never ran — the live checkpoint must remain the previous
         # intact version (asserted by the torn-write drill)
         fi.fire("checkpoint.write.torn")
         os.replace(tmp, self._path)
+        # rename durability: fsyncing only the tmp file persists the
+        # BYTES, not the directory entry — a power cut after the rename
+        # could still resurrect the old file. fsync the directory too.
+        _fsync_dir(self._state_dir)
+        self.last_journal_gen = int(journal_gen or 0)
         _metrics.CHECKPOINT_WRITES.inc()
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return  # platforms without directory fds: best-effort
+    try:
+        os.fsync(fd)
+        _metrics.CHECKPOINT_FSYNCS.labels("dir").inc()
+    finally:
+        os.close(fd)
+
+
+def _journal_gen_of(raw: Dict) -> int:
+    j = raw.get("journal")
+    if not isinstance(j, dict):
+        return 0
+    try:
+        return int(j.get("gen", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Append-only journal checkpoint (feature gate: JournalCheckpoint)
+# ---------------------------------------------------------------------------
+#
+# WAL discipline over the rewrite format above: each write-ahead/commit
+# transition APPENDS one CRC-framed record to ``checkpoint.journal``
+# instead of rewriting the whole ``checkpoint.json``; recovery replays
+# the journal over the last compacted base; a size/record-count trigger
+# compacts (rewrites the base atomically via CheckpointManager.write —
+# same tmp+rename+dir-fsync+torn-write machinery — then truncates the
+# journal). Records are generation-stamped so a crash BETWEEN the
+# compacted base landing and the journal truncate is safe: the new base
+# carries gen+1, every journal record still carries gen, and replay
+# skips stale generations instead of double-applying them.
+#
+# Record framing — one line per record::
+#
+#     <crc32 hex8> <canonical JSON body>\n
+#
+# body = {"gen": G, "seq": N, "op": "put"|"del", "uid": U[, "entry": E]}
+#
+# A torn tail (partial last line, CRC mismatch at the end) is truncated
+# and forgotten — the committer whose append tore never got its ack, so
+# recovery owes it nothing (write-ahead semantics). Corruption strictly
+# BEFORE intact records is different: the intact suffix cannot be
+# trusted to be causally complete, so replay stops at the first bad
+# record and the damaged journal is quarantined for postmortem.
+
+JOURNAL_FILENAME = "checkpoint.journal"
+
+#: compaction triggers (record count OR encoded bytes); also the
+#: JOURNAL_BLOAT threshold tools/doctor.py warns at.
+JOURNAL_COMPACT_MAX_RECORDS = 512
+JOURNAL_COMPACT_MAX_BYTES = 1 << 20
+
+JOURNAL_OP_PUT = "put"
+JOURNAL_OP_DEL = "del"
+
+
+@dataclass
+class JournalRecord:
+    gen: int
+    seq: int
+    op: str                          # put | del
+    uid: str
+    entry: Optional[Dict] = None     # ClaimEntry.to_obj() for put
+
+
+class JournalDecodeError(ValueError):
+    pass
+
+
+def encode_journal_record(rec: JournalRecord) -> str:
+    body: Dict = {"gen": rec.gen, "seq": rec.seq, "op": rec.op,
+                  "uid": rec.uid}
+    if rec.op == JOURNAL_OP_PUT:
+        body["entry"] = rec.entry
+    s = _canonical(body)
+    return f"{zlib.crc32(s.encode()):08x} {s}\n"
+
+
+def decode_journal_record(line: str) -> JournalRecord:
+    if not line.endswith("\n"):
+        raise JournalDecodeError("partial line (no trailing newline)")
+    raw = line[:-1]
+    crc_hex, sep, body = raw.partition(" ")
+    if not sep or len(crc_hex) != 8:
+        raise JournalDecodeError("malformed frame")
+    try:
+        want = int(crc_hex, 16)
+    except ValueError as e:
+        raise JournalDecodeError(f"bad CRC field: {e}") from e
+    if zlib.crc32(body.encode()) != want:
+        raise JournalDecodeError("CRC mismatch")
+    try:
+        obj = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise JournalDecodeError(f"invalid JSON body: {e}") from e
+    op = obj.get("op")
+    if op not in (JOURNAL_OP_PUT, JOURNAL_OP_DEL):
+        raise JournalDecodeError(f"unknown op {op!r}")
+    return JournalRecord(gen=int(obj.get("gen", 0)),
+                         seq=int(obj.get("seq", 0)), op=op,
+                         uid=str(obj.get("uid", "")),
+                         entry=obj.get("entry"))
+
+
+def scan_journal(path: str):
+    """Pure, read-only journal scan (shared with tools/doctor.py).
+
+    Returns ``(records, good_bytes, bad_index)``: decoded records up to
+    the first undecodable line, the byte offset of the end of the last
+    good record (the torn-tail truncation point), and the 0-based index
+    of the first bad line (None = clean). Missing file = empty journal.
+    """
+    records: List[JournalRecord] = []
+    good_bytes = 0
+    bad_index = None
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return records, 0, None
+    pos = 0
+    i = 0
+    while pos < len(data):
+        nl = data.find(b"\n", pos)
+        chunk = data[pos:] if nl < 0 else data[pos:nl + 1]
+        try:
+            records.append(decode_journal_record(chunk.decode()))
+        except (JournalDecodeError, UnicodeDecodeError):
+            bad_index = i
+            break
+        pos += len(chunk)
+        good_bytes = pos
+        i += 1
+    return records, good_bytes, bad_index
+
+
+def replay_records(cp: Checkpoint, base_gen: int,
+                   records: List[JournalRecord]) -> tuple:
+    """Apply ``records`` with gen == base_gen onto ``cp`` in order.
+    Returns ``(applied, stale)`` counts. Pure (used by doctor too)."""
+    applied = stale = 0
+    for rec in records:
+        if rec.gen != base_gen:
+            stale += 1
+            continue
+        if rec.op == JOURNAL_OP_PUT:
+            cp.claims[rec.uid] = ClaimEntry.from_obj(rec.entry or {})
+        else:
+            cp.claims.pop(rec.uid, None)
+        applied += 1
+    return applied, stale
+
+
+class JournalCheckpointManager:
+    """Checkpoint persistence as base + append-only journal.
+
+    Owns both files. ``recover()`` replays the journal over the base and
+    then compacts, so every restart begins from a fresh base and an
+    empty journal — byte-compatible (same v1/v2 payload bytes) with what
+    the rewrite-format manager would persist for the same claim state,
+    which is exactly what the format-migration drills assert. Appends
+    after recovery go through :meth:`append`; callers coalesce them via
+    :class:`GroupCommitWriter`.
+    """
+
+    def __init__(self, state_dir: str,
+                 compact_max_records: int = JOURNAL_COMPACT_MAX_RECORDS,
+                 compact_max_bytes: int = JOURNAL_COMPACT_MAX_BYTES):
+        self.base = CheckpointManager(state_dir)
+        self._state_dir = state_dir
+        self._jpath = os.path.join(state_dir, JOURNAL_FILENAME)
+        self._compact_max_records = compact_max_records
+        self._compact_max_bytes = compact_max_bytes
+        self._gen = 0
+        self._seq = 0
+        self._jbytes = 0
+        self._jrecords = 0
+        self._jfile = None
+
+    @property
+    def journal_path(self) -> str:
+        return self._jpath
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    @property
+    def journal_records(self) -> int:
+        return self._jrecords
+
+    # -- recovery -----------------------------------------------------------
+
+    def recover(self) -> Checkpoint:
+        """Base (quarantining if corrupt) + journal replay + compact.
+
+        Idempotent at every crash boundary: re-crashing anywhere inside
+        recovery leaves base+journal in a state this same procedure
+        resolves to the same claim set (stale-generation skip covers the
+        compact/truncate window; torn-tail truncate covers append)."""
+        cp = self.base.read_or_quarantine()
+        base_gen = self.base.last_journal_gen
+        records, good_bytes, bad_index = scan_journal(self._jpath)
+        if bad_index is not None:
+            tail_only = bad_index == len(records) and self._is_tail_damage(
+                good_bytes)
+            if tail_only:
+                # torn tail: the committer never acked; drop it silently
+                log.warning(
+                    "journal %s: torn tail truncated at byte %d "
+                    "(%d intact records)", self._jpath, good_bytes,
+                    len(records))
+            else:
+                # mid-file damage: records after it can't be trusted to
+                # be causally complete — quarantine for postmortem and
+                # recover from the intact prefix only
+                qpath = self._quarantine_journal()
+                _metrics.CHECKPOINT_QUARANTINED.inc()
+                log.error(
+                    "JOURNAL CORRUPT: %s record %d undecodable mid-file "
+                    "— quarantined to %s; recovering from the %d-record "
+                    "intact prefix (later transitions may be lost; the "
+                    "cleanup sweep and idempotent re-prepare will "
+                    "reconverge)", self._jpath, bad_index, qpath,
+                    len(records))
+        applied, stale = replay_records(cp, base_gen, records)
+        if stale:
+            log.info("journal %s: skipped %d stale-generation records "
+                     "(base gen %d moved past them mid-compaction)",
+                     self._jpath, stale, base_gen)
+        self._gen = base_gen
+        # compact unconditionally: recovery ends with a fresh base and an
+        # empty journal, making the recovered state byte-identical to the
+        # rewrite format's and re-crash during recovery a no-op
+        self.compact(cp)
+        self._open_journal()
+        return cp
+
+    def _is_tail_damage(self, good_bytes: int) -> bool:
+        """True when the undecodable region is the LAST thing in the
+        file (no intact record follows it) — the torn-append signature."""
+        try:
+            with open(self._jpath, "rb") as f:
+                f.seek(good_bytes)
+                rest = f.read()
+        except OSError:
+            return True
+        # any intact record after the damage ⇒ mid-file corruption
+        for line in rest.splitlines(keepends=True):
+            try:
+                decode_journal_record(line.decode())
+            except (JournalDecodeError, UnicodeDecodeError):
+                continue
+            return False
+        return True
+
+    def _quarantine_journal(self) -> str:
+        import shutil
+        n = 1
+        while os.path.exists(f"{self._jpath}.corrupt-{n}"):
+            n += 1
+        qpath = f"{self._jpath}.corrupt-{n}"
+        try:
+            shutil.copyfile(self._jpath, qpath)
+        except OSError:
+            log.warning("could not preserve corrupt journal at %s",
+                        qpath, exc_info=True)
+            return "<copy failed>"
+        return qpath
+
+    # -- append path --------------------------------------------------------
+
+    def _open_journal(self) -> None:
+        if self._jfile is None:
+            self._jfile = open(self._jpath, "a")
+
+    def append(self, ops) -> int:
+        """Append ``[(op, uid, entry_obj_or_None), ...]`` as one write +
+        one fsync. Returns the record count. Called only from the
+        group-commit writer thread (single writer — no locking here)."""
+        self._open_journal()
+        lines = []
+        for op, uid, entry in ops:
+            self._seq += 1
+            lines.append(encode_journal_record(JournalRecord(
+                gen=self._gen, seq=self._seq, op=op, uid=uid,
+                entry=entry)))
+        data = "".join(lines)
+        data = fi.fire("journal.append", payload=data)
+        self._jfile.write(data)
+        self._jfile.flush()
+        os.fsync(self._jfile.fileno())
+        _metrics.CHECKPOINT_FSYNCS.labels("journal").inc()
+        self._jbytes += len(data)
+        self._jrecords += len(lines)
+        _metrics.JOURNAL_RECORDS.set(self._jrecords)
+        return len(lines)
+
+    def needs_compaction(self) -> bool:
+        return (self._jrecords >= self._compact_max_records
+                or self._jbytes >= self._compact_max_bytes)
+
+    def compact(self, cp: Checkpoint) -> None:
+        """Rewrite the base at gen+1 (atomic, reusing the torn-write and
+        quarantine machinery of CheckpointManager.write) and truncate
+        the journal. Crash-safe at every boundary:
+
+        - before the rename lands: old base + old journal, nothing lost;
+        - after the rename, before the truncate (``journal.compact``
+          fires here): new base gen+1, journal full of gen records —
+          replay skips them all as stale;
+        - after the truncate: steady state.
+        """
+        t0 = _time.monotonic()
+        self._gen += 1
+        self.base.write(cp, journal_gen=self._gen)
+        fi.fire("journal.compact")
+        if self._jfile is not None:
+            self._jfile.truncate(0)
+            self._jfile.flush()
+        else:
+            with open(self._jpath, "w"):
+                pass
+        self._seq = 0
+        self._jbytes = 0
+        self._jrecords = 0
+        _metrics.JOURNAL_RECORDS.set(0)
+        _metrics.JOURNAL_COMPACTION_SECONDS.observe(
+            _time.monotonic() - t0)
+
+    def close(self) -> None:
+        if self._jfile is not None:
+            try:
+                self._jfile.close()
+            finally:
+                self._jfile = None
+
+
+def fold_journal_into_base(state_dir: str) -> bool:
+    """Migration: journal format → rewrite format. When the gate is off
+    but a journal file exists (a downgrade after running journaled), fold
+    its surviving records into the base and remove it, so the rewrite
+    manager — and any pre-journal reader — sees one healthy
+    checkpoint.json. Returns True when a fold happened."""
+    jpath = os.path.join(state_dir, JOURNAL_FILENAME)
+    if not os.path.exists(jpath):
+        return False
+    mgr = JournalCheckpointManager(state_dir)
+    try:
+        mgr.recover()   # replay + compact: journal now empty
+    finally:
+        mgr.close()
+    os.unlink(jpath)
+    log.info("folded checkpoint journal into base (%s removed): "
+             "JournalCheckpoint gate is off", jpath)
+    return True
+
+
+class _CommitTicket:
+    """One committer's stake in a group commit."""
+
+    __slots__ = ("_ev", "_err")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._err = None
+
+    def done(self, err=None) -> None:
+        self._err = err
+        self._ev.set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("journal group commit did not complete")
+        if self._err is not None:
+            raise self._err
+
+
+class GroupCommitWriter:
+    """Single journal-writer thread coalescing appends from concurrent
+    batches into one fsync (classic group commit: the leader drains the
+    queue while fsyncing; followers that arrive meanwhile ride the next
+    round). A bounded latency window (~2 ms) lets the writer wait for
+    stragglers ONLY while other batches are known in flight
+    (``batch_begin``/``batch_end`` hints), so a lone committer never
+    pays the window.
+
+    ``enqueue`` is called under DeviceState's state lock (preserving
+    journal order = memory order); ``Ticket.wait`` happens OUTSIDE it.
+    Compaction runs on the writer thread between commits, against a
+    snapshot the owner supplies (it takes the state lock itself).
+    """
+
+    def __init__(self, mgr: JournalCheckpointManager, snapshot,
+                 window_s: float = 0.002):
+        self._mgr = mgr
+        self._snapshot = snapshot          # () -> Checkpoint, takes state lock
+        self._window_s = window_s
+        self._cond = threading.Condition()
+        self._queue: List[tuple] = []      # [(ops, ticket), ...]
+        self._inflight = 0
+        self._stopped = False
+        self._held = False                 # deterministic test hook
+        # lazy start: idle plugins (fleet harnesses build many) don't
+        # pay a thread until their first commit
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="journal-group-commit", daemon=True)
+            self._thread.start()
+
+    # -- committer side -----------------------------------------------------
+
+    def batch_begin(self) -> None:
+        with self._cond:
+            self._inflight += 1
+
+    def batch_end(self) -> None:
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            self._cond.notify_all()
+
+    def enqueue(self, ops) -> _CommitTicket:
+        """Queue ``[(op, uid, entry_obj), ...]`` for the next group
+        commit. Call under the state lock; ``wait()`` the ticket after
+        releasing it."""
+        t = _CommitTicket()
+        with self._cond:
+            if self._stopped:
+                t.done(RuntimeError("journal writer is stopped"))
+                return t
+            self._ensure_thread()
+            self._queue.append((list(ops), t))
+            self._cond.notify_all()
+        return t
+
+    # -- test hooks ---------------------------------------------------------
+
+    def hold(self) -> None:
+        """Pause draining (tests enqueue from N threads, then release
+        and assert ONE fsync served them all)."""
+        with self._cond:
+            self._held = True
+
+    def release(self) -> None:
+        with self._cond:
+            self._held = False
+            self._cond.notify_all()
+
+    # -- writer thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._queue or self._held) and not self._stopped:
+                    self._cond.wait()
+                if self._stopped and not self._queue:
+                    return
+                if not self._held:
+                    # bounded straggler window: only worth waiting when
+                    # more batches are in flight than are already queued
+                    deadline = _time.monotonic() + self._window_s
+                    while (self._inflight > len(self._queue)
+                           and not self._stopped):
+                        left = deadline - _time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cond.wait(left)
+                batch = self._queue
+                self._queue = []
+            t0 = _time.monotonic()
+            ops = [op for ops_, _ in batch for op in ops_]
+            err = None
+            try:
+                n = self._mgr.append(ops)
+                _metrics.JOURNAL_GROUP_COMMIT_RECORDS.observe(n)
+            except BaseException as e:  # chaos-ok: delivered to every waiting ticket, whose wait() re-raises it on the calling batch
+                err = e
+            dt = _time.monotonic() - t0
+            for _, ticket in batch:
+                _metrics.JOURNAL_APPEND_SECONDS.observe(dt)
+                ticket.done(err)
+            if err is None and self._mgr.needs_compaction():
+                try:
+                    self._mgr.compact(self._snapshot())
+                except Exception:  # noqa: BLE001
+                    # a failed compaction is survivable: the journal
+                    # keeps growing and the next round retries
+                    log.exception("journal compaction failed; will retry")
+                    _metrics.SWALLOWED_ERRORS.labels("journal.compact").inc()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain outstanding commits and stop the writer thread."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)

@@ -33,6 +33,7 @@ import logging
 import os
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Iterable, List, Optional, Set
@@ -59,10 +60,15 @@ from tpu_dra_driver.plugin.checkpoint import (
     Checkpoint,
     CheckpointManager,
     ClaimEntry,
+    GroupCommitWriter,
+    JOURNAL_OP_DEL,
+    JOURNAL_OP_PUT,
+    JournalCheckpointManager,
     PreparedDevice,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
     backfill_pools,
+    fold_journal_into_base,
 )
 from tpu_dra_driver.plugin.claims import (
     ClaimInfo,
@@ -149,9 +155,33 @@ class DeviceState:
         self._gates = gates
         self._cdi = cdi
         self._mu = threading.RLock()
-        self._cp_mgr = CheckpointManager(state_dir)
         self._cp_lock_path = os.path.join(state_dir, "cp.lock")
-        self._cp_mgr.ensure_exists()
+        #: dynamic placement has no internal locking (it historically ran
+        #: under _mu + cp flock); parallel actuation serializes it here
+        self._place_mu = threading.Lock()
+        self.journal_mode = gates.enabled(fg.JOURNAL_CHECKPOINT)
+        if self.journal_mode:
+            # append-only journal + cross-batch group commit: state is
+            # authoritative IN MEMORY (single-writer ownership of the
+            # state dir), every transition an appended record; the cp
+            # flock is held only across recovery — steady-state commits
+            # are serialized by the writer thread instead
+            self._jcp_mgr = JournalCheckpointManager(state_dir)
+            with self._cp_locked():
+                self._cp_mem: Checkpoint = self._jcp_mgr.recover()
+            self._cp_mgr = self._jcp_mgr.base
+            self._restore_claim_specs(self._cp_mem)
+            self.journal_writer = GroupCommitWriter(
+                self._jcp_mgr, snapshot=self._cp_snapshot)
+            self._actuate_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="prepare-actuate")
+        else:
+            self._cp_mgr = CheckpointManager(state_dir)
+            with self._cp_locked():
+                # downgrade path: fold any journal left by a journaled
+                # run into the base so rewrite-format readers see it all
+                fold_journal_into_base(state_dir)
+                self._cp_mgr.ensure_exists()
         self._timeslicing = TimeSlicingManager(lib)
         self._multiprocess = MultiProcessManager(lib)
         self.repartition = RepartitionManager(lib, state_dir)
@@ -174,9 +204,41 @@ class DeviceState:
     def _cp_locked(self):
         return Flock(self._cp_lock_path, FlockOptions(timeout=10.0))
 
+    def _cp_snapshot(self) -> Checkpoint:
+        """Point-in-time copy of the in-memory checkpoint (journal mode;
+        the group-commit writer compacts against this)."""
+        with self._mu:
+            return self._cp_mem.deepcopy()
+
+    def _restore_claim_specs(self, cp: Checkpoint) -> None:
+        """Journal-mode recovery: the prepare path writes CDI spec files
+        WITHOUT a per-file fsync (the body's durability is the fsynced
+        journal record carrying the entry), so after a crash a committed
+        claim's spec file may be missing or torn. Rewrite any divergent
+        spec durably from its checkpointed body before serving."""
+        for uid, entry in cp.claims.items():
+            if entry.state != PREPARE_COMPLETED or not entry.cdi_spec:
+                continue
+            if self._cdi.restore_claim_spec(uid, entry.cdi_spec):
+                log.info("recovery: restored CDI spec for claim %s from "
+                         "its checkpoint entry", uid)
+
     def get_checkpoint(self) -> Checkpoint:
+        if self.journal_mode:
+            return self._cp_snapshot()
         with self._cp_locked():
             return self._cp_mgr.read_or_quarantine()
+
+    def close(self) -> None:
+        """Stop journal-mode background machinery (writer thread +
+        actuation pool). Safe to call repeatedly; a no-op in rewrite
+        mode. In-process restarts (drills, rolling upgrades, soak)
+        must not strand one writer thread per plugin generation."""
+        if not self.journal_mode:
+            return
+        self.journal_writer.stop()
+        self._actuate_pool.shutdown(wait=True, cancel_futures=True)
+        self._jcp_mgr.close()
 
     # ------------------------------------------------------------------
     # Prepare
@@ -222,6 +284,8 @@ class DeviceState:
             return out
         t0 = time.perf_counter()
         _metrics.PREPARE_BATCH_CLAIMS.observe(len(claims))
+        if self.journal_mode:
+            return self._prepare_batch_journal(claims, spans)
         phase = _metrics.PREPARE_BATCH_PHASE_SECONDS.labels
         with self._mu:
             t_lock0 = time.perf_counter()
@@ -233,49 +297,7 @@ class DeviceState:
                 t_read = time.perf_counter() - t_read0
                 phase("read").observe(t_read)
 
-                to_prepare: List[ClaimInfo] = []
-                admitted: Set[str] = set()
-                for claim in claims:
-                    if claim.uid in out or claim.uid in admitted:
-                        # duplicate UID within one batch: the first
-                        # occurrence decides (the serial path's second
-                        # pass would have seen its completed entry)
-                        continue
-                    entry = cp.claims.get(claim.uid)
-                    if entry is not None and entry.state == PREPARE_COMPLETED:
-                        t_claim0 = time.perf_counter()
-                        log.debug("prepare %s: already completed (idempotent)",
-                                  claim.canonical)
-                        backfill_pools(entry, claim)
-                        timing = PrepareTiming(claim=claim.canonical,
-                                               cached=True,
-                                               t_checkpoint=t_read)
-                        timing.t_total = time.perf_counter() - t_claim0
-                        self.timings.append(timing)
-                        out[claim.uid] = BatchClaimResult(
-                            devices=entry.prepared_devices, cached=True)
-                        continue
-                    try:
-                        # against PRE-EXISTING owners only; a conflict
-                        # with a batch peer is decided in the prepare
-                        # loop below, after the peer's actual outcome
-                        self._validate_no_overlap(cp, claim)
-                    except (PermanentError, TpuLibError) as e:
-                        # TpuLibError = the transient dynamic-placement
-                        # conflict: still isolated to this claim, but
-                        # retriable
-                        log.error("prepare %s failed (%s): %s",
-                                  claim.canonical, type(e).__name__, e)
-                        out[claim.uid] = BatchClaimResult(exception=e)
-                        continue
-                    if entry is not None and entry.state == PREPARE_STARTED:
-                        # crashed mid-prepare earlier: roll the partial
-                        # attempt back
-                        log.info("prepare %s: rolling back partial previous "
-                                 "attempt", claim.canonical)
-                        self._unprepare_devices(entry, best_effort=True)
-                    admitted.add(claim.uid)
-                    to_prepare.append(claim)
+                to_prepare = self._admit_claims(cp, claims, out, t_read)
 
                 if not to_prepare:
                     return out
@@ -324,6 +346,216 @@ class DeviceState:
                   len(claims), (time.perf_counter() - t0) * 1e3)
         return out
 
+    def _admit_claims(self, cp: Checkpoint, claims: List[ClaimInfo],
+                      out: Dict[str, BatchClaimResult],
+                      t_read: float) -> List[ClaimInfo]:
+        """The batch admission loop (shared by both persistence modes):
+        idempotent completed hits, the overlap guard against pre-existing
+        owners, and rollback of PrepareStarted leftovers. Called under
+        the state lock; fills ``out`` for claims decided here and returns
+        the list to actually prepare."""
+        to_prepare: List[ClaimInfo] = []
+        admitted: Set[str] = set()
+        for claim in claims:
+            if claim.uid in out or claim.uid in admitted:
+                # duplicate UID within one batch: the first
+                # occurrence decides (the serial path's second
+                # pass would have seen its completed entry)
+                continue
+            entry = cp.claims.get(claim.uid)
+            if entry is not None and entry.state == PREPARE_COMPLETED:
+                t_claim0 = time.perf_counter()
+                log.debug("prepare %s: already completed (idempotent)",
+                          claim.canonical)
+                backfill_pools(entry, claim)
+                timing = PrepareTiming(claim=claim.canonical,
+                                       cached=True,
+                                       t_checkpoint=t_read)
+                timing.t_total = time.perf_counter() - t_claim0
+                self.timings.append(timing)
+                out[claim.uid] = BatchClaimResult(
+                    devices=entry.prepared_devices, cached=True)
+                continue
+            try:
+                # against PRE-EXISTING owners only; a conflict
+                # with a batch peer is decided in the prepare
+                # loop below, after the peer's actual outcome
+                self._validate_no_overlap(cp, claim)
+            except (PermanentError, TpuLibError) as e:
+                # TpuLibError = the transient dynamic-placement
+                # conflict: still isolated to this claim, but
+                # retriable
+                log.error("prepare %s failed (%s): %s",
+                          claim.canonical, type(e).__name__, e)
+                out[claim.uid] = BatchClaimResult(exception=e)
+                continue
+            if entry is not None and entry.state == PREPARE_STARTED:
+                # crashed mid-prepare earlier: roll the partial
+                # attempt back
+                log.info("prepare %s: rolling back partial previous "
+                         "attempt", claim.canonical)
+                self._unprepare_devices(entry, best_effort=True)
+            admitted.add(claim.uid)
+            to_prepare.append(claim)
+        return to_prepare
+
+    # ------------------------------------------------------------------
+    # journal mode: group-commit prepare pipeline
+    # ------------------------------------------------------------------
+
+    def _prepare_batch_journal(self, claims: List[ClaimInfo],
+                               spans: Dict[str, object]
+                               ) -> Dict[str, BatchClaimResult]:
+        """The journaled prepare pipeline: admission under the state
+        lock, write-ahead as appended journal records (one group-commit
+        fsync SHARED with every other in-flight batch), parallel device
+        actuation through the TpuLib seam, then commit records through
+        the same group commit. Crash semantics are identical to the
+        rewrite path — PrepareStarted is durable before any device
+        mutation, PrepareCompleted only after the CDI spec is on disk —
+        but N concurrent batches now pay O(1) fsyncs instead of 2N."""
+        out: Dict[str, BatchClaimResult] = {}
+        phase = _metrics.PREPARE_BATCH_PHASE_SECONDS.labels
+        w = self.journal_writer
+        w.batch_begin()
+        try:
+            with self._mu:
+                cp = self._cp_mem
+                to_prepare = self._admit_claims(cp, claims, out, 0.0)
+                if not to_prepare:
+                    return out
+                # write-ahead records enqueued UNDER the state lock
+                # (journal order must equal memory order); the fsync
+                # wait happens after release so concurrent batches
+                # coalesce instead of convoying
+                for claim in to_prepare:
+                    cp.claims[claim.uid] = ClaimEntry(
+                        claim_uid=claim.uid, claim_name=claim.name,
+                        namespace=claim.namespace, state=PREPARE_STARTED,
+                    )
+                ticket = w.enqueue(
+                    [(JOURNAL_OP_PUT, c.uid, cp.claims[c.uid].to_obj())
+                     for c in to_prepare])
+            t_wa0 = time.perf_counter()
+            with tracing.span("prepare.write_ahead",
+                              attributes={"claims": len(to_prepare)}):
+                ticket.wait(30.0)
+            phase("write_ahead").observe(time.perf_counter() - t_wa0,
+                                         exemplar=tracing.exemplar())
+            fi.fire("plugin.prepare.after_write_ahead")
+
+            t_prep0 = time.perf_counter()
+            self._actuate_claims(to_prepare, cp, spans, out)
+            phase("prepare").observe(time.perf_counter() - t_prep0,
+                                     exemplar=tracing.exemplar())
+
+            completed = [c for c in to_prepare
+                         if out[c.uid].exception is None]
+            if completed:
+                fi.fire("plugin.prepare.before_commit")
+                with self._mu:
+                    ticket = w.enqueue(
+                        [(JOURNAL_OP_PUT, c.uid, cp.claims[c.uid].to_obj())
+                         for c in completed])
+                t_c0 = time.perf_counter()
+                with tracing.span("prepare.commit"):
+                    ticket.wait(30.0)
+                phase("commit").observe(time.perf_counter() - t_c0,
+                                        exemplar=tracing.exemplar())
+        finally:
+            w.batch_end()
+        return out
+
+    def _actuate_claims(self, to_prepare: List[ClaimInfo], cp: Checkpoint,
+                        spans: Dict[str, object],
+                        out: Dict[str, BatchClaimResult]) -> None:
+        """Fan device actuation out across the batch (journal mode).
+
+        Claims that share a (non-admin) device with an earlier batch
+        peer are chained AFTER that peer, preserving the serial-run
+        overlap equivalence the rewrite path guarantees; the mutually
+        independent chains run in parallel through the TpuLib seam —
+        the journal serializes state, so device work no longer needs
+        the state lock for the whole batch."""
+        chains: List[List[ClaimInfo]] = []
+        chain_of: Dict[str, int] = {}   # device name -> chain index
+        for claim in to_prepare:
+            devs = {r.device for r in claim.results if not r.admin_access}
+            idxs = sorted({chain_of[d] for d in devs if d in chain_of})
+            if not idxs:
+                chains.append([claim])
+                idx = len(chains) - 1
+            else:
+                # this claim bridges several so-far-independent chains:
+                # merge them (their devices are disjoint, so relative
+                # order between them is immaterial; within each chain,
+                # batch order is preserved)
+                idx = idxs[0]
+                for j in idxs[1:]:
+                    chains[idx].extend(chains[j])
+                    chains[j] = []
+                for d, ci in list(chain_of.items()):
+                    if ci in idxs[1:]:
+                        chain_of[d] = idx
+                chains[idx].append(claim)
+            for d in devs:
+                chain_of[d] = idx
+
+        def run_chain(chain: List[ClaimInfo]) -> None:
+            for claim in chain:
+                with tracing.use_span(spans.get(claim.uid)):
+                    out[claim.uid] = self._prepare_one_in_batch(
+                        claim, cp, 0.0)
+
+        live = [ch for ch in chains if ch]
+        if len(live) <= 1:
+            for ch in live:
+                run_chain(ch)
+            return
+        futures = [self._actuate_pool.submit(run_chain, ch) for ch in live]
+        for f in futures:
+            f.result()
+
+    # ------------------------------------------------------------------
+    # journal mode: unprepare
+    # ------------------------------------------------------------------
+
+    def _unprepare_batch_journal(self, claim_uids: List[str]
+                                 ) -> Dict[str, Optional[BaseException]]:
+        out: Dict[str, Optional[BaseException]] = {}
+        w = self.journal_writer
+        w.batch_begin()
+        ops: List[tuple] = []
+        try:
+            with self._mu:
+                cp = self._cp_mem
+                for uid in claim_uids:
+                    entry = cp.claims.get(uid)
+                    if entry is None:
+                        log.debug("unprepare %s: no checkpoint entry "
+                                  "(idempotent)", uid)
+                        out[uid] = None
+                        continue
+                    try:
+                        self._unprepare_devices(entry, best_effort=False)
+                        self._cdi.delete_claim_spec(uid)
+                    except Exception as e:  # chaos-ok: kept for retry
+                        log.exception("unprepare %s failed", uid)
+                        out[uid] = e
+                        continue
+                    del cp.claims[uid]
+                    ops.append((JOURNAL_OP_DEL, uid, None))
+                    out[uid] = None
+                    log.info("unprepare %s: done", uid)
+                if ops:
+                    fi.fire("plugin.unprepare.before_write")
+                    ticket = w.enqueue(ops)
+            if ops:
+                ticket.wait(30.0)
+        finally:
+            w.batch_end()
+        return out
+
     def _prepare_one_in_batch(self, claim: ClaimInfo, cp: Checkpoint,
                               t_read: float) -> BatchClaimResult:
         """Device preparation + CDI write for one claim of a batch, with
@@ -344,7 +576,11 @@ class DeviceState:
             # an earlier peer only if that peer completed — exactly the
             # error (and message) a serial run produces; if the peer
             # failed, this claim proceeds, just as it would serially.
-            self._validate_no_overlap(cp, claim)
+            # (under _mu: journal-mode actuation threads share ``cp``
+            # with concurrent batches' admission; _mu is reentrant for
+            # the rewrite path, which already holds it)
+            with self._mu:
+                self._validate_no_overlap(cp, claim)
             t_core0 = time.perf_counter()
             with tracing.span("prepare.devices",
                               attributes={"claim": claim.canonical}):
@@ -355,8 +591,14 @@ class DeviceState:
             t_cdi0 = time.perf_counter()
             with tracing.span("prepare.cdi",
                               attributes={"claim": claim.canonical}):
-                qualified = self._cdi.write_claim_spec(
+                spec_body, qualified = self._cdi.render_claim_spec(
                     claim.uid, cdi_devices, extra_common=extra_common)
+                # journal mode: the rendered body rides the fsynced
+                # journal record (and is restored from it on recovery),
+                # so the spec FILE skips its per-claim fsync — the
+                # coalesced journal fsync is the prepare path's only one
+                self._cdi.write_claim_spec_body(
+                    claim.uid, spec_body, durable=not self.journal_mode)
             timing.t_cdi = time.perf_counter() - t_cdi0
         except PermanentError as e:
             log.error("prepare %s failed permanently: %s", claim.canonical, e)
@@ -366,11 +608,13 @@ class DeviceState:
             return BatchClaimResult(exception=e)
         for dev, qname in zip(prepared, qualified):
             dev.cdi_device_ids = [qname]
-        cp.claims[claim.uid] = ClaimEntry(
-            claim_uid=claim.uid, claim_name=claim.name,
-            namespace=claim.namespace, state=PREPARE_COMPLETED,
-            prepared_devices=prepared,
-        )
+        with self._mu:
+            cp.claims[claim.uid] = ClaimEntry(
+                claim_uid=claim.uid, claim_name=claim.name,
+                namespace=claim.namespace, state=PREPARE_COMPLETED,
+                prepared_devices=prepared,
+                cdi_spec=spec_body if self.journal_mode else "",
+            )
         timing.t_total = time.perf_counter() - t_claim0
         self.timings.append(timing)
         log.info("prepare %s: %d device(s) in %.1fms (core=%.1fms cdi=%.1fms)",
@@ -584,7 +828,11 @@ class DeviceState:
                           attributes={"profile": dev.profile.id,
                                       "chip": dev.chip.index,
                                       "dynamic": True}):
-            spec, live = self.repartition.place(dev.chip, dev.profile, cp)
+            # placement reads checkpoint occupancy and has no locking of
+            # its own; parallel actuation serializes it explicitly
+            with self._place_mu, self._mu:
+                spec, live = self.repartition.place(dev.chip, dev.profile,
+                                                    cp)
         placed_name = spec.canonical_name()
         edits = ContainerEdits(
             device_nodes=[{"path": live.devfs_path}],
@@ -636,8 +884,11 @@ class DeviceState:
             raise PermanentError(
                 "vfio device allocated but PassthroughSupport gate is off"
             )
-        group = self.vfio.configure(dev.chip.pci_address)
-        edits = self.vfio.container_edits(group)
+        # vfio driver flips mutate shared manager state; serialize them
+        # (rewrite mode already holds the reentrant _mu)
+        with self._mu:
+            group = self.vfio.configure(dev.chip.pci_address)
+            edits = self.vfio.container_edits(group)
         name = self._cdi.claim_device_name(claim.uid, dev.canonical_name)
         pd = PreparedDevice(
             canonical_name=dev.canonical_name, request=request,
@@ -670,6 +921,8 @@ class DeviceState:
         if not claim_uids:
             return out
         _metrics.UNPREPARE_BATCH_CLAIMS.observe(len(claim_uids))
+        if self.journal_mode:
+            return self._unprepare_batch_journal(claim_uids)
         with self._mu, self._cp_locked():
             cp = self._cp_mgr.read_or_quarantine()
             dirty = False
@@ -778,6 +1031,11 @@ class DeviceState:
         detached, and the density gauge re-seeds from hardware truth
         (seats persist across plugin restarts, the in-process gauge
         does not)."""
+        if self.journal_mode:
+            with self._mu:
+                destroyed = self.repartition.reconcile(self._cp_mem)
+                self._reconcile_seats(self._cp_mem)
+                return destroyed
         with self._mu, self._cp_locked():
             cp = self._cp_mgr.read_or_quarantine()
             destroyed = self.repartition.reconcile(cp)

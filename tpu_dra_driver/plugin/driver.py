@@ -18,6 +18,7 @@ transport-independent core so tests and the e2e harness drive it directly
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -167,10 +168,24 @@ class TpuKubeletPlugin:
         log.info("tpu-kubelet-plugin started on node %s (%d allocatable devices)",
                  self._config.node_name, len(self.state.allocatable))
 
+    def _pu_locked(self):
+        """The NodePrepare/UnprepareResources serialization point. In
+        journal mode batches must NOT serialize here — cross-batch group
+        commit only coalesces fsyncs across batches that are actually in
+        flight together; DeviceState's admission lock + the single
+        journal-writer thread provide the consistency the flock used to."""
+        if self.state.journal_mode:
+            return contextlib.nullcontext()
+        return Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
+
     def shutdown(self) -> None:
         self.cleanup.stop()
         if self.health is not None:
             self.health.stop()
+        # stop the journal group-commit writer + actuation pool (no-op in
+        # rewrite mode): outstanding commits drain first, so an in-process
+        # restart over the same state dir finds every acked record on disk
+        self.state.close()
         # close the async Event worker promptly: an in-process restart
         # (drills, fleet servicing) must not strand one worker thread
         # per plugin generation (endurance-soak thread sentinel)
@@ -383,8 +398,7 @@ class TpuKubeletPlugin:
         batch_span = next(iter(spans.values()), None)
         t0 = time.perf_counter()
         try:
-            lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
-            with lock:
+            with self._pu_locked():
                 t_lock = time.perf_counter() - t0
                 self._m_lock_wait.observe(t_lock)
                 with tracing.use_span(batch_span):
@@ -460,8 +474,7 @@ class TpuKubeletPlugin:
             return {}
         t0 = time.perf_counter()
         try:
-            lock = Flock(self._pu_lock_path, FlockOptions(timeout=PU_LOCK_TIMEOUT))
-            with lock:
+            with self._pu_locked():
                 self._m_lock_wait.observe(time.perf_counter() - t0)
                 batch = self.state.unprepare_batch(claim_uids)
         except Exception as e:  # chaos-ok: per-uid errors + error histogram

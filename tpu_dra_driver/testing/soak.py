@@ -756,6 +756,11 @@ class SoakEngine:
         # every real node, reshaped on demand by the reshape adversity
         # source while sub-slice/chip traffic flows
         gates.set(fg.DYNAMIC_REPARTITION, True)
+        # the journal checkpoint + group-commit arm: every real plugin
+        # runs the append-only journal (writer thread + actuation pool),
+        # so the soak's kill/restart adversity exercises journal
+        # recovery, compaction, and CDI spec restoration continuously
+        gates.set(fg.JOURNAL_CHECKPOINT, True)
         self.cluster = FakeCluster()
         self.handle = fencing_mod.install_admission(self.cluster)
         self.observer = ClientSets(cluster=self.cluster)

@@ -63,6 +63,13 @@ LEAK_GAUGE_DELTAS = {
 }
 LEAK_STATE_DIR_BYTES_THRESHOLD = 4096
 
+#: journal records past this flag JOURNAL_BLOAT — mirrors the plugin's
+#: own compaction trigger (plugin/checkpoint.py
+#: JOURNAL_COMPACT_MAX_RECORDS): a healthy writer compacts before the
+#: journal ever reaches it, so a bundle catching it above means the
+#: compactor is stalled or erroring.
+JOURNAL_BLOAT_RECORDS_THRESHOLD = 512
+
 
 @dataclass
 class Finding:
@@ -214,27 +221,75 @@ def _read_json(path: str) -> Optional[Dict]:
         return None
 
 
-def _checkpoint_owned_devices(obj: Dict) -> Optional[List[str]]:
-    """Canonical device names PrepareCompleted entries own, from a raw
-    checkpoint envelope (v2 preferred, v1 fallback; checksums are NOT
-    verified — the doctor reads what it can). None when no version
-    parses."""
+def _checkpoint_claims(obj: Dict) -> Optional[Dict[str, Dict]]:
+    """Raw claim-entry objects from a checkpoint envelope (v2 preferred,
+    v1 fallback; checksums are NOT verified — the doctor reads what it
+    can). None when no version parses."""
     for version in ("v2", "v1"):
         payload = obj.get(version)
         if not isinstance(payload, dict):
             continue
-        names: List[str] = []
-        for entry in (payload.get("claims") or {}).values():
-            if not isinstance(entry, dict):
-                continue
-            # v1 records only completed claims (no state field)
-            if entry.get("state", "PrepareCompleted") != "PrepareCompleted":
-                continue
-            for dev in entry.get("preparedDevices") or []:
-                if isinstance(dev, dict) and dev.get("canonicalName"):
-                    names.append(dev["canonicalName"])
-        return names
+        return {uid: entry for uid, entry in
+                (payload.get("claims") or {}).items()
+                if isinstance(entry, dict)}
     return None
+
+
+def _owned_devices(claims: Dict[str, Dict]) -> List[str]:
+    """Canonical device names PrepareCompleted entries own."""
+    names: List[str] = []
+    for entry in claims.values():
+        # v1 records only completed claims (no state field)
+        if entry.get("state", "PrepareCompleted") != "PrepareCompleted":
+            continue
+        for dev in entry.get("preparedDevices") or []:
+            if isinstance(dev, dict) and dev.get("canonicalName"):
+                names.append(dev["canonicalName"])
+    return names
+
+
+def _checkpoint_owned_devices(obj: Dict) -> Optional[List[str]]:
+    claims = _checkpoint_claims(obj)
+    return None if claims is None else _owned_devices(claims)
+
+
+def _scan_journal_file(full: str, base_obj: Optional[Dict]) -> Dict:
+    """Offline read of an append-only checkpoint journal: frame/CRC scan
+    plus a replay of in-generation records over the base checkpoint, so
+    findings (SUBSLICE_ORPHANS, JOURNAL_BLOAT) see the same state the
+    plugin would recover — not the stale compacted base."""
+    from tpu_dra_driver.plugin import checkpoint as _ckpt
+
+    info: Dict = {}
+    try:
+        records, good_bytes, bad_index = _ckpt.scan_journal(full)
+    except Exception as e:  # noqa: BLE001 — best-effort offline read
+        info["error"] = f"{type(e).__name__}: {e}"
+        return info
+    info["records"] = len(records)
+    info["good_bytes"] = good_bytes
+    if bad_index is not None:
+        info["bad_record_index"] = bad_index
+    base_gen = 0
+    claims: Dict[str, Dict] = {}
+    if base_obj is not None:
+        base_gen = int((base_obj.get("journal") or {}).get("gen") or 0)
+        claims = dict(_checkpoint_claims(base_obj) or {})
+    applied = stale = 0
+    for rec in records:
+        if rec.gen != base_gen:
+            stale += 1
+            continue
+        applied += 1
+        if rec.op == _ckpt.JOURNAL_OP_DEL:
+            claims.pop(rec.uid, None)
+        elif isinstance(rec.entry, dict):
+            claims[rec.uid] = rec.entry
+    info["base_gen"] = base_gen
+    info["applied"] = applied
+    info["stale"] = stale
+    info["replayed_owned_devices"] = _owned_devices(claims)
+    return info
 
 
 def collect_state_dir(path: str) -> Dict:
@@ -249,6 +304,8 @@ def collect_state_dir(path: str) -> Dict:
         return out
     manifest_partitions: Optional[List[str]] = None
     owned_devices: Optional[List[str]] = None
+    base_raw: Optional[Dict] = None
+    journal_file: Optional[Tuple[str, str, int]] = None
     for dirpath, _, files in os.walk(path):
         for name in files:
             full = os.path.join(dirpath, name)
@@ -270,14 +327,27 @@ def collect_state_dir(path: str) -> Dict:
                         "live": manifest_partitions,
                     }
                 out["checkpoints"].append({"file": rel, "bytes": size})
+            elif name == "checkpoint.journal":
+                journal_file = (full, rel, size)
+                out["checkpoints"].append({"file": rel, "bytes": size})
             elif name.endswith((".json", ".chk")) or "checkpoint" in name:
                 if name == "checkpoint.json":
-                    raw = _read_json(full)
-                    if raw is not None:
-                        parsed = _checkpoint_owned_devices(raw)
+                    base_raw = _read_json(full)
+                    if base_raw is not None:
+                        parsed = _checkpoint_owned_devices(base_raw)
                         if parsed is not None:
                             owned_devices = (owned_devices or []) + parsed
                 out["checkpoints"].append({"file": rel, "bytes": size})
+    if journal_file is not None:
+        full, rel, size = journal_file
+        info = _scan_journal_file(full, base_raw)
+        info.update({"file": rel, "bytes": size})
+        out["journal"] = info
+        replayed = info.get("replayed_owned_devices")
+        if replayed is not None:
+            # journal mode: replayed state supersedes the compacted base
+            # (the base alone misses every claim since the last compact)
+            owned_devices = list(replayed)
     if manifest_partitions is not None:
         owned = set(owned_devices or [])
         out["subslice_orphans"] = sorted(
@@ -516,6 +586,30 @@ def run_findings(bundle: Dict) -> List[Finding]:
                 f"{len(state['quarantined'])} quarantined checkpoint "
                 f"file(s) on disk under {state['path']}",
                 {"files": [q["file"] for q in state["quarantined"]]}))
+        journal = state.get("journal") or {}
+        if journal.get("records", 0) > JOURNAL_BLOAT_RECORDS_THRESHOLD:
+            findings.append(Finding(
+                WARNING, "JOURNAL_BLOAT", name,
+                f"checkpoint journal holds {journal['records']} records "
+                f"(compaction trigger is "
+                f"{JOURNAL_BLOAT_RECORDS_THRESHOLD}) under "
+                f"{state['path']}: the compactor is not keeping up — "
+                f"replay-on-restart grows with the journal; check "
+                f"dra_journal_compaction_seconds and the plugin log for "
+                f"swallowed compaction errors",
+                {"records": journal.get("records"),
+                 "bytes": journal.get("bytes"),
+                 "stale": journal.get("stale")}))
+        if journal.get("bad_record_index") is not None:
+            findings.append(Finding(
+                WARNING, "JOURNAL_CORRUPT_RECORDS", name,
+                f"checkpoint journal has undecodable record(s) starting "
+                f"at index {journal['bad_record_index']} "
+                f"({state['path']}): a torn tail is benign (recovery "
+                f"truncates it) but mid-file damage quarantines on the "
+                f"next restart",
+                {"bad_record_index": journal.get("bad_record_index"),
+                 "good_bytes": journal.get("good_bytes")}))
         orphans = state.get("subslice_orphans") or []
         if orphans:
             findings.append(Finding(
