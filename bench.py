@@ -1804,6 +1804,259 @@ def bench_slo_overhead(n_iters: int = 200_000, eval_rounds: int = 50,
     return out
 
 
+def _commit_phase_breakdown(before: dict, after: dict) -> dict:
+    """Per-phase stats from two ``ALLOCATION_COMMIT_PHASE_SECONDS
+    .snapshots()`` captures: {phase: {n, p50_ms, p99_ms, mean_ms}} over
+    the window between them (the same delta rule the SLO engine uses)."""
+    from tpu_dra_driver.pkg.metrics import quantile_of_snapshot
+
+    out = {}
+    for key, snap in after.items():
+        window = snap.delta(before.get(key))
+        if window.count <= 0:
+            continue
+        phase = key[0] if key else ""
+        p50 = quantile_of_snapshot(window, 0.5) or 0.0
+        p99 = quantile_of_snapshot(window, 0.99) or 0.0
+        out[phase] = {
+            "n": window.count,
+            "p50_ms": round(p50 * 1e3, 4),
+            "p99_ms": round(p99 * 1e3, 4),
+            "mean_ms": round(window.sum / window.count * 1e3, 4),
+        }
+    return out
+
+
+def bench_allocation_commit(n_claims: int = 64,
+                            n_cross_claims: int = 16,
+                            nodes_per_slot: int = 12) -> dict:
+    """Commit-path micro-attribution (ISSUE 20): where inside
+    ``allocation.commit`` does the time go, per topology?
+
+    Three arms, each read from the ``dra_allocation_commit_phase_
+    seconds`` histogram's per-phase window delta (the same numbers the
+    child spans feed the critical-path analyzer):
+
+    - **single_shard** — one standalone Allocator over a local fleet:
+      verify_read + status_write only, the floor every commit pays;
+    - **cross_shard** — two fenced controller replicas with remote
+      reserves: reserve_phase1 (containing await_grants) +
+      phase2_graduate join the path;
+    - **contended** — two allocators racing the SAME claim set from two
+      threads: lost verify-on-commit races exercise the re-read and
+      unwind phases.
+
+    Recorded under ``allocation_commit`` in BENCH_DETAIL.json and gated
+    by tests/test_bench_artifact.py."""
+    import threading
+
+    from tpu_dra_driver.kube import fencing as fencing_mod
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        AllocationControllerConfig,
+        ShardWiring,
+    )
+    from tpu_dra_driver.kube.allocator import Allocator
+    from tpu_dra_driver.kube.client import ClientSets
+    from tpu_dra_driver.kube.fake import FakeCluster
+    from tpu_dra_driver.kube.fencing import FencingTokens
+    from tpu_dra_driver.kube.sharding import ShardRing, shard_slots
+    from tpu_dra_driver.pkg.metrics import ALLOCATION_COMMIT_PHASE_SECONDS
+    from tpu_dra_driver.testing.scenarios import _gen_slice
+
+    out = {}
+
+    def snapshots():
+        return ALLOCATION_COMMIT_PHASE_SECONDS.snapshots()
+
+    # --- arm 1: single shard — the no-coordination floor ---------------
+    clients = _sweep_fleet(n_nodes=16)
+    claims = _sweep_claims(clients, n_claims)
+    alloc = Allocator(clients, driver_name=_SWEEP_DRIVER)
+    before = snapshots()
+    t0 = time.perf_counter()
+    results = alloc.allocate_batch(claims)
+    wall = time.perf_counter() - t0
+    committed = sum(1 for r in results.values() if r.committed)
+    assert committed == n_claims, f"single-shard arm: {committed} committed"
+    out["single_shard"] = {
+        "claims": committed,
+        "wall_ms": round(wall * 1e3, 2),
+        "phases": _commit_phase_breakdown(before, snapshots()),
+    }
+
+    # --- arm 2: cross shard — fenced two-replica remote reserves -------
+    cluster = FakeCluster()
+    fencing_mod.install_admission(cluster)
+    obs = ClientSets(cluster=cluster)
+    ring = ShardRing(shard_slots(2))
+    per_slot = {s: 0 for s in ring.members}
+    i = 0
+    while min(per_slot.values()) < nodes_per_slot:
+        node = f"bc-{i}"
+        i += 1
+        slot = ring.owner(node)
+        if per_slot[slot] >= nodes_per_slot:
+            continue
+        per_slot[slot] += 1
+        obs.resource_slices.create(_gen_slice(node))
+    for slot in ring.members:
+        obs.leases.create({
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": f"allocation-controller-{slot}",
+                         "namespace": "tpu-dra-driver"},
+            "spec": {"holderIdentity": f"r-{slot}",
+                     "renewTime": time.time(),
+                     "leaseDurationSeconds": 15.0,
+                     "leaseTransitions": 1}})
+    cfg = AllocationControllerConfig(
+        workers=4, batch_max=32, retry_interval=0.5,
+        reserve_grant_timeout=3.0, remote_reserves=True)
+    controllers = []
+    for slot in ring.members:
+        ctrl = AllocationController(
+            ClientSets(cluster=cluster), cfg,
+            shard=ShardWiring(ring, owned={slot}),
+            identity=f"bench-{slot}")
+        ctrl.set_fencing(FencingTokens(
+            ring, (lambda s, mine=slot: 1 if s == mine else None)))
+        controllers.append(ctrl)
+    before = snapshots()
+    for ctrl in controllers:
+        ctrl.start()
+    try:
+        t0 = time.perf_counter()
+        for k in range(n_cross_claims):
+            obs.resource_claims.create({
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": f"cb-{k}", "namespace": "bench",
+                             "uid": f"cb-uid-{k:04d}"},
+                "spec": {"devices": {"requests": [
+                    {"name": "tpu", "count": 1,
+                     "selectors": [{"attribute": "type",
+                                    "equals": "chip"}]}]}}})
+
+        def allocated() -> int:
+            return sum(1 for c in obs.resource_claims.list()
+                       if (c.get("status") or {}).get("allocation"))
+
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and allocated() < n_cross_claims:
+            time.sleep(0.01)
+        wall = time.perf_counter() - t0
+        done = allocated()
+    finally:
+        for ctrl in controllers:
+            ctrl.stop()
+    assert done == n_cross_claims, f"cross-shard arm: {done} allocated"
+    out["cross_shard"] = {
+        "claims": done,
+        "wall_ms": round(wall * 1e3, 2),
+        "phases": _commit_phase_breakdown(before, snapshots()),
+    }
+
+    # --- arm 3: contended — two allocators race the same claim set -----
+    clients = _sweep_fleet(n_nodes=8)
+    claims = _sweep_claims(clients, n_claims // 2)
+    racers = [Allocator(clients, driver_name=_SWEEP_DRIVER)
+              for _ in range(2)]
+    barrier = threading.Barrier(2)
+    race_out = [None, None]
+
+    def race(idx: int) -> None:
+        barrier.wait()
+        race_out[idx] = racers[idx].allocate_batch(list(claims))
+
+    before = snapshots()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=race, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    committed = sum(1 for res in race_out for r in (res or {}).values()
+                    if r.committed)
+    # every claim is allocated exactly once; the loser of each race
+    # re-reads (verify_read) and unwinds instead of double-committing
+    assert committed == len(claims), f"contended arm: {committed} committed"
+    out["contended"] = {
+        "claims": committed,
+        "racers": 2,
+        "wall_ms": round(wall * 1e3, 2),
+        "phases": _commit_phase_breakdown(before, snapshots()),
+    }
+
+    # headline: the phase the slowest arm spends most of its p50 in
+    def dominant(arm: dict) -> str:
+        phases = arm["phases"]
+        return max(phases, key=lambda p: phases[p]["p50_ms"]) \
+            if phases else ""
+
+    out["dominant_phase"] = {arm: dominant(out[arm])
+                             for arm in ("single_shard", "cross_shard",
+                                         "contended")}
+    for arm in ("single_shard", "cross_shard", "contended"):
+        phases = out[arm]["phases"]
+        log(f"  {arm}: {out[arm]['claims']} commits in "
+            f"{out[arm]['wall_ms']:.1f} ms; dominant phase "
+            f"{dominant(out[arm]) or 'n/a'}; "
+            f"{ {p: s['p50_ms'] for p, s in sorted(phases.items())} } p50 ms")
+    return out
+
+
+def bench_timeseries_overhead(n_iters: int = 200_000,
+                              tick_rounds: int = 50) -> dict:
+    """Time-series ring cost accounting (ISSUE 20): the acceptance
+    proof that the metric HOT PATH pays nothing for the ring — it
+    samples reader-side on its own thread, so a histogram observe with
+    the ring armed must cost the same ns/op as disarmed (pinned < 2 us
+    by tests/test_bench_artifact.py, like the tracing/SLO disabled
+    paths) — plus what the reader side itself costs: one full-registry
+    ``tick()`` and one ``/debug/timeseries`` payload render."""
+    from tpu_dra_driver.pkg import metrics
+
+    child = metrics.DEFAULT_REGISTRY.histogram(
+        "dra_claim_prepare_duration_seconds",
+        "NodePrepareResources wall time per claim by result",
+        ("result",)).labels("ok")
+
+    def observe_loop():
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            child.observe(0.003)
+        return (time.perf_counter() - t0) / n_iters * 1e9  # ns/op
+
+    out = {}
+    metrics.timeseries_reset()
+    out["observe_ns_ring_off"] = round(
+        min(observe_loop() for _ in range(3)), 1)
+    # armed ring, no sampler thread (interval is irrelevant: ticks are
+    # driven manually below so the measured loops share no scheduler)
+    ring = metrics.timeseries_configure(interval=3600.0, start=False)
+    try:
+        ring.tick()   # populate series so the armed arm is realistic
+        out["observe_ns_ring_on"] = round(
+            min(observe_loop() for _ in range(3)), 1)
+        out["observe_overhead_ns"] = round(
+            out["observe_ns_ring_on"] - out["observe_ns_ring_off"], 1)
+        ticks = []
+        for _ in range(tick_rounds):
+            t0 = time.perf_counter()
+            ring.tick()
+            ticks.append((time.perf_counter() - t0) * 1e3)
+        out["tick_ms"] = round(statistics.median(ticks), 3)
+        t0 = time.perf_counter()
+        payload = ring.payload()
+        out["payload_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        out["series"] = len(payload["series"])
+    finally:
+        metrics.timeseries_reset()
+    out["n_iters"] = n_iters
+    return out
+
+
 # substrings that identify a TUNNEL/TRANSPORT failure inside a
 # JaxRuntimeError; anything else (device OOM, a genuine kernel fault)
 # must not be retried — a passing retry would launder it into a clean
@@ -2233,6 +2486,8 @@ SUMMARY_KEYS = [
     "soak_alloc_burst_per_sec",
     "trace_disabled_ns", "metrics_render_ms",
     "slo_eval_ms", "criticalpath_walk_us",
+    "commit_dominant_phase", "commit_single_shard_wall_ms",
+    "timeseries_observe_overhead_ns", "timeseries_tick_ms",
     "backend", "devices",
     "matmul_tflops_bf16_steady", "matmul_mfu",
     "flash_attn_tflops", "flash_vs_splash",
@@ -2471,6 +2726,27 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"  slo overhead bench failed ({type(e).__name__}: {e})")
 
+    log("[bench] allocation-commit micro-attribution (single-shard / "
+        "cross-shard / contended)…")
+    commit_bench = {}
+    try:
+        commit_bench = bench_allocation_commit()
+    except Exception as e:  # noqa: BLE001
+        log(f"  allocation-commit bench failed ({type(e).__name__}: {e})")
+
+    log("[bench] time-series ring overhead (observe hot path armed vs "
+        "disarmed, tick + payload cost)…")
+    ts_bench = {}
+    try:
+        ts_bench = bench_timeseries_overhead()
+        log(f"  observe ns/op: ring off {ts_bench['observe_ns_ring_off']:.0f}"
+            f" / on {ts_bench['observe_ns_ring_on']:.0f} "
+            f"(delta {ts_bench['observe_overhead_ns']:.0f}); tick "
+            f"{ts_bench['tick_ms']:.2f} ms over {ts_bench['series']} "
+            f"series; payload {ts_bench['payload_ms']:.2f} ms")
+    except Exception as e:  # noqa: BLE001
+        log(f"  timeseries overhead bench failed ({type(e).__name__}: {e})")
+
     log("[bench] accelerator microbenchmarks…")
     accel = bench_accelerator()
 
@@ -2589,6 +2865,21 @@ def main() -> int:
         **({"slo_eval_ms": slo_bench["slo_eval_ms"],
             "criticalpath_walk_us": slo_bench["criticalpath_walk_us"]}
            if slo_bench else {}),
+        # commit-path micro-attribution (per-sub-segment p50/p99 per
+        # topology arm under the allocation_commit key)
+        "allocation_commit": commit_bench,
+        **({"commit_dominant_phase":
+                commit_bench["dominant_phase"]["cross_shard"],
+            "commit_single_shard_wall_ms":
+                commit_bench["single_shard"]["wall_ms"]}
+           if commit_bench else {}),
+        # time-series ring cost (hot-path delta is the "ring is free to
+        # the data plane" proof; gated < 2 us by test_bench_artifact)
+        "timeseries_overhead": ts_bench,
+        **({"timeseries_observe_overhead_ns":
+                ts_bench["observe_overhead_ns"],
+            "timeseries_tick_ms": ts_bench["tick_ms"]}
+           if ts_bench else {}),
         # crash-recovery arms (full evidence under the recovery key)
         "recovery": recovery,
         **({"recovery_plugin_kill_ms":

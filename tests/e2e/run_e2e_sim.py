@@ -497,18 +497,26 @@ def phase_doctor(root: str) -> dict:
             "allocation-controller",
             ["-m", "tpu_dra_driver.cmd.allocation_controller",
              "--kube-backend", "rest", "--kubeconfig", cluster.kubeconfig,
-             "--http-endpoint", f"127.0.0.1:{ac_port}", "-v", "5"],
+             "--http-endpoint", f"127.0.0.1:{ac_port}", "-v", "5",
+             # fast ring ticks so the quick-mode run accumulates a
+             # usable delta window (>= 2 points) before the doctor
+             # collects — the bundle must carry sparklines.txt
+             "--timeseries-interval", "0.5"],
             os.path.join(log_dir, "allocation-controller.log"))
-        # unsatisfiable: no device publishes this type — the controller
-        # parks it (AllocationParked Event + gauge + /debug/allocator)
+        # unsatisfiable: no device publishes this model — the controller
+        # parks it (AllocationParked Event + gauge + /debug/allocator).
+        # "model" is deliberately NOT an indexed attribute, so every
+        # candidate flows through full selector evaluation and the
+        # explain record attributes the park to selector-false (an
+        # indexed miss would report an empty candidate set instead)
         cluster.clients.resource_claims.create({
             "apiVersion": "resource.k8s.io/v1beta1",
             "kind": "ResourceClaim",
             "metadata": {"name": "unsatisfiable", "namespace": "e2e"},
             "spec": {"devices": {"requests": [
                 {"name": "tpu", "count": 1,
-                 "selectors": [{"attribute": "type",
-                                "equals": "no-such-type"}]}]}},
+                 "selectors": [{"attribute": "model",
+                                "equals": "no-such-model"}]}]}},
         })
 
         # drive slow prepares: every claim succeeds but takes ~0.8s,
@@ -589,6 +597,75 @@ def phase_doctor(root: str) -> dict:
         results["parked"] = {"claims": state["parked_claims"]}
         log(f"parked OK: {state['parked_claims']}")
 
+        # decision explainability, cross-process: a pending satisfiable
+        # claim the CONTROLLER (not this harness's scheduler role)
+        # allocates, then its full decision funnel fetched over HTTP
+        # from /debug/explain/<uid> on the controller subprocess
+        explained = cluster.clients.resource_claims.create({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "explained", "namespace": "e2e"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "count": 1,
+                 "selectors": [{"attribute": "type",
+                                "equals": "chip"}]}]}},
+        })
+        explained_uid = explained["metadata"]["uid"]
+
+        def controller_allocated():
+            c = cluster.clients.resource_claims.get("explained", "e2e")
+            return c if (c.get("status") or {}).get("allocation") else None
+        wait_for(controller_allocated, 30,
+                 "controller-allocated 'explained' claim")
+
+        rec = http_get_json(
+            f"http://127.0.0.1:{ac_port}/debug/explain/{explained_uid}",
+            timeout=5)
+        if rec.get("outcome") != "allocated" or not rec.get("devices"):
+            raise HarnessError(f"explain record not allocated: {rec}")
+        req0 = (rec.get("requests") or [{}])[0]
+        if not (req0.get("candidates", 0) >= 1
+                and req0.get("picked") == 1
+                and req0.get("index_probe", {}).get("used_index")):
+            raise HarnessError(f"explain funnel malformed: {rec}")
+
+        # the parked claim's record names WHY: every candidate was
+        # rejected by the (non-indexed) selector, and the same reason
+        # rides the AllocationParked Event — `kubectl describe` answers
+        # the question without reaching the controller's debug port
+        parked_uid = state["parked_claims"][0]["uid"]
+        prec = http_get_json(
+            f"http://127.0.0.1:{ac_port}/debug/explain/{parked_uid}",
+            timeout=5)
+        if prec.get("top_rejection") != "selector-false":
+            raise HarnessError(
+                f"parked explain top_rejection not selector-false: {prec}")
+
+        def parked_event():
+            evs = [e for e in cluster.clients.events.list()
+                   if e.get("reason") == "AllocationParked"]
+            return evs or None
+        pevs = wait_for(parked_event, 15, "AllocationParked Event")
+        pmsg = pevs[0].get("message", "")
+        if "top rejection: selector-false" not in pmsg:
+            raise HarnessError(
+                f"AllocationParked Event lacks the explain reason: {pmsg}")
+        results["explain"] = {
+            "allocated": {"uid": explained_uid,
+                          "candidates": req0["candidates"],
+                          "picked": req0["picked"],
+                          "used_index": True,
+                          "devices": rec["devices"]},
+            "parked": {"uid": parked_uid,
+                       "top_rejection": prec["top_rejection"],
+                       "rejections": prec.get("rejections", {}),
+                       "event_carries_reason": True},
+        }
+        log(f"explain OK: allocated funnel candidates="
+            f"{req0['candidates']} picked={req0['picked']} devices="
+            f"{rec['devices']}; parked top rejection "
+            f"{prec['top_rejection']} on the Event")
+
         # brownout drill: an in-process RestCluster (this harness is a
         # component too) driven into an OPEN breaker via fault injection
         harness_srv = DebugHTTPServer(("127.0.0.1", 0))
@@ -661,7 +738,11 @@ def phase_doctor(root: str) -> dict:
         for member in ("tpu-plugin/metrics.txt", "tpu-plugin/slo.json",
                        "tpu-plugin/criticalpath.json",
                        "tpu-plugin/vars.json",
+                       "tpu-plugin/timeseries.json",
                        "allocation-controller/allocator.json",
+                       "allocation-controller/explain.json",
+                       "allocation-controller/timeseries.json",
+                       "allocation-controller/sparklines.txt",
                        "e2e-harness/metrics.txt", "events.json",
                        "state_dirs.json", "findings.json", "summary.txt"):
             if member not in members:

@@ -585,3 +585,140 @@ def test_subslice_orphans_end_to_end_from_live_plugin(tmp_path):
     finally:
         fi.reset()
         drill.crash()
+
+
+# ---------------------------------------------------------------------------
+# commit micro-attribution: per-phase quantiles + COMMIT_STALL
+# ---------------------------------------------------------------------------
+
+
+def _commit_phase_metrics(slow_phase="status_write", slow_value=0.5,
+                          n_slow=100):
+    reg = Registry()
+    h = reg.histogram("dra_allocation_commit_phase_seconds", "t",
+                      ("phase",), buckets=(0.001, 0.01, 0.1, 1.0))
+    for _ in range(200):
+        h.labels("verify_read").observe(0.0005)
+    for _ in range(n_slow):
+        h.labels(slow_phase).observe(slow_value)
+    return reg.render()
+
+
+def test_histogram_quantile_by_splits_label_values():
+    samples = doctor.parse_metrics_text(_commit_phase_metrics())
+    per_phase = doctor.histogram_quantile_by(
+        samples, "dra_allocation_commit_phase_seconds", 0.99, "phase")
+    # blended family quantile would hide the slow phase behind the fast
+    # one's 200 cheap samples; the by-label split must not
+    assert per_phase["verify_read"] == 0.001
+    assert per_phase["status_write"] == 1.0
+    assert doctor.histogram_quantile_by(
+        samples, "dra_absent_seconds", 0.99, "phase") == {}
+
+
+def test_finding_commit_stall_names_dominant_phase():
+    bundle = {"components": {"alloc": {
+        "metrics": _commit_phase_metrics()}}}
+    f = next(f for f in doctor.run_findings(bundle)
+             if f.code == "COMMIT_STALL")
+    assert f.severity == doctor.WARNING
+    assert f.details["phase"] == "status_write"
+    assert f.details["p99_upper_bound_s"] \
+        >= doctor.COMMIT_STALL_P99_THRESHOLD_S
+    assert "status_write" in f.message
+    # a healthy commit path (everything sub-ms) raises nothing
+    healthy = {"components": {"alloc": {"metrics": _commit_phase_metrics(
+        slow_value=0.0005)}}}
+    assert not [f for f in doctor.run_findings(healthy)
+                if f.code == "COMMIT_STALL"]
+
+
+def test_finding_parked_claims_reports_explain_reasons():
+    bundle = {"components": {"alloc": {
+        "metrics": _metrics_text(
+            dra_allocator_parked_claims=[({}, 3)]),
+        "allocator": {"parked_claims": [],
+                      "parked_reasons": {"selector-false": 2,
+                                         "counter-exhausted": 1}},
+    }}}
+    f = next(f for f in doctor.run_findings(bundle)
+             if f.code == "PARKED_CLAIMS")
+    assert f.details["by_reason"] == {"selector-false": 2,
+                                     "counter-exhausted": 1}
+    assert "selector-false" in f.message
+
+
+# ---------------------------------------------------------------------------
+# time-series ring reads: deltas, trend fits, sparklines
+# ---------------------------------------------------------------------------
+
+
+def _ring_art(series, metrics_text="", interval=5.0):
+    return {"metrics": metrics_text,
+            "timeseries": {"enabled": True, "interval_s": interval,
+                           "capacity": 360, "series": series}}
+
+
+def test_timeseries_delta_and_slope_skip_recording_rules():
+    art = _ring_art({
+        "dra_watch_streams_active{}": [[100.0, 4], [105.0, 6], [110.0, 9]],
+        "dra_watch_streams_active:rate{}": [[105.0, 0.4], [110.0, 0.6]],
+    })
+    assert doctor.timeseries_delta(art, "dra_watch_streams_active") == 5
+    slope = doctor.timeseries_slope(art, "dra_watch_streams_active")
+    assert slope == pytest.approx(0.5)
+    # absent family / disarmed ring -> None, never 0.0
+    assert doctor.timeseries_delta(art, "dra_absent") is None
+    assert doctor.timeseries_slope({"timeseries": {"enabled": False}},
+                                   "dra_watch_streams_active") is None
+
+
+def test_leak_suspected_trend_fit_requires_sustained_slope():
+    # monotone climb across the ring: delta >= threshold AND slope > 0
+    climbing = _ring_art({"dra_watch_streams_active{}": [
+        [100.0 + 5 * i, 4 + i] for i in range(10)]})
+    f = next(f for f in doctor.run_findings(
+        {"components": {"w": climbing}}) if f.code == "LEAK_SUSPECTED")
+    assert f.details["source"] == "timeseries"
+    assert f.details["grew"]["dra_watch_streams_active"][
+        "slope_per_s"] > 0
+    # a step that already settled (reconnect wave): same window delta,
+    # but the series has been FLAT since — resample-style two-point
+    # deltas paged on this; the trend fit must not
+    settled = _ring_art({"dra_watch_streams_active{}": (
+        [[100.0, 10.0], [105.0, 4.0]]
+        + [[110.0 + 5 * i, 4.0] for i in range(8)])})
+    assert not [f for f in doctor.run_findings({"components": {"w": settled}})
+                if f.code == "LEAK_SUSPECTED"]
+
+
+def test_lease_flapping_from_timeseries_window():
+    art = _ring_art({"dra_leader_transitions_total{}": [
+        [100.0 + 5 * i, 2 * i] for i in range(6)]},
+        metrics_text=_metrics_text(
+            dra_leader_transitions_total=[({}, 10)]))
+    f = next(f for f in doctor.run_findings({"components": {"c": art}})
+             if f.code == "LEASE_FLAPPING")
+    assert f.details["source"] == "timeseries"
+    assert f.details["delta_in_window"] == 10
+    assert "time-series ring" in f.message
+
+
+def test_sparkline_normalizes_and_handles_flat_series():
+    line = doctor.sparkline([0.0, 1.0, 2.0, 3.0])
+    assert len(line) == 4
+    assert line[0] == doctor._SPARK_CHARS[0]
+    assert line[-1] == doctor._SPARK_CHARS[-1]
+    assert doctor.sparkline([5.0, 5.0, 5.0]) == doctor._SPARK_CHARS[0] * 3
+    assert doctor.sparkline([]) == ""
+
+
+def test_component_sparklines_lists_ring_series():
+    art = _ring_art({
+        "dra_watch_streams_active{}": [[100.0, 1], [105.0, 3]],
+        "x_lat_seconds:p99{}": [[100.0, 0.2], [105.0, 0.4]],
+    })
+    text = doctor.component_sparklines(art)
+    assert "dra_watch_streams_active{}" in text
+    assert "x_lat_seconds:p99{}" in text
+    assert "series=2" in text

@@ -81,9 +81,22 @@ def test_sim_e2e_doctor(tmp_path):
     assert doc["criticalpath"]["traces_analyzed"] >= 1
     assert doc["parked"]["claims"], doc["parked"]
     assert doc["breaker_open"] is True
+    # explainability acceptance: the decision trace crosses the process
+    # boundary — the controller subprocess allocated the claim, and its
+    # /debug/explain/<uid> served the full funnel over HTTP; the parked
+    # claim's record names WHY, and the same reason rides the
+    # AllocationParked Event
+    exp = doc["explain"]
+    assert exp["allocated"]["devices"], exp
+    assert exp["allocated"]["picked"] == 1
+    assert exp["allocated"]["candidates"] >= 1
+    assert exp["allocated"]["used_index"] is True
+    assert exp["parked"]["top_rejection"] == "selector-false"
+    assert exp["parked"]["rejections"]["selector-false"] >= 1
+    assert exp["parked"]["event_carries_reason"] is True
     assert {"SLO_BURNING", "PARKED_CLAIMS", "BREAKER_OPEN"} <= \
         set(doc["doctor"]["findings"])
-    assert doc["doctor"]["bundle_members"] >= 10
+    assert doc["doctor"]["bundle_members"] >= 14
 
 
 def test_sim_e2e_compute_domain(tmp_path):

@@ -1,0 +1,436 @@
+"""Allocation decision explainability (kube/explain.py): the bounded
+decision ring and its eviction counter, frozen reads under live
+batches, the disabled path's zero cost, funnel correctness through a
+real Allocator, the /debug/explain[/<uid>] and /debug/timeseries
+endpoints, AllocationParked Event enrichment with the explain-derived
+top rejection, the commit_phase span+histogram helper, and the
+in-process time-series ring (pkg/metrics.py TimeSeriesRing).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra_driver.kube import explain
+from tpu_dra_driver.kube.allocator import Allocator
+from tpu_dra_driver.kube.client import ClientSets
+from tpu_dra_driver.pkg import metrics, tracing
+from tpu_dra_driver.pkg.metrics import (
+    DebugHTTPServer,
+    Registry,
+    TimeSeriesRing,
+    least_squares_slope,
+    quantile_of_snapshot,
+)
+from tpu_dra_driver.testing.scenarios import synthetic_slice
+
+DRIVER = "tpu.google.com"
+
+
+@pytest.fixture(autouse=True)
+def _clean_explain():
+    explain.reset()
+    yield
+    explain.reset()
+    metrics.timeseries_reset()
+
+
+def _record(uid, outcome="error", rejections=None):
+    rec = explain.ExplainRecord(uid, f"ns/{uid}", DRIVER, None)
+    req = rec.begin_request("tpu", 1)
+    req.candidates = 4
+    for reason, n in (rejections or {"selector-false": 4}).items():
+        req.rejections[reason] = n
+    rec.finished_unix = rec.started_unix
+    rec.outcome = outcome
+    return rec
+
+
+def _claim(uid, name, selectors=None):
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "ns", "uid": uid},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "count": 1,
+             "selectors": selectors
+             or [{"attribute": "type", "equals": "chip"}]}]}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounded_eviction_ticks_counter():
+    ring = explain.configure(capacity=4)
+    e0 = explain.EXPLAIN_EVICTED.value
+    for i in range(10):
+        ring.append(_record(f"uid-{i}"))
+    assert len(ring) == 4
+    assert explain.EXPLAIN_EVICTED.value - e0 == 6
+    payload = ring.payload()
+    assert payload["size"] == 4 and payload["capacity"] == 4
+    assert payload["evicted"] >= 6
+    # newest first; the evicted oldest records are gone from lookup too
+    assert payload["records"][0]["claim_uid"] == "uid-9"
+    assert ring.lookup("uid-0") is None
+    assert ring.lookup("uid-9")["claim_uid"] == "uid-9"
+
+
+def test_latest_attempt_wins_lookup():
+    ring = explain.configure(capacity=8)
+    ring.append(_record("uid-a", outcome="error"))
+    ring.append(_record("uid-a", outcome="allocated"))
+    assert ring.lookup("uid-a")["outcome"] == "allocated"
+
+
+def test_record_invisible_until_finished():
+    """Frozen reads: a record under construction by a worker thread is
+    NOT in the ring — payload()/lookup() only ever see finished,
+    immutable records, never a half-built funnel."""
+    explain.configure(capacity=8)
+    started = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        rec = explain.begin(_claim("uid-live", "live"), DRIVER)
+        rec.begin_request("tpu", 1).candidates = 7
+        started.set()
+        release.wait(timeout=5)
+        explain.finish(rec, "error", detail="done")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    try:
+        assert started.wait(timeout=5)
+        # mid-build: nothing visible
+        assert explain.lookup("uid-live") is None
+        assert explain.ring().payload()["records"] == []
+    finally:
+        release.set()
+        t.join(timeout=5)
+    rec = explain.lookup("uid-live")
+    assert rec["outcome"] == "error"
+    assert rec["requests"][0]["candidates"] == 7
+    assert rec["duration_ms"] is not None
+
+
+def test_disabled_path_returns_none_and_is_free():
+    """The tracing/faultinject discipline: disarmed explain allocates
+    nothing and begin/current are a bool check — 100k rounds well under
+    a second (generous absolute bound, same shape as
+    test_tracing.py::test_disabled_span_microbench)."""
+    assert not explain.enabled()
+    assert explain.begin(_claim("u", "c"), DRIVER) is None
+    assert explain.current() is None
+    assert explain.lookup("u") is None
+    explain.finish(None, "error")          # no-op, no crash
+    t0 = time.monotonic()
+    claim = _claim("u", "c")
+    for _ in range(100_000):
+        rec = explain.begin(claim, DRIVER)
+        explain.current()
+        explain.finish(rec, "x")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.0, f"disabled explain took {elapsed:.3f}s per 100k"
+
+
+def test_top_rejection_and_summary():
+    rec = _record("u", rejections={"held-by-other": 2, "selector-false": 5})
+    rec.note_rejection("remote-denied", n=1)
+    d = rec.to_dict()
+    assert d["rejections"] == {"held-by-other": 2, "selector-false": 5,
+                              "remote-denied": 1}
+    assert d["top_rejection"] == "selector-false"
+    assert "rejected[selector-false=5" in d["summary"]
+    assert "picked=0/1" in d["summary"]
+
+
+# ---------------------------------------------------------------------------
+# funnel correctness through a real Allocator
+# ---------------------------------------------------------------------------
+
+
+def _fleet(n_nodes=2, devices_per_node=4):
+    clients = ClientSets()
+    for i in range(n_nodes):
+        clients.resource_slices.create(
+            synthetic_slice(f"xp-{i}", devices_per_node))
+    return clients
+
+
+def test_allocated_claim_records_funnel():
+    explain.configure()
+    clients = _fleet()
+    claims = [clients.resource_claims.create(_claim(f"fu-{i}", f"c-{i}"))
+              for i in range(3)]
+    results = Allocator(clients, DRIVER).allocate_batch(claims)
+    assert all(r.committed for r in results.values())
+    rec = explain.lookup("fu-2")
+    assert rec["outcome"] == "allocated"
+    assert rec["claim"] == "ns/c-2"
+    req = rec["requests"][0]
+    # indexed probe on the type attribute, then the batch's earlier
+    # claims hold 2 of the candidates
+    assert req["index_probe"]["used_index"]
+    assert req["index_probe"]["constraints"] >= 1
+    assert req["candidates"] == 8
+    assert req["picked"] == 1
+    assert req["rejections"] == {"held-by-other": 2}
+    assert rec["top_rejection"] == "held-by-other"
+    assert len(rec["devices"]) == 1
+    assert rec["detail"] is None
+
+
+def test_unsatisfiable_claim_records_selector_rejections():
+    explain.configure()
+    clients = _fleet(n_nodes=1, devices_per_node=3)
+    # "model" is NOT an index attribute, so every candidate reaches the
+    # selector stage and fails there — the funnel must attribute all 3
+    claim = clients.resource_claims.create(_claim(
+        "fu-bad", "bad", selectors=[{"attribute": "model",
+                                     "equals": "no-such-model"}]))
+    res = Allocator(clients, DRIVER).allocate_batch([claim])["fu-bad"]
+    assert res.error is not None
+    rec = explain.lookup("fu-bad")
+    assert rec["outcome"] == "error"
+    assert "0/1" in rec["detail"]
+    req = rec["requests"][0]
+    assert req["candidates"] == 3
+    assert req["picked"] == 0
+    assert req["rejections"] == {"selector-false": 3}
+    assert rec["top_rejection"] == "selector-false"
+
+
+# ---------------------------------------------------------------------------
+# AllocationParked enrichment: the Event carries the explain verdict
+# ---------------------------------------------------------------------------
+
+
+def test_parked_event_and_debug_state_carry_explain_reason():
+    from tpu_dra_driver.kube.allocation_controller import (
+        AllocationController,
+        AllocationControllerConfig,
+    )
+    from tpu_dra_driver.kube.events import REASON_ALLOCATION_PARKED
+
+    clients = ClientSets()
+    clients.resource_slices.create(synthetic_slice("park-0", 1))
+    ctrl = AllocationController(
+        clients, AllocationControllerConfig(workers=1, retry_interval=0.3))
+    ctrl.start()
+    try:
+        clients.resource_claims.create(_claim("pk-fits", "fits"))
+        clients.resource_claims.create(_claim("pk-over", "overflow"))
+        deadline = time.monotonic() + 10.0
+        while ctrl.parked_claims() != [("ns", "overflow")]:
+            assert time.monotonic() < deadline, "overflow never parked"
+            time.sleep(0.01)
+        # the decision record is servable cross-surface by claim UID
+        rec = explain.lookup("pk-over")
+        assert rec["outcome"] == "error"
+        assert rec["top_rejection"] == "held-by-other"
+        # the Event body names the explain-derived reason: actionable
+        # straight from kubectl describe, no /debug access needed
+        ctrl.events.flush(timeout=2.0)
+        ev = next(e for e in clients.events.list()
+                  if e.get("reason") == REASON_ALLOCATION_PARKED)
+        assert "top rejection: held-by-other" in ev["message"]
+        assert "candidates=1" in ev["message"]
+        # /debug/allocator serves the per-reason park breakdown the
+        # doctor's PARKED_CLAIMS finding reports
+        state = ctrl.debug_state()
+        assert state["parked_reasons"] == {"held-by-other": 1}
+        (parked_row,) = state["parked_claims"]
+        assert parked_row["reason"] == "held-by-other"
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/explain + /debug/timeseries endpoints
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, ""
+
+
+def test_debug_explain_endpoints():
+    ring = explain.configure(capacity=8)
+    ring.append(_record("uid-x", outcome="allocated"))
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry())
+    srv.start()
+    try:
+        status, body = _get(srv.port, "/debug/explain")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["enabled"] and doc["size"] == 1
+        assert doc["records"][0]["claim_uid"] == "uid-x"
+        status, body = _get(srv.port, "/debug/explain/uid-x")
+        assert status == 200
+        assert json.loads(body)["outcome"] == "allocated"
+        status, _ = _get(srv.port, "/debug/explain/uid-absent")
+        assert status == 404
+        # disarmed: the surface stays up and SAYS it is disabled
+        explain.reset()
+        status, body = _get(srv.port, "/debug/explain")
+        assert status == 200
+        assert json.loads(body) == {"enabled": False, "records": []}
+        status, _ = _get(srv.port, "/debug/explain/uid-x")
+        assert status == 404
+    finally:
+        srv.stop()
+
+
+def test_debug_timeseries_endpoint():
+    srv = DebugHTTPServer(("127.0.0.1", 0), registry=Registry())
+    srv.start()
+    try:
+        status, body = _get(srv.port, "/debug/timeseries")
+        assert status == 200
+        assert json.loads(body) == {"enabled": False, "series": {}}
+        ring = metrics.timeseries_configure(interval=3600.0, start=False)
+        ring.tick()
+        ring.tick()
+        status, body = _get(srv.port, "/debug/timeseries")
+        doc = json.loads(body)
+        assert doc["enabled"] and doc["capacity"] == 360
+        # the default registry's own families are sampled
+        assert any(k.startswith("dra_timeseries_samples_total")
+                   for k in doc["series"])
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# commit_phase: span + histogram + exemplar in one helper
+# ---------------------------------------------------------------------------
+
+
+def test_commit_phase_observes_histogram_always():
+    def count():
+        snaps = metrics.ALLOCATION_COMMIT_PHASE_SECONDS.snapshots()
+        snap = snaps.get(("verify_read",))
+        return snap.count if snap is not None else 0
+
+    before = count()
+    assert not tracing.enabled() and not explain.enabled()
+    with explain.commit_phase("verify_read"):
+        pass
+    assert count() == before + 1
+
+
+def test_commit_phase_span_and_exemplar_when_tracing():
+    tracing.configure("always")
+    try:
+        root = tracing.start_span("allocator.commit")
+        with tracing.use_span(root):
+            with explain.commit_phase("status_write") as sp:
+                assert sp is not tracing.NOOP_SPAN
+        root.end()
+        spans = tracing.recorder().trace(root.context.trace_id)
+        names = {s["name"] for s in spans}
+        assert "allocator.commit.status_write" in names
+        # the histogram sample carries the child span's exemplar
+        text = metrics.DEFAULT_REGISTRY.render(exemplars=True)
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("dra_allocation_commit_phase_seconds_bucket")
+            and 'phase="status_write"' in ln and "trace_id" in ln)
+        assert root.context.trace_id in line
+    finally:
+        tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# the in-process time-series ring
+# ---------------------------------------------------------------------------
+
+
+def test_timeseries_ring_samples_and_recording_rules():
+    reg = Registry()
+    c = reg.counter("t_flow_total", "t")
+    g = reg.gauge("t_level", "t")
+    h = reg.histogram("t_lat_seconds", "t", buckets=(0.01, 0.1, 1.0))
+    ring = TimeSeriesRing(registry=reg, capacity=16, interval=5.0)
+    c.inc(10)
+    g.set(3)
+    for _ in range(9):
+        h.observe(0.005)
+    h.observe(0.5)
+    ring.tick(now=100.0)
+    c.inc(20)
+    g.set(7)
+    ring.tick(now=110.0)
+    assert ring.series("t_flow_total") == [(100.0, 10.0), (110.0, 30.0)]
+    # counter rate over the 10s between ticks
+    assert ring.series("t_flow_total:rate") == [(110.0, 2.0)]
+    assert ring.series("t_level") == [(100.0, 3.0), (110.0, 7.0)]
+    assert ring.series("t_lat_seconds:count")[-1] == (110.0, 10.0)
+    # first-window quantiles: p50 inside the cheap bucket, p99 in the
+    # slow one; the second window saw no traffic -> no new points
+    (t50, p50), = ring.series("t_lat_seconds:p50")
+    (t99, p99), = ring.series("t_lat_seconds:p99")
+    assert t50 == t99 == 100.0
+    assert p50 <= 0.01 and 0.1 < p99 <= 1.0
+
+
+def test_timeseries_ring_bounds_points_and_series():
+    reg = Registry()
+    g = reg.gauge("t_wide", "t", ("i",))
+    ring = TimeSeriesRing(registry=reg, capacity=4, max_series=3)
+    dropped0 = metrics.TIMESERIES_SERIES_DROPPED.value
+    for i in range(8):
+        g.labels(str(i)).set(i)
+    for tick in range(10):
+        ring.tick(now=float(tick))
+    payload = ring.payload()
+    # fixed memory: only max_series series retained, capacity points each
+    assert len(payload["series"]) == 3
+    assert all(len(pts) == 4 for pts in payload["series"].values())
+    assert metrics.TIMESERIES_SERIES_DROPPED.value > dropped0
+
+
+def test_timeseries_configure_replaces_and_resets():
+    r1 = metrics.timeseries_configure(interval=3600.0, start=False)
+    assert metrics.timeseries() is r1
+    r2 = metrics.timeseries_configure(interval=3600.0, capacity=10,
+                                      start=False)
+    assert metrics.timeseries() is r2 and r2 is not r1
+    metrics.timeseries_reset()
+    assert metrics.timeseries() is None
+
+
+def test_quantile_of_snapshot_interpolates_and_clamps():
+    reg = Registry()
+    h = reg.histogram("t_q_seconds", "t", buckets=(0.1, 1.0))
+    for _ in range(50):
+        h.observe(0.05)
+    for _ in range(50):
+        h.observe(0.5)
+    snap = h.snapshot()
+    assert quantile_of_snapshot(snap, 0.25) == pytest.approx(0.05)
+    # above the last finite bucket clamps to its bound
+    h.observe(100.0)
+    assert quantile_of_snapshot(h.snapshot(), 0.999) == 1.0
+    empty = h.snapshot().delta(h.snapshot())
+    assert quantile_of_snapshot(empty, 0.5) is None
+
+
+def test_least_squares_slope_units():
+    assert least_squares_slope([(0.0, 0.0), (10.0, 5.0)]) \
+        == pytest.approx(0.5)
+    assert least_squares_slope([(0.0, 3.0), (10.0, 3.0)]) == 0.0
+    assert least_squares_slope([(5.0, 1.0)]) is None
+    assert least_squares_slope([(5.0, 1.0), (5.0, 9.0)]) is None

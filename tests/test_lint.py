@@ -431,6 +431,12 @@ _SLO_EXEMPT = {
         "background maintenance off the claim-to-ready journey (the "
         "writer thread compacts after acking tickets); surfaced through "
         "the tpu-dra-doctor JOURNAL_BLOAT finding rather than an SLO",
+    "dra_allocation_commit_phase_seconds":
+        "phase-level breakdown of the commit path; the per-claim "
+        "dra_allocation_seconds carries the SLO, the "
+        "critical-path analyzer attributes the allocation.commit.* "
+        "segments, and the tpu-dra-doctor COMMIT_STALL finding is the "
+        "per-phase operational consumer",
 }
 
 

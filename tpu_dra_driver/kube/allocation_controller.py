@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tpu_dra_driver import DRIVER_NAME
 from tpu_dra_driver.kube import catalog as catalog_mod
+from tpu_dra_driver.kube import explain
 from tpu_dra_driver.kube import reservations as reservations_mod
 from tpu_dra_driver.kube import sharding
 from tpu_dra_driver.kube.allocator import Allocator
@@ -167,6 +168,11 @@ class AllocationController:
         self.events = EventRecorder(clients.events,
                                     component="allocation-controller",
                                     host=identity)
+        # arm the process-wide explain ring: every claim this
+        # controller's allocators drain leaves a decision record at
+        # /debug/explain/<uid> (idempotent — a ShardGroup's N
+        # controllers share the one ring)
+        explain.configure()
         self.allocator = Allocator(
             clients, self._config.driver_name,
             catalog=self.catalog, ledger=self.ledger,
@@ -222,6 +228,10 @@ class AllocationController:
         #: (_maybe_prune_parked) re-emits it verbatim so the recorder's
         #: dedupe bumps the existing Event instead of multiplying them
         self._parked_why: Dict[_Key, str] = {}
+        #: explain-derived top rejection reason per parked ref (e.g.
+        #: "selector-false") — /debug/allocator serves the per-reason
+        #: breakdown the doctor's park finding reports
+        self._parked_reason: Dict[_Key, str] = {}
         #: cross-shard routes for pending/parked claims, by key
         self._cross_routes: Dict[_Key, ShardRoute] = {}
         self._cross_allocators: Dict[Tuple[str, ...], Allocator] = {}
@@ -341,6 +351,7 @@ class AllocationController:
                 ALLOCATOR_PARKED_CLAIMS.dec()
             self._parked_refs.clear()
             self._parked_why.clear()
+            self._parked_reason.clear()
         self.events.stop(timeout=2.0)
 
     # -- shard routing -----------------------------------------------------
@@ -472,7 +483,21 @@ class AllocationController:
                "namespace": meta.get("namespace", ""),
                "uid": meta.get("uid", "")}
         self._parked_refs[key] = ref
-        self._parked_why[key] = f"allocation parked: {why[:240]}"
+        # enrich the Event body from the claim's explain record (when
+        # the ring holds one): the top rejection reason + the candidate
+        # funnel summary make the park actionable straight from
+        # `kubectl describe` — no /debug/explain round-trip needed
+        detail = ""
+        rec = explain.lookup(ref["uid"]) if ref["uid"] else None
+        if rec is not None:
+            top = rec.get("top_rejection")
+            self._parked_reason[key] = top or "no-candidates"
+            summary = rec.get("summary") or ""
+            if top:
+                detail = f" [top rejection: {top}; {summary}]"
+            elif summary:
+                detail = f" [{summary}]"
+        self._parked_why[key] = f"allocation parked: {why[:240]}{detail}"
         ALLOCATOR_PARKED_CLAIMS.inc()
         self.events.warning(ref, REASON_ALLOCATION_PARKED,
                             self._parked_why[key])
@@ -483,6 +508,7 @@ class AllocationController:
         Event and release the gauge."""
         ref = self._parked_refs.pop(key, None)
         self._parked_why.pop(key, None)
+        self._parked_reason.pop(key, None)
         if ref is not None:
             ALLOCATOR_PARKED_CLAIMS.dec()
             self.events.clear(ref, REASON_ALLOCATION_PARKED)
@@ -1096,8 +1122,13 @@ class AllocationController:
         ownership; collected verbatim into the tpu-dra-doctor bundle."""
         with self._cond:
             parked = [{"namespace": key[0], "name": key[1],
-                       "uid": ref.get("uid", "")}
+                       "uid": ref.get("uid", ""),
+                       "reason": self._parked_reason.get(key, "")}
                       for key, ref in self._parked_refs.items()]
+            parked_reasons: Dict[str, int] = {}
+            for key in self._parked_refs:
+                r = self._parked_reason.get(key) or "unknown"
+                parked_reasons[r] = parked_reasons.get(r, 0) + 1
             pending = len(self._pending)
             cross = len(self._cross_routes)
             inflight = self._inflight
@@ -1105,6 +1136,7 @@ class AllocationController:
             "pending": pending,
             "inflight_batches": inflight,
             "parked_claims": parked,
+            "parked_reasons": parked_reasons,
             "cross_shard_routes": cross,
             "catalog_version": self.catalog.version,
             "workers": self._config.workers,

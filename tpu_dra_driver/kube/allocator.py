@@ -70,6 +70,7 @@ from tpu_dra_driver.kube.catalog import (
     claim_allocated_keys,
     device_counter_consumption,
 )
+from tpu_dra_driver.kube import explain
 from tpu_dra_driver.kube import fencing as fencing_mod
 from tpu_dra_driver.kube.client import ClientSets
 from tpu_dra_driver.kube.errors import ConflictError, NotFoundError, StaleEpochError
@@ -448,6 +449,11 @@ class Allocator:
                     "claim": f"{meta.get('namespace', '')}/"
                              f"{meta.get('name', '')}",
                     "claim_uid": uid, "driver": self._driver})
+            # the decision explain record (kube/explain.py): None when
+            # the ring is disarmed — the standalone/bench paths pay one
+            # bool check here and one None check per candidate, nothing
+            # else
+            xrec = explain.begin(claim, self._driver, node_name)
             t0 = time.perf_counter()
             with tracing.use_span(root):
                 try:
@@ -455,11 +461,13 @@ class Allocator:
                         claim, snap, state, node_name)
                     out[uid] = AllocationResult(claim=updated,
                                                 committed=committed)
-                except StaleWriterError:
+                except StaleWriterError as e:
                     # fenced out: NOT a per-claim error — this process's
                     # lease tenure ended and everything it believes is
                     # suspect; the controller must demote wholesale
                     root.end(status="error")
+                    explain.finish(xrec, "aborted",
+                                   detail=f"fenced out: {e}")
                     raise
                 except AllocationAborted as e:
                     out[uid] = AllocationResult(error=str(e), aborted=True)
@@ -490,6 +498,24 @@ class Allocator:
             ALLOCATION_RESULTS.labels(result_label).inc()
             root.set_attribute("result", result_label)
             root.end(status="ok" if res.error is None else "error")
+            if xrec is not None:
+                ex = tracing.exemplar(root)
+                trace_id = ex["trace_id"] if ex else None
+                if res.error is None:
+                    devices = [
+                        f"{r.get('pool', '')}/{r.get('device', '')}"
+                        for r in ((((res.claim or {}).get("status") or {})
+                                   .get("allocation") or {})
+                                  .get("devices") or {}).get("results")
+                        or []]
+                    explain.finish(
+                        xrec,
+                        "allocated" if res.committed else "passthrough",
+                        devices=devices, trace_id=trace_id)
+                else:
+                    explain.finish(
+                        xrec, "aborted" if res.aborted else "error",
+                        detail=res.error, trace_id=trace_id)
             # explicit kind: claims from an informer LIST carry no
             # per-item "kind", and an empty involvedObject.kind would
             # hide the Event from kubectl describe's field selector
@@ -535,6 +561,7 @@ class Allocator:
         # opened, so the cross-process annotation parents downstream
         # spans on the root — not on a short-lived commit child
         trace_root = tracing.current_context()
+        xrec = explain.current()
         repicks = 0
         while True:
             results = []
@@ -552,8 +579,16 @@ class Allocator:
                 raise
             if self._ledger is None or not picked_entries:
                 break
-            if self._ledger.reserve(uid, picked_entries,
-                                    snap.counter_caps):
+            # phase 1 of the commit path: the ledger reservation (a
+            # remote cross-shard ledger's grant wait shows up inside as
+            # the await_grants child — reservations.py opens it)
+            with explain.commit_phase("reserve_phase1"):
+                reserved = self._ledger.reserve(uid, picked_entries,
+                                                snap.counter_caps)
+            if reserved:
+                if xrec is not None:
+                    xrec.note_reservation(op="reserve", ok=True,
+                                          attempt=repicks + 1)
                 break
             # Raced a concurrent claim between snapshot and reserve —
             # another worker in this process, or another REPLICA through
@@ -565,8 +600,13 @@ class Allocator:
             # attempts lost to exactly this storm. Re-pick against
             # refreshed usage truth instead (bounded): the loser simply
             # takes the next free device.
-            self._unwind(picked_entries, state)
+            with explain.commit_phase("unwind"):
+                self._unwind(picked_entries, state)
             repicks += 1
+            if xrec is not None:
+                xrec.repicks = repicks
+                xrec.note_reservation(op="reserve", ok=False,
+                                      attempt=repicks)
             if repicks > RESERVE_REPICK_ATTEMPTS:
                 raise AllocationError(
                     "allocation raced a concurrent claim; devices no "
@@ -578,9 +618,10 @@ class Allocator:
                 updated, committed = self._commit(claim, results,
                                                   trace_ctx=trace_root)
         except Exception:
-            self._unwind(picked_entries, state)
-            if self._ledger is not None:
-                self._ledger.release(uid)
+            with explain.commit_phase("unwind"):
+                self._unwind(picked_entries, state)
+                if self._ledger is not None:
+                    self._ledger.release(uid)
             raise
         self._reconcile_batch_state(updated, snap, state, picked_entries)
         return updated, committed
@@ -589,24 +630,49 @@ class Allocator:
                        state: _BatchState, node_name: Optional[str],
                        results: List[Dict],
                        picked_entries: List[DeviceEntry]) -> None:
+        xrec = explain.current()
+        denied = None
+        if xrec is not None:
+            # a remote cross-shard ledger exposes its denied-device
+            # steering set: a "taken" key in there was refused by a
+            # remote granter, not held by a committed claim — the funnel
+            # tells them apart
+            denied_fn = getattr(self._ledger, "denied_keys", None)
+            if denied_fn is not None:
+                denied = denied_fn()
         for req in ((claim.get("spec") or {}).get("devices") or {}
                     ).get("requests") or []:
             rname = req.get("name", "device")
             count = req.get("count", 1)
             selectors = req.get("selectors") or []
             admin = bool(req.get("adminAccess", False))
-            entries = self._candidates(snap, selectors, node_name)
+            xreq = (xrec.begin_request(rname, count)
+                    if xrec is not None else None)
+            rej = xreq.rejections if xreq is not None else None
+            entries = self._candidates(snap, selectors, node_name,
+                                       xreq=xreq)
             picked = 0
             for entry in entries:
                 if picked >= count:
                     break
                 dev = entry.device
                 if not admin and state.is_taken(entry.key):
+                    if rej is not None:
+                        reason = ("remote-denied"
+                                  if denied and entry.key in denied
+                                  else "held-by-other")
+                        rej[reason] = rej.get(reason, 0) + 1
                     continue
                 if not _matches(dev, selectors, driver=entry.driver):
+                    if rej is not None:
+                        rej["selector-false"] = \
+                            rej.get("selector-false", 0) + 1
                     continue
                 if not admin and not self._counters_fit(
                         entry, snap.counter_caps, state):
+                    if rej is not None:
+                        rej["counter-exhausted"] = \
+                            rej.get("counter-exhausted", 0) + 1
                     continue
                 # commit into the batch state
                 if not admin:
@@ -620,6 +686,8 @@ class Allocator:
                     **({"adminAccess": True} if admin else {}),
                 })
                 picked += 1
+            if xreq is not None:
+                xreq.picked = picked
             if picked < count:
                 raise AllocationError(
                     f"request {rname!r}: only {picked}/{count} devices "
@@ -650,17 +718,23 @@ class Allocator:
                     state.add_usage(ck, amount)
 
     def _candidates(self, snap: CatalogSnapshot, selectors: List[Dict],
-                    node_name: Optional[str]) -> List[DeviceEntry]:
+                    node_name: Optional[str],
+                    xreq=None) -> List[DeviceEntry]:
         if self._use_index:
             constraints = _index_constraints(selectors, self._driver)
             entries, used_index = snap.candidates(self._driver, node_name,
                                                   constraints)
         else:
+            constraints = ()
             entries = snap.all_candidates(self._driver, node_name)
             used_index = False
         ALLOCATOR_CANDIDATES_SCANNED.observe(len(entries))
         ALLOCATOR_INDEX_HITS.labels(
             "index" if used_index else "fallback").inc()
+        if xreq is not None:
+            xreq.probe_constraints = len(constraints)
+            xreq.used_index = used_index
+            xreq.candidates = len(entries)
         return entries
 
     @staticmethod
@@ -723,6 +797,9 @@ class Allocator:
                 # local state already knows — park the claim, it
                 # re-routes on the next pass (aborted: the rightful
                 # owner's attempt is the one availability judges)
+                xrec = explain.current()
+                if xrec is not None:
+                    xrec.note_rejection("fencing-stale")
                 raise AllocationAborted(f"fencing: {e}") from e
             fencing_mod.stamp(obj, epochs)
         try:
@@ -734,19 +811,24 @@ class Allocator:
             # rides the allocator.commit span so the critical-path
             # analyzer counts verify-on-commit retries per trace
             tracing.add_event("commit-conflict")
-            try:
-                fresh = self._clients.resource_claims.get(name, namespace)
-            except NotFoundError as e:
-                raise AllocationError(
-                    f"claim {namespace}/{name} deleted mid-allocation"
-                ) from e
+            with explain.commit_phase("verify_read"):
+                try:
+                    fresh = self._clients.resource_claims.get(name,
+                                                              namespace)
+                except NotFoundError as e:
+                    raise AllocationError(
+                        f"claim {namespace}/{name} deleted mid-allocation"
+                    ) from e
+                still_free = ((fresh.get("status") or {}).get("allocation")
+                              or self._devices_still_free(fresh, results))
             if (fresh.get("status") or {}).get("allocation"):
                 # a concurrent allocator won; ours is redundant
                 if self._ledger is not None:
-                    self._ledger.release(claim["metadata"]["uid"])
-                    self._ledger.observe_claim(fresh)
+                    with explain.commit_phase("phase2_graduate"):
+                        self._ledger.release(claim["metadata"]["uid"])
+                        self._ledger.observe_claim(fresh)
                 return fresh, False
-            if not self._devices_still_free(fresh, results):
+            if not still_free:
                 raise AllocationError(
                     "commit conflict: picked devices were allocated "
                     "concurrently")
@@ -764,7 +846,8 @@ class Allocator:
                     f"{namespace}/{name}: {e}") from e
         if self._ledger is not None:
             # the reservation graduates into the claim's ledger entry
-            self._ledger.observe_claim(updated)
+            with explain.commit_phase("phase2_graduate"):
+                self._ledger.observe_claim(updated)
         return updated, True
 
     def _fenced_update(self, obj: Dict, epochs) -> Dict:
@@ -776,12 +859,14 @@ class Allocator:
         :class:`StaleWriterError` so the controller demotes."""
         if epochs:
             try:
-                self._fencing.verify(epochs)
+                with explain.commit_phase("verify_read"):
+                    self._fencing.verify(epochs)
             except StaleWriterError:
                 FENCING_REJECTIONS.labels("allocator.verify").inc()
                 raise
         try:
-            return self._clients.resource_claims.update(obj)
+            with explain.commit_phase("status_write"):
+                return self._clients.resource_claims.update(obj)
         except StaleEpochError as e:
             FENCING_REJECTIONS.labels("allocator.commit").inc()
             raise StaleWriterError(str(e)) from e

@@ -1,0 +1,356 @@
+"""Allocation decision explain records — why the allocator picked or parked.
+
+Every claim through :meth:`Allocator.allocate_batch` can produce one
+bounded structured record of the decision: the index-probe plan, the
+candidate count each filter stage saw, a per-stage rejection histogram
+(``selector-false``, ``counter-exhausted``, ``held-by-other``,
+``fencing-stale``, ``remote-denied``), re-pick iterations, reservation
+phase outcomes, and the final placement or the reason the claim will
+park. Records live in a per-process bounded ring served at
+``/debug/explain[/<claim-uid>]`` (pkg/metrics.py DebugHTTPServer), and
+the top rejection reason is summarized into the ``AllocationParked``
+Event body so a parked claim is actionable straight from ``kubectl
+describe resourceclaim``.
+
+Design rules (the tracing/faultinject discipline):
+
+- **Disabled is free.** A module-global bool guards every entry point;
+  the allocator's hot loop pays one ``is not None`` check per candidate
+  and allocates nothing. The standalone/bench allocator paths never arm
+  the ring; the allocation controller arms it at construction.
+- **Eviction is never silent.** The ring is a fixed-capacity deque and
+  every record pushed out ticks ``dra_explain_evicted_total`` — the
+  FlightRecorder lesson (PR 8).
+- **Reads are frozen.** Records enter the ring only when *finished*
+  (immutable from then on) and ``payload()``/``lookup()`` copy the
+  membership under the ring lock, so a reader racing a live batch sees
+  a consistent prefix, never a half-built record.
+
+The commit-phase helper (:func:`commit_phase`) also lives here: one
+context manager that opens the ``allocator.commit.<phase>`` child span
+AND observes ``dra_allocation_commit_phase_seconds{phase}`` with the
+span's exemplar — allocator.py and reservations.py thread it through
+the verify-read / status-write / reserve-phase1 / await-grants /
+phase2-graduate / unwind legs of the commit path so the critical-path
+analyzer and the doctor's ``COMMIT_STALL`` finding see the same split.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from tpu_dra_driver.pkg import metrics, tracing
+
+#: records kept per process (one ring, shared by every allocator the
+#: controller rebuilds across hand-offs — same reasoning as the shared
+#: EventRecorder)
+DEFAULT_CAPACITY = 256
+
+#: the filter-stage taxonomy; every rejection a candidate or a claim
+#: suffers is counted under exactly one of these
+REJECTION_REASONS = ("selector-false", "counter-exhausted",
+                     "held-by-other", "fencing-stale", "remote-denied")
+
+#: the commit sub-segment taxonomy (span ``allocator.commit.<phase>``,
+#: critical-path segment ``allocation.commit.<phase>``, histogram label
+#: ``phase``) — keep the three surfaces in lockstep
+COMMIT_PHASES = ("verify_read", "status_write", "reserve_phase1",
+                 "await_grants", "phase2_graduate", "unwind")
+
+EXPLAIN_EVICTED = metrics.DEFAULT_REGISTRY.counter(
+    "dra_explain_evicted_total",
+    "Allocation explain records pushed out of the bounded decision "
+    "ring to make room for newer ones (served at /debug/explain; an "
+    "evicted claim's decision trace is gone)")
+
+_ENABLED = False
+_RING: Optional["ExplainRing"] = None
+_LOCAL = threading.local()
+
+
+class RequestExplain:
+    """The candidate funnel of ONE device request within a claim."""
+
+    __slots__ = ("name", "count", "probe_constraints", "used_index",
+                 "candidates", "rejections", "picked")
+
+    def __init__(self, name: str, count: int):
+        self.name = name
+        self.count = count
+        self.probe_constraints = 0
+        self.used_index = False
+        self.candidates = 0
+        #: reason -> candidates rejected at that stage (plain dict; the
+        #: pick loop increments it inline — one record is only ever
+        #: mutated by the worker thread allocating its claim)
+        self.rejections: Dict[str, int] = {}
+        self.picked = 0
+
+    def to_dict(self) -> Dict:
+        return {
+            "request": self.name,
+            "count": self.count,
+            "index_probe": {"constraints": self.probe_constraints,
+                            "used_index": self.used_index},
+            "candidates": self.candidates,
+            "rejections": dict(self.rejections),
+            "picked": self.picked,
+        }
+
+
+class ExplainRecord:
+    """The decision trace of one claim through one allocation attempt."""
+
+    __slots__ = ("claim_uid", "claim", "driver", "node", "started_unix",
+                 "finished_unix", "requests", "repicks", "reservations",
+                 "rejections", "outcome", "detail", "devices", "trace_id")
+
+    def __init__(self, claim_uid: str, claim: str, driver: str,
+                 node: Optional[str]):
+        self.claim_uid = claim_uid
+        self.claim = claim
+        self.driver = driver
+        self.node = node
+        self.started_unix = time.time()
+        self.finished_unix: Optional[float] = None
+        self.requests: List[RequestExplain] = []
+        self.repicks = 0
+        #: reservation-phase outcomes, in order (local reserve verdicts,
+        #: per-slot remote grant verdicts) — the two-phase protocol's
+        #: visible footprint
+        self.reservations: List[Dict] = []
+        #: claim-level rejections with no per-candidate stage
+        #: (fencing-stale, remote-denied at reserve time)
+        self.rejections: Dict[str, int] = {}
+        self.outcome = "in-flight"
+        self.detail: Optional[str] = None
+        self.devices: List[str] = []
+        self.trace_id: Optional[str] = None
+
+    # -- recording (worker thread only, no lock needed) -----------------
+
+    def begin_request(self, name: str, count: int) -> RequestExplain:
+        req = RequestExplain(name, count)
+        self.requests.append(req)
+        return req
+
+    def note_rejection(self, reason: str, n: int = 1) -> None:
+        self.rejections[reason] = self.rejections.get(reason, 0) + n
+
+    def note_reservation(self, **outcome) -> None:
+        self.reservations.append(outcome)
+
+    # -- reading --------------------------------------------------------
+
+    def rejection_totals(self) -> Dict[str, int]:
+        """Claim-level + per-request rejections merged, reason -> count."""
+        out = dict(self.rejections)
+        for req in self.requests:
+            for reason, n in req.rejections.items():
+                out[reason] = out.get(reason, 0) + n
+        return out
+
+    def top_rejection(self) -> Optional[str]:
+        totals = self.rejection_totals()
+        if not totals:
+            return None
+        return max(totals, key=lambda r: (totals[r], r))
+
+    def summary(self) -> str:
+        """One actionable line for the AllocationParked Event body."""
+        candidates = sum(r.candidates for r in self.requests)
+        picked = sum(r.picked for r in self.requests)
+        wanted = sum(r.count for r in self.requests)
+        totals = self.rejection_totals()
+        parts = [f"candidates={candidates}", f"picked={picked}/{wanted}"]
+        if totals:
+            rej = ",".join(f"{r}={totals[r]}"
+                           for r in sorted(totals, key=totals.get,
+                                           reverse=True))
+            parts.append(f"rejected[{rej}]")
+        if self.repicks:
+            parts.append(f"repicks={self.repicks}")
+        return " ".join(parts)
+
+    def to_dict(self) -> Dict:
+        dur = (None if self.finished_unix is None
+               else round((self.finished_unix - self.started_unix) * 1e3, 3))
+        return {
+            "claim_uid": self.claim_uid,
+            "claim": self.claim,
+            "driver": self.driver,
+            "node": self.node,
+            "started_unix": self.started_unix,
+            "finished_unix": self.finished_unix,
+            "duration_ms": dur,
+            "requests": [r.to_dict() for r in self.requests],
+            "repicks": self.repicks,
+            "reservations": list(self.reservations),
+            "rejections": self.rejection_totals(),
+            "top_rejection": self.top_rejection(),
+            "outcome": self.outcome,
+            "detail": self.detail,
+            "devices": list(self.devices),
+            "trace_id": self.trace_id,
+            "summary": self.summary(),
+        }
+
+
+class ExplainRing:
+    """Fixed-capacity ring of finished records, newest last, indexed by
+    claim UID (latest attempt wins)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self._records: deque = deque()
+        self._by_uid: Dict[str, ExplainRecord] = {}
+        self._mu = threading.Lock()
+
+    def append(self, rec: ExplainRecord) -> None:
+        with self._mu:
+            self._records.append(rec)
+            self._by_uid[rec.claim_uid] = rec
+            while len(self._records) > self.capacity:
+                evicted = self._records.popleft()
+                if self._by_uid.get(evicted.claim_uid) is evicted:
+                    del self._by_uid[evicted.claim_uid]
+                EXPLAIN_EVICTED.inc()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._records)
+
+    def lookup(self, claim_uid: str) -> Optional[Dict]:
+        """The latest finished record for a claim UID, or None."""
+        with self._mu:
+            rec = self._by_uid.get(claim_uid)
+        return rec.to_dict() if rec is not None else None
+
+    def record(self, claim_uid: str) -> Optional[ExplainRecord]:
+        with self._mu:
+            return self._by_uid.get(claim_uid)
+
+    def payload(self) -> Dict:
+        """The /debug/explain body: a frozen copy of the membership —
+        every listed record is finished and immutable."""
+        with self._mu:
+            records = list(self._records)
+        return {
+            "enabled": True,
+            "capacity": self.capacity,
+            "size": len(records),
+            "evicted": EXPLAIN_EVICTED.value,
+            "records": [r.to_dict() for r in reversed(records)],
+        }
+
+    def clear(self) -> None:
+        with self._mu:
+            self._records.clear()
+            self._by_uid.clear()
+
+
+# ---------------------------------------------------------------------------
+# module API (the tracing configure/reset shape)
+# ---------------------------------------------------------------------------
+
+def configure(capacity: int = DEFAULT_CAPACITY) -> ExplainRing:
+    """Arm the per-process decision ring (idempotent for the same
+    capacity; a different capacity replaces the ring)."""
+    global _ENABLED, _RING
+    if _RING is None or _RING.capacity != int(capacity):
+        _RING = ExplainRing(capacity)
+    _ENABLED = True
+    return _RING
+
+
+def reset() -> None:
+    """Disarm and drop the ring (tests)."""
+    global _ENABLED, _RING
+    _ENABLED = False
+    _RING = None
+    _LOCAL.rec = None
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def ring() -> Optional[ExplainRing]:
+    return _RING
+
+
+def begin(claim: Dict, driver: str,
+          node: Optional[str] = None) -> Optional[ExplainRecord]:
+    """Open the decision record for one claim on this worker thread.
+    Returns None (and allocates nothing) when explain is disarmed."""
+    if not _ENABLED:
+        return None
+    meta = claim.get("metadata") or {}
+    rec = ExplainRecord(
+        meta.get("uid", ""),
+        f"{meta.get('namespace', '')}/{meta.get('name', '')}",
+        driver, node)
+    _LOCAL.rec = rec
+    return rec
+
+
+def current() -> Optional[ExplainRecord]:
+    """This worker thread's in-flight record (None when disarmed or no
+    claim is being allocated) — reservations.py reports remote-denial
+    through this without plumbing the record through the ledger API."""
+    if not _ENABLED:
+        return None
+    return getattr(_LOCAL, "rec", None)
+
+
+def finish(rec: Optional[ExplainRecord], outcome: str,
+           detail: Optional[str] = None,
+           devices: Optional[List[str]] = None,
+           trace_id: Optional[str] = None) -> None:
+    """Seal the record and publish it to the ring (it becomes immutable
+    and reader-visible here, never earlier)."""
+    if rec is None:
+        return
+    rec.finished_unix = time.time()
+    rec.outcome = outcome
+    rec.detail = detail
+    if devices:
+        rec.devices = list(devices)
+    if trace_id:
+        rec.trace_id = trace_id
+    if getattr(_LOCAL, "rec", None) is rec:
+        _LOCAL.rec = None
+    ring_ = _RING
+    if _ENABLED and ring_ is not None:
+        ring_.append(rec)
+
+
+def lookup(claim_uid: str) -> Optional[Dict]:
+    """Latest finished record for a claim UID (controller Event
+    enrichment + /debug/explain/<uid>)."""
+    ring_ = _RING
+    return ring_.lookup(claim_uid) if ring_ is not None else None
+
+
+# ---------------------------------------------------------------------------
+# commit-path micro-attribution
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def commit_phase(phase: str):
+    """One commit sub-segment: opens the ``allocator.commit.<phase>``
+    child span (critical-path segment ``allocation.commit.<phase>``) and
+    observes ``dra_allocation_commit_phase_seconds{phase}`` with the
+    span's exemplar. Metrics always record; the span is free when
+    tracing is disabled."""
+    t0 = time.perf_counter()
+    with tracing.span("allocator.commit." + phase) as sp:
+        try:
+            yield sp
+        finally:
+            metrics.ALLOCATION_COMMIT_PHASE_SECONDS.labels(phase).observe(
+                time.perf_counter() - t0, exemplar=tracing.exemplar(sp))

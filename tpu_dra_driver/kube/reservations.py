@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
+from tpu_dra_driver.kube import explain
 from tpu_dra_driver.kube import fencing as fencing_mod
 from tpu_dra_driver.kube.catalog import CounterKey, DeviceEntry, DeviceKey
 from tpu_dra_driver.kube.errors import (
@@ -568,6 +569,12 @@ class RemoteCrossShardLedger:
                 del self._denied[k]
             return set(self._denied)
 
+    def denied_keys(self) -> Set[DeviceKey]:
+        """The live denial-steering set — the allocator's explain
+        funnel uses it to attribute a skipped device to
+        ``remote-denied`` rather than ``held-by-other``."""
+        return self._denied_keys()
+
     def held_by_other(self, keys: Iterable[DeviceKey], uid: str) -> bool:
         wanted = list(keys)
         return any(led.held_by_other(wanted, uid)
@@ -622,8 +629,13 @@ class RemoteCrossShardLedger:
                     home_epoch=self._home_epoch()))
             with self._mu:
                 self._requested[uid] = set(remote)
-            results = self._coord.await_grants(names, self._grant_timeout,
-                                               pump=self.pump)
+            # the commit path's grant wait, isolated as its own
+            # sub-segment (the reserve_phase1 span the allocator opened
+            # contains this wall time; the critical-path analyzer's
+            # child clipping splits them disjointly)
+            with explain.commit_phase("await_grants"):
+                results = self._coord.await_grants(
+                    names, self._grant_timeout, pump=self.pump)
         except StaleWriterError:
             self._rollback(uid, reserved_local, set(remote))
             raise
@@ -634,6 +646,7 @@ class RemoteCrossShardLedger:
             return False
         granted: Dict[str, int] = {}
         all_granted = True
+        xrec = explain.current()
         for slot, name in zip(sorted(remote), names):
             status = results.get(name) or {}
             if status.get("phase") != PHASE_GRANTED:
@@ -641,8 +654,19 @@ class RemoteCrossShardLedger:
                 # remember the contested devices (denial AND timeout:
                 # either way a rival likely holds them invisibly)
                 self._note_denied(remote[slot])
-            elif "epoch" in status:
-                granted[slot] = int(status["epoch"])
+                if xrec is not None:
+                    xrec.note_rejection("remote-denied",
+                                        n=len(remote[slot]))
+                    xrec.note_reservation(
+                        op="remote-grant", slot=slot,
+                        phase=status.get("phase", PHASE_REQUESTED),
+                        reason=status.get("reason", ""))
+            else:
+                if "epoch" in status:
+                    granted[slot] = int(status["epoch"])
+                if xrec is not None:
+                    xrec.note_reservation(op="remote-grant", slot=slot,
+                                          phase=PHASE_GRANTED)
         if not all_granted:
             self._rollback(uid, reserved_local, set(remote))
             return False

@@ -34,6 +34,12 @@ SEGMENT_BY_SPAN = {
     "allocator.allocate": "allocation",
     "allocator.pick": "allocation.pick",
     "allocator.commit": "allocation.commit",
+    "allocator.commit.verify_read": "allocation.commit.verify_read",
+    "allocator.commit.status_write": "allocation.commit.status_write",
+    "allocator.commit.reserve_phase1": "allocation.commit.reserve_phase1",
+    "allocator.commit.await_grants": "allocation.commit.await_grants",
+    "allocator.commit.phase2_graduate": "allocation.commit.phase2_graduate",
+    "allocator.commit.unwind": "allocation.commit.unwind",
     "kubelet.prepare": "prepare",
     "prepare.read_checkpoint": "prepare.read_checkpoint",
     "prepare.write_ahead": "prepare.write_ahead",

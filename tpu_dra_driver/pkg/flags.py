@@ -59,6 +59,17 @@ def add_common_flags(parser: EnvArgumentParser) -> None:
                         help="SLO engine evaluation interval in seconds "
                              "(pkg/slo.py: burn-rate gauges, /debug/slo, "
                              "SLOBurnRate Events); 0 disables the engine")
+    parser.add_argument("--timeseries-interval", env="TIMESERIES_INTERVAL",
+                        type=float, default=5.0,
+                        help="sampling interval in seconds for the "
+                             "in-process time-series ring (pkg/metrics "
+                             "TimeSeriesRing: periodic registry snapshot "
+                             "deltas + recording rules, served at "
+                             "/debug/timeseries); 0 disables the ring")
+    parser.add_argument("--timeseries-capacity", env="TIMESERIES_CAPACITY",
+                        type=int, default=360,
+                        help="points retained per series in the "
+                             "time-series ring (360 x 5s = 30 min)")
     parser.add_argument("--slo-windows", env="SLO_WINDOWS", default="",
                         help="burn-rate windows as "
                              "name:long/short:threshold[,...] in seconds "
@@ -144,6 +155,18 @@ def setup_observability(args: argparse.Namespace, component: str) -> None:
         engine.start()
     else:
         slo.configure(None)
+    # in-process time-series ring (--timeseries-interval/-capacity):
+    # same opt-in shape as the SLO engine — absent attribute or 0 means
+    # no sampler thread (the ring reads the registry; hot paths never
+    # see it either way)
+    from tpu_dra_driver.pkg import metrics
+    ts_interval = getattr(args, "timeseries_interval", 0.0)
+    if ts_interval and ts_interval > 0:
+        metrics.timeseries_configure(
+            interval=ts_interval,
+            capacity=getattr(args, "timeseries_capacity", 360))
+    else:
+        metrics.timeseries_reset()
 
 
 _PROCESS_START_UNIX = time.time()

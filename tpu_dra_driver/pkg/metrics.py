@@ -21,6 +21,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -616,6 +617,16 @@ ALLOCATOR_PARKED_CLAIMS = DEFAULT_REGISTRY.gauge(
     "ResourceClaims currently parked as unsatisfiable (no capacity or "
     "cross-shard ownership not converged), awaiting a fleet change; "
     "each parked claim also carries an AllocationParked Event")
+ALLOCATION_COMMIT_PHASE_SECONDS = DEFAULT_REGISTRY.histogram(
+    "dra_allocation_commit_phase_seconds",
+    "Allocation commit-path wall time by sub-phase (verify_read / "
+    "status_write / reserve_phase1 / await_grants / phase2_graduate / "
+    "unwind) — the micro-attribution of the soak-dominant "
+    "allocation.commit segment; each bucket carries the sub-span's "
+    "trace exemplar on /metrics?exemplars=1",
+    ("phase",),
+    buckets=(1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
 CATALOG_SNAPSHOT_SECONDS = DEFAULT_REGISTRY.histogram(
     "dra_catalog_snapshot_seconds",
     "Wall time to obtain one consistent per-batch view, by source: "
@@ -768,6 +779,234 @@ INFORMER_LISTER_HITS = DEFAULT_REGISTRY.counter(
     ("resource",))
 
 
+# ---------------------------------------------------------------------------
+# In-process time-series ring (a small fixed-memory TSDB). A sampler
+# periodically snapshots every registered family into bounded per-series
+# rings — counters and gauges as raw values, histograms through recording
+# rules (windowed p50/p99 over the delta since the previous tick, plus a
+# per-second rate) — served at /debug/timeseries. Consumers: the doctor's
+# LEAK_SUSPECTED / LEASE_FLAPPING trend fits (one fetch replaces the
+# fleet-wide --resample sleep window), its sparkline bundle summaries, and
+# the soak's leak sentinels.
+# ---------------------------------------------------------------------------
+
+TIMESERIES_SAMPLES = DEFAULT_REGISTRY.counter(
+    "dra_timeseries_samples_total",
+    "Sampling ticks the in-process time-series ring has taken over the "
+    "registry (each tick appends one point per live series)")
+TIMESERIES_SERIES_DROPPED = DEFAULT_REGISTRY.counter(
+    "dra_timeseries_series_dropped_total",
+    "New series the time-series ring refused because its fixed-memory "
+    "series cap was reached (existing series keep sampling; the "
+    "dropped family/labelset is absent from /debug/timeseries)")
+
+
+def quantile_of_snapshot(snap: HistogramSnapshot,
+                         q: float) -> Optional[float]:
+    """Linear-interpolated quantile over a (windowed) histogram
+    snapshot's buckets — the recording-rule math for the time-series
+    ring and the bench arms. None when the window saw no traffic;
+    observations above the last finite bucket clamp to that bound (the
+    classic histogram_quantile behavior)."""
+    if snap.count <= 0 or not snap.buckets:
+        return None
+    target = q * snap.count
+    cum = 0.0
+    prev_bound = 0.0
+    for bound, c in zip(snap.buckets, snap.counts):
+        if c and cum + c >= target:
+            frac = (target - cum) / c
+            return prev_bound + (bound - prev_bound) * frac
+        cum += c
+        prev_bound = bound
+    return snap.buckets[-1]
+
+
+def least_squares_slope(points: Sequence[Tuple[float, float]]
+                        ) -> Optional[float]:
+    """Per-second slope of a [(unix_ts, value), ...] series via ordinary
+    least squares — the trend fit that upgrades two-point resample
+    deltas. None for fewer than 2 points or a zero time span."""
+    if len(points) < 2:
+        return None
+    n = float(len(points))
+    mean_t = sum(p[0] for p in points) / n
+    mean_v = sum(p[1] for p in points) / n
+    num = sum((t - mean_t) * (v - mean_v) for t, v in points)
+    den = sum((t - mean_t) ** 2 for t, _ in points)
+    if den == 0:
+        return None
+    return num / den
+
+
+class TimeSeriesRing:
+    """Fixed-memory samples of a registry's families over time.
+
+    Each tick appends (unix_ts, value) to a bounded per-series deque:
+
+    - counters/gauges: one series per labelset, the raw value (a
+      counter reset shows as a drop; readers apply the standard
+      reset rule), plus a ``<name>:rate`` recording rule for counters
+      (per-second delta vs the previous tick, reset -> resample);
+    - histograms: ``<name>:count`` (cumulative observations) plus the
+      ``<name>:p50`` / ``<name>:p99`` recording rules evaluated over
+      the delta window since the previous tick (no point when the
+      window saw no traffic).
+
+    Memory is bounded two ways: ``capacity`` points per series and
+    ``max_series`` series total (overflow counts under
+    ``dra_timeseries_series_dropped_total`` — never silent)."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 capacity: int = 360, interval: float = 5.0,
+                 max_series: int = 4096):
+        self._registry = registry or DEFAULT_REGISTRY
+        self.capacity = int(capacity)
+        self.interval = float(interval)
+        self.max_series = int(max_series)
+        self._series: Dict[str, deque] = {}
+        self._prev_hist: Dict[str, HistogramSnapshot] = {}
+        self._prev_counter: Dict[str, Tuple[float, float]] = {}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sampling -------------------------------------------------------
+
+    def _append(self, key: str, t: float, v: float) -> None:
+        ring = self._series.get(key)
+        if ring is None:
+            if len(self._series) >= self.max_series:
+                TIMESERIES_SERIES_DROPPED.inc()
+                return
+            ring = deque(maxlen=self.capacity)
+            self._series[key] = ring
+        ring.append((t, v))
+
+    @staticmethod
+    def _key(name: str, label_names: Sequence[str],
+             label_values: Sequence[str], rule: str = "") -> str:
+        base = name + (":" + rule if rule else "")
+        return base + _format_labels(label_names, label_values)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Take one sample of every registered family. Reader-side by
+        design: the instrumented hot paths never see the ring — armed
+        or not, ``observe()``/``inc()`` cost is unchanged."""
+        t = time.time() if now is None else now
+        with self._registry._mu:
+            metrics_list = list(self._registry._metrics.values())
+        with self._mu:
+            for m in metrics_list:
+                if isinstance(m, Counter):
+                    for key, value in m.values().items():
+                        skey = self._key(m.name, m.label_names, key)
+                        self._append(skey, t, value)
+                        prev = self._prev_counter.get(skey)
+                        if prev is not None and t > prev[0] \
+                                and value >= prev[1]:
+                            self._append(
+                                self._key(m.name, m.label_names, key,
+                                          "rate"),
+                                t, (value - prev[1]) / (t - prev[0]))
+                        self._prev_counter[skey] = (t, value)
+                elif isinstance(m, Gauge):
+                    for key, child in m._iter_children():
+                        self._append(
+                            self._key(m.name, m.label_names, key),
+                            t, child.value)
+                elif isinstance(m, Histogram):
+                    for key, snap in m.snapshots().items():
+                        skey = self._key(m.name, m.label_names, key)
+                        self._append(self._key(m.name, m.label_names,
+                                               key, "count"),
+                                     t, snap.count)
+                        window = snap.delta(self._prev_hist.get(skey))
+                        self._prev_hist[skey] = snap
+                        if window.count > 0:
+                            for rule, q in (("p50", 0.5), ("p99", 0.99)):
+                                v = quantile_of_snapshot(window, q)
+                                if v is not None:
+                                    self._append(
+                                        self._key(m.name, m.label_names,
+                                                  key, rule), t, v)
+            TIMESERIES_SAMPLES.inc()
+
+    # -- background sampler ---------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — sampler must survive
+                    SWALLOWED_ERRORS.labels("timeseries.tick").inc()
+
+        self._thread = threading.Thread(target=_run, name="timeseries",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- reading --------------------------------------------------------
+
+    def series(self, key: str) -> List[Tuple[float, float]]:
+        with self._mu:
+            ring = self._series.get(key)
+            return list(ring) if ring is not None else []
+
+    def payload(self) -> Dict:
+        """The /debug/timeseries body."""
+        with self._mu:
+            series = {k: [[round(t, 3), v] for t, v in ring]
+                      for k, ring in sorted(self._series.items())}
+        return {
+            "enabled": True,
+            "interval_s": self.interval,
+            "capacity": self.capacity,
+            "series": series,
+        }
+
+
+_TIMESERIES: Optional[TimeSeriesRing] = None
+
+
+def timeseries_configure(interval: float = 5.0, capacity: int = 360,
+                         registry: Optional[Registry] = None,
+                         start: bool = True) -> TimeSeriesRing:
+    """Arm the process-global time-series ring (flags.py wires this from
+    --timeseries-interval; interval <= 0 leaves it disarmed). Replaces a
+    prior ring (its sampler is stopped first)."""
+    global _TIMESERIES
+    if _TIMESERIES is not None:
+        _TIMESERIES.stop()
+    _TIMESERIES = TimeSeriesRing(registry=registry, capacity=capacity,
+                                 interval=interval)
+    if start:
+        _TIMESERIES.start()
+    return _TIMESERIES
+
+
+def timeseries() -> Optional[TimeSeriesRing]:
+    return _TIMESERIES
+
+
+def timeseries_reset() -> None:
+    """Disarm and drop the process-global ring (tests)."""
+    global _TIMESERIES
+    if _TIMESERIES is not None:
+        _TIMESERIES.stop()
+    _TIMESERIES = None
+
+
 class QueueMetrics:
     """client-go workqueue metric set for one named queue.
 
@@ -814,8 +1053,11 @@ class DebugHTTPServer:
     recorder at /debug/traces + /debug/traces/<trace-id>
     (pkg/tracing.py; empty JSON when tracing is disabled), the SLO
     engine at /debug/slo (pkg/slo.py), latency attribution at
-    /debug/criticalpath[/<trace-id>] (pkg/criticalpath.py), and
-    process vars at /debug/vars (``json_endpoints`` — build info,
+    /debug/criticalpath[/<trace-id>] (pkg/criticalpath.py), the
+    allocation decision ring at /debug/explain[/<claim-uid>]
+    (kube/explain.py; ``enabled: false`` when disarmed), the
+    time-series ring at /debug/timeseries (:func:`timeseries_configure`),
+    and process vars at /debug/vars (``json_endpoints`` — build info,
     uptime, parsed flags, trace mode, fault-point arm state; the
     ``tpu-dra-doctor`` must-gather collects all of these).
 
@@ -917,6 +1159,31 @@ class DebugHTTPServer:
                                    "application/json")
                     else:
                         self._send(404, "trace not found")
+                elif path == "/debug/timeseries" \
+                        or path == "/debug/timeseries/":
+                    ts = timeseries()
+                    body = (ts.payload() if ts is not None
+                            else {"enabled": False, "series": {}})
+                    self._send(200, json.dumps(body, indent=1),
+                               "application/json")
+                elif path == "/debug/explain" or path == "/debug/explain/":
+                    # lazy import (mirrors the tracing routes): pkg never
+                    # imports kube at module load
+                    from tpu_dra_driver.kube import explain
+                    ring = explain.ring()
+                    body = (ring.payload() if ring is not None
+                            else {"enabled": False, "records": []})
+                    self._send(200, json.dumps(body, indent=1),
+                               "application/json")
+                elif path.startswith("/debug/explain/"):
+                    from tpu_dra_driver.kube import explain
+                    uid = path[len("/debug/explain/"):]
+                    rec = explain.lookup(uid)
+                    if rec is not None:
+                        self._send(200, json.dumps(rec, indent=1),
+                                   "application/json")
+                    else:
+                        self._send(404, "explain record not found")
                 elif path in outer._json_endpoints:
                     try:
                         body = json.dumps(outer._json_endpoints[path](),

@@ -5,11 +5,13 @@ pattern — when a fleet misbehaves, the first ask is always "collect
 everything and send it over". This module is the collection AND the
 first read: it pulls every observability surface this driver exposes
 (``/metrics``, ``/debug/traces``, ``/debug/slo``,
-``/debug/criticalpath``, ``/debug/vars``, ``/debug/allocator``) from
+``/debug/criticalpath``, ``/debug/vars``, ``/debug/allocator``,
+``/debug/explain``, ``/debug/timeseries``) from
 every component endpoint, plus checkpoint state dirs and recent
 Kubernetes Events, into one tarball — then runs automated findings
-over the bundle (breaker open, SLO burning, parked claims, shard
-imbalance, watch-mux lag, quarantined checkpoints, evicted traces) and
+over the bundle (breaker open, SLO burning, parked claims with
+per-reason breakdowns, shard imbalance, watch-mux lag, commit-phase
+stalls, quarantined checkpoints, evicted traces) and
 prints a severity-sorted triage summary, so the operator starts from
 "here is what is wrong" instead of from raw text exposition.
 
@@ -37,6 +39,8 @@ ENDPOINT_PATHS = {
     "criticalpath": "/debug/criticalpath",
     "vars": "/debug/vars",
     "allocator": "/debug/allocator",
+    "explain": "/debug/explain",
+    "timeseries": "/debug/timeseries",
 }
 
 CRITICAL = "critical"
@@ -62,6 +66,16 @@ LEAK_GAUGE_DELTAS = {
     "dra_allocator_parked_claims": 2.0,
 }
 LEAK_STATE_DIR_BYTES_THRESHOLD = 4096
+
+#: a commit sub-phase whose p99 reaches this flags COMMIT_STALL — the
+#: whole-commit SLO budget is sub-second, so one phase eating a quarter
+#: second of it names the concrete perf target.
+COMMIT_STALL_P99_THRESHOLD_S = 0.25
+
+#: trailing window (seconds) the time-series-ring trend fits cover when
+#: a component exposes /debug/timeseries (replaces the sleep-based
+#: two-point --resample delta for that component).
+TREND_WINDOW_S = 120.0
 
 #: journal records past this flag JOURNAL_BLOAT — mirrors the plugin's
 #: own compaction trigger (plugin/checkpoint.py
@@ -172,6 +186,87 @@ def histogram_quantile(samples: Dict, family: str, q: float
         if cum[bound] >= q * total:
             return bound
     return float("inf")
+
+
+def histogram_quantile_by(samples: Dict, family: str, q: float,
+                          label: str) -> Dict[str, float]:
+    """Per-label-value quantile upper bounds for ``family`` — what
+    :func:`histogram_quantile` cannot answer, because it sums label
+    sets (the COMMIT_STALL finding needs p99 PER commit phase, not of
+    the blended family)."""
+    counts: Dict[str, float] = {}
+    for labels, value in samples.get(f"{family}_count", []):
+        lv = labels.get(label, "")
+        counts[lv] = counts.get(lv, 0.0) + value
+    out: Dict[str, float] = {}
+    for lv, total in counts.items():
+        if total <= 0:
+            continue
+        cum: Dict[float, float] = {}
+        for labels, value in samples.get(f"{family}_bucket", []):
+            if labels.get(label, "") != lv:
+                continue
+            le = labels.get("le", "")
+            bound = float("inf") if le == "+Inf" else float(le)
+            cum[bound] = cum.get(bound, 0.0) + value
+        for bound in sorted(cum):
+            if cum[bound] >= q * total:
+                out[lv] = bound
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# time-series ring reads (/debug/timeseries artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _has_timeseries(art: Dict) -> bool:
+    """True when the component's ring is armed AND already holds a
+    usable delta window (>= 2 points on some series)."""
+    ts = art.get("timeseries") or {}
+    return bool(ts.get("enabled")) and any(
+        len(points) >= 2 for points in (ts.get("series") or {}).values())
+
+
+def timeseries_delta(art: Dict, family: str,
+                     window_s: float = TREND_WINDOW_S) -> Optional[float]:
+    """Growth of ``family`` over the trailing window of the component's
+    time-series ring, summed across label sets (raw series only —
+    recording-rule series like ``:rate`` are skipped). None when the
+    ring is absent or holds no usable points for the family."""
+    ts = art.get("timeseries") or {}
+    if not ts.get("enabled"):
+        return None
+    total: Optional[float] = None
+    for key, points in (ts.get("series") or {}).items():
+        if key.split("{", 1)[0] != family or len(points) < 2:
+            continue
+        t_last, v_last = points[-1]
+        cutoff = t_last - window_s
+        v_first = next((v for t, v in points if t >= cutoff), None)
+        if v_first is None:
+            continue
+        total = (total or 0.0) + (v_last - v_first)
+    return total
+
+
+def timeseries_slope(art: Dict, family: str) -> Optional[float]:
+    """Least-squares per-second trend of ``family``'s raw series
+    (summed across label sets) — the fit that tells monotone growth
+    from a step that already settled. None without usable data."""
+    from tpu_dra_driver.pkg.metrics import least_squares_slope
+    ts = art.get("timeseries") or {}
+    if not ts.get("enabled"):
+        return None
+    total: Optional[float] = None
+    for key, points in (ts.get("series") or {}).items():
+        if key.split("{", 1)[0] != family:
+            continue
+        s = least_squares_slope([(t, v) for t, v in points])
+        if s is not None:
+            total = (total or 0.0) + s
+    return total
 
 
 # ---------------------------------------------------------------------------
@@ -385,14 +480,21 @@ def collect(endpoints: Dict[str, str],
         "state_dirs": first_state,
     }
     if resample_after > 0:
-        time.sleep(resample_after)
-        for name, hp in endpoints.items():
-            resample_metrics(hp, components[name], timeout)
-        # state dirs resample too: checkpoint-dir byte growth within
-        # the same shared window feeds LEAK_SUSPECTED
-        bundle["state_dirs_resample"] = {
-            name: collect_state_dir(p)
-            for name, p in (state_dirs or {}).items()}
+        # components whose /debug/timeseries ring is armed already hold
+        # a real delta window in the first fetch — the sleep-based
+        # two-point fallback only covers components WITHOUT the ring
+        # (and state dirs, whose byte growth is filesystem-side)
+        no_ring = {name: hp for name, hp in endpoints.items()
+                   if not _has_timeseries(components[name])}
+        if no_ring or state_dirs:
+            time.sleep(resample_after)
+            for name, hp in no_ring.items():
+                resample_metrics(hp, components[name], timeout)
+            # state dirs resample too: checkpoint-dir byte growth within
+            # the same shared window feeds LEAK_SUSPECTED
+            bundle["state_dirs_resample"] = {
+                name: collect_state_dir(p)
+                for name, p in (state_dirs or {}).items()}
     if clients is not None:
         bundle["events"] = collect_events(clients)
     return bundle
@@ -439,13 +541,18 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
 
     parked = metric_value(samples, "dra_allocator_parked_claims")
     if parked > 0:
+        allocator_art = art.get("allocator") or {}
         uids = [c.get("uid", "") for c in
-                (art.get("allocator") or {}).get("parked_claims") or []]
+                allocator_art.get("parked_claims") or []]
+        reasons = allocator_art.get("parked_reasons") or {}
+        why = (f" — by explain-derived reason: "
+               f"{dict(sorted(reasons.items()))}" if reasons else "")
         out.append(Finding(
             WARNING, "PARKED_CLAIMS", name,
             f"{int(parked)} ResourceClaim(s) parked as unsatisfiable "
-            f"(each carries an AllocationParked Event)",
-            {"count": int(parked), "uids": uids}))
+            f"(each carries an AllocationParked Event){why}",
+            {"count": int(parked), "uids": uids,
+             "by_reason": reasons}))
 
     residue = (art.get("allocator") or {}).get("residue") or {}
     residue_total = (residue.get("extra_count", 0)
@@ -489,6 +596,23 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
             f"falling behind the watch streams",
             {"p99_upper_bound_s": lag_p99}))
 
+    phase_p99 = histogram_quantile_by(
+        samples, "dra_allocation_commit_phase_seconds", 0.99, "phase")
+    if phase_p99:
+        dominant = max(phase_p99, key=phase_p99.get)
+        if phase_p99[dominant] >= COMMIT_STALL_P99_THRESHOLD_S:
+            out.append(Finding(
+                WARNING, "COMMIT_STALL", name,
+                f"allocation commit sub-phase {dominant!r} p99 >= "
+                f"{phase_p99[dominant]}s (threshold "
+                f"{COMMIT_STALL_P99_THRESHOLD_S}s): one phase dominates "
+                f"the commit path — cross-reference "
+                f"/debug/criticalpath's allocation.commit.* segments "
+                f"and the phase's exemplar trace",
+                {"phase": dominant,
+                 "p99_upper_bound_s": phase_p99[dominant],
+                 "per_phase_p99_s": phase_p99}))
+
     rejections = metric_value(samples, "dra_fencing_rejections_total")
     if rejections > 0:
         by_site = {labels.get("site", "?"): value for labels, value in
@@ -505,17 +629,24 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
     flap_now = metric_value(samples, "dra_leader_transitions_total")
     resample = (parse_metrics_text(art["metrics_resample"])
                 if "metrics_resample" in art else None)
-    if resample is not None:
-        delta = metric_value(resample,
-                             "dra_leader_transitions_total") - flap_now
-        if delta >= LEASE_FLAP_DELTA_THRESHOLD:
+    has_ring = _has_timeseries(art)
+    flap_delta = (timeseries_delta(art, "dra_leader_transitions_total")
+                  if has_ring else None)
+    if flap_delta is None and resample is not None:
+        flap_delta = metric_value(resample,
+                                  "dra_leader_transitions_total") - flap_now
+    if flap_delta is not None:
+        if flap_delta >= LEASE_FLAP_DELTA_THRESHOLD:
+            window = ("the time-series ring's trailing window"
+                      if has_ring else "the bundle's resample window")
             out.append(Finding(
                 WARNING, "LEASE_FLAPPING", name,
-                f"{int(delta)} leadership transition(s) within the "
-                f"bundle's resample window: leases are flapping "
+                f"{int(flap_delta)} leadership transition(s) within "
+                f"{window}: leases are flapping "
                 f"(renewals racing expiry — look for clock trouble, "
                 f"API latency, or overloaded holders)",
-                {"delta_in_window": int(delta)}))
+                {"delta_in_window": int(flap_delta),
+                 "source": "timeseries" if has_ring else "resample"}))
     elif flap_now >= LEASE_FLAP_ABSOLUTE_THRESHOLD:
         out.append(Finding(
             WARNING, "LEASE_FLAPPING", name,
@@ -524,7 +655,28 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
             f"to confirm it is ongoing)",
             {"total": int(flap_now)}))
 
-    if resample is not None:
+    if has_ring:
+        # trend fit over the real series: growth over the window AND a
+        # positive least-squares slope — a step that already settled
+        # (one reconnect wave) no longer pages as a leak
+        grew = {}
+        for family, threshold in LEAK_GAUGE_DELTAS.items():
+            delta = timeseries_delta(art, family)
+            slope = timeseries_slope(art, family)
+            if delta is not None and delta >= threshold \
+                    and slope is not None and slope > 0:
+                grew[family] = {"delta_in_window": delta,
+                                "slope_per_s": round(slope, 6)}
+        if grew:
+            out.append(Finding(
+                WARNING, "LEAK_SUSPECTED", name,
+                f"sustained upward trend over the time-series ring: "
+                f"{ {k: v['delta_in_window'] for k, v in grew.items()} } "
+                f"with positive least-squares slope — long-horizon decay "
+                f"a one-shot scrape cannot see (watchers that are never "
+                f"released / parked claims that never drain)",
+                {"grew": grew, "source": "timeseries"}))
+    elif resample is not None:
         grew = {}
         for family, threshold in LEAK_GAUGE_DELTAS.items():
             delta = metric_value(resample, family) \
@@ -539,7 +691,7 @@ def _component_findings(name: str, art: Dict) -> List[Finding]:
                 f"decay a one-shot scrape cannot see (watchers that are "
                 f"never released / parked claims that never drain); "
                 f"re-collect with a longer --resample to confirm",
-                {"grew": grew}))
+                {"grew": grew, "source": "resample"}))
 
     quarantined = metric_value(samples, "dra_checkpoint_quarantined_total")
     if quarantined > 0:
@@ -680,6 +832,43 @@ def summary_text(findings: List[Finding], bundle: Dict) -> str:
 # ---------------------------------------------------------------------------
 
 
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float]) -> str:
+    """Min-max-normalized unicode sparkline for one series."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK_CHARS[0] * len(values)
+    return "".join(
+        _SPARK_CHARS[min(len(_SPARK_CHARS) - 1,
+                         int((v - lo) / (hi - lo) * len(_SPARK_CHARS)))]
+        for v in values)
+
+
+def component_sparklines(art: Dict, max_series: int = 64,
+                         points: int = 60) -> str:
+    """One text line per ring series — the at-a-glance shape of a
+    component's recent behavior, embedded in the bundle so triage does
+    not need a plotting stack."""
+    ts = art.get("timeseries") or {}
+    series = ts.get("series") or {}
+    lines = [f"interval={ts.get('interval_s')}s "
+             f"capacity={ts.get('capacity')} series={len(series)}"]
+    for key in sorted(series)[:max_series]:
+        vals = [v for _, v in series[key][-points:]]
+        if not vals:
+            continue
+        lines.append(f"{key:70s} [{min(vals):.6g}..{max(vals):.6g}] "
+                     f"{sparkline(vals)}")
+    if len(series) > max_series:
+        lines.append(f"... {len(series) - max_series} more series in "
+                     f"timeseries.json")
+    return "\n".join(lines) + "\n"
+
+
 def _add_member(tar: tarfile.TarFile, name: str, text: str) -> None:
     data = text.encode()
     info = tarfile.TarInfo(name)
@@ -704,6 +893,9 @@ def write_bundle(bundle: Dict, findings: List[Finding],
                 else:
                     _add_member(tar, f"{name}/{key}.json",
                                 json.dumps(art[key], indent=1))
+            if _has_timeseries(art):
+                _add_member(tar, f"{name}/sparklines.txt",
+                            component_sparklines(art))
             if art.get("errors"):
                 _add_member(tar, f"{name}/errors.json",
                             json.dumps(art["errors"], indent=1))
